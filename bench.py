@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic img/sec (data-parallel).
+
+TPU-native analog of the reference's synthetic benchmark
+(/root/reference/examples/pytorch/pytorch_synthetic_benchmark.py): random
+image batches through ResNet-50 with the DistributedOptimizer train step,
+img/sec reported over timed iterations.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_synthetic_img_sec_per_chip", "value": N,
+   "unit": "img/sec/chip", "vs_baseline": N}
+
+vs_baseline compares per-chip throughput against the reference's documented
+tf_cnn_benchmarks ResNet-101 example output (1656.82 img/sec on 16 P100s =
+103.55 img/sec/GPU, /root/reference/docs/benchmarks.rst:30-42) — the only
+quantitative throughput figure the reference publishes.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models.resnet import ResNet50
+from horovod_tpu.training import (init_replicated, make_train_step,
+                                  shard_batch)
+
+BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:30-42
+
+
+def main():
+    hvd.init()
+    mesh = hvd.core.basics.get_mesh()
+    n_dev = hvd.size()
+    platform = jax.devices()[0].platform
+
+    # Per-chip batch sized for one v5e chip in bf16; smaller on CPU so the
+    # harness still runs in CI.
+    per_chip_batch = 64 if platform == "tpu" else 2
+    batch = per_chip_batch * n_dev
+    image_size = 224 if platform == "tpu" else 64
+    num_warmup = 2 if platform != "tpu" else 4
+    num_iters = 3 if platform != "tpu" else 10
+
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    variables = model.init(rng, dummy, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    tx = optax.sgd(0.01, momentum=0.9)
+    params = init_replicated(params, mesh)
+    batch_stats = init_replicated(batch_stats, mesh)
+    step = make_train_step(model.apply, tx, mesh, has_batch_stats=True)
+    opt_state = init_replicated(step.init_opt_state(params), mesh)
+
+    images = shard_batch(
+        np.random.rand(batch, image_size, image_size, 3).astype(np.float32),
+        mesh)
+    labels = shard_batch(
+        np.random.randint(0, 1000, size=(batch,)).astype(np.int32), mesh)
+
+    for _ in range(num_warmup):
+        params, opt_state, batch_stats, loss = step(
+            params, opt_state, batch_stats, images, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(num_iters):
+        params, opt_state, batch_stats, loss = step(
+            params, opt_state, batch_stats, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_sec = batch * num_iters / dt
+    img_sec_per_chip = img_sec / n_dev
+    print(json.dumps({
+        "metric": "resnet50_synthetic_img_sec_per_chip",
+        "value": round(img_sec_per_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(img_sec_per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""horovod_tpu: a TPU-native distributed training framework.
+
+A ground-up re-design of Horovod's capabilities (reference: ERerGB/horovod)
+for TPUs: the data plane is XLA collectives over the ICI mesh emitted from
+shard_map/pjit programs; process sets are device sub-meshes; the async engine
+buckets requests into fused jitted collectives; elastic/launcher/timeline/
+autotune subsystems mirror the reference's behavior with TPU-idiomatic
+internals.
+
+Public API mirrors `import horovod.torch as hvd`:
+
+    import horovod_tpu as hvd
+    hvd.init()
+    out = hvd.allreduce(stacked_grads)        # sync
+    h = hvd.allreduce_async(stacked_grads)    # async (fused by the engine)
+    out = hvd.synchronize(h)
+"""
+
+from .core.types import (                                      # noqa: F401
+    ReduceOp, Average, Sum, Adasum, Min, Max, Product,
+    Status, StatusType, HorovodInternalError, HostsUpdatedInterrupt,
+    DuplicateNameError,
+)
+from .core.basics import (                                     # noqa: F401
+    init, shutdown, is_initialized,
+    size, rank, local_size, local_rank, cross_size, cross_rank,
+    is_homogeneous,
+    mpi_threads_supported, mpi_built, mpi_enabled, gloo_built, gloo_enabled,
+    nccl_built, ddl_built, ccl_built, cuda_built, rocm_built,
+    tpu_built, tpu_enabled,
+    add_process_set, remove_process_set, get_process_set_ids_and_ranks,
+    process_set_included, start_timeline, stop_timeline,
+)
+from .core.process_sets import ProcessSet, global_process_set  # noqa: F401
+from .core.mesh import (                                       # noqa: F401
+    GLOBAL_AXIS, CROSS_AXIS, LOCAL_AXIS, shard_stacked,
+)
+from .ops.collective_ops import (                              # noqa: F401
+    allreduce, allgather, broadcast, alltoall, reducescatter, barrier, join,
+)
+from .ops import inside                                        # noqa: F401
+from .ops.engine import (                                      # noqa: F401
+    allreduce_async, allgather_async, broadcast_async, alltoall_async,
+    reducescatter_async, grouped_allreduce, grouped_allreduce_async,
+    grouped_allgather, grouped_allgather_async, grouped_reducescatter,
+    grouped_reducescatter_async, synchronize, poll, wait,
+)
+from .optim.compression import Compression                     # noqa: F401
+from .optim.optimizer import DistributedOptimizer              # noqa: F401
+from .optim.functions import (                                 # noqa: F401
+    broadcast_parameters, broadcast_object, allgather_object,
+    broadcast_optimizer_state, broadcast_variables,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,131 @@
+"""Typed runtime configuration with HOROVOD_* env-var compatibility.
+
+The reference scatters ~30 knobs across env parsing in
+horovod/common/operations.cc:455-650 and horovod/common/utils/env_parser.cc.
+Here they collapse into one dataclass (SURVEY §5.6 direction) while keeping
+the same env names so reference users' scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+@dataclass
+class Config:
+    """All runtime knobs. Defaults mirror the reference where one exists."""
+
+    # Fusion: reference default 64MB via HOROVOD_FUSION_THRESHOLD
+    # (operations.cc:519-524; parameter_manager default 64MB).
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    # Background dispatch cycle in ms (reference default 1ms,
+    # operations.cc:525-534 HOROVOD_CYCLE_TIME).
+    cycle_time_ms: float = 1.0
+    # Response/jit cache capacity (reference HOROVOD_CACHE_CAPACITY,
+    # operations.cc:544).
+    cache_capacity: int = 1024
+    # Two-level algorithms (reference HOROVOD_HIERARCHICAL_ALLREDUCE,
+    # HOROVOD_TORUS_ALLREDUCE — operations.cc:548-606).
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    torus_allreduce: bool = False
+    # Autotune (operations.cc:628-637).
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    # Timeline (operations.cc:495-510).
+    timeline_filename: Optional[str] = None
+    timeline_mark_cycles: bool = False
+    # Stall inspector (env_parser.cc:121-133).
+    stall_check_disable: bool = False
+    stall_warning_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+    # Elastic (operations.cc:501).
+    elastic_enabled: bool = False
+    # Adasum tuning (HOROVOD_ADASUM_MPI_CHUNK_SIZE analog).
+    adasum_chunk_bytes: int = 1 << 26
+    # Process sets (operations.cc:649 HOROVOD_DYNAMIC_PROCESS_SETS).
+    dynamic_process_sets: bool = False
+    # Grouped-op fusion (operations.cc:616 HOROVOD_DISABLE_GROUP_FUSION).
+    disable_group_fusion: bool = False
+    # Logging.
+    log_level: str = "WARNING"
+    # Launcher-provided identity (gloo_run.py:66-78 env contract).
+    rank_env: Optional[int] = None
+    size_env: Optional[int] = None
+    local_rank_env: Optional[int] = None
+    local_size_env: Optional[int] = None
+    cross_rank_env: Optional[int] = None
+    cross_size_env: Optional[int] = None
+
+    @staticmethod
+    def from_env() -> "Config":
+        c = Config()
+        mb = _env_float("HOROVOD_FUSION_THRESHOLD", -1.0)
+        if mb >= 0:
+            c.fusion_threshold_bytes = int(mb)
+        c.cycle_time_ms = _env_float("HOROVOD_CYCLE_TIME", c.cycle_time_ms)
+        c.cache_capacity = _env_int("HOROVOD_CACHE_CAPACITY", c.cache_capacity)
+        c.hierarchical_allreduce = _env_bool(
+            "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
+        c.hierarchical_allgather = _env_bool(
+            "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
+        c.torus_allreduce = _env_bool("HOROVOD_TORUS_ALLREDUCE", c.torus_allreduce)
+        c.autotune = _env_bool("HOROVOD_AUTOTUNE", c.autotune)
+        c.autotune_log = os.environ.get("HOROVOD_AUTOTUNE_LOG", c.autotune_log)
+        c.timeline_filename = os.environ.get("HOROVOD_TIMELINE", c.timeline_filename)
+        c.timeline_mark_cycles = _env_bool(
+            "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)
+        c.stall_check_disable = _env_bool(
+            "HOROVOD_STALL_CHECK_DISABLE", c.stall_check_disable)
+        c.stall_warning_time_seconds = _env_float(
+            "HOROVOD_STALL_CHECK_TIME_SECONDS", c.stall_warning_time_seconds)
+        c.stall_shutdown_time_seconds = _env_float(
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", c.stall_shutdown_time_seconds)
+        c.elastic_enabled = _env_bool("HOROVOD_ELASTIC", c.elastic_enabled)
+        c.dynamic_process_sets = _env_bool(
+            "HOROVOD_DYNAMIC_PROCESS_SETS", c.dynamic_process_sets)
+        c.disable_group_fusion = _env_bool(
+            "HOROVOD_DISABLE_GROUP_FUSION", c.disable_group_fusion)
+        c.log_level = os.environ.get("HOROVOD_LOG_LEVEL", c.log_level).upper()
+
+        def _opt_int(name):
+            v = os.environ.get(name)
+            return int(v) if v is not None and v != "" else None
+
+        c.rank_env = _opt_int("HOROVOD_RANK")
+        c.size_env = _opt_int("HOROVOD_SIZE")
+        c.local_rank_env = _opt_int("HOROVOD_LOCAL_RANK")
+        c.local_size_env = _opt_int("HOROVOD_LOCAL_SIZE")
+        c.cross_rank_env = _opt_int("HOROVOD_CROSS_RANK")
+        c.cross_size_env = _opt_int("HOROVOD_CROSS_SIZE")
+        return c

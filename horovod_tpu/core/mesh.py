@@ -1,0 +1,78 @@
+"""Device-mesh construction for the TPU-native data plane.
+
+Where the reference builds NCCL/MPI communicators per
+Communicator::{GLOBAL,LOCAL,CROSS} (horovod/common/mpi/mpi_context.h,
+common.h:175), the TPU-native design builds `jax.sharding.Mesh` objects:
+
+* the GLOBAL communicator -> a 1-D mesh over all devices, axis "hvd";
+* the LOCAL communicator  -> the per-host sub-axis (devices of one process);
+* the CROSS communicator  -> the across-host sub-axis;
+* hierarchical/torus algorithms -> a 2-D (cross, local) factorization of the
+  same devices (see ops/cross.py), mirroring NCCLHierarchicalAllreduce /
+  NCCLTorusAllreduce (horovod/common/ops/nccl_operations.cc:308,606).
+
+Collectives become XLA HLOs over ICI by shard_mapping over these axes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names.
+GLOBAL_AXIS = "hvd"
+CROSS_AXIS = "hvd_cross"
+LOCAL_AXIS = "hvd_local"
+
+
+def global_devices() -> List[jax.Device]:
+    """All devices in id order (the global rank order)."""
+    return sorted(jax.devices(), key=lambda d: d.id)
+
+
+def build_global_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over every device: the GLOBAL communicator analog."""
+    devs = list(devices) if devices is not None else global_devices()
+    return Mesh(np.array(devs, dtype=object), (GLOBAL_AXIS,))
+
+
+def build_hierarchical_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    local_size: Optional[int] = None,
+) -> Mesh:
+    """2-D (cross, local) mesh for hierarchical/torus algorithms.
+
+    `local_size` defaults to the per-process device count (one host's chips —
+    the ICI-local group); the cross axis then spans hosts/slices (DCN).
+    Mirrors the local/cross communicator split of the reference
+    (mpi_context.cc Communicator::LOCAL/CROSS).
+    """
+    devs = list(devices) if devices is not None else global_devices()
+    if local_size is None:
+        per_proc = {}
+        for d in devs:
+            per_proc.setdefault(d.process_index, 0)
+            per_proc[d.process_index] += 1
+        local_size = min(per_proc.values()) if per_proc else len(devs)
+    n = len(devs)
+    if local_size <= 0 or n % local_size != 0:
+        local_size = 1
+    cross = n // local_size
+    arr = np.array(devs, dtype=object).reshape(cross, local_size)
+    return Mesh(arr, (CROSS_AXIS, LOCAL_AXIS))
+
+
+def stacked_sharding(mesh: Mesh, axis: str = GLOBAL_AXIS) -> NamedSharding:
+    """Sharding for a 'stacked' array: leading dim = ranks, one row/device."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_stacked(x, mesh: Mesh, axis: str = GLOBAL_AXIS):
+    """Place a [size, ...] host array so row i lives on device i."""
+    return jax.device_put(x, stacked_sharding(mesh, axis))

@@ -1,0 +1,138 @@
+"""Process sets: named subgroups of ranks with their own sub-mesh.
+
+Re-design of the reference's ProcessSet/ProcessSetTable
+(horovod/common/process_set.h:26,89 and horovod/common/process_sets.py):
+each reference process set owns a controller + tensor queue + sub-communicator;
+here a process set owns a sub-`Mesh` over its member devices, so every
+collective over the set compiles to XLA collectives scoped to exactly those
+chips. Id 0 is always the global set (process_set.h:89).
+
+TP/SP/EP schemes compose from these, exactly as the reference intends process
+sets to be the building block for hybrid parallelism (docs/process_set.rst).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+from . import mesh as mesh_lib
+
+
+class ProcessSet:
+    """A subgroup of ranks. `ranks` are global rank (= device) indices.
+
+    Mirrors horovod.ProcessSet (horovod/common/process_sets.py:18): users
+    construct with a rank list, then `add_process_set` assigns the id and
+    materializes the sub-mesh.
+    """
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.ranks: Optional[List[int]] = (
+            sorted(int(r) for r in ranks) if ranks is not None else None
+        )
+        self.process_set_id: Optional[int] = None
+        self._mesh: Optional[Mesh] = None
+
+    # -- identity ----------------------------------------------------------
+    def size(self) -> int:
+        if self.ranks is None:
+            raise ValueError("Process set not initialized")
+        return len(self.ranks)
+
+    def rank_in_set(self, global_rank: int) -> int:
+        """Position of `global_rank` inside the set (set-local rank)."""
+        return self.ranks.index(global_rank)
+
+    def included(self, global_rank: int) -> bool:
+        return self.ranks is not None and global_rank in self.ranks
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            raise ValueError(
+                f"Process set {self.process_set_id} has no mesh; was it added?")
+        return self._mesh
+
+    def _materialize(self, all_devices) -> None:
+        devs = [all_devices[r] for r in self.ranks]
+        self._mesh = Mesh(np.array(devs, dtype=object), (mesh_lib.GLOBAL_AXIS,))
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+# The global set singleton, like hvd.global_process_set
+# (horovod/common/process_sets.py:108).
+global_process_set = ProcessSet([])
+global_process_set.process_set_id = 0
+
+
+class ProcessSetTable:
+    """Registry of process sets; id 0 = global (process_set.h:89-101)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Dict[int, ProcessSet] = {}
+        self._next_id = 1
+
+    def initialize_global(self, all_devices) -> ProcessSet:
+        ps = global_process_set
+        ps.ranks = list(range(len(all_devices)))
+        ps.process_set_id = 0
+        ps._materialize(all_devices)
+        with self._lock:
+            self._table[0] = ps
+        return ps
+
+    def add(self, ps: ProcessSet, all_devices) -> int:
+        if ps.ranks is None or len(ps.ranks) == 0:
+            raise ValueError("An added process set must have at least one rank")
+        n = len(all_devices)
+        for r in ps.ranks:
+            if r < 0 or r >= n:
+                raise ValueError(f"Rank {r} out of range [0, {n})")
+        if len(set(ps.ranks)) != len(ps.ranks):
+            raise ValueError("Duplicate ranks in process set")
+        with self._lock:
+            for existing in self._table.values():
+                if existing.ranks == ps.ranks:
+                    raise ValueError(
+                        f"A process set with ranks {ps.ranks} already exists "
+                        f"(id={existing.process_set_id})")
+            ps.process_set_id = self._next_id
+            self._next_id += 1
+            self._table[ps.process_set_id] = ps
+        ps._materialize(all_devices)
+        return ps.process_set_id
+
+    def remove(self, process_set_id: int) -> None:
+        if process_set_id == 0:
+            raise ValueError("Cannot remove the global process set")
+        with self._lock:
+            ps = self._table.pop(process_set_id, None)
+        if ps is None:
+            raise ValueError(f"No process set with id {process_set_id}")
+        ps.process_set_id = None
+        ps._mesh = None
+
+    def get(self, process_set_id: int) -> ProcessSet:
+        with self._lock:
+            ps = self._table.get(process_set_id)
+        if ps is None:
+            raise ValueError(f"No process set with id {process_set_id}")
+        return ps
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._table.keys())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._table.clear()
+            self._next_id = 1
+        global_process_set.ranks = []
+        global_process_set.process_set_id = 0
+        global_process_set._mesh = None

@@ -1,0 +1,175 @@
+"""Core value types shared across the framework.
+
+TPU-native re-design of the reference's core C++ types
+(reference: horovod/common/common.h:169-405 — Status, TensorShape, Framework,
+ReduceOp enum in horovod/torch/mpi_ops.py / message.fbs:35-56).  Here they are
+plain Python dataclasses/enums: the data plane is JAX arrays, so no abstract
+Tensor/PersistentBuffer adapters are needed.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+
+class StatusType(enum.Enum):
+    # reference: horovod/common/common.h:206-214
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclass(frozen=True)
+class Status:
+    """Operation status (reference: horovod/common/common.h:206)."""
+
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    @staticmethod
+    def ok() -> "Status":
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def unknown(msg: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, msg)
+
+    @staticmethod
+    def precondition(msg: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    @staticmethod
+    def aborted(msg: str) -> "Status":
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def invalid_argument(msg: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, msg)
+
+    @staticmethod
+    def in_progress() -> "Status":
+        return Status(StatusType.IN_PROGRESS)
+
+    def ok_p(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress_p(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction operators for allreduce-family collectives.
+
+    Matches the reference's user-facing set: Average/Sum/Adasum
+    (horovod/torch/mpi_ops.py:60-66) plus Min/Max/Product
+    (horovod/common/message.fbs:35-45).
+    """
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Aliases mirroring `hvd.Average` / `hvd.Sum` / `hvd.Adasum` module constants.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+class RequestType(enum.Enum):
+    # reference: horovod/common/wire/message.fbs:47-56
+    ALLREDUCE = "allreduce"
+    ALLGATHER = "allgather"
+    BROADCAST = "broadcast"
+    JOIN = "join"
+    ADASUM = "adasum"
+    ALLTOALL = "alltoall"
+    BARRIER = "barrier"
+    REDUCESCATTER = "reducescatter"
+
+
+@dataclass(frozen=True)
+class TensorShape:
+    """Static shape (reference: horovod/common/common.h:243)."""
+
+    dims: Tuple[int, ...] = ()
+
+    @property
+    def rank(self) -> int:
+        return len(self.dims)
+
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def dim_size(self, i: int) -> int:
+        return self.dims[i]
+
+
+@dataclass
+class Request:
+    """A collective request from one logical rank.
+
+    TPU-native analog of the reference wire Request
+    (horovod/common/message.h:59): in single-controller SPMD mode requests
+    never cross a process boundary, so this is an in-memory record consumed
+    by the async engine; the multi-process controller serializes the same
+    fields (see native/ controller).
+    """
+
+    request_type: RequestType = RequestType.ALLREDUCE
+    tensor_name: str = ""
+    tensor_shape: Tuple[int, ...] = ()
+    dtype: str = "float32"
+    root_rank: int = -1
+    process_set_id: int = 0
+    reduce_op: ReduceOp = ReduceOp.SUM
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    splits: Optional[Sequence[int]] = None
+    group_id: int = -1
+
+
+@dataclass
+class Response:
+    """A fused response covering one or more requests.
+
+    Analog of horovod/common/message.h:175 — carries the fused tensor names
+    and any negotiated error text.
+    """
+
+    response_type: RequestType = RequestType.ALLREDUCE
+    tensor_names: list = field(default_factory=list)
+    error_message: str = ""
+    process_set_id: int = 0
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal/communication failure; elastic mode catches this and
+    re-initializes (reference: horovod/common/exceptions.py:24)."""
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised between steps when the host set changed
+    (reference: horovod/common/elastic.py HostsUpdatedInterrupt)."""
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class DuplicateNameError(ValueError):
+    """Two in-flight collectives share a name
+    (reference: DUPLICATE_NAME_ERROR, horovod/common/operations.cc:1436-1530)."""

@@ -1,0 +1,82 @@
+"""Adasum: scale-invariant gradient combination.
+
+Re-design of the reference's Adasum (horovod/common/ops/adasum/adasum.h:38 —
+pairwise combine a' = (1 - a.b/(2||a||^2)) a + (1 - a.b/(2||b||^2)) b applied
+over a recursive-halving binary tree, power-of-two ranks required,
+adasum.h:32).
+
+On TPU the tree is pure tensor math over the stacked rank axis: each level
+pairs adjacent rows and combines them with a vmapped kernel; XLA schedules the
+cross-device reads as ICI transfers. log2(n) levels, then the single result is
+broadcast back to all rows. Where the reference splits the work across an MPI
+tree of hosts (adasum.h:195 FusedAllreduce), here the whole tree is one jitted
+program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import basics
+from ..core.mesh import stacked_sharding
+from ..core.process_sets import ProcessSet
+
+
+def _is_power_of_two(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def adasum_combine(a: jax.Array, b: jax.Array) -> jax.Array:
+    """One pairwise Adasum combine (adasum.h:101-131 dot/normsq dispatch +
+    :366,406 ScaledAdd). Computed in float32 for stability, cast back."""
+    dt = a.dtype
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.vdot(af.ravel(), bf.ravel())
+    na = jnp.vdot(af.ravel(), af.ravel())
+    nb = jnp.vdot(bf.ravel(), bf.ravel())
+    acoef = 1.0 - jnp.where(na > 0, dot / (2.0 * na), 0.0)
+    bcoef = 1.0 - jnp.where(nb > 0, dot / (2.0 * nb), 0.0)
+    return (acoef * af + bcoef * bf).astype(dt)
+
+
+@functools.lru_cache(maxsize=256)
+def _adasum_tree_fn(n: int):
+    @jax.jit
+    def f(x):                                   # [n, ...]
+        levels = n.bit_length() - 1
+        v = x
+        for _ in range(levels):
+            m = v.shape[0] // 2
+            a = v[0::2]
+            b = v[1::2]
+            v = jax.vmap(adasum_combine)(a, b)  # [m, ...]
+        result = v[0]
+        return jnp.broadcast_to(result[None], x.shape)
+
+    return f
+
+
+def adasum_allreduce(x: jax.Array, *,
+                     process_set: Optional[ProcessSet] = None) -> jax.Array:
+    """Adasum reduction over the stacked rank axis; all ranks get the result.
+
+    Matches hvd.allreduce(op=hvd.Adasum). Requires power-of-two set size like
+    the reference tree (adasum.h:32 IsPowerOfTwo).
+    """
+    ps = basics.get_process_set(process_set)
+    n = ps.size()
+    if not _is_power_of_two(n):
+        raise ValueError(
+            f"Adasum requires a power-of-two number of ranks, got {n}")
+    x = jnp.asarray(x)
+    if x.ndim < 1 or x.shape[0] != n:
+        raise ValueError(
+            f"adasum expects stacked [size, ...] input; got {tuple(x.shape)}")
+    x = jax.device_put(x, stacked_sharding(ps.mesh))
+    if n == 1:
+        return x
+    return _adasum_tree_fn(n)(x)

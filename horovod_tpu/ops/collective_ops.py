@@ -1,0 +1,345 @@
+"""Synchronous collectives over stacked per-rank arrays.
+
+TPU-native re-design of the reference's collective op backends
+(horovod/common/ops/mpi_operations.cc, nccl_operations.cc,
+gloo_operations.cc): instead of NCCL calls on a side stream, every collective
+is a `shard_map` program over the process set's device mesh, compiled by XLA
+into native ICI collectives (psum / all_gather / all_to_all / psum_scatter).
+
+Data model: a "stacked" array has leading axis = process-set size, one row per
+rank/device, sharded row-wise over the set's 1-D mesh. Row i is rank i's local
+tensor — the moral equivalent of the per-process tensor in the reference.
+Results keep the stacked layout so every rank (device) holds its own copy of
+the output, matching the per-rank return contract of hvd.allreduce et al.
+
+Ragged variants (per-rank first-dim sizes for allgather / alltoall /
+reducescatter, mirroring MPI_Gatherv/Alltoallv paths in
+horovod/common/ops/mpi_operations.cc:122,441) take Python lists of per-rank
+arrays or split sizes; splits are static so the whole program still jits.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core import basics
+from ..core.mesh import GLOBAL_AXIS, stacked_sharding
+from ..core.process_sets import ProcessSet
+from ..core.types import ReduceOp
+
+Array = jax.Array
+AXIS = GLOBAL_AXIS
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+def _resolve(process_set: Optional[ProcessSet]):
+    ps = basics.get_process_set(process_set)
+    return ps, ps.mesh, ps.size()
+
+
+def _check_stacked(x, n: int, what: str) -> None:
+    if getattr(x, "ndim", 0) < 1 or x.shape[0] != n:
+        raise ValueError(
+            f"{what} expects a stacked array with leading axis == process-set "
+            f"size ({n}); got shape {tuple(getattr(x, 'shape', ()))}. In "
+            f"single-controller SPMD mode every rank's tensor is one row of "
+            f"the stacked input.")
+
+
+def _place_stacked(x: Array, mesh: Mesh, n: int, what: str) -> Array:
+    """Validate and row-shard x ([n, ...]) over the set mesh."""
+    x = jnp.asarray(x)
+    _check_stacked(x, n, what)
+    return jax.device_put(x, stacked_sharding(mesh))
+
+
+def _is_float(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating)
+
+
+@functools.lru_cache(maxsize=512)
+def _allreduce_fn(mesh: Mesh, op: ReduceOp, dtype_name: str, has_scale: bool):
+    n = mesh.devices.size
+
+    def blk(x, pre, post):
+        dt = x.dtype
+        if dt == jnp.bool_:
+            x = x.astype(jnp.int32)
+        if has_scale:
+            x = x * pre.astype(x.dtype)
+        if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            r = lax.psum(x, AXIS)
+            if op == ReduceOp.AVERAGE:
+                if _is_float(r.dtype):
+                    r = r / n
+                else:
+                    r = (r // n).astype(r.dtype)
+        elif op == ReduceOp.MIN:
+            r = lax.pmin(x, AXIS)
+        elif op == ReduceOp.MAX:
+            r = lax.pmax(x, AXIS)
+        elif op == ReduceOp.PRODUCT:
+            g = lax.all_gather(x, AXIS)        # [n, 1, ...]
+            r = jnp.prod(g, axis=0)
+        else:
+            raise ValueError(f"Unsupported reduce op {op}")
+        if has_scale:
+            r = r * post.astype(r.dtype)
+        if dt == jnp.bool_:
+            r = r.astype(jnp.bool_)
+        return r
+
+    f = shard_map(blk, mesh=mesh,
+                  in_specs=(P(AXIS), P(), P()),
+                  out_specs=P(AXIS))
+    return jax.jit(f)
+
+
+def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
+              process_set: Optional[ProcessSet] = None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              name: Optional[str] = None) -> Array:
+    """Reduce row-wise across ranks; every rank receives the result.
+
+    reference semantics: hvd.allreduce (horovod/torch/mpi_ops.py:157;
+    prescale/postscale handling operations.cc:1479).
+    """
+    ps, mesh, n = _resolve(process_set)
+    if op == ReduceOp.ADASUM:
+        from .adasum import adasum_allreduce
+        return adasum_allreduce(x, process_set=ps)
+    x = _place_stacked(x, mesh, n, "allreduce")
+    has_scale = (prescale_factor != 1.0) or (postscale_factor != 1.0)
+    # Topology-aware path (HOROVOD_HIERARCHICAL_ALLREDUCE /
+    # HOROVOD_TORUS_ALLREDUCE, operations.cc:548-606): two-level
+    # local-RS / cross-AR / local-AG over the (cross, local) mesh.
+    cfg = basics.get_config()
+    if (cfg.hierarchical_allreduce or cfg.torus_allreduce) and \
+            ps.process_set_id == 0 and not has_scale and \
+            op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        from .cross import two_level_allreduce
+        hier = basics.get_hier_mesh()
+        if hier.devices.size == n and hier.devices.shape[1] > 1:
+            return two_level_allreduce(x, op, hier)
+    f = _allreduce_fn(mesh, op, str(x.dtype), has_scale)
+    pre = jnp.asarray(prescale_factor, jnp.float32)
+    post = jnp.asarray(postscale_factor, jnp.float32)
+    return f(x, pre, post)
+
+
+@functools.lru_cache(maxsize=512)
+def _allgather_fn(mesh: Mesh):
+    n = mesh.devices.size
+
+    def blk(x):                      # x: [1, d0, ...]
+        g = lax.all_gather(x[0], AXIS)            # [n, d0, ...]
+        return g.reshape((1, n * g.shape[1]) + g.shape[2:])
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS)))
+
+
+def allgather(x: Union[Array, Sequence[Array]], *,
+              process_set: Optional[ProcessSet] = None,
+              name: Optional[str] = None) -> Array:
+    """Concatenate per-rank tensors along dim 0; all ranks get the result.
+
+    reference semantics: hvd.allgather (horovod/torch/mpi_ops.py:630;
+    ragged first dims supported like MPI_Allgatherv,
+    mpi_operations.cc:122). Stacked input -> stacked output
+    [n, n*d0, ...]; a list of per-rank arrays (possibly ragged) -> the
+    concatenated array replicated over the set mesh.
+    """
+    ps, mesh, n = _resolve(process_set)
+    if isinstance(x, (list, tuple)):
+        if len(x) != n:
+            raise ValueError(f"Expected {n} per-rank arrays, got {len(x)}")
+        shapes = {tuple(a.shape[1:]) for a in x}
+        if len(shapes) > 1:
+            raise ValueError(f"Mismatched trailing dims across ranks: {shapes}")
+        out = jnp.concatenate([jnp.asarray(a) for a in x], axis=0)
+        return jax.device_put(out, NamedSharding(mesh, P()))
+    x = _place_stacked(x, mesh, n, "allgather")
+    if x.ndim < 2:
+        raise ValueError("allgather requires tensors of rank >= 1 per rank")
+    return _allgather_fn(mesh)(x)
+
+
+@functools.lru_cache(maxsize=512)
+def _broadcast_fn(mesh: Mesh, root_rank: int):
+    def blk(x):                      # [1, ...]
+        dt = x.dtype
+        xi = x.astype(jnp.int32) if dt == jnp.bool_ else x
+        idx = lax.axis_index(AXIS)
+        contrib = jnp.where(idx == root_rank, xi, jnp.zeros_like(xi))
+        r = lax.psum(contrib, AXIS)
+        return r.astype(dt)
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS)))
+
+
+def broadcast(x: Array, root_rank: int = 0, *,
+              process_set: Optional[ProcessSet] = None,
+              name: Optional[str] = None) -> Array:
+    """Every rank's row replaced by the root's row (hvd.broadcast,
+    horovod/torch/mpi_ops.py:813). Root index is the set-local rank."""
+    ps, mesh, n = _resolve(process_set)
+    x = _place_stacked(x, mesh, n, "broadcast")
+    if not (0 <= root_rank < n):
+        raise ValueError(f"root_rank {root_rank} out of range [0, {n})")
+    return _broadcast_fn(mesh, root_rank)(x)
+
+
+@functools.lru_cache(maxsize=512)
+def _alltoall_fn(mesh: Mesh):
+    n = mesh.devices.size
+
+    def blk(x):                      # [1, m, ...], n | m
+        y = lax.all_to_all(x[0], AXIS, split_axis=0, concat_axis=0,
+                           tiled=True)
+        return y[None]
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS)))
+
+
+def alltoall(x: Union[Array, Sequence[Array]],
+             splits: Optional[Sequence[Sequence[int]]] = None, *,
+             process_set: Optional[ProcessSet] = None,
+             name: Optional[str] = None
+             ) -> Union[Array, Tuple[List[Array], List[List[int]]]]:
+    """Scatter slices of each rank's tensor to every other rank.
+
+    reference semantics: hvd.alltoall (horovod/torch/mpi_ops.py:960;
+    recv splits negotiated cross-rank, mpi_controller.cc:239).
+
+    Equal splits (splits=None): stacked [n, m, ...] with n | m -> stacked
+    [n, m, ...] where rank i's row is the concatenation of everyone's i-th
+    chunk. With `splits` (an [n][n] nested list: splits[i][j] = rows rank i
+    sends to rank j): returns (per-rank output list, recv_splits).
+    """
+    ps, mesh, n = _resolve(process_set)
+    if splits is None:
+        x = _place_stacked(x, mesh, n, "alltoall")
+        if x.ndim < 2 or x.shape[1] % n != 0:
+            raise ValueError(
+                f"alltoall with equal splits needs dim1 divisible by set size "
+                f"{n}; got {tuple(x.shape)}; pass explicit splits otherwise")
+        return _alltoall_fn(mesh)(x)
+
+    # Ragged path: static splits -> static slices, computed on the global
+    # array (XLA lowers the gathers to collectives under the hood).
+    splits = [list(map(int, s)) for s in splits]
+    if len(splits) != n or any(len(s) != n for s in splits):
+        raise ValueError(f"splits must be an {n}x{n} nested list")
+    if isinstance(x, (list, tuple)):
+        rows = [jnp.asarray(a) for a in x]
+    else:
+        x = jnp.asarray(x)
+        _check_stacked(x, n, "alltoall")
+        rows = [x[i] for i in range(n)]
+    for i, (row, s) in enumerate(zip(rows, splits)):
+        if row.shape[0] != sum(s):
+            raise ValueError(
+                f"rank {i}: sum(splits)={sum(s)} != dim0={row.shape[0]}")
+    offsets = [np.concatenate([[0], np.cumsum(s)]) for s in splits]
+    outputs, recv_splits = [], []
+    for j in range(n):
+        pieces = [rows[i][offsets[i][j]:offsets[i][j + 1]] for i in range(n)]
+        outputs.append(jnp.concatenate(pieces, axis=0)
+                       if pieces else jnp.zeros((0,)))
+        recv_splits.append([splits[i][j] for i in range(n)])
+    return outputs, recv_splits
+
+
+@functools.lru_cache(maxsize=512)
+def _reducescatter_fn(mesh: Mesh, op: ReduceOp):
+    n = mesh.devices.size
+
+    def blk(x):                      # [1, d0, ...], n | d0
+        v = x[0]
+        if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            r = lax.psum_scatter(v, AXIS, scatter_dimension=0, tiled=True)
+            if op == ReduceOp.AVERAGE:
+                r = r / n if _is_float(r.dtype) else (r // n).astype(r.dtype)
+        else:
+            # min/max/product have no fused scatter primitive; reduce then
+            # slice the local chunk.
+            if op == ReduceOp.MIN:
+                full = lax.pmin(v, AXIS)
+            elif op == ReduceOp.MAX:
+                full = lax.pmax(v, AXIS)
+            elif op == ReduceOp.PRODUCT:
+                full = jnp.prod(lax.all_gather(v, AXIS), axis=0)
+            else:
+                raise ValueError(f"Unsupported reduce op {op}")
+            i = lax.axis_index(AXIS)
+            chunk = v.shape[0] // n
+            r = lax.dynamic_slice_in_dim(full, i * chunk, chunk, axis=0)
+        return r[None]
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS)))
+
+
+def _rs_split_sizes(d0: int, n: int) -> List[int]:
+    """Reference chunking: even split, first (d0 % n) ranks get one extra
+    (horovod/common/ops/collective_operations.cc reducescatter sizing)."""
+    base, extra = divmod(d0, n)
+    return [base + (1 if i < extra else 0) for i in range(n)]
+
+
+def reducescatter(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
+                  process_set: Optional[ProcessSet] = None,
+                  name: Optional[str] = None) -> Union[Array, List[Array]]:
+    """Reduce across ranks, then scatter row-chunks: rank i gets chunk i.
+
+    reference semantics: hvd.reducescatter (horovod/torch/mpi_ops.py:1070).
+    Uniform chunking (n | d0): stacked [n, d0/n, ...] result. Ragged d0:
+    returns a per-rank list with reference chunk sizing.
+    """
+    ps, mesh, n = _resolve(process_set)
+    if op == ReduceOp.ADASUM:
+        raise ValueError("Adasum reducescatter is not supported")
+    x = _place_stacked(x, mesh, n, "reducescatter")
+    if x.ndim < 2:
+        raise ValueError("reducescatter requires tensors of rank >= 1")
+    d0 = x.shape[1]
+    if d0 % n == 0:
+        return _reducescatter_fn(mesh, op)(x)
+    sizes = _rs_split_sizes(d0, n)
+    full = allreduce(x, op, process_set=ps)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    return [full[i, offs[i]:offs[i + 1]] for i in range(n)]
+
+
+def barrier(*, process_set: Optional[ProcessSet] = None) -> None:
+    """Block until all ranks' queued device work completes
+    (hvd.barrier, collective_operations.cc:437)."""
+    ps, mesh, n = _resolve(process_set)
+    token = jnp.zeros((n, 1), jnp.int32)
+    out = allreduce(token, ReduceOp.SUM, process_set=ps)
+    jax.block_until_ready(out)
+
+
+def join() -> int:
+    """Mark this controller as joined; returns last joined rank
+    (hvd.join, operations.cc:1991). In single-controller SPMD mode there is
+    one controller, so join degenerates to a barrier; uneven-data handling
+    is provided by the engine's zero-fill path (see ops/engine.py)."""
+    barrier()
+    st = basics.get_state()
+    st.joined_ranks.add(basics.rank())
+    return basics.size() - 1

@@ -1,0 +1,73 @@
+"""Two-level (hierarchical / torus) allreduce over a (cross, local) mesh.
+
+TPU-native re-design of the reference's topology-aware algorithms:
+
+* `NCCLHierarchicalAllreduce` (horovod/common/ops/nccl_operations.cc:308-577):
+  NCCL reduce-scatter within the node -> cross-node MPI allreduce on host ->
+  NCCL allgather, with fused-buffer padding to a local_size-divisible count
+  (nccl_operations.cc:396-402).
+* `NCCLTorusAllreduce` (fork addition, nccl_operations.cc:606, env
+  HOROVOD_TORUS_ALLREDUCE): local reducescatter -> per-local-rank cross-ring
+  allreduce -> local allgather over separate local/cross communicators.
+
+On a TPU mesh both collapse to the same three-phase SPMD program over the 2-D
+(cross, local) mesh from core/mesh.build_hierarchical_mesh: psum_scatter over
+the LOCAL axis (ICI within a host/slice), psum over the CROSS axis (DCN or
+inter-slice ICI), all_gather back over LOCAL — each phase a native XLA
+collective. The element count is padded to a local-size multiple exactly like
+the reference's FUSION_BUFFER_ATOMIC_UNIT padding.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.mesh import CROSS_AXIS, LOCAL_AXIS
+from ..core.types import ReduceOp
+
+
+@functools.lru_cache(maxsize=256)
+def _two_level_allreduce_fn(mesh: Mesh, op: ReduceOp):
+    cross, local = mesh.devices.shape
+    n = cross * local
+
+    def blk(x):                           # [1, ...] per-device row
+        shape = x.shape
+        v = x.reshape(-1)
+        m = v.shape[0]
+        pad = (-m) % local
+        if pad:
+            v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+        # phase 1: reduce-scatter across the local (ICI) axis
+        piece = lax.psum_scatter(v, LOCAL_AXIS, scatter_dimension=0,
+                                 tiled=True)
+        # phase 2: allreduce across the cross (DCN/inter-slice) axis — one
+        # per local rank, all running concurrently (the torus property)
+        piece = lax.psum(piece, CROSS_AXIS)
+        # phase 3: allgather back across the local axis
+        v = lax.all_gather(piece, LOCAL_AXIS, tiled=True)
+        if pad:
+            v = v[:m]
+        r = v.reshape(shape)
+        if op == ReduceOp.AVERAGE:
+            r = r / n if jnp.issubdtype(r.dtype, jnp.floating) \
+                else (r // n).astype(r.dtype)
+        return r
+
+    f = jax.shard_map(blk, mesh=mesh,
+                      in_specs=P((CROSS_AXIS, LOCAL_AXIS)),
+                      out_specs=P((CROSS_AXIS, LOCAL_AXIS)))
+    return jax.jit(f)
+
+
+def two_level_allreduce(x: jax.Array, op: ReduceOp, mesh: Mesh) -> jax.Array:
+    """Stacked [n, ...] allreduce via local-RS / cross-AR / local-AG."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            "two-level allreduce supports Sum/Average only "
+            "(reference hierarchical path is likewise sum-based)")
+    return _two_level_allreduce_fn(mesh, op)(x)

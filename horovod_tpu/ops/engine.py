@@ -1,0 +1,497 @@
+"""Async collective engine: tensor queue, fusion, handles, background cycle.
+
+TPU-native re-design of the reference's background-thread core:
+
+* `BackgroundThreadLoop`/`RunLoopOnce` (horovod/common/operations.cc:409,751)
+  -> `Engine._loop`, waking every `cycle_time_ms`.
+* Tensor queue staging (horovod/common/tensor_queue.cc) -> `Engine._queue`.
+* Tensor fusion (horovod/common/fusion_buffer_manager.h + FuseResponses,
+  controller.cc:901: same type/dtype/device/scale, size cap) ->
+  `_bucketize`: requests are grouped by fusion signature and executed as ONE
+  jitted flatten-concat-collective-split program; XLA materializes the fusion
+  buffer in HBM and fuses the pack/unpack copies.
+* Response cache (horovod/common/response_cache.cc) -> the jit executable
+  cache: a repeated bucket signature reuses a compiled program with zero
+  negotiation, the moral equivalent of the 100%-cache-hit bitvector fast path
+  (controller.cc:155-190). `cache_stats` exposes hit counts.
+* Handle API (horovod/torch/handle_manager.h:16-25, mpi_ops_v2.cc:76-118) ->
+  `Handle` objects with poll/wait/synchronize.
+* Duplicate-name detection (operations.cc:1436-1530) and the stall inspector
+  (horovod/common/stall_inspector.cc) are preserved.
+
+In single-controller SPMD mode no cross-rank negotiation is needed: every
+request is visible to the one controller, so `ComputeResponseList` reduces to
+local bucketization. In multi-process mode the native DCN controller
+(native/) plays the coordinator role.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import basics
+from ..core.process_sets import ProcessSet
+from ..core.types import DuplicateNameError, ReduceOp, RequestType, Status
+from . import collective_ops
+
+logger = logging.getLogger("horovod_tpu")
+
+_name_counter = 0
+_name_lock = threading.Lock()
+
+
+def _auto_name(prefix: str) -> str:
+    global _name_counter
+    with _name_lock:
+        _name_counter += 1
+        return f"{prefix}.noname.{_name_counter}"
+
+
+class Handle:
+    """Completion handle for an async collective (handle_manager.h:16)."""
+
+    __slots__ = ("name", "_event", "_result", "_status", "enqueue_time")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._event = threading.Event()
+        self._result = None
+        self._status = Status.in_progress()
+        self.enqueue_time = time.monotonic()
+
+    def _resolve(self, result, status: Status) -> None:
+        self._result = result
+        self._status = status
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"Collective '{self.name}' did not complete")
+        if not self._status.ok_p():
+            raise RuntimeError(
+                f"Collective '{self.name}' failed: {self._status.reason}")
+        return self._result
+
+
+@dataclass
+class _Work:
+    request_type: RequestType
+    name: str
+    tensor: Any
+    op: ReduceOp
+    process_set: ProcessSet
+    handle: Handle
+    root_rank: int = 0
+    prescale: float = 1.0
+    postscale: float = 1.0
+    splits: Optional[Sequence[Sequence[int]]] = None
+    group_id: int = -1
+
+
+def _fusion_key(w: _Work) -> Tuple:
+    """Fusable iff same op kind/dtype/set/scale (FuseResponses rules,
+    controller.cc:901-1000)."""
+    dt = str(jnp.asarray(w.tensor).dtype)
+    return (w.request_type, w.op, dt, w.process_set.process_set_id,
+            w.prescale, w.postscale)
+
+
+class Engine:
+    """Background dispatcher. One per process (like the reference's one
+    background thread per HorovodGlobalState)."""
+
+    def __init__(self, state):
+        self._state = state
+        cfg = state.config
+        self.cycle_time_s = max(cfg.cycle_time_ms, 0.0) / 1000.0
+        self.fusion_threshold = cfg.fusion_threshold_bytes
+        self._queue: List[_Work] = []
+        self._qlock = threading.Lock()
+        self._wake = threading.Event()
+        self._inflight_names: set = set()
+        # name -> enqueue monotonic time, for the stall watchdog; entries
+        # live until the handle resolves (unlike _queue, drained per cycle).
+        self._outstanding: Dict[str, float] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stall_thread: Optional[threading.Thread] = None
+        self._running = False
+        # response-cache analog: signature -> hit count (jit owns the
+        # executables; we track stats + LRU for observability/autotune).
+        self.cache_stats: "OrderedDict[Tuple, int]" = OrderedDict()
+        self.cycles = 0
+        self.tensors_fused = 0
+        self.bytes_processed = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-tpu-engine")
+        self._thread.start()
+        if not self._state.config.stall_check_disable:
+            self._stall_thread = threading.Thread(
+                target=self._stall_loop, daemon=True,
+                name="hvd-tpu-stall-inspector")
+            self._stall_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._stall_thread is not None:
+            self._stall_thread.join(timeout=1)
+            self._stall_thread = None
+        # Finalize outstanding entries with an aborted status
+        # (tensor_queue.h:35 FinalizeTensorQueue).
+        with self._qlock:
+            pending, self._queue = self._queue, []
+            self._inflight_names.clear()
+            self._outstanding.clear()
+        for w in pending:
+            w.handle._resolve(None, Status.aborted("Horovod has been shut down"))
+
+    # -- enqueue API (operations.cc:1408-2025 analogs) ----------------------
+    def enqueue(self, work: _Work) -> Handle:
+        # Validate the stacked-shape contract up front so the fused path
+        # can't silently mis-reshape a malformed tensor.
+        if work.request_type in (RequestType.ALLREDUCE,
+                                 RequestType.ALLGATHER,
+                                 RequestType.BROADCAST,
+                                 RequestType.REDUCESCATTER) or (
+                work.request_type == RequestType.ALLTOALL
+                and work.splits is None):
+            if not isinstance(work.tensor, (list, tuple)):
+                t = jnp.asarray(work.tensor)
+                n = work.process_set.size()
+                if t.ndim < 1 or t.shape[0] != n:
+                    raise ValueError(
+                        f"{work.request_type.value} expects a stacked array "
+                        f"with leading axis == process-set size ({n}); got "
+                        f"shape {tuple(t.shape)}")
+        with self._qlock:
+            if work.name in self._inflight_names:
+                raise DuplicateNameError(
+                    f"Duplicate tensor name '{work.name}': a collective with "
+                    f"this name is already in flight (reference "
+                    f"DUPLICATE_NAME_ERROR)")
+            self._inflight_names.add(work.name)
+            self._outstanding[work.name] = work.handle.enqueue_time
+            self._queue.append(work)
+        tl = self._state.timeline
+        if tl is not None:
+            tl.begin(work.name, "QUEUED")
+        self._wake.set()
+        return work.handle
+
+    # -- background loop (RunLoopOnce, operations.cc:751) --------------------
+    def _loop(self) -> None:
+        while self._running:
+            woke = self._wake.wait(timeout=max(self.cycle_time_s, 1e-4))
+            self._wake.clear()
+            if not self._running:
+                break
+            # Batching window: after fresh work arrives, wait one cycle so
+            # concurrent enqueues land in the same fusion bucket. Idle
+            # timeouts skip it (no extra latency when nothing is queued).
+            if woke and self.cycle_time_s > 0:
+                time.sleep(self.cycle_time_s)
+            try:
+                self._run_cycle()
+            except Exception:  # pragma: no cover - engine must survive
+                logger.exception("engine cycle failed")
+
+    def _run_cycle(self) -> None:
+        with self._qlock:
+            batch, self._queue = self._queue, []
+        if not batch:
+            return
+        self.cycles += 1
+        tl = self._state.timeline
+        if tl is not None:
+            tl.mark_cycle()
+        for bucket in self._bucketize(batch):
+            self._execute_bucket(bucket)
+
+    def _bucketize(self, batch: List[_Work]) -> List[List[_Work]]:
+        """Group fusable requests, splitting at the fusion threshold."""
+        buckets: "OrderedDict[Tuple, List[List[_Work]]]" = OrderedDict()
+        sizes: Dict[Tuple, int] = {}
+        out: List[List[_Work]] = []
+        no_fusion = self._state.config.disable_group_fusion
+        for w in batch:
+            if no_fusion or w.request_type != RequestType.ALLREDUCE or \
+               w.op == ReduceOp.ADASUM:
+                out.append([w])          # non-fused kinds execute singly
+                continue
+            k = _fusion_key(w)
+            t = jnp.asarray(w.tensor)
+            nbytes = t.size * t.dtype.itemsize
+            if k not in buckets or sizes[k] + nbytes > self.fusion_threshold:
+                buckets.setdefault(k, []).append([])
+                sizes[k] = 0
+            buckets[k][-1].append(w)
+            sizes[k] += nbytes
+        for groups in buckets.values():
+            out.extend(groups)
+        return out
+
+    def _execute_bucket(self, bucket: List[_Work]) -> None:
+        tl = self._state.timeline
+        names = [w.name for w in bucket]
+        try:
+            if len(bucket) == 1 and \
+               bucket[0].request_type != RequestType.ALLREDUCE:
+                results = [self._execute_single(bucket[0])]
+            elif len(bucket) == 1:
+                w = bucket[0]
+                results = [collective_ops.allreduce(
+                    w.tensor, w.op, process_set=w.process_set,
+                    prescale_factor=w.prescale,
+                    postscale_factor=w.postscale)]
+            else:
+                results = self._execute_fused_allreduce(bucket)
+            status = Status.ok()
+        except Exception as e:
+            logger.exception("bucket %s failed", names)
+            results = [None] * len(bucket)
+            status = Status.unknown(str(e))
+        for w, r in zip(bucket, results):
+            if tl is not None:
+                tl.end(w.name, "QUEUED")
+            with self._qlock:
+                self._inflight_names.discard(w.name)
+                self._outstanding.pop(w.name, None)
+            w.handle._resolve(r, status)
+
+    def _execute_single(self, w: _Work):
+        if w.request_type == RequestType.ALLGATHER:
+            return collective_ops.allgather(w.tensor,
+                                            process_set=w.process_set)
+        if w.request_type == RequestType.BROADCAST:
+            return collective_ops.broadcast(w.tensor, w.root_rank,
+                                            process_set=w.process_set)
+        if w.request_type == RequestType.ALLTOALL:
+            return collective_ops.alltoall(w.tensor, w.splits,
+                                           process_set=w.process_set)
+        if w.request_type == RequestType.REDUCESCATTER:
+            return collective_ops.reducescatter(w.tensor, w.op,
+                                                process_set=w.process_set)
+        if w.request_type == RequestType.ALLREDUCE:
+            return collective_ops.allreduce(
+                w.tensor, w.op, process_set=w.process_set,
+                prescale_factor=w.prescale, postscale_factor=w.postscale)
+        raise ValueError(f"Unknown request type {w.request_type}")
+
+    def _execute_fused_allreduce(self, bucket: List[_Work]):
+        """One fused program: flatten rows -> concat -> allreduce -> split.
+
+        The fusion-buffer analog (fusion_buffer_manager.h): XLA fuses the
+        pack/unpack with the collective, so the copies the reference does
+        with batched D2D kernels (cuda_kernels.cu:48) disappear into the
+        compiled program.
+        """
+        w0 = bucket[0]
+        tensors = [jnp.asarray(w.tensor) for w in bucket]
+        n = w0.process_set.size()
+        sig = (_fusion_key(w0),
+               tuple((tuple(t.shape), str(t.dtype)) for t in tensors))
+        self.cache_stats[sig] = self.cache_stats.get(sig, 0) + 1
+        self.cache_stats.move_to_end(sig)
+        cap = self._state.config.cache_capacity
+        while len(self.cache_stats) > cap:
+            self.cache_stats.popitem(last=False)
+        self.tensors_fused += len(bucket)
+        self.bytes_processed += sum(t.size * t.dtype.itemsize for t in tensors)
+
+        flat = jnp.concatenate(
+            [t.reshape(n, -1) for t in tensors], axis=1)
+        fused = collective_ops.allreduce(
+            flat, w0.op, process_set=w0.process_set,
+            prescale_factor=w0.prescale, postscale_factor=w0.postscale)
+        results = []
+        off = 0
+        for t in tensors:
+            m = t.size // n
+            results.append(fused[:, off:off + m].reshape(t.shape))
+            off += m
+        return results
+
+    # -- stall inspector (stall_inspector.h:41-68) ---------------------------
+    # Runs on its own watchdog thread so it still fires when the dispatch
+    # thread is blocked inside a hung collective. Scans _outstanding
+    # (enqueue -> handle resolution), not the per-cycle staging queue.
+    def _stall_loop(self) -> None:
+        cfg = self._state.config
+        # short poll so tests can exercise it; warnings are rate-limited by
+        # removing names only on completion
+        warned: set = set()
+        while self._running:
+            time.sleep(min(cfg.stall_warning_time_seconds / 4.0, 1.0))
+            now = time.monotonic()
+            with self._qlock:
+                stalled = [name for name, t in self._outstanding.items()
+                           if now - t > cfg.stall_warning_time_seconds
+                           and name not in warned]
+                overdue = [name for name, t in self._outstanding.items()
+                           if cfg.stall_shutdown_time_seconds > 0
+                           and now - t > cfg.stall_shutdown_time_seconds]
+            if stalled:
+                warned.update(stalled)
+                logger.warning(
+                    "One or more tensors were submitted for collective "
+                    "execution but have not completed for over %ss: %s "
+                    "(reference stall_inspector.cc warning)",
+                    cfg.stall_warning_time_seconds, stalled)
+            if overdue:
+                logger.error(
+                    "Stalled tensors exceeded "
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; shutting engine "
+                    "down: %s", overdue)
+                self._running = False
+                self._wake.set()
+                return
+
+
+# --------------------------------------------------------------------------
+# public async API (horovod/torch/mpi_ops.py sync/async surface)
+# --------------------------------------------------------------------------
+
+def _engine() -> Engine:
+    return basics.get_engine()
+
+
+def allreduce_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
+                    name: Optional[str] = None, *,
+                    process_set: Optional[ProcessSet] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> Handle:
+    ps = basics.get_process_set(process_set)
+    name = name or _auto_name("allreduce")
+    w = _Work(RequestType.ALLREDUCE, name, tensor, op, ps,
+              Handle(name), prescale=prescale_factor,
+              postscale=postscale_factor)
+    return _engine().enqueue(w)
+
+
+def allgather_async(tensor, name: Optional[str] = None, *,
+                    process_set: Optional[ProcessSet] = None) -> Handle:
+    ps = basics.get_process_set(process_set)
+    name = name or _auto_name("allgather")
+    w = _Work(RequestType.ALLGATHER, name, tensor, ReduceOp.SUM, ps,
+              Handle(name))
+    return _engine().enqueue(w)
+
+
+def broadcast_async(tensor, root_rank: int = 0,
+                    name: Optional[str] = None, *,
+                    process_set: Optional[ProcessSet] = None) -> Handle:
+    ps = basics.get_process_set(process_set)
+    name = name or _auto_name("broadcast")
+    w = _Work(RequestType.BROADCAST, name, tensor, ReduceOp.SUM, ps,
+              Handle(name), root_rank=root_rank)
+    return _engine().enqueue(w)
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None, *,
+                   process_set: Optional[ProcessSet] = None) -> Handle:
+    ps = basics.get_process_set(process_set)
+    name = name or _auto_name("alltoall")
+    w = _Work(RequestType.ALLTOALL, name, tensor, ReduceOp.SUM, ps,
+              Handle(name), splits=splits)
+    return _engine().enqueue(w)
+
+
+def reducescatter_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
+                        name: Optional[str] = None, *,
+                        process_set: Optional[ProcessSet] = None) -> Handle:
+    ps = basics.get_process_set(process_set)
+    name = name or _auto_name("reducescatter")
+    w = _Work(RequestType.REDUCESCATTER, name, tensor, op, ps, Handle(name))
+    return _engine().enqueue(w)
+
+
+def synchronize(handle: Handle):
+    """Wait for an async op and return its result (hvd.synchronize)."""
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    """True when the async op finished (hvd.poll)."""
+    return handle.done()
+
+
+def wait(handle: Handle):
+    """Alias of synchronize (hvd.wait)."""
+    return handle.wait()
+
+
+# -- grouped ops (group_table.h:29-53: groups complete atomically) -----------
+
+def grouped_allreduce_async(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
+                            name: Optional[str] = None, *,
+                            process_set: Optional[ProcessSet] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> List[Handle]:
+    base = name or _auto_name("grouped_allreduce")
+    return [allreduce_async(t, op, f"{base}.{i}", process_set=process_set,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allreduce(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
+                      name: Optional[str] = None, *,
+                      process_set: Optional[ProcessSet] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> List:
+    hs = grouped_allreduce_async(tensors, op, name, process_set=process_set,
+                                 prescale_factor=prescale_factor,
+                                 postscale_factor=postscale_factor)
+    return [h.wait() for h in hs]
+
+
+def grouped_allgather_async(tensors: Sequence, name: Optional[str] = None, *,
+                            process_set: Optional[ProcessSet] = None
+                            ) -> List[Handle]:
+    base = name or _auto_name("grouped_allgather")
+    return [allgather_async(t, f"{base}.{i}", process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allgather(tensors: Sequence, name: Optional[str] = None, *,
+                      process_set: Optional[ProcessSet] = None) -> List:
+    return [h.wait() for h in
+            grouped_allgather_async(tensors, name, process_set=process_set)]
+
+
+def grouped_reducescatter_async(tensors: Sequence,
+                                op: ReduceOp = ReduceOp.AVERAGE,
+                                name: Optional[str] = None, *,
+                                process_set: Optional[ProcessSet] = None
+                                ) -> List[Handle]:
+    base = name or _auto_name("grouped_reducescatter")
+    return [reducescatter_async(t, op, f"{base}.{i}", process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_reducescatter(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
+                          name: Optional[str] = None, *,
+                          process_set: Optional[ProcessSet] = None) -> List:
+    return [h.wait() for h in
+            grouped_reducescatter_async(tensors, name=name, op=op,
+                                        process_set=process_set)]

@@ -1,0 +1,101 @@
+"""Collectives for use *inside* user shard_map / pjit code.
+
+The reference exposes collectives only at the framework boundary (framework
+thread -> background thread -> NCCL). On TPU the idiomatic hot path is the
+opposite: the user's whole train step is one XLA program and collectives are
+HLOs inside it. This module is that in-graph API — thin, composable wrappers
+over lax collectives carrying the ReduceOp semantics of
+horovod/torch/mpi_ops.py, so `DistributedOptimizer`-style wrappers and
+hand-rolled TP/SP/EP schemes share one vocabulary.
+
+All functions take `axis_name` (a mesh axis or tuple of axes — the in-graph
+analog of a process set).
+"""
+from __future__ import annotations
+
+from typing import Optional, Union, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.mesh import GLOBAL_AXIS
+from ..core.types import ReduceOp
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def _axis_size(axis_name: AxisName):
+    if isinstance(axis_name, (tuple, list)):
+        s = 1
+        for a in axis_name:
+            s *= lax.psum(1, a)
+        return s
+    return lax.psum(1, axis_name)
+
+
+def allreduce(x: jax.Array, op: ReduceOp = ReduceOp.AVERAGE,
+              axis_name: AxisName = GLOBAL_AXIS, *,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> jax.Array:
+    """In-graph allreduce with hvd reduce-op semantics."""
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, x.dtype)
+    if op == ReduceOp.SUM:
+        r = lax.psum(x, axis_name)
+    elif op == ReduceOp.AVERAGE:
+        r = lax.pmean(x, axis_name)
+    elif op == ReduceOp.MIN:
+        r = lax.pmin(x, axis_name)
+    elif op == ReduceOp.MAX:
+        r = lax.pmax(x, axis_name)
+    elif op == ReduceOp.PRODUCT:
+        r = jnp.prod(lax.all_gather(x, axis_name), axis=0)
+    else:
+        raise ValueError(f"Unsupported in-graph reduce op {op}")
+    if postscale_factor != 1.0:
+        r = r * jnp.asarray(postscale_factor, r.dtype)
+    return r
+
+
+def allgather(x: jax.Array, axis_name: AxisName = GLOBAL_AXIS,
+              axis: int = 0, tiled: bool = True) -> jax.Array:
+    """In-graph allgather, concatenating along `axis` (hvd.allgather)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def broadcast(x: jax.Array, root_rank: int = 0,
+              axis_name: AxisName = GLOBAL_AXIS) -> jax.Array:
+    """In-graph broadcast from `root_rank` via masked psum."""
+    dt = x.dtype
+    xi = x.astype(jnp.int32) if dt == jnp.bool_ else x
+    idx = lax.axis_index(axis_name)
+    r = lax.psum(jnp.where(idx == root_rank, xi, jnp.zeros_like(xi)),
+                 axis_name)
+    return r.astype(dt)
+
+
+def alltoall(x: jax.Array, axis_name: AxisName = GLOBAL_AXIS,
+             split_axis: int = 0, concat_axis: int = 0) -> jax.Array:
+    """In-graph alltoall (hvd.alltoall; the Ulysses-SP primitive)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def reducescatter(x: jax.Array, op: ReduceOp = ReduceOp.AVERAGE,
+                  axis_name: AxisName = GLOBAL_AXIS,
+                  scatter_axis: int = 0) -> jax.Array:
+    """In-graph reduce-scatter (hvd.reducescatter)."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("In-graph reducescatter supports Sum/Average only")
+    r = lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                         tiled=True)
+    if op == ReduceOp.AVERAGE:
+        n = _axis_size(axis_name)
+        r = r / n
+    return r
+
+
+def rank(axis_name: AxisName = GLOBAL_AXIS):
+    """In-graph rank: axis index (device position along the hvd axis)."""
+    return lax.axis_index(axis_name)

@@ -1,0 +1,109 @@
+"""State-sync helpers: broadcast_parameters / broadcast_object / allgather_object.
+
+Re-design of horovod/torch/functions.py (broadcast_parameters,
+broadcast_optimizer_state, broadcast_object) and
+horovod/tensorflow/functions.py:66-177 (broadcast_variables,
+allgather_object).
+
+In single-controller SPMD mode model state is replicated by construction, so
+"broadcast from rank 0" means: pin the pytree's device placement to the
+replicated sharding of the process set's mesh (one copy, consistent
+everywhere). Stacked leaves (leading axis == set size, i.e. genuinely
+per-rank state) are broadcast row-wise from the root. In multi-process mode
+the same calls traverse real DCN broadcasts.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import basics
+from ..core.process_sets import ProcessSet
+from ..ops import collective_ops
+
+
+def _is_stacked(leaf, n: int) -> bool:
+    return hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == n
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0, *,
+                         process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast a pytree of parameters from root_rank
+    (horovod/torch/functions.py broadcast_parameters)."""
+    ps = basics.get_process_set(process_set)
+    n = ps.size()
+    mesh = ps.mesh
+    repl = NamedSharding(mesh, P())
+
+    def one(leaf):
+        leaf = jnp.asarray(leaf)
+        if _is_stacked(leaf, n):
+            return collective_ops.broadcast(leaf, root_rank, process_set=ps)
+        return jax.device_put(leaf, repl)
+
+    return jax.tree_util.tree_map(one, params)
+
+
+def broadcast_variables(variables: Any, root_rank: int = 0, *,
+                        process_set: Optional[ProcessSet] = None) -> Any:
+    """TF-flavored alias (horovod/tensorflow/functions.py:66)."""
+    return broadcast_parameters(variables, root_rank,
+                                process_set=process_set)
+
+
+def broadcast_optimizer_state(state: Any, root_rank: int = 0, *,
+                              process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast optax optimizer state (torch/functions.py
+    broadcast_optimizer_state — there it must walk the torch state dict;
+    optax state is already a pytree, so the same traversal applies)."""
+    return broadcast_parameters(state, root_rank, process_set=process_set)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, *,
+                     process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast an arbitrary picklable object from root_rank
+    (horovod/torch/functions.py broadcast_object: pickle -> size bcast ->
+    payload bcast -> unpickle).
+
+    Single-controller: the controller owns every rank's copy, so the object
+    round-trips through pickle (preserving the serialization contract) and is
+    returned. Multi-process: the payload is broadcast as a uint8 stacked
+    array over DCN.
+    """
+    ps = basics.get_process_set(process_set)
+    payload = pickle.dumps(obj)
+    if jax.process_count() == 1:
+        return pickle.loads(payload)
+    n = ps.size()
+    # Protocol (reference torch/functions.py broadcast_object): broadcast
+    # the root's payload size first, pad everyone to it, broadcast payload.
+    local_size = np.full((n, 1), len(payload), np.int32)
+    size_out = collective_ops.broadcast(local_size, root_rank, process_set=ps)
+    root_size = int(np.asarray(size_out)[0, 0])
+    buf = np.zeros((root_size,), np.uint8)
+    buf[:min(len(payload), root_size)] = np.frombuffer(
+        payload, dtype=np.uint8)[:root_size]
+    stacked = np.broadcast_to(buf[None], (n,) + buf.shape)
+    out = collective_ops.broadcast(jnp.asarray(stacked), root_rank,
+                                   process_set=ps)
+    return pickle.loads(np.asarray(out[0]).tobytes())
+
+
+def allgather_object(obj: Any, *,
+                     process_set: Optional[ProcessSet] = None) -> List[Any]:
+    """Gather a picklable object from every rank into a list
+    (horovod/tensorflow/functions.py allgather_object).
+
+    Single-controller: pass one object (replicated semantics) or a list with
+    one object per rank; returns the per-rank list.
+    """
+    ps = basics.get_process_set(process_set)
+    n = ps.size()
+    if isinstance(obj, list) and len(obj) == n:
+        return [pickle.loads(pickle.dumps(o)) for o in obj]
+    return [pickle.loads(pickle.dumps(obj)) for _ in range(n)]

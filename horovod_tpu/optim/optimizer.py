@@ -1,0 +1,155 @@
+"""DistributedOptimizer: gradient-averaging wrapper for optax.
+
+Re-design of the reference's optimizer wrappers
+(horovod/torch/optimizer.py:516 DistributedOptimizer factory,
+horovod/tensorflow/__init__.py:889): instead of hooking per-parameter
+grad-accumulators and enqueuing async allreduces, the TPU-native wrapper is an
+`optax.GradientTransformation` that allreduces the whole gradient pytree
+before the inner update:
+
+* **In-graph mode** (`axis_name=...`): for use inside shard_map/pjit train
+  steps — gradients are reduced with one `lax.pmean`/`psum` per leaf which XLA
+  fuses and overlaps with backward compute (the role the reference's
+  start/done XLA custom-calls play, tensorflow/xla_mpi_ops.cc:176-227).
+  This is the performance path.
+* **Stacked eager mode** (default): gradients are stacked [size, ...] arrays;
+  leaves go through the async engine as one grouped allreduce, so tensor
+  fusion applies exactly like the reference's fusion buffer.
+
+Supported knobs mirror the reference factory: `op` (Average/Sum/Adasum),
+`gradient_predivide_factor` (prescale/postscale folding,
+torch/optimizer.py:199-204), `backward_passes_per_step` (local gradient
+aggregation, tensorflow/gradient_aggregation.py:23), `compression`,
+`process_set`.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core import basics
+from ..core.process_sets import ProcessSet
+from ..core.types import ReduceOp
+from ..ops import collective_ops, engine, inside
+from .compression import Compression
+
+
+class _AggState(NamedTuple):
+    inner: Any
+    acc: Any            # accumulated gradient pytree
+    count: jnp.ndarray  # micro-steps since last apply
+
+
+def _reduce_tree_ingraph(grads, op, axis_name, prescale, postscale,
+                         compression):
+    def one(g):
+        c, ctx = compression.compress(g)
+        r = inside.allreduce(c, op, axis_name,
+                             prescale_factor=prescale,
+                             postscale_factor=postscale)
+        return compression.decompress(r, ctx)
+    return jax.tree_util.tree_map(one, grads)
+
+
+def _reduce_tree_eager(grads, op, process_set, prescale, postscale,
+                       compression):
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    comp = [compression.compress(g) for g in leaves]
+    tensors = [c for c, _ in comp]
+    if op == ReduceOp.ADASUM:
+        from ..ops.adasum import adasum_allreduce
+        reduced = [adasum_allreduce(t, process_set=process_set)
+                   for t in tensors]
+    else:
+        reduced = engine.grouped_allreduce(
+            tensors, op, process_set=process_set,
+            prescale_factor=prescale, postscale_factor=postscale)
+    out = [compression.decompress(r, ctx)
+           for r, (_, ctx) in zip(reduced, comp)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    *,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    gradient_predivide_factor: float = 1.0,
+    backward_passes_per_step: int = 1,
+    compression=Compression.none,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so updates see globally-reduced gradients."""
+    if gradient_predivide_factor != 1.0 and op != ReduceOp.AVERAGE:
+        raise ValueError(
+            "gradient_predivide_factor requires op=Average "
+            "(reference: torch/optimizer.py:560)")
+    # prescale 1/f before the sum, postscale f after; the 1/size for Average
+    # is folded by the reduction itself (torch/optimizer.py:199-204).
+    prescale = 1.0 / gradient_predivide_factor
+    postscale = gradient_predivide_factor
+    if axis_name is not None and op == ReduceOp.ADASUM:
+        raise ValueError("Adasum is not supported in in-graph mode yet; "
+                         "use the stacked eager mode")
+
+    def reduce_grads(grads):
+        if axis_name is not None:
+            return _reduce_tree_ingraph(grads, op, axis_name, prescale,
+                                        postscale, compression)
+        ps = basics.get_process_set(process_set)
+        return _reduce_tree_eager(grads, op, ps, prescale, postscale,
+                                  compression)
+
+    k = int(backward_passes_per_step)
+    if k < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    def init_fn(params):
+        inner = optimizer.init(params)
+        if k == 1:
+            return _AggState(inner, (), jnp.zeros((), jnp.int32))
+        acc = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return _AggState(inner, acc, jnp.zeros((), jnp.int32))
+
+    def update_fn(grads, state: _AggState, params=None):
+        if k == 1:
+            reduced = reduce_grads(grads)
+            updates, inner = optimizer.update(reduced, state.inner, params)
+            return updates, _AggState(inner, state.acc, state.count)
+
+        # Local gradient aggregation (gradient_aggregation.py:23): average k
+        # micro-batch gradients locally, allreduce once per k steps.
+        acc = jax.tree_util.tree_map(lambda a, g: a + g, state.acc, grads)
+        count = state.count + 1
+
+        def apply_branch(args):
+            acc, inner = args
+            mean = jax.tree_util.tree_map(lambda a: a / k, acc)
+            reduced = reduce_grads(mean)
+            updates, inner = optimizer.update(reduced, inner, params)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return updates, zeroed, inner
+
+        def skip_branch(args):
+            acc, inner = args
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return zeros, acc, inner
+
+        if axis_name is not None:
+            # traceable: branch with lax.cond
+            updates, acc, inner = jax.lax.cond(
+                count >= k, apply_branch, skip_branch, (acc, state.inner))
+            count = jnp.where(count >= k, 0, count)
+        else:
+            # eager: python control flow (engine calls are not traceable)
+            if int(count) >= k:
+                updates, acc, inner = apply_branch((acc, state.inner))
+                count = jnp.zeros((), jnp.int32)
+            else:
+                updates, acc, inner = skip_branch((acc, state.inner))
+        return updates, _AggState(inner, acc, count)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,119 @@
+"""Chrome-trace timeline profiler.
+
+Re-design of the reference Timeline (horovod/common/timeline.cc, states at
+timeline.h:102): per-tensor phase events (QUEUED -> NEGOTIATING -> fused-op
+activities -> done) written as Chrome trace JSON by a dedicated writer thread
+fed through a queue (the reference uses a boost lockfree SPSC queue,
+timeline.h:48-70). Enable via HOROVOD_TIMELINE=<file> or dynamically with
+hvd.start_timeline/stop_timeline (basics.py:159-185).
+
+On TPU the per-collective phases inside a fused XLA program are not separately
+host-visible; the engine emits ENQUEUE / CYCLE / FUSE / EXECUTE / DONE phases,
+and users combine this with the JAX profiler (xplane) for on-device detail —
+the NVTX-range analog (horovod/common/nvtx_op_range.cc).
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    """Chrome trace (catapult) event writer with a background thread."""
+
+    def __init__(self, filename: str, mark_cycles: bool = False):
+        self.filename = filename
+        self.mark_cycles = mark_cycles
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._start_us = time.monotonic_ns() // 1000
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._writer, daemon=True,
+                                        name="hvd-timeline-writer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self._q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- event emission (engine-facing) ------------------------------------
+    def _now_us(self) -> int:
+        return time.monotonic_ns() // 1000 - self._start_us
+
+    def _emit(self, ev: dict) -> None:
+        if self._running:
+            self._q.put(ev)
+
+    def begin(self, tensor_name: str, phase: str) -> None:
+        self._emit({"name": phase, "cat": phase, "ph": "B",
+                    "ts": self._now_us(), "pid": 0,
+                    "tid": hash(tensor_name) % (1 << 31),
+                    "args": {"tensor": tensor_name}})
+
+    def end(self, tensor_name: str, phase: str) -> None:
+        self._emit({"name": phase, "cat": phase, "ph": "E",
+                    "ts": self._now_us(), "pid": 0,
+                    "tid": hash(tensor_name) % (1 << 31)})
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        self._emit({"name": name, "ph": "i", "s": "g",
+                    "ts": self._now_us(), "pid": 0, "tid": 0,
+                    "args": args or {}})
+
+    def mark_cycle(self) -> None:
+        # reference: HOROVOD_TIMELINE_MARK_CYCLES (operations.cc:506)
+        if self.mark_cycles:
+            self.instant("CYCLE")
+
+    # -- writer thread ------------------------------------------------------
+    def _writer(self) -> None:
+        events = []
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                break
+            events.append(ev)
+            # Drain opportunistically to batch writes.
+            try:
+                while True:
+                    nxt = self._q.get_nowait()
+                    if nxt is None:
+                        self._flush(events)
+                        return
+                    events.append(nxt)
+            except queue.Empty:
+                pass
+            if len(events) >= 4096:
+                self._flush(events)
+                events = []
+        self._flush(events)
+
+    def _flush(self, events) -> None:
+        # Rewrite the whole file each flush so it is always valid JSON
+        # (the reference streams and leaves the array unterminated; valid
+        # files are friendlier to tooling).
+        path = self.filename
+        existing = []
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    existing = json.load(f).get("traceEvents", [])
+            except Exception:
+                existing = []
+        with open(path, "w") as f:
+            json.dump({"traceEvents": existing + events}, f)

@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device CPU platform so every collective
+test exercises a real multi-device mesh without TPU hardware (the analog of
+the reference running parallel tests under mpirun -np N,
+.buildkite/gen-pipeline.sh:140).
+
+Note: jax may already be imported by the interpreter's sitecustomize, so the
+platform is overridden via jax.config (effective until the backend
+initializes) rather than env vars alone.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

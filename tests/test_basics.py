@@ -1,0 +1,75 @@
+"""Unit tests for init/rank/size/process-set management.
+
+Mirrors the reference's basic API tests (test/parallel/test_torch.py rank/size
+checks and test/parallel/test_process_sets.py)."""
+import numpy as np
+import pytest
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()  # second call is a no-op
+    assert hvd.is_initialized()
+
+
+def test_size_and_ranks(hvd):
+    assert hvd.size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.local_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_capability_queries(hvd):
+    assert hvd.tpu_built()
+    assert not hvd.nccl_built()
+    assert not hvd.mpi_built()
+    assert hvd.gloo_built()
+    assert not hvd.tpu_enabled()  # tests run on the CPU platform
+
+
+def test_uninitialized_raises():
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    with pytest.raises(ValueError):
+        hvd.size()
+
+
+def test_add_remove_process_set(hvd):
+    ps = hvd.add_process_set([0, 2, 4])
+    assert ps.process_set_id is not None
+    assert ps.size() == 3
+    assert ps.rank_in_set(4) == 2
+    ids = hvd.get_process_set_ids_and_ranks()
+    assert ids[0] == list(range(8))
+    assert ids[ps.process_set_id] == [0, 2, 4]
+    hvd.remove_process_set(ps)
+    assert ps.process_set_id is None
+
+
+def test_duplicate_process_set_rejected(hvd):
+    hvd.add_process_set([1, 3])
+    with pytest.raises(ValueError):
+        hvd.add_process_set([1, 3])
+
+
+def test_process_set_out_of_range(hvd):
+    with pytest.raises(ValueError):
+        hvd.add_process_set([0, 99])
+
+
+def test_cannot_remove_global_set(hvd):
+    with pytest.raises(ValueError):
+        hvd.remove_process_set(hvd.global_process_set)
+
+
+def test_init_with_rank_subset():
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    hvd.init(comm=[0, 1, 2, 3])
+    try:
+        assert hvd.size() == 4
+    finally:
+        hvd.shutdown()

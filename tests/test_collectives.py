@@ -1,0 +1,247 @@
+"""Sync collective correctness vs numpy references.
+
+Mirrors the reference's op tests (test/parallel/test_torch.py — every op x
+dtype x process set, ragged variants), run on the 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+DTYPES = [np.float32, np.float64, np.int32, np.int64, np.float16]
+
+
+def _stacked(n, shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(dtype, np.floating):
+        return rng.randn(n, *shape).astype(dtype)
+    return rng.randint(-10, 10, size=(n,) + shape).astype(dtype)
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_sum(self, hvd, dtype):
+        x = _stacked(8, (4, 3), dtype)
+        out = np.asarray(hvd.allreduce(x, hvd.Sum))
+        expect = np.tile(x.sum(0, dtype=dtype), (8, 1, 1))
+        rtol = 1e-2 if dtype == np.float16 else 1e-5
+        np.testing.assert_allclose(out, expect, rtol=rtol)
+
+    def test_average(self, hvd):
+        x = _stacked(8, (5,), np.float32)
+        out = np.asarray(hvd.allreduce(x, hvd.Average))
+        np.testing.assert_allclose(out, np.tile(x.mean(0), (8, 1)), rtol=1e-5)
+
+    def test_default_op_is_average(self, hvd):
+        x = _stacked(8, (5,), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x)), np.asarray(hvd.allreduce(x, hvd.Average)))
+
+    def test_min_max(self, hvd):
+        x = _stacked(8, (6,), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, hvd.Min)), np.tile(x.min(0), (8, 1)))
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, hvd.Max)), np.tile(x.max(0), (8, 1)))
+
+    def test_product(self, hvd):
+        x = _stacked(8, (3,), np.float32, seed=1) * 0.5
+        out = np.asarray(hvd.allreduce(x, hvd.Product))
+        np.testing.assert_allclose(out, np.tile(np.prod(x, 0), (8, 1)),
+                                   rtol=1e-4)
+
+    def test_int_average_floor_divides(self, hvd):
+        x = np.full((8, 4), 3, np.int32)
+        out = np.asarray(hvd.allreduce(x, hvd.Average))
+        np.testing.assert_array_equal(out, np.full((8, 4), 3))
+
+    def test_prescale_postscale(self, hvd):
+        x = _stacked(8, (4,), np.float32)
+        out = np.asarray(hvd.allreduce(x, hvd.Sum, prescale_factor=0.5,
+                                       postscale_factor=4.0))
+        np.testing.assert_allclose(out, np.tile(x.sum(0) * 2.0, (8, 1)),
+                                   rtol=1e-5)
+
+    def test_process_set_subgroup(self, hvd):
+        ps = hvd.add_process_set([1, 3, 5, 7])
+        x = _stacked(4, (4,), np.float32)
+        out = np.asarray(hvd.allreduce(x, hvd.Sum, process_set=ps))
+        np.testing.assert_allclose(out, np.tile(x.sum(0), (4, 1)), rtol=1e-5)
+
+    def test_bad_leading_axis(self, hvd):
+        with pytest.raises(ValueError, match="stacked"):
+            hvd.allreduce(np.ones((3, 2), np.float32), hvd.Sum)
+
+    def test_bool(self, hvd):
+        x = np.array([[True], [False]] * 4)
+        out = np.asarray(hvd.allreduce(x, hvd.Max))
+        assert out.dtype == np.bool_
+        np.testing.assert_array_equal(out, np.ones((8, 1), bool))
+
+
+class TestAllgather:
+    @pytest.mark.parametrize("dtype", [np.float32, np.int32])
+    def test_uniform(self, hvd, dtype):
+        x = _stacked(8, (2, 3), dtype)
+        out = np.asarray(hvd.allgather(x))
+        assert out.shape == (8, 16, 3)
+        expect = x.reshape(16, 3)
+        for i in range(8):
+            np.testing.assert_array_equal(out[i], expect)
+
+    def test_ragged(self, hvd):
+        parts = [np.full((i + 1, 2), i, np.float32) for i in range(8)]
+        out = np.asarray(hvd.allgather(parts))
+        assert out.shape == (36, 2)
+        expect = np.concatenate(parts, 0)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_ragged_mismatched_trailing_dims(self, hvd):
+        parts = [np.zeros((2, 2)), np.zeros((2, 3))] + [np.zeros((1, 2))] * 6
+        with pytest.raises(ValueError, match="trailing"):
+            hvd.allgather(parts)
+
+    def test_process_set(self, hvd):
+        ps = hvd.add_process_set([0, 4])
+        x = _stacked(2, (3, 2), np.float32)
+        out = np.asarray(hvd.allgather(x, process_set=ps))
+        assert out.shape == (2, 6, 2)
+        np.testing.assert_array_equal(out[0], x.reshape(6, 2))
+
+
+class TestBroadcast:
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_roots(self, hvd, root):
+        x = _stacked(8, (4, 2), np.float32)
+        out = np.asarray(hvd.broadcast(x, root))
+        np.testing.assert_array_equal(out, np.tile(x[root], (8, 1, 1)))
+
+    def test_int_and_bool(self, hvd):
+        x = _stacked(8, (3,), np.int64)
+        out = np.asarray(hvd.broadcast(x, 2))
+        np.testing.assert_array_equal(out, np.tile(x[2], (8, 1)))
+        b = np.arange(8)[:, None] % 2 == 0
+        outb = np.asarray(hvd.broadcast(b, 1))
+        assert outb.dtype == np.bool_
+        np.testing.assert_array_equal(outb, np.zeros((8, 1), bool))
+
+    def test_bad_root(self, hvd):
+        with pytest.raises(ValueError):
+            hvd.broadcast(np.zeros((8, 1), np.float32), 8)
+
+
+class TestAlltoall:
+    def test_equal_splits(self, hvd):
+        n = 8
+        # row i sends chunk j (of size 2) to rank j
+        x = np.arange(n * n * 2, dtype=np.float32).reshape(n, n * 2)
+        out = np.asarray(hvd.alltoall(x))
+        assert out.shape == (n, n * 2)
+        expect = np.stack(
+            [np.concatenate([x[i, 2 * j:2 * j + 2] for i in range(n)])
+             for j in range(n)])
+        np.testing.assert_array_equal(out, expect)
+
+    def test_ragged_splits(self, hvd):
+        n = 8
+        splits = [[(i + j) % 3 for j in range(n)] for i in range(n)]
+        rows = [np.arange(sum(s), dtype=np.float32) + 100 * i
+                for i, s in enumerate(splits)]
+        outs, recv = hvd.alltoall(rows, splits)
+        assert len(outs) == n
+        for j in range(n):
+            pieces = []
+            for i in range(n):
+                off = sum(splits[i][:j])
+                pieces.append(rows[i][off:off + splits[i][j]])
+            np.testing.assert_array_equal(np.asarray(outs[j]),
+                                          np.concatenate(pieces))
+            assert recv[j] == [splits[i][j] for i in range(n)]
+
+    def test_indivisible_requires_splits(self, hvd):
+        with pytest.raises(ValueError, match="divisible"):
+            hvd.alltoall(np.zeros((8, 9), np.float32))
+
+
+class TestReducescatter:
+    def test_uniform_sum(self, hvd):
+        x = _stacked(8, (16, 3), np.float32)
+        out = np.asarray(hvd.reducescatter(x, hvd.Sum))
+        assert out.shape == (8, 2, 3)
+        total = x.sum(0)
+        for i in range(8):
+            np.testing.assert_allclose(out[i], total[2 * i:2 * i + 2],
+                                       rtol=1e-5)
+
+    def test_uniform_average(self, hvd):
+        x = _stacked(8, (8,), np.float32)
+        out = np.asarray(hvd.reducescatter(x, hvd.Average))
+        mean = x.mean(0)
+        for i in range(8):
+            np.testing.assert_allclose(out[i], mean[i:i + 1], rtol=1e-5)
+
+    def test_uniform_minmax(self, hvd):
+        x = _stacked(8, (8,), np.float32)
+        out = np.asarray(hvd.reducescatter(x, hvd.Min))
+        mn = x.min(0)
+        for i in range(8):
+            np.testing.assert_allclose(out[i], mn[i:i + 1])
+
+    def test_ragged(self, hvd):
+        x = _stacked(8, (10,), np.float32)  # 10 = 8*1 + 2 extra
+        outs = hvd.reducescatter(x, hvd.Sum)
+        assert isinstance(outs, list)
+        sizes = [len(np.asarray(o)) for o in outs]
+        assert sizes == [2, 2, 1, 1, 1, 1, 1, 1]
+        total = x.sum(0)
+        off = 0
+        for o, s in zip(outs, sizes):
+            np.testing.assert_allclose(np.asarray(o), total[off:off + s],
+                                       rtol=1e-5)
+            off += s
+
+
+class TestBarrierJoin:
+    def test_barrier(self, hvd):
+        hvd.barrier()  # must not raise or deadlock
+
+    def test_join(self, hvd):
+        assert hvd.join() == hvd.size() - 1
+
+
+class TestAdasum:
+    def test_parallel_vectors_halve(self, hvd):
+        # Identical gradients on all ranks: Adasum(a, a) = a (dot=|a|^2 ->
+        # each coef = 1/2). Tree of identical rows returns the row itself.
+        row = np.linspace(-1, 1, 12, dtype=np.float32)
+        x = np.tile(row, (8, 1))
+        out = np.asarray(hvd.allreduce(x, hvd.Adasum))
+        np.testing.assert_allclose(out, x, rtol=1e-5)
+
+    def test_orthogonal_vectors_add(self, hvd):
+        # Orthogonal gradients: dot = 0 -> plain sum.
+        x = np.zeros((8, 8), np.float32)
+        for i in range(8):
+            x[i, i] = float(i + 1)
+        out = np.asarray(hvd.allreduce(x, hvd.Adasum))
+        expect = np.tile(np.arange(1, 9, dtype=np.float32), (8, 1))
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_non_power_of_two_rejected(self, hvd):
+        ps = hvd.add_process_set([0, 1, 2])
+        with pytest.raises(ValueError, match="power-of-two"):
+            hvd.allreduce(np.ones((3, 2), np.float32), hvd.Adasum,
+                          process_set=ps)
+
+    def test_matches_pairwise_formula(self, hvd):
+        # 2-rank process set: compare against the scalar formula from
+        # adasum.h:38.
+        ps = hvd.add_process_set([0, 1])
+        rng = np.random.RandomState(3)
+        a, b = rng.randn(2, 6).astype(np.float32)
+        out = np.asarray(hvd.allreduce(np.stack([a, b]), hvd.Adasum,
+                                       process_set=ps))
+        dot = float(a @ b)
+        na, nb = float(a @ a), float(b @ b)
+        expect = (1 - dot / (2 * na)) * a + (1 - dot / (2 * nb)) * b
+        np.testing.assert_allclose(out[0], expect, rtol=1e-4)
+        np.testing.assert_allclose(out[1], expect, rtol=1e-4)
