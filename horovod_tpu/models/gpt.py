@@ -1,0 +1,123 @@
+"""GPT-style decoder transformer, built for hybrid dp/tp/sp meshes.
+
+The long-context / distributed flagship: parameters follow Megatron-style
+tensor-parallel partition rules (parallel/tp.py:gpt_partition_rules), the
+batch shards over 'dp', and attention can run as ring attention or Ulysses
+over an 'sp' axis (parallel/sp.py) for sequences longer than one device's
+memory. Everything is standard flax under jit+GSPMD; the sp attention drops
+into shard_map over the same mesh.
+
+bfloat16 compute, float32 params; pre-LN blocks; learned positions.
+"""
+from __future__ import annotations
+
+from dataclasses import field
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel import sp as sp_lib
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=256, num_layers=2, num_heads=4,
+                 head_dim=16, mlp_ratio=4, max_seq_len=512,
+                 attention: str = "dense", mesh: Optional[Mesh] = None,
+                 sp_axis: str = "sp", dp_axis: str = "dp",
+                 tp_axis: str = "tp", dtype=jnp.bfloat16):
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.embed_dim = num_heads * head_dim
+        self.mlp_dim = self.embed_dim * mlp_ratio
+        self.max_seq_len = max_seq_len
+        self.attention = attention          # dense | ring | ulysses
+        self.mesh = mesh
+        self.sp_axis = sp_axis
+        self.dp_axis = dp_axis
+        self.tp_axis = tp_axis
+        self.dtype = dtype
+
+
+class Attention(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        qkv = nn.Dense(3 * cfg.embed_dim, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="qkv")(x)
+        qkv = qkv.reshape(B, S, 3, cfg.num_heads, cfg.head_dim)
+        q, k, v = [qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3)]
+
+        if cfg.attention in ("ring", "ulysses") and cfg.mesh is not None:
+            attn = (sp_lib.ring_attention if cfg.attention == "ring"
+                    else sp_lib.ulysses_attention)
+            mesh_axes = cfg.mesh.axis_names
+            b_ax = cfg.dp_axis if cfg.dp_axis in mesh_axes else None
+            h_ax = cfg.tp_axis if cfg.tp_axis in mesh_axes else None
+            spec = P(b_ax, h_ax, cfg.sp_axis, None)
+            o = jax.shard_map(
+                partial(attn, axis_name=cfg.sp_axis, causal=True),
+                mesh=cfg.mesh,
+                in_specs=(spec, spec, spec), out_specs=spec,
+            )(q, k, v)
+        else:
+            o = sp_lib.attention_reference(q, k, v, causal=True)
+
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.embed_dim)
+        return nn.Dense(cfg.embed_dim, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="out")(o)
+
+
+class MLP(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.embed_dim, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="down")(h)
+
+
+class Block(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + Attention(cfg, name="attn")(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        return x + MLP(cfg, name="mlp")(h)
+
+
+class GPT(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, S = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                       param_dtype=jnp.float32, name="pos_embed")(
+            jnp.arange(S)[None])
+        x = (x + pos).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="lm_head")(x)
+        return logits

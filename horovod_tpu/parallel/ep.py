@@ -1,0 +1,92 @@
+"""Expert parallelism: switch-style MoE dispatch over an 'ep' mesh axis.
+
+The reference's accounting (SURVEY §2.6): "EP — absent; alltoall + process
+sets are the primitives an MoE implementation would use." This module is that
+implementation, TPU-native: top-1 routing with fixed expert capacity (static
+shapes for XLA), dispatch/combine as einsums against a one-hot dispatch mask,
+and `lax.all_to_all` moving token buffers between expert shards — the same
+primitive the reference exposes as hvd.alltoall (torch/mpi_ops.py:960).
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_route(logits: jax.Array, num_experts: int, capacity: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 router with capacity dropping (Switch Transformer style).
+
+    logits: [T, E]. Returns (dispatch [T, E, C] one-hot, combine [T, E, C]
+    probability-weighted), both zero for dropped tokens.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                       # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
+    # position of each token within its expert's buffer
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [T, E]
+    in_cap = (pos < capacity) & (pos >= 0)
+    pos_cap = jnp.where(in_cap, pos, 0).astype(jnp.int32)
+    dispatch = (onehot * in_cap)[..., None] * jax.nn.one_hot(
+        pos_cap, capacity, dtype=jnp.float32)                  # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
+              expert_params, *, axis_name: str = "ep",
+              capacity_factor: float = 1.25) -> jax.Array:
+    """Expert-parallel MoE for use inside shard_map.
+
+    x: local tokens [T_local, D]. `expert_params` are the LOCAL experts'
+    parameters, stacked on a leading axis [E_local, ...]. Global expert
+    count = E_local * ep_size. Dispatch crosses the 'ep' axis via
+    all_to_all; combine returns by the reverse all_to_all.
+    """
+    n = lax.psum(1, axis_name)
+    T, D = x.shape
+    e_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
+    E = e_local * n
+    capacity = max(1, int(capacity_factor * T / E))
+
+    logits = x @ router_w                                       # [T, E]
+    dispatch, combine = top1_route(logits, E, capacity)
+
+    # token buffers per global expert: [E, C, D]
+    buffers = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # reshape to [n, E_local, C, D] and all_to_all so shard j receives the
+    # buffers for ITS experts from every shard: result [n, E_local, C, D]
+    # with axis 0 = source shard
+    send = buffers.reshape(n, e_local, capacity, D)
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    # merge the per-source buffers: experts process all n*C slots
+    expert_in = recv.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, D)
+    expert_out = jax.vmap(expert_fn)(expert_params,
+                                     expert_in.astype(x.dtype))
+    expert_out = expert_out.astype(jnp.float32).reshape(
+        e_local, n, capacity, D).transpose(1, 0, 2, 3)          # [n,EL,C,D]
+    # return results to the source shards
+    back = lax.all_to_all(expert_out, axis_name, split_axis=0,
+                          concat_axis=0, tiled=True)            # [n,EL,C,D]
+    out_buffers = back.reshape(E, capacity, D)
+    y = jnp.einsum("tec,ecd->td", combine, out_buffers)
+    return y.astype(x.dtype)
+
+
+def moe_reference(x, router_w, expert_fn, all_expert_params,
+                  capacity_factor: float = 1.25):
+    """Single-device oracle: same routing/capacity, all experts local."""
+    T, D = x.shape
+    E = jax.tree_util.tree_leaves(all_expert_params)[0].shape[0]
+    capacity = max(1, int(capacity_factor * T / E))
+    logits = x @ router_w
+    dispatch, combine = top1_route(logits, E, capacity)
+    buffers = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    out = jax.vmap(expert_fn)(all_expert_params, buffers.astype(x.dtype))
+    y = jnp.einsum("tec,ecd->td", combine, out.astype(jnp.float32))
+    return y.astype(x.dtype)

@@ -1,0 +1,41 @@
+"""Multi-axis mesh construction for hybrid parallelism.
+
+The reference composes hybrid schemes from process sets (SURVEY §2.6); the
+TPU-native equivalent is one global Mesh with named axes, each axis playing
+the role of one process-set family: 'dp' (data), 'tp' (tensor), 'sp'
+(sequence/context), 'ep' (expert), 'pp' (pipeline). XLA maps the leading
+axes onto ICI rings of the physical topology.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1, ep: int = 1,
+              pp: int = 1, *, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh with only the axes of size > 1 (plus 'dp' always).
+
+    Axis order is (pp, dp, ep, sp, tp): tp innermost so tensor-parallel
+    collectives ride the fastest ICI hops; pp outermost so stage transfers
+    cross the slowest links only once per microbatch.
+    """
+    devs = list(devices) if devices is not None else sorted(
+        jax.devices(), key=lambda d: d.id)
+    sizes = {"pp": pp, "dp": dp, "ep": ep, "sp": sp, "tp": tp}
+    total = 1
+    for v in sizes.values():
+        total *= v
+    if total != len(devs):
+        raise ValueError(
+            f"mesh axes product {total} != device count {len(devs)} "
+            f"(axes {sizes})")
+    names = [k for k, v in sizes.items() if v > 1]
+    if not names:
+        names = ["dp"]
+    shape = tuple(sizes[k] for k in names)
+    arr = np.array(devs, dtype=object).reshape(shape)
+    return Mesh(arr, tuple(names))

@@ -1,0 +1,78 @@
+"""Pipeline parallelism: SPMD GPipe over a 'pp' mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.6 "PP — absent"). The
+TPU-native design runs all stages as ONE SPMD program: every device holds its
+stage's parameters; activations advance stage-to-stage with `lax.ppermute`
+(neighbor ICI transfers) inside a `lax.scan` over clock ticks — the
+collective-permute pipeline pattern. GPipe fill-drain schedule: with M
+microbatches and S stages, M + S - 1 ticks.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
+          stage_params: Any,
+          microbatches: jax.Array,
+          axis_name: str = "pp") -> jax.Array:
+    """Run a GPipe forward pass inside shard_map.
+
+    stage_fn(params, x) -> y: one stage's computation (same shape in/out).
+    stage_params: this device's stage parameters.
+    microbatches: [M, mb, ...] — the full input on stage 0 (other stages
+    ignore their copy).
+    Returns [M, mb, ...]: the pipeline output, valid on the LAST stage
+    (zeros elsewhere); callers typically ppermute/psum it home.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        state, outputs = carry            # state: [mb, ...] in-flight act
+        # stage 0 injects microbatch t (when one remains); others use the
+        # activation received from their left neighbor
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+        x = jnp.where(idx == 0, inject, state)
+        y = stage_fn(stage_params, x)
+        # last stage records finished microbatch t - (n - 1); a negative
+        # slot matches no index, masked update keeps vma types uniform
+        out_slot = t - (n - 1)
+        sel = (jnp.arange(M) == out_slot) & (idx == n - 1)
+        bcast = sel.reshape((M,) + (1,) * len(mb_shape))
+        outputs = jnp.where(bcast, y[None], outputs)
+        # advance activations around the ring
+        state = lax.ppermute(y, axis_name, fwd_perm)
+        return (state, outputs), None
+
+    def _varying(x):
+        # mark as device-varying along the pp axis so scan carry types are
+        # stable (see jax shard_map scan-vma docs)
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, axis_name, to="varying")
+        return lax.pvary(x, axis_name)
+
+    state0 = _varying(jnp.zeros(mb_shape, microbatches.dtype))
+    out0 = _varying(jnp.zeros((M,) + mb_shape, microbatches.dtype))
+    (_, outputs), _ = lax.scan(tick, (state0, out0),
+                               jnp.arange(M + n - 1))
+    return outputs
+
+
+def gpipe_and_return(stage_fn, stage_params, microbatches,
+                     axis_name: str = "pp") -> jax.Array:
+    """gpipe + broadcast of the final output from the last stage to all
+    stages (masked psum), so every device returns the result."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    out = gpipe(stage_fn, stage_params, microbatches, axis_name)
+    masked = jnp.where(idx == n - 1, out, jnp.zeros_like(out))
+    return lax.psum(masked, axis_name)

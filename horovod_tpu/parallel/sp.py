@@ -1,0 +1,146 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no sequence parallelism (SURVEY §5.7) — its building block
+is the user-level `alltoall` with uneven splits (horovod/common/operations.cc
+:1904, torch/mpi_ops.py:960), the core primitive of DeepSpeed-Ulysses-style
+SP. This module provides both first-class schemes the TPU way:
+
+* **Ring attention** (`ring_attention`): KV blocks rotate around the mesh
+  axis with `lax.ppermute` (ICI-neighbor transfers) while each device
+  accumulates flash-attention-style online-softmax partial results for its
+  local queries. Communication overlaps compute; memory stays O(local_seq).
+* **Ulysses attention** (`ulysses_attention`): `lax.all_to_all` reshards
+  [seq-sharded, all heads] -> [head-sharded, full seq], runs dense local
+  attention, and reshards back — two all-to-alls per call, best when
+  heads >= axis size.
+
+Both are pure lax programs usable inside any shard_map/pjit region, testable
+on a CPU mesh, and lower to native ICI collectives on TPU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _online_softmax_step(o, m, l, s, v):
+    """One flash-attention accumulation step in float32.
+
+    o: [B,H,Sq,D] accumulator, m: [B,H,Sq] running max, l: [B,H,Sq] running
+    denominator, s: [B,H,Sq,Skv] scores, v: [B,H,Skv,D] values.
+    """
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard: fully-masked blocks keep m at NEG_INF; exp(NEG_INF-NEG_INF)
+    # must not produce NaN
+    safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    corr = jnp.exp(jnp.minimum(m - safe_m, 0.0))
+    corr = jnp.where(m <= NEG_INF / 2, 0.0, corr)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o_new, m_new, l_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, *, causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over a sequence sharded along `axis_name`.
+
+    Inputs are the device-local blocks [B, H, S_local, D] (inside
+    shard_map). Returns the local attention output [B, H, S_local, D].
+    Sequence positions follow the axis order: device i holds positions
+    [i*S_local, (i+1)*S_local).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        o, m, l, kc, vc = carry
+        kv_idx = (idx - step) % n
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        if causal:
+            q_pos = idx * Sq + jnp.arange(Sq)
+            k_pos = kv_idx * Skv + jnp.arange(Skv)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        o, m, l = _online_softmax_step(o, m, l, s, vc)
+        # rotate KV to the next neighbor (ICI ring)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, m, l, kc, vc), None
+
+    # derive initial carries from qf so they are device-varying under
+    # shard_map (a plain jnp.zeros would be 'unvarying' and trip the scan
+    # carry vma check)
+    o0 = qf * 0.0
+    m0 = qf[..., 0] * 0.0 + NEG_INF
+    l0 = qf[..., 0] * 0.0
+    (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v),
+                                  jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, *, causal: bool = True,
+                      scale: Optional[float] = None) -> jax.Array:
+    """DeepSpeed-Ulysses-style SP: all_to_all heads<->sequence reshard.
+
+    Local blocks [B, H, S_local, D] with H divisible by the axis size.
+    Internally each device sees [B, H/n, S_full, D], computes dense local
+    attention, and reshards back. The all_to_all is the same primitive the
+    reference exposes as hvd.alltoall (torch/mpi_ops.py:960).
+    """
+    n = lax.psum(1, axis_name)
+    B, H, S_local, D = q.shape
+
+    def to_headsharded(x):
+        # split heads across the axis, gather the sequence
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seqsharded(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_headsharded(q), to_headsharded(k), to_headsharded(v)
+    S = qh.shape[2]
+    scale_ = scale if scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) * scale_
+    if causal:
+        pos = jnp.arange(S)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    oh = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+    return to_seqsharded(oh.astype(q.dtype))
+
+
+def attention_reference(q, k, v, *, causal: bool = True,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Single-device dense attention (test oracle)."""
+    D = q.shape[-1]
+    scale_ = scale if scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale_
+    if causal:
+        S, Skv = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(Skv)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)

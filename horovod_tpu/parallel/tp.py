@@ -1,0 +1,106 @@
+"""Tensor parallelism: partition rules (GSPMD path) + shard_map primitives.
+
+The reference's building block for hybrid parallelism is the process set
+(SURVEY §2.6 "TP — absent; process sets are the primitives"). The TPU-native
+design gives TP first-class support two ways:
+
+1. **GSPMD path** (`PartitionRules`, `shard_params`): regex rules map
+   parameter pytree paths to PartitionSpecs (Megatron-style: column-parallel
+   up-projections sharded on the output dim, row-parallel down-projections
+   on the input dim). `jit` then auto-inserts the psums — the scaling-book
+   recipe: annotate shardings, let XLA place collectives on ICI.
+2. **shard_map path** (`column_parallel_dense` / `row_parallel_dense`):
+   explicit local matmuls + psum for hand-rolled layers, mirroring how a
+   reference user would compose TP from process-set allreduces
+   (docs/process_set.rst).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class PartitionRules:
+    """Ordered (path-regex -> PartitionSpec) rules; first match wins.
+
+    Paths are '/'-joined pytree key paths, e.g.
+    'transformer/layers_0/attn/qkv/kernel'.
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]]):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return P()  # replicated by default
+
+    def tree_specs(self, params: Any) -> Any:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        specs = []
+        for keypath, _ in flat:
+            path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in keypath)
+            specs.append(self.spec_for(path))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_params(params: Any, mesh: Mesh, rules: PartitionRules) -> Any:
+    """Place a parameter pytree according to the rules."""
+    specs = rules.tree_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+
+
+def param_shardings(params: Any, mesh: Mesh, rules: PartitionRules) -> Any:
+    """NamedSharding pytree (for jit in_shardings/out_shardings)."""
+    specs = rules.tree_specs(params)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+# Megatron-style rules for the GPT model in models/gpt.py: attention QKV and
+# MLP up-projection are column-parallel (output dim on 'tp'), attention
+# output and MLP down-projection are row-parallel (input dim on 'tp'),
+# embeddings shard the vocab/hidden dim.
+def gpt_partition_rules(tp_axis: str = "tp") -> PartitionRules:
+    return PartitionRules([
+        (r"attn/qkv/kernel", P(None, tp_axis)),
+        (r"attn/out/kernel", P(tp_axis, None)),
+        (r"mlp/up/kernel", P(None, tp_axis)),
+        (r"mlp/down/kernel", P(tp_axis, None)),
+        (r"embed/embedding", P(None, tp_axis)),
+        (r"lm_head/kernel", P(None, tp_axis)),
+        # biases of column-parallel layers follow the sharded output dim
+        (r"attn/qkv/bias", P(tp_axis)),
+        (r"mlp/up/bias", P(tp_axis)),
+    ])
+
+
+# ---- shard_map-level primitives -------------------------------------------
+
+def column_parallel_dense(x: jax.Array, w_local: jax.Array,
+                          b_local=None) -> jax.Array:
+    """y_local = x @ W_local: output features sharded over tp (no comm)."""
+    y = jnp.einsum("...i,io->...o", x, w_local)
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel_dense(x_local: jax.Array, w_local: jax.Array,
+                       b=None, axis_name: str = "tp") -> jax.Array:
+    """y = psum_tp(x_local @ W_local): input features sharded over tp;
+    one psum on the tp ring."""
+    y = lax.psum(jnp.einsum("...i,io->...o", x_local, w_local), axis_name)
+    if b is not None:
+        y = y + b
+    return y

@@ -95,6 +95,48 @@ def make_train_step(
     return step
 
 
+def make_gspmd_train_step(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rules,
+    *,
+    batch_spec: P = None,
+    loss_fn: Callable = cross_entropy_loss,
+):
+    """Build a jitted hybrid-parallel (dp/tp/sp) train step via GSPMD.
+
+    Parameters are sharded by `rules` (parallel/tp.py PartitionRules);
+    the token batch is sharded by `batch_spec` (default P('dp','sp') reduced
+    to the axes present on `mesh`). XLA inserts all collectives: dp gradient
+    psums, tp row-parallel psums, sp attention comms (via the model's
+    shard_map). This is the scaling-book path — the in-graph analog of the
+    reference's DistributedOptimizer+XLA-custom-call overlap.
+    """
+    if batch_spec is None:
+        axes = mesh.axis_names
+        batch_spec = P("dp" if "dp" in axes else None,
+                       "sp" if "sp" in axes else None)
+    batch_sh = NamedSharding(mesh, batch_spec)
+
+    def step(params, opt_state, tokens, targets):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sh)
+
+        def compute_loss(p):
+            logits = apply_fn({"params": p}, tokens)
+            return loss_fn(logits, targets)
+
+        loss, grads = jax.value_and_grad(compute_loss)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # Shardings are inferred from the (committed) input arrays: params
+    # placed by parallel.tp.shard_params carry their NamedShardings, optax
+    # state inherits them at init, and the batch is constrained above.
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
 def init_replicated(tree: Any, mesh: Mesh) -> Any:
     """Pin a pytree to the replicated sharding of `mesh`."""
     repl = NamedSharding(mesh, P())
