@@ -52,4 +52,7 @@ from .optim.functions import (                                 # noqa: F401
     broadcast_optimizer_state, broadcast_variables,
 )
 
+from . import elastic                                          # noqa: F401
+from .runner.api import run                                    # noqa: F401
+
 __version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""Elastic fault-tolerant training (reference: horovod/common/elastic.py,
+horovod/runner/elastic/)."""
+from .state import State, ObjectState, TrainState          # noqa: F401
+from .run import run, notification_manager                 # noqa: F401
+from .sampler import ElasticSampler                        # noqa: F401
+from .discovery import (HostDiscovery, HostDiscoveryScript,  # noqa: F401
+                        FixedHostDiscovery, HostManager, HostState)
+from .driver import ElasticDriver                          # noqa: F401

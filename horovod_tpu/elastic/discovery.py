@@ -1,0 +1,97 @@
+"""Host discovery for elastic training.
+
+Re-design of horovod/runner/elastic/discovery.py: a user-supplied executable
+prints the current 'host:slots' set; the driver polls it (~1 s). HostState
+tracks blacklisting with cooldown + resurrection (discovery.py:35-110) so a
+flapping host is retried with exponential backoff rather than permanently
+lost.
+"""
+from __future__ import annotations
+
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from ..runner.hosts import HostInfo
+
+
+class HostState:
+    """Blacklist with cooldown (discovery.py:33)."""
+
+    COOLDOWN_BASE = 10.0
+    COOLDOWN_MAX = 600.0
+
+    def __init__(self):
+        self.blacklisted = False
+        self.failures = 0
+        self._until = 0.0
+
+    def blacklist(self) -> None:
+        self.failures += 1
+        self.blacklisted = True
+        cooldown = min(self.COOLDOWN_BASE * (2 ** (self.failures - 1)),
+                       self.COOLDOWN_MAX)
+        self._until = time.monotonic() + cooldown
+
+    def maybe_resurrect(self) -> None:
+        if self.blacklisted and time.monotonic() >= self._until:
+            self.blacklisted = False
+
+
+class HostDiscovery:
+    """Interface (discovery.py HostDiscovery)."""
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Executable printing one 'hostname:slots' (or bare hostname) per line
+    (discovery.py HostDiscoveryScript)."""
+
+    def __init__(self, script: str, default_slots: int = 1):
+        self.script = script
+        self.default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.check_output(self.script, shell=True,
+                                      timeout=30).decode()
+        hosts: Dict[str, int] = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                name, slots = line.rsplit(":", 1)
+                hosts[name] = int(slots)
+            else:
+                hosts[line] = self.default_slots
+        return hosts
+
+
+class FixedHostDiscovery(HostDiscovery):
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Tracks current + blacklisted hosts (driver-side state)."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self.discovery = discovery
+        self.states: Dict[str, HostState] = {}
+
+    def current_hosts(self) -> List[HostInfo]:
+        found = self.discovery.find_available_hosts_and_slots()
+        for name in found:
+            self.states.setdefault(name, HostState())
+        for st in self.states.values():
+            st.maybe_resurrect()
+        return [HostInfo(name, slots) for name, slots in found.items()
+                if not self.states[name].blacklisted]
+
+    def blacklist(self, hostname: str) -> None:
+        self.states.setdefault(hostname, HostState()).blacklist()

@@ -1,0 +1,175 @@
+"""ElasticDriver: fault-tolerant multi-worker orchestration.
+
+Re-design of horovod/runner/elastic/driver.py: a discovery thread polls the
+host set (~1 s, driver.py:188); on change or worker failure the driver
+recomputes rank assignments PRESERVING surviving ranks (driver.py:240-283),
+re-seeds the rendezvous KV, and (re)spawns workers; failed hosts are
+blacklisted with cooldown; `min_np`/`max_np` bound the world size;
+`reset_limit` bounds the number of reset events.
+
+On TPU each reset restarts worker processes (mesh rebuild requires process
+restart — SURVEY §7 'elastic on TPU slices'), so the driver IS the recovery
+path; in-process NCCL-style repair does not apply.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..runner import exec as exec_lib
+from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from ..runner.http_kv import RendezvousServer, make_secret
+from .discovery import HostDiscoveryScript, HostManager
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class ElasticDriver:
+    def __init__(self, discovery, command: List[str], min_np: int,
+                 max_np: Optional[int] = None, reset_limit: Optional[int] = None,
+                 base_env: Optional[dict] = None,
+                 poll_interval: float = 1.0):
+        self.manager = HostManager(discovery)
+        self.command = command
+        self.min_np = min_np
+        self.max_np = max_np
+        self.reset_limit = reset_limit
+        self.base_env = dict(base_env if base_env is not None else os.environ)
+        self.poll_interval = poll_interval
+        self.resets = 0
+        self._assignments: Dict[str, List[SlotInfo]] = {}
+        self._workers: List[exec_lib.WorkerProcess] = []
+        self._server: Optional[RendezvousServer] = None
+        self._secret = make_secret()
+        self._stop = threading.Event()
+        self._rc = 0
+
+    # -- host assignment (driver.py:240 _update_host_assignments) ----------
+    def _compute_slots(self, hosts: List[HostInfo],
+                       previous: Optional[List[SlotInfo]]) -> List[SlotInfo]:
+        np_ = sum(h.slots for h in hosts)
+        if self.max_np is not None:
+            np_ = min(np_, self.max_np)
+        if np_ < self.min_np:
+            raise RuntimeError(
+                f"Only {np_} slots available, below min_np={self.min_np}")
+        # order hosts so surviving ones keep their rank blocks
+        if previous:
+            prev_order = []
+            for s in previous:
+                if s.hostname not in prev_order:
+                    prev_order.append(s.hostname)
+            cur = {h.hostname: h for h in hosts}
+            ordered = [cur[n] for n in prev_order if n in cur]
+            ordered += [h for h in hosts if h.hostname not in prev_order]
+        else:
+            ordered = hosts
+        return get_host_assignments(ordered, np_)
+
+    # -- lifecycle ----------------------------------------------------------
+    def run(self) -> int:
+        self._server = RendezvousServer(secret=self._secret)
+        port = self._server.start()
+        slots = None
+        try:
+            while not self._stop.is_set():
+                hosts = self._wait_for_min_hosts()
+                slots = self._compute_slots(hosts, slots)
+                self._server.init(slots)
+                self._launch(slots, port)
+                outcome = self._supervise(slots)
+                if outcome == "done":
+                    return self._rc
+                self.resets += 1
+                if self.reset_limit is not None and \
+                        self.resets > self.reset_limit:
+                    raise RuntimeError(
+                        f"reset_limit ({self.reset_limit}) exceeded")
+        finally:
+            self._terminate_workers()
+            self._server.stop()
+        return self._rc
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _wait_for_min_hosts(self) -> List[HostInfo]:
+        while True:
+            hosts = self.manager.current_hosts()
+            if sum(h.slots for h in hosts) >= self.min_np:
+                return hosts
+            if self._stop.is_set():
+                raise RuntimeError("driver stopped while waiting for hosts")
+            time.sleep(self.poll_interval)
+
+    def _launch(self, slots: List[SlotInfo], kv_port: int) -> None:
+        coord = f"127.0.0.1:{_free_port()}"
+        self._workers = exec_lib.launch_slots(
+            slots, self.command, coord, kv_port, self._secret, self.base_env)
+
+    def _supervise(self, slots: List[SlotInfo]) -> str:
+        """Watch workers + host set. Returns 'done' or 'reset'."""
+        known = {h.hostname: h.slots for h in self.manager.current_hosts()}
+        while True:
+            # worker exits (driver.py:304 _handle_worker_exit)
+            all_done = True
+            for w in self._workers:
+                rc = w.proc.poll()
+                if rc is None:
+                    all_done = False
+                elif rc != 0:
+                    logger.warning(
+                        "elastic: worker rank %d on %s failed (rc=%d); "
+                        "blacklisting host and resetting",
+                        w.slot.rank, w.slot.hostname, rc)
+                    self.manager.blacklist(w.slot.hostname)
+                    self._terminate_workers()
+                    return "reset"
+            if all_done:
+                self._rc = 0
+                return "done"
+            # discovery poll (driver.py:188 _discover_hosts)
+            now = {h.hostname: h.slots
+                   for h in self.manager.current_hosts()}
+            if now != known:
+                logger.info("elastic: host set changed %s -> %s; resetting",
+                            known, now)
+                self._terminate_workers()
+                return "reset"
+            time.sleep(self.poll_interval)
+
+    def _terminate_workers(self) -> None:
+        for w in self._workers:
+            w.terminate()
+        for w in self._workers:
+            try:
+                w.proc.wait(timeout=10)
+            except Exception:
+                pass
+        self._workers = []
+
+
+def run_elastic(args) -> int:
+    """Entry from the hvdrun CLI (launch.py)."""
+    if not args.host_discovery_script:
+        raise SystemExit(
+            "elastic mode requires --host-discovery-script")
+    from ..runner.launch import env_from_args
+    base_env = dict(os.environ)
+    base_env.update(env_from_args(args))
+    discovery = HostDiscoveryScript(args.host_discovery_script)
+    driver = ElasticDriver(
+        discovery, args.command,
+        min_np=args.min_np or 1, max_np=args.max_np,
+        base_env=base_env)
+    return driver.run()
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,89 @@
+"""hvd.elastic.run: the fault-tolerant training wrapper.
+
+Re-design of the reference wrapper (horovod/common/elastic.py:151-175
+run_fn): loop { state.sync() -> user function } catching
+HorovodInternalError (communication failure -> shutdown/reinit + restore)
+and HostsUpdatedInterrupt (topology change -> commit-or-abort + reinit).
+`reset_limit` bounds resets (runner/elastic/registration.py analog).
+
+On TPU a topology change means the mesh must be rebuilt (XLA programs are
+compiled for a fixed device set), so reset = full shutdown + re-init of the
+framework — exactly the driver-level restart path SURVEY §7 prescribes.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Callable, Optional
+
+from ..core import basics
+from ..core.types import HorovodInternalError, HostsUpdatedInterrupt
+from .state import State
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def run(func: Callable) -> Callable:
+    """Decorator: `@hvd.elastic.run def train(state, ...)`."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        reset_limit = kwargs.pop("reset_limit", None)
+        resets = 0
+        notification_manager.init()
+        while True:
+            try:
+                if not basics.is_initialized():
+                    basics.init()
+                state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as e:
+                logger.warning("elastic: internal error, restoring: %s", e)
+                _reinitialize()
+                state.restore()
+                state.on_reset()
+            except HostsUpdatedInterrupt as e:
+                logger.info("elastic: hosts updated, re-initializing")
+                _reinitialize()
+                if not e.skip_sync:
+                    state.commit()
+                state.on_reset()
+            resets += 1
+            if reset_limit is not None and resets >= reset_limit:
+                raise RuntimeError(
+                    f"Elastic training reset limit ({reset_limit}) reached")
+
+    return wrapper
+
+
+def _reinitialize() -> None:
+    basics.shutdown()
+    basics.init()
+
+
+class WorkerNotificationManager:
+    """Receives host-change notifications (runner/elastic/worker.py:46).
+
+    The driver pings workers when discovery reports a changed host set;
+    workers then raise HostsUpdatedInterrupt at the next step boundary via
+    `check()`. In-process, the driver calls `handle_hosts_updated`.
+    """
+
+    def __init__(self):
+        self._pending = False
+        self._initialized = False
+
+    def init(self):
+        self._initialized = True
+
+    def handle_hosts_updated(self):
+        self._pending = True
+
+    def check(self):
+        """Call between steps: raises if the host set changed."""
+        if self._pending:
+            self._pending = False
+            raise HostsUpdatedInterrupt()
+
+
+notification_manager = WorkerNotificationManager()

@@ -1,0 +1,72 @@
+"""ElasticSampler: shard-aware sampling that survives topology changes.
+
+Re-design of horovod/torch/elastic/sampler.py:9 (ElasticSampler): partitions
+the dataset indices across workers; `record_batch` tracks processed indices;
+after a reset, `set_epoch`/reset re-partitions only the UNPROCESSED samples
+across the new worker set so no sample is lost or duplicated within an epoch.
+"""
+from __future__ import annotations
+
+import random
+from typing import Iterator, List, Optional
+
+
+class ElasticSampler:
+    def __init__(self, dataset_size: int, shuffle: bool = True,
+                 seed: int = 0, num_replicas: Optional[int] = None,
+                 rank: Optional[int] = None):
+        from ..core import basics
+        self.dataset_size = dataset_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed: set = set()
+        if num_replicas is None:
+            num_replicas = basics.size() if basics.is_initialized() else 1
+        if rank is None:
+            rank = 0
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self._reindex()
+
+    # -- epoch / progress --------------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.processed.clear()
+        self._reindex()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        start = batch_idx * batch_size
+        chunk = self.indices[start:start + batch_size]
+        self.record_indices(chunk)
+
+    def record_indices(self, indices: List[int]) -> None:
+        self.processed.update(indices)
+
+    def reset(self, num_replicas: Optional[int] = None,
+              rank: Optional[int] = None) -> None:
+        """After a topology change: re-partition unprocessed samples."""
+        if num_replicas is not None:
+            self.num_replicas = num_replicas
+        if rank is not None:
+            self.rank = rank
+        self._reindex()
+
+    # -- internals ---------------------------------------------------------
+    def _reindex(self) -> None:
+        remaining = [i for i in range(self.dataset_size)
+                     if i not in self.processed]
+        if self.shuffle:
+            rng = random.Random(self.seed + self.epoch)
+            rng.shuffle(remaining)
+        # pad so every replica sees the same count (drop-none semantics)
+        n = self.num_replicas
+        if remaining and len(remaining) % n != 0:
+            remaining += remaining[: n - len(remaining) % n]
+        self.indices = remaining[self.rank::n]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return len(self.indices)

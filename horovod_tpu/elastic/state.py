@@ -1,0 +1,102 @@
+"""Elastic state objects: in-memory checkpoint with commit/restore/sync.
+
+Re-design of the reference's elastic state layer (horovod/common/elastic.py:
+60-148 State/ObjectState and horovod/torch/elastic/state.py TorchState):
+`commit()` snapshots, `restore()` rolls back to the last commit, `sync()`
+broadcasts from the root so re-admitted or new workers converge. Here state
+values are pytrees of jax arrays / picklable python objects; sync pins
+arrays to the replicated sharding of the current mesh (single-controller) or
+broadcasts over DCN (multi-process) via optim.functions.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..optim.functions import broadcast_object, broadcast_parameters
+
+
+class State:
+    """Base elastic state (common/elastic.py:60).
+
+    Subclasses or instances carry named values; `register_reset_callbacks`
+    mirrors the reference hook invoked after a topology change.
+    """
+
+    def __init__(self, **kwargs):
+        self._saved: Dict[str, Any] = {}
+        self._reset_callbacks: List[Callable] = []
+        self._values: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            self._values[k] = v
+        self.commit()
+
+    def __getattr__(self, name):
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._values[name] = value
+
+    def register_reset_callbacks(self, callbacks: List[Callable]) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def save(self) -> None:
+        self._saved = {k: self._snapshot(v)
+                       for k, v in self._values.items()}
+
+    @staticmethod
+    def _snapshot(v):
+        if isinstance(v, jax.Array):
+            return np.asarray(v).copy()
+        return copy.deepcopy(v)
+
+    def commit(self) -> None:
+        """Save + sync point (common/elastic.py commit)."""
+        self.save()
+
+    def restore(self) -> None:
+        """Roll back to the last commit (common/elastic.py restore)."""
+        self._values = {k: copy.deepcopy(v) for k, v in self._saved.items()}
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Broadcast state from root so all workers agree
+        (common/elastic.py sync)."""
+        for k, v in list(self._values.items()):
+            if isinstance(v, (jax.Array, np.ndarray)) or _is_pytree_of_arrays(v):
+                self._values[k] = broadcast_parameters(v, root_rank)
+            else:
+                self._values[k] = broadcast_object(v, root_rank)
+        self.save()
+
+
+def _is_pytree_of_arrays(v) -> bool:
+    leaves = jax.tree_util.tree_leaves(v)
+    return bool(leaves) and all(
+        isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
+
+
+class ObjectState(State):
+    """Arbitrary picklable attributes (common/elastic.py ObjectState)."""
+
+
+class TrainState(State):
+    """Convenience: params/opt_state/epoch/batch
+    (TorchState analog, torch/elastic/state.py:27)."""
+
+    def __init__(self, params=None, opt_state=None, epoch=0, batch=0,
+                 **kwargs):
+        super().__init__(params=params, opt_state=opt_state, epoch=epoch,
+                         batch=batch, **kwargs)

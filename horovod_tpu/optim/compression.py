@@ -75,13 +75,19 @@ class SparCompressor(Compressor):
     """
 
     keep_prob = 0.3
-    _base_key = jax.random.PRNGKey(0)
+    # lazily built: creating a PRNGKey at import time would initialize the
+    # jax backend as an import side effect
+    _base_key = None
 
     @classmethod
     def compress(cls, tensor):
         tensor = jnp.asarray(tensor)
         if not jnp.issubdtype(tensor.dtype, jnp.floating):
             return tensor, None
+        if cls._base_key is None:
+            # concrete even when first touched inside a jit trace
+            with jax.ensure_compile_time_eval():
+                cls._base_key = jax.random.PRNGKey(0)
         # cheap value-dependent seed: reinterpret a few elements as bits
         bits = jax.lax.bitcast_convert_type(
             tensor.ravel()[:8].astype(jnp.float32), jnp.int32)

@@ -1,0 +1,6 @@
+"""Launcher layer (reference: horovod/runner/)."""
+from .hosts import (HostInfo, SlotInfo, parse_hosts,        # noqa: F401
+                    parse_host_file, get_host_assignments)
+from .http_kv import (KVStoreServer, KVStoreClient,          # noqa: F401
+                      RendezvousServer, make_secret)
+from .api import run                                         # noqa: F401

@@ -1,0 +1,96 @@
+"""Programmatic launcher: horovod_tpu.run(fn, ...).
+
+Re-design of the reference's in-process API (horovod/runner/__init__.py:95
+`horovod.run`): serialize `fn` + args, spawn `np` workers through the same
+static launcher path as the CLI, each worker deserializes and calls fn, and
+rank results return to the caller ordered by rank.
+
+Functions must be picklable (module-level); the reference relies on
+cloudpickle for closures — stdlib pickle keeps this dependency-free.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from . import exec as exec_lib
+from .hosts import get_host_assignments, parse_hosts
+from .http_kv import RendezvousServer, make_secret
+
+_WORKER_STUB = r"""
+import os, pickle, sys
+payload_path = sys.argv[1]
+with open(payload_path, 'rb') as f:
+    fn, args, kwargs = pickle.load(f)
+result = fn(*args, **kwargs)
+rank = int(os.environ.get('HOROVOD_RANK', '0'))
+out_path = os.path.join(os.path.dirname(payload_path), f'result.{rank}')
+with open(out_path + '.tmp', 'wb') as f:
+    pickle.dump(result, f)
+os.replace(out_path + '.tmp', out_path)
+"""
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 1, hosts: Optional[str] = None,
+        env: Optional[dict] = None, verbose: bool = False) -> List[Any]:
+    """Run fn under np worker processes; returns per-rank results."""
+    kwargs = kwargs or {}
+    host_infos = parse_hosts(hosts if hosts else f"localhost:{np}")
+    slots = get_host_assignments(host_infos, np)
+
+    with tempfile.TemporaryDirectory(prefix="hvdrun_") as tmp:
+        payload = os.path.join(tmp, "payload.pkl")
+        with open(payload, "wb") as f:
+            pickle.dump((fn, args, kwargs), f)
+        stub = os.path.join(tmp, "worker_stub.py")
+        with open(stub, "w") as f:
+            f.write(_WORKER_STUB)
+
+        secret = make_secret()
+        server = RendezvousServer(secret=secret)
+        port = server.start()
+        server.init(slots)
+        base_env = dict(os.environ)
+        if env:
+            base_env.update(env)
+        # make fn's defining module importable in the workers
+        import inspect
+        paths = list(sys.path)
+        try:
+            mod_dir = os.path.dirname(os.path.abspath(inspect.getfile(fn)))
+            paths.insert(0, mod_dir)
+        except TypeError:
+            pass
+        existing = base_env.get("PYTHONPATH", "")
+        base_env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in paths if p] + ([existing] if existing else []))
+        command = [sys.executable, stub, payload]
+        coord = f"127.0.0.1:{_free_port()}"
+        workers = exec_lib.launch_slots(slots, command, coord, port, secret,
+                                        base_env)
+        try:
+            for w in workers:
+                rc = w.wait()
+                if rc != 0:
+                    raise RuntimeError(
+                        f"Worker rank {w.slot.rank} exited with code {rc}")
+        finally:
+            server.stop()
+
+        results = []
+        for rank in range(np):
+            with open(os.path.join(tmp, f"result.{rank}"), "rb") as f:
+                results.append(pickle.load(f))
+        return results
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,106 @@
+"""Host list parsing and slot assignment.
+
+Re-design of the reference's host utilities
+(horovod/runner/common/util/hosts.py: parse_hosts, get_host_assignments):
+'-H host1:4,host2:4' or a hostfile ('hostname slots=N' lines) becomes a list
+of per-slot assignments carrying rank / local_rank / cross_rank — the same
+identity contract the launcher exports as HOROVOD_RANK / HOROVOD_LOCAL_RANK /
+HOROVOD_CROSS_RANK env (runner/gloo_run.py:66-78).
+
+TPU difference: a "slot" is one launched process. On TPU pods the natural
+slot count per host is 1 (one jax process drives all local chips); on CPU
+simulation it is any N.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass(frozen=True)
+class HostInfo:
+    hostname: str
+    slots: int
+
+
+@dataclass(frozen=True)
+class SlotInfo:
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """Parse 'host1:2,host2:4' (slots default to 1)."""
+    infos = []
+    for part in hosts_string.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            infos.append(HostInfo(name, int(slots)))
+        else:
+            infos.append(HostInfo(part, 1))
+    if not infos:
+        raise ValueError(f"No hosts found in {hosts_string!r}")
+    return infos
+
+
+def parse_host_file(path: str) -> List[HostInfo]:
+    """Parse a hostfile: one 'hostname [slots=N]' per line, '#' comments."""
+    infos = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            name = fields[0]
+            slots = 1
+            for fld in fields[1:]:
+                if fld.startswith("slots="):
+                    slots = int(fld[len("slots="):])
+            infos.append(HostInfo(name, slots))
+    if not infos:
+        raise ValueError(f"No hosts found in hostfile {path}")
+    return infos
+
+
+def get_host_assignments(hosts: List[HostInfo], np: int) -> List[SlotInfo]:
+    """Assign np ranks to host slots, filling hosts in order.
+
+    rank: global, dense by host then slot. local_rank: index within the
+    host. cross_rank: index of the host among hosts that have this
+    local_rank (the reference's definition for cross-communicators).
+    """
+    total = sum(h.slots for h in hosts)
+    if np > total:
+        raise ValueError(
+            f"Requested np={np} exceeds total available slots {total}")
+    placements = []  # (hostname, local_rank)
+    for h in hosts:
+        for l in range(h.slots):
+            if len(placements) < np:
+                placements.append((h.hostname, l))
+    used_hosts = []
+    for name, _ in placements:
+        if name not in used_hosts:
+            used_hosts.append(name)
+    local_sizes = {name: sum(1 for n, _ in placements if n == name)
+                   for name in used_hosts}
+    slots = []
+    for rank, (name, local_rank) in enumerate(placements):
+        cross_rank = [n for n in used_hosts
+                      if local_sizes[n] > local_rank].index(name)
+        cross_size = sum(1 for n in used_hosts
+                         if local_sizes[n] > local_rank)
+        slots.append(SlotInfo(
+            hostname=name, rank=rank, local_rank=local_rank,
+            cross_rank=cross_rank, size=np,
+            local_size=local_sizes[name], cross_size=cross_size))
+    return slots

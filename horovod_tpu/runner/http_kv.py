@@ -1,0 +1,216 @@
+"""HTTP key-value store + rendezvous server.
+
+Re-design of the reference's rendezvous layer (horovod/runner/http/
+http_server.py:35-218 KVStoreServer/RendezvousServer and the C++ client
+horovod/common/gloo/http_store.cc): a tiny threaded HTTP server holding a
+scope->key->value map. Workers GET/PUT under scopes; DELETE marks a scope
+finalized. The launcher seeds it with the host allocation plan; elastic
+re-rendezvous reuses it. Values are opaque bytes.
+
+Security note: like the reference, requests carry a shared secret header the
+launcher generates per run (runner/common/util/secret.py analog) so stray
+processes can't poison the store.
+"""
+from __future__ import annotations
+
+import hmac
+import http.client
+import http.server
+import json
+import secrets as _secrets
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+SECRET_HEADER = "X-Hvd-Secret"
+
+
+def make_secret() -> str:
+    return _secrets.token_hex(16)
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _check_auth(self) -> bool:
+        server: KVStoreServer = self.server.kv  # type: ignore
+        if server.secret is None:
+            return True
+        given = self.headers.get(SECRET_HEADER, "")
+        return hmac.compare_digest(given, server.secret)
+
+    def _split(self) -> Tuple[str, str]:
+        parts = self.path.strip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_PUT(self):
+        if not self._check_auth():
+            self.send_error(403)
+            return
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        self.server.kv.put(scope, key, value)  # type: ignore
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._check_auth():
+            self.send_error(403)
+            return
+        scope, key = self._split()
+        value = self.server.kv.get(scope, key)  # type: ignore
+        if value is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):
+        if not self._check_auth():
+            self.send_error(403)
+            return
+        scope, _ = self._split()
+        self.server.kv.finalize(scope)  # type: ignore
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+
+class KVStoreServer:
+    """Threaded HTTP KV server (KVStoreServer, http_server.py:35)."""
+
+    def __init__(self, port: int = 0, secret: Optional[str] = None):
+        self.secret = secret
+        self._store: Dict[str, Dict[str, bytes]] = {}
+        self._finalized: set = set()
+        self._lock = threading.Lock()
+        self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port),
+                                                      _Handler)
+        self._httpd.kv = self  # type: ignore
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="hvd-kv-server")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- store ops ---------------------------------------------------------
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        with self._lock:
+            self._store.setdefault(scope, {})[key] = value
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(scope, {}).get(key)
+
+    def scope_keys(self, scope: str):
+        with self._lock:
+            return list(self._store.get(scope, {}).keys())
+
+    def finalize(self, scope: str) -> None:
+        with self._lock:
+            self._finalized.add(scope)
+
+    def is_finalized(self, scope: str) -> bool:
+        with self._lock:
+            return scope in self._finalized
+
+
+class RendezvousServer(KVStoreServer):
+    """KV server seeded with the host allocation plan
+    (RendezvousServer, http_server.py:112)."""
+
+    def init(self, slots) -> None:
+        """Publish the slot plan: one JSON record per rank + global meta."""
+        meta = {"size": slots[0].size if slots else 0,
+                "nhosts": len({s.hostname for s in slots})}
+        self.put("rendezvous", "meta", json.dumps(meta).encode())
+        for s in slots:
+            rec = {"hostname": s.hostname, "rank": s.rank,
+                   "local_rank": s.local_rank, "cross_rank": s.cross_rank,
+                   "size": s.size, "local_size": s.local_size,
+                   "cross_size": s.cross_size}
+            self.put("rendezvous", str(s.rank), json.dumps(rec).encode())
+
+
+class KVStoreClient:
+    """HTTP client for the KV store (http_store.cc / http_client.py)."""
+
+    def __init__(self, addr: str, port: int, secret: Optional[str] = None,
+                 timeout: float = 30.0):
+        self.addr = addr
+        self.port = port
+        self.secret = secret
+        self.timeout = timeout
+
+    def _headers(self):
+        h = {}
+        if self.secret:
+            h[SECRET_HEADER] = self.secret
+        return h
+
+    def _conn(self):
+        return http.client.HTTPConnection(self.addr, self.port,
+                                          timeout=self.timeout)
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        c = self._conn()
+        try:
+            c.request("PUT", f"/{scope}/{key}", body=value,
+                      headers=self._headers())
+            r = c.getresponse()
+            r.read()
+            if r.status != 200:
+                raise RuntimeError(f"KV put failed: HTTP {r.status}")
+        finally:
+            c.close()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        c = self._conn()
+        try:
+            c.request("GET", f"/{scope}/{key}", headers=self._headers())
+            r = c.getresponse()
+            body = r.read()
+            if r.status == 404:
+                return None
+            if r.status != 200:
+                raise RuntimeError(f"KV get failed: HTTP {r.status}")
+            return body
+        finally:
+            c.close()
+
+    def wait(self, scope: str, key: str, timeout: float = 60.0,
+             poll: float = 0.1) -> bytes:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = self.get(scope, key)
+            if v is not None:
+                return v
+            time.sleep(poll)
+        raise TimeoutError(f"KV key {scope}/{key} not available "
+                           f"after {timeout}s")
+
+    def finalize(self, scope: str) -> None:
+        c = self._conn()
+        try:
+            c.request("DELETE", f"/{scope}/", headers=self._headers())
+            c.getresponse().read()
+        finally:
+            c.close()

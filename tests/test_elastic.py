@@ -1,0 +1,182 @@
+"""Elastic tests: state commit/restore/sync, sampler re-partitioning,
+driver with fake discovery + mock workers, run wrapper recovery.
+
+Mirrors test/single/test_elastic_driver.py (fake discovery scripts, mock
+workers) and test/single/test_torch_elastic.py (state save/restore)."""
+import os
+import stat
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from horovod_tpu.core.types import (HorovodInternalError,
+                                    HostsUpdatedInterrupt)
+from horovod_tpu.elastic import (ElasticDriver, ElasticSampler,
+                                 FixedHostDiscovery, HostManager, State,
+                                 TrainState)
+from horovod_tpu.elastic.discovery import HostDiscoveryScript
+
+
+class TestState:
+    def test_commit_restore(self, hvd):
+        s = State(epoch=1, w=np.ones(3))
+        s.epoch = 5
+        s.w = np.zeros(3)
+        s.restore()
+        assert s.epoch == 1
+        np.testing.assert_array_equal(s.w, np.ones(3))
+
+    def test_commit_saves(self, hvd):
+        s = State(epoch=0)
+        s.epoch = 2
+        s.commit()
+        s.epoch = 9
+        s.restore()
+        assert s.epoch == 2
+
+    def test_sync_pytree(self, hvd):
+        s = TrainState(params={"w": jnp.ones((4,))}, epoch=3)
+        s.sync()
+        assert s.epoch == 3
+        np.testing.assert_array_equal(np.asarray(s.params["w"]), np.ones(4))
+
+    def test_reset_callbacks(self, hvd):
+        calls = []
+        s = State(x=1)
+        s.register_reset_callbacks([lambda: calls.append(1)])
+        s.on_reset()
+        assert calls == [1]
+
+
+class TestSampler:
+    def test_partition_across_replicas(self):
+        samplers = [ElasticSampler(12, shuffle=False, num_replicas=3, rank=r)
+                    for r in range(3)]
+        seen = sorted(i for s in samplers for i in s)
+        assert seen == list(range(12))
+
+    def test_reset_repartitions_unprocessed(self):
+        s = ElasticSampler(12, shuffle=False, num_replicas=3, rank=0)
+        s.record_indices([0, 1, 2, 3, 4, 5])
+        s.reset(num_replicas=2, rank=0)
+        s2 = ElasticSampler(12, shuffle=False, num_replicas=2, rank=1)
+        s2.record_indices([0, 1, 2, 3, 4, 5])
+        s2.reset(num_replicas=2, rank=1)
+        remaining = sorted(set(list(s) + list(s2)))
+        assert remaining == [6, 7, 8, 9, 10, 11]
+
+    def test_epoch_clears_progress(self):
+        s = ElasticSampler(8, shuffle=True, num_replicas=2, rank=0)
+        s.record_indices(list(range(8)))
+        s.set_epoch(1)
+        assert len(s) == 4
+
+
+class TestHostManager:
+    def test_blacklist_and_resurrect(self, monkeypatch):
+        disc = FixedHostDiscovery({"a": 1, "b": 1})
+        mgr = HostManager(disc)
+        assert {h.hostname for h in mgr.current_hosts()} == {"a", "b"}
+        mgr.blacklist("b")
+        assert {h.hostname for h in mgr.current_hosts()} == {"a"}
+        # simulate cooldown expiry
+        mgr.states["b"]._until = 0.0
+        assert {h.hostname for h in mgr.current_hosts()} == {"a", "b"}
+
+    def test_discovery_script(self, tmp_path):
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho localhost:2\necho otherhost:1\n")
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        d = HostDiscoveryScript(str(script))
+        assert d.find_available_hosts_and_slots() == {"localhost": 2,
+                                                      "otherhost": 1}
+
+
+class TestElasticDriver:
+    def test_completes_on_success(self):
+        disc = FixedHostDiscovery({"localhost": 2})
+        driver = ElasticDriver(disc, ["true"], min_np=1, poll_interval=0.1)
+        assert driver.run() == 0
+
+    def test_worker_failure_blacklists_and_respects_reset_limit(self):
+        disc = FixedHostDiscovery({"localhost": 1})
+        driver = ElasticDriver(disc, ["false"], min_np=1, reset_limit=1,
+                               poll_interval=0.05)
+        with pytest.raises(RuntimeError, match="reset_limit"):
+            driver.run()
+        assert driver.resets >= 1
+
+    def test_host_change_triggers_reset(self, tmp_path):
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("localhost:1\n")
+        script = tmp_path / "discover.sh"
+        script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        disc = HostDiscoveryScript(str(script))
+        driver = ElasticDriver(disc, ["sleep", "30"], min_np=1,
+                               reset_limit=0, poll_interval=0.05)
+
+        def mutate():
+            time.sleep(0.5)
+            hosts_file.write_text("localhost:2\n")  # topology change
+
+        t = threading.Thread(target=mutate)
+        t.start()
+        with pytest.raises(RuntimeError, match="reset_limit"):
+            driver.run()
+        t.join()
+
+
+class TestRunWrapper:
+    def test_recovers_from_internal_error(self, hvd):
+        from horovod_tpu.elastic import run as elastic_run
+        attempts = []
+
+        @elastic_run
+        def train(state):
+            attempts.append(state.epoch)
+            if len(attempts) < 2:
+                state.epoch = 99   # uncommitted progress, must roll back
+                raise HorovodInternalError("fake comm failure")
+            return state.epoch
+
+        s = State(epoch=7)
+        assert train(s) == 7
+        assert len(attempts) == 2
+
+    def test_hosts_updated_commits(self, hvd):
+        from horovod_tpu.elastic import run as elastic_run
+        attempts = []
+
+        @elastic_run
+        def train(state):
+            attempts.append(1)
+            if len(attempts) < 2:
+                state.epoch = 42
+                raise HostsUpdatedInterrupt()
+            return state.epoch
+
+        s = State(epoch=0)
+        assert train(s) == 42   # HostsUpdated commits in-flight progress
+
+    def test_reset_limit(self, hvd):
+        from horovod_tpu.elastic import run as elastic_run
+
+        @elastic_run
+        def train(state):
+            raise HorovodInternalError("always fails")
+
+        with pytest.raises(RuntimeError, match="reset limit"):
+            train(State(epoch=0), reset_limit=2)
+
+    def test_notification_manager_check(self, hvd):
+        from horovod_tpu.elastic import notification_manager
+        notification_manager.init()
+        notification_manager.handle_hosts_updated()
+        with pytest.raises(HostsUpdatedInterrupt):
+            notification_manager.check()
+        notification_manager.check()  # cleared

@@ -1,0 +1,141 @@
+"""Launcher unit tests: host parsing, slot assignment, CLI env mapping,
+KV store, programmatic run() integration.
+
+Mirrors the reference's test/single/test_run.py (arg parsing, backend
+choice, cmdline construction) and test/integration/test_static_run.py
+(real localhost launch)."""
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner.hosts import (HostInfo, get_host_assignments,
+                                      parse_host_file, parse_hosts)
+from horovod_tpu.runner.http_kv import (KVStoreClient, RendezvousServer,
+                                        make_secret)
+from horovod_tpu.runner.launch import check_build, env_from_args, parse_args
+
+
+class TestHosts:
+    def test_parse_hosts(self):
+        hosts = parse_hosts("a:2, b:4,c")
+        assert hosts == [HostInfo("a", 2), HostInfo("b", 4),
+                         HostInfo("c", 1)]
+
+    def test_parse_host_file(self, tmp_path):
+        p = tmp_path / "hf"
+        p.write_text("# comment\nhost1 slots=2\nhost2\n")
+        assert parse_host_file(str(p)) == [HostInfo("host1", 2),
+                                           HostInfo("host2", 1)]
+
+    def test_assignments_ranks(self):
+        slots = get_host_assignments(parse_hosts("a:2,b:2"), 4)
+        assert [(s.hostname, s.rank, s.local_rank, s.cross_rank)
+                for s in slots] == [
+            ("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)]
+        assert all(s.size == 4 and s.local_size == 2 and s.cross_size == 2
+                   for s in slots)
+
+    def test_assignments_partial(self):
+        slots = get_host_assignments(parse_hosts("a:4,b:4"), 3)
+        assert [s.hostname for s in slots] == ["a", "a", "a"]
+        assert slots[2].local_size == 3
+
+    def test_np_exceeds_slots(self):
+        with pytest.raises(ValueError, match="exceeds"):
+            get_host_assignments(parse_hosts("a:1"), 2)
+
+
+class TestCLI:
+    def test_flag_env_mapping(self):
+        args = parse_args(["-np", "2", "--fusion-threshold-mb", "32",
+                           "--cycle-time-ms", "2.5", "--autotune",
+                           "--timeline-filename", "/tmp/tl.json",
+                           "python", "train.py"])
+        env = env_from_args(args)
+        assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+        assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+        assert env["HOROVOD_AUTOTUNE"] == "1"
+        assert env["HOROVOD_TIMELINE"] == "/tmp/tl.json"
+        assert args.command == ["python", "train.py"]
+
+    def test_config_file_merge(self, tmp_path):
+        cfg = tmp_path / "conf.json"
+        cfg.write_text(json.dumps({"cycle-time-ms": 7.0,
+                                   "cache-capacity": 99}))
+        args = parse_args(["-np", "1", "--config-file", str(cfg),
+                           "--cache-capacity", "5", "x"])
+        env = env_from_args(args)
+        assert env["HOROVOD_CYCLE_TIME"] == "7.0"   # from config file
+        assert env["HOROVOD_CACHE_CAPACITY"] == "5"  # CLI wins
+
+    def test_check_build_mentions_tpu(self):
+        assert "XLA collectives" in check_build()
+
+
+class TestKVStore:
+    def test_put_get_roundtrip(self):
+        secret = make_secret()
+        server = RendezvousServer(secret=secret)
+        port = server.start()
+        try:
+            client = KVStoreClient("127.0.0.1", port, secret)
+            client.put("scope", "k1", b"hello")
+            assert client.get("scope", "k1") == b"hello"
+            assert client.get("scope", "missing") is None
+            assert client.wait("scope", "k1") == b"hello"
+        finally:
+            server.stop()
+
+    def test_bad_secret_rejected(self):
+        server = RendezvousServer(secret=make_secret())
+        port = server.start()
+        try:
+            bad = KVStoreClient("127.0.0.1", port, "wrong")
+            with pytest.raises(RuntimeError, match="403"):
+                bad.put("s", "k", b"x")
+        finally:
+            server.stop()
+
+    def test_rendezvous_plan(self):
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            slots = get_host_assignments(parse_hosts("localhost:2"), 2)
+            server.init(slots)
+            client = KVStoreClient("127.0.0.1", port)
+            meta = json.loads(client.get("rendezvous", "meta"))
+            assert meta["size"] == 2
+            rec = json.loads(client.get("rendezvous", "1"))
+            assert rec["rank"] == 1 and rec["local_rank"] == 1
+        finally:
+            server.stop()
+
+
+def _worker_identity():
+    import os
+    return {k: os.environ.get(f"HOROVOD_{k.upper()}")
+            for k in ("rank", "size", "local_rank", "cross_rank")}
+
+
+class TestProgrammaticRun:
+    def test_run_two_local_workers(self):
+        import horovod_tpu
+        results = horovod_tpu.run(_worker_identity, np=2)
+        assert results[0]["rank"] == "0" and results[1]["rank"] == "1"
+        assert all(r["size"] == "2" for r in results)
+
+    def test_worker_failure_raises(self):
+        import horovod_tpu
+        with pytest.raises(RuntimeError, match="exited"):
+            horovod_tpu.run(_fail_fn, np=2)
+
+
+def _fail_fn():
+    import os
+    if os.environ.get("HOROVOD_RANK") == "1":
+        raise SystemExit(3)
+    return "ok"
