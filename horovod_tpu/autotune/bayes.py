@@ -1,0 +1,100 @@
+"""Bayesian optimization: Gaussian-process regression + expected improvement.
+
+Re-design of the reference's autotuning math
+(horovod/common/optim/gaussian_process.{cc,h} and
+bayesian_optimization.{cc,h}): a numpy GP with RBF kernel fit by jittered
+Cholesky, EI acquisition maximized by random candidate search (the reference
+uses vendored L-BFGS; random search over the small 2-4 dim knob space is
+equally effective and dependency-free).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class GaussianProcess:
+    """GP regression with RBF kernel (gaussian_process.cc analog)."""
+
+    def __init__(self, length_scale: float = 1.0, sigma_f: float = 1.0,
+                 sigma_n: float = 1e-4):
+        self.length_scale = length_scale
+        self.sigma_f = sigma_f
+        self.sigma_n = sigma_n
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._alpha = None
+        self._L = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.sigma_f ** 2 * np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        y = np.asarray(y, np.float64).reshape(-1)
+        K = self._kernel(x, x) + self.sigma_n ** 2 * np.eye(len(x))
+        self._L = np.linalg.cholesky(K)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, y))
+        self._x, self._y = x, y
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        if self._x is None:
+            return np.zeros(len(x)), np.ones(len(x))
+        Ks = self._kernel(x, self._x)
+        mu = Ks @ self._alpha
+        v = np.linalg.solve(self._L, Ks.T)
+        var = np.clip(self.sigma_f ** 2 - (v ** 2).sum(0), 1e-12, None)
+        return mu, np.sqrt(var)
+
+
+def expected_improvement(mu: np.ndarray, sigma: np.ndarray,
+                         best: float, xi: float = 0.01) -> np.ndarray:
+    """EI acquisition (bayesian_optimization.cc analog)."""
+    from math import erf, sqrt
+    z = (mu - best - xi) / sigma
+    cdf = 0.5 * (1.0 + np.vectorize(erf)(z / sqrt(2.0)))
+    pdf = np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+    return (mu - best - xi) * cdf + sigma * pdf
+
+
+class BayesianOptimizer:
+    """Sequential maximizer over a box domain."""
+
+    def __init__(self, bounds: Sequence[Tuple[float, float]],
+                 seed: int = 0, n_candidates: int = 512):
+        self.bounds = np.asarray(bounds, np.float64)
+        self.rng = np.random.RandomState(seed)
+        self.n_candidates = n_candidates
+        self.gp = GaussianProcess(length_scale=0.3)
+        self.xs: List[np.ndarray] = []
+        self.ys: List[float] = []
+
+    def _norm(self, x):
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return (x - lo) / np.maximum(hi - lo, 1e-12)
+
+    def _denorm(self, u):
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return lo + u * (hi - lo)
+
+    def tell(self, x: np.ndarray, y: float) -> None:
+        self.xs.append(self._norm(np.asarray(x, np.float64)))
+        self.ys.append(float(y))
+        self.gp.fit(np.stack(self.xs), np.asarray(self.ys))
+
+    def suggest(self) -> np.ndarray:
+        if len(self.xs) < 3:          # bootstrap: random exploration
+            u = self.rng.rand(len(self.bounds))
+            return self._denorm(u)
+        cand = self.rng.rand(self.n_candidates, len(self.bounds))
+        mu, sigma = self.gp.predict(cand)
+        ei = expected_improvement(mu, sigma, max(self.ys))
+        return self._denorm(cand[int(np.argmax(ei))])
+
+    def best(self) -> Tuple[np.ndarray, float]:
+        i = int(np.argmax(self.ys))
+        return self._denorm(self.xs[i]), self.ys[i]

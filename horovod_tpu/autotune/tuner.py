@@ -1,0 +1,92 @@
+"""ParameterManager: runtime autotuning of fusion/cycle knobs.
+
+Re-design of horovod/common/parameter_manager.{cc,h}: when HOROVOD_AUTOTUNE=1
+the engine reports (bytes, seconds) per scoring window; the manager samples
+candidate (fusion_threshold, cycle_time) settings via Bayesian optimization
+maximizing bytes/sec (parameter_manager.h:33-41), discards warmup samples,
+and after `max_samples` pins the best configuration. Sampled scores go to a
+CSV log when HOROVOD_AUTOTUNE_LOG is set (operations.cc:630-637).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .bayes import BayesianOptimizer
+
+# knob domains: fusion threshold 0..128 MB, cycle time 1..25 ms — the
+# reference's tunable ranges (parameter_manager.cc defaults)
+FUSION_MB_RANGE = (0.0, 128.0)
+CYCLE_MS_RANGE = (1.0, 25.0)
+
+
+class ParameterManager:
+    def __init__(self, warmup_samples: int = 3, steps_per_sample: int = 10,
+                 max_samples: int = 20, log_path: Optional[str] = None,
+                 seed: int = 0):
+        self.opt = BayesianOptimizer([FUSION_MB_RANGE, CYCLE_MS_RANGE],
+                                     seed=seed)
+        self.warmup_samples = warmup_samples
+        self.steps_per_sample = steps_per_sample
+        self.max_samples = max_samples
+        self.log_path = log_path
+        self.active = True
+        self.samples_taken = 0
+        self._steps = 0
+        self._bytes = 0.0
+        self._t0 = time.monotonic()
+        self._current = np.array([64.0, 1.0])
+        self._log_header_written = False
+
+    # -- current knob values ------------------------------------------------
+    @property
+    def fusion_threshold_bytes(self) -> int:
+        return int(self._current[0] * 1024 * 1024)
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return float(self._current[1])
+
+    # -- scoring (parameter_manager Update analog) ---------------------------
+    def record(self, nbytes: int) -> bool:
+        """Report one engine cycle's traffic; returns True when knob values
+        changed (caller should re-read the properties)."""
+        if not self.active:
+            return False
+        self._bytes += nbytes
+        self._steps += 1
+        if self._steps < self.steps_per_sample:
+            return False
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        score = self._bytes / elapsed          # bytes/sec
+        self._finish_sample(score)
+        return True
+
+    def _finish_sample(self, score: float) -> None:
+        self.samples_taken += 1
+        if self.samples_taken > self.warmup_samples:
+            self.opt.tell(self._current, score)
+            self._log(score)
+        if self.samples_taken >= self.max_samples + self.warmup_samples \
+                and self.opt.ys:
+            best, best_score = self.opt.best()
+            self._current = best
+            self.active = False
+            self._log(best_score, final=True)
+        else:
+            self._current = self.opt.suggest()
+        self._steps = 0
+        self._bytes = 0.0
+        self._t0 = time.monotonic()
+
+    def _log(self, score: float, final: bool = False) -> None:
+        if not self.log_path:
+            return
+        with open(self.log_path, "a") as f:
+            if not self._log_header_written:
+                f.write("fusion_mb,cycle_ms,bytes_per_sec,final\n")
+                self._log_header_written = True
+            f.write(f"{self._current[0]:.2f},{self._current[1]:.2f},"
+                    f"{score:.1f},{int(final)}\n")

@@ -1,0 +1,134 @@
+"""Training-loop callbacks: broadcast, metric averaging, LR warmup/schedule.
+
+Re-design of the reference's keras callback family
+(horovod/_keras/callbacks.py:23-213: BroadcastGlobalVariablesCallback,
+MetricAverageCallback, LearningRateWarmupCallback,
+LearningRateScheduleCallback), framework-agnostic for jax training loops.
+
+Protocol: a loop calls `on_train_begin()`, `on_epoch_begin(epoch)`,
+`on_batch_begin(batch, epoch)`, `on_batch_end(batch, logs)`,
+`on_epoch_end(epoch, logs)`. LR callbacks mutate a `Schedule` object the
+optimizer reads (use `optax.inject_hyperparams` or read `.value` in your
+own schedule fn).
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .core import basics
+from .core.types import ReduceOp
+from .ops import collective_ops
+from .optim.functions import broadcast_parameters
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class Callback:
+    def on_train_begin(self): ...
+    def on_epoch_begin(self, epoch: int): ...
+    def on_batch_begin(self, batch: int, epoch: int = 0): ...
+    def on_batch_end(self, batch: int, logs: Optional[Dict] = None): ...
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None): ...
+
+
+class LearningRate:
+    """Mutable LR handle shared between callbacks and the optimizer."""
+
+    def __init__(self, value: float):
+        self.initial = value
+        self.value = value
+
+    def __float__(self):
+        return float(self.value)
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Sync state from root at train start
+    (_keras/callbacks.py:23 BroadcastGlobalVariablesCallbackImpl)."""
+
+    def __init__(self, state_getter: Callable[[], Any],
+                 state_setter: Callable[[Any], None], root_rank: int = 0):
+        self.get, self.set, self.root = state_getter, state_setter, root_rank
+
+    def on_train_begin(self):
+        self.set(broadcast_parameters(self.get(), self.root))
+
+
+class MetricAverageCallback(Callback):
+    """Allreduce-average metrics across workers at epoch end
+    (_keras/callbacks.py:62)."""
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None):
+        if not logs or not basics.is_initialized():
+            return
+        n = basics.size()
+        for k, v in list(logs.items()):
+            arr = np.asarray(v, np.float32)
+            if arr.ndim == 0:
+                # replicated scalar metric: already identical under the
+                # single controller; stacked [size] vector: average rows
+                continue
+            if arr.shape[0] == n:
+                out = collective_ops.allreduce(arr, ReduceOp.AVERAGE)
+                logs[k] = np.asarray(out)[0]
+
+
+class LearningRateWarmupCallback(Callback):
+    """Linear LR ramp initial_lr/size -> initial_lr*size over warmup epochs
+    (_keras/callbacks.py:106 — 'gradual warmup' per Goyal et al.)."""
+
+    def __init__(self, lr: LearningRate, warmup_epochs: int = 5,
+                 steps_per_epoch: int = 1, momentum_correction: bool = True,
+                 verbose: bool = False):
+        self.lr = lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+
+    def on_batch_begin(self, batch: int, epoch: int = 0):
+        if epoch >= self.warmup_epochs:
+            self.lr.value = self.lr.initial * basics.size()
+            return
+        progress = (epoch * self.steps_per_epoch + batch) / float(
+            self.warmup_epochs * self.steps_per_epoch)
+        size = basics.size()
+        self.lr.value = self.lr.initial * (1.0 + progress * (size - 1.0))
+        if self.verbose:
+            logger.info("warmup lr=%.6f", self.lr.value)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply LR by `multiplier(epoch)` within [start_epoch, end_epoch)
+    (_keras/callbacks.py:160)."""
+
+    def __init__(self, lr: LearningRate, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True):
+        self.lr = lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        if not callable(multiplier):
+            mult = float(multiplier)
+            self.multiplier = lambda epoch: mult
+        else:
+            self.multiplier = multiplier
+
+    def _in_range(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch: int):
+        if self.staircase and self._in_range(epoch):
+            self.lr.value = self.lr.initial * basics.size() * \
+                self.multiplier(epoch)
+
+    def on_batch_begin(self, batch: int, epoch: int = 0):
+        if not self.staircase and self._in_range(epoch):
+            frac = epoch + batch / 1000.0
+            self.lr.value = self.lr.initial * basics.size() * \
+                self.multiplier(frac)

@@ -1,0 +1,99 @@
+"""Data loading utilities: base loader + async prefetch + shard helper.
+
+Re-design of horovod/data/data_loader_base.py (BaseDataLoader,
+AsyncDataLoaderMixin — background-thread prefetch queue) plus the sharding
+convention the reference's examples use (DistributedSampler with
+num_replicas=hvd.size(), rank=hvd.rank()).
+
+TPU note: the prefetch thread overlaps host-side batch prep with device
+steps; pair with `training.shard_batch` to land batches directly in their
+mesh sharding (one host->HBM transfer per step).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+
+class BaseDataLoader:
+    """Iterable loader contract (data_loader_base.py BaseDataLoader)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Any]:
+        return self._iterate()
+
+
+class AsyncDataLoaderMixin:
+    """Prefetch batches on a background thread
+    (data_loader_base.py AsyncDataLoaderMixin).
+
+    Mix in BEFORE the loader class:
+        class MyAsyncLoader(AsyncDataLoaderMixin, MyLoader): ...
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 5, **kwargs):
+        self.async_loader_queue_size = async_loader_queue_size
+        self._async_queue: Optional[queue.Queue] = None
+        self._async_thread: Optional[threading.Thread] = None
+        self._async_stop = threading.Event()
+        super().__init__(*args, **kwargs)
+
+    def close_async_loader(self) -> None:
+        self._async_stop.set()
+        if self._async_queue is not None:
+            try:
+                while True:
+                    self._async_queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._async_thread is not None:
+            self._async_thread.join(timeout=5)
+            self._async_thread = None
+
+    def _producer(self) -> None:
+        try:
+            for batch in super()._iterate():
+                if self._async_stop.is_set():
+                    return
+                self._async_queue.put(batch)
+        finally:
+            self._async_queue.put(None)  # sentinel
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.async_loader_queue_size <= 0:
+            yield from super()._iterate()
+            return
+        self._async_stop.clear()
+        self._async_queue = queue.Queue(self.async_loader_queue_size)
+        self._async_thread = threading.Thread(target=self._producer,
+                                              daemon=True)
+        self._async_thread.start()
+        while True:
+            batch = self._async_queue.get()
+            if batch is None:
+                break
+            yield batch
+        self._async_thread.join(timeout=5)
+        self._async_thread = None
+
+
+def shard_indices(dataset_size: int, rank: int, num_replicas: int,
+                  shuffle: bool = False, seed: int = 0,
+                  drop_remainder: bool = False):
+    """Deterministic per-rank index shard (DistributedSampler semantics)."""
+    import random
+    idx = list(range(dataset_size))
+    if shuffle:
+        random.Random(seed).shuffle(idx)
+    if drop_remainder:
+        per = dataset_size // num_replicas
+        idx = idx[: per * num_replicas]
+    elif len(idx) % num_replicas != 0:
+        idx += idx[: num_replicas - len(idx) % num_replicas]
+    return idx[rank::num_replicas]

@@ -132,6 +132,14 @@ class Engine:
         self.cycles = 0
         self.tensors_fused = 0
         self.bytes_processed = 0
+        # autotuner (HOROVOD_AUTOTUNE=1, parameter_manager.cc analog)
+        self.tuner = None
+        if cfg.autotune:
+            from ..autotune.tuner import ParameterManager
+            self.tuner = ParameterManager(
+                warmup_samples=cfg.autotune_warmup_samples,
+                steps_per_sample=cfg.autotune_steps_per_sample,
+                log_path=cfg.autotune_log)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -224,8 +232,13 @@ class Engine:
         tl = self._state.timeline
         if tl is not None:
             tl.mark_cycle()
+        bytes_before = self.bytes_processed
         for bucket in self._bucketize(batch):
             self._execute_bucket(bucket)
+        if self.tuner is not None and self.tuner.active:
+            if self.tuner.record(self.bytes_processed - bytes_before):
+                self.fusion_threshold = self.tuner.fusion_threshold_bytes
+                self.cycle_time_s = self.tuner.cycle_time_ms / 1000.0
 
     def _bucketize(self, batch: List[_Work]) -> List[List[_Work]]:
         """Group fusable requests, splitting at the fusion threshold."""
@@ -253,6 +266,10 @@ class Engine:
     def _execute_bucket(self, bucket: List[_Work]) -> None:
         tl = self._state.timeline
         names = [w.name for w in bucket]
+        for w in bucket:
+            if not isinstance(w.tensor, (list, tuple)):
+                t = jnp.asarray(w.tensor)
+                self.bytes_processed += t.size * t.dtype.itemsize
         try:
             if len(bucket) == 1 and \
                bucket[0].request_type != RequestType.ALLREDUCE:
@@ -316,7 +333,6 @@ class Engine:
         while len(self.cache_stats) > cap:
             self.cache_stats.popitem(last=False)
         self.tensors_fused += len(bucket)
-        self.bytes_processed += sum(t.size * t.dtype.itemsize for t in tensors)
 
         flat = jnp.concatenate(
             [t.reshape(n, -1) for t in tensors], axis=1)

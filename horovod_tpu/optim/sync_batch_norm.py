@@ -1,0 +1,77 @@
+"""SyncBatchNorm: batch normalization with cross-device statistics.
+
+Re-design of horovod/torch/sync_batch_norm.py:40-218 — there, mean/var are
+exchanged with hand-rolled allgathers inside a custom autograd Function. On
+TPU the whole thing is one flax module: `axis_name` makes the batch-stat
+reduction a psum over the mesh axis inside the compiled step, and the
+backward pass falls out of autodiff through the psum (which differentiates
+to another psum). Usable inside shard_map/pmap regions with a 'hvd'/'dp'
+axis.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.mesh import GLOBAL_AXIS
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BatchNorm whose statistics span all devices on `axis_name`.
+
+    Parameters mirror flax BatchNorm; `axis_name` defaults to the global
+    mesh axis. Process-set scoped normalization = pass that set's axis.
+    """
+
+    axis_name: str = GLOBAL_AXIS
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        features = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros(features, jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones(features, jnp.float32))
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            local_mean = xf.mean(axes)
+            local_sq = (xf ** 2).mean(axes)
+            # cross-device moments: one fused psum pair on the mesh axis
+            # (the role of the reference's mean/var allgather,
+            # sync_batch_norm.py:99); during init the axis is unbound, so
+            # local moments stand in (flax BatchNorm does the same)
+            if self.is_initializing():
+                mean, sq = local_mean, local_sq
+            else:
+                mean = lax.pmean(local_mean, self.axis_name)
+                sq = lax.pmean(local_sq, self.axis_name)
+            var = sq - mean ** 2
+            if not self.is_initializing():
+                ra_mean.value = self.momentum * ra_mean.value + \
+                    (1 - self.momentum) * mean
+                ra_var.value = self.momentum * ra_var.value + \
+                    (1 - self.momentum) * var
+        y = (x.astype(jnp.float32) - mean) / jnp.sqrt(var + self.epsilon)
+        if self.use_scale:
+            y = y * self.param("scale", nn.initializers.ones,
+                               (features,), self.param_dtype)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros,
+                               (features,), self.param_dtype)
+        return y.astype(self.dtype or x.dtype)
