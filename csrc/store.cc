@@ -1,0 +1,517 @@
+// Native coordination layer: TCP key-value store + rank-0-free coordinator
+// primitives (barrier / allgather / broadcast / bitwise AND-OR of bitvectors).
+//
+// TPU-native re-design of the reference's control-plane transport:
+//  * horovod/common/gloo/http_store.{cc,h} — HTTP KV rendezvous store the C++
+//    core uses to bootstrap Gloo contexts. Here the store speaks a compact
+//    length-prefixed binary protocol instead of HTTP, and supports blocking
+//    GET with timeout plus read-counted auto-deletion (the role of the
+//    reference's DELETE-based finalization scopes, runner/http/http_server.py).
+//  * horovod/common/controller.h:49-157 — the pure-virtual transport hooks
+//    (CrossRankBitwiseAnd/Or, Bcast, Barrier, SendReadyTensors, ...) that MPI
+//    and Gloo controllers implement. hvd_coord_* provides the same primitive
+//    set over the store so the Python negotiation layer can agree on cache
+//    bitvectors across processes exactly like ComputeResponseList's fast path
+//    (controller.cc:155-190) without MPI or Gloo.
+//
+// Design notes: the control plane is low-fan-out (one connection per process)
+// and latency-bound, so the server is thread-per-connection with a condvar'd
+// map; collectives are store-key based with an internal sequence number so
+// repeated calls on the same tag never collide.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  OP_SET = 1,
+  OP_GET = 2,      // blocking, with timeout; optional read-counted delete
+  OP_DEL = 3,
+  OP_PING = 4,
+};
+
+enum Status : uint8_t {
+  ST_OK = 0,
+  ST_TIMEOUT = 1,
+  ST_ERROR = 2,
+};
+
+bool send_all(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool send_frame(int fd, uint8_t status, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  char hdr[5];
+  hdr[0] = static_cast<char>(status);
+  std::memcpy(hdr + 1, &len, 4);
+  if (!send_all(fd, hdr, 5)) return false;
+  return payload.empty() || send_all(fd, payload.data(), payload.size());
+}
+
+struct Entry {
+  std::string value;
+  int reads_left = 0;  // 0 = persistent; >0 = erase after this many reads
+  bool present = false;
+};
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+            0 ||
+        ::listen(listen_fd_, 512) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~StoreServer() {
+    shutting_down_.store(true);
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      cv_.notify_all();
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : handlers_)
+      if (t.joinable()) t.join();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+  }
+
+  int port() const { return port_; }
+  bool ok() const { return listen_fd_ >= 0; }
+
+ private:
+  void AcceptLoop() {
+    while (!shutting_down_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (errno == EINTR) continue;
+        break;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu_);
+      if (shutting_down_.load()) {
+        ::close(fd);
+        break;
+      }
+      conn_fds_.insert(fd);
+      handlers_.emplace_back([this, fd] { Handle(fd); });
+    }
+  }
+
+  void Handle(int fd) {
+    for (;;) {
+      uint8_t op;
+      uint32_t klen, vlen;
+      if (!recv_all(fd, &op, 1) || !recv_all(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !recv_all(fd, &key[0], klen)) break;
+      if (!recv_all(fd, &vlen, 4)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !recv_all(fd, &val[0], vlen)) break;
+
+      bool alive = true;
+      switch (op) {
+        case OP_SET: {
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            auto& e = data_[key];
+            e.value = std::move(val);
+            e.present = true;
+            e.reads_left = 0;
+          }
+          cv_.notify_all();
+          alive = send_frame(fd, ST_OK, "");
+          break;
+        }
+        case OP_GET: {
+          // value payload: double timeout_s + int32 expected_reads
+          double timeout_s = -1.0;
+          int32_t expected = 0;
+          if (val.size() >= 12) {
+            std::memcpy(&timeout_s, val.data(), 8);
+            std::memcpy(&expected, val.data() + 8, 4);
+          }
+          std::unique_lock<std::mutex> lk(mu_);
+          auto ready = [&] {
+            auto it = data_.find(key);
+            return (it != data_.end() && it->second.present) ||
+                   shutting_down_.load();
+          };
+          bool got;
+          if (timeout_s < 0) {
+            cv_.wait(lk, ready);
+            got = !shutting_down_.load();
+          } else {
+            got = cv_.wait_for(
+                      lk, std::chrono::duration<double>(timeout_s), ready) &&
+                  !shutting_down_.load();
+          }
+          if (!got) {
+            lk.unlock();
+            alive = send_frame(fd, ST_TIMEOUT, "");
+            break;
+          }
+          auto it = data_.find(key);
+          std::string out = it->second.value;
+          if (expected > 0) {
+            if (it->second.reads_left == 0) it->second.reads_left = expected;
+            if (--it->second.reads_left == 0) data_.erase(it);
+          }
+          lk.unlock();
+          alive = send_frame(fd, ST_OK, out);
+          break;
+        }
+        case OP_DEL: {
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            data_.erase(key);
+          }
+          alive = send_frame(fd, ST_OK, "");
+          break;
+        }
+        case OP_PING:
+          alive = send_frame(fd, ST_OK, "pong");
+          break;
+        default:
+          alive = send_frame(fd, ST_ERROR, "bad op");
+      }
+      if (!alive) break;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      conn_fds_.erase(fd);
+    }
+    ::close(fd);
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> shutting_down_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> handlers_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, Entry> data_;
+  std::set<int> conn_fds_;
+};
+
+class StoreClient {
+ public:
+  StoreClient(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      // not a dotted quad — resolve via loopback fallback
+      addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    }
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return;
+    }
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  // Returns status; fills out on ST_OK.
+  int Request(uint8_t op, const std::string& key, const std::string& val,
+              std::string* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    uint32_t vlen = static_cast<uint32_t>(val.size());
+    std::string frame;
+    frame.reserve(9 + klen + vlen);
+    frame.push_back(static_cast<char>(op));
+    frame.append(reinterpret_cast<char*>(&klen), 4);
+    frame.append(key);
+    frame.append(reinterpret_cast<char*>(&vlen), 4);
+    frame.append(val);
+    if (!send_all(fd_, frame.data(), frame.size())) return ST_ERROR;
+    uint8_t status;
+    uint32_t len;
+    if (!recv_all(fd_, &status, 1) || !recv_all(fd_, &len, 4)) return ST_ERROR;
+    std::string payload(len, '\0');
+    if (len && !recv_all(fd_, &payload[0], len)) return ST_ERROR;
+    if (out) *out = std::move(payload);
+    return status;
+  }
+
+  int Set(const std::string& key, const std::string& val) {
+    return Request(OP_SET, key, val, nullptr);
+  }
+
+  int Get(const std::string& key, double timeout_s, int expected_reads,
+          std::string* out) {
+    std::string arg(12, '\0');
+    std::memcpy(&arg[0], &timeout_s, 8);
+    int32_t er = expected_reads;
+    std::memcpy(&arg[8], &er, 4);
+    return Request(OP_GET, key, arg, out);
+  }
+
+  int Del(const std::string& key) { return Request(OP_DEL, key, "", nullptr); }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+// Coordinator: the reference controller's transport hook set
+// (controller.h:49-157) implemented over the store. Each collective call
+// consumes one sequence number; all ranks must call collectives in the same
+// order (the same assumption the reference's negotiation protocol makes).
+class Coordinator {
+ public:
+  Coordinator(const std::string& host, int port, int rank, int size)
+      : client_(host, port), rank_(rank), size_(size) {}
+
+  bool ok() const { return client_.ok(); }
+
+  std::string Key(const std::string& tag, uint64_t seq, int rank) {
+    return "hvd/" + tag + "/" + std::to_string(seq) + "/" +
+           std::to_string(rank);
+  }
+
+  // Allgather of variable-size blobs. out = concat of u32-len-prefixed blobs
+  // in rank order.
+  int Allgather(const std::string& tag, const std::string& blob,
+                double timeout_s, std::string* out) {
+    uint64_t seq = seq_++;
+    if (client_.Set(Key(tag, seq, rank_), blob) != ST_OK) return ST_ERROR;
+    out->clear();
+    for (int r = 0; r < size_; ++r) {
+      std::string v;
+      int st = client_.Get(Key(tag, seq, r), timeout_s, size_, &v);
+      if (st != ST_OK) return st;
+      uint32_t len = static_cast<uint32_t>(v.size());
+      out->append(reinterpret_cast<char*>(&len), 4);
+      out->append(v);
+    }
+    return ST_OK;
+  }
+
+  int Barrier(const std::string& tag, double timeout_s) {
+    std::string out;
+    return Allgather(tag, "", timeout_s, &out);
+  }
+
+  int Bcast(const std::string& tag, int root, std::string* blob,
+            double timeout_s) {
+    uint64_t seq = seq_++;
+    if (rank_ == root) {
+      if (size_ == 1) return ST_OK;
+      return client_.Set(Key(tag, seq, root), *blob) == ST_OK ? ST_OK
+                                                              : ST_ERROR;
+    }
+    return client_.Get(Key(tag, seq, root), timeout_s, size_ - 1, blob);
+  }
+
+  // In-place bitwise AND/OR allreduce of a bitvector — the cache-coordination
+  // primitive (controller.cc:845 CoordinateCacheAndState).
+  int BitReduce(const std::string& tag, uint8_t* bits, uint32_t nbytes,
+                bool is_and, double timeout_s) {
+    std::string blob(reinterpret_cast<char*>(bits), nbytes);
+    std::string all;
+    int st = Allgather(tag, blob, timeout_s, &all);
+    if (st != ST_OK) return st;
+    size_t off = 0;
+    bool first = true;
+    for (int r = 0; r < size_; ++r) {
+      uint32_t len;
+      std::memcpy(&len, all.data() + off, 4);
+      off += 4;
+      if (len != nbytes) return ST_ERROR;
+      const uint8_t* v = reinterpret_cast<const uint8_t*>(all.data() + off);
+      off += len;
+      if (first) {
+        std::memcpy(bits, v, nbytes);
+        first = false;
+      } else {
+        for (uint32_t i = 0; i < nbytes; ++i)
+          bits[i] = is_and ? (bits[i] & v[i]) : (bits[i] | v[i]);
+      }
+    }
+    return ST_OK;
+  }
+
+  StoreClient client_;
+  int rank_, size_;
+  std::atomic<uint64_t> seq_{0};
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hvd_store_server_create(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int hvd_store_server_port(void* s) {
+  return static_cast<StoreServer*>(s)->port();
+}
+
+void hvd_store_server_destroy(void* s) { delete static_cast<StoreServer*>(s); }
+
+void* hvd_client_create(const char* host, int port) {
+  auto* c = new StoreClient(host, port);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void hvd_client_destroy(void* c) { delete static_cast<StoreClient*>(c); }
+
+int hvd_client_set(void* c, const char* key, const uint8_t* val,
+                   uint32_t len) {
+  return static_cast<StoreClient*>(c)->Set(
+      key, std::string(reinterpret_cast<const char*>(val), len));
+}
+
+// out must hold *outcap bytes; returns status, sets *outlen to the full value
+// size (caller re-calls with a larger buffer if *outlen > *outcap — values
+// are small control-plane blobs so this is rare).
+int hvd_client_get(void* c, const char* key, double timeout_s,
+                   int expected_reads, uint8_t* out, uint32_t outcap,
+                   uint32_t* outlen) {
+  std::string v;
+  int st = static_cast<StoreClient*>(c)->Get(key, timeout_s, expected_reads,
+                                             &v);
+  if (st != ST_OK) return st;
+  *outlen = static_cast<uint32_t>(v.size());
+  if (*outlen > outcap) return ST_ERROR;
+  std::memcpy(out, v.data(), v.size());
+  return ST_OK;
+}
+
+int hvd_client_del(void* c, const char* key) {
+  return static_cast<StoreClient*>(c)->Del(key);
+}
+
+void* hvd_coord_create(const char* host, int port, int rank, int size) {
+  auto* co = new Coordinator(host, port, rank, size);
+  if (!co->ok()) {
+    delete co;
+    return nullptr;
+  }
+  return co;
+}
+
+void hvd_coord_destroy(void* c) { delete static_cast<Coordinator*>(c); }
+
+int hvd_coord_barrier(void* c, const char* tag, double timeout_s) {
+  return static_cast<Coordinator*>(c)->Barrier(tag, timeout_s);
+}
+
+int hvd_coord_allgather(void* c, const char* tag, const uint8_t* val,
+                        uint32_t len, double timeout_s, uint8_t* out,
+                        uint32_t outcap, uint32_t* outlen) {
+  std::string o;
+  int st = static_cast<Coordinator*>(c)->Allgather(
+      tag, std::string(reinterpret_cast<const char*>(val), len), timeout_s,
+      &o);
+  if (st != ST_OK) return st;
+  *outlen = static_cast<uint32_t>(o.size());
+  if (*outlen > outcap) return ST_ERROR;
+  std::memcpy(out, o.data(), o.size());
+  return ST_OK;
+}
+
+int hvd_coord_bcast(void* c, const char* tag, int root, const uint8_t* val,
+                    uint32_t len, double timeout_s, uint8_t* out,
+                    uint32_t outcap, uint32_t* outlen) {
+  std::string blob(reinterpret_cast<const char*>(val), len);
+  int st = static_cast<Coordinator*>(c)->Bcast(tag, root, &blob, timeout_s);
+  if (st != ST_OK) return st;
+  *outlen = static_cast<uint32_t>(blob.size());
+  if (*outlen > outcap) return ST_ERROR;
+  std::memcpy(out, blob.data(), blob.size());
+  return ST_OK;
+}
+
+int hvd_coord_bitand(void* c, const char* tag, uint8_t* bits, uint32_t nbytes,
+                     double timeout_s) {
+  return static_cast<Coordinator*>(c)->BitReduce(tag, bits, nbytes, true,
+                                                 timeout_s);
+}
+
+int hvd_coord_bitor(void* c, const char* tag, uint8_t* bits, uint32_t nbytes,
+                    double timeout_s) {
+  return static_cast<Coordinator*>(c)->BitReduce(tag, bits, nbytes, false,
+                                                 timeout_s);
+}
+
+}  // extern "C"

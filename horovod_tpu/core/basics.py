@@ -48,6 +48,7 @@ class _GlobalState:
         self.engine = None            # ops.engine.Engine, lazily started
         self.timeline = None          # timeline.Timeline
         self.parameter_manager = None # autotune.ParameterManager
+        self.coordinator = None       # native.store.Coordinator (multi-proc)
         self.joined_ranks = set()
         self.shutdown_requested = False
 
@@ -58,7 +59,10 @@ _state = _GlobalState()
 def _maybe_init_distributed(cfg: Config) -> None:
     """Join a multi-host job when the launcher provided coordinates."""
     coord = os.environ.get("HOROVOD_COORDINATOR_ADDR")
-    if coord and cfg.size_env and cfg.size_env > 1 and jax.process_count() == 1:
+    # NB: must not touch jax.process_count()/jax.devices() here — any backend
+    # query initializes XLA and makes jax.distributed.initialize impossible.
+    if coord and cfg.size_env and cfg.size_env > 1 \
+            and not jax.distributed.is_initialized():
         # Process identity is the host-level (cross) numbering, not the
         # per-device global rank; fall back explicitly (a '0' value is valid).
         def _first(*vals):
@@ -86,6 +90,39 @@ def _maybe_init_distributed(cfg: Config) -> None:
             raise RuntimeError(f"jax.distributed.initialize failed: {e}") from e
 
 
+def _maybe_create_coordinator():
+    """Connect the native host-level Coordinator (csrc/store.cc) when the
+    launcher exported a native KV address — the role the reference's
+    controller transport plays over Gloo (gloo/gloo_controller.cc): barrier,
+    blob allgather/bcast and cache-bitvector AND/OR across processes."""
+    addr = os.environ.get("HOROVOD_NATIVE_KV_ADDR")
+    port = os.environ.get("HOROVOD_NATIVE_KV_PORT")
+    if not addr or not port:
+        return None
+    rank_ = int(os.environ.get("HOROVOD_PROCESS_ID",
+                               os.environ.get("HOROVOD_CROSS_RANK", "0")))
+    size_ = int(os.environ.get("HOROVOD_NUM_PROCESSES",
+                               os.environ.get("HOROVOD_CROSS_SIZE", "1")))
+    try:
+        import socket
+        from ..native.store import Coordinator
+        # The launcher exports a hostname; resolve worker-side so remote
+        # workers get a routable address (the launcher's own /etc/hosts may
+        # map its name to loopback).
+        ip = socket.gethostbyname(addr)
+        return Coordinator(ip, int(port), rank_, size_)
+    except Exception as e:  # noqa: BLE001
+        if size_ > 1:
+            # The coordinator protocol is collective: one process silently
+            # running without it would leave the others blocked in every
+            # barrier/allgather until timeout. Fail fast instead.
+            raise RuntimeError(
+                f"native coordinator connect failed ({addr}:{port}): {e}; "
+                "all processes must join the control plane") from e
+        logger.warning("native coordinator unavailable: %s", e)
+        return None
+
+
 def init(comm: Optional[Sequence[int]] = None,
          process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
     """Initialize the framework (reference: hvd.init, basics.py:51).
@@ -100,6 +137,7 @@ def init(comm: Optional[Sequence[int]] = None,
         cfg = Config.from_env()
         _state.config = cfg
         _maybe_init_distributed(cfg)
+        _state.coordinator = _maybe_create_coordinator()
 
         devices = global_devices()
         if comm is not None and not hasattr(comm, "Get_rank"):
@@ -148,6 +186,9 @@ def shutdown() -> None:
     if _state.timeline is not None:
         _state.timeline.stop()
         _state.timeline = None
+    if _state.coordinator is not None:
+        _state.coordinator.close()
+        _state.coordinator = None
     with _state.lock:
         _state.process_set_table.clear()
         _state.initialized = False
@@ -325,6 +366,12 @@ def get_hier_mesh():
 def get_config() -> Config:
     _require_init()
     return _state.config
+
+
+def get_coordinator():
+    """The native host-level Coordinator, or None in single-process mode."""
+    _require_init()
+    return _state.coordinator
 
 
 def get_process_set(process_set: Optional[ProcessSet] = None) -> ProcessSet:

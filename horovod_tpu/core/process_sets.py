@@ -50,6 +50,11 @@ class ProcessSet:
         return self.ranks is not None and global_rank in self.ranks
 
     @property
+    def is_global(self) -> bool:
+        """True for the global set (id 0, process_set.h:89 'id 0 = global')."""
+        return self.process_set_id == 0
+
+    @property
     def mesh(self) -> Mesh:
         if self._mesh is None:
             raise ValueError(

@@ -1,0 +1,182 @@
+"""Python wrappers over the native TCP KV store + coordinator.
+
+These mirror the objects the reference builds its control plane from:
+`StoreServer`/`StoreClient` play the role of the Gloo HTTP rendezvous store
+(horovod/common/gloo/http_store.cc, runner/http/http_server.py KVStoreServer)
+and `Coordinator` the role of the controller transport hooks
+(horovod/common/controller.h:49-157 — Barrier, Bcast, CrossRankBitwiseAnd/Or,
+SendReadyTensors/RecvReadyTensors as blob allgather).
+"""
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import List, Optional
+
+from . import lib
+
+_OK, _TIMEOUT, _ERROR = 0, 1, 2
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+class NativeTimeout(NativeError):
+    pass
+
+
+def _check(status: int, what: str) -> None:
+    if status == _TIMEOUT:
+        raise NativeTimeout(f"{what} timed out")
+    if status != _OK:
+        raise NativeError(f"{what} failed (status {status})")
+
+
+def _buf(n: int):
+    return (ctypes.c_uint8 * n)()
+
+
+def _as_u8p(data: bytes):
+    return ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+
+
+class StoreServer:
+    """In-process KV store server; one per job, usually on the launcher."""
+
+    def __init__(self, port: int = 0):
+        self._lib = lib()
+        self._h = self._lib.hvd_store_server_create(port)
+        if not self._h:
+            raise NativeError(f"could not bind store server on port {port}")
+        self.port = self._lib.hvd_store_server_port(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_store_server_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class StoreClient:
+    def __init__(self, host: str, port: int):
+        self._lib = lib()
+        self._h = self._lib.hvd_client_create(host.encode(), port)
+        if not self._h:
+            raise NativeError(f"could not connect to store {host}:{port}")
+
+    def set(self, key: str, value: bytes) -> None:
+        _check(self._lib.hvd_client_set(self._h, key.encode(),
+                                        _as_u8p(value), len(value)),
+               f"set({key})")
+
+    def get(self, key: str, timeout: Optional[float] = None,
+            expected_reads: int = 0, max_bytes: int = 1 << 20) -> bytes:
+        out = _buf(max_bytes)
+        outlen = ctypes.c_uint32(0)
+        t = -1.0 if timeout is None else float(timeout)
+        st = self._lib.hvd_client_get(self._h, key.encode(), t,
+                                      expected_reads, out, max_bytes,
+                                      ctypes.byref(outlen))
+        _check(st, f"get({key})")
+        return bytes(out[:outlen.value])
+
+    def delete(self, key: str) -> None:
+        _check(self._lib.hvd_client_del(self._h, key.encode()),
+               f"delete({key})")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_client_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class Coordinator:
+    """Cross-rank control-plane collectives over the store.
+
+    All ranks must issue the same sequence of calls (the reference's
+    negotiation protocol makes the identical assumption — controller.cc:74).
+    """
+
+    def __init__(self, host: str, port: int, rank: int, size: int,
+                 timeout: float = 300.0):
+        self._lib = lib()
+        self._h = self._lib.hvd_coord_create(host.encode(), port, rank, size)
+        if not self._h:
+            raise NativeError(f"coordinator connect failed {host}:{port}")
+        self.rank, self.size, self.timeout = rank, size, timeout
+
+    def barrier(self, tag: str = "barrier") -> None:
+        _check(self._lib.hvd_coord_barrier(self._h, tag.encode(),
+                                           self.timeout), f"barrier({tag})")
+
+    def allgather(self, blob: bytes, tag: str = "ag",
+                  max_bytes: int = 1 << 22) -> List[bytes]:
+        out = _buf(max_bytes)
+        outlen = ctypes.c_uint32(0)
+        st = self._lib.hvd_coord_allgather(self._h, tag.encode(),
+                                           _as_u8p(blob), len(blob),
+                                           self.timeout, out, max_bytes,
+                                           ctypes.byref(outlen))
+        _check(st, f"allgather({tag})")
+        raw = bytes(out[:outlen.value])
+        blobs, off = [], 0
+        for _ in range(self.size):
+            (n,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            blobs.append(raw[off:off + n])
+            off += n
+        return blobs
+
+    def broadcast(self, blob: Optional[bytes], root: int = 0, tag: str = "bc",
+                  max_bytes: int = 1 << 22) -> bytes:
+        out = _buf(max_bytes)
+        outlen = ctypes.c_uint32(0)
+        data = blob if blob is not None else b""
+        st = self._lib.hvd_coord_bcast(self._h, tag.encode(), root,
+                                       _as_u8p(data), len(data), self.timeout,
+                                       out, max_bytes, ctypes.byref(outlen))
+        _check(st, f"broadcast({tag})")
+        return bytes(out[:outlen.value])
+
+    def bitand(self, bits: bytes, tag: str = "and") -> bytes:
+        buf = (ctypes.c_uint8 * len(bits)).from_buffer_copy(bits)
+        _check(self._lib.hvd_coord_bitand(self._h, tag.encode(), buf,
+                                          len(bits), self.timeout),
+               f"bitand({tag})")
+        return bytes(buf)
+
+    def bitor(self, bits: bytes, tag: str = "or") -> bytes:
+        buf = (ctypes.c_uint8 * len(bits)).from_buffer_copy(bits)
+        _check(self._lib.hvd_coord_bitor(self._h, tag.encode(), buf,
+                                         len(bits), self.timeout),
+               f"bitor({tag})")
+        return bytes(buf)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_coord_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

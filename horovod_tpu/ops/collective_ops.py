@@ -327,19 +327,41 @@ def reducescatter(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
 
 def barrier(*, process_set: Optional[ProcessSet] = None) -> None:
     """Block until all ranks' queued device work completes
-    (hvd.barrier, collective_operations.cc:437)."""
+    (hvd.barrier, collective_operations.cc:437).
+
+    Device level: a tiny psum over the set's mesh. Host level (multi-process
+    jobs): additionally a native-coordinator barrier so Python control flow
+    on every process is aligned — the role the reference's controller barrier
+    plays (controller.h Barrier hook)."""
     ps, mesh, n = _resolve(process_set)
     token = jnp.zeros((n, 1), jnp.int32)
     out = allreduce(token, ReduceOp.SUM, process_set=ps)
     jax.block_until_ready(out)
+    # Host-level sync only for the GLOBAL set: the coordinator barrier
+    # involves every process, so running it for a subset barrier would hang
+    # non-member processes that (correctly) never call it. Subset device sync
+    # is already complete after block_until_ready above.
+    coord = basics.get_state().coordinator
+    if coord is not None and coord.size > 1 and \
+            (process_set is None or ps.is_global):
+        coord.barrier("hvd.barrier")
 
 
 def join() -> int:
     """Mark this controller as joined; returns last joined rank
-    (hvd.join, operations.cc:1991). In single-controller SPMD mode there is
-    one controller, so join degenerates to a barrier; uneven-data handling
-    is provided by the engine's zero-fill path (see ops/engine.py)."""
-    barrier()
+    (hvd.join, operations.cc:1991).
+
+    SPMD semantics: uneven-data handling (the reference's zero-fill of a
+    joined rank's contributions, controller.cc:496) happens at the *data*
+    level via the engine's zero-fill path (see ops/engine.py) — device
+    collectives are compiled programs that every process must execute, so a
+    process cannot silently drop out mid-job. join() is therefore a
+    collective termination sync: ALL controllers must call it (in the same
+    control-flow position, like every coordinator collective), after which
+    every worker rank is considered joined. Arrival order is not tracked;
+    the returned value is the highest global rank, matching the
+    single-controller behavior."""
     st = basics.get_state()
-    st.joined_ranks.add(basics.rank())
+    barrier()
+    st.joined_ranks.update(range(basics.size()))
     return basics.size() - 1

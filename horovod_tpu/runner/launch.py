@@ -146,6 +146,26 @@ def run_static(args: argparse.Namespace) -> int:
         f":{_free_port()}"
     base_env = dict(os.environ)
     base_env.update(env_from_args(args))
+
+    # Native control-plane store (csrc/store.cc): the rebuild's analog of the
+    # reference launcher's Gloo rendezvous (gloo_run.py:242 RendezvousServer
+    # + gloo/http_store.cc). Workers connect a Coordinator to it for
+    # host-level negotiation (join, dynamic process sets, elastic sync).
+    native_server = None
+    try:
+        from ..native.store import StoreServer
+        # Workers resolve the hostname themselves (basics.py
+        # _maybe_create_coordinator) — remote hosts must not inherit this
+        # host's /etc/hosts loopback mapping.
+        kv_addr = "127.0.0.1" if len(hosts) == 1 else os.uname().nodename
+        native_server = StoreServer()
+        base_env["HOROVOD_NATIVE_KV_ADDR"] = kv_addr
+        base_env["HOROVOD_NATIVE_KV_PORT"] = str(native_server.port)
+    except Exception:  # noqa: BLE001 — toolchain-less host: Python KV only
+        if native_server is not None:
+            native_server.close()
+        native_server = None
+
     workers = exec_lib.launch_slots(slots, args.command, coord, port,
                                     secret, base_env)
     rc = 0
@@ -158,6 +178,8 @@ def run_static(args: argparse.Namespace) -> int:
         rc = 130
     finally:
         server.stop()
+        if native_server is not None:
+            native_server.close()
     return rc
 
 

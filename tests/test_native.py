@@ -1,0 +1,148 @@
+"""Tests for the native C++ coordination layer (csrc/store.cc).
+
+Mirrors the reference's control-plane test coverage: the rendezvous KV store
+behavior (test/single/test_service.py territory) and the controller transport
+primitives exercised under multiple client threads, the way
+ComputeResponseList's bitvector fast path uses them across ranks
+(horovod/common/controller.cc:155-190).
+"""
+import threading
+
+import pytest
+
+from horovod_tpu import native
+from horovod_tpu.native.store import (Coordinator, NativeTimeout, StoreClient,
+                                      StoreServer)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture()
+def server():
+    with StoreServer() as s:
+        yield s
+
+
+def test_set_get_roundtrip(server):
+    c = StoreClient("127.0.0.1", server.port)
+    c.set("k", b"hello")
+    assert c.get("k", timeout=5) == b"hello"
+    # overwrite
+    c.set("k", b"world")
+    assert c.get("k", timeout=5) == b"world"
+
+
+def test_get_blocks_until_set(server):
+    c1 = StoreClient("127.0.0.1", server.port)
+    c2 = StoreClient("127.0.0.1", server.port)
+    result = {}
+
+    def waiter():
+        result["v"] = c1.get("late", timeout=10)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    c2.set("late", b"arrived")
+    t.join(timeout=10)
+    assert result["v"] == b"arrived"
+
+
+def test_get_timeout(server):
+    c = StoreClient("127.0.0.1", server.port)
+    with pytest.raises(NativeTimeout):
+        c.get("missing", timeout=0.1)
+
+
+def test_read_counted_deletion(server):
+    c = StoreClient("127.0.0.1", server.port)
+    c.set("once", b"x")
+    assert c.get("once", timeout=5, expected_reads=1) == b"x"
+    with pytest.raises(NativeTimeout):
+        c.get("once", timeout=0.1)
+
+
+def test_delete(server):
+    c = StoreClient("127.0.0.1", server.port)
+    c.set("d", b"x")
+    c.delete("d")
+    with pytest.raises(NativeTimeout):
+        c.get("d", timeout=0.1)
+
+
+def _run_ranks(server, size, fn):
+    """Run fn(coordinator, rank) on `size` threads, return results by rank."""
+    results = [None] * size
+    errors = []
+
+    def worker(rank):
+        try:
+            coord = Coordinator("127.0.0.1", server.port, rank, size,
+                                timeout=30.0)
+            results[rank] = fn(coord, rank)
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    return results
+
+
+def test_coordinator_barrier(server):
+    _run_ranks(server, 4, lambda c, r: c.barrier("b1") or True)
+
+
+def test_coordinator_allgather(server):
+    size = 4
+    res = _run_ranks(server, size,
+                     lambda c, r: c.allgather(f"rank{r}".encode() * (r + 1),
+                                              tag="ag1"))
+    expected = [f"rank{r}".encode() * (r + 1) for r in range(size)]
+    for blobs in res:
+        assert blobs == expected
+
+
+def test_coordinator_allgather_repeated(server):
+    # sequence numbers keep repeated collectives on one tag from colliding
+    def fn(c, r):
+        out = []
+        for i in range(5):
+            out.append(c.allgather(bytes([r, i]), tag="rep"))
+        return out
+
+    res = _run_ranks(server, 3, fn)
+    for blobs_per_iter in res:
+        for i, blobs in enumerate(blobs_per_iter):
+            assert blobs == [bytes([r, i]) for r in range(3)]
+
+
+def test_coordinator_broadcast(server):
+    res = _run_ranks(
+        server, 4,
+        lambda c, r: c.broadcast(b"payload" if r == 2 else None, root=2,
+                                 tag="bc1"))
+    assert all(b == b"payload" for b in res)
+
+
+def test_coordinator_bitand_bitor(server):
+    # rank r contributes a bitvector with bit r set plus bit 7 always set
+    def fn(c, r):
+        mine = bytes([(1 << r) | 0x80])
+        return c.bitand(mine, tag="and1"), c.bitor(mine, tag="or1")
+
+    res = _run_ranks(server, 4, fn)
+    for and_bits, or_bits in res:
+        assert and_bits == bytes([0x80])
+        assert or_bits == bytes([0x8F])
+
+
+def test_coordinator_single_rank(server):
+    coord = Coordinator("127.0.0.1", server.port, 0, 1)
+    coord.barrier("solo")
+    assert coord.allgather(b"x", tag="solo-ag") == [b"x"]
+    assert coord.broadcast(b"y", root=0, tag="solo-bc") == b"y"
