@@ -1,0 +1,343 @@
+// Native shared-memory CPU collectives for local multi-process jobs.
+//
+// TPU-native re-design of the reference's CPU data plane
+// (horovod/common/ops/gloo_operations.cc — ring/halving-doubling allreduce,
+// allgatherv, broadcast over Gloo). On one host the fastest transport is not
+// a socket ring but the page cache: ranks map one POSIX shm segment laid out
+// as [header | per-rank slots | result area] and run a chunked
+// reduce-scatter + copy-out:
+//
+//   copy-in -> barrier -> each rank reduces its 1/size chunk across all
+//   slots into the result area (parallel, like the per-local-rank split in
+//   NCCLHierarchicalAllreduce, nccl_operations.cc:404-470) -> barrier ->
+//   copy-out -> barrier (so nobody overwrites slots for the next call while
+//   a peer still reads).
+//
+// Synchronization is a sense-reversing barrier on std::atomics in the shm
+// header with sched_yield backoff — no kernel objects needed beyond the
+// segment itself.
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x48564453484d0001ull;  // "HVDSHM" v1
+
+enum DType : int { DT_F32 = 0, DT_F64 = 1, DT_I32 = 2, DT_I64 = 3 };
+enum RedOp : int { OP_SUM = 0, OP_PROD = 1, OP_MIN = 2, OP_MAX = 3 };
+
+struct Header {
+  std::atomic<uint64_t> magic;
+  std::atomic<uint64_t> gen;  // per-job token: attachers reject stale segments
+  uint32_t size;
+  uint64_t capacity;
+  std::atomic<uint32_t> arrived;
+  std::atomic<uint32_t> sense;
+  std::atomic<uint32_t> attached;
+};
+
+struct Comm {
+  Header* hdr = nullptr;
+  uint8_t* base = nullptr;   // whole mapping
+  size_t map_len = 0;
+  int rank = 0, size = 0;
+  uint64_t capacity = 0;
+  uint32_t local_sense = 0;
+  std::string name;
+  bool owner = false;
+
+  uint8_t* slot(int r) const {
+    return base + sizeof(Header) + static_cast<uint64_t>(r) * capacity;
+  }
+  uint8_t* result() const {
+    return base + sizeof(Header) + static_cast<uint64_t>(size) * capacity;
+  }
+};
+
+bool deadline_passed(const std::chrono::steady_clock::time_point& dl) {
+  return std::chrono::steady_clock::now() > dl;
+}
+
+// 0 = ok, 1 = timeout
+int barrier(Comm* c, double timeout_s) {
+  auto dl = std::chrono::steady_clock::now() +
+            std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                std::chrono::duration<double>(timeout_s));
+  c->local_sense ^= 1;
+  if (c->hdr->arrived.fetch_add(1, std::memory_order_acq_rel) ==
+      static_cast<uint32_t>(c->size - 1)) {
+    c->hdr->arrived.store(0, std::memory_order_relaxed);
+    c->hdr->sense.store(c->local_sense, std::memory_order_release);
+    return 0;
+  }
+  int spins = 0;
+  while (c->hdr->sense.load(std::memory_order_acquire) != c->local_sense) {
+    if (++spins > 1024) {
+      sched_yield();
+      spins = 0;
+      if (deadline_passed(dl)) return 1;
+    }
+  }
+  return 0;
+}
+
+template <typename T>
+void reduce_chunk(Comm* c, uint64_t begin, uint64_t end, int op) {
+  T* out = reinterpret_cast<T*>(c->result());
+  const T* first = reinterpret_cast<const T*>(c->slot(0));
+  std::memcpy(out + begin, first + begin, (end - begin) * sizeof(T));
+  for (int r = 1; r < c->size; ++r) {
+    const T* in = reinterpret_cast<const T*>(c->slot(r));
+    switch (op) {
+      case OP_SUM:
+        for (uint64_t i = begin; i < end; ++i) out[i] += in[i];
+        break;
+      case OP_PROD:
+        for (uint64_t i = begin; i < end; ++i) out[i] *= in[i];
+        break;
+      case OP_MIN:
+        for (uint64_t i = begin; i < end; ++i)
+          out[i] = in[i] < out[i] ? in[i] : out[i];
+        break;
+      case OP_MAX:
+        for (uint64_t i = begin; i < end; ++i)
+          out[i] = in[i] > out[i] ? in[i] : out[i];
+        break;
+    }
+  }
+}
+
+size_t dtype_size(int dtype) {
+  switch (dtype) {
+    case DT_F32:
+    case DT_I32:
+      return 4;
+    default:
+      return 8;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Rank 0 creates + initializes the segment; other ranks attach (retrying
+// until the header magic appears). `capacity` bytes per rank slot. `gen` is
+// a job-unique token (all ranks pass the same value): attachers reject a
+// stale segment left by a crashed previous job whose magic is still set —
+// without it a fast-starting rank could join the old segment just before
+// rank 0 unlinks it.
+void* hvd_shm_create(const char* name, int rank, int size, uint64_t capacity,
+                     uint64_t gen, double timeout_s) {
+  std::string shm_name = std::string("/") + name;
+  size_t map_len =
+      sizeof(Header) + (static_cast<size_t>(size) + 1) * capacity;
+  auto dl = std::chrono::steady_clock::now() +
+            std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                std::chrono::duration<double>(timeout_s));
+  void* base = MAP_FAILED;
+  if (rank == 0) {
+    shm_unlink(shm_name.c_str());  // stale segment from a crashed job
+    int fd = shm_open(shm_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    if (ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+      close(fd);
+      shm_unlink(shm_name.c_str());
+      return nullptr;
+    }
+    base = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (base == MAP_FAILED) return nullptr;
+  } else {
+    // Attach loop: a stale segment from a crashed previous job may still be
+    // linked under this name with magic set, so after mapping we check the
+    // generation token and, on mismatch, unmap and re-open — the fresh
+    // segment is a different inode, so the old mapping would never update.
+    for (;;) {
+      int fd = shm_open(shm_name.c_str(), O_RDWR, 0600);
+      if (fd >= 0) {
+        struct stat st {};
+        if (fstat(fd, &st) == 0 &&
+            static_cast<size_t>(st.st_size) >= map_len) {
+          base = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                      fd, 0);
+          close(fd);
+          if (base != MAP_FAILED) {
+            auto* hdr = reinterpret_cast<Header*>(base);
+            auto probe_dl = std::chrono::steady_clock::now() +
+                            std::chrono::milliseconds(50);
+            bool match = false;
+            do {
+              match =
+                  hdr->magic.load(std::memory_order_acquire) == kMagic &&
+                  hdr->gen.load(std::memory_order_relaxed) == gen;
+            } while (!match && !deadline_passed(probe_dl));
+            if (match) break;
+            munmap(base, map_len);
+            base = MAP_FAILED;
+          }
+        } else {
+          close(fd);
+        }
+      }
+      if (deadline_passed(dl)) return nullptr;
+      usleep(1000);
+    }
+  }
+
+  auto* c = new Comm();
+  c->base = static_cast<uint8_t*>(base);
+  c->map_len = map_len;
+  c->hdr = reinterpret_cast<Header*>(base);
+  c->rank = rank;
+  c->size = size;
+  c->capacity = capacity;
+  c->name = shm_name;
+  c->owner = (rank == 0);
+
+  if (rank == 0) {
+    c->hdr->size = static_cast<uint32_t>(size);
+    c->hdr->capacity = capacity;
+    c->hdr->arrived.store(0);
+    c->hdr->sense.store(0);
+    c->hdr->attached.store(0);
+    c->hdr->gen.store(gen, std::memory_order_relaxed);
+    c->hdr->magic.store(kMagic, std::memory_order_release);
+  }
+  c->hdr->attached.fetch_add(1);
+  // join barrier: everyone mapped before anyone proceeds
+  while (c->hdr->attached.load(std::memory_order_acquire) <
+         static_cast<uint32_t>(size)) {
+    if (deadline_passed(dl)) {
+      munmap(base, map_len);
+      delete c;
+      return nullptr;
+    }
+    usleep(1000);
+  }
+  return c;
+}
+
+void hvd_shm_destroy(void* h) {
+  auto* c = static_cast<Comm*>(h);
+  if (!c) return;
+  if (c->base) munmap(c->base, c->map_len);
+  if (c->owner) shm_unlink(c->name.c_str());
+  delete c;
+}
+
+int hvd_shm_barrier(void* h, double timeout_s) {
+  return barrier(static_cast<Comm*>(h), timeout_s);
+}
+
+// In-place allreduce over all ranks. Chunked: each rank reduces an equal
+// share into the shared result area; all copy the full result out.
+int hvd_shm_allreduce(void* h, void* data, uint64_t count, int dtype, int op,
+                      double timeout_s) {
+  auto* c = static_cast<Comm*>(h);
+  size_t esize = dtype_size(dtype);
+  uint64_t bytes = count * esize;
+  if (bytes > c->capacity) return 2;
+  std::memcpy(c->slot(c->rank), data, bytes);
+  if (barrier(c, timeout_s)) return 1;
+
+  uint64_t chunk = (count + c->size - 1) / c->size;
+  uint64_t begin = std::min<uint64_t>(chunk * c->rank, count);
+  uint64_t end = std::min<uint64_t>(begin + chunk, count);
+  if (end > begin) {
+    switch (dtype) {
+      case DT_F32:
+        reduce_chunk<float>(c, begin, end, op);
+        break;
+      case DT_F64:
+        reduce_chunk<double>(c, begin, end, op);
+        break;
+      case DT_I32:
+        reduce_chunk<int32_t>(c, begin, end, op);
+        break;
+      case DT_I64:
+        reduce_chunk<int64_t>(c, begin, end, op);
+        break;
+      default:
+        return 3;
+    }
+  }
+  if (barrier(c, timeout_s)) return 1;
+  std::memcpy(data, c->result(), bytes);
+  // third barrier: nobody may start the next collective (overwriting slots /
+  // result) until everyone has copied out
+  if (barrier(c, timeout_s)) return 1;
+  return 0;
+}
+
+// Uniform-size allgather: out receives size*bytes, rank order.
+int hvd_shm_allgather(void* h, const void* in, uint64_t bytes, void* out,
+                      double timeout_s) {
+  auto* c = static_cast<Comm*>(h);
+  if (bytes > c->capacity) return 2;
+  std::memcpy(c->slot(c->rank), in, bytes);
+  if (barrier(c, timeout_s)) return 1;
+  for (int r = 0; r < c->size; ++r)
+    std::memcpy(static_cast<uint8_t*>(out) + static_cast<uint64_t>(r) * bytes,
+                c->slot(r), bytes);
+  if (barrier(c, timeout_s)) return 1;
+  return 0;
+}
+
+// In-place broadcast from root.
+int hvd_shm_broadcast(void* h, void* data, uint64_t bytes, int root,
+                      double timeout_s) {
+  auto* c = static_cast<Comm*>(h);
+  if (bytes > c->capacity) return 2;
+  if (c->rank == root) std::memcpy(c->slot(root), data, bytes);
+  if (barrier(c, timeout_s)) return 1;
+  if (c->rank != root) std::memcpy(data, c->slot(root), bytes);
+  if (barrier(c, timeout_s)) return 1;
+  return 0;
+}
+
+// Reduce-scatter: rank i receives the reduced chunk i (equal chunks of
+// count/size elements; count must be divisible by size). out holds
+// count/size elements.
+int hvd_shm_reducescatter(void* h, const void* in, void* out, uint64_t count,
+                          int dtype, int op, double timeout_s) {
+  auto* c = static_cast<Comm*>(h);
+  if (count % c->size != 0) return 4;
+  size_t esize = dtype_size(dtype);
+  if (count * esize > c->capacity) return 2;
+  std::memcpy(c->slot(c->rank), in, count * esize);
+  if (barrier(c, timeout_s)) return 1;
+  uint64_t chunk = count / c->size;
+  uint64_t begin = chunk * c->rank, end = begin + chunk;
+  switch (dtype) {
+    case DT_F32:
+      reduce_chunk<float>(c, begin, end, op);
+      break;
+    case DT_F64:
+      reduce_chunk<double>(c, begin, end, op);
+      break;
+    case DT_I32:
+      reduce_chunk<int32_t>(c, begin, end, op);
+      break;
+    case DT_I64:
+      reduce_chunk<int64_t>(c, begin, end, op);
+      break;
+    default:
+      return 3;
+  }
+  if (barrier(c, timeout_s)) return 1;
+  std::memcpy(out, c->result() + begin * esize, chunk * esize);
+  if (barrier(c, timeout_s)) return 1;
+  return 0;
+}
+
+}  // extern "C"

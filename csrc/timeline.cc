@@ -1,0 +1,155 @@
+// Native Chrome-trace timeline writer.
+//
+// TPU-native re-design of the reference Timeline (horovod/common/timeline.cc:
+// a writer thread fed by a boost lockfree SPSC queue, timeline.h:48-70).
+// Emitting threads format one compact JSON event and hand it to a
+// mutex+condvar MPSC queue; a dedicated writer thread batches buffered
+// appends. The file is a streaming Chrome trace: "{"traceEvents":[" then
+// comma-separated events; destroy() seals it with "]}" so the finished file
+// is valid JSON (the reference leaves the array unterminated —
+// timeline.cc WriteAtFileStart).
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace {
+
+void append_escaped(std::string* out, const char* s) {
+  for (; *s; ++s) {
+    unsigned char c = static_cast<unsigned char>(*s);
+    switch (c) {
+      case '"':
+        *out += "\\\"";
+        break;
+      case '\\':
+        *out += "\\\\";
+        break;
+      case '\n':
+        *out += "\\n";
+        break;
+      case '\t':
+        *out += "\\t";
+        break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+}
+
+class TimelineWriter {
+ public:
+  explicit TimelineWriter(const std::string& path) {
+    file_ = std::fopen(path.c_str(), "w");
+    if (!file_) return;
+    std::fputs("{\"traceEvents\":[", file_);
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  ~TimelineWriter() {
+    if (!file_) return;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stopping_ = true;
+    }
+    cv_.notify_all();
+    if (thread_.joinable()) thread_.join();
+    std::fputs("]}", file_);
+    std::fclose(file_);
+  }
+
+  bool ok() const { return file_ != nullptr; }
+
+  void Emit(const char* name, const char* cat, char ph, int64_t ts_us,
+            int pid, int64_t tid, const char* args_json) {
+    if (!file_) return;
+    std::string ev;
+    ev.reserve(96);
+    ev += "{\"name\":\"";
+    append_escaped(&ev, name);
+    ev += "\",\"ph\":\"";
+    ev.push_back(ph);
+    ev += "\"";
+    if (cat && *cat) {
+      ev += ",\"cat\":\"";
+      append_escaped(&ev, cat);
+      ev += "\"";
+    }
+    if (ph == 'i') ev += ",\"s\":\"g\"";
+    ev += ",\"ts\":" + std::to_string(ts_us);
+    ev += ",\"pid\":" + std::to_string(pid);
+    ev += ",\"tid\":" + std::to_string(tid);
+    if (args_json && *args_json) {
+      ev += ",\"args\":";
+      ev += args_json;  // caller-provided JSON object
+    }
+    ev += "}";
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      queue_.push_back(std::move(ev));
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  void Loop() {
+    std::deque<std::string> batch;
+    bool first = true;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stopping_ || !queue_.empty(); });
+        batch.swap(queue_);
+        if (batch.empty() && stopping_) break;
+      }
+      for (auto& ev : batch) {
+        if (!first) std::fputc(',', file_);
+        first = false;
+        std::fwrite(ev.data(), 1, ev.size(), file_);
+      }
+      batch.clear();
+      std::fflush(file_);
+    }
+  }
+
+  std::FILE* file_ = nullptr;
+  std::thread thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  bool stopping_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hvd_timeline_create(const char* path) {
+  auto* t = new TimelineWriter(path);
+  if (!t->ok()) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+void hvd_timeline_destroy(void* t) { delete static_cast<TimelineWriter*>(t); }
+
+void hvd_timeline_emit(void* t, const char* name, const char* cat, char ph,
+                       int64_t ts_us, int pid, int64_t tid,
+                       const char* args_json) {
+  static_cast<TimelineWriter*>(t)->Emit(name, cat, ph, ts_us, pid, tid,
+                                        args_json);
+}
+
+}  // extern "C"

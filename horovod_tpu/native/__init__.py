@@ -99,6 +99,24 @@ def _declare(lib):
                                        c.c_uint32, c.c_double]),
         "hvd_coord_bitor": (c.c_int, [c.c_void_p, c.c_char_p, u8p, c.c_uint32,
                                       c.c_double]),
+        "hvd_timeline_create": (c.c_void_p, [c.c_char_p]),
+        "hvd_timeline_destroy": (None, [c.c_void_p]),
+        "hvd_timeline_emit": (None, [c.c_void_p, c.c_char_p, c.c_char_p,
+                                     c.c_char, c.c_int64, c.c_int, c.c_int64,
+                                     c.c_char_p]),
+        "hvd_shm_create": (c.c_void_p, [c.c_char_p, c.c_int, c.c_int,
+                                        c.c_uint64, c.c_uint64, c.c_double]),
+        "hvd_shm_destroy": (None, [c.c_void_p]),
+        "hvd_shm_barrier": (c.c_int, [c.c_void_p, c.c_double]),
+        "hvd_shm_allreduce": (c.c_int, [c.c_void_p, c.c_void_p, c.c_uint64,
+                                        c.c_int, c.c_int, c.c_double]),
+        "hvd_shm_allgather": (c.c_int, [c.c_void_p, c.c_void_p, c.c_uint64,
+                                        c.c_void_p, c.c_double]),
+        "hvd_shm_broadcast": (c.c_int, [c.c_void_p, c.c_void_p, c.c_uint64,
+                                        c.c_int, c.c_double]),
+        "hvd_shm_reducescatter": (c.c_int, [c.c_void_p, c.c_void_p,
+                                            c.c_void_p, c.c_uint64, c.c_int,
+                                            c.c_int, c.c_double]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)

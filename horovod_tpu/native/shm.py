@@ -1,0 +1,134 @@
+"""Shared-memory CPU collectives (wrapper over csrc/shm_coll.cc).
+
+The rebuild's native CPU data plane for local multi-process jobs — the role
+gloo_operations.cc plays in the reference (CPU allreduce/allgather/broadcast
+when no device fabric applies). Works on numpy arrays; reductions run
+chunk-parallel across ranks in one POSIX shm segment.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from . import lib
+
+_DTYPES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+}
+
+_OPS = {"sum": 0, "prod": 1, "min": 2, "max": 3}
+
+
+class ShmError(RuntimeError):
+    pass
+
+
+def _check(status: int, what: str) -> None:
+    if status == 1:
+        raise ShmError(f"{what}: barrier timeout (peer died?)")
+    if status == 2:
+        raise ShmError(f"{what}: message exceeds slot capacity")
+    if status:
+        raise ShmError(f"{what}: error {status}")
+
+
+class ShmComm:
+    """One communicator per (job, rank); all local ranks share the segment.
+
+    `gen` is a job-unique token every rank must agree on — it lets attachers
+    reject a stale segment left by a crashed previous job under the same
+    name. The launcher exports one per run as HOROVOD_SHM_GEN; standalone
+    users should pass a fresh value (e.g. a startup timestamp) or use
+    per-run-unique names.
+    """
+
+    def __init__(self, name: str, rank: int, size: int,
+                 capacity: int = 64 << 20, timeout: float = 60.0,
+                 gen: Optional[int] = None):
+        import os
+        self._lib = lib()
+        self.rank, self.size, self.timeout = rank, size, timeout
+        self.capacity = capacity
+        if gen is None:
+            gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
+        self._h = self._lib.hvd_shm_create(name.encode(), rank, size,
+                                           capacity, gen, timeout)
+        if not self._h:
+            raise ShmError(f"shm attach failed for '{name}' rank {rank}")
+
+    def _dtype_op(self, arr: np.ndarray, op: str):
+        dt = _DTYPES.get(arr.dtype)
+        if dt is None:
+            raise ShmError(f"unsupported dtype {arr.dtype}")
+        o = _OPS.get(op)
+        if o is None:
+            raise ShmError(f"unsupported op {op}")
+        return dt, o
+
+    def barrier(self) -> None:
+        _check(self._lib.hvd_shm_barrier(self._h, self.timeout), "barrier")
+
+    def allreduce(self, arr: np.ndarray, op: str = "sum",
+                  average: bool = False) -> np.ndarray:
+        out = np.ascontiguousarray(arr)
+        if out is arr:
+            out = arr.copy()
+        dt, o = self._dtype_op(out, op)
+        _check(self._lib.hvd_shm_allreduce(
+            self._h, out.ctypes.data_as(ctypes.c_void_p), out.size, dt, o,
+            self.timeout), "allreduce")
+        if average:
+            out = out / self.size if np.issubdtype(out.dtype, np.floating) \
+                else out // self.size
+        return out
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        out = np.empty((self.size,) + arr.shape, dtype=arr.dtype)
+        _check(self._lib.hvd_shm_allgather(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
+            out.ctypes.data_as(ctypes.c_void_p), self.timeout), "allgather")
+        return out
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        out = np.ascontiguousarray(arr).copy()
+        _check(self._lib.hvd_shm_broadcast(
+            self._h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes, root,
+            self.timeout), "broadcast")
+        return out
+
+    def reducescatter(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        if arr.size % self.size:
+            raise ShmError(
+                f"reducescatter needs count divisible by size ({arr.size} "
+                f"% {self.size})")
+        dt, o = self._dtype_op(arr, op)
+        out = np.empty(arr.size // self.size, dtype=arr.dtype)
+        _check(self._lib.hvd_shm_reducescatter(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), arr.size, dt, o,
+            self.timeout), "reducescatter")
+        return out
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.hvd_shm_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -20,6 +20,7 @@ arrays or split sizes; splits are static so the whole program still jits.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -45,6 +46,32 @@ AXIS = GLOBAL_AXIS
 def _resolve(process_set: Optional[ProcessSet]):
     ps = basics.get_process_set(process_set)
     return ps, ps.mesh, ps.size()
+
+
+# Set by the engine's background thread so engine-dispatched calls don't
+# double-emit timeline spans (the engine emits per-tensor phases itself).
+_tl_local = threading.local()
+
+
+def _timeline_span(fn):
+    """Emit a begin/end timeline span around a sync collective call —
+    the sync-path analog of the reference's per-op activity events
+    (timeline activity hooks throughout PerformOperation,
+    operations.cc:283-304)."""
+    phase = fn.__name__.upper()
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        tl = basics.get_state().timeline
+        if tl is None or getattr(_tl_local, "in_engine", False):
+            return fn(*args, **kwargs)
+        tag = kwargs.get("name") or fn.__name__
+        tl.begin(tag, phase)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            tl.end(tag, phase)
+    return wrapper
 
 
 def _check_stacked(x, n: int, what: str) -> None:
@@ -105,6 +132,7 @@ def _allreduce_fn(mesh: Mesh, op: ReduceOp, dtype_name: str, has_scale: bool):
     return jax.jit(f)
 
 
+@_timeline_span
 def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
               process_set: Optional[ProcessSet] = None,
               prescale_factor: float = 1.0,
@@ -150,6 +178,7 @@ def _allgather_fn(mesh: Mesh):
                              out_specs=P(AXIS)))
 
 
+@_timeline_span
 def allgather(x: Union[Array, Sequence[Array]], *,
               process_set: Optional[ProcessSet] = None,
               name: Optional[str] = None) -> Array:
@@ -190,6 +219,7 @@ def _broadcast_fn(mesh: Mesh, root_rank: int):
                              out_specs=P(AXIS)))
 
 
+@_timeline_span
 def broadcast(x: Array, root_rank: int = 0, *,
               process_set: Optional[ProcessSet] = None,
               name: Optional[str] = None) -> Array:
@@ -215,6 +245,7 @@ def _alltoall_fn(mesh: Mesh):
                              out_specs=P(AXIS)))
 
 
+@_timeline_span
 def alltoall(x: Union[Array, Sequence[Array]],
              splits: Optional[Sequence[Sequence[int]]] = None, *,
              process_set: Optional[ProcessSet] = None,
@@ -301,6 +332,7 @@ def _rs_split_sizes(d0: int, n: int) -> List[int]:
     return [base + (1 if i < extra else 0) for i in range(n)]
 
 
+@_timeline_span
 def reducescatter(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
                   process_set: Optional[ProcessSet] = None,
                   name: Optional[str] = None) -> Union[Array, List[Array]]:

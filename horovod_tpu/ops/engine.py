@@ -199,15 +199,19 @@ class Engine:
                     f"DUPLICATE_NAME_ERROR)")
             self._inflight_names.add(work.name)
             self._outstanding[work.name] = work.handle.enqueue_time
+            # begin(QUEUED) must precede the cycle thread's pop (which emits
+            # the matching end) — emit under the same lock as the append
+            tl = self._state.timeline
+            if tl is not None:
+                tl.begin(work.name, "QUEUED")
             self._queue.append(work)
-        tl = self._state.timeline
-        if tl is not None:
-            tl.begin(work.name, "QUEUED")
         self._wake.set()
         return work.handle
 
     # -- background loop (RunLoopOnce, operations.cc:751) --------------------
     def _loop(self) -> None:
+        # engine-dispatched sync calls must not double-emit timeline spans
+        collective_ops._tl_local.in_engine = True
         while self._running:
             woke = self._wake.wait(timeout=max(self.cycle_time_s, 1e-4))
             self._wake.clear()
@@ -270,6 +274,14 @@ class Engine:
             if not isinstance(w.tensor, (list, tuple)):
                 t = jnp.asarray(w.tensor)
                 self.bytes_processed += t.size * t.dtype.itemsize
+        # Per-tensor phase transitions, mirroring the reference timeline's
+        # state machine (timeline.h:102: QUEUED -> fused-op activity -> done).
+        phase = bucket[0].request_type.name + \
+            ("_FUSED" if len(bucket) > 1 else "")
+        if tl is not None:
+            for w in bucket:
+                tl.end(w.name, "QUEUED")
+                tl.begin(w.name, phase)
         try:
             if len(bucket) == 1 and \
                bucket[0].request_type != RequestType.ALLREDUCE:
@@ -289,7 +301,7 @@ class Engine:
             status = Status.unknown(str(e))
         for w, r in zip(bucket, results):
             if tl is not None:
-                tl.end(w.name, "QUEUED")
+                tl.end(w.name, phase)
             with self._qlock:
                 self._inflight_names.discard(w.name)
                 self._outstanding.pop(w.name, None)

@@ -23,7 +23,13 @@ from typing import Optional
 
 
 class Timeline:
-    """Chrome trace (catapult) event writer with a background thread."""
+    """Chrome trace (catapult) event writer.
+
+    Two paths: the native writer (csrc/timeline.cc — the reference's
+    writer-thread design, timeline.cc) when the toolchain is available, and
+    a pure-Python queue+thread fallback. Both produce the same trace schema.
+    Disable the native path with HOROVOD_TIMELINE_NATIVE=0.
+    """
 
     def __init__(self, filename: str, mark_cycles: bool = False):
         self.filename = filename
@@ -32,12 +38,29 @@ class Timeline:
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._start_us = time.monotonic_ns() // 1000
+        self._native = None
+        self._native_lib = None
+        # serializes native emits against stop()'s destroy (use-after-free
+        # otherwise: an emitter could pass the None-check while stop frees
+        # the writer)
+        self._native_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         if self._running:
             return
         self._running = True
+        if os.environ.get("HOROVOD_TIMELINE_NATIVE", "1") != "0":
+            try:
+                from . import native
+                lib = native.lib()
+                handle = lib.hvd_timeline_create(self.filename.encode())
+                if handle:
+                    self._native_lib = lib
+                    self._native = handle
+                    return
+            except Exception:  # noqa: BLE001 — fall back to Python writer
+                self._native = None
         self._thread = threading.Thread(target=self._writer, daemon=True,
                                         name="hvd-timeline-writer")
         self._thread.start()
@@ -46,6 +69,11 @@ class Timeline:
         if not self._running:
             return
         self._running = False
+        if self._native is not None:
+            with self._native_lock:
+                self._native_lib.hvd_timeline_destroy(self._native)
+                self._native = None
+            return
         self._q.put(None)
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -56,8 +84,20 @@ class Timeline:
         return time.monotonic_ns() // 1000 - self._start_us
 
     def _emit(self, ev: dict) -> None:
-        if self._running:
-            self._q.put(ev)
+        if not self._running:
+            return
+        if self._native is not None:
+            with self._native_lock:
+                if self._native is None:  # stopped concurrently
+                    return
+                args = ev.get("args")
+                self._native_lib.hvd_timeline_emit(
+                    self._native, ev["name"].encode(),
+                    ev.get("cat", "").encode(), ev["ph"].encode(), ev["ts"],
+                    ev.get("pid", 0), ev.get("tid", 0),
+                    json.dumps(args).encode() if args else None)
+            return
+        self._q.put(ev)
 
     def begin(self, tensor_name: str, phase: str) -> None:
         self._emit({"name": phase, "cat": phase, "ph": "B",

@@ -1,0 +1,128 @@
+"""Multi-process tests for the shared-memory CPU collectives
+(csrc/shm_coll.cc) — the rebuild's analog of the reference's Gloo CPU op
+tests (test/parallel/test_torch.py CPU paths), run under real forked
+processes like the reference runs its parallel tier under mpirun/horovodrun.
+"""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _worker(name, rank, size, fn_name, q):
+    try:
+        from horovod_tpu.native.shm import ShmComm
+        with ShmComm(name, rank, size, capacity=1 << 20, timeout=30.0) as c:
+            result = globals()[fn_name](c, rank, size)
+        q.put((rank, "ok", result))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, "err", repr(e)))
+
+
+def _run(size, fn_name):
+    name = f"hvdtest_{os.getpid()}_{fn_name}"
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker, args=(name, r, size, fn_name, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(size):
+        rank, status, payload = q.get(timeout=120)
+        assert status == "ok", f"rank {rank}: {payload}"
+        results[rank] = payload
+    for p in procs:
+        p.join(timeout=30)
+    return results
+
+
+def _allreduce_sum(c, rank, size):
+    x = np.full(1000, float(rank + 1), np.float32)
+    out = c.allreduce(x, "sum")
+    expected = sum(range(1, size + 1))
+    np.testing.assert_allclose(out, expected)
+    return True
+
+
+def _allreduce_avg(c, rank, size):
+    x = np.full(64, float(rank), np.float64)
+    out = c.allreduce(x, "sum", average=True)
+    np.testing.assert_allclose(out, sum(range(size)) / size)
+    return True
+
+
+def _allreduce_minmax(c, rank, size):
+    x = np.arange(10, dtype=np.int32) + rank * 100
+    mn = c.allreduce(x, "min")
+    mx = c.allreduce(x, "max")
+    np.testing.assert_array_equal(mn, np.arange(10, dtype=np.int32))
+    np.testing.assert_array_equal(
+        mx, np.arange(10, dtype=np.int32) + (size - 1) * 100)
+    return True
+
+
+def _allgather(c, rank, size):
+    x = np.full((3, 2), rank, np.int64)
+    out = c.allgather(x)
+    assert out.shape == (size, 3, 2)
+    for r in range(size):
+        np.testing.assert_array_equal(out[r], np.full((3, 2), r))
+    return True
+
+
+def _broadcast(c, rank, size):
+    x = np.arange(17, dtype=np.float32) * (1 if rank == 1 else 0)
+    out = c.broadcast(x, root=1)
+    np.testing.assert_allclose(out, np.arange(17, dtype=np.float32))
+    return True
+
+
+def _reducescatter(c, rank, size):
+    x = np.arange(size * 4, dtype=np.float32)
+    out = c.reducescatter(x, "sum")
+    np.testing.assert_allclose(
+        out, np.arange(rank * 4, (rank + 1) * 4, dtype=np.float32) * size)
+    return True
+
+
+def _repeated(c, rank, size):
+    # back-to-back collectives reuse slots safely (3-barrier protocol)
+    for i in range(20):
+        out = c.allreduce(np.full(50, float(rank + i), np.float32), "sum")
+        np.testing.assert_allclose(
+            out, sum(range(size)) + i * size)
+    return True
+
+
+@pytest.mark.parametrize("fn", ["_allreduce_sum", "_allreduce_avg",
+                                "_allreduce_minmax", "_allgather",
+                                "_broadcast", "_reducescatter", "_repeated"])
+def test_shm_collectives_2proc(fn):
+    _run(2, fn)
+
+
+@pytest.mark.parametrize("fn", ["_allreduce_sum", "_allgather", "_repeated"])
+def test_shm_collectives_4proc(fn):
+    _run(4, fn)
+
+
+def test_shm_single_rank():
+    from horovod_tpu.native.shm import ShmComm
+    with ShmComm(f"hvdtest_solo_{os.getpid()}", 0, 1) as c:
+        out = c.allreduce(np.ones(5, np.float32), "sum")
+        np.testing.assert_allclose(out, 1.0)
+        c.barrier()
+
+
+def test_shm_capacity_error():
+    from horovod_tpu.native.shm import ShmComm, ShmError
+    with ShmComm(f"hvdtest_cap_{os.getpid()}", 0, 1, capacity=1024) as c:
+        with pytest.raises(ShmError, match="capacity"):
+            c.allreduce(np.ones(100000, np.float32), "sum")

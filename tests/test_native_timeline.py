@@ -1,0 +1,70 @@
+"""Native timeline writer tests (csrc/timeline.cc + timeline.py wiring)."""
+import json
+import os
+
+import pytest
+
+from horovod_tpu import native
+from horovod_tpu.timeline import Timeline
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_native_writer_valid_json(tmp_path):
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    tl.start()
+    assert tl._native is not None, "native writer should be selected"
+    for i in range(100):
+        tl.begin(f"tensor_{i % 7}", "ALLREDUCE")
+        tl.end(f"tensor_{i % 7}", "ALLREDUCE")
+    tl.instant("CYCLE", {"n": 3})
+    tl.stop()
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert len(evs) == 201
+    assert evs[0]["name"] == "ALLREDUCE"
+    assert evs[0]["ph"] == "B"
+    assert evs[0]["args"] == {"tensor": "tensor_0"}
+    assert evs[-1]["name"] == "CYCLE"
+    assert evs[-1]["args"] == {"n": 3}
+
+
+def test_native_writer_escaping(tmp_path):
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    tl.start()
+    tl.begin('weird"name\\with\nstuff', "PH")
+    tl.stop()
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"][0]["args"]["tensor"] == \
+        'weird"name\\with\nstuff'
+
+
+def test_python_fallback(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_TIMELINE_NATIVE", "0")
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    tl.start()
+    assert tl._native is None
+    tl.begin("t", "X")
+    tl.end("t", "X")
+    tl.stop()
+    with open(path) as f:
+        doc = json.load(f)
+    assert len(doc["traceEvents"]) == 2
+
+
+def test_mark_cycles(tmp_path):
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path, mark_cycles=True)
+    tl.start()
+    tl.mark_cycle()
+    tl.stop()
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"][0]["name"] == "CYCLE"
+    assert os.path.getsize(path) > 0
