@@ -181,7 +181,17 @@ void* hvd_shm_create(const char* name, int rank, int size, uint64_t capacity,
                   hdr->magic.load(std::memory_order_acquire) == kMagic &&
                   hdr->gen.load(std::memory_order_relaxed) == gen;
             } while (!match && !deadline_passed(probe_dl));
-            if (match) break;
+            if (match) {
+              // magic is stored with release after size/capacity, so both
+              // are valid here; a mismatch is a config/version error, not
+              // staleness — fail loudly instead of corrupting offsets.
+              if (hdr->size != static_cast<uint32_t>(size) ||
+                  hdr->capacity != capacity) {
+                munmap(base, map_len);
+                return nullptr;
+              }
+              break;
+            }
             munmap(base, map_len);
             base = MAP_FAILED;
           }
@@ -244,6 +254,9 @@ int hvd_shm_barrier(void* h, double timeout_s) {
 int hvd_shm_allreduce(void* h, void* data, uint64_t count, int dtype, int op,
                       double timeout_s) {
   auto* c = static_cast<Comm*>(h);
+  // validate before the first barrier: a mid-protocol return would
+  // desynchronize the sense-reversing barrier for every peer
+  if (dtype < DT_F32 || dtype > DT_I64) return 3;
   size_t esize = dtype_size(dtype);
   uint64_t bytes = count * esize;
   if (bytes > c->capacity) return 2;
@@ -312,6 +325,7 @@ int hvd_shm_reducescatter(void* h, const void* in, void* out, uint64_t count,
                           int dtype, int op, double timeout_s) {
   auto* c = static_cast<Comm*>(h);
   if (count % c->size != 0) return 4;
+  if (dtype < DT_F32 || dtype > DT_I64) return 3;
   size_t esize = dtype_size(dtype);
   if (count * esize > c->capacity) return 2;
   std::memcpy(c->slot(c->rank), in, count * esize);

@@ -38,6 +38,7 @@ from .core.mesh import (                                       # noqa: F401
 from .ops.collective_ops import (                              # noqa: F401
     allreduce, allgather, broadcast, alltoall, reducescatter, barrier, join,
 )
+from .ops.sparse import sparse_allreduce                       # noqa: F401
 from .ops import inside                                        # noqa: F401
 from .ops.engine import (                                      # noqa: F401
     allreduce_async, allgather_async, broadcast_async, alltoall_async,

@@ -17,6 +17,7 @@ import logging
 import os
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional
 
 from ..runner import exec as exec_lib
@@ -107,8 +108,13 @@ class ElasticDriver:
 
     def _launch(self, slots: List[SlotInfo], kv_port: int) -> None:
         coord = f"127.0.0.1:{_free_port()}"
+        # Fresh shm-generation token per launch round so a restarted
+        # incarnation can never attach a dead round's stale segment
+        # (native/shm.py staleness check).
+        env = dict(self.base_env)
+        env["HOROVOD_SHM_GEN"] = str(uuid.uuid4().int & ((1 << 63) - 1))
         self._workers = exec_lib.launch_slots(
-            slots, self.command, coord, kv_port, self._secret, self.base_env)
+            slots, self.command, coord, kv_port, self._secret, env)
 
     def _supervise(self, slots: List[SlotInfo]) -> str:
         """Watch workers + host set. Returns 'done' or 'reset'."""

@@ -202,6 +202,15 @@ def allgather(x: Union[Array, Sequence[Array]], *,
     x = _place_stacked(x, mesh, n, "allgather")
     if x.ndim < 2:
         raise ValueError("allgather requires tensors of rank >= 1 per rank")
+    # Topology-aware path (HOROVOD_HIERARCHICAL_ALLGATHER,
+    # mpi_operations.cc MPIHierarchicalAllgather): local-AG then cross-AG
+    # over the (cross, local) mesh.
+    cfg = basics.get_config()
+    if cfg.hierarchical_allgather and ps.process_set_id == 0:
+        from .cross import two_level_allgather
+        hier = basics.get_hier_mesh()
+        if hier.devices.size == n and hier.devices.shape[1] > 1:
+            return two_level_allgather(x, hier)
     return _allgather_fn(mesh)(x)
 
 

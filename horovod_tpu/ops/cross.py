@@ -71,3 +71,36 @@ def two_level_allreduce(x: jax.Array, op: ReduceOp, mesh: Mesh) -> jax.Array:
             "two-level allreduce supports Sum/Average only "
             "(reference hierarchical path is likewise sum-based)")
     return _two_level_allreduce_fn(mesh, op)(x)
+
+
+@functools.lru_cache(maxsize=256)
+def _two_level_allgather_fn(mesh: Mesh):
+    cross, local = mesh.devices.shape
+    n = cross * local
+
+    def blk(x):                           # [1, d0, ...] per-device row
+        # phase 1: allgather within the local (ICI) group
+        g = lax.all_gather(x[0], LOCAL_AXIS)          # [local, d0, ...]
+        # phase 2: allgather the local blocks across the cross (DCN) axis
+        g = lax.all_gather(g, CROSS_AXIS)             # [cross, local, d0, ...]
+        # (cross, local) row-major is exactly global rank order
+        # (build_hierarchical_mesh reshapes the global device list row-major)
+        out = g.reshape((1, n * g.shape[2]) + g.shape[3:])
+        return out
+
+    f = jax.shard_map(blk, mesh=mesh,
+                      in_specs=P((CROSS_AXIS, LOCAL_AXIS)),
+                      out_specs=P((CROSS_AXIS, LOCAL_AXIS)))
+    return jax.jit(f)
+
+
+def two_level_allgather(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Stacked [n, d0, ...] -> [n, n*d0, ...] via local-AG then cross-AG.
+
+    TPU re-design of MPIHierarchicalAllgather
+    (horovod/common/ops/mpi_operations.cc MPIHierarchicalAllgather): gather
+    within the node over shared memory first, then exchange whole node-blocks
+    across nodes. Here phase 1 rides the ICI local axis and phase 2 the
+    cross/DCN axis, each a native XLA all_gather.
+    """
+    return _two_level_allgather_fn(mesh)(x)

@@ -95,7 +95,7 @@ class Timeline:
                     self._native, ev["name"].encode(),
                     ev.get("cat", "").encode(), ev["ph"].encode(), ev["ts"],
                     ev.get("pid", 0), ev.get("tid", 0),
-                    json.dumps(args).encode() if args else None)
+                    json.dumps(args).encode() if args is not None else None)
             return
         self._q.put(ev)
 

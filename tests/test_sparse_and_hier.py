@@ -1,0 +1,88 @@
+"""Sparse allreduce + hierarchical allgather tests."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+
+def test_sparse_allreduce_coalesced(hvd):
+    rng = np.random.RandomState(0)
+    n = hvd.size()
+    pairs = []
+    expect = {}
+    for r in range(n):
+        k = r + 1                                    # ragged sizes
+        idx = rng.randint(0, 10, size=(k,))
+        val = rng.randn(k, 3).astype(np.float32)
+        pairs.append((idx, val))
+        for i, row in zip(idx, val):
+            expect[i] = expect.get(i, np.zeros(3, np.float32)) + row
+    uniq, vals = hvd.sparse_allreduce(pairs, hvd.Sum)
+    assert list(uniq) == sorted(expect)
+    for i, u in enumerate(uniq):
+        np.testing.assert_allclose(np.asarray(vals[i]), expect[u], rtol=1e-5)
+
+
+def test_sparse_allreduce_average_dense(hvd):
+    n = hvd.size()
+    pairs = [((np.array([r]),
+               np.full((1, 2), float(r), np.float32))) for r in range(n)]
+    out = np.asarray(hvd.sparse_allreduce(pairs, hvd.Average,
+                                          dense_dim0=n + 2, dense=True))
+    assert out.shape == (n + 2, 2)
+    for r in range(n):
+        np.testing.assert_allclose(out[r], r / n)
+    np.testing.assert_allclose(out[n:], 0.0)
+
+
+def test_sparse_allreduce_duplicate_indices(hvd):
+    n = hvd.size()
+    # every rank contributes to index 0
+    pairs = [(np.array([0]), np.ones((1, 4), np.float32)) for _ in range(n)]
+    uniq, vals = hvd.sparse_allreduce(pairs, hvd.Sum)
+    assert list(uniq) == [0]
+    np.testing.assert_allclose(np.asarray(vals[0]), n * np.ones(4))
+
+
+def test_sparse_allreduce_validation(hvd):
+    n = hvd.size()
+    with pytest.raises(ValueError, match="pairs"):
+        hvd.sparse_allreduce([(np.array([0]), np.ones((1, 2)))])
+    bad = [(np.array([0]), np.ones((1, 2), np.float32))] * (n - 1)
+    bad.append((np.array([0]), np.ones((1, 3), np.float32)))
+    with pytest.raises(ValueError, match="trailing"):
+        hvd.sparse_allreduce(bad)
+    with pytest.raises(ValueError, match="Sum/Average"):
+        hvd.sparse_allreduce(
+            [(np.array([0]), np.ones((1, 2), np.float32))] * n, hvd.Max)
+
+
+def test_two_level_allgather_matches_flat(hvd):
+    from horovod_tpu.core.mesh import build_hierarchical_mesh
+    from horovod_tpu.ops.cross import two_level_allgather
+    mesh = build_hierarchical_mesh(jax.devices(), local_size=4)  # (2, 4)
+    x = np.random.RandomState(0).randn(8, 3, 5).astype(np.float32)
+    out = np.asarray(two_level_allgather(jnp.asarray(x), mesh))
+    flat = x.reshape(24, 5)                           # global-rank order
+    assert out.shape == (8, 24, 5)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], flat, rtol=1e-6)
+
+
+def test_hierarchical_allgather_env_flag():
+    import horovod_tpu as hvd
+    os.environ["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+    os.environ["HOROVOD_LOCAL_SIZE"] = "4"
+    try:
+        hvd.shutdown()
+        hvd.init()
+        x = np.random.RandomState(1).randn(8, 2, 3).astype(np.float32)
+        out = np.asarray(hvd.allgather(x))
+        assert out.shape == (8, 16, 3)
+        np.testing.assert_allclose(out[0], x.reshape(16, 3), rtol=1e-6)
+    finally:
+        del os.environ["HOROVOD_HIERARCHICAL_ALLGATHER"]
+        del os.environ["HOROVOD_LOCAL_SIZE"]
+        hvd.shutdown()
