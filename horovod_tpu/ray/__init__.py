@@ -1,0 +1,15 @@
+"""Ray integration: actor-fleet executor + elastic host discovery.
+
+Re-design of horovod/ray/ (RayExecutor runner.py:168, strategies
+strategy.py, RayHostDiscovery elastic.py) with Ray as an optional
+dependency: placement/rank logic is pure Python, the actor transport is
+injectable, and the data plane on each worker is horovod_tpu's XLA
+collectives.
+"""
+from .runner import (                                          # noqa: F401
+    BaseHorovodWorker, Coordinator, RayExecutor, worker_env,
+)
+from .strategy import (                                        # noqa: F401
+    PlacementPlan, colocated_plan, spread_plan,
+)
+from .elastic import RayHostDiscovery                          # noqa: F401

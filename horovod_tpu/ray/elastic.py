@@ -1,0 +1,55 @@
+"""Ray-native host discovery for elastic training.
+
+Re-design of the reference's `RayHostDiscovery`
+(horovod/ray/elastic.py): instead of polling a user shell script, ask the
+Ray GCS for the current set of alive nodes and their resources, and present
+them through the same `HostDiscovery` interface the elastic driver polls
+(elastic/discovery.py) — so `ElasticDriver` works unchanged on a Ray
+cluster that autoscales.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ..elastic.discovery import HostDiscovery
+
+
+def _default_nodes() -> List[dict]:
+    import ray                                         # gated import
+    return ray.nodes()
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Map alive Ray nodes to {hostname: slots}.
+
+    slots per host = floor(resource / per-worker need), using TPU custom
+    resources when `use_tpu` else CPUs — the reference's GPU/CPU logic
+    (horovod/ray/elastic.py RayHostDiscovery.find_available_hosts_and_slots)
+    re-targeted at TPU resources.
+    """
+
+    def __init__(self, use_tpu: bool = False, cpus_per_slot: float = 1.0,
+                 tpus_per_slot: float = 1.0,
+                 nodes_fn: Optional[Callable[[], List[dict]]] = None) -> None:
+        self.use_tpu = use_tpu
+        self.cpus_per_slot = cpus_per_slot
+        self.tpus_per_slot = tpus_per_slot
+        self._nodes_fn = nodes_fn or _default_nodes
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        hosts: Dict[str, int] = {}
+        for node in self._nodes_fn():
+            if not node.get("Alive", False):
+                continue
+            resources: Dict[str, Any] = node.get("Resources", {}) or {}
+            hostname = node.get("NodeManagerHostname") or \
+                node.get("NodeManagerAddress")
+            if not hostname:
+                continue
+            if self.use_tpu:
+                slots = int(resources.get("TPU", 0) // self.tpus_per_slot)
+            else:
+                slots = int(resources.get("CPU", 0) // self.cpus_per_slot)
+            if slots > 0:
+                hosts[hostname] = hosts.get(hostname, 0) + slots
+        return hosts

@@ -1,0 +1,78 @@
+"""Placement planning for the Ray executor.
+
+Re-design of the reference's placement strategies
+(horovod/ray/strategy.py: ColocatedStrategy / PackStrategy — placement-group
+bundle layout deciding how workers land on hosts). The bundle math is pure
+Python here so it is unit-testable without a Ray cluster; the executor feeds
+the resulting spec to `ray.util.placement_group` at start time.
+
+TPU angle: one worker per host is the natural layout (a single jax process
+drives every local chip), which is `workers_per_host=1` colocated bundles
+with `tpus_per_worker` custom resources.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class PlacementPlan:
+    """Bundle list + ray placement strategy + per-worker resource needs."""
+    bundles: List[Dict[str, float]]
+    strategy: str                      # "PACK" | "STRICT_PACK" | "SPREAD"
+    workers_per_bundle: List[int]      # how many workers share each bundle
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def num_workers(self) -> int:
+        return sum(self.workers_per_bundle)
+
+
+def colocated_plan(num_workers: int, workers_per_host: int,
+                   cpus_per_worker: float = 1.0,
+                   tpus_per_worker: float = 0.0,
+                   extra_resources: Optional[Dict[str, float]] = None,
+                   ) -> PlacementPlan:
+    """Whole-host bundles: each bundle holds `workers_per_host` workers.
+
+    Mirrors the reference ColocatedStrategy (horovod/ray/strategy.py): the
+    last bundle may be partial when num_workers % workers_per_host != 0.
+    STRICT_PACK pins each bundle to one node so local collectives ride
+    shared memory / ICI.
+    """
+    if num_workers <= 0 or workers_per_host <= 0:
+        raise ValueError("num_workers and workers_per_host must be positive")
+    extra = dict(extra_resources or {})
+    per_worker: Dict[str, float] = {"CPU": cpus_per_worker, **extra}
+    if tpus_per_worker:
+        per_worker["TPU"] = tpus_per_worker
+    bundles, per_bundle_workers = [], []
+    remaining = num_workers
+    while remaining > 0:
+        w = min(workers_per_host, remaining)
+        bundles.append({k: v * w for k, v in per_worker.items()})
+        per_bundle_workers.append(w)
+        remaining -= w
+    return PlacementPlan(bundles=bundles, strategy="STRICT_PACK",
+                         workers_per_bundle=per_bundle_workers,
+                         worker_resources=per_worker)
+
+
+def spread_plan(num_workers: int, cpus_per_worker: float = 1.0,
+                tpus_per_worker: float = 0.0,
+                extra_resources: Optional[Dict[str, float]] = None,
+                ) -> PlacementPlan:
+    """One worker per bundle, spread across hosts (reference PackStrategy
+    with SPREAD scheduling): maximizes per-worker bandwidth on CPU
+    clusters."""
+    if num_workers <= 0:
+        raise ValueError("num_workers must be positive")
+    extra = dict(extra_resources or {})
+    per_worker: Dict[str, float] = {"CPU": cpus_per_worker, **extra}
+    if tpus_per_worker:
+        per_worker["TPU"] = tpus_per_worker
+    return PlacementPlan(bundles=[dict(per_worker)] * num_workers,
+                         strategy="SPREAD",
+                         workers_per_bundle=[1] * num_workers,
+                         worker_resources=per_worker)

@@ -1,0 +1,140 @@
+"""Ray executor tests — no Ray required.
+
+Mirrors test/single/test_ray.py's coverage shape (executor lifecycle, rank
+env, placement), using the injectable local backend instead of a ray
+mini-cluster (ray is an optional dependency of the rebuild).
+"""
+import os
+
+import pytest
+
+from horovod_tpu.ray import (
+    BaseHorovodWorker, Coordinator, RayExecutor, RayHostDiscovery,
+    colocated_plan, spread_plan, worker_env,
+)
+from horovod_tpu.ray.runner import _LocalBackend
+from horovod_tpu.runner.hosts import SlotInfo
+
+
+# -- strategy ---------------------------------------------------------------
+
+def test_colocated_plan_bundles():
+    plan = colocated_plan(num_workers=5, workers_per_host=2,
+                          cpus_per_worker=2.0)
+    assert plan.strategy == "STRICT_PACK"
+    assert plan.workers_per_bundle == [2, 2, 1]
+    assert plan.bundles[0] == {"CPU": 4.0}
+    assert plan.bundles[2] == {"CPU": 2.0}
+    assert plan.num_workers == 5
+
+
+def test_colocated_plan_tpu_resources():
+    plan = colocated_plan(num_workers=2, workers_per_host=1,
+                          tpus_per_worker=4.0)
+    assert plan.bundles == [{"CPU": 1.0, "TPU": 4.0}] * 2
+    assert plan.worker_resources["TPU"] == 4.0
+
+
+def test_spread_plan():
+    plan = spread_plan(num_workers=3, cpus_per_worker=1.5)
+    assert plan.strategy == "SPREAD"
+    assert plan.workers_per_bundle == [1, 1, 1]
+    assert plan.bundles == [{"CPU": 1.5}] * 3
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError):
+        colocated_plan(0, 1)
+    with pytest.raises(ValueError):
+        spread_plan(-1)
+
+
+# -- coordinator ------------------------------------------------------------
+
+def test_coordinator_rank_assignment():
+    c = Coordinator()
+    for h in ["hostA", "hostB", "hostA", "hostB"]:
+        c.register(h)
+    slots = c.slots()
+    assert [s.rank for s in slots] == [0, 2, 1, 3]      # dense by host
+    assert [s.local_rank for s in slots] == [0, 0, 1, 1]
+    assert all(s.size == 4 and s.local_size == 2 for s in slots)
+    # cross ranks: hostA is host 0, hostB host 1
+    assert slots[0].cross_rank == 0 and slots[1].cross_rank == 1
+
+
+def test_worker_env_contract():
+    s = SlotInfo("h1", rank=3, local_rank=1, cross_rank=1,
+                 size=4, local_size=2, cross_size=2)
+    env = worker_env(s, "driver-host", 12345, {"EXTRA": "1"})
+    assert env["HOROVOD_RANK"] == "3"
+    assert env["HOROVOD_LOCAL_RANK"] == "1"
+    assert env["HOROVOD_CROSS_SIZE"] == "2"
+    assert env["HOROVOD_NATIVE_KV_ADDR"] == "driver-host"
+    assert env["HOROVOD_NATIVE_KV_PORT"] == "12345"
+    assert env["EXTRA"] == "1"
+
+
+# -- executor (local backend) ----------------------------------------------
+
+def test_executor_lifecycle_and_run():
+    ex = RayExecutor(num_workers=4, workers_per_host=2,
+                     backend=_LocalBackend(),
+                     env_vars={"HVD_TEST_MARK": "yes"})
+    ex.start()
+    try:
+        assert len(ex.workers) == 4
+        assert sorted(s.rank for s in ex.slots) == [0, 1, 2, 3]
+        # env was pushed (local backend shares this process env)
+        assert os.environ["HVD_TEST_MARK"] == "yes"
+        results = ex.run(lambda a, b: a + b, args=(2, 3))
+        assert results == [5, 5, 5, 5]
+        assert ex.execute_single(lambda: "root") == "root"
+        refs = ex.run_remote(lambda: 7)
+        assert ex.wait(refs) == [7, 7, 7, 7]
+    finally:
+        ex.shutdown()
+        os.environ.pop("HVD_TEST_MARK", None)
+    assert ex.workers == []
+
+
+def test_executor_requires_start():
+    ex = RayExecutor(num_workers=1, backend=_LocalBackend())
+    with pytest.raises(RuntimeError, match="start"):
+        ex.run(lambda: 1)
+
+
+def test_base_worker_execute():
+    w = BaseHorovodWorker(world_rank=0)
+    assert w.execute(lambda x: x * 2, (21,)) == 42
+    assert isinstance(w.hostname(), str) and w.hostname()
+
+
+# -- elastic discovery ------------------------------------------------------
+
+def test_ray_host_discovery_cpu_and_tpu():
+    nodes = [
+        {"Alive": True, "NodeManagerHostname": "n1",
+         "Resources": {"CPU": 8.0, "TPU": 4.0}},
+        {"Alive": True, "NodeManagerHostname": "n2",
+         "Resources": {"CPU": 4.0}},
+        {"Alive": False, "NodeManagerHostname": "dead",
+         "Resources": {"CPU": 64.0}},
+        {"Alive": True, "NodeManagerHostname": "headless",
+         "Resources": {}},
+    ]
+    d = RayHostDiscovery(nodes_fn=lambda: nodes, cpus_per_slot=2.0)
+    assert d.find_available_hosts_and_slots() == {"n1": 4, "n2": 2}
+    d = RayHostDiscovery(use_tpu=True, tpus_per_slot=4.0,
+                         nodes_fn=lambda: nodes)
+    assert d.find_available_hosts_and_slots() == {"n1": 1}
+
+
+def test_ray_host_discovery_with_elastic_manager():
+    from horovod_tpu.elastic.discovery import HostManager
+    nodes = [{"Alive": True, "NodeManagerHostname": "n1",
+              "Resources": {"CPU": 2.0}}]
+    mgr = HostManager(RayHostDiscovery(nodes_fn=lambda: nodes))
+    hosts = mgr.current_hosts()
+    assert len(hosts) == 1 and hosts[0].hostname == "n1"
+    assert hosts[0].slots == 2
