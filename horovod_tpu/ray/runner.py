@@ -25,7 +25,7 @@ import os
 import socket
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from ..runner.hosts import SlotInfo, assign_from_hostnames
 from .strategy import PlacementPlan, colocated_plan, spread_plan
 
 
@@ -49,26 +49,7 @@ class Coordinator:
         """SlotInfo per worker id: workers grouped by host (first-seen host
         order, like the reference's registration-ordered node list), dense
         global ranks by host then arrival."""
-        host_order: List[str] = []
-        per_host: Dict[str, int] = {}
-        for h in self._hostnames:
-            if h not in per_host:
-                host_order.append(h)
-                per_host[h] = 0
-            per_host[h] += 1
-        hosts = [HostInfo(h, per_host[h]) for h in host_order]
-        assignments = get_host_assignments(hosts, len(self._hostnames))
-        # map worker id -> its slot: workers on a host take local ranks in
-        # registration order
-        taken: Dict[str, int] = {h: 0 for h in host_order}
-        by_host: Dict[str, List[SlotInfo]] = {}
-        for s in assignments:
-            by_host.setdefault(s.hostname, []).append(s)
-        out: List[SlotInfo] = []
-        for h in self._hostnames:
-            out.append(by_host[h][taken[h]])
-            taken[h] += 1
-        return out
+        return assign_from_hostnames(self._hostnames)
 
 
 def worker_env(slot: SlotInfo, kv_addr: Optional[str], kv_port: Optional[int],

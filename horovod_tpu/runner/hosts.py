@@ -104,3 +104,32 @@ def get_host_assignments(hosts: List[HostInfo], np: int) -> List[SlotInfo]:
             cross_rank=cross_rank, size=np,
             local_size=local_sizes[name], cross_size=cross_size))
     return slots
+
+
+def assign_from_hostnames(hostnames: List[str]) -> List[SlotInfo]:
+    """SlotInfo per worker given one hostname per worker (registration
+    order): workers are grouped by host in first-seen host order with dense
+    global ranks by host then arrival — the rank map the reference's Ray
+    Coordinator (horovod/ray/runner.py:45) and Spark task rendezvous
+    (spark/runner.py:165) both compute.
+
+    Returns slots aligned with the input order: entry i is worker i's slot.
+    """
+    host_order: List[str] = []
+    per_host = {}
+    for h in hostnames:
+        if h not in per_host:
+            host_order.append(h)
+            per_host[h] = 0
+        per_host[h] += 1
+    hosts = [HostInfo(h, per_host[h]) for h in host_order]
+    assignments = get_host_assignments(hosts, len(hostnames))
+    by_host = {}
+    for s in assignments:
+        by_host.setdefault(s.hostname, []).append(s)
+    taken = {h: 0 for h in host_order}
+    out = []
+    for h in hostnames:
+        out.append(by_host[h][taken[h]])
+        taken[h] += 1
+    return out

@@ -1,0 +1,12 @@
+"""Spark integration: distributed run API, Store abstraction, estimators.
+
+Re-design of horovod/spark/ (runner.py:200 run, common/store.py:38 Store,
+keras/torch estimators) with pyspark as an optional dependency: the barrier
+job is an injectable runner, rendezvous rides the HTTP KV server, and the
+estimator trains single-controller SPMD over the TPU mesh.
+"""
+from .runner import (                                          # noqa: F401
+    MultiprocessingJobRunner, SparkJobRunner, run,
+)
+from .store import FsspecStore, LocalStore, Store              # noqa: F401
+from .estimator import FlaxEstimator, FlaxModel                # noqa: F401

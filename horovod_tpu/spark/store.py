@@ -1,0 +1,186 @@
+"""Storage abstraction for the Spark estimator layer.
+
+Re-design of the reference's Store (horovod/spark/common/store.py:38):
+a `Store` names the locations an estimator uses — intermediate training
+data, checkpoints, logs — behind a filesystem-agnostic interface, selected
+by URL scheme via `Store.create(prefix_path)`.
+
+The rebuild ships a complete `LocalStore` (posix paths; covers NFS/FUSE
+mounts, the common case on TPU pods where data lives on GCS-FUSE) and a
+`FilesystemStore` base that remote implementations (HDFS/S3/GCS/ADLS/DBFS
+in the reference) plug into via fsspec when available; without fsspec those
+schemes raise an informative error rather than import-failing.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional
+
+
+class Store:
+    """Abstract location provider (reference Store, spark/common/store.py)."""
+
+    def get_train_data_path(self, idx: Optional[Any] = None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx: Optional[Any] = None) -> str:
+        raise NotImplementedError
+
+    def get_test_data_path(self, idx: Optional[Any] = None) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def sync_fn(self, run_id: str):
+        """Return fn(local_dir) uploading a local run dir to the store
+        (reference sync_fn contract used by remote trainers)."""
+        raise NotImplementedError
+
+    # -- object helpers shared by all stores --------------------------------
+    def write_obj(self, path: str, obj: Any) -> None:
+        self.write(path, pickle.dumps(obj))
+
+    def read_obj(self, path: str) -> Any:
+        return pickle.loads(self.read(path))
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Choose a Store by scheme (reference Store.create)."""
+        scheme = prefix_path.split("://", 1)[0] if "://" in prefix_path \
+            else "file"
+        if scheme in ("file", ""):
+            return LocalStore(prefix_path.replace("file://", "", 1),
+                              *args, **kwargs)
+        try:
+            import fsspec                              # gated optional dep
+        except ImportError as e:
+            raise RuntimeError(
+                f"Store scheme {scheme!r} requires fsspec (reference uses "
+                f"per-filesystem clients, spark/common/store.py); install "
+                f"fsspec or use a file:// / local path") from e
+        return FsspecStore(prefix_path, fsspec.filesystem(scheme),
+                           *args, **kwargs)
+
+
+class LocalStore(Store):
+    """Posix-filesystem store (reference LocalStore)."""
+
+    def __init__(self, prefix_path: Optional[str] = None) -> None:
+        self.prefix = prefix_path or os.path.join(
+            tempfile.gettempdir(), "horovod_tpu_store")
+        os.makedirs(self.prefix, exist_ok=True)
+
+    def _p(self, *parts: str) -> str:
+        p = os.path.join(self.prefix, *parts)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        return p
+
+    def get_train_data_path(self, idx: Optional[Any] = None) -> str:
+        return self._p("intermediate_train_data" +
+                       (f".{idx}" if idx is not None else ""))
+
+    def get_val_data_path(self, idx: Optional[Any] = None) -> str:
+        return self._p("intermediate_val_data" +
+                       (f".{idx}" if idx is not None else ""))
+
+    def get_test_data_path(self, idx: Optional[Any] = None) -> str:
+        return self._p("intermediate_test_data" +
+                       (f".{idx}" if idx is not None else ""))
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._p("runs", run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._p("runs", run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def sync_fn(self, run_id: str):
+        ckpt_root = os.path.dirname(self.get_checkpoint_path(run_id))
+
+        def fn(local_dir: str) -> None:
+            os.makedirs(ckpt_root, exist_ok=True)
+            for name in os.listdir(local_dir):
+                src = os.path.join(local_dir, name)
+                dst = os.path.join(ckpt_root, name)
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                else:
+                    shutil.copy2(src, dst)
+        return fn
+
+
+class FsspecStore(Store):
+    """Remote store over an fsspec filesystem (HDFS/S3/GCS/ADLS schemes)."""
+
+    def __init__(self, prefix_path: str, fs: Any) -> None:
+        self.prefix = prefix_path.rstrip("/")
+        self.fs = fs
+
+    def _p(self, *parts: str) -> str:
+        return "/".join((self.prefix,) + parts)
+
+    def get_train_data_path(self, idx: Optional[Any] = None) -> str:
+        return self._p("intermediate_train_data" +
+                       (f".{idx}" if idx is not None else ""))
+
+    def get_val_data_path(self, idx: Optional[Any] = None) -> str:
+        return self._p("intermediate_val_data" +
+                       (f".{idx}" if idx is not None else ""))
+
+    def get_test_data_path(self, idx: Optional[Any] = None) -> str:
+        return self._p("intermediate_test_data" +
+                       (f".{idx}" if idx is not None else ""))
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._p("runs", run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._p("runs", run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        return self.fs.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with self.fs.open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        with self.fs.open(path, "wb") as f:
+            f.write(data)
+
+    def sync_fn(self, run_id: str):
+        ckpt_root = "/".join(self.get_checkpoint_path(run_id)
+                             .split("/")[:-1])
+
+        def fn(local_dir: str) -> None:
+            self.fs.put(local_dir, ckpt_root, recursive=True)
+        return fn

@@ -1,0 +1,137 @@
+"""Spark layer tests — no pyspark required.
+
+Mirrors test/integration/test_spark.py's coverage shape (run() end-to-end
+with process isolation, store round-trips, estimator fit/predict) using the
+multiprocessing job runner in place of local-mode Spark.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from horovod_tpu.spark import (
+    FlaxEstimator, FlaxModel, LocalStore, MultiprocessingJobRunner, Store,
+    run,
+)
+
+
+# -- store ------------------------------------------------------------------
+
+def test_local_store_paths_and_io(tmp_path):
+    store = LocalStore(str(tmp_path / "store"))
+    p = store.get_checkpoint_path("run1")
+    assert "run1" in p
+    store.write(p, b"hello")
+    assert store.exists(p)
+    assert store.read(p) == b"hello"
+    store.write_obj(store.get_train_data_path("a"), {"x": 1})
+    assert store.read_obj(store.get_train_data_path("a")) == {"x": 1}
+
+
+def test_store_create_local_scheme(tmp_path):
+    s = Store.create(f"file://{tmp_path}/st")
+    assert isinstance(s, LocalStore)
+    s2 = Store.create(str(tmp_path / "st2"))
+    assert isinstance(s2, LocalStore)
+
+
+def test_store_create_remote_scheme_requires_fsspec():
+    try:
+        import fsspec  # noqa: F401
+        pytest.skip("fsspec installed")
+    except ImportError:
+        pass
+    with pytest.raises(RuntimeError, match="fsspec"):
+        Store.create("s3://bucket/prefix")
+
+
+def test_local_store_sync_fn(tmp_path):
+    store = LocalStore(str(tmp_path / "store"))
+    local = tmp_path / "local_run"
+    local.mkdir()
+    (local / "weights.bin").write_bytes(b"w")
+    store.sync_fn("runX")(str(local))
+    root = os.path.dirname(store.get_checkpoint_path("runX"))
+    assert os.path.exists(os.path.join(root, "weights.bin"))
+
+
+# -- run() ------------------------------------------------------------------
+
+def _task():
+    """Top-level so it pickles under spawn."""
+    return (int(os.environ["HOROVOD_RANK"]),
+            int(os.environ["HOROVOD_SIZE"]),
+            os.environ["HOROVOD_HOSTNAME"])
+
+
+def _task_with_args(a, b=0):
+    return int(os.environ["HOROVOD_RANK"]) * 100 + a + b
+
+
+def test_spark_run_multiprocessing():
+    results = run(_task, num_proc=3,
+                  job_runner=MultiprocessingJobRunner())
+    ranks = [r[0] for r in results]
+    assert ranks == [0, 1, 2]                 # rank-ordered
+    assert all(r[1] == 3 for r in results)
+
+
+def test_spark_run_args_and_env():
+    results = run(_task_with_args, args=(7,), kwargs={"b": 2}, num_proc=2,
+                  job_runner=MultiprocessingJobRunner())
+    assert results == [9, 109]
+
+
+def test_spark_run_validates_num_proc():
+    with pytest.raises(ValueError, match="num_proc"):
+        run(_task, num_proc=0, job_runner=MultiprocessingJobRunner())
+
+
+def _boom():
+    raise RuntimeError("worker exploded")
+
+
+def test_spark_run_failure_propagates():
+    with pytest.raises(RuntimeError, match="tasks failed"):
+        run(_boom, num_proc=2, job_runner=MultiprocessingJobRunner(),
+            start_timeout=30.0)
+
+
+# -- estimator --------------------------------------------------------------
+
+def test_flax_estimator_fit_predict(hvd, tmp_path):
+    import flax.linen as nn
+    import optax
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(2)(x)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+
+    store = LocalStore(str(tmp_path / "store"))
+    est = FlaxEstimator(MLP(), optax.adam(1e-2), epochs=5, batch_size=64,
+                        store=store, run_id="fitrun", validation=0.1)
+    model = est.fit(x, y)
+    assert len(est.history) == 5
+    assert est.history[-1]["loss"] < est.history[0]["loss"]
+    assert "val_loss" in est.history[-1]
+
+    preds = model.predict(x[:32])
+    assert preds.shape == (32, 2)
+    acc = (preds.argmax(1) == y[:32]).mean()
+    assert acc > 0.6
+
+    # checkpoint round-trip through the store
+    loaded = FlaxModel.load(store, "fitrun", MLP())
+    np.testing.assert_allclose(loaded.predict(x[:8]), preds[:8], rtol=1e-6)
+
+    # intermediate data was materialized
+    assert store.exists(store.get_train_data_path("fitrun"))
+    assert store.exists(store.get_val_data_path("fitrun"))
