@@ -1,0 +1,12 @@
+"""Data utilities: loaders, sharding, and the compute (data) service.
+
+Re-design of horovod/data/ (BaseDataLoader/AsyncDataLoaderMixin,
+data_loader_base.py) and the tf.data-service integration
+(tensorflow/data/compute_service.py).
+"""
+from .loader import (                                          # noqa: F401
+    AsyncDataLoaderMixin, BaseDataLoader, shard_indices,
+)
+from .compute_service import (                                 # noqa: F401
+    ComputeClient, ComputeConfig, ComputeService, ComputeWorker,
+)

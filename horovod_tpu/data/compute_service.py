@@ -1,0 +1,218 @@
+"""Data compute service: run the input pipeline in a separate job.
+
+Re-design of the reference's tf.data-service integration
+(horovod/tensorflow/data/compute_service.py:34 `TfDataServiceConfig`,
+compute_worker.py, and the registration protocol in
+horovod/runner/common/service/compute_service.py:97,219): a "compute" job
+of worker processes runs the user's data pipeline on CPU hosts, and the
+training job's ranks stream ready batches from it — decoupling input
+preprocessing from accelerator stepping.
+
+TPU-native architecture: the dispatcher is the existing HTTP KV server
+(worker registration + discovery — the ComputeService registration role);
+each compute worker serves pickled batches over a length-prefixed TCP
+socket. Sharding follows the tf.data-service "distributed epoch" mode:
+batches are handed out first-come-first-served, so consumers collectively
+see every batch exactly once per epoch regardless of relative speed; a
+per-consumer round-robin mode mirrors the deterministic sharding mode.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, List, Optional
+
+from ..runner.http_kv import KVStoreClient, KVStoreServer, make_secret
+
+_SCOPE = "compute_workers"
+_END = b"__END_OF_EPOCH__"
+
+
+@dataclass
+class ComputeConfig:
+    """Serializable handle to a running compute service (the reference's
+    TfDataServiceConfig role: everything a training rank needs to
+    connect)."""
+    dispatcher_addr: str
+    dispatcher_port: int
+    secret: str
+    num_workers: int
+    extra: dict = field(default_factory=dict)
+
+
+class ComputeService:
+    """Dispatcher: worker registry on the KV server."""
+
+    def __init__(self, num_workers: int) -> None:
+        self.num_workers = num_workers
+        self.secret = make_secret()
+        self._server = KVStoreServer(secret=self.secret)
+        self.port = self._server.start()
+        self.addr = "127.0.0.1"
+
+    def config(self, addr: Optional[str] = None) -> ComputeConfig:
+        return ComputeConfig(addr or self.addr, self.port, self.secret,
+                             self.num_workers)
+
+    def wait_for_workers(self, timeout: float = 60.0) -> List[str]:
+        """Block until all workers registered; returns their addresses."""
+        kv = KVStoreClient("127.0.0.1", self.port, secret=self.secret)
+        deadline = time.monotonic() + timeout
+        while True:
+            addrs = [kv.get(_SCOPE, str(i)) for i in range(self.num_workers)]
+            if all(a is not None for a in addrs):
+                return [a.decode() for a in addrs]
+            if time.monotonic() > deadline:
+                missing = [i for i, a in enumerate(addrs) if a is None]
+                raise TimeoutError(
+                    f"compute workers {missing} did not register")
+            time.sleep(0.05)
+
+    def shutdown(self) -> None:
+        self._server.stop()
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    hdr = _recv_exact(sock, 8)
+    (n,) = struct.unpack("!Q", hdr)
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("compute service peer closed")
+        buf += chunk
+    return buf
+
+
+class ComputeWorker:
+    """One compute-job process: runs `dataset_fn()` (an iterable factory)
+    and serves its batches over TCP (compute_worker.py role).
+
+    First-come-first-served batch handout; `reset()` (a new `epoch` id in
+    the request) restarts the iterator — the consumer side advances epochs
+    collectively.
+    """
+
+    def __init__(self, index: int, config: ComputeConfig,
+                 dataset_fn: Callable[[], Any]) -> None:
+        self.index = index
+        self.config = config
+        self.dataset_fn = dataset_fn
+        self._lock = threading.Lock()
+        self._epoch = -1
+        self._it: Optional[Iterator] = None
+        worker = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                try:
+                    while True:
+                        req = pickle.loads(_recv_msg(self.request))
+                        _send_msg(self.request,
+                                  worker._next_batch(req["epoch"]))
+                except (ConnectionError, EOFError):
+                    pass
+
+        self._srv = socketserver.ThreadingTCPServer(
+            ("0.0.0.0", 0), Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        # register with the dispatcher
+        kv = KVStoreClient(config.dispatcher_addr, config.dispatcher_port,
+                           secret=config.secret)
+        kv.put(_SCOPE, str(index),
+               f"{socket.gethostname()}:{self.port}".encode())
+
+    def _next_batch(self, epoch: int) -> bytes:
+        with self._lock:
+            if epoch != self._epoch:
+                self._epoch = epoch
+                self._it = iter(self.dataset_fn())
+            try:
+                return pickle.dumps(next(self._it))
+            except StopIteration:
+                return _END
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class ComputeClient:
+    """Training-rank side: pull batches from every worker (reference
+    compute-side `ComputeClient`, runner/common/service/compute_service.py:219).
+
+    Iterating yields each worker's batches first-come-first-served until
+    all workers are exhausted for the epoch. With `deterministic=True`
+    and (rank, num_consumers), rank r only takes workers w where
+    w % num_consumers == r — the deterministic sharding mode.
+    """
+
+    def __init__(self, config: ComputeConfig, *, rank: int = 0,
+                 num_consumers: int = 1, deterministic: bool = False,
+                 connect_timeout: float = 60.0) -> None:
+        self.config = config
+        self.rank = rank
+        self.num_consumers = num_consumers
+        self.deterministic = deterministic
+        kv = KVStoreClient(config.dispatcher_addr, config.dispatcher_port,
+                           secret=config.secret)
+        deadline = time.monotonic() + connect_timeout
+        addrs: List[Optional[bytes]] = []
+        while True:
+            addrs = [kv.get(_SCOPE, str(i))
+                     for i in range(config.num_workers)]
+            if all(a is not None for a in addrs):
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("compute workers not available")
+            time.sleep(0.05)
+        self._workers = []
+        for i, a in enumerate(addrs):
+            if deterministic and i % num_consumers != rank:
+                continue
+            host, port = a.decode().rsplit(":", 1)
+            if host == socket.gethostname():
+                host = "127.0.0.1"
+            s = socket.create_connection((host, int(port)),
+                                         timeout=connect_timeout)
+            self._workers.append(s)
+        self._epoch = 0
+
+    def batches(self) -> Iterator[Any]:
+        """One epoch of batches across this consumer's workers."""
+        live = list(self._workers)
+        epoch = self._epoch
+        self._epoch += 1
+        req = pickle.dumps({"epoch": epoch})
+        while live:
+            for s in list(live):
+                _send_msg(s, req)
+                payload = _recv_msg(s)
+                if payload == _END:
+                    live.remove(s)
+                    continue
+                yield pickle.loads(payload)
+
+    def close(self) -> None:
+        for s in self._workers:
+            try:
+                s.close()
+            except OSError:
+                pass

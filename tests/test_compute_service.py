@@ -1,0 +1,97 @@
+"""Compute (data) service tests.
+
+Mirrors test/parallel/test_compute_worker.py + test_compute_service.py
+coverage shape: registration, batch streaming, sharding modes, epochs —
+in-process (threads) instead of a separate compute job.
+"""
+import numpy as np
+import pytest
+
+from horovod_tpu.data import (
+    ComputeClient, ComputeService, ComputeWorker,
+)
+
+
+def _dataset_fn_factory(worker_idx, n_batches=4):
+    def fn():
+        for b in range(n_batches):
+            yield {"x": np.full((2, 2), worker_idx * 100 + b), "idx":
+                   (worker_idx, b)}
+    return fn
+
+
+@pytest.fixture()
+def service():
+    svc = ComputeService(num_workers=2)
+    workers = [ComputeWorker(i, svc.config(), _dataset_fn_factory(i))
+               for i in range(2)]
+    svc.wait_for_workers(timeout=10)
+    yield svc
+    for w in workers:
+        w.shutdown()
+    svc.shutdown()
+
+
+def test_registration_and_full_epoch(service):
+    client = ComputeClient(service.config(), connect_timeout=10)
+    got = sorted(b["idx"] for b in client.batches())
+    assert got == [(w, b) for w in range(2) for b in range(4)]
+    client.close()
+
+
+def test_multiple_epochs(service):
+    client = ComputeClient(service.config(), connect_timeout=10)
+    first = sorted(b["idx"] for b in client.batches())
+    second = sorted(b["idx"] for b in client.batches())
+    assert first == second and len(first) == 8
+    client.close()
+
+
+def test_deterministic_sharding(service):
+    c0 = ComputeClient(service.config(), rank=0, num_consumers=2,
+                       deterministic=True, connect_timeout=10)
+    c1 = ComputeClient(service.config(), rank=1, num_consumers=2,
+                       deterministic=True, connect_timeout=10)
+    got0 = sorted(b["idx"] for b in c0.batches())
+    got1 = sorted(b["idx"] for b in c1.batches())
+    assert {w for w, _ in got0} == {0}
+    assert {w for w, _ in got1} == {1}
+    assert len(got0) == len(got1) == 4
+    c0.close()
+    c1.close()
+
+
+def test_fcfs_consumers_disjoint_cover(service):
+    """Two first-come-first-served consumers sharing one epoch see every
+    batch exactly once collectively (distributed-epoch semantics)."""
+    c0 = ComputeClient(service.config(), connect_timeout=10)
+    c1 = ComputeClient(service.config(), connect_timeout=10)
+    # both pull from the same workers' epoch-0 iterators
+    it0, it1 = c0.batches(), c1.batches()
+    seen = []
+    done0 = done1 = False
+    while not (done0 and done1):
+        if not done0:
+            try:
+                seen.append(next(it0)["idx"])
+            except StopIteration:
+                done0 = True
+        if not done1:
+            try:
+                seen.append(next(it1)["idx"])
+            except StopIteration:
+                done1 = True
+    assert sorted(seen) == [(w, b) for w in range(2) for b in range(4)]
+    c0.close()
+    c1.close()
+
+
+def test_missing_worker_times_out():
+    svc = ComputeService(num_workers=1)
+    try:
+        with pytest.raises(TimeoutError):
+            svc.wait_for_workers(timeout=0.3)
+        with pytest.raises(TimeoutError):
+            ComputeClient(svc.config(), connect_timeout=0.3)
+    finally:
+        svc.shutdown()
