@@ -1,0 +1,9 @@
+"""Foreign-framework interop layer.
+
+The reference binds TensorFlow/PyTorch/MXNet through per-framework C++
+adapters (horovod/torch/, horovod/tensorflow/, horovod/mxnet/). The
+rebuild's compute path is JAX-native; this package is the equivalent
+binding surface for foreign frameworks, staged through DLPack/numpy —
+the north-star's "XLA custom-call interop layer for foreign frameworks
+via DLPack staging".
+"""

@@ -1,0 +1,267 @@
+"""PyTorch binding: hvd-style collectives + DistributedOptimizer for torch.
+
+Re-design of the reference's torch layer (horovod/torch/mpi_ops.py,
+optimizer.py, functions.py). Two data planes:
+
+* **Multi-process CPU**: each rank is a separate Python process holding a
+  torch model replica; collectives run over the native shared-memory
+  segment (csrc/shm_coll.cc) — the role Gloo CPU ops play in the
+  reference. Identity comes from the launcher env (HOROVOD_RANK/SIZE),
+  so `hvdrun -np N python torch_script.py` works unchanged.
+* **Single-process staging into JAX**: `to_jax`/`from_torch` move tensors
+  between torch and jax (zero-copy DLPack when both sides share the
+  platform, numpy otherwise) so torch tensors can ride any jax collective
+  (e.g. stacked TPU allreduce) — the DLPack staging path of the north
+  star.
+
+Usage (mirrors `import horovod.torch as hvd`):
+
+    import horovod_tpu.interop.torch as hvd
+    hvd.init()
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvd.DistributedOptimizer(opt, named_parameters=model.named_parameters())
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+Average = "average"
+Sum = "sum"
+
+_comm = None
+_rank = 0
+_size = 1
+
+
+# -- lifecycle (basics.py init contract) ------------------------------------
+
+def init(comm_name: Optional[str] = None) -> None:
+    """Initialize from launcher env (HOROVOD_RANK/SIZE); single-process
+    fallback when unset. Multi-process needs the native shm library."""
+    global _comm, _rank, _size
+    _rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    _size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    if _size > 1:
+        from ..native.shm import ShmComm
+        gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
+        name = comm_name or \
+            f"hvd_torch_{os.environ.get('HOROVOD_JOB_ID', 'local')}"
+        _comm = ShmComm(name, _rank, _size, gen=gen)
+
+
+def shutdown() -> None:
+    global _comm
+    if _comm is not None:
+        _comm.close()
+        _comm = None
+
+
+def rank() -> int:
+    return _rank
+
+
+def size() -> int:
+    return _size
+
+
+def local_rank() -> int:
+    return int(os.environ.get("HOROVOD_LOCAL_RANK", _rank))
+
+
+def local_size() -> int:
+    return int(os.environ.get("HOROVOD_LOCAL_SIZE", _size))
+
+
+def is_initialized() -> bool:
+    return _size == 1 or _comm is not None
+
+
+# -- DLPack/numpy staging ---------------------------------------------------
+
+def to_jax(t) -> Any:
+    """torch.Tensor -> jax.Array, zero-copy via DLPack when possible."""
+    import jax
+    try:
+        return jax.dlpack.from_dlpack(t.detach())
+    except Exception:  # noqa: BLE001 — cross-platform: stage via numpy
+        return jax.numpy.asarray(t.detach().cpu().numpy())
+
+
+def from_jax(a, like=None):
+    """jax.Array -> torch.Tensor, zero-copy via DLPack when possible."""
+    import torch
+    try:
+        return torch.from_dlpack(a)
+    except Exception:  # noqa: BLE001
+        t = torch.from_numpy(np.asarray(a).copy())
+        return t.to(like.device) if like is not None else t
+
+
+# -- collectives (torch/mpi_ops.py surface, shm data plane) -----------------
+
+def _np_view(t) -> np.ndarray:
+    if not t.is_contiguous():
+        raise ValueError("horovod_tpu torch collectives require contiguous "
+                         "tensors")
+    return t.detach().numpy()
+
+
+def allreduce_(t, op: str = Average, name: Optional[str] = None):
+    """In-place allreduce (hvd.allreduce_, torch/mpi_ops.py:194)."""
+    if _size == 1:
+        return t
+    arr = _np_view(t)
+    np.copyto(arr, _comm.allreduce(arr, op="sum"))
+    if op == Average:
+        t /= _size
+    return t
+
+
+def allreduce(t, op: str = Average, name: Optional[str] = None):
+    out = t.clone()
+    return allreduce_(out, op=op, name=name)
+
+
+def allgather(t, name: Optional[str] = None):
+    """Concatenate along dim 0 across ranks (torch/mpi_ops.py:630)."""
+    import torch
+    if _size == 1:
+        return t.clone()
+    arr = _np_view(t)
+    gathered = _comm.allgather(np.ascontiguousarray(arr))
+    return torch.from_numpy(
+        gathered.reshape((_size * t.shape[0],) + tuple(t.shape[1:])))
+
+
+def broadcast_(t, root_rank: int = 0, name: Optional[str] = None):
+    if _size == 1:
+        return t
+    arr = _np_view(t)
+    np.copyto(arr, _comm.broadcast(arr, root=root_rank))
+    return t
+
+
+def broadcast(t, root_rank: int = 0, name: Optional[str] = None):
+    out = t.clone()
+    return broadcast_(out, root_rank=root_rank, name=name)
+
+
+def reducescatter(t, op: str = Average, name: Optional[str] = None):
+    import torch
+    if _size == 1:
+        return t.clone()
+    arr = np.ascontiguousarray(_np_view(t))
+    out = _comm.reducescatter(arr, op="sum")
+    res = torch.from_numpy(out.reshape((-1,) + tuple(t.shape[1:])))
+    if op == Average:
+        res /= _size
+    return res
+
+
+def barrier() -> None:
+    if _comm is not None:
+        _comm.barrier()
+
+
+# -- state sync (torch/functions.py) ----------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a state_dict or named_parameters iterable from root
+    (torch/functions.py broadcast_parameters)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = sorted(dict(params).items())
+    for _, p in items:
+        if hasattr(p, "data"):
+            p = p.data
+        broadcast_(p, root_rank=root_rank)   # byte-level, dtype-agnostic
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast optimizer hyper-state tensors from root
+    (torch/functions.py broadcast_optimizer_state)."""
+    import torch
+    for group in optimizer.param_groups:
+        for p in group["params"]:
+            st = optimizer.state.get(p, {})
+            for k in sorted(st):
+                v = st[k]
+                if isinstance(v, torch.Tensor) and v.numel() > 0:
+                    broadcast_(v.contiguous(), root_rank=root_rank)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    """Pickle-broadcast an arbitrary object (common/util broadcast_object)."""
+    import pickle
+    if _size == 1:
+        return obj
+    if _rank == root_rank:
+        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        n = np.array([blob.size], dtype=np.int64)
+    else:
+        blob = np.zeros(0, np.uint8)
+        n = np.zeros(1, dtype=np.int64)
+    n = _comm.broadcast(n, root=root_rank)
+    buf = blob if _rank == root_rank else np.zeros(int(n[0]), np.uint8)
+    buf = _comm.broadcast(buf, root=root_rank)
+    return pickle.loads(buf.tobytes())
+
+
+# -- optimizer wrapper (torch/optimizer.py) ---------------------------------
+
+class _DistributedOptimizer:
+    """Wraps a torch optimizer: step() first allreduces every grad
+    (the synchronize-then-step contract of torch/optimizer.py:255-324;
+    hook-free because the shm plane has no async queue to overlap with)."""
+
+    def __init__(self, optimizer, named_parameters=None, op: str = Average,
+                 backward_passes_per_step: int = 1,
+                 gradient_predivide_factor: float = 1.0) -> None:
+        self._opt = optimizer
+        self.op = op
+        self.backward_passes_per_step = int(backward_passes_per_step)
+        self.gradient_predivide_factor = float(gradient_predivide_factor)
+        self._pass_count = 0
+        if named_parameters is not None:
+            self._params = [p for _, p in named_parameters]
+        else:
+            self._params = [p for g in optimizer.param_groups
+                            for p in g["params"]]
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def synchronize(self) -> None:
+        for p in self._params:
+            if p.grad is not None:
+                if self.gradient_predivide_factor != 1.0:
+                    p.grad /= self.gradient_predivide_factor
+                allreduce_(p.grad, op=self.op)
+                if self.gradient_predivide_factor != 1.0:
+                    p.grad *= self.gradient_predivide_factor
+        self._pass_count = 0
+
+    def step(self, closure=None):
+        self._pass_count += 1
+        if self._pass_count >= self.backward_passes_per_step:
+            self.synchronize()
+            return self._opt.step(closure)
+        return None
+
+    def zero_grad(self, set_to_none: bool = False):
+        return self._opt.zero_grad(set_to_none=set_to_none)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         op: str = Average,
+                         backward_passes_per_step: int = 1,
+                         gradient_predivide_factor: float = 1.0
+                         ) -> _DistributedOptimizer:
+    """Factory mirroring hvd.DistributedOptimizer (torch/optimizer.py:516)."""
+    return _DistributedOptimizer(
+        optimizer, named_parameters, op, backward_passes_per_step,
+        gradient_predivide_factor)

@@ -1,0 +1,118 @@
+"""PyTorch interop tests.
+
+Mirrors test/parallel/test_torch.py's coverage shape (collectives,
+optimizer, parameter broadcast) for the torch binding: single-process
+semantics in-process, multi-process over the native shm data plane via
+spawned workers (the spark MultiprocessingJobRunner provides process
+isolation + rank env, standing in for horovodrun).
+"""
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+# -- single-process fallback ------------------------------------------------
+
+def test_single_process_identity():
+    import horovod_tpu.interop.torch as hvd
+    hvd.shutdown()
+    os.environ.pop("HOROVOD_RANK", None)
+    os.environ.pop("HOROVOD_SIZE", None)
+    hvd.init()
+    assert hvd.size() == 1 and hvd.rank() == 0
+    t = torch.randn(4, 3)
+    out = hvd.allreduce(t)
+    assert torch.equal(out, t)
+    assert torch.equal(hvd.broadcast(t, 0), t)
+    assert hvd.broadcast_object({"a": 1}) == {"a": 1}
+    hvd.shutdown()
+
+
+def test_jax_staging_roundtrip():
+    import horovod_tpu.interop.torch as hvd
+    t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+    a = hvd.to_jax(t)
+    assert a.shape == (3, 4)
+    back = hvd.from_jax(a)
+    assert torch.equal(back, t)
+
+
+def test_stacked_jax_collective_via_staging(hvd):
+    """Torch tensors ride the jax stacked allreduce through staging."""
+    import horovod_tpu.interop.torch as it
+    n = hvd.size()
+    t = torch.randn(n, 5)
+    out = it.from_jax(hvd.allreduce(it.to_jax(t), hvd.Sum))
+    np.testing.assert_allclose(out[0].numpy(), t.sum(0).numpy(), rtol=1e-4)
+
+
+# -- multi-process over the native shm plane --------------------------------
+
+def _torch_worker():
+    import torch
+    import horovod_tpu.interop.torch as hvd
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    # allreduce
+    t = torch.full((8,), float(r + 1))
+    hvd.allreduce_(t, op=hvd.Sum)
+    expect = sum(range(1, n + 1))
+    assert torch.allclose(t, torch.full((8,), float(expect))), t
+
+    # broadcast
+    b = torch.full((4,), float(r))
+    hvd.broadcast_(b, root_rank=1)
+    assert torch.allclose(b, torch.full((4,), 1.0)), b
+
+    # allgather
+    g = hvd.allgather(torch.full((2, 3), float(r)))
+    assert g.shape == (2 * n, 3)
+    assert torch.allclose(g[0], torch.zeros(3))
+    assert torch.allclose(g[-1], torch.full((3,), float(n - 1)))
+
+    # reducescatter (average)
+    rs = hvd.reducescatter(torch.full((2 * n,), float(r + 1)),
+                           op=hvd.Average)
+    assert rs.shape == (2,)
+    assert torch.allclose(rs, torch.full((2,), expect / n)), rs
+
+    # broadcast_object
+    obj = hvd.broadcast_object({"epoch": 7, "blob": list(range(50))},
+                               root_rank=0)
+    assert obj["epoch"] == 7 and len(obj["blob"]) == 50
+
+    # model + optimizer end-to-end: replicas converge identically
+    torch.manual_seed(100 + r)                     # diverged init
+    model = torch.nn.Linear(4, 2)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    torch.manual_seed(0)                           # same data every rank
+    x, y = torch.randn(16, 4), torch.randn(16, 2)
+    for _ in range(3):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+    w = model.weight.detach().numpy().copy()
+    ws = hvd.allgather(torch.from_numpy(w).reshape(1, -1))
+    for i in range(n):
+        np.testing.assert_allclose(ws[i].numpy(), ws[0].numpy(), rtol=1e-6)
+
+    hvd.shutdown()
+    return float(t[0])
+
+
+def test_torch_multiprocess_shm():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_torch_worker, num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [3.0, 3.0]
