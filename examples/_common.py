@@ -1,0 +1,12 @@
+"""Shared example bootstrap: optional virtual CPU mesh via HVD_EXAMPLE_CPU."""
+import os
+
+
+def maybe_cpu_mesh() -> None:
+    n = os.environ.get("HVD_EXAMPLE_CPU")
+    if n:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Compute-service input pipeline (reference:
+examples/tensorflow2/tensorflow2_mnist_data_service.py semantics): data
+preprocessing runs in compute workers; the training process streams ready
+batches.
+
+    HVD_EXAMPLE_CPU=8 python examples/data_service_example.py
+"""
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import numpy as np                                          # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+from horovod_tpu.data import (                              # noqa: E402
+    ComputeClient, ComputeService, ComputeWorker,
+)
+
+
+def make_dataset(worker_idx, num_workers, n_samples=512, batch=32):
+    """Each worker preprocesses its shard (simulated augmentation)."""
+    def fn():
+        rng = np.random.RandomState(worker_idx)
+        shard = n_samples // num_workers
+        for s in range(shard // batch):
+            x = rng.rand(batch, 28, 28, 1).astype(np.float32)
+            x = (x - x.mean()) / (x.std() + 1e-6)     # "preprocessing"
+            y = rng.randint(0, 10, (batch,)).astype(np.int32)
+            yield {"x": x, "y": y}
+    return fn
+
+
+def main() -> None:
+    hvd.init()
+    num_workers = 2
+
+    # normally these run in a separate compute job (CPU hosts); in the
+    # example they share the process
+    svc = ComputeService(num_workers=num_workers)
+    workers = [ComputeWorker(i, svc.config(),
+                             make_dataset(i, num_workers))
+               for i in range(num_workers)]
+    svc.wait_for_workers()
+
+    client = ComputeClient(svc.config())
+    n_batches, n_images = 0, 0
+    for batch in client.batches():
+        n_batches += 1
+        n_images += batch["x"].shape[0]
+    print(f"trained on {n_batches} served batches / {n_images} images "
+          f"from {num_workers} compute workers")
+
+    client.close()
+    for w in workers:
+        w.shutdown()
+    svc.shutdown()
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

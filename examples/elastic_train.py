@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Elastic training (reference:
+examples/elastic/pytorch/pytorch_mnist_elastic.py semantics): wrap the
+training loop with @hvd.elastic.run, keep progress in a State, commit
+every N batches; on reset the state rolls back to the last commit and
+training resumes.
+
+    HVD_EXAMPLE_CPU=8 python examples/elastic_train.py
+"""
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+import optax                                                # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+
+
+def main() -> None:
+    hvd.init()
+    n = hvd.size()
+
+    w0 = jnp.zeros((4,))
+    params = {"w": jnp.broadcast_to(w0[None], (n, 4))}
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    state = hvd.elastic.TrainState(
+        params=params, opt_state=opt.init(params), epoch=0, batch=0)
+
+    data = np.random.RandomState(0).randn(64, n, 4).astype(np.float32)
+
+    @hvd.elastic.run
+    def train(state):
+        opt_state, params = state.opt_state, state.params
+        for epoch in range(state.epoch, 3):
+            for b in range(state.batch, len(data)):
+                grads = {"w": jnp.asarray(data[b])}
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                if b % 16 == 0:
+                    state.params, state.opt_state = params, opt_state
+                    state.epoch, state.batch = epoch, b
+                    state.commit()        # checkpoint + sync point
+            state.batch = 0
+            if hvd.rank() == 0:
+                print(f"epoch {epoch} done; w[0]={float(params['w'][0,0]):.3f}")
+        state.params, state.opt_state = params, opt_state
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

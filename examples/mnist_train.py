@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""MNIST-style classifier training (reference:
+examples/tensorflow2/tensorflow2_keras_mnist.py semantics): Flax CNN,
+DistributedOptimizer, rank-0 state broadcast, LR warmup + metric-average
+callbacks. Uses synthetic digits unless --data points at an npz with
+x/y arrays (no dataset download in the example itself).
+
+    HVD_EXAMPLE_CPU=8 python examples/mnist_train.py --epochs 2
+"""
+import argparse
+
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import flax.linen as nn                                     # noqa: E402
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+import optax                                                # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+from horovod_tpu.callbacks import (                         # noqa: E402
+    LearningRate, LearningRateWarmupCallback, MetricAverageCallback,
+)
+from horovod_tpu.data import shard_indices                  # noqa: E402
+from horovod_tpu.training import cross_entropy_loss         # noqa: E402
+
+
+class CNN(nn.Module):
+    """Small MNIST CNN (kept light so the CPU-mesh demo runs quickly;
+    scale channels up freely on TPU)."""
+    features: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features * 2, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def load_data(path):
+    if path:
+        with np.load(path) as d:
+            return d["x"].astype(np.float32), d["y"].astype(np.int32)
+    rng = np.random.RandomState(0)
+    x = rng.rand(512, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, (512,)).astype(np.int32)
+    # make the synthetic task learnable: brightness encodes the label
+    x += y[:, None, None, None] / 10.0
+    return x, y
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-device batch size")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--data", default=None, help="npz with x/y arrays")
+    args = p.parse_args()
+
+    hvd.init()
+    n = hvd.size()
+    x, y = load_data(args.data)
+
+    model = CNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))[
+        "params"]
+    lr = LearningRate(args.lr)
+    opt = hvd.DistributedOptimizer(optax.adam(args.lr))
+
+    # replicate: stacked params, one row per device (SPMD data parallel)
+    params = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), params)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def forward_backward(params, xb, yb):
+        def loss_one(p, xr, yr):
+            return cross_entropy_loss(model.apply({"params": p}, xr), yr)
+
+        def total(ps):
+            return jax.vmap(loss_one)(ps, xb, yb).mean()
+        return jax.value_and_grad(total)(params)
+
+    warmup = LearningRateWarmupCallback(lr, warmup_epochs=1, verbose=False)
+    metric_avg = MetricAverageCallback()
+    global_bs = args.batch_size * n
+    steps = len(x) // global_bs
+    for epoch in range(args.epochs):
+        order = np.random.RandomState(epoch).permutation(len(x))
+        total_loss = 0.0
+        for s in range(steps):
+            warmup.on_batch_begin(s, epoch)
+            idx = order[s * global_bs:(s + 1) * global_bs]
+            xb = jnp.asarray(x[idx]).reshape(n, args.batch_size, 28, 28, 1)
+            yb = jnp.asarray(y[idx]).reshape(n, args.batch_size)
+            loss, grads = forward_backward(params, xb, yb)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            total_loss += float(loss)
+        logs = {"loss": total_loss / steps}
+        metric_avg.on_epoch_end(epoch, logs)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={logs['loss']:.4f} "
+                  f"lr={float(lr):.2e}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

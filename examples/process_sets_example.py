@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Process sets: collectives over device subgroups (reference:
+docs/process_set.rst usage; process_sets.py API).
+
+    HVD_EXAMPLE_CPU=8 python examples/process_sets_example.py
+"""
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import numpy as np                                          # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+
+
+def main() -> None:
+    hvd.init()
+    n = hvd.size()
+    assert n >= 4, "needs >= 4 devices (set HVD_EXAMPLE_CPU=8)"
+
+    even = hvd.add_process_set(list(range(0, n, 2)))
+    odd = hvd.add_process_set(list(range(1, n, 2)))
+
+    x = np.arange(n, dtype=np.float32)[:, None] + 1   # rank i -> i+1
+
+    full = np.asarray(hvd.allreduce(x, hvd.Sum))[0, 0]
+    ev = np.asarray(hvd.allreduce(x[0::2], hvd.Sum, process_set=even))[0, 0]
+    od = np.asarray(hvd.allreduce(x[1::2], hvd.Sum, process_set=odd))[0, 0]
+
+    print(f"global sum over {n} ranks: {full}")
+    print(f"even-set sum {even.ranks}: {ev}")
+    print(f"odd-set sum  {odd.ranks}: {od}")
+
+    hvd.remove_process_set(even)
+    hvd.remove_process_set(odd)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Synthetic data-parallel benchmark (reference:
+examples/pytorch/pytorch_synthetic_benchmark.py): random batches through a
+ResNet with the DistributedOptimizer train step; prints img/sec per
+iteration and the aggregate.
+
+    HVD_EXAMPLE_CPU=8 python examples/synthetic_benchmark.py --model resnet18
+"""
+import argparse
+import time
+
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+import optax                                                # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+from horovod_tpu.models.resnet import (                     # noqa: E402
+    ResNet18, ResNet50,
+)
+from horovod_tpu.training import (                          # noqa: E402
+    init_replicated, make_train_step, shard_batch,
+)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet18", "resnet50"])
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="per-device batch size")
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--num-iters", type=int, default=5)
+    p.add_argument("--num-warmup", type=int, default=2)
+    args = p.parse_args()
+
+    hvd.init()
+    mesh = hvd.core.basics.get_mesh()
+    n = hvd.size()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    per_dev = args.batch_size or (64 if on_tpu else 2)
+    hw = args.image_size or (224 if on_tpu else 64)
+    batch = per_dev * n
+
+    model = {"resnet18": ResNet18, "resnet50": ResNet50}[args.model](
+        num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, hw, hw, 3)), train=True)
+    params = init_replicated(variables["params"], mesh)
+    batch_stats = init_replicated(variables["batch_stats"], mesh)
+    step = make_train_step(model.apply, optax.sgd(0.01, momentum=0.9), mesh,
+                           has_batch_stats=True)
+    opt_state = init_replicated(step.init_opt_state(params), mesh)
+
+    rng = np.random.RandomState(0)
+    images = shard_batch(rng.rand(batch, hw, hw, 3).astype(np.float32), mesh)
+    labels = shard_batch(rng.randint(0, 1000, (batch,)).astype(np.int32),
+                         mesh)
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch {batch} ({per_dev}/device x {n})")
+
+    for _ in range(args.num_warmup):
+        params, opt_state, batch_stats, loss = step(
+            params, opt_state, batch_stats, images, labels)
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        params, opt_state, batch_stats, loss = step(
+            params, opt_state, batch_stats, images, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_secs.append(batch / dt)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {img_secs[-1]:.1f} img/sec total")
+    if hvd.rank() == 0:
+        print(f"Img/sec per device: {np.mean(img_secs) / n:.1f} "
+              f"+-{1.96 * np.std(img_secs) / n:.1f}")
+        print(f"Total img/sec on {n} device(s): {np.mean(img_secs):.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

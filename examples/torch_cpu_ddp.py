@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""PyTorch data-parallel training over the native shm plane (reference:
+examples/pytorch/pytorch_mnist.py shape). Launch with hvdrun:
+
+    python -m horovod_tpu.runner.launch -np 2 python examples/torch_cpu_ddp.py
+
+or standalone single-process: python examples/torch_cpu_ddp.py
+"""
+import numpy as np
+import torch
+
+import horovod_tpu.interop.torch as hvd
+
+
+def main() -> None:
+    hvd.init()
+    torch.manual_seed(1234 + hvd.rank())     # diverged init on purpose
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(16, 32), torch.nn.ReLU(), torch.nn.Linear(32, 2))
+    # rank 0's weights everywhere (examples convention: rank 0 is source)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+
+    rng = np.random.RandomState(hvd.rank())  # each rank its own shard
+    x = torch.from_numpy(rng.randn(256, 16).astype(np.float32))
+    y = torch.from_numpy((x.numpy().sum(1) > 0).astype(np.int64))
+
+    for epoch in range(3):
+        perm = torch.randperm(len(x))
+        total = 0.0
+        for s in range(0, len(x), 32):
+            idx = perm[s:s + 32]
+            opt.zero_grad()
+            loss = torch.nn.functional.cross_entropy(model(x[idx]), y[idx])
+            loss.backward()
+            opt.step()                        # grads allreduced here
+            total += float(loss)
+        avg = hvd.allreduce(torch.tensor([total / (len(x) // 32)]))
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: mean loss {float(avg):.4f} "
+                  f"across {hvd.size()} rank(s)")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Smoke-run the examples suite (the reference CI runs its examples as
+integration tests; .buildkite pipeline). Each runs as a subprocess on a
+virtual 8-device CPU mesh."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _run(script, *args, timeout=240):
+    env = dict(os.environ)
+    env["HVD_EXAMPLE_CPU"] = "8"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.path.dirname(EXAMPLES)
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=EXAMPLES)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+@pytest.mark.parametrize("script,args,expect", [
+    ("synthetic_benchmark.py", ["--model", "resnet18", "--num-iters", "2",
+                                "--num-warmup", "1"], "Total img/sec"),
+    ("mnist_train.py", ["--epochs", "1", "--batch-size", "8"], "epoch 0"),
+    ("gpt_hybrid_parallel.py", ["--steps", "1", "--seq-len", "64"],
+     "loss="),
+    ("elastic_train.py", [], "epoch 2 done"),
+    ("adasum_example.py", [], "Adasum"),
+    ("process_sets_example.py", [], "even-set sum"),
+    ("data_service_example.py", [], "served batches"),
+])
+def test_example_runs(script, args, expect):
+    out = _run(script, *args)
+    assert expect in out, f"{script} output missing {expect!r}:\n{out}"
+
+
+def test_torch_ddp_example_single_process():
+    env = dict(os.environ)
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = os.path.dirname(EXAMPLES)
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "torch_cpu_ddp.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=EXAMPLES)
+    assert r.returncode == 0, r.stderr
+    assert "mean loss" in r.stdout
