@@ -28,7 +28,8 @@ class GPTConfig:
                  head_dim=16, mlp_ratio=4, max_seq_len=512,
                  attention: str = "dense", mesh: Optional[Mesh] = None,
                  sp_axis: str = "sp", dp_axis: str = "dp",
-                 tp_axis: str = "tp", dtype=jnp.bfloat16):
+                 tp_axis: str = "tp", dtype=jnp.bfloat16,
+                 attention_impl: Optional[str] = None):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -42,6 +43,9 @@ class GPTConfig:
         self.dp_axis = dp_axis
         self.tp_axis = tp_axis
         self.dtype = dtype
+        # None = auto (pallas on TPU, reference elsewhere);
+        # "pallas" | "reference" | "interpret" to force
+        self.attention_impl = attention_impl
 
 
 class Attention(nn.Module):
@@ -69,7 +73,10 @@ class Attention(nn.Module):
                 in_specs=(spec, spec, spec), out_specs=spec,
             )(q, k, v)
         else:
-            o = sp_lib.attention_reference(q, k, v, causal=True)
+            # fused pallas kernel on TPU, dense reference elsewhere
+            from ..ops.pallas_attention import fused_attention
+            o = fused_attention(q, k, v, causal=True,
+                                force=cfg.attention_impl)
 
         o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.embed_dim)
         return nn.Dense(cfg.embed_dim, dtype=cfg.dtype,

@@ -1,0 +1,147 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+The hot op of the GPT model family (models/gpt.py). The pure-lax reference
+implementation (parallel/sp.py attention_reference) materializes the full
+[Sq, Skv] score matrix in HBM; this kernel streams K/V blocks through VMEM
+with the online-softmax recurrence, so HBM traffic is O(S*D) instead of
+O(S^2) and the matmuls hit the MXU at block size.
+
+Design (pallas_guide.md patterns):
+* grid = (batch*heads, Sq/block_q); each program owns one query block.
+* K/V for the (batch, head) live in VMEM whole (fits for the sequence
+  lengths the model targets; the block loop walks them in block_k chunks).
+* fp32 accumulation in the fori_loop carry; causal masking by global
+  position; the loop trip count shrinks for causal queries (no work on
+  fully-masked key blocks).
+* On non-TPU platforms the same kernel runs in interpret mode (tests), or
+  falls back to the dense reference via `fused_attention(..., force=...)`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                 block_q: int, block_k: int, seq_k: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    bq, d = q.shape
+
+    num_kb = seq_k // block_k
+    if causal:
+        # last key position this query block can see
+        last = (qi + 1) * block_q - 1
+        nkb = jnp.minimum(num_kb, (last // block_k) + 1)
+    else:
+        nkb = num_kb
+
+    def body(kb, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(
+            jnp.float32)                              # [bk, D]
+        v = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - safe_m[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(jnp.minimum(m - safe_m, 0.0))
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, corr)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, nkb, body, (o0, m0, l0))
+    o = o / jnp.maximum(l, 1e-20)[:, None]
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """[B, H, Sq, D] x [B, H, Skv, D] -> [B, H, Sq, D] fused attention."""
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    scale_ = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Skv)
+
+    # pad sequences to block multiples; padded keys are masked by position
+    pad_q = (-Sq) % block_q
+    pad_k = (-Skv) % block_k
+    if pad_k and not causal:
+        # non-causal can't rely on the causal mask to hide padded keys
+        raise ValueError(
+            f"non-causal flash attention needs Skv divisible by block_k "
+            f"({Skv} % {block_k}); pass a smaller block_k")
+    qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vv = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    Sq_p, Skv_p = Sq + pad_q, Skv + pad_k
+
+    qr = qq.reshape(B * H, Sq_p, D)
+    kr = kk.reshape(B * H, Skv_p, D)
+    vr = vv.reshape(B * H, Skv_p, D)
+
+    kernel = functools.partial(
+        _attn_kernel, scale=scale_, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=Skv_p)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq_p, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    out = out.reshape(B, H, Sq_p, D)
+    return out[:, :, :Sq] if pad_q else out
+
+
+def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: Optional[float] = None,
+                    force: Optional[str] = None) -> jax.Array:
+    """Dispatch: pallas kernel on TPU, dense reference elsewhere.
+
+    force: "pallas" | "reference" | "interpret" overrides the platform
+    check (tests use "interpret" to run the kernel on CPU).
+    """
+    mode = force
+    if mode is None:
+        mode = "pallas" if jax.devices()[0].platform == "tpu" \
+            else "reference"
+    if mode == "pallas":
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if mode == "interpret":
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               interpret=True)
+    from ..parallel.sp import attention_reference
+    return attention_reference(q, k, v, causal=causal, scale=scale)
