@@ -55,5 +55,9 @@ from .optim.functions import (                                 # noqa: F401
 
 from . import elastic                                          # noqa: F401
 from .runner.api import run                                    # noqa: F401
+from . import checkpoint                                       # noqa: F401
+from .checkpoint import (                                      # noqa: F401
+    Checkpointer, save_checkpoint, restore_checkpoint,
+)
 
 __version__ = "0.1.0"

@@ -29,7 +29,7 @@ NEG_INF = -1e30
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                 block_q: int, block_k: int, seq_k: int):
+                 block_q: int, block_k: int, seq_k: int, seq_k_actual: int):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
     bq, d = q.shape
@@ -51,12 +51,20 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
+        pad_keys = seq_k_actual != seq_k
+        if causal or pad_keys:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            mask = jnp.full((bq, block_k), True)
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                mask = q_pos >= k_pos
+            if pad_keys:
+                # zero-padded keys past the real Skv must never score,
+                # even for causal queries with q_pos >= Skv
+                mask = mask & (k_pos < seq_k_actual)
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - safe_m[:, None])
@@ -93,11 +101,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     # pad sequences to block multiples; padded keys are masked by position
     pad_q = (-Sq) % block_q
     pad_k = (-Skv) % block_k
-    if pad_k and not causal:
-        # non-causal can't rely on the causal mask to hide padded keys
-        raise ValueError(
-            f"non-causal flash attention needs Skv divisible by block_k "
-            f"({Skv} % {block_k}); pass a smaller block_k")
     qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
     kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
     vv = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
@@ -109,7 +112,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     kernel = functools.partial(
         _attn_kernel, scale=scale_, causal=causal,
-        block_q=block_q, block_k=block_k, seq_k=Skv_p)
+        block_q=block_q, block_k=block_k, seq_k=Skv_p, seq_k_actual=Skv)
     out = pl.pallas_call(
         kernel,
         grid=(B * H, Sq_p // block_q),

@@ -87,3 +87,19 @@ def test_gpt_with_interpret_kernel(hvd):
     out_pal = GPT(cfg_pal).apply({"params": params}, tokens)
     np.testing.assert_allclose(np.asarray(out_pal), np.asarray(out_ref),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_padded_kv_shorter_than_q(causal):
+    """Skv not divisible by block_k AND Skv < Sq: padded key positions must
+    never receive softmax weight (regression: causal queries past Skv used
+    to attend to the zero-padded keys)."""
+    r = np.random.RandomState(3)
+    q = jnp.asarray(r.randn(1, 2, 64, 8).astype(np.float32))
+    k = jnp.asarray(r.randn(1, 2, 33, 8).astype(np.float32))
+    v = jnp.asarray(r.randn(1, 2, 33, 8).astype(np.float32))
+    ref = attention_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
