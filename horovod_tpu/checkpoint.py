@@ -32,10 +32,14 @@ from .optim.functions import broadcast_object
 
 
 def _to_numpy_tree(tree: Any) -> Any:
-    """Device arrays -> host numpy (orbax handles both, but forcing numpy
-    makes rank-0-only writes safe when arrays are sharded)."""
+    """Fully-addressable device arrays -> host numpy so rank-0-only writes
+    are safe. Arrays spanning non-addressable devices (multi-host GSPMD)
+    are passed through unchanged — orbax coordinates those across all
+    participating processes itself."""
     return jax.tree_util.tree_map(
-        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree)
+        lambda x: np.asarray(x)
+        if isinstance(x, jax.Array) and x.is_fully_addressable else x,
+        tree)
 
 
 def _is_multiprocess() -> bool:
@@ -67,7 +71,13 @@ class Checkpointer:
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
-        self._is_writer = (not basics.is_initialized()) or basics.rank() == 0
+        # Rank 0 writes in the socket-coordinator multi-process mode (each
+        # process owns its devices). Under multi-host jax (process_count>1,
+        # GSPMD arrays span hosts) EVERY process must enter orbax save —
+        # orbax coordinates the distributed write itself.
+        self._is_writer = ((not basics.is_initialized())
+                           or basics.rank() == 0
+                           or jax.process_count() > 1)
         self._mgr = None
         if self._is_writer:
             os.makedirs(self.directory, exist_ok=True)
@@ -189,7 +199,11 @@ class FileBackedState(State):
     """
 
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
-                 async_save: bool = True, **kwargs):
+                 async_save: bool = False, **kwargs):
+        # async_save defaults OFF here: commit() must be durable — a crash
+        # right after commit() with a queued async write would lose exactly
+        # the state this class exists to preserve. Opt into async only if
+        # losing the most recent commit on preemption is acceptable.
         self._ckpt = Checkpointer(directory, max_to_keep=max_to_keep,
                                   async_save=async_save)
         self._commit_count = 0

@@ -1,0 +1,11 @@
+"""Model families: image classifiers (ResNet, ViT) and language models
+(GPT dense, MoE expert-parallel). All flax/linen, float32 params with
+bfloat16 compute, built for dp/tp/sp/ep meshes."""
+from .resnet import ResNet18, ResNet50          # noqa: F401
+from .gpt import GPT, GPTConfig                 # noqa: F401
+from .vit import (                              # noqa: F401
+    ViT, ViTConfig, ViT_S, ViT_B, ViT_Tiny, vit_partition_rules,
+)
+from .moe import (                              # noqa: F401
+    MoEGPT, MoEGPTConfig, moe_partition_rules, moe_aux_loss,
+)

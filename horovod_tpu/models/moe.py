@@ -1,0 +1,192 @@
+"""Mixture-of-Experts transformer over an expert-parallel mesh axis.
+
+The reference lists EP as "absent as a strategy; alltoall + process sets
+are the primitives an MoE implementation would use" (SURVEY §2.6,
+operations.cc:1904 alltoall). parallel/ep.py supplies those primitives
+TPU-natively (top-1 routing, capacity dispatch, lax.all_to_all across the
+'ep' axis); this module is the model family built on them: a GPT-style
+decoder whose MLPs are switch-style MoE layers.
+
+Execution modes:
+* `mesh` with an 'ep' axis of size > 1 — experts shard over 'ep'
+  (leading axis of the stacked expert weights), tokens all_to_all to
+  their experts inside shard_map, combine returns them (ep.moe_layer).
+* otherwise — all experts local, same routing math (ep.moe_reference),
+  so a single chip runs the identical model.
+
+Router load-balancing aux loss (Switch Transformer eq. 4) is sowed under
+("intermediates", "aux_loss"); `moe_aux_loss` sums it for the train step.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel import ep as ep_lib
+from ..parallel.tp import PartitionRules
+from .gpt import Attention
+
+
+class MoEGPTConfig:
+    def __init__(self, vocab_size=256, num_layers=2, num_heads=4,
+                 head_dim=16, mlp_ratio=4, max_seq_len=512,
+                 num_experts=4, capacity_factor=1.25,
+                 mesh: Optional[Mesh] = None, ep_axis: str = "ep",
+                 dp_axis: str = "dp", tp_axis: str = "tp",
+                 sp_axis: str = "sp", attention: str = "dense",
+                 dtype=jnp.bfloat16, attention_impl: Optional[str] = None):
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.embed_dim = num_heads * head_dim
+        self.mlp_dim = self.embed_dim * mlp_ratio
+        self.max_seq_len = max_seq_len
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.mesh = mesh
+        self.ep_axis = ep_axis
+        self.dp_axis = dp_axis
+        self.tp_axis = tp_axis
+        self.sp_axis = sp_axis
+        self.attention = attention
+        self.dtype = dtype
+        self.attention_impl = attention_impl
+
+    @property
+    def ep_size(self) -> int:
+        if self.mesh is None or self.ep_axis not in self.mesh.axis_names:
+            return 1
+        return self.mesh.shape[self.ep_axis]
+
+
+def _expert_fn(params, tokens):
+    """One expert's FFN: tokens [C, D] -> [C, D]; vmapped over experts."""
+    up_w, up_b, down_w, down_b = params
+    h = tokens @ up_w + up_b
+    h = nn.gelu(h)
+    return h @ down_w + down_b
+
+
+class MoEMLP(nn.Module):
+    """Switch-style MoE FFN; drop-in for the dense MLP in a Block."""
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, D = x.shape
+        E, M = cfg.num_experts, cfg.mlp_dim
+        router_w = self.param("router_kernel",
+                              nn.initializers.normal(0.02), (D, E),
+                              jnp.float32)
+        init = nn.initializers.lecun_normal()
+        up_w = self.param("up_kernel", init, (E, D, M), jnp.float32)
+        up_b = self.param("up_bias", nn.initializers.zeros, (E, M),
+                          jnp.float32)
+        down_w = self.param("down_kernel", init, (E, M, D), jnp.float32)
+        down_b = self.param("down_bias", nn.initializers.zeros, (E, D),
+                            jnp.float32)
+
+        x2 = x.reshape(B * S, D).astype(cfg.dtype)
+
+        # router logits computed ONCE in fp32 — used both for the aux loss
+        # and (passed down) for dispatch, so balance statistics and routing
+        # decisions can never diverge on near-tie tokens
+        logits = x2.astype(jnp.float32) @ router_w
+
+        # Switch load-balancing aux loss: E * sum_e(frac_tokens_e * mean_prob_e)
+        probs = jax.nn.softmax(logits, axis=-1)
+        frac = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), E,
+                                       dtype=jnp.float32), axis=0)
+        aux = E * jnp.sum(frac * probs.mean(axis=0))
+        self.sow("intermediates", "aux_loss", aux)
+
+        params = (up_w.astype(cfg.dtype), up_b.astype(cfg.dtype),
+                  down_w.astype(cfg.dtype), down_b.astype(cfg.dtype))
+        if cfg.ep_size > 1:
+            mesh = cfg.mesh
+            tok_axes = tuple(a for a in (cfg.dp_axis, cfg.ep_axis)
+                             if a in mesh.axis_names)
+            tok_spec = P(tok_axes if len(tok_axes) > 1 else tok_axes[0],
+                         None)
+            e_spec = jax.tree_util.tree_map(
+                lambda w: P(*((cfg.ep_axis,) + (None,) * (w.ndim - 1))),
+                params)
+
+            def _dispatch(xs, lg, ps):
+                return ep_lib.moe_layer(
+                    xs, None, _expert_fn, ps, axis_name=cfg.ep_axis,
+                    capacity_factor=cfg.capacity_factor, logits=lg)
+
+            y = jax.shard_map(
+                _dispatch,
+                mesh=mesh,
+                in_specs=(tok_spec, tok_spec, e_spec),
+                out_specs=tok_spec,
+            )(x2, logits, params)
+        else:
+            y = ep_lib.moe_reference(
+                x2, None, _expert_fn, params,
+                capacity_factor=cfg.capacity_factor, logits=logits)
+        return y.reshape(B, S, D).astype(cfg.dtype)
+
+
+class MoEBlock(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + Attention(cfg, name="attn")(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        return x + MoEMLP(cfg, name="moe")(h)
+
+
+class MoEGPT(nn.Module):
+    """Decoder LM: every block's FFN is expert-routed."""
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, S = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                       param_dtype=jnp.float32, name="pos_embed")(
+            jnp.arange(S)[None])
+        x = (x + pos).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = MoEBlock(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="lm_head")(x)
+
+
+def moe_aux_loss(intermediates: Any) -> jax.Array:
+    """Sum the sowed per-layer router aux losses (0.0 if none)."""
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(intermediates):
+        total = total + jnp.sum(leaf)
+    return jnp.asarray(total, jnp.float32)
+
+
+def moe_partition_rules(tp_axis: str = "tp",
+                        ep_axis: str = "ep") -> PartitionRules:
+    """GSPMD rules: experts shard on their leading E axis over 'ep';
+    attention follows Megatron TP; router replicated."""
+    return PartitionRules([
+        (r"moe/(up|down)_(kernel|bias)", P(ep_axis)),
+        (r"moe/router_kernel", P(None, None)),
+        (r"attn/qkv/kernel", P(None, tp_axis)),
+        (r"attn/out/kernel", P(tp_axis, None)),
+        (r"attn/qkv/bias", P(tp_axis)),
+        (r"embed/embedding", P(None, tp_axis)),
+        (r"lm_head/kernel", P(None, tp_axis)),
+    ])

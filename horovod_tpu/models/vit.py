@@ -1,0 +1,149 @@
+"""Vision Transformer, TPU-first.
+
+Second dense model family beside ResNet (models/resnet.py) and GPT
+(models/gpt.py). The reference frames its benchmarks around image
+classifiers (docs/benchmarks.rst: Inception V3 / ResNet-101 / VGG-16);
+ViT is the modern equivalent and maps better onto the MXU than VGG-era
+convs: patch embedding is one strided conv, everything after is large
+batched matmuls in bfloat16.
+
+Design notes:
+* pre-LN encoder blocks; fused (flash) attention kernel on TPU via
+  ops/pallas_attention.fused_attention (non-causal);
+* float32 params, bfloat16 activations (param_dtype/dtype split, same
+  convention as models/gpt.py);
+* mean-pool head by default (CLS token optional) — pooling keeps shapes
+  static and avoids the concat that breaks fused attention block sizes;
+* Megatron-style tensor-parallel partition rules in
+  `vit_partition_rules` mirror parallel/tp.py:gpt_partition_rules.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.tp import PartitionRules
+from jax.sharding import PartitionSpec as P
+
+
+class ViTConfig:
+    def __init__(self, image_size=224, patch_size=16, num_classes=1000,
+                 num_layers=12, num_heads=12, head_dim=64, mlp_ratio=4,
+                 pool: str = "mean", dtype=jnp.bfloat16,
+                 attention_impl: Optional[str] = None):
+        assert image_size % patch_size == 0
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.num_classes = num_classes
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.embed_dim = num_heads * head_dim
+        self.mlp_dim = self.embed_dim * mlp_ratio
+        self.num_patches = (image_size // patch_size) ** 2
+        self.pool = pool                    # "mean" | "cls"
+        self.dtype = dtype
+        # None = auto (pallas on TPU, dense reference elsewhere)
+        self.attention_impl = attention_impl
+
+
+class EncoderAttention(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        qkv = nn.Dense(3 * cfg.embed_dim, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="qkv")(x)
+        qkv = qkv.reshape(B, S, 3, cfg.num_heads, cfg.head_dim)
+        q, k, v = [qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3)]
+        from ..ops.pallas_attention import fused_attention
+        o = fused_attention(q, k, v, causal=False,
+                            force=cfg.attention_impl)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.embed_dim)
+        return nn.Dense(cfg.embed_dim, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="out")(o)
+
+
+class EncoderBlock(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + EncoderAttention(cfg, name="attn")(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.embed_dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_down")(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        cfg = self.cfg
+        B = images.shape[0]
+        p = cfg.patch_size
+        # patchify: one strided conv = a single big matmul on the MXU
+        x = nn.Conv(cfg.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=cfg.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.embed_dim)              # [B, N, D]
+        S = x.shape[1]
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, cfg.num_patches, cfg.embed_dim), jnp.float32)
+        x = x + pos[:, :S].astype(cfg.dtype)
+        if cfg.pool == "cls":
+            cls = self.param("cls", nn.initializers.zeros,
+                             (1, 1, cfg.embed_dim), jnp.float32)
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls.astype(cfg.dtype),
+                                  (B, 1, cfg.embed_dim)), x], axis=1)
+        for i in range(cfg.num_layers):
+            x = EncoderBlock(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = x[:, 0] if cfg.pool == "cls" else x.mean(axis=1)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)
+
+
+def vit_partition_rules(tp_axis: str = "tp") -> PartitionRules:
+    """Megatron-style TP rules for the ViT encoder (column-parallel qkv/up,
+    row-parallel out/down), matching parallel/tp.py:gpt_partition_rules."""
+    return PartitionRules([
+        (r"attn/qkv/kernel", P(None, tp_axis)),
+        (r"attn/out/kernel", P(tp_axis, None)),
+        (r"mlp_up/kernel", P(None, tp_axis)),
+        (r"mlp_down/kernel", P(tp_axis, None)),
+        (r"attn/qkv/bias", P(tp_axis)),
+        (r"mlp_up/bias", P(tp_axis)),
+    ])
+
+
+# -- presets ---------------------------------------------------------------
+
+def ViT_S(num_classes: int = 1000, **kw) -> ViT:
+    return ViT(ViTConfig(num_classes=num_classes, num_layers=12,
+                         num_heads=6, head_dim=64, **kw))
+
+
+def ViT_B(num_classes: int = 1000, **kw) -> ViT:
+    return ViT(ViTConfig(num_classes=num_classes, num_layers=12,
+                         num_heads=12, head_dim=64, **kw))
+
+
+def ViT_Tiny(num_classes: int = 10, **kw) -> ViT:
+    """Small enough for CPU-mesh tests."""
+    kw.setdefault("image_size", 32)
+    kw.setdefault("patch_size", 8)
+    return ViT(ViTConfig(num_classes=num_classes, num_layers=2,
+                         num_heads=2, head_dim=8, **kw))

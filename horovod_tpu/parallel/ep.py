@@ -39,13 +39,19 @@ def top1_route(logits: jax.Array, num_experts: int, capacity: int
 
 def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
               expert_params, *, axis_name: str = "ep",
-              capacity_factor: float = 1.25) -> jax.Array:
+              capacity_factor: float = 1.25,
+              logits: jax.Array = None) -> jax.Array:
     """Expert-parallel MoE for use inside shard_map.
 
     x: local tokens [T_local, D]. `expert_params` are the LOCAL experts'
     parameters, stacked on a leading axis [E_local, ...]. Global expert
     count = E_local * ep_size. Dispatch crosses the 'ep' axis via
     all_to_all; combine returns by the reverse all_to_all.
+
+    Pass precomputed fp32 `logits` [T_local, E] to route on exactly the
+    values a caller also uses for the load-balancing aux loss (avoids a
+    second router matmul and bf16/fp32 divergence on near-tie tokens);
+    `router_w` is ignored then and may be None.
     """
     n = lax.psum(1, axis_name)
     T, D = x.shape
@@ -53,7 +59,8 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
     E = e_local * n
     capacity = max(1, int(capacity_factor * T / E))
 
-    logits = x @ router_w                                       # [T, E]
+    if logits is None:
+        logits = x @ router_w                                   # [T, E]
     dispatch, combine = top1_route(logits, E, capacity)
 
     # token buffers per global expert: [E, C, D]
@@ -79,12 +86,13 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
 
 
 def moe_reference(x, router_w, expert_fn, all_expert_params,
-                  capacity_factor: float = 1.25):
+                  capacity_factor: float = 1.25, logits=None):
     """Single-device oracle: same routing/capacity, all experts local."""
     T, D = x.shape
     E = jax.tree_util.tree_leaves(all_expert_params)[0].shape[0]
     capacity = max(1, int(capacity_factor * T / E))
-    logits = x @ router_w
+    if logits is None:
+        logits = x @ router_w
     dispatch, combine = top1_route(logits, E, capacity)
     buffers = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     out = jax.vmap(expert_fn)(all_expert_params, buffers.astype(x.dtype))

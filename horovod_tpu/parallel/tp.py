@@ -51,11 +51,29 @@ class PartitionRules:
         return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def _restrict_spec(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes the rule names but this mesh doesn't have, so one
+    rule set serves every mesh shape (a dp-only mesh simply replicates the
+    tp/ep-sharded dims)."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return P(*(keep(e) for e in spec))
+
+
 def shard_params(params: Any, mesh: Mesh, rules: PartitionRules) -> Any:
     """Place a parameter pytree according to the rules."""
     specs = rules.tree_specs(params)
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        lambda x, s: jax.device_put(
+            x, NamedSharding(mesh, _restrict_spec(s, mesh))),
         params, specs)
 
 
@@ -63,7 +81,7 @@ def param_shardings(params: Any, mesh: Mesh, rules: PartitionRules) -> Any:
     """NamedSharding pytree (for jit in_shardings/out_shardings)."""
     specs = rules.tree_specs(params)
     return jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), specs,
+        lambda s: NamedSharding(mesh, _restrict_spec(s, mesh)), specs,
         is_leaf=lambda x: isinstance(x, P))
 
 

@@ -103,6 +103,8 @@ def make_gspmd_train_step(
     *,
     batch_spec: P = None,
     loss_fn: Callable = cross_entropy_loss,
+    aux_loss_fn: Callable = None,
+    aux_loss_weight: float = 0.01,
 ):
     """Build a jitted hybrid-parallel (dp/tp/sp) train step via GSPMD.
 
@@ -112,6 +114,11 @@ def make_gspmd_train_step(
     psums, tp row-parallel psums, sp attention comms (via the model's
     shard_map). This is the scaling-book path — the in-graph analog of the
     reference's DistributedOptimizer+XLA-custom-call overlap.
+
+    `aux_loss_fn(intermediates) -> scalar` (e.g. models.moe.moe_aux_loss)
+    adds `aux_loss_weight` times the model's sowed auxiliary losses to the
+    objective; without it flax silently drops sowed values, so MoE routers
+    would get no load-balancing gradient.
     """
     if batch_spec is None:
         axes = mesh.axis_names
@@ -123,6 +130,12 @@ def make_gspmd_train_step(
         tokens = jax.lax.with_sharding_constraint(tokens, batch_sh)
 
         def compute_loss(p):
+            if aux_loss_fn is not None:
+                logits, mut = apply_fn({"params": p}, tokens,
+                                       mutable=["intermediates"])
+                return (loss_fn(logits, targets)
+                        + aux_loss_weight
+                        * aux_loss_fn(mut["intermediates"]))
             logits = apply_fn({"params": p}, tokens)
             return loss_fn(logits, targets)
 

@@ -1,0 +1,150 @@
+"""Model-family tests: ViT (image encoder) and MoE-GPT (expert-parallel LM).
+
+ViT and MoE extend the model zoo beyond ResNet/GPT; the MoE tests exercise
+the ep-axis all_to_all dispatch (parallel/ep.py) end to end through a real
+GSPMD train step — the strategy the reference only provides primitives for
+(SURVEY §2.6)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.models.moe import (MoEGPT, MoEGPTConfig, moe_aux_loss,
+                                    moe_partition_rules)
+from horovod_tpu.models.vit import ViT_Tiny, ViTConfig, ViT, \
+    vit_partition_rules
+from horovod_tpu.parallel.mesh_utils import make_mesh
+from horovod_tpu.parallel.tp import shard_params
+
+
+class TestViT:
+    def _tiny(self, **kw):
+        kw.setdefault("attention_impl", "reference")
+        return ViT_Tiny(num_classes=10, dtype=jnp.float32, **kw)
+
+    def test_forward_shape_finite(self):
+        model = self._tiny()
+        imgs = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3),
+                           jnp.float32)
+        v = model.init(jax.random.PRNGKey(0), imgs)
+        out = model.apply(v, imgs)
+        assert out.shape == (2, 10)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_cls_pool_matches_shape(self):
+        cfg = ViTConfig(image_size=32, patch_size=8, num_classes=5,
+                        num_layers=1, num_heads=2, head_dim=8, pool="cls",
+                        dtype=jnp.float32, attention_impl="reference")
+        model = ViT(cfg)
+        imgs = jnp.zeros((3, 32, 32, 3))
+        v = model.init(jax.random.PRNGKey(0), imgs)
+        assert model.apply(v, imgs).shape == (3, 5)
+
+    def test_dp_train_step_learns(self, hvd):
+        from horovod_tpu.training import (init_replicated, make_train_step,
+                                          shard_batch)
+        mesh = hvd.core.basics.get_mesh()
+        model = self._tiny()
+        r = np.random.RandomState(0)
+        imgs = r.rand(16, 32, 32, 3).astype(np.float32)
+        lbls = r.randint(0, 10, (16,)).astype(np.int32)
+        v = model.init(jax.random.PRNGKey(0), jnp.asarray(imgs[:1]))
+        params = init_replicated(v["params"], mesh)
+        tx = optax.adam(1e-3)
+        step = make_train_step(model.apply, tx, mesh)
+        opt = init_replicated(step.init_opt_state(params), mesh)
+        xi, yi = shard_batch(imgs, mesh), shard_batch(lbls, mesh)
+        params, opt, _, l1 = step(params, opt, {}, xi, yi)
+        for _ in range(3):
+            params, opt, _, l2 = step(params, opt, {}, xi, yi)
+        assert float(l2) < float(l1)
+
+    def test_tp_partition_rules_forward(self, hvd):
+        mesh = make_mesh(dp=4, tp=2)
+        model = self._tiny()
+        imgs = jnp.zeros((4, 32, 32, 3))
+        v = model.init(jax.random.PRNGKey(0), imgs)
+        sharded = shard_params(v["params"], mesh, vit_partition_rules())
+        qkv = sharded["layers_0"]["attn"]["qkv"]["kernel"]
+        assert qkv.sharding.spec == P(None, "tp")
+        out = jax.jit(lambda p, x: model.apply({"params": p}, x))(
+            sharded, imgs)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestMoEGPT:
+    def _cfg(self, **kw):
+        kw.setdefault("vocab_size", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("head_dim", 8)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("dtype", jnp.float32)
+        kw.setdefault("attention_impl", "reference")
+        return MoEGPTConfig(**kw)
+
+    def test_single_device_forward(self):
+        model = MoEGPT(self._cfg())
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 64, (2, 16)), jnp.int32)
+        v = model.init(jax.random.PRNGKey(0), toks)
+        out = model.apply(v, toks)
+        assert out.shape == (2, 16, 64)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_aux_loss_sowed(self):
+        model = MoEGPT(self._cfg())
+        toks = jnp.zeros((2, 8), jnp.int32)
+        v = model.init(jax.random.PRNGKey(0), toks)
+        _, mut = model.apply(v, toks, mutable=["intermediates"])
+        aux = moe_aux_loss(mut["intermediates"])
+        # balanced-routing lower bound is 1.0 (Switch eq. 4)
+        assert float(aux) >= 2.0 * 0.99  # 2 layers x >= ~1.0 each
+
+    def test_ep_mesh_train_step_learns(self, hvd):
+        """dp=2 x ep=4: experts sharded over ep, tokens all_to_all'd."""
+        mesh = make_mesh(dp=2, ep=4)
+        cfg = self._cfg(mesh=mesh)
+        model = MoEGPT(cfg)
+        r = np.random.RandomState(0)
+        toks = jnp.asarray(r.randint(0, 64, (4, 16)), jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        v = model.init(jax.random.PRNGKey(0), toks)
+        rules = moe_partition_rules()
+        params = shard_params(v["params"], mesh, rules)
+        up = params["layers_0"]["moe"]["up_kernel"]
+        assert up.sharding.spec == P("ep")
+        from horovod_tpu.training import make_gspmd_train_step
+        tx = optax.adam(1e-2)
+        opt = tx.init(params)
+        step = make_gspmd_train_step(
+            model.apply, tx, mesh, rules,
+            batch_spec=P("dp", None),
+            aux_loss_fn=moe_aux_loss)
+        params, opt, l1 = step(params, opt, toks, tgts)
+        for _ in range(3):
+            params, opt, l2 = step(params, opt, toks, tgts)
+        assert np.isfinite(float(l2))
+        assert float(l2) < float(l1)
+
+    def test_ep_matches_local_when_capacity_ample(self, hvd):
+        """With generous capacity and identical per-shard routing inputs,
+        the distributed dispatch must agree with the all-local oracle on
+        token outputs that were not dropped by either."""
+        mesh = make_mesh(dp=2, ep=4)
+        # capacity_factor == num_experts => capacity == all local tokens,
+        # so neither path can drop and outputs must agree exactly
+        cfg_d = self._cfg(mesh=mesh, num_layers=1, capacity_factor=4.0)
+        cfg_l = self._cfg(num_layers=1, capacity_factor=4.0)
+        model_d, model_l = MoEGPT(cfg_d), MoEGPT(cfg_l)
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, 64, (4, 8)), jnp.int32)
+        v = model_l.init(jax.random.PRNGKey(0), toks)
+        out_l = model_l.apply(v, toks)
+        out_d = model_d.apply(v, toks)
+        np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_l),
+                                   rtol=2e-3, atol=2e-3)
