@@ -48,6 +48,15 @@ def _is_multiprocess() -> bool:
     return basics.is_initialized() and basics.get_coordinator() is not None
 
 
+def _needs_rank0_fanout() -> bool:
+    """Rank-0-reads-then-broadcast applies only in socket-coordinator mode
+    where each process runs its own jax. Under multi-host jax
+    (process_count > 1) every process restores via orbax's coordinated
+    reader itself, and broadcasting would re-ship (or fail to pickle
+    GSPMD-sharded) trees."""
+    return _is_multiprocess() and jax.process_count() == 1
+
+
 def _barrier_if_multiprocess() -> None:
     if _is_multiprocess():
         basics.get_coordinator().barrier("checkpoint")
@@ -111,13 +120,13 @@ class Checkpointer:
             step = self._mgr.latest_step()
         else:
             step = None
-        if _is_multiprocess():
+        if _needs_rank0_fanout():
             step = broadcast_object(step, 0)
         return step
 
     def all_steps(self):
         steps = sorted(self._mgr.all_steps()) if self._mgr is not None else []
-        if _is_multiprocess():
+        if _needs_rank0_fanout():
             steps = broadcast_object(steps, 0)
         return steps
 
@@ -141,7 +150,7 @@ class Checkpointer:
             else:
                 tree = self._mgr.restore(
                     int(step), args=self._ocp.args.StandardRestore())
-        if _is_multiprocess():
+        if _needs_rank0_fanout():
             tree = broadcast_object(tree, 0)
         return tree
 

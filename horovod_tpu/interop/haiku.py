@@ -1,0 +1,132 @@
+"""dm-haiku front end: distributed train steps for hk.transform models.
+
+The reference ships one binding per framework a user might already hold
+their model in (horovod/tensorflow, /torch, /mxnet, /keras — SURVEY §2.3).
+On the JAX side of the fence the ecosystem splits the same way into
+flax.linen (training.py, models/) and dm-haiku; this module is the haiku
+binding. haiku's pure (init, apply) pairs are already the functional shape
+the engine wants, so the binding is thin: a train-step builder that
+threads rng/state through `apply` and reduces gradients in-graph with
+DistributedOptimizer — the same wrap-the-optimizer contract as
+horovod.torch.DistributedOptimizer (torch/optimizer.py:516).
+
+    import haiku as hk, horovod_tpu as hvd
+    import horovod_tpu.interop.haiku as hvd_hk
+    net = hk.transform(lambda x: hk.nets.MLP([64, 10])(x))
+    step = hvd_hk.make_train_step(net, optax.adam(1e-3), mesh,
+                                  loss_fn=my_loss)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.mesh import GLOBAL_AXIS
+from ..core.types import ReduceOp
+from ..optim.functions import broadcast_parameters  # noqa: F401 (re-export)
+from ..optim.optimizer import DistributedOptimizer
+
+
+def make_train_step(
+    transformed: Any,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    *,
+    loss_fn: Callable,
+    axis_name: str = GLOBAL_AXIS,
+    has_state: bool = False,
+    compression=None,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    backward_passes_per_step: int = 1,
+    donate: bool = True,
+):
+    """Data-parallel train step for a haiku-transformed model.
+
+    `transformed` is `hk.transform(...)` (then `has_state=False`; returns
+    `step(params, opt_state, rng, x, y) -> (params, opt_state, loss)`) or
+    `hk.transform_with_state(...)` (`has_state=True`; returns
+    `step(params, hk_state, opt_state, rng, x, y) ->
+    (params, hk_state, opt_state, loss)`; non-trainable state is averaged
+    cross-replica, the SyncBatchNorm behavior of the reference,
+    torch/sync_batch_norm.py:40).
+
+    `loss_fn(outputs, y) -> scalar`. Params/opt state replicated, batch
+    sharded over `axis_name`, gradients reduced in-graph.
+    """
+    from ..optim.compression import Compression
+    dist_opt = DistributedOptimizer(
+        optimizer, axis_name=axis_name, op=op,
+        compression=compression or Compression.none,
+        backward_passes_per_step=backward_passes_per_step)
+
+    if has_state:
+        def local_step(params, hk_state, opt_state, rng, x, y):
+            def compute(p):
+                out, new_state = transformed.apply(p, hk_state, rng, x)
+                return loss_fn(out, y), new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                compute, has_aux=True)(params)
+            updates, new_opt = dist_opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            new_state = jax.tree_util.tree_map(
+                lambda s: lax.pmean(s, axis_name), new_state)
+            return params, new_state, new_opt, lax.pmean(loss, axis_name)
+
+        repl, sh = P(), P(axis_name)
+        smapped = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(repl, repl, repl, repl, sh, sh),
+            out_specs=(repl, repl, repl, repl))
+        step = jax.jit(smapped,
+                       donate_argnums=(0, 1, 2) if donate else ())
+    else:
+        def local_step(params, opt_state, rng, x, y):
+            def compute(p):
+                out = transformed.apply(p, rng, x)
+                return loss_fn(out, y)
+
+            loss, grads = jax.value_and_grad(compute)(params)
+            updates, new_opt = dist_opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_opt, lax.pmean(loss, axis_name)
+
+        repl, sh = P(), P(axis_name)
+        smapped = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(repl, repl, repl, sh, sh),
+            out_specs=(repl, repl, repl))
+        step = jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+    step.init_opt_state = dist_opt.init
+    return step
+
+
+def make_eval_step(transformed: Any, mesh, *,
+                   metric_fn: Callable,
+                   axis_name: str = GLOBAL_AXIS,
+                   has_state: bool = False):
+    """Jitted eval: batch sharded, metric pmean-averaged cross-replica
+    (the MetricAverageCallback contract, _keras/callbacks.py:62-106)."""
+    if has_state:
+        def local_eval(params, hk_state, rng, x, y):
+            out, _ = transformed.apply(params, hk_state, rng, x)
+            return lax.pmean(metric_fn(out, y), axis_name)
+
+        return jax.jit(jax.shard_map(
+            local_eval, mesh=mesh,
+            in_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
+            out_specs=P()))
+
+    def local_eval(params, rng, x, y):
+        out = transformed.apply(params, rng, x)
+        return lax.pmean(metric_fn(out, y), axis_name)
+
+    return jax.jit(jax.shard_map(
+        local_eval, mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P(axis_name)),
+        out_specs=P()))

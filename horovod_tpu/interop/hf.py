@@ -1,0 +1,134 @@
+"""HuggingFace transformers (Flax) integration: distributed fine-tuning.
+
+The reference's bindings exist so users keep their framework-native model
+objects and only swap the optimizer (SURVEY §2.3). The modern analog of
+"my model is already defined elsewhere" is a HF `FlaxPreTrainedModel`;
+this module data-parallelizes its fine-tune loop over the mesh with the
+same wrap-the-optimizer contract (torch/optimizer.py:516) and
+broadcast-initial-state convention (torch/functions.py).
+
+    from transformers import FlaxBertForSequenceClassification
+    import horovod_tpu.interop.hf as hvd_hf
+    model = FlaxBertForSequenceClassification.from_pretrained(...)
+    step = hvd_hf.make_finetune_step(model, optax.adamw(2e-5), mesh)
+    params = model.params
+    for batch in loader:   # dict with input_ids/attention_mask/labels
+        params, opt_state, loss = step(params, opt_state, rng, batch)
+
+Imports of `transformers` are deferred so the rest of the framework works
+without it installed (the reference gates frameworks the same way,
+setup.py:43-48).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.mesh import GLOBAL_AXIS
+from ..core.types import ReduceOp
+from ..optim.functions import broadcast_parameters  # noqa: F401 (re-export)
+from ..optim.optimizer import DistributedOptimizer
+
+
+def hf_available() -> bool:
+    try:
+        import transformers  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def sequence_classification_loss(logits: jax.Array,
+                                 labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def causal_lm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Shifted next-token cross entropy (the HF run_clm convention)."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], labels[:, 1:]).mean()
+
+
+def make_finetune_step(
+    model: Any,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    *,
+    loss_fn: Callable = sequence_classification_loss,
+    axis_name: str = GLOBAL_AXIS,
+    label_key: str = "labels",
+    train: bool = True,
+    compression=None,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    donate: bool = True,
+):
+    """Data-parallel fine-tune step for a FlaxPreTrainedModel.
+
+    Returns `step(params, opt_state, rng, batch) ->
+    (params, opt_state, loss)`. `batch` is a dict of arrays; `label_key`
+    is split off as the target, the rest are passed to the model
+    (input_ids, attention_mask, ...). Every batch value is sharded over
+    `axis_name`; params/opt state are replicated; gradients reduce
+    in-graph via DistributedOptimizer.
+    """
+    from ..optim.compression import Compression
+    dist_opt = DistributedOptimizer(
+        optimizer, axis_name=axis_name, op=op,
+        compression=compression or Compression.none)
+
+    def local_step(params, opt_state, rng, inputs, labels):
+        def compute(p):
+            outputs = model(**inputs, params=p, train=train,
+                            dropout_rng=rng if train else None)
+            return loss_fn(outputs.logits, labels)
+
+        loss, grads = jax.value_and_grad(compute)(params)
+        updates, opt_state = dist_opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, lax.pmean(loss, axis_name)
+
+    repl, sh = P(), P(axis_name)
+    smapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(repl, repl, repl, sh, sh),
+        out_specs=(repl, repl, repl))
+    jitted = jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+    def step(params, opt_state, rng, batch):
+        inputs = {k: v for k, v in batch.items() if k != label_key}
+        return jitted(params, opt_state, rng, inputs, batch[label_key])
+
+    step.init_opt_state = dist_opt.init
+    return step
+
+
+def make_eval_step(model: Any, mesh, *,
+                   metric_fn: Callable = None,
+                   axis_name: str = GLOBAL_AXIS,
+                   label_key: str = "labels"):
+    """Jitted distributed eval: accuracy by default, pmean-averaged."""
+    if metric_fn is None:
+        def metric_fn(logits, labels):
+            return jnp.mean(
+                (jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+    def local_eval(params, inputs, labels):
+        outputs = model(**inputs, params=params, train=False)
+        return lax.pmean(metric_fn(outputs.logits, labels), axis_name)
+
+    jitted = jax.jit(jax.shard_map(
+        local_eval, mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name)),
+        out_specs=P()))
+
+    def evaluate(params, batch):
+        inputs = {k: v for k, v in batch.items() if k != label_key}
+        return jitted(params, inputs, batch[label_key])
+
+    return evaluate

@@ -49,7 +49,10 @@ class GPTConfig:
 
 
 class Attention(nn.Module):
+    """Multi-head attention; `causal=False` makes it the encoder flavor
+    (shared with models/vit.py)."""
     cfg: Any
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -68,14 +71,14 @@ class Attention(nn.Module):
             h_ax = cfg.tp_axis if cfg.tp_axis in mesh_axes else None
             spec = P(b_ax, h_ax, cfg.sp_axis, None)
             o = jax.shard_map(
-                partial(attn, axis_name=cfg.sp_axis, causal=True),
+                partial(attn, axis_name=cfg.sp_axis, causal=self.causal),
                 mesh=cfg.mesh,
                 in_specs=(spec, spec, spec), out_specs=spec,
             )(q, k, v)
         else:
             # fused pallas kernel on TPU, dense reference elsewhere
             from ..ops.pallas_attention import fused_attention
-            o = fused_attention(q, k, v, causal=True,
+            o = fused_attention(q, k, v, causal=self.causal,
                                 force=cfg.attention_impl)
 
         o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.embed_dim)

@@ -25,6 +25,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..parallel.tp import PartitionRules
+from .gpt import MLP, Attention
 from jax.sharding import PartitionSpec as P
 
 
@@ -47,42 +48,23 @@ class ViTConfig:
         self.dtype = dtype
         # None = auto (pallas on TPU, dense reference elsewhere)
         self.attention_impl = attention_impl
-
-
-class EncoderAttention(nn.Module):
-    cfg: Any
-
-    @nn.compact
-    def __call__(self, x):
-        cfg = self.cfg
-        B, S, _ = x.shape
-        qkv = nn.Dense(3 * cfg.embed_dim, dtype=cfg.dtype,
-                       param_dtype=jnp.float32, name="qkv")(x)
-        qkv = qkv.reshape(B, S, 3, cfg.num_heads, cfg.head_dim)
-        q, k, v = [qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3)]
-        from ..ops.pallas_attention import fused_attention
-        o = fused_attention(q, k, v, causal=False,
-                            force=cfg.attention_impl)
-        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.embed_dim)
-        return nn.Dense(cfg.embed_dim, dtype=cfg.dtype,
-                        param_dtype=jnp.float32, name="out")(o)
+        # gpt.Attention contract (dense path; no sp for images)
+        self.attention = "dense"
+        self.mesh = None
+        self.dp_axis, self.tp_axis, self.sp_axis = "dp", "tp", "sp"
 
 
 class EncoderBlock(nn.Module):
+    """Pre-LN encoder block: gpt.Attention (causal=False) + gpt.MLP."""
     cfg: Any
 
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + EncoderAttention(cfg, name="attn")(h)
+        x = x + Attention(cfg, causal=False, name="attn")(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="mlp_up")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(cfg.embed_dim, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="mlp_down")(h)
-        return x + h
+        return x + MLP(cfg, name="mlp")(h)
 
 
 class ViT(nn.Module):
@@ -122,10 +104,10 @@ def vit_partition_rules(tp_axis: str = "tp") -> PartitionRules:
     return PartitionRules([
         (r"attn/qkv/kernel", P(None, tp_axis)),
         (r"attn/out/kernel", P(tp_axis, None)),
-        (r"mlp_up/kernel", P(None, tp_axis)),
-        (r"mlp_down/kernel", P(tp_axis, None)),
+        (r"mlp/up/kernel", P(None, tp_axis)),
+        (r"mlp/down/kernel", P(tp_axis, None)),
         (r"attn/qkv/bias", P(tp_axis)),
-        (r"mlp_up/bias", P(tp_axis)),
+        (r"mlp/up/bias", P(tp_axis)),
     ])
 
 

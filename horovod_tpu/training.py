@@ -151,7 +151,12 @@ def make_gspmd_train_step(
 
 
 def init_replicated(tree: Any, mesh: Mesh) -> Any:
-    """Pin a pytree to the replicated sharding of `mesh`."""
+    """Pin a pytree to the replicated sharding of `mesh`.
+
+    Note: device_put may alias the source buffers (e.g. CPU -> CPU mesh),
+    and the train steps donate their param/opt arguments — so treat the
+    ORIGINAL tree as consumed once its replicated copy has been through a
+    donating step."""
     repl = NamedSharding(mesh, P())
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
 

@@ -1,0 +1,147 @@
+"""Framework-binding tests: dm-haiku and HF transformers (Flax) front ends.
+
+The reference's per-framework binding tests live in test/parallel/
+test_torch.py / test_tensorflow.py etc.; these cover the JAX-ecosystem
+equivalents (flax is native, haiku + HF are bindings)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.training import init_replicated, shard_batch
+
+hk = pytest.importorskip("haiku")
+
+
+def _xy(n=16, d=8, classes=4, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, d).astype(np.float32)
+    y = r.randint(0, classes, (n,)).astype(np.int32)
+    return x, y
+
+
+def _ce(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+class TestHaiku:
+    def test_train_step_learns(self, hvd):
+        import horovod_tpu.interop.haiku as hvd_hk
+        mesh = hvd.core.basics.get_mesh()
+        net = hk.transform(lambda x: hk.nets.MLP([32, 4])(x))
+        x, y = _xy()
+        rng = jax.random.PRNGKey(0)
+        params = init_replicated(net.init(rng, jnp.asarray(x[:1])), mesh)
+        step = hvd_hk.make_train_step(net, optax.adam(1e-2), mesh,
+                                      loss_fn=_ce)
+        opt = init_replicated(step.init_opt_state(params), mesh)
+        xi, yi = shard_batch(x, mesh), shard_batch(y, mesh)
+        params, opt, l1 = step(params, opt, rng, xi, yi)
+        for _ in range(5):
+            params, opt, l2 = step(params, opt, rng, xi, yi)
+        assert float(l2) < float(l1)
+
+    def test_train_step_with_state_syncs(self, hvd):
+        """hk state (e.g. BN averages) must come back pmean-synced."""
+        import horovod_tpu.interop.haiku as hvd_hk
+        mesh = hvd.core.basics.get_mesh()
+
+        def fwd(x):
+            # running mean of the batch — per-replica values differ, so a
+            # correct implementation must pmean them (SyncBatchNorm)
+            mean = hk.get_state("mean", [], jnp.float32, init=jnp.zeros)
+            hk.set_state("mean", 0.9 * mean + 0.1 * x.mean())
+            return hk.nets.MLP([16, 4])(x)
+
+        net = hk.transform_with_state(fwd)
+        x, y = _xy()
+        rng = jax.random.PRNGKey(0)
+        params, state = net.init(rng, jnp.asarray(x[:1]))
+        params = init_replicated(params, mesh)
+        state = init_replicated(state, mesh)
+        step = hvd_hk.make_train_step(net, optax.adam(1e-2), mesh,
+                                      loss_fn=_ce, has_state=True)
+        opt = init_replicated(step.init_opt_state(params), mesh)
+        xi, yi = shard_batch(x, mesh), shard_batch(y, mesh)
+        params, state, opt, loss = step(params, state, opt, rng, xi, yi)
+        # synced value == update computed from the global batch mean
+        expect = 0.1 * x.mean()
+        np.testing.assert_allclose(float(state["~"]["mean"]), expect,
+                                   rtol=1e-5, atol=1e-6)
+        assert np.isfinite(float(loss))
+
+    def test_eval_step_averages_metric(self, hvd):
+        import horovod_tpu.interop.haiku as hvd_hk
+        mesh = hvd.core.basics.get_mesh()
+        net = hk.transform(lambda x: hk.nets.MLP([8, 4])(x))
+        x, y = _xy()
+        rng = jax.random.PRNGKey(0)
+        params = init_replicated(net.init(rng, jnp.asarray(x[:1])), mesh)
+
+        def acc(out, labels):
+            return jnp.mean((jnp.argmax(out, -1) == labels)
+                            .astype(jnp.float32))
+
+        ev = hvd_hk.make_eval_step(net, mesh, metric_fn=acc)
+        val = ev(params, rng, shard_batch(x, mesh), shard_batch(y, mesh))
+        assert 0.0 <= float(val) <= 1.0
+
+
+class TestHF:
+    @pytest.fixture()
+    def tiny_bert(self):
+        # function-scoped: train steps donate their param buffers, and on
+        # CPU device_put may alias, so reusing one model across tests
+        # would hand later tests deleted arrays
+        transformers = pytest.importorskip("transformers")
+        cfg = transformers.BertConfig(
+            vocab_size=99, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=64, num_labels=3)
+        model = transformers.FlaxBertForSequenceClassification(
+            cfg, seed=0, dtype=jnp.float32)
+        return model
+
+    def _batch(self, n=16, seq=10, vocab=99, classes=3, seed=0):
+        r = np.random.RandomState(seed)
+        return {
+            "input_ids": r.randint(0, vocab, (n, seq)).astype(np.int32),
+            "attention_mask": np.ones((n, seq), np.int32),
+            "labels": r.randint(0, classes, (n,)).astype(np.int32),
+        }
+
+    def test_finetune_step_learns(self, hvd, tiny_bert):
+        import horovod_tpu.interop.hf as hvd_hf
+        mesh = hvd.core.basics.get_mesh()
+        model = tiny_bert
+        step = hvd_hf.make_finetune_step(model, optax.adamw(1e-3), mesh)
+        params = init_replicated(model.params, mesh)
+        opt = init_replicated(step.init_opt_state(params), mesh)
+        batch = {k: shard_batch(v, mesh)
+                 for k, v in self._batch().items()}
+        rng = jax.random.PRNGKey(0)
+        params, opt, l1 = step(params, opt, rng, batch)
+        for _ in range(3):
+            params, opt, l2 = step(params, opt, rng, batch)
+        assert float(l2) < float(l1)
+
+    def test_eval_accuracy_bounds(self, hvd, tiny_bert):
+        import horovod_tpu.interop.hf as hvd_hf
+        mesh = hvd.core.basics.get_mesh()
+        model = tiny_bert
+        ev = hvd_hf.make_eval_step(model, mesh)
+        params = init_replicated(model.params, mesh)
+        batch = {k: shard_batch(v, mesh)
+                 for k, v in self._batch(seed=1).items()}
+        acc = ev(params, batch)
+        assert 0.0 <= float(acc) <= 1.0
+
+    def test_broadcast_parameters_reexport(self, hvd, tiny_bert):
+        import horovod_tpu.interop.hf as hvd_hf
+        out = hvd_hf.broadcast_parameters(tiny_bert.params, 0)
+        l0 = jax.tree_util.tree_leaves(tiny_bert.params)[0]
+        r0 = jax.tree_util.tree_leaves(out)[0]
+        np.testing.assert_allclose(np.asarray(l0), np.asarray(r0))

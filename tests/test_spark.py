@@ -135,3 +135,49 @@ def test_flax_estimator_fit_predict(hvd, tmp_path):
     # intermediate data was materialized
     assert store.exists(store.get_train_data_path("fitrun"))
     assert store.exists(store.get_val_data_path("fitrun"))
+
+
+class TestTorchEstimator:
+    def _data(self, n=64, d=6, classes=3, seed=0):
+        r = np.random.RandomState(seed)
+        x = r.randn(n, d).astype(np.float32)
+        w = r.randn(d, classes).astype(np.float32)
+        y = np.argmax(x @ w, axis=1).astype(np.int64)
+        return x, y
+
+    def test_fit_predict_and_checkpoint(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.spark import LocalStore, TorchEstimator, TorchModel
+        x, y = self._data()
+        model = torch.nn.Sequential(
+            torch.nn.Linear(6, 16), torch.nn.ReLU(), torch.nn.Linear(16, 3))
+        optim = torch.optim.Adam(model.parameters(), lr=5e-2)
+        store = LocalStore(str(tmp_path))
+        est = TorchEstimator(model, optim, epochs=8, batch_size=16,
+                             store=store, run_id="tr1", validation=0.25)
+        fitted = est.fit(x, y)
+        assert len(est.history) == 8
+        assert est.history[-1]["loss"] < est.history[0]["loss"]
+        assert "val_loss" in est.history[-1]
+        preds = fitted.predict(x[:8])
+        assert preds.shape == (8, 3)
+        # round-trip through the Store checkpoint
+        model2 = torch.nn.Sequential(
+            torch.nn.Linear(6, 16), torch.nn.ReLU(), torch.nn.Linear(16, 3))
+        loaded = TorchModel.load(store, "tr1", model2)
+        np.testing.assert_allclose(loaded.predict(x[:8]), preds,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_regression_default_mse(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.spark import LocalStore, TorchEstimator
+        r = np.random.RandomState(1)
+        x = r.randn(48, 4).astype(np.float32)
+        y = (x @ r.randn(4, 1).astype(np.float32))
+        model = torch.nn.Linear(4, 1)
+        est = TorchEstimator(model, torch.optim.SGD(model.parameters(),
+                                                    lr=1e-2),
+                             epochs=5, batch_size=16,
+                             store=LocalStore(str(tmp_path)))
+        est.fit(x, y)
+        assert est.history[-1]["loss"] < est.history[0]["loss"]
