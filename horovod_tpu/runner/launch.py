@@ -85,6 +85,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="Elastic: maximum workers.")
     p.add_argument("--host-discovery-script", default=None,
                    help="Elastic: executable printing 'host:slots' lines.")
+    p.add_argument("--tpu-pod", action="store_true", default=None,
+                   help="Derive hosts from TPU pod metadata "
+                        "(TPU_WORKER_HOSTNAMES); one process per TPU VM. "
+                        "The scheduler-native path, like the reference's "
+                        "LSF/jsrun mode.")
     p.add_argument("--start-timeout", type=float, default=120.0)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--check-build", action="store_true",
@@ -204,6 +209,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
+    if args.tpu_pod:
+        if (args.min_np is not None or args.max_np is not None
+                or args.host_discovery_script is not None):
+            print("hvdrun: --tpu-pod is static (a pod slice cannot gain "
+                  "hosts at runtime — resize the slice and relaunch); it "
+                  "cannot combine with --min-np/--max-np/"
+                  "--host-discovery-script", file=sys.stderr)
+            return 2
+        from .tpu_pod import require_worker_zero, tpu_pod_hosts_arg
+        require_worker_zero()
+        args.hosts = tpu_pod_hosts_arg()
+        args.hostfile = None
     if args.min_np is not None or args.host_discovery_script is not None:
         from ..elastic.driver import run_elastic
         return run_elastic(args)

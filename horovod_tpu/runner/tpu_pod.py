@@ -1,0 +1,70 @@
+"""TPU-pod launch mode: derive the host set from the pod's own metadata.
+
+The reference's scheduler-native launcher is the LSF/jsrun path
+(runner/js_run.py + runner/util/lsf.py): when running under a cluster
+scheduler it reads the scheduler's env (LSB_HOSTS etc.) instead of
+requiring -H/--hostfile. The TPU-native equivalent of "the scheduler
+already knows the hosts" is a Cloud TPU pod slice: every TPU VM carries
+the worker topology in its environment/metadata (TPU_WORKER_HOSTNAMES,
+TPU_WORKER_ID). `hvdrun --tpu-pod python train.py` run on worker 0
+launches one process per TPU VM over ssh; each worker joins the
+multi-host job via jax.distributed (HOROVOD_COORDINATOR_ADDR +
+process id/count from the slot env, core/basics._maybe_init_distributed)
+and its local chips come up under the global mesh.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+#: env vars consulted in order; comma-separated hostnames
+_HOSTNAME_VARS = ("HOROVOD_TPU_WORKER_HOSTNAMES", "TPU_WORKER_HOSTNAMES")
+_WORKER_ID_VARS = ("HOROVOD_TPU_WORKER_ID", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID")
+
+
+def detect_tpu_pod_hosts(env: Optional[dict] = None) -> Optional[List[str]]:
+    """Hostnames of all workers in this pod slice, or None when not
+    running on a TPU pod (mirrors lsf.LSFUtils.using_lsf)."""
+    env = os.environ if env is None else env
+    for var in _HOSTNAME_VARS:
+        val = env.get(var)
+        if val:
+            hosts = [h.strip() for h in val.split(",") if h.strip()]
+            if hosts:
+                return hosts
+    return None
+
+
+def tpu_worker_id(env: Optional[dict] = None) -> int:
+    env = os.environ if env is None else env
+    for var in _WORKER_ID_VARS:
+        val = env.get(var)
+        if val is not None and val.strip() != "":
+            try:
+                return int(val.strip())
+            except ValueError:
+                raise RuntimeError(
+                    f"--tpu-pod: {var}={val!r} is not an integer worker id")
+    return 0
+
+
+def tpu_pod_hosts_arg(env: Optional[dict] = None) -> str:
+    """'-H'-style host:slots string: ONE process per TPU VM (its local
+    chips are driven by that single process under jax — launching one
+    process per chip, the GPU habit, would fight the TPU runtime)."""
+    hosts = detect_tpu_pod_hosts(env)
+    if hosts is None:
+        raise RuntimeError(
+            "--tpu-pod: no TPU pod metadata found (set TPU_WORKER_HOSTNAMES "
+            "or HOROVOD_TPU_WORKER_HOSTNAMES to a comma-separated host list)")
+    return ",".join(f"{h}:1" for h in hosts)
+
+
+def require_worker_zero(env: Optional[dict] = None) -> None:
+    """The pod launch must run on worker 0 (it hosts the rendezvous + the
+    jax.distributed coordinator the other VMs dial)."""
+    wid = tpu_worker_id(env)
+    if wid != 0:
+        raise RuntimeError(
+            f"--tpu-pod must be launched from TPU worker 0 (this is worker "
+            f"{wid}); run it once on worker 0, not per-VM")

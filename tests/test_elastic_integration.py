@@ -1,0 +1,134 @@
+"""Tier-3 elastic integration: a REAL `hvdrun` elastic job on localhost
+driven by a mutable discovery script.
+
+Mirrors the reference's test/integration/elastic_common.py flow: start
+`horovodrun --host-discovery-script`, let workers make progress, mutate
+the discovery-script-backed hostfile mid-run to simulate hosts
+joining, assert the driver resets onto the new topology, then let the
+job finish cleanly."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys, time
+log_path = os.environ["ELASTIC_TEST_LOG"]
+stop_flag = os.environ["ELASTIC_TEST_STOP"]
+rank = os.environ.get("HOROVOD_RANK")
+size = os.environ.get("HOROVOD_SIZE")
+with open(log_path, "a") as f:
+    f.write(f"start rank={rank} size={size}\n")
+    f.flush()
+deadline = time.time() + 60
+while not os.path.exists(stop_flag):
+    if time.time() > deadline:
+        sys.exit(7)
+    time.sleep(0.2)
+with open(log_path, "a") as f:
+    f.write(f"done rank={rank} size={size}\n")
+sys.exit(0)
+"""
+
+
+def _wait_for(predicate, timeout=60, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _log_lines(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+def test_elastic_launcher_topology_change(tmp_path):
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:2\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hostfile}\n")
+    disc.chmod(0o755)
+
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+    log = tmp_path / "events.log"
+    stop = tmp_path / "stop.flag"
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TEST_LOG"] = str(log)
+    env["ELASTIC_TEST_STOP"] = str(stop)
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1", "--max-np", "4",
+         "--host-discovery-script", str(disc),
+         "python", str(worker_py)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=str(tmp_path))
+    try:
+        # phase 1: both initial workers came up with size=2
+        assert _wait_for(lambda: sum(
+            1 for ln in _log_lines(str(log))
+            if ln.startswith("start") and "size=2" in ln) >= 2), \
+            f"initial workers never started: {_log_lines(str(log))}"
+        ranks = {ln.split()[1] for ln in _log_lines(str(log))
+                 if ln.startswith("start")}
+        assert ranks == {"rank=0", "rank=1"}
+
+        # phase 2: a host gains a slot -> driver must reset onto 3 workers
+        hostfile.write_text("localhost:3\n")
+        assert _wait_for(lambda: sum(
+            1 for ln in _log_lines(str(log))
+            if ln.startswith("start") and "size=3" in ln) >= 3, timeout=90), \
+            f"no reset onto 3 slots: {_log_lines(str(log))}"
+
+        # phase 3: let the new incarnation finish cleanly
+        stop.write_text("")
+        rc = proc.wait(timeout=60)
+        assert rc == 0, f"driver rc={rc}"
+        done = [ln for ln in _log_lines(str(log)) if ln.startswith("done")]
+        assert len(done) >= 3
+        assert all("size=3" in ln for ln in done)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_elastic_launcher_completes_without_change(tmp_path):
+    """Steady topology: job runs to completion, rc 0, ranks distinct."""
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:2\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hostfile}\n")
+    disc.chmod(0o755)
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+    log = tmp_path / "events.log"
+    stop = tmp_path / "stop.flag"
+    stop.write_text("")           # workers exit immediately after logging
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TEST_LOG"] = str(log)
+    env["ELASTIC_TEST_STOP"] = str(stop)
+
+    rc = subprocess.call(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--min-np", "1",
+         "--host-discovery-script", str(disc),
+         "python", str(worker_py)],
+        env=env, cwd=str(tmp_path), timeout=120)
+    assert rc == 0
+    done = [ln for ln in _log_lines(str(log)) if ln.startswith("done")]
+    assert {ln.split()[1] for ln in done} == {"rank=0", "rank=1"}

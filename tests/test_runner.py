@@ -139,3 +139,68 @@ def _fail_fn():
     if os.environ.get("HOROVOD_RANK") == "1":
         raise SystemExit(3)
     return "ok"
+
+
+class TestTpuPodMode:
+    def test_detect_and_hosts_arg(self):
+        from horovod_tpu.runner.tpu_pod import (detect_tpu_pod_hosts,
+                                                tpu_pod_hosts_arg,
+                                                tpu_worker_id)
+        env = {"TPU_WORKER_HOSTNAMES": "t1v-0,t1v-1,t1v-2,t1v-3",
+               "TPU_WORKER_ID": "0"}
+        assert detect_tpu_pod_hosts(env) == ["t1v-0", "t1v-1", "t1v-2",
+                                             "t1v-3"]
+        assert tpu_pod_hosts_arg(env) == "t1v-0:1,t1v-1:1,t1v-2:1,t1v-3:1"
+        assert tpu_worker_id(env) == 0
+        assert detect_tpu_pod_hosts({}) is None
+
+    def test_hvd_override_wins(self):
+        from horovod_tpu.runner.tpu_pod import detect_tpu_pod_hosts
+        env = {"TPU_WORKER_HOSTNAMES": "a,b",
+               "HOROVOD_TPU_WORKER_HOSTNAMES": "x,y,z"}
+        assert detect_tpu_pod_hosts(env) == ["x", "y", "z"]
+
+    def test_requires_worker_zero(self):
+        from horovod_tpu.runner.tpu_pod import require_worker_zero
+        with pytest.raises(RuntimeError, match="worker 0"):
+            require_worker_zero({"TPU_WORKER_ID": "2"})
+        require_worker_zero({"TPU_WORKER_ID": "0"})   # no raise
+
+    def test_missing_metadata_raises(self):
+        from horovod_tpu.runner.tpu_pod import tpu_pod_hosts_arg
+        with pytest.raises(RuntimeError, match="no TPU pod metadata"):
+            tpu_pod_hosts_arg({})
+
+    def test_launch_flag_synthesizes_hosts(self, monkeypatch):
+        """--tpu-pod on worker 0 resolves the pod hosts into -H form
+        before run_static sees the args."""
+        from horovod_tpu.runner import launch
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1v-0,t1v-1")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        seen = {}
+
+        def fake_run_static(args):
+            seen["hosts"] = args.hosts
+            seen["hostfile"] = args.hostfile
+            return 0
+
+        monkeypatch.setattr(launch, "run_static", fake_run_static)
+        rc = launch.main(["--tpu-pod", "python", "-c", "pass"])
+        assert rc == 0
+        assert seen["hosts"] == "t1v-0:1,t1v-1:1"
+        assert seen["hostfile"] is None
+
+    def test_launch_flag_rejects_elastic_combo(self, monkeypatch, capsys):
+        from horovod_tpu.runner import launch
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1v-0,t1v-1")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        rc = launch.main(["--tpu-pod", "--min-np", "1",
+                          "python", "-c", "pass"])
+        assert rc == 2
+        assert "--tpu-pod is static" in capsys.readouterr().err
+
+    def test_malformed_worker_id(self):
+        from horovod_tpu.runner.tpu_pod import tpu_worker_id
+        with pytest.raises(RuntimeError, match="not an integer"):
+            tpu_worker_id({"TPU_WORKER_ID": "worker-0"})
+        assert tpu_worker_id({"TPU_WORKER_ID": " 3 "}) == 3
