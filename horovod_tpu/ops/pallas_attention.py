@@ -1,20 +1,26 @@
-"""Fused flash attention as a Pallas TPU kernel.
+"""Fused flash attention as Pallas TPU kernels — forward AND backward.
 
 The hot op of the GPT model family (models/gpt.py). The pure-lax reference
 implementation (parallel/sp.py attention_reference) materializes the full
-[Sq, Skv] score matrix in HBM; this kernel streams K/V blocks through VMEM
+[Sq, Skv] score matrix in HBM; these kernels stream K/V blocks through VMEM
 with the online-softmax recurrence, so HBM traffic is O(S*D) instead of
 O(S^2) and the matmuls hit the MXU at block size.
 
+Training support: `flash_attention` carries a custom VJP. The forward
+kernel additionally emits the per-row log-sum-exp; the backward pass is
+the standard recompute scheme as two Pallas kernels — one gridded over
+query blocks producing dQ, one over key blocks producing dK/dV — so the
+backward also never materializes [Sq, Skv] (classic FlashAttention-2
+structure; all accumulation in fp32).
+
 Design (pallas_guide.md patterns):
-* grid = (batch*heads, Sq/block_q); each program owns one query block.
-* K/V for the (batch, head) live in VMEM whole (fits for the sequence
-  lengths the model targets; the block loop walks them in block_k chunks).
-* fp32 accumulation in the fori_loop carry; causal masking by global
-  position; the loop trip count shrinks for causal queries (no work on
-  fully-masked key blocks).
-* On non-TPU platforms the same kernel runs in interpret mode (tests), or
-  falls back to the dense reference via `fused_attention(..., force=...)`.
+* grid = (batch*heads, S/block); each program owns one row block.
+* K/V (resp. Q/dO) for the (batch, head) live in VMEM whole; the inner
+  fori_loop walks them in blocks, trip count trimmed for causal.
+* padding to block multiples is masked by real-position bounds inside the
+  kernels (both padded keys and padded queries).
+* On non-TPU platforms the same kernels run in interpret mode (tests), or
+  fall back to the dense reference via `fused_attention(..., force=...)`.
 """
 from __future__ import annotations
 
@@ -28,13 +34,34 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                 block_q: int, block_k: int, seq_k: int, seq_k_actual: int):
+def _pos_mask(qi_base, kb_base, bq, bk, *, causal: bool,
+              seq_q: int, seq_q_p: int, seq_k: int, seq_k_p: int):
+    """[bq, bk] validity mask for a (query-block, key-block) tile:
+    causal lower-triangle plus real (unpadded) position bounds."""
+    q_pos = qi_base + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = kb_base + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = jnp.full((bq, bk), True)
+    if causal:
+        mask = q_pos >= k_pos
+    if seq_k != seq_k_p:
+        mask = mask & (k_pos < seq_k)
+    if seq_q != seq_q_p:
+        mask = mask & (q_pos < seq_q)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                seq_q: int, seq_q_p: int, seq_k: int, seq_k_p: int):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
     bq, d = q.shape
 
-    num_kb = seq_k // block_k
+    num_kb = seq_k_p // block_k
     if causal:
         # last key position this query block can see
         last = (qi + 1) * block_q - 1
@@ -51,20 +78,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk]
-        pad_keys = seq_k_actual != seq_k
-        if causal or pad_keys:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            mask = jnp.full((bq, block_k), True)
-            if causal:
-                q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, block_k), 0)
-                mask = q_pos >= k_pos
-            if pad_keys:
-                # zero-padded keys past the real Skv must never score,
-                # even for causal queries with q_pos >= Skv
-                mask = mask & (k_pos < seq_k_actual)
-            s = jnp.where(mask, s, NEG_INF)
+        mask = _pos_mask(qi * block_q, kb * block_k, bq, block_k,
+                         causal=causal, seq_q=seq_q, seq_q_p=seq_q_p,
+                         seq_k=seq_k, seq_k_p=seq_k_p)
+        s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - safe_m[:, None])
@@ -83,22 +100,235 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
     o, m, l = jax.lax.fori_loop(0, nkb, body, (o0, m0, l0))
     o = o / jnp.maximum(l, 1e-20)[:, None]
     o_ref[0] = o.astype(o_ref.dtype)
+    if maybe_lse_ref:   # training: emit per-row log-sum-exp for the VJP
+        safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+        # [bq, 1] column: TPU pallas requires the last two block dims to
+        # obey the (8, 128) tiling rule, which [1, block_q] violates
+        maybe_lse_ref[0][0] = \
+            (safe_m + jnp.log(jnp.maximum(l, 1e-20)))[:, None]
 
+
+def _fwd_impl(q, k, v, causal, scale, block_q, block_k,
+              seq_q, seq_k, interpret, emit_lse=True):
+    BH, Sq_p, D = q.shape
+    Skv_p = k.shape[1]
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+        seq_q=seq_q, seq_q_p=Sq_p, seq_k=seq_k, seq_k_p=Skv_p)
+    out_specs = [pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct((BH, Sq_p, D), q.dtype)]
+    if emit_lse:
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((BH, Sq_p, 1), jnp.float32))
+    out = pl.pallas_call(
+        kernel,
+        grid=(BH, Sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q, k, v)
+    return out if emit_lse else (out[0], None)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2 recompute scheme)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale: float, causal: bool, block_q: int,
+                   block_k: int, seq_q: int, seq_q_p: int, seq_k: int,
+                   seq_k_p: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    do = do_ref[0].astype(jnp.float32)                # [bq, D]
+    lse = lse_ref[0]                                  # [bq, 1]
+    delta = delta_ref[0]                              # [bq, 1]
+    bq, d = q.shape
+
+    num_kb = seq_k_p // block_k
+    if causal:
+        last = (qi + 1) * block_q - 1
+        nkb = jnp.minimum(num_kb, (last // block_k) + 1)
+    else:
+        nkb = num_kb
+
+    def body(kb, dq):
+        k = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(
+            jnp.float32)
+        v = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        mask = _pos_mask(qi * block_q, kb * block_k, bq, block_k,
+                         causal=causal, seq_q=seq_q, seq_q_p=seq_q_p,
+                         seq_k=seq_k, seq_k_p=seq_k_p)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, block_k: int, seq_q: int, seq_q_p: int,
+                    seq_k: int, seq_k_p: int):
+    kb = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+    v = v_ref[0].astype(jnp.float32)                  # [bk, D]
+    bk, d = k.shape
+
+    num_qb = seq_q_p // block_q
+    if causal:
+        # first query block that can see this key block
+        qb0 = (kb * block_k) // block_q
+    else:
+        qb0 = 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(qi * block_q, block_q), :].astype(
+            jnp.float32) * scale                      # [bq, D]
+        do = do_ref[0, pl.dslice(qi * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, pl.dslice(qi * block_q, block_q), :]
+        delta = delta_ref[0, pl.dslice(qi * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        mask = _pos_mask(qi * block_q, kb * block_k, block_q, bk,
+                         causal=causal, seq_q=seq_q, seq_q_p=seq_q_p,
+                         seq_k=seq_k, seq_k_p=seq_k_p)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, D]
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb0, num_qb, body, (dk0, dv0))
+    # q was pre-scaled, so dk already carries one factor of `scale`
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP wrapper (operates on padded [B*H, S_p, D] arrays)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, scale, block_q, block_k, seq_q, seq_k,
+           interpret):
+    # primal (inference) path: skip the LSE output entirely
+    o, _ = _fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                     seq_q, seq_k, interpret, emit_lse=False)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, seq_q, seq_k,
+               interpret):
+    o, lse = _fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                       seq_q, seq_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, seq_q, seq_k, interpret,
+               res, do):
+    q, k, v, o, lse = res
+    BH, Sq_p, D = q.shape
+    Skv_p = k.shape[1]
+    # D_i = rowsum(dO_i * O_i) — cheap elementwise, fused by XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)           # [BH, Sq_p, 1]
+
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_q=seq_q, seq_q_p=Sq_p,
+                  seq_k=seq_k, seq_k_p=Skv_p)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(BH, Sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq_p, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(BH, Skv_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, Sq_p, D), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, Sq_p, D), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, Sq_p, 1), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, Sq_p, 1), lambda bh, kb: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Skv_p, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Skv_p, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=(
     "causal", "scale", "block_q", "block_k", "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 256, block_k: int = 512,
                     interpret: bool = False) -> jax.Array:
-    """[B, H, Sq, D] x [B, H, Skv, D] -> [B, H, Sq, D] fused attention."""
+    """[B, H, Sq, D] x [B, H, Skv, D] -> [B, H, Sq, D] fused attention.
+    Differentiable (custom VJP with Pallas backward kernels)."""
     B, H, Sq, D = q.shape
     Skv = k.shape[2]
     scale_ = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     block_q = min(block_q, Sq)
     block_k = min(block_k, Skv)
 
-    # pad sequences to block multiples; padded keys are masked by position
+    # pad sequences to block multiples; padded positions are masked by
+    # real-position bounds inside the kernels
     pad_q = (-Sq) % block_q
     pad_k = (-Skv) % block_k
     qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
@@ -110,21 +340,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kr = kk.reshape(B * H, Skv_p, D)
     vr = vv.reshape(B * H, Skv_p, D)
 
-    kernel = functools.partial(
-        _attn_kernel, scale=scale_, causal=causal,
-        block_q=block_q, block_k=block_k, seq_k=Skv_p, seq_k_actual=Skv)
-    out = pl.pallas_call(
-        kernel,
-        grid=(B * H, Sq_p // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq_p, D), q.dtype),
-        interpret=interpret,
-    )(qr, kr, vr)
+    out = _flash(qr, kr, vr, causal, scale_, block_q, block_k,
+                 Sq, Skv, interpret)
     out = out.reshape(B, H, Sq_p, D)
     return out[:, :, :Sq] if pad_q else out
 

@@ -217,10 +217,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "cannot combine with --min-np/--max-np/"
                   "--host-discovery-script", file=sys.stderr)
             return 2
+        if args.hosts is not None or args.hostfile is not None:
+            print("hvdrun: --tpu-pod derives hosts from pod metadata; "
+                  "drop -H/--hostfile (or drop --tpu-pod to launch on "
+                  "your own host list)", file=sys.stderr)
+            return 2
         from .tpu_pod import require_worker_zero, tpu_pod_hosts_arg
-        require_worker_zero()
-        args.hosts = tpu_pod_hosts_arg()
-        args.hostfile = None
+        try:
+            require_worker_zero()
+            args.hosts = tpu_pod_hosts_arg()
+        except RuntimeError as e:
+            print(f"hvdrun: {e}", file=sys.stderr)
+            return 2
     if args.min_np is not None or args.host_discovery_script is not None:
         from ..elastic.driver import run_elastic
         return run_elastic(args)

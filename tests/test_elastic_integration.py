@@ -68,12 +68,15 @@ def test_elastic_launcher_topology_change(tmp_path):
     env["ELASTIC_TEST_LOG"] = str(log)
     env["ELASTIC_TEST_STOP"] = str(stop)
 
+    # driver output goes to a file: a PIPE nobody drains can fill and
+    # deadlock the launcher's streaming writes
+    driver_log = open(tmp_path / "driver.log", "w")
     proc = subprocess.Popen(
         [sys.executable, "-m", "horovod_tpu.runner.launch",
          "-np", "2", "--min-np", "1", "--max-np", "4",
          "--host-discovery-script", str(disc),
          "python", str(worker_py)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=env, stdout=driver_log, stderr=subprocess.STDOUT,
         text=True, cwd=str(tmp_path))
     try:
         # phase 1: both initial workers came up with size=2
@@ -103,6 +106,7 @@ def test_elastic_launcher_topology_change(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+        driver_log.close()
 
 
 def test_elastic_launcher_completes_without_change(tmp_path):
