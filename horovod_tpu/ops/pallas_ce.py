@@ -1,0 +1,163 @@
+"""Fused softmax cross-entropy as Pallas TPU kernels (fwd + custom VJP).
+
+For an LM head the logits tensor [T, V] is the largest activation in the
+step; the stock composition (softmax -> log -> gather -> mean, as in
+optax.softmax_cross_entropy_with_integer_labels) walks it several times
+and materializes [T, V] intermediates in HBM. These kernels stream the
+vocabulary once per pass with an online max/sum-exp recurrence:
+
+* forward: one pass over V per row block -> per-row loss (lse - l[y]);
+  no [T, V] intermediate is written.
+* backward: one pass recomputing p = exp(l - lse) and writing
+  dlogits = (p - onehot(y)) * g directly — the only [T, V] write.
+
+Same structure as ops/pallas_attention.py: fp32 accumulation, padding
+masked by real-size bounds, interpret mode on CPU for tests, dense
+fallback for tiny shapes via `fused_cross_entropy(..., force=...)`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(logits_ref, labels_ref, loss_ref, lse_ref, *, vocab: int):
+    x = logits_ref[...].astype(jnp.float32)           # [bt, V]
+    y = labels_ref[...]                               # [bt, 1] int32
+    bt, vp = x.shape
+    v_pos = jax.lax.broadcasted_iota(jnp.int32, (bt, vp), 1)
+    # Mosaic pads the lane dim to tile multiples with UNDEFINED values;
+    # reductions must mask them out explicitly (v_pos >= vocab)
+    x = jnp.where(v_pos < vocab, x, NEG_INF)
+    m = x.max(axis=-1)                                # [bt]
+    s = jnp.exp(x - m[:, None]).sum(axis=-1)
+    ly = jnp.where(v_pos == y, x, 0.0).sum(axis=-1)   # label logit
+    lse = m + jnp.log(jnp.maximum(s, 1e-20))
+    loss_ref[...] = (lse - ly)[:, None]
+    lse_ref[...] = lse[:, None]
+
+
+def _bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, dlogits_ref, *,
+                vocab: int):
+    x = logits_ref[...].astype(jnp.float32)           # [bt, V]
+    y = labels_ref[...]                               # [bt, 1]
+    lse = lse_ref[...]                                # [bt, 1]
+    g = g_ref[...]                                    # [bt, 1]
+    bt, vp = x.shape
+    v_pos = jax.lax.broadcasted_iota(jnp.int32, (bt, vp), 1)
+    # mask undefined padded lanes (see _fwd_kernel)
+    p = jnp.where(v_pos < vocab, jnp.exp(x - lse), 0.0)   # [bt, V]
+    d = (p - (v_pos == y).astype(jnp.float32)) * g
+    dlogits_ref[...] = d.astype(dlogits_ref.dtype)
+
+
+#: VMEM budget per row block — the [block_t, V] tile must fit alongside
+#: the kernel's temporaries (v5e VMEM is ~16 MB/core)
+_VMEM_TILE_BYTES = 6 << 20
+
+
+def _pick_block_t(T: int, V: int, itemsize: int) -> int:
+    bt = _VMEM_TILE_BYTES // max(V * itemsize, 1)
+    bt = max(8, min(256, bt))
+    bt = (bt // 8) * 8                    # sublane-aligned
+    # tiny inputs: one full-size block (full-dim blocks may be unaligned)
+    return min(bt, T)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _ce(logits, labels2d, vocab, block_t, interpret):
+    loss, _ = _ce_fwd_impl(logits, labels2d, vocab, block_t, interpret)
+    return loss
+
+
+def _ce_fwd_impl(logits, labels2d, vocab, block_t, interpret):
+    T_p, V_p = logits.shape
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, vocab=vocab),
+        grid=(T_p // block_t,),
+        in_specs=[
+            pl.BlockSpec((block_t, V_p), lambda t: (t, 0)),
+            pl.BlockSpec((block_t, 1), lambda t: (t, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_t, 1), lambda t: (t, 0)),
+            pl.BlockSpec((block_t, 1), lambda t: (t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_p, 1), jnp.float32),
+            jax.ShapeDtypeStruct((T_p, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(logits, labels2d)
+    return loss, lse
+
+
+def _ce_fwd(logits, labels2d, vocab, block_t, interpret):
+    loss, lse = _ce_fwd_impl(logits, labels2d, vocab, block_t, interpret)
+    return loss, (logits, labels2d, lse)
+
+
+def _ce_bwd(vocab, block_t, interpret, res, g):
+    logits, labels2d, lse = res
+    T_p, V_p = logits.shape
+    dlogits = pl.pallas_call(
+        functools.partial(_bwd_kernel, vocab=vocab),
+        grid=(T_p // block_t,),
+        in_specs=[
+            pl.BlockSpec((block_t, V_p), lambda t: (t, 0)),
+            pl.BlockSpec((block_t, 1), lambda t: (t, 0)),
+            pl.BlockSpec((block_t, 1), lambda t: (t, 0)),
+            pl.BlockSpec((block_t, 1), lambda t: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, V_p), lambda t: (t, 0)),
+        out_shape=jax.ShapeDtypeStruct((T_p, V_p), logits.dtype),
+        interpret=interpret,
+    )(logits, labels2d, lse, g)
+    return dlogits, None
+
+
+_ce.defvjp(_ce_fwd, _ce_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_softmax_cross_entropy(logits: jax.Array, labels: jax.Array, *,
+                                interpret: bool = False) -> jax.Array:
+    """Mean token cross entropy. logits [..., V] (any leading dims),
+    integer labels with matching leading shape. Differentiable."""
+    V = logits.shape[-1]
+    x = logits.reshape(-1, V)
+    y = labels.reshape(-1).astype(jnp.int32)
+    T = x.shape[0]
+
+    block_t = _pick_block_t(T, V, x.dtype.itemsize)
+    pad_t = (-T) % block_t
+    if pad_t:
+        x = jnp.pad(x, ((0, pad_t), (0, 0)))
+        # padded rows: label -1 never matches a v_pos, loss rows dropped
+        y = jnp.pad(y, (0, pad_t), constant_values=-1)
+
+    loss = _ce(x, y[:, None], V, block_t, interpret)
+    return loss[:T, 0].mean() if pad_t else loss[:, 0].mean()
+
+
+def fused_cross_entropy(logits: jax.Array, labels: jax.Array, *,
+                        force: Optional[str] = None) -> jax.Array:
+    """Dispatch: pallas on TPU, optax composition elsewhere.
+    force: "pallas" | "reference" | "interpret"."""
+    mode = force
+    if mode is None:
+        mode = "pallas" if jax.devices()[0].platform == "tpu" \
+            else "reference"
+    if mode == "pallas":
+        return fused_softmax_cross_entropy(logits, labels)
+    if mode == "interpret":
+        return fused_softmax_cross_entropy(logits, labels, interpret=True)
+    import optax
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()

@@ -29,8 +29,10 @@ from .optim.optimizer import DistributedOptimizer
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    return optax.softmax_cross_entropy_with_integer_labels(
-        logits, labels).mean()
+    """Mean token cross entropy — fused Pallas kernel on TPU (one HBM
+    pass over the [T, V] logits, ops/pallas_ce.py), optax elsewhere."""
+    from .ops.pallas_ce import fused_cross_entropy
+    return fused_cross_entropy(logits, labels)
 
 
 def make_train_step(
