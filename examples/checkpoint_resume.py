@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Checkpoint + resume: train, save via orbax, restart, continue
+(reference convention: rank 0 saves; broadcast on resume —
+examples/pytorch/pytorch_imagenet_resnet50.py).
+
+    HVD_EXAMPLE_CPU=8 python examples/checkpoint_resume.py
+"""
+import os
+import tempfile
+
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+import optax                                                # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+from horovod_tpu.models import ViT_Tiny                     # noqa: E402
+from horovod_tpu.training import (init_replicated,          # noqa: E402
+                                  make_train_step, shard_batch)
+
+
+def build(mesh):
+    model = ViT_Tiny(num_classes=10, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)))
+    params = init_replicated(variables["params"], mesh)
+    step = make_train_step(model.apply, optax.adam(1e-3), mesh)
+    opt = init_replicated(step.init_opt_state(params), mesh)
+    return step, params, opt
+
+
+def main() -> None:
+    hvd.init()
+    mesh = hvd.core.basics.get_mesh()
+    ckpt_dir = os.environ.get("CKPT_DIR") or tempfile.mkdtemp()
+
+    r = np.random.RandomState(0)
+    xb = shard_batch(r.rand(16, 32, 32, 3).astype(np.float32), mesh)
+    yb = shard_batch(r.randint(0, 10, (16,)).astype(np.int32), mesh)
+
+    # phase 1: train 3 steps, checkpoint asynchronously
+    step, params, opt = build(mesh)
+    with hvd.Checkpointer(ckpt_dir) as ckpt:
+        for s in range(3):
+            params, opt, _, loss = step(params, opt, {}, xb, yb)
+            ckpt.save(s, {"params": params, "opt": opt})
+        loss_before = float(loss)
+    print(f"phase 1 trained to step 3, loss={loss_before:.4f}")
+
+    # phase 2: fresh process state, restore latest, continue
+    step, params, opt = build(mesh)
+    with hvd.Checkpointer(ckpt_dir) as ckpt:
+        restored = ckpt.restore(target={"params": params, "opt": opt})
+    params, opt = restored["params"], restored["opt"]
+    params, opt, _, loss = step(params, opt, {}, xb, yb)
+    print(f"resumed from step {hvd.checkpoint.latest_step(ckpt_dir)}, "
+          f"loss={float(loss):.4f} (continues below {loss_before:.4f})")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""dm-haiku model trained through the haiku binding (reference analog:
+per-framework examples, e.g. examples/pytorch/pytorch_mnist.py).
+
+    HVD_EXAMPLE_CPU=8 python examples/haiku_train.py
+"""
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import haiku as hk                                          # noqa: E402
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+import optax                                                # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+import horovod_tpu.interop.haiku as hvd_hk                  # noqa: E402
+from horovod_tpu.training import (init_replicated,          # noqa: E402
+                                  shard_batch)
+
+
+def main() -> None:
+    hvd.init()
+    mesh = hvd.core.basics.get_mesh()
+
+    net = hk.transform(lambda x: hk.nets.MLP([64, 32, 4])(x))
+    r = np.random.RandomState(0)
+    x = r.randn(64, 16).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32) + 2 * (
+        x[:, 0] > 0).astype(np.int32)
+
+    rng = jax.random.PRNGKey(0)
+    params = init_replicated(net.init(rng, jnp.asarray(x[:1])), mesh)
+
+    def ce(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    step = hvd_hk.make_train_step(net, optax.adam(5e-3), mesh, loss_fn=ce)
+    opt = init_replicated(step.init_opt_state(params), mesh)
+    xi, yi = shard_batch(x, mesh), shard_batch(y, mesh)
+    for s in range(8):
+        params, opt, loss = step(params, opt, rng, xi, yi)
+    print(f"haiku final loss={float(loss):.4f}")
+
+    def acc(out, labels):
+        return jnp.mean((jnp.argmax(out, -1) == labels)
+                        .astype(jnp.float32))
+
+    ev = hvd_hk.make_eval_step(net, mesh, metric_fn=acc)
+    print(f"haiku accuracy={float(ev(params, rng, xi, yi)):.3f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

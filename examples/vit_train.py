@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""ViT fine-tune, data-parallel over the mesh (reference analog:
+examples/pytorch/pytorch_imagenet_resnet50.py shape, modern encoder).
+
+    HVD_EXAMPLE_CPU=8 python examples/vit_train.py --epochs 1
+"""
+import argparse
+
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+import optax                                                # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+from horovod_tpu.models import ViT_Tiny                     # noqa: E402
+from horovod_tpu.training import (init_replicated,          # noqa: E402
+                                  make_train_step, shard_batch)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    args = p.parse_args()
+
+    hvd.init()
+    mesh = hvd.core.basics.get_mesh()
+    platform = jax.devices()[0].platform
+    model = ViT_Tiny(num_classes=10,
+                     dtype=jnp.float32 if platform == "cpu"
+                     else jnp.bfloat16)
+
+    r = np.random.RandomState(0)
+    n = 4 * args.batch_size
+    images = r.rand(n, 32, 32, 3).astype(np.float32)
+    labels = r.randint(0, 10, (n,)).astype(np.int32)
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.asarray(images[:1]))
+    params = init_replicated(variables["params"], mesh)
+    step = make_train_step(model.apply, optax.adam(1e-3), mesh)
+    opt = init_replicated(step.init_opt_state(params), mesh)
+
+    steps = n // args.batch_size
+    for epoch in range(args.epochs):
+        total = 0.0
+        for s in range(steps):
+            lo, hi = s * args.batch_size, (s + 1) * args.batch_size
+            xb = shard_batch(images[lo:hi], mesh)
+            yb = shard_batch(labels[lo:hi], mesh)
+            params, opt, _, loss = step(params, opt, {}, xb, yb)
+            total += float(loss)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={total / steps:.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

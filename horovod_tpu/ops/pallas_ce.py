@@ -143,7 +143,7 @@ def fused_softmax_cross_entropy(logits: jax.Array, labels: jax.Array, *,
         y = jnp.pad(y, (0, pad_t), constant_values=-1)
 
     loss = _ce(x, y[:, None], V, block_t, interpret)
-    return loss[:T, 0].mean() if pad_t else loss[:, 0].mean()
+    return loss[:T, 0].mean()
 
 
 def fused_cross_entropy(logits: jax.Array, labels: jax.Array, *,

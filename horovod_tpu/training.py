@@ -29,8 +29,19 @@ from .optim.optimizer import DistributedOptimizer
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Mean token cross entropy — fused Pallas kernel on TPU (one HBM
-    pass over the [T, V] logits, ops/pallas_ce.py), optax elsewhere."""
+    """Mean token cross entropy as plain XLA ops — the GSPMD-friendly
+    form: the partitioner shards elementwise/reduce freely, so use this
+    wherever logits are globally sharded (make_gspmd_train_step)."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def fused_cross_entropy_loss(logits: jax.Array,
+                             labels: jax.Array) -> jax.Array:
+    """Mean token cross entropy via the fused Pallas kernel on TPU
+    (one HBM pass, ops/pallas_ce.py), optax elsewhere. Use on LOCAL
+    shards (inside shard_map) — a bare pallas_call on globally-sharded
+    logits would force the partitioner to gather them."""
     from .ops.pallas_ce import fused_cross_entropy
     return fused_cross_entropy(logits, labels)
 
@@ -42,7 +53,7 @@ def make_train_step(
     *,
     axis_name: str = GLOBAL_AXIS,
     has_batch_stats: bool = False,
-    loss_fn: Callable = cross_entropy_loss,
+    loss_fn: Callable = None,
     compression=None,
     op: ReduceOp = ReduceOp.AVERAGE,
     backward_passes_per_step: int = 1,
@@ -56,6 +67,10 @@ def make_train_step(
     `DistributedOptimizer`.
     """
     from .optim.compression import Compression
+    if loss_fn is None:
+        # local_step runs inside shard_map on local shards, where the
+        # fused Pallas kernel applies without partitioning concerns
+        loss_fn = fused_cross_entropy_loss
     dist_opt = DistributedOptimizer(
         optimizer, axis_name=axis_name, op=op,
         compression=compression or Compression.none,

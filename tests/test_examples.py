@@ -34,6 +34,10 @@ def _run(script, *args, timeout=240):
     ("adasum_example.py", [], "Adasum"),
     ("process_sets_example.py", [], "even-set sum"),
     ("data_service_example.py", [], "served batches"),
+    ("vit_train.py", ["--epochs", "1", "--batch-size", "16"], "loss="),
+    ("moe_expert_parallel.py", ["--steps", "2"], "experts sharded 4-way"),
+    ("haiku_train.py", [], "haiku accuracy="),
+    ("checkpoint_resume.py", [], "resumed from step 2"),
 ])
 def test_example_runs(script, args, expect):
     out = _run(script, *args)
