@@ -14,24 +14,41 @@ vs_baseline compares per-chip throughput against the reference's documented
 tf_cnn_benchmarks ResNet-101 example output (1656.82 img/sec on 16 P100s =
 103.55 img/sec/GPU, /root/reference/docs/benchmarks.rst:30-42) — the only
 quantitative throughput figure the reference publishes.
+
+Resilience: the TPU tunnel in this environment is flaky, so backend init is
+retried with backoff in a fresh subprocess each attempt (a hung PJRT client
+cannot be recovered in-process), and any terminal failure is reported as a
+structured JSON error line rather than a traceback.
 """
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-import horovod_tpu as hvd
-from horovod_tpu.models.resnet import ResNet50
-from horovod_tpu.training import (init_replicated, make_train_step,
-                                  shard_batch)
 
 BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:30-42
 
+#: per-attempt budget; generous for first-compile (~20-40s) + timed iters
+ATTEMPT_TIMEOUT_S = int(os.environ.get("HVD_BENCH_ATTEMPT_TIMEOUT", "420"))
+MAX_ATTEMPTS = int(os.environ.get("HVD_BENCH_ATTEMPTS", "3"))
+BACKOFF_S = 20.0
 
-def main():
+_MARK = "HVD_BENCH_RESULT:"
+
+
+def run_benchmark():
+    """The measured body. Runs in a worker subprocess; prints the result
+    JSON prefixed with _MARK on success."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet50
+    from horovod_tpu.training import (init_replicated, make_train_step,
+                                      shard_batch)
+
     hvd.init()
     mesh = hvd.core.basics.get_mesh()
     n_dev = hvd.size()
@@ -77,13 +94,48 @@ def main():
 
     img_sec = batch * num_iters / dt
     img_sec_per_chip = img_sec / n_dev
-    print(json.dumps({
+    print(_MARK + json.dumps({
         "metric": "resnet50_synthetic_img_sec_per_chip",
         "value": round(img_sec_per_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(img_sec_per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
-    }))
+        "platform": platform,
+        "n_devices": n_dev,
+    }), flush=True)
+
+
+def main() -> int:
+    errors = []
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-u", __file__, "--worker"],
+                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+            for line in out.stdout.splitlines():
+                if line.startswith(_MARK):
+                    print(line[len(_MARK):], flush=True)
+                    return 0
+            tail = (out.stdout + out.stderr).strip().splitlines()[-6:]
+            errors.append(f"attempt {attempt}: rc={out.returncode}: "
+                          + " | ".join(tail))
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt}: timed out after "
+                          f"{ATTEMPT_TIMEOUT_S}s (TPU tunnel hang?)")
+        if attempt < MAX_ATTEMPTS:
+            time.sleep(BACKOFF_S * attempt)
+    print(json.dumps({
+        "metric": "resnet50_synthetic_img_sec_per_chip",
+        "value": None,
+        "unit": "img/sec/chip",
+        "vs_baseline": None,
+        "error": "; ".join(errors)[-2000:],
+    }), flush=True)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        run_benchmark()
+    else:
+        sys.exit(main())

@@ -37,6 +37,7 @@ from .core.mesh import (                                       # noqa: F401
 )
 from .ops.collective_ops import (                              # noqa: F401
     allreduce, allgather, broadcast, alltoall, reducescatter, barrier, join,
+    local_rows,
 )
 from .ops.sparse import sparse_allreduce                       # noqa: F401
 from .ops import inside                                        # noqa: F401

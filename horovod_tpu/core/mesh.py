@@ -76,3 +76,54 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def shard_stacked(x, mesh: Mesh, axis: str = GLOBAL_AXIS):
     """Place a [size, ...] host array so row i lives on device i."""
     return jax.device_put(x, stacked_sharding(mesh, axis))
+
+
+def mesh_is_multiprocess(mesh: Mesh) -> bool:
+    """True when the mesh spans devices of more than one controller process
+    (the reference's multi-worker regime: one HorovodGlobalState per process,
+    negotiation across them)."""
+    pi = jax.process_index()
+    return any(d.process_index != pi for d in mesh.devices.flat)
+
+
+def local_row_indices(mesh: Mesh) -> List[int]:
+    """Global row indices (1-D mesh positions) owned by this process."""
+    pi = jax.process_index()
+    return [i for i, d in enumerate(mesh.devices.flat)
+            if d.process_index == pi]
+
+
+def place_replicated(x, mesh: Mesh):
+    """Replicate a host array over `mesh`, multi-process safe.
+
+    device_put cannot target non-addressable devices; in multi-process mode
+    every process contributes its (identical) copy instead."""
+    if mesh_is_multiprocess(mesh):
+        return jax.make_array_from_process_local_data(
+            replicated_sharding(mesh), np.asarray(x))
+    return jax.device_put(x, replicated_sharding(mesh))
+
+
+def place_stacked_rows(x, mesh: Mesh, axis: str = GLOBAL_AXIS):
+    """Row-shard a stacked array over `mesh`, multi-process safe.
+
+    Single-process: a plain device_put of the full [n, ...] array.
+    Multi-process: `x` may be either this process's local rows
+    [n_local, ...] or the full [n, ...] array (from which the local rows
+    are sliced); the global array is assembled with
+    jax.make_array_from_process_local_data — the multi-host staging path
+    the reference performs with per-process tensors."""
+    if not mesh_is_multiprocess(mesh):
+        return jax.device_put(x, stacked_sharding(mesh, axis))
+    n = mesh.devices.size
+    rows = local_row_indices(mesh)
+    x = np.asarray(x)
+    if x.shape[0] == n and len(rows) != n:
+        x = x[np.asarray(rows)]
+    elif x.shape[0] != len(rows):
+        raise ValueError(
+            f"multi-process stacked input must have leading dim == global "
+            f"size ({n}) or this process's local row count ({len(rows)}); "
+            f"got {tuple(x.shape)}")
+    return jax.make_array_from_process_local_data(
+        stacked_sharding(mesh, axis), x)

@@ -78,10 +78,13 @@ def make_train_step(
             return params, new_state, new_opt, lax.pmean(loss, axis_name)
 
         repl, sh = P(), P(axis_name)
+        # check_vma=False: user loss_fn may be a pallas kernel (see
+        # training.make_train_step); outputs are replicated by the pmeans.
         smapped = jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(repl, repl, repl, repl, sh, sh),
-            out_specs=(repl, repl, repl, repl))
+            out_specs=(repl, repl, repl, repl),
+            check_vma=False)
         step = jax.jit(smapped,
                        donate_argnums=(0, 1, 2) if donate else ())
     else:
@@ -99,7 +102,8 @@ def make_train_step(
         smapped = jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(repl, repl, repl, sh, sh),
-            out_specs=(repl, repl, repl))
+            out_specs=(repl, repl, repl),
+            check_vma=False)
         step = jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
 
     step.init_opt_state = dist_opt.init

@@ -94,10 +94,13 @@ def make_finetune_step(
         return params, opt_state, lax.pmean(loss, axis_name)
 
     repl, sh = P(), P(axis_name)
+    # check_vma=False: user loss_fn may be a pallas kernel (see
+    # training.make_train_step); outputs are replicated by the pmeans.
     smapped = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(repl, repl, repl, sh, sh),
-        out_specs=(repl, repl, repl))
+        out_specs=(repl, repl, repl),
+        check_vma=False)
     jitted = jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
 
     def step(params, opt_state, rng, batch):

@@ -84,10 +84,43 @@ def _check_stacked(x, n: int, what: str) -> None:
 
 
 def _place_stacked(x: Array, mesh: Mesh, n: int, what: str) -> Array:
-    """Validate and row-shard x ([n, ...]) over the set mesh."""
+    """Validate and row-shard x ([n, ...]) over the set mesh.
+
+    Multi-process mode (jax.distributed, mesh spans processes): a global
+    jax.Array with non-addressable shards passes through; host arrays may be
+    either this process's local rows or the full stacked array (see
+    core.mesh.place_stacked_rows) — the analog of each reference worker
+    staging its own tensor before the fused collective."""
+    from ..core.mesh import mesh_is_multiprocess, place_stacked_rows
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        if x.ndim < 1 or x.shape[0] != n:
+            raise ValueError(
+                f"{what}: global array must be stacked [n={n}, ...]; got "
+                f"{tuple(x.shape)}")
+        return x
+    if mesh_is_multiprocess(mesh):
+        # already row-sharded over this mesh (e.g. a collective output fed
+        # back in): no host round trip
+        if isinstance(x, jax.Array) and \
+                x.sharding == stacked_sharding(mesh):
+            return x
+        return place_stacked_rows(np.asarray(x), mesh)
     x = jnp.asarray(x)
     _check_stacked(x, n, what)
     return jax.device_put(x, stacked_sharding(mesh))
+
+
+def local_rows(x) -> np.ndarray:
+    """This process's rows of a stacked (possibly multi-process global)
+    array as numpy — what each reference rank would receive as its own
+    output tensor. Single-controller arrays return all rows."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        if x.sharding.is_fully_replicated:
+            return np.asarray(x)
+        shards = sorted(x.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    return np.asarray(x)
 
 
 def _is_float(dtype) -> bool:

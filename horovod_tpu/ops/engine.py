@@ -132,6 +132,8 @@ class Engine:
         self.cycles = 0
         self.tensors_fused = 0
         self.bytes_processed = 0
+        # cross-process negotiation round counter (multi-process mode)
+        self._negot_round = 0
         # autotuner (HOROVOD_AUTOTUNE=1, parameter_manager.cc analog)
         self.tuner = None
         if cfg.autotune:
@@ -176,7 +178,11 @@ class Engine:
     # -- enqueue API (operations.cc:1408-2025 analogs) ----------------------
     def enqueue(self, work: _Work) -> Handle:
         # Validate the stacked-shape contract up front so the fused path
-        # can't silently mis-reshape a malformed tensor.
+        # can't silently mis-reshape a malformed tensor. In multi-process
+        # mode this also stages the tensor as a global array (the
+        # framework-thread staging the reference does before enqueue,
+        # operations.cc:1436-1556) so the dispatch thread only handles
+        # uniform global arrays.
         if work.request_type in (RequestType.ALLREDUCE,
                                  RequestType.ALLGATHER,
                                  RequestType.BROADCAST,
@@ -184,13 +190,19 @@ class Engine:
                 work.request_type == RequestType.ALLTOALL
                 and work.splits is None):
             if not isinstance(work.tensor, (list, tuple)):
-                t = jnp.asarray(work.tensor)
+                from ..core.mesh import mesh_is_multiprocess
+                mesh = work.process_set.mesh
                 n = work.process_set.size()
-                if t.ndim < 1 or t.shape[0] != n:
-                    raise ValueError(
-                        f"{work.request_type.value} expects a stacked array "
-                        f"with leading axis == process-set size ({n}); got "
-                        f"shape {tuple(t.shape)}")
+                if mesh_is_multiprocess(mesh):
+                    work.tensor = collective_ops._place_stacked(
+                        work.tensor, mesh, n, work.request_type.value)
+                else:
+                    t = jnp.asarray(work.tensor)
+                    if t.ndim < 1 or t.shape[0] != n:
+                        raise ValueError(
+                            f"{work.request_type.value} expects a stacked "
+                            f"array with leading axis == process-set size "
+                            f"({n}); got shape {tuple(t.shape)}")
         with self._qlock:
             if work.name in self._inflight_names:
                 raise DuplicateNameError(
@@ -232,6 +244,31 @@ class Engine:
             batch, self._queue = self._queue, []
         if not batch:
             return
+        # Multi-process: agree with peer engines on which tensors are ready
+        # everywhere before executing (the controller negotiation,
+        # controller.cc:74-442); non-common requests go back on the queue.
+        coord = self._state.coordinator
+        if coord is not None and coord.size > 1:
+            try:
+                batch, deferred = self._negotiate(coord, batch)
+            except Exception as e:  # noqa: BLE001 - peer divergence/timeout
+                # A peer never joined the round (crashed or diverged): fail
+                # every request cleanly instead of hanging callers — the
+                # engine's analog of finalizing the tensor queue with an
+                # error status (tensor_queue.h:35).
+                logger.exception("cross-process negotiation failed")
+                st = Status.unknown(f"negotiation failed: {e}")
+                for w in batch:
+                    with self._qlock:
+                        self._inflight_names.discard(w.name)
+                        self._outstanding.pop(w.name, None)
+                    w.handle._resolve(None, st)
+                return
+            if deferred:
+                with self._qlock:
+                    self._queue = deferred + self._queue
+            if not batch:
+                return
         self.cycles += 1
         tl = self._state.timeline
         if tl is not None:
@@ -243,6 +280,46 @@ class Engine:
             if self.tuner.record(self.bytes_processed - bytes_before):
                 self.fusion_threshold = self.tuner.fusion_threshold_bytes
                 self.cycle_time_s = self.tuner.cycle_time_ms / 1000.0
+
+    def _negotiate(self, coord, batch: List[_Work]
+                   ) -> Tuple[List[_Work], List[_Work]]:
+        """Cross-process readiness agreement (ComputeResponseList's slow
+        path, controller.cc:286-442: workers send ready tensor names, only
+        tensors ready on EVERY member rank execute this cycle).
+
+        Implemented as one coordinator allgather of (name, process_set_id)
+        pairs per negotiation round (csrc/store.cc blob allgather — the
+        SendReadyTensors/RecvReadyTensors transport). Readiness is judged
+        per process set over its MEMBER processes only (the reference keeps
+        one controller per ProcessSet, process_set.h:26), so sub-set
+        collectives don't wait on non-members' queues. The returned ready
+        list is name-sorted so every process compiles and launches the same
+        XLA programs in the same order; deferred requests retry next cycle.
+
+        A round blocks until every process joins it (allgather is
+        collective): the SPMD contract that all controllers keep issuing
+        collectives. Divergence surfaces as a coordinator timeout, which
+        _run_cycle converts into error-status handles, plus stall-inspector
+        warnings meanwhile."""
+        import json
+        self._negot_round += 1
+        mine = sorted({(w.name, w.process_set.process_set_id)
+                       for w in batch})
+        blobs = coord.allgather(json.dumps(mine).encode(),
+                                tag=f"engine-negot-{self._negot_round}")
+        peer_sets = [set(map(tuple, json.loads(b.decode()))) for b in blobs]
+
+        def _ready(w: _Work) -> bool:
+            members = {d.process_index
+                       for d in w.process_set.mesh.devices.flat}
+            key = (w.name, w.process_set.process_set_id)
+            return all(key in peer_sets[p] for p in members)
+
+        ready = sorted((w for w in batch if _ready(w)),
+                       key=lambda w: w.name)
+        ready_names = {w.name for w in ready}
+        deferred = [w for w in batch if w.name not in ready_names]
+        return ready, deferred
 
     def _bucketize(self, batch: List[_Work]) -> List[List[_Work]]:
         """Group fusable requests, splitting at the fusion threshold."""

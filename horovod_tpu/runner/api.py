@@ -58,6 +58,22 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         base_env = dict(os.environ)
         if env:
             base_env.update(env)
+        # Native control-plane store for the workers' Coordinator (same as
+        # the CLI launcher, launch.py run_static) — engine negotiation,
+        # barrier and join ride it in multi-process mode.
+        native_server = None
+        try:
+            from ..native.store import StoreServer
+            native_server = StoreServer()
+            # remote workers must not resolve the launcher's loopback
+            # (same logic as launch.py run_static)
+            all_local = all(h.hostname in exec_lib.LOCAL_NAMES
+                            for h in host_infos)
+            base_env["HOROVOD_NATIVE_KV_ADDR"] = (
+                "127.0.0.1" if all_local else os.uname().nodename)
+            base_env["HOROVOD_NATIVE_KV_PORT"] = str(native_server.port)
+        except Exception:  # noqa: BLE001 — toolchain-less host
+            native_server = None
         # make fn's defining module importable in the workers
         import inspect
         paths = list(sys.path)
@@ -81,6 +97,8 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                         f"Worker rank {w.slot.rank} exited with code {rc}")
         finally:
             server.stop()
+            if native_server is not None:
+                native_server.close()
 
         results = []
         for rank in range(np):

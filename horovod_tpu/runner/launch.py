@@ -124,7 +124,8 @@ def check_build() -> str:
         "  tpu:          [X]",
         "  cpu (virtual mesh): [X]",
         "  nccl/mpi/gloo/ccl: [ ] (not needed: XLA owns the data plane)",
-        "  controller:   single-controller SPMD + jax.distributed multi-host",
+        "  controller:   single-controller SPMD + jax.distributed multi-"
+        "process (tier-3 tested: tests/test_multiprocess.py)",
         "  elastic:      [X]",
         "  timeline:     [X]",
         "  autotune:     [X]",

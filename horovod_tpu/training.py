@@ -101,10 +101,15 @@ def make_train_step(
 
     repl = P()
     sharded = P(axis_name)
+    # check_vma=False: the body may contain pallas_call (fused CE), whose
+    # out_shape carries no varying-manual-axes info; jax's vma tracker
+    # rejects it under shard_map (jax 0.9). out_specs stay authoritative:
+    # params/opt/stats/loss are replicated via the explicit pmeans above.
     smapped = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(repl, repl, repl, sharded, sharded),
-        out_specs=(repl, repl, repl, repl))
+        out_specs=(repl, repl, repl, repl),
+        check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
     step = jax.jit(smapped, donate_argnums=donate_argnums)
     # expose the wrapped optimizer's init so callers build the right state
@@ -170,15 +175,30 @@ def make_gspmd_train_step(
 def init_replicated(tree: Any, mesh: Mesh) -> Any:
     """Pin a pytree to the replicated sharding of `mesh`.
 
+    Multi-process safe: when the mesh spans processes every process
+    contributes its identical copy (core.mesh.place_replicated).
+
     Note: device_put may alias the source buffers (e.g. CPU -> CPU mesh),
     and the train steps donate their param/opt arguments — so treat the
     ORIGINAL tree as consumed once its replicated copy has been through a
     donating step."""
-    repl = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
+    from .core.mesh import place_replicated
+    return jax.tree_util.tree_map(lambda x: place_replicated(x, mesh), tree)
 
 
 def shard_batch(batch: Any, mesh: Mesh, axis_name: str = GLOBAL_AXIS) -> Any:
-    """Shard a host batch along its leading axis over the mesh."""
+    """Shard a host batch along its leading axis over the mesh.
+
+    Single-process: `batch` is the full global batch. Multi-process: each
+    process passes its LOCAL portion (what that worker's data loader
+    produced — the reference's per-rank batch) and the global batch is the
+    concatenation across processes in rank order."""
+    from .core.mesh import mesh_is_multiprocess
+    import numpy as _np
+    if mesh_is_multiprocess(mesh):
+        sh = NamedSharding(mesh, P(axis_name))
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sh, _np.asarray(x)), batch)
     sh = NamedSharding(mesh, P(axis_name))
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
