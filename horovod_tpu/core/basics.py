@@ -50,6 +50,7 @@ class _GlobalState:
         self.parameter_manager = None # autotune.ParameterManager
         self.coordinator = None       # native.store.Coordinator (multi-proc)
         self.joined_ranks = set()
+        self.last_joined_rank = -1
         self.shutdown_requested = False
 
 

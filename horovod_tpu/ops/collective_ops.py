@@ -127,14 +127,62 @@ def _is_float(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.floating)
 
 
+def _engine_route(kind: str, tensor, **fields):
+    """Route a sync collective through the async engine in multi-process
+    mode (reference architecture: the sync API is async + synchronize,
+    torch/mpi_ops.py:157). Serializing every collective through the one
+    dispatch thread guarantees all processes launch the same XLA programs
+    in the same order, and lets negotiation/join zero-fill apply. Returns
+    None when the caller should run the direct path (single process, or
+    already on the engine thread)."""
+    st = basics.get_state()
+    coord = st.coordinator
+    if coord is None or coord.size <= 1 or \
+            getattr(_tl_local, "in_engine", False):
+        return None
+    from . import engine as engine_mod
+    fn = getattr(engine_mod, f"{kind}_async")
+    return fn(tensor, **fields).wait()
+
+
+def _joined_mask(n: int):
+    """[n] 0/1 mask zeroing rows of joined ranks (single-controller
+    uneven-data path; the reference's joined-rank zero-fill,
+    controller.cc:317-320 + fusion-buffer zero memcpy)."""
+    st = basics.get_state()
+    if not st.joined_ranks:
+        return None
+    mask = np.ones((n,), np.float32)
+    for r in st.joined_ranks:
+        if 0 <= r < n:
+            mask[r] = 0.0
+    return jnp.asarray(mask)
+
+
+def _reject_joined(what: str) -> None:
+    """Non-allreduce collectives are unsupported while ranks are joined
+    (reference parity: controller.cc:627-741 error texts)."""
+    st = basics.get_state()
+    if st.joined_ranks:
+        raise ValueError(
+            f"{what} is not supported with Join at this time.")
+
+
 @functools.lru_cache(maxsize=512)
-def _allreduce_fn(mesh: Mesh, op: ReduceOp, dtype_name: str, has_scale: bool):
+def _allreduce_fn(mesh: Mesh, op: ReduceOp, dtype_name: str, has_scale: bool,
+                  has_mask: bool = False):
     n = mesh.devices.size
 
-    def blk(x, pre, post):
+    def blk(x, pre, post, mask):
         dt = x.dtype
         if dt == jnp.bool_:
             x = x.astype(jnp.int32)
+        if has_mask:
+            # zero-fill joined ranks' rows; AVERAGE still divides by the
+            # full set size (reference join test:
+            # averaged == tensor * (size-1) / size)
+            idx = lax.axis_index(AXIS)
+            x = jnp.where(mask[idx] > 0, x, jnp.zeros_like(x))
         if has_scale:
             x = x * pre.astype(x.dtype)
         if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
@@ -160,7 +208,7 @@ def _allreduce_fn(mesh: Mesh, op: ReduceOp, dtype_name: str, has_scale: bool):
         return r
 
     f = shard_map(blk, mesh=mesh,
-                  in_specs=(P(AXIS), P(), P()),
+                  in_specs=(P(AXIS), P(), P(), P()),
                   out_specs=P(AXIS))
     return jax.jit(f)
 
@@ -180,23 +228,36 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_allreduce
         return adasum_allreduce(x, process_set=ps)
+    routed = _engine_route("allreduce", x, op=op, name=name, process_set=ps,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+    if routed is not None:
+        return routed
     x = _place_stacked(x, mesh, n, "allreduce")
     has_scale = (prescale_factor != 1.0) or (postscale_factor != 1.0)
+    mask = _joined_mask(n)
+    if mask is not None and op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"allreduce({op}) is not supported with Join (zero-filled "
+            "rows would corrupt min/max/product)")
     # Topology-aware path (HOROVOD_HIERARCHICAL_ALLREDUCE /
     # HOROVOD_TORUS_ALLREDUCE, operations.cc:548-606): two-level
     # local-RS / cross-AR / local-AG over the (cross, local) mesh.
     cfg = basics.get_config()
     if (cfg.hierarchical_allreduce or cfg.torus_allreduce) and \
-            ps.process_set_id == 0 and not has_scale and \
+            ps.process_set_id == 0 and not has_scale and mask is None and \
             op in (ReduceOp.SUM, ReduceOp.AVERAGE):
         from .cross import two_level_allreduce
         hier = basics.get_hier_mesh()
         if hier.devices.size == n and hier.devices.shape[1] > 1:
             return two_level_allreduce(x, op, hier)
-    f = _allreduce_fn(mesh, op, str(x.dtype), has_scale)
+    f = _allreduce_fn(mesh, op, str(x.dtype), has_scale,
+                      has_mask=mask is not None)
     pre = jnp.asarray(prescale_factor, jnp.float32)
     post = jnp.asarray(postscale_factor, jnp.float32)
-    return f(x, pre, post)
+    if mask is None:
+        mask = jnp.ones((n,), jnp.float32)
+    return f(x, pre, post, mask)
 
 
 @functools.lru_cache(maxsize=512)
@@ -224,6 +285,11 @@ def allgather(x: Union[Array, Sequence[Array]], *,
     concatenated array replicated over the set mesh.
     """
     ps, mesh, n = _resolve(process_set)
+    _reject_joined("Allgather")
+    if not isinstance(x, (list, tuple)):
+        routed = _engine_route("allgather", x, name=name, process_set=ps)
+        if routed is not None:
+            return routed
     if isinstance(x, (list, tuple)):
         if len(x) != n:
             raise ValueError(f"Expected {n} per-rank arrays, got {len(x)}")
@@ -268,9 +334,14 @@ def broadcast(x: Array, root_rank: int = 0, *,
     """Every rank's row replaced by the root's row (hvd.broadcast,
     horovod/torch/mpi_ops.py:813). Root index is the set-local rank."""
     ps, mesh, n = _resolve(process_set)
-    x = _place_stacked(x, mesh, n, "broadcast")
+    _reject_joined("Broadcast")
     if not (0 <= root_rank < n):
         raise ValueError(f"root_rank {root_rank} out of range [0, {n})")
+    routed = _engine_route("broadcast", x, root_rank=root_rank, name=name,
+                           process_set=ps)
+    if routed is not None:
+        return routed
+    x = _place_stacked(x, mesh, n, "broadcast")
     return _broadcast_fn(mesh, root_rank)(x)
 
 
@@ -304,7 +375,11 @@ def alltoall(x: Union[Array, Sequence[Array]],
     sends to rank j): returns (per-rank output list, recv_splits).
     """
     ps, mesh, n = _resolve(process_set)
+    _reject_joined("Alltoall")
     if splits is None:
+        routed = _engine_route("alltoall", x, name=name, process_set=ps)
+        if routed is not None:
+            return routed
         x = _place_stacked(x, mesh, n, "alltoall")
         if x.ndim < 2 or x.shape[1] % n != 0:
             raise ValueError(
@@ -385,8 +460,13 @@ def reducescatter(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     returns a per-rank list with reference chunk sizing.
     """
     ps, mesh, n = _resolve(process_set)
+    _reject_joined("Reducescatter")
     if op == ReduceOp.ADASUM:
         raise ValueError("Adasum reducescatter is not supported")
+    routed = _engine_route("reducescatter", x, op=op, name=name,
+                           process_set=ps)
+    if routed is not None:
+        return routed
     x = _place_stacked(x, mesh, n, "reducescatter")
     if x.ndim < 2:
         raise ValueError("reducescatter requires tensors of rank >= 1")
@@ -421,21 +501,41 @@ def barrier(*, process_set: Optional[ProcessSet] = None) -> None:
         coord.barrier("hvd.barrier")
 
 
-def join() -> int:
-    """Mark this controller as joined; returns last joined rank
-    (hvd.join, operations.cc:1991).
+def join(rank: Optional[int] = None) -> int:
+    """Join op: uneven-participation termination (hvd.join,
+    operations.cc:1991; JoinOp collective_operations.cc:418-432).
 
-    SPMD semantics: uneven-data handling (the reference's zero-fill of a
-    joined rank's contributions, controller.cc:496) happens at the *data*
-    level via the engine's zero-fill path (see ops/engine.py) — device
-    collectives are compiled programs that every process must execute, so a
-    process cannot silently drop out mid-job. join() is therefore a
-    collective termination sync: ALL controllers must call it (in the same
-    control-flow position, like every coordinator collective), after which
-    every worker rank is considered joined. Arrival order is not tracked;
-    the returned value is the highest global rank, matching the
-    single-controller behavior."""
+    **Multi-process mode** (reference semantics): the calling process has
+    run out of data. Blocks until EVERY process has joined; meanwhile this
+    process's engine keeps participating in negotiation and contributes
+    ZERO-filled tensors to peers' allreduces (controller.cc:317-320
+    joined_size accounting; Average still divides by the full set size).
+    Returns the globally-agreed last-joined rank; join state then resets.
+    Only allreduce is supported while ranks are joined — allgather /
+    broadcast / alltoall / reducescatter raise, as in the reference
+    (controller.cc:627-741). The `rank` argument (the reference's device
+    hint, e.g. hvd.join(hvd.local_rank())) is accepted and ignored.
+
+    **Single-controller SPMD mode**: one Python process drives all device
+    ranks, so per-rank early exit is expressed as `join(rank=k)`: marks
+    device rank k joined (non-blocking, returns -1); subsequent allreduces
+    zero-fill row k. A final bare `join()` joins all remaining ranks,
+    resets the join state and returns the last joined rank."""
     st = basics.get_state()
+    coord = st.coordinator
+    if coord is not None and coord.size > 1:
+        return basics.get_engine().join()
+    n = basics.size()
+    if rank is not None:
+        if not (0 <= rank < n):
+            raise ValueError(f"rank {rank} out of range [0, {n})")
+        st.joined_ranks.add(rank)
+        st.last_joined_rank = rank
+        return -1
+    remaining = [r for r in range(n) if r not in st.joined_ranks]
+    last = remaining[-1] if remaining else getattr(
+        st, "last_joined_rank", n - 1)
+    st.joined_ranks = set()
+    st.last_joined_rank = -1
     barrier()
-    st.joined_ranks.update(range(basics.size()))
-    return basics.size() - 1
+    return last

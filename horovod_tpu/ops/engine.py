@@ -134,6 +134,13 @@ class Engine:
         self.bytes_processed = 0
         # cross-process negotiation round counter (multi-process mode)
         self._negot_round = 0
+        # join state (JoinOp, collective_operations.cc:418-432): while
+        # _joined, the engine keeps negotiating with an empty queue and
+        # contributes zero-filled tensors to peers' allreduces
+        self._joined = False
+        self._join_event = threading.Event()
+        self._join_result = -1
+        self._joined_procs: Dict[int, int] = {}   # proc -> announce round
         # autotuner (HOROVOD_AUTOTUNE=1, parameter_manager.cc analog)
         self.tuner = None
         if cfg.autotune:
@@ -239,10 +246,24 @@ class Engine:
             except Exception:  # pragma: no cover - engine must survive
                 logger.exception("engine cycle failed")
 
+    def join(self) -> int:
+        """Process-level join (hvd.join in multi-process mode). Blocks the
+        caller until every process joined; the engine thread keeps
+        negotiating and zero-filling meanwhile. Returns the agreed
+        last-joined rank."""
+        self._join_event.clear()
+        self._joined = True
+        self._wake.set()
+        if not self._join_event.wait(timeout=600):
+            self._joined = False
+            raise TimeoutError(
+                "hvd.join(): not all processes joined within 600s")
+        return self._join_result
+
     def _run_cycle(self) -> None:
         with self._qlock:
             batch, self._queue = self._queue, []
-        if not batch:
+        if not batch and not self._joined:
             return
         # Multi-process: agree with peer engines on which tensors are ready
         # everywhere before executing (the controller negotiation,
@@ -258,10 +279,13 @@ class Engine:
                 # error status (tensor_queue.h:35).
                 logger.exception("cross-process negotiation failed")
                 st = Status.unknown(f"negotiation failed: {e}")
+                tl_ = self._state.timeline
                 for w in batch:
                     with self._qlock:
                         self._inflight_names.discard(w.name)
                         self._outstanding.pop(w.name, None)
+                    if tl_ is not None:
+                        tl_.end(w.name, "QUEUED")
                     w.handle._resolve(None, st)
                 return
             if deferred:
@@ -281,20 +305,32 @@ class Engine:
                 self.fusion_threshold = self.tuner.fusion_threshold_bytes
                 self.cycle_time_s = self.tuner.cycle_time_ms / 1000.0
 
+    @staticmethod
+    def _work_meta(w: _Work) -> dict:
+        t = w.tensor
+        shape = list(getattr(t, "shape", ()))
+        dt = str(getattr(t, "dtype", ""))
+        return {"n": w.name, "s": w.process_set.process_set_id,
+                "t": w.request_type.value, "sh": shape, "dt": dt,
+                "op": w.op.value, "pre": w.prescale, "post": w.postscale,
+                "root": w.root_rank}
+
     def _negotiate(self, coord, batch: List[_Work]
                    ) -> Tuple[List[_Work], List[_Work]]:
-        """Cross-process readiness agreement (ComputeResponseList's slow
-        path, controller.cc:286-442: workers send ready tensor names, only
-        tensors ready on EVERY member rank execute this cycle).
+        """Cross-process readiness agreement (ComputeResponseList,
+        controller.cc:74-442: workers send ready tensor metadata; a tensor
+        executes once every NON-JOINED member rank submitted it —
+        count == size - joined_size, controller.cc:320).
 
-        Implemented as one coordinator allgather of (name, process_set_id)
-        pairs per negotiation round (csrc/store.cc blob allgather — the
-        SendReadyTensors/RecvReadyTensors transport). Readiness is judged
-        per process set over its MEMBER processes only (the reference keeps
-        one controller per ProcessSet, process_set.h:26), so sub-set
-        collectives don't wait on non-members' queues. The returned ready
-        list is name-sorted so every process compiles and launches the same
-        XLA programs in the same order; deferred requests retry next cycle.
+        One coordinator allgather of {joined flag, queued work metadata}
+        per round (csrc/store.cc blob allgather — the SendReadyTensors/
+        RecvReadyTensors transport). Readiness is judged per process set
+        over its member processes (one controller per ProcessSet in the
+        reference, process_set.h:26). The ready list is name-sorted so all
+        processes launch identical XLA programs in identical order;
+        deferred requests retry next cycle. While this process is joined it
+        synthesizes zero-filled entries for peers' allreduces (JoinOp
+        zero-fill, controller.cc:496) and detects all-joined completion.
 
         A round blocks until every process joins it (allgather is
         collective): the SPMD contract that all controllers keep issuing
@@ -303,23 +339,127 @@ class Engine:
         warnings meanwhile."""
         import json
         self._negot_round += 1
-        mine = sorted({(w.name, w.process_set.process_set_id)
-                       for w in batch})
-        blobs = coord.allgather(json.dumps(mine).encode(),
-                                tag=f"engine-negot-{self._negot_round}")
-        peer_sets = [set(map(tuple, json.loads(b.decode()))) for b in blobs]
+        rnd = self._negot_round
+        payload = {"j": bool(self._joined),
+                   "w": [self._work_meta(w) for w in batch],
+                   # rank 0 owns the tunables; peers adopt them below so
+                   # bucketization stays identical across processes
+                   # (SynchronizeParameters, operations.cc:843-846)
+                   "ft": self.fusion_threshold}
+        # Block until every process reaches this round. A slow peer (long
+        # compile / data stall) is NOT an error — the reference waits
+        # indefinitely with stall-inspector warnings (stall_inspector.cc);
+        # retry coordinator timeouts until the engine stops. Re-posting the
+        # same tag/value is idempotent in the native store.
+        from ..native.store import NativeTimeout
+        while True:
+            try:
+                blobs = coord.allgather(json.dumps(payload).encode(),
+                                        tag=f"engine-negot-{rnd}")
+                break
+            except NativeTimeout:
+                if not self._running:
+                    raise
+                logger.warning(
+                    "negotiation round %d still waiting for peers "
+                    "(stall_inspector analog)", rnd)
+        peers = [json.loads(b.decode()) for b in blobs]
+        self.fusion_threshold = peers[0].get("ft", self.fusion_threshold)
+        peer_works = [{(e["n"], e["s"]): e for e in p["w"]} for p in peers]
+        for p, msg in enumerate(peers):
+            if msg["j"] and p not in self._joined_procs:
+                self._joined_procs[p] = rnd
 
-        def _ready(w: _Work) -> bool:
-            members = {d.process_index
-                       for d in w.process_set.mesh.devices.flat}
+        def _members(ps: ProcessSet) -> set:
+            return {d.process_index for d in ps.mesh.devices.flat}
+
+        # classify my works
+        ready: List[_Work] = []
+        deferred: List[_Work] = []
+        errors: List[Tuple[_Work, str]] = []
+        ready_keys = set()
+        for w in batch:
             key = (w.name, w.process_set.process_set_id)
-            return all(key in peer_sets[p] for p in members)
+            need = [p for p in _members(w.process_set)
+                    if p not in self._joined_procs]
+            if not all(key in peer_works[p] for p in need):
+                deferred.append(w)
+                continue
+            metas = [peer_works[p][key] for p in need]
+            m0 = self._work_meta(w)
+            bad = next((m for m in metas
+                        if (m["sh"], m["dt"], m["t"], m["op"]) !=
+                           (m0["sh"], m0["dt"], m0["t"], m0["op"])), None)
+            if bad is not None:
+                errors.append((w, f"Mismatched collective for '{w.name}': "
+                                  f"{bad} vs {m0} (reference "
+                                  "ConstructResponse mismatch error)"))
+            elif self._joined_procs and \
+                    w.request_type != RequestType.ALLREDUCE:
+                errors.append((w, f"{w.request_type.value} is not supported "
+                                  "with Join at this time."))
+            else:
+                ready.append(w)
+                ready_keys.add(key)
+        tl_ = self._state.timeline
+        for w, msg in errors:
+            with self._qlock:
+                self._inflight_names.discard(w.name)
+                self._outstanding.pop(w.name, None)
+            if tl_ is not None:
+                tl_.end(w.name, "QUEUED")
+            w.handle._resolve(None, Status.unknown(msg))
 
-        ready = sorted((w for w in batch if _ready(w)),
-                       key=lambda w: w.name)
-        ready_names = {w.name for w in ready}
-        deferred = [w for w in batch if w.name not in ready_names]
+        # joined: synthesize zero-filled contributions for peer allreduces
+        # on sets THIS process belongs to that are ready without us
+        # (count == size - joined_size path, controller.cc:320)
+        if self._joined:
+            mine = {(w.name, w.process_set.process_set_id) for w in batch}
+            synth_keys = set()
+            my_proc = coord.rank
+            for pw in peer_works:
+                for key, e in pw.items():
+                    if key in mine or key in synth_keys or \
+                            e["t"] != RequestType.ALLREDUCE.value:
+                        continue
+                    try:
+                        ps = self._state.process_set_table.get(e["s"])
+                    except Exception:  # noqa: BLE001 - set unknown here
+                        continue
+                    members = _members(ps)
+                    if my_proc not in members:
+                        continue          # collective doesn't involve us
+                    need = [p for p in members
+                            if p not in self._joined_procs]
+                    if all(key in peer_works[p] for p in need):
+                        synth_keys.add(key)
+                        ready.append(self._make_zero_work(e))
+        ready.sort(key=lambda w: w.name)
+
+        # all-joined: agree on the last joined rank and reset (JoinOp,
+        # collective_operations.cc:425-430)
+        if len(self._joined_procs) == coord.size:
+            last_round = max(self._joined_procs.values())
+            self._join_result = max(
+                p for p, r in self._joined_procs.items() if r == last_round)
+            self._joined_procs = {}
+            if self._joined:
+                self._joined = False
+                self._join_event.set()
         return ready, deferred
+
+    def _make_zero_work(self, meta: dict) -> _Work:
+        """Zero-filled stand-in for a joined process (JoinOp zero
+        contribution, controller.cc:496)."""
+        ps = self._state.process_set_table.get(meta["s"])
+        zero = np.zeros(tuple(meta["sh"]), dtype=np.dtype(meta["dt"]))
+        w = _Work(RequestType(meta["t"]), meta["n"],
+                  collective_ops._place_stacked(
+                      zero, ps.mesh, ps.size(), "allreduce"),
+                  ReduceOp(meta["op"]), ps, Handle(meta["n"]),
+                  root_rank=meta["root"], prescale=meta["pre"],
+                  postscale=meta["post"])
+        return w
 
     def _bucketize(self, batch: List[_Work]) -> List[List[_Work]]:
         """Group fusable requests, splitting at the fusion threshold."""

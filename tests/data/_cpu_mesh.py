@@ -1,0 +1,14 @@
+"""Shared preamble for multi-process worker scripts: pin this process to
+`n` virtual CPU devices BEFORE any jax backend init (env flag must be set
+pre-import; the platform pin must go through jax.config because an ambient
+TPU plugin may have forced its own jax_platforms at import time)."""
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "--xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    import jax
+    jax.config.update("jax_platforms", "cpu")

@@ -16,16 +16,12 @@ import json
 import os
 import sys
 
-# per-process virtual CPU devices, BEFORE any jax backend init
-_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
-          if "--xla_force_host_platform_device_count" not in f]
-_flags.append("--xla_force_host_platform_device_count=2")
-os.environ["XLA_FLAGS"] = " ".join(_flags)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(2)
 
 import jax  # noqa: E402
-
-# the ambient TPU plugin (if any) must not win platform selection
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 

@@ -1,0 +1,62 @@
+"""Multi-process join worker (launched by test_multiprocess.py).
+
+Reference scenario (test/parallel/test_torch.py test_horovod_join_allreduce):
+process 0 runs out of data first and calls hvd.join(); process 1 keeps
+allreducing — its results see zero-filled contributions from process 0's
+devices with Average still dividing by the full size — then joins. Both
+processes must agree join() returned the last joiner.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(2)
+
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main(out_dir: str) -> None:
+    hvd.init()
+    pid = jax.process_index()
+    n = hvd.size()                       # 4 device ranks, 2 per process
+    result = {"pid": pid}
+
+    # both processes participate in the first allreduce
+    t1 = np.full((2, 3), 1.0, np.float32)
+    out1 = hvd.local_rows(hvd.allreduce(t1, hvd.Average, name="t1"))
+    np.testing.assert_allclose(out1, np.ones((2, 3)))   # 4 ones / 4
+
+    if pid == 0:
+        ret = hvd.join()
+    else:
+        # process 0 is joined: its device rows contribute zeros, Average
+        # divides by the full size (reference: tensor * (size-1)/size with
+        # one joined process owning half the devices -> value / 2)
+        t2 = np.full((2, 3), 8.0, np.float32)
+        out2 = hvd.local_rows(hvd.allreduce(t2, hvd.Average, name="t2"))
+        np.testing.assert_allclose(out2, np.full((2, 3), 4.0), rtol=1e-6)
+        result["joined_allreduce"] = out2.tolist()
+        ret = hvd.join()
+
+    assert ret == 1, f"last joined process should be 1, got {ret}"
+    result["join_ret"] = ret
+
+    # join state reset: collectives work again for everyone
+    t3 = np.full((2, 3), 2.0, np.float32)
+    out3 = hvd.local_rows(hvd.allreduce(t3, hvd.Average, name="t3"))
+    np.testing.assert_allclose(out3, np.full((2, 3), 2.0))
+    result["ok"] = True
+    with open(os.path.join(out_dir, f"result.{pid}.json"), "w") as f:
+        json.dump(result, f)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

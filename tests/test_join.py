@@ -1,0 +1,54 @@
+"""Join / uneven-participation semantics (reference: JoinOp
+collective_operations.cc:418-432, joined_size zero-fill controller.cc:496,
+test/parallel/test_torch.py test_horovod_join_*)."""
+import numpy as np
+import pytest
+
+
+class TestSingleControllerJoin:
+    def test_join_zero_fills_allreduce(self, hvd):
+        n = hvd.size()
+        x = np.ones((n, 4), np.float32)
+        # reference contract: averaged == tensor * (size - 1) / size
+        assert hvd.join(rank=3) == -1
+        out = np.asarray(hvd.allreduce(x, hvd.Average))
+        np.testing.assert_allclose(out, np.full((n, 4), (n - 1) / n),
+                                   rtol=1e-6)
+        # sum path zero-fills too
+        out = np.asarray(hvd.allreduce(x, hvd.Sum))
+        np.testing.assert_allclose(out, np.full((n, 4), n - 1.0))
+        # bare join(): everyone joins, state resets, last joined rank is
+        # the final holdout
+        assert hvd.join() == n - 1
+        out = np.asarray(hvd.allreduce(x, hvd.Average))
+        np.testing.assert_allclose(out, np.ones((n, 4)))
+
+    def test_join_async_engine_path(self, hvd):
+        n = hvd.size()
+        x = np.ones((n, 2), np.float32)
+        hvd.join(rank=0)
+        h = hvd.allreduce_async(x, hvd.Average, name="join_t")
+        out = np.asarray(hvd.synchronize(h))
+        np.testing.assert_allclose(out, np.full((n, 2), (n - 1) / n),
+                                   rtol=1e-6)
+        hvd.join()
+
+    def test_join_rejects_other_collectives(self, hvd):
+        n = hvd.size()
+        x = np.ones((n, 4), np.float32)
+        hvd.join(rank=1)
+        with pytest.raises(ValueError, match="not supported with Join"):
+            hvd.allgather(x)
+        with pytest.raises(ValueError, match="not supported with Join"):
+            hvd.broadcast(x, 0)
+        with pytest.raises(ValueError, match="not supported with Join"):
+            hvd.alltoall(np.ones((n, n), np.float32))
+        with pytest.raises(ValueError, match="not supported with Join"):
+            hvd.reducescatter(x)
+        with pytest.raises(ValueError, match="not supported with Join"):
+            hvd.allreduce(x, hvd.Min)
+        hvd.join()
+
+    def test_join_rank_validation(self, hvd):
+        with pytest.raises(ValueError, match="out of range"):
+            hvd.join(rank=99)

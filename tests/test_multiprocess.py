@@ -13,11 +13,10 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-WORKER = os.path.join(HERE, "data", "mp_jax_worker.py")
 REPO = os.path.dirname(HERE)
 
 
-def test_hvdrun_np2_jax_plane(tmp_path):
+def _hvdrun_np2(worker: str, tmp_path, timeout=240):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     # the launcher runs in a subprocess too, so a hung worker cannot wedge
@@ -25,17 +24,30 @@ def test_hvdrun_np2_jax_plane(tmp_path):
     proc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
          "--stall-check-time-seconds", "30",
-         sys.executable, WORKER, str(tmp_path)],
-        env=env, capture_output=True, text=True, timeout=240)
+         sys.executable, os.path.join(HERE, "data", worker), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=timeout)
     assert proc.returncode == 0, (
         f"hvdrun failed rc={proc.returncode}\n--- stdout ---\n"
         f"{proc.stdout[-4000:]}\n--- stderr ---\n{proc.stderr[-4000:]}")
-
     results = sorted(glob.glob(str(tmp_path / "result.*.json")))
     assert len(results) == 2, (results, proc.stdout[-2000:])
+    out = []
     for path in results:
         with open(path) as f:
             r = json.load(f)
         assert r["ok"] is True
+        out.append(r)
+    return out
+
+
+def test_hvdrun_np2_jax_plane(tmp_path):
+    for r in _hvdrun_np2("mp_jax_worker.py", tmp_path):
         assert r["eager_allreduce"] == [[6.0] * 3] * 2
         assert r["train_loss"] > 0
+
+
+def test_hvdrun_np2_join_zero_fill(tmp_path):
+    results = _hvdrun_np2("mp_join_worker.py", tmp_path)
+    assert all(r["join_ret"] == 1 for r in results)
+    r1 = next(r for r in results if r["pid"] == 1)
+    assert r1["joined_allreduce"] == [[4.0] * 3] * 2
