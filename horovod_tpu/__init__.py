@@ -61,4 +61,4 @@ from .checkpoint import (                                      # noqa: F401
     Checkpointer, save_checkpoint, restore_checkpoint,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

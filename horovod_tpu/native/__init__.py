@@ -19,7 +19,14 @@ import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_CSRC = os.path.abspath(os.path.join(_HERE, "..", "..", "csrc"))
+# repo layout first (editable installs), then the in-package copy that
+# wheels/sdists ship (see setup.py build_py hook)
+_CSRC_CANDIDATES = (
+    os.path.abspath(os.path.join(_HERE, "..", "..", "csrc")),
+    os.path.join(_HERE, "csrc"),
+)
+_CSRC = next((p for p in _CSRC_CANDIDATES if os.path.isdir(p)),
+             _CSRC_CANDIDATES[0])
 _BUILD_DIR = os.path.join(_HERE, "_build")
 
 _lock = threading.Lock()

@@ -145,18 +145,23 @@ def _engine_route(kind: str, tensor, **fields):
     return fn(tensor, **fields).wait()
 
 
-def _joined_mask(n: int):
-    """[n] 0/1 mask zeroing rows of joined ranks (single-controller
-    uneven-data path; the reference's joined-rank zero-fill,
-    controller.cc:317-320 + fusion-buffer zero memcpy)."""
+def _joined_mask(ps: ProcessSet, n: int):
+    """[n] 0/1 mask over SET-LOCAL rows zeroing joined ranks'
+    contributions (single-controller uneven-data path; the reference's
+    joined-rank zero-fill, controller.cc:317-320). st.joined_ranks holds
+    GLOBAL ranks; a sub-set row i corresponds to global rank
+    ps.ranks[i]."""
     st = basics.get_state()
     if not st.joined_ranks:
         return None
+    global_ranks = list(ps.ranks) if ps.ranks else list(range(n))
     mask = np.ones((n,), np.float32)
-    for r in st.joined_ranks:
-        if 0 <= r < n:
-            mask[r] = 0.0
-    return jnp.asarray(mask)
+    hit = False
+    for i, g in enumerate(global_ranks[:n]):
+        if g in st.joined_ranks:
+            mask[i] = 0.0
+            hit = True
+    return jnp.asarray(mask) if hit else None
 
 
 def _reject_joined(what: str) -> None:
@@ -166,6 +171,17 @@ def _reject_joined(what: str) -> None:
     if st.joined_ranks:
         raise ValueError(
             f"{what} is not supported with Join at this time.")
+
+
+def _reject_multiprocess(what: str) -> None:
+    """Paths that cannot yet be serialized through the engine raise in
+    multi-process mode instead of hanging in an unmatched device
+    collective."""
+    st = basics.get_state()
+    if st.coordinator is not None and st.coordinator.size > 1:
+        raise NotImplementedError(
+            f"{what} is not supported in multi-process mode yet; use the "
+            "uniform (stacked-array) form, which routes through the engine")
 
 
 @functools.lru_cache(maxsize=512)
@@ -226,6 +242,7 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     """
     ps, mesh, n = _resolve(process_set)
     if op == ReduceOp.ADASUM:
+        _reject_multiprocess("Adasum allreduce")
         from .adasum import adasum_allreduce
         return adasum_allreduce(x, process_set=ps)
     routed = _engine_route("allreduce", x, op=op, name=name, process_set=ps,
@@ -235,7 +252,7 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
         return routed
     x = _place_stacked(x, mesh, n, "allreduce")
     has_scale = (prescale_factor != 1.0) or (postscale_factor != 1.0)
-    mask = _joined_mask(n)
+    mask = _joined_mask(ps, n)
     if mask is not None and op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
             f"allreduce({op}) is not supported with Join (zero-filled "
@@ -291,6 +308,7 @@ def allgather(x: Union[Array, Sequence[Array]], *,
         if routed is not None:
             return routed
     if isinstance(x, (list, tuple)):
+        _reject_multiprocess("Ragged (per-rank list) allgather")
         if len(x) != n:
             raise ValueError(f"Expected {n} per-rank arrays, got {len(x)}")
         shapes = {tuple(a.shape[1:]) for a in x}
@@ -389,6 +407,7 @@ def alltoall(x: Union[Array, Sequence[Array]],
 
     # Ragged path: static splits -> static slices, computed on the global
     # array (XLA lowers the gathers to collectives under the hood).
+    _reject_multiprocess("Ragged (splits) alltoall")
     splits = [list(map(int, s)) for s in splits]
     if len(splits) != n or any(len(s) != n for s in splits):
         raise ValueError(f"splits must be an {n}x{n} nested list")

@@ -248,16 +248,21 @@ class Engine:
 
     def join(self) -> int:
         """Process-level join (hvd.join in multi-process mode). Blocks the
-        caller until every process joined; the engine thread keeps
-        negotiating and zero-filling meanwhile. Returns the agreed
-        last-joined rank."""
+        caller until every process joined — indefinitely, like the
+        reference (peers may train arbitrarily long before joining; a
+        local timeout would desynchronize the joined_procs accounting on
+        the peers). The engine thread keeps negotiating and zero-filling
+        meanwhile. Returns the agreed last-joined rank (the last joining
+        process's lowest global device rank, i.e. its hvd.rank())."""
         self._join_event.clear()
         self._joined = True
         self._wake.set()
-        if not self._join_event.wait(timeout=600):
-            self._joined = False
-            raise TimeoutError(
-                "hvd.join(): not all processes joined within 600s")
+        while not self._join_event.wait(timeout=60):
+            if not self._running:
+                self._joined = False
+                raise RuntimeError("engine stopped while waiting in join()")
+            logger.warning("hvd.join(): still waiting for peers to join "
+                           "(stall_inspector analog)")
         return self._join_result
 
     def _run_cycle(self) -> None:
@@ -377,7 +382,6 @@ class Engine:
         ready: List[_Work] = []
         deferred: List[_Work] = []
         errors: List[Tuple[_Work, str]] = []
-        ready_keys = set()
         for w in batch:
             key = (w.name, w.process_set.process_set_id)
             need = [p for p in _members(w.process_set)
@@ -390,17 +394,24 @@ class Engine:
             bad = next((m for m in metas
                         if (m["sh"], m["dt"], m["t"], m["op"]) !=
                            (m0["sh"], m0["dt"], m0["t"], m0["op"])), None)
+            joined_members = any(p in self._joined_procs
+                                 for p in _members(w.process_set))
             if bad is not None:
                 errors.append((w, f"Mismatched collective for '{w.name}': "
                                   f"{bad} vs {m0} (reference "
                                   "ConstructResponse mismatch error)"))
-            elif self._joined_procs and \
+            elif joined_members and \
                     w.request_type != RequestType.ALLREDUCE:
                 errors.append((w, f"{w.request_type.value} is not supported "
                                   "with Join at this time."))
+            elif joined_members and w.op not in (ReduceOp.SUM,
+                                                 ReduceOp.AVERAGE):
+                # zero-fill would corrupt min/max/product (same guard as
+                # the single-controller path)
+                errors.append((w, f"allreduce({w.op}) is not supported "
+                                  "with Join (zero-filled contributions)"))
             else:
                 ready.append(w)
-                ready_keys.add(key)
         tl_ = self._state.timeline
         for w, msg in errors:
             with self._qlock:
@@ -420,7 +431,9 @@ class Engine:
             for pw in peer_works:
                 for key, e in pw.items():
                     if key in mine or key in synth_keys or \
-                            e["t"] != RequestType.ALLREDUCE.value:
+                            e["t"] != RequestType.ALLREDUCE.value or \
+                            ReduceOp(e["op"]) not in (ReduceOp.SUM,
+                                                      ReduceOp.AVERAGE):
                         continue
                     try:
                         ps = self._state.process_set_table.get(e["s"])
@@ -440,8 +453,15 @@ class Engine:
         # collective_operations.cc:425-430)
         if len(self._joined_procs) == coord.size:
             last_round = max(self._joined_procs.values())
-            self._join_result = max(
+            last_proc = max(
                 p for p, r in self._joined_procs.items() if r == last_round)
+            # report the process's lowest global DEVICE rank (its
+            # hvd.rank()), keeping the return comparable with the
+            # single-controller mode's device-rank semantics
+            mesh = self._state.mesh
+            self._join_result = min(
+                (i for i, d in enumerate(mesh.devices.flat)
+                 if d.process_index == last_proc), default=last_proc)
             self._joined_procs = {}
             if self._joined:
                 self._joined = False

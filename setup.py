@@ -1,0 +1,28 @@
+"""Wheel build hook: ship csrc/*.cc inside the package so installed (non-
+editable) copies can lazily compile the native runtime (native/__init__.py
+searches horovod_tpu/native/csrc after the repo layout). All metadata lives
+in pyproject.toml; this file only adds the copy step — the rebuild's analog
+of the reference's extension build orchestration (setup.py:35-48), which is
+otherwise unnecessary because compilation happens at first use."""
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildPyWithCsrc(build_py):
+    def run(self):
+        super().run()
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, "csrc")
+        if os.path.isdir(src):
+            dst = os.path.join(self.build_lib, "horovod_tpu", "native",
+                               "csrc")
+            os.makedirs(dst, exist_ok=True)
+            for f in os.listdir(src):
+                if f.endswith(".cc"):
+                    shutil.copy2(os.path.join(src, f), os.path.join(dst, f))
+
+
+setup(cmdclass={"build_py": BuildPyWithCsrc})

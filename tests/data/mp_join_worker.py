@@ -45,7 +45,9 @@ def main(out_dir: str) -> None:
         result["joined_allreduce"] = out2.tolist()
         ret = hvd.join()
 
-    assert ret == 1, f"last joined process should be 1, got {ret}"
+    # last joiner is process 1, whose lowest global device rank (its
+    # hvd.rank()) is 2
+    assert ret == 2, f"join() should return rank 2, got {ret}"
     result["join_ret"] = ret
 
     # join state reset: collectives work again for everyone

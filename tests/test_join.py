@@ -52,3 +52,19 @@ class TestSingleControllerJoin:
     def test_join_rank_validation(self, hvd):
         with pytest.raises(ValueError, match="out of range"):
             hvd.join(rank=99)
+
+    def test_join_subset_mask_uses_set_local_rows(self, hvd):
+        """A joined GLOBAL rank must map to its SET-LOCAL row; joined
+        ranks outside the set must not affect it."""
+        ps = hvd.add_process_set([4, 6])
+        x = np.ones((2, 3), np.float32)
+        # joined rank 1 is not in the set: result unaffected
+        hvd.join(rank=1)
+        out = np.asarray(hvd.allreduce(x, hvd.Sum, process_set=ps))
+        np.testing.assert_allclose(out, np.full((2, 3), 2.0))
+        # joined rank 6 is set-local row 1
+        hvd.join(rank=6)
+        out = np.asarray(hvd.allreduce(x, hvd.Sum, process_set=ps))
+        np.testing.assert_allclose(out, np.full((2, 3), 1.0))
+        hvd.join()
+        hvd.remove_process_set(ps)

@@ -48,6 +48,6 @@ def test_hvdrun_np2_jax_plane(tmp_path):
 
 def test_hvdrun_np2_join_zero_fill(tmp_path):
     results = _hvdrun_np2("mp_join_worker.py", tmp_path)
-    assert all(r["join_ret"] == 1 for r in results)
+    assert all(r["join_ret"] == 2 for r in results)
     r1 = next(r for r in results if r["pid"] == 1)
     assert r1["joined_allreduce"] == [[4.0] * 3] * 2
