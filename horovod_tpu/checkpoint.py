@@ -230,13 +230,18 @@ class FileBackedState(State):
         self._ckpt.save(int(step), dict(self._saved), force=True)
         self._commit_count += 1
 
-    def load_latest(self) -> bool:
+    def load_latest(self, target: Optional[Any] = None) -> bool:
         """Restore the most recent on-disk commit into live values.
-        Returns False when no checkpoint exists yet."""
+        Returns False when no checkpoint exists yet.
+
+        ``target``: optional pytree with the desired structure (e.g. optax
+        NamedTuple states) — without it orbax restores plain dicts/lists
+        (StandardRestore topology warning), which breaks consumers that
+        attribute-access state fields."""
         step = self._ckpt.latest_step()
         if step is None:
             return False
-        tree = self._ckpt.restore(step)
+        tree = self._ckpt.restore(step, target=target)
         self._values.update(tree)
         self.save()
         return True

@@ -27,7 +27,29 @@ _CSRC_CANDIDATES = (
 )
 _CSRC = next((p for p in _CSRC_CANDIDATES if os.path.isdir(p)),
              _CSRC_CANDIDATES[0])
-_BUILD_DIR = os.path.join(_HERE, "_build")
+
+
+def _build_dir() -> str:
+    """In-package _build when writable (repo checkouts), else a per-user
+    cache (system-wide installs where site-packages is read-only)."""
+    in_pkg = os.path.join(_HERE, "_build")
+    try:
+        os.makedirs(in_pkg, exist_ok=True)
+        probe = os.path.join(in_pkg, ".w")
+        with open(probe, "w"):
+            pass
+        os.unlink(probe)
+        return in_pkg
+    except OSError:
+        cache = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")),
+            "horovod_tpu", "native_build")
+        os.makedirs(cache, exist_ok=True)
+        return cache
+
+
+_BUILD_DIR = _build_dir()
 
 _lock = threading.Lock()
 _lib = None

@@ -39,6 +39,38 @@ def broadcast_parameters(params: Any, root_rank: int = 0, *,
     n = ps.size()
     mesh = ps.mesh
     repl = NamedSharding(mesh, P())
+    from ..core.mesh import mesh_is_multiprocess, place_replicated
+    multi = mesh_is_multiprocess(mesh)
+
+    if multi:
+        # Replicated state may DISAGREE across processes (e.g. a fresh
+        # worker joining after an elastic reset): run real row broadcasts
+        # from the root and re-replicate the root's copy — the reference's
+        # broadcast_parameters contract. Enqueue EVERY leaf async first so
+        # one engine cycle negotiates the whole batch (the reference fuses
+        # the same way via grouped enqueue, torch/functions.py), then wait.
+        from ..ops import engine as engine_mod
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        handles, stacked_flags = [], []
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable \
+                    and not leaf.sharding.is_fully_replicated:
+                payload, is_stacked = leaf, True   # already stacked global
+            else:
+                host = np.asarray(leaf)
+                is_stacked = _is_stacked(host, n)
+                payload = jnp.asarray(host) if is_stacked else jnp.asarray(
+                    np.broadcast_to(host[None], (n,) + host.shape))
+            stacked_flags.append(is_stacked)
+            handles.append(engine_mod.broadcast_async(
+                payload, root_rank, name=f"bcast_params.{i}",
+                process_set=ps))
+        out_leaves = []
+        for is_stacked, h in zip(stacked_flags, handles):
+            out = h.wait()
+            out_leaves.append(out if is_stacked else place_replicated(
+                collective_ops.local_rows(out)[0], mesh))
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
     def one(leaf):
         leaf = jnp.asarray(leaf)
@@ -84,14 +116,16 @@ def broadcast_object(obj: Any, root_rank: int = 0, *,
     # the root's payload size first, pad everyone to it, broadcast payload.
     local_size = np.full((n, 1), len(payload), np.int32)
     size_out = collective_ops.broadcast(local_size, root_rank, process_set=ps)
-    root_size = int(np.asarray(size_out)[0, 0])
+    # read via this process's own rows — row 0 may be non-addressable here
+    root_size = int(collective_ops.local_rows(size_out)[0, 0])
     buf = np.zeros((root_size,), np.uint8)
     buf[:min(len(payload), root_size)] = np.frombuffer(
         payload, dtype=np.uint8)[:root_size]
     stacked = np.broadcast_to(buf[None], (n,) + buf.shape)
     out = collective_ops.broadcast(jnp.asarray(stacked), root_rank,
                                    process_set=ps)
-    return pickle.loads(np.asarray(out[0]).tobytes())
+    return pickle.loads(
+        collective_ops.local_rows(out)[0].astype(np.uint8).tobytes())
 
 
 def allgather_object(obj: Any, *,

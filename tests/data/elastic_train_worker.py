@@ -1,0 +1,140 @@
+"""Elastic training worker (launched by test_elastic_integration.py).
+
+The reference model: test/integration/data/elastic_torch_main.py — a real
+training loop under @hvd.elastic.run with committed state, killed mid-run
+and resumed. Here: 2 processes x 1 CPU device train a linear model with the
+in-graph DP step; FileBackedState commits every 3 steps; rank 1 kills
+itself at step 7 of the first incarnation; the relaunched job must resume
+from the last commit (step 6) and run to step 12 with identical params on
+every rank.
+"""
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.checkpoint import FileBackedState  # noqa: E402
+
+TARGET_STEPS = 12
+COMMIT_EVERY = 3
+KILL_AT_STEP = 7
+
+OUT = os.environ["ELASTIC_TRAIN_OUT"]
+LOG = os.path.join(OUT, "events.log")
+KILL_FLAG = os.path.join(OUT, "killed.flag")
+CKPT_DIR = os.path.join(OUT, "ckpt")
+
+
+def log(msg: str) -> None:
+    with open(LOG, "a") as f:
+        f.write(msg + "\n")
+
+
+def param_hash(tree) -> str:
+    flat = np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree_util.tree_leaves(tree)])
+    return hashlib.sha256(flat.astype(np.float64).tobytes()).hexdigest()[:16]
+
+
+def make_step(mesh):
+    import flax.linen as nn
+    import optax
+
+    from horovod_tpu.training import make_train_step
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    model = Net()
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 3), np.float32))
+    step = make_train_step(lambda v, x: model.apply(v, x),
+                           optax.sgd(0.05), mesh, donate=False)
+    return step, variables["params"]
+
+
+@hvd.elastic.run
+def train(state):
+    proc_rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    mesh = hvd.core.basics.get_mesh()
+    step_fn, init_params = make_step(mesh)
+
+    from horovod_tpu.training import init_replicated, shard_batch
+    params = init_replicated(state.params if state.params is not None
+                             else init_params, mesh)
+    opt_state = init_replicated(
+        state.opt_state if state.opt_state is not None
+        else step_fn.init_opt_state(params), mesh)
+
+    log(f"incarnation rank={proc_rank} start_step={state.step} "
+        f"hash={param_hash(params)}")
+
+    while state.step < TARGET_STEPS:
+        rng = np.random.RandomState(state.step)   # deterministic data
+        x_local = rng.rand(4, 3).astype(np.float32)
+        y_local = rng.randint(0, 4, (4,)).astype(np.int32)
+        images = shard_batch(x_local, mesh)
+        labels = shard_batch(y_local, mesh)
+        params, opt_state, _, loss = step_fn(params, opt_state, {},
+                                             images, labels)
+        state.step += 1
+        log(f"step rank={proc_rank} step={state.step} "
+            f"loss={float(loss):.4f}")
+
+        if state.step % COMMIT_EVERY == 0:
+            state.params = jax.device_get(params)
+            state.opt_state = jax.device_get(opt_state)
+            state.commit()
+            log(f"commit rank={proc_rank} step={state.step} "
+                f"hash={param_hash(state.params)}")
+
+        if (proc_rank == 1 and state.step == KILL_AT_STEP
+                and not os.path.exists(KILL_FLAG)):
+            with open(KILL_FLAG, "w") as f:
+                f.write(str(state.step))
+            log(f"kill rank={proc_rank} step={state.step}")
+            os._exit(1)
+
+    return params
+
+
+def main() -> None:
+    hvd.init()
+    proc_rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    state = FileBackedState(CKPT_DIR, async_save=False,
+                            params=None, opt_state=None, step=0)
+    # restore target preserves optax NamedTuple structure (orbax restores
+    # bare dicts otherwise)
+    mesh = hvd.core.basics.get_mesh()
+    step_fn, init_params = make_step(mesh)
+    target = {"params": jax.device_get(init_params),
+              "opt_state": jax.device_get(
+                  step_fn.init_opt_state(init_params)),
+              "step": 0}
+    if state.load_latest(target=target):
+        log(f"resumed rank={proc_rank} step={state.step} "
+            f"hash={param_hash(state.params)}")
+
+    params = train(state)
+
+    final = {"rank": proc_rank, "step": int(state.step),
+             "hash": param_hash(params)}
+    with open(os.path.join(OUT, f"final.{proc_rank}.json"), "w") as f:
+        json.dump(final, f)
+    log(f"done rank={proc_rank} step={state.step}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,67 @@ def test_elastic_launcher_topology_change(tmp_path):
         driver_log.close()
 
 
+def test_elastic_training_survives_worker_kill(tmp_path):
+    """The VERDICT tier: a REAL training loop (hvd.init + in-graph DP step
+    + @hvd.elastic.run + FileBackedState) killed mid-run; committed
+    step/params must survive the reset (reference:
+    test/integration/elastic_common.py + data/elastic_torch_main.py)."""
+    import glob
+    import json
+
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:2\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hostfile}\n")
+    disc.chmod(0o755)
+    worker = os.path.join(REPO, "tests", "data", "elastic_train_worker.py")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TRAIN_OUT"] = str(tmp_path)
+
+    driver_log = open(tmp_path / "driver.log", "w")
+    try:
+        rc = subprocess.call(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "2", "--min-np", "2", "--max-np", "2",
+             "--host-discovery-script", str(disc),
+             sys.executable, worker],
+            env=env, stdout=driver_log, stderr=subprocess.STDOUT,
+            cwd=str(tmp_path), timeout=420)
+    finally:
+        driver_log.close()
+    log = _log_lines(str(tmp_path / "events.log"))
+    assert rc == 0, f"driver rc={rc}\nevents:\n" + "\n".join(log[-30:]) + \
+        "\ndriver:\n" + "\n".join(
+            _log_lines(str(tmp_path / "driver.log"))[-20:])
+
+    # the failure was actually injected
+    assert os.path.exists(tmp_path / "killed.flag")
+    kills = [ln for ln in log if ln.startswith("kill ")]
+    assert kills and "step=7" in kills[0]
+
+    # the relaunched incarnation resumed from the last commit (step 6),
+    # not from scratch and not from the uncommitted step 7
+    resumes = [ln for ln in log if ln.startswith("resumed ")]
+    assert len(resumes) >= 2, log
+    assert all("step=6" in ln for ln in resumes), resumes
+    commit6 = next(ln for ln in log
+                   if ln.startswith("commit ") and "step=6" in ln)
+    committed_hash = commit6.split("hash=")[1]
+    assert all(ln.split("hash=")[1] == committed_hash for ln in resumes), \
+        (commit6, resumes)
+
+    # both ranks finished all steps with identical final params
+    finals = []
+    for path in sorted(glob.glob(str(tmp_path / "final.*.json"))):
+        with open(path) as f:
+            finals.append(json.load(f))
+    assert len(finals) == 2, (finals, log[-10:])
+    assert all(f["step"] == 12 for f in finals)
+    assert finals[0]["hash"] == finals[1]["hash"]
+
+
 def test_elastic_launcher_completes_without_change(tmp_path):
     """Steady topology: job runs to completion, rc 0, ranks distinct."""
     hostfile = tmp_path / "hosts.txt"
