@@ -1,0 +1,133 @@
+"""Shared multi-process CPU data plane for foreign-framework bindings.
+
+The torch and keras bindings (interop/torch.py, interop/keras.py) run one
+model replica per Python process and exchange numpy buffers over the native
+shared-memory segment (csrc/shm_coll.cc) — the role the reference's Gloo
+CPU ops play for its torch/TF bindings (horovod/common/ops/
+gloo_operations.cc). Identity comes from the launcher env
+(HOROVOD_RANK/SIZE, the gloo_run.py:66-78 contract), so
+`hvdrun -np N python script.py` works unchanged for either framework.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+Average = "average"
+Sum = "sum"
+
+_comm = None
+_rank = 0
+_size = 1
+
+
+def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
+    """Initialize from launcher env; single-process fallback when unset.
+    Multi-process needs the native shm library."""
+    global _comm, _rank, _size
+    _rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    _size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    if _size > 1 and _comm is None:
+        from ..native.shm import ShmComm
+        gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
+        name = comm_name or \
+            f"hvd_plane_{os.environ.get('HOROVOD_JOB_ID', default_job)}"
+        _comm = ShmComm(name, _rank, _size, gen=gen)
+
+
+def shutdown() -> None:
+    global _comm
+    if _comm is not None:
+        _comm.close()
+        _comm = None
+
+
+def rank() -> int:
+    return _rank
+
+
+def size() -> int:
+    return _size
+
+
+def local_rank() -> int:
+    return int(os.environ.get("HOROVOD_LOCAL_RANK", _rank))
+
+
+def local_size() -> int:
+    return int(os.environ.get("HOROVOD_LOCAL_SIZE", _size))
+
+
+def is_initialized() -> bool:
+    return _size == 1 or _comm is not None
+
+
+def comm():
+    return _comm
+
+
+def allreduce_np(arr: np.ndarray, op: str = Sum) -> np.ndarray:
+    """Sum-allreduce (caller divides for Average — dtype-specific)."""
+    if _size == 1:
+        return arr
+    return _comm.allreduce(np.ascontiguousarray(arr), op="sum")
+
+
+def allgather_np(arr: np.ndarray) -> np.ndarray:
+    if _size == 1:
+        return arr
+    return _comm.allgather(np.ascontiguousarray(arr))
+
+
+def broadcast_np(arr: np.ndarray, root: int = 0) -> np.ndarray:
+    if _size == 1:
+        return arr
+    return _comm.broadcast(np.ascontiguousarray(arr), root=root)
+
+
+def reducescatter_np(arr: np.ndarray) -> np.ndarray:
+    if _size == 1:
+        return arr
+    return _comm.reducescatter(np.ascontiguousarray(arr), op="sum")
+
+
+def barrier() -> None:
+    if _comm is not None:
+        _comm.barrier()
+
+
+def allgather_object(obj: Any) -> list:
+    """Gather a picklable object from every rank into a rank-ordered list
+    (tensorflow/functions.py:141 allgather_object protocol: gather sizes,
+    pad to max, gather payloads)."""
+    if _size == 1:
+        return [obj]
+    blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = _comm.allgather(
+        np.array([[blob.size]], dtype=np.int64)).ravel()
+    pad = int(sizes.max())
+    buf = np.zeros((1, pad), np.uint8)
+    buf[0, :blob.size] = blob
+    out = _comm.allgather(buf)
+    return [pickle.loads(out[i, :int(sizes[i])].tobytes())
+            for i in range(_size)]
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    """Pickle-broadcast (torch/functions.py broadcast_object protocol:
+    size first, then payload)."""
+    if _size == 1:
+        return obj
+    if _rank == root_rank:
+        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        n = np.array([blob.size], dtype=np.int64)
+    else:
+        blob = np.zeros(0, np.uint8)
+        n = np.zeros(1, dtype=np.int64)
+    n = _comm.broadcast(n, root=root_rank)
+    buf = blob if _rank == root_rank else np.zeros(int(n[0]), np.uint8)
+    buf = _comm.broadcast(buf, root=root_rank)
+    return pickle.loads(buf.tobytes())

@@ -1,0 +1,212 @@
+"""tf.keras binding: DistributedOptimizer + broadcast + callbacks.
+
+Re-design of the reference's keras layer (horovod/keras/__init__.py,
+horovod/tensorflow/keras/__init__.py, shared impl horovod/_keras/ — the
+reference's largest user surface). Instead of custom TF C++ kernels
+(tensorflow/mpi_ops.cc), collectives run over the shared multi-process CPU
+plane (interop/_plane.py -> csrc/shm_coll.cc), staged through numpy: each
+rank is a separate Python process holding a keras model replica, launched
+with `hvdrun -np N python keras_script.py`.
+
+Graph mode: gradient allreduce is wrapped in `tf.py_function`, so it works
+inside keras' tf.function train step. XLA jit cannot trace py_function —
+compile with `jit_compile=False` (the same constraint the reference's
+non-XLA op path has with HOROVOD_ENABLE_XLA_OPS=0).
+
+Usage (mirrors `import horovod.tensorflow.keras as hvd`):
+
+    import horovod_tpu.interop.keras as hvd
+    hvd.init()
+    model.compile(optimizer=hvd.DistributedOptimizer(opt),
+                  loss=..., jit_compile=False)
+    model.fit(..., callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from . import _plane
+from . import keras_callbacks as callbacks  # noqa: F401  (hvd.callbacks.*)
+
+Average = _plane.Average
+Sum = _plane.Sum
+
+
+def init(comm_name: Optional[str] = None) -> None:
+    """Initialize from launcher env (HOROVOD_RANK/SIZE, the
+    gloo_run.py:66-78 contract); single-process fallback when unset."""
+    _plane.init(comm_name, default_job="local")
+
+
+shutdown = _plane.shutdown
+rank = _plane.rank
+size = _plane.size
+local_rank = _plane.local_rank
+local_size = _plane.local_size
+is_initialized = _plane.is_initialized
+broadcast_object = _plane.broadcast_object
+barrier = _plane.barrier
+
+
+# -- tensor collectives (tensorflow/mpi_ops.py surface) ----------------------
+
+def _to_numpy(t) -> np.ndarray:
+    import tensorflow as tf
+    if isinstance(t, tf.IndexedSlices):
+        t = tf.convert_to_tensor(t)   # sparse_as_dense (tensorflow/__init__.py:59)
+    return np.ascontiguousarray(t.numpy() if hasattr(t, "numpy")
+                                else np.asarray(t))
+
+
+def allreduce(t, op: str = Average, name: Optional[str] = None):
+    """Allreduce a tf tensor across ranks (hvd.allreduce,
+    horovod/tensorflow/mpi_ops.py)."""
+    import tensorflow as tf
+    t = tf.convert_to_tensor(t)
+    if _plane.size() == 1:
+        return t
+    arr = _to_numpy(t)
+    out = _plane.allreduce_np(arr)
+    if op == Average:
+        out = out / _plane.size()
+    # np.ascontiguousarray promotes 0-d to 1-d; restore the true shape
+    return tf.constant(out.astype(arr.dtype).reshape(tuple(t.shape)))
+
+
+def allgather(t, name: Optional[str] = None):
+    """Concatenate along dim 0 across ranks (hvd.allgather)."""
+    import tensorflow as tf
+    t = tf.convert_to_tensor(t)
+    if t.shape.rank == 0:
+        raise ValueError("allgather requires tensors of rank >= 1")
+    if _plane.size() == 1:
+        return t
+    arr = _to_numpy(t)
+    out = _plane.allgather_np(arr)
+    return tf.constant(
+        out.reshape((_plane.size() * arr.shape[0],) + arr.shape[1:]))
+
+
+def broadcast(t, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast a tf tensor from root_rank (hvd.broadcast)."""
+    import tensorflow as tf
+    t = tf.convert_to_tensor(t)
+    if _plane.size() == 1:
+        return t
+    arr = _to_numpy(t)
+    out = _plane.broadcast_np(arr, root=root_rank)
+    return tf.constant(np.asarray(out).reshape(tuple(t.shape)))
+
+
+# -- variable sync (tensorflow/functions.py:66 broadcast_variables,
+#    keras broadcast_global_variables) ---------------------------------------
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable the root's value."""
+    if _plane.size() == 1:
+        return
+    for v in variables:
+        shape = tuple(v.shape)
+        out = _plane.broadcast_np(_to_numpy(v), root=root_rank)
+        # np.ascontiguousarray promotes 0-d to 1-d; restore the true shape
+        v.assign(np.asarray(out).reshape(shape))
+
+
+def broadcast_global_variables(root_rank: int = 0, model=None) -> None:
+    """Broadcast a model's weights (keras flavor of
+    broadcast_global_variables; pass the model explicitly — TF2 has no
+    global-variable collection)."""
+    if model is None:
+        raise ValueError(
+            "TF2/keras has no global variable collection; pass model=")
+    broadcast_variables(model.variables, root_rank)
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    """Gather a picklable object from every rank (functions.py:141)."""
+    return _plane.allgather_object(obj)
+
+
+# -- DistributedOptimizer (reference _keras/__init__.py dynamic subclass) ----
+
+def _make_distributed_apply(op: str, gradient_predivide_factor: float):
+    def apply(self, grads, trainable_variables=None, **kwargs):
+        import tensorflow as tf
+
+        def _reduce_py(*flat_grads):
+            outs = []
+            for g in flat_grads:
+                arr = np.ascontiguousarray(g.numpy())
+                if gradient_predivide_factor != 1.0:
+                    arr = arr / gradient_predivide_factor
+                red = _plane.allreduce_np(arr)
+                if op == Average:
+                    red = red / _plane.size()
+                if gradient_predivide_factor != 1.0:
+                    red = red * gradient_predivide_factor
+                outs.append(red.astype(arr.dtype))
+            return outs
+
+        if _plane.size() > 1:
+            dense = [tf.convert_to_tensor(g) for g in grads]
+            reduced = tf.py_function(
+                _reduce_py, dense, Tout=[g.dtype for g in dense])
+            for r, g in zip(reduced, dense):
+                r.set_shape(g.shape)
+            grads = reduced
+        return super(self.__class__, self).apply(
+            grads, trainable_variables, **kwargs)
+
+    return apply
+
+
+_DIST_CLASS_CACHE: dict = {}
+
+
+def _dist_class(cls, op: str = Average,
+                gradient_predivide_factor: float = 1.0):
+    # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
+    # via load_model's custom-object mapping
+    key = (cls, op, gradient_predivide_factor)
+    if key not in _DIST_CLASS_CACHE:
+        _DIST_CLASS_CACHE[key] = type("Distributed" + cls.__name__, (cls,), {
+            "apply": _make_distributed_apply(op, gradient_predivide_factor),
+        })
+    return _DIST_CLASS_CACHE[key]
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         op: str = Average,
+                         gradient_predivide_factor: float = 1.0):
+    """Wrap a keras optimizer so `apply` allreduce-averages gradients
+    across ranks first (reference: horovod/_keras/__init__.py
+    create_distributed_optimizer — the same dynamic-subclass technique, so
+    isinstance checks and get_config round-trips keep working). `name` is
+    accepted for reference-signature parity and ignored (there it names
+    the op scope)."""
+    dist_cls = _dist_class(optimizer.__class__, op,
+                           gradient_predivide_factor)
+    return dist_cls.from_config(optimizer.get_config())
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """keras.models.load_model with the saved Distributed* optimizer class
+    resolvable (reference horovod/keras/__init__.py:load_model builds the
+    same custom-object mapping over wrapped optimizer classes)."""
+    import keras
+    import inspect
+    objects = {}
+    bases = list(custom_optimizers or [])
+    bases += [c for _, c in inspect.getmembers(keras.optimizers,
+                                               inspect.isclass)
+              if issubclass(c, keras.optimizers.Optimizer)]
+    for cls in bases:
+        objects[f"Distributed{cls.__name__}"] = _dist_class(cls)
+    objects.update(custom_objects or {})
+    return keras.models.load_model(filepath, custom_objects=objects)

@@ -1,0 +1,203 @@
+"""Keras callbacks (reference horovod/_keras/callbacks.py:23-213).
+
+The four reference callbacks, re-implemented over the shared process plane:
+
+* BroadcastGlobalVariablesCallback — sync initial weights from a root rank
+  at train start (callbacks.py:23).
+* MetricAverageCallback — allreduce-average epoch metrics across ranks
+  (callbacks.py:62).
+* LearningRateWarmupCallback — linear LR ramp over the first epochs
+  (callbacks.py:108: lr = initial * (epoch * size + batch)/(warmup * steps)).
+* LearningRateScheduleCallback — multiplier schedule on the base LR.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from . import _plane
+
+
+def _get_lr(optimizer) -> float:
+    return float(np.asarray(optimizer.learning_rate))
+
+
+def _set_lr(optimizer, value: float) -> None:
+    optimizer.learning_rate.assign(value)
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast model + optimizer variables from root_rank at the start of
+    training. Model weights go out at on_train_begin; optimizer slot
+    variables (Adam moments, momentum) only exist after the optimizer is
+    built by the first step, so the full broadcast happens at the end of
+    the FIRST batch — the same reason the reference broadcasts in
+    on_batch_end (_keras/callbacks.py:23-60)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self.broadcast_done = False
+        self.model = None
+        self.params = None
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def _bcast(self, variables):
+        from .keras import broadcast_variables
+        broadcast_variables(
+            [v for v in variables if v.shape.num_elements()],
+            self.root_rank)
+
+    def on_train_begin(self, logs=None):
+        if _plane.size() == 1:
+            return
+        self._bcast(self.model.variables)
+
+    def on_train_batch_end(self, batch, logs=None):
+        if self.broadcast_done or _plane.size() == 1:
+            return
+        # optimizer slots are built now; sync them (and re-sync weights,
+        # which drifted by exactly one divergently-scaled step if the
+        # slots disagreed — matches the reference's batch-0 broadcast)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None and getattr(opt, "variables", None):
+            self._bcast(opt.variables)
+            self._bcast(self.model.variables)
+        self.broadcast_done = True
+
+    def __getattr__(self, item):
+        if item.startswith("on_") or item.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(item)
+
+
+class MetricAverageCallback:
+    """Average epoch metrics across ranks so logs agree everywhere
+    (reference _keras/callbacks.py:62-106)."""
+
+    def __init__(self):
+        self.model = None
+        self.params = None
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def _average(self, logs: Dict) -> None:
+        if not logs or _plane.size() == 1:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if np.isscalar(v) or getattr(v, "ndim", None) == 0)
+        if not keys:
+            return
+        vals = np.array([float(logs[k]) for k in keys], np.float64)
+        summed = _plane.allreduce_np(vals)
+        for k, v in zip(keys, summed / _plane.size()):
+            logs[k] = v
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average(logs)
+
+    def __getattr__(self, item):
+        if item.startswith("on_") or item.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(item)
+
+
+class LearningRateScheduleCallback:
+    """Multiply the initial LR by multiplier(epoch) inside
+    [start_epoch, end_epoch) (reference _keras/callbacks.py:108-166)."""
+
+    def __init__(self, initial_lr: Optional[float] = None,
+                 multiplier: Callable[[int], float] = None,
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self.model = None
+        self.params = None
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+        if self.steps_per_epoch is None:
+            self.steps_per_epoch = (params or {}).get("steps")
+
+    def _in_range(self, epoch) -> bool:
+        return epoch >= self.start_epoch and \
+            (self.end_epoch is None or epoch < self.end_epoch)
+
+    def _adjust(self, epoch_frac: float) -> None:
+        opt = self.model.optimizer
+        if self.initial_lr is None:
+            raise ValueError(
+                "initial_lr is required (reference callbacks.py raises the "
+                "same when the optimizer LR cannot be read)")
+        _set_lr(opt, self.initial_lr * self.multiplier(epoch_frac))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            self._adjust(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase and self.steps_per_epoch and \
+                self._in_range(self.current_epoch):
+            self._adjust(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None and self.model is not None:
+            logs["lr"] = _get_lr(self.model.optimizer)
+
+    def __getattr__(self, item):
+        if item.startswith("on_") or item.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(item)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear ramp from initial_lr to initial_lr * size over warmup_epochs
+    (reference _keras/callbacks.py:168-213: 'gradual warmup' of the
+    facebook large-minibatch recipe)."""
+
+    def __init__(self, initial_lr: Optional[float] = None,
+                 warmup_epochs: int = 5, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch_frac):
+            # epoch_frac/warmup of the way towards size x
+            frac = min(epoch_frac / max(warmup_epochs, 1e-9), 1.0)
+            return 1.0 + frac * (_plane.size() - 1)
+
+        super().__init__(initial_lr=initial_lr, multiplier=multiplier,
+                         start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose and \
+                _plane.rank() == 0:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {_get_lr(self.model.optimizer)}.")

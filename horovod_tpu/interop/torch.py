@@ -28,55 +28,31 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
-Average = "average"
-Sum = "sum"
+from . import _plane
 
-_comm = None
-_rank = 0
-_size = 1
+Average = _plane.Average
+Sum = _plane.Sum
 
 
-# -- lifecycle (basics.py init contract) ------------------------------------
+# -- lifecycle (basics.py init contract): shared process plane --------------
 
 def init(comm_name: Optional[str] = None) -> None:
     """Initialize from launcher env (HOROVOD_RANK/SIZE); single-process
     fallback when unset. Multi-process needs the native shm library."""
-    global _comm, _rank, _size
-    _rank = int(os.environ.get("HOROVOD_RANK", "0"))
-    _size = int(os.environ.get("HOROVOD_SIZE", "1"))
-    if _size > 1:
-        from ..native.shm import ShmComm
-        gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
-        name = comm_name or \
-            f"hvd_torch_{os.environ.get('HOROVOD_JOB_ID', 'local')}"
-        _comm = ShmComm(name, _rank, _size, gen=gen)
+    _plane.init(comm_name, default_job="local")
 
 
 def shutdown() -> None:
-    global _comm
-    if _comm is not None:
-        _comm.close()
-        _comm = None
+    _plane.shutdown()
 
 
-def rank() -> int:
-    return _rank
-
-
-def size() -> int:
-    return _size
-
-
-def local_rank() -> int:
-    return int(os.environ.get("HOROVOD_LOCAL_RANK", _rank))
-
-
-def local_size() -> int:
-    return int(os.environ.get("HOROVOD_LOCAL_SIZE", _size))
-
-
-def is_initialized() -> bool:
-    return _size == 1 or _comm is not None
+rank = _plane.rank
+size = _plane.size
+local_rank = _plane.local_rank
+local_size = _plane.local_size
+is_initialized = _plane.is_initialized
+broadcast_object = _plane.broadcast_object
+allgather_object = _plane.allgather_object
 
 
 # -- DLPack/numpy staging ---------------------------------------------------
@@ -111,12 +87,12 @@ def _np_view(t) -> np.ndarray:
 
 def allreduce_(t, op: str = Average, name: Optional[str] = None):
     """In-place allreduce (hvd.allreduce_, torch/mpi_ops.py:194)."""
-    if _size == 1:
+    if _plane.size() == 1:
         return t
     arr = _np_view(t)
-    np.copyto(arr, _comm.allreduce(arr, op="sum"))
+    np.copyto(arr, _plane.allreduce_np(arr))
     if op == Average:
-        t /= _size
+        t /= _plane.size()
     return t
 
 
@@ -128,19 +104,20 @@ def allreduce(t, op: str = Average, name: Optional[str] = None):
 def allgather(t, name: Optional[str] = None):
     """Concatenate along dim 0 across ranks (torch/mpi_ops.py:630)."""
     import torch
-    if _size == 1:
+    if _plane.size() == 1:
         return t.clone()
     arr = _np_view(t)
-    gathered = _comm.allgather(np.ascontiguousarray(arr))
+    gathered = _plane.allgather_np(arr)
     return torch.from_numpy(
-        gathered.reshape((_size * t.shape[0],) + tuple(t.shape[1:])))
+        gathered.reshape((_plane.size() * t.shape[0],)
+                         + tuple(t.shape[1:])))
 
 
 def broadcast_(t, root_rank: int = 0, name: Optional[str] = None):
-    if _size == 1:
+    if _plane.size() == 1:
         return t
     arr = _np_view(t)
-    np.copyto(arr, _comm.broadcast(arr, root=root_rank))
+    np.copyto(arr, _plane.broadcast_np(arr, root=root_rank))
     return t
 
 
@@ -151,19 +128,17 @@ def broadcast(t, root_rank: int = 0, name: Optional[str] = None):
 
 def reducescatter(t, op: str = Average, name: Optional[str] = None):
     import torch
-    if _size == 1:
+    if _plane.size() == 1:
         return t.clone()
-    arr = np.ascontiguousarray(_np_view(t))
-    out = _comm.reducescatter(arr, op="sum")
+    out = _plane.reducescatter_np(_np_view(t))
     res = torch.from_numpy(out.reshape((-1,) + tuple(t.shape[1:])))
     if op == Average:
-        res /= _size
+        res /= _plane.size()
     return res
 
 
 def barrier() -> None:
-    if _comm is not None:
-        _comm.barrier()
+    _plane.barrier()
 
 
 # -- state sync (torch/functions.py) ----------------------------------------
@@ -191,24 +166,16 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
             for k in sorted(st):
                 v = st[k]
                 if isinstance(v, torch.Tensor) and v.numel() > 0:
-                    broadcast_(v.contiguous(), root_rank=root_rank)
+                    if v.is_contiguous():
+                        broadcast_(v, root_rank=root_rank)
+                    else:
+                        # contiguous() copies for strided tensors: receive
+                        # into the copy, then write back into the live one
+                        c = v.contiguous()
+                        broadcast_(c, root_rank=root_rank)
+                        v.copy_(c)
 
 
-def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
-    """Pickle-broadcast an arbitrary object (common/util broadcast_object)."""
-    import pickle
-    if _size == 1:
-        return obj
-    if _rank == root_rank:
-        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        n = np.array([blob.size], dtype=np.int64)
-    else:
-        blob = np.zeros(0, np.uint8)
-        n = np.zeros(1, dtype=np.int64)
-    n = _comm.broadcast(n, root=root_rank)
-    buf = blob if _rank == root_rank else np.zeros(int(n[0]), np.uint8)
-    buf = _comm.broadcast(buf, root=root_rank)
-    return pickle.loads(buf.tobytes())
 
 
 # -- optimizer wrapper (torch/optimizer.py) ---------------------------------

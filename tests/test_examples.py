@@ -44,6 +44,21 @@ def test_example_runs(script, args, expect):
     assert expect in out, f"{script} output missing {expect!r}:\n{out}"
 
 
+def test_keras_example_under_hvdrun():
+    """The keras front end end-to-end: hvdrun -np 2 over the shm plane."""
+    pytest.importorskip("tensorflow")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(EXAMPLES) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, os.path.join(EXAMPLES, "keras_train.py")],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=EXAMPLES)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "final averaged accuracy" in r.stdout
+
+
 def test_torch_ddp_example_single_process():
     env = dict(os.environ)
     for k in ("HOROVOD_RANK", "HOROVOD_SIZE"):

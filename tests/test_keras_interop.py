@@ -1,0 +1,130 @@
+"""tf.keras binding tests (reference test/parallel/test_tensorflow2_keras.py
++ test_keras.py, scaled to this environment: single-process semantics plus a
+real 2-process shm-plane job like test_torch_interop.py)."""
+import uuid
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _tiny_model(seed=0):
+    import keras
+    keras.utils.set_random_seed(seed)
+    return keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+
+
+class TestSingleProcess:
+    def test_distributed_optimizer_trains(self):
+        import keras
+        import horovod_tpu.interop.keras as hvd
+        hvd.init()
+        assert hvd.size() == 1 and hvd.rank() == 0
+        model = _tiny_model()
+        opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1))
+        assert isinstance(opt, keras.optimizers.SGD)
+        model.compile(optimizer=opt, loss="mse", jit_compile=False)
+        x = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+        y = np.random.RandomState(1).rand(32, 2).astype(np.float32)
+        h = model.fit(x, y, epochs=2, batch_size=8, verbose=0)
+        assert h.history["loss"][1] < h.history["loss"][0]
+
+    def test_collectives_single(self):
+        import horovod_tpu.interop.keras as hvd
+        hvd.init()
+        t = tf.constant([[1.0, 2.0]])
+        np.testing.assert_allclose(hvd.allreduce(t).numpy(), t.numpy())
+        np.testing.assert_allclose(hvd.allgather(t).numpy(), t.numpy())
+        np.testing.assert_allclose(hvd.broadcast(t).numpy(), t.numpy())
+        assert hvd.allgather_object({"a": 1}) == [{"a": 1}]
+        assert hvd.broadcast_object(7) == 7
+
+    def test_lr_callbacks(self):
+        import keras
+        import horovod_tpu.interop.keras as hvd
+        hvd.init()
+        model = _tiny_model()
+        opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.4))
+        model.compile(optimizer=opt, loss="mse", jit_compile=False)
+        sched = hvd.callbacks.LearningRateScheduleCallback(
+            initial_lr=0.4, multiplier=lambda e: 0.1 ** e, start_epoch=0)
+        x = np.random.rand(16, 4).astype(np.float32)
+        y = np.random.rand(16, 2).astype(np.float32)
+        h = model.fit(x, y, epochs=3, batch_size=8, verbose=0,
+                      callbacks=[sched,
+                                 hvd.callbacks.MetricAverageCallback()])
+        np.testing.assert_allclose(
+            h.history["lr"], [0.4, 0.04, 0.004], rtol=1e-5)
+
+    def test_save_load_model_rewraps(self, tmp_path):
+        import keras
+        import horovod_tpu.interop.keras as hvd
+        hvd.init()
+        model = _tiny_model()
+        model.compile(optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.Adam(1e-3)), loss="mse", jit_compile=False)
+        x = np.random.rand(8, 4).astype(np.float32)
+        y = np.random.rand(8, 2).astype(np.float32)
+        model.fit(x, y, epochs=1, verbose=0)
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        loaded = hvd.load_model(path)
+        np.testing.assert_allclose(
+            loaded.predict(x, verbose=0), model.predict(x, verbose=0),
+            rtol=1e-5)
+
+
+def _keras_worker(tag):
+    """2-process worker: diverged init -> broadcast sync -> identical
+    sharded-data training via DistributedOptimizer (the
+    test_tensorflow2_keras.py train contract)."""
+    import os
+    import numpy as np
+    import keras
+    import horovod_tpu.interop.keras as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    keras.utils.set_random_seed(100 + r)           # diverged init
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(2),
+    ])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    model.compile(optimizer=opt, loss="mse", jit_compile=False)
+
+    rng = np.random.RandomState(0)                 # same dataset everywhere
+    x, y = rng.rand(32, 4).astype(np.float32), \
+        rng.rand(32, 2).astype(np.float32)
+    # each rank trains on its own shard (data parallelism)
+    xs, ys = x[r::n], y[r::n]
+
+    cb = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+          hvd.callbacks.MetricAverageCallback()]
+    h = model.fit(xs, ys, epochs=2, batch_size=4, verbose=0, callbacks=cb)
+
+    # replicas must agree exactly after synchronized training
+    w = np.concatenate([v.numpy().ravel() for v in model.variables])
+    ws = hvd.allgather_object(w)
+    np.testing.assert_allclose(ws[0], ws[1], rtol=1e-6)
+    # metric averaging produced identical logs on both ranks
+    losses = hvd.allgather_object(h.history["loss"])
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    hvd.shutdown()
+    return float(len(h.history["loss"]))
+
+
+def test_keras_multiprocess_shm():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_keras_worker, args=("t",), num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [2.0, 2.0]
