@@ -134,7 +134,22 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
 
 # -- DistributedOptimizer (reference _keras/__init__.py dynamic subclass) ----
 
-def _make_distributed_apply(op: str, gradient_predivide_factor: float):
+_DIST_CLASS_CACHE: dict = {}
+
+
+def _dist_class(cls, op: str = Average,
+                gradient_predivide_factor: float = 1.0):
+    # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
+    # via load_model's custom-object mapping; re-wrapping an already
+    # distributed class is an identity (idempotent, no recursive apply)
+    if getattr(cls, "_hvd_distributed", False):
+        return cls
+    key = (cls, op, gradient_predivide_factor)
+    if key in _DIST_CLASS_CACHE:
+        return _DIST_CLASS_CACHE[key]
+    dist_cls = type("Distributed" + cls.__name__, (cls,),
+                    {"_hvd_distributed": True})
+
     def apply(self, grads, trainable_variables=None, **kwargs):
         import tensorflow as tf
 
@@ -159,25 +174,14 @@ def _make_distributed_apply(op: str, gradient_predivide_factor: float):
             for r, g in zip(reduced, dense):
                 r.set_shape(g.shape)
             grads = reduced
-        return super(self.__class__, self).apply(
+        # bind the created class explicitly: super(self.__class__, ...)
+        # would recurse if dist_cls is ever subclassed again
+        return super(dist_cls, self).apply(
             grads, trainable_variables, **kwargs)
 
-    return apply
-
-
-_DIST_CLASS_CACHE: dict = {}
-
-
-def _dist_class(cls, op: str = Average,
-                gradient_predivide_factor: float = 1.0):
-    # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
-    # via load_model's custom-object mapping
-    key = (cls, op, gradient_predivide_factor)
-    if key not in _DIST_CLASS_CACHE:
-        _DIST_CLASS_CACHE[key] = type("Distributed" + cls.__name__, (cls,), {
-            "apply": _make_distributed_apply(op, gradient_predivide_factor),
-        })
-    return _DIST_CLASS_CACHE[key]
+    dist_cls.apply = apply
+    _DIST_CLASS_CACHE[key] = dist_cls
+    return dist_cls
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,

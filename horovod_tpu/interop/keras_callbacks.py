@@ -158,10 +158,17 @@ class LearningRateScheduleCallback:
         if self.staircase and self._in_range(epoch):
             self._adjust(epoch)
 
-    def on_batch_begin(self, batch, logs=None):
+    # Keras 3 dispatches on_train_batch_begin (on_batch_begin is only an
+    # alias inside keras.callbacks.Callback, which these duck-typed
+    # callbacks don't subclass) — implement the real hook and keep the
+    # old name as an alias.
+    def on_train_batch_begin(self, batch, logs=None):
         if not self.staircase and self.steps_per_epoch and \
                 self._in_range(self.current_epoch):
             self._adjust(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        self.on_train_batch_begin(batch, logs)
 
     def on_epoch_end(self, epoch, logs=None):
         if logs is not None and self.model is not None:
@@ -174,9 +181,11 @@ class LearningRateScheduleCallback:
 
 
 class LearningRateWarmupCallback(LearningRateScheduleCallback):
-    """Linear ramp from initial_lr to initial_lr * size over warmup_epochs
-    (reference _keras/callbacks.py:168-213: 'gradual warmup' of the
-    facebook large-minibatch recipe)."""
+    """Linear ramp from initial_lr/size UP TO initial_lr over
+    warmup_epochs. `initial_lr` is the full (already size-scaled) target —
+    the reference contract (_keras/callbacks.py:168-213 multiplier
+    1/size * (epoch*(size-1)/warmup + 1), the facebook gradual-warmup
+    recipe)."""
 
     def __init__(self, initial_lr: Optional[float] = None,
                  warmup_epochs: int = 5, momentum_correction: bool = True,
@@ -185,9 +194,9 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
         self.verbose = verbose
 
         def multiplier(epoch_frac):
-            # epoch_frac/warmup of the way towards size x
+            size = _plane.size()
             frac = min(epoch_frac / max(warmup_epochs, 1e-9), 1.0)
-            return 1.0 + frac * (_plane.size() - 1)
+            return (1.0 + frac * (size - 1)) / size
 
         super().__init__(initial_lr=initial_lr, multiplier=multiplier,
                          start_epoch=0, end_epoch=warmup_epochs,

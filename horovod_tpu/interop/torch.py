@@ -23,7 +23,6 @@ Usage (mirrors `import horovod.torch as hvd`):
 """
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 import numpy as np

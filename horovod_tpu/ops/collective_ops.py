@@ -405,29 +405,48 @@ def alltoall(x: Union[Array, Sequence[Array]],
                 f"{n}; got {tuple(x.shape)}; pass explicit splits otherwise")
         return _alltoall_fn(mesh)(x)
 
-    # Ragged path: static splits -> static slices, computed on the global
-    # array (XLA lowers the gathers to collectives under the hood).
+    # Ragged path (MPI_Alltoallv, mpi_operations.cc:441): pad every
+    # (sender, receiver) cell to the max split and run ONE device
+    # all_to_all on the padded stacked buffer — constant device-op count
+    # regardless of n (the previous implementation built n^2 device
+    # slices). Host work is numpy packing/unpacking of views.
     _reject_multiprocess("Ragged (splits) alltoall")
     splits = [list(map(int, s)) for s in splits]
     if len(splits) != n or any(len(s) != n for s in splits):
         raise ValueError(f"splits must be an {n}x{n} nested list")
     if isinstance(x, (list, tuple)):
-        rows = [jnp.asarray(a) for a in x]
+        rows = [np.asarray(a) for a in x]
     else:
-        x = jnp.asarray(x)
+        x = np.asarray(x)
         _check_stacked(x, n, "alltoall")
         rows = [x[i] for i in range(n)]
     for i, (row, s) in enumerate(zip(rows, splits)):
         if row.shape[0] != sum(s):
             raise ValueError(
                 f"rank {i}: sum(splits)={sum(s)} != dim0={row.shape[0]}")
+    recv_splits = [[splits[i][j] for i in range(n)] for j in range(n)]
+    m = max((v for s in splits for v in s), default=0)
+    trailing = rows[0].shape[1:] if rows else ()
+    # promote like concatenate would (mixed per-rank dtypes must not be
+    # silently truncated into rows[0]'s dtype)
+    dtype = np.result_type(*rows) if rows else np.float32
+    if m == 0:
+        return [np.zeros((0,) + trailing, dtype)
+                for _ in range(n)], recv_splits
+    send = np.zeros((n, n * m) + trailing, dtype)
     offsets = [np.concatenate([[0], np.cumsum(s)]) for s in splits]
-    outputs, recv_splits = [], []
-    for j in range(n):
-        pieces = [rows[i][offsets[i][j]:offsets[i][j + 1]] for i in range(n)]
-        outputs.append(jnp.concatenate(pieces, axis=0)
-                       if pieces else jnp.zeros((0,)))
-        recv_splits.append([splits[i][j] for i in range(n)])
+    for i in range(n):
+        for j in range(n):
+            cnt = splits[i][j]
+            send[i, j * m:j * m + cnt] = \
+                rows[i][offsets[i][j]:offsets[i][j] + cnt]
+    out = np.asarray(_alltoall_fn(mesh)(
+        jax.device_put(send, stacked_sharding(mesh))))
+    outputs = [
+        np.concatenate([out[j, i * m:i * m + splits[i][j]]
+                        for i in range(n)], axis=0)
+        for j in range(n)
+    ]
     return outputs, recv_splits
 
 
@@ -468,6 +487,40 @@ def _rs_split_sizes(d0: int, n: int) -> List[int]:
     return [base + (1 if i < extra else 0) for i in range(n)]
 
 
+@functools.lru_cache(maxsize=256)
+def _ragged_reducescatter_fn(mesh: Mesh, sizes: Tuple[int, ...],
+                             average: bool):
+    """Ragged reduce-scatter as ONE padded psum_scatter (the scalable
+    analog of MPI_Reduce_scatter with uneven counts): rows are re-packed so
+    rank i's reference chunk [offs[i], offs[i]+sizes[i]) lands in padded
+    slot i, then a single fused reduce+scatter runs on the device — ~1x
+    the communication of the tensor, vs the previous full allreduce (n x)."""
+    n = mesh.devices.size
+    c = max(sizes)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    # padded position (i, k) <- source row offs[i] + k (clamped); mask
+    # marks real rows so padding contributes zeros to the reduction
+    idx = np.zeros((n * c,), np.int32)
+    mask = np.zeros((n * c,), np.float32)
+    for i in range(n):
+        for k in range(sizes[i]):
+            idx[i * c + k] = offs[i] + k
+            mask[i * c + k] = 1.0
+
+    def blk(x):                       # x: [1, d0, ...]
+        v = x[0]
+        padded = jnp.take(v, jnp.asarray(idx), axis=0)
+        m = jnp.asarray(mask).reshape((-1,) + (1,) * (v.ndim - 1))
+        padded = padded * m.astype(padded.dtype)
+        r = lax.psum_scatter(padded, AXIS, scatter_dimension=0, tiled=True)
+        if average:
+            r = r / n if _is_float(r.dtype) else (r // n).astype(r.dtype)
+        return r[None]
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS)))
+
+
 @_timeline_span
 def reducescatter(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
                   process_set: Optional[ProcessSet] = None,
@@ -493,6 +546,12 @@ def reducescatter(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     if d0 % n == 0:
         return _reducescatter_fn(mesh, op)(x)
     sizes = _rs_split_sizes(d0, n)
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        # one padded fused reduce+scatter (no full allreduce)
+        out = _ragged_reducescatter_fn(
+            mesh, tuple(sizes), op == ReduceOp.AVERAGE)(x)
+        return [out[i, :sizes[i]] for i in range(n)]
+    # min/max/product: no fused scatter primitive — reduce then slice
     full = allreduce(x, op, process_set=ps)
     offs = np.concatenate([[0], np.cumsum(sizes)])
     return [full[i, offs[i]:offs[i + 1]] for i in range(n)]

@@ -99,6 +99,16 @@ class _Work:
     group_id: int = -1
 
 
+_group_counter = 0
+
+
+def _next_group_id() -> int:
+    global _group_counter
+    with _name_lock:
+        _group_counter += 1
+        return _group_counter
+
+
 def _fusion_key(w: _Work) -> Tuple:
     """Fusable iff same op kind/dtype/set/scale (FuseResponses rules,
     controller.cc:901-1000)."""
@@ -183,13 +193,13 @@ class Engine:
             w.handle._resolve(None, Status.aborted("Horovod has been shut down"))
 
     # -- enqueue API (operations.cc:1408-2025 analogs) ----------------------
-    def enqueue(self, work: _Work) -> Handle:
-        # Validate the stacked-shape contract up front so the fused path
-        # can't silently mis-reshape a malformed tensor. In multi-process
-        # mode this also stages the tensor as a global array (the
-        # framework-thread staging the reference does before enqueue,
-        # operations.cc:1436-1556) so the dispatch thread only handles
-        # uniform global arrays.
+    def _stage(self, work: _Work) -> None:
+        """Validate the stacked-shape contract up front so the fused path
+        can't silently mis-reshape a malformed tensor. In multi-process
+        mode this also stages the tensor as a global array (the
+        framework-thread staging the reference does before enqueue,
+        operations.cc:1436-1556) so the dispatch thread only handles
+        uniform global arrays."""
         if work.request_type in (RequestType.ALLREDUCE,
                                  RequestType.ALLGATHER,
                                  RequestType.BROADCAST,
@@ -210,22 +220,50 @@ class Engine:
                             f"{work.request_type.value} expects a stacked "
                             f"array with leading axis == process-set size "
                             f"({n}); got shape {tuple(t.shape)}")
+
+    def _commit(self, works: List[_Work]) -> None:
+        """Append validated works to the queue atomically."""
+        tl = self._state.timeline
         with self._qlock:
-            if work.name in self._inflight_names:
+            for w in works:
+                if w.name in self._inflight_names:
+                    raise DuplicateNameError(
+                        f"Duplicate tensor name '{w.name}': a collective "
+                        f"with this name is already in flight (reference "
+                        f"DUPLICATE_NAME_ERROR)")
+            names = [w.name for w in works]
+            if len(set(names)) != len(names):
                 raise DuplicateNameError(
-                    f"Duplicate tensor name '{work.name}': a collective with "
-                    f"this name is already in flight (reference "
-                    f"DUPLICATE_NAME_ERROR)")
-            self._inflight_names.add(work.name)
-            self._outstanding[work.name] = work.handle.enqueue_time
-            # begin(QUEUED) must precede the cycle thread's pop (which emits
-            # the matching end) — emit under the same lock as the append
-            tl = self._state.timeline
-            if tl is not None:
-                tl.begin(work.name, "QUEUED")
-            self._queue.append(work)
+                    f"Duplicate tensor names within one request: {names}")
+            for w in works:
+                self._inflight_names.add(w.name)
+                self._outstanding[w.name] = w.handle.enqueue_time
+                # begin(QUEUED) must precede the cycle thread's pop (which
+                # emits the matching end) — emit under the same lock as
+                # the append
+                if tl is not None:
+                    tl.begin(w.name, "QUEUED")
+                self._queue.append(w)
         self._wake.set()
+
+    def enqueue(self, work: _Work) -> Handle:
+        self._stage(work)
+        self._commit([work])
         return work.handle
+
+    def enqueue_group(self, works: List[_Work]) -> List[Handle]:
+        """Atomic grouped enqueue (group_table.h:29-53: groups complete
+        atomically; EnqueueTensorAllreduces validates every member before
+        queuing any). A bad member — wrong shape, duplicate name — means
+        NONE of the group is enqueued; the group later executes and
+        resolves as one unit in _execute_bucket."""
+        gid = _next_group_id()
+        for w in works:
+            w.group_id = gid
+        for w in works:                 # validate ALL before staging ANY
+            self._stage(w)
+        self._commit(works)
+        return [w.handle for w in works]
 
     # -- background loop (RunLoopOnce, operations.cc:751) --------------------
     def _loop(self) -> None:
@@ -412,6 +450,22 @@ class Engine:
                                   "with Join (zero-filled contributions)"))
             else:
                 ready.append(w)
+        # group closure (atomic completion): a group with any deferred
+        # member defers entirely; with any errored member errors entirely
+        gids_err = {w.group_id for w, _ in errors if w.group_id >= 0}
+        gids_def = {w.group_id for w in deferred if w.group_id >= 0}
+        if gids_err or gids_def:
+            keep = []
+            for w in ready:
+                if w.group_id in gids_err:
+                    errors.append((w, "group member failed; group aborted "
+                                      "atomically (group_table.h:29-53)"))
+                elif w.group_id in gids_def:
+                    deferred.append(w)
+                else:
+                    keep.append(w)
+            ready = keep
+
         tl_ = self._state.timeline
         for w, msg in errors:
             with self._qlock:
@@ -482,12 +536,21 @@ class Engine:
         return w
 
     def _bucketize(self, batch: List[_Work]) -> List[List[_Work]]:
-        """Group fusable requests, splitting at the fusion threshold."""
+        """Group fusable requests, splitting at the fusion threshold.
+        Members of one grouped op always stay in ONE bucket — atomic
+        completion (group_table.h:29-53) requires resolving them together,
+        so the fusion threshold never splits a group (the reference's
+        FuseResponses keeps groups whole the same way,
+        controller.cc:219-241)."""
         buckets: "OrderedDict[Tuple, List[List[_Work]]]" = OrderedDict()
         sizes: Dict[Tuple, int] = {}
         out: List[List[_Work]] = []
+        grouped: "OrderedDict[int, List[_Work]]" = OrderedDict()
         no_fusion = self._state.config.disable_group_fusion
         for w in batch:
+            if w.group_id >= 0:
+                grouped.setdefault(w.group_id, []).append(w)
+                continue
             if no_fusion or w.request_type != RequestType.ALLREDUCE or \
                w.op == ReduceOp.ADASUM:
                 out.append([w])          # non-fused kinds execute singly
@@ -500,6 +563,7 @@ class Engine:
                 sizes[k] = 0
             buckets[k][-1].append(w)
             sizes[k] += nbytes
+        out.extend(grouped.values())
         for groups in buckets.values():
             out.extend(groups)
         return out
@@ -520,8 +584,10 @@ class Engine:
                 tl.end(w.name, "QUEUED")
                 tl.begin(w.name, phase)
         try:
-            if len(bucket) == 1 and \
-               bucket[0].request_type != RequestType.ALLREDUCE:
+            if bucket[0].group_id >= 0:
+                results = self._execute_group(bucket)
+            elif len(bucket) == 1 and \
+                    bucket[0].request_type != RequestType.ALLREDUCE:
                 results = [self._execute_single(bucket[0])]
             elif len(bucket) == 1:
                 w = bucket[0]
@@ -543,6 +609,41 @@ class Engine:
                 self._inflight_names.discard(w.name)
                 self._outstanding.pop(w.name, None)
             w.handle._resolve(r, status)
+
+    def _execute_group(self, bucket: List[_Work]) -> List:
+        """Execute one grouped op atomically: members are internally fused
+        per dtype/op signature (the reference's mixed-dtype group look-ahead
+        fusion, controller.cc:931-1000), but the results only become
+        visible if EVERY sub-execution succeeds — any failure raises, and
+        _execute_bucket resolves the WHOLE group with the error status
+        (group_table.h:29-53 atomic completion)."""
+        sub: "OrderedDict[Tuple, List[int]]" = OrderedDict()
+        singles: List[int] = []
+        for i, w in enumerate(bucket):
+            if w.request_type == RequestType.ALLREDUCE and \
+                    w.op != ReduceOp.ADASUM:
+                sub.setdefault(_fusion_key(w), []).append(i)
+            else:
+                singles.append(i)
+        results: List = [None] * len(bucket)
+        for idxs in sub.values():
+            if len(idxs) == 1:
+                results[idxs[0]] = self._execute_single(bucket[idxs[0]])
+            else:
+                outs = self._execute_fused_allreduce(
+                    [bucket[i] for i in idxs])
+                for i, r in zip(idxs, outs):
+                    results[i] = r
+        for i in singles:
+            results[i] = self._execute_single(bucket[i])
+        # materialize before declaring success: an async XLA failure after
+        # partial resolution would break atomicity (tree-flattened: ragged
+        # reducescatter members return LISTS of arrays)
+        jax.block_until_ready([
+            leaf for r in results
+            for leaf in jax.tree_util.tree_leaves(r)
+            if isinstance(leaf, jax.Array)])
+        return results
 
     def _execute_single(self, w: _Work):
         if w.request_type == RequestType.ALLGATHER:
@@ -712,11 +813,13 @@ def grouped_allreduce_async(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
                             process_set: Optional[ProcessSet] = None,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0) -> List[Handle]:
+    ps = basics.get_process_set(process_set)
     base = name or _auto_name("grouped_allreduce")
-    return [allreduce_async(t, op, f"{base}.{i}", process_set=process_set,
-                            prescale_factor=prescale_factor,
-                            postscale_factor=postscale_factor)
-            for i, t in enumerate(tensors)]
+    works = [_Work(RequestType.ALLREDUCE, f"{base}.{i}", t, op, ps,
+                   Handle(f"{base}.{i}"), prescale=prescale_factor,
+                   postscale=postscale_factor)
+             for i, t in enumerate(tensors)]
+    return _engine().enqueue_group(works)
 
 
 def grouped_allreduce(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
@@ -733,9 +836,12 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
 def grouped_allgather_async(tensors: Sequence, name: Optional[str] = None, *,
                             process_set: Optional[ProcessSet] = None
                             ) -> List[Handle]:
+    ps = basics.get_process_set(process_set)
     base = name or _auto_name("grouped_allgather")
-    return [allgather_async(t, f"{base}.{i}", process_set=process_set)
-            for i, t in enumerate(tensors)]
+    works = [_Work(RequestType.ALLGATHER, f"{base}.{i}", t, ReduceOp.SUM,
+                   ps, Handle(f"{base}.{i}"))
+             for i, t in enumerate(tensors)]
+    return _engine().enqueue_group(works)
 
 
 def grouped_allgather(tensors: Sequence, name: Optional[str] = None, *,
@@ -749,9 +855,12 @@ def grouped_reducescatter_async(tensors: Sequence,
                                 name: Optional[str] = None, *,
                                 process_set: Optional[ProcessSet] = None
                                 ) -> List[Handle]:
+    ps = basics.get_process_set(process_set)
     base = name or _auto_name("grouped_reducescatter")
-    return [reducescatter_async(t, op, f"{base}.{i}", process_set=process_set)
-            for i, t in enumerate(tensors)]
+    works = [_Work(RequestType.REDUCESCATTER, f"{base}.{i}", t, op, ps,
+                   Handle(f"{base}.{i}"))
+             for i, t in enumerate(tensors)]
+    return _engine().enqueue_group(works)
 
 
 def grouped_reducescatter(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,

@@ -107,3 +107,71 @@ def test_engine_shutdown_aborts_pending(hvd):
     # shutdown() must finalize outstanding handles with an error, not hang
     # (tensor_queue.h:35 FinalizeTensorQueue).
     pass  # exercised implicitly by the fixture's shutdown
+
+
+class TestGroupAtomicity:
+    """group_table.h:29-53: grouped ops complete atomically."""
+
+    def test_grouped_mixed_dtypes_one_group(self, hvd):
+        import jax.numpy as jnp
+        n = hvd.size()
+        xs = [np.ones((n, 3), np.float32),
+              np.ones((n, 5), np.int32),
+              2 * np.ones((n, 2), np.float32)]
+        outs = hvd.grouped_allreduce(xs, hvd.Sum)
+        np.testing.assert_allclose(np.asarray(outs[0]),
+                                   n * np.ones((n, 3)))
+        np.testing.assert_array_equal(np.asarray(outs[1]),
+                                      n * np.ones((n, 5), np.int32))
+        np.testing.assert_allclose(np.asarray(outs[2]),
+                                   2 * n * np.ones((n, 2)))
+
+    def test_group_fails_atomically(self, hvd):
+        """A bad member (wrong stacked shape) must fail the WHOLE group at
+        enqueue: no member handle resolves ok."""
+        n = hvd.size()
+        good = np.ones((n, 3), np.float32)
+        bad = np.ones((n + 1, 3), np.float32)
+        with pytest.raises(ValueError):
+            hvd.grouped_allreduce_async([good, bad], hvd.Sum,
+                                        name="atomic_g")
+        # the good member must NOT be in flight anymore: re-using its name
+        # immediately works (no DuplicateNameError) and completes
+        out = hvd.synchronize(
+            hvd.allreduce_async(good, hvd.Sum, name="atomic_g.0"))
+        np.testing.assert_allclose(np.asarray(out), n * good)
+
+    def test_group_duplicate_name_rolls_back(self, hvd):
+        n = hvd.size()
+        x = np.ones((n, 2), np.float32)
+        eng = hvd.core.basics.get_engine()
+        # widen the batching window so the first enqueue is still in
+        # flight when the group tries to reuse its name (deterministic)
+        old_cycle = eng.cycle_time_s
+        eng.cycle_time_s = 2.0
+        try:
+            h = hvd.allreduce_async(x, hvd.Sum, name="dup_member.1")
+            with pytest.raises(hvd.DuplicateNameError):
+                hvd.grouped_allreduce_async([x, x], hvd.Sum,
+                                            name="dup_member")
+        finally:
+            eng.cycle_time_s = old_cycle
+        hvd.synchronize(h)
+        # nothing from the failed group was staged: both names are free
+        outs = hvd.grouped_allreduce([x, x], hvd.Sum, name="dup_member")
+        assert len(outs) == 2
+
+    def test_group_exceeds_fusion_threshold_stays_atomic(self, hvd):
+        """Groups are never split by the fusion threshold."""
+        eng = hvd.core.basics.get_engine()
+        old = eng.fusion_threshold
+        eng.fusion_threshold = 64          # bytes — tiny
+        try:
+            n = hvd.size()
+            xs = [np.full((n, 64), float(i), np.float32) for i in range(4)]
+            outs = hvd.grouped_allreduce(xs, hvd.Sum, name="big_group")
+            for i, o in enumerate(outs):
+                np.testing.assert_allclose(np.asarray(o),
+                                           n * i * np.ones((n, 64)))
+        finally:
+            eng.fusion_threshold = old
