@@ -450,16 +450,22 @@ class Engine:
                                   "with Join (zero-filled contributions)"))
             else:
                 ready.append(w)
-        # group closure (atomic completion): a group with any deferred
-        # member defers entirely; with any errored member errors entirely
+        # group closure (atomic completion): a group with any errored
+        # member errors entirely — including members that were merely
+        # deferred — and a group with any deferred member defers entirely
         gids_err = {w.group_id for w, _ in errors if w.group_id >= 0}
-        gids_def = {w.group_id for w in deferred if w.group_id >= 0}
+        gids_def = {w.group_id for w in deferred
+                    if w.group_id >= 0 and w.group_id not in gids_err}
         if gids_err or gids_def:
+            abort_msg = ("group member failed; group aborted atomically "
+                         "(group_table.h:29-53)")
+            errors.extend((w, abort_msg) for w in deferred
+                          if w.group_id in gids_err)
+            deferred = [w for w in deferred if w.group_id not in gids_err]
             keep = []
             for w in ready:
                 if w.group_id in gids_err:
-                    errors.append((w, "group member failed; group aborted "
-                                      "atomically (group_table.h:29-53)"))
+                    errors.append((w, abort_msg))
                 elif w.group_id in gids_def:
                     deferred.append(w)
                 else:

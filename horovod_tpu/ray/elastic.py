@@ -1,17 +1,23 @@
-"""Ray-native host discovery for elastic training.
+"""Ray-native elastic training: autoscaler-aware discovery + the
+fault-tolerant executor loop.
 
-Re-design of the reference's `RayHostDiscovery`
-(horovod/ray/elastic.py): instead of polling a user shell script, ask the
-Ray GCS for the current set of alive nodes and their resources, and present
-them through the same `HostDiscovery` interface the elastic driver polls
-(elastic/discovery.py) — so `ElasticDriver` works unchanged on a Ray
-cluster that autoscales.
+Re-design of the reference's `RayHostDiscovery` + `ElasticRayExecutor`
+(horovod/ray/elastic.py:479, elastic_v2.py): discovery asks the Ray GCS
+for alive nodes; the executor runs rounds of actors over the discovered
+topology, blacklists hosts whose actors die, and relaunches until the
+user function completes (bounded by reset_limit) — the Ray flavor of
+runner/elastic/driver.py supervision.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+import logging
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from ..elastic.discovery import HostDiscovery
+from ..elastic.discovery import HostDiscovery, HostManager
+from ..runner.hosts import HostInfo, get_host_assignments
+
+logger = logging.getLogger("horovod_tpu")
 
 
 def _default_nodes() -> List[dict]:
@@ -53,3 +59,143 @@ class RayHostDiscovery(HostDiscovery):
             if slots > 0:
                 hosts[hostname] = hosts.get(hostname, 0) + slots
         return hosts
+
+
+class ElasticRayExecutor:
+    """Fault-tolerant actor-fleet executor (reference ElasticRayExecutor,
+    horovod/ray/elastic.py:479 / elastic_v2.py ElasticAdapter).
+
+    Each round: poll discovery -> assign slots (min_np..max_np) -> start
+    one worker per slot (placement preserves surviving hosts' rank blocks
+    like ElasticDriver._compute_slots) -> run `fn` on all. An actor
+    failure blacklists its host (cooldown + resurrection via HostManager)
+    and starts the next round; `fn` is responsible for resuming from
+    committed state (hvd.elastic.run / FileBackedState), exactly as in the
+    launcher-based elastic path. `reset_limit` bounds rounds.
+
+    `backend` is injectable (tests use an in-process backend; production
+    uses the Ray actor backend from ray/runner.py)."""
+
+    def __init__(self, discovery: HostDiscovery, *, min_np: int = 1,
+                 max_np: Optional[int] = None,
+                 reset_limit: Optional[int] = None,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 backend: Optional[Any] = None,
+                 cpus_per_worker: float = 1.0,
+                 override_discovery: bool = True) -> None:
+        self.manager = HostManager(discovery)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.reset_limit = reset_limit
+        self.env_vars = dict(env_vars or {})
+        self.cpus_per_worker = cpus_per_worker
+        self._backend = backend
+        self.resets = 0
+
+    def _current_slots(self, previous):
+        hosts = self.manager.current_hosts()
+        np_ = sum(h.slots for h in hosts)
+        if self.max_np is not None:
+            np_ = min(np_, self.max_np)
+        if np_ < self.min_np:
+            return None
+        if previous:
+            prev_order = []
+            for s in previous:
+                if s.hostname not in prev_order:
+                    prev_order.append(s.hostname)
+            cur = {h.hostname: h for h in hosts}
+            ordered = [cur[n] for n in prev_order if n in cur]
+            ordered += [h for h in hosts if h.hostname not in prev_order]
+        else:
+            ordered = hosts
+        return get_host_assignments(ordered, np_)
+
+    def run(self, fn: Callable, args: Sequence = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        """Run fn elastically; returns the per-rank results of the first
+        round that completes on every worker."""
+        import socket
+        import time
+
+        from .runner import Coordinator, _RayBackend, spread_plan, \
+            worker_env
+
+        if self._backend is None:
+            self._backend = _RayBackend()
+        slots = None
+        while True:
+            slots = self._current_slots(slots)
+            if slots is None:
+                time.sleep(1.0)
+                continue
+            plan = spread_plan(len(slots), self.cpus_per_worker, 0.0)
+            workers = self._backend.start_workers(plan)
+            kv_server = None
+            worker_hosts: List[Optional[str]] = [None] * len(workers)
+            try:
+                # rank assignment from ACTUAL actor placement (like
+                # RayExecutor.start): Ray chooses the hosts, so hostnames
+                # must be queried, not assumed from the discovery order —
+                # otherwise a failure would blacklist the wrong host
+                hostnames = self._backend.call_all(workers, "hostname")
+                worker_hosts = list(hostnames)
+                coord = Coordinator()
+                for hn in hostnames:
+                    coord.register(hn)
+                placed = coord.slots()
+                # KV-store rendezvous for the workers' control plane (the
+                # same StoreServer RayExecutor.start provides)
+                kv_addr = kv_port = None
+                try:
+                    from ..native.store import StoreServer
+                    kv_server = StoreServer()
+                    kv_addr, kv_port = socket.gethostname(), kv_server.port
+                    if len(set(hostnames)) == 1:
+                        kv_addr = "127.0.0.1"
+                except Exception:  # noqa: BLE001 — toolchain-less driver
+                    kv_server = None
+                shm_gen = str(uuid.uuid4().int & ((1 << 62) - 1))
+                self._backend.call_all(
+                    workers, "update_env_vars",
+                    [(dict(worker_env(s, kv_addr, kv_port, self.env_vars),
+                           HOROVOD_SHM_GEN=shm_gen),)
+                     for s in placed])
+                return self._backend.call_all(
+                    workers, "execute",
+                    [(fn, tuple(args), kwargs) for _ in workers])
+            except Exception as e:  # noqa: BLE001 - actor death / fn error
+                failed = self._failed_hosts(workers, worker_hosts)
+                logger.warning(
+                    "elastic ray round failed (%s); blacklisting %s and "
+                    "resetting", e, failed or "nothing")
+                for hn in failed:
+                    self.manager.blacklist(hn)
+                self.resets += 1
+                if self.reset_limit is not None and \
+                        self.resets > self.reset_limit:
+                    raise RuntimeError(
+                        f"reset_limit ({self.reset_limit}) exceeded") from e
+            finally:
+                if kv_server is not None:
+                    kv_server.close()
+                try:
+                    self._backend.stop_workers(workers)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _failed_hosts(self, workers,
+                      worker_hosts: List[Optional[str]]) -> List[str]:
+        """Probe which actors are dead after a failed round, reporting
+        the hosts recorded at placement time (ElasticDriver's
+        _handle_worker_exit analog: exit -> blacklist). Deaths before the
+        placement query leave the host unknown — nothing is blacklisted
+        and the next round simply retries."""
+        failed = []
+        for w, hn in zip(workers, worker_hosts):
+            try:
+                self._backend.call(w, "hostname")
+            except Exception:  # noqa: BLE001 - actor is gone
+                if hn:
+                    failed.append(hn)
+        return failed

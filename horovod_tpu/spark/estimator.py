@@ -97,27 +97,70 @@ class FlaxEstimator:
         self.callbacks = list(callbacks or [])
         self.history: List[Dict[str, float]] = []
 
-    # -- data materialization (reference: DataFrame -> parquet in Store) ----
+    # -- data materialization (reference: DataFrame -> parquet in Store,
+    #    spark/common/util.py prepare_data) --------------------------------
     def _materialize(self, x: np.ndarray, y: np.ndarray
                      ) -> Tuple[str, Optional[str]]:
+        import os
+        import tempfile
+
+        from .parquet import write_parquet
+
         n = x.shape[0]
         n_val = int(n * self.validation)
         rng = np.random.RandomState(self.seed)
         order = rng.permutation(n) if self.shuffle else np.arange(n)
         val_idx, train_idx = order[:n_val], order[n_val:]
+
+        def put(path: str, xs, ys) -> None:
+            with tempfile.TemporaryDirectory() as tmp:
+                local = os.path.join(tmp, "data.parquet")
+                write_parquet(local, xs, ys,
+                              rows_per_group=max(self.batch_size * 8, 256))
+                with open(local, "rb") as f:
+                    self.store.write(path, f.read())
+
         train_path = self.store.get_train_data_path(self.run_id)
-        self.store.write(train_path, pickle.dumps(
-            {"x": x[train_idx], "y": y[train_idx]}))
+        put(train_path, x[train_idx], y[train_idx])
         val_path = None
         if n_val:
             val_path = self.store.get_val_data_path(self.run_id)
-            self.store.write(val_path, pickle.dumps(
-                {"x": x[val_idx], "y": y[val_idx]}))
+            put(val_path, x[val_idx], y[val_idx])
         return train_path, val_path
+
+    def _reader(self, store_path: str, batch_size: int, *,
+                shard_index: int = 0, num_shards: int = 1,
+                drop_remainder: bool = True):
+        """Per-worker parquet reader over a Store path (the petastorm
+        reader analog, spark/data_loaders/): stages the store bytes to a
+        local temp file (recorded on the reader as `_tmp_path` for
+        cleanup) and shards by row group."""
+        import tempfile
+
+        from .parquet import ParquetShardReader
+
+        tmp = tempfile.NamedTemporaryFile(suffix=".parquet", delete=False)
+        tmp.write(self.store.read(store_path))
+        tmp.close()
+        reader = ParquetShardReader(
+            tmp.name, shard_index=shard_index, num_shards=num_shards,
+            batch_size=batch_size, shuffle=self.shuffle, seed=self.seed,
+            drop_remainder=drop_remainder)
+        reader._tmp_path = tmp.name
+        return reader
 
     def fit(self, x: np.ndarray, y: np.ndarray) -> FlaxModel:
         """Materialize data to the Store, train SPMD over the device mesh,
         checkpoint to the Store, return the trained transformer."""
+        train_path, val_path = self._materialize(np.asarray(x),
+                                                 np.asarray(y))
+        return self.fit_on_store(train_path, val_path)
+
+    def fit_on_store(self, train_path: str,
+                     val_path: Optional[str] = None) -> "FlaxModel":
+        """Train from already-materialized parquet in the Store (the
+        petastorm-reader path: data streams row-group-wise through
+        ParquetShardReader instead of living in one array)."""
         import jax
         import jax.numpy as jnp
         import optax
@@ -126,20 +169,28 @@ class FlaxEstimator:
         from ..optim.optimizer import DistributedOptimizer
         from ..training import cross_entropy_loss
 
-        train_path, val_path = self._materialize(np.asarray(x),
-                                                 np.asarray(y))
-        data = pickle.loads(self.store.read(train_path))
-        xs, ys = data["x"], data["y"]
-
         if not basics.is_initialized():
             basics.init()
         mesh = basics.get_mesh()
         n_dev = mesh.devices.size
 
+        per_dev = max(self.batch_size // n_dev, 1)
+        global_bs = per_dev * n_dev
+        reader = self._reader(train_path, global_bs)
+        val_reader = (self._reader(val_path, self.batch_size,
+                                   drop_remainder=False)
+                      if val_path is not None else None)
+        xs0, _ = next(reader.batches(0), (None, None))
+        if xs0 is None:
+            # train split smaller than one global batch: initialize from
+            # the raw shard and return the (untrained) model, matching the
+            # pre-parquet behavior for tiny inputs
+            xs0, _ = reader.read_shard()
+
         loss_fn = self.loss or (
             lambda logits, labels: cross_entropy_loss(logits, labels))
         variables = self.model.init(jax.random.PRNGKey(self.seed),
-                                    jnp.asarray(xs[:1]))
+                                    jnp.asarray(xs0[:1]))
         params = variables["params"]
         batch_stats = variables.get("batch_stats")
 
@@ -171,32 +222,23 @@ class FlaxEstimator:
             return optax.apply_updates(params, updates), opt_state, \
                 loss / n_dev
 
-        per_dev = max(self.batch_size // n_dev, 1)
-        global_bs = per_dev * n_dev
-        steps = max(len(xs) // global_bs, 1)
-        rng = np.random.RandomState(self.seed + 1)
-
         for cb in self.callbacks:
             if hasattr(cb, "on_train_begin"):
                 cb.on_train_begin()
         for epoch in range(self.epochs):
-            order = rng.permutation(len(xs)) if self.shuffle \
-                else np.arange(len(xs))
-            epoch_loss = 0.0
-            for s in range(steps):
-                idx = order[s * global_bs:(s + 1) * global_bs]
-                if len(idx) < global_bs:
-                    break
-                xb = jnp.asarray(xs[idx]).reshape(
-                    (n_dev, per_dev) + xs.shape[1:])
-                yb = jnp.asarray(ys[idx]).reshape(
-                    (n_dev, per_dev) + ys.shape[1:])
+            epoch_loss, steps = 0.0, 0
+            for xb_np, yb_np in reader.batches(epoch):
+                xb = jnp.asarray(xb_np).reshape(
+                    (n_dev, per_dev) + xb_np.shape[1:])
+                yb = jnp.asarray(yb_np).reshape(
+                    (n_dev, per_dev) + yb_np.shape[1:])
                 params, opt_state, loss = step(params, opt_state, xb, yb)
                 epoch_loss += float(loss)
+                steps += 1
             logs = {"loss": epoch_loss / max(steps, 1), "epoch": epoch}
-            if val_path is not None:
+            if val_reader is not None:
                 logs["val_loss"] = self._evaluate(
-                    params, val_path, loss_fn, n_dev)
+                    params, val_reader, loss_fn)
             self.history.append(logs)
             for cb in self.callbacks:
                 if hasattr(cb, "on_epoch_end"):
@@ -206,14 +248,25 @@ class FlaxEstimator:
         final_params = jax.tree_util.tree_map(lambda a: a[0], params)
         fm = FlaxModel(self.model, final_params, batch_stats)
         fm.save(self.store, self.run_id)
+        self._cleanup(reader, val_reader)
         return fm
 
-    def _evaluate(self, stacked_params, val_path: str,
-                  loss_fn: Callable, n_dev: int) -> float:
+    @staticmethod
+    def _cleanup(*readers) -> None:
+        import os
+        for r in readers:
+            tmp = getattr(r, "_tmp_path", None)
+            if tmp:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def _evaluate(self, stacked_params, val_reader,
+                  loss_fn: Callable) -> float:
         import jax
         import jax.numpy as jnp
-        data = pickle.loads(self.store.read(val_path))
+        xv, yv = val_reader.read_shard()
         params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
-        logits = self.model.apply({"params": params},
-                                  jnp.asarray(data["x"]))
-        return float(loss_fn(logits, jnp.asarray(data["y"])))
+        logits = self.model.apply({"params": params}, jnp.asarray(xv))
+        return float(loss_fn(logits, jnp.asarray(yv)))

@@ -1,0 +1,160 @@
+"""Parquet data path for the estimator layer: the petastorm analog.
+
+The reference estimators materialize the Spark DataFrame as parquet in the
+Store and feed workers with petastorm readers that each consume a shard
+(horovod/spark/common/store.py:38, spark/common/util.py prepare_data,
+spark/data_loaders/). Here:
+
+* `write_parquet` materializes feature/label arrays as a parquet file with
+  bounded row groups (the shardable unit);
+* `ParquetShardReader` is the per-worker reader: worker `shard_index` of
+  `num_shards` reads ONLY its row groups (round-robin by group — petastorm's
+  cur_shard/shard_count contract), decodes to numpy, and yields shuffled
+  batches per epoch.
+
+fsspec paths work wherever pyarrow accepts a filesystem URL, which covers
+the reference's Store backends (local/HDFS/S3/GCS/ADLS).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def _pa():
+    try:
+        import pyarrow
+        import pyarrow.parquet
+        return pyarrow
+    except ImportError as e:  # pragma: no cover - env dependent
+        raise ImportError(
+            "the parquet data path needs pyarrow (pip install "
+            "horovod-tpu[spark])") from e
+
+
+def write_parquet(path: str, x: np.ndarray, y: Optional[np.ndarray] = None,
+                  *, feature_col: str = "features", label_col: str = "label",
+                  rows_per_group: int = 1024) -> int:
+    """Materialize arrays as parquet with fixed-size row groups.
+
+    Multi-dim features are stored as flat lists plus a shape column, the
+    way the reference serializes tensors into parquet cells
+    (spark/common/serialization.py ArrayType handling). Returns the number
+    of row groups written."""
+    pa = _pa()
+    import pyarrow.parquet as pq
+
+    x = np.asarray(x)
+    n = x.shape[0]
+
+    def encode(name, arr):
+        return {
+            name: pa.array(list(arr.reshape(n, -1))),
+            f"{name}_shape": pa.array([list(arr.shape[1:])] * n,
+                                      type=pa.list_(pa.int32())),
+            f"{name}_dtype": pa.array([str(arr.dtype)] * n),
+        }
+
+    cols = encode(feature_col, x)
+    if y is not None:
+        cols.update(encode(label_col, np.asarray(y)))
+    table = pa.table(cols)
+    pq.write_table(table, path, row_group_size=rows_per_group)
+    return pq.ParquetFile(path).num_row_groups
+
+
+class ParquetShardReader:
+    """Per-worker batch reader over a row-group shard of a parquet file.
+
+    shard_index/num_shards follow petastorm's cur_shard/shard_count: row
+    group g belongs to worker (g % num_shards == shard_index), so shards
+    are disjoint and cover the file. Batches are yielded as (features,
+    labels) numpy arrays with the original trailing shapes restored;
+    `shuffle` permutes within the shard per epoch (reshuffled by epoch
+    seed, the ElasticSampler convention)."""
+
+    def __init__(self, path: str, *, shard_index: int = 0,
+                 num_shards: int = 1, batch_size: int = 32,
+                 feature_col: str = "features", label_col: str = "label",
+                 shuffle: bool = True, seed: int = 0,
+                 drop_remainder: bool = False):
+        _pa()
+        import pyarrow.parquet as pq
+        if not (0 <= shard_index < num_shards):
+            raise ValueError(
+                f"shard_index {shard_index} out of range [0, {num_shards})")
+        self.path = path
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        self.batch_size = batch_size
+        self.feature_col = feature_col
+        self.label_col = label_col
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self._pf = pq.ParquetFile(path)
+        self.my_groups = [g for g in range(self._pf.num_row_groups)
+                          if g % num_shards == shard_index]
+        self.has_labels = label_col in self._pf.schema_arrow.names
+
+    def __len__(self) -> int:
+        rows = sum(self._pf.metadata.row_group(g).num_rows
+                   for g in self.my_groups)
+        if self.drop_remainder:
+            return rows // self.batch_size
+        return (rows + self.batch_size - 1) // self.batch_size
+
+    def _decode(self, table) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        def col(name):
+            arr = table.column(name).to_pylist()
+            shape = table.column(f"{name}_shape")[0].as_py()
+            dtype = table.column(f"{name}_dtype")[0].as_py()
+            return np.asarray(arr, dtype=np.dtype(dtype)).reshape(
+                (len(arr), *shape))
+
+        feats = col(self.feature_col)
+        labels = col(self.label_col) if self.has_labels else None
+        return feats, labels
+
+    def read_shard(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Materialize this worker's whole shard (small-data path)."""
+        if not self.my_groups:
+            raise ValueError(
+                f"shard {self.shard_index}/{self.num_shards} is empty: the "
+                f"file has only {self._pf.num_row_groups} row groups — "
+                "write with smaller rows_per_group")
+        return self._decode(self._pf.read_row_groups(self.my_groups))
+
+    def batches(self, epoch: int = 0
+                ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        """Yield (features, labels) batches for one epoch, streaming one
+        row group at a time (bounded memory: the petastorm reader
+        property). Shuffling is two-level — group order, then rows within
+        the group — reshuffled per epoch."""
+        rng = np.random.RandomState(self.seed + epoch)
+        order = list(self.my_groups)
+        if self.shuffle:
+            rng.shuffle(order)
+        leftover_x = leftover_y = None
+        for g in order:
+            feats, labels = self._decode(self._pf.read_row_group(g))
+            if self.shuffle:
+                perm = rng.permutation(len(feats))
+                feats = feats[perm]
+                labels = labels[perm] if labels is not None else None
+            if leftover_x is not None:
+                feats = np.concatenate([leftover_x, feats])
+                if labels is not None:
+                    labels = np.concatenate([leftover_y, labels])
+                leftover_x = leftover_y = None
+            n_full = len(feats) // self.batch_size * self.batch_size
+            for s in range(0, n_full, self.batch_size):
+                yield (feats[s:s + self.batch_size],
+                       labels[s:s + self.batch_size]
+                       if labels is not None else None)
+            if n_full < len(feats):
+                leftover_x = feats[n_full:]
+                leftover_y = labels[n_full:] if labels is not None else None
+        if leftover_x is not None and not self.drop_remainder:
+            yield leftover_x, leftover_y

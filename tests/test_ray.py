@@ -138,3 +138,128 @@ def test_ray_host_discovery_with_elastic_manager():
     hosts = mgr.current_hosts()
     assert len(hosts) == 1 and hosts[0].hostname == "n1"
     assert hosts[0].slots == 2
+
+
+class _FlakyBackend:
+    """In-process backend simulating Ray placement: workers are spread
+    round-robin over `hosts`; actors on die_plan[round] hosts die at
+    execute time. Exercises the blacklist/reset loop without Ray."""
+
+    def __init__(self, hosts, die_plan):
+        # die_plan: {round_index: set(hostnames that die that round)}
+        from horovod_tpu.ray.runner import BaseHorovodWorker
+        self._mk = BaseHorovodWorker
+        self.hosts = list(hosts)
+        self.die_plan = die_plan
+        self.round = -1
+        self._dead = set()
+
+    def start_workers(self, plan):
+        self.round += 1
+        self._dead = set()
+        workers = []
+        # simulate placement on non-blacklisted... the backend doesn't see
+        # the blacklist; the executor shrinks the plan instead, and we
+        # spread over however many hosts still have live actors planned
+        alive_hosts = [h for h in self.hosts
+                       if not self._always_dead(h)]
+        for i in range(plan.num_workers):
+            w = self._mk(world_rank=i)
+            w._host = alive_hosts[i % len(alive_hosts)]
+            workers.append(w)
+        return workers
+
+    def _always_dead(self, host):
+        # hosts that died in a PREVIOUS round stay gone (the blacklisted
+        # machine is down) — placement avoids them
+        return any(host in d for r, d in self.die_plan.items()
+                   if r < self.round)
+
+    def _maybe_die(self, w):
+        if w._host in self.die_plan.get(self.round, set()):
+            self._dead.add(id(w))
+        if id(w) in self._dead:
+            raise RuntimeError(f"actor on {w._host} died")
+
+    def call(self, worker, method, *args, **kw):
+        if id(worker) in self._dead:
+            raise RuntimeError(f"actor on {worker._host} died")
+        if method == "hostname":
+            return worker._host
+        return getattr(worker, method)(*args, **kw)
+
+    def call_all(self, workers, method, argss=None):
+        import os
+        argss = argss or [() for _ in workers]
+        if method == "hostname":
+            return [w._host for w in workers]
+        if method == "update_env_vars":
+            # in-process workers share os.environ: store per-worker env
+            # instead, applied around execute (a real Ray actor has its
+            # own process env)
+            for w, a in zip(workers, argss):
+                w._env = dict(a[0])
+            return [None] * len(workers)
+        outs = []
+        for w, a in zip(workers, argss):
+            if method == "execute":
+                self._maybe_die(w)
+                saved = dict(os.environ)
+                os.environ.update(w._env)
+                try:
+                    outs.append(getattr(w, method)(*a))
+                finally:
+                    os.environ.clear()
+                    os.environ.update(saved)
+            else:
+                outs.append(getattr(w, method)(*a))
+        return outs
+
+    def stop_workers(self, workers):
+        pass
+
+
+def _elastic_fn(tag):
+    import os
+    return (os.environ["HOROVOD_RANK"], os.environ["HOROVOD_SIZE"],
+            os.environ["HOROVOD_HOSTNAME"], tag)
+
+
+def test_elastic_ray_executor_blacklists_and_recovers():
+    from horovod_tpu.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.ray.elastic import ElasticRayExecutor
+
+    disc = FixedHostDiscovery({"hostA": 2, "hostB": 2})
+    ex = ElasticRayExecutor(
+        disc, min_np=2, reset_limit=3,
+        backend=_FlakyBackend(["hostA", "hostB"], {0: {"hostB"}}))
+    results = ex.run(_elastic_fn, args=("t",))
+    # round 0 failed on hostB -> blacklist -> round 1 runs on hostA only
+    assert ex.resets == 1
+    assert ex.manager.states["hostB"].blacklisted
+    assert len(results) == 2
+    assert all(r[2] == "hostA" and r[3] == "t" for r in results)
+    assert sorted(r[0] for r in results) == ["0", "1"]
+    assert all(r[1] == "2" for r in results)
+
+
+def test_elastic_ray_executor_reset_limit():
+    from horovod_tpu.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.ray.elastic import ElasticRayExecutor
+
+    disc = FixedHostDiscovery({"hostA": 2})
+    # hostA actors keep dying; with min_np=1 the blacklist would starve the
+    # loop, so deaths must trip reset_limit... but blacklisting hostA means
+    # _current_slots returns None and the loop waits; use a plan where the
+    # FUNCTION fails (no dead actor -> nothing blacklisted) every round.
+    class _AlwaysFnFail(_FlakyBackend):
+        def call_all(self, workers, method, argss=None):
+            if method == "execute":
+                raise RuntimeError("fn blew up")
+            return super().call_all(workers, method, argss)
+
+    ex = ElasticRayExecutor(disc, min_np=1, reset_limit=2,
+                            backend=_AlwaysFnFail(["hostA"], {}))
+    with pytest.raises(RuntimeError, match="reset_limit"):
+        ex.run(_elastic_fn, args=("t",))
+    assert ex.resets == 3
