@@ -23,8 +23,8 @@ from .core.types import (                                      # noqa: F401
 )
 from .core.basics import (                                     # noqa: F401
     init, shutdown, is_initialized,
-    size, rank, local_size, local_rank, cross_size, cross_rank,
-    is_homogeneous,
+    size, rank, stacked_rank, local_size, local_rank, cross_size,
+    cross_rank, is_homogeneous,
     mpi_threads_supported, mpi_built, mpi_enabled, gloo_built, gloo_enabled,
     nccl_built, ddl_built, ccl_built, cuda_built, rocm_built,
     tpu_built, tpu_enabled,

@@ -233,9 +233,27 @@ def rank() -> int:
     In multi-process mode each process controls `local_size()` consecutive
     devices and `rank()` is the first of them; in single-controller mode this
     is 0 and per-device ranks appear as the leading axis of stacked arrays.
+
+    NOTE for reference-script ports: a script that branches on
+    ``rank() == 0`` for per-WORKER behavior (e.g. "only rank 0 logs")
+    keeps its meaning — one controller, one log. But per-DEVICE rank
+    semantics (e.g. "each rank seeds with its rank") must move to the
+    data level: use :func:`stacked_rank` to get each device-rank's index
+    as a stacked array row.
     """
     _require_init()
     return jax.process_index() * local_size()
+
+
+def stacked_rank():
+    """Per-device global ranks as a stacked [size] int32 array — row i is
+    rank i's value of "my rank". The stacked-data counterpart of the
+    reference's per-process ``hvd.rank()`` for scripts that need a
+    per-rank value (seeding, sharding offsets) under the
+    single-controller SPMD model."""
+    import numpy as np
+    _require_init()
+    return np.arange(size(), dtype=np.int32)
 
 
 def local_size() -> int:

@@ -57,21 +57,51 @@ def _timeline_span(fn):
     """Emit a begin/end timeline span around a sync collective call —
     the sync-path analog of the reference's per-op activity events
     (timeline activity hooks throughout PerformOperation,
-    operations.cc:283-304)."""
+    operations.cc:283-304) — plus a jax.profiler.TraceAnnotation so the
+    span also shows up in TPU xplane traces correlated with device time
+    (the NVTX-range analog, horovod/common/nvtx_op_range.cc; disable via
+    HOROVOD_DISABLE_NVTX_RANGES like the reference, operations.cc:489)."""
     phase = fn.__name__.upper()
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        tl = basics.get_state().timeline
-        if tl is None or getattr(_tl_local, "in_engine", False):
-            return fn(*args, **kwargs)
         tag = kwargs.get("name") or fn.__name__
-        tl.begin(tag, phase)
-        try:
-            return fn(*args, **kwargs)
-        finally:
-            tl.end(tag, phase)
+        with profiler_range(f"hvd.{phase}.{tag}"):
+            tl = basics.get_state().timeline
+            if tl is None or getattr(_tl_local, "in_engine", False):
+                return fn(*args, **kwargs)
+            tl.begin(tag, phase)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                tl.end(tag, phase)
     return wrapper
+
+
+class _NullRange:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_RANGE = _NullRange()
+_profiler_disabled = None
+
+
+def profiler_range(name: str):
+    """jax.profiler.TraceAnnotation for `name`, or a no-op when ranges are
+    disabled (HOROVOD_DISABLE_NVTX_RANGES=1, mirroring the reference's
+    NVTX switch)."""
+    global _profiler_disabled
+    if _profiler_disabled is None:
+        import os
+        _profiler_disabled = os.environ.get(
+            "HOROVOD_DISABLE_NVTX_RANGES", "").strip() in ("1", "true")
+    if _profiler_disabled:
+        return _NULL_RANGE
+    return jax.profiler.TraceAnnotation(name)
 
 
 def _check_stacked(x, n: int, what: str) -> None:

@@ -22,7 +22,19 @@ TPU-native re-design of the reference's background-thread core:
 In single-controller SPMD mode no cross-rank negotiation is needed: every
 request is visible to the one controller, so `ComputeResponseList` reduces to
 local bucketization. In multi-process mode the native DCN controller
-(native/) plays the coordinator role.
+(native/) plays the coordinator role via per-cycle readiness allgathers
+(`_negotiate`).
+
+Overlap note (the reference's async-completion path,
+gpu_operations.cc:59-129): buckets are LAUNCHED serially from the dispatch
+thread, but jax's eager dispatch is asynchronous — each collective returns
+a future-backed Array immediately, so consecutive buckets overlap on the
+device exactly like the reference's per-stream NCCL launches; handles
+resolve with un-materialized arrays and callers block only when they read
+values (the XLA-native equivalent of HOROVOD_ENABLE_ASYNC_COMPLETION,
+which operations.cc:621-626 forces on for XLA). The exceptions that do
+block the dispatch thread are grouped ops (atomicity requires
+materialization before resolution) and multi-process negotiation rounds.
 """
 from __future__ import annotations
 
@@ -590,19 +602,24 @@ class Engine:
                 tl.end(w.name, "QUEUED")
                 tl.begin(w.name, phase)
         try:
-            if bucket[0].group_id >= 0:
-                results = self._execute_group(bucket)
-            elif len(bucket) == 1 and \
-                    bucket[0].request_type != RequestType.ALLREDUCE:
-                results = [self._execute_single(bucket[0])]
-            elif len(bucket) == 1:
-                w = bucket[0]
-                results = [collective_ops.allreduce(
-                    w.tensor, w.op, process_set=w.process_set,
-                    prescale_factor=w.prescale,
-                    postscale_factor=w.postscale)]
-            else:
-                results = self._execute_fused_allreduce(bucket)
+            # xplane span per bucket (NVTX-range analog,
+            # nvtx_op_range.cc): correlates the dispatch-thread launch
+            # with device time in TPU profiler traces
+            with collective_ops.profiler_range(
+                    f"hvd.{phase}.x{len(bucket)}"):
+                if bucket[0].group_id >= 0:
+                    results = self._execute_group(bucket)
+                elif len(bucket) == 1 and \
+                        bucket[0].request_type != RequestType.ALLREDUCE:
+                    results = [self._execute_single(bucket[0])]
+                elif len(bucket) == 1:
+                    w = bucket[0]
+                    results = [collective_ops.allreduce(
+                        w.tensor, w.op, process_set=w.process_set,
+                        prescale_factor=w.prescale,
+                        postscale_factor=w.postscale)]
+                else:
+                    results = self._execute_fused_allreduce(bucket)
             status = Status.ok()
         except Exception as e:
             logger.exception("bucket %s failed", names)

@@ -15,7 +15,6 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..elastic.discovery import HostDiscovery, HostManager
-from ..runner.hosts import HostInfo, get_host_assignments
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -65,9 +64,9 @@ class ElasticRayExecutor:
     """Fault-tolerant actor-fleet executor (reference ElasticRayExecutor,
     horovod/ray/elastic.py:479 / elastic_v2.py ElasticAdapter).
 
-    Each round: poll discovery -> assign slots (min_np..max_np) -> start
-    one worker per slot (placement preserves surviving hosts' rank blocks
-    like ElasticDriver._compute_slots) -> run `fn` on all. An actor
+    Each round: poll discovery -> pick the world size (min_np..max_np
+    over non-blacklisted hosts) -> start one actor per slot, assigning
+    ranks from the actors' REAL placement -> run `fn` on all. An actor
     failure blacklists its host (cooldown + resurrection via HostManager)
     and starts the next round; `fn` is responsible for resuming from
     committed state (hvd.elastic.run / FileBackedState), exactly as in the
@@ -81,8 +80,7 @@ class ElasticRayExecutor:
                  reset_limit: Optional[int] = None,
                  env_vars: Optional[Dict[str, str]] = None,
                  backend: Optional[Any] = None,
-                 cpus_per_worker: float = 1.0,
-                 override_discovery: bool = True) -> None:
+                 cpus_per_worker: float = 1.0) -> None:
         self.manager = HostManager(discovery)
         self.min_np = min_np
         self.max_np = max_np
@@ -92,75 +90,51 @@ class ElasticRayExecutor:
         self._backend = backend
         self.resets = 0
 
-    def _current_slots(self, previous):
+    def _current_np(self) -> Optional[int]:
+        """World size for the next round from non-blacklisted discovery,
+        clamped to [min_np, max_np]. Rank blocks are assigned later from
+        the actors' REAL placement (establish_rendezvous), so only the
+        count matters here. NOTE: actor placement itself is Ray's choice
+        — a blacklisted-but-alive node that Ray reuses fails its next
+        round too, refreshing the blacklist until its cooldown passes;
+        rounds are bounded by reset_limit."""
         hosts = self.manager.current_hosts()
         np_ = sum(h.slots for h in hosts)
         if self.max_np is not None:
             np_ = min(np_, self.max_np)
-        if np_ < self.min_np:
-            return None
-        if previous:
-            prev_order = []
-            for s in previous:
-                if s.hostname not in prev_order:
-                    prev_order.append(s.hostname)
-            cur = {h.hostname: h for h in hosts}
-            ordered = [cur[n] for n in prev_order if n in cur]
-            ordered += [h for h in hosts if h.hostname not in prev_order]
-        else:
-            ordered = hosts
-        return get_host_assignments(ordered, np_)
+        return np_ if np_ >= self.min_np else None
 
     def run(self, fn: Callable, args: Sequence = (),
             kwargs: Optional[dict] = None) -> List[Any]:
         """Run fn elastically; returns the per-rank results of the first
         round that completes on every worker."""
-        import socket
         import time
 
-        from .runner import Coordinator, _RayBackend, spread_plan, \
-            worker_env
+        from .runner import _RayBackend, establish_rendezvous, spread_plan
 
         if self._backend is None:
             self._backend = _RayBackend()
-        slots = None
         while True:
-            slots = self._current_slots(slots)
-            if slots is None:
+            np_ = self._current_np()
+            if np_ is None:
                 time.sleep(1.0)
                 continue
-            plan = spread_plan(len(slots), self.cpus_per_worker, 0.0)
-            workers = self._backend.start_workers(plan)
+            workers: List[Any] = []
             kv_server = None
-            worker_hosts: List[Optional[str]] = [None] * len(workers)
+            worker_hosts: List[Optional[str]] = []
             try:
-                # rank assignment from ACTUAL actor placement (like
-                # RayExecutor.start): Ray chooses the hosts, so hostnames
-                # must be queried, not assumed from the discovery order —
-                # otherwise a failure would blacklist the wrong host
-                hostnames = self._backend.call_all(workers, "hostname")
-                worker_hosts = list(hostnames)
-                coord = Coordinator()
-                for hn in hostnames:
-                    coord.register(hn)
-                placed = coord.slots()
-                # KV-store rendezvous for the workers' control plane (the
-                # same StoreServer RayExecutor.start provides)
-                kv_addr = kv_port = None
-                try:
-                    from ..native.store import StoreServer
-                    kv_server = StoreServer()
-                    kv_addr, kv_port = socket.gethostname(), kv_server.port
-                    if len(set(hostnames)) == 1:
-                        kv_addr = "127.0.0.1"
-                except Exception:  # noqa: BLE001 — toolchain-less driver
-                    kv_server = None
+                # actor startup is part of the round: a placement failure
+                # (node died since discovery) resets like any other
+                plan = spread_plan(np_, self.cpus_per_worker, 0.0)
+                workers = self._backend.start_workers(plan)
+                worker_hosts = [None] * len(workers)
+                # rank assignment from ACTUAL placement + KV rendezvous +
+                # identity env (shared with RayExecutor.start)
                 shm_gen = str(uuid.uuid4().int & ((1 << 62) - 1))
-                self._backend.call_all(
-                    workers, "update_env_vars",
-                    [(dict(worker_env(s, kv_addr, kv_port, self.env_vars),
-                           HOROVOD_SHM_GEN=shm_gen),)
-                     for s in placed])
+                slots, kv_server = establish_rendezvous(
+                    self._backend, workers, self.env_vars,
+                    extra_env={"HOROVOD_SHM_GEN": shm_gen})
+                worker_hosts = [s.hostname for s in slots]
                 return self._backend.call_all(
                     workers, "execute",
                     [(fn, tuple(args), kwargs) for _ in workers])

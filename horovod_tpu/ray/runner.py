@@ -130,7 +130,9 @@ class _RayBackend:
         ray = self._ray
         from ray.util.placement_group import placement_group
         self._pg = placement_group(plan.bundles, strategy=plan.strategy)
-        ray.get(self._pg.ready())
+        # bounded wait: an infeasible group (node died since discovery)
+        # must surface as a round failure, not block forever
+        ray.get(self._pg.ready(), timeout=120)
         RemoteWorker = ray.remote(BaseHorovodWorker)
         workers, rank = [], 0
         for bundle_idx, w in enumerate(plan.workers_per_bundle):
@@ -166,6 +168,36 @@ class _RayBackend:
             from ray.util.placement_group import remove_placement_group
             remove_placement_group(self._pg)
             self._pg = None
+
+
+def establish_rendezvous(backend, workers, env_vars=None, extra_env=None):
+    """Shared fleet-rendezvous tail (the Coordinator.establish_rendezvous
+    role in the reference): rank assignment from the actors' REAL
+    placement + KV-store control-plane setup + identity env push.
+    Returns (slots, kv_server-or-None). Used by RayExecutor.start and
+    ElasticRayExecutor.run so the two paths cannot diverge."""
+    coord = Coordinator()
+    hostnames = backend.call_all(workers, "hostname")
+    for hn in hostnames:
+        coord.register(hn)
+    slots = coord.slots()
+    kv_addr = kv_port = kv_server = None
+    try:
+        from ..native.store import StoreServer
+        kv_server = StoreServer()
+        kv_addr, kv_port = socket.gethostname(), kv_server.port
+        # loopback ONLY when the single worker host IS this driver host —
+        # a remote single-host fleet must still dial the driver
+        if set(hostnames) == {socket.gethostname()}:
+            kv_addr = "127.0.0.1"
+    except Exception:  # noqa: BLE001 — toolchain-less driver host
+        kv_server = None
+    backend.call_all(
+        workers, "update_env_vars",
+        [(dict(worker_env(s, kv_addr, kv_port, env_vars),
+               **(extra_env or {})),)
+         for s in slots])
+    return slots, kv_server
 
 
 class RayExecutor:
@@ -206,23 +238,8 @@ class RayExecutor:
         if self._backend is None:
             self._backend = _RayBackend()
         self.workers = self._backend.start_workers(self.plan)
-        coord = Coordinator()
-        for hn in self._backend.call_all(self.workers, "hostname"):
-            coord.register(hn)
-        self.slots = coord.slots()
-        kv_addr = kv_port = None
-        try:
-            from ..native.store import StoreServer
-            self._kv_server = StoreServer()
-            kv_addr, kv_port = socket.gethostname(), self._kv_server.port
-            if len({s.hostname for s in self.slots}) == 1:
-                kv_addr = "127.0.0.1"
-        except Exception:  # noqa: BLE001 — toolchain-less driver host
-            self._kv_server = None
-        self._backend.call_all(
-            self.workers, "update_env_vars",
-            [(worker_env(s, kv_addr, kv_port, self.env_vars),)
-             for s in self.slots])
+        self.slots, self._kv_server = establish_rendezvous(
+            self._backend, self.workers, self.env_vars)
 
     def shutdown(self) -> None:
         if self._backend is not None and self.workers:

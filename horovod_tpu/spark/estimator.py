@@ -161,13 +161,7 @@ class FlaxEstimator:
         """Train from already-materialized parquet in the Store (the
         petastorm-reader path: data streams row-group-wise through
         ParquetShardReader instead of living in one array)."""
-        import jax
-        import jax.numpy as jnp
-        import optax
-
         from ..core import basics
-        from ..optim.optimizer import DistributedOptimizer
-        from ..training import cross_entropy_loss
 
         if not basics.is_initialized():
             basics.init()
@@ -180,6 +174,21 @@ class FlaxEstimator:
         val_reader = (self._reader(val_path, self.batch_size,
                                    drop_remainder=False)
                       if val_path is not None else None)
+        try:
+            return self._fit_loop(reader, val_reader, n_dev, per_dev)
+        finally:
+            # staged temp copies must go even when training raises
+            self._cleanup(reader, val_reader)
+
+    def _fit_loop(self, reader, val_reader, n_dev: int,
+                  per_dev: int) -> "FlaxModel":
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ..optim.optimizer import DistributedOptimizer
+        from ..training import cross_entropy_loss
+
         xs0, _ = next(reader.batches(0), (None, None))
         if xs0 is None:
             # train split smaller than one global batch: initialize from
@@ -248,7 +257,6 @@ class FlaxEstimator:
         final_params = jax.tree_util.tree_map(lambda a: a[0], params)
         fm = FlaxModel(self.model, final_params, batch_stats)
         fm.save(self.store, self.run_id)
-        self._cleanup(reader, val_reader)
         return fm
 
     @staticmethod

@@ -107,10 +107,14 @@ class ParquetShardReader:
 
     def _decode(self, table) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         def col(name):
-            arr = table.column(name).to_pylist()
+            arr = table.column(name).combine_chunks()
             shape = table.column(f"{name}_shape")[0].as_py()
-            dtype = table.column(f"{name}_dtype")[0].as_py()
-            return np.asarray(arr, dtype=np.dtype(dtype)).reshape(
+            dtype = np.dtype(table.column(f"{name}_dtype")[0].as_py())
+            # list-array cells are equal-length: the flat values buffer
+            # decodes without per-cell Python objects (hot-loop path —
+            # every epoch re-reads every row group)
+            flat = arr.values.to_numpy(zero_copy_only=False)
+            return flat.astype(dtype, copy=False).reshape(
                 (len(arr), *shape))
 
         feats = col(self.feature_col)

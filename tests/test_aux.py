@@ -204,3 +204,30 @@ class TestTimeline:
         with pytest.raises(ValueError):
             hvd.start_timeline(str(tmp_path / "b.json"))
         hvd.stop_timeline()
+
+
+def test_stacked_rank_helper(hvd):
+    """Per-device rank values for stacked computations (the doc'd port
+    path for scripts using per-rank hvd.rank() semantics)."""
+    r = hvd.stacked_rank()
+    assert r.tolist() == list(range(hvd.size()))
+    # canonical use: per-rank contribution derived from the rank index
+    x = (r[:, None] * np.ones((hvd.size(), 2), np.float32))
+    out = np.asarray(hvd.allreduce(x, hvd.Sum))
+    expect = sum(range(hvd.size()))
+    np.testing.assert_allclose(out, np.full((hvd.size(), 2), expect))
+
+
+def test_profiler_range_disable_env(monkeypatch):
+    from horovod_tpu.ops import collective_ops as co
+    co._profiler_disabled = None
+    monkeypatch.setenv("HOROVOD_DISABLE_NVTX_RANGES", "1")
+    rng = co.profiler_range("x")
+    assert rng is co._NULL_RANGE
+    with rng:
+        pass
+    co._profiler_disabled = None
+    monkeypatch.delenv("HOROVOD_DISABLE_NVTX_RANGES")
+    import jax
+    assert isinstance(co.profiler_range("y"), jax.profiler.TraceAnnotation)
+    co._profiler_disabled = None
