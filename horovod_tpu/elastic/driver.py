@@ -32,7 +32,10 @@ class ElasticDriver:
     def __init__(self, discovery, command: List[str], min_np: int,
                  max_np: Optional[int] = None, reset_limit: Optional[int] = None,
                  base_env: Optional[dict] = None,
-                 poll_interval: float = 1.0):
+                 poll_interval: float = 1.0,
+                 ssh_port: Optional[int] = None,
+                 ssh_identity_file: Optional[str] = None,
+                 output_dir: Optional[str] = None):
         self.manager = HostManager(discovery)
         self.command = command
         self.min_np = min_np
@@ -40,6 +43,9 @@ class ElasticDriver:
         self.reset_limit = reset_limit
         self.base_env = dict(base_env if base_env is not None else os.environ)
         self.poll_interval = poll_interval
+        self.ssh_port = ssh_port
+        self.ssh_identity_file = ssh_identity_file
+        self.output_dir = output_dir
         self.resets = 0
         self._assignments: Dict[str, List[SlotInfo]] = {}
         self._workers: List[exec_lib.WorkerProcess] = []
@@ -114,7 +120,10 @@ class ElasticDriver:
         env = dict(self.base_env)
         env["HOROVOD_SHM_GEN"] = str(uuid.uuid4().int & ((1 << 63) - 1))
         self._workers = exec_lib.launch_slots(
-            slots, self.command, coord, kv_port, self._secret, env)
+            slots, self.command, coord, kv_port, self._secret, env,
+            ssh_port=self.ssh_port,
+            ssh_identity_file=self.ssh_identity_file,
+            output_dir=self.output_dir)
 
     def _supervise(self, slots: List[SlotInfo]) -> str:
         """Watch workers + host set. Returns 'done' or 'reset'."""
@@ -166,11 +175,17 @@ def run_elastic(args) -> int:
     from ..runner.launch import env_from_args
     base_env = dict(os.environ)
     base_env.update(env_from_args(args))
-    discovery = HostDiscoveryScript(args.host_discovery_script)
+    discovery = HostDiscoveryScript(
+        args.host_discovery_script,
+        default_slots=getattr(args, "slots", None) or 1)
     driver = ElasticDriver(
         discovery, args.command,
         min_np=args.min_np or 1, max_np=args.max_np,
-        base_env=base_env)
+        reset_limit=getattr(args, "reset_limit", None),
+        base_env=base_env,
+        ssh_port=getattr(args, "ssh_port", None),
+        ssh_identity_file=getattr(args, "ssh_identity_file", None),
+        output_dir=getattr(args, "output_filename", None))
     return driver.run()
 
 

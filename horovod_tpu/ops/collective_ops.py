@@ -78,15 +78,9 @@ def _timeline_span(fn):
     return wrapper
 
 
-class _NullRange:
-    def __enter__(self):
-        return self
+from contextlib import nullcontext
 
-    def __exit__(self, *exc):
-        return False
-
-
-_NULL_RANGE = _NullRange()
+_NULL_RANGE = nullcontext()
 _profiler_disabled = None
 
 

@@ -192,11 +192,18 @@ def establish_rendezvous(backend, workers, env_vars=None, extra_env=None):
             kv_addr = "127.0.0.1"
     except Exception:  # noqa: BLE001 — toolchain-less driver host
         kv_server = None
-    backend.call_all(
-        workers, "update_env_vars",
-        [(dict(worker_env(s, kv_addr, kv_port, env_vars),
-               **(extra_env or {})),)
-         for s in slots])
+    try:
+        backend.call_all(
+            workers, "update_env_vars",
+            [(dict(worker_env(s, kv_addr, kv_port, env_vars),
+                   **(extra_env or {})),)
+             for s in slots])
+    except Exception:
+        # a failed env push means the server never reaches the caller —
+        # close it here or the socket lingers for the exception's lifetime
+        if kv_server is not None:
+            kv_server.close()
+        raise
     return slots, kv_server
 
 

@@ -48,9 +48,12 @@ def is_local(hostname: str) -> bool:
 
 
 def build_command(slot: SlotInfo, command: List[str],
-                  env: Dict[str, str]) -> List[str]:
+                  env: Dict[str, str],
+                  ssh_port: Optional[int] = None,
+                  ssh_identity_file: Optional[str] = None) -> List[str]:
     """Local: run directly. Remote: wrap in ssh with env exported inline
-    (the reference does the same, gloo_run.py:_exec_command_fn)."""
+    (the reference does the same, gloo_run.py:_exec_command_fn; -p/-i are
+    the reference's --ssh-port/--ssh-identity-file flags)."""
     if is_local(slot.hostname):
         return command
     exports = " ".join(
@@ -58,34 +61,61 @@ def build_command(slot: SlotInfo, command: List[str],
         if k.startswith("HOROVOD_") or k in ("PATH", "PYTHONPATH"))
     remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
         " ".join(shlex.quote(c) for c in command)
-    return ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote]
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port is not None:
+        ssh += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        ssh += ["-i", ssh_identity_file]
+    return ssh + [slot.hostname, remote]
 
 
 class WorkerProcess:
     """One launched slot with prefixed streaming output
-    (safe_shell_exec.py analog: kills the whole process group)."""
+    (safe_shell_exec.py analog: kills the whole process group).
+    `output_dir` redirects the merged stream to <dir>/rank.<N>
+    (reference --output-filename)."""
 
     def __init__(self, slot: SlotInfo, command: List[str],
-                 env: Dict[str, str], prefix_output: bool = True):
+                 env: Dict[str, str], prefix_output: bool = True,
+                 ssh_port: Optional[int] = None,
+                 ssh_identity_file: Optional[str] = None,
+                 output_dir: Optional[str] = None):
         self.slot = slot
         self.prefix = f"[{slot.rank}]<stdout>:" if prefix_output else ""
+        self._sink = None
+        if output_dir:
+            os.makedirs(output_dir, exist_ok=True)
+            self._sink = open(
+                os.path.join(output_dir, f"rank.{slot.rank}"), "w")
         self.proc = subprocess.Popen(
-            build_command(slot, command, env), env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            build_command(slot, command, env, ssh_port, ssh_identity_file),
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             start_new_session=True)
         self._pump = threading.Thread(target=self._stream, daemon=True)
         self._pump.start()
 
     def _stream(self):
+        # the pump OWNS the sink: it closes it at pipe EOF, so a slow
+        # drain can never race a close from wait()
         assert self.proc.stdout is not None
-        for line in self.proc.stdout:
-            sys.stdout.write(
-                f"{self.prefix}{line.decode(errors='replace')}")
-            sys.stdout.flush()
+        sink = self._sink
+        try:
+            for line in self.proc.stdout:
+                text = line.decode(errors="replace")
+                if sink is not None:
+                    sink.write(text)
+                    sink.flush()
+                else:
+                    sys.stdout.write(f"{self.prefix}{text}")
+                    sys.stdout.flush()
+        finally:
+            if sink is not None:
+                sink.close()
 
     def wait(self, timeout: Optional[float] = None) -> int:
         rc = self.proc.wait(timeout)
-        self._pump.join(timeout=2)
+        # give the pump time to drain the pipe; it closes the sink itself
+        self._pump.join(timeout=10)
         return rc
 
     def terminate(self) -> None:
@@ -97,9 +127,15 @@ class WorkerProcess:
 
 def launch_slots(slots: List[SlotInfo], command: List[str],
                  coordinator_addr: str, kv_port: int, secret: str,
-                 base_env: Optional[Dict[str, str]] = None
+                 base_env: Optional[Dict[str, str]] = None,
+                 ssh_port: Optional[int] = None,
+                 ssh_identity_file: Optional[str] = None,
+                 output_dir: Optional[str] = None
                  ) -> List[WorkerProcess]:
     return [WorkerProcess(s, command,
                           slot_env(s, coordinator_addr, kv_port, secret,
-                                   base_env))
+                                   base_env),
+                          ssh_port=ssh_port,
+                          ssh_identity_file=ssh_identity_file,
+                          output_dir=output_dir)
             for s in slots]

@@ -13,6 +13,10 @@ simulation it is any N.
 """
 from __future__ import annotations
 
+import hashlib
+import os
+import socket
+
 from dataclasses import dataclass
 from typing import List
 
@@ -133,3 +137,15 @@ def assign_from_hostnames(hostnames: List[str]) -> List[SlotInfo]:
         out.append(by_host[h][taken[h]])
         taken[h] += 1
     return out
+
+
+def host_hash(salt=None) -> str:
+    """Stable identifier for THIS physical host, used to detect
+    co-located processes (reference common/util/host_hash.py host_hash:
+    domain-stripped hostname + optional salt, overridable via
+    HOROVOD_HOSTNAME for containers whose hostnames collide)."""
+    name = os.environ.get("HOROVOD_HOSTNAME") or \
+        socket.gethostname().split(".")[0]
+    if salt is not None:
+        name = f"{name}-{salt}"
+    return hashlib.md5(name.encode()).hexdigest()

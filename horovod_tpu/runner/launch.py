@@ -85,6 +85,20 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="Elastic: maximum workers.")
     p.add_argument("--host-discovery-script", default=None,
                    help="Elastic: executable printing 'host:slots' lines.")
+    p.add_argument("--reset-limit", type=int, default=None,
+                   help="Elastic: max reset events before aborting "
+                        "(reference --reset-limit).")
+    p.add_argument("--slots", type=int, default=None,
+                   help="Elastic: slots per discovered host without an "
+                        "explicit ':slots' (reference --slots).")
+    p.add_argument("-p", "--ssh-port", type=int, default=None,
+                   help="SSH port for remote workers (reference -p).")
+    p.add_argument("-i", "--ssh-identity-file", default=None,
+                   help="SSH identity file (reference -i).")
+    p.add_argument("--output-filename", default=None,
+                   help="Write each worker's merged stdout/stderr to "
+                        "<dir>/rank.<N> instead of the console "
+                        "(reference --output-filename).")
     p.add_argument("--tpu-pod", action="store_true", default=None,
                    help="Derive hosts from TPU pod metadata "
                         "(TPU_WORKER_HOSTNAMES); one process per TPU VM. "
@@ -100,8 +114,26 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
     if args.config_file:
         with open(args.config_file) as f:
-            conf = json.load(f)
-        for k, v in conf.items():
+            text = f.read()
+        conf = None
+        if args.config_file.endswith((".yaml", ".yml")):
+            # the reference config file is YAML (config_parser.py)
+            try:
+                import yaml
+                conf = yaml.safe_load(text)
+            except ImportError:
+                pass
+        if conf is None:
+            try:
+                conf = json.loads(text)
+            except json.JSONDecodeError:
+                import yaml
+                conf = yaml.safe_load(text)
+        if conf is not None and not isinstance(conf, dict):
+            raise SystemExit(
+                f"hvdrun: --config-file {args.config_file} must contain a "
+                f"mapping of flag names to values, got {type(conf).__name__}")
+        for k, v in (conf or {}).items():
             k = k.replace("-", "_")
             if getattr(args, k, None) is None:
                 setattr(args, k, v)
@@ -175,8 +207,11 @@ def run_static(args: argparse.Namespace) -> int:
             native_server.close()
         native_server = None
 
-    workers = exec_lib.launch_slots(slots, args.command, coord, port,
-                                    secret, base_env)
+    workers = exec_lib.launch_slots(
+        slots, args.command, coord, port, secret, base_env,
+        ssh_port=getattr(args, "ssh_port", None),
+        ssh_identity_file=getattr(args, "ssh_identity_file", None),
+        output_dir=getattr(args, "output_filename", None))
     rc = 0
     try:
         for w in workers:

@@ -1,0 +1,199 @@
+"""Keras estimator: fit a tf.keras model on array data via a Store.
+
+Re-design of the reference's spark/keras/estimator.py (`KerasEstimator`,
+537 LoC: Spark ML Estimator.fit(df) -> KerasModel — DataFrame materialized
+to the Store as parquet, workers train with petastorm readers and the
+horovod keras DistributedOptimizer, checkpoint to the Store, transformer
+returned with trained weights).
+
+Here the data path is the shared parquet layer (spark/parquet.py) and the
+training plane is the tf.keras binding (interop/keras.py): under
+`hvdrun -np N` each rank streams its row-group shard and gradients average
+over the process plane; standalone it degrades to one worker. Artifact
+layout matches spark/common/store.py conventions via the Store.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .store import LocalStore, Store
+
+
+class KerasModel:
+    """Trained-model transformer (reference KerasModel,
+    spark/keras/estimator.py)."""
+
+    def __init__(self, model: Any,
+                 feature_cols: Optional[List[str]] = None,
+                 label_cols: Optional[List[str]] = None) -> None:
+        self.model = model
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.predict(np.asarray(x), verbose=0))
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        return self.predict(x)
+
+    def save(self, store: Store, run_id: str) -> str:
+        path = store.get_checkpoint_path(run_id)
+        with tempfile.TemporaryDirectory() as tmp:
+            local = os.path.join(tmp, "model.keras")
+            self.model.save(local)
+            with open(local, "rb") as f:
+                store.write(path, f.read())
+        return path
+
+    @classmethod
+    def load(cls, store: Store, run_id: str) -> "KerasModel":
+        from ..interop.keras import load_model
+        with tempfile.TemporaryDirectory() as tmp:
+            local = os.path.join(tmp, "model.keras")
+            with open(local, "wb") as f:
+                f.write(store.read(store.get_checkpoint_path(run_id)))
+            return cls(load_model(local))
+
+
+class KerasEstimator:
+    """`fit(x, y) -> KerasModel`: Store-backed parquet data + per-rank
+    shard training with the keras DistributedOptimizer.
+
+    Args mirror the reference estimator params (spark/common/params.py +
+    keras/estimator.py): model, optimizer, loss, epochs, batch_size,
+    store, run_id, validation fraction, callbacks.
+    """
+
+    def __init__(self, model: Any, optimizer: Any = None,
+                 loss: Any = None, *,
+                 metrics: Optional[List[Any]] = None,
+                 epochs: int = 1, batch_size: int = 32,
+                 store: Optional[Store] = None,
+                 run_id: Optional[str] = None,
+                 validation: float = 0.0,
+                 shuffle: bool = True,
+                 seed: int = 0,
+                 callbacks: Optional[List[Any]] = None) -> None:
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = metrics
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.store = store or LocalStore()
+        self.run_id = run_id or f"run_{uuid.uuid4().hex[:12]}"
+        self.validation = validation
+        self.shuffle = shuffle
+        self.seed = seed
+        self.callbacks = list(callbacks or [])
+        self.history: Dict[str, List[float]] = {}
+
+    def _materialize(self, x: np.ndarray, y: np.ndarray
+                     ) -> Tuple[str, Optional[str]]:
+        from .parquet import write_parquet
+
+        n = x.shape[0]
+        n_val = int(n * self.validation)
+        rng = np.random.RandomState(self.seed)
+        order = rng.permutation(n) if self.shuffle else np.arange(n)
+        val_idx, train_idx = order[:n_val], order[n_val:]
+
+        def put(path: str, xs, ys) -> None:
+            with tempfile.TemporaryDirectory() as tmp:
+                local = os.path.join(tmp, "data.parquet")
+                # small groups: the shardable unit must outnumber workers
+                write_parquet(local, xs, ys,
+                              rows_per_group=max(self.batch_size, 32))
+                with open(local, "rb") as f:
+                    self.store.write(path, f.read())
+
+        train_path = self.store.get_train_data_path(self.run_id)
+        put(train_path, x[train_idx], y[train_idx])
+        val_path = None
+        if n_val:
+            val_path = self.store.get_val_data_path(self.run_id)
+            put(val_path, x[val_idx], y[val_idx])
+        return train_path, val_path
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> KerasModel:
+        """Materialize to the Store, train this rank's shard with the
+        distributed keras optimizer, checkpoint (rank 0) to the Store."""
+        import horovod_tpu.interop.keras as hvd
+        from .parquet import ParquetShardReader
+
+        hvd.init()
+        rank, size = hvd.rank(), hvd.size()
+
+        train_path, val_path = self._materialize(np.asarray(x),
+                                                 np.asarray(y))
+
+        def stage(path: str) -> str:
+            tmp = tempfile.NamedTemporaryFile(suffix=".parquet",
+                                              delete=False)
+            tmp.write(self.store.read(path))
+            tmp.close()
+            return tmp.name
+
+        train_local = stage(train_path)
+        val_local = stage(val_path) if val_path else None
+        try:
+            # Ranks must run IDENTICAL batch counts — the gradient
+            # allreduce is a per-step collective (the petastorm readers in
+            # the reference equalize via steps_per_epoch the same way).
+            # Every rank derives the minimum shard size from the parquet
+            # metadata (deterministic, no extra collective) and truncates.
+            reader = ParquetShardReader(
+                train_local, shard_index=rank, num_shards=size,
+                batch_size=self.batch_size, shuffle=self.shuffle,
+                seed=self.seed)
+            meta = reader._pf.metadata
+            counts = [sum(meta.row_group(g).num_rows
+                          for g in range(meta.num_row_groups)
+                          if g % size == s) for s in range(size)]
+            min_rows = min(counts)
+            if min_rows == 0:
+                # fewer row groups than workers: stride-shard the rows
+                full = ParquetShardReader(
+                    train_local, batch_size=self.batch_size,
+                    shuffle=False)
+                xa, ya = full.read_shard()
+                xs, ys = xa[rank::size], ya[rank::size]
+                min_rows = len(xa) // size
+            else:
+                xs, ys = reader.read_shard()
+            xs, ys = xs[:min_rows], ys[:min_rows]
+
+            opt = hvd.DistributedOptimizer(self.optimizer) \
+                if self.optimizer is not None else None
+            if opt is not None:
+                self.model.compile(optimizer=opt, loss=self.loss,
+                                   metrics=self.metrics or None,
+                                   jit_compile=False)
+
+            cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                   hvd.callbacks.MetricAverageCallback()] + self.callbacks
+            kwargs = {}
+            if val_local is not None:
+                xv, yv = ParquetShardReader(
+                    val_local, batch_size=self.batch_size).read_shard()
+                kwargs["validation_data"] = (xv, yv)
+            hist = self.model.fit(xs, ys, epochs=self.epochs,
+                                  batch_size=self.batch_size,
+                                  shuffle=self.shuffle, verbose=0,
+                                  callbacks=cbs, **kwargs)
+            self.history = hist.history
+        finally:
+            os.unlink(train_local)
+            if val_local:
+                os.unlink(val_local)
+
+        km = KerasModel(self.model)
+        if rank == 0:
+            km.save(self.store, self.run_id)
+        hvd.barrier()
+        return km

@@ -226,6 +226,8 @@ def test_profiler_range_disable_env(monkeypatch):
     assert rng is co._NULL_RANGE
     with rng:
         pass
+    with rng:                      # nullcontext is reusable
+        pass
     co._profiler_disabled = None
     monkeypatch.delenv("HOROVOD_DISABLE_NVTX_RANGES")
     import jax

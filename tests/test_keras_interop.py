@@ -128,3 +128,43 @@ def test_keras_multiprocess_shm():
                   env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
                        "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
     assert results == [2.0, 2.0]
+
+
+def _keras_estimator_worker(store_root):
+    """2-process spark-layer KerasEstimator: per-rank parquet shards,
+    distributed optimizer, rank-0 checkpoint to the Store."""
+    import keras
+    import numpy as np
+    from horovod_tpu.spark.keras_estimator import KerasEstimator, KerasModel
+    from horovod_tpu.spark.store import LocalStore
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(96, 4).astype(np.float32)
+    y = rng.randint(0, 3, (96,)).astype(np.int32)
+
+    keras.utils.set_random_seed(7)
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(3),
+    ])
+    est = KerasEstimator(
+        model, keras.optimizers.SGD(0.05),
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        epochs=2, batch_size=16, store=LocalStore(store_root),
+        run_id="kest", validation=0.25)
+    fitted = est.fit(x, y)
+    preds = fitted.predict(x[:4])
+    assert preds.shape == (4, 3)
+    # checkpoint written by rank 0 and loadable
+    loaded = KerasModel.load(LocalStore(store_root), "kest")
+    np.testing.assert_allclose(loaded.predict(x[:4]), preds, rtol=1e-5)
+    return float(len(est.history["loss"]))
+
+
+def test_keras_estimator_multiprocess(tmp_path):
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_keras_estimator_worker, args=(str(tmp_path),),
+                  num_proc=2, job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [2.0, 2.0]

@@ -18,6 +18,16 @@ from horovod_tpu.runner.hosts import SlotInfo
 
 # -- strategy ---------------------------------------------------------------
 
+@pytest.fixture(autouse=True)
+def _restore_environ():
+    """The in-process worker backend mutates os.environ (update_env_vars);
+    restore it so HOROVOD_* identity can't leak into other tests."""
+    saved = dict(os.environ)
+    yield
+    os.environ.clear()
+    os.environ.update(saved)
+
+
 def test_colocated_plan_bundles():
     plan = colocated_plan(num_workers=5, workers_per_host=2,
                           cpus_per_worker=2.0)

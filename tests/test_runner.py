@@ -204,3 +204,54 @@ class TestTpuPodMode:
         with pytest.raises(RuntimeError, match="not an integer"):
             tpu_worker_id({"TPU_WORKER_ID": "worker-0"})
         assert tpu_worker_id({"TPU_WORKER_ID": " 3 "}) == 3
+
+
+class TestCliParity:
+    def test_yaml_config_file(self, tmp_path):
+        pytest.importorskip("yaml")
+        from horovod_tpu.runner.launch import env_from_args, parse_args
+        cfg = tmp_path / "conf.yaml"
+        cfg.write_text("cycle-time-ms: 9.0\nfusion-threshold-mb: 2\n")
+        args = parse_args(["-np", "1", "--config-file", str(cfg), "x"])
+        env = env_from_args(args)
+        assert env["HOROVOD_CYCLE_TIME"] == "9.0"
+        assert env["HOROVOD_FUSION_THRESHOLD"] == str(2 * 1024 * 1024)
+
+    def test_new_flags_parse(self):
+        from horovod_tpu.runner.launch import parse_args
+        args = parse_args(["-np", "2", "--reset-limit", "3", "--slots", "2",
+                           "-p", "2222", "-i", "/tmp/id_rsa",
+                           "--output-filename", "/tmp/out", "cmd"])
+        assert args.reset_limit == 3 and args.slots == 2
+        assert args.ssh_port == 2222
+        assert args.ssh_identity_file == "/tmp/id_rsa"
+        assert args.output_filename == "/tmp/out"
+
+    def test_ssh_command_options(self):
+        from horovod_tpu.runner.exec import build_command
+        from horovod_tpu.runner.hosts import SlotInfo
+        slot = SlotInfo("remotehost", 0, 2, 0, 1, 0, 2)
+        cmd = build_command(slot, ["echo", "hi"], {"PATH": "/usr/bin"},
+                            ssh_port=2222, ssh_identity_file="/k")
+        assert cmd[0] == "ssh"
+        assert "-p" in cmd and "2222" in cmd
+        assert "-i" in cmd and "/k" in cmd
+
+    def test_output_filename_redirect(self, tmp_path):
+        import sys
+        from horovod_tpu.runner.exec import WorkerProcess
+        from horovod_tpu.runner.hosts import SlotInfo
+        slot = SlotInfo("localhost", 1, 2, 0, 1, 0, 1)
+        w = WorkerProcess(slot, [sys.executable, "-c", "print('hello')"],
+                          dict(os.environ), output_dir=str(tmp_path))
+        assert w.wait(timeout=30) == 0
+        assert (tmp_path / "rank.1").read_text().strip() == "hello"
+
+    def test_host_hash_stable_and_salted(self, monkeypatch):
+        from horovod_tpu.runner.hosts import host_hash
+        assert host_hash() == host_hash()
+        assert host_hash() != host_hash(salt=1)
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "nodeA")
+        a = host_hash()
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "nodeB")
+        assert a != host_hash()
