@@ -102,6 +102,10 @@ class Config:
         c.torus_allreduce = _env_bool("HOROVOD_TORUS_ALLREDUCE", c.torus_allreduce)
         c.autotune = _env_bool("HOROVOD_AUTOTUNE", c.autotune)
         c.autotune_log = os.environ.get("HOROVOD_AUTOTUNE_LOG", c.autotune_log)
+        c.autotune_warmup_samples = _env_int(
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", c.autotune_warmup_samples)
+        c.autotune_steps_per_sample = _env_int(
+            "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", c.autotune_steps_per_sample)
         c.timeline_filename = os.environ.get("HOROVOD_TIMELINE", c.timeline_filename)
         c.timeline_mark_cycles = _env_bool(
             "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)
