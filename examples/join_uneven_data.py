@@ -1,0 +1,54 @@
+"""Uneven-data DP training with hvd.join (zero-fill semantics).
+
+Reference scenario (test/parallel/test_torch.py test_horovod_join_allreduce
++ docs join op): ranks have different numbers of batches; a rank that runs
+out keeps contributing ZEROS to the gradient allreduce (Average still
+divides by the full size) until everyone is done.
+
+Single-controller flavor: device ranks are rows of the stacked batch, so
+"rank k ran out" becomes `hvd.join(rank=k)` — subsequent allreduces
+zero-fill row k. The multi-process flavor (each process calls bare
+`hvd.join()` when its loader is exhausted) is exercised by
+tests/data/mp_join_worker.py.
+
+Run: python examples/join_uneven_data.py
+"""
+import numpy as np
+
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    n = hvd.size()
+    rng = np.random.RandomState(0)
+
+    # rank r has (r + 1) batches of gradients — maximally uneven
+    batches_per_rank = np.arange(1, n + 1)
+    max_batches = int(batches_per_rank.max())
+
+    w = np.zeros((n, 4), np.float32)            # replicated "weights"
+    for step in range(max_batches):
+        # ranks whose data ran out join before this step
+        for r in range(n):
+            if batches_per_rank[r] == step:
+                hvd.join(rank=r)
+        grads = rng.rand(n, 4).astype(np.float32)
+        avg = np.asarray(hvd.allreduce(grads, hvd.Average,
+                                       name=f"grad_{step}"))
+        active = int((batches_per_rank > step).sum())
+        print(f"step {step}: {active}/{n} ranks active, "
+              f"grad mean {float(avg.mean()):.4f}")
+        w -= 0.1 * avg
+
+    last = hvd.join()                           # everyone joined; reset
+    print(f"all ranks joined; last joined rank = {last}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

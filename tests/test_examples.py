@@ -38,6 +38,8 @@ def _run(script, *args, timeout=240):
     ("moe_expert_parallel.py", ["--steps", "2"], "experts sharded 4-way"),
     ("haiku_train.py", [], "haiku accuracy="),
     ("checkpoint_resume.py", [], "resumed from step 2"),
+    ("compression_fusion_sweep.py", ["--steps", "2"], "sweep done"),
+    ("join_uneven_data.py", [], "last joined rank = 7"),
 ])
 def test_example_runs(script, args, expect):
     out = _run(script, *args)
