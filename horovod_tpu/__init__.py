@@ -48,7 +48,10 @@ from .ops.engine import (                                      # noqa: F401
     grouped_reducescatter_async, synchronize, poll, wait,
 )
 from .optim.compression import Compression                     # noqa: F401
-from .optim.optimizer import DistributedOptimizer              # noqa: F401
+from .optim.optimizer import (                                 # noqa: F401
+    DistributedOptimizer, DistributedGradientTape, distributed_grad,
+    allreduce_gradients,
+)
 from .optim.functions import (                                 # noqa: F401
     broadcast_parameters, broadcast_object, allgather_object,
     broadcast_optimizer_state, broadcast_variables,

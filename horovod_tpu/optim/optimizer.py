@@ -37,6 +37,17 @@ from ..ops import collective_ops, engine, inside
 from .compression import Compression
 
 
+def _validate_reduce_knobs(op: ReduceOp, gradient_predivide_factor: float,
+                           axis_name) -> None:
+    if gradient_predivide_factor != 1.0 and op != ReduceOp.AVERAGE:
+        raise ValueError(
+            "gradient_predivide_factor requires op=Average "
+            "(reference: torch/optimizer.py:560)")
+    if axis_name is not None and op == ReduceOp.ADASUM:
+        raise ValueError("Adasum is not supported in in-graph mode yet; "
+                         "use the stacked eager mode")
+
+
 class _AggState(NamedTuple):
     inner: Any
     acc: Any            # accumulated gradient pytree
@@ -83,25 +94,14 @@ def DistributedOptimizer(
     axis_name: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally-reduced gradients."""
-    if gradient_predivide_factor != 1.0 and op != ReduceOp.AVERAGE:
-        raise ValueError(
-            "gradient_predivide_factor requires op=Average "
-            "(reference: torch/optimizer.py:560)")
-    # prescale 1/f before the sum, postscale f after; the 1/size for Average
-    # is folded by the reduction itself (torch/optimizer.py:199-204).
-    prescale = 1.0 / gradient_predivide_factor
-    postscale = gradient_predivide_factor
-    if axis_name is not None and op == ReduceOp.ADASUM:
-        raise ValueError("Adasum is not supported in in-graph mode yet; "
-                         "use the stacked eager mode")
+    _validate_reduce_knobs(op, gradient_predivide_factor, axis_name)
 
     def reduce_grads(grads):
-        if axis_name is not None:
-            return _reduce_tree_ingraph(grads, op, axis_name, prescale,
-                                        postscale, compression)
-        ps = basics.get_process_set(process_set)
-        return _reduce_tree_eager(grads, op, ps, prescale, postscale,
-                                  compression)
+        # shared prescale/postscale folding + mode dispatch
+        return allreduce_gradients(
+            grads, op=op, compression=compression, process_set=process_set,
+            axis_name=axis_name,
+            gradient_predivide_factor=gradient_predivide_factor)
 
     k = int(backward_passes_per_step)
     if k < 1:
@@ -153,3 +153,81 @@ def DistributedOptimizer(
         return updates, _AggState(inner, acc, count)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def allreduce_gradients(grads, *,
+                        op: ReduceOp = ReduceOp.AVERAGE,
+                        compression=Compression.none,
+                        process_set: Optional[ProcessSet] = None,
+                        axis_name: Optional[str] = None,
+                        gradient_predivide_factor: float = 1.0):
+    """Reduce a gradient pytree across ranks without an optimizer wrapper —
+    the building block of DistributedGradientTape
+    (horovod/tensorflow/__init__.py:1026 _DistributedGradientTape, which
+    allreduces tape.gradient's results). Same dual modes as
+    DistributedOptimizer: `axis_name` for in-graph shard_map/pjit use,
+    stacked eager (grouped engine allreduce with fusion) otherwise."""
+    _validate_reduce_knobs(op, gradient_predivide_factor, axis_name)
+    prescale = 1.0 / gradient_predivide_factor
+    postscale = gradient_predivide_factor
+    if axis_name is not None:
+        return _reduce_tree_ingraph(grads, op, axis_name, prescale,
+                                    postscale, compression)
+    ps = basics.get_process_set(process_set)
+    return _reduce_tree_eager(grads, op, ps, prescale, postscale,
+                              compression)
+
+
+def distributed_grad(fun, argnums=0, *, has_aux: bool = False,
+                     op: ReduceOp = ReduceOp.AVERAGE,
+                     compression=Compression.none,
+                     process_set: Optional[ProcessSet] = None,
+                     axis_name: Optional[str] = None,
+                     gradient_predivide_factor: float = 1.0):
+    """jax.grad whose gradients come back allreduce-averaged across ranks —
+    the DistributedGradientTape analog (hvd.DistributedGradientTape wraps
+    tape.gradient the same way, horovod/tensorflow/__init__.py:1110).
+
+    In-graph: `distributed_grad(loss_fn, axis_name="hvd")` inside a
+    shard_map region. Eager: gradients must be stacked [size, ...] arrays
+    (one row per rank), reduced through the async engine with fusion."""
+    base = jax.grad(fun, argnums=argnums, has_aux=has_aux)
+
+    def reduce(g):
+        return allreduce_gradients(
+            g, op=op, compression=compression, process_set=process_set,
+            axis_name=axis_name,
+            gradient_predivide_factor=gradient_predivide_factor)
+
+    def wrapped(*args, **kwargs):
+        if axis_name is not None:
+            # Mark differentiated inputs device-varying first: under jax
+            # vma tracking (shard_map check_vma=True) AD transposes the
+            # implicit unvarying->varying broadcast of replicated params
+            # into a psum, so grads would arrive pre-summed and the
+            # Average below would silently become Sum. pvary keeps the
+            # grad local in both vma modes (verified ratio-1.0 both ways).
+            idx = (argnums,) if isinstance(argnums, int) else tuple(argnums)
+            args = tuple(
+                jax.tree_util.tree_map(
+                    lambda l: _to_varying(l, axis_name), a)
+                if i in idx else a
+                for i, a in enumerate(args))
+        if has_aux:
+            g, aux = base(*args, **kwargs)
+            return reduce(g), aux
+        return reduce(base(*args, **kwargs))
+
+    return wrapped
+
+
+def _to_varying(leaf, axis_name):
+    """unvarying -> device-varying cast; pcast on current jax, pvary on
+    older releases (pvary is deprecated in favor of pcast)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(leaf, axis_name, to="varying")
+    return jax.lax.pvary(leaf, axis_name)
+
+
+#: TF-flavored alias (scripts ported from hvd.DistributedGradientTape)
+DistributedGradientTape = distributed_grad

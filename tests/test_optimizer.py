@@ -154,3 +154,67 @@ def test_spar_compressor_unbiased_shape(hvd):
     assert c.shape == x.shape
     kept = float((np.asarray(c) != 0).mean())
     assert 0.1 < kept < 0.5  # ~30% kept
+
+
+class TestDistributedGrad:
+    """DistributedGradientTape analog (tensorflow/__init__.py:1026-1110)."""
+
+    def test_eager_stacked_grad_averaged(self, hvd):
+        n = hvd.size()
+
+        def loss(w):                      # w: stacked [n, d]
+            return (w ** 2).sum()
+
+        g = hvd.distributed_grad(loss)
+        w = np.tile(np.arange(1.0, 4.0, dtype=np.float32), (n, 1))
+        w = w * (1 + np.arange(n, dtype=np.float32))[:, None]  # per-rank rows
+        out = np.asarray(g(jnp.asarray(w)))
+        # grad rows = 2*w rows, averaged across ranks
+        expect = np.tile((2 * w).mean(axis=0), (n, 1))
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_eager_has_aux_and_pytree(self, hvd):
+        n = hvd.size()
+
+        def loss(params):
+            l = (params["a"] ** 2).sum() + (params["b"] ** 2).sum()
+            return l, {"l": l}
+
+        g = hvd.distributed_grad(loss, has_aux=True)
+        params = {"a": np.ones((n, 2), np.float32),
+                  "b": 2 * np.ones((n, 3), np.float32)}
+        grads, aux = g(jax.tree_util.tree_map(jnp.asarray, params))
+        np.testing.assert_allclose(np.asarray(grads["a"]),
+                                   2 * np.ones((n, 2)), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads["b"]),
+                                   4 * np.ones((n, 3)), rtol=1e-6)
+        assert "l" in aux
+
+    def test_ingraph_grad_inside_shard_map(self, hvd):
+        from jax.sharding import PartitionSpec as P
+        n = hvd.size()
+        mesh = hvd.core.basics.get_mesh()
+
+        def local(w, x):                  # per-device shard
+            def loss(w):
+                return ((x @ w) ** 2).sum()
+            return hvd.distributed_grad(loss, axis_name="hvd")(w)
+
+        f = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(P(), P("hvd")), out_specs=P()))
+        w = jnp.ones((3, 2), jnp.float32)
+        x = jnp.asarray(np.random.RandomState(0).rand(2 * n, 3)
+                        .astype(np.float32))
+        out = np.asarray(f(w, x))
+        # compare against global-batch gradient / n... pmean averages the
+        # per-shard SUM gradients, so expectation = mean over shards
+        shards = np.split(np.asarray(x), n)
+        per = [2 * s.T @ (s @ np.asarray(w)) for s in shards]
+        np.testing.assert_allclose(out, np.mean(per, axis=0), rtol=1e-5)
+
+    def test_alias_and_validation(self, hvd):
+        assert hvd.DistributedGradientTape is hvd.distributed_grad
+        with pytest.raises(ValueError, match="requires op=Average"):
+            hvd.allreduce_gradients(
+                jnp.ones((hvd.size(), 2)), op=hvd.Sum,
+                gradient_predivide_factor=2.0)
