@@ -43,6 +43,7 @@ def _hvdrun_np2(worker: str, tmp_path, timeout=240):
 def test_hvdrun_np2_jax_plane(tmp_path):
     for r in _hvdrun_np2("mp_jax_worker.py", tmp_path):
         assert r["eager_allreduce"] == [[6.0] * 3] * 2
+        assert r["op_matrix"] == "ok"
         assert r["train_loss"] > 0
 
 
