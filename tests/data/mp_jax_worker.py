@@ -83,6 +83,22 @@ def main(out_dir: str) -> None:
             np.array([10.0 * i + r for i in range(4)]))
     result["op_matrix"] = "ok"
 
+    # --- member-scoped sub-set negotiation -------------------------------
+    # Each process owns one process set (its own 2 devices) and reduces a
+    # DIFFERENT tensor name concurrently: readiness must be judged over
+    # set MEMBERS only (one controller per ProcessSet, process_set.h:26),
+    # so neither process waits for the other's tensor.
+    set_a = hvd.add_process_set([0, 1])     # process 0's devices
+    set_b = hvd.add_process_set([2, 3])     # process 1's devices
+    mine = set_a if pid == 0 else set_b
+    sub = np.full((2, 2), float(pid + 1), np.float32)
+    out = hvd.local_rows(hvd.allreduce(sub, hvd.Sum, process_set=mine,
+                                       name=f"subset_{pid}"))
+    np.testing.assert_allclose(out, np.full((2, 2), 2.0 * (pid + 1)))
+    hvd.remove_process_set(set_a)
+    hvd.remove_process_set(set_b)
+    result["subset_allreduce"] = out.tolist()
+
     # --- async engine with negotiation (different enqueue order) ---------
     names = ["t_a", "t_b"] if pid == 0 else ["t_b", "t_a"]
     handles = {}

@@ -44,6 +44,8 @@ def test_hvdrun_np2_jax_plane(tmp_path):
     for r in _hvdrun_np2("mp_jax_worker.py", tmp_path):
         assert r["eager_allreduce"] == [[6.0] * 3] * 2
         assert r["op_matrix"] == "ok"
+        expect = 2.0 * (r["pid"] + 1)
+        assert r["subset_allreduce"] == [[expect] * 2] * 2
         assert r["train_loss"] > 0
 
 
