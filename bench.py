@@ -65,6 +65,8 @@ def run_benchmark():
     # latency. On the tunneled TPU in this environment block_until_ready
     # returns before device execution finishes, so each timed run must end
     # with a real scalar readback (float(loss)) to observe completion.
+    # Inline copy of benchmarks/_timing.slope_time — kept standalone so the
+    # driver can run bench.py in isolation; keep the two in sync.
     num_iters_a = 2 if platform != "tpu" else 10
     num_iters_b = 6 if platform != "tpu" else 30
 

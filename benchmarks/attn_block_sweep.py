@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Sweep flash-attention Pallas block sizes on the current backend.
+
+Times fwd+bwd of the causal kernel via value_and_grad with slope timing
+(host scalar readback fences), printing one JSON line per config.
+"""
+import argparse
+import itertools
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._timing import slope_time  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--blocks", default="128,256,512")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.ops.pallas_attention import flash_attention
+
+    B, H, S, D = args.batch, args.heads, args.seq, args.head_dim
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+
+    blocks = [int(x) for x in args.blocks.split(",")]
+    # causal fwd+bwd FLOPs: fwd 2 matmuls, bwd 5 matmuls over the
+    # lower-triangular half
+    flops = 7 * 2 * B * H * S * S * D / 2
+
+    for bq, bk in itertools.product(blocks, blocks):
+        if bq > S or bk > S:
+            continue
+
+        def loss_fn(q, k, v):
+            o = flash_attention(q, k, v, causal=True,
+                                block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1, 2)))
+        try:
+            val, grads = g(q, k, v)
+            float(val)
+        except Exception as e:  # noqa: BLE001 - report and continue sweep
+            print(json.dumps({"block_q": bq, "block_k": bk,
+                              "error": str(e)[:120]}))
+            continue
+
+        def run_fenced(n):
+            val = None
+            for _ in range(n):
+                val, _ = g(q, k, v)
+            float(val)
+
+        st, timing = slope_time(run_fenced, 5, 15)
+        print(json.dumps({
+            "block_q": bq, "block_k": bk, "ms": round(st * 1000, 2),
+            "tflops": round(flops / st / 1e12, 1), "timing": timing,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

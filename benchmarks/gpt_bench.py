@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""GPT train-step throughput + MFU on the current backend.
+
+Methodology (see docs/benchmarks.md): two timed runs of different
+lengths, each fenced by a host scalar readback of the loss; per-step
+time is the slope, which cancels the tunnel's fixed readback latency.
+MFU uses the standard 6 * params * tokens FLOP estimate over the v5e
+bf16 peak (197 TFLOP/s) when on TPU.
+
+Usage: python benchmarks/gpt_bench.py [--impl pallas|reference]
+       [--layers 12] [--heads 12] [--head-dim 64] [--seq 1024]
+       [--batch 8] [--vocab 50304]
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._timing import slope_time  # noqa: E402
+
+V5E_BF16_PEAK = 197e12
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--impl", default="pallas",
+                    choices=["pallas", "reference"])
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=50304)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    if args.iters <= 0:
+        ap.error("--iters must be positive")
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.gpt import GPT, GPTConfig
+    from horovod_tpu.parallel.mesh_utils import make_mesh
+    from horovod_tpu.parallel.tp import gpt_partition_rules, shard_params
+    from horovod_tpu.training import make_gspmd_train_step
+
+    hvd.init()
+    n_dev = hvd.size()
+    platform = jax.devices()[0].platform
+    mesh = make_mesh(dp=n_dev)
+
+    cfg = GPTConfig(vocab_size=args.vocab, num_layers=args.layers,
+                    num_heads=args.heads, head_dim=args.head_dim,
+                    max_seq_len=args.seq, mesh=mesh,
+                    attention_impl=args.impl)
+    model = GPT(cfg)
+    B, S = args.batch * n_dev, args.seq
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, args.vocab, (B, S)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    rules = gpt_partition_rules()
+    params = shard_params(params, mesh, rules)
+    tx = optax.adamw(1e-3)
+    opt = tx.init(params)
+    step = make_gspmd_train_step(model.apply, tx, mesh, rules)
+
+    for _ in range(3):  # >1: the post-donation arg layouts can recompile
+        params, opt, loss = step(params, opt, tokens, targets)
+        float(loss)  # fenced per-step so compiles land inside warmup
+
+    def run_fenced(n):
+        nonlocal params, opt
+        loss = None
+        for _ in range(n):
+            params, opt, loss = step(params, opt, tokens, targets)
+        float(loss)
+
+    step_time, timing = slope_time(run_fenced, args.iters, 3 * args.iters)
+
+    tok_s = B * S / step_time
+    flops_per_tok = 6 * n_params  # + attention term below
+    attn_flops = 12 * args.layers * cfg.embed_dim * S  # 2*6*L*E*S per tok
+    mfu = ((flops_per_tok + attn_flops) * tok_s / (n_dev * V5E_BF16_PEAK)
+           if platform == "tpu" else None)
+    print(json.dumps({
+        "metric": "gpt_tokens_per_sec", "value": round(tok_s, 0),
+        "unit": "tok/s", "impl": args.impl, "params_m": round(n_params / 1e6, 1),
+        "batch": B, "seq": S, "ms_per_step": round(step_time * 1000, 2),
+        "mfu_v5e": round(mfu, 3) if mfu is not None else None,
+        "platform": platform, "n_devices": n_dev, "timing": timing,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -317,7 +317,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
     "causal", "scale", "block_q", "block_k", "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 512,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: bool = False) -> jax.Array:
     """[B, H, Sq, D] x [B, H, Skv, D] -> [B, H, Sq, D] fused attention.
     Differentiable (custom VJP with Pallas backward kernels)."""

@@ -57,12 +57,17 @@ def _bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, dlogits_ref, *,
     dlogits_ref[...] = d.astype(dlogits_ref.dtype)
 
 
-#: VMEM budget per row block — the [block_t, V] tile must fit alongside
-#: the kernel's temporaries (v5e VMEM is ~16 MB/core)
-_VMEM_TILE_BYTES = 6 << 20
+#: VMEM budget per row block — the [block_t, V] f32 tile must fit
+#: alongside the kernel's temporaries (v5e VMEM is ~16 MB/core; the
+#: bwd kernel holds ~3 f32-sized copies of the tile: x, p, d)
+_VMEM_TILE_BYTES = 3 << 20
 
 
 def _pick_block_t(T: int, V: int, itemsize: int) -> int:
+    # Both kernels cast the tile to f32 before reducing, so the VMEM
+    # working set scales with f32 width even for bf16 inputs — budget
+    # by the compute itemsize, not the storage itemsize.
+    itemsize = max(itemsize, 4)
     bt = _VMEM_TILE_BYTES // max(V * itemsize, 1)
     bt = max(8, min(256, bt))
     bt = (bt // 8) * 8                    # sublane-aligned
