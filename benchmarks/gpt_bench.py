@@ -27,10 +27,13 @@ V5E_BF16_PEAK = 197e12
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--family", default="gpt", choices=["gpt", "llama"])
     ap.add_argument("--impl", default="pallas",
                     choices=["pallas", "reference"])
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA kv heads (llama only; default = --heads)")
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--batch", type=int, default=8)
@@ -55,18 +58,26 @@ def main():
     platform = jax.devices()[0].platform
     mesh = make_mesh(dp=n_dev)
 
-    cfg = GPTConfig(vocab_size=args.vocab, num_layers=args.layers,
-                    num_heads=args.heads, head_dim=args.head_dim,
-                    max_seq_len=args.seq, mesh=mesh,
-                    attention_impl=args.impl)
-    model = GPT(cfg)
+    if args.family == "llama":
+        from horovod_tpu.models.llama import (Llama, LlamaConfig,
+                                              llama_partition_rules)
+        cfg = LlamaConfig(vocab_size=args.vocab, num_layers=args.layers,
+                          num_heads=args.heads, num_kv_heads=args.kv_heads,
+                          head_dim=args.head_dim, max_seq_len=args.seq,
+                          mesh=mesh, attention_impl=args.impl)
+        model, rules = Llama(cfg), llama_partition_rules()
+    else:
+        cfg = GPTConfig(vocab_size=args.vocab, num_layers=args.layers,
+                        num_heads=args.heads, head_dim=args.head_dim,
+                        max_seq_len=args.seq, mesh=mesh,
+                        attention_impl=args.impl)
+        model, rules = GPT(cfg), gpt_partition_rules()
     B, S = args.batch * n_dev, args.seq
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, args.vocab, (B, S)), jnp.int32)
     targets = jnp.roll(tokens, -1, axis=1)
     params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    rules = gpt_partition_rules()
     params = shard_params(params, mesh, rules)
     tx = optax.adamw(1e-3)
     opt = tx.init(params)
@@ -91,7 +102,7 @@ def main():
     mfu = ((flops_per_tok + attn_flops) * tok_s / (n_dev * V5E_BF16_PEAK)
            if platform == "tpu" else None)
     print(json.dumps({
-        "metric": "gpt_tokens_per_sec", "value": round(tok_s, 0),
+        "metric": f"{args.family}_tokens_per_sec", "value": round(tok_s, 0),
         "unit": "tok/s", "impl": args.impl, "params_m": round(n_params / 1e6, 1),
         "batch": B, "seq": S, "ms_per_step": round(step_time * 1000, 2),
         "mfu_v5e": round(mfu, 3) if mfu is not None else None,

@@ -9,3 +9,6 @@ from .vit import (                              # noqa: F401
 from .moe import (                              # noqa: F401
     MoEGPT, MoEGPTConfig, moe_partition_rules, moe_aux_loss,
 )
+from .llama import (                            # noqa: F401
+    Llama, LlamaConfig, Llama_1B, llama_partition_rules,
+)

@@ -1,0 +1,229 @@
+"""Llama-family decoder LM: RMSNorm, rotary embeddings, SwiGLU, GQA.
+
+Extends the model zoo beyond GPT with the architecture that dominates
+current open-weight LMs. The reference framework is model-agnostic (its
+examples stop at ResNet/transformer encoders); this family exists so
+the TPU framework's parallelism stack (TP partition rules, ring/Ulysses
+sequence parallelism, DP/PP composition) is demonstrated on a modern
+pretraining target, the same way models/gpt.py does for GPT-2.
+
+TPU-first design notes:
+* RoPE is computed in f32 and applied with rotate-half (two multiplies
+  + one add — XLA fuses it into the surrounding matmuls' epilogue).
+* GQA stores num_kv_heads K/V projections. On the dense path they are
+  broadcast to the full head count right before the attention kernel
+  (a local relayout). On the sequence-parallel path the kv-width
+  tensors go through the ring/Ulysses collectives and parallel/sp.py
+  broadcasts heads locally — ICI traffic shrinks by H/H_kv, which is
+  the point of GQA at long context.
+* Attention runs through ops/pallas_attention.fused_attention (flash
+  kernel on TPU) or parallel/sp ring/Ulysses under shard_map when a
+  sequence axis is configured — identical plumbing to models/gpt.py.
+* All matmuls are bf16 with f32 params (MXU-native); norms in f32.
+"""
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel import sp as sp_lib
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=256, num_layers=2, num_heads=4,
+                 num_kv_heads: Optional[int] = None, head_dim=16,
+                 mlp_dim: Optional[int] = None, max_seq_len=512,
+                 rope_theta: float = 10000.0,
+                 attention: str = "dense", mesh: Optional[Mesh] = None,
+                 sp_axis: str = "sp", dp_axis: str = "dp",
+                 tp_axis: str = "tp", dtype=jnp.bfloat16,
+                 attention_impl: Optional[str] = None):
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        if num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_heads={num_heads} must be a multiple of "
+                f"num_kv_heads={self.num_kv_heads}")
+        self.head_dim = head_dim
+        self.embed_dim = num_heads * head_dim
+        # Llama uses ~8/3 * d, rounded; keep it lane-aligned
+        self.mlp_dim = mlp_dim or _round_up(8 * self.embed_dim // 3, 128)
+        self.max_seq_len = max_seq_len
+        self.rope_theta = rope_theta
+        self.attention = attention          # dense | ring | ulysses
+        self.mesh = mesh
+        self.sp_axis = sp_axis
+        self.dp_axis = dp_axis
+        self.tp_axis = tp_axis
+        self.dtype = dtype
+        self.attention_impl = attention_impl
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int,
+                     theta: float) -> jax.Array:
+    """[max_seq_len, head_dim/2] rotation angles, f32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+    return jnp.outer(jnp.arange(max_seq_len, dtype=jnp.float32), inv)
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate-half RoPE. x [B, H, S, D]; angles [S, D/2] (f32).
+
+    Positions are absolute over the given angle slice, so sequence-
+    parallel shards pass their own angle window (see Attention)."""
+    B, H, S, D = x.shape
+    xf = x.astype(jnp.float32).reshape(B, H, S, D // 2, 2)
+    x1, x2 = xf[..., 0], xf[..., 1]
+    cos = jnp.cos(angles)[None, None]
+    sin = jnp.sin(angles)[None, None]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(B, H, S, D).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        xf = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        norm = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    """Causal GQA attention with RoPE; dense / ring / ulysses dispatch
+    mirrors models/gpt.py Attention."""
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        H, KV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(H * D, name="wq")(x).reshape(B, S, H, D)
+        k = dense(KV * D, name="wk")(x).reshape(B, S, KV, D)
+        v = dense(KV * D, name="wv")(x).reshape(B, S, KV, D)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+
+        sp = (cfg.attention in ("ring", "ulysses") and cfg.mesh is not None
+              and cfg.sp_axis in cfg.mesh.axis_names)
+        angles = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
+        if sp:
+            mesh_axes = cfg.mesh.axis_names
+            b_ax = cfg.dp_axis if cfg.dp_axis in mesh_axes else None
+            h_ax = cfg.tp_axis if cfg.tp_axis in mesh_axes else None
+            spec = P(b_ax, h_ax, cfg.sp_axis, None)
+            attn = (sp_lib.ring_attention if cfg.attention == "ring"
+                    else sp_lib.ulysses_attention)
+
+            def sharded(q, k, v):
+                # each sp shard rotates by its absolute position window;
+                # k/v stay kv-width — ring/ulysses broadcast heads
+                # locally, so ICI traffic is H/KV times smaller
+                idx = jax.lax.axis_index(cfg.sp_axis)
+                s_loc = q.shape[2]
+                win = jax.lax.dynamic_slice_in_dim(
+                    angles, idx * s_loc, s_loc, axis=0)
+                qr = apply_rope(q, win)
+                kr = apply_rope(k, win)
+                return attn(qr, kr, v, axis_name=cfg.sp_axis, causal=True)
+
+            o = jax.shard_map(sharded, mesh=cfg.mesh,
+                              in_specs=(spec, spec, spec), out_specs=spec)(
+                q, k, v)
+        else:
+            q = apply_rope(q, angles[:S])
+            k = apply_rope(k, angles[:S])
+            k, v = sp_lib.expand_kv_heads(k, v, H // KV)
+            from ..ops.pallas_attention import fused_attention
+            o = fused_attention(q, k, v, causal=True,
+                                force=cfg.attention_impl)
+
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+        return dense(cfg.embed_dim, name="wo")(o)
+
+
+class SwiGLU(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        g = dense(cfg.mlp_dim, name="gate")(x)
+        u = dense(cfg.mlp_dim, name="up")(x)
+        return dense(cfg.embed_dim, name="down")(nn.silu(g) * u)
+
+
+class LlamaBlock(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + LlamaAttention(self.cfg, name="attn")(
+            RMSNorm(name="attn_norm")(x))
+        return x + SwiGLU(self.cfg, name="mlp")(
+            RMSNorm(name="mlp_norm")(x))
+
+
+class Llama(nn.Module):
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        if tokens.shape[1] > cfg.max_seq_len:
+            # fail loudly: the sp path would otherwise silently clamp
+            # RoPE windows past the angle table (duplicated positions)
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds "
+                f"max_seq_len={cfg.max_seq_len}")
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        x = x.astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = LlamaBlock(cfg, name=f"layers_{i}")(x)
+        x = RMSNorm(name="norm_f")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="lm_head")(x)
+
+
+def llama_partition_rules(tp_axis: str = "tp"):
+    """Megatron-style TP rules for the Llama family.
+
+    Column-parallel: wq/wk/wv and gate/up (output features over tp);
+    row-parallel: wo/down (input features over tp; XLA inserts the
+    psum). With GQA, num_kv_heads must be divisible by the tp degree
+    or XLA falls back to a halo exchange — keep kv_heads % tp == 0.
+    """
+    from ..parallel.tp import PartitionRules
+    return PartitionRules([
+        (r"attn/w[qkv]/kernel", P(None, tp_axis)),
+        (r"attn/wo/kernel", P(tp_axis, None)),
+        (r"mlp/(gate|up)/kernel", P(None, tp_axis)),
+        (r"mlp/down/kernel", P(tp_axis, None)),
+        (r"embed/embedding", P(None, tp_axis)),
+        (r"lm_head/kernel", P(None, tp_axis)),
+    ])
+
+
+#: ~1.1B-param pretraining shape (TinyLlama-class), for benchmarks
+Llama_1B = partial(LlamaConfig, num_layers=22, num_heads=32,
+                   num_kv_heads=4, head_dim=64, vocab_size=32000,
+                   max_seq_len=2048)

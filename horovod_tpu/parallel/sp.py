@@ -49,6 +49,15 @@ def _online_softmax_step(o, m, l, s, v):
     return o_new, m_new, l_new
 
 
+def expand_kv_heads(k: jax.Array, v: jax.Array, groups: int):
+    """[B, H_kv, S, D] -> [B, H_kv*groups, S, D] by head repetition; the
+    canonical GQA head layout (query head h uses kv head h // groups)
+    shared by the dense, ring and Ulysses attention paths."""
+    if groups == 1:
+        return k, v
+    return (jnp.repeat(k, groups, axis=1), jnp.repeat(v, groups, axis=1))
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, *, causal: bool = True,
                    scale: Optional[float] = None) -> jax.Array:
@@ -58,13 +67,25 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     shard_map). Returns the local attention output [B, H, S_local, D].
     Sequence positions follow the axis order: device i holds positions
     [i*S_local, (i+1)*S_local).
+
+    GQA: k/v may carry fewer heads (H_kv dividing H). The ring then
+    circulates the kv-width blocks — H/H_kv times less ICI traffic —
+    and the GQA group is folded into the query sequence dim so every
+    local einsum also stays at kv head width (no full-width K/V is
+    ever materialized).
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Sq, D = q.shape
     Skv = k.shape[2]
+    groups = H // k.shape[1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32) * scale
+    if groups > 1:
+        # q head h attends kv head h // groups (the expand_kv_heads
+        # layout), so [B, H, Sq, D] -> [B, H_kv, groups*Sq, D] folds the
+        # group into the row dim of the same kv-width einsums
+        qf = qf.reshape(B, H // groups, groups * Sq, D)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -76,6 +97,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             q_pos = idx * Sq + jnp.arange(Sq)
             k_pos = kv_idx * Skv + jnp.arange(Skv)
             mask = q_pos[:, None] >= k_pos[None, :]
+            if groups > 1:
+                mask = jnp.tile(mask, (groups, 1))
             s = jnp.where(mask[None, None], s, NEG_INF)
         o, m, l = _online_softmax_step(o, m, l, s, vc)
         # rotate KV to the next neighbor (ICI ring)
@@ -92,6 +115,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v),
                                   jnp.arange(n))
     out = o / jnp.maximum(l, 1e-20)[..., None]
+    if groups > 1:
+        out = out.reshape(B, H, Sq, D)
     return out.astype(q.dtype)
 
 
@@ -104,9 +129,21 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Internally each device sees [B, H/n, S_full, D], computes dense local
     attention, and reshards back. The all_to_all is the same primitive the
     reference exposes as hvd.alltoall (torch/mpi_ops.py:960).
+
+    GQA: k/v may carry H_kv < H heads. When H_kv divides the axis size
+    the kv all_to_all moves only the kv-width tensors and heads are
+    broadcast locally (chunk alignment: q chunk d covers global heads
+    [d*H/n, (d+1)*H/n), whose kv heads are exactly kv chunk d);
+    otherwise k/v are pre-broadcast to full width.
     """
     n = lax.psum(1, axis_name)
     B, H, S_local, D = q.shape
+    H_kv = k.shape[1]
+    groups = H // H_kv
+    if groups > 1 and H_kv % n:
+        # kv heads don't split across the axis: fall back to full width
+        k, v = expand_kv_heads(k, v, groups)
+        groups = 1
 
     def to_headsharded(x):
         # split heads across the axis, gather the sequence
@@ -118,6 +155,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                               tiled=True)
 
     qh, kh, vh = to_headsharded(q), to_headsharded(k), to_headsharded(v)
+    if groups > 1:  # local head broadcast after the kv-width reshard
+        kh, vh = expand_kv_heads(kh, vh, groups)
     S = qh.shape[2]
     scale_ = scale if scale is not None else 1.0 / (D ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
