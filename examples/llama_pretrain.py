@@ -33,6 +33,9 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--fsdp", action="store_true",
+                    help="shard params + optimizer state over dp "
+                         "(ZeRO/FSDP, parallel/fsdp.py)")
     args = ap.parse_args()
 
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
@@ -52,13 +55,17 @@ def main() -> None:
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
     n_params = sum(x.size for x in jax.tree.leaves(params))
     rules = llama_partition_rules()
+    if args.fsdp:
+        from horovod_tpu.parallel.fsdp import FSDPRules
+        rules = FSDPRules(rules, mesh, min_size=2 ** 10)
     params = shard_params(params, mesh, rules)
     tx = optax.adamw(3e-3)
     opt = tx.init(params)
     step = make_gspmd_train_step(model.apply, tx, mesh, rules)
 
     print(f"llama {n_params/1e6:.1f}M params, mesh "
-          f"dp={args.dp} sp={args.sp} tp={args.tp}, "
+          f"dp={args.dp} sp={args.sp} tp={args.tp}"
+          f"{' +fsdp' if args.fsdp else ''}, "
           f"gqa {cfg.num_heads}q/{cfg.num_kv_heads}kv")
     for i in range(args.steps):
         t0 = time.perf_counter()

@@ -69,12 +69,38 @@ class BottleneckBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, W, C] -> [B, H/2, W/2, 4C]; channel order (dh, dw, c).
+    Requires even H and W (use stem="conv7" for odd image sizes)."""
+    B, H, W, C = x.shape
+    if H % 2 or W % 2:
+        raise ValueError(
+            f"space_to_depth stem needs even spatial dims, got {H}x{W}; "
+            "use stem='conv7' for odd image sizes")
+    x = x.reshape(B, H // 2, 2, W // 2, 2, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // 2, W // 2, 4 * C)
+
+
+def stem_kernel_to_s2d(k7: jnp.ndarray) -> jnp.ndarray:
+    """Rearrange a [7, 7, C, F] stride-2 stem kernel into the equivalent
+    [4, 4, 4C, F] space-to-depth kernel (zero 8th tap at offset -4)."""
+    K, _, C, F = k7.shape
+    k8 = jnp.zeros((8, 8, C, F), k7.dtype).at[1:, 1:].set(k7)
+    k8 = k8.reshape(4, 2, 4, 2, C, F)          # (t_h, dh, t_w, dw, c, f)
+    return k8.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * C, F)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    #: "conv7" = classic 7x7/2 stem; "space_to_depth" = the same linear
+    #: map as a 4x4/1 conv on 2x2-blocked input (12 channels instead of
+    #: 3) — the 3-channel 7x7 conv tiles poorly onto the 128-lane MXU,
+    #: the blocked form fills it (MLPerf-style stem optimization)
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -86,8 +112,18 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            # block pad (2,1) in block units == pixel pad (4,2); the
+            # extra left pixel vs conv7's (3,3) meets the zero 8th tap,
+            # so the map equals conv_init exactly (see stem_kernel_to_s2d)
+            x = space_to_depth(x)
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=[(2, 1), (2, 1)], name="conv_init")(x)
+        elif self.stem == "conv7":
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -25,6 +25,12 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def keypath_str(keypath) -> str:
+    """'/'-joined pytree key path, e.g. 'layers_0/attn/qkv/kernel'."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in keypath)
+
+
 class PartitionRules:
     """Ordered (path-regex -> PartitionSpec) rules; first match wins.
 
@@ -43,11 +49,7 @@ class PartitionRules:
 
     def tree_specs(self, params: Any) -> Any:
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-        specs = []
-        for keypath, _ in flat:
-            path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                            for k in keypath)
-            specs.append(self.spec_for(path))
+        specs = [self.spec_for(keypath_str(kp)) for kp, _ in flat]
         return jax.tree_util.tree_unflatten(treedef, specs)
 
 

@@ -148,3 +148,35 @@ class TestMoEGPT:
         out_d = model_d.apply(v, toks)
         np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_l),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestSpaceToDepthStem:
+    def test_stem_equivalent_to_conv7(self):
+        """stem='space_to_depth' computes exactly the conv7 stem's map
+        when its kernel is the stem_kernel_to_s2d rearrangement."""
+        import jax
+        from horovod_tpu.models.resnet import (ResNet50, space_to_depth,
+                                               stem_kernel_to_s2d)
+        rng = np.random.RandomState(0)
+        imgs = jnp.asarray(rng.rand(2, 64, 64, 3), jnp.float32)
+        m7 = ResNet50(num_classes=10, dtype=jnp.float32)
+        ms = ResNet50(num_classes=10, dtype=jnp.float32,
+                      stem="space_to_depth")
+        v7 = m7.init(jax.random.PRNGKey(0), imgs, train=False)
+        vs = jax.tree.map(lambda x: x, v7)
+        k7 = v7["params"]["conv_init"]["kernel"]
+        vs["params"] = {**vs["params"],
+                        "conv_init": {"kernel": stem_kernel_to_s2d(k7)}}
+        o7 = np.asarray(m7.apply(v7, imgs, train=False))
+        os_ = np.asarray(ms.apply(vs, imgs, train=False))
+        np.testing.assert_allclose(os_, o7, atol=1e-4)
+
+    def test_space_to_depth_layout(self):
+        from horovod_tpu.models.resnet import space_to_depth
+        x = jnp.arange(2 * 4 * 4 * 3).reshape(2, 4, 4, 3).astype(jnp.float32)
+        y = space_to_depth(x)
+        assert y.shape == (2, 2, 2, 12)
+        # channel order (dh, dw, c): y[b,i,j, dh*6+dw*3+c] = x[b,2i+dh,2j+dw,c]
+        np.testing.assert_array_equal(
+            np.asarray(y[0, 1, 0]),
+            np.asarray(x[0, 2:4, 0:2].reshape(-1)))
