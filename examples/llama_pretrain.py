@@ -36,6 +36,8 @@ def main() -> None:
     ap.add_argument("--fsdp", action="store_true",
                     help="shard params + optimizer state over dp "
                          "(ZeRO/FSDP, parallel/fsdp.py)")
+    ap.add_argument("--remat", action="store_true",
+                    help="per-block activation checkpointing")
     args = ap.parse_args()
 
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
@@ -44,7 +46,7 @@ def main() -> None:
         num_kv_heads=args.kv_heads, head_dim=16,
         max_seq_len=args.seq, mesh=mesh,
         attention="ring" if args.sp > 1 else "dense",
-        dtype=jnp.float32)
+        dtype=jnp.float32, remat=args.remat)
     model = Llama(cfg)
 
     rng = np.random.RandomState(0)

@@ -29,7 +29,8 @@ class GPTConfig:
                  attention: str = "dense", mesh: Optional[Mesh] = None,
                  sp_axis: str = "sp", dp_axis: str = "dp",
                  tp_axis: str = "tp", dtype=jnp.bfloat16,
-                 attention_impl: Optional[str] = None):
+                 attention_impl: Optional[str] = None,
+                 remat: bool = False):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -46,6 +47,10 @@ class GPTConfig:
         # None = auto (pallas on TPU, reference elsewhere);
         # "pallas" | "reference" | "interpret" to force
         self.attention_impl = attention_impl
+        #: rematerialize each block on the backward pass (activation
+        #: checkpointing, jax.checkpoint) — trades ~1/3 more FLOPs for
+        #: O(layers) less activation HBM; essential at long context
+        self.remat = remat
 
 
 class Attention(nn.Module):
@@ -124,8 +129,9 @@ class GPT(nn.Module):
                        param_dtype=jnp.float32, name="pos_embed")(
             jnp.arange(S)[None])
         x = (x + pos).astype(cfg.dtype)
+        block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"layers_{i}")(x)
+            x = block_cls(cfg, name=f"layers_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
                           dtype=jnp.float32, param_dtype=jnp.float32,

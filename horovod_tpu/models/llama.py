@@ -40,7 +40,8 @@ class LlamaConfig:
                  attention: str = "dense", mesh: Optional[Mesh] = None,
                  sp_axis: str = "sp", dp_axis: str = "dp",
                  tp_axis: str = "tp", dtype=jnp.bfloat16,
-                 attention_impl: Optional[str] = None):
+                 attention_impl: Optional[str] = None,
+                 remat: bool = False):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -62,6 +63,8 @@ class LlamaConfig:
         self.tp_axis = tp_axis
         self.dtype = dtype
         self.attention_impl = attention_impl
+        #: per-block activation checkpointing (see GPTConfig.remat)
+        self.remat = remat
 
 
 def _round_up(x: int, m: int) -> int:
@@ -197,8 +200,9 @@ class Llama(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
                      param_dtype=jnp.float32, name="embed")(tokens)
         x = x.astype(cfg.dtype)
+        block_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
-            x = LlamaBlock(cfg, name=f"layers_{i}")(x)
+            x = block_cls(cfg, name=f"layers_{i}")(x)
         x = RMSNorm(name="norm_f")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="lm_head")(x)
