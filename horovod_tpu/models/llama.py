@@ -10,12 +10,12 @@ pretraining target, the same way models/gpt.py does for GPT-2.
 TPU-first design notes:
 * RoPE is computed in f32 and applied with rotate-half (two multiplies
   + one add — XLA fuses it into the surrounding matmuls' epilogue).
-* GQA stores num_kv_heads K/V projections. On the dense path they are
-  broadcast to the full head count right before the attention kernel
-  (a local relayout). On the sequence-parallel path the kv-width
-  tensors go through the ring/Ulysses collectives and parallel/sp.py
-  broadcasts heads locally — ICI traffic shrinks by H/H_kv, which is
-  the point of GQA at long context.
+* GQA stores num_kv_heads K/V projections and keeps them at kv width
+  everywhere: the Pallas flash kernels read kv head h // G via block
+  index maps (never expanding K/V in HBM, forward or backward), and on
+  the sequence-parallel path the kv-width tensors go through the
+  ring/Ulysses collectives with heads broadcast locally — ICI traffic
+  shrinks by H/H_kv, which is the point of GQA at long context.
 * Attention runs through ops/pallas_attention.fused_attention (flash
   kernel on TPU) or parallel/sp ring/Ulysses under shard_map when a
   sequence axis is configured — identical plumbing to models/gpt.py.
@@ -152,7 +152,8 @@ class LlamaAttention(nn.Module):
         else:
             q = apply_rope(q, angles[:S])
             k = apply_rope(k, angles[:S])
-            k, v = sp_lib.expand_kv_heads(k, v, H // KV)
+            # kv-width k/v go straight in: the pallas kernels are
+            # GQA-aware (the reference fallback expands internally)
             from ..ops.pallas_attention import fused_attention
             o = fused_attention(q, k, v, causal=True,
                                 force=cfg.attention_impl)

@@ -14,9 +14,13 @@ backward also never materializes [Sq, Skv] (classic FlashAttention-2
 structure; all accumulation in fp32).
 
 Design (pallas_guide.md patterns):
-* grid = (batch*heads, S/block); each program owns one row block.
+* grid = (batch, heads, S/block); each program owns one row block.
 * K/V (resp. Q/dO) for the (batch, head) live in VMEM whole; the inner
   fori_loop walks them in blocks, trip count trimmed for causal.
+* GQA: K/V may carry fewer heads; the K/V block index maps read kv head
+  h // G, and the dK/dV kernel's innermost grid axis walks the group,
+  accumulating into the same (f32) output block — grouped K/V are never
+  expanded in HBM, forward or backward.
 * padding to block multiples is masked by real-position bounds inside the
   kernels (both padded keys and padded queries).
 * On non-TPU platforms the same kernels run in interpret mode (tests), or
@@ -57,8 +61,8 @@ def _pos_mask(qi_base, kb_base, bq, bk, *, causal: bool,
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
                 scale: float, causal: bool, block_q: int, block_k: int,
                 seq_q: int, seq_q_p: int, seq_k: int, seq_k_p: int):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, D]
     bq, d = q.shape
 
     num_kb = seq_k_p // block_k
@@ -71,9 +75,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
 
     def body(kb, carry):
         o, m, l = carry
-        k = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(
+        k = k_ref[0, 0, pl.dslice(kb * block_k, block_k), :].astype(
             jnp.float32)                              # [bk, D]
-        v = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(
+        v = v_ref[0, 0, pl.dslice(kb * block_k, block_k), :].astype(
             jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -99,36 +103,41 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
     l0 = jnp.zeros((bq,), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, nkb, body, (o0, m0, l0))
     o = o / jnp.maximum(l, 1e-20)[:, None]
-    o_ref[0] = o.astype(o_ref.dtype)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
     if maybe_lse_ref:   # training: emit per-row log-sum-exp for the VJP
         safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
         # [bq, 1] column: TPU pallas requires the last two block dims to
         # obey the (8, 128) tiling rule, which [1, block_q] violates
-        maybe_lse_ref[0][0] = \
+        maybe_lse_ref[0][0, 0] = \
             (safe_m + jnp.log(jnp.maximum(l, 1e-20)))[:, None]
 
 
 def _fwd_impl(q, k, v, causal, scale, block_q, block_k,
               seq_q, seq_k, interpret, emit_lse=True):
-    BH, Sq_p, D = q.shape
-    Skv_p = k.shape[1]
+    B, H, Sq_p, D = q.shape
+    KV, Skv_p = k.shape[1], k.shape[2]
+    G = H // KV  # GQA: q head h reads kv head h // G
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
         seq_q=seq_q, seq_q_p=Sq_p, seq_k=seq_k, seq_k_p=Skv_p)
-    out_specs = [pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0))]
-    out_shape = [jax.ShapeDtypeStruct((BH, Sq_p, D), q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, block_q, D),
+                              lambda b, h, qi: (b, h, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype)]
     if emit_lse:
         out_specs.append(
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((BH, Sq_p, 1), jnp.float32))
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, Sq_p, 1), jnp.float32))
     out = pl.pallas_call(
         kernel,
-        grid=(BH, Sq_p // block_q),
+        grid=(B, H, Sq_p // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, Skv_p, D),
+                         lambda b, h, qi: (b, h // G, 0, 0)),
+            pl.BlockSpec((1, 1, Skv_p, D),
+                         lambda b, h, qi: (b, h // G, 0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -145,11 +154,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale: float, causal: bool, block_q: int,
                    block_k: int, seq_q: int, seq_q_p: int, seq_k: int,
                    seq_k_p: int):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
-    do = do_ref[0].astype(jnp.float32)                # [bq, D]
-    lse = lse_ref[0]                                  # [bq, 1]
-    delta = delta_ref[0]                              # [bq, 1]
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, D]
+    do = do_ref[0, 0].astype(jnp.float32)             # [bq, D]
+    lse = lse_ref[0, 0]                               # [bq, 1]
+    delta = delta_ref[0, 0]                           # [bq, 1]
     bq, d = q.shape
 
     num_kb = seq_k_p // block_k
@@ -160,9 +169,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         nkb = num_kb
 
     def body(kb, dq):
-        k = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(
+        k = k_ref[0, 0, pl.dslice(kb * block_k, block_k), :].astype(
             jnp.float32)
-        v = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(
+        v = v_ref[0, 0, pl.dslice(kb * block_k, block_k), :].astype(
             jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -180,16 +189,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale: float, causal: bool,
                     block_q: int, block_k: int, seq_q: int, seq_q_p: int,
                     seq_k: int, seq_k_p: int):
-    kb = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                  # [bk, D]
-    v = v_ref[0].astype(jnp.float32)                  # [bk, D]
+    # grid (B, KV, kb, g): g (innermost) walks the GQA group sharing this
+    # kv head; the dk/dv output block index ignores g, so Pallas keeps it
+    # in VMEM across the consecutive g steps and we accumulate into it.
+    kb = pl.program_id(2)
+    g = pl.program_id(3)
+    k = k_ref[0, 0].astype(jnp.float32)               # [bk, D]
+    v = v_ref[0, 0].astype(jnp.float32)               # [bk, D]
     bk, d = k.shape
 
     num_qb = seq_q_p // block_q
@@ -201,12 +214,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[0, pl.dslice(qi * block_q, block_q), :].astype(
+        q = q_ref[0, 0, pl.dslice(qi * block_q, block_q), :].astype(
             jnp.float32) * scale                      # [bq, D]
-        do = do_ref[0, pl.dslice(qi * block_q, block_q), :].astype(
+        do = do_ref[0, 0, pl.dslice(qi * block_q, block_q), :].astype(
             jnp.float32)
-        lse = lse_ref[0, pl.dslice(qi * block_q, block_q), :]
-        delta = delta_ref[0, pl.dslice(qi * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.dslice(qi * block_q, block_q), :]
+        delta = delta_ref[0, 0, pl.dslice(qi * block_q, block_q), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk]
@@ -229,13 +242,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(qb0, num_qb, body, (dk0, dv0))
+
     # q was pre-scaled, so dk already carries one factor of `scale`
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(g != 0)
+    def _accum():
+        dk_ref[0, 0] += dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] += dv.astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
-# custom-VJP wrapper (operates on padded [B*H, S_p, D] arrays)
+# custom-VJP wrapper (operates on padded [B, H, S_p, D] / [B, KV, S_p, D])
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
@@ -257,53 +278,72 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, seq_q, seq_k,
 def _flash_bwd(causal, scale, block_q, block_k, seq_q, seq_k, interpret,
                res, do):
     q, k, v, o, lse = res
-    BH, Sq_p, D = q.shape
-    Skv_p = k.shape[1]
+    B, H, Sq_p, D = q.shape
+    KV, Skv_p = k.shape[1], k.shape[2]
+    G = H // KV
     # D_i = rowsum(dO_i * O_i) — cheap elementwise, fused by XLA
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)           # [BH, Sq_p, 1]
+                    axis=-1, keepdims=True)           # [B, H, Sq_p, 1]
 
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, seq_q=seq_q, seq_q_p=Sq_p,
                   seq_k=seq_k, seq_k_p=Skv_p)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
-        grid=(BH, Sq_p // block_q),
+        grid=(B, H, Sq_p // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, Skv_p, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, Skv_p, D),
+                         lambda b, h, qi: (b, h // G, 0, 0)),
+            pl.BlockSpec((1, 1, Skv_p, D),
+                         lambda b, h, qi: (b, h // G, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Sq_p, D), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, qi: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # dk/dv accumulate across the G query heads of each kv head (the g
+    # grid axis revisits the same output block), so they stay f32 in the
+    # kernel and are cast back here
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
-        grid=(BH, Skv_p // block_k),
+        grid=(B, KV, Skv_p // block_k, G),
         in_specs=[
-            pl.BlockSpec((1, Sq_p, D), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, Sq_p, D), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, Sq_p, 1), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, Sq_p, 1), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, Sq_p, D),
+                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kv, kb, g: (b, kv, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kv, kb, g: (b, kv, kb, 0)),
+            pl.BlockSpec((1, 1, Sq_p, D),
+                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
+            pl.BlockSpec((1, 1, Sq_p, 1),
+                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
+            pl.BlockSpec((1, 1, Sq_p, 1),
+                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kv, kb, g: (b, kv, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kv, kb, g: (b, kv, kb, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Skv_p, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, Skv_p, D), v.dtype),
+            # G == 1 never revisits a block, so write bf16 directly;
+            # G > 1 accumulates across visits and must stay f32
+            jax.ShapeDtypeStruct((B, KV, Skv_p, D),
+                                 k.dtype if G == 1 else jnp.float32),
+            jax.ShapeDtypeStruct((B, KV, Skv_p, D),
+                                 v.dtype if G == 1 else jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -319,10 +359,18 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 512, block_k: int = 512,
                     interpret: bool = False) -> jax.Array:
-    """[B, H, Sq, D] x [B, H, Skv, D] -> [B, H, Sq, D] fused attention.
+    """[B, H, Sq, D] x [B, H_kv, Skv, D] -> [B, H, Sq, D] fused attention.
+
+    GQA-aware: k/v may carry H_kv < H heads (H divisible by H_kv); q head
+    h reads kv head h // (H // H_kv) directly via the kernels' block index
+    maps, so grouped K/V are never expanded in HBM — forward reads and
+    the dK/dV gradients stay at kv width (the backward accumulates the
+    group's contributions inside the kernel).
     Differentiable (custom VJP with Pallas backward kernels)."""
     B, H, Sq, D = q.shape
-    Skv = k.shape[2]
+    KV, Skv = k.shape[1], k.shape[2]
+    if H % KV:
+        raise ValueError(f"q heads {H} must be a multiple of kv heads {KV}")
     scale_ = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     block_q = min(block_q, Sq)
     block_k = min(block_k, Skv)
@@ -334,15 +382,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
     kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
     vv = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
-    Sq_p, Skv_p = Sq + pad_q, Skv + pad_k
 
-    qr = qq.reshape(B * H, Sq_p, D)
-    kr = kk.reshape(B * H, Skv_p, D)
-    vr = vv.reshape(B * H, Skv_p, D)
-
-    out = _flash(qr, kr, vr, causal, scale_, block_q, block_k,
+    out = _flash(qq, kk, vv, causal, scale_, block_q, block_k,
                  Sq, Skv, interpret)
-    out = out.reshape(B, H, Sq_p, D)
     return out[:, :, :Sq] if pad_q else out
 
 
@@ -354,6 +396,9 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     force: "pallas" | "reference" | "interpret" overrides the platform
     check (tests use "interpret" to run the kernel on CPU).
     """
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(f"q heads {q.shape[1]} must be a multiple of "
+                         f"kv heads {k.shape[1]}")
     mode = force
     if mode is None:
         mode = "pallas" if jax.devices()[0].platform == "tpu" \
@@ -363,5 +408,6 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if mode == "interpret":
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                interpret=True)
-    from ..parallel.sp import attention_reference
+    from ..parallel.sp import attention_reference, expand_kv_heads
+    k, v = expand_kv_heads(k, v, q.shape[1] // k.shape[1])
     return attention_reference(q, k, v, causal=causal, scale=scale)
