@@ -104,6 +104,27 @@ def place_replicated(x, mesh: Mesh):
     return jax.device_put(x, replicated_sharding(mesh))
 
 
+def place_sharded(x, sharding):
+    """Place a host value (replicated on every process) under an arbitrary
+    NamedSharding, multi-process safe.
+
+    Generalizes place_replicated to any PartitionSpec: each process
+    contributes its addressable shards via make_array_from_callback.
+    `x` must be host-resident or fully addressable on this process — a
+    distributed jax.Array cannot be re-fetched here."""
+    mesh = sharding.mesh
+    if not mesh_is_multiprocess(mesh):
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        raise ValueError(
+            "place_sharded needs a replicated host copy on every process; "
+            "got a jax.Array spanning non-addressable devices (already "
+            "placed?). Pass the host value instead.")
+    host = np.asarray(x)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+
+
 def place_stacked_rows(x, mesh: Mesh, axis: str = GLOBAL_AXIS):
     """Row-shard a stacked array over `mesh`, multi-process safe.
 

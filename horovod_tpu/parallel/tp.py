@@ -71,10 +71,17 @@ def _restrict_spec(spec: P, mesh: Mesh) -> P:
 
 
 def shard_params(params: Any, mesh: Mesh, rules: PartitionRules) -> Any:
-    """Place a parameter pytree according to the rules."""
+    """Place a parameter pytree according to the rules.
+
+    Multi-process safe: when the mesh spans processes, each process
+    contributes its addressable shards from its (identical) host copy
+    (core.mesh.place_sharded) — the GSPMD analog of the launcher's
+    replicated-init convention (every worker initializes with the same
+    PRNG key, reference broadcast-of-initial-state semantics)."""
+    from ..core.mesh import place_sharded
     specs = rules.tree_specs(params)
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(
+        lambda x, s: place_sharded(
             x, NamedSharding(mesh, _restrict_spec(s, mesh))),
         params, specs)
 

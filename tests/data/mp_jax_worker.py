@@ -152,6 +152,39 @@ def main(out_dir: str) -> None:
         np.testing.assert_array_equal(
             np.frombuffer(blob, np.float32), kernel.ravel())
 
+    # --- GSPMD dp x tp train step across processes -----------------------
+    # params sharded by Megatron rules over a mesh spanning both
+    # processes: shard_params must use the multi-process placement path
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.models.gpt import GPT, GPTConfig
+    from horovod_tpu.parallel.mesh_utils import make_mesh
+    from horovod_tpu.parallel.tp import gpt_partition_rules, shard_params
+    from horovod_tpu.training import make_gspmd_train_step, shard_batch
+
+    gmesh = make_mesh(dp=2, tp=2)
+    cfg = GPTConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                    max_seq_len=16, mesh=gmesh, dtype=jnp.float32,
+                    attention_impl="reference")
+    gmodel = GPT(cfg)
+    # identical init on every process (same key) = replicated host copy
+    toks_local = np.random.RandomState(7 + pid).randint(
+        0, 32, (2, 16)).astype(np.int32)
+    gparams = gmodel.init(jax.random.PRNGKey(1),
+                          jnp.zeros((1, 16), jnp.int32))["params"]
+    rules = gpt_partition_rules()
+    gparams = shard_params(gparams, gmesh, rules)
+    gtx = optax.adam(1e-2)
+    gopt = gtx.init(gparams)
+    gstep = make_gspmd_train_step(gmodel.apply, gtx, gmesh, rules,
+                                  batch_spec=P("dp", None))
+    gtoks = shard_batch(toks_local, gmesh, axis_name="dp")
+    gtgts = shard_batch(np.roll(toks_local, -1, 1), gmesh, axis_name="dp")
+    gparams, gopt, gloss = gstep(gparams, gopt, gtoks, gtgts)
+    gloss = float(gloss)
+    assert np.isfinite(gloss), gloss
+    result["gspmd_tp_loss"] = gloss
+
     hvd.barrier()
     result["ok"] = True
     with open(os.path.join(out_dir, f"result.{pid}.json"), "w") as f:

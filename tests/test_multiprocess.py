@@ -47,6 +47,7 @@ def test_hvdrun_np2_jax_plane(tmp_path):
         expect = 2.0 * (r["pid"] + 1)
         assert r["subset_allreduce"] == [[expect] * 2] * 2
         assert r["train_loss"] > 0
+        assert r["gspmd_tp_loss"] > 0  # dp x tp GSPMD step across procs
 
 
 def test_hvdrun_np2_join_zero_fill(tmp_path):
