@@ -30,6 +30,8 @@ def main() -> None:
     p.add_argument("--dp", type=int, default=2)
     p.add_argument("--ep", type=int, default=4)
     p.add_argument("--experts", type=int, default=4)
+    p.add_argument("--top-k", type=int, default=1,
+                   help="1 = Switch routing, 2 = GShard/Mixtral top-2")
     args = p.parse_args()
 
     hvd.init()
@@ -37,6 +39,7 @@ def main() -> None:
     cfg = MoEGPTConfig(vocab_size=128, num_layers=2, num_heads=4,
                        head_dim=8, max_seq_len=64,
                        num_experts=args.experts, mesh=mesh,
+                       router_top_k=args.top_k,
                        dtype=jnp.float32, attention_impl="reference")
     model = MoEGPT(cfg)
 

@@ -34,7 +34,7 @@ from .gpt import Attention
 class MoEGPTConfig:
     def __init__(self, vocab_size=256, num_layers=2, num_heads=4,
                  head_dim=16, mlp_ratio=4, max_seq_len=512,
-                 num_experts=4, capacity_factor=1.25,
+                 num_experts=4, capacity_factor=1.25, router_top_k=1,
                  mesh: Optional[Mesh] = None, ep_axis: str = "ep",
                  dp_axis: str = "dp", tp_axis: str = "tp",
                  sp_axis: str = "sp", attention: str = "dense",
@@ -48,6 +48,8 @@ class MoEGPTConfig:
         self.max_seq_len = max_seq_len
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
+        #: 1 = Switch-style; 2 = GShard/Mixtral-style normalized top-2
+        self.router_top_k = router_top_k
         self.mesh = mesh
         self.ep_axis = ep_axis
         self.dp_axis = dp_axis
@@ -121,7 +123,8 @@ class MoEMLP(nn.Module):
             def _dispatch(xs, lg, ps):
                 return ep_lib.moe_layer(
                     xs, None, _expert_fn, ps, axis_name=cfg.ep_axis,
-                    capacity_factor=cfg.capacity_factor, logits=lg)
+                    capacity_factor=cfg.capacity_factor, logits=lg,
+                    top_k=cfg.router_top_k)
 
             y = jax.shard_map(
                 _dispatch,
@@ -132,7 +135,8 @@ class MoEMLP(nn.Module):
         else:
             y = ep_lib.moe_reference(
                 x2, None, _expert_fn, params,
-                capacity_factor=cfg.capacity_factor, logits=logits)
+                capacity_factor=cfg.capacity_factor, logits=logits,
+                top_k=cfg.router_top_k)
         return y.reshape(B, S, D).astype(cfg.dtype)
 
 

@@ -2,8 +2,9 @@
 
 The reference's accounting (SURVEY §2.6): "EP — absent; alltoall + process
 sets are the primitives an MoE implementation would use." This module is that
-implementation, TPU-native: top-1 routing with fixed expert capacity (static
-shapes for XLA), dispatch/combine as einsums against a one-hot dispatch mask,
+implementation, TPU-native: priority-ordered top-k routing (k=1 Switch,
+k=2 GShard/Mixtral) with fixed expert capacity (static shapes for XLA),
+dispatch/combine as einsums against a one-hot dispatch mask,
 and `lax.all_to_all` moving token buffers between expert shards — the same
 primitive the reference exposes as hvd.alltoall (torch/mpi_ops.py:960).
 """
@@ -16,31 +17,59 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def topk_route(logits: jax.Array, num_experts: int, capacity: int,
+               k: int = 1, normalize: bool = True
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k router with capacity dropping.
+
+    k=1 (normalize=False) is Switch-Transformer routing; k=2 with
+    normalized gates is the GShard/Mixtral scheme. Choices are placed in
+    priority order: every token's 1st choice claims buffer slots before
+    any 2nd choice does, so under capacity pressure second choices drop
+    first (GShard semantics).
+
+    logits: [T, E]. Returns (dispatch [T, E, C] one-hot, combine
+    [T, E, C] gate-weighted), both zero for dropped tokens.
+    """
+    if not 1 <= k <= num_experts:
+        raise ValueError(f"top-k k={k} must be in [1, num_experts="
+                         f"{num_experts}]")
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    occupancy = jnp.zeros((num_experts,), jnp.float32)  # slots used so far
+    masked = probs
+    dispatches, gates = [], []
+    for _ in range(k):
+        expert = jnp.argmax(masked, axis=-1)                  # [T]
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
+        # position within the expert buffer, offset by earlier choices
+        pos = (jnp.cumsum(onehot, axis=0) + occupancy[None, :]) \
+            * onehot - 1.0                                     # [T, E]
+        in_cap = (pos < capacity) & (pos >= 0)
+        pos_cap = jnp.where(in_cap, pos, 0).astype(jnp.int32)
+        dispatches.append((onehot * in_cap)[..., None] * jax.nn.one_hot(
+            pos_cap, capacity, dtype=jnp.float32))             # [T, E, C]
+        gates.append(gate)
+        occupancy = occupancy + onehot.sum(axis=0)
+        masked = jnp.where(onehot > 0, -jnp.inf, masked)
+    dispatch = sum(dispatches)
+    if normalize and k > 1:   # Mixtral-style: chosen gates sum to 1
+        denom = jnp.maximum(sum(gates), 1e-9)
+        gates = [g / denom for g in gates]
+    combine = sum(d * g[:, None, None] for d, g in zip(dispatches, gates))
+    return dispatch, combine
+
+
 def top1_route(logits: jax.Array, num_experts: int, capacity: int
                ) -> Tuple[jax.Array, jax.Array]:
-    """Top-1 router with capacity dropping (Switch Transformer style).
-
-    logits: [T, E]. Returns (dispatch [T, E, C] one-hot, combine [T, E, C]
-    probability-weighted), both zero for dropped tokens.
-    """
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                       # [T]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
-    # position of each token within its expert's buffer
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [T, E]
-    in_cap = (pos < capacity) & (pos >= 0)
-    pos_cap = jnp.where(in_cap, pos, 0).astype(jnp.int32)
-    dispatch = (onehot * in_cap)[..., None] * jax.nn.one_hot(
-        pos_cap, capacity, dtype=jnp.float32)                  # [T, E, C]
-    combine = dispatch * gate[:, None, None]
-    return dispatch, combine
+    """Top-1 router with capacity dropping (Switch Transformer style)."""
+    return topk_route(logits, num_experts, capacity, k=1, normalize=False)
 
 
 def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
               expert_params, *, axis_name: str = "ep",
               capacity_factor: float = 1.25,
-              logits: jax.Array = None) -> jax.Array:
+              logits: jax.Array = None, top_k: int = 1) -> jax.Array:
     """Expert-parallel MoE for use inside shard_map.
 
     x: local tokens [T_local, D]. `expert_params` are the LOCAL experts'
@@ -59,9 +88,10 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
     E = e_local * n
     capacity = max(1, int(capacity_factor * T / E))
 
+    capacity = capacity * top_k  # k choices share the buffer
     if logits is None:
         logits = x @ router_w                                   # [T, E]
-    dispatch, combine = top1_route(logits, E, capacity)
+    dispatch, combine = topk_route(logits, E, capacity, k=top_k)
 
     # token buffers per global expert: [E, C, D]
     buffers = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
@@ -86,14 +116,15 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
 
 
 def moe_reference(x, router_w, expert_fn, all_expert_params,
-                  capacity_factor: float = 1.25, logits=None):
+                  capacity_factor: float = 1.25, logits=None,
+                  top_k: int = 1):
     """Single-device oracle: same routing/capacity, all experts local."""
     T, D = x.shape
     E = jax.tree_util.tree_leaves(all_expert_params)[0].shape[0]
-    capacity = max(1, int(capacity_factor * T / E))
+    capacity = max(1, int(capacity_factor * T / E)) * top_k
     if logits is None:
         logits = x @ router_w
-    dispatch, combine = top1_route(logits, E, capacity)
+    dispatch, combine = topk_route(logits, E, capacity, k=top_k)
     buffers = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     out = jax.vmap(expert_fn)(all_expert_params, buffers.astype(x.dtype))
     y = jnp.einsum("tec,ecd->td", combine, out.astype(jnp.float32))

@@ -180,3 +180,84 @@ class TestSpaceToDepthStem:
         np.testing.assert_array_equal(
             np.asarray(y[0, 1, 0]),
             np.asarray(x[0, 2:4, 0:2].reshape(-1)))
+
+
+class TestTopKRouting:
+    def test_top2_ample_capacity_weighted_sum(self):
+        """With ample capacity, top-2 output = normalized-gate-weighted
+        sum of the two chosen experts' outputs."""
+        from horovod_tpu.parallel.ep import topk_route
+        rng = np.random.RandomState(0)
+        T, E, C = 8, 4, 16
+        logits = jnp.asarray(rng.randn(T, E), jnp.float32)
+        dispatch, combine = topk_route(logits, E, C, k=2)
+        probs = np.asarray(jax.nn.softmax(logits, -1))
+        top2 = np.argsort(-probs, axis=-1)[:, :2]
+        d = np.asarray(dispatch)
+        c = np.asarray(combine)
+        for t in range(T):
+            chosen = np.where(d[t].sum(-1) > 0)[0]
+            assert set(chosen) == set(top2[t])
+            g = probs[t, top2[t]]
+            g = g / g.sum()
+            np.testing.assert_allclose(
+                sorted(c[t].sum(-1)[top2[t]]), sorted(g), rtol=1e-5)
+
+    def test_top2_capacity_drops_second_choice_first(self):
+        """Under pressure, 1st choices keep their slots (GShard order)."""
+        from horovod_tpu.parallel.ep import topk_route
+        # all tokens prefer expert 0 then expert 1
+        logits = jnp.asarray(np.tile([[2.0, 1.0, -5, -5]], (6, 1)),
+                             jnp.float32)
+        dispatch, _ = topk_route(logits, 4, capacity=6, k=2)
+        d = np.asarray(dispatch)
+        # expert 0 holds exactly its capacity of first choices
+        assert d[:, 0].sum() == 6
+        assert d[:, 1].sum() == 6  # second choices fill expert 1
+        # a smaller capacity drops second choices, not first
+        dispatch2, _ = topk_route(logits, 4, capacity=3, k=2)
+        d2 = np.asarray(dispatch2)
+        assert d2[:3, 0].sum() == 3 and d2[3:, 0].sum() == 0
+        assert d2[:3, 1].sum() == 3
+
+    def test_top1_backcompat(self):
+        from horovod_tpu.parallel.ep import top1_route, topk_route
+        rng = np.random.RandomState(1)
+        logits = jnp.asarray(rng.randn(16, 4), jnp.float32)
+        d1, c1 = top1_route(logits, 4, 4)
+        dk, ck = topk_route(logits, 4, 4, k=1, normalize=False)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(dk))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(ck))
+
+    def test_top2_moe_gpt_trains_on_ep_mesh(self, hvd):
+        import optax
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.models.moe import (MoEGPT, MoEGPTConfig,
+                                            moe_aux_loss,
+                                            moe_partition_rules)
+        from horovod_tpu.parallel.mesh_utils import make_mesh
+        from horovod_tpu.parallel.tp import shard_params
+        from horovod_tpu.training import make_gspmd_train_step
+        mesh = make_mesh(dp=2, ep=4)
+        cfg = MoEGPTConfig(vocab_size=64, num_layers=1, num_heads=2,
+                           head_dim=8, max_seq_len=32, num_experts=4,
+                           router_top_k=2, mesh=mesh, dtype=jnp.float32,
+                           attention_impl="reference")
+        model = MoEGPT(cfg)
+        toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 16)),
+                           jnp.int32)
+        v = model.init(jax.random.PRNGKey(0), toks)
+        params = shard_params(v["params"], mesh, moe_partition_rules())
+        tx = optax.adam(1e-2)
+        opt = tx.init(params)
+        step = make_gspmd_train_step(model.apply, tx, mesh,
+                                     moe_partition_rules(),
+                                     batch_spec=P("dp", None),
+                                     aux_loss_fn=moe_aux_loss)
+        losses = []
+        p, o = params, opt
+        tg = jnp.asarray(np.roll(np.asarray(toks), -1, 1))
+        for _ in range(4):
+            p, o, loss = step(p, o, toks, tg)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
