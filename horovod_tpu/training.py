@@ -146,7 +146,11 @@ def make_gspmd_train_step(
         axes = mesh.axis_names
         batch_spec = P("dp" if "dp" in axes else None,
                        "sp" if "sp" in axes else None)
-    batch_sh = NamedSharding(mesh, batch_spec)
+    # restrict like param specs: axes the rule names but this mesh lacks
+    # degrade to None (e.g. batch_spec=P("dp", None) on an sp-only mesh),
+    # so call sites need not special-case degenerate meshes
+    from .parallel.tp import _restrict_spec
+    batch_sh = NamedSharding(mesh, _restrict_spec(batch_spec, mesh))
 
     def step(params, opt_state, tokens, targets):
         tokens = jax.lax.with_sharding_constraint(tokens, batch_sh)
