@@ -17,17 +17,27 @@ import numpy as np
 from .bayes import BayesianOptimizer
 
 # knob domains: fusion threshold 0..128 MB, cycle time 1..25 ms — the
-# reference's tunable ranges (parameter_manager.cc defaults)
+# reference's tunable ranges (parameter_manager.cc defaults) — plus the
+# two-level (hierarchical/torus) allreduce toggle as a 0/1 dimension,
+# matching the reference's categorical knobs (parameter_manager.h:59-84;
+# hier and torus share one code path here, ops/cross.py)
 FUSION_MB_RANGE = (0.0, 128.0)
 CYCLE_MS_RANGE = (1.0, 25.0)
+TWO_LEVEL_RANGE = (0.0, 1.0)
 
 
 class ParameterManager:
     def __init__(self, warmup_samples: int = 3, steps_per_sample: int = 10,
                  max_samples: int = 20, log_path: Optional[str] = None,
-                 seed: int = 0):
-        self.opt = BayesianOptimizer([FUSION_MB_RANGE, CYCLE_MS_RANGE],
-                                     seed=seed)
+                 seed: int = 0, tune_two_level: bool = True):
+        #: tune_two_level=False freezes the categorical dim (e.g. when
+        #: HOROVOD_TORUS_ALLREDUCE already forces the two-level path and
+        #: the knob would be behaviorally inert)
+        self.tune_two_level = tune_two_level
+        dims = [FUSION_MB_RANGE, CYCLE_MS_RANGE]
+        if tune_two_level:
+            dims.append(TWO_LEVEL_RANGE)
+        self.opt = BayesianOptimizer(dims, seed=seed)
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
         self.max_samples = max_samples
@@ -37,7 +47,7 @@ class ParameterManager:
         self._steps = 0
         self._bytes = 0.0
         self._t0 = time.monotonic()
-        self._current = np.array([64.0, 1.0])
+        self._current = np.array([64.0, 1.0, 0.0][:len(dims)])
         self._log_header_written = False
 
     # -- current knob values ------------------------------------------------
@@ -48,6 +58,13 @@ class ParameterManager:
     @property
     def cycle_time_ms(self) -> float:
         return float(self._current[1])
+
+    @property
+    def two_level_allreduce(self) -> bool:
+        """Hierarchical/torus two-level allreduce toggle (ops/cross.py)."""
+        if not self.tune_two_level:
+            return False
+        return bool(self._current[2])
 
     # -- scoring (parameter_manager Update analog) ---------------------------
     def record(self, nbytes: int) -> bool:
@@ -72,21 +89,32 @@ class ParameterManager:
         if self.samples_taken >= self.max_samples + self.warmup_samples \
                 and self.opt.ys:
             best, best_score = self.opt.best()
-            self._current = best
+            self._current = self._snap(best)
             self.active = False
             self._log(best_score, final=True)
         else:
-            self._current = self.opt.suggest()
+            self._current = self._snap(self.opt.suggest())
         self._steps = 0
         self._bytes = 0.0
         self._t0 = time.monotonic()
+
+    def _snap(self, x: np.ndarray) -> np.ndarray:
+        """Round categorical dims so the executed config (and the x later
+        told to the GP) matches what was measured — the GP must not
+        attribute a measurement of round(0.45)=0 to the point 0.45."""
+        x = np.asarray(x, float).copy()
+        if self.tune_two_level:
+            x[2] = float(round(x[2]))
+        return x
 
     def _log(self, score: float, final: bool = False) -> None:
         if not self.log_path:
             return
         with open(self.log_path, "a") as f:
             if not self._log_header_written:
-                f.write("fusion_mb,cycle_ms,bytes_per_sec,final\n")
+                f.write("fusion_mb,cycle_ms,two_level,bytes_per_sec,"
+                        "final\n")
                 self._log_header_written = True
             f.write(f"{self._current[0]:.2f},{self._current[1]:.2f},"
+                    f"{int(self.two_level_allreduce)},"
                     f"{score:.1f},{int(final)}\n")

@@ -170,7 +170,10 @@ class Engine:
             self.tuner = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
-                log_path=cfg.autotune_log)
+                log_path=cfg.autotune_log,
+                # torus already forces the two-level path, so the knob
+                # would be behaviorally inert — freeze it
+                tune_two_level=not cfg.torus_allreduce)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -359,6 +362,9 @@ class Engine:
             if self.tuner.record(self.bytes_processed - bytes_before):
                 self.fusion_threshold = self.tuner.fusion_threshold_bytes
                 self.cycle_time_s = self.tuner.cycle_time_ms / 1000.0
+                # live config: collective_ops re-reads it on every call
+                self._state.config.hierarchical_allreduce = \
+                    self.tuner.two_level_allreduce
 
     @staticmethod
     def _work_meta(w: _Work) -> dict:
@@ -398,9 +404,11 @@ class Engine:
         payload = {"j": bool(self._joined),
                    "w": [self._work_meta(w) for w in batch],
                    # rank 0 owns the tunables; peers adopt them below so
-                   # bucketization stays identical across processes
-                   # (SynchronizeParameters, operations.cc:843-846)
-                   "ft": self.fusion_threshold}
+                   # bucketization AND the allreduce algorithm stay
+                   # identical across processes (SynchronizeParameters,
+                   # operations.cc:843-846)
+                   "ft": self.fusion_threshold,
+                   "tl": bool(self._state.config.hierarchical_allreduce)}
         # Block until every process reaches this round. A slow peer (long
         # compile / data stall) is NOT an error — the reference waits
         # indefinitely with stall-inspector warnings (stall_inspector.cc);
@@ -420,6 +428,8 @@ class Engine:
                     "(stall_inspector analog)", rnd)
         peers = [json.loads(b.decode()) for b in blobs]
         self.fusion_threshold = peers[0].get("ft", self.fusion_threshold)
+        self._state.config.hierarchical_allreduce = peers[0].get(
+            "tl", self._state.config.hierarchical_allreduce)
         peer_works = [{(e["n"], e["s"]): e for e in p["w"]} for p in peers]
         for p, msg in enumerate(peers):
             if msg["j"] and p not in self._joined_procs:

@@ -271,9 +271,13 @@ def test_autotune_end_to_end_pins_knobs(tmp_path, monkeypatch):
                 time.monotonic() < deadline:
             time.sleep(0.01)
         assert eng.fusion_threshold == tuner.fusion_threshold_bytes
+        # categorical knob propagated to the live config (collective_ops
+        # re-reads it per call)
+        assert hvd_mod.core.basics.get_config().hierarchical_allreduce \
+            == tuner.two_level_allreduce
         # CSV log recorded sampled + final scores
         lines = log.read_text().strip().splitlines()
-        assert lines[0] == "fusion_mb,cycle_ms,bytes_per_sec,final"
+        assert lines[0] == "fusion_mb,cycle_ms,two_level,bytes_per_sec,final"
         assert any(ln.endswith(",1") for ln in lines[1:]), lines
     finally:
         hvd_mod.shutdown()
