@@ -245,3 +245,34 @@ class TestAdasum:
         expect = (1 - dot / (2 * na)) * a + (1 - dot / (2 * nb)) * b
         np.testing.assert_allclose(out[0], expect, rtol=1e-4)
         np.testing.assert_allclose(out[1], expect, rtol=1e-4)
+
+
+class TestEdgeShapes:
+    """Reference parity: 0-d/scalar and zero-size tensors go through every
+    path (test_torch.py exercises these shapes across its op matrix)."""
+
+    def test_scalar_per_device_stacked(self, hvd):
+        x = np.arange(8, dtype=np.float32)
+        out = np.asarray(hvd.allreduce(x, hvd.Sum))
+        np.testing.assert_allclose(out, np.full(8, x.sum()))
+
+    def test_empty_tensor(self, hvd):
+        e = np.zeros((8, 0), np.float32)
+        out = np.asarray(hvd.allreduce(e, hvd.Sum))
+        assert out.shape == (8, 0)
+
+    def test_empty_allgather(self, hvd):
+        e = np.zeros((8, 0, 3), np.float32)
+        out = np.asarray(hvd.allgather(e))
+        assert out.shape[1] == 0 or out.size == 0
+
+    def test_zero_dim_ingraph(self, hvd):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.ops import inside
+        mesh = hvd.core.basics.get_mesh()
+        out = jax.jit(jax.shard_map(
+            lambda: inside.allreduce(jnp.float32(3.0), hvd.Sum),
+            mesh=mesh, in_specs=(), out_specs=P()))()
+        assert float(out) == 24.0
