@@ -70,7 +70,10 @@ def run_benchmark():
     num_iters_a = 2 if platform != "tpu" else 10
     num_iters_b = 6 if platform != "tpu" else 30
 
-    model = ResNet50(num_classes=1000)
+    # HVD_BENCH_STEM=space_to_depth selects the MXU-friendly blocked stem
+    # (models/resnet.py); default stays the classic conv7
+    stem = os.environ.get("HVD_BENCH_STEM", "conv7")
+    model = ResNet50(num_classes=1000, stem=stem)
     rng = jax.random.PRNGKey(0)
     dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     variables = model.init(rng, dummy, train=True)
@@ -120,10 +123,19 @@ def run_benchmark():
         "platform": platform,
         "n_devices": n_dev,
         "timing": timing,
+        "stem": stem,
     }), flush=True)
 
 
 def main() -> int:
+    stem = os.environ.get("HVD_BENCH_STEM", "conv7")
+    if stem not in ("conv7", "space_to_depth"):
+        # deterministic config error: fail before the retry loop
+        print(json.dumps({
+            "metric": "resnet50_synthetic_img_sec_per_chip", "value": None,
+            "unit": "img/sec/chip", "vs_baseline": None,
+            "error": f"unknown HVD_BENCH_STEM {stem!r}"}), flush=True)
+        return 1
     errors = []
     for attempt in range(1, MAX_ATTEMPTS + 1):
         try:
