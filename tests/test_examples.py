@@ -41,6 +41,7 @@ def _run(script, *args, timeout=240):
     ("compression_fusion_sweep.py", ["--steps", "2"], "sweep done"),
     ("join_uneven_data.py", [], "last joined rank = 7"),
     ("llama_pretrain.py", ["--steps", "2"], "gqa 4q/2kv"),
+    ("pp_pipeline.py", ["--steps", "3"], "GPipe: 4 stages"),
 ])
 def test_example_runs(script, args, expect):
     out = _run(script, *args)
