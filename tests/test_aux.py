@@ -281,3 +281,35 @@ def test_autotune_end_to_end_pins_knobs(tmp_path, monkeypatch):
         assert any(ln.endswith(",1") for ln in lines[1:]), lines
     finally:
         hvd_mod.shutdown()
+
+
+class TestSlopeTiming:
+    def test_slope_cancels_fixed_latency(self):
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from benchmarks._timing import slope_time
+        import time as _t
+        calls = []
+
+        def run_fenced(n):   # 5 ms/step + 50 ms fixed "readback"
+            calls.append(n)
+            _t.sleep(0.005 * n + 0.05)
+
+        per, tag = slope_time(run_fenced, 4, 12)
+        assert tag == "slope"
+        assert calls == [4, 12]
+        assert 0.004 < per < 0.008  # latency cancelled
+
+    def test_fallback_marked(self):
+        from benchmarks._timing import slope_time
+        per, tag = slope_time(lambda n: None, 1, 2)
+        assert tag in ("slope", "mean_fallback")  # ~0-time runs: either
+
+    def test_rejects_bad_counts(self):
+        import pytest as _pytest
+        from benchmarks._timing import slope_time
+        with _pytest.raises(ValueError):
+            slope_time(lambda n: None, 5, 5)
+        with _pytest.raises(ValueError):
+            slope_time(lambda n: None, 0, 5)
