@@ -71,14 +71,17 @@ class Attention(nn.Module):
         if cfg.attention in ("ring", "ulysses") and cfg.mesh is not None:
             attn = (sp_lib.ring_attention if cfg.attention == "ring"
                     else sp_lib.ulysses_attention)
+            sp_impl, vma = sp_lib.sp_impl_for(cfg.attention_impl)
             mesh_axes = cfg.mesh.axis_names
             b_ax = cfg.dp_axis if cfg.dp_axis in mesh_axes else None
             h_ax = cfg.tp_axis if cfg.tp_axis in mesh_axes else None
             spec = P(b_ax, h_ax, cfg.sp_axis, None)
             o = jax.shard_map(
-                partial(attn, axis_name=cfg.sp_axis, causal=self.causal),
+                partial(attn, axis_name=cfg.sp_axis, causal=self.causal,
+                        impl=sp_impl),
                 mesh=cfg.mesh,
                 in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=vma,
             )(q, k, v)
         else:
             # fused pallas kernel on TPU, dense reference elsewhere

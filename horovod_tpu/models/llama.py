@@ -133,6 +133,7 @@ class LlamaAttention(nn.Module):
             spec = P(b_ax, h_ax, cfg.sp_axis, None)
             attn = (sp_lib.ring_attention if cfg.attention == "ring"
                     else sp_lib.ulysses_attention)
+            sp_impl, vma = sp_lib.sp_impl_for(cfg.attention_impl)
 
             def sharded(q, k, v):
                 # each sp shard rotates by its absolute position window;
@@ -144,11 +145,12 @@ class LlamaAttention(nn.Module):
                     angles, idx * s_loc, s_loc, axis=0)
                 qr = apply_rope(q, win)
                 kr = apply_rope(k, win)
-                return attn(qr, kr, v, axis_name=cfg.sp_axis, causal=True)
+                return attn(qr, kr, v, axis_name=cfg.sp_axis, causal=True,
+                            impl=sp_impl)
 
             o = jax.shard_map(sharded, mesh=cfg.mesh,
-                              in_specs=(spec, spec, spec), out_specs=spec)(
-                q, k, v)
+                              in_specs=(spec, spec, spec), out_specs=spec,
+                              check_vma=vma)(q, k, v)
         else:
             q = apply_rope(q, angles[:S])
             k = apply_rope(k, angles[:S])

@@ -38,6 +38,13 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _sds(ref_array, shape, dtype):
+    """ShapeDtypeStruct carrying the reference array's varying-mesh-axes
+    annotation, so the kernels also work inside shard_map (check_vma)."""
+    return jax.ShapeDtypeStruct(shape, dtype,
+                                vma=jax.typeof(ref_array).vma)
+
+
 def _pos_mask(qi_base, kb_base, bq, bk, *, causal: bool,
               seq_q: int, seq_q_p: int, seq_k: int, seq_k_p: int):
     """[bq, bk] validity mask for a (query-block, key-block) tile:
@@ -123,12 +130,11 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k,
         seq_q=seq_q, seq_q_p=Sq_p, seq_k=seq_k, seq_k_p=Skv_p)
     out_specs = [pl.BlockSpec((1, 1, block_q, D),
                               lambda b, h, qi: (b, h, qi, 0))]
-    out_shape = [jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype)]
+    out_shape = [_sds(q, (B, H, Sq_p, D), q.dtype)]
     if emit_lse:
         out_specs.append(
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)))
-        out_shape.append(
-            jax.ShapeDtypeStruct((B, H, Sq_p, 1), jnp.float32))
+        out_shape.append(_sds(q, (B, H, Sq_p, 1), jnp.float32))
     out = pl.pallas_call(
         kernel,
         grid=(B, H, Sq_p // block_q),
@@ -277,73 +283,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, seq_q, seq_k,
 
 def _flash_bwd(causal, scale, block_q, block_k, seq_q, seq_k, interpret,
                res, do):
-    q, k, v, o, lse = res
-    B, H, Sq_p, D = q.shape
-    KV, Skv_p = k.shape[1], k.shape[2]
-    G = H // KV
-    # D_i = rowsum(dO_i * O_i) — cheap elementwise, fused by XLA
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)           # [B, H, Sq_p, 1]
-
-    common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, seq_q=seq_q, seq_q_p=Sq_p,
-                  seq_k=seq_k, seq_k_p=Skv_p)
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
-        grid=(B, H, Sq_p // block_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, Skv_p, D),
-                         lambda b, h, qi: (b, h // G, 0, 0)),
-            pl.BlockSpec((1, 1, Skv_p, D),
-                         lambda b, h, qi: (b, h // G, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, qi: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq_p, D), q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    # dk/dv accumulate across the G query heads of each kv head (the g
-    # grid axis revisits the same output block), so they stay f32 in the
-    # kernel and are cast back here
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(B, KV, Skv_p // block_k, G),
-        in_specs=[
-            pl.BlockSpec((1, 1, Sq_p, D),
-                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, kv, kb, g: (b, kv, kb, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, kv, kb, g: (b, kv, kb, 0)),
-            pl.BlockSpec((1, 1, Sq_p, D),
-                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
-            pl.BlockSpec((1, 1, Sq_p, 1),
-                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
-            pl.BlockSpec((1, 1, Sq_p, 1),
-                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, kv, kb, g: (b, kv, kb, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, kv, kb, g: (b, kv, kb, 0)),
-        ],
-        out_shape=[
-            # G == 1 never revisits a block, so write bf16 directly;
-            # G > 1 accumulates across visits and must stay f32
-            jax.ShapeDtypeStruct((B, KV, Skv_p, D),
-                                 k.dtype if G == 1 else jnp.float32),
-            jax.ShapeDtypeStruct((B, KV, Skv_p, D),
-                                 v.dtype if G == 1 else jnp.float32),
-        ],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_bwd_delta(causal, scale, block_q, block_k, seq_q,
+                            seq_k, interpret, res, do, None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -386,6 +327,133 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     out = _flash(qq, kk, vv, causal, scale_, block_q, block_k,
                  Sq, Skv, interpret)
     return out[:, :, :Sq] if pad_q else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, seq_q, seq_k,
+               interpret):
+    return _fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                     seq_q, seq_k, interpret, emit_lse=True)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, seq_q, seq_k,
+                   interpret):
+    o, lse = _fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                       seq_q, seq_k, interpret, emit_lse=True)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, seq_q, seq_k,
+                   interpret, res, cts):
+    """VJP with a live LSE cotangent.
+
+    With L = f(o, lse): ds = p * (dp - delta) from the o path plus
+    p * dlse from the lse path (d lse / d s_qk = p_qk), i.e.
+    ds = p * (dp - (delta - dlse)) — so the existing dq/dkv kernels are
+    reused verbatim with delta' = delta - dlse. dv = p^T do is
+    unaffected by lse."""
+    do, dlse = cts
+    return _flash_bwd_delta(causal, scale, block_q, block_k, seq_q,
+                            seq_k, interpret, res, do, dlse)
+
+
+def _flash_bwd_delta(causal, scale, block_q, block_k, seq_q, seq_k,
+                     interpret, res, do, dlse):
+    q, k, v, o, lse = res
+    B, H, Sq_p, D = q.shape
+    KV, Skv_p = k.shape[1], k.shape[2]
+    G = H // KV
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_q=seq_q, seq_q_p=Sq_p,
+                  seq_k=seq_k, seq_k_p=Skv_p)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B, H, Sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, Skv_p, D),
+                         lambda b, h, qi: (b, h // G, 0, 0)),
+            pl.BlockSpec((1, 1, Skv_p, D),
+                         lambda b, h, qi: (b, h // G, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, qi: (b, h, qi, 0)),
+        out_shape=_sds(q, (B, H, Sq_p, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(B, KV, Skv_p // block_k, G),
+        in_specs=[
+            pl.BlockSpec((1, 1, Sq_p, D),
+                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kv, kb, g: (b, kv, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kv, kb, g: (b, kv, kb, 0)),
+            pl.BlockSpec((1, 1, Sq_p, D),
+                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
+            pl.BlockSpec((1, 1, Sq_p, 1),
+                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
+            pl.BlockSpec((1, 1, Sq_p, 1),
+                         lambda b, kv, kb, g: (b, kv * G + g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kv, kb, g: (b, kv, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kv, kb, g: (b, kv, kb, 0)),
+        ],
+        out_shape=[
+            _sds(k, (B, KV, Skv_p, D),
+                 k.dtype if G == 1 else jnp.float32),
+            _sds(v, (B, KV, Skv_p, D),
+                 v.dtype if G == 1 else jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True,
+                        scale: Optional[float] = None,
+                        block_q: int = 512, block_k: int = 512,
+                        interpret: bool = False):
+    """Like flash_attention but also returns the per-row log-sum-exp
+    [B, H, Sq] — the combination weight for blockwise/ring attention
+    (flash-decoding-style merging). Differentiable in BOTH outputs:
+    the VJP folds the lse cotangent into the same backward kernels
+    (delta' = delta - dlse). GQA-aware like flash_attention."""
+    B, H, Sq, D = q.shape
+    KV, Skv = k.shape[1], k.shape[2]
+    if H % KV:
+        raise ValueError(f"q heads {H} must be a multiple of kv heads {KV}")
+    scale_ = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Skv)
+    pad_q = (-Sq) % block_q
+    pad_k = (-Skv) % block_k
+    qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vv = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    o, lse = _flash_lse(qq, kk, vv, causal, scale_, block_q, block_k,
+                        Sq, Skv, interpret)
+    if pad_q:
+        o, lse = o[:, :, :Sq], lse[:, :, :Sq]
+    return o, lse[..., 0]
 
 
 def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

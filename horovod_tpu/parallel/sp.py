@@ -49,6 +49,20 @@ def _online_softmax_step(o, m, l, s, v):
     return o_new, m_new, l_new
 
 
+def sp_impl_for(attention_impl):
+    """Map a model config's attention_impl to (sp impl, check_vma).
+
+    "pallas" -> flash kernels inside the sp programs; "interpret" ->
+    the same kernels in interpret mode with shard_map vma checking off
+    (jax's HLO interpreter cannot yet propagate vma through pallas
+    calls); anything else -> the lax einsum path."""
+    if attention_impl == "pallas":
+        return "flash", True
+    if attention_impl == "interpret":
+        return "flash_interpret", False
+    return "lax", True
+
+
 def expand_kv_heads(k: jax.Array, v: jax.Array, groups: int):
     """[B, H_kv, S, D] -> [B, H_kv*groups, S, D] by head repetition; the
     canonical GQA head layout (query head h uses kv head h // groups)
@@ -60,7 +74,8 @@ def expand_kv_heads(k: jax.Array, v: jax.Array, groups: int):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, *, causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   impl: str = "lax") -> jax.Array:
     """Exact attention over a sequence sharded along `axis_name`.
 
     Inputs are the device-local blocks [B, H, S_local, D] (inside
@@ -73,7 +88,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     and the GQA group is folded into the query sequence dim so every
     local einsum also stays at kv head width (no full-width K/V is
     ever materialized).
+
+    impl: "lax" (default) computes each ring step with masked einsums
+    and an online-softmax carry; "flash" computes each step with the
+    Pallas flash kernel (ops/pallas_attention.flash_attention_lse) and
+    merges per-step partials by their log-sum-exp (flash-decoding-style
+    combination) — O(S_local*D) HBM per step instead of the einsum
+    path's O(S_local^2) f32 score block. "flash_interpret" runs the
+    same kernels in interpret mode (CPU tests).
     """
+    if impl in ("flash", "flash_interpret"):
+        return _ring_attention_flash(q, k, v, axis_name, causal=causal,
+                                     scale=scale,
+                                     interpret=impl == "flash_interpret")
+    if impl != "lax":
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Sq, D = q.shape
@@ -120,13 +149,78 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def _ring_attention_flash(q: jax.Array, k: jax.Array, v: jax.Array,
+                          axis_name: str, *, causal: bool,
+                          scale: Optional[float], interpret: bool
+                          ) -> jax.Array:
+    """Ring attention with the Pallas flash kernel per step.
+
+    Each step runs flash_attention_lse on (local q, visiting kv block):
+    the diagonal step (kv_idx == idx) uses the causal kernel, earlier
+    blocks use the full kernel, later blocks are masked out via
+    lse = -inf. Per-step (o_i, lse_i) partials merge with the standard
+    online max/sum-exp combination; gradients flow through the kernels'
+    custom VJP (live lse cotangent) and the scan.
+    """
+    from ..ops.pallas_attention import flash_attention_lse
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Sq, D = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step_fn(carry, step):
+        o_w, m, l, kc, vc = carry
+        kv_idx = (idx - step) % n
+
+        def diag(q, kc, vc):
+            return flash_attention_lse(q, kc, vc, causal=True,
+                                       scale=scale, interpret=interpret)
+
+        def offdiag(q, kc, vc):
+            return flash_attention_lse(q, kc, vc, causal=False,
+                                       scale=scale, interpret=interpret)
+
+        if causal:
+            o_i, lse_i = lax.cond(kv_idx == idx, diag, offdiag, q, kc, vc)
+        else:   # non-causal: every block (incl. the diagonal) is full
+            o_i, lse_i = offdiag(q, kc, vc)
+        o_i = o_i.astype(jnp.float32)
+        if causal:
+            # future blocks contribute nothing (weight exp(-inf) = 0)
+            valid = kv_idx <= idx
+            lse_i = jnp.where(valid, lse_i, NEG_INF)
+            o_i = jnp.where(valid, o_i, 0.0)
+        m_new = jnp.maximum(m, lse_i)
+        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        corr = jnp.exp(jnp.minimum(m - safe_m, 0.0))
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, corr)
+        w_i = jnp.exp(lse_i - safe_m)
+        w_i = jnp.where(lse_i <= NEG_INF / 2, 0.0, w_i)
+        o_w = o_w * corr[..., None] + o_i * w_i[..., None]
+        l = l * corr + w_i
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o_w, m_new, l, kc, vc), None
+
+    qf32 = q.astype(jnp.float32)
+    o0 = qf32 * 0.0
+    m0 = qf32[..., 0] * 0.0 + NEG_INF
+    l0 = qf32[..., 0] * 0.0
+    (o_w, m, l, _, _), _ = lax.scan(step_fn, (o0, m0, l0, k, v),
+                                    jnp.arange(n))
+    out = o_w / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str, *, causal: bool = True,
-                      scale: Optional[float] = None) -> jax.Array:
+                      scale: Optional[float] = None,
+                      impl: str = "lax") -> jax.Array:
     """DeepSpeed-Ulysses-style SP: all_to_all heads<->sequence reshard.
 
     Local blocks [B, H, S_local, D] with H divisible by the axis size.
-    Internally each device sees [B, H/n, S_full, D], computes dense local
+    Internally each device sees [B, H/n, S_full, D], computes local
     attention, and reshards back. The all_to_all is the same primitive the
     reference exposes as hvd.alltoall (torch/mpi_ops.py:960).
 
@@ -135,6 +229,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     broadcast locally (chunk alignment: q chunk d covers global heads
     [d*H/n, (d+1)*H/n), whose kv heads are exactly kv chunk d);
     otherwise k/v are pre-broadcast to full width.
+
+    impl: "lax" computes the local attention densely; "flash" /
+    "flash_interpret" run it through the Pallas flash kernel (GQA-aware,
+    so the local head broadcast is skipped too).
     """
     n = lax.psum(1, axis_name)
     B, H, S_local, D = q.shape
@@ -155,6 +253,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                               tiled=True)
 
     qh, kh, vh = to_headsharded(q), to_headsharded(k), to_headsharded(v)
+    if impl in ("flash", "flash_interpret"):
+        from ..ops.pallas_attention import flash_attention
+        oh = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                             interpret=impl == "flash_interpret")
+        return to_seqsharded(oh.astype(q.dtype))
+    if impl != "lax":
+        raise ValueError(f"unknown ulysses attention impl {impl!r}")
     if groups > 1:  # local head broadcast after the kv-width reshard
         kh, vh = expand_kv_heads(kh, vh, groups)
     S = qh.shape[2]
