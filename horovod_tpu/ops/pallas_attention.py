@@ -308,6 +308,17 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     the dK/dV gradients stay at kv width (the backward accumulates the
     group's contributions inside the kernel).
     Differentiable (custom VJP with Pallas backward kernels)."""
+    qq, kk, vv, scale_, block_q, block_k, Sq, Skv, pad_q = _prepare(
+        q, k, v, scale, block_q, block_k)
+    out = _flash(qq, kk, vv, causal, scale_, block_q, block_k,
+                 Sq, Skv, interpret)
+    return out[:, :, :Sq] if pad_q else out
+
+
+def _prepare(q, k, v, scale, block_q, block_k):
+    """Shared entry prologue: GQA validation, scale default, block
+    clamping, and padding sequences to block multiples (padded
+    positions are masked by real-position bounds inside the kernels)."""
     B, H, Sq, D = q.shape
     KV, Skv = k.shape[1], k.shape[2]
     if H % KV:
@@ -315,18 +326,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     scale_ = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     block_q = min(block_q, Sq)
     block_k = min(block_k, Skv)
-
-    # pad sequences to block multiples; padded positions are masked by
-    # real-position bounds inside the kernels
     pad_q = (-Sq) % block_q
     pad_k = (-Skv) % block_k
     qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
     kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
     vv = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
-
-    out = _flash(qq, kk, vv, causal, scale_, block_q, block_k,
-                 Sq, Skv, interpret)
-    return out[:, :, :Sq] if pad_q else out
+    return qq, kk, vv, scale_, block_q, block_k, Sq, Skv, pad_q
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
@@ -437,18 +442,8 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
     (flash-decoding-style merging). Differentiable in BOTH outputs:
     the VJP folds the lse cotangent into the same backward kernels
     (delta' = delta - dlse). GQA-aware like flash_attention."""
-    B, H, Sq, D = q.shape
-    KV, Skv = k.shape[1], k.shape[2]
-    if H % KV:
-        raise ValueError(f"q heads {H} must be a multiple of kv heads {KV}")
-    scale_ = float(scale) if scale is not None else 1.0 / (D ** 0.5)
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Skv)
-    pad_q = (-Sq) % block_q
-    pad_k = (-Skv) % block_k
-    qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
-    kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
-    vv = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    qq, kk, vv, scale_, block_q, block_k, Sq, Skv, pad_q = _prepare(
+        q, k, v, scale, block_q, block_k)
     o, lse = _flash_lse(qq, kk, vv, causal, scale_, block_q, block_k,
                         Sq, Skv, interpret)
     if pad_q:

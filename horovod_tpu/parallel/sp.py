@@ -52,10 +52,16 @@ def _online_softmax_step(o, m, l, s, v):
 def sp_impl_for(attention_impl):
     """Map a model config's attention_impl to (sp impl, check_vma).
 
-    "pallas" -> flash kernels inside the sp programs; "interpret" ->
-    the same kernels in interpret mode with shard_map vma checking off
-    (jax's HLO interpreter cannot yet propagate vma through pallas
-    calls); anything else -> the lax einsum path."""
+    None = auto — flash kernels on TPU, lax elsewhere (the same
+    contract as ops/pallas_attention.fused_attention); "pallas" ->
+    flash; "interpret" -> the same kernels in interpret mode with
+    shard_map vma checking off (jax's HLO interpreter cannot yet
+    propagate vma through pallas calls); anything else -> the lax
+    einsum path."""
+    if attention_impl is None:
+        attention_impl = ("pallas"
+                          if jax.devices()[0].platform == "tpu"
+                          else "lax")
     if attention_impl == "pallas":
         return "flash", True
     if attention_impl == "interpret":
@@ -181,16 +187,26 @@ def _ring_attention_flash(q: jax.Array, k: jax.Array, v: jax.Array,
             return flash_attention_lse(q, kc, vc, causal=False,
                                        scale=scale, interpret=interpret)
 
+        def skipped(q, kc, vc):
+            # future block under causality: zero weight, no kernel run.
+            # Derived from q so the outputs carry the same varying-mesh-
+            # axes type as the kernel branches (cond requires matching
+            # vma; a plain jnp.zeros would be unvarying).
+            return (q * 0.0,
+                    q[..., 0].astype(jnp.float32) * 0.0 + NEG_INF)
+
         if causal:
-            o_i, lse_i = lax.cond(kv_idx == idx, diag, offdiag, q, kc, vc)
+            # three-way branch so causally-masked steps cost nothing
+            # (the lax path computes and discards them; here lax.cond
+            # runs only the selected branch)
+            o_i, lse_i = lax.cond(
+                kv_idx == idx, diag,
+                lambda q, kc, vc: lax.cond(kv_idx < idx, offdiag,
+                                           skipped, q, kc, vc),
+                q, kc, vc)
         else:   # non-causal: every block (incl. the diagonal) is full
             o_i, lse_i = offdiag(q, kc, vc)
         o_i = o_i.astype(jnp.float32)
-        if causal:
-            # future blocks contribute nothing (weight exp(-inf) = 0)
-            valid = kv_idx <= idx
-            lse_i = jnp.where(valid, lse_i, NEG_INF)
-            o_i = jnp.where(valid, o_i, 0.0)
         m_new = jnp.maximum(m, lse_i)
         safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
         corr = jnp.exp(jnp.minimum(m - safe_m, 0.0))

@@ -269,3 +269,23 @@ class TestFlashSP:
             outs.append(g)
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-4), outs[0], outs[1])
+
+
+class TestFlashSPTracing:
+    def test_flash_impl_traces_with_vma_checking(self, hvd):
+        """The production attention_impl='pallas' path (check_vma=True)
+        must trace: every cond branch and scan carry has to yield
+        sp-varying types (a plain jnp.zeros constant would not)."""
+        from functools import partial
+        from horovod_tpu.parallel.sp import (ring_attention,
+                                             ulysses_attention)
+        mesh = make_mesh(dp=2, sp=4)
+        spec = P(None, None, "sp", None)
+        q = jnp.zeros((2, 4, 64, 16), jnp.float32)
+        k = v = jnp.zeros((2, 2, 64, 16), jnp.float32)
+        for attn in (ring_attention, ulysses_attention):
+            f = jax.shard_map(
+                partial(attn, axis_name="sp", causal=True, impl="flash"),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+            out = jax.eval_shape(f, q, k, v)
+            assert out.shape == (2, 4, 64, 16)
