@@ -156,6 +156,11 @@ class Engine:
         self.bytes_processed = 0
         # cross-process negotiation round counter (multi-process mode)
         self._negot_round = 0
+        # response-cache fast path over the wire: signature of the last
+        # meta this process sent, and each peer's last full meta
+        self._last_sent_sig = None
+        self._peer_meta_cache: Dict[int, Tuple] = {}
+        self.negot_cache_hits = 0
         # join state (JoinOp, collective_operations.cc:418-432): while
         # _joined, the engine keeps negotiating with an empty queue and
         # contributes zero-filled tensors to peers' allreduces
@@ -398,11 +403,23 @@ class Engine:
         collectives. Divergence surfaces as a coordinator timeout, which
         _run_cycle converts into error-status handles, plus stall-inspector
         warnings meanwhile."""
+        import hashlib
         import json
         self._negot_round += 1
         rnd = self._negot_round
+        meta = [self._work_meta(w) for w in batch]
+        meta_blob = json.dumps(meta, sort_keys=True)
+        # equality token, not a security boundary (FIPS-safe)
+        sig = hashlib.sha1(meta_blob.encode(),
+                           usedforsecurity=False).hexdigest()[:16]
         payload = {"j": bool(self._joined),
-                   "w": [self._work_meta(w) for w in batch],
+                   # response-cache fast path (response_cache.h:44 /
+                   # CoordinateCacheAndState): in steady state the same
+                   # tensor batch repeats every cycle, so a round whose
+                   # meta matches the previous round sends only the
+                   # 16-hex signature and peers replay their cached copy
+                   "sig": sig,
+                   "w": None if sig == self._last_sent_sig else meta,
                    # rank 0 owns the tunables; peers adopt them below so
                    # bucketization AND the allreduce algorithm stay
                    # identical across processes (SynchronizeParameters,
@@ -430,6 +447,25 @@ class Engine:
         self.fusion_threshold = peers[0].get("ft", self.fusion_threshold)
         self._state.config.hierarchical_allreduce = peers[0].get(
             "tl", self._state.config.hierarchical_allreduce)
+        # two phases so a replay failure can never leave full metas
+        # uncached, and _last_sent_sig only advances on a fully
+        # processed round — a failed round therefore falls back to a
+        # full-meta send next cycle instead of self-perpetuating
+        for p, msg in enumerate(peers):
+            if msg.get("w") is not None:
+                self._peer_meta_cache[p] = (msg.get("sig"), msg["w"])
+        for p, msg in enumerate(peers):
+            if msg.get("w") is None:    # fast path: replay cached meta
+                cached_sig, cached_meta = self._peer_meta_cache.get(
+                    p, (None, None))
+                if cached_sig != msg.get("sig"):
+                    raise RuntimeError(
+                        f"negotiation cache divergence: peer {p} sent "
+                        f"sig {msg.get('sig')} but cache holds "
+                        f"{cached_sig} (round {rnd})")
+                msg["w"] = cached_meta
+                self.negot_cache_hits += 1
+        self._last_sent_sig = sig
         peer_works = [{(e["n"], e["s"]): e for e in p["w"]} for p in peers]
         for p, msg in enumerate(peers):
             if msg["j"] and p not in self._joined_procs:

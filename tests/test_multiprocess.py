@@ -48,6 +48,7 @@ def test_hvdrun_np2_jax_plane(tmp_path):
         assert r["subset_allreduce"] == [[expect] * 2] * 2
         assert r["train_loss"] > 0
         assert r["gspmd_tp_loss"] > 0  # dp x tp GSPMD step across procs
+        assert r["negot_cache_hits"] > 0  # response-cache wire fast path
 
 
 def test_hvdrun_np2_join_zero_fill(tmp_path):
