@@ -127,15 +127,26 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def body(carry, step):
         o, m, l, kc, vc = carry
         kv_idx = (idx - step) % n
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+
+        def active(o, m, l, kc, vc):
+            s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+            if causal:
+                q_pos = idx * Sq + jnp.arange(Sq)
+                k_pos = kv_idx * Skv + jnp.arange(Skv)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                if groups > 1:
+                    mask = jnp.tile(mask, (groups, 1))
+                s = jnp.where(mask[None, None], s, NEG_INF)
+            return _online_softmax_step(o, m, l, s, vc)
+
         if causal:
-            q_pos = idx * Sq + jnp.arange(Sq)
-            k_pos = kv_idx * Skv + jnp.arange(Skv)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            if groups > 1:
-                mask = jnp.tile(mask, (groups, 1))
-            s = jnp.where(mask[None, None], s, NEG_INF)
-        o, m, l = _online_softmax_step(o, m, l, s, vc)
+            # skip fully-masked future blocks (the diagonal block at
+            # kv_idx == idx is partially visible and must run)
+            o, m, l = lax.cond(kv_idx <= idx, active,
+                               lambda o, m, l, kc, vc: (o, m, l),
+                               o, m, l, kc, vc)
+        else:
+            o, m, l = active(o, m, l, kc, vc)
         # rotate KV to the next neighbor (ICI ring)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
