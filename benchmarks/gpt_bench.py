@@ -39,6 +39,13 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=50304)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel degree (ring attention); "
+                         "dp = devices // sp")
+    ap.add_argument("--attention", default=None,
+                    choices=[None, "dense", "ring", "ulysses"],
+                    help="override attention mode (default: ring when "
+                         "--sp > 1 else dense)")
     args = ap.parse_args()
     if args.iters <= 0:
         ap.error("--iters must be positive")
@@ -56,7 +63,10 @@ def main():
     hvd.init()
     n_dev = hvd.size()
     platform = jax.devices()[0].platform
-    mesh = make_mesh(dp=n_dev)
+    if n_dev % args.sp:
+        ap.error(f"--sp {args.sp} must divide device count {n_dev}")
+    mesh = make_mesh(dp=n_dev // args.sp, sp=args.sp)
+    attention = args.attention or ("ring" if args.sp > 1 else "dense")
 
     if args.family == "llama":
         from horovod_tpu.models.llama import (Llama, LlamaConfig,
@@ -64,19 +74,22 @@ def main():
         cfg = LlamaConfig(vocab_size=args.vocab, num_layers=args.layers,
                           num_heads=args.heads, num_kv_heads=args.kv_heads,
                           head_dim=args.head_dim, max_seq_len=args.seq,
-                          mesh=mesh, attention_impl=args.impl)
+                          mesh=mesh, attention=attention,
+                          attention_impl=args.impl)
         model, rules = Llama(cfg), llama_partition_rules()
     else:
         cfg = GPTConfig(vocab_size=args.vocab, num_layers=args.layers,
                         num_heads=args.heads, head_dim=args.head_dim,
                         max_seq_len=args.seq, mesh=mesh,
+                        attention=attention,
                         attention_impl=args.impl)
         model, rules = GPT(cfg), gpt_partition_rules()
     B, S = args.batch * n_dev, args.seq
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, args.vocab, (B, S)), jnp.int32)
     targets = jnp.roll(tokens, -1, axis=1)
-    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+    # full batch for init: the sp shard_map needs batch % dp == 0
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
     n_params = sum(x.size for x in jax.tree.leaves(params))
     params = shard_params(params, mesh, rules)
     tx = optax.adamw(1e-3)
@@ -106,6 +119,7 @@ def main():
         "unit": "tok/s", "impl": args.impl, "params_m": round(n_params / 1e6, 1),
         "batch": B, "seq": S, "ms_per_step": round(step_time * 1000, 2),
         "mfu_v5e": round(mfu, 3) if mfu is not None else None,
+        "attention": attention, "sp": args.sp,
         "platform": platform, "n_devices": n_dev, "timing": timing,
     }))
 
