@@ -32,6 +32,10 @@ BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:30-42
 ATTEMPT_TIMEOUT_S = int(os.environ.get("HVD_BENCH_ATTEMPT_TIMEOUT", "420"))
 MAX_ATTEMPTS = int(os.environ.get("HVD_BENCH_ATTEMPTS", "3"))
 BACKOFF_S = 20.0
+#: overall deadline: when the TPU tunnel is hard-down every attempt burns
+#: its full timeout, and the driver's own timeout must not fire before we
+#: emit the structured error line
+MAX_TOTAL_S = int(os.environ.get("HVD_BENCH_TOTAL_TIMEOUT", "600"))
 
 _MARK = "HVD_BENCH_RESULT:"
 
@@ -137,11 +141,18 @@ def main() -> int:
             "error": f"unknown HVD_BENCH_STEM {stem!r}"}), flush=True)
         return 1
     errors = []
+    t_start = time.monotonic()
     for attempt in range(1, MAX_ATTEMPTS + 1):
+        remaining = MAX_TOTAL_S - (time.monotonic() - t_start)
+        if attempt > 1 and remaining < 60:
+            errors.append(f"stopping before attempt {attempt}: "
+                          f"total budget {MAX_TOTAL_S}s nearly spent")
+            break
+        budget = min(ATTEMPT_TIMEOUT_S, max(int(remaining), 60))
         try:
             out = subprocess.run(
                 [sys.executable, "-u", __file__, "--worker"],
-                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S,
+                capture_output=True, text=True, timeout=budget,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
             for line in out.stdout.splitlines():
                 if line.startswith(_MARK):
@@ -152,9 +163,11 @@ def main() -> int:
                           + " | ".join(tail))
         except subprocess.TimeoutExpired:
             errors.append(f"attempt {attempt}: timed out after "
-                          f"{ATTEMPT_TIMEOUT_S}s (TPU tunnel hang?)")
-        if attempt < MAX_ATTEMPTS:
-            time.sleep(BACKOFF_S * attempt)
+                          f"{budget}s (TPU tunnel hang?)")
+        left = MAX_TOTAL_S - (time.monotonic() - t_start)
+        if attempt < MAX_ATTEMPTS and left > 60:
+            # backoff counts against the total budget too
+            time.sleep(min(BACKOFF_S * attempt, max(left - 60, 0)))
     print(json.dumps({
         "metric": "resnet50_synthetic_img_sec_per_chip",
         "value": None,

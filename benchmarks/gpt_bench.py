@@ -43,7 +43,7 @@ def main():
                     help="sequence-parallel degree (ring attention); "
                          "dp = devices // sp")
     ap.add_argument("--attention", default=None,
-                    choices=[None, "dense", "ring", "ulysses"],
+                    choices=["dense", "ring", "ulysses"],
                     help="override attention mode (default: ring when "
                          "--sp > 1 else dense)")
     args = ap.parse_args()
@@ -67,6 +67,8 @@ def main():
         ap.error(f"--sp {args.sp} must divide device count {n_dev}")
     mesh = make_mesh(dp=n_dev // args.sp, sp=args.sp)
     attention = args.attention or ("ring" if args.sp > 1 else "dense")
+    if attention in ("ring", "ulysses") and args.sp <= 1:
+        ap.error(f"--attention {attention} requires --sp > 1")
 
     if args.family == "llama":
         from horovod_tpu.models.llama import (Llama, LlamaConfig,
@@ -88,8 +90,12 @@ def main():
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, args.vocab, (B, S)), jnp.int32)
     targets = jnp.roll(tokens, -1, axis=1)
-    # full batch for init: the sp shard_map needs batch % dp == 0
-    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    # smallest dp-divisible slice for init (the sp shard_map needs
+    # batch % dp == 0; the full batch would trace a throwaway forward
+    # at benchmark scale)
+    init_rows = max(1, n_dev // args.sp)
+    params = model.init(jax.random.PRNGKey(0),
+                        tokens[:init_rows])["params"]
     n_params = sum(x.size for x in jax.tree.leaves(params))
     params = shard_params(params, mesh, rules)
     tx = optax.adamw(1e-3)
