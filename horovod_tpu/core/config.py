@@ -73,6 +73,11 @@ class Config:
     elastic_enabled: bool = False
     # Adasum tuning (HOROVOD_ADASUM_MPI_CHUNK_SIZE analog).
     adasum_chunk_bytes: int = 1 << 26
+    # Two-level Adasum (AdasumGpuAllreduceOp::NcclHierarchical analog,
+    # adasum_gpu_operations.cc:66): local sum reduce-scatter, cross-node
+    # Adasum, local allgather. Off by default: the flat device-rank tree
+    # is the reference's AdasumMPI semantic.
+    adasum_hierarchical: bool = False
     # Process sets (operations.cc:649 HOROVOD_DYNAMIC_PROCESS_SETS).
     dynamic_process_sets: bool = False
     # Grouped-op fusion (operations.cc:616 HOROVOD_DISABLE_GROUP_FUSION).
@@ -100,6 +105,8 @@ class Config:
         c.hierarchical_allgather = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
         c.torus_allreduce = _env_bool("HOROVOD_TORUS_ALLREDUCE", c.torus_allreduce)
+        c.adasum_hierarchical = _env_bool(
+            "HOROVOD_ADASUM_HIERARCHICAL", c.adasum_hierarchical)
         c.autotune = _env_bool("HOROVOD_AUTOTUNE", c.autotune)
         c.autotune_log = os.environ.get("HOROVOD_AUTOTUNE_LOG", c.autotune_log)
         c.autotune_warmup_samples = _env_int(

@@ -5,12 +5,24 @@ pairwise combine a' = (1 - a.b/(2||a||^2)) a + (1 - a.b/(2||b||^2)) b applied
 over a recursive-halving binary tree, power-of-two ranks required,
 adasum.h:32).
 
-On TPU the tree is pure tensor math over the stacked rank axis: each level
-pairs adjacent rows and combines them with a vmapped kernel; XLA schedules the
-cross-device reads as ICI transfers. log2(n) levels, then the single result is
-broadcast back to all rows. Where the reference splits the work across an MPI
-tree of hosts (adasum.h:195 FusedAllreduce), here the whole tree is one jitted
-program.
+On TPU the tree is a shard_map program over the process set's mesh: each
+level every device exchanges its current value with its XOR partner via
+`lax.ppermute` (an ICI neighbor transfer) and combines — the pairwise
+formula is symmetric, so both partners converge on the same combined value
+and after log2(n) levels every rank holds the tree result with no final
+broadcast. The association (v0+v1)+(v2+v3)... matches the reference's
+recursive-halving order exactly. Because the program is a plain shard_map
+over the set mesh it runs identically in single-controller and
+multi-process (jax.distributed) mode — the path the reference covers with
+AdasumMPI cross-rank communication (adasum_mpi_operations.cc).
+
+`hierarchical=True` (or HOROVOD_ADASUM_HIERARCHICAL=1) selects the
+two-level variant of AdasumGpuAllreduceOp::NcclHierarchical
+(horovod/common/ops/adasum_gpu_operations.cc:66-243): reduce-scatter (sum)
+across the LOCAL mesh axis, Adasum recursive-doubling across the CROSS
+axis on each rank's chunk, allgather back across LOCAL. Chunk
+coefficients are per-chunk, like the reference's per-rank fused segments
+(adasum_gpu_operations.cc:224 notes the same approximation).
 """
 from __future__ import annotations
 
@@ -19,9 +31,12 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
 
 from ..core import basics
-from ..core.mesh import stacked_sharding
+from ..core.mesh import CROSS_AXIS, GLOBAL_AXIS, LOCAL_AXIS
 from ..core.process_sets import ProcessSet
 
 
@@ -31,7 +46,8 @@ def _is_power_of_two(n: int) -> bool:
 
 def adasum_combine(a: jax.Array, b: jax.Array) -> jax.Array:
     """One pairwise Adasum combine (adasum.h:101-131 dot/normsq dispatch +
-    :366,406 ScaledAdd). Computed in float32 for stability, cast back."""
+    :366,406 ScaledAdd). Computed in float32 for stability, cast back.
+    Symmetric in (a, b)."""
     dt = a.dtype
     af = a.astype(jnp.float32)
     bf = b.astype(jnp.float32)
@@ -43,40 +59,110 @@ def adasum_combine(a: jax.Array, b: jax.Array) -> jax.Array:
     return (acoef * af + bcoef * bf).astype(dt)
 
 
-@functools.lru_cache(maxsize=256)
-def _adasum_tree_fn(n: int):
-    @jax.jit
-    def f(x):                                   # [n, ...]
-        levels = n.bit_length() - 1
-        v = x
-        for _ in range(levels):
-            m = v.shape[0] // 2
-            a = v[0::2]
-            b = v[1::2]
-            v = jax.vmap(adasum_combine)(a, b)  # [m, ...]
-        result = v[0]
-        return jnp.broadcast_to(result[None], x.shape)
+def _xor_tree(v: jax.Array, axis: str, n: int) -> jax.Array:
+    """Recursive-doubling Adasum over mesh axis `axis` (size n, power of
+    two): level l exchanges with partner rank^2^l and combines. All ranks
+    hold the tree result afterwards."""
+    lvl = 1
+    while lvl < n:
+        u = lax.ppermute(v, axis, perm=[(i, i ^ lvl) for i in range(n)])
+        v = adasum_combine(v, u)
+        lvl *= 2
+    return v
 
-    return f
+
+@functools.lru_cache(maxsize=256)
+def _adasum_flat_fn(mesh: Mesh):
+    n = mesh.devices.size
+
+    def blk(x):                                   # [1, ...] per-device row
+        dt = x.dtype
+        v = x[0].astype(jnp.float32)
+        v = _xor_tree(v, GLOBAL_AXIS, n)
+        return v[None].astype(dt)
+
+    f = shard_map(blk, mesh=mesh, in_specs=P(GLOBAL_AXIS),
+                  out_specs=P(GLOBAL_AXIS))
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=256)
+def _adasum_hier_fn(mesh: Mesh):
+    """Two-level Adasum over a (cross, local) mesh
+    (adasum_gpu_operations.cc:135-138: NCCL ReduceScatter — parallelized
+    MPI Adasum — NCCL Allgather). The flat element count is padded to a
+    local-size multiple like the reference's FUSION_BUFFER_ATOMIC_UNIT
+    padding (adasum_gpu_operations.cc:118-123)."""
+    cross_n, local_n = mesh.devices.shape
+
+    def blk(x):                                   # [1, ...] per-device row
+        dt = x.dtype
+        v = x[0].astype(jnp.float32)
+        shape = v.shape
+        flat = v.reshape(-1)
+        m = flat.shape[0]
+        pad = (-m) % local_n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+        # phase 1: sum-reduce-scatter within the local (ICI) group
+        chunk = lax.psum_scatter(flat, LOCAL_AXIS, scatter_dimension=0,
+                                 tiled=True)
+        # phase 2: Adasum across nodes on this rank's chunk
+        chunk = _xor_tree(chunk, CROSS_AXIS, cross_n)
+        # phase 3: allgather back within the local group
+        full = lax.all_gather(chunk, LOCAL_AXIS, tiled=True)
+        if pad:
+            full = full[:m]
+        return full.reshape(shape)[None].astype(dt)
+
+    f = shard_map(blk, mesh=mesh, in_specs=P((CROSS_AXIS, LOCAL_AXIS)),
+                  out_specs=P((CROSS_AXIS, LOCAL_AXIS)))
+    return jax.jit(f)
 
 
 def adasum_allreduce(x: jax.Array, *,
-                     process_set: Optional[ProcessSet] = None) -> jax.Array:
+                     process_set: Optional[ProcessSet] = None,
+                     hierarchical: Optional[bool] = None) -> jax.Array:
     """Adasum reduction over the stacked rank axis; all ranks get the result.
 
-    Matches hvd.allreduce(op=hvd.Adasum). Requires power-of-two set size like
-    the reference tree (adasum.h:32 IsPowerOfTwo).
+    Matches hvd.allreduce(op=hvd.Adasum). Requires a power-of-two set size
+    like the reference tree (adasum.h:32 IsPowerOfTwo). `hierarchical`
+    (default HOROVOD_ADASUM_HIERARCHICAL, only for the global set) selects
+    the AdasumGpuAllreduceOp-style two-level algorithm: local sum
+    reduce-scatter, cross-node Adasum, local allgather.
     """
     ps = basics.get_process_set(process_set)
     n = ps.size()
+    if hierarchical is None:
+        hierarchical = basics.get_config().adasum_hierarchical and \
+            ps.process_set_id == 0
+    from .collective_ops import _place_stacked
+    if hierarchical:
+        hier = basics.get_hier_mesh()
+        if ps.process_set_id != 0 or hier.devices.size != n:
+            raise ValueError(
+                "hierarchical Adasum runs on the global process set only")
+        cross_n, local_n = hier.devices.shape
+        if not _is_power_of_two(cross_n):
+            raise ValueError(
+                f"hierarchical Adasum requires a power-of-two cross size, "
+                f"got {cross_n}")
+        x = _place_stacked(x, ps.mesh, n, "adasum")
+        if n == 1:
+            return x
+        if local_n == 1:          # degenerate: no local group -> flat tree
+            return _adasum_flat_fn(ps.mesh)(x)
+        from ..core.mesh import stacked_sharding
+        xh = jax.device_put(x, stacked_sharding(hier, (CROSS_AXIS,
+                                                       LOCAL_AXIS))) \
+            if x.is_fully_addressable else x
+        out = _adasum_hier_fn(hier)(xh)
+        return jax.device_put(out, stacked_sharding(ps.mesh)) \
+            if out.is_fully_addressable else out
     if not _is_power_of_two(n):
         raise ValueError(
             f"Adasum requires a power-of-two number of ranks, got {n}")
-    x = jnp.asarray(x)
-    if x.ndim < 1 or x.shape[0] != n:
-        raise ValueError(
-            f"adasum expects stacked [size, ...] input; got {tuple(x.shape)}")
-    x = jax.device_put(x, stacked_sharding(ps.mesh))
+    x = _place_stacked(x, ps.mesh, n, "adasum")
     if n == 1:
         return x
-    return _adasum_tree_fn(n)(x)
+    return _adasum_flat_fn(ps.mesh)(x)

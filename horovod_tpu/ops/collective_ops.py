@@ -197,15 +197,72 @@ def _reject_joined(what: str) -> None:
             f"{what} is not supported with Join at this time.")
 
 
-def _reject_multiprocess(what: str) -> None:
-    """Paths that cannot yet be serialized through the engine raise in
-    multi-process mode instead of hanging in an unmatched device
-    collective."""
-    st = basics.get_state()
-    if st.coordinator is not None and st.coordinator.size > 1:
-        raise NotImplementedError(
-            f"{what} is not supported in multi-process mode yet; use the "
-            "uniform (stacked-array) form, which routes through the engine")
+def _mp_ragged_allgather(rows: Sequence, sizes: Sequence[int],
+                         ps: ProcessSet):
+    """Multi-process ragged allgather: this process's per-rank arrays in,
+    the rank-ordered concatenation out (replicated over the set mesh).
+
+    `sizes` are the engine-negotiated per-rank dim-0 extents (the
+    reference's negotiated recv sizes, mpi_controller.cc:239 /
+    MPI_Allgatherv counts, mpi_operations.cc:122). Rows are padded to the
+    max extent, one device all_gather runs on the padded stacked buffer,
+    and the real segments are re-assembled on host."""
+    from ..core.mesh import place_replicated, place_stacked_rows
+    mesh, n = ps.mesh, ps.size()
+    rows = [np.asarray(r) for r in rows]
+    trailing = rows[0].shape[1:] if rows else ()
+    dtype = rows[0].dtype if rows else np.float32
+    m = max(sizes, default=0)
+    if m == 0:
+        return place_replicated(np.zeros((0,) + trailing, dtype), mesh)
+    padded = np.zeros((len(rows), m) + trailing, dtype)
+    for i, r in enumerate(rows):
+        padded[i, : r.shape[0]] = r
+    out = _allgather_fn(mesh)(place_stacked_rows(padded, mesh))
+    # every stacked row holds the full gather — pull ONE addressable shard
+    # to host instead of all local rows
+    row0 = np.asarray(out.addressable_shards[0].data)[0]
+    cat = np.concatenate(
+        [row0[i * m:i * m + sizes[i]] for i in range(n)], axis=0)
+    return place_replicated(cat, mesh)
+
+
+def _mp_ragged_alltoall(rows: Sequence, splits: Sequence[Sequence[int]],
+                        ps: ProcessSet):
+    """Multi-process ragged alltoall: this process's per-rank arrays +
+    the engine-negotiated FULL [n][n] splits table in; (per-local-rank
+    output list, their recv splits) out.
+
+    Same padded single-device-op scheme as the single-controller ragged
+    path (MPI_Alltoallv, mpi_operations.cc:441), with recv splits derived
+    from the negotiated table the way the reference's controller response
+    carries tensor_sizes (mpi_controller.cc:239)."""
+    from ..core.mesh import local_row_indices, place_stacked_rows
+    mesh, n = ps.mesh, ps.size()
+    my = local_row_indices(mesh)
+    rows = [np.asarray(r) for r in rows]
+    trailing = rows[0].shape[1:] if rows else ()
+    # promote like concatenate would (mixed per-rank dtypes must not be
+    # silently truncated into rows[0]'s dtype)
+    dtype = np.result_type(*rows) if rows else np.float32
+    recv_splits = [[splits[i][j] for i in range(n)] for j in my]
+    m = max((v for s in splits for v in s), default=0)
+    if m == 0:
+        return [np.zeros((0,) + trailing, dtype) for _ in my], recv_splits
+    send = np.zeros((len(my), n * m) + trailing, dtype)
+    for li, gi in enumerate(my):
+        offs = np.concatenate([[0], np.cumsum(splits[gi])])
+        for j in range(n):
+            cnt = splits[gi][j]
+            send[li, j * m:j * m + cnt] = rows[li][offs[j]:offs[j] + cnt]
+    out = _alltoall_fn(mesh)(place_stacked_rows(send, mesh))
+    loc = local_rows(out)                         # my rows of [n, n*m, ...]
+    outputs = [
+        np.concatenate([loc[li][i * m:i * m + splits[i][gj]]
+                        for i in range(n)], axis=0)
+        for li, gj in enumerate(my)
+    ]
+    return outputs, recv_splits
 
 
 @functools.lru_cache(maxsize=512)
@@ -265,15 +322,29 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     prescale/postscale handling operations.cc:1479).
     """
     ps, mesh, n = _resolve(process_set)
-    if op == ReduceOp.ADASUM:
-        _reject_multiprocess("Adasum allreduce")
-        from .adasum import adasum_allreduce
-        return adasum_allreduce(x, process_set=ps)
     routed = _engine_route("allreduce", x, op=op, name=name, process_set=ps,
                            prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor)
     if routed is not None:
         return routed
+    if op == ReduceOp.ADASUM:
+        if basics.get_state().joined_ranks:
+            # same guard the engine negotiation applies: zero-filled
+            # contributions would corrupt the scale-sensitive combine
+            raise ValueError(
+                "allreduce(Adasum) is not supported with Join "
+                "(zero-filled contributions)")
+        from .adasum import adasum_allreduce
+        # pre/postscale around the scale-invariant combine, like the
+        # reference's ScaleBuffer before/after NcclHierarchical
+        # (adasum_gpu_operations.cc:104)
+        if prescale_factor != 1.0:
+            x = _place_stacked(x, mesh, n, "allreduce")
+            x = x * jnp.asarray(prescale_factor, x.dtype)
+        r = adasum_allreduce(x, process_set=ps)
+        if postscale_factor != 1.0:
+            r = r * jnp.asarray(postscale_factor, jnp.float32).astype(r.dtype)
+        return r
     x = _place_stacked(x, mesh, n, "allreduce")
     has_scale = (prescale_factor != 1.0) or (postscale_factor != 1.0)
     mask = _joined_mask(ps, n)
@@ -327,12 +398,10 @@ def allgather(x: Union[Array, Sequence[Array]], *,
     """
     ps, mesh, n = _resolve(process_set)
     _reject_joined("Allgather")
-    if not isinstance(x, (list, tuple)):
-        routed = _engine_route("allgather", x, name=name, process_set=ps)
-        if routed is not None:
-            return routed
+    routed = _engine_route("allgather", x, name=name, process_set=ps)
+    if routed is not None:
+        return routed
     if isinstance(x, (list, tuple)):
-        _reject_multiprocess("Ragged (per-rank list) allgather")
         if len(x) != n:
             raise ValueError(f"Expected {n} per-rank arrays, got {len(x)}")
         shapes = {tuple(a.shape[1:]) for a in x}
@@ -433,8 +502,13 @@ def alltoall(x: Union[Array, Sequence[Array]],
     # (sender, receiver) cell to the max split and run ONE device
     # all_to_all on the padded stacked buffer — constant device-op count
     # regardless of n (the previous implementation built n^2 device
-    # slices). Host work is numpy packing/unpacking of views.
-    _reject_multiprocess("Ragged (splits) alltoall")
+    # slices). Host work is numpy packing/unpacking of views. In
+    # multi-process mode the engine negotiates the full splits table
+    # (the reference's negotiated recv splits, mpi_controller.cc:239).
+    routed = _engine_route("alltoall", x, splits=splits, name=name,
+                           process_set=ps)
+    if routed is not None:
+        return routed
     splits = [list(map(int, s)) for s in splits]
     if len(splits) != n or any(len(s) != n for s in splits):
         raise ValueError(f"splits must be an {n}x{n} nested list")
@@ -445,33 +519,14 @@ def alltoall(x: Union[Array, Sequence[Array]],
         _check_stacked(x, n, "alltoall")
         rows = [x[i] for i in range(n)]
     for i, (row, s) in enumerate(zip(rows, splits)):
+        if any(v < 0 for v in s):
+            raise ValueError(f"negative split in row {i}: {s}")
         if row.shape[0] != sum(s):
             raise ValueError(
                 f"rank {i}: sum(splits)={sum(s)} != dim0={row.shape[0]}")
-    recv_splits = [[splits[i][j] for i in range(n)] for j in range(n)]
-    m = max((v for s in splits for v in s), default=0)
-    trailing = rows[0].shape[1:] if rows else ()
-    # promote like concatenate would (mixed per-rank dtypes must not be
-    # silently truncated into rows[0]'s dtype)
-    dtype = np.result_type(*rows) if rows else np.float32
-    if m == 0:
-        return [np.zeros((0,) + trailing, dtype)
-                for _ in range(n)], recv_splits
-    send = np.zeros((n, n * m) + trailing, dtype)
-    offsets = [np.concatenate([[0], np.cumsum(s)]) for s in splits]
-    for i in range(n):
-        for j in range(n):
-            cnt = splits[i][j]
-            send[i, j * m:j * m + cnt] = \
-                rows[i][offsets[i][j]:offsets[i][j] + cnt]
-    out = np.asarray(_alltoall_fn(mesh)(
-        jax.device_put(send, stacked_sharding(mesh))))
-    outputs = [
-        np.concatenate([out[j, i * m:i * m + splits[i][j]]
-                        for i in range(n)], axis=0)
-        for j in range(n)
-    ]
-    return outputs, recv_splits
+    # single-controller: every row is local, so the shared pad/pack/unpack
+    # helper covers this path with my = all n ranks
+    return _mp_ragged_alltoall(rows, splits, ps)
 
 
 @functools.lru_cache(maxsize=512)

@@ -109,6 +109,10 @@ class _Work:
     postscale: float = 1.0
     splits: Optional[Sequence[Sequence[int]]] = None
     group_id: int = -1
+    # negotiation-derived cross-rank info for ragged ops (per-rank sizes /
+    # the full splits table) — the reference's controller response payload
+    # (tensor_sizes, mpi_controller.cc:239)
+    negotiated: Optional[dict] = None
 
 
 _group_counter = 0
@@ -220,6 +224,14 @@ class Engine:
         framework-thread staging the reference does before enqueue,
         operations.cc:1436-1556) so the dispatch thread only handles
         uniform global arrays."""
+        if work.request_type == RequestType.ALLGATHER and \
+                isinstance(work.tensor, (list, tuple)):
+            self._stage_ragged_allgather(work)
+            return
+        if work.request_type == RequestType.ALLTOALL and \
+                work.splits is not None:
+            self._stage_ragged_alltoall(work)
+            return
         if work.request_type in (RequestType.ALLREDUCE,
                                  RequestType.ALLGATHER,
                                  RequestType.BROADCAST,
@@ -240,6 +252,92 @@ class Engine:
                             f"{work.request_type.value} expects a stacked "
                             f"array with leading axis == process-set size "
                             f"({n}); got shape {tuple(t.shape)}")
+
+    def _stage_ragged_allgather(self, work: _Work) -> None:
+        """Normalize a ragged (per-rank list) allgather: multi-process mode
+        keeps this process's rows only (accepting either the local rows or
+        the full n-length list); trailing dims and dtype must agree across
+        the local rows — cross-rank agreement is checked in negotiation."""
+        from ..core.mesh import local_row_indices, mesh_is_multiprocess
+        mesh = work.process_set.mesh
+        n = work.process_set.size()
+        rows = list(work.tensor)
+        if mesh_is_multiprocess(mesh):
+            local = local_row_indices(mesh)
+            if len(rows) == n and len(local) != n:
+                rows = [rows[i] for i in local]
+            elif len(rows) != len(local):
+                raise ValueError(
+                    f"ragged allgather expects {len(local)} local per-rank "
+                    f"arrays (or the full {n}-length list); got {len(rows)}")
+        elif len(rows) != n:
+            raise ValueError(
+                f"Expected {n} per-rank arrays, got {len(rows)}")
+        if not rows:
+            raise ValueError("ragged allgather needs at least one row")
+        t0 = np.shape(rows[0])[1:]
+        dt0 = np.asarray(rows[0]).dtype
+        for i, r in enumerate(rows):
+            if len(np.shape(r)) < 1:
+                raise ValueError(
+                    f"ragged allgather rows must have rank >= 1; row {i} "
+                    f"has shape {np.shape(r)}")
+            if np.shape(r)[1:] != t0 or np.asarray(r).dtype != dt0:
+                raise ValueError(
+                    f"Mismatched trailing dims/dtype across local rows: "
+                    f"row {i} is {np.shape(r)}/{np.asarray(r).dtype}, "
+                    f"row 0 is {np.shape(rows[0])}/{dt0}")
+        work.tensor = rows
+
+    def _stage_ragged_alltoall(self, work: _Work) -> None:
+        """Normalize a ragged (splits) alltoall: rows become a per-rank
+        list (this process's rows in multi-process mode), splits the
+        matching per-row [n] send counts. Each row's dim0 must equal the
+        sum of its splits (alltoallv contract, mpi_operations.cc:441)."""
+        from ..core.mesh import local_row_indices, mesh_is_multiprocess
+        mesh = work.process_set.mesh
+        n = work.process_set.size()
+        mp = mesh_is_multiprocess(mesh)
+        local = local_row_indices(mesh) if mp else list(range(n))
+        if isinstance(work.tensor, (list, tuple)):
+            rows = [np.asarray(r) for r in work.tensor]
+        else:
+            t = np.asarray(work.tensor)
+            if t.ndim < 1 or t.shape[0] not in (n, len(local)):
+                raise ValueError(
+                    f"alltoall expects stacked [{n}, ...] input or the "
+                    f"local rows; got {tuple(t.shape)}")
+            rows = [t[i] for i in range(t.shape[0])]
+        splits = [[int(v) for v in s] for s in work.splits]
+        if mp and len(rows) == n and len(local) != n:
+            rows = [rows[i] for i in local]
+        if mp and len(splits) == n and len(local) != n:
+            splits = [splits[i] for i in local]
+        if len(rows) != len(local) or len(splits) != len(local):
+            raise ValueError(
+                f"alltoall expects {len(local)} local rows + splits rows "
+                f"(or full {n}-length); got {len(rows)} rows / "
+                f"{len(splits)} splits")
+        t0 = rows[0].shape[1:] if rows else ()
+        dt0 = rows[0].dtype if rows else None
+        for li, (row, s) in enumerate(zip(rows, splits)):
+            if row.shape[1:] != t0 or row.dtype != dt0:
+                raise ValueError(
+                    f"Mismatched trailing dims/dtype across local rows: "
+                    f"row {li} is {row.shape}/{row.dtype}, row 0 is "
+                    f"{rows[0].shape}/{dt0}")
+            if len(s) != n:
+                raise ValueError(
+                    f"splits rows must have length {n}; row {li} has "
+                    f"{len(s)}")
+            if any(v < 0 for v in s):
+                raise ValueError(f"negative split in row {li}: {s}")
+            if row.shape[0] != sum(s):
+                raise ValueError(
+                    f"row {li}: sum(splits)={sum(s)} != dim0="
+                    f"{row.shape[0]}")
+        work.tensor = rows
+        work.splits = splits
 
     def _commit(self, works: List[_Work]) -> None:
         """Append validated works to the queue atomically."""
@@ -374,12 +472,41 @@ class Engine:
     @staticmethod
     def _work_meta(w: _Work) -> dict:
         t = w.tensor
-        shape = list(getattr(t, "shape", ()))
-        dt = str(getattr(t, "dtype", ""))
-        return {"n": w.name, "s": w.process_set.process_set_id,
-                "t": w.request_type.value, "sh": shape, "dt": dt,
-                "op": w.op.value, "pre": w.prescale, "post": w.postscale,
-                "root": w.root_rank}
+        if isinstance(t, (list, tuple)):
+            # ragged op: per-rank shapes (this process's rows) — the
+            # request payload the reference's controller aggregates into
+            # negotiated recv sizes (mpi_controller.cc:239)
+            shape = [list(np.shape(a)) for a in t]
+            e0 = t[0] if len(t) else None
+            dt = "" if e0 is None else str(
+                e0.dtype if hasattr(e0, "dtype") else np.asarray(e0).dtype)
+            m = {"n": w.name, "s": w.process_set.process_set_id,
+                 "t": w.request_type.value, "sh": shape, "dt": dt,
+                 "op": w.op.value, "pre": w.prescale, "post": w.postscale,
+                 "root": w.root_rank, "rag": True}
+        else:
+            m = {"n": w.name, "s": w.process_set.process_set_id,
+                 "t": w.request_type.value,
+                 "sh": list(getattr(t, "shape", ())),
+                 "dt": str(getattr(t, "dtype", "")),
+                 "op": w.op.value, "pre": w.prescale, "post": w.postscale,
+                 "root": w.root_rank}
+        if w.splits is not None:
+            m["sp"] = [[int(v) for v in row] for row in w.splits]
+            m["rag"] = True
+        return m
+
+    @staticmethod
+    def _meta_cmp(m: dict):
+        """Cross-rank comparable signature. Ragged ops legitimately differ
+        in per-rank dim-0 extents, so only trailing dims + dtype + kind
+        must agree (the reference's ConstructResponse allows differing
+        first dims for allgather/alltoallv, controller.cc:627-741)."""
+        if m.get("rag"):
+            sh = m["sh"]
+            trails = sorted({tuple(s[1:]) for s in sh}) if sh else []
+            return ("rag", trails, m["dt"], m["t"], m["op"])
+        return (m["sh"], m["dt"], m["t"], m["op"])
 
     def _negotiate(self, coord, batch: List[_Work]
                    ) -> Tuple[List[_Work], List[_Work]]:
@@ -487,9 +614,9 @@ class Engine:
                 continue
             metas = [peer_works[p][key] for p in need]
             m0 = self._work_meta(w)
+            cmp0 = self._meta_cmp(m0)
             bad = next((m for m in metas
-                        if (m["sh"], m["dt"], m["t"], m["op"]) !=
-                           (m0["sh"], m0["dt"], m0["t"], m0["op"])), None)
+                        if self._meta_cmp(m) != cmp0), None)
             joined_members = any(p in self._joined_procs
                                  for p in _members(w.process_set))
             if bad is not None:
@@ -502,10 +629,16 @@ class Engine:
                                   "with Join at this time."))
             elif joined_members and w.op not in (ReduceOp.SUM,
                                                  ReduceOp.AVERAGE):
-                # zero-fill would corrupt min/max/product (same guard as
-                # the single-controller path)
+                # zero-fill would corrupt min/max/product/Adasum (same
+                # guard as the single-controller path)
                 errors.append((w, f"allreduce({w.op}) is not supported "
                                   "with Join (zero-filled contributions)"))
+            elif m0.get("rag"):
+                err = self._attach_negotiated(w, key, peer_works)
+                if err is not None:
+                    errors.append((w, err))
+                else:
+                    ready.append(w)
             else:
                 ready.append(w)
         # group closure (atomic completion): a group with any errored
@@ -585,6 +718,47 @@ class Engine:
                 self._joined = False
                 self._join_event.set()
         return ready, deferred
+
+    def _attach_negotiated(self, w: _Work, key, peer_works) -> Optional[str]:
+        """Assemble the cross-rank info a ragged op needs from the round's
+        peer metas: per-rank dim-0 sizes (allgather) or the full [n][n]
+        splits table (alltoall) — the payload the reference controller
+        returns in its response (tensor_sizes, mpi_controller.cc:239).
+        Returns an error string on malformed submissions."""
+        ps = w.process_set
+        n = ps.size()
+        rows_map: Dict[int, List[int]] = {}
+        for i, d in enumerate(ps.mesh.devices.flat):
+            rows_map.setdefault(d.process_index, []).append(i)
+        if w.request_type == RequestType.ALLGATHER:
+            sizes = [-1] * n
+            for p, rows in rows_map.items():
+                sh = peer_works[p][key].get("sh") or []
+                if len(sh) != len(rows):
+                    return (f"ragged allgather '{w.name}': process {p} "
+                            f"submitted {len(sh)} rows for {len(rows)} "
+                            f"devices")
+                for ri, s in zip(rows, sh):
+                    sizes[ri] = int(s[0])
+            w.negotiated = {"sizes": sizes}
+            return None
+        if w.request_type == RequestType.ALLTOALL:
+            table: List[Optional[List[int]]] = [None] * n
+            for p, rows in rows_map.items():
+                sp = peer_works[p][key].get("sp") or []
+                if len(sp) != len(rows):
+                    return (f"ragged alltoall '{w.name}': process {p} "
+                            f"submitted {len(sp)} splits rows for "
+                            f"{len(rows)} devices")
+                for ri, srow in zip(rows, sp):
+                    if len(srow) != n:
+                        return (f"ragged alltoall '{w.name}': splits row "
+                                f"of length {len(srow)} != set size {n}")
+                    table[ri] = [int(v) for v in srow]
+            w.negotiated = {"splits": table}
+            return None
+        return (f"ragged negotiation is not supported for "
+                f"{w.request_type.value}")
 
     def _make_zero_work(self, meta: dict) -> _Work:
         """Zero-filled stand-in for a joined process (JoinOp zero
@@ -716,12 +890,19 @@ class Engine:
 
     def _execute_single(self, w: _Work):
         if w.request_type == RequestType.ALLGATHER:
+            if isinstance(w.tensor, (list, tuple)) and \
+                    w.negotiated is not None:
+                return collective_ops._mp_ragged_allgather(
+                    w.tensor, w.negotiated["sizes"], w.process_set)
             return collective_ops.allgather(w.tensor,
                                             process_set=w.process_set)
         if w.request_type == RequestType.BROADCAST:
             return collective_ops.broadcast(w.tensor, w.root_rank,
                                             process_set=w.process_set)
         if w.request_type == RequestType.ALLTOALL:
+            if w.splits is not None and w.negotiated is not None:
+                return collective_ops._mp_ragged_alltoall(
+                    w.tensor, w.negotiated["splits"], w.process_set)
             return collective_ops.alltoall(w.tensor, w.splits,
                                            process_set=w.process_set)
         if w.request_type == RequestType.REDUCESCATTER:

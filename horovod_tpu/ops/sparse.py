@@ -20,8 +20,6 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from ..core import basics
 from ..core.process_sets import ProcessSet
 from ..core.types import ReduceOp
@@ -66,8 +64,11 @@ def sparse_allreduce(
     """
     ps, mesh = _resolve(process_set)
     n = ps.size()
-    if len(pairs) != n:
-        raise ValueError(f"Expected {n} (indices, values) pairs, got "
+    from ..core.mesh import local_row_indices, mesh_is_multiprocess
+    multiproc = mesh_is_multiprocess(mesh)
+    expect = len(local_row_indices(mesh)) if multiproc else n
+    if len(pairs) != expect:
+        raise ValueError(f"Expected {expect} (indices, values) pairs, got "
                          f"{len(pairs)}")
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("sparse_allreduce supports Sum/Average only "
@@ -90,19 +91,41 @@ def sparse_allreduce(
                 f"rank {r}: trailing dims {t} != {trailing}")
         idx_list.append(idx.astype(np.int64))
         val_list.append(val)
-
-    # "allgather" of the ragged indices/values: host-side concat, the moral
-    # equivalent of the reference's two allgathers (torch/mpi_ops.py:573-580).
-    all_idx = np.concatenate(idx_list) if idx_list else np.zeros(0, np.int64)
-    all_val = jnp.concatenate(val_list, axis=0)
     divide = n if op == ReduceOp.AVERAGE else 1
+
+    if multiproc:
+        # Two engine-routed ragged allgathers — exactly the reference's
+        # sparse path (torch/mpi_ops.py:573-580 allgathers indices and
+        # values); the engine negotiates per-rank sizes cross-process.
+        from . import collective_ops
+        base = name or "sparse_allreduce"
+        for r, idx in enumerate(idx_list):
+            if idx.size and idx.max() >= np.iinfo(np.int32).max:
+                raise ValueError(
+                    f"rank {r}: sparse index {idx.max()} exceeds int32 "
+                    "(TPU index dtype)")
+        all_idx = np.asarray(collective_ops.allgather(
+            [idx.astype(np.int32) for idx in idx_list],
+            process_set=ps, name=f"{base}.idx")).astype(np.int64)
+        all_val = collective_ops.allgather(
+            val_list, process_set=ps, name=f"{base}.val")
+        all_val = jnp.asarray(np.asarray(all_val))
+    else:
+        # "allgather" of the ragged indices/values: host-side concat, the
+        # moral equivalent of the reference's two allgathers
+        # (torch/mpi_ops.py:573-580).
+        all_idx = np.concatenate(idx_list) if idx_list \
+            else np.zeros(0, np.int64)
+        all_val = jnp.concatenate(val_list, axis=0)
 
     if all_idx.size == 0:
         if dense:
             if dense_dim0 is None:
                 raise ValueError("dense=True requires dense_dim0")
-            out = jnp.zeros((dense_dim0,) + trailing, all_val.dtype)
-            return jax.device_put(out, NamedSharding(mesh, P()))
+            from ..core.mesh import place_replicated
+            out = np.zeros((dense_dim0,) + trailing,
+                           np.dtype(str(all_val.dtype)))
+            return place_replicated(out, mesh)
         return np.zeros(0, np.int64), all_val
 
     if all_idx.min() < 0:
@@ -114,8 +137,9 @@ def sparse_allreduce(
             raise ValueError(
                 f"index {all_idx.max()} out of range for dense_dim0="
                 f"{dense_dim0}")
+        from ..core.mesh import place_replicated
         out = _coalesce_fn(dense_dim0, divide)(jnp.asarray(all_idx), all_val)
-        return jax.device_put(out, NamedSharding(mesh, P()))
+        return place_replicated(np.asarray(out), mesh)
 
     # coalesce: unique indices (static, host) + jitted segment-sum of values
     uniq, inverse = np.unique(all_idx, return_inverse=True)

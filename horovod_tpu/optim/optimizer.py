@@ -70,14 +70,12 @@ def _reduce_tree_eager(grads, op, process_set, prescale, postscale,
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     comp = [compression.compress(g) for g in leaves]
     tensors = [c for c, _ in comp]
-    if op == ReduceOp.ADASUM:
-        from ..ops.adasum import adasum_allreduce
-        reduced = [adasum_allreduce(t, process_set=process_set)
-                   for t in tensors]
-    else:
-        reduced = engine.grouped_allreduce(
-            tensors, op, process_set=process_set,
-            prescale_factor=prescale, postscale_factor=postscale)
+    # Adasum rides the same engine path (grouped; executed as per-tensor
+    # tree programs) so multi-process ordering/negotiation and the Join
+    # guard apply uniformly.
+    reduced = engine.grouped_allreduce(
+        tensors, op, process_set=process_set,
+        prescale_factor=prescale, postscale_factor=postscale)
     out = [compression.decompress(r, ctx)
            for r, (_, ctx) in zip(reduced, comp)]
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -81,6 +81,66 @@ def main(out_dir: str) -> None:
         np.testing.assert_allclose(
             a2a[r_local].ravel(),
             np.array([10.0 * i + r for i in range(4)]))
+    # --- ragged allgather: per-rank dim0 differs; engine negotiates sizes
+    # (reference: MPI_Allgatherv path, mpi_operations.cc:122) -------------
+    # rank r contributes r+1 rows of value r
+    my_ragged = [np.full((2 * pid + r + 1, 2), float(2 * pid + r),
+                         np.float32) for r in range(2)]
+    rag = np.asarray(hvd.allgather(my_ragged, name="mp_rag_ag"))
+    expect_rag = np.concatenate(
+        [np.full((r + 1, 2), float(r), np.float32) for r in range(4)])
+    np.testing.assert_allclose(rag, expect_rag)
+
+    # --- ragged alltoall: negotiated splits table (alltoallv,
+    # mpi_operations.cc:441 + mpi_controller.cc:239) ----------------------
+    # rank r sends j+1 rows (of value 100*r + j) to rank j
+    sp_local = [[j + 1 for j in range(4)] for _ in range(2)]
+    rows_local = [
+        np.concatenate([np.full((j + 1, 1), 100.0 * (2 * pid + r) + j,
+                                np.float32) for j in range(4)])
+        for r in range(2)
+    ]
+    outs, rsp = hvd.alltoall(rows_local, splits=sp_local, name="mp_rag_a2a")
+    for r_local in range(2):
+        r = 2 * pid + r_local
+        assert rsp[r_local] == [r + 1] * 4, rsp
+        expect_rows = np.concatenate(
+            [np.full((r + 1, 1), 100.0 * i + r, np.float32)
+             for i in range(4)])
+        np.testing.assert_allclose(outs[r_local], expect_rows)
+
+    # --- sparse allreduce across processes (torch/mpi_ops.py:567) --------
+    sp_pairs = [
+        (np.array([2 * pid + r, 0]),
+         np.stack([np.full((3,), float(2 * pid + r + 1), np.float32),
+                   np.ones((3,), np.float32)]))
+        for r in range(2)
+    ]
+    uniq, vals = hvd.sparse_allreduce(sp_pairs, hvd.Sum, name="mp_sparse")
+    np.testing.assert_array_equal(uniq, [0, 1, 2, 3])
+    vals = np.asarray(vals)
+    # index 0: 1 (from rank0) + 4*1 (the extra ones) -> rank r adds
+    # value r+1 at index r plus ones at index 0
+    np.testing.assert_allclose(vals[0], np.full((3,), 1.0 + 4.0))
+    for r in range(1, 4):
+        np.testing.assert_allclose(vals[r], np.full((3,), float(r + 1)))
+
+    # --- Adasum allreduce across processes (adasum_mpi_operations.cc) ----
+    rng_a = np.random.RandomState(11)
+    all_adasum = rng_a.randn(4, 5).astype(np.float32)
+    ada = hvd.local_rows(hvd.allreduce(
+        all_adasum[2 * pid:2 * pid + 2].copy(), hvd.Adasum,
+        name="mp_adasum"))
+
+    def _combine(a, b):
+        dot, na, nb = float(a @ b), float(a @ a), float(b @ b)
+        return (1 - dot / (2 * na)) * a + (1 - dot / (2 * nb)) * b
+
+    expect_ada = _combine(_combine(all_adasum[0], all_adasum[1]),
+                          _combine(all_adasum[2], all_adasum[3]))
+    np.testing.assert_allclose(ada, np.tile(expect_ada, (2, 1)), rtol=1e-4)
+    result["ragged_sparse_adasum"] = "ok"
+
     result["op_matrix"] = "ok"
 
     # --- member-scoped sub-set negotiation -------------------------------

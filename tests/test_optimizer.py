@@ -218,3 +218,99 @@ class TestDistributedGrad:
             hvd.allreduce_gradients(
                 jnp.ones((hvd.size(), 2)), op=hvd.Sum,
                 gradient_predivide_factor=2.0)
+
+
+class TestHierarchicalAdasum:
+    """Two-level Adasum over a (cross, local) hier mesh — the
+    AdasumGpuAllreduceOp::NcclHierarchical analog
+    (adasum_gpu_operations.cc:135-138: local sum reduce-scatter, cross
+    Adasum per chunk, local allgather)."""
+
+    @staticmethod
+    def _hier_2x4(hvd):
+        from horovod_tpu.core.mesh import build_hierarchical_mesh
+        st = hvd.core.basics.get_state()
+        prev = st.hier_mesh
+        st.hier_mesh = build_hierarchical_mesh(jax.devices(), local_size=4)
+        return prev
+
+    @staticmethod
+    def _expected(x, cross, local):
+        """Host model of the two-level algorithm: per-node sum, then
+        per-chunk pairwise Adasum across nodes (chunk = this local rank's
+        reduce-scatter share of the padded flat buffer)."""
+        from horovod_tpu.ops.adasum import adasum_combine
+        n, flatdim = x.shape[0], int(np.prod(x.shape[1:]))
+        pad = (-flatdim) % local
+        flat = np.concatenate(
+            [x.reshape(n, -1), np.zeros((n, pad), x.dtype)], axis=1)
+        nodes = flat.reshape(cross, local, -1).sum(axis=1)  # [cross, L]
+        chunks = np.split(nodes, local, axis=1)             # per local rank
+        combined = [
+            np.asarray(adasum_combine(jnp.asarray(c[0]), jnp.asarray(c[1])))
+            for c in chunks
+        ]
+        out = np.concatenate(combined)[:flatdim]
+        return np.tile(out.reshape(x.shape[1:])[None], (n,) + (1,) * (x.ndim - 1))
+
+    def test_two_level_matches_host_model(self, hvd):
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        prev = self._hier_2x4(hvd)
+        try:
+            rng = np.random.RandomState(5)
+            x = rng.randn(8, 5).astype(np.float32)   # flat 5 -> padded to 8
+            out = np.asarray(adasum_allreduce(jnp.asarray(x),
+                                              hierarchical=True))
+            np.testing.assert_allclose(out, self._expected(x, 2, 4),
+                                       rtol=1e-4)
+        finally:
+            hvd.core.basics.get_state().hier_mesh = prev
+
+    def test_scale_invariance_both_levels(self, hvd):
+        # combine(c*a, c*b) == c*combine(a, b) holds per chunk, and the
+        # local sum is linear, so the whole two-level op is
+        # scale-equivariant: hier_adasum(c*X) == c * hier_adasum(X).
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        prev = self._hier_2x4(hvd)
+        try:
+            rng = np.random.RandomState(7)
+            x = rng.randn(8, 6).astype(np.float32)
+            base = np.asarray(adasum_allreduce(jnp.asarray(x),
+                                               hierarchical=True))
+            scaled = np.asarray(adasum_allreduce(jnp.asarray(4.0 * x),
+                                                 hierarchical=True))
+            np.testing.assert_allclose(scaled, 4.0 * base, rtol=1e-4)
+            # identical node contributions -> result equals the node sum
+            # (combine(g, g) == g at the cross level)
+            y = np.tile(x[:4][None], (2, 1, 1)).reshape(8, 6)
+            out = np.asarray(adasum_allreduce(jnp.asarray(y),
+                                              hierarchical=True))
+            np.testing.assert_allclose(out, np.tile(x[:4].sum(0), (8, 1)),
+                                       rtol=1e-4)
+        finally:
+            hvd.core.basics.get_state().hier_mesh = prev
+
+    def test_validation(self, hvd):
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        ps = hvd.add_process_set([0, 1])
+        with pytest.raises(ValueError, match="global process set"):
+            adasum_allreduce(np.ones((2, 3), np.float32), process_set=ps,
+                             hierarchical=True)
+        hvd.remove_process_set(ps)
+
+    def test_env_flag_selects_hierarchical(self, hvd):
+        # HOROVOD_ADASUM_HIERARCHICAL makes hvd.allreduce(op=Adasum) take
+        # the two-level path on the global set
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        prev = self._hier_2x4(hvd)
+        cfg = hvd.core.basics.get_config()
+        try:
+            cfg.adasum_hierarchical = True
+            rng = np.random.RandomState(9)
+            x = rng.randn(8, 4).astype(np.float32)
+            out = np.asarray(hvd.allreduce(jnp.asarray(x), hvd.Adasum))
+            np.testing.assert_allclose(out, self._expected(x, 2, 4),
+                                       rtol=1e-4)
+        finally:
+            cfg.adasum_hierarchical = False
+            hvd.core.basics.get_state().hier_mesh = prev
