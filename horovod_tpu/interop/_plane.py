@@ -26,16 +26,29 @@ _size = 1
 
 def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
     """Initialize from launcher env; single-process fallback when unset.
-    Multi-process needs the native shm library."""
+
+    Same-host jobs ride the native shm segment. When ranks span hosts
+    (HOROVOD_CROSS_SIZE > 1) — or HOROVOD_INTEROP_FORCE_STORE=1 simulates
+    that on one machine — the plane becomes the two-level shm x TCP-store
+    hybrid (native/store_comm.py), the reference's hierarchical Gloo
+    scheme (gloo_operations.cc:33-53): reduce on-host over shm, exchange
+    once per host over the native store, fan back out over shm."""
     global _comm, _rank, _size
     _rank = int(os.environ.get("HOROVOD_RANK", "0"))
     _size = int(os.environ.get("HOROVOD_SIZE", "1"))
     if _size > 1 and _comm is None:
-        from ..native.shm import ShmComm
-        gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
         name = comm_name or \
             f"hvd_plane_{os.environ.get('HOROVOD_JOB_ID', default_job)}"
-        _comm = ShmComm(name, _rank, _size, gen=gen)
+        from ..core.config import _env_bool
+        cross_size = int(os.environ.get("HOROVOD_CROSS_SIZE", "1"))
+        force_store = _env_bool("HOROVOD_INTEROP_FORCE_STORE", False)
+        if cross_size > 1 or force_store:
+            from ..native.store_comm import build_hybrid_comm
+            _comm = build_hybrid_comm(name, force_store=force_store)
+        else:
+            from ..native.shm import ShmComm
+            gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
+            _comm = ShmComm(name, _rank, _size, gen=gen)
 
 
 def shutdown() -> None:

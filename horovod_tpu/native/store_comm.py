@@ -1,0 +1,228 @@
+"""Cross-host CPU collectives over the native TCP store.
+
+The foreign-framework plane (interop/_plane.py) needs numpy collectives
+that work when ranks span hosts — the role Gloo's TCP transport plays for
+the reference's torch/TF bindings (horovod/common/ops/gloo_operations.cc).
+`StoreComm` implements the ShmComm interface over the native store
+coordinator (csrc/store.cc), and `HybridComm` composes it with the POSIX
+shm plane into the reference's hierarchical scheme
+(gloo_operations.cc:33-53 / mpi_operations.cc MPIHierarchicalAllgather):
+reduce within the host over shared memory, exchange once per host over
+TCP, fan back out over shared memory.
+
+This is the control/CPU plane: device-resident training data rides the
+ICI mesh via the JAX collectives, not this path.
+"""
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+import numpy as np
+
+from .store import Coordinator
+
+
+_REDUCERS = {
+    "sum": lambda mats: np.sum(mats, axis=0),
+    "prod": lambda mats: np.prod(mats, axis=0),
+    "min": lambda mats: np.min(mats, axis=0),
+    "max": lambda mats: np.max(mats, axis=0),
+}
+
+
+class StoreComm:
+    """ShmComm-interface collectives among one coordinator group.
+
+    Each instance owns a Coordinator connection with a private tag prefix,
+    so it coexists with the engine's negotiation coordinator (and other
+    groups) on the same store server. All members must issue the same call
+    sequence — the collective contract every plane here shares.
+    """
+
+    def __init__(self, host: str, port: int, rank: int, size: int,
+                 prefix: str = "iplane", timeout: float = 300.0):
+        ip = socket.gethostbyname(host)
+        self._c = Coordinator(ip, port, rank, size, timeout=timeout)
+        self.rank, self.size = rank, size
+        self._prefix = prefix
+        self._seq = 0
+
+    def _tag(self, kind: str) -> str:
+        self._seq += 1
+        return f"{self._prefix}.{kind}.{self._seq}"
+
+    def barrier(self) -> None:
+        self._c.barrier(self._tag("bar"))
+
+    def _gather_blobs(self, arr: np.ndarray):
+        cap = self.size * (arr.nbytes + 8) + 64
+        return self._c.allgather(arr.tobytes(), tag=self._tag("ag"),
+                                 max_bytes=cap)
+
+    def allreduce(self, arr: np.ndarray, op: str = "sum",
+                  average: bool = False) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        red = _REDUCERS.get(op)
+        if red is None:
+            raise ValueError(f"unsupported op {op}")
+        mats = [np.frombuffer(b, arr.dtype).reshape(arr.shape)
+                for b in self._gather_blobs(arr)]
+        out = red(mats).astype(arr.dtype)
+        if average:
+            out = out / self.size if np.issubdtype(arr.dtype, np.floating) \
+                else out // self.size
+        return out
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        blobs = self._gather_blobs(arr)
+        return np.stack([np.frombuffer(b, arr.dtype).reshape(arr.shape)
+                         for b in blobs])
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        blob = self._c.broadcast(
+            arr.tobytes() if self.rank == root else None, root=root,
+            tag=self._tag("bc"), max_bytes=arr.nbytes + 64)
+        return np.frombuffer(blob, arr.dtype).reshape(arr.shape).copy()
+
+    def reducescatter(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        if arr.size % self.size:
+            raise ValueError(
+                f"reducescatter needs count divisible by size "
+                f"({arr.size} % {self.size})")
+        red = self.allreduce(arr, op)
+        chunk = red.size // self.size
+        return red.reshape(-1)[self.rank * chunk:
+                               (self.rank + 1) * chunk].copy()
+
+    def close(self) -> None:
+        self._c.close()
+
+
+class HybridComm:
+    """Two-level numpy collectives: shm within the host, store across.
+
+    `shm` is None on single-rank hosts; `store` (a StoreComm among the
+    per-host local roots) is None on non-root ranks. The call sequences
+    keep every member of each sub-plane in lockstep, mirroring the
+    reference's hierarchical CPU ops (gloo_operations.cc:33-53)."""
+
+    def __init__(self, shm, store: Optional[StoreComm],
+                 local_rank: int, local_size: int,
+                 cross_rank: int, cross_size: int,
+                 rank: int, size: int):
+        self._shm = shm
+        self._store = store
+        self._local_rank, self._local_size = local_rank, local_size
+        self._cross_rank, self._cross_size = cross_rank, cross_size
+        self.rank, self.size = rank, size
+
+    def barrier(self) -> None:
+        if self._shm is not None:
+            self._shm.barrier()
+        if self._store is not None:
+            self._store.barrier()
+        if self._shm is not None:
+            self._shm.barrier()     # non-roots wait for the cross barrier
+
+    def allreduce(self, arr: np.ndarray, op: str = "sum",
+                  average: bool = False) -> np.ndarray:
+        out = np.ascontiguousarray(arr)
+        if self._shm is not None:
+            out = self._shm.allreduce(out, op)       # host-local reduce
+        if self._store is not None:
+            out = self._store.allreduce(out, op)     # once per host on TCP
+        if self._shm is not None:
+            out = self._shm.broadcast(out, root=0)   # fan back out
+        if average:
+            out = out / self.size if np.issubdtype(out.dtype, np.floating) \
+                else out // self.size
+        return out
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        blk = self._shm.allgather(arr) if self._shm is not None \
+            else arr[None]                           # [L, ...]
+        g = None
+        if self._store is not None:
+            g = self._store.allgather(blk)           # [C, L, ...]
+            g = g.reshape((self.size,) + arr.shape)
+        if self._shm is not None:
+            if g is None:
+                g = np.empty((self.size,) + arr.shape, arr.dtype)
+            g = self._shm.broadcast(g, root=0)
+        return g
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        r_cross, r_local = divmod(root, max(self._local_size, 1))
+        out = np.ascontiguousarray(arr)
+        if self._shm is not None and self._cross_rank == r_cross:
+            out = self._shm.broadcast(out, root=r_local)
+        if self._store is not None:
+            out = self._store.broadcast(out, root=r_cross)
+        if self._shm is not None:
+            out = self._shm.broadcast(out, root=0)
+        return out
+
+    def reducescatter(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        if arr.size % self.size:
+            raise ValueError(
+                f"reducescatter needs count divisible by size "
+                f"({arr.size} % {self.size})")
+        red = self.allreduce(arr, op)
+        chunk = red.size // self.size
+        return red.reshape(-1)[self.rank * chunk:
+                               (self.rank + 1) * chunk].copy()
+
+    def close(self) -> None:
+        if self._store is not None:
+            self._store.close()
+            self._store = None
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
+
+
+def build_hybrid_comm(name_base: str, *, force_store: bool = False):
+    """Construct the cross-host plane from the launcher env contract.
+
+    Topology comes from HOROVOD_LOCAL_*/CROSS_* (runner/exec.py env);
+    the store address from HOROVOD_NATIVE_KV_ADDR/PORT (runner/launch.py).
+    `force_store` treats every rank as its own host (no shm) — the test
+    hook for simulating a multi-host job on one machine, and the fallback
+    when the slot layout is not host-major-uniform."""
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", "0"))
+    local_size = int(os.environ.get("HOROVOD_LOCAL_SIZE", "1"))
+    cross_rank = int(os.environ.get("HOROVOD_CROSS_RANK", str(rank)))
+    cross_size = int(os.environ.get("HOROVOD_CROSS_SIZE", str(size)))
+    addr = os.environ.get("HOROVOD_NATIVE_KV_ADDR")
+    port = os.environ.get("HOROVOD_NATIVE_KV_PORT")
+    if not addr or not port:
+        raise RuntimeError(
+            "cross-host interop plane needs HOROVOD_NATIVE_KV_ADDR/PORT "
+            "(exported by the hvdrun launcher)")
+    uniform = rank == cross_rank * local_size + local_rank and \
+        size == cross_size * local_size
+    if force_store or local_size <= 1 or not uniform:
+        # flat: every rank talks to the store directly
+        store = StoreComm(addr, int(port), rank, size, prefix="ipf")
+        return HybridComm(None, store, 0, 1, rank, size, rank, size)
+    from .shm import ShmComm
+    gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
+    # shm segment scoped per host (cross_rank suffix also keeps simulated
+    # multi-host runs on one machine from colliding)
+    shm = ShmComm(f"{name_base}_x{cross_rank}", local_rank, local_size,
+                  gen=gen)
+    store = None
+    if local_rank == 0:
+        store = StoreComm(addr, int(port), cross_rank, cross_size,
+                          prefix="ipx")
+    return HybridComm(shm, store, local_rank, local_size,
+                      cross_rank, cross_size, rank, size)

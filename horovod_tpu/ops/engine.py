@@ -275,17 +275,21 @@ class Engine:
                 f"Expected {n} per-rank arrays, got {len(rows)}")
         if not rows:
             raise ValueError("ragged allgather needs at least one row")
+
+        def _dt(a):      # no host transfer for device-resident rows
+            return getattr(a, "dtype", None) or np.asarray(a).dtype
+
         t0 = np.shape(rows[0])[1:]
-        dt0 = np.asarray(rows[0]).dtype
+        dt0 = _dt(rows[0])
         for i, r in enumerate(rows):
             if len(np.shape(r)) < 1:
                 raise ValueError(
                     f"ragged allgather rows must have rank >= 1; row {i} "
                     f"has shape {np.shape(r)}")
-            if np.shape(r)[1:] != t0 or np.asarray(r).dtype != dt0:
+            if np.shape(r)[1:] != t0 or _dt(r) != dt0:
                 raise ValueError(
                     f"Mismatched trailing dims/dtype across local rows: "
-                    f"row {i} is {np.shape(r)}/{np.asarray(r).dtype}, "
+                    f"row {i} is {np.shape(r)}/{_dt(r)}, "
                     f"row 0 is {np.shape(rows[0])}/{dt0}")
         work.tensor = rows
 

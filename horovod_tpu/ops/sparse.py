@@ -139,7 +139,7 @@ def sparse_allreduce(
                 f"{dense_dim0}")
         from ..core.mesh import place_replicated
         out = _coalesce_fn(dense_dim0, divide)(jnp.asarray(all_idx), all_val)
-        return place_replicated(np.asarray(out), mesh)
+        return place_replicated(out, mesh)
 
     # coalesce: unique indices (static, host) + jitted segment-sum of values
     uniq, inverse = np.unique(all_idx, return_inverse=True)

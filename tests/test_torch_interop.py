@@ -116,3 +116,81 @@ def test_torch_multiprocess_shm():
                   env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
                        "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
     assert results == [3.0, 3.0]
+
+
+# -- cross-host plane: TCP store instead of shm (VERDICT r2 item 3) ---------
+
+def test_torch_multiprocess_store_plane():
+    """Two processes with shm disabled (HOROVOD_INTEROP_FORCE_STORE
+    simulates ranks on different hosts): the full torch worker — ops,
+    object collectives, broadcast_parameters, a 3-step train — runs over
+    the native TCP store plane (the reference's cross-node Gloo role,
+    gloo_operations.cc)."""
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(
+            _torch_worker, num_proc=2,
+            job_runner=MultiprocessingJobRunner(),
+            env={"HOROVOD_INTEROP_FORCE_STORE": "1",
+                 "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+                 "HOROVOD_NATIVE_KV_PORT": str(server.port),
+                 "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results == [3.0, 3.0]
+    finally:
+        server.close()
+
+
+def _hybrid_worker(idx, port, gen, job):
+    import os
+    os.environ.update({
+        "HOROVOD_RANK": str(idx), "HOROVOD_SIZE": "4",
+        "HOROVOD_LOCAL_RANK": str(idx % 2), "HOROVOD_LOCAL_SIZE": "2",
+        "HOROVOD_CROSS_RANK": str(idx // 2), "HOROVOD_CROSS_SIZE": "2",
+        "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+        "HOROVOD_NATIVE_KV_PORT": str(port),
+        "HOROVOD_SHM_GEN": str(gen), "HOROVOD_JOB_ID": job,
+    })
+    import numpy as np
+    import horovod_tpu.interop._plane as plane
+    plane.init()
+    r = plane.rank()
+    out = plane.allreduce_np(np.full((3,), float(r + 1), np.float32))
+    assert np.allclose(out, 10.0), out               # 1+2+3+4
+    g = plane.allgather_np(np.array([[r]], np.int64))
+    assert g.ravel().tolist() == [0, 1, 2, 3], g
+    # root on the OTHER pseudo-host and non-zero local rank: all three
+    # phases of the hierarchical broadcast run
+    b = plane.broadcast_np(np.full((2,), float(r), np.float32), root=3)
+    assert np.allclose(b, 3.0), b
+    rs = plane.reducescatter_np(np.arange(8, dtype=np.float32))
+    assert np.allclose(rs, 4.0 * np.arange(8)[2 * r:2 * r + 2]), rs
+    objs = plane.allgather_object({"r": r})
+    assert [o["r"] for o in objs] == [0, 1, 2, 3], objs
+    plane.barrier()
+    plane.shutdown()
+
+
+def test_hybrid_two_level_plane():
+    """4 ranks as 2 pseudo-hosts x 2 local: shm within each pseudo-host,
+    TCP store across — the hierarchical scheme of the reference's CPU ops
+    (gloo_operations.cc:33-53)."""
+    import multiprocessing as mp
+    from horovod_tpu.native.store import StoreServer
+    server = StoreServer()
+    gen = uuid.uuid4().int % (1 << 62)
+    job = uuid.uuid4().hex[:8]
+    try:
+        ctx = mp.get_context("spawn")
+        procs = [ctx.Process(target=_hybrid_worker,
+                             args=(i, server.port, gen, job), daemon=True)
+                 for i in range(4)]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=120)
+        codes = [p.exitcode for p in procs]
+        assert codes == [0, 0, 0, 0], codes
+    finally:
+        server.close()
