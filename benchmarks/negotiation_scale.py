@@ -1,0 +1,85 @@
+"""Negotiation control-plane scale benchmark (VERDICT r2 item 5).
+
+Measures engine-negotiation round latency against the native TCP store at
+16-64 simulated processes — pure control plane, no devices, no jax. Each
+worker process runs the engine's wire pattern per round: one coordinator
+allgather of a meta blob (steady-state size ~90 bytes: the response-cache
+sig fast path payload, engine.py _negotiate). Rank 0 reports rounds/sec.
+
+The reference bar is the ~1 ms RunLoopOnce cadence
+(horovod/common/operations.cc:751) with its MPI/Gloo controller; a v5e-256
+pod is 64 hosts, so the store must sustain 64-way fan-in at the default
+1 ms cycle time (i.e. >=1000 rounds/s would saturate the cycle; in
+practice the engine only negotiates when work is queued and the cycle
+time acts as a floor between rounds).
+
+Usage: python benchmarks/negotiation_scale.py [--procs 8,16,32,64]
+       [--rounds 200] [--payload 90]
+Prints one JSON line per P: {"procs": P, "rounds_per_s": ..., ...}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _worker(rank: int, size: int, port: int, rounds: int, payload: int,
+            out_q) -> None:
+    from horovod_tpu.native.store import Coordinator
+    c = Coordinator("127.0.0.1", port, rank, size, timeout=120.0)
+    blob = bytes(payload)
+    c.barrier("warmup")
+    t0 = time.monotonic()
+    for r in range(rounds):
+        c.allgather(blob, tag=f"negot-{r}")
+    dt = time.monotonic() - t0
+    if rank == 0:
+        out_q.put(dt)
+    c.close()
+
+
+def measure(procs: int, rounds: int, payload: int) -> dict:
+    from horovod_tpu.native.store import StoreServer
+    server = StoreServer()
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    ps = [ctx.Process(target=_worker,
+                      args=(i, procs, server.port, rounds, payload, out_q),
+                      daemon=True)
+          for i in range(procs)]
+    t_start = time.monotonic()
+    for p in ps:
+        p.start()
+    dt = out_q.get(timeout=600)
+    for p in ps:
+        p.join(timeout=60)
+    server.close()
+    return {
+        "procs": procs,
+        "rounds": rounds,
+        "payload_bytes": payload,
+        "rounds_per_s": round(rounds / dt, 1),
+        "round_ms": round(1000.0 * dt / rounds, 3),
+        "wall_s": round(time.monotonic() - t_start, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--procs", default="8,16,32,64")
+    ap.add_argument("--rounds", type=int, default=200)
+    ap.add_argument("--payload", type=int, default=90)
+    args = ap.parse_args()
+    for p in [int(x) for x in args.procs.split(",")]:
+        print(json.dumps(measure(p, args.rounds, args.payload)),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

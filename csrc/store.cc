@@ -43,6 +43,7 @@ enum Op : uint8_t {
   OP_GET = 2,      // blocking, with timeout; optional read-counted delete
   OP_DEL = 3,
   OP_PING = 4,
+  OP_GATHER = 5,   // join-and-collect: post a blob, reply with all blobs
 };
 
 enum Status : uint8_t {
@@ -92,6 +93,13 @@ struct Entry {
   std::string value;
   int reads_left = 0;  // 0 = persistent; >0 = erase after this many reads
   bool present = false;
+};
+
+struct GatherState {
+  std::map<int, std::string> blobs;  // rank -> posted blob (pre-complete)
+  std::string result;                // concat, set at completion
+  bool complete = false;
+  int reads_left = 0;                // erase after every member read it
 };
 
 class StoreServer {
@@ -229,6 +237,73 @@ class StoreServer {
         case OP_PING:
           alive = send_frame(fd, ST_OK, "pong");
           break;
+        case OP_GATHER: {
+          // Server-side allgather: ONE round trip per member per round
+          // (the client-side loop of per-rank Gets was O(P) sequential
+          // RTTs — ~140 ms/round at P=64; this is the fan-in the
+          // reference controller does at the coordinator rank,
+          // controller.cc:124 RecvReadyTensors).
+          // value payload: double timeout_s + i32 group size + i32 rank
+          // + blob. Reply: concat of u32-len-prefixed blobs rank-order.
+          if (val.size() < 16) {
+            alive = send_frame(fd, ST_ERROR, "bad gather");
+            break;
+          }
+          double timeout_s;
+          int32_t gsize, grank;
+          std::memcpy(&timeout_s, val.data(), 8);
+          std::memcpy(&gsize, val.data() + 8, 4);
+          std::memcpy(&grank, val.data() + 12, 4);
+          if (gsize <= 0 || grank < 0 || grank >= gsize) {
+            alive = send_frame(fd, ST_ERROR, "bad gather args");
+            break;
+          }
+          std::unique_lock<std::mutex> lk(mu_);
+          GatherState& g = gathers_[key];
+          if (!g.complete) {
+            // idempotent re-post (a member retrying after timeout)
+            g.blobs[grank] = val.substr(16);
+            if (static_cast<int>(g.blobs.size()) == gsize) {
+              std::string res;
+              for (auto& kv : g.blobs) {
+                uint32_t blen = static_cast<uint32_t>(kv.second.size());
+                res.append(reinterpret_cast<char*>(&blen), 4);
+                res.append(kv.second);
+              }
+              g.result = std::move(res);
+              g.complete = true;
+              g.reads_left = gsize;
+              g.blobs.clear();
+              cv_.notify_all();
+            }
+          }
+          auto gready = [&] {
+            auto it = gathers_.find(key);
+            return (it != gathers_.end() && it->second.complete) ||
+                   shutting_down_.load();
+          };
+          bool got;
+          if (timeout_s < 0) {
+            cv_.wait(lk, gready);
+            got = !shutting_down_.load();
+          } else {
+            got = cv_.wait_for(
+                      lk, std::chrono::duration<double>(timeout_s),
+                      gready) &&
+                  !shutting_down_.load();
+          }
+          if (!got) {
+            lk.unlock();
+            alive = send_frame(fd, ST_TIMEOUT, "");
+            break;
+          }
+          auto git = gathers_.find(key);
+          std::string gout = git->second.result;
+          if (--git->second.reads_left == 0) gathers_.erase(git);
+          lk.unlock();
+          alive = send_frame(fd, ST_OK, gout);
+          break;
+        }
         default:
           alive = send_frame(fd, ST_ERROR, "bad op");
       }
@@ -249,6 +324,7 @@ class StoreServer {
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, Entry> data_;
+  std::map<std::string, GatherState> gathers_;
   std::set<int> conn_fds_;
 };
 
@@ -317,6 +393,17 @@ class StoreClient {
 
   int Del(const std::string& key) { return Request(OP_DEL, key, "", nullptr); }
 
+  int Gather(const std::string& key, double timeout_s, int size, int rank,
+             const std::string& blob, std::string* out) {
+    std::string arg(16, '\0');
+    std::memcpy(&arg[0], &timeout_s, 8);
+    int32_t s = size, r = rank;
+    std::memcpy(&arg[8], &s, 4);
+    std::memcpy(&arg[12], &r, 4);
+    arg += blob;
+    return Request(OP_GATHER, key, arg, out);
+  }
+
  private:
   int fd_ = -1;
   std::mutex mu_;
@@ -338,22 +425,30 @@ class Coordinator {
            std::to_string(rank);
   }
 
+  // Per-tag sequence numbers, advanced only on SUCCESS: a retry of a
+  // timed-out collective reuses the same key, so slow-peer retries stay
+  // idempotent (the engine's negotiation retry loop depends on this).
+  uint64_t SeqOf(const std::string& tag) {
+    std::lock_guard<std::mutex> lk(seq_mu_);
+    return tag_seq_[tag];
+  }
+
+  void Advance(const std::string& tag, uint64_t seq) {
+    std::lock_guard<std::mutex> lk(seq_mu_);
+    if (tag_seq_[tag] == seq) tag_seq_[tag] = seq + 1;
+  }
+
   // Allgather of variable-size blobs. out = concat of u32-len-prefixed blobs
-  // in rank order.
+  // in rank order. ONE round trip via the server-side gather (OP_GATHER) —
+  // the O(P)-sequential-Gets client loop capped negotiation at ~7 rounds/s
+  // for 64 processes.
   int Allgather(const std::string& tag, const std::string& blob,
                 double timeout_s, std::string* out) {
-    uint64_t seq = seq_++;
-    if (client_.Set(Key(tag, seq, rank_), blob) != ST_OK) return ST_ERROR;
-    out->clear();
-    for (int r = 0; r < size_; ++r) {
-      std::string v;
-      int st = client_.Get(Key(tag, seq, r), timeout_s, size_, &v);
-      if (st != ST_OK) return st;
-      uint32_t len = static_cast<uint32_t>(v.size());
-      out->append(reinterpret_cast<char*>(&len), 4);
-      out->append(v);
-    }
-    return ST_OK;
+    uint64_t seq = SeqOf(tag);
+    int st = client_.Gather(Key(tag, seq, -1), timeout_s, size_, rank_,
+                            blob, out);
+    if (st == ST_OK) Advance(tag, seq);
+    return st;
   }
 
   int Barrier(const std::string& tag, double timeout_s) {
@@ -363,13 +458,17 @@ class Coordinator {
 
   int Bcast(const std::string& tag, int root, std::string* blob,
             double timeout_s) {
-    uint64_t seq = seq_++;
+    uint64_t seq = SeqOf(tag);
+    int st;
     if (rank_ == root) {
       if (size_ == 1) return ST_OK;
-      return client_.Set(Key(tag, seq, root), *blob) == ST_OK ? ST_OK
-                                                              : ST_ERROR;
+      st = client_.Set(Key(tag, seq, root), *blob) == ST_OK ? ST_OK
+                                                            : ST_ERROR;
+    } else {
+      st = client_.Get(Key(tag, seq, root), timeout_s, size_ - 1, blob);
     }
-    return client_.Get(Key(tag, seq, root), timeout_s, size_ - 1, blob);
+    if (st == ST_OK) Advance(tag, seq);
+    return st;
   }
 
   // In-place bitwise AND/OR allreduce of a bitvector — the cache-coordination
@@ -402,7 +501,8 @@ class Coordinator {
 
   StoreClient client_;
   int rank_, size_;
-  std::atomic<uint64_t> seq_{0};
+  std::mutex seq_mu_;
+  std::map<std::string, uint64_t> tag_seq_;
 };
 
 }  // namespace

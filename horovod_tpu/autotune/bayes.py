@@ -65,11 +65,17 @@ class BayesianOptimizer:
     """Sequential maximizer over a box domain."""
 
     def __init__(self, bounds: Sequence[Tuple[float, float]],
-                 seed: int = 0, n_candidates: int = 512):
+                 seed: int = 0, n_candidates: int = 512,
+                 noise: Optional[float] = None):
         self.bounds = np.asarray(bounds, np.float64)
         self.rng = np.random.RandomState(seed)
         self.n_candidates = n_candidates
-        self.gp = GaussianProcess(length_scale=0.3)
+        # `noise` is the reference's [0, 1] sample-noise regularization
+        # (HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE,
+        # bayesian_optimization.cc): the GP's observation sigma
+        self.gp = GaussianProcess(
+            length_scale=0.3,
+            sigma_n=1e-4 if noise is None else float(noise))
         self.xs: List[np.ndarray] = []
         self.ys: List[float] = []
 

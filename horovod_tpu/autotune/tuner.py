@@ -29,7 +29,8 @@ TWO_LEVEL_RANGE = (0.0, 1.0)
 class ParameterManager:
     def __init__(self, warmup_samples: int = 3, steps_per_sample: int = 10,
                  max_samples: int = 20, log_path: Optional[str] = None,
-                 seed: int = 0, tune_two_level: bool = True):
+                 seed: int = 0, tune_two_level: bool = True,
+                 gp_noise: Optional[float] = None):
         #: tune_two_level=False freezes the categorical dim (e.g. when
         #: HOROVOD_TORUS_ALLREDUCE already forces the two-level path and
         #: the knob would be behaviorally inert)
@@ -37,7 +38,7 @@ class ParameterManager:
         dims = [FUSION_MB_RANGE, CYCLE_MS_RANGE]
         if tune_two_level:
             dims.append(TWO_LEVEL_RANGE)
-        self.opt = BayesianOptimizer(dims, seed=seed)
+        self.opt = BayesianOptimizer(dims, seed=seed, noise=gp_noise)
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
         self.max_samples = max_samples

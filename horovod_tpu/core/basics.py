@@ -99,7 +99,7 @@ def _maybe_init_distributed(cfg: Config) -> None:
             raise RuntimeError(f"jax.distributed.initialize failed: {e}") from e
 
 
-def _maybe_create_coordinator():
+def _maybe_create_coordinator(cfg: Optional[Config] = None):
     """Connect the native host-level Coordinator (csrc/store.cc) when the
     launcher exported a native KV address — the role the reference's
     controller transport plays over Gloo (gloo/gloo_controller.cc): barrier,
@@ -119,7 +119,9 @@ def _maybe_create_coordinator():
         # workers get a routable address (the launcher's own /etc/hosts may
         # map its name to loopback).
         ip = socket.gethostbyname(addr)
-        return Coordinator(ip, int(port), rank_, size_)
+        # reference HOROVOD_GLOO_TIMEOUT_SECONDS: control-plane op timeout
+        timeout = (cfg or Config.from_env()).gloo_timeout_seconds
+        return Coordinator(ip, int(port), rank_, size_, timeout=timeout)
     except Exception as e:  # noqa: BLE001
         if size_ > 1:
             # The coordinator protocol is collective: one process silently
@@ -146,7 +148,7 @@ def init(comm: Optional[Sequence[int]] = None,
         cfg = Config.from_env()
         _state.config = cfg
         _maybe_init_distributed(cfg)
-        _state.coordinator = _maybe_create_coordinator()
+        _state.coordinator = _maybe_create_coordinator(cfg)
 
         devices = global_devices()
         if comm is not None and not hasattr(comm, "Get_rank"):
@@ -181,6 +183,13 @@ def init(comm: Optional[Sequence[int]] = None,
 def _configure_logging(cfg: Config) -> None:
     level = getattr(logging, cfg.log_level, logging.WARNING)
     logger.setLevel(level)
+    if cfg.log_with_timestamp and not logger.handlers:
+        # reference --log-with-timestamp (launch.py:527)
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "[%(asctime)s] %(levelname)s %(message)s"))
+        logger.addHandler(h)
+        logger.propagate = False
 
 
 def shutdown() -> None:

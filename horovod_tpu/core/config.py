@@ -62,6 +62,18 @@ class Config:
     autotune_log: Optional[str] = None
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
+    # reference --autotune-bayes-opt-max-samples / ...-gaussian-process-noise
+    # (launch.py:431-437)
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: Optional[float] = None
+    # True when HOROVOD_HIERARCHICAL_ALLREDUCE was set explicitly (either
+    # value) — the reference's --no-hierarchical-allreduce contract: an
+    # explicit setting freezes the knob against autotuning (launch.py:380)
+    hierarchical_allreduce_set: bool = False
+    # Native control-plane op timeout (reference HOROVOD_GLOO_TIMEOUT_SECONDS)
+    gloo_timeout_seconds: float = 300.0
+    # Timestamps in log lines (reference --log-with-timestamp)
+    log_with_timestamp: bool = False
     # Timeline (operations.cc:495-510).
     timeline_filename: Optional[str] = None
     timeline_mark_cycles: bool = False
@@ -102,6 +114,8 @@ class Config:
         c.cache_capacity = _env_int("HOROVOD_CACHE_CAPACITY", c.cache_capacity)
         c.hierarchical_allreduce = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
+        c.hierarchical_allreduce_set = \
+            "HOROVOD_HIERARCHICAL_ALLREDUCE" in os.environ
         c.hierarchical_allgather = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
         c.torus_allreduce = _env_bool("HOROVOD_TORUS_ALLREDUCE", c.torus_allreduce)
@@ -113,6 +127,16 @@ class Config:
             "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", c.autotune_warmup_samples)
         c.autotune_steps_per_sample = _env_int(
             "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", c.autotune_steps_per_sample)
+        c.autotune_bayes_opt_max_samples = _env_int(
+            "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
+            c.autotune_bayes_opt_max_samples)
+        noise = _env_float("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", -1.0)
+        if noise >= 0:
+            c.autotune_gaussian_process_noise = noise
+        c.gloo_timeout_seconds = _env_float(
+            "HOROVOD_GLOO_TIMEOUT_SECONDS", c.gloo_timeout_seconds)
+        c.log_with_timestamp = _env_bool(
+            "HOROVOD_LOG_WITH_TIMESTAMP", c.log_with_timestamp)
         c.timeline_filename = os.environ.get("HOROVOD_TIMELINE", c.timeline_filename)
         c.timeline_mark_cycles = _env_bool(
             "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)

@@ -15,6 +15,18 @@ from typing import Dict, List, Optional
 from ..runner.hosts import HostInfo
 
 
+def set_blacklist_cooldown_range(lo: float, hi: float) -> None:
+    """Configure the blacklist cooldown bounds (reference
+    --blacklist-cooldown-range, launch.py:460: the min/max seconds a
+    failing host stays blacklisted; the backoff grows exponentially from
+    min to max)."""
+    if not (0 < lo <= hi):
+        raise ValueError(
+            f"cooldown range must satisfy 0 < min <= max, got ({lo}, {hi})")
+    HostState.COOLDOWN_BASE = float(lo)
+    HostState.COOLDOWN_MAX = float(hi)
+
+
 class HostState:
     """Blacklist with cooldown (discovery.py:33)."""
 

@@ -35,12 +35,17 @@ class ElasticDriver:
                  poll_interval: float = 1.0,
                  ssh_port: Optional[int] = None,
                  ssh_identity_file: Optional[str] = None,
-                 output_dir: Optional[str] = None):
+                 output_dir: Optional[str] = None,
+                 elastic_timeout: Optional[float] = None):
         self.manager = HostManager(discovery)
         self.command = command
         self.min_np = min_np
         self.max_np = max_np
         self.reset_limit = reset_limit
+        # reference --elastic-timeout (launch.py:452, default 600):
+        # bound on waiting for min_np hosts after a re-scale
+        self.elastic_timeout = elastic_timeout if elastic_timeout \
+            is not None else 600.0
         self.base_env = dict(base_env if base_env is not None else os.environ)
         self.poll_interval = poll_interval
         self.ssh_port = ssh_port
@@ -104,12 +109,18 @@ class ElasticDriver:
         self._stop.set()
 
     def _wait_for_min_hosts(self) -> List[HostInfo]:
+        deadline = time.monotonic() + self.elastic_timeout
         while True:
             hosts = self.manager.current_hosts()
             if sum(h.slots for h in hosts) >= self.min_np:
                 return hosts
             if self._stop.is_set():
                 raise RuntimeError("driver stopped while waiting for hosts")
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"elastic timeout: fewer than min_np={self.min_np} "
+                    f"slots available after {self.elastic_timeout}s "
+                    "(reference --elastic-timeout semantics)")
             time.sleep(self.poll_interval)
 
     def _launch(self, slots: List[SlotInfo], kv_port: int) -> None:
@@ -175,6 +186,10 @@ def run_elastic(args) -> int:
     from ..runner.launch import env_from_args
     base_env = dict(os.environ)
     base_env.update(env_from_args(args))
+    cooldown = getattr(args, "blacklist_cooldown_range", None)
+    if cooldown:
+        from .discovery import set_blacklist_cooldown_range
+        set_blacklist_cooldown_range(cooldown[0], cooldown[1])
     discovery = HostDiscoveryScript(
         args.host_discovery_script,
         default_slots=getattr(args, "slots", None) or 1)
@@ -185,7 +200,8 @@ def run_elastic(args) -> int:
         base_env=base_env,
         ssh_port=getattr(args, "ssh_port", None),
         ssh_identity_file=getattr(args, "ssh_identity_file", None),
-        output_dir=getattr(args, "output_filename", None))
+        output_dir=getattr(args, "output_filename", None),
+        elastic_timeout=getattr(args, "elastic_timeout", None))
     return driver.run()
 
 

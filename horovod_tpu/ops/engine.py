@@ -152,6 +152,9 @@ class Engine:
         self._thread: Optional[threading.Thread] = None
         self._stall_thread: Optional[threading.Thread] = None
         self._running = False
+        # set when the dispatcher will never run again (stop() or stall
+        # shutdown); enqueues then fail fast instead of queuing forever
+        self._stopped = False
         # response-cache analog: signature -> hit count (jit owns the
         # executables; we track stats + LRU for observability/autotune).
         self.cache_stats: "OrderedDict[Tuple, int]" = OrderedDict()
@@ -179,10 +182,17 @@ class Engine:
             self.tuner = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
+                max_samples=cfg.autotune_bayes_opt_max_samples,
                 log_path=cfg.autotune_log,
-                # torus already forces the two-level path, so the knob
-                # would be behaviorally inert — freeze it
-                tune_two_level=not cfg.torus_allreduce)
+                gp_noise=cfg.autotune_gaussian_process_noise,
+                # torus already forces the two-level path (knob inert),
+                # and an explicit HOROVOD_HIERARCHICAL_ALLREDUCE setting
+                # (either value) must not be overwritten by sampled
+                # values — freeze in both cases (reference
+                # --no-hierarchical-allreduce contract)
+                tune_two_level=not (cfg.torus_allreduce or
+                                    cfg.hierarchical_allreduce or
+                                    cfg.hierarchical_allreduce_set))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -200,6 +210,8 @@ class Engine:
 
     def stop(self) -> None:
         self._running = False
+        with self._qlock:
+            self._stopped = True
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
@@ -347,6 +359,10 @@ class Engine:
         """Append validated works to the queue atomically."""
         tl = self._state.timeline
         with self._qlock:
+            if self._stopped:
+                # reference parity: EnqueueTensorAllreduces after shutdown
+                # returns SHUT_DOWN_ERROR (operations.cc:1436)
+                raise RuntimeError("Horovod has been shut down")
             for w in works:
                 if w.name in self._inflight_names:
                     raise DuplicateNameError(
@@ -405,6 +421,20 @@ class Engine:
                 self._run_cycle()
             except Exception:  # pragma: no cover - engine must survive
                 logger.exception("engine cycle failed")
+        # Loop exit without stop() (stall shutdown, stall_inspector.cc
+        # shutdown path): finalize still-queued work so callers get an
+        # error status instead of hanging (tensor_queue.h:35
+        # FinalizeTensorQueue). _stopped is set under the queue lock so
+        # no enqueue can slip in between the drain and the flag.
+        with self._qlock:
+            self._stopped = True
+            pending, self._queue = self._queue, []
+            for w in pending:
+                self._inflight_names.discard(w.name)
+                self._outstanding.pop(w.name, None)
+        for w in pending:
+            w.handle._resolve(None, Status.aborted(
+                "Horovod has been shut down"))
 
     def join(self) -> int:
         """Process-level join (hvd.join in multi-process mode). Blocks the
@@ -469,9 +499,13 @@ class Engine:
             if self.tuner.record(self.bytes_processed - bytes_before):
                 self.fusion_threshold = self.tuner.fusion_threshold_bytes
                 self.cycle_time_s = self.tuner.cycle_time_ms / 1000.0
-                # live config: collective_ops re-reads it on every call
-                self._state.config.hierarchical_allreduce = \
-                    self.tuner.two_level_allreduce
+                # live config: collective_ops re-reads it on every call.
+                # When the two-level knob is frozen (explicit env setting
+                # or torus), the configured value must stand — never
+                # write the tuner's placeholder back over it.
+                if self.tuner.tune_two_level:
+                    self._state.config.hierarchical_allreduce = \
+                        self.tuner.two_level_allreduce
 
     @staticmethod
     def _work_meta(w: _Work) -> dict:

@@ -324,8 +324,12 @@ def _prepare(q, k, v, scale, block_q, block_k):
     if H % KV:
         raise ValueError(f"q heads {H} must be a multiple of kv heads {KV}")
     scale_ = float(scale) if scale is not None else 1.0 / (D ** 0.5)
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Skv)
+    # clamp to the sequence, then round UP to a sublane multiple (8) so
+    # odd lengths (e.g. S=50 -> block 56) still satisfy TPU (8,128)
+    # tiling — the sequence pads up to the block and the kernels mask
+    # padded rows by real-position bounds
+    block_q = -(-min(block_q, Sq) // 8) * 8
+    block_k = -(-min(block_k, Skv) // 8) * 8
     pad_q = (-Sq) % block_q
     pad_k = (-Skv) % block_k
     qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q

@@ -45,6 +45,32 @@ _FLAG_ENV = {
     "stall_shutdown_time_seconds": ("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
                                     str),
     "log_level": ("HOROVOD_LOG_LEVEL", str),
+    "hierarchical_allgather": ("HOROVOD_HIERARCHICAL_ALLGATHER",
+                               lambda v: "1" if v else "0"),
+    "autotune_warmup_samples": ("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", str),
+    "autotune_steps_per_sample": ("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", str),
+    "autotune_bayes_opt_max_samples": (
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", str),
+    "autotune_gaussian_process_noise": (
+        "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", str),
+    "gloo_timeout_seconds": ("HOROVOD_GLOO_TIMEOUT_SECONDS", str),
+    "log_with_timestamp": ("HOROVOD_LOG_WITH_TIMESTAMP",
+                           lambda v: "1" if v else "0"),
+}
+
+# GPU/MPI-era reference flags with no TPU meaning: accepted for drop-in
+# command-line compatibility, warned about, and ignored
+# (reference: horovod/runner/launch.py:319-520 — NIC selection, MPI
+# passthrough, NCCL streams, thread affinity).
+_IGNORED_FLAGS = {
+    "nics": "NIC selection (--network-interface(s)) — TPU jobs have no "
+            "NIC ambiguity; ICI/DCN routing is platform-managed",
+    "mpi_args": "--mpi-args — no MPI runtime in the TPU launcher",
+    "tcp_flag": "--tcp — transport is ICI/DCN, not chosen per job",
+    "binding_args": "--binding-args — no MPI process binding on TPU",
+    "num_nccl_streams": "--num-nccl-streams — XLA owns device streams",
+    "thread_affinity": "--thread-affinity — XLA owns dispatch threads",
+    "mpi_threads_disable": "--mpi-threads-disable — no MPI runtime",
 }
 
 
@@ -52,45 +78,128 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p = argparse.ArgumentParser(
         prog="hvdrun",
         description="Launch a horovod_tpu job across hosts/slots.")
+    from .. import __version__
+    p.add_argument("-v", "--version", action="version",
+                   version=__version__,
+                   help="Shows the framework version.")
     p.add_argument("-np", "--num-proc", type=int, default=None,
                    help="Total number of worker processes.")
     p.add_argument("-H", "--hosts", default=None,
                    help="Comma-separated host:slots list, e.g. "
                         "'host1:1,host2:1'.")
-    p.add_argument("--hostfile", default=None,
-                   help="Hostfile with 'hostname slots=N' lines.")
+    p.add_argument("-hostfile", "--hostfile", default=None,
+                   help="Hostfile with 'hostname slots=N' lines "
+                        "(both -hostfile and --hostfile, like the "
+                        "reference).")
     p.add_argument("--config-file", default=None,
                    help="JSON file of flag values (merged under CLI).")
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
-    p.add_argument("--hierarchical-allreduce", action="store_true",
+    p.add_argument("--disable-cache", action="store_true", default=None,
+                   help="Disable the response cache "
+                        "(reference --disable-cache; sets cache "
+                        "capacity 0).")
+    # paired enable/disable flags, like the reference's
+    # make_override_true/false_action pairs (launch.py:373-415): an
+    # explicit --no-X exports X=0 so autotuning will not adjust it
+    p.add_argument("--hierarchical-allreduce",
+                   dest="hierarchical_allreduce", action="store_true",
                    default=None)
-    p.add_argument("--torus-allreduce", action="store_true", default=None)
-    p.add_argument("--autotune", action="store_true", default=None)
+    p.add_argument("--no-hierarchical-allreduce",
+                   dest="hierarchical_allreduce", action="store_false",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--hierarchical-allgather",
+                   dest="hierarchical_allgather", action="store_true",
+                   default=None)
+    p.add_argument("--no-hierarchical-allgather",
+                   dest="hierarchical_allgather", action="store_false",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--torus-allreduce", dest="torus_allreduce",
+                   action="store_true", default=None)
+    p.add_argument("--no-torus-allreduce", dest="torus_allreduce",
+                   action="store_false", help=argparse.SUPPRESS)
+    p.add_argument("--autotune", dest="autotune", action="store_true",
+                   default=None)
+    p.add_argument("--no-autotune", dest="autotune", action="store_false",
+                   help=argparse.SUPPRESS)
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                   default=None)
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   default=None)
     p.add_argument("--timeline-filename", default=None)
-    p.add_argument("--timeline-mark-cycles", action="store_true",
+    p.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
+                   action="store_true", default=None)
+    p.add_argument("--no-timeline-mark-cycles", dest="timeline_mark_cycles",
+                   action="store_false", help=argparse.SUPPRESS)
+    p.add_argument("--stall-check-disable", "--no-stall-check",
+                   dest="stall_check_disable", action="store_true",
                    default=None)
-    p.add_argument("--stall-check-disable", action="store_true",
+    p.add_argument("--stall-check", dest="stall_check_disable",
+                   action="store_false", help=argparse.SUPPRESS)
+    p.add_argument("--stall-check-time-seconds",
+                   "--stall-check-warning-time-seconds",
+                   dest="stall_check_time_seconds", type=float,
                    default=None)
-    p.add_argument("--stall-check-time-seconds", type=float, default=None)
-    p.add_argument("--stall-shutdown-time-seconds", type=float, default=None)
+    p.add_argument("--stall-shutdown-time-seconds",
+                   "--stall-check-shutdown-time-seconds",
+                   dest="stall_shutdown_time_seconds", type=float,
+                   default=None)
+    p.add_argument("--gloo-timeout-seconds", type=float, default=None,
+                   help="Native control-plane (store/coordinator) op "
+                        "timeout — the reference's Gloo timeout.")
     p.add_argument("--log-level", default=None,
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
-    p.add_argument("--min-np", type=int, default=None,
-                   help="Elastic: minimum workers.")
-    p.add_argument("--max-np", type=int, default=None,
-                   help="Elastic: maximum workers.")
+    p.add_argument("--log-with-timestamp", dest="log_with_timestamp",
+                   action="store_true", default=None)
+    p.add_argument("--no-log-with-timestamp", dest="log_with_timestamp",
+                   action="store_false", help=argparse.SUPPRESS)
+    p.add_argument("--min-np", "--min-num-proc", dest="min_np", type=int,
+                   default=None, help="Elastic: minimum workers.")
+    p.add_argument("--max-np", "--max-num-proc", dest="max_np", type=int,
+                   default=None, help="Elastic: maximum workers.")
+    p.add_argument("--elastic-timeout", type=float, default=None,
+                   help="Elastic: seconds to wait for min-np hosts after "
+                        "a re-scale before aborting (reference "
+                        "--elastic-timeout, default 600).")
+    p.add_argument("--blacklist-cooldown-range", type=float, nargs=2,
+                   default=None, metavar=("MIN", "MAX"),
+                   help="Elastic: seconds (min, max) a failing host stays "
+                        "blacklisted (reference "
+                        "--blacklist-cooldown-range).")
+    # GPU/MPI-era flags: accepted, warned, ignored (see _IGNORED_FLAGS)
+    p.add_argument("--network-interfaces", "--network-interface", "--nics",
+                   dest="nics", action="append", default=None,
+                   help="IGNORED on TPU (reference NIC selection).")
+    p.add_argument("--mpi-args", dest="mpi_args", default=None,
+                   help="IGNORED on TPU (reference MPI passthrough).")
+    p.add_argument("--tcp", dest="tcp_flag", action="store_true",
+                   default=None, help="IGNORED on TPU.")
+    p.add_argument("--binding-args", dest="binding_args", default=None,
+                   help="IGNORED on TPU.")
+    p.add_argument("--num-nccl-streams", dest="num_nccl_streams", type=int,
+                   default=None, help="IGNORED on TPU.")
+    p.add_argument("--thread-affinity", dest="thread_affinity", type=int,
+                   default=None, help="IGNORED on TPU.")
+    p.add_argument("--mpi-threads-disable", dest="mpi_threads_disable",
+                   action="store_true", default=None,
+                   help="IGNORED on TPU.")
+    p.add_argument("--no-mpi-threads-disable", dest="mpi_threads_disable",
+                   action="store_false", help=argparse.SUPPRESS)
     p.add_argument("--host-discovery-script", default=None,
                    help="Elastic: executable printing 'host:slots' lines.")
     p.add_argument("--reset-limit", type=int, default=None,
                    help="Elastic: max reset events before aborting "
                         "(reference --reset-limit).")
-    p.add_argument("--slots", type=int, default=None,
+    p.add_argument("--slots", "--slots-per-host", dest="slots", type=int,
+                   default=None,
                    help="Elastic: slots per discovered host without an "
-                        "explicit ':slots' (reference --slots).")
+                        "explicit ':slots' (reference --slots / "
+                        "--slots-per-host).")
     p.add_argument("-p", "--ssh-port", type=int, default=None,
                    help="SSH port for remote workers (reference -p).")
     p.add_argument("-i", "--ssh-identity-file", default=None,
@@ -137,6 +246,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             k = k.replace("-", "_")
             if getattr(args, k, None) is None:
                 setattr(args, k, v)
+    for attr, why in _IGNORED_FLAGS.items():
+        if getattr(args, attr, None) is not None:
+            print(f"hvdrun: warning: ignored on TPU: {why}",
+                  file=sys.stderr)
     return args
 
 
@@ -146,6 +259,9 @@ def env_from_args(args: argparse.Namespace) -> dict:
         v = getattr(args, attr, None)
         if v is not None:
             env[name] = conv(v)
+    if getattr(args, "disable_cache", None):
+        # reference --disable-cache: no response caching at all
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
     return env
 
 

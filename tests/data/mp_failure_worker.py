@@ -1,0 +1,88 @@
+"""Multi-process negotiation FAILURE modes (launched by
+test_multiprocess.py) — VERDICT r2 item 9.
+
+Two processes exercise the engine's error paths under a real
+cross-process mesh (not unit mocks):
+
+* mismatched metas: both enqueue the same tensor name with different
+  shapes -> every process's handle resolves with the reference's
+  ConstructResponse mismatch error, and the engine stays usable;
+* stall shutdown: rank 0 enqueues a tensor rank 1 never submits; the
+  stall inspector (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS) shuts the engine
+  down and the pending handle errors instead of hanging
+  (stall_inspector.cc shutdown + tensor_queue.h:35 finalization).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(2)
+
+# fast control-plane timeouts so the stall path runs in test time
+os.environ.setdefault("HOROVOD_GLOO_TIMEOUT_SECONDS", "2")
+os.environ.setdefault("HOROVOD_STALL_CHECK_TIME_SECONDS", "1")
+os.environ.setdefault("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "5")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main(out_dir: str) -> None:
+    hvd.init()
+    pid = jax.process_index()
+    result = {"pid": pid}
+
+    # --- mismatched metas: same name, different shapes -------------------
+    shape = (2, 3) if pid == 0 else (2, 4)
+    h = hvd.allreduce_async(np.ones(shape, np.float32), hvd.Sum,
+                            name="bad_t")
+    try:
+        hvd.synchronize(h)
+        result["mismatch"] = "NO ERROR RAISED"
+    except RuntimeError as e:
+        msg = str(e)
+        assert "Mismatched collective" in msg, msg
+        result["mismatch"] = "ok"
+
+    # engine must remain usable after the error (groups/queue intact)
+    good = hvd.local_rows(hvd.allreduce(
+        np.ones((2, 2), np.float32), hvd.Sum, name="good_t"))
+    np.testing.assert_allclose(good, 4.0)
+    result["post_error_allreduce"] = "ok"
+
+    # --- stall shutdown: rank 1 never submits 'lonely' -------------------
+    if pid == 0:
+        h = hvd.allreduce_async(np.ones((2, 2), np.float32), hvd.Sum,
+                                name="lonely")
+        t0 = time.monotonic()
+        try:
+            h.wait(timeout=60)
+            result["stall"] = "NO ERROR RAISED"
+        except (RuntimeError, TimeoutError) as e:
+            took = time.monotonic() - t0
+            assert took < 45, f"stall error too slow: {took}s"
+            result["stall"] = "ok"
+            result["stall_error"] = type(e).__name__
+        eng = hvd.core.basics.get_engine()
+        assert eng._running is False, "engine should be shut down"
+    else:
+        # do not submit; give rank 0 time to hit the shutdown threshold
+        time.sleep(12)
+        result["stall"] = "ok"
+
+    result["ok"] = True
+    with open(os.path.join(out_dir, f"result.{pid}.json"), "w") as f:
+        json.dump(result, f)
+    # engine is (intentionally) dead on rank 0 -> plain exit; shutdown()
+    # must still be safe to call
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -175,3 +175,19 @@ class TestGroupAtomicity:
                                            n * i * np.ones((n, 64)))
         finally:
             eng.fusion_threshold = old
+
+
+def test_enqueue_after_shutdown_raises(hvd):
+    """Reference parity: EnqueueTensorAllreduces after shutdown returns
+    SHUT_DOWN_ERROR (operations.cc:1436) — enqueues on a stopped engine
+    fail fast instead of queueing forever."""
+    import numpy as np
+    eng = hvd.core.basics.get_engine()
+    eng.stop()
+    try:
+        with pytest.raises(RuntimeError, match="shut down"):
+            hvd.allreduce_async(np.ones((hvd.size(), 2), np.float32),
+                                hvd.Sum, name="after_stop")
+    finally:
+        eng._stopped = False      # restore for the shared fixture
+        eng.start()

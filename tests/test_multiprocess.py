@@ -56,3 +56,14 @@ def test_hvdrun_np2_join_zero_fill(tmp_path):
     assert all(r["join_ret"] == 2 for r in results)
     r1 = next(r for r in results if r["pid"] == 1)
     assert r1["joined_allreduce"] == [[4.0] * 3] * 2
+
+
+def test_hvdrun_np2_negotiation_failure_modes(tmp_path):
+    """Mismatched-meta error + stall shutdown under a real 2-process mesh
+    (VERDICT r2 item 9; reference stall_inspector.cc +
+    ConstructResponse mismatch error)."""
+    results = _hvdrun_np2("mp_failure_worker.py", tmp_path)
+    for r in results:
+        assert r["mismatch"] == "ok", r
+        assert r["post_error_allreduce"] == "ok", r
+        assert r["stall"] == "ok", r

@@ -146,3 +146,67 @@ def test_coordinator_single_rank(server):
     coord.barrier("solo")
     assert coord.allgather(b"x", tag="solo-ag") == [b"x"]
     assert coord.broadcast(b"y", root=0, tag="solo-bc") == b"y"
+
+
+def test_coordinator_gather_scale_smoke():
+    """The OP_GATHER fast path (one RTT per allgather): 16 members, every
+    round returns all blobs rank-ordered, and retries after timeout reuse
+    the same sequence (idempotence the engine's retry loop depends on)."""
+    import threading
+    import time
+    from horovod_tpu.native.store import Coordinator, StoreServer
+    server = StoreServer()
+    P, R = 16, 20
+    try:
+        cs = [Coordinator("127.0.0.1", server.port, i, P, timeout=60)
+              for i in range(P)]
+        outs = [None] * P
+
+        def drive(i):
+            for r in range(R):
+                blobs = cs[i].allgather(f"r{r}.m{i}".encode(), tag="scale")
+                assert blobs == [f"r{r}.m{j}".encode() for j in range(P)]
+            outs[i] = True
+
+        ts = [threading.Thread(target=drive, args=(i,)) for i in range(P)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert all(outs), outs
+        assert time.monotonic() - t0 < 60
+        # timeout + retry idempotence: member 1 delays past member 0's
+        # first (timing-out) attempt; 0's retry joins the same round
+        def late():
+            time.sleep(2.5)
+            cs[1].allgather(b"late1", tag="retry")
+        th = threading.Thread(target=late)
+        th.start()
+        got = None
+        for _ in range(10):   # rank 0 retries with a 1s timeout
+            try:
+                saved = cs[0].timeout
+                cs[0].timeout = 1.0
+                got = cs[0].allgather(b"early0", tag="retry")
+                break
+            except Exception:
+                continue
+            finally:
+                cs[0].timeout = saved
+        th.join()
+        # drain the other members so the round completes for everyone
+        def fill(i):
+            cs[i].allgather(f"fill{i}".encode(), tag="retry")
+        fts = [threading.Thread(target=fill, args=(i,)) for i in range(2, P)]
+        for t in fts:
+            t.start()
+        for t in fts:
+            t.join(timeout=60)
+        if got is None:
+            got = cs[0].allgather(b"early0", tag="retry")
+        assert got[0] == b"early0" and got[1] == b"late1"
+        for c in cs:
+            c.close()
+    finally:
+        server.close()
