@@ -273,3 +273,37 @@ def test_elastic_ray_executor_reset_limit():
     with pytest.raises(RuntimeError, match="reset_limit"):
         ex.run(_elastic_fn, args=("t",))
     assert ex.resets == 3
+
+
+# -- real Ray mini-cluster (tier-2, gated on the optional dep) --------------
+# Reference: test/single/test_ray.py runs against ray.init(); here the
+# same executor runs on a real local Ray when installed (CI installs the
+# extra; the default image does not ship ray).
+
+import importlib.util
+
+_HAS_RAY = importlib.util.find_spec("ray") is not None
+
+
+@pytest.mark.skipif(not _HAS_RAY, reason="ray not installed (tier-2 extra)")
+def test_real_ray_executor_mini_cluster():
+    import ray
+    ray.init(num_cpus=2, include_dashboard=False, ignore_reinit_error=True)
+    try:
+        ex = RayExecutor(num_workers=2)      # default backend: real Ray
+        ex.start()
+
+        def fn():
+            return (int(os.environ["HOROVOD_RANK"]),
+                    int(os.environ["HOROVOD_SIZE"]),
+                    bool(os.environ.get("HOROVOD_NATIVE_KV_ADDR")))
+
+        out = sorted(ex.run(fn))
+        assert [o[:2] for o in out] == [(0, 2), (1, 2)], out
+        # the native KV control plane must have been pushed to the actors
+        assert all(o[2] for o in out), out
+        rank0 = ex.execute_single(lambda: int(os.environ["HOROVOD_RANK"]))
+        assert rank0 == 0
+        ex.shutdown()
+    finally:
+        ray.shutdown()

@@ -185,7 +185,12 @@ class TestTorchEstimator:
 
 class TestParquetDataPath:
     """Per-worker parquet reader (petastorm analog,
-    spark/common/store.py:38 + spark/data_loaders/)."""
+    spark/common/store.py:38 + spark/data_loaders/). Requires pyarrow
+    (optional dep of the parquet Store path)."""
+
+    @pytest.fixture(autouse=True)
+    def _needs_pyarrow(self):
+        pytest.importorskip("pyarrow")
 
     def test_shards_are_disjoint_and_cover(self, tmp_path):
         from horovod_tpu.spark.parquet import (ParquetShardReader,
@@ -258,3 +263,34 @@ class TestParquetDataPath:
         assert "val_loss" in est.history[-1]
         preds = model.predict(x[:4])
         assert preds.shape == (4, 4)
+
+
+# -- real local-mode Spark (tier-2, gated on the optional dep) --------------
+# Reference: test/integration/test_spark.py runs local-mode Spark; here
+# the same SparkJobRunner barrier-stage path runs when pyspark is
+# installed (CI installs the extra; the default image does not ship it).
+
+import importlib.util
+
+_HAS_PYSPARK = importlib.util.find_spec("pyspark") is not None
+
+
+@pytest.mark.skipif(not _HAS_PYSPARK,
+                    reason="pyspark not installed (tier-2 extra)")
+def test_real_spark_local_mode_run():
+    from pyspark.sql import SparkSession
+    spark = SparkSession.builder.master("local[2]") \
+        .appName("horovod_tpu-test").getOrCreate()
+    try:
+        from horovod_tpu.spark import SparkJobRunner, run
+
+        def fn():
+            import os
+            return (int(os.environ["HOROVOD_RANK"]),
+                    int(os.environ["HOROVOD_SIZE"]))
+
+        res = run(fn, num_proc=2,
+                  job_runner=SparkJobRunner(spark.sparkContext))
+        assert sorted(res) == [(0, 2), (1, 2)], res
+    finally:
+        spark.stop()
