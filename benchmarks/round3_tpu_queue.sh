@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# One-shot round-3 TPU hardware queue (VERDICT r2 items 1 + 4): run the
+# moment the axon tunnel recovers. Probes first; every stage appends its
+# JSON lines to benchmarks/round3_tpu_results.jsonl so a mid-run wedge
+# still leaves partial results on disk.
+#
+#   bash benchmarks/round3_tpu_queue.sh
+#
+# Stages: tunnel probe -> Mosaic validation of the post-wedge kernels
+# (GQA / flash-LSE / odd-seq block rounding / LSE merge / ResNet stem
+# sweep) -> bench.py (headline ResNet-50) -> GPT + Llama end-to-end.
+# Generous timeouts: killing a TPU process mid-RPC can wedge the tunnel.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/round3_tpu_results.jsonl
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+echo "{\"stage\": \"start\", \"t\": \"$(stamp)\"}" >> "$OUT"
+
+timeout 60 python -c "import jax; print(jax.devices())" || {
+  echo "{\"stage\": \"probe\", \"ok\": false, \"t\": \"$(stamp)\"}" >> "$OUT"
+  echo "tunnel down; aborting" >&2
+  exit 1
+}
+echo "{\"stage\": \"probe\", \"ok\": true, \"t\": \"$(stamp)\"}" >> "$OUT"
+
+echo "== tpu_validation ==" >&2
+timeout 1800 python benchmarks/tpu_validation.py | tee -a "$OUT"
+
+echo "== bench.py (conv7 stem) ==" >&2
+timeout 1200 python bench.py | tee -a "$OUT"
+
+echo "== gpt_bench gpt-small ==" >&2
+timeout 1800 python benchmarks/gpt_bench.py --family gpt --iters 20 \
+  | tee -a "$OUT"
+
+echo "== gpt_bench llama GQA ==" >&2
+timeout 1800 python benchmarks/gpt_bench.py --family llama --kv-heads 4 \
+  --iters 20 | tee -a "$OUT"
+
+echo "== gpt_bench llama long-seq (flash, dense single chip) ==" >&2
+timeout 1800 python benchmarks/gpt_bench.py --family llama --kv-heads 4 \
+  --seq 4096 --batch 2 --iters 10 | tee -a "$OUT"
+
+echo "{\"stage\": \"done\", \"t\": \"$(stamp)\"}" >> "$OUT"
+echo "queue complete; results in $OUT" >&2

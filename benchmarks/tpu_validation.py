@@ -154,7 +154,26 @@ def main():
             out[stem] = {"img_s": round(64 / st, 1), "timing": tag}
         return out
 
+    def odd_seq_compile():
+        # S=50 must Mosaic-compile now that clamped blocks round up to a
+        # sublane multiple (ops/pallas_attention._prepare); previously
+        # odd lengths only ran in interpret mode
+        qs = jnp.asarray(rng.randn(1, 4, 50, 64), jnp.bfloat16)
+        out = np.asarray(jax.jit(
+            lambda a: flash_attention(a, a, a, causal=True))(qs),
+            np.float32)
+        ref = np.asarray(attention_reference(qs, qs, qs, causal=True),
+                         np.float32)
+        err = float(np.abs(out - ref).max())
+        assert err < 0.05, err
+        g = jax.jit(jax.grad(lambda a: jnp.sum(
+            flash_attention(a, a, a, causal=True).astype(jnp.float32)
+            ** 2)))(qs)
+        assert np.isfinite(np.asarray(g, np.float32)).all()
+        return {"max_err": round(err, 4)}
+
     ok = True
+    ok &= check("odd_seq_block_rounding", odd_seq_compile)
     ok &= check("gqa_flash_fwd", gqa_fwd)
     ok &= check("gqa_flash_bwd", gqa_bwd)
     ok &= check("flash_lse_fwd_bwd", lse_fwd_bwd)

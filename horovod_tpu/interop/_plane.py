@@ -22,6 +22,7 @@ Sum = "sum"
 _comm = None
 _rank = 0
 _size = 1
+_inited = False
 
 
 def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
@@ -33,9 +34,10 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
     hybrid (native/store_comm.py), the reference's hierarchical Gloo
     scheme (gloo_operations.cc:33-53): reduce on-host over shm, exchange
     once per host over the native store, fan back out over shm."""
-    global _comm, _rank, _size
+    global _comm, _rank, _size, _inited
     _rank = int(os.environ.get("HOROVOD_RANK", "0"))
     _size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    _inited = True
     if _size > 1 and _comm is None:
         name = comm_name or \
             f"hvd_plane_{os.environ.get('HOROVOD_JOB_ID', default_job)}"
@@ -52,7 +54,8 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
 
 
 def shutdown() -> None:
-    global _comm
+    global _comm, _inited
+    _inited = False
     if _comm is not None:
         _comm.close()
         _comm = None
@@ -75,7 +78,11 @@ def local_size() -> int:
 
 
 def is_initialized() -> bool:
-    return _size == 1 or _comm is not None
+    """True only after init() ran this process. (An uninitialized plane
+    must NOT report ready just because the module defaults look like a
+    single-process job — under a launcher that silently skips the
+    multi-process connection, which is how replicas diverge.)"""
+    return _inited and (_size == 1 or _comm is not None)
 
 
 def comm():

@@ -11,4 +11,6 @@ from .runner import (                                          # noqa: F401
 from .store import FsspecStore, LocalStore, Store              # noqa: F401
 from .estimator import FlaxEstimator, FlaxModel                # noqa: F401
 from .torch_estimator import TorchEstimator, TorchModel        # noqa: F401
+from .lightning_estimator import (                             # noqa: F401
+    LightningEstimator, LightningModel)
 from .keras_estimator import KerasEstimator, KerasModel    # noqa: F401

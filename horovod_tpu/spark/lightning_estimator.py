@@ -1,0 +1,155 @@
+"""Lightning estimator: fit a LightningModule on array data via a Store.
+
+Re-design of the reference's spark/lightning/estimator.py
+(`TorchEstimator` for LightningModules, :31-120: Spark ML Estimator.fit
+-> materialize DataFrame to a Store -> train horovod-distributed through
+the module's lightning hooks -> checkpoint -> transformer).
+
+TPU-first difference: the reference drives a full `pytorch_lightning.
+Trainer` with a horovod strategy; here the estimator drives the
+*LightningModule protocol* directly — `configure_optimizers()`,
+`training_step(batch, batch_idx)`, optional `validation_step` and the
+epoch hooks — over the same Store + interop.torch data plane as
+TorchEstimator (shm within a host, native TCP store across hosts). Any
+real `pytorch_lightning.LightningModule` satisfies the protocol, so
+pytorch_lightning stays an optional dependency (gated import, like the
+reference's `import pytorch_lightning as pl` at estimator.py:31) and a
+duck-typed module works without it. The lockstep training loop itself is
+TorchEstimator's template (`_fit`); only the module-hook glue differs.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .torch_estimator import TorchEstimator, TorchModel
+
+
+class LightningModel(TorchModel):
+    """Trained-module transformer (reference spark/lightning/estimator.py
+    TorchModel): predict/transform plus Store checkpoint io."""
+
+
+def _first_optimizer(configured: Any):
+    """Normalize configure_optimizers() return shapes (lightning contract:
+    optimizer | [optimizers] | [{"optimizer": ...}] |
+    (optimizers, schedulers) |
+    {"optimizer": ..., "lr_scheduler": scheduler-or-config-dict}).
+
+    Returns (optimizer, schedulers) with schedulers normalized to
+    (scheduler, interval) pairs, interval in {"epoch", "step"}."""
+    raw_scheds: List[Any] = []
+    opt = configured
+    if isinstance(opt, (list, tuple)) and not (
+            len(opt) == 2 and isinstance(opt[0], (list, tuple))):
+        if len(opt) != 1:
+            raise ValueError(
+                "LightningEstimator supports exactly one optimizer; got "
+                f"{len(opt)} (reference lightning estimator has the same "
+                "single-optimizer restriction for horovod training)")
+        opt = opt[0]                       # [opt] or [{"optimizer": ...}]
+    if isinstance(opt, dict):
+        sched = opt.get("lr_scheduler")
+        if sched is not None:
+            raw_scheds = list(sched) if isinstance(sched, (list, tuple)) \
+                else [sched]
+        opt = opt["optimizer"]
+    elif isinstance(opt, (tuple, list)):   # (optimizers, schedulers)
+        raw_scheds = list(opt[1])
+        opts = list(opt[0])
+        if len(opts) != 1:
+            raise ValueError(
+                "LightningEstimator supports exactly one optimizer; got "
+                f"{len(opts)}")
+        opt = opts[0]
+    # lightning allows scheduler CONFIG dicts ({"scheduler": s,
+    # "interval": "epoch"|"step", ...}); keep the interval
+    schedulers = []
+    for s in raw_scheds:
+        interval = "epoch"
+        if isinstance(s, dict):
+            interval = s.get("interval", "epoch")
+            s = s.get("scheduler")
+        if s is not None:
+            schedulers.append((s, interval))
+    return opt, schedulers
+
+
+class LightningEstimator(TorchEstimator):
+    """`fit(x, y) -> LightningModel` driving the LightningModule hooks.
+
+    The module must provide `configure_optimizers()` and
+    `training_step(batch, batch_idx) -> loss` (scalar tensor or
+    `{'loss': ...}`); `validation_step(batch, batch_idx)` and
+    `on_train_epoch_start/end` are honored when present. Distributed
+    under `hvdrun -np N` exactly like TorchEstimator.
+    """
+
+    def __init__(self, model: Any, *,
+                 epochs: int = 1, batch_size: int = 32,
+                 store=None, run_id: Optional[str] = None,
+                 validation: float = 0.0, shuffle: bool = True,
+                 seed: int = 0,
+                 callbacks: Optional[List[Any]] = None) -> None:
+        for hook in ("configure_optimizers", "training_step"):
+            if not callable(getattr(model, hook, None)):
+                raise TypeError(
+                    f"model must implement the LightningModule protocol; "
+                    f"missing {hook}() (pytorch_lightning.LightningModule "
+                    f"or any duck-typed torch module works)")
+        super().__init__(model, optimizer=None, loss=None, epochs=epochs,
+                         batch_size=batch_size, store=store, run_id=run_id,
+                         validation=validation, shuffle=shuffle, seed=seed,
+                         callbacks=callbacks)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> LightningModel:
+        return self._fit(x, y, LightningModel)
+
+    # -- template hooks ------------------------------------------------------
+
+    def _configure_optimizer(self, hvd_torch, ys):
+        optimizer, schedulers = _first_optimizer(
+            self.model.configure_optimizers())
+        hvd_torch.broadcast_optimizer_state(optimizer, 0)
+        return hvd_torch.DistributedOptimizer(
+            optimizer,
+            named_parameters=self.model.named_parameters()), schedulers
+
+    def _train_batch(self, batch, batch_idx: int):
+        loss = self.model.training_step(batch, batch_idx)
+        if isinstance(loss, dict):          # lightning allows {'loss': ...}
+            loss = loss["loss"]
+        return loss
+
+    def _on_epoch_start(self) -> None:
+        hook = getattr(self.model, "on_train_epoch_start", None)
+        if callable(hook):
+            hook()
+
+    def _on_epoch_end(self) -> None:
+        hook = getattr(self.model, "on_train_epoch_end", None)
+        if callable(hook):
+            hook()
+
+    def _validate(self, val_path: str) -> float:
+        import torch
+        data = pickle.loads(self.store.read(val_path))
+        batch = (torch.as_tensor(data["x"]), torch.as_tensor(data["y"]))
+        self.model.eval()
+        with torch.no_grad():
+            out = None
+            vs = getattr(self.model, "validation_step", None)
+            if callable(vs):
+                out = vs(batch, 0)
+                if isinstance(out, dict):
+                    out = out.get("val_loss", out.get("loss"))
+            if out is None:
+                # no validation_step, or the pl.LightningModule base stub
+                # (which returns None): fall back to the training loss
+                out = self.model.training_step(batch, 0)
+                if isinstance(out, dict):
+                    out = out["loss"]
+        self.model.train()
+        return float(out)

@@ -117,7 +117,15 @@ class TorchEstimator:
 
     def fit(self, x: np.ndarray, y: np.ndarray) -> TorchModel:
         """Materialize data to the Store, train (distributed under
-        hvdrun via the shm data plane), checkpoint, return transformer."""
+        hvdrun via the CPU data plane), checkpoint, return transformer."""
+        return self._fit(x, y, TorchModel)
+
+    # -- template skeleton shared with LightningEstimator ------------------
+    # The loop below is lockstep-critical (every rank must run the same
+    # number of opt.step() calls or the CPU-plane allreduces pair across
+    # epochs / deadlock), so subclasses override only the marked hooks.
+
+    def _fit(self, x: np.ndarray, y: np.ndarray, model_cls) -> TorchModel:
         import torch
 
         from ..interop import torch as hvd_torch
@@ -135,19 +143,15 @@ class TorchEstimator:
         # rank 0's weights win, like broadcast_parameters at train start
         # (reference _torch remote trainer broadcasts model state)
         hvd_torch.broadcast_parameters(self.model.state_dict(), 0)
-        hvd_torch.broadcast_optimizer_state(self.optimizer, 0)
-        opt = hvd_torch.DistributedOptimizer(
-            self.optimizer,
-            named_parameters=self.model.named_parameters())
-        loss_fn = self.loss or self._default_loss(ys)
+        opt, schedulers = self._configure_optimizer(hvd_torch, ys)
 
         # shard rows across ranks (reference: petastorm reader per rank)
         shard_x, shard_y = xs[rank::size], ys[rank::size]
         n_local = len(shard_x)
         per_rank_bs = max(self.batch_size // size, 1)
         # every rank MUST run the same number of opt.step() calls or the
-        # shm allreduces pair across epochs / deadlock — derive the step
-        # count from the guaranteed-minimum shard size, not the local one
+        # CPU-plane allreduces pair across epochs / deadlock — derive the
+        # step count from the guaranteed-minimum shard size, not local
         n_local_min = len(xs) // size
         steps = max(n_local_min // per_rank_bs, 1)
         rng = np.random.RandomState(self.seed + 1 + rank)
@@ -157,6 +161,7 @@ class TorchEstimator:
                 cb.on_train_begin()
         self.model.train()
         for epoch in range(self.epochs):
+            self._on_epoch_start()
             order = rng.permutation(n_local) if self.shuffle \
                 else np.arange(n_local)
             epoch_loss = 0.0
@@ -164,34 +169,63 @@ class TorchEstimator:
                 idx = order[s * per_rank_bs:(s + 1) * per_rank_bs]
                 if len(idx) == 0:
                     break
-                xb = torch.as_tensor(shard_x[idx])
-                yb = torch.as_tensor(shard_y[idx])
+                batch = (torch.as_tensor(shard_x[idx]),
+                         torch.as_tensor(shard_y[idx]))
                 opt.zero_grad()
-                loss = loss_fn(self.model(xb), yb)
+                loss = self._train_batch(batch, s)
                 loss.backward()
                 opt.step()    # averages gradients across ranks first
                 epoch_loss += float(loss.detach())
+                for sched, interval in schedulers:
+                    if interval == "step":
+                        sched.step()
+            for sched, interval in schedulers:
+                if interval != "step":
+                    sched.step()
             logs = {"loss": epoch_loss / max(steps, 1), "epoch": epoch}
             if val_path is not None:
-                logs["val_loss"] = self._evaluate(val_path, loss_fn)
+                logs["val_loss"] = self._validate(val_path)
             self.history.append(logs)
+            self._on_epoch_end()
             for cb in self.callbacks:
                 if hasattr(cb, "on_epoch_end"):
                     cb.on_epoch_end(epoch, logs)
 
-        tm = TorchModel(self.model)
+        tm = model_cls(self.model)
         if rank == 0:
             tm.save(self.store, self.run_id)
         if size > 1:
             hvd_torch.barrier()
         return tm
 
-    def _evaluate(self, val_path: str, loss_fn: Callable) -> float:
+    # -- hooks (overridden by LightningEstimator) ---------------------------
+
+    def _configure_optimizer(self, hvd_torch, ys):
+        """Wrap the optimizer for distributed training; returns
+        (optimizer, schedulers) with schedulers as (scheduler, interval)
+        pairs, interval in {"epoch", "step"}."""
+        hvd_torch.broadcast_optimizer_state(self.optimizer, 0)
+        self._loss_fn = self.loss or self._default_loss(ys)
+        return hvd_torch.DistributedOptimizer(
+            self.optimizer,
+            named_parameters=self.model.named_parameters()), []
+
+    def _train_batch(self, batch, batch_idx: int):
+        xb, yb = batch
+        return self._loss_fn(self.model(xb), yb)
+
+    def _on_epoch_start(self) -> None:
+        pass
+
+    def _on_epoch_end(self) -> None:
+        pass
+
+    def _validate(self, val_path: str) -> float:
         import torch
         data = pickle.loads(self.store.read(val_path))
         self.model.eval()
         with torch.no_grad():
             out = self.model(torch.as_tensor(data["x"]))
-            val = float(loss_fn(out, torch.as_tensor(data["y"])))
+            val = float(self._loss_fn(out, torch.as_tensor(data["y"])))
         self.model.train()
         return val

@@ -273,6 +273,7 @@ class TestParquetDataPath:
 import importlib.util
 
 _HAS_PYSPARK = importlib.util.find_spec("pyspark") is not None
+_HAS_PL = importlib.util.find_spec("pytorch_lightning") is not None
 
 
 @pytest.mark.skipif(not _HAS_PYSPARK,
@@ -294,3 +295,159 @@ def test_real_spark_local_mode_run():
         assert sorted(res) == [(0, 2), (1, 2)], res
     finally:
         spark.stop()
+
+
+class TestLightningEstimator:
+    """Lightning estimator (reference spark/lightning/estimator.py):
+    drives the LightningModule protocol — configure_optimizers /
+    training_step / validation_step / epoch hooks — over the same Store
+    plane. A duck-typed module exercises the protocol without
+    pytorch_lightning; the gated test runs a real LightningModule."""
+
+    @staticmethod
+    def _duck_module():
+        import torch
+
+        class Duck(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.net = torch.nn.Linear(3, 2)
+                self.epoch_starts = 0
+                self.epoch_ends = 0
+
+            def forward(self, x):
+                return self.net(x)
+
+            def configure_optimizers(self):
+                return torch.optim.SGD(self.parameters(), lr=0.1)
+
+            def training_step(self, batch, batch_idx):
+                x, y = batch
+                return torch.nn.functional.mse_loss(self.net(x), y)
+
+            def validation_step(self, batch, batch_idx):
+                x, y = batch
+                return torch.nn.functional.mse_loss(self.net(x), y)
+
+            def on_train_epoch_start(self):
+                self.epoch_starts += 1
+
+            def on_train_epoch_end(self):
+                self.epoch_ends += 1
+
+        return Duck()
+
+    def test_duck_typed_protocol_trains(self, tmp_path):
+        pytest.importorskip("torch")
+        from horovod_tpu.spark import LightningEstimator, LocalStore
+        rng = np.random.RandomState(0)
+        x = rng.rand(64, 3).astype(np.float32)
+        w = rng.rand(3, 2).astype(np.float32)
+        y = x @ w
+        model = self._duck_module()
+        est = LightningEstimator(model, epochs=4, batch_size=16,
+                                 store=LocalStore(str(tmp_path)),
+                                 validation=0.25, seed=3)
+        tm = est.fit(x, y)
+        assert est.history[-1]["loss"] < est.history[0]["loss"]
+        assert "val_loss" in est.history[-1]
+        assert model.epoch_starts == 4 and model.epoch_ends == 4
+        pred = tm.transform(x[:5])
+        assert pred.shape == (5, 2)
+
+    def test_optimizer_shapes_normalized(self):
+        pytest.importorskip("torch")
+        import torch
+
+        from horovod_tpu.spark.lightning_estimator import _first_optimizer
+        m = torch.nn.Linear(2, 2)
+        o = torch.optim.SGD(m.parameters(), lr=0.1)
+        s = torch.optim.lr_scheduler.StepLR(o, step_size=1)
+        assert _first_optimizer(o)[0] is o
+        assert _first_optimizer([o])[0] is o
+        opt, scheds = _first_optimizer(([o], [s]))
+        assert opt is o and scheds == [(s, "epoch")]
+        opt, _ = _first_optimizer({"optimizer": o})
+        assert opt is o
+        # list-of-dicts shape ([{"optimizer": ...}]) unwraps too
+        opt, _ = _first_optimizer([{"optimizer": o}])
+        assert opt is o
+        # canonical lightning dict forms: bare scheduler + config dict
+        # (the config dict's interval is honored: "step" steps per batch)
+        opt, scheds = _first_optimizer({"optimizer": o, "lr_scheduler": s})
+        assert opt is o and scheds == [(s, "epoch")]
+        opt, scheds = _first_optimizer(
+            {"optimizer": o,
+             "lr_scheduler": {"scheduler": s, "interval": "step"}})
+        assert opt is o and scheds == [(s, "step")]
+        with pytest.raises(ValueError, match="exactly one"):
+            _first_optimizer([o, torch.optim.SGD(m.parameters(), lr=0.1)])
+
+    def test_requires_protocol(self):
+        pytest.importorskip("torch")
+        import torch
+
+        from horovod_tpu.spark import LightningEstimator
+        with pytest.raises(TypeError, match="configure_optimizers"):
+            LightningEstimator(torch.nn.Linear(2, 2))
+
+    @pytest.mark.skipif(not _HAS_PL,
+                        reason="pytorch_lightning not installed "
+                               "(tier-2 extra)")
+    def test_real_lightning_module(self, tmp_path):
+        import pytorch_lightning as pl
+        import torch
+
+        from horovod_tpu.spark import LightningEstimator, LocalStore
+
+        class Lit(pl.LightningModule):
+            def __init__(self):
+                super().__init__()
+                self.net = torch.nn.Linear(3, 2)
+
+            def training_step(self, batch, batch_idx):
+                x, y = batch
+                return torch.nn.functional.mse_loss(self.net(x), y)
+
+            def configure_optimizers(self):
+                return torch.optim.SGD(self.parameters(), lr=0.1)
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 3).astype(np.float32)
+        y = (x @ rng.rand(3, 2)).astype(np.float32)
+        est = LightningEstimator(Lit(), epochs=3, batch_size=8,
+                                 store=LocalStore(str(tmp_path)))
+        est.fit(x, y)
+        assert est.history[-1]["loss"] < est.history[0]["loss"]
+
+
+def test_lightning_validation_fallback_without_validation_step(tmp_path):
+    """validation>0 with no validation_step (or a base-class stub that
+    returns None, like pl.LightningModule's): falls back to the training
+    loss instead of crashing on float(None)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import LightningEstimator, LocalStore
+
+    class NoVal(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.net = torch.nn.Linear(2, 1)
+
+        def configure_optimizers(self):
+            return torch.optim.SGD(self.parameters(), lr=0.05)
+
+        def training_step(self, batch, i):
+            x, y = batch
+            return torch.nn.functional.mse_loss(self.net(x), y)
+
+        def validation_step(self, batch, i):   # pl base-stub behavior
+            return None
+
+    rng = np.random.RandomState(2)
+    x = rng.rand(32, 2).astype(np.float32)
+    y = (x @ rng.rand(2, 1)).astype(np.float32)
+    est = LightningEstimator(NoVal(), epochs=2, batch_size=8,
+                             store=LocalStore(str(tmp_path)),
+                             validation=0.25)
+    est.fit(x, y)
+    assert np.isfinite(est.history[-1]["val_loss"])
