@@ -30,6 +30,11 @@ timeout 1800 python benchmarks/tpu_validation.py | tee -a "$OUT"
 echo "== bench.py (conv7 stem) ==" >&2
 timeout 1200 python bench.py | tee -a "$OUT"
 
+echo "== bench.py reference trio (resnet101 / vgg16 / inception3) ==" >&2
+for m in resnet101 vgg16 inception3; do
+  HVD_BENCH_MODEL=$m timeout 1200 python bench.py | tee -a "$OUT"
+done
+
 echo "== gpt_bench gpt-small ==" >&2
 timeout 1800 python benchmarks/gpt_bench.py --family gpt --iters 20 \
   | tee -a "$OUT"

@@ -1,7 +1,10 @@
-"""Model families: image classifiers (ResNet, ViT) and language models
-(GPT dense, MoE expert-parallel). All flax/linen, float32 params with
-bfloat16 compute, built for dp/tp/sp/ep meshes."""
+"""Model families: image classifiers (ResNet, VGG, Inception V3, ViT) —
+the reference's headline benchmark trio plus ViT — and language models
+(GPT dense, MoE expert-parallel, Llama). All flax/linen, float32 params
+with bfloat16 compute, built for dp/tp/sp/ep meshes."""
 from .resnet import ResNet18, ResNet50          # noqa: F401
+from .vgg import VGG, VGG16, VGG19              # noqa: F401
+from .inception import InceptionV3              # noqa: F401
 from .gpt import GPT, GPTConfig                 # noqa: F401
 from .vit import (                              # noqa: F401
     ViT, ViTConfig, ViT_S, ViT_B, ViT_Tiny, vit_partition_rules,

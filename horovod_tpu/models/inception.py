@@ -1,0 +1,179 @@
+"""Inception V3 in flax, TPU-first.
+
+One of the reference's three headline scaling-benchmark models
+(docs/benchmarks.rst:8-13: Inception V3 at ~90% scaling efficiency on
+512 GPUs). Fresh NHWC implementation of the Szegedy et al. 2015 V3
+topology — factorized 7x7 branches, grid reductions, BN on every conv —
+bfloat16 compute with float32 params/batch-stats. The branch concats are
+channel-major so XLA fuses each branch's convs and tiles them onto the
+MXU independently.
+
+The auxiliary logits head (training-regularization in the original) is
+omitted: the reference benchmark path (tf_cnn_benchmarks inception3)
+likewise trains the main head only. Minimum input 75x75 (three stride-2
+reductions in the stem + two grid reductions).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """conv -> BN -> relu, the V3 building unit (all convs carry BN)."""
+
+    filters: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.filters, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    """35x35 block: 1x1 / 5x5 / double-3x3 / pool-proj branches."""
+
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64, (1, 1))(x, train)
+        b5 = cbn(48, (1, 1))(x, train)
+        b5 = cbn(64, (5, 5))(b5, train)
+        b3 = cbn(64, (1, 1))(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(self.pool_features, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """35x35 -> 17x17 grid reduction."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        bd = cbn(64, (1, 1))(x, train)
+        bd = cbn(96, (3, 3))(bd, train)
+        bd = cbn(96, (3, 3), (2, 2), padding="VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 block with factorized 7x7 (1x7 + 7x1) branches."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = cbn(192, (1, 1))(x, train)
+        b7 = cbn(c7, (1, 1))(x, train)
+        b7 = cbn(c7, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        bd = cbn(c7, (1, 1))(x, train)
+        bd = cbn(c7, (7, 1))(bd, train)
+        bd = cbn(c7, (1, 7))(bd, train)
+        bd = cbn(c7, (7, 1))(bd, train)
+        bd = cbn(192, (1, 7))(bd, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(192, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """17x17 -> 8x8 grid reduction."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(192, (1, 1))(x, train)
+        b3 = cbn(320, (3, 3), (2, 2), padding="VALID")(b3, train)
+        b7 = cbn(192, (1, 1))(x, train)
+        b7 = cbn(192, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        b7 = cbn(192, (3, 3), (2, 2), padding="VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 block with split 3x3 (1x3 | 3x1) branches."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (1, 1))(x, train)
+        b3 = cbn(384, (1, 1))(x, train)
+        b3 = jnp.concatenate([cbn(384, (1, 3))(b3, train),
+                              cbn(384, (3, 1))(b3, train)], axis=-1)
+        bd = cbn(448, (1, 1))(x, train)
+        bd = cbn(384, (3, 3))(bd, train)
+        bd = jnp.concatenate([cbn(384, (1, 3))(bd, train),
+                              cbn(384, (3, 1))(bd, train)], axis=-1)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(192, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299 -> 35 (three stride-2 steps)
+        x = cbn(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 35x35
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        # 17x17
+        x = InceptionC(128, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(192, dtype=self.dtype)(x, train)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        # 8x8
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,66 @@
+"""VGG in flax, TPU-first.
+
+One of the reference's three headline scaling-benchmark models
+(docs/benchmarks.rst:13: VGG-16 at ~68% scaling efficiency on 512 GPUs —
+the hardest of the trio because its ~138M dense parameters stress the
+allreduce). NHWC, bfloat16 compute with float32 params; the three big FC
+matmuls (25088x4096, 4096x4096, 4096xC) are exactly MXU-shaped.
+
+`classifier="flatten"` is the classic 224x224 head (tf_cnn_benchmarks
+layout); `classifier="avg"` global-average-pools first so any input size
+works (used by the size-reduced tests).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# convs per stage (each stage ends in a 2x2 maxpool)
+_VGG16_STAGES = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+_VGG19_STAGES = ((64, 2), (128, 2), (256, 4), (512, 4), (512, 4))
+
+
+class VGG(nn.Module):
+    stages: Sequence = _VGG16_STAGES
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    #: BN variant (torchvision vgg16_bn); the reference benchmark model
+    #: is the plain one
+    batch_norm: bool = False
+    classifier: str = "flatten"
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for filters, reps in self.stages:
+            for _ in range(reps):
+                x = conv(filters)(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=self.dtype,
+                                     param_dtype=jnp.float32)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        if self.classifier == "avg":
+            x = jnp.mean(x, axis=(1, 2))
+        else:
+            x = x.reshape((x.shape[0], -1))
+        for _ in range(2):
+            x = nn.Dense(4096, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = partial(VGG, stages=_VGG16_STAGES)
+VGG19 = partial(VGG, stages=_VGG19_STAGES)

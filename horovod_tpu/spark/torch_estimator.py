@@ -179,12 +179,12 @@ class TorchEstimator:
                 for sched, interval in schedulers:
                     if interval == "step":
                         sched.step()
-            for sched, interval in schedulers:
-                if interval != "step":
-                    sched.step()
             logs = {"loss": epoch_loss / max(steps, 1), "epoch": epoch}
             if val_path is not None:
                 logs["val_loss"] = self._validate(val_path)
+            for sched, interval in schedulers:
+                if interval != "step":
+                    self._step_epoch_scheduler(sched, logs)
             self.history.append(logs)
             self._on_epoch_end()
             for cb in self.callbacks:
@@ -197,6 +197,17 @@ class TorchEstimator:
         if size > 1:
             hvd_torch.barrier()
         return tm
+
+    @staticmethod
+    def _step_epoch_scheduler(sched, logs: Dict[str, float]) -> None:
+        """ReduceLROnPlateau needs the monitored metric; every other
+        scheduler steps bare (the lightning Trainer does the same
+        monitor plumbing for plateau schedulers)."""
+        import torch
+        if isinstance(sched, torch.optim.lr_scheduler.ReduceLROnPlateau):
+            sched.step(logs.get("val_loss", logs["loss"]))
+        else:
+            sched.step()
 
     # -- hooks (overridden by LightningEstimator) ---------------------------
 

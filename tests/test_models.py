@@ -261,3 +261,69 @@ class TestTopKRouting:
             p, o, loss = step(p, o, toks, tg)
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestVGGAndInception:
+    """The rest of the reference's headline scaling-benchmark trio
+    (docs/benchmarks.rst:8-13: Inception V3 / ResNet-101 / VGG-16)."""
+
+    def test_vgg16_forward_and_train_step(self, hvd):
+        import optax
+        from horovod_tpu.models.vgg import VGG16
+        from horovod_tpu.training import (init_replicated, make_train_step,
+                                          shard_batch)
+        mesh = hvd.core.basics.get_mesh()
+        # avg-pool head so the size-reduced test input works; flatten is
+        # the canonical 224x224 benchmark head
+        model = VGG16(num_classes=10, classifier="avg", dtype=jnp.float32)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, 32, 32, 3), jnp.float32), train=False)
+        out = model.apply(variables, jnp.ones((2, 32, 32, 3)), train=False)
+        assert out.shape == (2, 10)
+        assert np.isfinite(np.asarray(out)).all()
+        params = init_replicated(variables["params"], mesh)
+        step = make_train_step(
+            lambda v, x: model.apply(v, x, train=False), optax.sgd(0.01),
+            mesh)
+        opt = init_replicated(step.init_opt_state(params), mesh)
+        rng = np.random.RandomState(0)
+        imgs = shard_batch(rng.rand(8, 32, 32, 3).astype(np.float32), mesh)
+        lbls = shard_batch(rng.randint(0, 10, (8,)).astype(np.int32), mesh)
+        _, _, _, loss = step(params, opt, {}, imgs, lbls)
+        assert np.isfinite(float(loss))
+
+    def test_vgg16_flatten_head_param_shapes(self):
+        # classic head: first FC is 7*7*512 x 4096 at 224 input
+        from horovod_tpu.models.vgg import VGG16
+        model = VGG16(num_classes=1000, dtype=jnp.float32)
+        variables = jax.eval_shape(
+            lambda: model.init({"params": jax.random.PRNGKey(0)},
+                               jnp.zeros((1, 224, 224, 3), jnp.float32),
+                               train=False))
+        dense0 = variables["params"]["Dense_0"]["kernel"]
+        assert dense0.shape == (7 * 7 * 512, 4096), dense0.shape
+
+    def test_inception_v3_forward(self):
+        from horovod_tpu.models.inception import InceptionV3
+        model = InceptionV3(num_classes=13, dtype=jnp.float32)
+        variables = model.init({"params": jax.random.PRNGKey(0)},
+                               jnp.zeros((1, 96, 96, 3), jnp.float32),
+                               train=False)
+        out = model.apply(variables, jnp.ones((2, 96, 96, 3)), train=False)
+        assert out.shape == (2, 13)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_inception_v3_grid_sizes(self):
+        # 299 input must reach the canonical 8x8 grid before pooling
+        # (three stem reductions + two grid reductions); check via shape
+        # inference only — no FLOPs
+        from horovod_tpu.models.inception import InceptionV3
+        model = InceptionV3(num_classes=5, dtype=jnp.float32)
+        var_shapes = jax.eval_shape(
+            lambda: model.init({"params": jax.random.PRNGKey(0)},
+                               jnp.zeros((1, 299, 299, 3), jnp.float32),
+                               train=False))
+        # final 1x1 projection in the last InceptionE sees the 2048-ch mix
+        last_e = var_shapes["params"]["InceptionE_1"]
+        assert last_e["ConvBN_0"]["Conv_0"]["kernel"].shape[-2] == 2048

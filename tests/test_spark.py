@@ -451,3 +451,37 @@ def test_lightning_validation_fallback_without_validation_step(tmp_path):
                              validation=0.25)
     est.fit(x, y)
     assert np.isfinite(est.history[-1]["val_loss"])
+
+
+def test_lightning_plateau_scheduler_steps_with_metric(tmp_path):
+    """ReduceLROnPlateau in the lightning config dict gets the monitored
+    metric at epoch end instead of crashing on a bare step()."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import LightningEstimator, LocalStore
+
+    class Plat(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.net = torch.nn.Linear(2, 1)
+
+        def configure_optimizers(self):
+            o = torch.optim.SGD(self.parameters(), lr=0.05)
+            return {"optimizer": o,
+                    "lr_scheduler": {
+                        "scheduler":
+                            torch.optim.lr_scheduler.ReduceLROnPlateau(
+                                o, patience=0, factor=0.5),
+                        "monitor": "val_loss"}}
+
+        def training_step(self, batch, i):
+            x, y = batch
+            return torch.nn.functional.mse_loss(self.net(x), y)
+
+    rng = np.random.RandomState(4)
+    x = rng.rand(32, 2).astype(np.float32)
+    y = (x @ rng.rand(2, 1)).astype(np.float32)
+    est = LightningEstimator(Plat(), epochs=3, batch_size=8,
+                             store=LocalStore(str(tmp_path)),
+                             validation=0.25)
+    est.fit(x, y)          # must not raise; plateau stepped with val_loss
+    assert len(est.history) == 3
