@@ -34,6 +34,17 @@ def main() -> None:
               f"(dominated by the largest rank)")
         print(f"Adasum  result norm: {np.linalg.norm(ada):.2f} "
               f"(scale-adaptive combination)")
+
+    # Two-level variant (AdasumGpuAllreduceOp analog): sum within each
+    # "host" group, Adasum across groups — here on a simulated 2x(n/2)
+    # topology via the local_size override.
+    from horovod_tpu.ops.adasum import adasum_allreduce
+    if n % 2 == 0 and n >= 4:
+        hier = np.asarray(adasum_allreduce(grads, hierarchical=True,
+                                           local_size=n // 2))[0]
+        if hvd.rank() == 0:
+            print(f"Hierarchical Adasum (2 groups x {n // 2}) norm: "
+                  f"{np.linalg.norm(hier):.2f}")
     hvd.shutdown()
 
 

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Spark LightningEstimator (reference: horovod.spark.lightning
+TorchEstimator): fit a LightningModule-protocol model on array data with
+Store-backed materialization and checkpoints. Works with a plain torch
+module implementing the protocol — pytorch_lightning itself is optional.
+
+    python examples/lightning_estimator.py
+Under a launcher the training is data-parallel over the CPU plane:
+    hvdrun -np 2 python examples/lightning_estimator.py
+"""
+import tempfile
+
+import numpy as np
+import torch
+
+from horovod_tpu.spark import LightningEstimator, LocalStore
+
+
+class LitRegressor(torch.nn.Module):
+    """Duck-typed LightningModule: configure_optimizers + training_step
+    (+ optional validation_step / epoch hooks)."""
+
+    def __init__(self):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(8, 32), torch.nn.ReLU(), torch.nn.Linear(32, 1))
+
+    def forward(self, x):
+        return self.net(x)
+
+    def configure_optimizers(self):
+        opt = torch.optim.Adam(self.parameters(), lr=1e-2)
+        return {"optimizer": opt,
+                "lr_scheduler": {
+                    "scheduler": torch.optim.lr_scheduler.StepLR(
+                        opt, step_size=2, gamma=0.5),
+                    "interval": "epoch"}}
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self.net(x), y)
+
+    def validation_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self.net(x), y)
+
+
+def main() -> None:
+    import os
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    rng = np.random.RandomState(0)
+    x = rng.rand(512, 8).astype(np.float32)
+    w = rng.rand(8, 1).astype(np.float32)
+    y = (x @ w + 0.01 * rng.randn(512, 1)).astype(np.float32)
+
+    with tempfile.TemporaryDirectory() as d:
+        store = LocalStore(d)
+        est = LightningEstimator(LitRegressor(), epochs=5, batch_size=64,
+                                 store=store, run_id="lit",
+                                 validation=0.2)
+        model = est.fit(x, y)
+        preds = model.predict(x[:4])
+        if rank == 0:
+            print(f"lightning history: "
+                  f"{[round(h['loss'], 4) for h in est.history]}")
+            print(f"lightning val_loss: {est.history[-1]['val_loss']:.4f}")
+            print(f"lightning preds shape: {preds.shape}")
+
+
+if __name__ == "__main__":
+    main()

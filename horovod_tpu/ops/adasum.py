@@ -122,14 +122,17 @@ def _adasum_hier_fn(mesh: Mesh):
 
 def adasum_allreduce(x: jax.Array, *,
                      process_set: Optional[ProcessSet] = None,
-                     hierarchical: Optional[bool] = None) -> jax.Array:
+                     hierarchical: Optional[bool] = None,
+                     local_size: Optional[int] = None) -> jax.Array:
     """Adasum reduction over the stacked rank axis; all ranks get the result.
 
     Matches hvd.allreduce(op=hvd.Adasum). Requires a power-of-two set size
     like the reference tree (adasum.h:32 IsPowerOfTwo). `hierarchical`
     (default HOROVOD_ADASUM_HIERARCHICAL, only for the global set) selects
     the AdasumGpuAllreduceOp-style two-level algorithm: local sum
-    reduce-scatter, cross-node Adasum, local allgather.
+    reduce-scatter, cross-node Adasum, local allgather. `local_size`
+    overrides the hier topology's local-group width (default: the
+    launcher/host-derived hier mesh from init()).
     """
     ps = basics.get_process_set(process_set)
     n = ps.size()
@@ -138,7 +141,12 @@ def adasum_allreduce(x: jax.Array, *,
             ps.process_set_id == 0
     from .collective_ops import _place_stacked
     if hierarchical:
-        hier = basics.get_hier_mesh()
+        if local_size is not None:
+            from ..core.mesh import build_hierarchical_mesh
+            hier = build_hierarchical_mesh(
+                list(ps.mesh.devices.flat), local_size=local_size)
+        else:
+            hier = basics.get_hier_mesh()
         if ps.process_set_id != 0 or hier.devices.size != n:
             raise ValueError(
                 "hierarchical Adasum runs on the global process set only")
