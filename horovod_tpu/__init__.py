@@ -39,7 +39,8 @@ from .ops.collective_ops import (                              # noqa: F401
     allreduce, allgather, broadcast, alltoall, reducescatter, barrier, join,
     local_rows,
 )
-from .ops.sparse import sparse_allreduce                       # noqa: F401
+from .ops.sparse import (                                      # noqa: F401
+    sparse_allreduce, sparse_allreduce_async)
 from .ops import inside                                        # noqa: F401
 from .ops.engine import (                                      # noqa: F401
     allreduce_async, allgather_async, broadcast_async, alltoall_async,

@@ -139,9 +139,16 @@ def adasum_allreduce(x: jax.Array, *,
     if hierarchical is None:
         hierarchical = basics.get_config().adasum_hierarchical and \
             ps.process_set_id == 0
+    if local_size is not None and not hierarchical:
+        raise ValueError(
+            "local_size only applies to hierarchical Adasum; pass "
+            "hierarchical=True (or set HOROVOD_ADASUM_HIERARCHICAL=1)")
     from .collective_ops import _place_stacked
     if hierarchical:
         if local_size is not None:
+            if local_size <= 0 or n % local_size != 0:
+                raise ValueError(
+                    f"local_size {local_size} must divide the set size {n}")
             from ..core.mesh import build_hierarchical_mesh
             hier = build_hierarchical_mesh(
                 list(ps.mesh.devices.flat), local_size=local_size)

@@ -113,6 +113,9 @@ class _Work:
     # the full splits table) — the reference's controller response payload
     # (tensor_sizes, mpi_controller.cc:239)
     negotiated: Optional[dict] = None
+    # cached wire meta: shapes/dtypes are fixed after staging, so the meta
+    # is computed once per work, not twice per negotiation round
+    meta_cache: Optional[dict] = None
 
 
 _group_counter = 0
@@ -509,6 +512,8 @@ class Engine:
 
     @staticmethod
     def _work_meta(w: _Work) -> dict:
+        if w.meta_cache is not None:
+            return w.meta_cache
         t = w.tensor
         if isinstance(t, (list, tuple)):
             # ragged op: per-rank shapes (this process's rows) — the
@@ -532,6 +537,7 @@ class Engine:
         if w.splits is not None:
             m["sp"] = [[int(v) for v in row] for row in w.splits]
             m["rag"] = True
+        w.meta_cache = m
         return m
 
     @staticmethod

@@ -151,3 +151,54 @@ def sparse_allreduce(
 def _resolve(process_set: Optional[ProcessSet]):
     ps = basics.get_process_set(process_set)
     return ps, ps.mesh
+
+
+def sparse_allreduce_async(
+    pairs: Sequence[Tuple[Union[np.ndarray, jax.Array],
+                          Union[np.ndarray, jax.Array]]],
+    op: ReduceOp = ReduceOp.AVERAGE, *,
+    dense_dim0: Optional[int] = None,
+    dense: bool = False,
+    process_set: Optional[ProcessSet] = None,
+    name: Optional[str] = None,
+):
+    """Async handle form of sparse_allreduce — the reference's surface
+    (torch/mpi_ops.py:567 sparse_allreduce_async returns a handle resolved
+    by synchronize). Work runs on one shared helper thread (per-call
+    ordering preserved — important for multi-process mode, where the
+    underlying ragged allgathers serialize through the engine)."""
+    from .engine import Handle, _auto_name
+    from ..core.types import Status
+
+    name = name or _auto_name("sparse_allreduce")
+    handle = Handle(name)
+
+    def _run():
+        try:
+            result = sparse_allreduce(
+                pairs, op, dense_dim0=dense_dim0, dense=dense,
+                process_set=process_set, name=name)
+            handle._resolve(result, Status.ok())
+        except Exception as e:  # noqa: BLE001 - surfaced via handle.wait()
+            handle._resolve(None, Status.unknown(str(e)))
+
+    _sparse_executor().submit(_run)
+    return handle
+
+
+import threading as _threading
+
+_executor = None
+_executor_lock = _threading.Lock()
+
+
+def _sparse_executor():
+    """Lazy single-thread executor: FIFO per process, no per-call thread
+    churn."""
+    global _executor
+    with _executor_lock:
+        if _executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="hvd-sparse")
+    return _executor

@@ -139,6 +139,25 @@ def main(out_dir: str) -> None:
     expect_ada = _combine(_combine(all_adasum[0], all_adasum[1]),
                           _combine(all_adasum[2], all_adasum[3]))
     np.testing.assert_allclose(ada, np.tile(expect_ada, (2, 1)), rtol=1e-4)
+    # --- grouped op with ragged members (atomic completion across the
+    # negotiated sizes) + async sparse handle ------------------------------
+    from horovod_tpu.ops.engine import grouped_allgather
+    g1 = [np.full((2 * pid + r + 1, 1), 1.0 + 2 * pid + r, np.float32)
+          for r in range(2)]
+    g2 = [np.full((1, 1), 10.0 * (2 * pid + r), np.float32)
+          for r in range(2)]
+    # both processes enqueue the same group names; members are ragged
+    outs_g = grouped_allgather([g1, g2], name="mp_grp_rag")
+    assert np.asarray(outs_g[0]).shape == (sum(r + 1 for r in range(4)), 1)
+    np.testing.assert_allclose(
+        np.asarray(outs_g[1]).ravel(), [0.0, 10.0, 20.0, 30.0])
+
+    h_sp = hvd.sparse_allreduce_async(
+        [(np.array([2 * pid + r]), np.full((1, 2), 1.0, np.float32))
+         for r in range(2)], hvd.Sum, name="mp_sparse_async")
+    uniq2, vals2 = hvd.synchronize(h_sp)
+    np.testing.assert_array_equal(uniq2, [0, 1, 2, 3])
+    np.testing.assert_allclose(np.asarray(vals2), 1.0)
     result["ragged_sparse_adasum"] = "ok"
 
     result["op_matrix"] = "ok"

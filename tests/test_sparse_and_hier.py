@@ -86,3 +86,22 @@ def test_hierarchical_allgather_env_flag():
         del os.environ["HOROVOD_HIERARCHICAL_ALLGATHER"]
         del os.environ["HOROVOD_LOCAL_SIZE"]
         hvd.shutdown()
+
+
+def test_sparse_allreduce_async_handle(hvd):
+    """Reference surface parity: sparse_allreduce_async returns a handle
+    resolved via hvd.synchronize (torch/mpi_ops.py:567)."""
+    n = hvd.size()
+    pairs = [(np.array([r % 2]), np.full((1, 3), float(r), np.float32))
+             for r in range(n)]
+    h = hvd.sparse_allreduce_async(pairs, hvd.Sum)
+    uniq, vals = hvd.synchronize(h)
+    np.testing.assert_array_equal(uniq, [0, 1])
+    np.testing.assert_allclose(np.asarray(vals)[0],
+                               sum(float(r) for r in range(0, n, 2)))
+    assert hvd.poll(h)
+    # error path surfaces through the handle
+    h_bad = hvd.sparse_allreduce_async(pairs[:1], hvd.Sum)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="pairs"):
+        hvd.synchronize(h_bad)
