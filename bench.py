@@ -53,7 +53,6 @@ def run_benchmark():
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models.resnet import ResNet50
     from horovod_tpu.training import (init_replicated, make_train_step,
                                       shard_batch)
 
@@ -64,7 +63,11 @@ def run_benchmark():
 
     # HVD_BENCH_MODEL extends the harness to the rest of the reference's
     # headline trio (docs/benchmarks.rst:8-13: Inception V3 / ResNet-101 /
-    # VGG-16). The driver headline stays resnet50.
+    # VGG-16). The driver headline stays resnet50. Model construction /
+    # sizing policy lives in models/bench_zoo.py (shared with
+    # examples/synthetic_benchmark.py).
+    from horovod_tpu.models.bench_zoo import (build_benchmark_model,
+                                              default_image_size)
     model_name = os.environ.get("HVD_BENCH_MODEL", "resnet50")
     # Per-chip batch sized for one v5e chip in bf16; smaller on CPU so the
     # harness still runs in CI.
@@ -72,13 +75,7 @@ def run_benchmark():
     per_chip_batch = (32 if heavy else 64) if platform == "tpu" \
         else (1 if heavy else 2)
     batch = per_chip_batch * n_dev
-    if model_name == "inception3":
-        image_size = 299 if platform == "tpu" else 80
-    elif model_name == "vgg16":
-        # CPU smoke uses the avg head at VGG's 5-maxpool minimum size
-        image_size = 224 if platform == "tpu" else 32
-    else:
-        image_size = 224 if platform == "tpu" else 64
+    image_size = default_image_size(model_name, platform == "tpu")
     num_warmup = 2 if platform != "tpu" else 4
     # Two timed runs of different lengths: per-step time is taken from the
     # SLOPE between them, which cancels the fixed host<->device readback
@@ -93,37 +90,8 @@ def run_benchmark():
     # HVD_BENCH_STEM=space_to_depth selects the MXU-friendly blocked stem
     # (models/resnet.py); default stays the classic conv7
     stem = os.environ.get("HVD_BENCH_STEM", "conv7")
-    rng = jax.random.PRNGKey(0)
-    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
-    if model_name in ("resnet50", "resnet101"):
-        from horovod_tpu.models.resnet import ResNet101
-        cls = ResNet50 if model_name == "resnet50" else ResNet101
-        model = cls(num_classes=1000, stem=stem)
-        variables = model.init(rng, dummy, train=True)
-        params, batch_stats = variables["params"], variables["batch_stats"]
-        apply_fn, has_bn = model.apply, True
-    elif model_name == "vgg16":
-        # frozen dropout (train=False head) — synthetic throughput
-        # without per-step rng plumbing; conv/FC FLOPs are identical
-        from horovod_tpu.models.vgg import VGG16
-        model = VGG16(num_classes=1000,
-                      classifier="flatten" if image_size == 224 else "avg")
-        variables = model.init(rng, dummy, train=False)
-        params, batch_stats = variables["params"], {}
-        apply_fn = lambda v, x: model.apply(v, x, train=False)  # noqa: E731
-        has_bn = False
-    else:                                   # inception3
-        # frozen BN running stats + dropout (train=False), stats ride the
-        # jit closure — conv FLOPs identical, no mutable-collection pass
-        from horovod_tpu.models.inception import InceptionV3
-        model = InceptionV3(num_classes=1000)
-        variables = model.init(rng, dummy, train=False)
-        params = variables["params"]
-        frozen_stats = variables["batch_stats"]
-        apply_fn = lambda v, x: model.apply(         # noqa: E731
-            dict(v, batch_stats=frozen_stats), x, train=False)
-        batch_stats = {}
-        has_bn = False
+    apply_fn, params, batch_stats, has_bn = build_benchmark_model(
+        model_name, image_size, stem=stem)
 
     tx = optax.sgd(0.01, momentum=0.9)
     params = init_replicated(params, mesh)

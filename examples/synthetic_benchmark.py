@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """Synthetic data-parallel benchmark (reference:
-examples/pytorch/pytorch_synthetic_benchmark.py): random batches through a
-ResNet with the DistributedOptimizer train step; prints img/sec per
+examples/pytorch/pytorch_synthetic_benchmark.py): random batches through
+a zoo model — ResNet-18/50/101 (full SyncBN train step), VGG-16 or
+Inception V3 (train step with frozen norm/dropout stats; see
+models/bench_zoo.py) — with the DistributedOptimizer; prints img/sec per
 iteration and the aggregate.
 
     HVD_EXAMPLE_CPU=8 python examples/synthetic_benchmark.py --model resnet18
@@ -14,13 +16,12 @@ from _common import maybe_cpu_mesh
 maybe_cpu_mesh()
 
 import jax                                                  # noqa: E402
-import jax.numpy as jnp                                     # noqa: E402
 import numpy as np                                          # noqa: E402
 import optax                                                # noqa: E402
 
 import horovod_tpu as hvd                                   # noqa: E402
-from horovod_tpu.models.resnet import (                     # noqa: E402
-    ResNet18, ResNet50,
+from horovod_tpu.models.bench_zoo import (                  # noqa: E402
+    BENCH_MODELS, build_benchmark_model, default_image_size,
 )
 from horovod_tpu.training import (                          # noqa: E402
     init_replicated, make_train_step, shard_batch,
@@ -30,7 +31,7 @@ from horovod_tpu.training import (                          # noqa: E402
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet18", "resnet50"])
+                   choices=list(BENCH_MODELS))
     p.add_argument("--batch-size", type=int, default=None,
                    help="per-device batch size")
     p.add_argument("--image-size", type=int, default=None)
@@ -43,17 +44,18 @@ def main() -> None:
     n = hvd.size()
     on_tpu = jax.devices()[0].platform == "tpu"
     per_dev = args.batch_size or (64 if on_tpu else 2)
-    hw = args.image_size or (224 if on_tpu else 64)
+    hw = args.image_size or default_image_size(args.model, on_tpu)
     batch = per_dev * n
 
-    model = {"resnet18": ResNet18, "resnet50": ResNet50}[args.model](
-        num_classes=1000)
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, hw, hw, 3)), train=True)
-    params = init_replicated(variables["params"], mesh)
-    batch_stats = init_replicated(variables["batch_stats"], mesh)
-    step = make_train_step(model.apply, optax.sgd(0.01, momentum=0.9), mesh,
-                           has_batch_stats=True)
+    # shared with bench.py: resnets run the full SyncBN train step;
+    # vgg/inception time it with frozen norm/dropout stats (see
+    # models/bench_zoo.py)
+    apply_fn, params, batch_stats, has_bn = build_benchmark_model(
+        args.model, hw)
+    params = init_replicated(params, mesh)
+    batch_stats = init_replicated(batch_stats, mesh)
+    step = make_train_step(apply_fn, optax.sgd(0.01, momentum=0.9), mesh,
+                           has_batch_stats=has_bn)
     opt_state = init_replicated(step.init_opt_state(params), mesh)
 
     rng = np.random.RandomState(0)

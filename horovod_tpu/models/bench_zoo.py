@@ -1,0 +1,64 @@
+"""Benchmark-model builder shared by bench.py and
+examples/synthetic_benchmark.py.
+
+One place that knows how each zoo model is timed (the reference's
+tf_cnn_benchmarks model registry role): resnets run the full SyncBN
+train step; VGG/Inception time the train step with frozen norm/dropout
+stats (identical conv/FC FLOPs, no per-step rng plumbing — Inception's
+running stats ride the jit closure).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+BENCH_MODELS = ("resnet18", "resnet50", "resnet101", "vgg16", "inception3")
+
+
+def default_image_size(name: str, on_tpu: bool) -> int:
+    """Canonical benchmark size on TPU; reduced CPU-smoke sizes that
+    respect each topology's minimum (Inception needs >=75 for its VALID
+    stem; VGG's 5 maxpools need >=32)."""
+    if name == "inception3":
+        return 299 if on_tpu else 80
+    if name == "vgg16":
+        return 224 if on_tpu else 32
+    return 224 if on_tpu else 64
+
+
+def build_benchmark_model(
+    name: str, image_size: int, *, stem: str = "conv7",
+    num_classes: int = 1000, seed: int = 0,
+) -> Tuple[Callable, Any, Any, bool]:
+    """Returns (apply_fn, params, batch_stats, has_batch_stats) ready for
+    training.make_train_step: apply_fn(variables, images) for the frozen
+    models, the raw module apply for resnets (SyncBN path)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    if name in ("resnet18", "resnet50", "resnet101"):
+        from .resnet import ResNet18, ResNet50, ResNet101
+        cls = {"resnet18": ResNet18, "resnet50": ResNet50,
+               "resnet101": ResNet101}[name]
+        model = cls(num_classes=num_classes, stem=stem)
+        variables = model.init(rng, dummy, train=True)
+        return (model.apply, variables["params"],
+                variables["batch_stats"], True)
+    if name == "vgg16":
+        from .vgg import VGG16
+        model = VGG16(num_classes=num_classes,
+                      classifier="flatten" if image_size == 224 else "avg")
+        variables = model.init(rng, dummy, train=False)
+        apply_fn = lambda v, x: model.apply(v, x, train=False)  # noqa: E731
+        return apply_fn, variables["params"], {}, False
+    if name == "inception3":
+        from .inception import InceptionV3
+        model = InceptionV3(num_classes=num_classes)
+        variables = model.init(rng, dummy, train=False)
+        frozen = variables["batch_stats"]
+        apply_fn = lambda v, x: model.apply(   # noqa: E731
+            dict(v, batch_stats=frozen), x, train=False)
+        return apply_fn, variables["params"], {}, False
+    raise ValueError(f"unknown benchmark model {name!r}; "
+                     f"choose from {BENCH_MODELS}")
