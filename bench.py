@@ -43,12 +43,16 @@ MAX_TOTAL_S = int(os.environ.get("HVD_BENCH_TOTAL_TIMEOUT", "600"))
 
 _MARK = "HVD_BENCH_RESULT:"
 
+#: mirror of horovod_tpu.models.bench_zoo.BENCH_MODELS — kept literal so
+#: main() never imports the package (and thus jax) in the parent process;
+#: tests/test_models.py asserts the two stay identical
+_BENCH_MODELS = ("resnet18", "resnet50", "resnet101", "vgg16", "inception3")
+
 
 def run_benchmark():
     """The measured body. Runs in a worker subprocess; prints the result
     JSON prefixed with _MARK on success."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
     import optax
 
@@ -152,7 +156,7 @@ def main() -> int:
     bad = None
     if stem not in ("conv7", "space_to_depth"):
         bad = f"unknown HVD_BENCH_STEM {stem!r}"
-    elif model_name not in ("resnet50", "resnet101", "vgg16", "inception3"):
+    elif model_name not in _BENCH_MODELS:
         bad = f"unknown HVD_BENCH_MODEL {model_name!r}"
     if bad:
         # deterministic config error: fail before the retry loop

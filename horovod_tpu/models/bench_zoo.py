@@ -47,8 +47,10 @@ def build_benchmark_model(
                 variables["batch_stats"], True)
     if name == "vgg16":
         from .vgg import VGG16
-        model = VGG16(num_classes=num_classes,
-                      classifier="flatten" if image_size == 224 else "avg")
+        # always the canonical flatten+FC head — it adapts to any input
+        # size (first FC width = (H/32)*(W/32)*512), so reduced smoke
+        # sizes still run the VGG architecture, not a different head
+        model = VGG16(num_classes=num_classes, classifier="flatten")
         variables = model.init(rng, dummy, train=False)
         apply_fn = lambda v, x: model.apply(v, x, train=False)  # noqa: E731
         return apply_fn, variables["params"], {}, False

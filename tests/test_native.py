@@ -178,9 +178,17 @@ def test_coordinator_gather_scale_smoke():
         assert time.monotonic() - t0 < 60
         # timeout + retry idempotence: member 1 delays past member 0's
         # first (timing-out) attempt; 0's retry joins the same round
+        late_done = []
+
         def late():
             time.sleep(2.5)
-            cs[1].allgather(b"late1", tag="retry")
+            for _ in range(10):     # the round may outlive one timeout
+                try:
+                    cs[1].allgather(b"late1", tag="retry")
+                    late_done.append(True)
+                    return
+                except Exception:  # noqa: BLE001 - retry like a real peer
+                    continue
         th = threading.Thread(target=late)
         th.start()
         got = None
@@ -194,17 +202,19 @@ def test_coordinator_gather_scale_smoke():
                 continue
             finally:
                 cs[0].timeout = saved
-        th.join()
-        # drain the other members so the round completes for everyone
+        # drain the other members CONCURRENTLY so the round can complete
+        # for everyone (incl. the still-waiting late member)
         def fill(i):
             cs[i].allgather(f"fill{i}".encode(), tag="retry")
         fts = [threading.Thread(target=fill, args=(i,)) for i in range(2, P)]
         for t in fts:
             t.start()
-        for t in fts:
-            t.join(timeout=60)
         if got is None:
             got = cs[0].allgather(b"early0", tag="retry")
+        for t in fts:
+            t.join(timeout=60)
+        th.join(timeout=60)
+        assert late_done, "late member never completed its round"
         assert got[0] == b"early0" and got[1] == b"late1"
         for c in cs:
             c.close()
