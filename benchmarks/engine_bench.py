@@ -1,0 +1,119 @@
+"""Async-engine control-plane microbenchmark (single controller).
+
+Measures the dispatch overhead the engine adds around the device
+collectives — the analog of the reference's RunLoopOnce cadence
+(~1 ms cycle, operations.cc:751) and fusion-buffer benefit:
+
+* handle round-trip latency: allreduce_async -> synchronize for one
+  small tensor (includes one engine cycle wait);
+* fused throughput: N small tensors enqueued together resolve as ONE
+  fused flatten-concat-allreduce-split program (tensors/sec);
+* unfused baseline: the same tensors with fusion disabled.
+
+Self-bootstraps a virtual CPU mesh (HVD_ENGINE_BENCH_CPU devices,
+default 8) — the dispatch overhead being measured is host-side and
+platform-agnostic. Set HVD_ENGINE_BENCH_CPU=0 to run on the real
+backend instead. One JSON line per measurement.
+
+    PYTHONPATH=. python benchmarks/engine_bench.py [--tensors 64]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CPU = int(os.environ.get("HVD_ENGINE_BENCH_CPU", "8"))
+if _CPU > 0:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_CPU}").strip()
+    import jax
+    # must land before any backend query; env vars alone are too late
+    # once jax is imported (tests/conftest.py applies the same bootstrap)
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tensors", type=int, default=64,
+                    help="small tensors per fused batch")
+    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--elems", type=int, default=256,
+                    help="elements per tensor per rank")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = hvd.size()
+    x = np.ones((n, args.elems), np.float32)
+
+    # warmup: compile the single + fused programs
+    hvd.synchronize(hvd.allreduce_async(x, hvd.Sum, name="warm.single"))
+    hs = [hvd.allreduce_async(x, hvd.Sum, name=f"warm.f{i}")
+          for i in range(args.tensors)]
+    for h in hs:
+        hvd.synchronize(h)
+
+    # single-handle round-trip latency
+    t0 = time.perf_counter()
+    for r in range(args.rounds):
+        hvd.synchronize(hvd.allreduce_async(x, hvd.Sum, name=f"lat.{r}"))
+    lat_ms = 1000.0 * (time.perf_counter() - t0) / args.rounds
+    print(json.dumps({"measure": "handle_round_trip_ms",
+                      "value": round(lat_ms, 3),
+                      "note": "enqueue->cycle->resolve, one small tensor"}),
+          flush=True)
+
+    eng = hvd.core.basics.get_engine()
+    from horovod_tpu.ops.engine import grouped_allreduce
+
+    # fused: the production gradient path (DistributedOptimizer enqueues
+    # the whole gradient tree as ONE group -> one stable-signature fused
+    # program: pack + collective + unpack, 3 dispatches per step)
+    tensors = [x] * args.tensors
+    grouped_allreduce(tensors, hvd.Sum, name="warm.g")     # compile
+    fused_before = eng.tensors_fused
+    t0 = time.perf_counter()
+    for r in range(args.rounds):
+        grouped_allreduce(tensors, hvd.Sum, name=f"g.{r}")
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "measure": "fused_tensors_per_s",
+        "value": round(args.rounds * args.tensors / dt, 1),
+        "tensors_per_batch": args.tensors,
+        "tensors_fused": eng.tensors_fused - fused_before,
+    }), flush=True)
+
+    # unfused baseline: independent async enqueues with a tiny fusion
+    # threshold — one bucket (and one collective dispatch) per tensor
+    # (the reference's HOROVOD_FUSION_THRESHOLD=0 comparison)
+    saved = eng.fusion_threshold
+    eng.fusion_threshold = 1
+    try:
+        t0 = time.perf_counter()
+        for r in range(args.rounds):
+            hs = [hvd.allreduce_async(x, hvd.Sum, name=f"uf.{r}.{i}")
+                  for i in range(args.tensors)]
+            for h in hs:
+                hvd.synchronize(h)
+        dt_uf = time.perf_counter() - t0
+    finally:
+        eng.fusion_threshold = saved
+    print(json.dumps({
+        "measure": "unfused_tensors_per_s",
+        "value": round(args.rounds * args.tensors / dt_uf, 1),
+        "fusion_speedup": round(dt_uf / dt, 2),
+    }), flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
