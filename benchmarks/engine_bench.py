@@ -62,10 +62,14 @@ def main() -> None:
     for h in hs:
         hvd.synchronize(h)
 
-    # single-handle round-trip latency
+    import jax
+
+    # single-handle round-trip latency (device completion fenced so the
+    # async-dispatch paths don't stop the clock early)
     t0 = time.perf_counter()
     for r in range(args.rounds):
-        hvd.synchronize(hvd.allreduce_async(x, hvd.Sum, name=f"lat.{r}"))
+        jax.block_until_ready(hvd.synchronize(
+            hvd.allreduce_async(x, hvd.Sum, name=f"lat.{r}")))
     lat_ms = 1000.0 * (time.perf_counter() - t0) / args.rounds
     print(json.dumps({"measure": "handle_round_trip_ms",
                       "value": round(lat_ms, 3),
@@ -83,7 +87,8 @@ def main() -> None:
     fused_before = eng.tensors_fused
     t0 = time.perf_counter()
     for r in range(args.rounds):
-        grouped_allreduce(tensors, hvd.Sum, name=f"g.{r}")
+        jax.block_until_ready(
+            grouped_allreduce(tensors, hvd.Sum, name=f"g.{r}"))
     dt = time.perf_counter() - t0
     print(json.dumps({
         "measure": "fused_tensors_per_s",
@@ -102,8 +107,7 @@ def main() -> None:
         for r in range(args.rounds):
             hs = [hvd.allreduce_async(x, hvd.Sum, name=f"uf.{r}.{i}")
                   for i in range(args.tensors)]
-            for h in hs:
-                hvd.synchronize(h)
+            jax.block_until_ready([hvd.synchronize(h) for h in hs])
         dt_uf = time.perf_counter() - t0
     finally:
         eng.fusion_threshold = saved

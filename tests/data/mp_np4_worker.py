@@ -1,0 +1,53 @@
+"""4-process tier-3 worker (1 device each): negotiation at a wider
+fan-in than the 2-process matrix — eager + async + ragged + barrier over
+a 4-way jax.distributed mesh (the reference's -np 4 tier,
+.buildkite/gen-pipeline.sh)."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main(out_dir: str) -> None:
+    hvd.init()
+    pid = jax.process_index()
+    assert hvd.size() == 4, hvd.size()
+    assert hvd.rank() == pid, (hvd.rank(), pid)  # 1 device/proc: rank==pid
+
+    out = hvd.local_rows(hvd.allreduce(
+        np.full((1, 3), float(pid + 1), np.float32), hvd.Sum))
+    np.testing.assert_allclose(out, 10.0)          # 1+2+3+4
+
+    # async with per-process enqueue-order shuffle: agreement required
+    names = [f"t{(pid + i) % 3}" for i in range(3)]
+    hs = {nm: hvd.allreduce_async(
+        np.full((1, 2), float(int(nm[1]) + 1), np.float32), hvd.Sum,
+        name=nm) for nm in names}
+    for nm, h in hs.items():
+        got = hvd.local_rows(hvd.synchronize(h))
+        np.testing.assert_allclose(got, 4.0 * (int(nm[1]) + 1))
+
+    # ragged allgather across 4 processes
+    rag = np.asarray(hvd.allgather(
+        [np.full((pid + 1, 2), float(pid), np.float32)], name="np4_rag"))
+    expect = np.concatenate(
+        [np.full((i + 1, 2), float(i), np.float32) for i in range(4)])
+    np.testing.assert_allclose(rag, expect)
+
+    hvd.barrier()
+    with open(os.path.join(out_dir, f"result.{pid}.json"), "w") as f:
+        json.dump({"pid": pid, "ok": True}, f)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

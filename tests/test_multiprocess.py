@@ -16,21 +16,22 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 
-def _hvdrun_np2(worker: str, tmp_path, timeout=240):
+def _hvdrun(worker: str, tmp_path, np_: int = 2, timeout=240,
+            stall_seconds: int = 30):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     # the launcher runs in a subprocess too, so a hung worker cannot wedge
     # the test session
     proc = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
-         "--stall-check-time-seconds", "30",
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", str(np_), "--stall-check-time-seconds", str(stall_seconds),
          sys.executable, os.path.join(HERE, "data", worker), str(tmp_path)],
         env=env, capture_output=True, text=True, timeout=timeout)
     assert proc.returncode == 0, (
-        f"hvdrun failed rc={proc.returncode}\n--- stdout ---\n"
+        f"hvdrun -np {np_} failed rc={proc.returncode}\n--- stdout ---\n"
         f"{proc.stdout[-4000:]}\n--- stderr ---\n{proc.stderr[-4000:]}")
     results = sorted(glob.glob(str(tmp_path / "result.*.json")))
-    assert len(results) == 2, (results, proc.stdout[-2000:])
+    assert len(results) == np_, (results, proc.stdout[-2000:])
     out = []
     for path in results:
         with open(path) as f:
@@ -38,6 +39,10 @@ def _hvdrun_np2(worker: str, tmp_path, timeout=240):
         assert r["ok"] is True
         out.append(r)
     return out
+
+
+def _hvdrun_np2(worker: str, tmp_path, timeout=240):
+    return _hvdrun(worker, tmp_path, np_=2, timeout=timeout)
 
 
 def test_hvdrun_np2_jax_plane(tmp_path):
@@ -67,3 +72,10 @@ def test_hvdrun_np2_negotiation_failure_modes(tmp_path):
         assert r["mismatch"] == "ok", r
         assert r["post_error_allreduce"] == "ok", r
         assert r["stall"] == "ok", r
+
+
+def test_hvdrun_np4_negotiation(tmp_path):
+    """4-way fan-in: eager/async/ragged negotiation across four real
+    processes (1 device each) — wider than the 2-process matrix."""
+    _hvdrun("mp_np4_worker.py", tmp_path, np_=4, timeout=360,
+            stall_seconds=60)
