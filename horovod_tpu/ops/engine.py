@@ -119,26 +119,30 @@ class _Work:
     meta_cache: Optional[dict] = None
 
 
+def _pack_impl(ts, n: int):
+    """Fusion-buffer layout, shared by the eager and jitted paths:
+    list of [n, ...] tensors -> [n, total]."""
+    return jnp.concatenate([t.reshape(n, -1) for t in ts], axis=1)
+
+
+def _unpack_impl(fused, n: int, shapes: Tuple[Tuple[int, ...], ...]):
+    """Inverse of _pack_impl: [n, total] -> original-shape list."""
+    outs, off = [], 0
+    for s in shapes:
+        m = int(np.prod(s)) // n
+        outs.append(fused[:, off:off + m].reshape(s))
+        off += m
+    return outs
+
+
 @functools.lru_cache(maxsize=512)
 def _pack_fn(n: int, shapes: Tuple[Tuple[int, ...], ...]):
-    """Jitted fusion-buffer pack: list of [n, ...] tensors -> [n, total]."""
-    @jax.jit
-    def pack(ts):
-        return jnp.concatenate([t.reshape(n, -1) for t in ts], axis=1)
-    return pack
+    return jax.jit(lambda ts: _pack_impl(ts, n))
 
 
 @functools.lru_cache(maxsize=512)
 def _unpack_fn(n: int, shapes: Tuple[Tuple[int, ...], ...]):
-    """Jitted fusion-buffer unpack: [n, total] -> original-shape list."""
-    widths = [int(np.prod(s)) // n for s in shapes]
-    offs = np.concatenate([[0], np.cumsum(widths)])
-
-    @jax.jit
-    def unpack(fused):
-        return [fused[:, offs[i]:offs[i + 1]].reshape(shapes[i])
-                for i in range(len(shapes))]
-    return unpack
+    return jax.jit(lambda fused: _unpack_impl(fused, n, shapes))
 
 
 _group_counter = 0
@@ -184,6 +188,9 @@ class Engine:
         # response-cache analog: signature -> hit count (jit owns the
         # executables; we track stats + LRU for observability/autotune).
         self.cache_stats: "OrderedDict[Tuple, int]" = OrderedDict()
+        # fused-bucket signatures seen at least once (promotion to the
+        # jitted pack/unpack path); independent of cache_capacity
+        self._fused_seen: "OrderedDict[Tuple, bool]" = OrderedDict()
         self.cycles = 0
         self.tensors_fused = 0
         self.bytes_processed = 0
@@ -984,13 +991,16 @@ class Engine:
     def _execute_fused_allreduce(self, bucket: List[_Work]):
         """One fused program: flatten rows -> concat -> allreduce -> split.
 
-        The fusion-buffer analog (fusion_buffer_manager.h). Pack and
-        unpack are each ONE jitted program keyed by the bucket's shape
-        signature — a bucket costs 3 dispatches (pack, collective,
-        unpack) instead of ~2x-tensors eager ops, the dispatch-overhead
-        property the reference gets from its single fused buffer (the
-        batched D2D kernels of cuda_kernels.cu:48 collapse into the
-        compiled pack/unpack).
+        The fusion-buffer analog (fusion_buffer_manager.h). On a REPEATED
+        bucket signature (steady-state training: the same gradient set
+        every step) pack and unpack are each ONE jitted program — a
+        bucket costs 3 dispatches instead of ~2x-tensors eager ops, the
+        dispatch-overhead property the reference gets from its single
+        fused buffer (cuda_kernels.cu:48 batched D2D kernels collapse
+        into the compiled pack/unpack). A first-seen signature uses the
+        eager ops instead: timing-dependent bucket splits (bursts of
+        per-tensor enqueues racing the cycle window) would otherwise pay
+        a jit compile per novel split.
         """
         w0 = bucket[0]
         tensors = [jnp.asarray(w.tensor) for w in bucket]
@@ -1004,12 +1014,24 @@ class Engine:
         while len(self.cache_stats) > cap:
             self.cache_stats.popitem(last=False)
         self.tensors_fused += len(bucket)
+        # promotion tracking is separate from the (user-capped) response
+        # cache stats: HOROVOD_CACHE_CAPACITY=0 must not disable the
+        # jitted fast path
+        repeated = sig in self._fused_seen
+        if not repeated:
+            self._fused_seen[sig] = True
+            while len(self._fused_seen) > 4096:
+                self._fused_seen.popitem(last=False)
 
-        flat = _pack_fn(n, shapes)(tensors)
+        if repeated:                   # repeated signature: jitted 3-dispatch
+            flat = _pack_fn(n, shapes)(tensors)
+        else:                          # novel: eager, no compile
+            flat = _pack_impl(tensors, n)
         fused = collective_ops.allreduce(
             flat, w0.op, process_set=w0.process_set,
             prescale_factor=w0.prescale, postscale_factor=w0.postscale)
-        return _unpack_fn(n, shapes)(fused)
+        return _unpack_fn(n, shapes)(fused) if repeated \
+            else _unpack_impl(fused, n, shapes)
 
     # -- stall inspector (stall_inspector.h:41-68) ---------------------------
     # Runs on its own watchdog thread so it still fires when the dispatch
