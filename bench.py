@@ -78,6 +78,10 @@ def run_benchmark():
     heavy = model_name in ("vgg16", "inception3", "resnet101")
     per_chip_batch = (32 if heavy else 64) if platform == "tpu" \
         else (1 if heavy else 2)
+    # HVD_BENCH_BATCH overrides the per-chip batch (sweep support; the
+    # default operating point was chosen by an on-hardware sweep)
+    if os.environ.get("HVD_BENCH_BATCH"):
+        per_chip_batch = int(os.environ["HVD_BENCH_BATCH"])
     batch = per_chip_batch * n_dev
     image_size = default_image_size(model_name, platform == "tpu")
     num_warmup = 2 if platform != "tpu" else 4

@@ -51,7 +51,7 @@ from .ops.engine import (                                      # noqa: F401
 from .optim.compression import Compression                     # noqa: F401
 from .optim.optimizer import (                                 # noqa: F401
     DistributedOptimizer, DistributedGradientTape, distributed_grad,
-    allreduce_gradients,
+    allreduce_gradients, PartialDistributedGradientTape,
 )
 from .optim.functions import (                                 # noqa: F401
     broadcast_parameters, broadcast_object, allgather_object,

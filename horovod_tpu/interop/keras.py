@@ -137,6 +137,15 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
 _DIST_CLASS_CACHE: dict = {}
 
 
+def _var_key(var):
+    """Hashable identity for a keras/tf variable. Object identity, not
+    .ref(): keras-3 Variables delegate unknown attributes to their value
+    tensor, so var.ref() yields a DIFFERENT reference on every access.
+    Model variables are long-lived objects, so id() is stable across
+    register_local_var and apply."""
+    return id(var)
+
+
 def _dist_class(cls, op: str = Average,
                 gradient_predivide_factor: float = 1.0):
     # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
@@ -150,8 +159,28 @@ def _dist_class(cls, op: str = Average,
     dist_cls = type("Distributed" + cls.__name__, (cls,),
                     {"_hvd_distributed": True})
 
+    def register_local_var(self, var):
+        """Mark `var` so its gradient stays rank-local (skips the
+        allreduce) — reference: horovod/_keras/__init__.py:97.
+        object.__setattr__ keeps the set out of keras' attribute
+        tracking, which would otherwise wrap the assignment in a
+        TrackedSet COPY and orphan the original."""
+        if getattr(self, "_hvd_local_refs", None) is None:
+            object.__setattr__(self, "_hvd_local_refs", set())
+        self._hvd_local_refs.add(_var_key(var))
+
     def apply(self, grads, trainable_variables=None, **kwargs):
         import tensorflow as tf
+
+        grads = list(grads)  # may be an iterator; consume exactly once
+        local_refs = getattr(self, "_hvd_local_refs", set())
+        is_local = [False] * len(grads)
+        # apply(grads) without explicit variables uses the list the
+        # optimizer was built with (keras 3 BaseOptimizer semantics)
+        match_vars = trainable_variables if trainable_variables is not None \
+            else getattr(self, "_trainable_variables", None)
+        if local_refs and match_vars is not None:
+            is_local = [_var_key(v) in local_refs for v in match_vars]
 
         def _reduce_py(*flat_grads):
             outs = []
@@ -169,17 +198,30 @@ def _dist_class(cls, op: str = Average,
 
         if _plane.size() > 1:
             dense = [tf.convert_to_tensor(g) for g in grads]
-            reduced = tf.py_function(
-                _reduce_py, dense, Tout=[g.dtype for g in dense])
-            for r, g in zip(reduced, dense):
-                r.set_shape(g.shape)
-            grads = reduced
+            send = [g for g, loc in zip(dense, is_local) if not loc]
+            if send:
+                reduced = tf.py_function(
+                    _reduce_py, send, Tout=[g.dtype for g in send])
+                if len(send) == 1:  # py_function unwraps 1-elem lists
+                    reduced = [reduced] if tf.is_tensor(reduced) \
+                        else list(reduced)
+                it = iter(reduced)
+                merged = []
+                for g, loc in zip(dense, is_local):
+                    if loc:
+                        merged.append(g)
+                    else:
+                        r = next(it)
+                        r.set_shape(g.shape)
+                        merged.append(r)
+                grads = merged
         # bind the created class explicitly: super(self.__class__, ...)
         # would recurse if dist_cls is ever subclassed again
         return super(dist_cls, self).apply(
             grads, trainable_variables, **kwargs)
 
     dist_cls.apply = apply
+    dist_cls.register_local_var = register_local_var
     _DIST_CLASS_CACHE[key] = dist_cls
     return dist_cls
 

@@ -54,30 +54,64 @@ class _AggState(NamedTuple):
     count: jnp.ndarray  # micro-steps since last apply
 
 
+def _local_mask(grads, local_vars):
+    """Per-leaf True = keep this gradient local (skip the allreduce).
+
+    `local_vars` mirrors the reference's local-variable registration
+    (horovod/tensorflow/__init__.py:1045 register_local_source,
+    _keras/__init__.py:97 register_local_var): either a callable
+    ``(path_str, leaf) -> bool`` or an iterable of substrings matched
+    against the leaf's pytree key path (e.g. ``["embedding", "head"]``).
+    """
+    if local_vars is None:
+        return None
+    if callable(local_vars):
+        pred = local_vars
+    else:
+        if isinstance(local_vars, str):  # a bare string is ONE needle,
+            local_vars = (local_vars,)   # not an iterable of chars
+        needles = tuple(str(s) for s in local_vars)
+        pred = lambda path, leaf: any(n in path for n in needles)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(grads)
+    mask = [bool(pred(jax.tree_util.keystr(path), leaf))
+            for path, leaf in leaves]
+    return jax.tree_util.tree_unflatten(treedef, mask)
+
+
 def _reduce_tree_ingraph(grads, op, axis_name, prescale, postscale,
-                         compression):
-    def one(g):
+                         compression, local_mask=None):
+    def one(g, is_local=False):
+        if is_local:
+            return g
         c, ctx = compression.compress(g)
         r = inside.allreduce(c, op, axis_name,
                              prescale_factor=prescale,
                              postscale_factor=postscale)
         return compression.decompress(r, ctx)
-    return jax.tree_util.tree_map(one, grads)
+    if local_mask is None:
+        return jax.tree_util.tree_map(one, grads)
+    return jax.tree_util.tree_map(one, grads, local_mask)
 
 
 def _reduce_tree_eager(grads, op, process_set, prescale, postscale,
-                       compression):
+                       compression, local_mask=None):
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    comp = [compression.compress(g) for g in leaves]
+    local = jax.tree_util.tree_flatten(local_mask)[0] \
+        if local_mask is not None else [False] * len(leaves)
+    send = [g for g, loc in zip(leaves, local) if not loc]
+    comp = [compression.compress(g) for g in send]
     tensors = [c for c, _ in comp]
     # Adasum rides the same engine path (grouped; executed as per-tensor
     # tree programs) so multi-process ordering/negotiation and the Join
     # guard apply uniformly.
     reduced = engine.grouped_allreduce(
         tensors, op, process_set=process_set,
-        prescale_factor=prescale, postscale_factor=postscale)
-    out = [compression.decompress(r, ctx)
-           for r, (_, ctx) in zip(reduced, comp)]
+        prescale_factor=prescale, postscale_factor=postscale) \
+        if tensors else []
+    red_iter = iter(compression.decompress(r, ctx)
+                    for r, (_, ctx) in zip(reduced, comp))
+    out = [g if loc else next(red_iter)
+           for g, loc in zip(leaves, local)]
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -90,15 +124,21 @@ def DistributedOptimizer(
     compression=Compression.none,
     process_set: Optional[ProcessSet] = None,
     axis_name: Optional[str] = None,
+    local_vars=None,
 ) -> optax.GradientTransformation:
-    """Wrap an optax optimizer so updates see globally-reduced gradients."""
+    """Wrap an optax optimizer so updates see globally-reduced gradients.
+
+    `local_vars` marks parameters whose gradients stay rank-local (not
+    allreduced) — the reference's register_local_var surface
+    (horovod/_keras/__init__.py:97, tensorflow/__init__.py:688); see
+    `_local_mask` for the accepted forms."""
     _validate_reduce_knobs(op, gradient_predivide_factor, axis_name)
 
     def reduce_grads(grads):
         # shared prescale/postscale folding + mode dispatch
         return allreduce_gradients(
             grads, op=op, compression=compression, process_set=process_set,
-            axis_name=axis_name,
+            axis_name=axis_name, local_vars=local_vars,
             gradient_predivide_factor=gradient_predivide_factor)
 
     k = int(backward_passes_per_step)
@@ -158,22 +198,25 @@ def allreduce_gradients(grads, *,
                         compression=Compression.none,
                         process_set: Optional[ProcessSet] = None,
                         axis_name: Optional[str] = None,
-                        gradient_predivide_factor: float = 1.0):
+                        gradient_predivide_factor: float = 1.0,
+                        local_vars=None):
     """Reduce a gradient pytree across ranks without an optimizer wrapper —
     the building block of DistributedGradientTape
     (horovod/tensorflow/__init__.py:1026 _DistributedGradientTape, which
     allreduces tape.gradient's results). Same dual modes as
     DistributedOptimizer: `axis_name` for in-graph shard_map/pjit use,
-    stacked eager (grouped engine allreduce with fusion) otherwise."""
+    stacked eager (grouped engine allreduce with fusion) otherwise.
+    Leaves matched by `local_vars` pass through unreduced."""
     _validate_reduce_knobs(op, gradient_predivide_factor, axis_name)
     prescale = 1.0 / gradient_predivide_factor
     postscale = gradient_predivide_factor
+    mask = _local_mask(grads, local_vars)
     if axis_name is not None:
         return _reduce_tree_ingraph(grads, op, axis_name, prescale,
-                                    postscale, compression)
+                                    postscale, compression, mask)
     ps = basics.get_process_set(process_set)
     return _reduce_tree_eager(grads, op, ps, prescale, postscale,
-                              compression)
+                              compression, mask)
 
 
 def distributed_grad(fun, argnums=0, *, has_aux: bool = False,
@@ -181,7 +224,8 @@ def distributed_grad(fun, argnums=0, *, has_aux: bool = False,
                      compression=Compression.none,
                      process_set: Optional[ProcessSet] = None,
                      axis_name: Optional[str] = None,
-                     gradient_predivide_factor: float = 1.0):
+                     gradient_predivide_factor: float = 1.0,
+                     local_vars=None):
     """jax.grad whose gradients come back allreduce-averaged across ranks —
     the DistributedGradientTape analog (hvd.DistributedGradientTape wraps
     tape.gradient the same way, horovod/tensorflow/__init__.py:1110).
@@ -194,7 +238,7 @@ def distributed_grad(fun, argnums=0, *, has_aux: bool = False,
     def reduce(g):
         return allreduce_gradients(
             g, op=op, compression=compression, process_set=process_set,
-            axis_name=axis_name,
+            axis_name=axis_name, local_vars=local_vars,
             gradient_predivide_factor=gradient_predivide_factor)
 
     def wrapped(*args, **kwargs):
@@ -221,7 +265,12 @@ def distributed_grad(fun, argnums=0, *, has_aux: bool = False,
 
 def _to_varying(leaf, axis_name):
     """unvarying -> device-varying cast; pcast on current jax, pvary on
-    older releases (pvary is deprecated in favor of pcast)."""
+    older releases (pvary is deprecated in favor of pcast). Identity when
+    the leaf is already device-varying over `axis_name` (a sharded input:
+    pcast varying->varying raises)."""
+    vma = getattr(getattr(leaf, "aval", None), "vma", None)
+    if vma and axis_name in vma:
+        return leaf
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(leaf, axis_name, to="varying")
     return jax.lax.pvary(leaf, axis_name)
@@ -229,3 +278,14 @@ def _to_varying(leaf, axis_name):
 
 #: TF-flavored alias (scripts ported from hvd.DistributedGradientTape)
 DistributedGradientTape = distributed_grad
+
+
+def PartialDistributedGradientTape(fun, *, local_vars, **kwargs):
+    """distributed_grad that allreduces only the NON-local gradients —
+    the functional analog of the reference's PartialDistributedGradientTape
+    (horovod/tensorflow/__init__.py:1189: wraps a GradientTape and calls
+    register_local_source on each local-layer variable so its gradient
+    skips the allreduce). Here `local_vars` (required) selects the local
+    leaves by pytree key path or predicate; everything else matches
+    distributed_grad."""
+    return distributed_grad(fun, local_vars=local_vars, **kwargs)

@@ -130,6 +130,49 @@ def test_keras_multiprocess_shm():
     assert results == [2.0, 2.0]
 
 
+def _keras_local_var_worker():
+    """register_local_var: the bias gradient stays rank-local while the
+    kernel gradient is allreduce-averaged (reference
+    horovod/_keras/__init__.py:97)."""
+    import keras
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.interop.keras as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    keras.utils.set_random_seed(3)
+    model = keras.Sequential([keras.layers.Input((4,)),
+                              keras.layers.Dense(2)])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0))
+    opt.build(model.trainable_variables)
+    kernel, bias = model.trainable_variables
+    opt.register_local_var(bias)
+    kernel.assign(np.zeros((4, 2), np.float32))
+    bias.assign(np.zeros((2,), np.float32))
+    grads = [tf.constant(np.full((4, 2), float(r + 1), np.float32)),
+             tf.constant(np.full((2,), float(r + 1), np.float32))]
+    opt.apply(grads, model.trainable_variables)
+    # kernel: averaged grad (1+2)/2 -> -1.5; bias: own grad -> -(r+1)
+    np.testing.assert_allclose(kernel.numpy(), np.full((4, 2), -1.5),
+                               rtol=1e-6)
+    np.testing.assert_allclose(bias.numpy(), np.full((2,), -(r + 1.0)),
+                               rtol=1e-6)
+    hvd.shutdown()
+    return float(r)
+
+
+def test_keras_register_local_var_multiprocess():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_keras_local_var_worker, num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [0.0, 1.0]
+
+
 def _keras_estimator_worker(store_root):
     """2-process spark-layer KerasEstimator: per-rank parquet shards,
     distributed optimizer, rank-0 checkpoint to the Store."""

@@ -102,6 +102,66 @@ def test_adasum_op(hvd):
                                rtol=1e-5)
 
 
+def test_local_vars_skip_allreduce_eager(hvd):
+    """local_vars gradients pass through unreduced (reference:
+    register_local_var, horovod/_keras/__init__.py:97)."""
+    from horovod_tpu.optim.optimizer import DistributedOptimizer
+    opt = DistributedOptimizer(optax.sgd(1.0), local_vars=["b"])
+    grads = _stacked_grads(8)
+    params = jax.tree_util.tree_map(jnp.zeros_like, grads)
+    state = opt.init(params)
+    updates, _ = opt.update(grads, state, params)
+    np.testing.assert_allclose(
+        np.asarray(updates["w"]),
+        np.tile(-grads["w"].mean(0), (8, 1, 1)), rtol=1e-5)
+    # "b" kept its per-rank rows: no averaging happened
+    np.testing.assert_allclose(np.asarray(updates["b"]), -grads["b"],
+                               rtol=1e-5)
+
+
+def test_local_vars_predicate_form(hvd):
+    from horovod_tpu.optim.optimizer import allreduce_gradients
+    grads = _stacked_grads(8)
+    out = allreduce_gradients(
+        grads, local_vars=lambda path, leaf: leaf.ndim == 2)  # matches "b"
+    np.testing.assert_allclose(np.asarray(out["b"]), grads["b"], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), np.tile(grads["w"].mean(0), (8, 1, 1)),
+        rtol=1e-5)
+
+
+def test_partial_distributed_gradient_tape_ingraph(hvd):
+    """PartialDistributedGradientTape under shard_map: the local leaf keeps
+    its per-device gradient while the shared leaf is averaged
+    (reference: tensorflow/__init__.py:1189)."""
+    from horovod_tpu.optim.optimizer import PartialDistributedGradientTape
+    from jax.sharding import PartitionSpec as P
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("hvd",))
+
+    def loss(p, x):
+        return jnp.sum(p["shared"] * x) + jnp.sum(p["local_head"] * x * x)
+
+    g = PartialDistributedGradientTape(loss, local_vars=["local_head"],
+                                       axis_name="hvd")
+
+    def step(p, x):
+        return g({"shared": p["shared"][0], "local_head": p["local_head"][0]},
+                 x[0])
+
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 1, 4)
+    params = {"shared": jnp.ones((8, 1, 4)), "local_head": jnp.ones((8, 1, 4))}
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P("hvd"), P("hvd")),
+        out_specs={"shared": P("hvd"), "local_head": P("hvd")}))
+    out = f(params, jnp.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(out["shared"]).reshape(8, 4),
+        np.tile(x.reshape(8, 4).mean(0), (8, 1)), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["local_head"]).reshape(8, 4),
+        (x * x).reshape(8, 4), rtol=1e-5)
+
+
 def test_ingraph_mode_under_shard_map(hvd):
     """The performance path: optimizer used inside shard_map with axis_name."""
     from horovod_tpu.optim.optimizer import DistributedOptimizer
