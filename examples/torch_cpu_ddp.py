@@ -17,7 +17,8 @@ def main() -> None:
     torch.manual_seed(1234 + hvd.rank())     # diverged init on purpose
 
     model = torch.nn.Sequential(
-        torch.nn.Linear(16, 32), torch.nn.ReLU(), torch.nn.Linear(32, 2))
+        torch.nn.Linear(16, 32), hvd.SyncBatchNorm(32), torch.nn.ReLU(),
+        torch.nn.Linear(32, 2))  # BN statistics span the GLOBAL batch
     # rank 0's weights everywhere (examples convention: rank 0 is source)
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 

@@ -42,6 +42,17 @@ def init(comm_name: Optional[str] = None) -> None:
 
 
 def shutdown() -> None:
+    # drain outstanding async work first: a rendezvous op abandoned
+    # mid-flight would hang the peer ranks
+    ex = _async_state.get("exec")
+    if ex is not None:
+        for h in list(_async_state["futures"]):
+            try:
+                _async_state["futures"].pop(h).result()
+            except Exception:  # noqa: BLE001 — best-effort drain
+                pass
+        ex.shutdown(wait=True)
+        _async_state["exec"] = None
     _plane.shutdown()
 
 
@@ -84,8 +95,46 @@ def _np_view(t) -> np.ndarray:
     return t.detach().numpy()
 
 
-def allreduce_(t, op: str = Average, name: Optional[str] = None):
-    """In-place allreduce (hvd.allreduce_, torch/mpi_ops.py:194)."""
+# -- op ordering ------------------------------------------------------------
+#
+# The plane's collectives are rendezvous ops with no tags: the k-th
+# collective started by rank A pairs with the k-th started by rank B, so
+# every rank must START collectives in the same order. Async submissions
+# execute on ONE background thread per process in enqueue order; sync ops
+# issued while async work is outstanding are routed through the SAME
+# queue (enqueue + wait) so the per-rank start order equals the per-rank
+# CALL order — the same total-order contract the reference enforces by
+# funneling every op through its background loop (operations.cc:751).
+
+_async_state: Dict[str, Any] = {"exec": None, "next": 0, "futures": {},
+                                "worker": None}
+
+
+def _ensure_exec():
+    import concurrent.futures
+    import threading
+    if _async_state["exec"] is None:
+        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        _async_state["exec"] = ex
+        ex.submit(lambda: _async_state.__setitem__(
+            "worker", threading.current_thread())).result()
+    return _async_state["exec"]
+
+
+def _ordered(fn):
+    """Run a plane op in per-rank call order relative to async work:
+    inline when the async queue is idle (or we ARE the queue thread),
+    through the queue when async ops are outstanding."""
+    import threading
+    st = _async_state
+    if st["worker"] is threading.current_thread():
+        return fn()                       # already inside the queue
+    if st["exec"] is None or not st["futures"]:
+        return fn()                       # queue idle: inline is ordered
+    return st["exec"].submit(fn).result()
+
+
+def _allreduce_impl_(t, op: str, name=None):
     if _plane.size() == 1:
         return t
     arr = _np_view(t)
@@ -95,13 +144,17 @@ def allreduce_(t, op: str = Average, name: Optional[str] = None):
     return t
 
 
+def allreduce_(t, op: str = Average, name: Optional[str] = None):
+    """In-place allreduce (hvd.allreduce_, torch/mpi_ops.py:194)."""
+    return _ordered(lambda: _allreduce_impl_(t, op, name))
+
+
 def allreduce(t, op: str = Average, name: Optional[str] = None):
     out = t.clone()
     return allreduce_(out, op=op, name=name)
 
 
-def allgather(t, name: Optional[str] = None):
-    """Concatenate along dim 0 across ranks (torch/mpi_ops.py:630)."""
+def _allgather_impl(t, name=None):
     import torch
     if _plane.size() == 1:
         return t.clone()
@@ -112,7 +165,12 @@ def allgather(t, name: Optional[str] = None):
                          + tuple(t.shape[1:])))
 
 
-def broadcast_(t, root_rank: int = 0, name: Optional[str] = None):
+def allgather(t, name: Optional[str] = None):
+    """Concatenate along dim 0 across ranks (torch/mpi_ops.py:630)."""
+    return _ordered(lambda: _allgather_impl(t, name))
+
+
+def _broadcast_impl_(t, root_rank: int, name=None):
     if _plane.size() == 1:
         return t
     arr = _np_view(t)
@@ -120,12 +178,16 @@ def broadcast_(t, root_rank: int = 0, name: Optional[str] = None):
     return t
 
 
+def broadcast_(t, root_rank: int = 0, name: Optional[str] = None):
+    return _ordered(lambda: _broadcast_impl_(t, root_rank, name))
+
+
 def broadcast(t, root_rank: int = 0, name: Optional[str] = None):
     out = t.clone()
     return broadcast_(out, root_rank=root_rank, name=name)
 
 
-def reducescatter(t, op: str = Average, name: Optional[str] = None):
+def _reducescatter_impl(t, op: str, name=None):
     import torch
     if _plane.size() == 1:
         return t.clone()
@@ -136,8 +198,147 @@ def reducescatter(t, op: str = Average, name: Optional[str] = None):
     return res
 
 
+def reducescatter(t, op: str = Average, name: Optional[str] = None):
+    return _ordered(lambda: _reducescatter_impl(t, op, name))
+
+
+def _alltoall_impl(t, splits=None, name=None):
+    import torch
+    n = _plane.size()
+    if splits is None:
+        if t.shape[0] % n:
+            raise ValueError(
+                f"alltoall without splits needs dim0 divisible by size "
+                f"({t.shape[0]} vs {n})")
+        splits = [t.shape[0] // n] * n
+    splits = [int(s) for s in splits]
+    if sum(splits) != t.shape[0]:
+        raise ValueError("splits must sum to dim 0")
+    if n == 1:
+        return t.clone(), torch.tensor(splits[:1])
+    chunks = []
+    off = 0
+    for s in splits:
+        chunks.append(np.ascontiguousarray(_np_view(t)[off:off + s]))
+        off += s
+    everyone = _plane.allgather_object(chunks)   # [src][dst] -> chunk
+    me = _plane.rank()
+    mine = [everyone[src][me] for src in range(n)]
+    recv_splits = torch.tensor([c.shape[0] for c in mine])
+    out = torch.from_numpy(np.concatenate(mine, axis=0)) if mine else t[:0]
+    return out.to(t.dtype), recv_splits
+
+
+def alltoall(t, splits=None, name: Optional[str] = None):
+    """Distribute slices of dim 0 to all ranks; returns (output,
+    received_splits) like the reference (torch/mpi_ops.py:960 alltoall
+    with uneven `splits`; recv splits negotiated across ranks). Rides the
+    object plane (gather-then-pick), which is fine for the binding's
+    same-host/control-plane scale; the JAX engine owns the ICI path."""
+    return _ordered(lambda: _alltoall_impl(t, splits, name))
+
+
 def barrier() -> None:
-    _plane.barrier()
+    _ordered(_plane.barrier)
+
+
+# -- async handle API (torch/mpi_ops.py allreduce_async_/synchronize/...) ----
+
+def _submit(fn) -> int:
+    ex = _ensure_exec()
+    h = _async_state["next"]
+    _async_state["next"] += 1
+    _async_state["futures"][h] = ex.submit(fn)
+    return h
+
+
+def poll(handle: int) -> bool:
+    """True when the async op behind `handle` has completed
+    (torch/mpi_ops.py poll)."""
+    return _async_state["futures"][handle].done()
+
+
+def synchronize(handle: int):
+    """Wait for an async op and return its result (torch/mpi_ops.py
+    synchronize)."""
+    fut = _async_state["futures"].pop(handle)
+    return fut.result()
+
+
+wait = synchronize  # reference alias
+
+
+def allreduce_async_(t, op: str = Average, name: Optional[str] = None) -> int:
+    return _submit(lambda: allreduce_(t, op=op, name=name))
+
+
+def allreduce_async(t, op: str = Average, name: Optional[str] = None) -> int:
+    return _submit(lambda: allreduce(t, op=op, name=name))
+
+
+def allgather_async(t, name: Optional[str] = None) -> int:
+    return _submit(lambda: allgather(t, name=name))
+
+
+def broadcast_async_(t, root_rank: int = 0,
+                     name: Optional[str] = None) -> int:
+    return _submit(lambda: broadcast_(t, root_rank=root_rank, name=name))
+
+
+def broadcast_async(t, root_rank: int = 0, name: Optional[str] = None) -> int:
+    return _submit(lambda: broadcast(t, root_rank=root_rank, name=name))
+
+
+def reducescatter_async(t, op: str = Average,
+                        name: Optional[str] = None) -> int:
+    return _submit(lambda: reducescatter(t, op=op, name=name))
+
+
+def alltoall_async(t, splits=None, name: Optional[str] = None) -> int:
+    return _submit(lambda: alltoall(t, splits=splits, name=name))
+
+
+def grouped_allreduce_(tensors, op: str = Average, name=None):
+    """In-place allreduce of a list (torch/mpi_ops.py grouped ops)."""
+    return [allreduce_(t, op=op) for t in tensors]
+
+
+def grouped_allreduce(tensors, op: str = Average, name=None):
+    return [allreduce(t, op=op) for t in tensors]
+
+
+def grouped_allreduce_async_(tensors, op: str = Average, name=None) -> int:
+    return _submit(lambda: grouped_allreduce_(tensors, op=op))
+
+
+def grouped_allreduce_async(tensors, op: str = Average, name=None) -> int:
+    return _submit(lambda: grouped_allreduce(tensors, op=op))
+
+
+def sparse_allreduce_async(t, name: Optional[str] = None,
+                           op: str = Average) -> int:
+    """Average a sparse COO tensor across ranks via allgather of
+    indices/values — exactly the reference's sparse strategy
+    (torch/mpi_ops.py:567: two allgathers re-assembled into a sparse
+    tensor, divided by size)."""
+    import torch
+    if op != Average:
+        raise ValueError("sparse_allreduce_async supports op=Average "
+                         "(reference: torch/mpi_ops.py:567)")
+
+    def run():
+        sp = t.coalesce()
+        idx = sp.indices().numpy()
+        val = sp.values().numpy()
+        pieces = _plane.allgather_object((idx, val))
+        cat_idx = np.concatenate([p[0] for p in pieces], axis=1)
+        cat_val = np.concatenate([p[1] for p in pieces], axis=0)
+        out = torch.sparse_coo_tensor(
+            torch.from_numpy(cat_idx), torch.from_numpy(cat_val),
+            size=sp.shape).coalesce()
+        return out / _plane.size()
+
+    return _submit(run)
 
 
 # -- state sync (torch/functions.py) ----------------------------------------
@@ -231,3 +432,120 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     return _DistributedOptimizer(
         optimizer, named_parameters, op, backward_passes_per_step,
         gradient_predivide_factor)
+
+
+# -- SyncBatchNorm (torch/sync_batch_norm.py) --------------------------------
+
+def _make_sync_bn_function():
+    import torch
+
+    class _SyncBNFunc(torch.autograd.Function):
+        """Cross-rank batch norm: global mean/var in forward, global
+        sum_dy/sum_dy_xmu in backward (the reference's
+        torch/sync_batch_norm.py:40,99 _SyncBatchNorm Function, same
+        math, allreduce over the shared plane)."""
+
+        @staticmethod
+        def forward(ctx, x, weight, bias, mean, invstd, count):
+            # mean/invstd/count are the GLOBAL stats, computed once by
+            # the module (one allreduce total) and treated as constants
+            # here — backward implements the full cross-rank gradient
+            # explicitly, so no autograd flow through them is needed
+            dims = [0] + list(range(2, x.dim()))
+            c = x.shape[1]
+            shape = [1, c] + [1] * (x.dim() - 2)
+            xhat = (x - mean.view(shape)) * invstd.view(shape)
+            out = xhat * weight.view(shape) + bias.view(shape)
+            ctx.save_for_backward(xhat, weight, invstd)
+            ctx.count = count
+            ctx.dims = dims
+            ctx.shape = shape
+            return out
+
+        @staticmethod
+        def backward(ctx, dy):
+            xhat, weight, invstd = ctx.saved_tensors
+            dims, shape, count = ctx.dims, ctx.shape, ctx.count
+            sum_dy = dy.sum(dims)
+            sum_dy_xhat = (dy * xhat).sum(dims)
+            both = torch.cat([sum_dy, sum_dy_xhat])
+            total = _ordered(lambda: _plane.allreduce_np(
+                both.detach().contiguous().numpy().copy()))
+            c = xhat.shape[1]
+            g_sum_dy = torch.from_numpy(total[:c]).to(dy.dtype)
+            g_sum_dy_xhat = torch.from_numpy(total[c:]).to(dy.dtype)
+            dx = (dy - g_sum_dy.view(shape) / count
+                  - xhat * g_sum_dy_xhat.view(shape) / count) \
+                * (weight * invstd).view(shape)
+            # dweight/dbias stay local sums; the DistributedOptimizer's
+            # gradient allreduce combines them like any other grad
+            dweight = sum_dy_xhat
+            dbias = sum_dy
+            return dx, dweight, dbias, None, None, None
+
+    return _SyncBNFunc
+
+
+_SYNC_BN_FUNC = None
+
+
+def SyncBatchNorm(num_features: int, eps: float = 1e-5,
+                  momentum: float = 0.1, affine: bool = True,
+                  track_running_stats: bool = True):
+    """Batch norm whose statistics are computed over the GLOBAL batch
+    across ranks (reference: horovod/torch/sync_batch_norm.py). Falls
+    back to regular BatchNorm statistics when size() == 1 or in eval
+    mode. Returns a torch.nn.Module."""
+    import torch
+
+    global _SYNC_BN_FUNC
+    if _SYNC_BN_FUNC is None:
+        _SYNC_BN_FUNC = _make_sync_bn_function()
+    func = _SYNC_BN_FUNC
+
+    class _SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+        def _check_input_dim(self, x):
+            if x.dim() < 2:
+                raise ValueError("expected at least 2D input")
+
+        def forward(self, x):
+            self._check_input_dim(x)
+            if (not self.training) or _plane.size() == 1:
+                return super().forward(x)
+            c = x.shape[1]
+            w = self.weight if self.weight is not None \
+                else torch.ones(c, dtype=x.dtype)
+            b = self.bias if self.bias is not None \
+                else torch.zeros(c, dtype=x.dtype)
+            # ONE stats allreduce per forward, shared between
+            # normalization and the running-stats update
+            with torch.no_grad():
+                dims = [0] + list(range(2, x.dim()))
+                cnt = float(x.numel() // c)
+                st = torch.cat([x.sum(dims), (x * x).sum(dims),
+                                torch.tensor([cnt], dtype=x.dtype)])
+                tot = _ordered(lambda: _plane.allreduce_np(
+                    st.contiguous().numpy().copy()))
+                n = float(tot[-1])
+                mean = torch.from_numpy(tot[:c] / n).to(x.dtype)
+                # E[x^2]-mean^2 can go slightly negative from float
+                # cancellation; clamp before rsqrt
+                var = (torch.from_numpy(tot[c:2 * c] / n).to(x.dtype)
+                       - mean * mean).clamp_min_(0.0)
+                invstd = torch.rsqrt(var + self.eps)
+            out = func.apply(x, w, b, mean, invstd, n)
+            if self.track_running_stats:
+                with torch.no_grad():
+                    self.num_batches_tracked += 1
+                    # momentum=None means cumulative moving average
+                    # (torch._BatchNorm semantics)
+                    m = self.momentum if self.momentum is not None \
+                        else 1.0 / float(self.num_batches_tracked)
+                    unbiased = var * n / max(n - 1.0, 1.0)
+                    self.running_mean.mul_(1 - m).add_(mean, alpha=m)
+                    self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
+            return out
+
+    return _SyncBatchNorm(num_features, eps=eps, momentum=momentum,
+                          affine=affine,
+                          track_running_stats=track_running_stats)
