@@ -76,8 +76,10 @@ def run_benchmark():
     # Per-chip batch sized for one v5e chip in bf16; smaller on CPU so the
     # harness still runs in CI.
     heavy = model_name in ("vgg16", "inception3", "resnet101")
-    per_chip_batch = (32 if heavy else 64) if platform == "tpu" \
-        else (1 if heavy else 2)
+    # B=32 per chip: an on-hardware sweep (docs/benchmarks.md round-3
+    # record) measured 16/32/48/64/128 and found the old default 64 the
+    # WORST point (2.3k img/s vs 2.6-2.8k for 32-128)
+    per_chip_batch = 32 if platform == "tpu" else (1 if heavy else 2)
     # HVD_BENCH_BATCH overrides the per-chip batch (sweep support; the
     # default operating point was chosen by an on-hardware sweep)
     if os.environ.get("HVD_BENCH_BATCH"):

@@ -6,3 +6,4 @@ from .sampler import ElasticSampler                        # noqa: F401
 from .discovery import (HostDiscovery, HostDiscoveryScript,  # noqa: F401
                         FixedHostDiscovery, HostManager, HostState)
 from .driver import ElasticDriver                          # noqa: F401
+from ..checkpoint import FileBackedState                   # noqa: F401

@@ -12,4 +12,4 @@ from .runner import (                                          # noqa: F401
 from .strategy import (                                        # noqa: F401
     PlacementPlan, colocated_plan, spread_plan,
 )
-from .elastic import RayHostDiscovery                          # noqa: F401
+from .elastic import ElasticRayExecutor, RayHostDiscovery      # noqa: F401
