@@ -1,0 +1,163 @@
+"""TensorFlow-eager binding: DistributedGradientTape + collectives.
+
+Re-design of the reference's `import horovod.tensorflow as hvd` surface
+for custom TF2 eager training loops (horovod/tensorflow/__init__.py:
+_DistributedGradientTape :1026, DistributedGradientTape :1110,
+broadcast_variables functions.py:66). model.fit users should use
+`horovod_tpu.interop.keras` instead; this module serves hand-written
+`tf.GradientTape` loops. Collectives ride the same two-level CPU plane
+as the torch/keras bindings (shm within a host, native TCP store across
+hosts).
+
+Usage (mirrors `import horovod.tensorflow as hvd`):
+
+    import horovod_tpu.interop.tf as hvd
+    hvd.init()
+    with tf.GradientTape() as tape:
+        loss = loss_fn(model(x), y)
+    tape = hvd.DistributedGradientTape(tape)
+    grads = tape.gradient(loss, model.trainable_variables)  # averaged
+    opt.apply_gradients(zip(grads, model.trainable_variables))
+    hvd.broadcast_variables(model.variables, root_rank=0)   # once, at start
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from . import _plane
+
+Average = _plane.Average
+Sum = _plane.Sum
+
+
+def init(comm_name: Optional[str] = None) -> None:
+    _plane.init(comm_name, default_job="local")
+
+
+def shutdown() -> None:
+    _plane.shutdown()
+
+
+rank = _plane.rank
+size = _plane.size
+local_rank = _plane.local_rank
+local_size = _plane.local_size
+is_initialized = _plane.is_initialized
+broadcast_object = _plane.broadcast_object
+allgather_object = _plane.allgather_object
+
+
+# The tensor collectives are the keras binding's (same plane, same
+# numpy staging, 0-d shape restoration, IndexedSlices handling) —
+# ONE maintained implementation for both tf front ends
+from .keras import (                                           # noqa: F401
+    allgather, allreduce, broadcast, broadcast_global_variables,
+    broadcast_variables,
+)
+
+
+def barrier() -> None:
+    _plane.barrier()
+
+
+class _DistributedGradientTape:
+    """Proxy around a tf.GradientTape whose gradient() returns
+    allreduce-averaged gradients (tensorflow/__init__.py:1026). Local
+    sources registered via register_local_source keep their rank-local
+    gradient (:1045)."""
+
+    def __init__(self, tape, op: str = Average,
+                 gradient_predivide_factor: float = 1.0,
+                 sparse_as_dense: bool = False) -> None:
+        if gradient_predivide_factor != 1.0 and op != Average:
+            raise ValueError("gradient_predivide_factor requires "
+                             "op=Average")
+        self._tape = tape
+        self._op = op
+        self._predivide = float(gradient_predivide_factor)
+        self._sparse_as_dense = sparse_as_dense
+        self._local_ids = set()
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def register_local_source(self, source) -> None:
+        """Keep `source`'s gradient rank-local (reference :1045)."""
+        self._local_ids.add(id(source))
+
+    def _reduce_sparse(self, g):
+        """IndexedSlices allreduce as an allgather of (indices, values)
+        — the reference's sparse strategy when sparse_as_dense=False
+        (tensorflow/__init__.py:59-233 sparse handling)."""
+        import tensorflow as tf
+        pieces = _plane.allgather_object(
+            (g.indices.numpy(), g.values.numpy()))
+        idx = np.concatenate([p[0] for p in pieces], axis=0)
+        vals = np.concatenate([p[1] for p in pieces], axis=0)
+        if self._op == Average:
+            vals = (vals / _plane.size()).astype(vals.dtype)
+        return tf.IndexedSlices(tf.constant(vals), tf.constant(idx),
+                                dense_shape=g.dense_shape)
+
+    def gradient(self, target, sources, output_gradients=None):
+        import tensorflow as tf
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients)
+        if _plane.size() == 1:
+            return grads
+        flat_sources = tf.nest.flatten(sources)
+        out = []
+        for g, s in zip(tf.nest.flatten(grads), flat_sources):
+            if g is None or id(s) in self._local_ids:
+                out.append(g)
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                if not self._sparse_as_dense:
+                    out.append(self._reduce_sparse(g))
+                    continue
+                g = tf.convert_to_tensor(g)
+            arr = np.ascontiguousarray(g.numpy())
+            if self._predivide != 1.0:
+                arr = arr / self._predivide
+            red = _plane.allreduce_np(arr)
+            if self._op == Average:
+                red = red / _plane.size()
+            if self._predivide != 1.0:
+                red = red * self._predivide
+            # ascontiguousarray promotes 0-d to (1,): restore the shape
+            red = red.astype(arr.dtype).reshape(tuple(g.shape))
+            out.append(tf.constant(red, dtype=g.dtype))
+        return tf.nest.pack_sequence_as(grads, out)
+
+
+def DistributedGradientTape(gradtape, op: str = Average,
+                            gradient_predivide_factor: float = 1.0,
+                            sparse_as_dense: bool = False,
+                            **_ignored) -> _DistributedGradientTape:
+    """Factory mirroring hvd.DistributedGradientTape
+    (tensorflow/__init__.py:1110); device/compression kwargs accepted
+    and ignored for signature parity."""
+    return _DistributedGradientTape(
+        gradtape, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        sparse_as_dense=sparse_as_dense)
+
+
+def PartialDistributedGradientTape(gradtape, local_layers=None, **kwargs):
+    """Reference tensorflow/__init__.py:1189: a DistributedGradientTape
+    with every variable of `local_layers` registered as a local
+    source."""
+    tape = DistributedGradientTape(gradtape, **kwargs)
+    for layer in (local_layers or []):
+        for v in getattr(layer, "variables", [layer]):
+            tape.register_local_source(v)
+    return tape

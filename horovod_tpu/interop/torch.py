@@ -315,6 +315,23 @@ def grouped_allreduce_async(tensors, op: str = Average, name=None) -> int:
     return _submit(lambda: grouped_allreduce(tensors, op=op))
 
 
+def grouped_allgather(tensors, name=None):
+    """List-of-tensors allgather (torch/mpi_ops.py grouped_allgather)."""
+    return [allgather(t) for t in tensors]
+
+
+def grouped_allgather_async(tensors, name=None) -> int:
+    return _submit(lambda: grouped_allgather(tensors))
+
+
+def grouped_reducescatter(tensors, op: str = Average, name=None):
+    return [reducescatter(t, op=op) for t in tensors]
+
+
+def grouped_reducescatter_async(tensors, op: str = Average, name=None) -> int:
+    return _submit(lambda: grouped_reducescatter(tensors, op=op))
+
+
 def sparse_allreduce_async(t, name: Optional[str] = None,
                            op: str = Average) -> int:
     """Average a sparse COO tensor across ranks via allgather of

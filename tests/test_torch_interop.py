@@ -164,6 +164,16 @@ def _torch_async_ops_worker():
     assert torch.allclose(ts[0], torch.full((3,), 1.5))
     assert torch.allclose(ts[1], torch.full((2,), 10.5))
 
+    # grouped allgather / reducescatter
+    hg2 = hvd.grouped_allgather_async([torch.full((1, 2), float(r)),
+                                       torch.full((2, 2), float(r + 5))])
+    g1, g2 = hvd.synchronize(hg2)
+    assert g1.shape == (2, 2) and g2.shape == (4, 2)
+    assert torch.allclose(g1[1], torch.ones(2))
+    rs1, = hvd.grouped_reducescatter([torch.full((4,), float(r + 1))],
+                                     op=hvd.Sum)
+    assert torch.allclose(rs1, torch.full((2,), 3.0)), rs1
+
     # sparse allreduce: union of indices, averaged values
     i = torch.tensor([[0, 2]]) if r == 0 else torch.tensor([[1, 2]])
     v = torch.tensor([1.0, 2.0]) if r == 0 else torch.tensor([3.0, 4.0])
