@@ -1,0 +1,82 @@
+"""Shared scaffolding for framework-specific elastic states.
+
+The reference gives every framework its own State handler
+(horovod/common/elastic.py:60 State, torch/elastic/state.py TorchState,
+keras/elastic.py KerasState) that shares one contract: extra kwargs
+become named attributes, `commit()` snapshots, `restore()` rolls back
+to the last snapshot, and `sync()` broadcasts rank 0's live state THEN
+refreshes the snapshot (common/elastic.py ObjectState.sync — without
+the save-after-sync, a restore() after a post-join failure would roll
+ranks back to pre-sync divergent states).
+
+This base is deliberately jax-free so the torch/keras bindings can
+import it without pulling jax into their worker processes; the jax
+State in elastic/state.py keeps its own pytree-aware implementation.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List
+
+
+class BaseFrameworkState:
+    """Subclasses implement `_save_payload() -> Any`,
+    `_restore_payload(snapshot)`, `_sync_payload(root_rank)`, and
+    `_broadcast_extras(extras, root_rank) -> extras`."""
+
+    def __init__(self, **extras):
+        self._extras: Dict[str, Any] = dict(extras)
+        self._saved = None
+        self._reset_callbacks: List[Callable] = []
+        self.commit()
+
+    def __getattr__(self, name):
+        extras = object.__getattribute__(self, "_extras")
+        if name in extras:
+            return extras[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._extras[name] = value
+
+    def register_reset_callbacks(self, callbacks: List[Callable]) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def save(self) -> None:
+        self._saved = {"extras": copy.deepcopy(self._extras),
+                       "payload": self._save_payload()}
+
+    def commit(self) -> None:
+        self.save()
+
+    def restore(self) -> None:
+        self._extras = copy.deepcopy(self._saved["extras"])
+        self._restore_payload(self._saved["payload"])
+
+    def sync(self, root_rank: int = 0) -> None:
+        self._sync_payload(root_rank)
+        self._extras = self._broadcast_extras(self._extras, root_rank)
+        # refresh the snapshot: a restore() after sync must reproduce
+        # the synced state, not each rank's pre-sync one
+        self.save()
+
+    # -- subclass hooks ------------------------------------------------
+
+    def _save_payload(self):
+        raise NotImplementedError
+
+    def _restore_payload(self, snapshot) -> None:
+        raise NotImplementedError
+
+    def _sync_payload(self, root_rank: int) -> None:
+        raise NotImplementedError
+
+    def _broadcast_extras(self, extras, root_rank: int):
+        raise NotImplementedError

@@ -31,6 +31,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from . import _plane
+from ..elastic._base_state import BaseFrameworkState as _BaseFrameworkState
 from . import keras_callbacks as callbacks  # noqa: F401  (hvd.callbacks.*)
 
 Average = _plane.Average
@@ -256,3 +257,34 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
         objects[f"Distributed{cls.__name__}"] = _dist_class(cls)
     objects.update(custom_objects or {})
     return keras.models.load_model(filepath, custom_objects=objects)
+
+
+class KerasState(_BaseFrameworkState):
+    """Elastic in-memory checkpoint for a keras model (reference
+    horovod/keras/elastic.py KerasState / _keras/elastic.py): commit()
+    snapshots the weights, restore() rolls back, sync() broadcasts rank
+    0's weights + extras (then refreshes the snapshot) so re-admitted
+    workers converge. Extra kwargs become named attributes."""
+
+    def __init__(self, model, **extras):
+        self._model = model
+        super().__init__(**extras)
+
+    def _save_payload(self):
+        return [w.copy() for w in self._model.get_weights()]
+
+    def _restore_payload(self, weights):
+        self._model.set_weights([w.copy() for w in weights])
+
+    def _sync_payload(self, root_rank):
+        if _plane.size() == 1:
+            return
+        synced = [_plane.broadcast_np(np.ascontiguousarray(w),
+                                      root=root_rank).reshape(w.shape)
+                  for w in self._model.get_weights()]
+        self._model.set_weights(synced)
+
+    def _broadcast_extras(self, extras, root_rank):
+        if _plane.size() == 1:
+            return extras
+        return _plane.broadcast_object(extras, root_rank=root_rank)

@@ -154,10 +154,16 @@ def DistributedGradientTape(gradtape, op: str = Average,
 
 def PartialDistributedGradientTape(gradtape, local_layers=None, **kwargs):
     """Reference tensorflow/__init__.py:1189: a DistributedGradientTape
-    with every variable of `local_layers` registered as a local
-    source."""
+    with every trainable weight of `local_layers` registered as a local
+    source. A single layer is accepted like the reference (:1210-1213
+    wraps a bare Layer in a list)."""
+    import tensorflow as tf
     tape = DistributedGradientTape(gradtape, **kwargs)
-    for layer in (local_layers or []):
-        for v in getattr(layer, "variables", [layer]):
+    if local_layers is None:
+        local_layers = []
+    elif isinstance(local_layers, tf.keras.layers.Layer):
+        local_layers = [local_layers]
+    for layer in local_layers:
+        for v in getattr(layer, "trainable_weights", [layer]):
             tape.register_local_source(v)
     return tape

@@ -28,6 +28,7 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 import numpy as np
 
 from . import _plane
+from ..elastic._base_state import BaseFrameworkState as _BaseFrameworkState
 
 Average = _plane.Average
 Sum = _plane.Sum
@@ -449,6 +450,53 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     return _DistributedOptimizer(
         optimizer, named_parameters, op, backward_passes_per_step,
         gradient_predivide_factor)
+
+
+# -- elastic state (torch/elastic/state.py TorchState) ----------------------
+
+class TorchState(_BaseFrameworkState):
+    """Elastic in-memory checkpoint for a torch model + optimizer
+    (reference horovod/torch/elastic/state.py:27-120 TorchState):
+    `commit()` snapshots, `restore()` rolls back to the last commit,
+    `sync()` broadcasts rank 0's weights/optimizer/extras (then
+    refreshes the snapshot) so re-admitted workers converge. Extra
+    kwargs become named attributes (epoch=..., batch=...)."""
+
+    def __init__(self, model=None, optimizer=None, **extras):
+        self._model = model
+        self._optimizer = optimizer
+        super().__init__(**extras)
+
+    def _save_payload(self):
+        import copy
+        snap = {}
+        if self._model is not None:
+            snap["model"] = copy.deepcopy(self._model.state_dict())
+        if self._optimizer is not None:
+            snap["opt"] = copy.deepcopy(self._optimizer.state_dict())
+        return snap
+
+    def _restore_payload(self, snap):
+        import copy
+        if self._model is not None and "model" in snap:
+            self._model.load_state_dict(copy.deepcopy(snap["model"]))
+        if self._optimizer is not None and "opt" in snap:
+            self._optimizer.load_state_dict(copy.deepcopy(snap["opt"]))
+
+    def _sync_payload(self, root_rank):
+        if _plane.size() == 1:
+            return
+        if self._model is not None:
+            broadcast_parameters(self._model.state_dict(),
+                                 root_rank=root_rank)
+        if self._optimizer is not None:
+            broadcast_optimizer_state(self._optimizer,
+                                      root_rank=root_rank)
+
+    def _broadcast_extras(self, extras, root_rank):
+        if _plane.size() == 1:
+            return extras
+        return _plane.broadcast_object(extras, root_rank=root_rank)
 
 
 # -- SyncBatchNorm (torch/sync_batch_norm.py) --------------------------------

@@ -173,6 +173,52 @@ def test_keras_register_local_var_multiprocess():
     assert results == [0.0, 1.0]
 
 
+def _keras_elastic_state_worker():
+    """KerasState commit/restore/sync (reference horovod/keras/elastic.py)."""
+    import keras
+    import numpy as np
+    import horovod_tpu.interop.keras as hvd
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    keras.utils.set_random_seed(60 + r)           # diverged weights
+    model = keras.Sequential([keras.layers.Input((3,)),
+                              keras.layers.Dense(2)])
+    state = hvd.KerasState(model, epoch=r)
+
+    state.sync()
+    assert state.epoch == 0
+    flat = np.concatenate([w.ravel() for w in model.get_weights()])
+    ws = hvd.allgather_object(flat)
+    np.testing.assert_allclose(ws[0], ws[1])
+    # restore() right after sync keeps the synced weights
+    state.restore()
+    flat2 = np.concatenate([w.ravel() for w in model.get_weights()])
+    np.testing.assert_allclose(flat2, ws[0])
+
+    state.commit()
+    committed = [w.copy() for w in model.get_weights()]
+    model.set_weights([w + 1.0 for w in model.get_weights()])
+    state.epoch = 9
+    state.restore()
+    for got, want in zip(model.get_weights(), committed):
+        np.testing.assert_allclose(got, want)
+    assert state.epoch == 0
+
+    hvd.shutdown()
+    return 1.0
+
+
+def test_keras_elastic_state_multiprocess():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_keras_elastic_state_worker, num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [1.0, 1.0]
+
+
 def _keras_estimator_worker(store_root):
     """2-process spark-layer KerasEstimator: per-rank parquet shards,
     distributed optimizer, rank-0 checkpoint to the Store."""

@@ -88,6 +88,19 @@ def _tf_worker():
     # averaged: row0 0.5, row1 1.0, row2 1.5
     np.testing.assert_allclose(dense[:, 0], [0.5, 1.0, 1.5, 0.0])
 
+    # PartialDistributedGradientTape accepts a single bare layer
+    layer = tf.keras.layers.Dense(1)
+    layer.build((None, 2))
+    shared = tf.Variable([2.0])
+    with tf.GradientTape() as tp:
+        lossp = float(r + 1) * (tf.reduce_sum(layer(tf.ones((1, 2))))
+                                + tf.reduce_sum(shared))
+    ptape = hvd.PartialDistributedGradientTape(tp, local_layers=layer)
+    gs_p = ptape.gradient(lossp, [layer.kernel, shared])
+    np.testing.assert_allclose(gs_p[0].numpy(),
+                               np.full((2, 1), float(r + 1)))  # local
+    np.testing.assert_allclose(gs_p[1].numpy(), [1.5])          # averaged
+
     # full train-loop identity across replicas (shared data, diverged init)
     tf.random.set_seed(100 + r)
     model = tf.keras.Sequential([tf.keras.layers.Input((4,)),

@@ -255,6 +255,54 @@ def _torch_sync_bn_worker():
     return 1.0
 
 
+def _torch_elastic_state_worker():
+    """TorchState commit/restore/sync (reference
+    torch/elastic/state.py:27-120)."""
+    import torch
+    import horovod_tpu.interop.torch as hvd
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    torch.manual_seed(50 + r)                    # diverged weights
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    state = hvd.TorchState(model=model, optimizer=opt, epoch=0, batch=0)
+
+    # sync: every rank converges to rank 0's weights + extras
+    state.epoch = r                              # diverged extra
+    state.sync()
+    assert state.epoch == 0
+    w0 = hvd.allgather_object(model.weight.detach().numpy().copy())
+    np.testing.assert_allclose(w0[0], w0[1])
+    # sync refreshes the snapshot: restore() right after must keep the
+    # SYNCED weights, not roll back to the pre-sync diverged ones
+    state.restore()
+    np.testing.assert_allclose(model.weight.detach().numpy(), w0[0])
+
+    # commit -> mutate -> restore rolls everything back
+    state.commit()
+    committed = model.weight.detach().numpy().copy()
+    with torch.no_grad():
+        model.weight += 1.0
+    state.epoch = 7
+    state.restore()
+    np.testing.assert_allclose(model.weight.detach().numpy(), committed)
+    assert state.epoch == 0
+
+    hvd.shutdown()
+    return 1.0
+
+
+def test_torch_elastic_state_multiprocess():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_torch_elastic_state_worker, num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [1.0, 1.0]
+
+
 def test_torch_sync_batch_norm_multiprocess():
     from horovod_tpu.spark import MultiprocessingJobRunner, run
     results = run(_torch_sync_bn_worker, num_proc=2,
