@@ -79,4 +79,10 @@ class BaseFrameworkState:
         raise NotImplementedError
 
     def _broadcast_extras(self, extras, root_rank: int):
-        raise NotImplementedError
+        # default: pickle-broadcast over the interop CPU plane (late
+        # import keeps this module importable without the plane); the
+        # plane's object ops already no-op at size 1
+        from ..interop import _plane
+        if _plane.size() == 1:
+            return extras
+        return _plane.broadcast_object(extras, root_rank=root_rank)

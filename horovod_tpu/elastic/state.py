@@ -24,6 +24,11 @@ class State:
 
     Subclasses or instances carry named values; `register_reset_callbacks`
     mirrors the reference hook invoked after a topology change.
+
+    The foreign-framework bindings implement the same contract
+    (commit/restore/sync-then-save, extras attributes) on
+    `elastic/_base_state.py BaseFrameworkState`; this jax State keeps
+    its own pytree-aware implementation — change semantics in BOTH.
     """
 
     def __init__(self, **kwargs):

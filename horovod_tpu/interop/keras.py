@@ -277,14 +277,4 @@ class KerasState(_BaseFrameworkState):
         self._model.set_weights([w.copy() for w in weights])
 
     def _sync_payload(self, root_rank):
-        if _plane.size() == 1:
-            return
-        synced = [_plane.broadcast_np(np.ascontiguousarray(w),
-                                      root=root_rank).reshape(w.shape)
-                  for w in self._model.get_weights()]
-        self._model.set_weights(synced)
-
-    def _broadcast_extras(self, extras, root_rank):
-        if _plane.size() == 1:
-            return extras
-        return _plane.broadcast_object(extras, root_rank=root_rank)
+        broadcast_variables(self._model.weights, root_rank=root_rank)

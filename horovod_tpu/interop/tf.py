@@ -27,6 +27,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from . import _plane
+from ..elastic._base_state import BaseFrameworkState as _BaseFrameworkState
 
 Average = _plane.Average
 Sum = _plane.Sum
@@ -167,3 +168,27 @@ def PartialDistributedGradientTape(gradtape, local_layers=None, **kwargs):
         for v in getattr(layer, "trainable_weights", [layer]):
             tape.register_local_source(v)
     return tape
+
+
+class TensorFlowState(_BaseFrameworkState):
+    """Elastic in-memory checkpoint for a set of tf.Variables
+    (reference horovod/tensorflow/elastic.py:156 TensorFlowState):
+    commit() snapshots the variable values, restore() rolls back,
+    sync() broadcasts rank 0's values + extras and refreshes the
+    snapshot. Pass `variables=model.variables` (TF2 has no global
+    collection). The keras-model flavor (TensorFlowKerasState, :91)
+    is `horovod_tpu.interop.keras.KerasState`."""
+
+    def __init__(self, variables=None, **extras):
+        self._variables = list(variables or [])
+        super().__init__(**extras)
+
+    def _save_payload(self):
+        return [np.array(v.numpy(), copy=True) for v in self._variables]
+
+    def _restore_payload(self, values):
+        for v, val in zip(self._variables, values):
+            v.assign(val)
+
+    def _sync_payload(self, root_rank):
+        broadcast_variables(self._variables, root_rank=root_rank)

@@ -477,11 +477,13 @@ class TorchState(_BaseFrameworkState):
         return snap
 
     def _restore_payload(self, snap):
-        import copy
+        # load_state_dict already copies incoming values (module:
+        # param.copy_; optimizer: internal deepcopy), so the snapshot
+        # is never aliased by the live objects
         if self._model is not None and "model" in snap:
-            self._model.load_state_dict(copy.deepcopy(snap["model"]))
+            self._model.load_state_dict(snap["model"])
         if self._optimizer is not None and "opt" in snap:
-            self._optimizer.load_state_dict(copy.deepcopy(snap["opt"]))
+            self._optimizer.load_state_dict(snap["opt"])
 
     def _sync_payload(self, root_rank):
         if _plane.size() == 1:
@@ -492,11 +494,6 @@ class TorchState(_BaseFrameworkState):
         if self._optimizer is not None:
             broadcast_optimizer_state(self._optimizer,
                                       root_rank=root_rank)
-
-    def _broadcast_extras(self, extras, root_rank):
-        if _plane.size() == 1:
-            return extras
-        return _plane.broadcast_object(extras, root_rank=root_rank)
 
 
 # -- SyncBatchNorm (torch/sync_batch_norm.py) --------------------------------

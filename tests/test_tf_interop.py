@@ -101,6 +101,16 @@ def _tf_worker():
                                np.full((2, 1), float(r + 1)))  # local
     np.testing.assert_allclose(gs_p[1].numpy(), [1.5])          # averaged
 
+    # TensorFlowState: sync converges, restore-after-sync keeps synced
+    sv = tf.Variable(np.full(2, float(r), np.float32))
+    st = hvd.TensorFlowState(variables=[sv], epoch=r)
+    st.sync()
+    assert st.epoch == 0
+    np.testing.assert_allclose(sv.numpy(), [0.0, 0.0])
+    sv.assign([5.0, 5.0])
+    st.restore()
+    np.testing.assert_allclose(sv.numpy(), [0.0, 0.0])
+
     # full train-loop identity across replicas (shared data, diverged init)
     tf.random.set_seed(100 + r)
     model = tf.keras.Sequential([tf.keras.layers.Input((4,)),
