@@ -32,7 +32,11 @@ namespace {
 
 constexpr uint64_t kMagic = 0x48564453484d0001ull;  // "HVDSHM" v1
 
-enum DType : int { DT_F32 = 0, DT_F64 = 1, DT_I32 = 2, DT_I64 = 3 };
+enum DType : int {
+  DT_F32 = 0, DT_F64 = 1, DT_I32 = 2, DT_I64 = 3,
+  DT_F16 = 4  // reduced via software half<->float conversion (the role
+              // of the reference's fp16 CPU math, common/half.cc:30-54)
+};
 enum RedOp : int { OP_SUM = 0, OP_PROD = 1, OP_MIN = 2, OP_MAX = 3 };
 
 struct Header {
@@ -118,11 +122,101 @@ void reduce_chunk(Comm* c, uint64_t begin, uint64_t end, int op) {
 
 size_t dtype_size(int dtype) {
   switch (dtype) {
+    case DT_F16:
+      return 2;
     case DT_F32:
     case DT_I32:
       return 4;
     default:
       return 8;
+  }
+}
+
+// IEEE-754 binary16 <-> binary32, scalar software conversion with
+// round-to-nearest-even on the way down (the reference keeps a scalar
+// fallback beside its F16C fast path, half.cc:30-54).
+inline float half_to_float(uint16_t h) {
+  uint32_t sign = static_cast<uint32_t>(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1fu;
+  uint32_t man = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (man == 0) {
+      f = sign;
+    } else {  // subnormal half: renormalize into a normal float
+      uint32_t e = 113;  // 127 - 15 + 1
+      while (!(man & 0x400u)) {
+        man <<= 1;
+        --e;
+      }
+      man &= 0x3ffu;
+      f = sign | (e << 23) | (man << 13);
+    }
+  } else if (exp == 31) {  // inf / nan
+    f = sign | 0x7f800000u | (man << 13);
+  } else {
+    f = sign | ((exp + 112) << 23) | (man << 13);
+  }
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t float_to_half(float v) {
+  uint32_t f;
+  std::memcpy(&f, &v, 4);
+  uint16_t sign = static_cast<uint16_t>((f >> 16) & 0x8000u);
+  uint32_t fexp = (f >> 23) & 0xffu;
+  uint32_t man = f & 0x7fffffu;
+  if (fexp == 0xffu) {  // inf / nan
+    return sign | 0x7c00u | (man ? 0x200u : 0u);
+  }
+  int32_t exp = static_cast<int32_t>(fexp) - 127 + 15;
+  if (exp >= 31) return sign | 0x7c00u;  // overflow -> inf
+  if (exp <= 0) {                        // subnormal half or zero
+    if (exp < -10) return sign;
+    man |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint16_t h = static_cast<uint16_t>(man >> shift);
+    uint32_t rem = man & ((1u << shift) - 1u);
+    uint32_t mid = 1u << (shift - 1);
+    if (rem > mid || (rem == mid && (h & 1u))) ++h;
+    return sign | h;
+  }
+  uint16_t h = sign | static_cast<uint16_t>(exp << 10) |
+               static_cast<uint16_t>(man >> 13);
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (h & 1u))) ++h;
+  return h;
+}
+
+void reduce_chunk_f16(Comm* c, uint64_t begin, uint64_t end, int op) {
+  // element-outer with a float accumulator: one rounding per element
+  // (rank-outer would re-round per rank, compounding error — e.g.
+  // 1024 + 0.4*3 pairwise-rounds to 1024, accumulated rounds to 1025)
+  uint16_t* out = reinterpret_cast<uint16_t*>(c->result());
+  for (uint64_t i = begin; i < end; ++i) {
+    float acc = half_to_float(
+        reinterpret_cast<const uint16_t*>(c->slot(0))[i]);
+    for (int r = 1; r < c->size; ++r) {
+      float b = half_to_float(
+          reinterpret_cast<const uint16_t*>(c->slot(r))[i]);
+      switch (op) {
+        case OP_SUM:
+          acc += b;
+          break;
+        case OP_PROD:
+          acc *= b;
+          break;
+        case OP_MIN:
+          acc = b < acc ? b : acc;
+          break;
+        default:
+          acc = b > acc ? b : acc;
+          break;
+      }
+    }
+    out[i] = float_to_half(acc);
   }
 }
 
@@ -256,7 +350,7 @@ int hvd_shm_allreduce(void* h, void* data, uint64_t count, int dtype, int op,
   auto* c = static_cast<Comm*>(h);
   // validate before the first barrier: a mid-protocol return would
   // desynchronize the sense-reversing barrier for every peer
-  if (dtype < DT_F32 || dtype > DT_I64) return 3;
+  if (dtype < DT_F32 || dtype > DT_F16) return 3;
   size_t esize = dtype_size(dtype);
   uint64_t bytes = count * esize;
   if (bytes > c->capacity) return 2;
@@ -279,6 +373,9 @@ int hvd_shm_allreduce(void* h, void* data, uint64_t count, int dtype, int op,
         break;
       case DT_I64:
         reduce_chunk<int64_t>(c, begin, end, op);
+        break;
+      case DT_F16:
+        reduce_chunk_f16(c, begin, end, op);
         break;
       default:
         return 3;
@@ -325,7 +422,7 @@ int hvd_shm_reducescatter(void* h, const void* in, void* out, uint64_t count,
                           int dtype, int op, double timeout_s) {
   auto* c = static_cast<Comm*>(h);
   if (count % c->size != 0) return 4;
-  if (dtype < DT_F32 || dtype > DT_I64) return 3;
+  if (dtype < DT_F32 || dtype > DT_F16) return 3;
   size_t esize = dtype_size(dtype);
   if (count * esize > c->capacity) return 2;
   std::memcpy(c->slot(c->rank), in, count * esize);
@@ -344,6 +441,9 @@ int hvd_shm_reducescatter(void* h, const void* in, void* out, uint64_t count,
       break;
     case DT_I64:
       reduce_chunk<int64_t>(c, begin, end, op);
+      break;
+    case DT_F16:
+      reduce_chunk_f16(c, begin, end, op);
       break;
     default:
       return 3;

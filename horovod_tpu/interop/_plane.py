@@ -151,3 +151,20 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     buf = blob if _rank == root_rank else np.zeros(int(n[0]), np.uint8)
     buf = _comm.broadcast(buf, root=root_rank)
     return pickle.loads(buf.tobytes())
+
+
+def resolve_compression(c, local_none, local_fp16):
+    """Map the package-level jax compressors (horovod_tpu.Compression.*,
+    optim/compression.py — they operate on jax arrays) to a binding's
+    local numpy/tensor compressors by ROLE, so reference habits like
+    `compression=hvd.Compression.fp16` work against every front end
+    instead of raising deep inside the plane."""
+    try:
+        from ..optim import compression as _jc
+    except Exception:  # pragma: no cover — optim always importable here
+        return c
+    if c in (_jc.NoneCompressor,):
+        return local_none
+    if c in (_jc.FP16Compressor, getattr(_jc, "Float16Compressor", None)):
+        return local_fp16
+    return c

@@ -396,6 +396,36 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
 
 
 
+# -- gradient compression (torch/compression.py) ----------------------------
+
+class Compression:
+    """Gradient compression algorithms (reference torch/compression.py:
+    NoneCompressor, FP16Compressor — static compress/decompress pairs).
+    fp16 halves the bytes staged through the CPU plane; the shm segment
+    reduces float16 natively (csrc reduce_chunk_f16)."""
+
+    class none:  # noqa: N801 — reference naming (hvd.Compression.none)
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:  # noqa: N801 — reference naming (hvd.Compression.fp16)
+        @staticmethod
+        def compress(t):
+            import torch
+            if t.dtype in (torch.float32, torch.float64):
+                return t.half(), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t if ctx is None else t.to(ctx)
+
+
 # -- optimizer wrapper (torch/optimizer.py) ---------------------------------
 
 class _DistributedOptimizer:
@@ -405,11 +435,14 @@ class _DistributedOptimizer:
 
     def __init__(self, optimizer, named_parameters=None, op: str = Average,
                  backward_passes_per_step: int = 1,
-                 gradient_predivide_factor: float = 1.0) -> None:
+                 gradient_predivide_factor: float = 1.0,
+                 compression=Compression.none) -> None:
         self._opt = optimizer
         self.op = op
         self.backward_passes_per_step = int(backward_passes_per_step)
         self.gradient_predivide_factor = float(gradient_predivide_factor)
+        self.compression = _plane.resolve_compression(
+            compression, Compression.none, Compression.fp16)
         self._pass_count = 0
         if named_parameters is not None:
             self._params = [p for _, p in named_parameters]
@@ -425,7 +458,11 @@ class _DistributedOptimizer:
             if p.grad is not None:
                 if self.gradient_predivide_factor != 1.0:
                     p.grad /= self.gradient_predivide_factor
-                allreduce_(p.grad, op=self.op)
+                comp, ctx = self.compression.compress(p.grad)
+                comp = comp.contiguous()
+                allreduce_(comp, op=self.op)
+                if comp.data_ptr() != p.grad.data_ptr():
+                    p.grad.copy_(self.compression.decompress(comp, ctx))
                 if self.gradient_predivide_factor != 1.0:
                     p.grad *= self.gradient_predivide_factor
         self._pass_count = 0
@@ -444,12 +481,13 @@ class _DistributedOptimizer:
 def DistributedOptimizer(optimizer, named_parameters=None,
                          op: str = Average,
                          backward_passes_per_step: int = 1,
-                         gradient_predivide_factor: float = 1.0
+                         gradient_predivide_factor: float = 1.0,
+                         compression=Compression.none
                          ) -> _DistributedOptimizer:
     """Factory mirroring hvd.DistributedOptimizer (torch/optimizer.py:516)."""
     return _DistributedOptimizer(
         optimizer, named_parameters, op, backward_passes_per_step,
-        gradient_predivide_factor)
+        gradient_predivide_factor, compression)
 
 
 # -- elastic state (torch/elastic/state.py TorchState) ----------------------

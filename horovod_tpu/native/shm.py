@@ -19,6 +19,8 @@ _DTYPES = {
     np.dtype(np.float64): 1,
     np.dtype(np.int32): 2,
     np.dtype(np.int64): 3,
+    np.dtype(np.float16): 4,  # reduced natively (csrc reduce_chunk_f16,
+                              # the reference's half.cc role)
 }
 
 _OPS = {"sum": 0, "prod": 1, "min": 2, "max": 3}

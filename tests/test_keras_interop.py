@@ -146,7 +146,10 @@ def _keras_local_var_worker():
     keras.utils.set_random_seed(3)
     model = keras.Sequential([keras.layers.Input((4,)),
                               keras.layers.Dense(2)])
-    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0))
+    # fp16 wire compression: the test values (1, 2, 1.5) are exact in
+    # fp16, so the assertions below double as the compression check
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0),
+                                   compression=hvd.Compression.fp16)
     opt.build(model.trainable_variables)
     kernel, bias = model.trainable_variables
     opt.register_local_var(bias)

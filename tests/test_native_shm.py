@@ -58,6 +58,25 @@ def _allreduce_avg(c, rank, size):
     return True
 
 
+def _allreduce_f16(c, rank, size):
+    """float16 reduced natively (csrc reduce_chunk_f16 — the reference's
+    fp16 CPU math role, half.cc). Small integers are exact in fp16."""
+    x = np.full(1000, float(rank + 1), np.float16)
+    out = c.allreduce(x, "sum")
+    assert out.dtype == np.float16
+    np.testing.assert_allclose(out.astype(np.float32),
+                               sum(range(1, size + 1)))
+    # min/max keep f16 semantics too
+    mn = c.allreduce(np.full(8, float(rank), np.float16), "min")
+    np.testing.assert_allclose(mn.astype(np.float32), 0.0)
+    # subnormal halves survive the conversion round-trip (2^-24)
+    tiny = np.full(8, np.float16(5.96e-08), np.float16)
+    s = c.allreduce(tiny, "sum")
+    np.testing.assert_allclose(s.astype(np.float32), 5.96e-08 * size,
+                               rtol=0.5)
+    return True
+
+
 def _allreduce_minmax(c, rank, size):
     x = np.arange(10, dtype=np.int32) + rank * 100
     mn = c.allreduce(x, "min")
@@ -102,13 +121,15 @@ def _repeated(c, rank, size):
 
 
 @pytest.mark.parametrize("fn", ["_allreduce_sum", "_allreduce_avg",
-                                "_allreduce_minmax", "_allgather",
-                                "_broadcast", "_reducescatter", "_repeated"])
+                                "_allreduce_minmax", "_allreduce_f16",
+                                "_allgather", "_broadcast",
+                                "_reducescatter", "_repeated"])
 def test_shm_collectives_2proc(fn):
     _run(2, fn)
 
 
-@pytest.mark.parametrize("fn", ["_allreduce_sum", "_allgather", "_repeated"])
+@pytest.mark.parametrize("fn", ["_allreduce_sum", "_allgather", "_repeated",
+                                "_allreduce_f16"])
 def test_shm_collectives_4proc(fn):
     _run(4, fn)
 

@@ -32,6 +32,23 @@ def test_single_process_identity():
     hvd.shutdown()
 
 
+def test_package_level_compression_objects_resolve():
+    """`compression=horovod_tpu.Compression.fp16` (the jax compressor)
+    maps by role onto the binding's tensor compressor instead of
+    exploding inside the plane."""
+    import horovod_tpu.interop.torch as hvd
+    from horovod_tpu.optim.compression import Compression as JaxCompression
+    p = torch.nn.Parameter(torch.zeros(3))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([p], lr=1.0), named_parameters=[("p", p)],
+        compression=JaxCompression.fp16)
+    assert opt.compression is hvd.Compression.fp16
+    opt2 = hvd.DistributedOptimizer(
+        torch.optim.SGD([p], lr=1.0), named_parameters=[("p", p)],
+        compression=JaxCompression.none)
+    assert opt2.compression is hvd.Compression.none
+
+
 def test_jax_staging_roundtrip():
     import horovod_tpu.interop.torch as hvd
     t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
@@ -173,6 +190,27 @@ def _torch_async_ops_worker():
     rs1, = hvd.grouped_reducescatter([torch.full((4,), float(r + 1))],
                                      op=hvd.Sum)
     assert torch.allclose(rs1, torch.full((2,), 3.0)), rs1
+
+    # native fp16 allreduce (csrc reduce_chunk_f16): exact for small ints
+    h16 = torch.full((1025,), float(r + 1), dtype=torch.float16)
+    hvd.allreduce_(h16, op=hvd.Sum)
+    assert h16.dtype == torch.float16
+    assert torch.allclose(h16.float(), torch.full((1025,), 3.0)), h16[:4]
+
+    # fp16-compressed optimizer step matches the uncompressed one
+    pa = torch.nn.Parameter(torch.zeros(8))
+    pb = torch.nn.Parameter(torch.zeros(8))
+    for p in (pa, pb):
+        p.grad = torch.full((8,), float(r + 1))
+    oc = hvd.DistributedOptimizer(
+        torch.optim.SGD([pa], lr=1.0), named_parameters=[("a", pa)],
+        compression=hvd.Compression.fp16)
+    on = hvd.DistributedOptimizer(
+        torch.optim.SGD([pb], lr=1.0), named_parameters=[("b", pb)])
+    oc.step(); on.step()
+    assert pa.grad.dtype == torch.float32
+    np.testing.assert_allclose(pa.detach().numpy(), pb.detach().numpy(),
+                               rtol=1e-3)
 
     # sparse allreduce: union of indices, averaged values
     i = torch.tensor([[0, 2]]) if r == 0 else torch.tensor([[1, 2]])
