@@ -167,4 +167,10 @@ def resolve_compression(c, local_none, local_fp16):
         return local_none
     if c in (_jc.FP16Compressor, getattr(_jc, "Float16Compressor", None)):
         return local_fp16
+    if isinstance(c, type) and issubclass(c, _jc.Compressor):
+        # a jax compressor with no binding counterpart (e.g. spar):
+        # fail HERE, at construction, not deep inside a training step
+        raise ValueError(
+            f"{c.__name__} has no counterpart on this binding's CPU "
+            "plane; use the binding's own Compression.none/fp16")
     return c

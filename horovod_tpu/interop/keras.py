@@ -176,13 +176,15 @@ class Compression:
 
 def _dist_class(cls, op: str = Average,
                 gradient_predivide_factor: float = 1.0,
-                compression=Compression.none):
+                compression=Compression.none,
+                backward_passes_per_step: int = 1):
     # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
     # via load_model's custom-object mapping; re-wrapping an already
     # distributed class is an identity (idempotent, no recursive apply)
     if getattr(cls, "_hvd_distributed", False):
         return cls
-    key = (cls, op, gradient_predivide_factor, compression)
+    key = (cls, op, gradient_predivide_factor, compression,
+           backward_passes_per_step)
     if key in _DIST_CLASS_CACHE:
         return _DIST_CLASS_CACHE[key]
     dist_cls = type("Distributed" + cls.__name__, (cls,),
@@ -202,6 +204,43 @@ def _dist_class(cls, op: str = Average,
         import tensorflow as tf
 
         grads = list(grads)  # may be an iterator; consume exactly once
+
+        # local gradient aggregation (reference
+        # tensorflow/gradient_aggregation.py:23): accumulate k
+        # micro-batch gradients, allreduce + apply the mean every k-th.
+        # Skipping apply entirely is only a true no-op in eager mode
+        # (graph mode would need a cond with optimizer side effects),
+        # so k>1 requires eager apply — compile(run_eagerly=True) or a
+        # custom loop.
+        k = backward_passes_per_step
+        if k > 1:
+            if not tf.executing_eagerly():
+                raise RuntimeError(
+                    "backward_passes_per_step > 1 needs eager apply: "
+                    "compile(run_eagerly=True) or call apply() from a "
+                    "custom eager loop")
+            # sparse grads (Embedding layers) densify before the numpy
+            # accumulation — same treatment the k=1 wire path applies
+            grads = [tf.convert_to_tensor(g)
+                     if isinstance(g, tf.IndexedSlices) else g
+                     for g in grads]
+            if getattr(self, "_hvd_agg", None) is None:
+                object.__setattr__(self, "_hvd_agg",
+                                   [np.zeros(tuple(g.shape),
+                                             g.dtype.as_numpy_dtype)
+                                    for g in grads])
+                object.__setattr__(self, "_hvd_agg_count", 0)
+            for buf, g in zip(self._hvd_agg, grads):
+                buf += g.numpy()
+            object.__setattr__(self, "_hvd_agg_count",
+                               self._hvd_agg_count + 1)
+            if self._hvd_agg_count < k:
+                return None                      # true no-op micro-step
+            grads = [tf.constant(buf / k) for buf in self._hvd_agg]
+            for buf in self._hvd_agg:
+                buf[...] = 0
+            object.__setattr__(self, "_hvd_agg_count", 0)
+
         local_refs = getattr(self, "_hvd_local_refs", set())
         is_local = [False] * len(grads)
         # apply(grads) without explicit variables uses the list the
@@ -251,8 +290,18 @@ def _dist_class(cls, op: str = Average,
         return super(dist_cls, self).apply(
             grads, trainable_variables, **kwargs)
 
+    def reset_aggregation(self):
+        """Drop accumulated micro-batch gradients (elastic rollback:
+        gradients computed against discarded state must not leak into
+        the first post-restore update)."""
+        if getattr(self, "_hvd_agg", None) is not None:
+            for buf in self._hvd_agg:
+                buf[...] = 0
+            object.__setattr__(self, "_hvd_agg_count", 0)
+
     dist_cls.apply = apply
     dist_cls.register_local_var = register_local_var
+    dist_cls.reset_aggregation = reset_aggregation
     _DIST_CLASS_CACHE[key] = dist_cls
     return dist_cls
 
@@ -260,7 +309,8 @@ def _dist_class(cls, op: str = Average,
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          op: str = Average,
                          gradient_predivide_factor: float = 1.0,
-                         compression=Compression.none):
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
     """Wrap a keras optimizer so `apply` allreduce-averages gradients
     across ranks first (reference: horovod/_keras/__init__.py
     create_distributed_optimizer — the same dynamic-subclass technique, so
@@ -272,7 +322,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     compression = _plane.resolve_compression(
         compression, Compression.none, Compression.fp16)
     dist_cls = _dist_class(optimizer.__class__, op,
-                           gradient_predivide_factor, compression)
+                           gradient_predivide_factor, compression,
+                           int(backward_passes_per_step))
     return dist_cls.from_config(optimizer.get_config())
 
 
@@ -301,15 +352,26 @@ class KerasState(_BaseFrameworkState):
     0's weights + extras (then refreshes the snapshot) so re-admitted
     workers converge. Extra kwargs become named attributes."""
 
-    def __init__(self, model, **extras):
+    def __init__(self, model, optimizer=None, **extras):
         self._model = model
+        #: optional DistributedOptimizer: restore/sync drop its
+        #: accumulated micro-batch gradients (backward_passes_per_step)
+        #: so pre-rollback gradients never update post-rollback weights
+        self._optimizer = optimizer
         super().__init__(**extras)
+
+    def _drop_aggregation(self):
+        reset = getattr(self._optimizer, "reset_aggregation", None)
+        if callable(reset):
+            reset()
 
     def _save_payload(self):
         return [w.copy() for w in self._model.get_weights()]
 
     def _restore_payload(self, weights):
         self._model.set_weights([w.copy() for w in weights])
+        self._drop_aggregation()
 
     def _sync_payload(self, root_rank):
         broadcast_variables(self._model.weights, root_rank=root_rank)
+        self._drop_aggregation()

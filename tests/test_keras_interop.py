@@ -176,6 +176,42 @@ def test_keras_register_local_var_multiprocess():
     assert results == [0.0, 1.0]
 
 
+def _keras_bpps_worker():
+    """backward_passes_per_step: k micro-batch gradients accumulate
+    locally, one allreduce+apply per k steps (reference
+    tensorflow/gradient_aggregation.py:23)."""
+    import keras
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.interop.keras as hvd
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    v = keras.Variable(np.zeros(4, np.float32))
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0),
+                                   backward_passes_per_step=2)
+    opt.build([v])
+    g1 = tf.constant(np.full(4, float(r + 1), np.float32))
+    g2 = tf.constant(np.full(4, 3.0 * (r + 1), np.float32))
+    opt.apply([g1], [v])
+    np.testing.assert_allclose(v.numpy(), 0.0)       # micro-step: no-op
+    opt.apply([g2], [v])
+    # mean over k=2 then averaged over ranks: ((1+3)/2 + (2+6)/2)/2 = 3
+    np.testing.assert_allclose(v.numpy(), -3.0, rtol=1e-6)
+    hvd.shutdown()
+    return 1.0
+
+
+def test_keras_backward_passes_per_step_multiprocess():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_keras_bpps_worker, num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [1.0, 1.0]
+
+
 def _keras_elastic_state_worker():
     """KerasState commit/restore/sync (reference horovod/keras/elastic.py)."""
     import keras

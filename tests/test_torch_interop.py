@@ -47,6 +47,11 @@ def test_package_level_compression_objects_resolve():
         torch.optim.SGD([p], lr=1.0), named_parameters=[("p", p)],
         compression=JaxCompression.none)
     assert opt2.compression is hvd.Compression.none
+    # an unmapped jax compressor fails at construction, not mid-step
+    with pytest.raises(ValueError, match="no counterpart"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD([p], lr=1.0), named_parameters=[("p", p)],
+            compression=JaxCompression.spar)
 
 
 def test_jax_staging_roundtrip():
