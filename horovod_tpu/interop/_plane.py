@@ -23,6 +23,9 @@ _comm = None
 _rank = 0
 _size = 1
 _inited = False
+_name = None
+_process_sets: dict = {}   # psid -> (ProcessSet, sub-comm or None)
+_next_psid = 1
 
 
 def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
@@ -34,13 +37,14 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
     hybrid (native/store_comm.py), the reference's hierarchical Gloo
     scheme (gloo_operations.cc:33-53): reduce on-host over shm, exchange
     once per host over the native store, fan back out over shm."""
-    global _comm, _rank, _size, _inited
+    global _comm, _rank, _size, _inited, _name
     _rank = int(os.environ.get("HOROVOD_RANK", "0"))
     _size = int(os.environ.get("HOROVOD_SIZE", "1"))
     _inited = True
     if _size > 1 and _comm is None:
         name = comm_name or \
             f"hvd_plane_{os.environ.get('HOROVOD_JOB_ID', default_job)}"
+        _name = name
         from ..core.config import _env_bool
         cross_size = int(os.environ.get("HOROVOD_CROSS_SIZE", "1"))
         force_store = _env_bool("HOROVOD_INTEROP_FORCE_STORE", False)
@@ -56,9 +60,99 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
 def shutdown() -> None:
     global _comm, _inited
     _inited = False
+    for _, sub in list(_process_sets.values()):
+        if sub is not None:
+            sub.close()
+    _process_sets.clear()
     if _comm is not None:
         _comm.close()
         _comm = None
+
+
+# -- process sets (subgroup collectives; reference process_sets.py:18) -------
+
+class ProcessSet:
+    """Named subset of global ranks every member calls collectives over
+    (reference horovod/common/process_sets.py ProcessSet: global-rank
+    list, stable id, membership queries)."""
+
+    def __init__(self, ranks, psid: int):
+        self.ranks = sorted({int(r) for r in ranks})
+        self.psid = psid
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's rank WITHIN the set (-1 if not a member)."""
+        try:
+            return self.ranks.index(_rank)
+        except ValueError:
+            return -1
+
+    def included(self) -> bool:
+        return _rank in self.ranks
+
+    def __repr__(self):
+        return f"ProcessSet(id={self.psid}, ranks={self.ranks})"
+
+
+def add_process_set(ranks) -> ProcessSet:
+    """Create a subgroup; EVERY rank must call with the same ranks (the
+    reference's dynamic-process-set contract). Members get a dedicated
+    sub-communicator on the same transport as the global plane: another
+    shm segment on-host, another coordinator tag-space over the store."""
+    global _next_psid
+    ps = ProcessSet(ranks, _next_psid)
+    _next_psid += 1
+    if not ps.ranks or ps.ranks[0] < 0 or ps.ranks[-1] >= _size:
+        raise ValueError(f"process set ranks out of range: {ps.ranks}")
+    sub = None
+    if _size > 1 and ps.included() and ps.size() > 1:
+        from ..native.shm import ShmComm
+        from ..native.store_comm import StoreComm
+        if isinstance(_comm, ShmComm):
+            gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
+            sub = ShmComm(f"{_name}_ps{ps.psid}", ps.rank(), ps.size(),
+                          gen=gen)
+        else:
+            # store/hybrid plane: a pure store subgroup (members may
+            # span hosts arbitrarily, so no shm level is assumed)
+            sub = StoreComm(
+                os.environ.get("HOROVOD_NATIVE_KV_ADDR", "127.0.0.1"),
+                int(os.environ["HOROVOD_NATIVE_KV_PORT"]),
+                ps.rank(), ps.size(), prefix=f"iplane_ps{ps.psid}")
+    _process_sets[ps.psid] = (ps, sub)
+    return ps
+
+
+def remove_process_set(ps: ProcessSet) -> None:
+    entry = _process_sets.pop(ps.psid, None)
+    if entry and entry[1] is not None:
+        entry[1].close()
+
+
+def resolve_set(process_set):
+    """-> (comm, rank_in_set, set_size, global_member_ranks)."""
+    if process_set is None:
+        if _size > 1 and _comm is None:
+            # post-shutdown (or pre-init) multi-process call: fail loud
+            # — returning local data here would silently corrupt the
+            # caller's "global mean" numerics
+            raise RuntimeError(
+                "plane is not connected (init() not called, or "
+                "shutdown() already ran) for a multi-process job")
+        return _comm, _rank, _size, list(range(_size))
+    entry = _process_sets.get(process_set.psid)
+    if entry is None:
+        raise ValueError(f"unknown process set {process_set!r}; "
+                         "call add_process_set on every rank first")
+    ps, sub = entry
+    if not ps.included():
+        raise ValueError(
+            f"rank {_rank} is not a member of {ps!r} "
+            "(reference: process-set ops error on non-members)")
+    return sub, ps.rank(), ps.size(), ps.ranks
 
 
 def rank() -> int:
@@ -89,67 +183,89 @@ def comm():
     return _comm
 
 
-def allreduce_np(arr: np.ndarray, op: str = Sum) -> np.ndarray:
+def allreduce_np(arr: np.ndarray, op: str = Sum,
+                 process_set=None) -> np.ndarray:
     """Sum-allreduce (caller divides for Average — dtype-specific)."""
-    if _size == 1:
+    comm, _, n, _ = resolve_set(process_set)
+    if n == 1 or comm is None:
         return arr
-    return _comm.allreduce(np.ascontiguousarray(arr), op="sum")
+    return comm.allreduce(np.ascontiguousarray(arr), op="sum")
 
 
-def allgather_np(arr: np.ndarray) -> np.ndarray:
-    if _size == 1:
+def allgather_np(arr: np.ndarray, process_set=None) -> np.ndarray:
+    comm, _, n, _ = resolve_set(process_set)
+    if n == 1 or comm is None:
         return arr
-    return _comm.allgather(np.ascontiguousarray(arr))
+    return comm.allgather(np.ascontiguousarray(arr))
 
 
-def broadcast_np(arr: np.ndarray, root: int = 0) -> np.ndarray:
-    if _size == 1:
+def broadcast_np(arr: np.ndarray, root: int = 0,
+                 process_set=None) -> np.ndarray:
+    """`root` is the GLOBAL rank (reference process-set convention);
+    it must be a member of the set."""
+    comm, _, n, members = resolve_set(process_set)
+    # validate the root BEFORE the degenerate-size return so a wrong
+    # root raises on every set size, not only n > 1
+    if root not in members:
+        raise ValueError(f"root {root} not in process set {members}")
+    if n == 1 or comm is None:
         return arr
-    return _comm.broadcast(np.ascontiguousarray(arr), root=root)
+    if process_set is not None:
+        root = members.index(root)
+    return comm.broadcast(np.ascontiguousarray(arr), root=root)
 
 
-def reducescatter_np(arr: np.ndarray) -> np.ndarray:
-    if _size == 1:
+def reducescatter_np(arr: np.ndarray, process_set=None) -> np.ndarray:
+    comm, _, n, _ = resolve_set(process_set)
+    if n == 1 or comm is None:
         return arr
-    return _comm.reducescatter(np.ascontiguousarray(arr), op="sum")
+    return comm.reducescatter(np.ascontiguousarray(arr), op="sum")
 
 
-def barrier() -> None:
-    if _comm is not None:
-        _comm.barrier()
+def barrier(process_set=None) -> None:
+    comm, _, n, _ = resolve_set(process_set)
+    if comm is not None and n > 1:
+        comm.barrier()
 
 
-def allgather_object(obj: Any) -> list:
-    """Gather a picklable object from every rank into a rank-ordered list
-    (tensorflow/functions.py:141 allgather_object protocol: gather sizes,
-    pad to max, gather payloads)."""
-    if _size == 1:
+def allgather_object(obj: Any, process_set=None) -> list:
+    """Gather a picklable object from every member into a rank-ordered
+    list (tensorflow/functions.py:141 allgather_object protocol: gather
+    sizes, pad to max, gather payloads)."""
+    comm, _, n_members, _ = resolve_set(process_set)
+    if n_members == 1 or comm is None:
         return [obj]
     blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    sizes = _comm.allgather(
+    sizes = comm.allgather(
         np.array([[blob.size]], dtype=np.int64)).ravel()
     pad = int(sizes.max())
     buf = np.zeros((1, pad), np.uint8)
     buf[0, :blob.size] = blob
-    out = _comm.allgather(buf)
+    out = comm.allgather(buf)
     return [pickle.loads(out[i, :int(sizes[i])].tobytes())
-            for i in range(_size)]
+            for i in range(n_members)]
 
 
-def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+def broadcast_object(obj: Any, root_rank: int = 0, process_set=None) -> Any:
     """Pickle-broadcast (torch/functions.py broadcast_object protocol:
-    size first, then payload)."""
-    if _size == 1:
+    size first, then payload). `root_rank` is the global rank."""
+    comm, _, n_members, members = resolve_set(process_set)
+    if root_rank not in members:
+        raise ValueError(f"root {root_rank} not in set {members}")
+    if n_members == 1 or comm is None:
         return obj
-    if _rank == root_rank:
+    is_root = _rank == root_rank
+    root = members.index(root_rank) if process_set is not None \
+        else root_rank
+    if is_root:
         blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
         n = np.array([blob.size], dtype=np.int64)
     else:
         blob = np.zeros(0, np.uint8)
         n = np.zeros(1, dtype=np.int64)
-    n = _comm.broadcast(n, root=root_rank)
-    buf = blob if _rank == root_rank else np.zeros(int(n[0]), np.uint8)
-    buf = _comm.broadcast(buf, root=root_rank)
+    n = comm.broadcast(n, root=root)
+    buf = blob if is_root else np.zeros(int(n[0]), np.uint8)
+    buf = comm.broadcast(buf, root=root)
     return pickle.loads(buf.tobytes())
 
 

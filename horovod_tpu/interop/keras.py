@@ -177,14 +177,15 @@ class Compression:
 def _dist_class(cls, op: str = Average,
                 gradient_predivide_factor: float = 1.0,
                 compression=Compression.none,
-                backward_passes_per_step: int = 1):
+                backward_passes_per_step: int = 1,
+                average_aggregated_gradients: bool = False):
     # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
     # via load_model's custom-object mapping; re-wrapping an already
     # distributed class is an identity (idempotent, no recursive apply)
     if getattr(cls, "_hvd_distributed", False):
         return cls
     key = (cls, op, gradient_predivide_factor, compression,
-           backward_passes_per_step)
+           backward_passes_per_step, average_aggregated_gradients)
     if key in _DIST_CLASS_CACHE:
         return _DIST_CLASS_CACHE[key]
     dist_cls = type("Distributed" + cls.__name__, (cls,),
@@ -236,7 +237,11 @@ def _dist_class(cls, op: str = Average,
                                self._hvd_agg_count + 1)
             if self._hvd_agg_count < k:
                 return None                      # true no-op micro-step
-            grads = [tf.constant(buf / k) for buf in self._hvd_agg]
+            # reference default SUMS the k micro-batch gradients
+            # (average_aggregated_gradients=False,
+            # _keras/__init__.py create_distributed_optimizer)
+            div = float(k) if average_aggregated_gradients else 1.0
+            grads = [tf.constant(buf / div) for buf in self._hvd_agg]
             for buf in self._hvd_agg:
                 buf[...] = 0
             object.__setattr__(self, "_hvd_agg_count", 0)
@@ -310,7 +315,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          op: str = Average,
                          gradient_predivide_factor: float = 1.0,
                          compression=Compression.none,
-                         backward_passes_per_step: int = 1):
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = False):
     """Wrap a keras optimizer so `apply` allreduce-averages gradients
     across ranks first (reference: horovod/_keras/__init__.py
     create_distributed_optimizer — the same dynamic-subclass technique, so
@@ -323,7 +329,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         compression, Compression.none, Compression.fp16)
     dist_cls = _dist_class(optimizer.__class__, op,
                            gradient_predivide_factor, compression,
-                           int(backward_passes_per_step))
+                           int(backward_passes_per_step),
+                           bool(average_aggregated_gradients))
     return dist_cls.from_config(optimizer.get_config())
 
 

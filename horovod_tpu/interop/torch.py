@@ -64,6 +64,11 @@ local_size = _plane.local_size
 is_initialized = _plane.is_initialized
 broadcast_object = _plane.broadcast_object
 allgather_object = _plane.allgather_object
+# subgroup collectives (reference horovod/common/process_sets.py): every
+# tensor op below takes process_set=
+ProcessSet = _plane.ProcessSet
+add_process_set = _plane.add_process_set
+remove_process_set = _plane.remove_process_set
 
 
 # -- DLPack/numpy staging ---------------------------------------------------
@@ -135,77 +140,88 @@ def _ordered(fn):
     return st["exec"].submit(fn).result()
 
 
-def _allreduce_impl_(t, op: str, name=None):
-    if _plane.size() == 1:
+def _allreduce_impl_(t, op: str, name=None, process_set=None):
+    comm, _, n, _ = _plane.resolve_set(process_set)
+    if n == 1 or comm is None:
         return t
     arr = _np_view(t)
-    np.copyto(arr, _plane.allreduce_np(arr))
+    np.copyto(arr, comm.allreduce(np.ascontiguousarray(arr), op="sum"))
     if op == Average:
-        t /= _plane.size()
+        t /= n
     return t
 
 
-def allreduce_(t, op: str = Average, name: Optional[str] = None):
+def allreduce_(t, op: str = Average, name: Optional[str] = None,
+               process_set=None):
     """In-place allreduce (hvd.allreduce_, torch/mpi_ops.py:194)."""
-    return _ordered(lambda: _allreduce_impl_(t, op, name))
+    return _ordered(lambda: _allreduce_impl_(t, op, name, process_set))
 
 
-def allreduce(t, op: str = Average, name: Optional[str] = None):
+def allreduce(t, op: str = Average, name: Optional[str] = None,
+              process_set=None):
     out = t.clone()
-    return allreduce_(out, op=op, name=name)
+    return allreduce_(out, op=op, name=name, process_set=process_set)
 
 
-def _allgather_impl(t, name=None):
+def _allgather_impl(t, name=None, process_set=None):
     import torch
-    if _plane.size() == 1:
+    comm, _, n, _ = _plane.resolve_set(process_set)
+    if n == 1 or comm is None:
         return t.clone()
-    arr = _np_view(t)
-    gathered = _plane.allgather_np(arr)
+    gathered = comm.allgather(np.ascontiguousarray(_np_view(t)))
     return torch.from_numpy(
-        gathered.reshape((_plane.size() * t.shape[0],)
-                         + tuple(t.shape[1:])))
+        gathered.reshape((n * t.shape[0],) + tuple(t.shape[1:])))
 
 
-def allgather(t, name: Optional[str] = None):
+def allgather(t, name: Optional[str] = None, process_set=None):
     """Concatenate along dim 0 across ranks (torch/mpi_ops.py:630)."""
-    return _ordered(lambda: _allgather_impl(t, name))
+    return _ordered(lambda: _allgather_impl(t, name, process_set))
 
 
-def _broadcast_impl_(t, root_rank: int, name=None):
-    if _plane.size() == 1:
-        return t
+def _broadcast_impl_(t, root_rank: int, name=None, process_set=None):
+    # broadcast keeps the *_np helper: it owns the global-root-to-
+    # member-index mapping and root validation
     arr = _np_view(t)
-    np.copyto(arr, _plane.broadcast_np(arr, root=root_rank))
+    out = _plane.broadcast_np(arr, root=root_rank,
+                              process_set=process_set)
+    if out is not arr:
+        np.copyto(arr, out)
     return t
 
 
-def broadcast_(t, root_rank: int = 0, name: Optional[str] = None):
-    return _ordered(lambda: _broadcast_impl_(t, root_rank, name))
+def broadcast_(t, root_rank: int = 0, name: Optional[str] = None,
+               process_set=None):
+    return _ordered(lambda: _broadcast_impl_(t, root_rank, name,
+                                             process_set))
 
 
-def broadcast(t, root_rank: int = 0, name: Optional[str] = None):
+def broadcast(t, root_rank: int = 0, name: Optional[str] = None,
+              process_set=None):
     out = t.clone()
-    return broadcast_(out, root_rank=root_rank, name=name)
+    return broadcast_(out, root_rank=root_rank, name=name,
+                      process_set=process_set)
 
 
-def _reducescatter_impl(t, op: str, name=None):
+def _reducescatter_impl(t, op: str, name=None, process_set=None):
     import torch
-    if _plane.size() == 1:
+    comm, _, n, _ = _plane.resolve_set(process_set)
+    if n == 1 or comm is None:
         return t.clone()
-    out = _plane.reducescatter_np(_np_view(t))
+    out = comm.reducescatter(np.ascontiguousarray(_np_view(t)), op="sum")
     res = torch.from_numpy(out.reshape((-1,) + tuple(t.shape[1:])))
     if op == Average:
-        res /= _plane.size()
+        res /= n
     return res
 
 
-def reducescatter(t, op: str = Average, name: Optional[str] = None):
-    return _ordered(lambda: _reducescatter_impl(t, op, name))
+def reducescatter(t, op: str = Average, name: Optional[str] = None,
+                  process_set=None):
+    return _ordered(lambda: _reducescatter_impl(t, op, name, process_set))
 
 
-def _alltoall_impl(t, splits=None, name=None):
+def _alltoall_impl(t, splits=None, name=None, process_set=None):
     import torch
-    n = _plane.size()
+    _, me, n, _ = _plane.resolve_set(process_set)
     if splits is None:
         if t.shape[0] % n:
             raise ValueError(
@@ -222,21 +238,21 @@ def _alltoall_impl(t, splits=None, name=None):
     for s in splits:
         chunks.append(np.ascontiguousarray(_np_view(t)[off:off + s]))
         off += s
-    everyone = _plane.allgather_object(chunks)   # [src][dst] -> chunk
-    me = _plane.rank()
+    everyone = _plane.allgather_object(chunks,   # [src][dst] -> chunk
+                                       process_set=process_set)
     mine = [everyone[src][me] for src in range(n)]
     recv_splits = torch.tensor([c.shape[0] for c in mine])
     out = torch.from_numpy(np.concatenate(mine, axis=0)) if mine else t[:0]
     return out.to(t.dtype), recv_splits
 
 
-def alltoall(t, splits=None, name: Optional[str] = None):
+def alltoall(t, splits=None, name: Optional[str] = None, process_set=None):
     """Distribute slices of dim 0 to all ranks; returns (output,
     received_splits) like the reference (torch/mpi_ops.py:960 alltoall
     with uneven `splits`; recv splits negotiated across ranks). Rides the
     object plane (gather-then-pick), which is fine for the binding's
     same-host/control-plane scale; the JAX engine owns the ICI path."""
-    return _ordered(lambda: _alltoall_impl(t, splits, name))
+    return _ordered(lambda: _alltoall_impl(t, splits, name, process_set))
 
 
 def barrier() -> None:
@@ -269,68 +285,89 @@ def synchronize(handle: int):
 wait = synchronize  # reference alias
 
 
-def allreduce_async_(t, op: str = Average, name: Optional[str] = None) -> int:
-    return _submit(lambda: allreduce_(t, op=op, name=name))
+def allreduce_async_(t, op: str = Average, name: Optional[str] = None,
+                     process_set=None) -> int:
+    return _submit(lambda: allreduce_(t, op=op, name=name,
+                                      process_set=process_set))
 
 
-def allreduce_async(t, op: str = Average, name: Optional[str] = None) -> int:
-    return _submit(lambda: allreduce(t, op=op, name=name))
+def allreduce_async(t, op: str = Average, name: Optional[str] = None,
+                    process_set=None) -> int:
+    return _submit(lambda: allreduce(t, op=op, name=name,
+                                     process_set=process_set))
 
 
-def allgather_async(t, name: Optional[str] = None) -> int:
-    return _submit(lambda: allgather(t, name=name))
+def allgather_async(t, name: Optional[str] = None, process_set=None) -> int:
+    return _submit(lambda: allgather(t, name=name, process_set=process_set))
 
 
-def broadcast_async_(t, root_rank: int = 0,
-                     name: Optional[str] = None) -> int:
-    return _submit(lambda: broadcast_(t, root_rank=root_rank, name=name))
+def broadcast_async_(t, root_rank: int = 0, name: Optional[str] = None,
+                     process_set=None) -> int:
+    return _submit(lambda: broadcast_(t, root_rank=root_rank, name=name,
+                                      process_set=process_set))
 
 
-def broadcast_async(t, root_rank: int = 0, name: Optional[str] = None) -> int:
-    return _submit(lambda: broadcast(t, root_rank=root_rank, name=name))
+def broadcast_async(t, root_rank: int = 0, name: Optional[str] = None,
+                    process_set=None) -> int:
+    return _submit(lambda: broadcast(t, root_rank=root_rank, name=name,
+                                     process_set=process_set))
 
 
-def reducescatter_async(t, op: str = Average,
-                        name: Optional[str] = None) -> int:
-    return _submit(lambda: reducescatter(t, op=op, name=name))
+def reducescatter_async(t, op: str = Average, name: Optional[str] = None,
+                        process_set=None) -> int:
+    return _submit(lambda: reducescatter(t, op=op, name=name,
+                                         process_set=process_set))
 
 
-def alltoall_async(t, splits=None, name: Optional[str] = None) -> int:
-    return _submit(lambda: alltoall(t, splits=splits, name=name))
+def alltoall_async(t, splits=None, name: Optional[str] = None,
+                   process_set=None) -> int:
+    return _submit(lambda: alltoall(t, splits=splits, name=name,
+                                    process_set=process_set))
 
 
-def grouped_allreduce_(tensors, op: str = Average, name=None):
+def grouped_allreduce_(tensors, op: str = Average, name=None,
+                       process_set=None):
     """In-place allreduce of a list (torch/mpi_ops.py grouped ops)."""
-    return [allreduce_(t, op=op) for t in tensors]
+    return [allreduce_(t, op=op, process_set=process_set) for t in tensors]
 
 
-def grouped_allreduce(tensors, op: str = Average, name=None):
-    return [allreduce(t, op=op) for t in tensors]
+def grouped_allreduce(tensors, op: str = Average, name=None,
+                      process_set=None):
+    return [allreduce(t, op=op, process_set=process_set) for t in tensors]
 
 
-def grouped_allreduce_async_(tensors, op: str = Average, name=None) -> int:
-    return _submit(lambda: grouped_allreduce_(tensors, op=op))
+def grouped_allreduce_async_(tensors, op: str = Average, name=None,
+                             process_set=None) -> int:
+    return _submit(lambda: grouped_allreduce_(tensors, op=op,
+                                              process_set=process_set))
 
 
-def grouped_allreduce_async(tensors, op: str = Average, name=None) -> int:
-    return _submit(lambda: grouped_allreduce(tensors, op=op))
+def grouped_allreduce_async(tensors, op: str = Average, name=None,
+                            process_set=None) -> int:
+    return _submit(lambda: grouped_allreduce(tensors, op=op,
+                                             process_set=process_set))
 
 
-def grouped_allgather(tensors, name=None):
+def grouped_allgather(tensors, name=None, process_set=None):
     """List-of-tensors allgather (torch/mpi_ops.py grouped_allgather)."""
-    return [allgather(t) for t in tensors]
+    return [allgather(t, process_set=process_set) for t in tensors]
 
 
-def grouped_allgather_async(tensors, name=None) -> int:
-    return _submit(lambda: grouped_allgather(tensors))
+def grouped_allgather_async(tensors, name=None, process_set=None) -> int:
+    return _submit(lambda: grouped_allgather(tensors,
+                                             process_set=process_set))
 
 
-def grouped_reducescatter(tensors, op: str = Average, name=None):
-    return [reducescatter(t, op=op) for t in tensors]
+def grouped_reducescatter(tensors, op: str = Average, name=None,
+                          process_set=None):
+    return [reducescatter(t, op=op, process_set=process_set)
+            for t in tensors]
 
 
-def grouped_reducescatter_async(tensors, op: str = Average, name=None) -> int:
-    return _submit(lambda: grouped_reducescatter(tensors, op=op))
+def grouped_reducescatter_async(tensors, op: str = Average, name=None,
+                                process_set=None) -> int:
+    return _submit(lambda: grouped_reducescatter(tensors, op=op,
+                                                 process_set=process_set))
 
 
 def sparse_allreduce_async(t, name: Optional[str] = None,
@@ -436,13 +473,15 @@ class _DistributedOptimizer:
     def __init__(self, optimizer, named_parameters=None, op: str = Average,
                  backward_passes_per_step: int = 1,
                  gradient_predivide_factor: float = 1.0,
-                 compression=Compression.none) -> None:
+                 compression=Compression.none,
+                 process_set=None) -> None:
         self._opt = optimizer
         self.op = op
         self.backward_passes_per_step = int(backward_passes_per_step)
         self.gradient_predivide_factor = float(gradient_predivide_factor)
         self.compression = _plane.resolve_compression(
             compression, Compression.none, Compression.fp16)
+        self.process_set = process_set
         self._pass_count = 0
         if named_parameters is not None:
             self._params = [p for _, p in named_parameters]
@@ -460,7 +499,8 @@ class _DistributedOptimizer:
                     p.grad /= self.gradient_predivide_factor
                 comp, ctx = self.compression.compress(p.grad)
                 comp = comp.contiguous()
-                allreduce_(comp, op=self.op)
+                allreduce_(comp, op=self.op,
+                           process_set=self.process_set)
                 if comp.data_ptr() != p.grad.data_ptr():
                     p.grad.copy_(self.compression.decompress(comp, ctx))
                 if self.gradient_predivide_factor != 1.0:
@@ -482,12 +522,13 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          op: str = Average,
                          backward_passes_per_step: int = 1,
                          gradient_predivide_factor: float = 1.0,
-                         compression=Compression.none
+                         compression=Compression.none,
+                         process_set=None
                          ) -> _DistributedOptimizer:
     """Factory mirroring hvd.DistributedOptimizer (torch/optimizer.py:516)."""
     return _DistributedOptimizer(
         optimizer, named_parameters, op, backward_passes_per_step,
-        gradient_predivide_factor, compression)
+        gradient_predivide_factor, compression, process_set)
 
 
 # -- elastic state (torch/elastic/state.py TorchState) ----------------------

@@ -197,8 +197,20 @@ def _keras_bpps_worker():
     opt.apply([g1], [v])
     np.testing.assert_allclose(v.numpy(), 0.0)       # micro-step: no-op
     opt.apply([g2], [v])
-    # mean over k=2 then averaged over ranks: ((1+3)/2 + (2+6)/2)/2 = 3
-    np.testing.assert_allclose(v.numpy(), -3.0, rtol=1e-6)
+    # reference default SUMS the k micro-batches, then rank-averages:
+    # ((1+3) + (2+6)) / 2 = 6
+    np.testing.assert_allclose(v.numpy(), -6.0, rtol=1e-6)
+
+    # average_aggregated_gradients=True divides by k like the reference
+    # knob: ((1+3)/2 + (2+6)/2)/2 = 3
+    v2 = keras.Variable(np.zeros(4, np.float32))
+    opt2 = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0),
+                                    backward_passes_per_step=2,
+                                    average_aggregated_gradients=True)
+    opt2.build([v2])
+    opt2.apply([g1], [v2])
+    opt2.apply([g2], [v2])
+    np.testing.assert_allclose(v2.numpy(), -3.0, rtol=1e-6)
     hvd.shutdown()
     return 1.0
 

@@ -298,6 +298,86 @@ def _torch_sync_bn_worker():
     return 1.0
 
 
+def _torch_process_set_worker():
+    """Subgroup collectives over the plane (reference: every torch op
+    takes process_set=, torch/mpi_ops.py:157; process_sets.py:18)."""
+    import torch
+    import horovod_tpu.interop.torch as hvd
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 4
+
+    evens = hvd.add_process_set([0, 2])          # every rank registers
+    assert evens.size() == 2
+    assert evens.included() == (r in (0, 2))
+
+    if evens.included():
+        # allreduce over members only: mean of ranks {0, 2} -> 1.0
+        t = torch.full((5,), float(r))
+        out = hvd.allreduce(t, process_set=evens)
+        assert torch.allclose(out, torch.ones(5)), out
+        # broadcast with GLOBAL root rank 2
+        b = torch.full((3,), float(r))
+        hvd.broadcast_(b, root_rank=2, process_set=evens)
+        assert torch.allclose(b, torch.full((3,), 2.0)), b
+        # allgather over the set
+        g = hvd.allgather(torch.full((1, 2), float(r)), process_set=evens)
+        assert g.shape == (2, 2) and float(g[1, 0]) == 2.0
+        # object plane over the set
+        objs = hvd.allgather_object({"r": r}, process_set=evens)
+        assert [o["r"] for o in objs] == [0, 2]
+        # optimizer scoped to the subgroup
+        p = torch.nn.Parameter(torch.zeros(4))
+        p.grad = torch.full((4,), float(r + 1))   # 1 and 3 -> mean 2
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD([p], lr=1.0), named_parameters=[("p", p)],
+            process_set=evens)
+        opt.step()
+        np.testing.assert_allclose(p.detach().numpy(), -2.0, rtol=1e-6)
+    else:
+        # non-members error clearly instead of hanging the members
+        try:
+            hvd.allreduce(torch.zeros(2), process_set=evens)
+            raise AssertionError("expected non-member ValueError")
+        except ValueError as e:
+            assert "not a member" in str(e)
+
+    # global collectives still work alongside the subgroup
+    s = hvd.allreduce(torch.full((2,), float(r)), op=hvd.Sum)
+    assert torch.allclose(s, torch.full((2,), 6.0)), s
+    hvd.remove_process_set(evens)
+    hvd.shutdown()
+    return 1.0
+
+
+def test_torch_process_sets_multiprocess():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_torch_process_set_worker, num_proc=4,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [1.0] * 4
+
+
+def test_torch_process_sets_store_plane():
+    """Same subgroup worker with shm disabled: the sub-communicator is a
+    pure store group (members may span hosts arbitrarily)."""
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(
+            _torch_process_set_worker, num_proc=4,
+            job_runner=MultiprocessingJobRunner(),
+            env={"HOROVOD_INTEROP_FORCE_STORE": "1",
+                 "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+                 "HOROVOD_NATIVE_KV_PORT": str(server.port),
+                 "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results == [1.0] * 4
+    finally:
+        server.close()
+
+
 def _torch_elastic_state_worker():
     """TorchState commit/restore/sync (reference
     torch/elastic/state.py:27-120)."""
