@@ -52,6 +52,9 @@ local_size = _plane.local_size
 is_initialized = _plane.is_initialized
 broadcast_object = _plane.broadcast_object
 barrier = _plane.barrier
+ProcessSet = _plane.ProcessSet
+add_process_set = _plane.add_process_set
+remove_process_set = _plane.remove_process_set
 
 
 # -- tensor collectives (tensorflow/mpi_ops.py surface) ----------------------
@@ -64,43 +67,49 @@ def _to_numpy(t) -> np.ndarray:
                                 else np.asarray(t))
 
 
-def allreduce(t, op: str = Average, name: Optional[str] = None):
+def allreduce(t, op: str = Average, name: Optional[str] = None,
+              process_set=None):
     """Allreduce a tf tensor across ranks (hvd.allreduce,
-    horovod/tensorflow/mpi_ops.py)."""
+    horovod/tensorflow/mpi_ops.py); `process_set` scopes it to a
+    subgroup (reference: every op takes process_set)."""
     import tensorflow as tf
     t = tf.convert_to_tensor(t)
-    if _plane.size() == 1:
+    _, _, n, _ = _plane.resolve_set(process_set)
+    if n == 1:
         return t
     arr = _to_numpy(t)
-    out = _plane.allreduce_np(arr)
+    out = _plane.allreduce_np(arr, process_set=process_set)
     if op == Average:
-        out = out / _plane.size()
+        out = out / n
     # np.ascontiguousarray promotes 0-d to 1-d; restore the true shape
     return tf.constant(out.astype(arr.dtype).reshape(tuple(t.shape)))
 
 
-def allgather(t, name: Optional[str] = None):
+def allgather(t, name: Optional[str] = None, process_set=None):
     """Concatenate along dim 0 across ranks (hvd.allgather)."""
     import tensorflow as tf
     t = tf.convert_to_tensor(t)
     if t.shape.rank == 0:
         raise ValueError("allgather requires tensors of rank >= 1")
-    if _plane.size() == 1:
+    _, _, n, _ = _plane.resolve_set(process_set)
+    if n == 1:
         return t
     arr = _to_numpy(t)
-    out = _plane.allgather_np(arr)
+    out = _plane.allgather_np(arr, process_set=process_set)
     return tf.constant(
-        out.reshape((_plane.size() * arr.shape[0],) + arr.shape[1:]))
+        out.reshape((n * arr.shape[0],) + arr.shape[1:]))
 
 
-def broadcast(t, root_rank: int = 0, name: Optional[str] = None):
-    """Broadcast a tf tensor from root_rank (hvd.broadcast)."""
+def broadcast(t, root_rank: int = 0, name: Optional[str] = None,
+              process_set=None):
+    """Broadcast a tf tensor from root_rank — a GLOBAL rank
+    (hvd.broadcast). Always routed through broadcast_np so its root
+    validation fires on every set size, degenerate singletons included."""
     import tensorflow as tf
     t = tf.convert_to_tensor(t)
-    if _plane.size() == 1:
-        return t
     arr = _to_numpy(t)
-    out = _plane.broadcast_np(arr, root=root_rank)
+    out = _plane.broadcast_np(arr, root=root_rank,
+                              process_set=process_set)
     return tf.constant(np.asarray(out).reshape(tuple(t.shape)))
 
 
@@ -128,9 +137,10 @@ def broadcast_global_variables(root_rank: int = 0, model=None) -> None:
     broadcast_variables(model.variables, root_rank)
 
 
-def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+def allgather_object(obj: Any, name: Optional[str] = None,
+                     process_set=None) -> List[Any]:
     """Gather a picklable object from every rank (functions.py:141)."""
-    return _plane.allgather_object(obj)
+    return _plane.allgather_object(obj, process_set=process_set)
 
 
 # -- DistributedOptimizer (reference _keras/__init__.py dynamic subclass) ----

@@ -48,6 +48,9 @@ local_size = _plane.local_size
 is_initialized = _plane.is_initialized
 broadcast_object = _plane.broadcast_object
 allgather_object = _plane.allgather_object
+ProcessSet = _plane.ProcessSet
+add_process_set = _plane.add_process_set
+remove_process_set = _plane.remove_process_set
 
 
 # The tensor collectives are the keras binding's (same plane, same

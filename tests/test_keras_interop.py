@@ -110,6 +110,15 @@ def _keras_worker(tag):
           hvd.callbacks.MetricAverageCallback()]
     h = model.fit(xs, ys, epochs=2, batch_size=4, verbose=0, callbacks=cb)
 
+    # subgroup collectives on the keras surface
+    solo = hvd.add_process_set([0])
+    if r == 0:
+        import tensorflow as tf
+        only = hvd.allreduce(tf.constant([5.0]), process_set=solo)
+        np.testing.assert_allclose(only.numpy(), [5.0])
+        assert hvd.allgather_object("x", process_set=solo) == ["x"]
+    hvd.remove_process_set(solo)
+
     # replicas must agree exactly after synchronized training
     w = np.concatenate([v.numpy().ravel() for v in model.variables])
     ws = hvd.allgather_object(w)
