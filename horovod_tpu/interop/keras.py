@@ -184,18 +184,50 @@ class Compression:
             return arr if ctx is None else arr.astype(ctx)
 
 
+def reduce_indexed_slices(slices_list, op: str = Average,
+                          compression=Compression.none, process_set=None):
+    """Reduce a LIST of eager tf.IndexedSlices in ONE allgather round
+    (the reference's sparse_as_dense=False strategy,
+    tensorflow/__init__.py:59-233): gather every rank's (indices,
+    compressed values) for all slices together, concatenate per slice,
+    average. Shared by the keras optimizer and the tf.py tape — one
+    maintained sparse implementation for both tf front ends."""
+    import tensorflow as tf
+    _, _, n, _ = _plane.resolve_set(process_set)
+    payload = []
+    for g in slices_list:
+        comp, cctx = compression.compress(
+            np.ascontiguousarray(g.values.numpy()))
+        payload.append((np.ascontiguousarray(g.indices.numpy()), comp,
+                        cctx))
+    pieces = _plane.allgather_object(payload, process_set=process_set)
+    outs = []
+    for i, g in enumerate(slices_list):
+        idx = np.concatenate([p[i][0] for p in pieces], axis=0)
+        vals = np.concatenate(
+            [compression.decompress(p[i][1], p[i][2]) for p in pieces],
+            axis=0)
+        if op == Average:
+            vals = (vals / n).astype(vals.dtype)
+        outs.append(tf.IndexedSlices(tf.constant(vals), tf.constant(idx),
+                                     dense_shape=g.dense_shape))
+    return outs
+
+
 def _dist_class(cls, op: str = Average,
                 gradient_predivide_factor: float = 1.0,
                 compression=Compression.none,
                 backward_passes_per_step: int = 1,
-                average_aggregated_gradients: bool = False):
+                average_aggregated_gradients: bool = False,
+                sparse_as_dense: bool = False):
     # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
     # via load_model's custom-object mapping; re-wrapping an already
     # distributed class is an identity (idempotent, no recursive apply)
     if getattr(cls, "_hvd_distributed", False):
         return cls
     key = (cls, op, gradient_predivide_factor, compression,
-           backward_passes_per_step, average_aggregated_gradients)
+           backward_passes_per_step, average_aggregated_gradients,
+           sparse_as_dense)
     if key in _DIST_CLASS_CACHE:
         return _DIST_CLASS_CACHE[key]
     dist_cls = type("Distributed" + cls.__name__, (cls,),
@@ -265,6 +297,27 @@ def _dist_class(cls, op: str = Average,
         if local_refs and match_vars is not None:
             is_local = [_var_key(v) in local_refs for v in match_vars]
 
+        # sparse gradients (Embedding layers): with the reference's
+        # sparse_as_dense=False default, eager IndexedSlices ride ONE
+        # batched allgather (compression applied to values) and STAY
+        # sparse into the inner apply (tensorflow/__init__.py:59-233).
+        # Graph mode densifies either way (py_function staging
+        # constraint — run_eagerly=True gets the sparse path), as does
+        # sparse_as_dense=True.
+        sparse_reduced = {}
+        if _plane.size() > 1 and not sparse_as_dense \
+                and tf.executing_eagerly():
+            sp_idx = [i for i, g in enumerate(grads)
+                      if isinstance(g, tf.IndexedSlices)
+                      and not is_local[i]]
+            if sp_idx:
+                reduced_sp = reduce_indexed_slices(
+                    [grads[i] for i in sp_idx], op=op,
+                    compression=compression)
+                for i, sp in zip(sp_idx, reduced_sp):
+                    sparse_reduced[i] = sp
+                    is_local[i] = True   # skip the dense wire path
+
         def _reduce_py(*flat_grads):
             outs = []
             for g in flat_grads:
@@ -300,6 +353,9 @@ def _dist_class(cls, op: str = Average,
                         r.set_shape(g.shape)
                         merged.append(r)
                 grads = merged
+            # re-insert the sparse-reduced gradients AS IndexedSlices
+            for i, sp in sparse_reduced.items():
+                grads[i] = sp
         # bind the created class explicitly: super(self.__class__, ...)
         # would recurse if dist_cls is ever subclassed again
         return super(dist_cls, self).apply(
@@ -326,7 +382,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          gradient_predivide_factor: float = 1.0,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
-                         average_aggregated_gradients: bool = False):
+                         average_aggregated_gradients: bool = False,
+                         sparse_as_dense: bool = False):
     """Wrap a keras optimizer so `apply` allreduce-averages gradients
     across ranks first (reference: horovod/_keras/__init__.py
     create_distributed_optimizer — the same dynamic-subclass technique, so
@@ -340,7 +397,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     dist_cls = _dist_class(optimizer.__class__, op,
                            gradient_predivide_factor, compression,
                            int(backward_passes_per_step),
-                           bool(average_aggregated_gradients))
+                           bool(average_aggregated_gradients),
+                           bool(sparse_as_dense))
     return dist_cls.from_config(optimizer.get_config())
 
 

@@ -99,18 +99,11 @@ class _DistributedGradientTape:
         self._local_ids.add(id(source))
 
     def _reduce_sparse(self, g):
-        """IndexedSlices allreduce as an allgather of (indices, values)
-        — the reference's sparse strategy when sparse_as_dense=False
-        (tensorflow/__init__.py:59-233 sparse handling)."""
-        import tensorflow as tf
-        pieces = _plane.allgather_object(
-            (g.indices.numpy(), g.values.numpy()))
-        idx = np.concatenate([p[0] for p in pieces], axis=0)
-        vals = np.concatenate([p[1] for p in pieces], axis=0)
-        if self._op == Average:
-            vals = (vals / _plane.size()).astype(vals.dtype)
-        return tf.IndexedSlices(tf.constant(vals), tf.constant(idx),
-                                dense_shape=g.dense_shape)
+        """IndexedSlices allreduce — the shared sparse implementation
+        (keras.reduce_indexed_slices, the reference's
+        sparse_as_dense=False strategy, tensorflow/__init__.py:59-233)."""
+        from .keras import reduce_indexed_slices
+        return reduce_indexed_slices([g], op=self._op)[0]
 
     def gradient(self, target, sources, output_gradients=None):
         import tensorflow as tf

@@ -233,6 +233,51 @@ def test_keras_backward_passes_per_step_multiprocess():
     assert results == [1.0, 1.0]
 
 
+def _keras_sparse_grad_worker():
+    """Embedding (IndexedSlices) gradients ride the allgather-based
+    sparse path by default and stay sparse into the inner apply
+    (reference sparse_as_dense=False, tensorflow/__init__.py:59-233)."""
+    import keras
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.interop.keras as hvd
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    emb = keras.Variable(np.zeros((4, 2), np.float32))
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0))
+    opt.build([emb])
+    # rank-dependent sparse grad: rank0 touches rows {0,2}, rank1 {1,2}
+    g = tf.IndexedSlices(
+        tf.constant(np.full((2, 2), float(r + 1), np.float32)),
+        tf.constant(np.array([r, 2], np.int64)),
+        dense_shape=tf.constant([4, 2], tf.int64))
+    opt.apply([g], [emb])
+    # averaged: row0 -0.5, row1 -1.0, row2 -(1+2)/2=-1.5, row3 0
+    np.testing.assert_allclose(
+        emb.numpy()[:, 0], [-0.5, -1.0, -1.5, 0.0], rtol=1e-6)
+
+    # sparse_as_dense=True densifies (same numbers, dense wire)
+    emb2 = keras.Variable(np.zeros((4, 2), np.float32))
+    opt2 = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0),
+                                    sparse_as_dense=True)
+    opt2.build([emb2])
+    opt2.apply([g], [emb2])
+    np.testing.assert_allclose(emb2.numpy(), emb.numpy(), rtol=1e-6)
+    hvd.shutdown()
+    return 1.0
+
+
+def test_keras_sparse_gradients_multiprocess():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_keras_sparse_grad_worker, num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [1.0, 1.0]
+
+
 def _keras_elastic_state_worker():
     """KerasState commit/restore/sync (reference horovod/keras/elastic.py)."""
     import keras
