@@ -185,19 +185,25 @@ class Compression:
 
 
 def reduce_indexed_slices(slices_list, op: str = Average,
-                          compression=Compression.none, process_set=None):
+                          compression=Compression.none, process_set=None,
+                          gradient_predivide_factor: float = 1.0):
     """Reduce a LIST of eager tf.IndexedSlices in ONE allgather round
     (the reference's sparse_as_dense=False strategy,
     tensorflow/__init__.py:59-233): gather every rank's (indices,
     compressed values) for all slices together, concatenate per slice,
-    average. Shared by the keras optimizer and the tf.py tape — one
-    maintained sparse implementation for both tf front ends."""
+    average. Predivide applies before compression exactly like the
+    dense path (its purpose: keep scaled values inside fp16 range).
+    Shared by the keras optimizer and the tf.py tape — one maintained
+    sparse implementation for both tf front ends."""
     import tensorflow as tf
     _, _, n, _ = _plane.resolve_set(process_set)
+    pre = float(gradient_predivide_factor)
     payload = []
     for g in slices_list:
-        comp, cctx = compression.compress(
-            np.ascontiguousarray(g.values.numpy()))
+        vals = np.ascontiguousarray(g.values.numpy())
+        if pre != 1.0:
+            vals = vals / pre
+        comp, cctx = compression.compress(vals)
         payload.append((np.ascontiguousarray(g.indices.numpy()), comp,
                         cctx))
     pieces = _plane.allgather_object(payload, process_set=process_set)
@@ -208,9 +214,12 @@ def reduce_indexed_slices(slices_list, op: str = Average,
             [compression.decompress(p[i][1], p[i][2]) for p in pieces],
             axis=0)
         if op == Average:
-            vals = (vals / n).astype(vals.dtype)
-        outs.append(tf.IndexedSlices(tf.constant(vals), tf.constant(idx),
-                                     dense_shape=g.dense_shape))
+            vals = vals / n
+        if pre != 1.0:
+            vals = vals * pre
+        outs.append(tf.IndexedSlices(
+            tf.constant(np.asarray(vals, dtype=g.values.dtype.as_numpy_dtype)),
+            tf.constant(idx), dense_shape=g.dense_shape))
     return outs
 
 
@@ -313,7 +322,8 @@ def _dist_class(cls, op: str = Average,
             if sp_idx:
                 reduced_sp = reduce_indexed_slices(
                     [grads[i] for i in sp_idx], op=op,
-                    compression=compression)
+                    compression=compression,
+                    gradient_predivide_factor=gradient_predivide_factor)
                 for i, sp in zip(sp_idx, reduced_sp):
                     sparse_reduced[i] = sp
                     is_local[i] = True   # skip the dense wire path
@@ -335,7 +345,11 @@ def _dist_class(cls, op: str = Average,
             return outs
 
         if _plane.size() > 1:
-            dense = [tf.convert_to_tensor(g) for g in grads]
+            # sparse-reduced slots keep their ORIGINAL IndexedSlices here
+            # (they're overwritten below) — densifying them would
+            # materialize the full embedding-size tensor for nothing
+            dense = [g if i in sparse_reduced else tf.convert_to_tensor(g)
+                     for i, g in enumerate(grads)]
             send = [g for g, loc in zip(dense, is_local) if not loc]
             if send:
                 reduced = tf.py_function(

@@ -159,6 +159,8 @@ def allreduce_(t, op: str = Average, name: Optional[str] = None,
 
 def allreduce(t, op: str = Average, name: Optional[str] = None,
               process_set=None):
+    if _wants_grad(t):
+        return _grad_fns()["allreduce"].apply(t, op, process_set)
     out = t.clone()
     return allreduce_(out, op=op, name=name, process_set=process_set)
 
@@ -175,6 +177,8 @@ def _allgather_impl(t, name=None, process_set=None):
 
 def allgather(t, name: Optional[str] = None, process_set=None):
     """Concatenate along dim 0 across ranks (torch/mpi_ops.py:630)."""
+    if _wants_grad(t):
+        return _grad_fns()["allgather"].apply(t, process_set)
     return _ordered(lambda: _allgather_impl(t, name, process_set))
 
 
@@ -197,6 +201,8 @@ def broadcast_(t, root_rank: int = 0, name: Optional[str] = None,
 
 def broadcast(t, root_rank: int = 0, name: Optional[str] = None,
               process_set=None):
+    if _wants_grad(t):
+        return _grad_fns()["broadcast"].apply(t, root_rank, process_set)
     out = t.clone()
     return broadcast_(out, root_rank=root_rank, name=name,
                       process_set=process_set)
@@ -216,6 +222,8 @@ def _reducescatter_impl(t, op: str, name=None, process_set=None):
 
 def reducescatter(t, op: str = Average, name: Optional[str] = None,
                   process_set=None):
+    if _wants_grad(t):
+        return _grad_fns()["reducescatter"].apply(t, op, process_set)
     return _ordered(lambda: _reducescatter_impl(t, op, name, process_set))
 
 
@@ -249,9 +257,12 @@ def _alltoall_impl(t, splits=None, name=None, process_set=None):
 def alltoall(t, splits=None, name: Optional[str] = None, process_set=None):
     """Distribute slices of dim 0 to all ranks; returns (output,
     received_splits) like the reference (torch/mpi_ops.py:960 alltoall
-    with uneven `splits`; recv splits negotiated across ranks). Rides the
-    object plane (gather-then-pick), which is fine for the binding's
+    with uneven `splits`; recv splits negotiated across ranks, gradient
+    support via the transposed alltoall). Rides the object plane
+    (gather-then-pick), which is fine for the binding's
     same-host/control-plane scale; the JAX engine owns the ICI path."""
+    if _wants_grad(t):
+        return _grad_fns()["alltoall"].apply(t, splits, process_set)
     return _ordered(lambda: _alltoall_impl(t, splits, name, process_set))
 
 
@@ -262,10 +273,20 @@ def barrier() -> None:
 # -- async handle API (torch/mpi_ops.py allreduce_async_/synchronize/...) ----
 
 def _submit(fn) -> int:
+    import torch
     ex = _ensure_exec()
+    # grad mode is thread-local: capture the CALLER's so an async op
+    # under torch.no_grad() behaves like its synchronous twin instead
+    # of silently re-enabling autograd on the worker thread
+    mode = torch.is_grad_enabled()
+
+    def run():
+        with torch.set_grad_enabled(mode):
+            return fn()
+
     h = _async_state["next"]
     _async_state["next"] += 1
-    _async_state["futures"][h] = ex.submit(fn)
+    _async_state["futures"][h] = ex.submit(run)
     return h
 
 
@@ -394,6 +415,113 @@ def sparse_allreduce_async(t, name: Optional[str] = None,
         return out / _plane.size()
 
     return _submit(run)
+
+
+# -- differentiable collectives (torch/mpi_ops.py autograd Functions) --------
+#
+# The reference's public torch ops are differentiable (autograd Functions
+# at mpi_ops.py:194 allreduce, :630 allgather, :960 alltoall, broadcast,
+# reducescatter): collectives can sit INSIDE a model (hand-rolled model
+# parallelism) and gradients flow with the transposed collective.
+# The public ops below route through these when the input requires grad.
+
+_GRAD_FNS = {}
+
+
+def _grad_fns():
+    """Lazily-built autograd.Function classes (torch import deferred)."""
+    if _GRAD_FNS:
+        return _GRAD_FNS
+    import torch
+
+    class _AllreduceFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, t, op, process_set):
+            ctx.op, ctx.ps = op, process_set
+            return allreduce(t.detach(), op=op, process_set=process_set)
+
+        @staticmethod
+        def backward(ctx, dy):
+            # d(allreduce)/dx is the same allreduce: every rank's input
+            # feeds every rank's output (same op so Average stays
+            # Average, matching torch/mpi_ops.py:194 handle pairing)
+            return (allreduce(dy.contiguous(), op=ctx.op,
+                              process_set=ctx.ps), None, None)
+
+    class _AllgatherFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, t, process_set):
+            ctx.ps = process_set
+            ctx.rows = t.shape[0]
+            return allgather(t.detach(), process_set=process_set)
+
+        @staticmethod
+        def backward(ctx, dy):
+            # sum each rank's dy, then take this rank's row block
+            # (reference allgather backward: allreduce + narrow)
+            _, me, n, _ = _plane.resolve_set(ctx.ps)
+            g = allreduce(dy.contiguous(), op=Sum, process_set=ctx.ps)
+            return (g[me * ctx.rows:(me + 1) * ctx.rows], None)
+
+    class _BroadcastFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, t, root_rank, process_set):
+            ctx.root, ctx.ps = root_rank, process_set
+            return broadcast(t.detach(), root_rank=root_rank,
+                             process_set=process_set)
+
+        @staticmethod
+        def backward(ctx, dy):
+            # gradients flow back to the root only: sum everyone's dy,
+            # zero elsewhere
+            g = allreduce(dy.contiguous(), op=Sum, process_set=ctx.ps)
+            if _plane.rank() != ctx.root:
+                g = torch.zeros_like(g)
+            return (g, None, None)
+
+    class _AlltoallFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, t, splits, process_set):
+            out, recv = alltoall(t.detach(), splits=splits,
+                                 process_set=process_set)
+            ctx.ps = process_set
+            ctx.recv = [int(x) for x in recv]
+            ctx.mark_non_differentiable(recv)
+            return out, recv
+
+        @staticmethod
+        def backward(ctx, dy, _drecv):
+            # transpose of alltoall is alltoall with the received splits
+            back, _ = alltoall(dy.contiguous(), splits=ctx.recv,
+                               process_set=ctx.ps)
+            return (back, None, None)
+
+    class _ReducescatterFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, t, op, process_set):
+            ctx.op, ctx.ps = op, process_set
+            return reducescatter(t.detach(), op=op,
+                                 process_set=process_set)
+
+        @staticmethod
+        def backward(ctx, dy):
+            # transpose of reduce-scatter is allgather (scaled for
+            # Average, whose forward divided by n)
+            _, _, n, _ = _plane.resolve_set(ctx.ps)
+            g = allgather(dy.contiguous(), process_set=ctx.ps)
+            if ctx.op == Average:
+                g = g / n
+            return (g, None, None)
+
+    _GRAD_FNS.update(allreduce=_AllreduceFn, allgather=_AllgatherFn,
+                     broadcast=_BroadcastFn, alltoall=_AlltoallFn,
+                     reducescatter=_ReducescatterFn)
+    return _GRAD_FNS
+
+
+def _wants_grad(t) -> bool:
+    import torch
+    return torch.is_grad_enabled() and t.requires_grad
 
 
 # -- state sync (torch/functions.py) ----------------------------------------

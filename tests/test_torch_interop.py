@@ -179,6 +179,14 @@ def _torch_async_ops_worker():
     assert torch.allclose(s, torch.full((3,), 15.0)), s
     assert torch.allclose(hvd.wait(ha), torch.full((3,), 1.0))
 
+    # async under no_grad matches the sync twin: the worker thread must
+    # inherit the CALLER's grad mode, not its own default
+    with torch.no_grad():
+        hng = hvd.allreduce_async(
+            torch.ones(2, requires_grad=True), op=hvd.Average)
+        got_ng = hvd.wait(hng)
+    assert not got_ng.requires_grad and got_ng.grad_fn is None
+
     # grouped allreduce
     ts = [torch.full((3,), float(r + 1)), torch.full((2,), float(r + 10))]
     hg = hvd.grouped_allreduce_async_(ts, op=hvd.Average)
@@ -296,6 +304,68 @@ def _torch_sync_bn_worker():
                                atol=1e-5)
     hvd.shutdown()
     return 1.0
+
+
+def _torch_autograd_collectives_worker():
+    """Differentiable collectives: gradients flow through the transposed
+    collective (reference autograd Functions, torch/mpi_ops.py:194
+    allreduce, :630 allgather, :960 alltoall)."""
+    import torch
+    import horovod_tpu.interop.torch as hvd
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    # allreduce(Average): dL/dx = allreduce(w, Average)
+    x = torch.arange(4, dtype=torch.float32, requires_grad=True)
+    w = torch.full((4,), float(r + 1))            # rank-dependent weight
+    y = hvd.allreduce(x, op=hvd.Average)
+    (y * w).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 1.5)   # mean(1,2)
+
+    # allgather: dL/dx = sum_r dy_r sliced to this rank's block
+    x2 = torch.ones(2, 3, requires_grad=True)
+    m = torch.arange(4 * 3, dtype=torch.float32).reshape(4, 3) * (r + 1)
+    g = hvd.allgather(x2)
+    assert g.requires_grad
+    (g * m).sum().backward()
+    expect = (np.arange(12).reshape(4, 3) * 3)[2 * r:2 * r + 2]  # 1+2
+    np.testing.assert_allclose(x2.grad.numpy(), expect)
+
+    # broadcast: grads accumulate at the root, zero elsewhere
+    x3 = torch.ones(3, requires_grad=True)
+    b = hvd.broadcast(x3, root_rank=0)
+    (b * float(r + 1)).sum().backward()
+    np.testing.assert_allclose(x3.grad.numpy(),
+                               3.0 if r == 0 else 0.0)
+
+    # reducescatter(Sum): dL/dx = allgather of each rank's dy
+    x4 = torch.ones(4, requires_grad=True)
+    rs = hvd.reducescatter(x4, op=hvd.Sum)
+    (rs * float(10 * (r + 1))).sum().backward()
+    np.testing.assert_allclose(x4.grad.numpy(), [10., 10., 20., 20.])
+
+    # alltoall round-trips gradients to the sending rank
+    x5 = torch.arange(4, dtype=torch.float32).reshape(4, 1) \
+        .requires_grad_(True)
+    out, recv = hvd.alltoall(x5, splits=[1, 3] if r == 0 else [2, 2])
+    (out * float(r + 1)).sum().backward()
+    # rank0 sent 1 row to rank0 (grad *1) and 3 rows to rank1 (grad *2)
+    expect5 = [[1.], [2.], [2.], [2.]] if r == 0 else \
+        [[1.], [1.], [2.], [2.]]
+    np.testing.assert_allclose(x5.grad.numpy(), expect5)
+
+    hvd.shutdown()
+    return 1.0
+
+
+def test_torch_autograd_collectives_multiprocess():
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_torch_autograd_collectives_worker, num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [1.0, 1.0]
 
 
 def _torch_process_set_worker():
