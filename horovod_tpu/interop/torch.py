@@ -594,15 +594,25 @@ class Compression:
 # -- optimizer wrapper (torch/optimizer.py) ---------------------------------
 
 class _DistributedOptimizer:
-    """Wraps a torch optimizer: step() first allreduces every grad
-    (the synchronize-then-step contract of torch/optimizer.py:255-324;
-    hook-free because the shm plane has no async queue to overlap with)."""
+    """Wraps a torch optimizer with the reference's hot-loop design
+    (torch/optimizer.py:131,176,225): per-parameter
+    post-accumulate-grad hooks fire an ASYNC allreduce the moment each
+    gradient is ready during backward — communication overlaps the rest
+    of backward on the plane's background thread — and step() waits the
+    outstanding handles before the inner update (synchronize-then-step,
+    :255-324). Hooks fire in autograd order, identical across ranks for
+    the same model graph, which satisfies the plane's ordering contract;
+    ranks must compute gradients for the same parameter set each step
+    (data-dependent frozen branches diverge the queue — the same
+    constraint the reference's stall inspector polices). Falls back to
+    step-time synchronous reduction when hooks are unavailable or
+    use_grad_hooks=False."""
 
     def __init__(self, optimizer, named_parameters=None, op: str = Average,
                  backward_passes_per_step: int = 1,
                  gradient_predivide_factor: float = 1.0,
                  compression=Compression.none,
-                 process_set=None) -> None:
+                 process_set=None, use_grad_hooks: bool = True) -> None:
         self._opt = optimizer
         self.op = op
         self.backward_passes_per_step = int(backward_passes_per_step)
@@ -616,11 +626,77 @@ class _DistributedOptimizer:
         else:
             self._params = [p for g in optimizer.param_groups
                             for p in g["params"]]
+        self._hook_handles = []
+        self._inflight = {}     # id(param) -> (param, comp, ctx, handle)
+        self._hook_passes = {}  # id(param) -> micro-passes since sync
+        if use_grad_hooks:
+            try:
+                for p in self._params:
+                    if p.requires_grad:
+                        self._hook_handles.append(
+                            p.register_post_accumulate_grad_hook(
+                                self._grad_hook))
+            except (AttributeError, RuntimeError):
+                for h in self._hook_handles:
+                    h.remove()
+                self._hook_handles = []   # old torch: step-time path
 
     def __getattr__(self, item):
         return getattr(self._opt, item)
 
+    def _submit_grad(self, p) -> None:
+        if id(p) in self._inflight:
+            # a second backward before step() would race the in-flight
+            # in-place allreduce on this very grad buffer — fail loud,
+            # like the reference's "Gradients were computed more than
+            # backward_passes_per_step times" (torch/optimizer.py:225)
+            raise RuntimeError(
+                "gradient reduced twice before step(): call step()/"
+                "synchronize() between backwards or raise "
+                "backward_passes_per_step")
+        if self.gradient_predivide_factor != 1.0:
+            p.grad /= self.gradient_predivide_factor
+        comp, ctx = self.compression.compress(p.grad)
+        comp = comp.contiguous()
+        h = allreduce_async_(comp, op=self.op,
+                             process_set=self.process_set)
+        self._inflight[id(p)] = (p, comp, ctx, h)
+
+    def _grad_hook(self, p) -> None:
+        if _plane.size() == 1 or p.grad is None:
+            return
+        cnt = self._hook_passes.get(id(p), 0) + 1
+        self._hook_passes[id(p)] = cnt
+        if cnt < self.backward_passes_per_step:
+            return                     # keep accumulating locally
+        self._submit_grad(p)
+
+    def _finish_inflight(self) -> None:
+        for p, comp, ctx, h in self._inflight.values():
+            synchronize(h)             # module-level handle wait
+            if p.grad is None:
+                continue   # grad cleared between backward and step:
+                           # drain the handle, drop the result
+            if comp.data_ptr() != p.grad.data_ptr():
+                p.grad.copy_(self.compression.decompress(comp, ctx))
+            if self.gradient_predivide_factor != 1.0:
+                p.grad *= self.gradient_predivide_factor
+        self._inflight.clear()
+        self._hook_passes.clear()
+
     def synchronize(self) -> None:
+        if self._hook_handles:
+            if _plane.size() > 1:
+                # backfill: grads set without a backward (manual .grad
+                # assignment) never fire the hooks — the reference's
+                # synchronize() submits handles for any param missing
+                # one (torch/optimizer.py:255-302)
+                for p in self._params:
+                    if p.grad is not None and id(p) not in self._inflight:
+                        self._submit_grad(p)
+            self._finish_inflight()
+            self._pass_count = 0
+            return
         for p in self._params:
             if p.grad is not None:
                 if self.gradient_predivide_factor != 1.0:
@@ -651,12 +727,16 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          backward_passes_per_step: int = 1,
                          gradient_predivide_factor: float = 1.0,
                          compression=Compression.none,
-                         process_set=None
+                         process_set=None, use_grad_hooks: bool = True
                          ) -> _DistributedOptimizer:
-    """Factory mirroring hvd.DistributedOptimizer (torch/optimizer.py:516)."""
+    """Factory mirroring hvd.DistributedOptimizer (torch/optimizer.py:516).
+    Gradient allreduces start asynchronously from per-parameter hooks
+    DURING backward (the reference's overlap design); pass
+    use_grad_hooks=False for strictly synchronous step-time reduction."""
     return _DistributedOptimizer(
         optimizer, named_parameters, op, backward_passes_per_step,
-        gradient_predivide_factor, compression, process_set)
+        gradient_predivide_factor, compression, process_set,
+        use_grad_hooks)
 
 
 # -- elastic state (torch/elastic/state.py TorchState) ----------------------

@@ -355,6 +355,24 @@ def _torch_autograd_collectives_worker():
         [[1.], [1.], [2.], [2.]]
     np.testing.assert_allclose(x5.grad.numpy(), expect5)
 
+    # hook-based optimizer: a second backward before step() fails loud
+    # (reference: "Gradients were computed more than
+    # backward_passes_per_step times"), and grads cleared before step()
+    # drain cleanly instead of crashing
+    model = torch.nn.Linear(2, 1)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    xx = torch.ones(4, 2)
+    model(xx).sum().backward()
+    try:
+        model(xx).sum().backward()
+        raise AssertionError("expected double-backward RuntimeError")
+    except RuntimeError as e:
+        assert "reduced twice" in str(e)
+    opt.zero_grad(set_to_none=True)
+    opt.step()                        # drains in-flight, no crash
+
     hvd.shutdown()
     return 1.0
 
