@@ -74,7 +74,8 @@ class _DistributedGradientTape:
 
     def __init__(self, tape, op: str = Average,
                  gradient_predivide_factor: float = 1.0,
-                 sparse_as_dense: bool = False) -> None:
+                 sparse_as_dense: bool = False,
+                 process_set=None) -> None:
         if gradient_predivide_factor != 1.0 and op != Average:
             raise ValueError("gradient_predivide_factor requires "
                              "op=Average")
@@ -82,6 +83,7 @@ class _DistributedGradientTape:
         self._op = op
         self._predivide = float(gradient_predivide_factor)
         self._sparse_as_dense = sparse_as_dense
+        self._process_set = process_set
         self._local_ids = set()
 
     def __getattr__(self, item):
@@ -98,36 +100,53 @@ class _DistributedGradientTape:
         """Keep `source`'s gradient rank-local (reference :1045)."""
         self._local_ids.add(id(source))
 
-    def _reduce_sparse(self, g):
-        """IndexedSlices allreduce — the shared sparse implementation
-        (keras.reduce_indexed_slices, the reference's
-        sparse_as_dense=False strategy, tensorflow/__init__.py:59-233)."""
-        from .keras import reduce_indexed_slices
-        return reduce_indexed_slices([g], op=self._op)[0]
-
     def gradient(self, target, sources, output_gradients=None):
         import tensorflow as tf
+        from .keras import reduce_indexed_slices
         grads = self._tape.gradient(target, sources,
                                     output_gradients=output_gradients)
-        if _plane.size() == 1:
-            return grads
+        if self._process_set is None:
+            _, _, n, _ = _plane.resolve_set(None)
+            if n == 1:
+                return grads
+        else:
+            # resolve LAZILY: a non-member rank whose gradients are all
+            # local/None must not trip the membership check for
+            # collectives it never issues
+            n = None
         flat_sources = tf.nest.flatten(sources)
+        flat = list(tf.nest.flatten(grads))
+        skip = {i for i, (g, s) in enumerate(zip(flat, flat_sources))
+                if g is None or id(s) in self._local_ids}
+        # sparse gradients: ONE batched allgather round for all of them
+        # (the shared reference sparse_as_dense=False strategy,
+        # tensorflow/__init__.py:59-233)
+        sparse_ix = [i for i, g in enumerate(flat)
+                     if i not in skip and isinstance(g, tf.IndexedSlices)
+                     and not self._sparse_as_dense]
+        if sparse_ix:
+            reduced_sp = reduce_indexed_slices(
+                [flat[i] for i in sparse_ix], op=self._op,
+                process_set=self._process_set,
+                gradient_predivide_factor=self._predivide)
+            for i, sp in zip(sparse_ix, reduced_sp):
+                flat[i] = sp
+            skip.update(sparse_ix)
         out = []
-        for g, s in zip(tf.nest.flatten(grads), flat_sources):
-            if g is None or id(s) in self._local_ids:
+        for i, g in enumerate(flat):
+            if i in skip:
                 out.append(g)
                 continue
             if isinstance(g, tf.IndexedSlices):
-                if not self._sparse_as_dense:
-                    out.append(self._reduce_sparse(g))
-                    continue
-                g = tf.convert_to_tensor(g)
+                g = tf.convert_to_tensor(g)      # sparse_as_dense=True
+            if n is None:
+                _, _, n, _ = _plane.resolve_set(self._process_set)
             arr = np.ascontiguousarray(g.numpy())
             if self._predivide != 1.0:
                 arr = arr / self._predivide
-            red = _plane.allreduce_np(arr)
+            red = _plane.allreduce_np(arr, process_set=self._process_set)
             if self._op == Average:
-                red = red / _plane.size()
+                red = red / n
             if self._predivide != 1.0:
                 red = red * self._predivide
             # ascontiguousarray promotes 0-d to (1,): restore the shape
@@ -139,6 +158,7 @@ class _DistributedGradientTape:
 def DistributedGradientTape(gradtape, op: str = Average,
                             gradient_predivide_factor: float = 1.0,
                             sparse_as_dense: bool = False,
+                            process_set=None,
                             **_ignored) -> _DistributedGradientTape:
     """Factory mirroring hvd.DistributedGradientTape
     (tensorflow/__init__.py:1110); device/compression kwargs accepted
@@ -146,7 +166,7 @@ def DistributedGradientTape(gradtape, op: str = Average,
     return _DistributedGradientTape(
         gradtape, op=op,
         gradient_predivide_factor=gradient_predivide_factor,
-        sparse_as_dense=sparse_as_dense)
+        sparse_as_dense=sparse_as_dense, process_set=process_set)
 
 
 def PartialDistributedGradientTape(gradtape, local_layers=None, **kwargs):

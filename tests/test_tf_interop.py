@@ -101,6 +101,31 @@ def _tf_worker():
                                np.full((2, 1), float(r + 1)))  # local
     np.testing.assert_allclose(gs_p[1].numpy(), [1.5])          # averaged
 
+    # tape scoped to a process set: use per-rank SINGLETON sets (both
+    # registered on both ranks per the contract) so a dropped
+    # process_set would produce the global average 1.5, not the
+    # unaveraged local gradient this asserts
+    ps0 = hvd.add_process_set([0])
+    ps1 = hvd.add_process_set([1])
+    mine = ps0 if r == 0 else ps1
+    vps = tf.Variable([1.0])
+    with tf.GradientTape() as tps:
+        lps = float(r + 1) * tf.reduce_sum(vps)
+    dps = hvd.DistributedGradientTape(tps, process_set=mine)
+    gps, = dps.gradient(lps, [vps])
+    np.testing.assert_allclose(gps.numpy(), [float(r + 1)])
+    # a non-member tape whose gradients are all LOCAL never trips the
+    # membership check (lazy resolve)
+    other = ps1 if r == 0 else ps0
+    with tf.GradientTape() as tl:
+        ll = tf.reduce_sum(vps * vps)
+    dl = hvd.DistributedGradientTape(tl, process_set=other)
+    dl.register_local_source(vps)
+    gl, = dl.gradient(ll, [vps])
+    np.testing.assert_allclose(gl.numpy(), [2.0])
+    hvd.remove_process_set(ps0)
+    hvd.remove_process_set(ps1)
+
     # TensorFlowState: sync converges, restore-after-sync keeps synced
     sv = tf.Variable(np.full(2, float(r), np.float32))
     st = hvd.TensorFlowState(variables=[sv], epoch=r)

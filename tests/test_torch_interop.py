@@ -306,6 +306,24 @@ def _torch_sync_bn_worker():
     return 1.0
 
 
+def test_elastic_sampler_with_torch_dataloader():
+    """ElasticSampler duck-types torch's Sampler protocol (__iter__ +
+    __len__), the reference's torch/elastic/sampler.py usage."""
+    from torch.utils.data import DataLoader, TensorDataset
+    from horovod_tpu.elastic import ElasticSampler
+    ds = TensorDataset(torch.arange(12, dtype=torch.float32))
+    s = ElasticSampler(12, shuffle=False, num_replicas=3, rank=1)
+    dl = DataLoader(ds, batch_size=2, sampler=s)
+    seen = [float(v) for b in dl for v in b[0]]
+    assert len(seen) == len(s) == 4
+    assert all(int(v) % 3 == 1 for v in seen)   # rank-1 shard
+    # record progress, reset to a 2-replica world: unprocessed only
+    s.record_indices([int(v) for v in seen[:2]])
+    s.reset(num_replicas=2, rank=0)
+    remaining = list(s)
+    assert set(int(v) for v in seen[:2]).isdisjoint(remaining)
+
+
 def _torch_autograd_collectives_worker():
     """Differentiable collectives: gradients flow through the transposed
     collective (reference autograd Functions, torch/mpi_ops.py:194
