@@ -57,9 +57,72 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
             _comm = ShmComm(name, _rank, _size, gen=gen)
 
 
+_timeline = None
+
+
+def _tl():
+    """Rank-0 Chrome-trace timeline for plane collectives when
+    HOROVOD_TIMELINE is set (the reference records its torch/TF op
+    phases through the core timeline, timeline.cc; binding jobs never
+    start the jax engine, so the plane owns its own writer)."""
+    global _timeline
+    if _timeline is None and _rank == 0 and _size > 1:
+        fn = os.environ.get("HOROVOD_TIMELINE")
+        if fn and fn.upper() != "DYNAMIC":
+            from .. import timeline as timeline_mod
+            _timeline = timeline_mod.Timeline(fn)
+            _timeline.start()
+    return _timeline
+
+
+def traced(kind: str, fn):
+    """Record fn() as a Chrome-trace phase event. The tag is STABLE per
+    kind — plane collectives are strictly serialized (one background
+    queue), so B/E pairs nest correctly and each kind renders as one
+    viewer row instead of one row per call."""
+    t = _tl()
+    if t is None:
+        return fn()
+    tag = f"plane.{kind}"
+    t.begin(tag, kind.upper())
+    try:
+        return fn()
+    finally:
+        t.end(tag, kind.upper())
+
+
+# one traced call site per collective kind, shared by the *_np wrappers
+# below AND the torch binding's direct-comm fast path
+
+def comm_allreduce(comm, arr: np.ndarray) -> np.ndarray:
+    return traced("allreduce",
+                  lambda: comm.allreduce(np.ascontiguousarray(arr),
+                                         op="sum"))
+
+
+def comm_allgather(comm, arr: np.ndarray) -> np.ndarray:
+    return traced("allgather",
+                  lambda: comm.allgather(np.ascontiguousarray(arr)))
+
+
+def comm_broadcast(comm, arr: np.ndarray, root: int) -> np.ndarray:
+    return traced("broadcast",
+                  lambda: comm.broadcast(np.ascontiguousarray(arr),
+                                         root=root))
+
+
+def comm_reducescatter(comm, arr: np.ndarray) -> np.ndarray:
+    return traced("reducescatter",
+                  lambda: comm.reducescatter(np.ascontiguousarray(arr),
+                                             op="sum"))
+
+
 def shutdown() -> None:
-    global _comm, _inited
+    global _comm, _inited, _timeline
     _inited = False
+    if _timeline is not None:
+        _timeline.stop()
+        _timeline = None
     for _, sub in list(_process_sets.values()):
         if sub is not None:
             sub.close()
@@ -189,14 +252,14 @@ def allreduce_np(arr: np.ndarray, op: str = Sum,
     comm, _, n, _ = resolve_set(process_set)
     if n == 1 or comm is None:
         return arr
-    return comm.allreduce(np.ascontiguousarray(arr), op="sum")
+    return comm_allreduce(comm, arr)
 
 
 def allgather_np(arr: np.ndarray, process_set=None) -> np.ndarray:
     comm, _, n, _ = resolve_set(process_set)
     if n == 1 or comm is None:
         return arr
-    return comm.allgather(np.ascontiguousarray(arr))
+    return comm_allgather(comm, arr)
 
 
 def broadcast_np(arr: np.ndarray, root: int = 0,
@@ -212,20 +275,20 @@ def broadcast_np(arr: np.ndarray, root: int = 0,
         return arr
     if process_set is not None:
         root = members.index(root)
-    return comm.broadcast(np.ascontiguousarray(arr), root=root)
+    return comm_broadcast(comm, arr, root)
 
 
 def reducescatter_np(arr: np.ndarray, process_set=None) -> np.ndarray:
     comm, _, n, _ = resolve_set(process_set)
     if n == 1 or comm is None:
         return arr
-    return comm.reducescatter(np.ascontiguousarray(arr), op="sum")
+    return comm_reducescatter(comm, arr)
 
 
 def barrier(process_set=None) -> None:
     comm, _, n, _ = resolve_set(process_set)
     if comm is not None and n > 1:
-        comm.barrier()
+        traced("barrier", comm.barrier)
 
 
 def allgather_object(obj: Any, process_set=None) -> list:
@@ -235,15 +298,18 @@ def allgather_object(obj: Any, process_set=None) -> list:
     comm, _, n_members, _ = resolve_set(process_set)
     if n_members == 1 or comm is None:
         return [obj]
-    blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    sizes = comm.allgather(
-        np.array([[blob.size]], dtype=np.int64)).ravel()
-    pad = int(sizes.max())
-    buf = np.zeros((1, pad), np.uint8)
-    buf[0, :blob.size] = blob
-    out = comm.allgather(buf)
-    return [pickle.loads(out[i, :int(sizes[i])].tobytes())
-            for i in range(n_members)]
+    def run():
+        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        sizes = comm.allgather(
+            np.array([[blob.size]], dtype=np.int64)).ravel()
+        pad = int(sizes.max())
+        buf = np.zeros((1, pad), np.uint8)
+        buf[0, :blob.size] = blob
+        out = comm.allgather(buf)
+        return [pickle.loads(out[i, :int(sizes[i])].tobytes())
+                for i in range(n_members)]
+
+    return traced("allgather_object", run)
 
 
 def broadcast_object(obj: Any, root_rank: int = 0, process_set=None) -> Any:
@@ -257,16 +323,19 @@ def broadcast_object(obj: Any, root_rank: int = 0, process_set=None) -> Any:
     is_root = _rank == root_rank
     root = members.index(root_rank) if process_set is not None \
         else root_rank
-    if is_root:
-        blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        n = np.array([blob.size], dtype=np.int64)
-    else:
-        blob = np.zeros(0, np.uint8)
-        n = np.zeros(1, dtype=np.int64)
-    n = comm.broadcast(n, root=root)
-    buf = blob if is_root else np.zeros(int(n[0]), np.uint8)
-    buf = comm.broadcast(buf, root=root)
-    return pickle.loads(buf.tobytes())
+    def run():
+        if is_root:
+            blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+            n = np.array([blob.size], dtype=np.int64)
+        else:
+            blob = np.zeros(0, np.uint8)
+            n = np.zeros(1, dtype=np.int64)
+        n = comm.broadcast(n, root=root)
+        buf = blob if is_root else np.zeros(int(n[0]), np.uint8)
+        buf = comm.broadcast(buf, root=root)
+        return pickle.loads(buf.tobytes())
+
+    return traced("broadcast_object", run)
 
 
 def resolve_compression(c, local_none, local_fp16):

@@ -145,7 +145,7 @@ def _allreduce_impl_(t, op: str, name=None, process_set=None):
     if n == 1 or comm is None:
         return t
     arr = _np_view(t)
-    np.copyto(arr, comm.allreduce(np.ascontiguousarray(arr), op="sum"))
+    np.copyto(arr, _plane.comm_allreduce(comm, arr))
     if op == Average:
         t /= n
     return t
@@ -170,7 +170,7 @@ def _allgather_impl(t, name=None, process_set=None):
     comm, _, n, _ = _plane.resolve_set(process_set)
     if n == 1 or comm is None:
         return t.clone()
-    gathered = comm.allgather(np.ascontiguousarray(_np_view(t)))
+    gathered = _plane.comm_allgather(comm, _np_view(t))
     return torch.from_numpy(
         gathered.reshape((n * t.shape[0],) + tuple(t.shape[1:])))
 
@@ -213,7 +213,7 @@ def _reducescatter_impl(t, op: str, name=None, process_set=None):
     comm, _, n, _ = _plane.resolve_set(process_set)
     if n == 1 or comm is None:
         return t.clone()
-    out = comm.reducescatter(np.ascontiguousarray(_np_view(t)), op="sum")
+    out = _plane.comm_reducescatter(comm, _np_view(t))
     res = torch.from_numpy(out.reshape((-1,) + tuple(t.shape[1:])))
     if op == Average:
         res /= n

@@ -306,6 +306,40 @@ def _torch_sync_bn_worker():
     return 1.0
 
 
+def _torch_timeline_worker(tl_path):
+    import os
+    import torch
+    import horovod_tpu.interop.torch as hvd
+    assert os.environ["HOROVOD_TIMELINE"] == tl_path
+    hvd.init()
+    hvd.allreduce(torch.ones(4))
+    hvd.allgather(torch.ones(2, 2))
+    hvd.broadcast(torch.ones(3), root_rank=0)
+    hvd.allgather_object({"r": hvd.rank()})
+    hvd.barrier()
+    hvd.shutdown()
+    return 1.0
+
+
+def test_torch_plane_timeline(tmp_path):
+    """HOROVOD_TIMELINE records plane collectives as Chrome-trace phase
+    events (the role timeline.cc plays for the reference's binding ops)."""
+    import json
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    tl = str(tmp_path / "plane_timeline.json")
+    results = run(_torch_timeline_worker, args=(tl,), num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8],
+                       "HOROVOD_TIMELINE": tl})
+    assert results == [1.0, 1.0]
+    doc = json.loads(open(tl).read())
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    assert {"ALLREDUCE", "ALLGATHER", "BROADCAST", "ALLGATHER_OBJECT",
+            "BARRIER"} <= names, names
+
+
 def test_elastic_sampler_with_torch_dataloader():
     """ElasticSampler duck-types torch's Sampler protocol (__iter__ +
     __len__), the reference's torch/elastic/sampler.py usage."""
