@@ -113,6 +113,133 @@ def broadcast(t, root_rank: int = 0, name: Optional[str] = None,
     return tf.constant(np.asarray(out).reshape(tuple(t.shape)))
 
 
+def broadcast_(var, root_rank: int = 0, name: Optional[str] = None,
+               process_set=None):
+    """In-place broadcast into a tf.Variable (hvd.broadcast_,
+    tensorflow/mpi_ops.py:broadcast_): assigns the root's value and
+    returns the variable."""
+    shape = tuple(var.shape)
+    out = _plane.broadcast_np(_to_numpy(var), root=root_rank,
+                              process_set=process_set)
+    var.assign(np.asarray(out).reshape(shape))
+    return var
+
+
+def reducescatter(t, op: str = Average, name: Optional[str] = None,
+                  process_set=None):
+    """Reduce across ranks, then scatter dim-0 chunks — rank r keeps the
+    r-th chunk (hvd.reducescatter, tensorflow/__init__.py reducescatter;
+    the chunking contract matches the torch binding's)."""
+    import tensorflow as tf
+    t = tf.convert_to_tensor(t)
+    if t.shape.rank == 0:
+        raise ValueError("reducescatter requires tensors of rank >= 1")
+    _, me, n, _ = _plane.resolve_set(process_set)
+    if n == 1:
+        return tf.identity(t)
+    arr = _to_numpy(t).reshape(tuple(t.shape))
+    d0 = arr.shape[0]
+    if d0 % n == 0:
+        out = _plane.reducescatter_np(arr, process_set=process_set)
+        out = np.asarray(out).reshape((-1,) + arr.shape[1:])
+    else:
+        # uneven dim 0: reference semantics — earlier ranks get one
+        # extra row. The plane's reducescatter needs even counts, so
+        # reduce fully and slice this rank's chunk.
+        full = np.asarray(_plane.allreduce_np(arr,
+                                              process_set=process_set))
+        full = full.reshape(arr.shape)
+        base, extra = divmod(d0, n)
+        start = me * base + min(me, extra)
+        out = full[start:start + base + (1 if me < extra else 0)]
+    if op == Average:
+        out = out / n
+    return tf.constant(out.astype(arr.dtype))
+
+
+def alltoall(t, splits=None, name: Optional[str] = None, process_set=None):
+    """Scatter dim-0 slices to all ranks and gather theirs
+    (hvd.alltoall, tensorflow/mpi_ops.py:396). With `splits` given,
+    returns ``(output, received_splits)``; without, splits dim 0 evenly
+    and returns just the output — the reference's exact return
+    convention. Recv splits are negotiated across ranks (the
+    mpi_controller.cc:239 role) by the gather-then-pick object plane."""
+    import tensorflow as tf
+    t = tf.convert_to_tensor(t)
+    if t.shape.rank == 0:
+        raise ValueError("alltoall requires tensors of rank >= 1")
+    had_splits = splits is not None
+    _, me, n, _ = _plane.resolve_set(process_set)
+    if splits is None:
+        if t.shape[0] % n:
+            raise ValueError(
+                f"alltoall without splits needs dim0 divisible by size "
+                f"({t.shape[0]} vs {n})")
+        splits = [int(t.shape[0]) // n] * n
+    splits = [int(s) for s in np.asarray(splits).reshape(-1)]
+    if len(splits) != n:
+        raise ValueError(
+            f"alltoall needs one split per rank in the set "
+            f"({len(splits)} splits vs size {n})")
+    if sum(splits) != t.shape[0]:
+        raise ValueError("splits must sum to dim 0")
+    arr = _to_numpy(t).reshape(tuple(t.shape))
+    if n == 1:
+        out = tf.identity(t)
+        return (out, tf.constant(splits[:1], dtype=tf.int32)) \
+            if had_splits else out
+    chunks, off = [], 0
+    for s in splits:
+        chunks.append(np.ascontiguousarray(arr[off:off + s]))
+        off += s
+    everyone = _plane.allgather_object(chunks,   # [src][dst] -> chunk
+                                       process_set=process_set)
+    mine = [everyone[src][me] for src in range(n)]
+    rsplits = tf.constant([c.shape[0] for c in mine], dtype=tf.int32)
+    out = tf.constant(np.concatenate(mine, axis=0).astype(arr.dtype))
+    return (out, rsplits) if had_splits else out
+
+
+def grouped_allreduce(tensors, op: str = Average, name=None,
+                      process_set=None):
+    """Allreduce a list as one fused plane round (hvd.grouped_allreduce):
+    flatten-concat, single allreduce, split — the fusion-buffer strategy
+    of the reference's grouped ops (tensorflow/mpi_ops.py:145)."""
+    import tensorflow as tf
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    _, _, n, _ = _plane.resolve_set(process_set)
+    if n == 1 or not tensors:
+        return list(tensors)
+    arrs = [_to_numpy(t).reshape(tuple(t.shape)) for t in tensors]
+    if len({a.dtype for a in arrs}) == 1:
+        flat = np.concatenate([a.ravel() for a in arrs])
+        red = np.asarray(_plane.allreduce_np(flat,
+                                             process_set=process_set))
+        if op == Average:
+            red = red / n
+        out, off = [], 0
+        for a in arrs:
+            piece = red[off:off + a.size].astype(a.dtype).reshape(a.shape)
+            out.append(tf.constant(piece))
+            off += a.size
+        return out
+    # mixed dtypes: per-tensor rounds (the reference splits groups by
+    # dtype into separate fusion buffers)
+    return [allreduce(t, op=op, process_set=process_set) for t in tensors]
+
+
+def grouped_allgather(tensors, name=None, process_set=None):
+    """List-of-tensors allgather (hvd.grouped_allgather)."""
+    return [allgather(t, process_set=process_set) for t in tensors]
+
+
+def grouped_reducescatter(tensors, op: str = Average, name=None,
+                          process_set=None):
+    """List-of-tensors reducescatter (hvd.grouped_reducescatter)."""
+    return [reducescatter(t, op=op, process_set=process_set)
+            for t in tensors]
+
+
 # -- variable sync (tensorflow/functions.py:66 broadcast_variables,
 #    keras broadcast_global_variables) ---------------------------------------
 
@@ -464,3 +591,81 @@ class KerasState(_BaseFrameworkState):
     def _sync_payload(self, root_rank):
         broadcast_variables(self._model.weights, root_rank=root_rank)
         self._drop_aggregation()
+
+
+def _sync_batch_norm_class():
+    """Build SyncBatchNormalization against the installed keras
+    BatchNormalization (deferred so importing this module never imports
+    tf)."""
+    import tensorflow as tf
+
+    class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+        """Batch norm whose batch statistics are averaged across ranks
+        during training (reference horovod/tensorflow/sync_batch_norm.py
+        SyncBatchNormalization): _moments computes the local mean and
+        E[X^2], allreduce-averages the stacked pair over the plane, and
+        re-derives the group variance as E[X^2] - E[X]^2. The plane call
+        rides tf.py_function so the layer works inside model.fit's
+        tf.function (but not under jit_compile=True/XLA, where
+        py_function cannot run)."""
+
+        def __init__(self, fused=False, process_set=None, **kwargs):
+            if fused in (True, None):
+                raise ValueError(
+                    "SyncBatchNormalization does not support fused=True.")
+            if not kwargs.get("name"):
+                kwargs["name"] = "sync_batch_normalization"
+            # keras-3 BatchNormalization has no fused arg; accepted for
+            # reference signature parity and dropped
+            super().__init__(**kwargs)
+            self._hvd_process_set = process_set
+
+        def _moments(self, inputs, mask):
+            mean, variance = super()._moments(inputs, mask)
+            _, _, n, _ = _plane.resolve_set(self._hvd_process_set)
+            if n == 1:
+                return mean, variance
+            mean_of_square = variance + tf.math.square(mean)
+            stack = tf.stack([mean, mean_of_square])
+            ps = self._hvd_process_set
+
+            def _avg(x):
+                arr = np.ascontiguousarray(x.numpy())
+                red = np.asarray(_plane.allreduce_np(arr, process_set=ps))
+                return (red / n).astype(arr.dtype).reshape(arr.shape)
+
+            # group-average with the transposed-collective backward:
+            # y_r = (1/n)·Σ_s x_s, so dL/dx_r = (1/n)·Σ_s dL/dy_s —
+            # the SAME map. Without this the batch-stat terms of the BN
+            # gradient would be silently dropped (py_function breaks
+            # the tape), unlike the reference's differentiable
+            # allreduce (tensorflow/mpi_ops.py _allreduce gradient).
+            @tf.custom_gradient
+            def _group_avg_op(x):
+                y = tf.ensure_shape(
+                    tf.py_function(_avg, [x], x.dtype), x.shape)
+
+                def grad(dy):
+                    return tf.ensure_shape(
+                        tf.py_function(_avg, [dy], dy.dtype), x.shape)
+
+                return y, grad
+
+            group = _group_avg_op(stack)
+            group_mean = group[0]
+            group_variance = group[1] - tf.math.square(group_mean)
+            return group_mean, group_variance
+
+    return SyncBatchNormalization
+
+
+_SYNC_BN_CLASS = None
+
+
+def __getattr__(name):
+    if name == "SyncBatchNormalization":
+        global _SYNC_BN_CLASS
+        if _SYNC_BN_CLASS is None:
+            _SYNC_BN_CLASS = _sync_batch_norm_class()
+        return _SYNC_BN_CLASS
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

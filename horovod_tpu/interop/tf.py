@@ -57,9 +57,18 @@ remove_process_set = _plane.remove_process_set
 # numpy staging, 0-d shape restoration, IndexedSlices handling) —
 # ONE maintained implementation for both tf front ends
 from .keras import (                                           # noqa: F401
-    allgather, allreduce, broadcast, broadcast_global_variables,
-    broadcast_variables,
+    allgather, allreduce, alltoall, broadcast, broadcast_,
+    broadcast_global_variables, broadcast_variables,
+    grouped_allgather, grouped_allreduce, grouped_reducescatter,
+    reducescatter,
 )
+
+
+def __getattr__(name):
+    if name == "SyncBatchNormalization":
+        from . import keras as _keras
+        return _keras.SyncBatchNormalization
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def barrier() -> None:

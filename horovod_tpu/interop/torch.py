@@ -237,6 +237,10 @@ def _alltoall_impl(t, splits=None, name=None, process_set=None):
                 f"({t.shape[0]} vs {n})")
         splits = [t.shape[0] // n] * n
     splits = [int(s) for s in splits]
+    if len(splits) != n:
+        raise ValueError(
+            f"alltoall needs one split per rank in the set "
+            f"({len(splits)} splits vs size {n})")
     if sum(splits) != t.shape[0]:
         raise ValueError("splits must sum to dim 0")
     if n == 1:

@@ -20,6 +20,23 @@ def test_single_process_identity():
     np.testing.assert_allclose(hvd.allreduce(t).numpy(), t.numpy())
     np.testing.assert_allclose(hvd.allgather(t).numpy(), t.numpy())
     np.testing.assert_allclose(hvd.broadcast(t).numpy(), t.numpy())
+    np.testing.assert_allclose(hvd.reducescatter(t).numpy(), t.numpy())
+    # alltoall: reference return convention — bare output without
+    # splits, (output, recv_splits) with
+    np.testing.assert_allclose(hvd.alltoall(t).numpy(), t.numpy())
+    out, rs = hvd.alltoall(t, splits=[1])
+    np.testing.assert_allclose(out.numpy(), t.numpy())
+    assert rs.numpy().tolist() == [1]
+    g1, g2 = hvd.grouped_allreduce([t, 2.0 * t])
+    np.testing.assert_allclose(g1.numpy(), t.numpy())
+    np.testing.assert_allclose(g2.numpy(), 2.0 * t.numpy())
+    # SyncBatchNormalization single-rank path == plain batch norm
+    sbn = hvd.SyncBatchNormalization(axis=-1, epsilon=1e-3)
+    x = tf.constant(np.random.RandomState(0).rand(8, 3).astype(np.float32))
+    y = sbn(x, training=True).numpy()
+    mu, var = x.numpy().mean(0), x.numpy().var(0)
+    np.testing.assert_allclose(
+        y, (x.numpy() - mu) / np.sqrt(var + 1e-3), rtol=1e-4, atol=1e-5)
     # single-process tape is a passthrough
     v = tf.Variable([1.0, 2.0])
     with tf.GradientTape() as tape:
@@ -125,6 +142,80 @@ def _tf_worker():
     np.testing.assert_allclose(gl.numpy(), [2.0])
     hvd.remove_process_set(ps0)
     hvd.remove_process_set(ps1)
+
+    # reducescatter: rank r keeps rows [2r, 2r+2) of the averaged tensor
+    trs = tf.constant((np.arange(8.0).reshape(4, 2)
+                       + float(r)).astype(np.float32))
+    rs = hvd.reducescatter(trs)                    # Average default
+    expect_full = np.arange(8.0).reshape(4, 2) + 0.5
+    np.testing.assert_allclose(rs.numpy(), expect_full[2 * r:2 * r + 2])
+
+    # alltoall with uneven splits: negotiated recv splits
+    # rank0 sends [1,2] of rows 0..2; rank1 sends [2,1] of rows 10..12
+    rows = (np.arange(3.0)[:, None] + 10.0 * r).astype(np.float32)
+    send_splits = [1, 2] if r == 0 else [2, 1]
+    out, rsp = hvd.alltoall(tf.constant(rows), splits=send_splits)
+    if r == 0:
+        assert rsp.numpy().tolist() == [1, 2]
+        np.testing.assert_allclose(out.numpy().ravel(), [0.0, 10.0, 11.0])
+    else:
+        assert rsp.numpy().tolist() == [2, 1]
+        np.testing.assert_allclose(out.numpy().ravel(), [1.0, 2.0, 12.0])
+
+    # grouped_allreduce: one fused round, averaged; mixed-dtype fallback
+    a = tf.constant(np.full(3, float(r + 1), np.float32))
+    b = tf.constant(np.full((2, 2), float(2 * r), np.float32))
+    ga, gb = hvd.grouped_allreduce([a, b])
+    np.testing.assert_allclose(ga.numpy(), np.full(3, 1.5))
+    np.testing.assert_allclose(gb.numpy(), np.full((2, 2), 1.0))
+    c64 = tf.constant(np.full(2, float(r), np.float64))
+    gm = hvd.grouped_allreduce([a, c64])
+    np.testing.assert_allclose(gm[1].numpy(), np.full(2, 0.5))
+
+    # broadcast_: in-place variable assign from root
+    bvar = tf.Variable(np.full(2, float(5 + r), np.float32))
+    ret = hvd.broadcast_(bvar, root_rank=1)
+    assert ret is bvar
+    np.testing.assert_allclose(bvar.numpy(), np.full(2, 6.0))
+
+    # SyncBatchNormalization: output normalized by GROUP stats (the
+    # concatenated global batch), eager and inside tf.function
+    xr = (np.random.RandomState(7 + r).rand(4, 3) * (r + 1)) \
+        .astype(np.float32)
+    both = np.concatenate(
+        [(np.random.RandomState(7 + k).rand(4, 3) * (k + 1))
+         .astype(np.float32) for k in range(2)])
+    gmu, gvar = both.mean(0), both.var(0)
+    sbn = hvd.SyncBatchNormalization(axis=-1, epsilon=1e-3)
+    y = sbn(tf.constant(xr), training=True).numpy()
+    np.testing.assert_allclose(y, (xr - gmu) / np.sqrt(gvar + 1e-3),
+                               rtol=1e-3, atol=1e-4)
+    fn = tf.function(lambda inp: sbn(inp, training=True))
+    yg = fn(tf.constant(xr)).numpy()
+    np.testing.assert_allclose(yg, y, rtol=1e-5, atol=1e-6)
+
+    # the gradient flows THROUGH the synced statistics: for loss = Σy
+    # the BN backward cancels exactly (≈1/σ per element if the group
+    # stats were silently treated as constants)
+    xv = tf.Variable(xr)
+    with tf.GradientTape() as tbn:
+        lbn = tf.reduce_sum(sbn(xv, training=True))
+    gbn = tbn.gradient(lbn, xv)
+    assert np.abs(gbn.numpy()).max() < 1e-2, gbn.numpy()
+
+    # uneven reducescatter: 3 rows over 2 ranks -> rank0 gets 2 rows
+    tu = tf.constant((np.arange(6.0).reshape(3, 2) + r).astype(np.float32))
+    ru = hvd.reducescatter(tu)
+    full = np.arange(6.0).reshape(3, 2) + 0.5
+    np.testing.assert_allclose(ru.numpy(),
+                               full[:2] if r == 0 else full[2:])
+
+    # wrong splits length is a clear error, not silent data loss
+    try:
+        hvd.alltoall(tf.constant(rows), splits=[1, 1, 1])
+        raise AssertionError("expected ValueError for bad splits length")
+    except ValueError:
+        pass
 
     # TensorFlowState: sync converges, restore-after-sync keeps synced
     sv = tf.Variable(np.full(2, float(r), np.float32))
