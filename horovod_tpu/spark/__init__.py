@@ -6,7 +6,8 @@ job is an injectable runner, rendezvous rides the HTTP KV server, and the
 estimator trains single-controller SPMD over the TPU mesh.
 """
 from .runner import (                                          # noqa: F401
-    MultiprocessingJobRunner, SparkJobRunner, run,
+    MultiprocessingJobRunner, SparkJobRunner, TaskFailuresError, run,
+    run_elastic,
 )
 from .store import FsspecStore, LocalStore, Store              # noqa: F401
 from .estimator import FlaxEstimator, FlaxModel                # noqa: F401

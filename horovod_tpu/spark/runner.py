@@ -85,6 +85,15 @@ def _mp_entry(task: Callable[[int], Any], index: int) -> None:
     task(index)
 
 
+class TaskFailuresError(RuntimeError):
+    """A barrier round lost tasks. `failed` is [(index, exitcode)] —
+    run_elastic uses its length as the shrink hint for the next round."""
+
+    def __init__(self, failed) -> None:
+        super().__init__(f"spark-local tasks failed: {failed}")
+        self.failed = list(failed)
+
+
 class MultiprocessingJobRunner:
     """Spawned local processes with the same task-body contract — the
     no-Spark fallback and the test vehicle (the reference tests Spark paths
@@ -109,7 +118,7 @@ class MultiprocessingJobRunner:
             if p.exitcode != 0:
                 failed.append((i, p.exitcode))
         if failed:
-            raise RuntimeError(f"spark-local tasks failed: {failed}")
+            raise TaskFailuresError(failed)
         return [None] * num_proc          # results read from KV by driver
 
 
@@ -191,3 +200,92 @@ def run(fn: Callable, args: Sequence = (), kwargs: Optional[dict] = None,
         return by_index
     finally:
         server.stop()
+
+
+def run_elastic(fn: Callable, args: Sequence = (),
+                kwargs: Optional[dict] = None,
+                num_proc: Optional[int] = None, *,
+                min_num_proc: Optional[int] = None,
+                max_num_proc: Optional[int] = None,
+                reset_limit: Optional[int] = None,
+                elastic_timeout: float = 600.0,
+                spark_context: Optional[Any] = None,
+                env: Optional[Dict[str, str]] = None,
+                job_runner: Optional[Callable[[Callable[[int], Any], int],
+                                              List[Any]]] = None,
+                start_timeout: float = 120.0,
+                retry_wait: float = 1.0,
+                # deprecated reference aliases (spark/runner.py:316-319)
+                min_np: Optional[int] = None,
+                max_np: Optional[int] = None) -> List[Any]:
+    """Elastic distributed run over Spark tasks (reference
+    horovod.spark.run_elastic, spark/runner.py:312).
+
+    TPU semantics (elastic/driver.py contract): a TPU mesh rebuild needs
+    a process restart, so each reset re-runs `fn` in a FRESH round of
+    barrier tasks instead of resuming in-process like the reference's
+    Gloo path. Workers resume from committed state — `fn` should use the
+    elastic State surface (FileBackedState, or State.sync() from rank 0)
+    exactly as with `hvdrun` elastic jobs. `HOROVOD_ELASTIC_ROUND` in the
+    worker env carries the round number; each round gets a fresh
+    `HOROVOD_SHM_GEN`/job id so a restarted incarnation can never attach
+    a dead round's segment.
+
+    A round that loses tasks shrinks the next round by the number of
+    lost tasks, floored at `min_num_proc` (default: `num_proc`, i.e. a
+    constant world size — Spark re-provisions executors on retry).
+    `reset_limit` bounds the number of resets; `elastic_timeout` bounds
+    the cumulative retry window after the first failure.
+    """
+    import time as _time
+    import uuid as _uuid
+
+    kwargs = dict(kwargs or {})
+    if min_np is not None and min_num_proc is None:
+        min_num_proc = min_np
+    if max_np is not None and max_num_proc is None:
+        max_num_proc = max_np
+    if num_proc is None:
+        num_proc = max_num_proc or 1
+    if max_num_proc is not None:
+        num_proc = min(num_proc, max_num_proc)
+    if min_num_proc is None:
+        min_num_proc = num_proc
+    if not (0 < min_num_proc <= num_proc):
+        raise ValueError(
+            f"need 0 < min_num_proc <= num_proc, got {min_num_proc} "
+            f"vs {num_proc}")
+
+    base_job = (env or {}).get("HOROVOD_JOB_ID", _uuid.uuid4().hex[:8])
+    np_now, resets = num_proc, 0
+    first_failure: Optional[float] = None
+    while True:
+        round_env = dict(env or {})
+        round_env["HOROVOD_JOB_ID"] = f"{base_job}r{resets}"
+        round_env["HOROVOD_SHM_GEN"] = \
+            str(_uuid.uuid4().int & ((1 << 63) - 1))
+        round_env["HOROVOD_ELASTIC_ROUND"] = str(resets)
+        try:
+            return run(fn, args, kwargs, np_now,
+                       spark_context=spark_context, env=round_env,
+                       job_runner=job_runner, start_timeout=start_timeout)
+        except TaskFailuresError as e:
+            lost = len(e.failed)
+        except Exception:
+            # runner-level failure (e.g. a Spark barrier-job abort):
+            # no per-task attribution, keep the world size
+            lost = 0
+        resets += 1
+        if reset_limit is not None and resets > reset_limit:
+            raise RuntimeError(
+                f"reset_limit ({reset_limit}) exceeded after {resets} "
+                "resets")
+        now = _time.monotonic()
+        if first_failure is None:
+            first_failure = now
+        elif now - first_failure > elastic_timeout:
+            raise RuntimeError(
+                f"elastic timeout: rounds kept failing for more than "
+                f"{elastic_timeout}s")
+        np_now = max(min_num_proc, np_now - lost)
+        _time.sleep(retry_wait)

@@ -98,6 +98,79 @@ def test_spark_run_failure_propagates():
             start_timeout=30.0)
 
 
+# -- run_elastic (reference horovod.spark.run_elastic) ----------------------
+
+def _elastic_fn(marker_dir):
+    """Collective over the interop plane, then rank 1 dies in round 0
+    AFTER its collectives (post-collective exits can't wedge peers);
+    round 1 must succeed with the full history visible on disk."""
+    import pathlib
+    from horovod_tpu.interop import _plane
+    _plane.init()
+    r = _plane.rank()
+    rnd = int(os.environ["HOROVOD_ELASTIC_ROUND"])
+    out = _plane.allreduce_np(np.ones(2, np.float32))
+    assert out[0] == float(_plane.size())
+    pathlib.Path(marker_dir, f"round{rnd}_rank{r}").write_text("ok")
+    _plane.shutdown()
+    if rnd == 0 and r == 1:
+        os._exit(17)
+    return (rnd, r, int(out[0]))
+
+
+def test_spark_run_elastic_restarts_round(tmp_path):
+    from horovod_tpu.spark import run_elastic
+    results = run_elastic(_elastic_fn, args=(str(tmp_path),), num_proc=2,
+                          job_runner=MultiprocessingJobRunner(),
+                          reset_limit=2, start_timeout=30.0,
+                          retry_wait=0.1)
+    # success in round 1 with a constant world size (min defaults to np)
+    assert results == [(1, 0, 2), (1, 1, 2)]
+    # both rounds ran both ranks
+    assert sorted(p.name for p in tmp_path.iterdir()) == [
+        "round0_rank0", "round0_rank1", "round1_rank0", "round1_rank1"]
+
+
+def _elastic_always_fail():
+    from horovod_tpu.interop import _plane
+    _plane.init()
+    r = _plane.rank()
+    _plane.shutdown()
+    if r == int(os.environ["HOROVOD_SIZE"]) - 1:
+        os._exit(3)
+    return r
+
+
+def test_spark_run_elastic_reset_limit(tmp_path):
+    from horovod_tpu.spark import run_elastic
+    with pytest.raises(RuntimeError, match="reset_limit"):
+        run_elastic(_elastic_always_fail, num_proc=2,
+                    job_runner=MultiprocessingJobRunner(),
+                    reset_limit=1, start_timeout=30.0, retry_wait=0.05)
+
+
+def test_spark_run_elastic_shrinks_to_min(tmp_path):
+    from horovod_tpu.spark import run_elastic
+    # last rank always dies: round 0 (np=2) loses 1 task, round 1 runs
+    # with np=1 whose "last rank" is rank 0 -> it dies too... so floor
+    # at min_num_proc=1 and reset_limit=3 proves the shrink happened by
+    # the time the limit trips (np can never go below 1)
+    results = run_elastic(_elastic_fn, args=(str(tmp_path),), num_proc=2,
+                          min_num_proc=1,
+                          job_runner=MultiprocessingJobRunner(),
+                          reset_limit=2, start_timeout=30.0,
+                          retry_wait=0.1)
+    # round 0 at np=2 fails (rank 1 exits), round 1 shrinks to np=1
+    assert results == [(1, 0, 1)]
+
+
+def test_spark_run_elastic_validates_min():
+    from horovod_tpu.spark import run_elastic
+    with pytest.raises(ValueError, match="min_num_proc"):
+        run_elastic(_task, num_proc=2, min_num_proc=5,
+                    job_runner=MultiprocessingJobRunner())
+
+
 # -- estimator --------------------------------------------------------------
 
 def test_flax_estimator_fit_predict(hvd, tmp_path):
