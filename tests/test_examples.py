@@ -74,3 +74,30 @@ def test_torch_ddp_example_single_process():
         capture_output=True, text=True, timeout=240, env=env, cwd=EXAMPLES)
     assert r.returncode == 0, r.stderr
     assert "mean loss" in r.stdout
+
+
+def test_tf2_custom_loop_example_under_hvdrun():
+    """The TF2-eager front end end-to-end: hvdrun -np 2."""
+    pytest.importorskip("tensorflow")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(EXAMPLES) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, os.path.join(EXAMPLES, "tf2_custom_loop.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=EXAMPLES)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "replicas identical across 2 rank(s)" in r.stdout
+
+
+def test_ray_executor_example_local_backend():
+    env = dict(os.environ)
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = os.path.dirname(EXAMPLES)
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "ray_executor.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=EXAMPLES)
+    assert r.returncode == 0, r.stderr
+    assert "2 workers" in r.stdout and "driver-side probe ok" in r.stdout
