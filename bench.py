@@ -206,14 +206,48 @@ def main() -> int:
         if attempt < MAX_ATTEMPTS and left > 60:
             # backoff counts against the total budget too
             time.sleep(min(BACKOFF_S * attempt, max(left - 60, 0)))
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": None,
         "unit": "img/sec/chip",
         "vs_baseline": None,
         "error": "; ".join(errors)[-2000:],
-    }), flush=True)
+    }
+    cached = _last_hardware_capture(metric)
+    if cached is not None:
+        # NOT the live value (that stays null) — the most recent real-TPU
+        # capture of this metric from benchmarks/*_results.jsonl, so a
+        # tunnel outage at capture time still surfaces the evidence
+        out["last_hardware_capture"] = cached
+    print(json.dumps(out), flush=True)
     return 1
+
+
+def _last_hardware_capture(metric: str):
+    """Most recent non-null real-TPU record of `metric` from the on-disk
+    capture logs (benchmarks/*_results.jsonl), or None."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(here, "benchmarks",
+                                              "*_results.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("metric") == metric and \
+                            rec.get("value") is not None and \
+                            rec.get("platform", "tpu") == "tpu":
+                        best = {k: rec[k] for k in
+                                ("metric", "value", "unit", "vs_baseline",
+                                 "batch", "timing") if k in rec}
+                        best["source"] = os.path.basename(path)
+        except OSError:
+            continue
+    return best
 
 
 if __name__ == "__main__":
