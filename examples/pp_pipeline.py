@@ -5,9 +5,13 @@ the reference, whose SURVEY §2.6 accounting lists PP as absent).
 
 Each device owns one stage's parameters; activations advance
 stage-to-stage with lax.ppermute inside the scan over clock ticks, and
-the backward pass flows through the same SPMD program via jax autodiff.
+the backward pass flows through the same SPMD program via jax autodiff
+(--schedule gpipe) or the explicit 1F1B schedule (--schedule 1f1b),
+which bounds live activations at 2S-1 per stage instead of M and
+accumulates parameter grads online.
 
     HVD_EXAMPLE_CPU=8 python examples/pp_pipeline.py --stages 4
+    HVD_EXAMPLE_CPU=8 python examples/pp_pipeline.py --schedule 1f1b
 """
 import argparse
 import time
@@ -22,7 +26,8 @@ import numpy as np                                          # noqa: E402
 from jax.sharding import PartitionSpec as P                 # noqa: E402
 
 from horovod_tpu.parallel.mesh_utils import make_mesh       # noqa: E402
-from horovod_tpu.parallel.pp import gpipe_and_return        # noqa: E402
+from horovod_tpu.parallel.pp import (gpipe_and_return,      # noqa: E402
+                                     pipeline_1f1b)
 
 
 def main() -> None:
@@ -32,6 +37,8 @@ def main() -> None:
     ap.add_argument("--mb-size", type=int, default=8)
     ap.add_argument("--width", type=int, default=32)
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b"],
+                    default="gpipe")
     args = ap.parse_args()
 
     S, M, mb, D = args.stages, args.microbatches, args.mb_size, args.width
@@ -51,17 +58,30 @@ def main() -> None:
     def stage_fn(w, x):
         return jnp.tanh(x @ w)
 
-    def loss_fn(w_local, xs, tgt):
-        out = gpipe_and_return(stage_fn, w_local[0], xs, "pp")
-        return ((out - tgt) ** 2).mean()
+    if args.schedule == "gpipe":
+        def loss_fn(w_local, xs, tgt):
+            out = gpipe_and_return(stage_fn, w_local[0], xs, "pp")
+            return ((out - tgt) ** 2).mean()
 
-    grad_fn = jax.jit(jax.shard_map(
-        jax.value_and_grad(loss_fn), mesh=mesh,
-        in_specs=(P("pp"), P(), P()), out_specs=(P(), P("pp"))))
+        grad_fn = jax.jit(jax.shard_map(
+            jax.value_and_grad(loss_fn), mesh=mesh,
+            in_specs=(P("pp"), P(), P()), out_specs=(P(), P("pp"))))
+        print(f"GPipe: {S} stages x {M} microbatches "
+              f"({S + M - 1} ticks/step)")
+    else:
+        def step_1f1b(w_local, xs, tgt):
+            loss, g = pipeline_1f1b(
+                stage_fn, w_local[0], xs, tgt,
+                lambda y, t: ((y - t) ** 2).mean(), "pp")
+            return loss, g[None]          # restore the stage axis
+
+        grad_fn = jax.jit(jax.shard_map(
+            step_1f1b, mesh=mesh,
+            in_specs=(P("pp"), P(), P()), out_specs=(P(), P("pp"))))
+        print(f"GPipe: {S} stages x {M} microbatches — 1F1B schedule "
+              f"({M + 2 * S - 1} ticks/step, <=2S-1 live activations)")
 
     lr = 0.2
-    print(f"GPipe: {S} stages x {M} microbatches "
-          f"({S + M - 1} ticks/step)")
     for step in range(args.steps):
         t0 = time.perf_counter()
         loss, grads = grad_fn(Ws, xs, tgt)

@@ -76,3 +76,105 @@ def gpipe_and_return(stage_fn, stage_params, microbatches,
     out = gpipe(stage_fn, stage_params, microbatches, axis_name)
     masked = jnp.where(idx == n - 1, out, jnp.zeros_like(out))
     return lax.psum(masked, axis_name)
+
+
+def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                  stage_params: Any,
+                  microbatches: jax.Array,
+                  targets: jax.Array,
+                  loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+                  axis_name: str = "pp"):
+    """One-forward-one-backward pipeline training step inside shard_map.
+
+    The memory-bound schedule (beyond the reference; GPipe + jax.grad
+    holds all M microbatch activations, 1F1B holds at most 2S-1 per
+    stage): each clock tick every stage runs one forward (microbatch
+    ``t - s``) and one backward (microbatch ``t - (2S-1-s)``), forward
+    activations ppermute right while cotangents ppermute left, and
+    parameter gradients accumulate online. Backward recomputes the stage
+    forward from the saved input (rematerialization — FLOPs for HBM, the
+    TPU trade).
+
+    stage_fn(params, x) -> y: one stage, same shape in/out.
+    microbatches: [M, mb, ...] (read on stage 0); targets: [M, ...]
+    (read on the last stage). loss_fn(y, target) -> scalar per
+    microbatch; the step optimizes the MEAN over microbatches.
+
+    Returns (loss, grads): the scalar mean loss (identical on every
+    stage) and this stage's parameter-gradient pytree.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    is_last = idx == n - 1
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    B = 2 * n - 1                     # ring-buffer depth = max live acts
+    right = [(i, (i + 1) % n) for i in range(n)]
+    left = [(i, (i - 1) % n) for i in range(n)]
+    inv_m = 1.0 / M
+
+    def _varying(x):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, axis_name, to="varying")
+        return lax.pvary(x, axis_name)
+
+    def tick(carry, t):
+        fwd_in, bwd_in, buf, gseed, gacc, loss_acc = carry
+        # read the backward half's saved input FIRST: at stage 0 the
+        # live-activation window equals the ring depth, so this tick's
+        # forward write lands in the same slot
+        # (written at tick t_f = t - (2(S-s) - 1))
+        bwd_slot = jnp.mod(t - (2 * (n - idx) - 1), B)
+        x_saved = lax.dynamic_index_in_dim(buf, bwd_slot, axis=0,
+                                           keepdims=False)
+        # ---- forward: microbatch t - s -------------------------------
+        m_f = t - idx
+        f_valid = (m_f >= 0) & (m_f < M)
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(m_f, 0, M - 1), axis=0, keepdims=False)
+        x = jnp.where(idx == 0, inject, fwd_in)
+        # zero invalid lanes BEFORE compute so junk can't make NaNs that
+        # survive multiplicative masking
+        x = jnp.where(f_valid, x, jnp.zeros_like(x))
+        y = stage_fn(stage_params, x)
+        buf = lax.dynamic_update_index_in_dim(buf, x, jnp.mod(t, B),
+                                              axis=0)
+        # last stage: per-microbatch loss + the backward seed dL/dy,
+        # consumed by the backward half exactly one tick later
+        tgt = lax.dynamic_index_in_dim(
+            targets, jnp.clip(m_f, 0, M - 1), axis=0, keepdims=False)
+        lval, loss_vjp = jax.vjp(loss_fn, y, tgt)
+        gy = loss_vjp(_varying(jnp.asarray(inv_m, lval.dtype)))[0]
+        lmask = f_valid & is_last
+        loss_acc = loss_acc + jnp.where(lmask, lval * inv_m, 0.0)
+        new_gseed = jnp.where(lmask, gy, jnp.zeros_like(gy))
+        # ---- backward: microbatch t - (2S-1-s) -----------------------
+        m_b = t - (2 * n - 1 - idx)
+        b_valid = (m_b >= 0) & (m_b < M)
+        g_in = jnp.where(is_last, gseed, bwd_in)
+        g_in = jnp.where(b_valid, g_in, jnp.zeros_like(g_in))
+        _, stage_vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        dparams, dx = stage_vjp(g_in)
+        gacc = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
+            gacc, dparams)
+        # ---- advance the rings ---------------------------------------
+        fwd_in = lax.ppermute(y, axis_name, right)
+        bwd_in = lax.ppermute(dx, axis_name, left)
+        return (fwd_in, bwd_in, buf, new_gseed, gacc, loss_acc), None
+
+    dt = microbatches.dtype
+    zero_act = lambda: _varying(jnp.zeros(mb_shape, dt))  # noqa: E731
+    carry0 = (zero_act(),                                # fwd ring
+              zero_act(),                                # bwd ring
+              _varying(jnp.zeros((B,) + mb_shape, dt)),  # act buffer
+              zero_act(),                                # loss seed
+              jax.tree_util.tree_map(
+                  lambda p: _varying(jnp.zeros(p.shape, p.dtype)),
+                  stage_params),
+              _varying(jnp.zeros((), jnp.float32)))
+    (_, _, _, _, grads, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(M + 2 * n - 1))
+    # only the last stage accumulated loss; share it with every stage
+    loss = lax.psum(loss_acc, axis_name)
+    return loss, grads

@@ -42,6 +42,8 @@ def _run(script, *args, timeout=240):
     ("join_uneven_data.py", [], "last joined rank = 7"),
     ("llama_pretrain.py", ["--steps", "2"], "gqa 4q/2kv"),
     ("pp_pipeline.py", ["--steps", "3"], "GPipe: 4 stages"),
+    ("pp_pipeline.py", ["--steps", "2", "--schedule", "1f1b"],
+     "1F1B schedule"),
     ("lightning_estimator.py", [], "lightning val_loss"),
 ])
 def test_example_runs(script, args, expect):
