@@ -1,15 +1,21 @@
-"""Pipeline parallelism: SPMD GPipe over a 'pp' mesh axis.
+"""Pipeline parallelism: SPMD GPipe + 1F1B over a 'pp' mesh axis.
 
 The reference has no pipeline parallelism (SURVEY §2.6 "PP — absent"). The
 TPU-native design runs all stages as ONE SPMD program: every device holds its
 stage's parameters; activations advance stage-to-stage with `lax.ppermute`
 (neighbor ICI transfers) inside a `lax.scan` over clock ticks — the
-collective-permute pipeline pattern. GPipe fill-drain schedule: with M
-microbatches and S stages, M + S - 1 ticks.
+collective-permute pipeline pattern. Two schedules:
+
+* `gpipe` — forward fill-drain (M + S - 1 ticks); training via jax autodiff
+  through the scan (holds all M microbatch activations).
+* `pipeline_1f1b` — explicit one-forward-one-backward training step: live
+  activations bounded at 2S-1 per stage, parameter grads accumulate online,
+  with hooks for non-uniform first/last stages (embedding input grads,
+  head/loss parameters) so real LMs pipeline end to end.
 """
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -82,8 +88,11 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
                   stage_params: Any,
                   microbatches: jax.Array,
                   targets: jax.Array,
-                  loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
-                  axis_name: str = "pp"):
+                  loss_fn: Callable[..., jax.Array],
+                  axis_name: str = "pp",
+                  *,
+                  head_params: Optional[Any] = None,
+                  return_input_grads: bool = False):
     """One-forward-one-backward pipeline training step inside shard_map.
 
     The memory-bound schedule (beyond the reference; GPipe + jax.grad
@@ -97,11 +106,23 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
     stage_fn(params, x) -> y: one stage, same shape in/out.
     microbatches: [M, mb, ...] (read on stage 0); targets: [M, ...]
-    (read on the last stage). loss_fn(y, target) -> scalar per
-    microbatch; the step optimizes the MEAN over microbatches.
+    (read on the last stage). The step optimizes the MEAN over
+    microbatches of ``loss_fn(y, target)`` — or, with `head_params`
+    given, ``loss_fn(head_params, y, target)``, so an LM head / final
+    projection lives inside the loss and its parameter grads come back
+    too (they conceptually belong to the last stage; returned replicated
+    via psum).
 
-    Returns (loss, grads): the scalar mean loss (identical on every
-    stage) and this stage's parameter-gradient pytree.
+    `return_input_grads=True` additionally returns dL/d(microbatches)
+    ([M, mb, ...], replicated) — the hook for a pre-pipeline embedding
+    computed outside: embed tokens, pipeline the blocks, backprop the
+    returned input grads into the embedding table.
+
+    Returns ``(loss, grads)`` — or ``(loss, grads, aux)`` when
+    `head_params` or `return_input_grads` is set, with
+    ``aux = {"head_grads": ..., "input_grads": ...}`` (absent hooks are
+    None). `loss` is the scalar mean loss, identical on every stage;
+    `grads` is this stage's parameter-gradient pytree.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -112,14 +133,20 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     right = [(i, (i + 1) % n) for i in range(n)]
     left = [(i, (i - 1) % n) for i in range(n)]
     inv_m = 1.0 / M
+    with_head = head_params is not None
 
     def _varying(x):
         if hasattr(lax, "pcast"):
             return lax.pcast(x, axis_name, to="varying")
         return lax.pvary(x, axis_name)
 
+    def _masked_add(acc, new, valid):
+        return jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(valid, g, jnp.zeros_like(g)),
+            acc, new)
+
     def tick(carry, t):
-        fwd_in, bwd_in, buf, gseed, gacc, loss_acc = carry
+        (fwd_in, bwd_in, buf, gseed, gacc, hacc, dxs, loss_acc) = carry
         # read the backward half's saved input FIRST: at stage 0 the
         # live-activation window equals the ring depth, so this tick's
         # forward write lands in the same slot
@@ -143,9 +170,19 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
         # consumed by the backward half exactly one tick later
         tgt = lax.dynamic_index_in_dim(
             targets, jnp.clip(m_f, 0, M - 1), axis=0, keepdims=False)
-        lval, loss_vjp = jax.vjp(loss_fn, y, tgt)
-        gy = loss_vjp(_varying(jnp.asarray(inv_m, lval.dtype)))[0]
         lmask = f_valid & is_last
+        if with_head:
+            # pvary the head first: a replicated (unvarying) primal
+            # makes vma-aware AD insert an implicit psum inside the vjp,
+            # folding OTHER stages' mid-pipeline activations into dhead
+            hp = jax.tree_util.tree_map(_varying, head_params)
+            lval, loss_vjp = jax.vjp(loss_fn, hp, y, tgt)
+            dhead, gy, _ = loss_vjp(_varying(jnp.asarray(inv_m,
+                                                         lval.dtype)))
+            hacc = _masked_add(hacc, dhead, lmask)
+        else:
+            lval, loss_vjp = jax.vjp(loss_fn, y, tgt)
+            gy = loss_vjp(_varying(jnp.asarray(inv_m, lval.dtype)))[0]
         loss_acc = loss_acc + jnp.where(lmask, lval * inv_m, 0.0)
         new_gseed = jnp.where(lmask, gy, jnp.zeros_like(gy))
         # ---- backward: microbatch t - (2S-1-s) -----------------------
@@ -155,26 +192,42 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
         g_in = jnp.where(b_valid, g_in, jnp.zeros_like(g_in))
         _, stage_vjp = jax.vjp(stage_fn, stage_params, x_saved)
         dparams, dx = stage_vjp(g_in)
-        gacc = jax.tree_util.tree_map(
-            lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
-            gacc, dparams)
+        gacc = _masked_add(gacc, dparams, b_valid)
+        if return_input_grads:
+            # stage 0's dx IS dL/d(microbatch m_b)
+            written = lax.dynamic_update_index_in_dim(
+                dxs, dx, jnp.clip(m_b, 0, M - 1), axis=0)
+            dxs = jnp.where(b_valid & (idx == 0), written, dxs)
         # ---- advance the rings ---------------------------------------
         fwd_in = lax.ppermute(y, axis_name, right)
         bwd_in = lax.ppermute(dx, axis_name, left)
-        return (fwd_in, bwd_in, buf, new_gseed, gacc, loss_acc), None
+        return (fwd_in, bwd_in, buf, new_gseed, gacc, hacc, dxs,
+                loss_acc), None
 
     dt = microbatches.dtype
     zero_act = lambda: _varying(jnp.zeros(mb_shape, dt))  # noqa: E731
+    zero_tree = lambda tree: jax.tree_util.tree_map(      # noqa: E731
+        lambda p: _varying(jnp.zeros(p.shape, p.dtype)), tree)
     carry0 = (zero_act(),                                # fwd ring
               zero_act(),                                # bwd ring
               _varying(jnp.zeros((B,) + mb_shape, dt)),  # act buffer
               zero_act(),                                # loss seed
-              jax.tree_util.tree_map(
-                  lambda p: _varying(jnp.zeros(p.shape, p.dtype)),
-                  stage_params),
+              zero_tree(stage_params),
+              zero_tree(head_params) if with_head else (),
+              _varying(jnp.zeros((M,) + mb_shape, dt))
+              if return_input_grads else (),
               _varying(jnp.zeros((), jnp.float32)))
-    (_, _, _, _, grads, loss_acc), _ = lax.scan(
+    (_, _, _, _, grads, hacc, dxs, loss_acc), _ = lax.scan(
         tick, carry0, jnp.arange(M + 2 * n - 1))
     # only the last stage accumulated loss; share it with every stage
     loss = lax.psum(loss_acc, axis_name)
-    return loss, grads
+    if not with_head and not return_input_grads:
+        return loss, grads
+    aux = {"head_grads": None, "input_grads": None}
+    if with_head:
+        # accumulated on the last stage only; replicate
+        aux["head_grads"] = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axis_name), hacc)
+    if return_input_grads:
+        aux["input_grads"] = lax.psum(dxs, axis_name)  # stage 0's writes
+    return loss, grads, aux

@@ -203,6 +203,63 @@ class TestPipeline:
         np.testing.assert_allclose(grads, np.asarray(ref_g),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_1f1b_lm_embed_and_head(self, hvd):
+        """The full-LM hooks: embedding outside the pipeline (input
+        grads returned), head inside the loss (head grads returned) —
+        every gradient matches sequential autodiff."""
+        from horovod_tpu.parallel.pp import pipeline_1f1b
+        rng = np.random.RandomState(3)
+        n, M, mb, S, D, V = 4, 4, 2, 8, 6, 12
+        Ws = (rng.randn(n, D, D) * 0.5).astype(np.float32)
+        emb = (rng.randn(V, D) * 0.5).astype(np.float32)
+        head = (rng.randn(D, V) * 0.5).astype(np.float32)
+        toks = rng.randint(0, V, (M, mb, S)).astype(np.int32)
+        tgts = rng.randint(0, V, (M, mb, S)).astype(np.int32)
+
+        def stage_fn(w, x):
+            return x + jnp.tanh(x @ w)          # residual block
+
+        def loss_fn(h, y, t):
+            logp = jax.nn.log_softmax(y @ h)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, t[..., None], axis=-1))
+
+        mesh = make_mesh(pp=4, devices=jax.devices()[:4])
+
+        def run(w, e, h):
+            xs = e[jnp.asarray(toks)]           # [M, mb, S, D]
+            loss, g, aux = pipeline_1f1b(
+                stage_fn, w[0], xs, jnp.asarray(tgts), loss_fn, "pp",
+                head_params=h, return_input_grads=True)
+            demb = jnp.zeros_like(e).at[jnp.asarray(toks).ravel()].add(
+                aux["input_grads"].reshape(-1, e.shape[1]))
+            return loss, g[None], aux["head_grads"], demb
+
+        f = jax.jit(jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp"), P(), P())))
+        loss, gW, gH, gE = f(Ws, emb, head)
+
+        def ref(w, e, h):
+            x = e[jnp.asarray(toks)]
+            for s in range(n):
+                x = stage_fn(w[s], x)
+            per_mb = jax.vmap(lambda y, t: loss_fn(h, y, t))(
+                x, jnp.asarray(tgts))
+            return per_mb.mean()
+
+        ref_l, (rW, rE, rH) = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(jnp.asarray(Ws), jnp.asarray(emb),
+                                    jnp.asarray(head))
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(gW), np.asarray(rW),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gH), np.asarray(rH),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gE), np.asarray(rE),
+                                   rtol=1e-4, atol=1e-5)
+
 
 class TestGPTModel:
     def test_gpt_dense_forward(self, hvd):
