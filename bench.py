@@ -229,8 +229,11 @@ def _last_hardware_capture(metric: str):
     import glob
     here = os.path.dirname(os.path.abspath(__file__))
     best = None
+    # mtime order, oldest first, so the newest file's newest record wins
+    # (lexical order would put round10 before round3)
     for path in sorted(glob.glob(os.path.join(here, "benchmarks",
-                                              "*_results.jsonl"))):
+                                              "*_results.jsonl")),
+                       key=os.path.getmtime):
         try:
             with open(path) as f:
                 for line in f:

@@ -17,7 +17,6 @@ import logging
 import os
 import threading
 import time
-import uuid
 from typing import Dict, List, Optional
 
 from ..runner import exec as exec_lib
@@ -128,8 +127,9 @@ class ElasticDriver:
         # Fresh shm-generation token per launch round so a restarted
         # incarnation can never attach a dead round's stale segment
         # (native/shm.py staleness check).
+        from ..native.shm import fresh_shm_gen
         env = dict(self.base_env)
-        env["HOROVOD_SHM_GEN"] = str(uuid.uuid4().int & ((1 << 63) - 1))
+        env["HOROVOD_SHM_GEN"] = fresh_shm_gen()
         self._workers = exec_lib.launch_slots(
             slots, self.command, coord, kv_port, self._secret, env,
             ssh_port=self.ssh_port,

@@ -30,6 +30,15 @@ class ShmError(RuntimeError):
     pass
 
 
+def fresh_shm_gen() -> str:
+    """A fresh generation token for HOROVOD_SHM_GEN (one per launch
+    round): lets attachers reject a stale segment left by a previous
+    incarnation under the same name. Single definition — the launcher,
+    the elastic driver, and spark run_elastic all mint tokens here."""
+    import uuid
+    return str(uuid.uuid4().int & ((1 << 63) - 1))
+
+
 def _check(status: int, what: str) -> None:
     if status == 1:
         raise ShmError(f"{what}: barrier timeout (peer died?)")

@@ -300,9 +300,9 @@ def run_static(args: argparse.Namespace) -> int:
         f":{_free_port()}"
     base_env = dict(os.environ)
     base_env.update(env_from_args(args))
-    # per-run token for shm-segment staleness detection (native/shm.py)
-    import uuid
-    base_env["HOROVOD_SHM_GEN"] = str(uuid.uuid4().int & ((1 << 63) - 1))
+    # per-run token for shm-segment staleness detection
+    from ..native.shm import fresh_shm_gen
+    base_env["HOROVOD_SHM_GEN"] = fresh_shm_gen()
 
     # Native control-plane store (csrc/store.cc): the rebuild's analog of the
     # reference launcher's Gloo rendezvous (gloo_run.py:242 RendezvousServer

@@ -21,10 +21,13 @@ Differences from the reference (TPU-first, optional-dependency):
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import socket
 from typing import Any, Callable, Dict, List, Optional, Sequence
+
+_logger = logging.getLogger("horovod_tpu")
 
 from ..runner.hosts import assign_from_hostnames
 from ..runner.http_kv import KVStoreClient, RendezvousServer, make_secret
@@ -256,36 +259,40 @@ def run_elastic(fn: Callable, args: Sequence = (),
             f"need 0 < min_num_proc <= num_proc, got {min_num_proc} "
             f"vs {num_proc}")
 
+    from ..native.shm import fresh_shm_gen
+
     base_job = (env or {}).get("HOROVOD_JOB_ID", _uuid.uuid4().hex[:8])
     np_now, resets = num_proc, 0
     first_failure: Optional[float] = None
+    last_exc: Optional[BaseException] = None
     while True:
         round_env = dict(env or {})
         round_env["HOROVOD_JOB_ID"] = f"{base_job}r{resets}"
-        round_env["HOROVOD_SHM_GEN"] = \
-            str(_uuid.uuid4().int & ((1 << 63) - 1))
+        round_env["HOROVOD_SHM_GEN"] = fresh_shm_gen()
         round_env["HOROVOD_ELASTIC_ROUND"] = str(resets)
         try:
             return run(fn, args, kwargs, np_now,
                        spark_context=spark_context, env=round_env,
                        job_runner=job_runner, start_timeout=start_timeout)
         except TaskFailuresError as e:
-            lost = len(e.failed)
-        except Exception:
+            lost, last_exc = len(e.failed), e
+        except Exception as e:  # noqa: BLE001 — any barrier-job abort
             # runner-level failure (e.g. a Spark barrier-job abort):
             # no per-task attribution, keep the world size
-            lost = 0
+            lost, last_exc = 0, e
+        _logger.warning("spark elastic: round %d failed (%s); resetting",
+                        resets, last_exc)
         resets += 1
         if reset_limit is not None and resets > reset_limit:
             raise RuntimeError(
                 f"reset_limit ({reset_limit}) exceeded after {resets} "
-                "resets")
+                "resets") from last_exc
         now = _time.monotonic()
         if first_failure is None:
             first_failure = now
         elif now - first_failure > elastic_timeout:
             raise RuntimeError(
                 f"elastic timeout: rounds kept failing for more than "
-                f"{elastic_timeout}s")
+                f"{elastic_timeout}s") from last_exc
         np_now = max(min_num_proc, np_now - lost)
         _time.sleep(retry_wait)

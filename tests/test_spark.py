@@ -151,16 +151,14 @@ def test_spark_run_elastic_reset_limit(tmp_path):
 
 def test_spark_run_elastic_shrinks_to_min(tmp_path):
     from horovod_tpu.spark import run_elastic
-    # last rank always dies: round 0 (np=2) loses 1 task, round 1 runs
-    # with np=1 whose "last rank" is rank 0 -> it dies too... so floor
-    # at min_num_proc=1 and reset_limit=3 proves the shrink happened by
-    # the time the limit trips (np can never go below 1)
+    # round 0 at np=2 loses rank 1 (_elastic_fn exits in round 0), so
+    # round 1 shrinks by the lost-task count to np=1 — proven by the
+    # single-rank result tuple (round 1, rank 0, world size 1)
     results = run_elastic(_elastic_fn, args=(str(tmp_path),), num_proc=2,
                           min_num_proc=1,
                           job_runner=MultiprocessingJobRunner(),
                           reset_limit=2, start_timeout=30.0,
                           retry_wait=0.1)
-    # round 0 at np=2 fails (rank 1 exits), round 1 shrinks to np=1
     assert results == [(1, 0, 1)]
 
 
