@@ -15,3 +15,4 @@ from .moe import (                              # noqa: F401
 from .llama import (                            # noqa: F401
     Llama, LlamaConfig, Llama_1B, llama_partition_rules,
 )
+from .gpt_pp import gpt_pp_init, make_gpt_pp_step   # noqa: F401

@@ -1,0 +1,150 @@
+"""Pipelined GPT: the decoder transformer trained with the 1F1B schedule.
+
+Composes models/gpt.py's Block with parallel/pp.pipeline_1f1b (beyond the
+reference — SURVEY §2.6 lists PP as absent): the embedding (+positions)
+runs replicated before the pipeline and trains through the returned input
+grads; each pp-mesh device owns `num_layers / stages` Blocks; the final
+LayerNorm + LM head live inside the pipeline loss (head grads returned
+replicated). One SPMD program — stage hops are neighbor `ppermute`s on
+ICI, live activations are bounded at 2S-1 microbatches per stage.
+
+    embed_p, stage_p, head_p = gpt_pp_init(cfg, stages, rng)
+    step = make_gpt_pp_step(cfg, mesh, num_microbatches=M)
+    loss, grads = step((embed_p, stage_p, head_p), tokens, targets)
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.pp import pipeline_1f1b
+from .gpt import Block
+
+
+class StageBlocks(nn.Module):
+    """One pipeline stage: a run of decoder Blocks (same shape in/out)."""
+    cfg: Any
+    blocks_per_stage: int
+
+    @nn.compact
+    def __call__(self, x):
+        block_cls = nn.remat(Block) if self.cfg.remat else Block
+        for i in range(self.blocks_per_stage):
+            x = block_cls(self.cfg, name=f"blk_{i}")(x)
+        return x
+
+
+class EmbedIn(nn.Module):
+    """Token + learned-position embedding (runs before the pipeline)."""
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        S = tokens.shape[-1]
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                       param_dtype=jnp.float32, name="pos_embed")(
+            jnp.arange(S)[None])
+        return (x + pos).astype(cfg.dtype)
+
+
+class Head(nn.Module):
+    """Final LayerNorm + LM head (lives inside the pipeline loss)."""
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(self.cfg.vocab_size, use_bias=False,
+                        dtype=jnp.float32, param_dtype=jnp.float32,
+                        name="lm_head")(x)
+
+
+def gpt_pp_init(cfg, stages: int, rng, microbatch_size: int = 1):
+    """Initialize (embed_params, stage_params, head_params).
+
+    stage_params is stacked [stages, ...] on the leading axis — shard it
+    P('pp') into the step. cfg.num_layers must divide by `stages`."""
+    if cfg.num_layers % stages:
+        raise ValueError(f"num_layers {cfg.num_layers} must divide by "
+                         f"stages {stages}")
+    bps = cfg.num_layers // stages
+    r_e, r_s, r_h = jax.random.split(rng, 3)
+    toks = jnp.zeros((microbatch_size, cfg.max_seq_len), jnp.int32)
+    x = jnp.zeros((microbatch_size, cfg.max_seq_len, cfg.embed_dim),
+                  cfg.dtype)
+    embed_p = EmbedIn(cfg).init(r_e, toks)["params"]
+    stage_mod = StageBlocks(cfg, bps)
+    stage_p = jax.vmap(lambda r: stage_mod.init(r, x)["params"])(
+        jax.random.split(r_s, stages))
+    head_p = Head(cfg).init(r_h, x)["params"]
+    return embed_p, stage_p, head_p
+
+
+def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
+                     pp_axis: str = "pp"):
+    """Build the jitted 1F1B loss+grads step.
+
+    Returned step(params, tokens, targets) takes
+    params = (embed_p, stage_p[S, ...], head_p), tokens/targets [B, S]
+    with B divisible by num_microbatches, and returns
+    (loss, (embed_grads, stage_grads, head_grads)) — stage grads stay
+    pp-sharded on their stacked axis; embed/head grads are replicated.
+    """
+    n_stages = mesh.shape[pp_axis]
+    bps = cfg.num_layers // n_stages
+    stage_mod = StageBlocks(cfg, bps)
+    embed_mod = EmbedIn(cfg)
+    head_mod = Head(cfg)
+    M = num_microbatches
+
+    def body(stage_p_stacked, embed_p, head_p, toks, tgts):
+        stage_p = jax.tree_util.tree_map(lambda a: a[0], stage_p_stacked)
+        mb = toks.shape[0] // M
+        toks_mb = toks.reshape(M, mb, toks.shape[1])
+        tgts_mb = tgts.reshape(M, mb, tgts.shape[1])
+
+        def embed_fn(p):
+            return jax.vmap(
+                lambda t: embed_mod.apply({"params": p}, t))(toks_mb)
+
+        xs, embed_vjp = jax.vjp(embed_fn, embed_p)
+
+        def stage_fn(p, x):
+            return stage_mod.apply({"params": p}, x)
+
+        def loss_fn(hp, y, t):
+            logp = jax.nn.log_softmax(
+                head_mod.apply({"params": hp}, y))
+            return -jnp.mean(
+                jnp.take_along_axis(logp, t[..., None], axis=-1))
+
+        loss, g_stage, aux = pipeline_1f1b(
+            stage_fn, stage_p, xs, tgts_mb, loss_fn, pp_axis,
+            head_params=head_p, return_input_grads=True)
+        (g_embed,) = embed_vjp(aux["input_grads"])
+        g_stage = jax.tree_util.tree_map(lambda g: g[None], g_stage)
+        return loss, g_embed, g_stage, aux["head_grads"]
+
+    mapped = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(pp_axis), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(pp_axis), P())))
+
+    def step(params, tokens, targets):
+        embed_p, stage_p, head_p = params
+        if tokens.shape[0] % M:
+            raise ValueError(
+                f"batch {tokens.shape[0]} must divide by "
+                f"num_microbatches {M}")
+        loss, g_embed, g_stage, g_head = mapped(
+            stage_p, embed_p, head_p, tokens, targets)
+        return loss, (g_embed, g_stage, g_head)
+
+    return step

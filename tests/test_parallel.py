@@ -260,6 +260,59 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(gE), np.asarray(rE),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_gpt_pp_matches_sequential(self, hvd):
+        """The pipelined GPT (models/gpt_pp.py): 1F1B loss and every
+        grad family (embed, per-stage blocks, head) == sequential
+        autodiff with the same modules and params."""
+        from horovod_tpu.models.gpt import GPTConfig
+        from horovod_tpu.models.gpt_pp import (EmbedIn, Head,
+                                               StageBlocks, gpt_pp_init,
+                                               make_gpt_pp_step)
+        cfg = GPTConfig(vocab_size=32, num_layers=4, num_heads=2,
+                        head_dim=4, max_seq_len=16, dtype=jnp.float32)
+        stages, M, mb, seq = 4, 4, 2, 16
+        embed_p, stage_p, head_p = gpt_pp_init(
+            cfg, stages, jax.random.PRNGKey(0))
+        mesh = make_mesh(pp=4, devices=jax.devices()[:4])
+        rnp = np.random.RandomState(0)
+        toks = rnp.randint(0, 32, (M * mb, seq)).astype(np.int32)
+        tgts = rnp.randint(0, 32, (M * mb, seq)).astype(np.int32)
+
+        step = make_gpt_pp_step(cfg, mesh, num_microbatches=M)
+        loss, (gE, gS, gH) = step((embed_p, stage_p, head_p), toks, tgts)
+
+        toks_mb = jnp.asarray(toks.reshape(M, mb, seq))
+        tgts_mb = jnp.asarray(tgts.reshape(M, mb, seq))
+        stage_mod = StageBlocks(cfg, cfg.num_layers // stages)
+
+        def ref(ep, sp, hp):
+            x = jax.vmap(lambda t: EmbedIn(cfg).apply(
+                {"params": ep}, t))(toks_mb)
+            for s in range(stages):
+                p_s = jax.tree_util.tree_map(lambda a: a[s], sp)
+                x = jax.vmap(lambda xx: stage_mod.apply(
+                    {"params": p_s}, xx))(x)
+
+            def mb_loss(y, t):
+                logp = jax.nn.log_softmax(
+                    Head(cfg).apply({"params": hp}, y))
+                return -jnp.mean(
+                    jnp.take_along_axis(logp, t[..., None], axis=-1))
+
+            return jax.vmap(mb_loss)(x, tgts_mb).mean()
+
+        ref_l, (rE, rS, rH) = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(embed_p, stage_p, head_p)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        for got, want, name in ((gE, rE, "embed"), (gS, rS, "stage"),
+                                (gH, rH, "head")):
+            flat_g = jax.tree_util.tree_leaves(got)
+            flat_r = jax.tree_util.tree_leaves(want)
+            for a, b in zip(flat_g, flat_r):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+                    err_msg=f"{name} grads diverge")
+
 
 class TestGPTModel:
     def test_gpt_dense_forward(self, hvd):
