@@ -88,7 +88,7 @@ def gpt_pp_init(cfg, stages: int, rng, microbatch_size: int = 1):
 
 
 def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
-                     pp_axis: str = "pp"):
+                     pp_axis: str = "pp", dp_axis: str = None):
     """Build the jitted 1F1B loss+grads step.
 
     Returned step(params, tokens, targets) takes
@@ -96,6 +96,12 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
     with B divisible by num_microbatches, and returns
     (loss, (embed_grads, stage_grads, head_grads)) — stage grads stay
     pp-sharded on their stacked axis; embed/head grads are replicated.
+
+    With `dp_axis` set (a pp×dp hybrid mesh), the global batch shards
+    over dp — each dp shard runs its own pipeline on B/dp examples (so
+    B must divide by dp*num_microbatches per shard) — and the loss and
+    every gradient family are pmean'd over dp (the DP allreduce riding
+    the same compiled program).
     """
     n_stages = mesh.shape[pp_axis]
     bps = cfg.num_layers // n_stages
@@ -103,9 +109,20 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
     embed_mod = EmbedIn(cfg)
     head_mod = Head(cfg)
     M = num_microbatches
+    vary = (dp_axis,) if dp_axis else ()
 
     def body(stage_p_stacked, embed_p, head_p, toks, tgts):
         stage_p = jax.tree_util.tree_map(lambda a: a[0], stage_p_stacked)
+        if dp_axis:
+            # everything the pipeline touches must be explicitly
+            # dp-varying: each dp shard runs an independent pipeline and
+            # the reduction happens ONCE, explicitly, at the end
+            _pv = (lambda a: jax.lax.pcast(a, dp_axis, to="varying")) \
+                if hasattr(jax.lax, "pcast") else \
+                (lambda a: jax.lax.pvary(a, dp_axis))
+            dpv = lambda t: jax.tree_util.tree_map(_pv, t)  # noqa: E731
+            stage_p, embed_p, head_p = (dpv(stage_p), dpv(embed_p),
+                                        dpv(head_p))
         mb = toks.shape[0] // M
         toks_mb = toks.reshape(M, mb, toks.shape[1])
         tgts_mb = tgts.reshape(M, mb, tgts.shape[1])
@@ -127,14 +144,23 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
 
         loss, g_stage, aux = pipeline_1f1b(
             stage_fn, stage_p, xs, tgts_mb, loss_fn, pp_axis,
-            head_params=head_p, return_input_grads=True)
+            head_params=head_p, return_input_grads=True,
+            vary_axes=vary)
         (g_embed,) = embed_vjp(aux["input_grads"])
+        g_head = aux["head_grads"]
+        if dp_axis:
+            pm = lambda t: jax.tree_util.tree_map(       # noqa: E731
+                lambda g: jax.lax.pmean(g, dp_axis), t)
+            loss = jax.lax.pmean(loss, dp_axis)
+            g_embed, g_stage, g_head = pm(g_embed), pm(g_stage), \
+                pm(g_head)
         g_stage = jax.tree_util.tree_map(lambda g: g[None], g_stage)
-        return loss, g_embed, g_stage, aux["head_grads"]
+        return loss, g_embed, g_stage, g_head
 
+    batch_spec = P(dp_axis) if dp_axis else P()
     mapped = jax.jit(jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(pp_axis), P(), P(), P(), P()),
+        in_specs=(P(pp_axis), P(), P(), batch_spec, batch_spec),
         out_specs=(P(), P(), P(pp_axis), P())))
 
     def step(params, tokens, targets):

@@ -92,7 +92,8 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
                   axis_name: str = "pp",
                   *,
                   head_params: Optional[Any] = None,
-                  return_input_grads: bool = False):
+                  return_input_grads: bool = False,
+                  vary_axes: tuple = ()):
     """One-forward-one-backward pipeline training step inside shard_map.
 
     The memory-bound schedule (beyond the reference; GPipe + jax.grad
@@ -118,6 +119,11 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     computed outside: embed tokens, pipeline the blocks, backprop the
     returned input grads into the embedding table.
 
+    `vary_axes`: further mesh axes the inputs are device-varying over
+    (e.g. a dp axis whose shards carry different microbatches) — the
+    scan carries are initialized varying over them too. The caller owns
+    any reduction over those axes (e.g. pmean the grads over dp).
+
     Returns ``(loss, grads)`` — or ``(loss, grads, aux)`` when
     `head_params` or `return_input_grads` is set, with
     ``aux = {"head_grads": ..., "input_grads": ...}`` (absent hooks are
@@ -134,11 +140,20 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     left = [(i, (i - 1) % n) for i in range(n)]
     inv_m = 1.0 / M
     with_head = head_params is not None
+    all_axes = (axis_name,) + tuple(vary_axes)
 
-    def _varying(x):
+    def _vary_pp(x):
+        # the pp axis only — for values already varying over vary_axes
         if hasattr(lax, "pcast"):
             return lax.pcast(x, axis_name, to="varying")
         return lax.pvary(x, axis_name)
+
+    def _varying(x):
+        # fresh zero-init carries: varying over pp AND the extra axes
+        for ax in all_axes:
+            x = lax.pcast(x, ax, to="varying") if hasattr(lax, "pcast") \
+                else lax.pvary(x, ax)
+        return x
 
     def _masked_add(acc, new, valid):
         return jax.tree_util.tree_map(
@@ -175,14 +190,16 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
             # pvary the head first: a replicated (unvarying) primal
             # makes vma-aware AD insert an implicit psum inside the vjp,
             # folding OTHER stages' mid-pipeline activations into dhead
-            hp = jax.tree_util.tree_map(_varying, head_params)
+            hp = jax.tree_util.tree_map(_vary_pp, head_params)
             lval, loss_vjp = jax.vjp(loss_fn, hp, y, tgt)
-            dhead, gy, _ = loss_vjp(_varying(jnp.asarray(inv_m,
-                                                         lval.dtype)))
+            # seed inherits lval's device-varying type via zeros_like
+            dhead, gy, _ = loss_vjp(jnp.zeros_like(lval)
+                                    + jnp.asarray(inv_m, lval.dtype))
             hacc = _masked_add(hacc, dhead, lmask)
         else:
             lval, loss_vjp = jax.vjp(loss_fn, y, tgt)
-            gy = loss_vjp(_varying(jnp.asarray(inv_m, lval.dtype)))[0]
+            gy = loss_vjp(jnp.zeros_like(lval)
+                          + jnp.asarray(inv_m, lval.dtype))[0]
         loss_acc = loss_acc + jnp.where(lmask, lval * inv_m, 0.0)
         new_gseed = jnp.where(lmask, gy, jnp.zeros_like(gy))
         # ---- backward: microbatch t - (2S-1-s) -----------------------

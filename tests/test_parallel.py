@@ -313,6 +313,61 @@ class TestPipeline:
                     np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
                     err_msg=f"{name} grads diverge")
 
+    def test_gpt_pp_dp_hybrid_matches_sequential(self, hvd):
+        """pp=4 x dp=2: each dp shard pipelines its half of the batch;
+        loss and all grads pmean over dp — must equal full-batch
+        sequential autodiff."""
+        from horovod_tpu.models.gpt import GPTConfig
+        from horovod_tpu.models.gpt_pp import (EmbedIn, Head,
+                                               StageBlocks, gpt_pp_init,
+                                               make_gpt_pp_step)
+        cfg = GPTConfig(vocab_size=32, num_layers=4, num_heads=2,
+                        head_dim=4, max_seq_len=16, dtype=jnp.float32)
+        stages, dp, M, mb, seq = 4, 2, 2, 2, 16
+        embed_p, stage_p, head_p = gpt_pp_init(
+            cfg, stages, jax.random.PRNGKey(1))
+        mesh = make_mesh(pp=4, dp=2)
+        rnp = np.random.RandomState(2)
+        B = dp * M * mb
+        toks = rnp.randint(0, 32, (B, seq)).astype(np.int32)
+        tgts = rnp.randint(0, 32, (B, seq)).astype(np.int32)
+
+        step = make_gpt_pp_step(cfg, mesh, num_microbatches=M,
+                                dp_axis="dp")
+        loss, (gE, gS, gH) = step((embed_p, stage_p, head_p), toks, tgts)
+
+        # oracle: mean over ALL dp*M microbatches, sequential
+        toks_mb = jnp.asarray(toks.reshape(dp * M, mb, seq))
+        tgts_mb = jnp.asarray(tgts.reshape(dp * M, mb, seq))
+        stage_mod = StageBlocks(cfg, cfg.num_layers // stages)
+
+        def ref(ep, sp, hp):
+            x = jax.vmap(lambda t: EmbedIn(cfg).apply(
+                {"params": ep}, t))(toks_mb)
+            for s in range(stages):
+                p_s = jax.tree_util.tree_map(lambda a: a[s], sp)
+                x = jax.vmap(lambda xx: stage_mod.apply(
+                    {"params": p_s}, xx))(x)
+
+            def mb_loss(y, t):
+                logp = jax.nn.log_softmax(
+                    Head(cfg).apply({"params": hp}, y))
+                return -jnp.mean(
+                    jnp.take_along_axis(logp, t[..., None], axis=-1))
+
+            return jax.vmap(mb_loss)(x, tgts_mb).mean()
+
+        ref_l, (rE, rS, rH) = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(embed_p, stage_p, head_p)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        for got, want, name in ((gE, rE, "embed"), (gS, rS, "stage"),
+                                (gH, rH, "head")):
+            for a, b in zip(jax.tree_util.tree_leaves(got),
+                            jax.tree_util.tree_leaves(want)):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+                    err_msg=f"{name} grads diverge (pp x dp)")
+
 
 class TestGPTModel:
     def test_gpt_dense_forward(self, hvd):
