@@ -240,6 +240,222 @@ def _ring_attention_flash(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def zigzag_order(n: int) -> list:
+    """Chunk order of the zigzag layout: device i holds sequence chunks
+    (i, 2n-1-i) of 2n equal chunks — the balanced-causal sharding."""
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    return order
+
+
+def zigzag_shard(x: jax.Array, n: int, seq_axis: int = 2) -> jax.Array:
+    """Permute the global sequence so standard equal sharding over the
+    mesh axis hands device i chunks (i, 2n-1-i). Inverse:
+    zigzag_unshard. S must divide by 2n."""
+    S = x.shape[seq_axis]
+    if S % (2 * n):
+        raise ValueError(f"seq {S} must divide by 2n={2 * n}")
+    c = S // (2 * n)
+    shape = x.shape
+    split = shape[:seq_axis] + (2 * n, c) + shape[seq_axis + 1:]
+    return jnp.take(x.reshape(split), jnp.asarray(zigzag_order(n)),
+                    axis=seq_axis).reshape(shape)
+
+
+def zigzag_unshard(x: jax.Array, n: int, seq_axis: int = 2) -> jax.Array:
+    """Inverse permutation of zigzag_shard."""
+    S = x.shape[seq_axis]
+    c = S // (2 * n)
+    inv = [0] * (2 * n)
+    for pos, chunk in enumerate(zigzag_order(n)):
+        inv[chunk] = pos
+    shape = x.shape
+    split = shape[:seq_axis] + (2 * n, c) + shape[seq_axis + 1:]
+    return jnp.take(x.reshape(split), jnp.asarray(inv),
+                    axis=seq_axis).reshape(shape)
+
+
+def zigzag_ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          axis_name: str, *, causal: bool = True,
+                          scale: Optional[float] = None,
+                          impl: str = "lax") -> jax.Array:
+    """Causally load-balanced ring attention over the zigzag layout.
+
+    Plain causal ring attention makes device i compute i+1 of n KV
+    blocks — wall clock is the last device's n blocks, ~2x the useful
+    work. In the zigzag layout (device i holds sequence chunks i and
+    2n-1-i of 2n; see zigzag_shard) every device sees ~2 visible
+    half-blocks per ring step, so causal wall clock halves at large n.
+    Inputs/outputs are device-local zigzag blocks [B, H, S_local, D]
+    (inside shard_map); GQA kv-width blocks circulate like
+    ring_attention. Non-causal zigzag is the plain ring (no imbalance
+    to fix) and is delegated.
+
+    impl: "lax" masks by true positions inside the einsum;
+    "flash"/"flash_interpret" decompose each step into per-chunk-pair
+    Pallas kernels (full / diagonal / skipped) merged by LSE, so the
+    kernel only runs on visible areas.
+    """
+    if not causal:
+        return ring_attention(q, k, v, axis_name, causal=False,
+                              scale=scale, impl=impl)
+    if impl in ("flash", "flash_interpret"):
+        return _zigzag_flash(q, k, v, axis_name, scale=scale,
+                             interpret=impl == "flash_interpret")
+    if impl != "lax":
+        raise ValueError(f"unknown zigzag attention impl {impl!r}")
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    groups = H // k.shape[1]
+    c = Sq // 2
+    ckv = Skv // 2
+    scale_ = scale if scale is not None else 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32) * scale_
+    if groups > 1:
+        qf = qf.reshape(B, H // groups, groups * Sq, D)
+
+    def positions(dev, half_len):
+        # local rows -> true positions: first half chunk `dev`, second
+        # half chunk 2n-1-dev
+        head = dev * half_len + jnp.arange(half_len)
+        tail = (2 * n - 1 - dev) * half_len + jnp.arange(half_len)
+        return jnp.concatenate([head, tail])
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        o, m, l, kc, vc = carry
+        src = (idx - step) % n
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        q_pos = positions(idx, c)
+        k_pos = positions(src, ckv)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        if groups > 1:
+            mask = jnp.tile(mask, (groups, 1))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+        o, m, l = _online_softmax_step(o, m, l, s, vc)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, m, l, kc, vc), None
+
+    o0 = qf * 0.0
+    m0 = qf[..., 0] * 0.0 + NEG_INF
+    l0 = qf[..., 0] * 0.0
+    (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v),
+                                  jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    if groups > 1:
+        out = out.reshape(B, H, Sq, D)
+    return out.astype(q.dtype)
+
+
+def _merge_lse(o_a, lse_a, o_b, lse_b):
+    """Combine two flash partials by log-sum-exp (flash-decoding merge).
+    Returns (o_weighted_sum, m, l) — caller divides by l at the end."""
+    m = jnp.maximum(lse_a, lse_b)
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    w_a = jnp.where(lse_a <= NEG_INF / 2, 0.0, jnp.exp(lse_a - safe_m))
+    w_b = jnp.where(lse_b <= NEG_INF / 2, 0.0, jnp.exp(lse_b - safe_m))
+    o = o_a.astype(jnp.float32) * w_a[..., None] \
+        + o_b.astype(jnp.float32) * w_b[..., None]
+    return o, m, w_a + w_b
+
+
+def _zigzag_flash(q, k, v, axis_name, *, scale, interpret):
+    """Zigzag causal ring with per-chunk-pair Pallas kernels.
+
+    Each ring step splits the visiting KV block into its (head, tail)
+    chunks and the local queries likewise; each of the four chunk pairs
+    is exactly full, diagonal, or empty under causality, so the flash
+    kernel runs only on visible areas — the balanced schedule that makes
+    zigzag ~2x plain causal ring at large n.
+    """
+    from ..ops.pallas_attention import flash_attention_lse
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Sq, D = q.shape
+    c = Sq // 2
+    q_head, q_tail = q[:, :, :c], q[:, :, c:]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def full(qq, kk, vv):
+        o, lse = flash_attention_lse(qq, kk, vv, causal=False,
+                                     scale=scale, interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def diag(qq, kk, vv):
+        o, lse = flash_attention_lse(qq, kk, vv, causal=True,
+                                     scale=scale, interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def skip(qq, kk, vv):
+        return (qq.astype(jnp.float32) * 0.0,
+                qq[..., 0].astype(jnp.float32) * 0.0 + NEG_INF)
+
+    def body(carry, step):
+        ow_h, m_h, l_h, ow_t, m_t, l_t, kc, vc = carry
+        src = (idx - step) % n
+        ckv = kc.shape[2] // 2
+        k_head, k_tail = kc[:, :, :ckv], kc[:, :, ckv:]
+        v_head, v_tail = vc[:, :, :ckv], vc[:, :, ckv:]
+
+        # q_head (chunk idx) vs k_head (chunk src):
+        #   src < idx -> full, src == idx -> diagonal, src > idx -> none
+        # q_head vs k_tail (chunk 2n-1-src >= n > idx): never visible
+        o1, lse1 = lax.cond(
+            src == idx, diag,
+            lambda a, b, cc: lax.cond(src < idx, full, skip, a, b, cc),
+            q_head, k_head, v_head)
+        # q_tail (chunk 2n-1-idx) vs k_head (chunk src < n): always full
+        o2, lse2 = full(q_tail, k_head, v_head)
+        # q_tail vs k_tail (chunk 2n-1-src):
+        #   src > idx -> full, src == idx -> diagonal, src < idx -> none
+        o3, lse3 = lax.cond(
+            src == idx, diag,
+            lambda a, b, cc: lax.cond(src > idx, full, skip, a, b, cc),
+            q_tail, k_tail, v_tail)
+
+        def merge_into(ow, m, l, o_i, lse_i):
+            m_new = jnp.maximum(m, lse_i)
+            safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+            corr = jnp.where(m <= NEG_INF / 2, 0.0,
+                             jnp.exp(jnp.minimum(m - safe_m, 0.0)))
+            w = jnp.where(lse_i <= NEG_INF / 2, 0.0,
+                          jnp.exp(lse_i - safe_m))
+            return (ow * corr[..., None] + o_i * w[..., None],
+                    m_new, l * corr + w)
+
+        ow_h, m_h, l_h = merge_into(ow_h, m_h, l_h, o1, lse1)
+        o23, m23, l23 = _merge_lse(o2, lse2, o3, lse3)
+        # o23 is weight-summed with denominator l23 at reference max
+        # m23: fold as a partial with lse = m23 + log(l23)
+        lse23 = jnp.where(l23 > 0.0, m23 + jnp.log(jnp.maximum(l23,
+                                                               1e-38)),
+                          NEG_INF)
+        o23 = o23 / jnp.maximum(l23, 1e-38)[..., None]
+        ow_t, m_t, l_t = merge_into(ow_t, m_t, l_t, o23, lse23)
+
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (ow_h, m_h, l_h, ow_t, m_t, l_t, kc, vc), None
+
+    def zeros_like_q(qq):
+        f = qq.astype(jnp.float32)
+        return f * 0.0, f[..., 0] * 0.0 + NEG_INF, f[..., 0] * 0.0
+
+    oh0, mh0, lh0 = zeros_like_q(q_head)
+    ot0, mt0, lt0 = zeros_like_q(q_tail)
+    (ow_h, _, l_h, ow_t, _, l_t, _, _), _ = lax.scan(
+        body, (oh0, mh0, lh0, ot0, mt0, lt0, k, v), jnp.arange(n))
+    out_h = ow_h / jnp.maximum(l_h, 1e-20)[..., None]
+    out_t = ow_t / jnp.maximum(l_t, 1e-20)[..., None]
+    return jnp.concatenate([out_h, out_t], axis=2).astype(q.dtype)
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str, *, causal: bool = True,
                       scale: Optional[float] = None,

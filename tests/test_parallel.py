@@ -135,6 +135,102 @@ class TestExpertParallel:
         np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
 
 
+class TestZigzagRing:
+    def test_shard_roundtrip(self, hvd):
+        x = np.arange(2 * 3 * 32 * 4).reshape(2, 3, 32, 4) \
+            .astype(np.float32)
+        z = sp_lib.zigzag_shard(jnp.asarray(x), 8)
+        assert not np.array_equal(np.asarray(z), x)
+        np.testing.assert_array_equal(
+            np.asarray(sp_lib.zigzag_unshard(z, 8)), x)
+
+    @pytest.mark.parametrize("impl", ["lax", "flash_interpret"])
+    def test_matches_dense_causal(self, hvd, impl):
+        q, k, v = _qkv()
+        n = 8
+        mesh = make_mesh(sp=8)
+        spec = P(None, None, "sp", None)
+        qz, kz, vz = [sp_lib.zigzag_shard(jnp.asarray(t), n)
+                      for t in (q, k, v)]
+        f = jax.jit(jax.shard_map(
+            lambda a, b, c: sp_lib.zigzag_ring_attention(
+                a, b, c, "sp", causal=True, impl=impl),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=(impl == "lax")))
+        out = sp_lib.zigzag_unshard(f(qz, kz, vz), n)
+        ref = sp_lib.attention_reference(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=2e-4)
+
+    def test_noncausal_delegates_to_ring(self, hvd):
+        q, k, v = _qkv()
+        mesh = make_mesh(sp=8)
+        spec = P(None, None, "sp", None)
+        f = jax.jit(jax.shard_map(
+            lambda a, b, c: sp_lib.zigzag_ring_attention(
+                a, b, c, "sp", causal=False),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
+        out = f(*[jnp.asarray(t) for t in (q, k, v)])
+        ref = sp_lib.attention_reference(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=2e-4)
+
+    def test_gqa_kv_width(self, hvd):
+        rng = np.random.RandomState(5)
+        B, H, Hkv, S, D = 2, 4, 2, 32, 8
+        q = (rng.randn(B, H, S, D) * 0.3).astype(np.float32)
+        k = (rng.randn(B, Hkv, S, D) * 0.3).astype(np.float32)
+        v = (rng.randn(B, Hkv, S, D) * 0.3).astype(np.float32)
+        n = 8
+        mesh = make_mesh(sp=8)
+        spec = P(None, None, "sp", None)
+        qz = sp_lib.zigzag_shard(jnp.asarray(q), n)
+        kz = sp_lib.zigzag_shard(jnp.asarray(k), n)
+        vz = sp_lib.zigzag_shard(jnp.asarray(v), n)
+        f = jax.jit(jax.shard_map(
+            lambda a, b, c: sp_lib.zigzag_ring_attention(
+                a, b, c, "sp", causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
+        out = sp_lib.zigzag_unshard(f(qz, kz, vz), n)
+        kf, vf = sp_lib.expand_kv_heads(jnp.asarray(k), jnp.asarray(v),
+                                        H // Hkv)
+        ref = sp_lib.attention_reference(jnp.asarray(q), kf, vf,
+                                         causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("impl", ["lax", "flash_interpret"])
+    def test_grads_match_dense(self, hvd, impl):
+        q, k, v = _qkv(B=1, H=2, S=32, D=8)
+        n = 8
+        mesh = make_mesh(sp=8)
+        spec = P(None, None, "sp", None)
+
+        def zig_loss(q_, k_, v_):
+            qz, kz, vz = [sp_lib.zigzag_shard(t, n)
+                          for t in (q_, k_, v_)]
+            f = jax.shard_map(
+                lambda a, b, c: sp_lib.zigzag_ring_attention(
+                    a, b, c, "sp", causal=True, impl=impl),
+                mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+                check_vma=(impl == "lax"))
+            out = sp_lib.zigzag_unshard(f(qz, kz, vz), n)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        def ref_loss(q_, k_, v_):
+            out = sp_lib.attention_reference(q_, k_, v_, causal=True)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        args = [jnp.asarray(t) for t in (q, k, v)]
+        gz = jax.jit(jax.grad(zig_loss, argnums=(0, 1, 2)))(*args)
+        gr = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(*args)
+        for a, b in zip(gz, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+
+
 class TestPipeline:
     def test_gpipe_matches_sequential(self, hvd):
         from horovod_tpu.parallel.pp import gpipe_and_return
