@@ -38,7 +38,7 @@ class GPTConfig:
         self.embed_dim = num_heads * head_dim
         self.mlp_dim = self.embed_dim * mlp_ratio
         self.max_seq_len = max_seq_len
-        self.attention = attention          # dense | ring | ulysses
+        self.attention = attention   # dense | ring | ulysses | zigzag
         self.mesh = mesh
         self.sp_axis = sp_axis
         self.dp_axis = dp_axis
@@ -68,9 +68,11 @@ class Attention(nn.Module):
         qkv = qkv.reshape(B, S, 3, cfg.num_heads, cfg.head_dim)
         q, k, v = [qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3)]
 
-        if cfg.attention in ("ring", "ulysses") and cfg.mesh is not None:
-            attn = (sp_lib.ring_attention if cfg.attention == "ring"
-                    else sp_lib.ulysses_attention)
+        if cfg.attention in ("ring", "ulysses", "zigzag") \
+                and cfg.mesh is not None:
+            attn = {"ring": sp_lib.ring_attention,
+                    "ulysses": sp_lib.ulysses_attention,
+                    "zigzag": sp_lib.zigzag_ring_attention}[cfg.attention]
             sp_impl, vma = sp_lib.sp_impl_for(cfg.attention_impl)
             mesh_axes = cfg.mesh.axis_names
             b_ax = cfg.dp_axis if cfg.dp_axis in mesh_axes else None
@@ -132,9 +134,22 @@ class GPT(nn.Module):
                        param_dtype=jnp.float32, name="pos_embed")(
             jnp.arange(S)[None])
         x = (x + pos).astype(cfg.dtype)
+        zig = (cfg.attention == "zigzag" and cfg.mesh is not None
+               and cfg.sp_axis in cfg.mesh.axis_names)
+        if zig:
+            # residual stream in zigzag order between embed (positions
+            # already added in natural order) and the final norm — see
+            # models/llama.py; causal masks use true positions
+            n_sp = cfg.mesh.shape[cfg.sp_axis]
+            if S % (2 * n_sp):
+                raise ValueError(f"zigzag needs seq {S} divisible by "
+                                 f"2*sp={2 * n_sp}")
+            x = sp_lib.zigzag_shard(x, n_sp, seq_axis=1)
         block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layers_{i}")(x)
+        if zig:
+            x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
                           dtype=jnp.float32, param_dtype=jnp.float32,

@@ -56,7 +56,10 @@ class LlamaConfig:
         self.mlp_dim = mlp_dim or _round_up(8 * self.embed_dim // 3, 128)
         self.max_seq_len = max_seq_len
         self.rope_theta = rope_theta
-        self.attention = attention          # dense | ring | ulysses
+        #: dense | ring | ulysses | zigzag (causally load-balanced ring;
+        #: the residual stream runs zigzag-permuted between embed and
+        #: final norm — user-invisible, logits return in natural order)
+        self.attention = attention
         self.mesh = mesh
         self.sp_axis = sp_axis
         self.dp_axis = dp_axis
@@ -123,7 +126,8 @@ class LlamaAttention(nn.Module):
         v = dense(KV * D, name="wv")(x).reshape(B, S, KV, D)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
-        sp = (cfg.attention in ("ring", "ulysses") and cfg.mesh is not None
+        sp = (cfg.attention in ("ring", "ulysses", "zigzag")
+              and cfg.mesh is not None
               and cfg.sp_axis in cfg.mesh.axis_names)
         angles = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
         if sp:
@@ -131,8 +135,9 @@ class LlamaAttention(nn.Module):
             b_ax = cfg.dp_axis if cfg.dp_axis in mesh_axes else None
             h_ax = cfg.tp_axis if cfg.tp_axis in mesh_axes else None
             spec = P(b_ax, h_ax, cfg.sp_axis, None)
-            attn = (sp_lib.ring_attention if cfg.attention == "ring"
-                    else sp_lib.ulysses_attention)
+            attn = {"ring": sp_lib.ring_attention,
+                    "ulysses": sp_lib.ulysses_attention,
+                    "zigzag": sp_lib.zigzag_ring_attention}[cfg.attention]
             sp_impl, vma = sp_lib.sp_impl_for(cfg.attention_impl)
 
             def sharded(q, k, v):
@@ -141,8 +146,20 @@ class LlamaAttention(nn.Module):
                 # locally, so ICI traffic is H/KV times smaller
                 idx = jax.lax.axis_index(cfg.sp_axis)
                 s_loc = q.shape[2]
-                win = jax.lax.dynamic_slice_in_dim(
-                    angles, idx * s_loc, s_loc, axis=0)
+                if cfg.attention == "zigzag":
+                    # local rows are chunks (idx, 2n-1-idx) of 2n — the
+                    # RoPE window follows the true zigzag positions
+                    n_sp = jax.lax.psum(1, cfg.sp_axis)
+                    c = s_loc // 2
+                    win = jnp.concatenate([
+                        jax.lax.dynamic_slice_in_dim(
+                            angles, idx * c, c, axis=0),
+                        jax.lax.dynamic_slice_in_dim(
+                            angles, (2 * n_sp - 1 - idx) * c, c, axis=0),
+                    ])
+                else:
+                    win = jax.lax.dynamic_slice_in_dim(
+                        angles, idx * s_loc, s_loc, axis=0)
                 qr = apply_rope(q, win)
                 kr = apply_rope(k, win)
                 return attn(qr, kr, v, axis_name=cfg.sp_axis, causal=True,
@@ -203,9 +220,24 @@ class Llama(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
                      param_dtype=jnp.float32, name="embed")(tokens)
         x = x.astype(cfg.dtype)
+        zig = (cfg.attention == "zigzag" and cfg.mesh is not None
+               and cfg.sp_axis in cfg.mesh.axis_names)
+        if zig:
+            # the residual stream runs in the zigzag order between the
+            # embedding and the final norm: one gather each way for the
+            # whole model, RMSNorm/SwiGLU are position-independent, and
+            # attention masks/RoPE use the true positions
+            n_sp = cfg.mesh.shape[cfg.sp_axis]
+            if tokens.shape[1] % (2 * n_sp):
+                raise ValueError(
+                    f"zigzag needs seq {tokens.shape[1]} divisible by "
+                    f"2*sp={2 * n_sp}")
+            x = sp_lib.zigzag_shard(x, n_sp, seq_axis=1)
         block_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layers_{i}")(x)
+        if zig:
+            x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = RMSNorm(name="norm_f")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="lm_head")(x)

@@ -147,10 +147,12 @@ class TestLlamaParallel:
         wq = p["layers_0"]["attn"]["wq"]["kernel"]
         assert wq.sharding.spec == P(None, "tp")
 
-    def test_ring_sp_matches_dense(self, hvd):
-        # GQA kv-width blocks circulate the ring (2 kv heads, 4 q heads)
+    @pytest.mark.parametrize("attention", ["ring", "zigzag"])
+    def test_ring_sp_matches_dense(self, hvd, attention):
+        # GQA kv-width blocks circulate the ring (2 kv heads, 4 q heads);
+        # zigzag additionally permutes the residual stream + RoPE windows
         mesh = make_mesh(dp=2, sp=4)
-        cfg_r = _tiny(mesh=mesh, attention="ring", num_kv_heads=2)
+        cfg_r = _tiny(mesh=mesh, attention=attention, num_kv_heads=2)
         cfg_d = _tiny(num_kv_heads=2)
         toks = jnp.asarray(
             np.random.RandomState(1).randint(0, 64, (2, 32)), jnp.int32)
@@ -235,7 +237,7 @@ class TestFlashSP:
     """Ring/Ulysses attention with the Pallas flash kernel per step
     (flash-decoding-style LSE merging) must match the lax sp path."""
 
-    @pytest.mark.parametrize("attention", ["ring", "ulysses"])
+    @pytest.mark.parametrize("attention", ["ring", "ulysses", "zigzag"])
     def test_flash_sp_matches_lax_sp(self, hvd, attention):
         mesh = make_mesh(dp=2, sp=4)
         toks = jnp.asarray(

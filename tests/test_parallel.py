@@ -476,7 +476,8 @@ class TestGPTModel:
         logits = model.apply({"params": params}, tokens)
         assert logits.shape == (2, 16, 64)
 
-    def test_gpt_ring_matches_dense(self, hvd):
+    @pytest.mark.parametrize("attention", ["ring", "zigzag"])
+    def test_gpt_ring_matches_dense(self, hvd, attention):
         from horovod_tpu.models.gpt import GPT, GPTConfig
         mesh = make_mesh(sp=8)
         tokens = np.random.RandomState(0).randint(
@@ -484,7 +485,7 @@ class TestGPTModel:
         cfg_d = GPTConfig(vocab_size=64, num_layers=1, num_heads=4,
                           head_dim=8, max_seq_len=64, dtype=jnp.float32)
         cfg_r = GPTConfig(vocab_size=64, num_layers=1, num_heads=4,
-                          head_dim=8, max_seq_len=64, attention="ring",
+                          head_dim=8, max_seq_len=64, attention=attention,
                           mesh=mesh, dp_axis="none", tp_axis="none",
                           dtype=jnp.float32)
         model_d, model_r = GPT(cfg_d), GPT(cfg_r)
