@@ -43,9 +43,10 @@ def main():
                     help="sequence-parallel degree (ring attention); "
                          "dp = devices // sp")
     ap.add_argument("--attention", default=None,
-                    choices=["dense", "ring", "ulysses"],
+                    choices=["dense", "ring", "ulysses", "zigzag"],
                     help="override attention mode (default: ring when "
-                         "--sp > 1 else dense)")
+                         "--sp > 1 else dense; zigzag = causally "
+                         "load-balanced ring)")
     args = ap.parse_args()
     if args.iters <= 0:
         ap.error("--iters must be positive")
@@ -67,7 +68,7 @@ def main():
         ap.error(f"--sp {args.sp} must divide device count {n_dev}")
     mesh = make_mesh(dp=n_dev // args.sp, sp=args.sp)
     attention = args.attention or ("ring" if args.sp > 1 else "dense")
-    if attention in ("ring", "ulysses") and args.sp <= 1:
+    if attention in ("ring", "ulysses", "zigzag") and args.sp <= 1:
         ap.error(f"--attention {attention} requires --sp > 1")
 
     if args.family == "llama":

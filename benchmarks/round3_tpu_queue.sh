@@ -16,6 +16,9 @@
 #      impossible marginal TFLOP/s for its extra blocks vs rn50@64 —
 #      recheck both models at the same batch with repeats)
 #   3. llama GQA (kv-heads 4) and long-seq 4096 flash configs
+# (zigzag ring attention needs sp>1 = multiple chips; it cannot be
+# captured on the single tunneled chip — correctness + balance are
+# proven on the 8-device CPU mesh instead)
 # Generous timeouts: killing a TPU process mid-RPC wedges the tunnel.
 set -u
 cd "$(dirname "$0")/.."

@@ -38,6 +38,10 @@ def main() -> None:
                          "(ZeRO/FSDP, parallel/fsdp.py)")
     ap.add_argument("--remat", action="store_true",
                     help="per-block activation checkpointing")
+    ap.add_argument("--attention", default=None,
+                    choices=["dense", "ring", "ulysses", "zigzag"],
+                    help="attention mode (default: ring when --sp > 1; "
+                         "zigzag = causally load-balanced ring)")
     args = ap.parse_args()
 
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
@@ -45,7 +49,8 @@ def main() -> None:
         vocab_size=256, num_layers=2, num_heads=4,
         num_kv_heads=args.kv_heads, head_dim=16,
         max_seq_len=args.seq, mesh=mesh,
-        attention="ring" if args.sp > 1 else "dense",
+        attention=args.attention or
+        ("ring" if args.sp > 1 else "dense"),
         dtype=jnp.float32, remat=args.remat)
     model = Llama(cfg)
 

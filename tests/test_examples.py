@@ -41,6 +41,8 @@ def _run(script, *args, timeout=240):
     ("compression_fusion_sweep.py", ["--steps", "2"], "sweep done"),
     ("join_uneven_data.py", [], "last joined rank = 7"),
     ("llama_pretrain.py", ["--steps", "2"], "gqa 4q/2kv"),
+    ("llama_pretrain.py", ["--steps", "2", "--attention", "zigzag"],
+     "loss"),
     ("pp_pipeline.py", ["--steps", "3"], "GPipe: 4 stages"),
     ("pp_pipeline.py", ["--steps", "2", "--schedule", "1f1b"],
      "1F1B schedule"),
