@@ -248,3 +248,167 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     if return_input_grads:
         aux["input_grads"] = lax.psum(dxs, axis_name)  # stage 0's writes
     return loss, grads, aux
+
+
+def pipeline_interleaved_1f1b(
+        stage_fn: Callable[[Any, jax.Array], jax.Array],
+        stage_params: Any,
+        microbatches: jax.Array,
+        targets: jax.Array,
+        loss_fn: Callable[..., jax.Array],
+        axis_name: str = "pp",
+        *,
+        head_params: Optional[Any] = None,
+        return_input_grads: bool = False,
+        vary_axes: tuple = ()):
+    """Interleaved (virtual-stage) 1F1B: Megatron-style bubble shrink.
+
+    `stage_params` is stacked [V, ...]: this device owns V virtual
+    stages — global stage i + j·n for chunk j on device i — so the
+    pipeline has S·V stages on S devices and the fill/drain bubble per
+    microbatch group shrinks by V (activations just flow around the
+    same ppermute ring V times; stage n·j's input arrives from device
+    n-1's chunk j-1 via the ordinary wrap). Schedules forward of
+    microbatch m on global stage s at tick m+s and backward at tick
+    m+2nV−s; each device still runs at most one forward and one
+    backward per tick.
+
+    Constraint: M ≤ n (one microbatch group — the Megatron group size).
+    For more microbatches, run waves of n and combine (losses average,
+    gradients add).
+
+    Same hooks and return convention as pipeline_1f1b; grads come back
+    stacked [V, ...] matching `stage_params`.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    if M > n:
+        raise ValueError(
+            f"interleaved schedule takes one microbatch group at a time "
+            f"(M={M} > stages={n}); run waves of {n} and combine")
+    V = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    mb_shape = microbatches.shape[1:]
+    B = 2 * n * V                     # ring-buffer depth (window max)
+    right = [(i, (i + 1) % n) for i in range(n)]
+    left = [(i, (i - 1) % n) for i in range(n)]
+    inv_m = 1.0 / M
+    with_head = head_params is not None
+    all_axes = (axis_name,) + tuple(vary_axes)
+
+    def _vary_pp(x):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, axis_name, to="varying")
+        return lax.pvary(x, axis_name)
+
+    def _varying(x):
+        for ax in all_axes:
+            x = lax.pcast(x, ax, to="varying") if hasattr(lax, "pcast") \
+                else lax.pvary(x, ax)
+        return x
+
+    def _masked_add(acc, new, valid):
+        return jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(valid, g, jnp.zeros_like(g)),
+            acc, new)
+
+    def _chunk_params(j):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(
+                a, jnp.clip(j, 0, V - 1), axis=0, keepdims=False),
+            stage_params)
+
+    def tick(carry, t):
+        (fwd_in, bwd_in, buf, gseed, gacc, hacc, dxs, loss_acc) = carry
+        # ---- backward indices + saved-input read (before the write:
+        # the (i=0, j=0) window equals the ring depth) ----------------
+        # bwd of (m, stage s=i+jn) runs at t = m + 2nV - 1 - i - jn,
+        # so w := t - (2nV - 1) + i = m - jn
+        w = t - 2 * n * V + 1 + idx
+        m_b = jnp.mod(w, n)
+        j_b = (m_b - w) // n
+        b_valid = (w <= m_b) & (j_b < V) & (m_b < M)
+        slot_r = jnp.mod(m_b + idx + j_b * n, B)
+        x_saved = lax.dynamic_index_in_dim(buf, slot_r, axis=0,
+                                           keepdims=False)
+        # ---- forward: device i, tick t -> (m, chunk) ----------------
+        r = t - idx
+        m_f = jnp.mod(r, n)
+        j_f = r // n
+        f_valid = (r >= 0) & (m_f < M) & (j_f < V)
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(m_f, 0, M - 1), axis=0,
+            keepdims=False)
+        # global stage 0 == device 0 chunk 0 injects; every other
+        # (device, chunk) takes the ring value (device 0's chunks j>0
+        # receive device n-1 chunk j-1 through the ordinary wrap)
+        x = jnp.where((idx == 0) & (j_f == 0), inject, fwd_in)
+        x = jnp.where(f_valid, x, jnp.zeros_like(x))
+        y = stage_fn(_chunk_params(j_f), x)
+        buf = lax.dynamic_update_index_in_dim(buf, x, jnp.mod(t, B),
+                                              axis=0)
+        tgt = lax.dynamic_index_in_dim(
+            targets, jnp.clip(m_f, 0, M - 1), axis=0, keepdims=False)
+        lmask = f_valid & (idx == n - 1) & (j_f == V - 1)
+        if with_head:
+            hp = jax.tree_util.tree_map(_vary_pp, head_params)
+            lval, loss_vjp = jax.vjp(loss_fn, hp, y, tgt)
+            dhead, gy, _ = loss_vjp(jnp.zeros_like(lval)
+                                    + jnp.asarray(inv_m, lval.dtype))
+            hacc = _masked_add(hacc, dhead, lmask)
+        else:
+            lval, loss_vjp = jax.vjp(loss_fn, y, tgt)
+            gy = loss_vjp(jnp.zeros_like(lval)
+                          + jnp.asarray(inv_m, lval.dtype))[0]
+        loss_acc = loss_acc + jnp.where(lmask, lval * inv_m, 0.0)
+        new_gseed = jnp.where(lmask, gy, jnp.zeros_like(gy))
+        # ---- backward ------------------------------------------------
+        g_in = jnp.where((idx == n - 1) & (j_b == V - 1), gseed, bwd_in)
+        g_in = jnp.where(b_valid, g_in, jnp.zeros_like(g_in))
+        _, stage_vjp = jax.vjp(stage_fn, _chunk_params(j_b), x_saved)
+        dparams, dx = stage_vjp(g_in)
+        gacc = jax.tree_util.tree_map(
+            lambda acc, g: lax.dynamic_update_index_in_dim(
+                acc,
+                lax.dynamic_index_in_dim(
+                    acc, jnp.clip(j_b, 0, V - 1), axis=0,
+                    keepdims=False)
+                + jnp.where(b_valid, g, jnp.zeros_like(g)),
+                jnp.clip(j_b, 0, V - 1), axis=0),
+            gacc, dparams)
+        if return_input_grads:
+            written = lax.dynamic_update_index_in_dim(
+                dxs, dx, jnp.clip(m_b, 0, M - 1), axis=0)
+            dxs = jnp.where(b_valid & (idx == 0) & (j_b == 0),
+                            written, dxs)
+        # ---- rings ---------------------------------------------------
+        fwd_in = lax.ppermute(y, axis_name, right)
+        bwd_in = lax.ppermute(dx, axis_name, left)
+        return (fwd_in, bwd_in, buf, new_gseed, gacc, hacc, dxs,
+                loss_acc), None
+
+    dt = microbatches.dtype
+    zero_act = lambda: _varying(jnp.zeros(mb_shape, dt))  # noqa: E731
+    zero_tree = lambda tree: jax.tree_util.tree_map(      # noqa: E731
+        lambda p: _varying(jnp.zeros(p.shape, p.dtype)), tree)
+    carry0 = (zero_act(),                                # fwd ring
+              zero_act(),                                # bwd ring
+              _varying(jnp.zeros((B,) + mb_shape, dt)),  # act buffer
+              zero_act(),                                # loss seed
+              zero_tree(stage_params),                   # [V, ...] gacc
+              zero_tree(head_params) if with_head else (),
+              _varying(jnp.zeros((M,) + mb_shape, dt))
+              if return_input_grads else (),
+              _varying(jnp.zeros((), jnp.float32)))
+    (_, _, _, _, grads, hacc, dxs, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(M + 2 * n * V - 1))
+    loss = lax.psum(loss_acc, axis_name)
+    if not with_head and not return_input_grads:
+        return loss, grads
+    aux = {"head_grads": None, "input_grads": None}
+    if with_head:
+        aux["head_grads"] = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axis_name), hacc)
+    if return_input_grads:
+        aux["input_grads"] = lax.psum(dxs, axis_name)
+    return loss, grads, aux

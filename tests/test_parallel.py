@@ -356,6 +356,77 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(gE), np.asarray(rE),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("M", [2, 4])
+    def test_interleaved_1f1b_matches_sequential(self, hvd, M):
+        """Virtual-stage (Megatron interleaved) schedule: n=4 devices x
+        V=2 chunks = 8 global stages; loss + per-chunk grads + head +
+        input grads all match sequential autodiff."""
+        from horovod_tpu.parallel.pp import pipeline_interleaved_1f1b
+        rng = np.random.RandomState(11)
+        n, V, mb, D = 4, 2, 2, 6
+        S_total = n * V
+        Wg = (rng.randn(S_total, D, D) * 0.5).astype(np.float32)
+        # device i owns global stages (i, i+n) -> stack [n, V, D, D]
+        Wdev = np.stack([Wg[[i, i + n]] for i in range(n)])
+        xs = rng.randn(M, mb, D).astype(np.float32)
+        ys = rng.randn(M, mb, D).astype(np.float32)
+        head = (rng.randn(D, D) * 0.5).astype(np.float32)
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        def loss_fn(h, y, t):
+            return jnp.mean((y @ h - t) ** 2)
+
+        mesh = make_mesh(pp=4, devices=jax.devices()[:4])
+
+        def run(w, a, b, h):
+            loss, g, aux = pipeline_interleaved_1f1b(
+                stage_fn, w[0], a, b, loss_fn, "pp",
+                head_params=h, return_input_grads=True)
+            return loss, g[None], aux["head_grads"], aux["input_grads"]
+
+        f = jax.jit(jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(P("pp"), P(), P(), P()),
+            out_specs=(P(), P("pp"), P(), P())))
+        loss, gW, gH, gX = f(Wdev, xs, ys, head)
+
+        def ref(wg, h, xin):
+            x = xin
+            for s in range(S_total):
+                x = stage_fn(wg[s], x)
+            return jax.vmap(lambda y, t: loss_fn(h, y, t))(
+                x, jnp.asarray(ys)).mean()
+
+        ref_l, (rWg, rH, rX) = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(jnp.asarray(Wg), jnp.asarray(head),
+                                    jnp.asarray(xs))
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        rWdev = np.stack([np.asarray(rWg)[[i, i + n]] for i in range(n)])
+        np.testing.assert_allclose(np.asarray(gW), rWdev,
+                                   rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gH), np.asarray(rH),
+                                   rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gX), np.asarray(rX),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_interleaved_rejects_large_group(self, hvd):
+        from horovod_tpu.parallel.pp import pipeline_interleaved_1f1b
+        mesh = make_mesh(pp=4, devices=jax.devices()[:4])
+        W = np.zeros((4, 2, 4, 4), np.float32)
+        xs = np.zeros((6, 2, 4), np.float32)   # M=6 > n=4
+
+        def run(w, a):
+            return pipeline_interleaved_1f1b(
+                lambda p, x: x, w[0], a, a,
+                lambda y, t: jnp.mean(y), "pp")
+
+        with pytest.raises(ValueError, match="waves"):
+            jax.jit(jax.shard_map(
+                run, mesh=mesh, in_specs=(P("pp"), P()),
+                out_specs=(P(), P("pp"))))(W, xs)
+
     def test_gpt_pp_matches_sequential(self, hvd):
         """The pipelined GPT (models/gpt_pp.py): 1F1B loss and every
         grad family (embed, per-stage blocks, head) == sequential
