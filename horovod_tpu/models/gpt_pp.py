@@ -66,29 +66,42 @@ class Head(nn.Module):
                         name="lm_head")(x)
 
 
-def gpt_pp_init(cfg, stages: int, rng, microbatch_size: int = 1):
+def gpt_pp_init(cfg, stages: int, rng, microbatch_size: int = 1,
+                virtual: int = 1):
     """Initialize (embed_params, stage_params, head_params).
 
     stage_params is stacked [stages, ...] on the leading axis — shard it
-    P('pp') into the step. cfg.num_layers must divide by `stages`."""
-    if cfg.num_layers % stages:
+    P('pp') into the step. With `virtual` > 1 (the interleaved
+    schedule) it is stacked [stages, virtual, ...]: device i's chunk j
+    holds GLOBAL stage i + j*stages, the interleaved assignment.
+    cfg.num_layers must divide by stages*virtual."""
+    if cfg.num_layers % (stages * virtual):
         raise ValueError(f"num_layers {cfg.num_layers} must divide by "
-                         f"stages {stages}")
-    bps = cfg.num_layers // stages
+                         f"stages*virtual={stages * virtual}")
+    bps = cfg.num_layers // (stages * virtual)
     r_e, r_s, r_h = jax.random.split(rng, 3)
     toks = jnp.zeros((microbatch_size, cfg.max_seq_len), jnp.int32)
     x = jnp.zeros((microbatch_size, cfg.max_seq_len, cfg.embed_dim),
                   cfg.dtype)
     embed_p = EmbedIn(cfg).init(r_e, toks)["params"]
     stage_mod = StageBlocks(cfg, bps)
-    stage_p = jax.vmap(lambda r: stage_mod.init(r, x)["params"])(
-        jax.random.split(r_s, stages))
+    flat = jax.vmap(lambda r: stage_mod.init(r, x)["params"])(
+        jax.random.split(r_s, stages * virtual))
+    if virtual > 1:
+        # [S*V, ...] in global-stage order -> [S, V, ...] where
+        # [i, j] = global stage i + j*S
+        order = jnp.asarray([[i + j * stages for j in range(virtual)]
+                             for i in range(stages)])
+        stage_p = jax.tree_util.tree_map(lambda a: a[order], flat)
+    else:
+        stage_p = flat
     head_p = Head(cfg).init(r_h, x)["params"]
     return embed_p, stage_p, head_p
 
 
 def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
-                     pp_axis: str = "pp", dp_axis: str = None):
+                     pp_axis: str = "pp", dp_axis: str = None,
+                     virtual: int = 1):
     """Build the jitted 1F1B loss+grads step.
 
     Returned step(params, tokens, targets) takes
@@ -102,9 +115,15 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
     B must divide by dp*num_microbatches per shard) — and the loss and
     every gradient family are pmean'd over dp (the DP allreduce riding
     the same compiled program).
+
+    `virtual` > 1 selects the interleaved schedule
+    (pipeline_interleaved_1f1b): stage_params from
+    gpt_pp_init(..., virtual=V) is [stages, V, ...] and
+    num_microbatches must be ≤ stages (one group per step).
     """
+    from ..parallel.pp import pipeline_interleaved_1f1b
     n_stages = mesh.shape[pp_axis]
-    bps = cfg.num_layers // n_stages
+    bps = cfg.num_layers // (n_stages * virtual)
     stage_mod = StageBlocks(cfg, bps)
     embed_mod = EmbedIn(cfg)
     head_mod = Head(cfg)
@@ -142,7 +161,9 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
             return -jnp.mean(
                 jnp.take_along_axis(logp, t[..., None], axis=-1))
 
-        loss, g_stage, aux = pipeline_1f1b(
+        pipeline = pipeline_1f1b if virtual == 1 \
+            else pipeline_interleaved_1f1b
+        loss, g_stage, aux = pipeline(
             stage_fn, stage_p, xs, tgts_mb, loss_fn, pp_axis,
             head_params=head_p, return_input_grads=True,
             vary_axes=vary)

@@ -480,6 +480,59 @@ class TestPipeline:
                     np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
                     err_msg=f"{name} grads diverge")
 
+    def test_gpt_pp_interleaved_matches_sequential(self, hvd):
+        """Pipelined GPT on the interleaved schedule: 2 devices x 2
+        virtual chunks = 4 global stages."""
+        from horovod_tpu.models.gpt import GPTConfig
+        from horovod_tpu.models.gpt_pp import (EmbedIn, Head,
+                                               StageBlocks, gpt_pp_init,
+                                               make_gpt_pp_step)
+        cfg = GPTConfig(vocab_size=32, num_layers=4, num_heads=2,
+                        head_dim=4, max_seq_len=16, dtype=jnp.float32)
+        stages, V, M, mb, seq = 2, 2, 2, 2, 16
+        embed_p, stage_p, head_p = gpt_pp_init(
+            cfg, stages, jax.random.PRNGKey(4), virtual=V)
+        mesh = make_mesh(pp=2, devices=jax.devices()[:2])
+        rnp = np.random.RandomState(5)
+        toks = rnp.randint(0, 32, (M * mb, seq)).astype(np.int32)
+        tgts = rnp.randint(0, 32, (M * mb, seq)).astype(np.int32)
+
+        step = make_gpt_pp_step(cfg, mesh, num_microbatches=M,
+                                virtual=V)
+        loss, (gE, gS, gH) = step((embed_p, stage_p, head_p), toks, tgts)
+
+        toks_mb = jnp.asarray(toks.reshape(M, mb, seq))
+        tgts_mb = jnp.asarray(tgts.reshape(M, mb, seq))
+        stage_mod = StageBlocks(cfg, cfg.num_layers // (stages * V))
+
+        def ref(ep, sp, hp):
+            x = jax.vmap(lambda t: EmbedIn(cfg).apply(
+                {"params": ep}, t))(toks_mb)
+            for s in range(stages * V):   # global stage s = [s%S, s//S]
+                p_s = jax.tree_util.tree_map(
+                    lambda a: a[s % stages, s // stages], sp)
+                x = jax.vmap(lambda xx: stage_mod.apply(
+                    {"params": p_s}, xx))(x)
+
+            def mb_loss(y, t):
+                logp = jax.nn.log_softmax(
+                    Head(cfg).apply({"params": hp}, y))
+                return -jnp.mean(
+                    jnp.take_along_axis(logp, t[..., None], axis=-1))
+
+            return jax.vmap(mb_loss)(x, tgts_mb).mean()
+
+        ref_l, (rE, rS, rH) = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(embed_p, stage_p, head_p)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        for got, want, name in ((gE, rE, "embed"), (gS, rS, "stage"),
+                                (gH, rH, "head")):
+            for a, b in zip(jax.tree_util.tree_leaves(got),
+                            jax.tree_util.tree_leaves(want)):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+                    err_msg=f"{name} grads diverge (interleaved)")
+
     def test_gpt_pp_dp_hybrid_matches_sequential(self, hvd):
         """pp=4 x dp=2: each dp shard pipelines its half of the batch;
         loss and all grads pmean over dp — must equal full-batch
