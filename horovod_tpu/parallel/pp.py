@@ -412,3 +412,82 @@ def pipeline_interleaved_1f1b(
     if return_input_grads:
         aux["input_grads"] = lax.psum(dxs, axis_name)
     return loss, grads, aux
+
+
+def pipeline_interleaved_waves(stage_fn, stage_params, microbatches,
+                               targets, loss_fn, axis_name: str = "pp",
+                               *, head_params: Optional[Any] = None,
+                               return_input_grads: bool = False,
+                               vary_axes: tuple = ()):
+    """Interleaved 1F1B over M > S microbatches: waves of S groups.
+
+    Scans pipeline_interleaved_1f1b over ⌈M/S⌉ groups of S microbatches
+    (M must divide by S), averaging losses and every gradient family —
+    the exact mean-over-M objective of pipeline_1f1b. Same return
+    convention; with `return_input_grads` the per-wave input grads
+    reassemble to [M, mb, ...].
+    """
+    n = lax.psum(1, axis_name)
+    M = microbatches.shape[0]
+    if M <= n:
+        return pipeline_interleaved_1f1b(
+            stage_fn, stage_params, microbatches, targets, loss_fn,
+            axis_name, head_params=head_params,
+            return_input_grads=return_input_grads, vary_axes=vary_axes)
+    if M % n:
+        raise ValueError(f"microbatch count {M} must divide by the "
+                         f"stage count {n} for wave scheduling")
+    W = M // n
+    xs_w = microbatches.reshape((W, n) + microbatches.shape[1:])
+    ts_w = targets.reshape((W, n) + targets.shape[1:])
+    with_head = head_params is not None
+
+    def wave(carry, inputs):
+        gsum, hsum, lsum = carry
+        xw, tw = inputs
+        out = pipeline_interleaved_1f1b(
+            stage_fn, stage_params, xw, tw, loss_fn, axis_name,
+            head_params=head_params,
+            return_input_grads=return_input_grads,
+            vary_axes=vary_axes)
+        if with_head or return_input_grads:
+            loss, grads, aux = out
+        else:
+            loss, grads = out
+            aux = {"head_grads": None, "input_grads": None}
+        gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+        if with_head:
+            hsum = jax.tree_util.tree_map(jnp.add, hsum,
+                                          aux["head_grads"])
+        return (gsum, hsum, lsum + loss), aux["input_grads"]
+
+    # zero carries derived from the params/inputs so they inherit the
+    # same device-varying axes as the per-wave outputs
+    zero_g = jax.tree_util.tree_map(lambda p: p * 0, stage_params)
+    zero_h = jax.tree_util.tree_map(lambda p: p * 0, head_params) \
+        if with_head else ()
+
+    def _vary_extra(x):
+        for ax in vary_axes:
+            x = lax.pcast(x, ax, to="varying") if hasattr(lax, "pcast") \
+                else lax.pvary(x, ax)
+        return x
+
+    (gsum, hsum, lsum), dxs_w = lax.scan(
+        wave, (zero_g, zero_h, _vary_extra(jnp.zeros((), jnp.float32))),
+        (xs_w, ts_w))
+    inv_w = 1.0 / W
+    loss = lsum * inv_w
+    grads = jax.tree_util.tree_map(lambda g: g * inv_w, gsum)
+    if not with_head and not return_input_grads:
+        return loss, grads
+    aux = {"head_grads": None, "input_grads": None}
+    if with_head:
+        aux["head_grads"] = jax.tree_util.tree_map(
+            lambda g: g * inv_w, hsum)
+    if return_input_grads:
+        # [W, n, mb...] -> [M, mb...]; each wave's grads are d(wave
+        # mean)/dx — rescale to the global mean
+        aux["input_grads"] = dxs_w.reshape(
+            (M,) + dxs_w.shape[2:]) * inv_w
+    return loss, grads, aux

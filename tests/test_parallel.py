@@ -411,6 +411,48 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(gX), np.asarray(rX),
                                    rtol=2e-4, atol=1e-5)
 
+    def test_interleaved_waves_m_gt_s(self, hvd):
+        """M=8 over 4 stages: two waves, losses averaged, grads summed
+        to the exact mean-over-M objective."""
+        from horovod_tpu.parallel.pp import pipeline_interleaved_waves
+        rng = np.random.RandomState(13)
+        n, V, M, mb, D = 4, 2, 8, 2, 6
+        S_total = n * V
+        Wg = (rng.randn(S_total, D, D) * 0.5).astype(np.float32)
+        Wdev = np.stack([Wg[[i, i + n]] for i in range(n)])
+        xs = rng.randn(M, mb, D).astype(np.float32)
+        ys = rng.randn(M, mb, D).astype(np.float32)
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
+
+        mesh = make_mesh(pp=4, devices=jax.devices()[:4])
+
+        def run(w, a, b):
+            loss, g = pipeline_interleaved_waves(
+                stage_fn, w[0], a, b, loss_fn, "pp")
+            return loss, g[None]
+
+        f = jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp"))))
+        loss, gW = f(Wdev, xs, ys)
+
+        def ref(wg):
+            x = jnp.asarray(xs)
+            for s in range(S_total):
+                x = stage_fn(wg[s], x)
+            return jax.vmap(loss_fn)(x, jnp.asarray(ys)).mean()
+
+        ref_l, rWg = jax.value_and_grad(ref)(jnp.asarray(Wg))
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        rWdev = np.stack([np.asarray(rWg)[[i, i + n]] for i in range(n)])
+        np.testing.assert_allclose(np.asarray(gW), rWdev,
+                                   rtol=2e-4, atol=1e-5)
+
     def test_interleaved_rejects_large_group(self, hvd):
         from horovod_tpu.parallel.pp import pipeline_interleaved_1f1b
         mesh = make_mesh(pp=4, devices=jax.devices()[:4])
