@@ -43,6 +43,8 @@ def main() -> None:
                     help="attention mode (default: ring when --sp > 1; "
                          "zigzag = causally load-balanced ring)")
     args = ap.parse_args()
+    if args.attention in ("ring", "ulysses", "zigzag") and args.sp <= 1:
+        ap.error(f"--attention {args.attention} requires --sp > 1")
 
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
     cfg = LlamaConfig(

@@ -116,12 +116,11 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
     every gradient family are pmean'd over dp (the DP allreduce riding
     the same compiled program).
 
-    `virtual` > 1 selects the interleaved schedule
-    (pipeline_interleaved_1f1b): stage_params from
-    gpt_pp_init(..., virtual=V) is [stages, V, ...] and
-    num_microbatches must be ≤ stages (one group per step).
+    `virtual` > 1 selects the interleaved schedule (wave-scanned for
+    num_microbatches > stages): stage_params from
+    gpt_pp_init(..., virtual=V) is [stages, V, ...].
     """
-    from ..parallel.pp import pipeline_interleaved_1f1b
+    from ..parallel.pp import pipeline_interleaved_waves
     n_stages = mesh.shape[pp_axis]
     bps = cfg.num_layers // (n_stages * virtual)
     stage_mod = StageBlocks(cfg, bps)
@@ -136,10 +135,9 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
             # everything the pipeline touches must be explicitly
             # dp-varying: each dp shard runs an independent pipeline and
             # the reduction happens ONCE, explicitly, at the end
-            _pv = (lambda a: jax.lax.pcast(a, dp_axis, to="varying")) \
-                if hasattr(jax.lax, "pcast") else \
-                (lambda a: jax.lax.pvary(a, dp_axis))
-            dpv = lambda t: jax.tree_util.tree_map(_pv, t)  # noqa: E731
+            from ..parallel.pp import _pvary
+            dpv = lambda t: jax.tree_util.tree_map(      # noqa: E731
+                lambda a: _pvary(a, dp_axis), t)
             stage_p, embed_p, head_p = (dpv(stage_p), dpv(embed_p),
                                         dpv(head_p))
         mb = toks.shape[0] // M
@@ -161,8 +159,9 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
             return -jnp.mean(
                 jnp.take_along_axis(logp, t[..., None], axis=-1))
 
+        # waves delegate to a single interleaved group when M <= stages
         pipeline = pipeline_1f1b if virtual == 1 \
-            else pipeline_interleaved_1f1b
+            else pipeline_interleaved_waves
         loss, g_stage, aux = pipeline(
             stage_fn, stage_p, xs, tgts_mb, loss_fn, pp_axis,
             head_params=head_p, return_input_grads=True,
@@ -186,10 +185,11 @@ def make_gpt_pp_step(cfg, mesh: Mesh, num_microbatches: int,
 
     def step(params, tokens, targets):
         embed_p, stage_p, head_p = params
-        if tokens.shape[0] % M:
+        div = M * (mesh.shape[dp_axis] if dp_axis else 1)
+        if tokens.shape[0] % div:
             raise ValueError(
                 f"batch {tokens.shape[0]} must divide by "
-                f"num_microbatches {M}")
+                f"num_microbatches*dp = {div}")
         loss, g_embed, g_stage, g_head = mapped(
             stage_p, embed_p, head_p, tokens, targets)
         return loss, (g_embed, g_stage, g_head)

@@ -22,6 +22,24 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _pvary(x, axes):
+    """Mark `x` device-varying over `axes` (pcast on new jax, pvary on
+    old) — the one copy of the compatibility shim."""
+    if isinstance(axes, str):
+        axes = (axes,)
+    for ax in axes:
+        x = lax.pcast(x, ax, to="varying") if hasattr(lax, "pcast") \
+            else lax.pvary(x, ax)
+    return x
+
+
+def _masked_add(acc, new, valid):
+    """acc + new where `valid`, leafwise over a pytree."""
+    return jax.tree_util.tree_map(
+        lambda a, g: a + jnp.where(valid, g, jnp.zeros_like(g)),
+        acc, new)
+
+
 def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
           stage_params: Any,
           microbatches: jax.Array,
@@ -59,15 +77,11 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         state = lax.ppermute(y, axis_name, fwd_perm)
         return (state, outputs), None
 
-    def _varying(x):
-        # mark as device-varying along the pp axis so scan carry types are
-        # stable (see jax shard_map scan-vma docs)
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, axis_name, to="varying")
-        return lax.pvary(x, axis_name)
-
-    state0 = _varying(jnp.zeros(mb_shape, microbatches.dtype))
-    out0 = _varying(jnp.zeros((M,) + mb_shape, microbatches.dtype))
+    # mark as device-varying along the pp axis so scan carry types are
+    # stable (see jax shard_map scan-vma docs)
+    state0 = _pvary(jnp.zeros(mb_shape, microbatches.dtype), axis_name)
+    out0 = _pvary(jnp.zeros((M,) + mb_shape, microbatches.dtype),
+                  axis_name)
     (_, outputs), _ = lax.scan(tick, (state0, out0),
                                jnp.arange(M + n - 1))
     return outputs
@@ -141,24 +155,10 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     inv_m = 1.0 / M
     with_head = head_params is not None
     all_axes = (axis_name,) + tuple(vary_axes)
-
-    def _vary_pp(x):
-        # the pp axis only — for values already varying over vary_axes
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, axis_name, to="varying")
-        return lax.pvary(x, axis_name)
-
-    def _varying(x):
-        # fresh zero-init carries: varying over pp AND the extra axes
-        for ax in all_axes:
-            x = lax.pcast(x, ax, to="varying") if hasattr(lax, "pcast") \
-                else lax.pvary(x, ax)
-        return x
-
-    def _masked_add(acc, new, valid):
-        return jax.tree_util.tree_map(
-            lambda a, g: a + jnp.where(valid, g, jnp.zeros_like(g)),
-            acc, new)
+    # _vary_pp: pp only (for values already varying over vary_axes);
+    # _varying: fresh zero-init carries, varying over pp + extra axes
+    _vary_pp = lambda x: _pvary(x, axis_name)        # noqa: E731
+    _varying = lambda x: _pvary(x, all_axes)         # noqa: E731
 
     def tick(carry, t):
         (fwd_in, bwd_in, buf, gseed, gacc, hacc, dxs, loss_acc) = carry
@@ -295,22 +295,8 @@ def pipeline_interleaved_1f1b(
     inv_m = 1.0 / M
     with_head = head_params is not None
     all_axes = (axis_name,) + tuple(vary_axes)
-
-    def _vary_pp(x):
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, axis_name, to="varying")
-        return lax.pvary(x, axis_name)
-
-    def _varying(x):
-        for ax in all_axes:
-            x = lax.pcast(x, ax, to="varying") if hasattr(lax, "pcast") \
-                else lax.pvary(x, ax)
-        return x
-
-    def _masked_add(acc, new, valid):
-        return jax.tree_util.tree_map(
-            lambda a, g: a + jnp.where(valid, g, jnp.zeros_like(g)),
-            acc, new)
+    _vary_pp = lambda x: _pvary(x, axis_name)        # noqa: E731
+    _varying = lambda x: _pvary(x, all_axes)         # noqa: E731
 
     def _chunk_params(j):
         return jax.tree_util.tree_map(
@@ -467,14 +453,9 @@ def pipeline_interleaved_waves(stage_fn, stage_params, microbatches,
     zero_h = jax.tree_util.tree_map(lambda p: p * 0, head_params) \
         if with_head else ()
 
-    def _vary_extra(x):
-        for ax in vary_axes:
-            x = lax.pcast(x, ax, to="varying") if hasattr(lax, "pcast") \
-                else lax.pvary(x, ax)
-        return x
-
     (gsum, hsum, lsum), dxs_w = lax.scan(
-        wave, (zero_g, zero_h, _vary_extra(jnp.zeros((), jnp.float32))),
+        wave, (zero_g, zero_h,
+               _pvary(jnp.zeros((), jnp.float32), vary_axes)),
         (xs_w, ts_w))
     inv_w = 1.0 / W
     loss = lsum * inv_w

@@ -524,14 +524,15 @@ class TestPipeline:
 
     def test_gpt_pp_interleaved_matches_sequential(self, hvd):
         """Pipelined GPT on the interleaved schedule: 2 devices x 2
-        virtual chunks = 4 global stages."""
+        virtual chunks = 4 global stages; M=4 > stages exercises the
+        wave scan inside make_gpt_pp_step."""
         from horovod_tpu.models.gpt import GPTConfig
         from horovod_tpu.models.gpt_pp import (EmbedIn, Head,
                                                StageBlocks, gpt_pp_init,
                                                make_gpt_pp_step)
         cfg = GPTConfig(vocab_size=32, num_layers=4, num_heads=2,
                         head_dim=4, max_seq_len=16, dtype=jnp.float32)
-        stages, V, M, mb, seq = 2, 2, 2, 2, 16
+        stages, V, M, mb, seq = 2, 2, 4, 2, 16
         embed_p, stage_p, head_p = gpt_pp_init(
             cfg, stages, jax.random.PRNGKey(4), virtual=V)
         mesh = make_mesh(pp=2, devices=jax.devices()[:2])
