@@ -18,6 +18,10 @@ import numpy as np
 
 Average = "average"
 Sum = "sum"
+Min = "min"
+Max = "max"
+Product = "prod"
+Adasum = "adasum"
 
 _comm = None
 _rank = 0
@@ -94,10 +98,10 @@ def traced(kind: str, fn):
 # one traced call site per collective kind, shared by the *_np wrappers
 # below AND the torch binding's direct-comm fast path
 
-def comm_allreduce(comm, arr: np.ndarray) -> np.ndarray:
+def comm_allreduce(comm, arr: np.ndarray, op: str = "sum") -> np.ndarray:
     return traced("allreduce",
                   lambda: comm.allreduce(np.ascontiguousarray(arr),
-                                         op="sum"))
+                                         op=op))
 
 
 def comm_allgather(comm, arr: np.ndarray) -> np.ndarray:
@@ -195,8 +199,36 @@ def remove_process_set(ps: ProcessSet) -> None:
         entry[1].close()
 
 
+class _GlobalProcessSet:
+    """hvd.global_process_set: the implicit all-ranks set (reference
+    process_sets.py global_process_set) — accepted anywhere
+    `process_set=` is, resolving to the global communicator."""
+    psid = 0
+
+    @property
+    def ranks(self):
+        return list(range(_size))
+
+    def included(self):
+        return True
+
+    def rank(self):
+        return _rank
+
+    def size(self):
+        return _size
+
+    def __repr__(self):
+        return f"ProcessSet(global, size={_size})"
+
+
+global_process_set = _GlobalProcessSet()
+
+
 def resolve_set(process_set):
     """-> (comm, rank_in_set, set_size, global_member_ranks)."""
+    if isinstance(process_set, _GlobalProcessSet):
+        process_set = None
     if process_set is None:
         if _size > 1 and _comm is None:
             # post-shutdown (or pre-init) multi-process call: fail loud
@@ -234,6 +266,37 @@ def local_size() -> int:
     return int(os.environ.get("HOROVOD_LOCAL_SIZE", _size))
 
 
+def cross_rank() -> int:
+    """Rank of this process's host among hosts (hvd.cross_rank)."""
+    return int(os.environ.get("HOROVOD_CROSS_RANK", "0"))
+
+
+def cross_size() -> int:
+    """Number of hosts (hvd.cross_size)."""
+    return int(os.environ.get("HOROVOD_CROSS_SIZE", "1"))
+
+
+def start_timeline(filename: str) -> None:
+    """Dynamically start the rank-0 plane timeline (hvd.start_timeline;
+    reference timeline DYNAMIC mode). No-op on other ranks."""
+    global _timeline
+    if _rank != 0 or _size <= 1:
+        return
+    if _timeline is not None:
+        _timeline.stop()
+    from .. import timeline as timeline_mod
+    _timeline = timeline_mod.Timeline(filename)
+    _timeline.start()
+
+
+def stop_timeline() -> None:
+    """Stop and flush the dynamically started plane timeline."""
+    global _timeline
+    if _timeline is not None:
+        _timeline.stop()
+        _timeline = None
+
+
 def is_initialized() -> bool:
     """True only after init() ran this process. (An uninitialized plane
     must NOT report ready just because the module defaults look like a
@@ -248,11 +311,42 @@ def comm():
 
 def allreduce_np(arr: np.ndarray, op: str = Sum,
                  process_set=None) -> np.ndarray:
-    """Sum-allreduce (caller divides for Average — dtype-specific)."""
+    """Reduce across the set. Sum/Average reduce with "sum" (the caller
+    divides for Average — dtype-specific); Min/Max/Product reduce
+    natively in the comm (csrc reduce kernels); Adasum allgathers and
+    combines with the reference's pairwise formula (adasum.h:101-131 via
+    ops/adasum.adasum_combine semantics, computed identically on every
+    member)."""
     comm, _, n, _ = resolve_set(process_set)
     if n == 1 or comm is None:
         return arr
-    return comm_allreduce(comm, arr)
+    if op == Adasum:
+        stack = comm_allgather(comm, np.ascontiguousarray(arr))
+        stack = np.asarray(stack).reshape((n,) + arr.shape)
+        return _adasum_np(stack)
+    comm_op = "sum" if op in (Sum, Average) else op
+    return comm_allreduce(comm, arr, op=comm_op)
+
+
+def _adasum_np(stack: np.ndarray) -> np.ndarray:
+    """Pairwise-tree Adasum of stack[n, ...] in numpy — the
+    adasum_combine formula (ops/adasum.py:47, reference
+    adasum.h:101-131), float32 accumulation, odd member carried."""
+    vecs = [stack[i].astype(np.float32) for i in range(stack.shape[0])]
+    while len(vecs) > 1:
+        nxt = []
+        for i in range(0, len(vecs) - 1, 2):
+            a, b = vecs[i], vecs[i + 1]
+            dot = float(np.vdot(a.ravel(), b.ravel()))
+            na = float(np.vdot(a.ravel(), a.ravel()))
+            nb = float(np.vdot(b.ravel(), b.ravel()))
+            acoef = 1.0 - (dot / (2.0 * na) if na > 0 else 0.0)
+            bcoef = 1.0 - (dot / (2.0 * nb) if nb > 0 else 0.0)
+            nxt.append(acoef * a + bcoef * b)
+        if len(vecs) % 2:
+            nxt.append(vecs[-1])
+        vecs = nxt
+    return vecs[0].astype(stack.dtype)
 
 
 def allgather_np(arr: np.ndarray, process_set=None) -> np.ndarray:

@@ -36,6 +36,17 @@ from . import keras_callbacks as callbacks  # noqa: F401  (hvd.callbacks.*)
 
 Average = _plane.Average
 Sum = _plane.Sum
+Min = _plane.Min
+Max = _plane.Max
+Product = _plane.Product
+Adasum = _plane.Adasum
+
+# capability predicates (reference tensorflow/__init__.py re-exports)
+from ..core.basics import (                                    # noqa: F401,E402
+    ccl_built, cuda_built, ddl_built, gloo_built, gloo_enabled,
+    mpi_built, mpi_enabled, mpi_threads_supported, nccl_built,
+    rocm_built, tpu_built, tpu_enabled,
+)
 
 
 def init(comm_name: Optional[str] = None) -> None:
@@ -49,12 +60,17 @@ rank = _plane.rank
 size = _plane.size
 local_rank = _plane.local_rank
 local_size = _plane.local_size
+cross_rank = _plane.cross_rank
+cross_size = _plane.cross_size
 is_initialized = _plane.is_initialized
 broadcast_object = _plane.broadcast_object
 barrier = _plane.barrier
+start_timeline = _plane.start_timeline
+stop_timeline = _plane.stop_timeline
 ProcessSet = _plane.ProcessSet
 add_process_set = _plane.add_process_set
 remove_process_set = _plane.remove_process_set
+global_process_set = _plane.global_process_set
 
 
 # -- tensor collectives (tensorflow/mpi_ops.py surface) ----------------------
@@ -71,14 +87,15 @@ def allreduce(t, op: str = Average, name: Optional[str] = None,
               process_set=None):
     """Allreduce a tf tensor across ranks (hvd.allreduce,
     horovod/tensorflow/mpi_ops.py); `process_set` scopes it to a
-    subgroup (reference: every op takes process_set)."""
+    subgroup (reference: every op takes process_set). op accepts
+    Average/Sum/Min/Max/Product/Adasum like the reference."""
     import tensorflow as tf
     t = tf.convert_to_tensor(t)
     _, _, n, _ = _plane.resolve_set(process_set)
     if n == 1:
         return t
     arr = _to_numpy(t)
-    out = _plane.allreduce_np(arr, process_set=process_set)
+    out = _plane.allreduce_np(arr, op=op, process_set=process_set)
     if op == Average:
         out = out / n
     # np.ascontiguousarray promotes 0-d to 1-d; restore the true shape

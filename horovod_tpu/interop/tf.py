@@ -31,6 +31,17 @@ from ..elastic._base_state import BaseFrameworkState as _BaseFrameworkState
 
 Average = _plane.Average
 Sum = _plane.Sum
+Min = _plane.Min
+Max = _plane.Max
+Product = _plane.Product
+Adasum = _plane.Adasum
+
+# capability predicates (reference tensorflow/__init__.py re-exports)
+from ..core.basics import (                                    # noqa: F401
+    ccl_built, cuda_built, ddl_built, gloo_built, gloo_enabled,
+    mpi_built, mpi_enabled, mpi_threads_supported, nccl_built,
+    rocm_built, tpu_built, tpu_enabled,
+)
 
 
 def init(comm_name: Optional[str] = None) -> None:
@@ -45,12 +56,17 @@ rank = _plane.rank
 size = _plane.size
 local_rank = _plane.local_rank
 local_size = _plane.local_size
+cross_rank = _plane.cross_rank
+cross_size = _plane.cross_size
 is_initialized = _plane.is_initialized
 broadcast_object = _plane.broadcast_object
 allgather_object = _plane.allgather_object
+start_timeline = _plane.start_timeline
+stop_timeline = _plane.stop_timeline
 ProcessSet = _plane.ProcessSet
 add_process_set = _plane.add_process_set
 remove_process_set = _plane.remove_process_set
+global_process_set = _plane.global_process_set
 
 
 # The tensor collectives are the keras binding's (same plane, same

@@ -32,6 +32,10 @@ from ..elastic._base_state import BaseFrameworkState as _BaseFrameworkState
 
 Average = _plane.Average
 Sum = _plane.Sum
+Min = _plane.Min
+Max = _plane.Max
+Product = _plane.Product
+Adasum = _plane.Adasum
 
 
 # -- lifecycle (basics.py init contract): shared process plane --------------
@@ -61,14 +65,27 @@ rank = _plane.rank
 size = _plane.size
 local_rank = _plane.local_rank
 local_size = _plane.local_size
+cross_rank = _plane.cross_rank
+cross_size = _plane.cross_size
 is_initialized = _plane.is_initialized
 broadcast_object = _plane.broadcast_object
 allgather_object = _plane.allgather_object
+start_timeline = _plane.start_timeline
+stop_timeline = _plane.stop_timeline
 # subgroup collectives (reference horovod/common/process_sets.py): every
 # tensor op below takes process_set=
 ProcessSet = _plane.ProcessSet
 add_process_set = _plane.add_process_set
 remove_process_set = _plane.remove_process_set
+global_process_set = _plane.global_process_set
+
+# capability predicates (reference torch/__init__.py re-exports; the
+# core owns the truth — no MPI/NCCL/CUDA in a TPU-native build)
+from ..core.basics import (                                    # noqa: F401,E402
+    ccl_built, cuda_built, ddl_built, gloo_built, gloo_enabled,
+    mpi_built, mpi_enabled, mpi_threads_supported, nccl_built,
+    rocm_built, tpu_built, tpu_enabled,
+)
 
 
 # -- DLPack/numpy staging ---------------------------------------------------
@@ -145,9 +162,15 @@ def _allreduce_impl_(t, op: str, name=None, process_set=None):
     if n == 1 or comm is None:
         return t
     arr = _np_view(t)
-    np.copyto(arr, _plane.comm_allreduce(comm, arr))
-    if op == Average:
-        t /= n
+    if op in (Average, Sum):
+        np.copyto(arr, _plane.comm_allreduce(comm, arr))
+        if op == Average:
+            t /= n
+    else:
+        # Min/Max/Product reduce natively in the comm; Adasum
+        # allgathers + pairwise-combines (torch/mpi_ops.py op= surface)
+        np.copyto(arr, _plane.allreduce_np(arr, op=op,
+                                           process_set=process_set))
     return t
 
 
@@ -159,7 +182,10 @@ def allreduce_(t, op: str = Average, name: Optional[str] = None,
 
 def allreduce(t, op: str = Average, name: Optional[str] = None,
               process_set=None):
-    if _wants_grad(t):
+    if _wants_grad(t) and op in (Average, Sum):
+        # the differentiable path covers the linear ops (the reference's
+        # autograd Function likewise); Min/Max/Product/Adasum reduce the
+        # detached values
         return _grad_fns()["allreduce"].apply(t, op, process_set)
     out = t.clone()
     return allreduce_(out, op=op, name=name, process_set=process_set)
@@ -724,6 +750,13 @@ class _DistributedOptimizer:
 
     def zero_grad(self, set_to_none: bool = False):
         return self._opt.zero_grad(set_to_none=set_to_none)
+
+    def set_backward_passes_per_step(self, passes: int) -> None:
+        """Re-configure gradient accumulation between reductions
+        (reference torch/optimizer.py set_backward_passes_per_step)."""
+        if passes < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self.backward_passes_per_step = int(passes)
 
 
 def DistributedOptimizer(optimizer, named_parameters=None,

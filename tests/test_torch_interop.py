@@ -108,6 +108,36 @@ def _torch_worker():
                                root_rank=0)
     assert obj["epoch"] == 7 and len(obj["blob"]) == 50
 
+    # Min/Max/Product reduce natively in the comm (reference op= set)
+    mn = hvd.allreduce(torch.full((3,), float(r + 1)), op=hvd.Min)
+    mx = hvd.allreduce(torch.full((3,), float(r + 1)), op=hvd.Max)
+    pr = hvd.allreduce(torch.full((3,), float(r + 2)), op=hvd.Product)
+    assert torch.allclose(mn, torch.full((3,), 1.0)), mn
+    assert torch.allclose(mx, torch.full((3,), float(n))), mx
+    import math
+    assert torch.allclose(pr, torch.full((3,), float(
+        math.prod(range(2, n + 2))))), pr
+
+    # Adasum: 2 ranks against the pairwise formula (adasum.h:101-131)
+    av = torch.tensor([1.0, 0.0]) if r == 0 else torch.tensor([0.0, 1.0])
+    ad = hvd.allreduce(av.clone(), op=hvd.Adasum)
+    if n == 2:
+        # orthogonal vectors: dot=0 -> plain sum
+        assert torch.allclose(ad, torch.tensor([1.0, 1.0])), ad
+        same = hvd.allreduce(torch.tensor([2.0, 0.0]), op=hvd.Adasum)
+        # identical vectors: adasum(a, a) = a
+        assert torch.allclose(same, torch.tensor([2.0, 0.0])), same
+
+    # identity/topology surface (reference torch/__init__.py exports)
+    assert hvd.cross_size() >= 1 and hvd.cross_rank() >= 0
+    assert hvd.global_process_set.size() == n
+    assert hvd.global_process_set.ranks == list(range(n))
+    g_ps = hvd.allreduce(torch.full((2,), float(r + 1)), op=hvd.Sum,
+                         process_set=hvd.global_process_set)
+    assert torch.allclose(g_ps, torch.full((2,), float(expect))), g_ps
+    assert not hvd.mpi_built() and not hvd.nccl_built()
+    assert hvd.gloo_built() and hvd.tpu_built()
+
     # model + optimizer end-to-end: replicas converge identically
     torch.manual_seed(100 + r)                     # diverged init
     model = torch.nn.Linear(4, 2)
@@ -126,6 +156,18 @@ def _torch_worker():
     ws = hvd.allgather(torch.from_numpy(w).reshape(1, -1))
     for i in range(n):
         np.testing.assert_allclose(ws[i].numpy(), ws[0].numpy(), rtol=1e-6)
+
+    # set_backward_passes_per_step: live re-config — first micro-step
+    # accumulates (weights untouched), second reduces + applies
+    opt.set_backward_passes_per_step(2)
+    w0 = model.weight.detach().clone()
+    opt.zero_grad()
+    torch.nn.functional.mse_loss(model(x), y).backward()
+    opt.step()
+    assert torch.equal(model.weight.detach(), w0), "applied too early"
+    torch.nn.functional.mse_loss(model(x), y).backward()
+    opt.step()
+    assert not torch.equal(model.weight.detach(), w0), "never applied"
 
     hvd.shutdown()
     return float(t[0])
