@@ -46,6 +46,9 @@ def _run(script, *args, timeout=240):
     ("pp_pipeline.py", ["--steps", "3"], "GPipe: 4 stages"),
     ("pp_pipeline.py", ["--steps", "2", "--schedule", "1f1b"],
      "1F1B schedule"),
+    ("pp_pipeline.py", ["--steps", "2", "--model", "gpt", "--stages",
+                        "2", "--virtual", "2", "--microbatches", "2"],
+     "gpt pipeline done"),
     ("lightning_estimator.py", [], "lightning val_loss"),
 ])
 def test_example_runs(script, args, expect):
