@@ -560,6 +560,46 @@ def test_torch_process_sets_store_plane():
         server.close()
 
 
+def _torch_reduction_ops_worker():
+    """Min/Max/Product/Adasum over the cross-host (store) plane."""
+    import math
+    import torch
+    import horovod_tpu.interop.torch as hvd
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    mn = hvd.allreduce(torch.full((3,), float(r + 1)), op=hvd.Min)
+    assert torch.allclose(mn, torch.full((3,), 1.0)), mn
+    mx = hvd.allreduce(torch.full((3,), float(r + 1)), op=hvd.Max)
+    assert torch.allclose(mx, torch.full((3,), float(n))), mx
+    pr = hvd.allreduce(torch.full((2,), float(r + 2)), op=hvd.Product)
+    assert torch.allclose(pr, torch.full((2,), float(
+        math.prod(range(2, n + 2))))), pr
+    av = torch.tensor([1.0, 0.0]) if r == 0 else torch.tensor([0.0, 1.0])
+    ad = hvd.allreduce(av, op=hvd.Adasum)
+    assert torch.allclose(ad, torch.tensor([1.0, 1.0])), ad
+    hvd.shutdown()
+    return 1.0
+
+
+def test_torch_reduction_ops_store_plane():
+    """The widened op set must work when ranks span hosts (hybrid
+    store comm), not just over shm."""
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(
+            _torch_reduction_ops_worker, num_proc=2,
+            job_runner=MultiprocessingJobRunner(),
+            env={"HOROVOD_INTEROP_FORCE_STORE": "1",
+                 "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+                 "HOROVOD_NATIVE_KV_PORT": str(server.port),
+                 "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results == [1.0, 1.0]
+    finally:
+        server.close()
+
+
 def _torch_elastic_state_worker():
     """TorchState commit/restore/sync (reference
     torch/elastic/state.py:27-120)."""
