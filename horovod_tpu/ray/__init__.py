@@ -13,3 +13,6 @@ from .strategy import (                                        # noqa: F401
     PlacementPlan, colocated_plan, spread_plan,
 )
 from .elastic import ElasticRayExecutor, RayHostDiscovery      # noqa: F401
+from .tune import (                                            # noqa: F401
+    DistributedTrainableCreator, run_grid_search,
+)

@@ -1,0 +1,106 @@
+"""Ray Tune integration: distributed trials over the actor fleet.
+
+Re-design of the reference's hyperparameter-search flow
+(/root/reference/docs/hyperparameter_search.rst: Ray Tune's
+DistributedTrainableCreator adapts a Horovod training function so each
+Tune trial is itself a distributed job). Here the adapter wraps
+RayExecutor: one trial = one fleet running `func(config)` on every
+worker, results returned rank-ordered; Tune schedules trials in
+parallel subject to the placement resources.
+
+    from horovod_tpu.ray.tune import DistributedTrainableCreator
+    trainable = DistributedTrainableCreator(training_function,
+                                            num_workers=2)
+    analysis = tune.run(trainable, config={"lr": tune.grid_search(...)})
+
+`func(config)` runs on every worker of the trial's fleet with the
+launcher identity env set (HOROVOD_RANK/SIZE/...); report metrics from
+rank 0 (`ray.tune.report` under real Tune, or just return them — the
+trainable returns rank 0's result as the trial result dict).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .runner import RayExecutor
+
+
+def DistributedTrainableCreator(func: Callable[[Dict], Any],
+                                num_workers: int = 1, *,
+                                num_slots: Optional[int] = None,
+                                num_hosts: Optional[int] = None,
+                                workers_per_host: Optional[int] = None,
+                                cpus_per_worker: float = 1.0,
+                                tpus_per_worker: float = 0.0,
+                                use_gpu: bool = False,
+                                backend: Optional[Any] = None
+                                ) -> Callable[[Dict], Any]:
+    """Adapt `func(config)` into a Tune function-trainable whose every
+    trial is a `num_workers`-rank distributed job.
+
+    Reference-signature compatibility: `num_slots` (the reference's
+    per-trial worker count) and `num_hosts` map onto
+    num_workers/workers_per_host; `use_gpu` is accepted and ignored
+    (workers use the TPU/XLA data plane). `backend` injects a non-Ray
+    actor transport (tests / local debugging).
+    """
+    if num_slots is not None:
+        num_workers = num_slots * (num_hosts or 1)
+    if num_hosts is not None and workers_per_host is None and \
+            num_slots is not None:
+        workers_per_host = num_slots
+
+    def trainable(config: Dict, checkpoint_dir: Optional[str] = None):
+        ex = RayExecutor(num_workers=num_workers,
+                         workers_per_host=workers_per_host,
+                         cpus_per_worker=cpus_per_worker,
+                         tpus_per_worker=tpus_per_worker,
+                         backend=backend)
+        ex.start()
+        try:
+            results = ex.run(func, args=(dict(config),))
+        finally:
+            ex.shutdown()
+        # rank 0's return value is the trial result (dict-valued
+        # results integrate with tune.run's analysis dataframes)
+        return results[0]
+
+    trainable.__name__ = getattr(func, "__name__", "hvd_trainable")
+    return trainable
+
+
+def run_grid_search(func: Callable[[Dict], Any],
+                    param_grid: Dict[str, list],
+                    num_workers: int = 1, *,
+                    backend: Optional[Any] = None,
+                    metric: Optional[str] = None,
+                    mode: str = "min") -> Dict[str, Any]:
+    """Tune-less fallback: exhaustively run the cartesian grid, one
+    distributed trial per point, and return the best config
+    (`hyperparameter_search.rst`'s flow without a Ray installation —
+    trials run sequentially on the shared fleet resources).
+
+    Each trial's result is rank 0's return value; with `metric` given
+    it must be a dict containing that key.
+    """
+    import itertools
+
+    trainable = DistributedTrainableCreator(func, num_workers,
+                                            backend=backend)
+    keys = sorted(param_grid)
+    best = None
+    trials = []
+    for values in itertools.product(*(param_grid[k] for k in keys)):
+        config = dict(zip(keys, values))
+        result = trainable(config)
+        trials.append({"config": config, "result": result})
+        if metric is not None:
+            score = result[metric]
+            if best is None or \
+                    (score < best[0] if mode == "min" else score > best[0]):
+                best = (score, config, result)
+    out = {"trials": trials}
+    if best is not None:
+        out["best_config"] = best[1]
+        out["best_result"] = best[2]
+    return out

@@ -120,6 +120,36 @@ def test_base_worker_execute():
     assert isinstance(w.hostname(), str) and w.hostname()
 
 
+# -- Ray Tune integration (docs/hyperparameter_search.rst flow) -------------
+
+def _trial_fn(config):
+    return {"loss": (config["lr"] - 0.2) ** 2 + config["wd"],
+            "epochs": config.get("epochs", 1)}
+
+
+def test_distributed_trainable_creator():
+    from horovod_tpu.ray import DistributedTrainableCreator
+    trainable = DistributedTrainableCreator(
+        _trial_fn, num_workers=2, backend=_LocalBackend())
+    result = trainable({"lr": 0.3, "wd": 0.0})
+    assert abs(result["loss"] - 0.01) < 1e-9     # rank 0's result dict
+    # reference num_slots/num_hosts signature maps onto the fleet shape
+    t2 = DistributedTrainableCreator(_trial_fn, num_slots=2, num_hosts=1,
+                                     backend=_LocalBackend())
+    assert abs(t2({"lr": 0.2, "wd": 0.5})["loss"] - 0.5) < 1e-9
+
+
+def test_run_grid_search_picks_best():
+    from horovod_tpu.ray import run_grid_search
+    out = run_grid_search(
+        _trial_fn, {"lr": [0.1, 0.2, 0.3], "wd": [0.0, 0.1]},
+        num_workers=2, backend=_LocalBackend(),
+        metric="loss", mode="min")
+    assert out["best_config"] == {"lr": 0.2, "wd": 0.0}
+    assert len(out["trials"]) == 6
+    assert out["best_result"]["loss"] == 0.0
+
+
 # -- elastic discovery ------------------------------------------------------
 
 def test_ray_host_discovery_cpu_and_tpu():
