@@ -257,3 +257,52 @@ def test_tf_tape_multiprocess_shm():
                   env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
                        "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
     assert results == [1.0, 1.0]
+
+
+def _tf_store_worker():
+    """Condensed TF2-eager contract over the cross-host (store) plane:
+    averaged tape gradients + the new collective surface."""
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.interop.tf as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+    v = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        loss = float(r + 1) * tf.reduce_sum(v)
+    g, = hvd.DistributedGradientTape(tape).gradient(loss, [v])
+    np.testing.assert_allclose(g.numpy(), [1.5, 1.5])
+    rs = hvd.reducescatter(tf.constant(
+        (np.arange(8.0).reshape(4, 2) + r).astype(np.float32)))
+    np.testing.assert_allclose(
+        rs.numpy(), (np.arange(8.0).reshape(4, 2) + 0.5)[2 * r:2 * r + 2])
+    out, rsp = hvd.alltoall(tf.constant(np.arange(3.0, dtype=np.float32)
+                                        + 10 * r),
+                            splits=[1, 2] if r == 0 else [2, 1])
+    assert rsp.numpy().tolist() == ([1, 2] if r == 0 else [2, 1])
+    mx = hvd.allreduce(tf.constant([float(r)]), op=hvd.Max)
+    np.testing.assert_allclose(mx.numpy(), [1.0])
+    hvd.shutdown()
+    return 1.0
+
+
+def test_tf_tape_store_plane():
+    """Simulated multi-host: shm disabled, everything over the native
+    TCP store (the reference torch/TF bindings are multi-node; this
+    pins the tf front end's cross-host path)."""
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(
+            _tf_store_worker, num_proc=2,
+            job_runner=MultiprocessingJobRunner(),
+            env={"HOROVOD_INTEROP_FORCE_STORE": "1",
+                 "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+                 "HOROVOD_NATIVE_KV_PORT": str(server.port),
+                 "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results == [1.0, 1.0]
+    finally:
+        server.close()
