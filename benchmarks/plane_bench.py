@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Binding-plane collective latency: shm and store planes, P ranks.
+
+The torch/keras/tf front ends run their collectives on the native CPU
+plane (csrc/shm_coll.cc within a host, csrc/store.cc across hosts) —
+unlike the TPU data plane, this layer's performance is a host-side
+property and measures meaningfully on any machine. The reference's
+analogous layer is its Gloo CPU ops (gloo_operations.cc).
+
+    python benchmarks/plane_bench.py [--ranks 2 4] [--iters 50]
+
+Prints one JSON line per (plane, ranks, size): median round latency and
+effective bandwidth. Rank 0 measures; a final barrier keeps peers alive
+until the slowest measurement finishes.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import uuid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SIZES = [1 << 10, 1 << 16, 1 << 20, 1 << 23]   # floats: 4KB .. 32MB
+
+
+def _worker(plane: str, sizes, iters: int):
+    import numpy as np
+    from horovod_tpu.interop import _plane
+
+    _plane.init()
+    r, n = _plane.rank(), _plane.size()
+    results = []
+    for count in sizes:
+        arr = np.ones(count, np.float32)
+        _plane.allreduce_np(arr)                   # warm the path
+        lat = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            _plane.allreduce_np(arr)
+            lat.append(time.perf_counter() - t0)
+        med = sorted(lat)[len(lat) // 2]
+        if r == 0:
+            mb = count * 4 / 1e6
+            results.append({
+                "metric": "plane_allreduce_latency",
+                "plane": plane, "ranks": n, "floats": count,
+                "median_us": round(med * 1e6, 1),
+                "mb_per_s": round(mb / med, 1) if med > 0 else None,
+                "iters": iters,
+            })
+    _plane.barrier()
+    _plane.shutdown()
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, nargs="+", default=[2, 4])
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--sizes", type=int, nargs="+", default=SIZES)
+    args = ap.parse_args()
+
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+
+    for plane in ("shm", "store"):
+        for p in args.ranks:
+            env = {"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                   "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]}
+            server = None
+            if plane == "store":
+                server = StoreServer()
+                env.update({"HOROVOD_INTEROP_FORCE_STORE": "1",
+                            "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+                            "HOROVOD_NATIVE_KV_PORT": str(server.port)})
+            try:
+                results = run(_worker, args=(plane, args.sizes,
+                                             args.iters),
+                              num_proc=p,
+                              job_runner=MultiprocessingJobRunner(),
+                              env=env)
+            finally:
+                if server is not None:
+                    server.close()
+            for rec in results[0]:
+                print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

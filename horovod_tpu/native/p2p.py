@@ -1,0 +1,265 @@
+"""Point-to-point TCP ring collectives for the cross-host plane.
+
+The star-topology StoreComm funnels O(P²·N) bytes through one server per
+allreduce; this ring moves the wire-optimal 2·N·(P-1)/P bytes per link —
+the role Gloo's TCP transport rings play for the reference's CPU ops
+(horovod/common/ops/gloo_operations.cc; gloo's allreduce_ring). Bulk
+bytes move via sendall/recv_into (kernel-space copies); Python only
+steps the chunk loop, and the per-step reduction is a vectorized numpy
+ufunc.
+
+Rendezvous rides the native store KV: each member publishes its
+listening address under a prefixed key and dials its ring successor.
+Failure semantics match the shm plane: a dead peer surfaces as a
+P2PError (socket timeout/EOF) within `timeout`, which elastic treats
+like any other communication failure.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .store import StoreClient
+
+_CHUNK = 1 << 20          # recv_into slice; sendall handles its own loop
+
+_REDUCE_UFUNC = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+class P2PError(RuntimeError):
+    pass
+
+
+def _outbound_ip(kv_host: str, kv_port: int) -> str:
+    """The local address routable toward the store (UDP-connect trick) —
+    gethostname() can resolve to the wrong interface on multi-NIC
+    hosts."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((kv_host, kv_port))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+class RingComm:
+    """ShmComm-interface collectives over a TCP ring of `size` members.
+
+    All members must issue the same call sequence (the shared plane
+    contract); each call's traffic is framed implicitly by exact byte
+    counts, so no tags are needed on the wire.
+    """
+
+    def __init__(self, kv_host: str, kv_port: int, rank: int, size: int,
+                 prefix: str = "p2p", timeout: float = 300.0):
+        self.rank, self.size = rank, size
+        self.timeout = timeout
+        if size == 1:
+            self._send = self._recv = None
+            return
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("", 0))
+        srv.listen(2)
+        srv.settimeout(timeout)
+        ip = _outbound_ip(kv_host, kv_port)
+        kv = StoreClient(socket.gethostbyname(kv_host), kv_port)
+        try:
+            kv.set(f"{prefix}.addr.{rank}",
+                   f"{ip}:{srv.getsockname()[1]}".encode())
+            nxt = kv.get(f"{prefix}.addr.{(rank + 1) % size}",
+                         timeout=timeout)
+            if nxt is None:
+                raise P2PError("ring successor never registered")
+            host, port = nxt.decode().rsplit(":", 1)
+
+            accepted = {}
+
+            def accept():
+                conn, _ = srv.accept()
+                conn.settimeout(timeout)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                peer = struct.unpack("!i", _recv_exact(conn, 4))[0]
+                accepted["conn"] = conn
+                accepted["peer"] = peer
+
+            t = threading.Thread(target=accept, daemon=True)
+            t.start()
+            self._send = socket.create_connection((host, int(port)),
+                                                  timeout=timeout)
+            self._send.settimeout(timeout)
+            self._send.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+            self._send.sendall(struct.pack("!i", rank))
+            t.join(timeout)
+            if "conn" not in accepted:
+                raise P2PError("ring predecessor never connected")
+            if accepted["peer"] != (rank - 1) % size:
+                raise P2PError(
+                    f"ring mis-wire: expected predecessor "
+                    f"{(rank - 1) % size}, got {accepted['peer']}")
+            self._recv = accepted["conn"]
+        finally:
+            kv.close()
+            srv.close()
+
+    # -- wire helpers ------------------------------------------------------
+
+    #: below this, sequential send-then-recv cannot deadlock (the whole
+    #: message fits the kernel send buffer), so skip the helper thread
+    _INLINE_BYTES = 1 << 15
+
+    def _xfer(self, send_view, recv_view) -> None:
+        """Full-duplex step: send to successor while receiving from the
+        predecessor (sequential send-then-recv deadlocks once messages
+        exceed the socket buffers)."""
+        if memoryview(send_view).nbytes <= self._INLINE_BYTES:
+            self._send.sendall(send_view)
+            _recv_into(self._recv, recv_view)
+            return
+        err = []
+
+        def tx():
+            try:
+                self._send.sendall(send_view)
+            except OSError as e:  # pragma: no cover — peer death
+                err.append(e)
+
+        t = threading.Thread(target=tx, daemon=True)
+        t.start()
+        try:
+            _recv_into(self._recv, recv_view)
+        finally:
+            t.join(self.timeout)
+        if err:
+            raise P2PError(f"ring send failed: {err[0]}")
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce(self, arr: np.ndarray, op: str = "sum",
+                  average: bool = False) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        ufunc = _REDUCE_UFUNC.get(op)
+        if ufunc is None:
+            raise ValueError(f"unsupported op {op}")
+        P, r = self.size, self.rank
+        if P == 1:
+            out = arr.copy()
+        else:
+            buf = arr.reshape(-1).copy()
+            n = buf.size
+            bounds = [(i * n) // P for i in range(P + 1)]
+            tmp = np.empty(max(bounds[i + 1] - bounds[i]
+                               for i in range(P)), arr.dtype)
+
+            def chunk(i):
+                i %= P
+                return buf[bounds[i]:bounds[i + 1]]
+
+            # ring reduce-scatter: after P-1 steps this rank holds the
+            # fully reduced chunk (r + 1) % P
+            for s in range(P - 1):
+                sv = chunk(r - s)
+                rv = chunk(r - s - 1)
+                t = tmp[:rv.size]
+                self._xfer(memoryview(sv), t)
+                ufunc(rv, t, out=rv)
+            # ring allgather of the reduced chunks
+            for s in range(P - 1):
+                sv = chunk(r + 1 - s)
+                rv = chunk(r - s)
+                self._xfer(memoryview(sv), rv)
+            out = buf.reshape(arr.shape)
+        if average:
+            out = out / P if np.issubdtype(arr.dtype, np.floating) \
+                else out // P
+        return out
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        P, r = self.size, self.rank
+        out = np.empty((P,) + arr.shape, arr.dtype)
+        out[r] = arr
+        for s in range(P - 1):
+            sv = out[(r - s) % P].reshape(-1)
+            rv = out[(r - s - 1) % P].reshape(-1)
+            self._xfer(memoryview(sv), rv)
+        return out
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        P, r = self.size, self.rank
+        if P == 1:
+            return arr.copy()
+        out = arr.copy() if r == root else np.empty_like(arr)
+        flat = out.reshape(-1)
+        # chain around the ring from the root; the last hop stops
+        if r == root:
+            self._send.sendall(memoryview(flat))
+        else:
+            _recv_into(self._recv, flat)
+            if (r + 1) % P != root:
+                self._send.sendall(memoryview(flat))
+        return out
+
+    def reducescatter(self, arr: np.ndarray, op: str = "sum"
+                      ) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        if arr.size % self.size:
+            raise ValueError(
+                f"reducescatter needs count divisible by size "
+                f"({arr.size} % {self.size})")
+        red = self.allreduce(arr, op)
+        chunk = red.size // self.size
+        return red.reshape(-1)[self.rank * chunk:
+                               (self.rank + 1) * chunk].copy()
+
+    def barrier(self) -> None:
+        """Two token laps: everyone has entered after lap one, everyone
+        may leave after lap two."""
+        if self.size == 1:
+            return
+        token = np.zeros(1, np.uint8)
+        for _ in range(2):
+            if self.rank == 0:
+                self._send.sendall(memoryview(token))
+                _recv_into(self._recv, token)
+            else:
+                _recv_into(self._recv, token)
+                self._send.sendall(memoryview(token))
+
+    def close(self) -> None:
+        for s in (self._send, self._recv):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:  # pragma: no cover
+                    pass
+        self._send = self._recv = None
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray(n)
+    _recv_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+def _recv_into(sock, view) -> None:
+    mv = memoryview(view).cast("B")
+    while mv.nbytes:
+        try:
+            k = sock.recv_into(mv, min(mv.nbytes, _CHUNK))
+        except socket.timeout as e:
+            raise P2PError("ring receive timed out (peer died?)") from e
+        if k == 0:
+            raise P2PError("ring peer closed the connection")
+        mv = mv[k:]

@@ -210,10 +210,28 @@ def build_hybrid_comm(name_base: str, *, force_store: bool = False):
             "(exported by the hvdrun launcher)")
     uniform = rank == cross_rank * local_size + local_rank and \
         size == cross_size * local_size
+
+    def cross_comm(xr: int, xs: int, role: str):
+        """Cross-host transport: p2p TCP ring by default (wire-optimal
+        2N(P-1)/P per link — the reference's Gloo-ring role), the
+        star-topology StoreComm when HOROVOD_PLANE_P2P=0 or the ring
+        cannot form (e.g. unroutable peers)."""
+        from ..core.config import _env_bool
+        if xs > 1 and _env_bool("HOROVOD_PLANE_P2P", True):
+            from .p2p import RingComm
+            try:
+                return RingComm(addr, int(port), xr, xs,
+                                prefix=f"p2p.{name_base}.{role}")
+            except Exception as e:  # noqa: BLE001 — fall back to star
+                import logging
+                logging.getLogger("horovod_tpu").warning(
+                    "p2p ring unavailable (%s); using store plane", e)
+        return StoreComm(addr, int(port), xr, xs, prefix=role)
+
     if force_store or local_size <= 1 or not uniform:
-        # flat: every rank talks to the store directly
-        store = StoreComm(addr, int(port), rank, size, prefix="ipf")
-        return HybridComm(None, store, 0, 1, rank, size, rank, size)
+        # flat: every rank on the cross plane directly
+        return HybridComm(None, cross_comm(rank, size, "ipf"),
+                          0, 1, rank, size, rank, size)
     from .shm import ShmComm
     gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
     # shm segment scoped per host (cross_rank suffix also keeps simulated
@@ -222,7 +240,6 @@ def build_hybrid_comm(name_base: str, *, force_store: bool = False):
                   gen=gen)
     store = None
     if local_rank == 0:
-        store = StoreComm(addr, int(port), cross_rank, cross_size,
-                          prefix="ipx")
+        store = cross_comm(cross_rank, cross_size, "ipx")
     return HybridComm(shm, store, local_rank, local_size,
                       cross_rank, cross_size, rank, size)

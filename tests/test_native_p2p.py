@@ -1,0 +1,115 @@
+"""P2P TCP ring collectives (native/p2p.py) — the cross-host plane's
+wire-optimal transport (the reference's Gloo-ring role,
+gloo_operations.cc). Real processes, real sockets, rendezvous over the
+native store."""
+import uuid
+
+import numpy as np
+import pytest
+
+
+def _ring_worker(kv_port):
+    import os
+    import numpy as np
+    from horovod_tpu.native.p2p import RingComm
+
+    r = int(os.environ["HOROVOD_RANK"])
+    n = int(os.environ["HOROVOD_SIZE"])
+    c = RingComm("127.0.0.1", kv_port, r, n,
+                 prefix=f"t.{os.environ['HOROVOD_JOB_ID']}")
+    try:
+        # allreduce sum, with a size NOT divisible by the ring (uneven
+        # chunk bounds)
+        a = np.full(10, float(r + 1), np.float32)
+        out = c.allreduce(a, "sum")
+        assert np.allclose(out, sum(range(1, n + 1))), out
+        # min / max / prod
+        assert np.allclose(c.allreduce(a, "min"), 1.0)
+        assert np.allclose(c.allreduce(a, "max"), float(n))
+        import math
+        assert np.allclose(c.allreduce(a, "prod"),
+                           float(math.prod(range(1, n + 1))))
+        # average flag
+        av = c.allreduce(np.full(3, float(r + 1), np.float32), "sum",
+                         average=True)
+        assert np.allclose(av, (n + 1) / 2), av
+        # allgather (2-d payload)
+        g = c.allgather(np.full((2, 3), float(r), np.float32))
+        assert g.shape == (n, 2, 3)
+        for i in range(n):
+            assert np.allclose(g[i], float(i)), (i, g[i])
+        # broadcast from every root
+        for root in range(n):
+            b = c.broadcast(
+                np.full(5, float(r * 100), np.float32)
+                if r == root else np.empty(5, np.float32), root=root)
+            assert np.allclose(b, float(root * 100)), (root, b)
+        # reducescatter
+        rs = c.reducescatter(
+            np.arange(2 * n, dtype=np.float32) + r, "sum")
+        expect = (np.arange(2 * n, dtype=np.float32) * n
+                  + sum(range(n)))
+        assert np.allclose(rs, expect[2 * r:2 * r + 2]), rs
+        # barrier (repeat to prove the token ring re-arms)
+        for _ in range(3):
+            c.barrier()
+        # large buffer: crosses the inline/full-duplex threshold
+        big = c.allreduce(np.full(1 << 18, 1.0, np.float32), "sum")
+        assert np.allclose(big, float(n))
+    finally:
+        c.close()
+    return 1.0
+
+
+@pytest.mark.parametrize("procs", [2, 4])
+def test_ring_collectives(procs):
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(_ring_worker, args=(server.port,),
+                      num_proc=procs,
+                      job_runner=MultiprocessingJobRunner(),
+                      env={"HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results == [1.0] * procs
+    finally:
+        server.close()
+
+
+def test_ring_single_rank_identity():
+    from horovod_tpu.native.p2p import RingComm
+    c = RingComm("127.0.0.1", 1, 0, 1)
+    a = np.arange(4.0, dtype=np.float32)
+    assert np.allclose(c.allreduce(a, "sum"), a)
+    assert np.allclose(c.broadcast(a), a)
+    c.barrier()
+    c.close()
+
+
+def _star_fallback_worker():
+    """HOROVOD_PLANE_P2P=0 must keep the star StoreComm path working."""
+    import numpy as np
+    from horovod_tpu.interop import _plane
+    _plane.init()
+    out = _plane.allreduce_np(np.ones(4, np.float32))
+    assert out[0] == float(_plane.size())
+    _plane.shutdown()
+    return 1.0
+
+
+def test_plane_p2p_opt_out():
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(
+            _star_fallback_worker, num_proc=2,
+            job_runner=MultiprocessingJobRunner(),
+            env={"HOROVOD_INTEROP_FORCE_STORE": "1",
+                 "HOROVOD_PLANE_P2P": "0",
+                 "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+                 "HOROVOD_NATIVE_KV_PORT": str(server.port),
+                 "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results == [1.0, 1.0]
+    finally:
+        server.close()
