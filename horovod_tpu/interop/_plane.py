@@ -41,10 +41,11 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
     hybrid (native/store_comm.py), the reference's hierarchical Gloo
     scheme (gloo_operations.cc:33-53): reduce on-host over shm, exchange
     once per host over the native store, fan back out over shm."""
-    global _comm, _rank, _size, _inited, _name
+    global _comm, _rank, _size, _inited, _name, _timeline_stopped
     _rank = int(os.environ.get("HOROVOD_RANK", "0"))
     _size = int(os.environ.get("HOROVOD_SIZE", "1"))
     _inited = True
+    _timeline_stopped = False
     if _size > 1 and _comm is None:
         name = comm_name or \
             f"hvd_plane_{os.environ.get('HOROVOD_JOB_ID', default_job)}"
@@ -64,13 +65,18 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
 _timeline = None
 
 
+_timeline_stopped = False     # stop_timeline() latch: _tl() must not
+                              # lazily resurrect the env-var timeline
+
+
 def _tl():
     """Rank-0 Chrome-trace timeline for plane collectives when
     HOROVOD_TIMELINE is set (the reference records its torch/TF op
     phases through the core timeline, timeline.cc; binding jobs never
     start the jax engine, so the plane owns its own writer)."""
     global _timeline
-    if _timeline is None and _rank == 0 and _size > 1:
+    if _timeline is None and not _timeline_stopped \
+            and _rank == 0 and _size > 1:
         fn = os.environ.get("HOROVOD_TIMELINE")
         if fn and fn.upper() != "DYNAMIC":
             from .. import timeline as timeline_mod
@@ -279,19 +285,22 @@ def cross_size() -> int:
 def start_timeline(filename: str) -> None:
     """Dynamically start the rank-0 plane timeline (hvd.start_timeline;
     reference timeline DYNAMIC mode). No-op on other ranks."""
-    global _timeline
+    global _timeline, _timeline_stopped
     if _rank != 0 or _size <= 1:
         return
     if _timeline is not None:
         _timeline.stop()
+    _timeline_stopped = False
     from .. import timeline as timeline_mod
     _timeline = timeline_mod.Timeline(filename)
     _timeline.start()
 
 
 def stop_timeline() -> None:
-    """Stop and flush the dynamically started plane timeline."""
-    global _timeline
+    """Stop and flush the plane timeline; stays stopped (the env-var
+    timeline is NOT lazily resurrected) until start_timeline again."""
+    global _timeline, _timeline_stopped
+    _timeline_stopped = True
     if _timeline is not None:
         _timeline.stop()
         _timeline = None

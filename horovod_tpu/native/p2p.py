@@ -140,6 +140,11 @@ class RingComm:
             _recv_into(self._recv, recv_view)
         finally:
             t.join(self.timeout)
+        if t.is_alive():
+            # a still-running sendall would interleave bytes with the
+            # next step's send on the same socket — the stream has no
+            # tags to detect that, so fail loud instead
+            raise P2PError("ring send timed out (peer died?)")
         if err:
             raise P2PError(f"ring send failed: {err[0]}")
 
@@ -213,15 +218,33 @@ class RingComm:
 
     def reducescatter(self, arr: np.ndarray, op: str = "sum"
                       ) -> np.ndarray:
+        """Ring reduce-scatter only — half the allreduce's wire bytes.
+        The chunk walk is shifted by one so rank r ends owning chunk r
+        (the ShmComm contract)."""
         arr = np.ascontiguousarray(arr)
         if arr.size % self.size:
             raise ValueError(
                 f"reducescatter needs count divisible by size "
                 f"({arr.size} % {self.size})")
-        red = self.allreduce(arr, op)
-        chunk = red.size // self.size
-        return red.reshape(-1)[self.rank * chunk:
-                               (self.rank + 1) * chunk].copy()
+        ufunc = _REDUCE_UFUNC.get(op)
+        if ufunc is None:
+            raise ValueError(f"unsupported op {op}")
+        P, r = self.size, self.rank
+        if P == 1:
+            return arr.copy()
+        buf = arr.reshape(-1).copy()
+        cs = buf.size // P
+
+        def chunk(i):
+            i %= P
+            return buf[i * cs:(i + 1) * cs]
+
+        tmp = np.empty(cs, arr.dtype)
+        for s in range(P - 1):
+            self._xfer(memoryview(chunk(r - s - 1)), tmp)
+            rv = chunk(r - s - 2)
+            ufunc(rv, tmp, out=rv)
+        return chunk(r).copy()
 
     def barrier(self) -> None:
         """Two token laps: everyone has entered after lap one, everyone

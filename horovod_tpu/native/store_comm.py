@@ -24,6 +24,8 @@ import numpy as np
 from .store import Coordinator
 
 
+_ring_epoch = 0
+
 _REDUCERS = {
     "sum": lambda mats: np.sum(mats, axis=0),
     "prod": lambda mats: np.prod(mats, axis=0),
@@ -214,18 +216,24 @@ def build_hybrid_comm(name_base: str, *, force_store: bool = False):
     def cross_comm(xr: int, xs: int, role: str):
         """Cross-host transport: p2p TCP ring by default (wire-optimal
         2N(P-1)/P per link — the reference's Gloo-ring role), the
-        star-topology StoreComm when HOROVOD_PLANE_P2P=0 or the ring
-        cannot form (e.g. unroutable peers)."""
+        star-topology StoreComm with HOROVOD_PLANE_P2P=0. The choice is
+        env-driven ONLY — a per-rank fallback on local failure would
+        split one communicator across two transports and deadlock it, so
+        a ring that cannot form raises (set HOROVOD_PLANE_P2P=0 on every
+        rank for unroutable-peer networks). The rendezvous prefix
+        carries the shm generation token so a restarted incarnation can
+        never dial a previous round's stale address."""
         from ..core.config import _env_bool
         if xs > 1 and _env_bool("HOROVOD_PLANE_P2P", True):
             from .p2p import RingComm
-            try:
-                return RingComm(addr, int(port), xr, xs,
-                                prefix=f"p2p.{name_base}.{role}")
-            except Exception as e:  # noqa: BLE001 — fall back to star
-                import logging
-                logging.getLogger("horovod_tpu").warning(
-                    "p2p ring unavailable (%s); using store plane", e)
+            gen = os.environ.get("HOROVOD_SHM_GEN", "1")
+            # epoch: same-process re-init (shutdown+init is a collective,
+            # so counts agree) must not read the previous ring's keys
+            global _ring_epoch
+            _ring_epoch += 1
+            return RingComm(
+                addr, int(port), xr, xs,
+                prefix=f"p2p.{name_base}.{role}.g{gen}.e{_ring_epoch}")
         return StoreComm(addr, int(port), xr, xs, prefix=role)
 
     if force_store or local_size <= 1 or not uniform:

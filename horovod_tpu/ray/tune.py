@@ -44,11 +44,12 @@ def DistributedTrainableCreator(func: Callable[[Dict], Any],
     (workers use the TPU/XLA data plane). `backend` injects a non-Ray
     actor transport (tests / local debugging).
     """
-    if num_slots is not None:
-        num_workers = num_slots * (num_hosts or 1)
-    if num_hosts is not None and workers_per_host is None and \
-            num_slots is not None:
-        workers_per_host = num_slots
+    if num_slots is not None or num_hosts is not None:
+        # reference signature: total = hosts x slots (each defaults 1)
+        slots = num_slots if num_slots is not None else 1
+        num_workers = slots * (num_hosts or 1)
+        if num_hosts is not None and workers_per_host is None:
+            workers_per_host = slots
 
     def trainable(config: Dict, checkpoint_dir: Optional[str] = None):
         ex = RayExecutor(num_workers=num_workers,

@@ -139,6 +139,20 @@ def test_distributed_trainable_creator():
     assert abs(t2({"lr": 0.2, "wd": 0.5})["loss"] - 0.5) < 1e-9
 
 
+def _count_workers_fn(config):
+    import os
+    return int(os.environ.get("HOROVOD_SIZE", "1"))
+
+
+def test_trainable_num_hosts_alone_sets_world_size():
+    """Reference semantics: num_hosts with default num_slots=1 means
+    num_hosts workers — it must not silently run single-rank."""
+    from horovod_tpu.ray import DistributedTrainableCreator
+    t = DistributedTrainableCreator(_count_workers_fn, num_hosts=2,
+                                    backend=_LocalBackend())
+    assert t({}) == 2
+
+
 def test_run_grid_search_picks_best():
     from horovod_tpu.ray import run_grid_search
     out = run_grid_search(
