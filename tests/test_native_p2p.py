@@ -86,6 +86,48 @@ def test_ring_single_rank_identity():
     c.close()
 
 
+def _peer_death_worker(kv_port):
+    """Rank 1 dies after the first collective; rank 0's next collective
+    must fail fast with P2PError (EOF), not hang for the full timeout."""
+    import os
+    import time
+    import numpy as np
+    from horovod_tpu.native.p2p import P2PError, RingComm
+
+    r = int(os.environ["HOROVOD_RANK"])
+    c = RingComm("127.0.0.1", kv_port, r, 2,
+                 prefix=f"d.{os.environ['HOROVOD_JOB_ID']}", timeout=30)
+    out = c.allreduce(np.ones(4, np.float32), "sum")
+    assert np.allclose(out, 2.0)
+    if r == 1:
+        c.close()
+        os._exit(0)
+    t0 = time.time()
+    try:
+        c.allreduce(np.ones(1 << 16, np.float32), "sum")
+        raise AssertionError("expected P2PError after peer death")
+    except P2PError:
+        pass
+    took = time.time() - t0
+    assert took < 20, f"peer-death detection took {took:.1f}s"
+    c.close()
+    return 1.0
+
+
+def test_ring_peer_death_fails_fast():
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(_peer_death_worker, args=(server.port,),
+                      num_proc=2,
+                      job_runner=MultiprocessingJobRunner(),
+                      env={"HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results[0] == 1.0
+    finally:
+        server.close()
+
+
 def _star_fallback_worker():
     """HOROVOD_PLANE_P2P=0 must keep the star StoreComm path working."""
     import numpy as np
