@@ -53,6 +53,24 @@ def run_benchmark():
     """The measured body. Runs in a worker subprocess; prints the result
     JSON prefixed with _MARK on success."""
     import jax
+
+    # Persistent compilation cache: the dominant cost of a bench attempt on
+    # a healthy tunnel is the first ResNet-50 compile (~20-40s, sometimes
+    # much longer over a slow relay). With the cache warm, any later tunnel
+    # window costs seconds, so retries and driver re-runs stop burning
+    # their whole 420s budget recompiling. min thresholds are 0 so even
+    # cheap executables (the init fns) persist.
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:  # older jax without the persistent cache knobs
+        pass
+
     import numpy as np
     import optax
 

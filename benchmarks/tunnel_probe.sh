@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Background TPU-tunnel liveness probe. Appends one JSON line per probe to
+# benchmarks/tunnel_probe.log. A probe is only "ok" if a REAL computation
+# completes with a scalar readback — round 3 showed jax.devices() can
+# succeed while compile/execute RPCs hang.
+#
+#   bash benchmarks/tunnel_probe.sh [interval_seconds]
+#
+# Run it in the background during CPU-side work; when it reports ok, run
+# the capture queue on a QUIET machine (stop the probe loop first).
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/tunnel_probe.log
+INTERVAL=${1:-300}
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+while true; do
+  t0=$(date +%s)
+  if timeout 150 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128)))
+print(float(x), d[0].platform)
+" >/dev/null 2>&1; then
+    dt=$(( $(date +%s) - t0 ))
+    echo "{\"t\": \"$(stamp)\", \"ok\": true, \"probe_s\": $dt}" >> "$OUT"
+  else
+    dt=$(( $(date +%s) - t0 ))
+    echo "{\"t\": \"$(stamp)\", \"ok\": false, \"probe_s\": $dt}" >> "$OUT"
+  fi
+  sleep "$INTERVAL"
+done
