@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Binding-plane collective latency: shm and store planes, P ranks.
+"""Binding-plane collective latency: shm, store and p2p-ring planes.
 
 The torch/keras/tf front ends run their collectives on the native CPU
 plane (csrc/shm_coll.cc within a host, csrc/store.cc across hosts) —
@@ -66,14 +66,20 @@ def main() -> None:
     from horovod_tpu.native.store import StoreServer
     from horovod_tpu.spark import MultiprocessingJobRunner, run
 
-    for plane in ("shm", "store"):
+    for plane in ("shm", "store", "p2p"):
         for p in args.ranks:
             env = {"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
                    "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]}
             server = None
-            if plane == "store":
+            if plane in ("store", "p2p"):
+                # both legs force the flat cross-host path; the store leg
+                # must ALSO pin HOROVOD_PLANE_P2P=0 or build_hybrid_comm's
+                # default would route it over the ring and the "store"
+                # label would report ring latencies
                 server = StoreServer()
                 env.update({"HOROVOD_INTEROP_FORCE_STORE": "1",
+                            "HOROVOD_PLANE_P2P":
+                                "1" if plane == "p2p" else "0",
                             "HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
                             "HOROVOD_NATIVE_KV_PORT": str(server.port)})
             try:

@@ -121,10 +121,11 @@ def comm_broadcast(comm, arr: np.ndarray, root: int) -> np.ndarray:
                                          root=root))
 
 
-def comm_reducescatter(comm, arr: np.ndarray) -> np.ndarray:
+def comm_reducescatter(comm, arr: np.ndarray,
+                       op: str = "sum") -> np.ndarray:
     return traced("reducescatter",
                   lambda: comm.reducescatter(np.ascontiguousarray(arr),
-                                             op="sum"))
+                                             op=op))
 
 
 def shutdown() -> None:
@@ -381,11 +382,18 @@ def broadcast_np(arr: np.ndarray, root: int = 0,
     return comm_broadcast(comm, arr, root)
 
 
-def reducescatter_np(arr: np.ndarray, process_set=None) -> np.ndarray:
+def reducescatter_np(arr: np.ndarray, process_set=None,
+                     op: str = Sum) -> np.ndarray:
+    """Reduce-scatter across the set. Sum/Average reduce with "sum" (the
+    caller divides for Average); Min/Max/Product reduce natively in the
+    comm. Adasum has no scatter form — rejected here."""
+    if op == Adasum:
+        raise ValueError("reducescatter does not support Adasum")
     comm, _, n, _ = resolve_set(process_set)
     if n == 1 or comm is None:
         return arr
-    return comm_reducescatter(comm, arr)
+    comm_op = "sum" if op in (Sum, Average) else op
+    return comm_reducescatter(comm, arr, op=comm_op)
 
 
 def barrier(process_set=None) -> None:

@@ -148,6 +148,8 @@ def reducescatter(t, op: str = Average, name: Optional[str] = None,
     r-th chunk (hvd.reducescatter, tensorflow/__init__.py reducescatter;
     the chunking contract matches the torch binding's)."""
     import tensorflow as tf
+    if op == Adasum:
+        raise ValueError("reducescatter does not support Adasum")
     t = tf.convert_to_tensor(t)
     if t.shape.rank == 0:
         raise ValueError("reducescatter requires tensors of rank >= 1")
@@ -157,13 +159,13 @@ def reducescatter(t, op: str = Average, name: Optional[str] = None,
     arr = _to_numpy(t).reshape(tuple(t.shape))
     d0 = arr.shape[0]
     if d0 % n == 0:
-        out = _plane.reducescatter_np(arr, process_set=process_set)
+        out = _plane.reducescatter_np(arr, process_set=process_set, op=op)
         out = np.asarray(out).reshape((-1,) + arr.shape[1:])
     else:
         # uneven dim 0: reference semantics — earlier ranks get one
         # extra row. The plane's reducescatter needs even counts, so
-        # reduce fully and slice this rank's chunk.
-        full = np.asarray(_plane.allreduce_np(arr,
+        # reduce fully (honoring op) and slice this rank's chunk.
+        full = np.asarray(_plane.allreduce_np(arr, op=op,
                                               process_set=process_set))
         full = full.reshape(arr.shape)
         base, extra = divmod(d0, n)
@@ -229,8 +231,11 @@ def grouped_allreduce(tensors, op: str = Average, name=None,
         return list(tensors)
     arrs = [_to_numpy(t).reshape(tuple(t.shape)) for t in tensors]
     if len({a.dtype for a in arrs}) == 1:
+        # one fused round, honoring op. Adasum on the fused buffer treats
+        # the group as a single vector — the reference's behavior too
+        # (Adasum runs on whole fusion buffers, adasum_mpi_operations.cc)
         flat = np.concatenate([a.ravel() for a in arrs])
-        red = np.asarray(_plane.allreduce_np(flat,
+        red = np.asarray(_plane.allreduce_np(flat, op=op,
                                              process_set=process_set))
         if op == Average:
             red = red / n

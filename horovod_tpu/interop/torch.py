@@ -236,11 +236,27 @@ def broadcast(t, root_rank: int = 0, name: Optional[str] = None,
 
 def _reducescatter_impl(t, op: str, name=None, process_set=None):
     import torch
-    comm, _, n, _ = _plane.resolve_set(process_set)
-    if n == 1 or comm is None:
+    if op == Adasum:   # rejected on every size, like the plane
+        raise ValueError("reducescatter does not support Adasum")
+    _, me, n, _ = _plane.resolve_set(process_set)
+    if n == 1:
         return t.clone()
-    out = _plane.comm_reducescatter(comm, _np_view(t))
-    res = torch.from_numpy(out.reshape((-1,) + tuple(t.shape[1:])))
+    arr = _np_view(t)
+    if t.shape[0] % n == 0:
+        out = _plane.reducescatter_np(arr, process_set=process_set,
+                                      op=op)
+    else:
+        # uneven dim 0 (reference semantics: earlier ranks get one extra
+        # row, torch/mpi_ops.py reducescatter): reduce fully, slice this
+        # rank's chunk — same fallback as the keras binding
+        full = np.asarray(_plane.allreduce_np(arr, op=op,
+                                              process_set=process_set))
+        full = full.reshape(arr.shape)
+        base, extra = divmod(int(t.shape[0]), n)
+        start = me * base + min(me, extra)
+        out = full[start:start + base + (1 if me < extra else 0)]
+    res = torch.from_numpy(
+        np.ascontiguousarray(out).reshape((-1,) + tuple(t.shape[1:])))
     if op == Average:
         res /= n
     return res

@@ -19,11 +19,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time as _time
 from typing import Optional
 
 import numpy as np
 
-from .store import StoreClient
+from .store import NativeTimeout, StoreClient
 
 _CHUNK = 1 << 20          # recv_into slice; sendall handles its own loop
 
@@ -60,7 +61,8 @@ class RingComm:
     """
 
     def __init__(self, kv_host: str, kv_port: int, rank: int, size: int,
-                 prefix: str = "p2p", timeout: float = 300.0):
+                 prefix: str = "p2p", timeout: float = 300.0,
+                 epoch: int = 0):
         self.rank, self.size = rank, size
         self.timeout = timeout
         if size == 1:
@@ -74,13 +76,42 @@ class RingComm:
         ip = _outbound_ip(kv_host, kv_port)
         kv = StoreClient(socket.gethostbyname(kv_host), kv_port)
         try:
+            # `epoch` distinguishes re-builds of the same ring (same
+            # prefix) so a stale address from a previous round is never
+            # dialed. It travels in the VALUE and the TCP handshake, not
+            # the key: with per-rank epoch counters in the key, one rank
+            # retrying init more times than its peers makes every rank
+            # block on a key nobody will ever write (a silent 300 s
+            # hang); carried in the value, divergence is OBSERVED and
+            # fails fast with P2PError.
             kv.set(f"{prefix}.addr.{rank}",
-                   f"{ip}:{srv.getsockname()[1]}".encode())
-            nxt = kv.get(f"{prefix}.addr.{(rank + 1) % size}",
-                         timeout=timeout)
-            if nxt is None:
-                raise P2PError("ring successor never registered")
-            host, port = nxt.decode().rsplit(":", 1)
+                   f"{ip}:{srv.getsockname()[1]}:{epoch}".encode())
+            nxt_key = f"{prefix}.addr.{(rank + 1) % size}"
+            deadline = _time.monotonic() + timeout
+            while True:
+                try:
+                    nxt = kv.get(nxt_key,
+                                 timeout=max(deadline - _time.monotonic(),
+                                             0.001))
+                except NativeTimeout:
+                    # module contract: a dead/absent peer surfaces as
+                    # P2PError, the failure type elastic classifies on
+                    raise P2PError("ring successor never registered")
+                host, port, peer_epoch = nxt.decode().rsplit(":", 2)
+                if int(peer_epoch) == epoch:
+                    break
+                if int(peer_epoch) > epoch:
+                    raise P2PError(
+                        f"ring epoch diverged: successor at "
+                        f"e{peer_epoch}, local e{epoch} — this rank "
+                        f"missed a collective rebuild")
+                # successor still shows an older round's address: it has
+                # not re-registered yet; poll until it does or time out
+                if _time.monotonic() >= deadline:
+                    raise P2PError(
+                        f"ring successor stuck at epoch {peer_epoch} "
+                        f"(local e{epoch})")
+                _time.sleep(0.05)
 
             accepted = {}
 
@@ -88,9 +119,10 @@ class RingComm:
                 conn, _ = srv.accept()
                 conn.settimeout(timeout)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                peer = struct.unpack("!i", _recv_exact(conn, 4))[0]
+                peer, peer_e = struct.unpack("!ii", _recv_exact(conn, 8))
                 accepted["conn"] = conn
                 accepted["peer"] = peer
+                accepted["epoch"] = peer_e
 
             t = threading.Thread(target=accept, daemon=True)
             t.start()
@@ -99,7 +131,7 @@ class RingComm:
             self._send.settimeout(timeout)
             self._send.setsockopt(socket.IPPROTO_TCP,
                                   socket.TCP_NODELAY, 1)
-            self._send.sendall(struct.pack("!i", rank))
+            self._send.sendall(struct.pack("!ii", rank, epoch))
             t.join(timeout)
             if "conn" not in accepted:
                 raise P2PError("ring predecessor never connected")
@@ -107,6 +139,10 @@ class RingComm:
                 raise P2PError(
                     f"ring mis-wire: expected predecessor "
                     f"{(rank - 1) % size}, got {accepted['peer']}")
+            if accepted["epoch"] != epoch:
+                raise P2PError(
+                    f"ring epoch mismatch: predecessor at "
+                    f"e{accepted['epoch']}, local e{epoch}")
             self._recv = accepted["conn"]
         finally:
             kv.close()

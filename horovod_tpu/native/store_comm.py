@@ -24,7 +24,7 @@ import numpy as np
 from .store import Coordinator
 
 
-_ring_epoch = 0
+_ring_epochs: dict = {}   # rendezvous prefix -> last epoch built here
 
 _REDUCERS = {
     "sum": lambda mats: np.sum(mats, axis=0),
@@ -227,13 +227,23 @@ def build_hybrid_comm(name_base: str, *, force_store: bool = False):
         if xs > 1 and _env_bool("HOROVOD_PLANE_P2P", True):
             from .p2p import RingComm
             gen = os.environ.get("HOROVOD_SHM_GEN", "1")
-            # epoch: same-process re-init (shutdown+init is a collective,
-            # so counts agree) must not read the previous ring's keys
-            global _ring_epoch
-            _ring_epoch += 1
-            return RingComm(
-                addr, int(port), xr, xs,
-                prefix=f"p2p.{name_base}.{role}.g{gen}.e{_ring_epoch}")
+            # epoch: same-process re-init (shutdown+init is a collective)
+            # must not dial the previous ring's stale address. The epoch
+            # rides in the registered VALUE and the ring handshake — not
+            # the key — so if one rank's counter drifts ahead (a failed
+            # init retried on one rank only), peers observe the mismatch
+            # and fail fast with P2PError instead of all blocking on a
+            # key that will never be written. Counters are PER PREFIX
+            # (gen included): every elastic round gets a fresh gen from
+            # the launcher (runner/launch.py, elastic/driver.py,
+            # spark/runner.py all export fresh_shm_gen()), so a
+            # surviving process and a newly spawned replacement both
+            # start the new round's ring at epoch 1 — a module-global
+            # counter would desync them permanently.
+            prefix = f"p2p.{name_base}.{role}.g{gen}"
+            _ring_epochs[prefix] = _ring_epochs.get(prefix, 0) + 1
+            return RingComm(addr, int(port), xr, xs, prefix=prefix,
+                            epoch=_ring_epochs[prefix])
         return StoreComm(addr, int(port), xr, xs, prefix=role)
 
     if force_store or local_size <= 1 or not uniform:

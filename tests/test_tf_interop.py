@@ -172,6 +172,25 @@ def _tf_worker():
     gm = hvd.grouped_allreduce([a, c64])
     np.testing.assert_allclose(gm[1].numpy(), np.full(2, 0.5))
 
+    # op plumbing (ADVICE r3): Min/Max reach the comm's native reduction
+    # — not a silent sum — on reducescatter AND the fused single-dtype
+    # grouped_allreduce path
+    tmm = tf.constant((np.arange(4.0).reshape(2, 2) * (r + 1))
+                      .astype(np.float32))
+    base = np.arange(4.0).reshape(2, 2)        # rank0's copy is the min
+    rmin = hvd.reducescatter(tmm, op=hvd.Min)
+    np.testing.assert_allclose(rmin.numpy(), base[r:r + 1])
+    rmax = hvd.reducescatter(tmm, op=hvd.Max)
+    np.testing.assert_allclose(rmax.numpy(), (base * 2)[r:r + 1])
+    gmax = hvd.grouped_allreduce([a, b], op=hvd.Max)
+    np.testing.assert_allclose(gmax[0].numpy(), np.full(3, 2.0))
+    np.testing.assert_allclose(gmax[1].numpy(), np.full((2, 2), 2.0))
+    try:
+        hvd.reducescatter(tmm, op=hvd.Adasum)
+        raise AssertionError("expected ValueError for Adasum rs")
+    except ValueError:
+        pass
+
     # broadcast_: in-place variable assign from root
     bvar = tf.Variable(np.full(2, float(5 + r), np.float32))
     ret = hvd.broadcast_(bvar, root_rank=1)
@@ -209,6 +228,11 @@ def _tf_worker():
     full = np.arange(6.0).reshape(3, 2) + 0.5
     np.testing.assert_allclose(ru.numpy(),
                                full[:2] if r == 0 else full[2:])
+    # ...and the uneven fallback honors op too (full reduce + slice)
+    ru_min = hvd.reducescatter(tu, op=hvd.Min)
+    full_min = np.arange(6.0).reshape(3, 2)    # rank0's copy
+    np.testing.assert_allclose(ru_min.numpy(),
+                               full_min[:2] if r == 0 else full_min[2:])
 
     # wrong splits length is a clear error, not silent data loss
     try:

@@ -103,6 +103,21 @@ def _torch_worker():
     assert rs.shape == (2,)
     assert torch.allclose(rs, torch.full((2,), expect / n)), rs
 
+    # uneven dim 0: earlier ranks get one extra row (reference
+    # torch/mpi_ops.py semantics), via the allreduce-and-slice fallback
+    tu = torch.arange(6.0).reshape(3, 2) + float(r)
+    ru = hvd.reducescatter(tu, op=hvd.Average)
+    full = torch.arange(6.0).reshape(3, 2) + 0.5
+    assert torch.allclose(ru, full[:2] if r == 0 else full[2:]), ru
+
+    # reducescatter honors Min/Max natively (ADVICE r3: was a silent sum)
+    rmin = hvd.reducescatter(torch.full((2 * n,), float(r + 1)),
+                             op=hvd.Min)
+    assert torch.allclose(rmin, torch.ones(2)), rmin
+    rmax = hvd.reducescatter(torch.full((2 * n,), float(r + 1)),
+                             op=hvd.Max)
+    assert torch.allclose(rmax, torch.full((2,), float(n))), rmax
+
     # broadcast_object
     obj = hvd.broadcast_object({"epoch": 7, "blob": list(range(50))},
                                root_rank=0)
