@@ -42,8 +42,24 @@ def _worker(plane: str, sizes, iters: int):
             _plane.allreduce_np(arr)
             lat.append(time.perf_counter() - t0)
         med = sorted(lat)[len(lat) // 2]
+        # alltoall: the same payload split evenly across destinations
+        chunks = np.array_split(arr, n)
+        _plane.alltoall_np(chunks)
+        lat_a = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            _plane.alltoall_np(chunks)
+            lat_a.append(time.perf_counter() - t0)
+        med_a = sorted(lat_a)[len(lat_a) // 2]
         if r == 0:
             mb = count * 4 / 1e6
+            results.append({
+                "metric": "plane_alltoall_latency",
+                "plane": plane, "ranks": n, "floats": count,
+                "median_us": round(med_a * 1e6, 1),
+                "mb_per_s": round(mb / med_a, 1) if med_a > 0 else None,
+                "iters": iters,
+            })
             results.append({
                 "metric": "plane_allreduce_latency",
                 "plane": plane, "ranks": n, "floats": count,

@@ -128,6 +128,10 @@ def comm_reducescatter(comm, arr: np.ndarray,
                                              op=op))
 
 
+def comm_alltoall(comm, chunks) -> list:
+    return traced("alltoall", lambda: comm.alltoall(chunks))
+
+
 def shutdown() -> None:
     global _comm, _inited, _timeline
     _inited = False
@@ -394,6 +398,18 @@ def reducescatter_np(arr: np.ndarray, process_set=None,
         return arr
     comm_op = "sum" if op in (Sum, Average) else op
     return comm_reducescatter(comm, arr, op=comm_op)
+
+
+def alltoall_np(chunks, process_set=None) -> list:
+    """Ragged numpy alltoall: ``chunks[d]`` is delivered to member d;
+    returns ``received[src]``. Rides the comm-native data path (shm
+    gather-and-pick on host, p2p ring rotation or star store across
+    hosts, two-level aggregation on the hybrid) — recv sizes are
+    negotiated inside the comm (the mpi_controller.cc:239 role)."""
+    comm, _, n, _ = resolve_set(process_set)
+    if n == 1 or comm is None:
+        return [np.ascontiguousarray(chunks[0]).copy()]
+    return comm_alltoall(comm, chunks)
 
 
 def barrier(process_set=None) -> None:

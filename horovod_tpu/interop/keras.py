@@ -182,7 +182,8 @@ def alltoall(t, splits=None, name: Optional[str] = None, process_set=None):
     returns ``(output, received_splits)``; without, splits dim 0 evenly
     and returns just the output — the reference's exact return
     convention. Recv splits are negotiated across ranks (the
-    mpi_controller.cc:239 role) by the gather-then-pick object plane."""
+    mpi_controller.cc:239 role) inside the comm-native alltoall (ring
+    rotation cross-host, shm pick on host)."""
     import tensorflow as tf
     t = tf.convert_to_tensor(t)
     if t.shape.rank == 0:
@@ -211,9 +212,9 @@ def alltoall(t, splits=None, name: Optional[str] = None, process_set=None):
     for s in splits:
         chunks.append(np.ascontiguousarray(arr[off:off + s]))
         off += s
-    everyone = _plane.allgather_object(chunks,   # [src][dst] -> chunk
-                                       process_set=process_set)
-    mine = [everyone[src][me] for src in range(n)]
+    # comm-native ragged alltoall: recv splits negotiated inside the
+    # comm (ring rotation cross-host — no star-server detour)
+    mine = _plane.alltoall_np(chunks, process_set=process_set)
     rsplits = tf.constant([c.shape[0] for c in mine], dtype=tf.int32)
     out = tf.constant(np.concatenate(mine, axis=0).astype(arr.dtype))
     return (out, rsplits) if had_splits else out

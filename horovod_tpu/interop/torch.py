@@ -292,11 +292,11 @@ def _alltoall_impl(t, splits=None, name=None, process_set=None):
     for s in splits:
         chunks.append(np.ascontiguousarray(_np_view(t)[off:off + s]))
         off += s
-    everyone = _plane.allgather_object(chunks,   # [src][dst] -> chunk
-                                       process_set=process_set)
-    mine = [everyone[src][me] for src in range(n)]
+    # comm-native ragged alltoall: recv splits negotiated inside the
+    # comm (ring rotation cross-host — no star-server detour)
+    mine = _plane.alltoall_np(chunks, process_set=process_set)
     recv_splits = torch.tensor([c.shape[0] for c in mine])
-    out = torch.from_numpy(np.concatenate(mine, axis=0)) if mine else t[:0]
+    out = torch.from_numpy(np.concatenate(mine, axis=0))
     return out.to(t.dtype), recv_splits
 
 

@@ -282,6 +282,52 @@ class RingComm:
             ufunc(rv, tmp, out=rv)
         return chunk(r).copy()
 
+    def alltoall(self, chunks) -> list:
+        """Ragged alltoall: ``chunks[d]`` is delivered to rank ``d``;
+        returns ``received[src]`` — the chunk each source sent here.
+        Chunks share dtype and trailing shape; dim-0 row counts may
+        differ per (src, dst) pair and are negotiated with one ring
+        allgather of the row vector (the mpi_controller.cc:239
+        recv-splits negotiation role).
+
+        Relay rotation: the chunk for the destination h hops ahead
+        travels h links, one per step, so step s moves every in-flight
+        chunk one link and delivers the s-hop chunks. Per-link traffic
+        is N·(P-1)/2 vs the star store's 2·N·P server bottleneck. No
+        tags are needed: all sizes derive from the negotiated row
+        matrix, and each step's payload keeps hop order (the arriving
+        head chunk is always addressed to this rank)."""
+        from .shm import check_alltoall_chunks
+        P, r = self.size, self.rank
+        chunks = check_alltoall_chunks(P, chunks)
+        dtype, trail = chunks[0].dtype, chunks[0].shape[1:]
+        out: list = [None] * P
+        out[r] = chunks[r].copy()
+        if P == 1:
+            return out
+        row_elems = 1
+        for d in trail:
+            row_elems *= int(d)
+        rows = np.array([c.shape[0] for c in chunks], np.int64)
+        S = self.allgather(rows)                     # S[src, dst] rows
+        # in-flight payload to relay, kept in hop order (the chunk k+1
+        # hops past the current origin comes k-th). Only step 1 needs a
+        # concatenate; afterwards the remainder of each receive buffer
+        # IS the next step's send payload, already contiguous.
+        send_buf = np.concatenate(
+            [chunks[(r + k) % P].reshape(-1) for k in range(1, P)])
+        for s in range(1, P):
+            o = (r - s) % P               # origin of this step's arrivals
+            recv_rows = [int(S[o, (o + s + k) % P]) for k in range(P - s)]
+            recv_buf = np.empty(sum(recv_rows) * row_elems, dtype)
+            self._xfer(memoryview(send_buf), recv_buf)
+            # head chunk is addressed here (dst = o + s = r); the tail
+            # stays in hop order for the next step
+            cut = recv_rows[0] * row_elems
+            out[o] = recv_buf[:cut].reshape((recv_rows[0],) + trail).copy()
+            send_buf = recv_buf[cut:]
+        return out
+
     def barrier(self) -> None:
         """Two token laps: everyone has entered after lap one, everyone
         may leave after lap two."""

@@ -48,6 +48,54 @@ def _check(status: int, what: str) -> None:
         raise ShmError(f"{what}: error {status}")
 
 
+def check_alltoall_chunks(size: int, chunks) -> list:
+    """Shared validation for the comm-level ragged alltoall contract:
+    one chunk per rank, all sharing dtype and trailing shape."""
+    if len(chunks) != size:
+        raise ValueError(
+            f"alltoall needs one chunk per rank ({len(chunks)} vs size "
+            f"{size})")
+    chunks = [np.ascontiguousarray(c) for c in chunks]
+    dtype, trail = chunks[0].dtype, chunks[0].shape[1:]
+    for c in chunks:
+        if c.dtype != dtype or c.shape[1:] != trail:
+            raise ValueError(
+                "alltoall chunks must share dtype and trailing shape")
+    return chunks
+
+
+def alltoall_via_allgather(comm, chunks) -> list:
+    """Ragged alltoall built from a comm's allgather: negotiate the
+    (P, P) row matrix, gather every rank's padded concat, pick this
+    rank's slices. O(P·N) read amplification — right for shm (memory
+    bandwidth) and the star-store fallback; the p2p ring has a real
+    rotation instead (p2p.py alltoall)."""
+    P, r = comm.size, comm.rank
+    chunks = check_alltoall_chunks(P, chunks)
+    dtype, trail = chunks[0].dtype, chunks[0].shape[1:]
+    if P == 1:
+        return [chunks[0].copy()]
+    row_elems = 1
+    for d in trail:
+        row_elems *= int(d)
+    rows = np.array([c.shape[0] for c in chunks], np.int64)
+    S = comm.allgather(rows)                        # S[src, dst] rows
+    totals = S.sum(axis=1) * row_elems
+    pad = int(totals.max())
+    buf = np.zeros(pad, dtype)
+    if chunks:
+        flat = np.concatenate([c.reshape(-1) for c in chunks])
+        buf[:flat.size] = flat
+    allbuf = comm.allgather(buf)                    # (P, pad)
+    out = []
+    for src in range(P):
+        off = int(S[src, :r].sum()) * row_elems
+        m = int(S[src, r])
+        out.append(allbuf[src, off:off + m * row_elems]
+                   .reshape((m,) + trail).copy())
+    return out
+
+
 class ShmComm:
     """One communicator per (job, rank); all local ranks share the segment.
 
@@ -126,6 +174,12 @@ class ShmComm:
             out.ctypes.data_as(ctypes.c_void_p), arr.size, dt, o,
             self.timeout), "reducescatter")
         return out
+
+    def alltoall(self, chunks) -> list:
+        """Ragged alltoall via allgather-then-pick — within a host the
+        shared segment is memory bandwidth, so the P× read amplification
+        of gather-and-pick costs less than P extra barrier rounds."""
+        return alltoall_via_allgather(self, chunks)
 
     def close(self) -> None:
         if getattr(self, "_h", None):

@@ -101,6 +101,13 @@ class StoreComm:
         return red.reshape(-1)[self.rank * chunk:
                                (self.rank + 1) * chunk].copy()
 
+    def alltoall(self, chunks) -> list:
+        """Ragged alltoall — star fallback (gather-and-pick through the
+        store server). The p2p ring is the wire-efficient default; this
+        exists so HOROVOD_PLANE_P2P=0 networks keep the full op surface."""
+        from .shm import alltoall_via_allgather
+        return alltoall_via_allgather(self, chunks)
+
     def close(self) -> None:
         self._c.close()
 
@@ -180,6 +187,92 @@ class HybridComm:
         chunk = red.size // self.size
         return red.reshape(-1)[self.rank * chunk:
                                (self.rank + 1) * chunk].copy()
+
+    def alltoall(self, chunks) -> list:
+        """Ragged alltoall, two-level: intra-host pairs resolve in the
+        shm segment; cross-host rows are aggregated into ONE bundle per
+        (host, host) pair at the local roots and exchanged over the
+        cross transport (p2p ring by default) — so the slow leg moves
+        each payload byte once, aggregated, instead of per-rank-pair
+        messages through the star store (the role of the reference's
+        hierarchical ops + mpi_controller.cc:239 splits negotiation)."""
+        from .shm import check_alltoall_chunks
+        chunks = check_alltoall_chunks(self.size, chunks)
+        if self._shm is None:
+            if self._store is None:                 # size 1
+                return [chunks[0].copy()]
+            return self._store.alltoall(chunks)
+        L, C = self._local_size, self._cross_size
+        lr, xr = self._local_rank, self._cross_rank
+        dtype, trail = chunks[0].dtype, chunks[0].shape[1:]
+        row_elems = 1
+        for d in trail:
+            row_elems *= int(d)
+        rows = np.array([c.shape[0] for c in chunks], np.int64)
+        S = self.allgather(rows)                    # global (P, P) rows
+        out: list = [None] * self.size
+        # stage A: shm-gather every local rank's full (padded) sendset;
+        # local deliveries pick directly, roots slice the cross bundles
+        host0 = xr * L                              # host-major uniform
+        pad = int((S[host0:host0 + L].sum(axis=1) * row_elems).max())
+        buf = np.zeros(pad, dtype)
+        flat = np.concatenate([c.reshape(-1) for c in chunks])
+        buf[:flat.size] = flat
+        local_all = self._shm.allgather(buf)        # (L, pad)
+        for ls in range(L):
+            src = host0 + ls
+            off = int(S[src, :self.rank].sum()) * row_elems
+            m = int(S[src, self.rank])
+            out[src] = local_all[ls, off:off + m * row_elems] \
+                .reshape((m,) + trail).copy()
+        if C == 1:
+            return out
+        # stage B (roots): bundle for host c = rows from every local
+        # src to every rank on c, ls-major / dst-minor — contiguous in
+        # each src's concat because dsts are rank-ordered
+        if self._store is not None:
+            bundles = []
+            for c in range(C):
+                if c == xr:
+                    bundles.append(np.empty((0,) + trail, dtype))
+                    continue
+                parts, rows_c = [], 0
+                for ls in range(L):
+                    src = host0 + ls
+                    start = int(S[src, :c * L].sum()) * row_elems
+                    m = int(S[src, c * L:(c + 1) * L].sum())
+                    parts.append(local_all[ls, start:start
+                                           + m * row_elems])
+                    rows_c += m
+                # explicit row count: reshape(-1) is ambiguous when the
+                # trailing shape contains a zero-size dim
+                bundles.append(np.concatenate(parts)
+                               .reshape((rows_c,) + trail))
+            received = self._store.alltoall(bundles)  # [src host]
+            blob = np.concatenate(
+                [received[o].reshape(-1) for o in range(C) if o != xr]) \
+                if C > 1 else np.empty(0, dtype)
+        else:
+            # non-root shell for the shm broadcast; size derives from S
+            total_in = int(S[np.r_[0:host0, host0 + L:self.size],
+                             host0:host0 + L].sum()) * row_elems
+            blob = np.empty(total_in, dtype)
+        # stage C: fan the host's inbound rows out over shm; each local
+        # rank picks its (src -> me) slices by walking S in bundle order
+        blob = self._shm.broadcast(blob, root=0)
+        pos = 0
+        for o in range(C):
+            if o == xr:
+                continue
+            for ls in range(L):
+                src = o * L + ls
+                seg = S[src, host0:host0 + L]
+                off = int(seg[:lr].sum()) * row_elems
+                m = int(seg[lr])
+                out[src] = blob[pos + off:pos + off + m * row_elems] \
+                    .reshape((m,) + trail).copy()
+                pos += int(seg.sum()) * row_elems
+        return out
 
     def close(self) -> None:
         if self._store is not None:

@@ -50,6 +50,14 @@ def _ring_worker(kv_port):
         expect = (np.arange(2 * n, dtype=np.float32) * n
                   + sum(range(n)))
         assert np.allclose(rs, expect[2 * r:2 * r + 2]), rs
+        # ragged alltoall via the relay rotation: rows(src->dst) =
+        # src + dst, so sizes differ per pair and (0,0) is empty
+        chunks = [np.full((r + d, 3), float(10 * r + d), np.float32)
+                  for d in range(n)]
+        a2a = c.alltoall(chunks)
+        for src in range(n):
+            assert a2a[src].shape == (src + r, 3), (src, a2a[src].shape)
+            assert np.allclose(a2a[src], float(10 * src + r)), a2a[src]
         # barrier (repeat to prove the token ring re-arms)
         for _ in range(3):
             c.barrier()
@@ -135,6 +143,14 @@ def _star_fallback_worker():
     _plane.init()
     out = _plane.allreduce_np(np.ones(4, np.float32))
     assert out[0] == float(_plane.size())
+    # ragged alltoall on the star path (gather-and-pick)
+    r, n = _plane.rank(), _plane.size()
+    chunks = [np.full((r + d, 2), float(10 * r + d), np.float32)
+              for d in range(n)]
+    mine = _plane.alltoall_np(chunks)
+    for src in range(n):
+        assert mine[src].shape == (src + r, 2), (src, mine[src].shape)
+        assert np.allclose(mine[src], float(10 * src + r)), mine[src]
     _plane.shutdown()
     return 1.0
 

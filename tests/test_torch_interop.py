@@ -722,6 +722,15 @@ def _hybrid_worker(idx, port, gen, job):
     assert np.allclose(rs, 4.0 * np.arange(8)[2 * r:2 * r + 2]), rs
     objs = plane.allgather_object({"r": r})
     assert [o["r"] for o in objs] == [0, 1, 2, 3], objs
+    # ragged alltoall over the two-level plane: intra-host pairs resolve
+    # in shm, cross-host rows bundle through the local roots. rows
+    # (src -> dst) = src + dst, so every pair size differs and (0,0)=0
+    chunks = [np.full((r + d, 2), float(10 * r + d), np.float32)
+              for d in range(4)]
+    mine = plane.alltoall_np(chunks)
+    for src in range(4):
+        assert mine[src].shape == (src + r, 2), (src, mine[src].shape)
+        assert np.allclose(mine[src], float(10 * src + r)), mine[src]
     plane.barrier()
     plane.shutdown()
 
