@@ -165,7 +165,10 @@ def init(comm: Optional[Sequence[int]] = None,
         _state.shutdown_requested = False
 
         _configure_logging(cfg)
-        if cfg.timeline_filename:
+        # rank 0 records, like the reference's coordinator-written
+        # timeline (timeline.cc; multi-rank writers would race on the
+        # same HOROVOD_TIMELINE path)
+        if cfg.timeline_filename and jax.process_index() == 0:
             from .. import timeline as timeline_mod
             _state.timeline = timeline_mod.Timeline(cfg.timeline_filename)
             _state.timeline.start()

@@ -297,19 +297,15 @@ class RingComm:
         tags are needed: all sizes derive from the negotiated row
         matrix, and each step's payload keeps hop order (the arriving
         head chunk is always addressed to this rank)."""
-        from .shm import check_alltoall_chunks
+        from .shm import check_alltoall_chunks, negotiate_alltoall_meta
         P, r = self.size, self.rank
-        chunks = check_alltoall_chunks(P, chunks)
-        dtype, trail = chunks[0].dtype, chunks[0].shape[1:]
+        if P == 1:
+            chunks = check_alltoall_chunks(P, chunks)
+            return [chunks[0].copy()]
+        chunks, dtype, trail, row_elems, S = \
+            negotiate_alltoall_meta(self, chunks)
         out: list = [None] * P
         out[r] = chunks[r].copy()
-        if P == 1:
-            return out
-        row_elems = 1
-        for d in trail:
-            row_elems *= int(d)
-        rows = np.array([c.shape[0] for c in chunks], np.int64)
-        S = self.allgather(rows)                     # S[src, dst] rows
         # in-flight payload to relay, kept in hop order (the chunk k+1
         # hops past the current origin comes k-th). Only step 1 needs a
         # concatenate; afterwards the remainder of each receive buffer

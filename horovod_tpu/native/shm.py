@@ -64,6 +64,32 @@ def check_alltoall_chunks(size: int, chunks) -> list:
     return chunks
 
 
+def negotiate_alltoall_meta(comm, chunks):
+    """Validate + negotiate the ragged-alltoall metadata in ONE
+    allgather: the (P, P) per-(src, dst) row matrix, plus a
+    (dtype, trailing-shape) digest per rank — every member derives byte
+    offsets from its LOCAL dtype/trailing shape, so a cross-rank
+    mismatch must fail loud (the engine's "Mismatched collective"
+    behavior) instead of mis-slicing buffers or desyncing the tagless
+    ring stream. Returns (chunks, dtype, trail, row_elems, S)."""
+    import zlib
+    P = comm.size
+    chunks = check_alltoall_chunks(P, chunks)
+    dtype, trail = chunks[0].dtype, chunks[0].shape[1:]
+    row_elems = 1
+    for d in trail:
+        row_elems *= int(d)
+    # crc32, not hash(): hash() is per-process randomized
+    digest = zlib.crc32(repr((dtype.str, tuple(trail))).encode())
+    rows = np.array([c.shape[0] for c in chunks] + [digest], np.int64)
+    g = comm.allgather(rows)                        # (P, P + 1)
+    if not (g[:, -1] == digest).all():
+        raise ValueError(
+            "Mismatched alltoall: chunks must share dtype and trailing "
+            "shape across ranks")
+    return chunks, dtype, trail, row_elems, g[:, :-1]
+
+
 def alltoall_via_allgather(comm, chunks) -> list:
     """Ragged alltoall built from a comm's allgather: negotiate the
     (P, P) row matrix, gather every rank's padded concat, pick this
@@ -71,21 +97,15 @@ def alltoall_via_allgather(comm, chunks) -> list:
     bandwidth) and the star-store fallback; the p2p ring has a real
     rotation instead (p2p.py alltoall)."""
     P, r = comm.size, comm.rank
-    chunks = check_alltoall_chunks(P, chunks)
-    dtype, trail = chunks[0].dtype, chunks[0].shape[1:]
     if P == 1:
-        return [chunks[0].copy()]
-    row_elems = 1
-    for d in trail:
-        row_elems *= int(d)
-    rows = np.array([c.shape[0] for c in chunks], np.int64)
-    S = comm.allgather(rows)                        # S[src, dst] rows
+        return [np.ascontiguousarray(chunks[0]).copy()]
+    chunks, dtype, trail, row_elems, S = \
+        negotiate_alltoall_meta(comm, chunks)
     totals = S.sum(axis=1) * row_elems
     pad = int(totals.max())
     buf = np.zeros(pad, dtype)
-    if chunks:
-        flat = np.concatenate([c.reshape(-1) for c in chunks])
-        buf[:flat.size] = flat
+    flat = np.concatenate([c.reshape(-1) for c in chunks])
+    buf[:flat.size] = flat
     allbuf = comm.allgather(buf)                    # (P, pad)
     out = []
     for src in range(P):

@@ -196,20 +196,16 @@ class HybridComm:
         each payload byte once, aggregated, instead of per-rank-pair
         messages through the star store (the role of the reference's
         hierarchical ops + mpi_controller.cc:239 splits negotiation)."""
-        from .shm import check_alltoall_chunks
-        chunks = check_alltoall_chunks(self.size, chunks)
+        from .shm import check_alltoall_chunks, negotiate_alltoall_meta
         if self._shm is None:
             if self._store is None:                 # size 1
+                chunks = check_alltoall_chunks(self.size, chunks)
                 return [chunks[0].copy()]
             return self._store.alltoall(chunks)
         L, C = self._local_size, self._cross_size
         lr, xr = self._local_rank, self._cross_rank
-        dtype, trail = chunks[0].dtype, chunks[0].shape[1:]
-        row_elems = 1
-        for d in trail:
-            row_elems *= int(d)
-        rows = np.array([c.shape[0] for c in chunks], np.int64)
-        S = self.allgather(rows)                    # global (P, P) rows
+        chunks, dtype, trail, row_elems, S = \
+            negotiate_alltoall_meta(self, chunks)
         out: list = [None] * self.size
         # stage A: shm-gather every local rank's full (padded) sendset;
         # local deliveries pick directly, roots slice the cross bundles
@@ -250,8 +246,7 @@ class HybridComm:
                                .reshape((rows_c,) + trail))
             received = self._store.alltoall(bundles)  # [src host]
             blob = np.concatenate(
-                [received[o].reshape(-1) for o in range(C) if o != xr]) \
-                if C > 1 else np.empty(0, dtype)
+                [received[o].reshape(-1) for o in range(C) if o != xr])
         else:
             # non-root shell for the shm broadcast; size derives from S
             total_in = int(S[np.r_[0:host0, host0 + L:self.size],

@@ -498,6 +498,13 @@ class Engine:
         # controller.cc:74-442); non-common requests go back on the queue.
         coord = self._state.coordinator
         if coord is not None and coord.size > 1:
+            tl_n = self._state.timeline
+            if tl_n is not None:
+                # dedicated viewer row: negotiation wall time per cycle,
+                # so a trace shows how much of each cycle the control
+                # plane takes and what it overlaps with (the reference
+                # timeline's NEGOTIATE_* phases, timeline.h:102)
+                tl_n.begin("negotiation", "NEGOTIATE")
             try:
                 batch, deferred = self._negotiate(coord, batch)
             except Exception as e:  # noqa: BLE001 - peer divergence/timeout
@@ -516,6 +523,9 @@ class Engine:
                         tl_.end(w.name, "QUEUED")
                     w.handle._resolve(None, st)
                 return
+            finally:
+                if tl_n is not None:
+                    tl_n.end("negotiation", "NEGOTIATE")
             if deferred:
                 with self._qlock:
                     self._queue = deferred + self._queue
