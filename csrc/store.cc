@@ -26,6 +26,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -44,12 +45,15 @@ enum Op : uint8_t {
   OP_DEL = 3,
   OP_PING = 4,
   OP_GATHER = 5,   // join-and-collect: post a blob, reply with all blobs
+  OP_STAT = 6,     // introspection: entry/gather counts (leak checks)
 };
 
 enum Status : uint8_t {
   ST_OK = 0,
   ST_TIMEOUT = 1,
   ST_ERROR = 2,
+  ST_AGAIN = 3,  // client-side: result larger than the caller's buffer,
+                 // stashed in the client — take with take_pending
 };
 
 bool send_all(int fd, const void* buf, size_t len) {
@@ -93,6 +97,7 @@ struct Entry {
   std::string value;
   int reads_left = 0;  // 0 = persistent; >0 = erase after this many reads
   bool present = false;
+  std::chrono::steady_clock::time_point touch;  // for the TTL sweep
 };
 
 struct GatherState {
@@ -100,11 +105,29 @@ struct GatherState {
   std::string result;                // concat, set at completion
   bool complete = false;
   int reads_left = 0;                // erase after every member read it
+  int waiters = 0;                   // handlers blocked on this round —
+                                     // the sweep must not pull state out
+                                     // from under a live (possibly
+                                     // infinite-timeout) waiter
+  std::chrono::steady_clock::time_point touch;  // for the TTL sweep
 };
 
 class StoreServer {
  public:
   explicit StoreServer(int port) {
+    // Orphaned-state TTL (seconds): read-counted entries and gather
+    // rounds whose readers died can never hit reads_left == 0 on their
+    // own; the sweep expires them so a member crash does not leak state
+    // for the server's lifetime. Generous default — well above every
+    // client-side timeout — so no live waiter ever sees its state
+    // swept from under it.
+    const char* ttl = std::getenv("HVD_STORE_STATE_TTL_S");
+    double ttl_s = ttl ? std::atof(ttl) : 900.0;
+    // malformed values (atof -> 0) must not turn the sweep into a
+    // destroy-everything loop; fall back to the default
+    if (!(ttl_s > 0.0) || !std::isfinite(ttl_s)) ttl_s = 900.0;
+    state_ttl_ = std::chrono::duration<double>(ttl_s);
+    last_sweep_ = std::chrono::steady_clock::now();
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return;
     int one = 1;
@@ -179,10 +202,12 @@ class StoreServer {
         case OP_SET: {
           {
             std::lock_guard<std::mutex> lk(mu_);
+            SweepLocked(false);
             auto& e = data_[key];
             e.value = std::move(val);
             e.present = true;
             e.reads_left = 0;
+            e.touch = std::chrono::steady_clock::now();
           }
           cv_.notify_all();
           alive = send_frame(fd, ST_OK, "");
@@ -220,6 +245,7 @@ class StoreServer {
           std::string out = it->second.value;
           if (expected > 0) {
             if (it->second.reads_left == 0) it->second.reads_left = expected;
+            it->second.touch = std::chrono::steady_clock::now();
             if (--it->second.reads_left == 0) data_.erase(it);
           }
           lk.unlock();
@@ -259,7 +285,9 @@ class StoreServer {
             break;
           }
           std::unique_lock<std::mutex> lk(mu_);
+          SweepLocked(false);
           GatherState& g = gathers_[key];
+          g.touch = std::chrono::steady_clock::now();
           if (!g.complete) {
             // idempotent re-post (a member retrying after timeout)
             g.blobs[grank] = val.substr(16);
@@ -282,6 +310,7 @@ class StoreServer {
             return (it != gathers_.end() && it->second.complete) ||
                    shutting_down_.load();
           };
+          g.waiters++;           // pin against the TTL sweep while blocked
           bool got;
           if (timeout_s < 0) {
             cv_.wait(lk, gready);
@@ -292,16 +321,32 @@ class StoreServer {
                       gready) &&
                   !shutting_down_.load();
           }
+          auto git = gathers_.find(key);
+          if (git != gathers_.end()) {
+            git->second.waiters--;
+            git->second.touch = std::chrono::steady_clock::now();
+          }
           if (!got) {
             lk.unlock();
             alive = send_frame(fd, ST_TIMEOUT, "");
             break;
           }
-          auto git = gathers_.find(key);
           std::string gout = git->second.result;
           if (--git->second.reads_left == 0) gathers_.erase(git);
           lk.unlock();
           alive = send_frame(fd, ST_OK, gout);
+          break;
+        }
+        case OP_STAT: {
+          // leak introspection: sweep (ignoring the rate guard) and
+          // report live state counts — the restart-after-dead-member
+          // test asserts gathers=0 here
+          std::unique_lock<std::mutex> lk(mu_);
+          SweepLocked(true);
+          std::string st = "data=" + std::to_string(data_.size()) +
+                           " gathers=" + std::to_string(gathers_.size());
+          lk.unlock();
+          alive = send_frame(fd, ST_OK, st);
           break;
         }
         default:
@@ -316,6 +361,30 @@ class StoreServer {
     ::close(fd);
   }
 
+  // mu_ held. Expire orphaned state: read-counted entries and gather
+  // rounds whose remaining readers died (reads_left can never reach 0),
+  // and gather rounds that never completed (a member crashed before
+  // joining). Live waiters are unaffected: the TTL is far above every
+  // client timeout, and a swept incomplete gather just times out its
+  // (already doomed) waiter cleanly.
+  void SweepLocked(bool force) {
+    auto now = std::chrono::steady_clock::now();
+    if (!force && now - last_sweep_ < state_ttl_ / 10) return;
+    last_sweep_ = now;
+    for (auto it = data_.begin(); it != data_.end();) {
+      if (it->second.reads_left > 0 && now - it->second.touch > state_ttl_)
+        it = data_.erase(it);
+      else
+        ++it;
+    }
+    for (auto it = gathers_.begin(); it != gathers_.end();) {
+      if (it->second.waiters == 0 && now - it->second.touch > state_ttl_)
+        it = gathers_.erase(it);
+      else
+        ++it;
+    }
+  }
+
   int listen_fd_ = -1;
   int port_ = 0;
   std::atomic<bool> shutting_down_{false};
@@ -326,6 +395,8 @@ class StoreServer {
   std::map<std::string, Entry> data_;
   std::map<std::string, GatherState> gathers_;
   std::set<int> conn_fds_;
+  std::chrono::duration<double> state_ttl_{900.0};
+  std::chrono::steady_clock::time_point last_sweep_;
 };
 
 class StoreClient {
@@ -404,9 +475,23 @@ class StoreClient {
     return Request(OP_GATHER, key, arg, out);
   }
 
+  // Oversized-result stash: get/gather consume server-side read slots
+  // BEFORE the reply, so "retry with a bigger buffer" would corrupt
+  // round state — instead the wrapper stashes the full value here and
+  // returns ST_AGAIN; the caller drains it with take_pending.
+  void StashPending(std::string v) {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_ = std::move(v);
+  }
+  std::string TakePending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return std::move(pending_);
+  }
+
  private:
   int fd_ = -1;
   std::mutex mu_;
+  std::string pending_;
 };
 
 // Coordinator: the reference controller's transport hook set
@@ -541,9 +626,11 @@ int hvd_client_set(void* c, const char* key, const uint8_t* val,
       key, std::string(reinterpret_cast<const char*>(val), len));
 }
 
-// out must hold *outcap bytes; returns status, sets *outlen to the full value
-// size (caller re-calls with a larger buffer if *outlen > *outcap — values
-// are small control-plane blobs so this is rare).
+// out must hold outcap bytes; sets *outlen to the full value size. When
+// the value exceeds outcap the read was ALREADY consumed server-side
+// (read-counted entries / gather slots), so re-requesting would corrupt
+// state — the value is stashed client-side and ST_AGAIN returned; drain
+// it with hvd_client_take_pending(outlen bytes).
 int hvd_client_get(void* c, const char* key, double timeout_s,
                    int expected_reads, uint8_t* out, uint32_t outcap,
                    uint32_t* outlen) {
@@ -552,13 +639,58 @@ int hvd_client_get(void* c, const char* key, double timeout_s,
                                              &v);
   if (st != ST_OK) return st;
   *outlen = static_cast<uint32_t>(v.size());
-  if (*outlen > outcap) return ST_ERROR;
+  if (*outlen > outcap) {
+    static_cast<StoreClient*>(c)->StashPending(std::move(v));
+    return ST_AGAIN;
+  }
+  std::memcpy(out, v.data(), v.size());
+  return ST_OK;
+}
+
+int hvd_client_take_pending(void* c, uint8_t* out, uint32_t outcap,
+                            uint32_t* outlen) {
+  std::string v = static_cast<StoreClient*>(c)->TakePending();
+  *outlen = static_cast<uint32_t>(v.size());
+  if (*outlen > outcap) {
+    static_cast<StoreClient*>(c)->StashPending(std::move(v));
+    return ST_AGAIN;
+  }
   std::memcpy(out, v.data(), v.size());
   return ST_OK;
 }
 
 int hvd_client_del(void* c, const char* key) {
   return static_cast<StoreClient*>(c)->Del(key);
+}
+
+int hvd_client_gather(void* c, const char* key, double timeout_s, int size,
+                      int rank, const uint8_t* blob, uint32_t bloblen,
+                      uint8_t* out, uint32_t outcap, uint32_t* outlen) {
+  std::string v;
+  int st = static_cast<StoreClient*>(c)->Gather(
+      key, timeout_s, size, rank,
+      std::string(reinterpret_cast<const char*>(blob), bloblen), &v);
+  if (st != ST_OK) return st;
+  *outlen = static_cast<uint32_t>(v.size());
+  if (*outlen > outcap) {
+    static_cast<StoreClient*>(c)->StashPending(std::move(v));
+    return ST_AGAIN;
+  }
+  std::memcpy(out, v.data(), v.size());
+  return ST_OK;
+}
+
+// "data=<n> gathers=<m>" live-state counts after a forced TTL sweep —
+// the leak-check hook (tests + doctor tooling).
+int hvd_client_stat(void* c, uint8_t* out, uint32_t outcap,
+                    uint32_t* outlen) {
+  std::string v;
+  int st = static_cast<StoreClient*>(c)->Request(OP_STAT, "", "", &v);
+  if (st != ST_OK) return st;
+  *outlen = static_cast<uint32_t>(v.size());
+  if (*outlen > outcap) return ST_ERROR;
+  std::memcpy(out, v.data(), v.size());
+  return ST_OK;
 }
 
 void* hvd_coord_create(const char* host, int port, int rank, int size) {

@@ -89,12 +89,57 @@ class StoreClient:
         st = self._lib.hvd_client_get(self._h, key.encode(), t,
                                       expected_reads, out, max_bytes,
                                       ctypes.byref(outlen))
-        _check(st, f"get({key})")
+        return self._finish(st, out, outlen, f"get({key})")
+
+    def _finish(self, st: int, out, outlen, what: str) -> bytes:
+        """Resolve a sized-reply call. ST_AGAIN (3) = the value exceeded
+        the caller buffer AFTER the server consumed the read slot; the
+        client stashed it — drain with take_pending, never re-request."""
+        if st == 3:
+            need = outlen.value
+            out2 = _buf(need)
+            outlen2 = ctypes.c_uint32(0)
+            _check(self._lib.hvd_client_take_pending(
+                self._h, out2, need, ctypes.byref(outlen2)), what)
+            return bytes(out2[:outlen2.value])
+        _check(st, what)
         return bytes(out[:outlen.value])
 
     def delete(self, key: str) -> None:
         _check(self._lib.hvd_client_del(self._h, key.encode()),
                f"delete({key})")
+
+    def gather(self, key: str, size: int, rank: int, blob: bytes,
+               timeout: Optional[float] = None,
+               max_bytes: int = 1 << 22) -> list:
+        """Join-and-collect (OP_GATHER): post `blob`, block until all
+        `size` members posted under `key`, return the rank-ordered blob
+        list. One round trip; idempotent re-post on retry."""
+        out = _buf(max_bytes)
+        outlen = ctypes.c_uint32(0)
+        t = -1.0 if timeout is None else float(timeout)
+        st = self._lib.hvd_client_gather(
+            self._h, key.encode(), t, size, rank, _as_u8p(blob),
+            len(blob), out, max_bytes, ctypes.byref(outlen))
+        raw = self._finish(st, out, outlen, f"gather({key})")
+        blobs, off = [], 0
+        for _ in range(size):
+            (n,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            blobs.append(raw[off:off + n])
+            off += n
+        return blobs
+
+    def stat(self) -> dict:
+        """Server live-state counts after a forced TTL sweep
+        ({"data": n, "gathers": m}) — the leak-check hook."""
+        out = _buf(256)
+        outlen = ctypes.c_uint32(0)
+        _check(self._lib.hvd_client_stat(self._h, out, 256,
+                                         ctypes.byref(outlen)), "stat")
+        txt = bytes(out[:outlen.value]).decode()
+        return {k: int(v) for k, v in
+                (kv.split("=") for kv in txt.split())}
 
     def close(self) -> None:
         if self._h:

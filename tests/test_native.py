@@ -220,3 +220,84 @@ def test_coordinator_gather_scale_smoke():
             c.close()
     finally:
         server.close()
+
+
+def test_store_state_ttl_sweep_and_restart():
+    """Dead-member hygiene (VERDICT r3 item 7): a member that dies
+    mid-gather — before OR after the round completes — must not leak
+    GatherState (csrc/store.cc TTL sweep), a read-counted entry whose
+    second reader died must expire, and the next round on the same
+    store must run clean afterwards."""
+    import os
+    import time
+    os.environ["HVD_STORE_STATE_TTL_S"] = "2"
+    try:
+        server = StoreServer()
+    finally:
+        del os.environ["HVD_STORE_STATE_TTL_S"]
+    try:
+        a = StoreClient("127.0.0.1", server.port)
+        b = StoreClient("127.0.0.1", server.port)
+
+        # incomplete round: rank 0 posts, peer never joins, caller
+        # times out and "dies" -> state visible, then swept by TTL
+        with pytest.raises(NativeTimeout):
+            a.gather("dead1", 2, 0, b"x", timeout=0.3)
+        assert a.stat()["gathers"] == 1
+        time.sleep(2.5)
+        assert a.stat()["gathers"] == 0
+
+        # complete-but-unread: rank 0 posts + times out (its blob stays,
+        # idempotent-retry contract), rank 1's post completes the round
+        # and reads — reads_left sticks at 1 because rank 0 never
+        # returns. Swept by TTL.
+        with pytest.raises(NativeTimeout):
+            a.gather("dead2", 2, 0, b"a", timeout=0.3)
+        assert b.gather("dead2", 2, 1, b"b", timeout=5) == [b"a", b"b"]
+        assert b.stat()["gathers"] == 1
+        time.sleep(2.5)
+        assert b.stat()["gathers"] == 0
+
+        # read-counted entry whose second reader died
+        a.set("rc", b"v")
+        assert a.get("rc", timeout=5, expected_reads=2) == b"v"
+        assert a.stat()["data"] == 1
+        time.sleep(2.5)
+        assert a.stat()["data"] == 0
+
+        # restart after the dead member: a fresh full round on the SAME
+        # key runs clean (no poisoned state), and nothing leaks after
+        import threading
+        outs = {}
+
+        def drive(client, rank):
+            outs[rank] = client.gather("dead2", 2, rank,
+                                       f"r{rank}".encode(), timeout=10)
+
+        ts = [threading.Thread(target=drive, args=(c, r))
+              for r, c in ((0, a), (1, b))]
+        [t.start() for t in ts]
+        [t.join(timeout=30) for t in ts]
+        assert outs[0] == outs[1] == [b"r0", b"r1"]
+        assert a.stat()["gathers"] == 0
+        a.close()
+        b.close()
+    finally:
+        server.close()
+
+
+def test_store_oversized_value_stash(server):
+    """A value larger than the caller's buffer is returned via the
+    client-side stash (ST_AGAIN + take_pending): get/gather consume
+    server-side read slots BEFORE the reply, so a re-request would
+    corrupt round state — the stash makes overflow lossless."""
+    c = StoreClient("127.0.0.1", server.port)
+    big = bytes(range(256)) * 100
+    c.set("big", big)
+    assert c.get("big", timeout=5, max_bytes=64) == big
+    # read-counted + overflow: the slot is consumed exactly once and
+    # the entry is gone after its single read
+    c.set("rc", big)
+    assert c.get("rc", timeout=5, expected_reads=1, max_bytes=64) == big
+    assert c.stat()["data"] == 1          # only the persistent "big"
+    c.close()
