@@ -93,6 +93,18 @@ bool send_frame(int fd, uint8_t status, const std::string& payload) {
   return payload.empty() || send_all(fd, payload.data(), payload.size());
 }
 
+// Is the requesting connection still alive? A cheap nonblocking peek:
+// orderly EOF or a hard error means the client died and nobody will read
+// our reply — the handler must stop waiting on its behalf.
+bool peer_alive(int fd) {
+  char b;
+  ssize_t n = ::recv(fd, &b, 1, MSG_PEEK | MSG_DONTWAIT);
+  if (n == 0) return false;  // EOF
+  if (n < 0)
+    return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+  return true;  // pipelined next request already queued: alive
+}
+
 struct Entry {
   std::string value;
   int reads_left = 0;  // 0 = persistent; >0 = erase after this many reads
@@ -227,15 +239,8 @@ class StoreServer {
             return (it != data_.end() && it->second.present) ||
                    shutting_down_.load();
           };
-          bool got;
-          if (timeout_s < 0) {
-            cv_.wait(lk, ready);
-            got = !shutting_down_.load();
-          } else {
-            got = cv_.wait_for(
-                      lk, std::chrono::duration<double>(timeout_s), ready) &&
-                  !shutting_down_.load();
-          }
+          bool got = WaitPred(lk, timeout_s, fd, ready) &&
+                     !shutting_down_.load();
           if (!got) {
             lk.unlock();
             alive = send_frame(fd, ST_TIMEOUT, "");
@@ -311,16 +316,8 @@ class StoreServer {
                    shutting_down_.load();
           };
           g.waiters++;           // pin against the TTL sweep while blocked
-          bool got;
-          if (timeout_s < 0) {
-            cv_.wait(lk, gready);
-            got = !shutting_down_.load();
-          } else {
-            got = cv_.wait_for(
-                      lk, std::chrono::duration<double>(timeout_s),
-                      gready) &&
-                  !shutting_down_.load();
-          }
+          bool got = WaitPred(lk, timeout_s, fd, gready) &&
+                     !shutting_down_.load();
           auto git = gathers_.find(key);
           if (git != gathers_.end()) {
             git->second.waiters--;
@@ -359,6 +356,32 @@ class StoreServer {
       conn_fds_.erase(fd);
     }
     ::close(fd);
+  }
+
+  // Wait under lk until pred, honoring timeout_s (< 0 = unbounded), but
+  // bail out when the REQUESTING connection dies: a handler blocked
+  // forever on behalf of a dead peer would leak its thread — and for
+  // gathers, pin (sweep-proof) the round state — for the server's
+  // lifetime. Returns pred()'s final value.
+  template <typename Pred>
+  bool WaitPred(std::unique_lock<std::mutex>& lk, double timeout_s, int fd,
+                Pred pred) {
+    using clock = std::chrono::steady_clock;
+    const clock::duration slice = std::chrono::seconds(15);
+    clock::time_point deadline;
+    if (timeout_s >= 0)
+      deadline = clock::now() + std::chrono::duration_cast<clock::duration>(
+                                    std::chrono::duration<double>(timeout_s));
+    for (;;) {
+      clock::duration wait = slice;
+      if (timeout_s >= 0) {
+        auto left = deadline - clock::now();
+        if (left <= clock::duration::zero()) return pred();
+        if (left < wait) wait = left;
+      }
+      if (cv_.wait_for(lk, wait, pred)) return true;
+      if (!peer_alive(fd)) return false;  // requester died
+    }
   }
 
   // mu_ held. Expire orphaned state: read-counted entries and gather
@@ -478,7 +501,11 @@ class StoreClient {
   // Oversized-result stash: get/gather consume server-side read slots
   // BEFORE the reply, so "retry with a bigger buffer" would corrupt
   // round state — instead the wrapper stashes the full value here and
-  // returns ST_AGAIN; the caller drains it with take_pending.
+  // returns ST_AGAIN; the caller drains it with take_pending. The
+  // request->ST_AGAIN->take_pending sequence must run under the SAME
+  // external serialization as the request itself (one slot, not a
+  // queue) — the Python StoreClient holds its per-client lock across
+  // the pair.
   void StashPending(std::string v) {
     std::lock_guard<std::mutex> lk(mu_);
     pending_ = std::move(v);

@@ -35,7 +35,8 @@ class ElasticDriver:
                  ssh_port: Optional[int] = None,
                  ssh_identity_file: Optional[str] = None,
                  output_dir: Optional[str] = None,
-                 elastic_timeout: Optional[float] = None):
+                 elastic_timeout: Optional[float] = None,
+                 prefix_timestamp: bool = False):
         self.manager = HostManager(discovery)
         self.command = command
         self.min_np = min_np
@@ -50,6 +51,7 @@ class ElasticDriver:
         self.ssh_port = ssh_port
         self.ssh_identity_file = ssh_identity_file
         self.output_dir = output_dir
+        self.prefix_timestamp = prefix_timestamp
         self.resets = 0
         self._assignments: Dict[str, List[SlotInfo]] = {}
         self._workers: List[exec_lib.WorkerProcess] = []
@@ -134,7 +136,8 @@ class ElasticDriver:
             slots, self.command, coord, kv_port, self._secret, env,
             ssh_port=self.ssh_port,
             ssh_identity_file=self.ssh_identity_file,
-            output_dir=self.output_dir)
+            output_dir=self.output_dir,
+            prefix_timestamp=self.prefix_timestamp)
 
     def _supervise(self, slots: List[SlotInfo]) -> str:
         """Watch workers + host set. Returns 'done' or 'reset'."""
@@ -201,7 +204,8 @@ def run_elastic(args) -> int:
         ssh_port=getattr(args, "ssh_port", None),
         ssh_identity_file=getattr(args, "ssh_identity_file", None),
         output_dir=getattr(args, "output_filename", None),
-        elastic_timeout=getattr(args, "elastic_timeout", None))
+        elastic_timeout=getattr(args, "elastic_timeout", None),
+        prefix_timestamp=bool(getattr(args, "prefix_timestamp", None)))
     return driver.run()
 
 

@@ -11,11 +11,12 @@ from __future__ import annotations
 
 import ctypes
 import struct
+import threading
 from typing import List, Optional
 
 from . import lib
 
-_OK, _TIMEOUT, _ERROR = 0, 1, 2
+_OK, _TIMEOUT, _ERROR, _AGAIN = 0, 1, 2, 3  # mirrors csrc/store.cc Status
 
 
 class NativeError(RuntimeError):
@@ -75,6 +76,10 @@ class StoreClient:
         self._h = self._lib.hvd_client_create(host.encode(), port)
         if not self._h:
             raise NativeError(f"could not connect to store {host}:{port}")
+        # serializes request -> possible ST_AGAIN stash -> take_pending:
+        # the stash is a single per-client slot, so a concurrent
+        # oversized call from another thread would overwrite it
+        self._lock = threading.Lock()
 
     def set(self, key: str, value: bytes) -> None:
         _check(self._lib.hvd_client_set(self._h, key.encode(),
@@ -86,16 +91,18 @@ class StoreClient:
         out = _buf(max_bytes)
         outlen = ctypes.c_uint32(0)
         t = -1.0 if timeout is None else float(timeout)
-        st = self._lib.hvd_client_get(self._h, key.encode(), t,
-                                      expected_reads, out, max_bytes,
-                                      ctypes.byref(outlen))
-        return self._finish(st, out, outlen, f"get({key})")
+        with self._lock:
+            st = self._lib.hvd_client_get(self._h, key.encode(), t,
+                                          expected_reads, out, max_bytes,
+                                          ctypes.byref(outlen))
+            return self._finish(st, out, outlen, f"get({key})")
 
     def _finish(self, st: int, out, outlen, what: str) -> bytes:
-        """Resolve a sized-reply call. ST_AGAIN (3) = the value exceeded
-        the caller buffer AFTER the server consumed the read slot; the
-        client stashed it — drain with take_pending, never re-request."""
-        if st == 3:
+        """Resolve a sized-reply call (self._lock held). _AGAIN = the
+        value exceeded the caller buffer AFTER the server consumed the
+        read slot; the client stashed it — drain with take_pending,
+        never re-request."""
+        if st == _AGAIN:
             need = outlen.value
             out2 = _buf(need)
             outlen2 = ctypes.c_uint32(0)
@@ -118,10 +125,11 @@ class StoreClient:
         out = _buf(max_bytes)
         outlen = ctypes.c_uint32(0)
         t = -1.0 if timeout is None else float(timeout)
-        st = self._lib.hvd_client_gather(
-            self._h, key.encode(), t, size, rank, _as_u8p(blob),
-            len(blob), out, max_bytes, ctypes.byref(outlen))
-        raw = self._finish(st, out, outlen, f"gather({key})")
+        with self._lock:
+            st = self._lib.hvd_client_gather(
+                self._h, key.encode(), t, size, rank, _as_u8p(blob),
+                len(blob), out, max_bytes, ctypes.byref(outlen))
+            raw = self._finish(st, out, outlen, f"gather({key})")
         blobs, off = [], 0
         for _ in range(size):
             (n,) = struct.unpack_from("<I", raw, off)

@@ -14,6 +14,7 @@ import signal
 import subprocess
 import sys
 import threading
+import time
 from typing import Dict, List, Optional
 
 from .hosts import SlotInfo
@@ -79,9 +80,11 @@ class WorkerProcess:
                  env: Dict[str, str], prefix_output: bool = True,
                  ssh_port: Optional[int] = None,
                  ssh_identity_file: Optional[str] = None,
-                 output_dir: Optional[str] = None):
+                 output_dir: Optional[str] = None,
+                 prefix_timestamp: bool = False):
         self.slot = slot
         self.prefix = f"[{slot.rank}]<stdout>:" if prefix_output else ""
+        self.prefix_timestamp = prefix_timestamp
         self._sink = None
         if output_dir:
             os.makedirs(output_dir, exist_ok=True)
@@ -102,6 +105,11 @@ class WorkerProcess:
         try:
             for line in self.proc.stdout:
                 text = line.decode(errors="replace")
+                if self.prefix_timestamp:
+                    # reference --prefix-output-with-timestamp
+                    # (safe_shell_exec prepend_timestamp)
+                    text = time.strftime("%a %b %d %H:%M:%S %Y") \
+                        + ": " + text
                 if sink is not None:
                     sink.write(text)
                     sink.flush()
@@ -130,12 +138,14 @@ def launch_slots(slots: List[SlotInfo], command: List[str],
                  base_env: Optional[Dict[str, str]] = None,
                  ssh_port: Optional[int] = None,
                  ssh_identity_file: Optional[str] = None,
-                 output_dir: Optional[str] = None
+                 output_dir: Optional[str] = None,
+                 prefix_timestamp: bool = False
                  ) -> List[WorkerProcess]:
     return [WorkerProcess(s, command,
                           slot_env(s, coordinator_addr, kv_port, secret,
                                    base_env),
                           ssh_port=ssh_port,
                           ssh_identity_file=ssh_identity_file,
-                          output_dir=output_dir)
+                          output_dir=output_dir,
+                          prefix_timestamp=prefix_timestamp)
             for s in slots]

@@ -71,6 +71,10 @@ _IGNORED_FLAGS = {
     "num_nccl_streams": "--num-nccl-streams — XLA owns device streams",
     "thread_affinity": "--thread-affinity — XLA owns dispatch threads",
     "mpi_threads_disable": "--mpi-threads-disable — no MPI runtime",
+    "use_mpi": "--mpi — no MPI runtime; the native store controller "
+               "(the gloo role) runs the job",
+    "use_jsrun": "--jsrun — no LSF on TPU pods; use --tpu-pod for "
+                 "scheduler-managed launch",
 }
 
 
@@ -156,8 +160,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                             "FATAL"])
     p.add_argument("--log-with-timestamp", dest="log_with_timestamp",
                    action="store_true", default=None)
-    p.add_argument("--no-log-with-timestamp", dest="log_with_timestamp",
+    p.add_argument("--no-log-with-timestamp", "--log-without-timestamp",
+                   dest="log_with_timestamp",
                    action="store_false", help=argparse.SUPPRESS)
+    # deprecated reference aliases (launch.py:536-543: hide == without)
+    p.add_argument("--log-hide-timestamp", dest="log_with_timestamp",
+                   action="store_false", help=argparse.SUPPRESS)
+    p.add_argument("--no-log-hide-timestamp", dest="log_with_timestamp",
+                   action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--min-np", "--min-num-proc", dest="min_np", type=int,
                    default=None, help="Elastic: minimum workers.")
     p.add_argument("--max-np", "--max-num-proc", dest="max_np", type=int,
@@ -208,6 +218,25 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="Write each worker's merged stdout/stderr to "
                         "<dir>/rank.<N> instead of the console "
                         "(reference --output-filename).")
+    p.add_argument("-prefix-timestamp", "--prefix-output-with-timestamp",
+                   dest="prefix_timestamp", action="store_true",
+                   default=None,
+                   help="Timestamp every worker output line (reference "
+                        "--prefix-output-with-timestamp).")
+    # controller selectors (reference launch.py:566-578). The native
+    # store controller IS this launcher's gloo-role controller, so
+    # --gloo is an accepted no-op; MPI and LSF/jsrun have no runtime on
+    # TPU pods (declared cuts) and warn-and-ignore.
+    p.add_argument("--gloo", dest="use_gloo", action="store_true",
+                   default=None,
+                   help="Accepted: the native store controller is the "
+                        "gloo-role controller here (always on).")
+    p.add_argument("--mpi", dest="use_mpi", action="store_true",
+                   default=None, help="IGNORED on TPU (no MPI runtime).")
+    p.add_argument("--jsrun", dest="use_jsrun", action="store_true",
+                   default=None,
+                   help="IGNORED on TPU (use --tpu-pod for "
+                        "scheduler-managed launch).")
     p.add_argument("--tpu-pod", action="store_true", default=None,
                    help="Derive hosts from TPU pod metadata "
                         "(TPU_WORKER_HOSTNAMES); one process per TPU VM. "
@@ -215,7 +244,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "LSF/jsrun mode.")
     p.add_argument("--start-timeout", type=float, default=120.0)
     p.add_argument("--verbose", action="store_true")
-    p.add_argument("--check-build", action="store_true",
+    p.add_argument("-cb", "--check-build", action="store_true",
                    help="Print capability summary and exit.")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="Program and args to launch.")
@@ -327,7 +356,8 @@ def run_static(args: argparse.Namespace) -> int:
         slots, args.command, coord, port, secret, base_env,
         ssh_port=getattr(args, "ssh_port", None),
         ssh_identity_file=getattr(args, "ssh_identity_file", None),
-        output_dir=getattr(args, "output_filename", None))
+        output_dir=getattr(args, "output_filename", None),
+        prefix_timestamp=bool(getattr(args, "prefix_timestamp", None)))
     rc = 0
     try:
         for w in workers:

@@ -301,3 +301,36 @@ def test_store_oversized_value_stash(server):
     assert c.get("rc", timeout=5, expected_reads=1, max_bytes=64) == big
     assert c.stat()["data"] == 1          # only the persistent "big"
     c.close()
+
+
+def test_store_dead_infinite_waiter_reclaimed():
+    """A client killed while blocked in an infinite-timeout gather must
+    not pin its round forever: the handler's liveness check notices the
+    dead peer (15s wait slices), unpins, and the TTL sweep reclaims the
+    state — the docs' no-permanent-leak guarantee."""
+    import os
+    import subprocess
+    import sys
+    import time
+    os.environ["HVD_STORE_STATE_TTL_S"] = "2"
+    try:
+        server = StoreServer()
+    finally:
+        del os.environ["HVD_STORE_STATE_TTL_S"]
+    try:
+        child = subprocess.Popen([sys.executable, "-c", f"""
+from horovod_tpu.native.store import StoreClient
+c = StoreClient("127.0.0.1", {server.port})
+c.gather("orphan", 2, 0, b"x")   # never completes; infinite wait
+"""])
+        time.sleep(2.0)                 # child blocked in the gather
+        child.kill()
+        child.wait()
+        c = StoreClient("127.0.0.1", server.port)
+        deadline = time.time() + 40
+        while time.time() < deadline and c.stat()["gathers"]:
+            time.sleep(1)
+        assert c.stat()["gathers"] == 0, c.stat()
+        c.close()
+    finally:
+        server.close()

@@ -387,3 +387,72 @@ class TestReferenceFlagParity:
              "--cycle-time-ms", "3.0", "x"]))
         assert env["HOROVOD_CYCLE_TIME"] == "3.0"      # CLI wins
         assert env["HOROVOD_CACHE_CAPACITY"] == "77"   # file fills gap
+
+
+def test_every_reference_flag_parses(capsys):
+    """Final flag audit (VERDICT r3 item 8): every add_argument name in
+    the reference horovodrun CLI (horovod/runner/launch.py:286-594)
+    parses here — implemented, aliased, or warn-and-ignored. The list is
+    the complete reference flag-name inventory, kept literal so the test
+    runs without the reference checkout."""
+    from horovod_tpu.runner.launch import parse_args
+
+    # flags taking a value (flag, sample) — one parse each
+    valued = [
+        ("-np", "2"), ("--num-proc", "2"),
+        ("--start-timeout", "30"),
+        ("--network-interfaces", "eth0,eth1"),
+        ("--network-interface", "eth0"),
+        ("--output-filename", "/tmp/o"),
+        ("--config-file", None),          # needs a real file; parse-only skip
+        ("-p", "12"), ("--ssh-port", "12"),
+        ("-i", "/tmp/id"), ("--ssh-identity-file", "/tmp/id"),
+        ("--fusion-threshold-mb", "64"), ("--cycle-time-ms", "5"),
+        ("--cache-capacity", "1024"),
+        ("--autotune-log-file", "/tmp/a"),
+        ("--autotune-warmup-samples", "3"),
+        ("--autotune-steps-per-sample", "10"),
+        ("--autotune-bayes-opt-max-samples", "20"),
+        ("--autotune-gaussian-process-noise", "0.8"),
+        ("--min-np", "1"), ("--min-num-proc", "1"),
+        ("--max-np", "4"), ("--max-num-proc", "4"),
+        ("--slots-per-host", "2"),
+        ("--elastic-timeout", "600"), ("--reset-limit", "3"),
+        ("--blacklist-cooldown-range", None),  # nargs=2, below
+        ("--timeline-filename", "/tmp/t"),
+        ("--stall-check-warning-time-seconds", "60"),
+        ("--stall-check-shutdown-time-seconds", "120"),
+        ("--mpi-args", "-x FOO"), ("--binding-args", "-bind-to core"),
+        ("--num-nccl-streams", "2"), ("--thread-affinity", "1"),
+        ("--gloo-timeout-seconds", "30"),
+        ("--log-level", "INFO"),
+        ("-H", "localhost:2"), ("--hosts", "localhost:2"),
+        ("-hostfile", "/tmp/hf"), ("--hostfile", "/tmp/hf"),
+        ("--host-discovery-script", "/tmp/d.sh"),
+    ]
+    for flag, sample in valued:
+        if sample is None:
+            continue
+        parse_args(["-np", "2", flag, sample, "python", "x.py"]) \
+            if flag not in ("-np", "--num-proc") else \
+            parse_args([flag, sample, "python", "x.py"])
+    parse_args(["-np", "2", "--blacklist-cooldown-range", "10", "100",
+                "python", "x.py"])
+
+    # boolean/no-arg flags (every reference store_true/deprecated pair)
+    for flag in [
+            "--disable-cache", "--verbose",
+            "--hierarchical-allreduce", "--no-hierarchical-allreduce",
+            "--hierarchical-allgather", "--no-hierarchical-allgather",
+            "--torus-allreduce", "--no-torus-allreduce",
+            "--autotune", "--no-autotune",
+            "--timeline-mark-cycles", "--no-timeline-mark-cycles",
+            "--no-stall-check", "--stall-check",
+            "--mpi-threads-disable", "--no-mpi-threads-disable",
+            "--tcp",
+            "--log-with-timestamp", "--log-without-timestamp",
+            "-prefix-timestamp", "--prefix-output-with-timestamp",
+            "--log-hide-timestamp", "--no-log-hide-timestamp",
+            "--gloo", "--mpi", "--jsrun"]:
+        parse_args(["-np", "2", flag, "python", "x.py"])
+    capsys.readouterr()               # swallow the warn-and-ignore notes
