@@ -6,4 +6,6 @@ from .sampler import ElasticSampler                        # noqa: F401
 from .discovery import (HostDiscovery, HostDiscoveryScript,  # noqa: F401
                         FixedHostDiscovery, HostManager, HostState)
 from .driver import ElasticDriver                          # noqa: F401
+from .hybrid import (ElasticMeshSpec, GSPMDState,          # noqa: F401
+                     MeshResizeError, host_tree)
 from ..checkpoint import FileBackedState                   # noqa: F401

@@ -180,3 +180,99 @@ class TestRunWrapper:
         with pytest.raises(HostsUpdatedInterrupt):
             notification_manager.check()
         notification_manager.check()  # cleared
+
+
+class TestElasticHybrid:
+    """Elastic x hybrid parallelism semantics (VERDICT r3 item 9): the
+    model-parallel factorization is fixed, dp absorbs elasticity, and an
+    incompatible world fails fast with MeshResizeError."""
+
+    def test_spec_resizes_dp_only(self, hvd):
+        import jax
+        from horovod_tpu.elastic import ElasticMeshSpec
+        spec = ElasticMeshSpec(tp=2)
+        devs = jax.devices()
+        m8 = spec.build(devs)                     # 8 -> dp=4, tp=2
+        assert m8.shape["dp"] == 4 and m8.shape["tp"] == 2
+        m4 = spec.build(devs[:4])                 # shrink -> dp=2, tp=2
+        assert m4.shape["dp"] == 2 and m4.shape["tp"] == 2
+        m2 = spec.build(devs[:2])                 # minimum: dp=1
+        # make_mesh drops size-1 axes (rules restrict to present axes)
+        assert dict(m2.shape).get("dp", 1) == 1 and m2.shape["tp"] == 2
+
+    def test_spec_rejects_misfit_world(self, hvd):
+        import jax
+        from horovod_tpu.elastic import ElasticMeshSpec, MeshResizeError
+        devs = jax.devices()
+        spec = ElasticMeshSpec(tp=2)
+        with pytest.raises(MeshResizeError) as e:
+            spec.build(devs[:3])                  # odd world under tp=2
+        assert "multiple of 2" in str(e.value)
+        with pytest.raises(MeshResizeError):
+            ElasticMeshSpec(tp=2, sp=2).build(devs[:2])   # below fixed
+        # the unit named in the message is tp*sp*pp*ep
+        with pytest.raises(MeshResizeError) as e:
+            ElasticMeshSpec(tp=2, pp=2).build(devs[:6])
+        assert "multiple of 4" in str(e.value)
+
+    def test_gspmd_state_reshards_on_sync(self, hvd):
+        import jax
+        import numpy as np
+        from horovod_tpu.elastic import ElasticMeshSpec, GSPMDState
+        from horovod_tpu.parallel.tp import PartitionRules
+        from jax.sharding import PartitionSpec as P
+
+        rules = PartitionRules([(r"w", P(None, "tp"))])
+        spec = ElasticMeshSpec(tp=2)
+        w = np.arange(32, dtype=np.float32).reshape(4, 8)
+        state = GSPMDState(spec, rules, params={"w": w}, epoch=0)
+
+        state.sync()
+        placed = state.placed("params")["w"]
+        assert placed.sharding.mesh.shape["dp"] == 4
+        np.testing.assert_array_equal(np.asarray(placed), w)
+        # tracked values stay snapshot-able host-side trees (the
+        # broadcast/snapshot/checkpoint contract): every leaf fully
+        # addressable — placement is a view, not the stored value
+        assert state.params["w"].is_fully_addressable \
+            if hasattr(state.params["w"], "is_fully_addressable") else True
+        np.testing.assert_array_equal(np.asarray(state.params["w"]), w)
+
+        # trained device trees flow back as host trees...
+        state.update_from_device(params={"w": placed * 2})
+        assert isinstance(state.params["w"], np.ndarray)
+        np.testing.assert_array_equal(state.params["w"], w * 2)
+        state.update_from_device(params={"w": placed})
+
+        # simulate an elastic shrink: fewer devices -> smaller dp, same
+        # tp sharding, values preserved (reshard-on-restore)
+        import jax as _jax
+        spec2 = ElasticMeshSpec(tp=2)
+        state._spec = spec2
+        orig_build = spec2.build
+        spec2.build = lambda devices=None: orig_build(_jax.devices()[:4])
+        state.sync()
+        placed = state.placed("params")["w"]
+        assert placed.sharding.mesh.shape["dp"] == 2
+        np.testing.assert_array_equal(np.asarray(placed), w)
+        # place() puts auxiliary trees on the same mesh
+        aux = state.place({"w": w * 2})
+        assert aux["w"].sharding.mesh.shape["dp"] == 2
+        # a second sync (in-process reset path) keeps working even with
+        # a device tree stored: it is normalized back to host first
+        state._values["params"] = {"w": placed}
+        state.sync()
+        np.testing.assert_array_equal(np.asarray(state.params["w"]), w)
+
+    def test_gspmd_state_sync_fails_fast_on_misfit(self, hvd):
+        import jax
+        from horovod_tpu.elastic import (ElasticMeshSpec, GSPMDState,
+                                         MeshResizeError)
+        from horovod_tpu.parallel.tp import PartitionRules
+        from jax.sharding import PartitionSpec as P
+        spec = ElasticMeshSpec(tp=2)
+        orig = spec.build
+        spec.build = lambda devices=None: orig(jax.devices()[:3])
+        state = GSPMDState(spec, PartitionRules([]), params=None)
+        with pytest.raises(MeshResizeError):
+            state.sync()

@@ -197,3 +197,71 @@ def test_elastic_launcher_completes_without_change(tmp_path):
     assert rc == 0
     done = [ln for ln in _log_lines(str(log)) if ln.startswith("done")]
     assert {ln.split()[1] for ln in done} == {"rank=0", "rank=1"}
+
+
+def test_elastic_shrink_under_hybrid_tp_mesh(tmp_path):
+    """Elastic x hybrid parallelism (VERDICT r3 item 9): a REAL hvdrun
+    elastic job training a tp=2-sharded model on 4 workers shrinks to 2
+    mid-run via a discovery change. The relaunched incarnation rebuilds
+    the mesh from the SAME ElasticMeshSpec (dp 2 -> 1, tp stays 2),
+    restores the committed host checkpoint, re-places it with the same
+    partition rules (reshard-on-restore), and completes — the
+    model-parallel layout never changes across the resize."""
+    import glob
+    import json
+
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:4\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hostfile}\n")
+    disc.chmod(0o755)
+    worker = os.path.join(REPO, "tests", "data",
+                          "elastic_hybrid_worker.py")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TRAIN_OUT"] = str(tmp_path)
+    env["ELASTIC_TEST_HOSTFILE"] = str(hostfile)
+
+    driver_log = open(tmp_path / "driver.log", "w")
+    try:
+        rc = subprocess.call(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "4", "--min-np", "2", "--max-np", "4",
+             "--host-discovery-script", str(disc),
+             sys.executable, worker],
+            env=env, stdout=driver_log, stderr=subprocess.STDOUT,
+            cwd=str(tmp_path), timeout=420)
+    finally:
+        driver_log.close()
+    log = _log_lines(str(tmp_path / "events.log"))
+    assert rc == 0, f"driver rc={rc}\nevents:\n" + "\n".join(log[-30:]) + \
+        "\ndriver:\n" + "\n".join(
+            _log_lines(str(tmp_path / "driver.log"))[-20:])
+
+    # first incarnation ran dp=2 x tp=2 on world 4; the relaunch ran
+    # dp=1 x tp=2 on world 2 — tp NEVER changed
+    inc = [ln for ln in log if ln.startswith("incarnation ")]
+    assert any("world=4" in ln and "mesh=dp2xtp2" in ln for ln in inc), inc
+    assert any("world=2" in ln and "mesh=dp1xtp2" in ln for ln in inc), inc
+    assert all("tp2" in ln for ln in inc), inc
+
+    # the shrink was injected at step 5; the relaunch resumed from the
+    # commit at step 3, not from scratch
+    assert os.path.exists(tmp_path / "shrunk.flag")
+    resumes = [ln for ln in log if ln.startswith("resumed ")]
+    assert resumes and all("step=3" in ln for ln in resumes), resumes
+    commit3 = next(ln for ln in log
+                   if ln.startswith("commit ") and "step=3" in ln)
+    committed_hash = commit3.split("hash=")[1]
+    assert all(ln.split("hash=")[1] == committed_hash
+               for ln in resumes), (commit3, resumes)
+
+    # both surviving ranks finished all steps with identical params
+    finals = []
+    for path in sorted(glob.glob(str(tmp_path / "final.*.json"))):
+        with open(path) as f:
+            finals.append(json.load(f))
+    assert len(finals) == 2, (finals, log[-10:])
+    assert all(f["step"] == 12 and f["world"] == 2 for f in finals)
+    assert finals[0]["hash"] == finals[1]["hash"]
