@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Conv-by-conv roofline for the ResNet-50 train step on v5e
+(VERDICT r3 item 3: quantify the ceiling behind the measured ~39%
+effective MFU, or find headroom).
+
+Model: every conv lowers to three implicit GEMMs per train step —
+forward (M=B·Ho·Wo, K=Cin·kh·kw, N=Cout), input gradient
+(M=B·Hi·Wi, K=Cout·kh·kw, N=Cin) and weight gradient
+(M=Cin·kh·kw, K=B·Ho·Wo, N=Cout). The MXU computes on 128-padded
+operand tiles (8-padded on the sublane M dim), so the *padded* FLOPs —
+not the algorithmic FLOPs — set the compute-time floor; early ResNet
+convs (Cin·kh·kw = 147 on the stem, Cout = 64) waste most of each tile.
+Memory floor: bf16 activations + weights moved per GEMM, plus
+BN-train normalization passes and the fp32 SGD+momentum update, at HBM
+bandwidth. Per-op time = max(compute floor, memory floor); the step
+floor is the sum (serial; XLA overlap can only approach it).
+
+Outputs one JSON line per conv group and a summary line comparing the
+model ceiling to the measured img/s. All analytic — runs anywhere; the
+shapes mirror models/resnet.py (conv7 stem, bottleneck blocks).
+"""
+import argparse
+import json
+import math
+
+# v5e, single chip. Peak from the on-chip calibration in
+# docs/benchmarks.md (184.9 TFLOP/s measured on 8192^3 bf16 matmuls =
+# 94% of the 197 nominal); HBM 819 GB/s.
+PEAK_MEASURED = 184.9e12
+PEAK_NOMINAL = 197e12
+HBM_BW = 819e9
+BF16 = 2
+FP32 = 4
+
+
+def ceil_to(x, m):
+    return ((x + m - 1) // m) * m
+
+
+def gemm(m, k, n):
+    """(real_flops, padded_flops) for one MXU GEMM."""
+    real = 2.0 * m * k * n
+    padded = 2.0 * ceil_to(m, 8) * ceil_to(k, 128) * ceil_to(n, 128)
+    return real, padded
+
+
+def conv_cost(b, hi, wi, cin, cout, kh, kw, stride, first=False,
+              block_out=False):
+    """One conv's train-step cost: fwd + dgrad + wgrad GEMMs + bytes.
+
+    dgrad does exactly the forward's MAC count (each input position
+    accumulates from the taps that touched it — a stride-s conv's
+    zero-dilated taps do no real work), so it is modeled as the
+    M=B·Ho·Wo transposed GEMM, NOT an M=B·Hi·Wi one (that would
+    overcount strided convs by stride² — enough to push the "ceiling"
+    below measured throughput). ``first`` elides dgrad entirely: the
+    input-image gradient is never needed and XLA removes it."""
+    ho, wo = math.ceil(hi / stride), math.ceil(wi / stride)
+    f_r, f_p = gemm(b * ho * wo, cin * kh * kw, cout)         # forward
+    d_r, d_p = (0.0, 0.0) if first else \
+        gemm(b * ho * wo, cout * kh * kw, cin)                # dgrad
+    w_r, w_p = gemm(cin * kh * kw, b * ho * wo, cout)         # wgrad
+    real, padded = f_r + d_r + w_r, f_p + d_p + w_p
+    act_in = b * hi * wi * cin * BF16
+    act_out = b * ho * wo * cout * BF16
+    weights = cin * kh * kw * cout * BF16
+    # fwd: read in+w, write out; dgrad: read dy+w, write dx;
+    # wgrad: read in+dy, write dw  (fusion-optimistic: one pass each)
+    passes = 2 if first else 3
+    bytes_moved = passes * (act_in + act_out) + 3 * weights
+    return {"real": real, "padded": padded, "bytes": bytes_moved,
+            "out_elems": b * ho * wo * cout, "block_out": block_out}
+
+
+def resnet50_convs(b, img, stem="conv7"):
+    """Yield (name, cost) for every conv in models/resnet.py ResNet50."""
+    convs = []
+    if stem == "conv7":
+        convs.append(("stem7x7", conv_cost(b, img, img, 3, 64, 7, 7, 2,
+                                           first=True)))
+        h = img // 2
+    else:                       # space_to_depth: 4x4 stride-1 on s2d'd input
+        convs.append(("stem_s2d", conv_cost(b, img // 2, img // 2, 12,
+                                            64, 4, 4, 1, first=True)))
+        h = img // 2
+    h //= 2                     # maxpool 3x3 s2
+    cin = 64
+    for i, blocks in enumerate([3, 4, 6, 3]):
+        f = 64 * (2 ** i)
+        for j in range(blocks):
+            s = 2 if (i > 0 and j == 0) else 1
+            pre = f"s{i}b{j}"
+            # v1.5 (models/resnet.py BottleneckBlock): the STRIDE rides
+            # the 3x3, not the 1x1a — the 1x1a runs at full resolution
+            convs.append((f"{pre}_1x1a", conv_cost(b, h, h, cin, f,
+                                                   1, 1, 1)))
+            hs = math.ceil(h / s)
+            convs.append((f"{pre}_3x3", conv_cost(b, h, h, f, f, 3, 3, s)))
+            convs.append((f"{pre}_1x1b", conv_cost(b, hs, hs, f, 4 * f,
+                                                   1, 1, 1,
+                                                   block_out=True)))
+            if j == 0:
+                convs.append((f"{pre}_proj", conv_cost(b, h, h, cin, 4 * f,
+                                                       1, 1, s)))
+            cin = 4 * f
+            h = hs
+    return convs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--img", type=int, default=224)
+    ap.add_argument("--stem", default="conv7")
+    ap.add_argument("--measured-img-s", type=float, default=None,
+                    help="measured img/s for THIS config (comparison "
+                         "fields omitted when not given)")
+    ap.add_argument("--per-conv", action="store_true")
+    args = ap.parse_args()
+    b = args.batch
+
+    convs = resnet50_convs(b, args.img, args.stem)
+    tot_real = tot_padded = tot_bytes = 0.0
+    t_compute = t_mem = t_step = 0.0
+    for name, c in convs:
+        tc = c["padded"] / PEAK_MEASURED
+        tm = c["bytes"] / HBM_BW
+        t_step += max(tc, tm)
+        t_compute += tc
+        t_mem += tm
+        tot_real += c["real"]
+        tot_padded += c["padded"]
+        tot_bytes += c["bytes"]
+        if args.per_conv:
+            print(json.dumps({
+                "conv": name, "gflop": round(c["real"] / 1e9, 2),
+                "gflop_padded": round(c["padded"] / 1e9, 2),
+                "mxu_util": round(c["real"] / c["padded"], 3),
+                "mb": round(c["bytes"] / 1e6, 1),
+                "bound": "mxu" if tc > tm else "hbm",
+                "us_floor": round(max(tc, tm) * 1e6, 1)}))
+
+    # BN-train passes: each conv output is normalized (read for stats is
+    # fused into the producing conv's epilogue at best, but the
+    # normalize+scale pass re-reads and re-writes the activation; bwd
+    # re-reads twice for the dgamma/dbeta + dx terms). 4 passes bf16.
+    bn_elems = sum(c["out_elems"] for _, c in convs)
+    bn_bytes = 4 * bn_elems * BF16
+    t_bn = bn_bytes / HBM_BW
+    # residual adds + relus not fused into a conv epilogue: one extra
+    # pass over each BLOCK output, forward and backward
+    blk_elems = sum(c["out_elems"] for _, c in convs if c["block_out"])
+    elt_bytes = 2 * blk_elems * BF16
+    t_elt = elt_bytes / HBM_BW
+    # fc 2048->1000 + CE: small; SGD+momentum fp32: read p,m,g; write p,m
+    params = 25.6e6
+    fc_r, fc_p = gemm(b, 2048, 1000)
+    t_fc = max(3 * fc_p / PEAK_MEASURED,
+               (3 * (b * 2048 + b * 1000) * BF16 + 3 * 2048 * 1000 * BF16)
+               / HBM_BW)
+    t_opt = 5 * params * FP32 / HBM_BW
+
+    # two bounds: serial (sum of per-op max — no inter-op overlap) and
+    # perfect-overlap (compute and memory streams fully pipelined; the
+    # true step time must land between them)
+    serial = t_step + t_bn + t_elt + t_fc + t_opt
+    mem_total = t_mem + t_bn + t_elt + t_opt + \
+        (3 * (b * 2048 + b * 1000) * BF16 + 3 * 2048 * 1000 * BF16) / HBM_BW
+    compute_total = t_compute + 3 * fc_p / PEAK_MEASURED
+    overlap = max(compute_total, mem_total)
+    measured = args.measured_img_s
+    step_flops = tot_real + 3 * fc_r
+
+    def mfu(img_s):
+        return round(100 * step_flops * img_s / b / PEAK_NOMINAL, 1)
+
+    out = {
+        "metric": "resnet50_roofline",
+        "batch": b, "img": args.img, "stem": args.stem,
+        "conv_gflop_step": round(tot_real / 1e9, 1),
+        "conv_gflop_padded": round(tot_padded / 1e9, 1),
+        "mxu_pad_util": round(tot_real / tot_padded, 3),
+        "conv_compute_floor_ms": round(t_compute * 1e3, 2),
+        "conv_mem_floor_ms": round(t_mem * 1e3, 2),
+        "compute_floor_ms": round(compute_total * 1e3, 2),
+        "mem_floor_ms": round(mem_total * 1e3, 2),
+        "bound": "hbm" if mem_total > compute_total else "mxu",
+        "bn_ms": round(t_bn * 1e3, 2), "elt_ms": round(t_elt * 1e3, 2),
+        "opt_ms": round(t_opt * 1e3, 2),
+        "serial_floor_ms": round(serial * 1e3, 2),
+        "serial_ceiling_img_s": round(b / serial, 0),
+        "overlap_ceiling_img_s": round(b / overlap, 0),
+        "overlap_ceiling_mfu_pct": mfu(b / overlap),
+    }
+    if measured is not None:
+        out.update({
+            "measured_img_s": measured,
+            "measured_pct_of_overlap_ceiling": round(
+                100 * measured / (b / overlap), 1),
+            "measured_mfu_pct": mfu(measured),
+        })
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
