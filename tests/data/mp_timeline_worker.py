@@ -1,0 +1,33 @@
+"""Minimal multi-process engine job for timeline assertions (launched by
+test_multiprocess.py): a few negotiated allreduces with HOROVOD_TIMELINE
+set — the rank-0 trace must carry NEGOTIATE spans (engine cycle
+negotiation wall time) plus per-tensor QUEUED/ALLREDUCE phases."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main(out_dir: str) -> None:
+    hvd.init()
+    pid = jax.process_index()
+    for i in range(3):
+        out = hvd.local_rows(hvd.allreduce(
+            np.ones((1, 4), np.float32), hvd.Sum, name=f"tl{i}"))
+        np.testing.assert_allclose(out, 2.0)
+    hvd.shutdown()
+    with open(os.path.join(out_dir, f"result.{pid}.json"), "w") as f:
+        json.dump({"ok": True, "pid": pid}, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -17,9 +17,10 @@ REPO = os.path.dirname(HERE)
 
 
 def _hvdrun(worker: str, tmp_path, np_: int = 2, timeout=240,
-            stall_seconds: int = 30):
+            stall_seconds: int = 30, extra_env: dict = None):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     # the launcher runs in a subprocess too, so a hung worker cannot wedge
     # the test session
     proc = subprocess.run(
@@ -79,3 +80,23 @@ def test_hvdrun_np4_negotiation(tmp_path):
     processes (1 device each) — wider than the 2-process matrix."""
     _hvdrun("mp_np4_worker.py", tmp_path, np_=4, timeout=360,
             stall_seconds=60)
+
+
+def test_hvdrun_np2_engine_timeline_negotiate_spans(tmp_path):
+    """HOROVOD_TIMELINE on a real 2-process engine job: rank 0 writes
+    the trace (coordinator-written, reference timeline.cc) and every
+    negotiation cycle appears as a NEGOTIATE B/E span alongside the
+    per-tensor QUEUED/ALLREDUCE phases (the overlap-measurement hook,
+    benchmarks/overlap_trace.py)."""
+    trace = tmp_path / "timeline.json"
+    _hvdrun("mp_timeline_worker.py", tmp_path,
+            extra_env={"HOROVOD_TIMELINE": str(trace)})
+    with open(trace) as f:
+        events = json.load(f)["traceEvents"]
+    neg_b = [e for e in events
+             if e.get("name") == "NEGOTIATE" and e.get("ph") == "B"]
+    neg_e = [e for e in events
+             if e.get("name") == "NEGOTIATE" and e.get("ph") == "E"]
+    assert neg_b and len(neg_b) == len(neg_e), (len(neg_b), len(neg_e))
+    phases = {e.get("name") for e in events}
+    assert "QUEUED" in phases and "ALLREDUCE" in phases, phases
