@@ -370,6 +370,34 @@ def allgather_np(arr: np.ndarray, process_set=None) -> np.ndarray:
     return comm_allgather(comm, arr)
 
 
+def allgather_ragged_np(arr: np.ndarray, process_set=None,
+                        return_rows: bool = False):
+    """Rank-ordered dim-0 concatenation where per-rank row counts MAY
+    differ — the reference's allgather semantics (its controller
+    negotiates tensor_sizes, controller.cc:627-741). Row counts are
+    agreed in one small round, payloads padded to the max and gathered
+    on the comm's native transport, then sliced. ``return_rows`` also
+    returns the negotiated per-rank row counts (e.g. for the allgather
+    backward's row-block offsets)."""
+    comm, _, n, _ = resolve_set(process_set)
+    arr = np.ascontiguousarray(arr)
+    if n == 1 or comm is None:
+        rows = [int(arr.shape[0])]
+        # fresh buffer even when degenerate: callers (e.g. the torch
+        # autograd path) hand the result to the user as a NEW tensor,
+        # and an aliased view would let in-place edits corrupt the input
+        return (arr.copy(), rows) if return_rows else arr.copy()
+    counts = comm_allgather(
+        comm, np.array([arr.shape[0]], np.int64)).ravel()
+    rows = [int(c) for c in counts]
+    mx = max(rows)
+    pad = np.zeros((mx,) + arr.shape[1:], arr.dtype)
+    pad[:arr.shape[0]] = arr
+    out = comm_allgather(comm, pad)              # (n, mx, ...)
+    cat = np.concatenate([out[i, :rows[i]] for i in range(n)], axis=0)
+    return (cat, rows) if return_rows else cat
+
+
 def broadcast_np(arr: np.ndarray, root: int = 0,
                  process_set=None) -> np.ndarray:
     """`root` is the GLOBAL rank (reference process-set convention);

@@ -103,7 +103,9 @@ def allreduce(t, op: str = Average, name: Optional[str] = None,
 
 
 def allgather(t, name: Optional[str] = None, process_set=None):
-    """Concatenate along dim 0 across ranks (hvd.allgather)."""
+    """Concatenate along dim 0 across ranks (hvd.allgather). Per-rank
+    dim-0 sizes MAY differ — negotiated like the reference controller's
+    tensor_sizes (controller.cc:627)."""
     import tensorflow as tf
     t = tf.convert_to_tensor(t)
     if t.shape.rank == 0:
@@ -111,10 +113,9 @@ def allgather(t, name: Optional[str] = None, process_set=None):
     _, _, n, _ = _plane.resolve_set(process_set)
     if n == 1:
         return t
-    arr = _to_numpy(t)
-    out = _plane.allgather_np(arr, process_set=process_set)
-    return tf.constant(
-        out.reshape((n * arr.shape[0],) + arr.shape[1:]))
+    arr = _to_numpy(t).reshape(tuple(t.shape))
+    out = _plane.allgather_ragged_np(arr, process_set=process_set)
+    return tf.constant(np.ascontiguousarray(out))
 
 
 def broadcast(t, root_rank: int = 0, name: Optional[str] = None,

@@ -193,12 +193,14 @@ def allreduce(t, op: str = Average, name: Optional[str] = None,
 
 def _allgather_impl(t, name=None, process_set=None):
     import torch
-    comm, _, n, _ = _plane.resolve_set(process_set)
-    if n == 1 or comm is None:
+    _, _, n, _ = _plane.resolve_set(process_set)
+    if n == 1:
         return t.clone()
-    gathered = _plane.comm_allgather(comm, _np_view(t))
-    return torch.from_numpy(
-        gathered.reshape((n * t.shape[0],) + tuple(t.shape[1:])))
+    # ragged-capable: per-rank dim-0 sizes are negotiated, like the
+    # reference controller's tensor_sizes (controller.cc:627)
+    gathered = _plane.allgather_ragged_np(_np_view(t),
+                                          process_set=process_set)
+    return torch.from_numpy(np.ascontiguousarray(gathered))
 
 
 def allgather(t, name: Optional[str] = None, process_set=None):
@@ -498,16 +500,23 @@ def _grad_fns():
         @staticmethod
         def forward(ctx, t, process_set):
             ctx.ps = process_set
-            ctx.rows = t.shape[0]
-            return allgather(t.detach(), process_set=process_set)
+            out, rows = _ordered(lambda: _plane.allgather_ragged_np(
+                _np_view(t.detach()), process_set=process_set,
+                return_rows=True))
+            ctx.rows = rows               # negotiated per-rank counts
+            return torch.from_numpy(np.ascontiguousarray(out)) \
+                .to(t.dtype)
 
         @staticmethod
         def backward(ctx, dy):
-            # sum each rank's dy, then take this rank's row block
-            # (reference allgather backward: allreduce + narrow)
+            # sum each rank's dy, then take this rank's row block —
+            # offsets follow the NEGOTIATED per-rank sizes, so ragged
+            # gathers backprop correctly (reference allgather backward:
+            # allreduce + narrow by tensor_sizes)
             _, me, n, _ = _plane.resolve_set(ctx.ps)
             g = allreduce(dy.contiguous(), op=Sum, process_set=ctx.ps)
-            return (g[me * ctx.rows:(me + 1) * ctx.rows], None)
+            start = sum(ctx.rows[:me])
+            return (g[start:start + ctx.rows[me]], None)
 
     class _BroadcastFn(torch.autograd.Function):
         @staticmethod

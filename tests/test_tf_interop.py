@@ -172,6 +172,14 @@ def _tf_worker():
     gm = hvd.grouped_allreduce([a, c64])
     np.testing.assert_allclose(gm[1].numpy(), np.full(2, 0.5))
 
+    # ragged allgather: per-rank dim-0 sizes differ (reference
+    # tensor_sizes negotiation, controller.cc:627)
+    gr = hvd.allgather(tf.constant(np.full((r + 1, 2), float(r),
+                                           np.float32)))
+    assert gr.shape == (3, 2), gr.shape
+    np.testing.assert_allclose(gr.numpy()[0], 0.0)
+    np.testing.assert_allclose(gr.numpy()[1:], 1.0)
+
     # op plumbing (ADVICE r3): Min/Max reach the comm's native reduction
     # — not a silent sum — on reducescatter AND the fused single-dtype
     # grouped_allreduce path

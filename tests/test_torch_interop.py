@@ -97,6 +97,16 @@ def _torch_worker():
     assert torch.allclose(g[0], torch.zeros(3))
     assert torch.allclose(g[-1], torch.full((3,), float(n - 1)))
 
+    # ragged allgather: per-rank dim-0 sizes differ (reference
+    # tensor_sizes negotiation, controller.cc:627)
+    gr = hvd.allgather(torch.full((r + 1, 2), float(r)))
+    assert gr.shape == (sum(range(1, n + 1)), 2), gr.shape
+    off = 0
+    for src in range(n):
+        assert torch.allclose(gr[off:off + src + 1],
+                              torch.full((src + 1, 2), float(src)))
+        off += src + 1
+
     # reducescatter (average)
     rs = hvd.reducescatter(torch.full((2 * n,), float(r + 1)),
                            op=hvd.Average)
@@ -440,6 +450,17 @@ def _torch_autograd_collectives_worker():
     (g * m).sum().backward()
     expect = (np.arange(12).reshape(4, 3) * 3)[2 * r:2 * r + 2]  # 1+2
     np.testing.assert_allclose(x2.grad.numpy(), expect)
+
+    # RAGGED allgather grad: per-rank row counts differ (1 vs 2); the
+    # backward's row-block offsets must follow the NEGOTIATED sizes
+    xr = torch.ones(r + 1, 2, requires_grad=True)
+    c = torch.arange(3 * 2, dtype=torch.float32).reshape(3, 2)
+    gr = hvd.allgather(xr)
+    assert gr.shape == (3, 2)
+    (gr * c).sum().backward()
+    start = 0 if r == 0 else 1
+    np.testing.assert_allclose(
+        xr.grad.numpy(), 2 * c[start:start + r + 1].numpy())
 
     # broadcast: grads accumulate at the root, zero elsewhere
     x3 = torch.ones(3, requires_grad=True)
