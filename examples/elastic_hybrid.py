@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Elastic training under HYBRID parallelism (tp > 1).
+
+The reference's elastic mode is data-parallel only; this framework
+extends it with defined semantics for model-parallel meshes
+(docs/elastic.md): the tp/sp/pp/ep factorization is declared once with
+`ElasticMeshSpec` and stays fixed — `dp` absorbs every resize, and a
+world that no longer fits fails fast with `MeshResizeError` instead of
+training a silently different layout. `GSPMDState` keeps committed
+state as host trees and re-places it on each incarnation's mesh with
+the same partition rules (reshard-on-restore).
+
+    HVD_EXAMPLE_CPU=8 python examples/elastic_hybrid.py
+"""
+from _common import maybe_cpu_mesh
+
+maybe_cpu_mesh()
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+import optax                                                # noqa: E402
+from jax.sharding import PartitionSpec as P                 # noqa: E402
+
+import horovod_tpu as hvd                                   # noqa: E402
+from horovod_tpu.elastic import (ElasticMeshSpec, GSPMDState,  # noqa: E402
+                                 MeshResizeError)
+from horovod_tpu.parallel.tp import PartitionRules          # noqa: E402
+from horovod_tpu.training import make_gspmd_train_step      # noqa: E402
+
+
+def main() -> None:
+    hvd.init()
+
+    # fixed model parallelism: tp=2; dp = devices / 2 on every
+    # incarnation (8 devices -> dp=4)
+    spec = ElasticMeshSpec(tp=2)
+    rules = PartitionRules([(r"w", P(None, "tp"))])
+    rs = np.random.RandomState(0)
+    state = GSPMDState(
+        spec, rules,
+        params={"w": (rs.randn(16, 32) * 0.1).astype(np.float32)},
+        step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        mesh = state.mesh                 # this incarnation's mesh
+        print(f"mesh dp={dict(mesh.shape).get('dp', 1)} "
+              f"tp={dict(mesh.shape)['tp']}", flush=True)
+        tx = optax.sgd(0.05)
+        step = make_gspmd_train_step(
+            lambda v, x: jnp.tanh(x @ v["params"]["w"]), tx, mesh, rules,
+            batch_spec=P("dp", None),
+            loss_fn=lambda y, t: ((y - t) ** 2).mean())
+        params = state.placed("params")   # reshard-on-restore
+        opt = tx.init(params)
+        while state.step < 6:
+            rng = np.random.RandomState(state.step)
+            x = jnp.asarray(rng.rand(8, 16).astype(np.float32))
+            y = jnp.asarray(rng.rand(8, 32).astype(np.float32))
+            params, opt, loss = step(params, opt, x, y)
+            state.step += 1
+            if state.step % 3 == 0:
+                state.update_from_device(params=params)
+                state.commit()
+                print(f"step {state.step} committed "
+                      f"loss={float(loss):.5f}", flush=True)
+        return params
+
+    train(state)
+
+    # the fail-fast contract: a world that does not fit the fixed
+    # factorization raises a clear MeshResizeError
+    try:
+        ElasticMeshSpec(tp=2).build(jax.devices()[:3])
+    except MeshResizeError as e:
+        print(f"misfit world rejected: {e}", flush=True)
+
+    print("elastic hybrid done", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

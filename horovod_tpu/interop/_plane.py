@@ -390,11 +390,19 @@ def allgather_ragged_np(arr: np.ndarray, process_set=None,
     counts = comm_allgather(
         comm, np.array([arr.shape[0]], np.int64)).ravel()
     rows = [int(c) for c in counts]
-    mx = max(rows)
-    pad = np.zeros((mx,) + arr.shape[1:], arr.dtype)
-    pad[:arr.shape[0]] = arr
-    out = comm_allgather(comm, pad)              # (n, mx, ...)
-    cat = np.concatenate([out[i, :rows[i]] for i in range(n)], axis=0)
+    mx, total = max(rows), sum(rows)
+    if mx * n > 2 * total:
+        # extreme skew (one rank holds most rows): pad-to-max would move
+        # and hold O(n*max) — the variable-chunk alltoall moves only the
+        # real rows (every destination gets this rank's full payload)
+        chunks = comm_alltoall(comm, [arr] * n)
+        cat = np.concatenate(chunks, axis=0)
+    else:
+        pad = np.zeros((mx,) + arr.shape[1:], arr.dtype)
+        pad[:arr.shape[0]] = arr
+        out = comm_allgather(comm, pad)          # (n, mx, ...)
+        cat = np.concatenate([out[i, :rows[i]] for i in range(n)],
+                             axis=0)
     return (cat, rows) if return_rows else cat
 
 

@@ -191,16 +191,19 @@ def allreduce(t, op: str = Average, name: Optional[str] = None,
     return allreduce_(out, op=op, name=name, process_set=process_set)
 
 
-def _allgather_impl(t, name=None, process_set=None):
+def _allgather_impl(t, name=None, process_set=None,
+                    return_rows: bool = False):
     import torch
     _, _, n, _ = _plane.resolve_set(process_set)
     if n == 1:
-        return t.clone()
+        return (t.clone(), [int(t.shape[0])]) if return_rows \
+            else t.clone()
     # ragged-capable: per-rank dim-0 sizes are negotiated, like the
     # reference controller's tensor_sizes (controller.cc:627)
-    gathered = _plane.allgather_ragged_np(_np_view(t),
-                                          process_set=process_set)
-    return torch.from_numpy(np.ascontiguousarray(gathered))
+    gathered, rows = _plane.allgather_ragged_np(
+        _np_view(t), process_set=process_set, return_rows=True)
+    out = torch.from_numpy(np.ascontiguousarray(gathered)).to(t.dtype)
+    return (out, rows) if return_rows else out
 
 
 def allgather(t, name: Optional[str] = None, process_set=None):
@@ -500,12 +503,10 @@ def _grad_fns():
         @staticmethod
         def forward(ctx, t, process_set):
             ctx.ps = process_set
-            out, rows = _ordered(lambda: _plane.allgather_ragged_np(
-                _np_view(t.detach()), process_set=process_set,
-                return_rows=True))
+            out, rows = _ordered(lambda: _allgather_impl(
+                t.detach(), process_set=process_set, return_rows=True))
             ctx.rows = rows               # negotiated per-rank counts
-            return torch.from_numpy(np.ascontiguousarray(out)) \
-                .to(t.dtype)
+            return out
 
         @staticmethod
         def backward(ctx, dy):

@@ -31,6 +31,7 @@ def _run(script, *args, timeout=240):
     ("gpt_hybrid_parallel.py", ["--steps", "1", "--seq-len", "64"],
      "loss="),
     ("elastic_train.py", [], "epoch 2 done"),
+    ("elastic_hybrid.py", [], "misfit world rejected"),
     ("adasum_example.py", [], "Adasum"),
     ("process_sets_example.py", [], "even-set sum"),
     ("data_service_example.py", [], "served batches"),

@@ -743,6 +743,14 @@ def _hybrid_worker(idx, port, gen, job):
     assert np.allclose(rs, 4.0 * np.arange(8)[2 * r:2 * r + 2]), rs
     objs = plane.allgather_object({"r": r})
     assert [o["r"] for o in objs] == [0, 1, 2, 3], objs
+    # extreme-skew ragged allgather (one rank holds everything): routes
+    # through the variable-chunk alltoall instead of pad-to-max
+    rows_n = 9 if r == 0 else 0
+    sk = plane.allgather_ragged_np(
+        np.full((rows_n, 2), float(r), np.float32))
+    assert sk.shape == (9, 2), sk.shape
+    assert np.allclose(sk, 0.0), sk
+
     # ragged alltoall over the two-level plane: intra-host pairs resolve
     # in shm, cross-host rows bundle through the local roots. rows
     # (src -> dst) = src + dst, so every pair size differs and (0,0)=0
