@@ -23,7 +23,7 @@ Usage (mirrors `import horovod.torch as hvd`):
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import numpy as np
 

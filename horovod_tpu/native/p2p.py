@@ -20,7 +20,7 @@ import socket
 import struct
 import threading
 import time as _time
-from typing import Optional
+
 
 import numpy as np
 
