@@ -33,7 +33,8 @@ print(float(jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128)))),
 }
 echo "{\"stage\": \"probe\", \"ok\": true, \"t\": \"$(stamp)\"}" >> "$OUT"
 
-for cfg in "resnet50 32" "resnet50 64" "resnet101 32"; do
+for cfg in "resnet50 32" "resnet50 64" "resnet101 32" "vgg16 32" \
+           "inception3 32"; do
   set -- $cfg
   echo "== $1 B=$2 $(date -u +%H:%M:%S) ==" >&2
   HVD_BENCH_MODEL=$1 HVD_BENCH_BATCH=$2 HVD_BENCH_REPEATS=3 \

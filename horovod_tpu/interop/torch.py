@@ -668,7 +668,8 @@ class _DistributedOptimizer:
                  backward_passes_per_step: int = 1,
                  gradient_predivide_factor: float = 1.0,
                  compression=Compression.none,
-                 process_set=None, use_grad_hooks: bool = True) -> None:
+                 process_set=None, use_grad_hooks: bool = True,
+                 groups=None) -> None:
         self._opt = optimizer
         self.op = op
         self.backward_passes_per_step = int(backward_passes_per_step)
@@ -682,8 +683,40 @@ class _DistributedOptimizer:
         else:
             self._params = [p for g in optimizer.param_groups
                             for p in g["params"]]
+        # `groups` (reference torch/optimizer.py:40): explicit gradient
+        # fusion — an int splits the parameter list into that many
+        # contiguous fusion groups, a list of parameter lists fuses each
+        # given set; each group allreduces as ONE flat buffer once every
+        # member's gradient is ready
+        self._groups = None
+        self._group_of = {}
+        if groups is not None:
+            if isinstance(groups, int):
+                if groups <= 0:
+                    raise ValueError("groups must be a positive int or "
+                                     "a list of parameter lists")
+                n = max(1, min(groups, len(self._params)))
+                k, m = divmod(len(self._params), n)
+                self._groups, off = [], 0
+                for i in range(n):
+                    step_ = k + (1 if i < m else 0)
+                    self._groups.append(self._params[off:off + step_])
+                    off += step_
+            else:
+                known = {id(p) for p in self._params}
+                self._groups = [list(g) for g in groups]
+                for g in self._groups:
+                    for p in g:
+                        if id(p) not in known:
+                            raise ValueError(
+                                "groups contains a parameter not in "
+                                "this optimizer")
+            for gi, g in enumerate(self._groups):
+                for p in g:
+                    self._group_of[id(p)] = gi
+        self._group_ready = {}  # group idx -> params with ready grads
         self._hook_handles = []
-        self._inflight = {}     # id(param) -> (param, comp, ctx, handle)
+        self._inflight = {}     # id(param) | ('g', gi) -> inflight item
         self._hook_passes = {}  # id(param) -> micro-passes since sync
         if use_grad_hooks:
             try:
@@ -718,6 +751,30 @@ class _DistributedOptimizer:
                              process_set=self.process_set)
         self._inflight[id(p)] = (p, comp, ctx, h)
 
+    def _submit_group(self, gi: int, params) -> None:
+        import torch
+        if ("g", gi) in self._inflight:
+            raise RuntimeError(
+                "gradient group reduced twice before step(): call "
+                "step()/synchronize() between backwards or raise "
+                "backward_passes_per_step")
+        if len({p.grad.dtype for p in params}) > 1:
+            # mixed dtypes cannot share a flat buffer — per-tensor
+            # rounds (the reference splits fusion buffers by dtype)
+            for p in params:
+                self._submit_grad(p)
+            return
+        if self.gradient_predivide_factor != 1.0:
+            for p in params:
+                p.grad /= self.gradient_predivide_factor
+        sizes = [p.grad.numel() for p in params]
+        flat = torch.cat([p.grad.reshape(-1) for p in params])
+        comp, ctx = self.compression.compress(flat)
+        comp = comp.contiguous()       # BEFORE the store: the in-place
+        h = allreduce_async_(comp, op=self.op,   # reduce must hit the
+                             process_set=self.process_set)  # kept tensor
+        self._inflight[("g", gi)] = (list(params), sizes, comp, ctx, h)
+
     def _grad_hook(self, p) -> None:
         if _plane.size() == 1 or p.grad is None:
             return
@@ -725,10 +782,50 @@ class _DistributedOptimizer:
         self._hook_passes[id(p)] = cnt
         if cnt < self.backward_passes_per_step:
             return                     # keep accumulating locally
-        self._submit_grad(p)
+        if self._groups is None:
+            self._submit_grad(p)
+            return
+        gi = self._group_of.get(id(p))
+        if gi is None:
+            # params not named in an explicit groups= list reduce
+            # per-parameter (the reference's unlisted-param behavior)
+            self._submit_grad(p)
+            return
+        ready = self._group_ready.setdefault(gi, {})
+        if id(p) in ready:
+            # a second backward readied this member again while another
+            # member never produced a gradient — the same loud error the
+            # per-param path raises, instead of silently skipping a peer
+            raise RuntimeError(
+                "gradient reduced twice before step(): call step()/"
+                "synchronize() between backwards or raise "
+                "backward_passes_per_step")
+        ready[id(p)] = p
+        members = [q for q in self._groups[gi] if q.requires_grad]
+        if len(ready) == len(members):  # whole group ready: ONE round
+            self._group_ready[gi] = {}
+            # submit in group-definition order: the flat-buffer layout
+            # must agree across ranks regardless of hook firing order
+            self._submit_group(gi, [ready[id(q)] for q in members])
 
     def _finish_inflight(self) -> None:
-        for p, comp, ctx, h in self._inflight.values():
+        for key, item in self._inflight.items():
+            if isinstance(key, tuple):              # fused group
+                params, sizes, comp, ctx, h = item
+                synchronize(h)
+                flat = self.compression.decompress(comp, ctx)
+                if self.gradient_predivide_factor != 1.0:
+                    flat = flat * self.gradient_predivide_factor
+                off = 0
+                for p, n in zip(params, sizes):
+                    # sizes recorded at submit: a grad cleared between
+                    # backward and step still occupies its buffer slice
+                    if p.grad is not None:
+                        p.grad.copy_(
+                            flat[off:off + n].view_as(p.grad))
+                    off += n
+                continue
+            p, comp, ctx, h = item
             synchronize(h)             # module-level handle wait
             if p.grad is None:
                 continue   # grad cleared between backward and step:
@@ -739,6 +836,7 @@ class _DistributedOptimizer:
                 p.grad *= self.gradient_predivide_factor
         self._inflight.clear()
         self._hook_passes.clear()
+        self._group_ready.clear()
 
     def synchronize(self) -> None:
         if self._hook_handles:
@@ -746,9 +844,18 @@ class _DistributedOptimizer:
                 # backfill: grads set without a backward (manual .grad
                 # assignment) never fire the hooks — the reference's
                 # synchronize() submits handles for any param missing
-                # one (torch/optimizer.py:255-302)
+                # one (torch/optimizer.py:255-302). Members of fused
+                # group submissions count as covered; a PARTIALLY-ready
+                # group (some member never got a grad) backfills its
+                # ready members per-parameter.
+                covered = set()
+                for key, item in self._inflight.items():
+                    if isinstance(key, tuple):
+                        covered |= {id(q) for q in item[0]}
+                    else:
+                        covered.add(key)
                 for p in self._params:
-                    if p.grad is not None and id(p) not in self._inflight:
+                    if p.grad is not None and id(p) not in covered:
                         self._submit_grad(p)
             self._finish_inflight()
             self._pass_count = 0
@@ -790,16 +897,19 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          backward_passes_per_step: int = 1,
                          gradient_predivide_factor: float = 1.0,
                          compression=Compression.none,
-                         process_set=None, use_grad_hooks: bool = True
-                         ) -> _DistributedOptimizer:
+                         process_set=None, use_grad_hooks: bool = True,
+                         groups=None) -> _DistributedOptimizer:
     """Factory mirroring hvd.DistributedOptimizer (torch/optimizer.py:516).
     Gradient allreduces start asynchronously from per-parameter hooks
     DURING backward (the reference's overlap design); pass
-    use_grad_hooks=False for strictly synchronous step-time reduction."""
+    use_grad_hooks=False for strictly synchronous step-time reduction.
+    `groups` (int or list of parameter lists, torch/optimizer.py:40)
+    fuses each group's gradients into one flat allreduce round once
+    every member is ready."""
     return _DistributedOptimizer(
         optimizer, named_parameters, op, backward_passes_per_step,
         gradient_predivide_factor, compression, process_set,
-        use_grad_hooks)
+        use_grad_hooks, groups)
 
 
 # -- elastic state (torch/elastic/state.py TorchState) ----------------------

@@ -182,6 +182,32 @@ def _torch_worker():
     for i in range(n):
         np.testing.assert_allclose(ws[i].numpy(), ws[0].numpy(), rtol=1e-6)
 
+    # groups= fusion (reference torch/optimizer.py:40): rank-dependent
+    # grads fused into flat rounds must average EXACTLY like per-param.
+    # Fresh identically-seeded model per optimizer: hooks registered by
+    # a previous wrapper on the SAME params would also fire.
+    def grads_with(groups):
+        torch.manual_seed(7)               # same init on every rank
+        m2 = torch.nn.Sequential(torch.nn.Linear(3, 5),
+                                 torch.nn.Linear(5, 2))
+        if groups == "explicit":
+            groups = [list(m2[0].parameters()), list(m2[1].parameters())]
+        elif groups == "partial":
+            # unlisted params must reduce per-parameter, not KeyError
+            groups = [list(m2[0].parameters())]
+        o = hvd.DistributedOptimizer(
+            torch.optim.SGD(m2.parameters(), lr=0.0),  # grads only
+            named_parameters=m2.named_parameters(), groups=groups)
+        o.zero_grad()
+        (float(r + 1) * m2(torch.ones(4, 3)).sum()).backward()
+        o.step()
+        return [p.grad.detach().clone() for p in m2.parameters()]
+
+    g_ref = grads_with(None)               # per-param path
+    for mode in (2, "explicit", "partial"):
+        for a, b in zip(grads_with(mode), g_ref):
+            torch.testing.assert_close(a, b)
+
     # set_backward_passes_per_step: live re-config — first micro-step
     # accumulates (weights untouched), second reduces + applies
     opt.set_backward_passes_per_step(2)
