@@ -379,16 +379,22 @@ def _dist_class(cls, op: str = Average,
                 compression=Compression.none,
                 backward_passes_per_step: int = 1,
                 average_aggregated_gradients: bool = False,
-                sparse_as_dense: bool = False):
+                sparse_as_dense: bool = False,
+                groups=None, process_set=None):
     # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
     # via load_model's custom-object mapping; re-wrapping an already
     # distributed class is an identity (idempotent, no recursive apply)
     if getattr(cls, "_hvd_distributed", False):
         return cls
+    # explicit variable-list groups and process sets are unhashable /
+    # instance-specific: build an UNCACHED class for them (an id()-keyed
+    # cache would pin the variable lists — whole models — forever)
+    cacheable = isinstance(groups, (int, type(None))) \
+        and process_set is None
     key = (cls, op, gradient_predivide_factor, compression,
            backward_passes_per_step, average_aggregated_gradients,
-           sparse_as_dense)
-    if key in _DIST_CLASS_CACHE:
+           sparse_as_dense, groups if cacheable else None)
+    if cacheable and key in _DIST_CLASS_CACHE:
         return _DIST_CLASS_CACHE[key]
     dist_cls = type("Distributed" + cls.__name__, (cls,),
                     {"_hvd_distributed": True})
@@ -464,8 +470,9 @@ def _dist_class(cls, op: str = Average,
         # Graph mode densifies either way (py_function staging
         # constraint — run_eagerly=True gets the sparse path), as does
         # sparse_as_dense=True.
+        _, _, set_size, _ = _plane.resolve_set(process_set)
         sparse_reduced = {}
-        if _plane.size() > 1 and not sparse_as_dense \
+        if set_size > 1 and not sparse_as_dense \
                 and tf.executing_eagerly():
             sp_idx = [i for i, g in enumerate(grads)
                       if isinstance(g, tf.IndexedSlices)
@@ -474,28 +481,94 @@ def _dist_class(cls, op: str = Average,
                 reduced_sp = reduce_indexed_slices(
                     [grads[i] for i in sp_idx], op=op,
                     compression=compression,
-                    gradient_predivide_factor=gradient_predivide_factor)
+                    gradient_predivide_factor=gradient_predivide_factor,
+                    process_set=process_set)
                 for i, sp in zip(sp_idx, reduced_sp):
                     sparse_reduced[i] = sp
                     is_local[i] = True   # skip the dense wire path
 
+        def _reduce_one(arr):
+            if gradient_predivide_factor != 1.0:
+                arr = arr / gradient_predivide_factor
+            comp, cctx = compression.compress(arr)
+            red = compression.decompress(
+                _plane.allreduce_np(np.ascontiguousarray(comp),
+                                    process_set=process_set), cctx)
+            if op == Average:
+                red = red / set_size
+            if gradient_predivide_factor != 1.0:
+                red = red * gradient_predivide_factor
+            return red.astype(arr.dtype)
+
+        # explicit variable-list groups -> send-list index groups
+        # (unlisted variables reduce per-tensor, reference semantics)
+        explicit_send_groups = None
+        if isinstance(groups, (list, tuple)):
+            if match_vars is None:
+                raise ValueError(
+                    "groups= with explicit variable lists needs the "
+                    "optimizer's variables (apply(grads, variables))")
+            send_pos, pos = {}, 0
+            for v, loc in zip(match_vars, is_local):
+                if not loc:
+                    send_pos[_var_key(v)] = pos
+                    pos += 1
+            explicit_send_groups, seen = [], set()
+            for gl in groups:
+                # a variable named in several groups (shared embeddings)
+                # fuses with its FIRST group only — never reduced twice
+                g_idx = [send_pos[_var_key(v)] for v in gl
+                         if _var_key(v) in send_pos
+                         and send_pos[_var_key(v)] not in seen]
+                if g_idx:
+                    explicit_send_groups.append(g_idx)
+                    seen |= set(g_idx)
+            explicit_send_groups.extend(
+                [i] for i in range(pos) if i not in seen)
+
+        def _fusion_buckets(arrs):
+            """Partition send-list indexes into fusion buckets
+            (reference `groups`, tensorflow/__init__.py:127-131): int =
+            that many contiguous groups; explicit variable lists map to
+            the given sets. Same-dtype only — mixed dtypes subdivide
+            (the reference's per-dtype fusion buffers)."""
+            if explicit_send_groups is not None:
+                idx_groups = explicit_send_groups
+            elif isinstance(groups, int) and groups > 0:
+                n_b = max(1, min(groups, len(arrs)))
+                k_, m_ = divmod(len(arrs), n_b)
+                idx_groups, off = [], 0
+                for i in range(n_b):
+                    stp = k_ + (1 if i < m_ else 0)
+                    idx_groups.append(list(range(off, off + stp)))
+                    off += stp
+            else:
+                idx_groups = [[i] for i in range(len(arrs))]
+            out = []
+            for g_ in idx_groups:
+                by_dtype = {}
+                for i in g_:
+                    by_dtype.setdefault(arrs[i].dtype, []).append(i)
+                out.extend(by_dtype.values())
+            return out
+
         def _reduce_py(*flat_grads):
-            outs = []
-            for g in flat_grads:
-                arr = np.ascontiguousarray(g.numpy())
-                if gradient_predivide_factor != 1.0:
-                    arr = arr / gradient_predivide_factor
-                comp, cctx = compression.compress(arr)
-                red = compression.decompress(
-                    _plane.allreduce_np(np.ascontiguousarray(comp)), cctx)
-                if op == Average:
-                    red = red / _plane.size()
-                if gradient_predivide_factor != 1.0:
-                    red = red * gradient_predivide_factor
-                outs.append(red.astype(arr.dtype))
+            arrs = [np.ascontiguousarray(g.numpy()) for g in flat_grads]
+            outs = [None] * len(arrs)
+            for bucket in _fusion_buckets(arrs):
+                if len(bucket) == 1:
+                    outs[bucket[0]] = _reduce_one(arrs[bucket[0]])
+                    continue
+                flat = np.concatenate([arrs[i].ravel() for i in bucket])
+                red = _reduce_one(flat)
+                off = 0
+                for i in bucket:
+                    n_ = arrs[i].size
+                    outs[i] = red[off:off + n_].reshape(arrs[i].shape)
+                    off += n_
             return outs
 
-        if _plane.size() > 1:
+        if set_size > 1:
             # sparse-reduced slots keep their ORIGINAL IndexedSlices here
             # (they're overwritten below) — densifying them would
             # materialize the full embedding-size tensor for nothing
@@ -538,7 +611,8 @@ def _dist_class(cls, op: str = Average,
     dist_cls.apply = apply
     dist_cls.register_local_var = register_local_var
     dist_cls.reset_aggregation = reset_aggregation
-    _DIST_CLASS_CACHE[key] = dist_cls
+    if cacheable:
+        _DIST_CLASS_CACHE[key] = dist_cls
     return dist_cls
 
 
@@ -548,7 +622,9 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          average_aggregated_gradients: bool = False,
-                         sparse_as_dense: bool = False):
+                         sparse_as_dense: bool = False,
+                         num_groups: int = 0, groups=None,
+                         process_set=None):
     """Wrap a keras optimizer so `apply` allreduce-averages gradients
     across ranks first (reference: horovod/_keras/__init__.py
     create_distributed_optimizer — the same dynamic-subclass technique, so
@@ -556,14 +632,24 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     accepted for reference-signature parity and ignored (there it names
     the op scope). `compression` compresses the staged gradient bytes
     (Compression.fp16 halves them; the package-level jax
-    hvd.Compression.* objects are accepted and mapped by role)."""
+    hvd.Compression.* objects are accepted and mapped by role).
+    `groups` (int or explicit variable lists — `num_groups` is the
+    reference's deprecated alias, tensorflow/keras/__init__.py:127)
+    fuses each group's gradients into one flat plane round;
+    `process_set` scopes the reduction to a subgroup."""
+    if num_groups:
+        import warnings
+        warnings.warn("Parameter `num_groups` has been replaced by "
+                      "`groups` and will be removed", DeprecationWarning)
+        if groups is None:
+            groups = int(num_groups)
     compression = _plane.resolve_compression(
         compression, Compression.none, Compression.fp16)
     dist_cls = _dist_class(optimizer.__class__, op,
                            gradient_predivide_factor, compression,
                            int(backward_passes_per_step),
                            bool(average_aggregated_gradients),
-                           bool(sparse_as_dense))
+                           bool(sparse_as_dense), groups, process_set)
     return dist_cls.from_config(optimizer.get_config())
 
 

@@ -383,3 +383,69 @@ def test_keras_multiprocess_store_plane():
         assert results == [2.0, 2.0]
     finally:
         server.close()
+
+
+def _keras_groups_worker():
+    """groups=/num_groups/process_set on the keras DistributedOptimizer
+    (reference tensorflow/keras/__init__.py:68,127): fused rounds must
+    reduce EXACTLY like per-tensor, and a process_set scopes the
+    reduction to its members."""
+    import warnings
+    import numpy as np
+    import keras
+    import tensorflow as tf
+    import horovod_tpu.interop.keras as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+    keras.utils.set_random_seed(0)                  # same init everywhere
+    model = keras.Sequential([keras.layers.Input((3,)),
+                              keras.layers.Dense(5),
+                              keras.layers.Dense(2)])
+    tvars = model.trainable_variables
+
+    def reduced_with(**kw):
+        opt = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0), **kw)
+        w0 = [v.numpy().copy() for v in tvars]
+        grads = [tf.constant(np.full(v.shape, float(r + 1), np.float32))
+                 for v in tvars]
+        opt.apply(grads, tvars)
+        out = [w - v.numpy() for w, v in zip(w0, tvars)]  # lr=1 delta
+        for v, w in zip(tvars, w0):
+            v.assign(w)                                   # restore
+        return out
+
+    base = reduced_with()
+    for a in base:                                  # mean(1, 2) = 1.5
+        np.testing.assert_allclose(a, 1.5, rtol=1e-6)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        for kw in ({"groups": 2},
+                   {"groups": [tvars[:2], tvars[2:]]},
+                   {"groups": [tvars[:1]]},         # unlisted: per-tensor
+                   {"groups": [tvars[:2], tvars[1:]]},  # shared var:
+                   # fuses with its first group only, never twice
+                   {"num_groups": 2}):
+            for a, b in zip(reduced_with(**kw), base):
+                np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    # process_set-scoped optimizer: singleton sets -> local grads only
+    ps0, ps1 = hvd.add_process_set([0]), hvd.add_process_set([1])
+    got = reduced_with(process_set=(ps0 if r == 0 else ps1))
+    for a in got:
+        np.testing.assert_allclose(a, float(r + 1), rtol=1e-6)
+    hvd.remove_process_set(ps0)
+    hvd.remove_process_set(ps1)
+    hvd.shutdown()
+    return 1.0
+
+
+def test_keras_optimizer_groups_multiprocess():
+    import uuid
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    results = run(_keras_groups_worker, num_proc=2,
+                  job_runner=MultiprocessingJobRunner(),
+                  env={"HOROVOD_SHM_GEN": str(uuid.uuid4().int % (1 << 62)),
+                       "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+    assert results == [1.0, 1.0]
