@@ -335,6 +335,15 @@ class Compression:
             return arr if ctx is None else arr.astype(ctx)
 
 
+def scale_local_gradient(g, sz: int):
+    """``g / sz`` preserving IndexedSlices — the pull/3695 local-grad
+    scaling, shared by the tf tape and the keras optimizer."""
+    import tensorflow as tf
+    if isinstance(g, tf.IndexedSlices):
+        return tf.IndexedSlices(g.values / sz, g.indices, g.dense_shape)
+    return g / sz
+
+
 def reduce_indexed_slices(slices_list, op: str = Average,
                           compression=Compression.none, process_set=None,
                           gradient_predivide_factor: float = 1.0):
@@ -380,7 +389,8 @@ def _dist_class(cls, op: str = Average,
                 backward_passes_per_step: int = 1,
                 average_aggregated_gradients: bool = False,
                 sparse_as_dense: bool = False,
-                groups=None, process_set=None):
+                groups=None, process_set=None,
+                scale_local_gradients: bool = True):
     # class name is ALWAYS "Distributed<Cls>" so saved models stay loadable
     # via load_model's custom-object mapping; re-wrapping an already
     # distributed class is an identity (idempotent, no recursive apply)
@@ -393,7 +403,8 @@ def _dist_class(cls, op: str = Average,
         and process_set is None
     key = (cls, op, gradient_predivide_factor, compression,
            backward_passes_per_step, average_aggregated_gradients,
-           sparse_as_dense, groups if cacheable else None)
+           sparse_as_dense, groups if cacheable else None,
+           scale_local_gradients)
     if cacheable and key in _DIST_CLASS_CACHE:
         return _DIST_CLASS_CACHE[key]
     dist_cls = type("Distributed" + cls.__name__, (cls,),
@@ -470,7 +481,13 @@ def _dist_class(cls, op: str = Average,
         # Graph mode densifies either way (py_function staging
         # constraint — run_eagerly=True gets the sparse path), as does
         # sparse_as_dense=True.
-        _, _, set_size, _ = _plane.resolve_set(process_set)
+        # set SIZE only (no membership resolve): a non-member rank whose
+        # gradients are all local issues no collective and must not trip
+        # the membership check — the lazy contract the tf tape keeps.
+        # The *_np calls resolve (and enforce membership) themselves.
+        set_size = process_set.size() if process_set is not None \
+            else _plane.size()
+        true_local = list(is_local)    # before the sparse-path marking
         sparse_reduced = {}
         if set_size > 1 and not sparse_as_dense \
                 and tf.executing_eagerly():
@@ -594,6 +611,14 @@ def _dist_class(cls, op: str = Average,
             # re-insert the sparse-reduced gradients AS IndexedSlices
             for i, sp in sparse_reduced.items():
                 grads[i] = sp
+            # scale_local_gradients (reference :734, pull/3695): local
+            # vars' gradients divide by the set size so their effective
+            # magnitude matches the AVERAGED global gradients
+            if scale_local_gradients and local_refs:
+                for i, loc in enumerate(true_local):
+                    if loc and grads[i] is not None:
+                        grads[i] = scale_local_gradient(grads[i],
+                                                        set_size)
         # bind the created class explicitly: super(self.__class__, ...)
         # would recurse if dist_cls is ever subclassed again
         return super(dist_cls, self).apply(
@@ -624,7 +649,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          average_aggregated_gradients: bool = False,
                          sparse_as_dense: bool = False,
                          num_groups: int = 0, groups=None,
-                         process_set=None):
+                         process_set=None,
+                         scale_local_gradients: bool = True):
     """Wrap a keras optimizer so `apply` allreduce-averages gradients
     across ranks first (reference: horovod/_keras/__init__.py
     create_distributed_optimizer — the same dynamic-subclass technique, so
@@ -649,7 +675,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                            gradient_predivide_factor, compression,
                            int(backward_passes_per_step),
                            bool(average_aggregated_gradients),
-                           bool(sparse_as_dense), groups, process_set)
+                           bool(sparse_as_dense), groups, process_set,
+                           bool(scale_local_gradients))
     return dist_cls.from_config(optimizer.get_config())
 
 

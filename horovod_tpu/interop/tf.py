@@ -100,7 +100,8 @@ class _DistributedGradientTape:
     def __init__(self, tape, op: str = Average,
                  gradient_predivide_factor: float = 1.0,
                  sparse_as_dense: bool = False,
-                 process_set=None) -> None:
+                 process_set=None,
+                 scale_local_gradients: bool = True) -> None:
         if gradient_predivide_factor != 1.0 and op != Average:
             raise ValueError("gradient_predivide_factor requires "
                              "op=Average")
@@ -109,6 +110,10 @@ class _DistributedGradientTape:
         self._predivide = float(gradient_predivide_factor)
         self._sparse_as_dense = sparse_as_dense
         self._process_set = process_set
+        #: reference default (tensorflow/__init__.py:1113, pull/3695):
+        #: local-source gradients are divided by the set size so their
+        #: effective magnitude matches the AVERAGED global gradients
+        self._scale_local = bool(scale_local_gradients)
         self._local_ids = set()
 
     def __getattr__(self, item):
@@ -177,6 +182,17 @@ class _DistributedGradientTape:
             # ascontiguousarray promotes 0-d to (1,): restore the shape
             red = red.astype(arr.dtype).reshape(tuple(g.shape))
             out.append(tf.constant(red, dtype=g.dtype))
+        # scale_local_gradients (reference :734, pull/3695): local
+        # sources divide by the SET size — ps.size(), no membership
+        # resolve, so a non-member all-local tape stays lazy
+        if self._scale_local and self._local_ids:
+            from .keras import scale_local_gradient
+            sz = self._process_set.size() \
+                if self._process_set is not None else _plane.size()
+            if sz > 1:
+                for i, s in enumerate(flat_sources):
+                    if id(s) in self._local_ids and out[i] is not None:
+                        out[i] = scale_local_gradient(out[i], sz)
         return tf.nest.pack_sequence_as(grads, out)
 
 
@@ -184,6 +200,7 @@ def DistributedGradientTape(gradtape, op: str = Average,
                             gradient_predivide_factor: float = 1.0,
                             sparse_as_dense: bool = False,
                             process_set=None,
+                            scale_local_gradients: bool = True,
                             **_ignored) -> _DistributedGradientTape:
     """Factory mirroring hvd.DistributedGradientTape
     (tensorflow/__init__.py:1110); device/compression kwargs accepted
@@ -191,7 +208,8 @@ def DistributedGradientTape(gradtape, op: str = Average,
     return _DistributedGradientTape(
         gradtape, op=op,
         gradient_predivide_factor=gradient_predivide_factor,
-        sparse_as_dense=sparse_as_dense, process_set=process_set)
+        sparse_as_dense=sparse_as_dense, process_set=process_set,
+        scale_local_gradients=scale_local_gradients)
 
 
 def PartialDistributedGradientTape(gradtape, local_layers=None, **kwargs):

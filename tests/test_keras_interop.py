@@ -167,9 +167,22 @@ def _keras_local_var_worker():
     grads = [tf.constant(np.full((4, 2), float(r + 1), np.float32)),
              tf.constant(np.full((2,), float(r + 1), np.float32))]
     opt.apply(grads, model.trainable_variables)
-    # kernel: averaged grad (1+2)/2 -> -1.5; bias: own grad -> -(r+1)
+    # kernel: averaged grad (1+2)/2 -> -1.5; bias: own grad scaled by
+    # 1/size (reference scale_local_gradients=True default, pull/3695)
     np.testing.assert_allclose(kernel.numpy(), np.full((4, 2), -1.5),
                                rtol=1e-6)
+    np.testing.assert_allclose(bias.numpy(),
+                               np.full((2,), -(r + 1.0) / n), rtol=1e-6)
+
+    # scale_local_gradients=False keeps the raw local gradient
+    opt2 = hvd.DistributedOptimizer(keras.optimizers.SGD(1.0),
+                                    scale_local_gradients=False)
+    opt2.register_local_var(bias)
+    kernel.assign(np.zeros((4, 2), np.float32))
+    bias.assign(np.zeros((2,), np.float32))
+    grads = [tf.constant(np.full((4, 2), float(r + 1), np.float32)),
+             tf.constant(np.full((2,), float(r + 1), np.float32))]
+    opt2.apply(grads, model.trainable_variables)
     np.testing.assert_allclose(bias.numpy(), np.full((2,), -(r + 1.0)),
                                rtol=1e-6)
     hvd.shutdown()

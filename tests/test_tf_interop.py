@@ -66,7 +66,8 @@ def _tf_worker():
     g, = dtape.gradient(loss, [v])
     np.testing.assert_allclose(g.numpy(), [1.5, 1.5, 1.5])  # mean(1,2)
 
-    # local source: gradient stays rank-local
+    # local source: gradient stays rank-local, divided by size (the
+    # reference's scale_local_gradients=True default, pull/3695)
     w = tf.Variable([1.0])
     u = tf.Variable([1.0])
     with tf.GradientTape() as tape2:
@@ -75,7 +76,15 @@ def _tf_worker():
     dtape2.register_local_source(u)
     gw, gu = dtape2.gradient(loss2, [w, u])
     np.testing.assert_allclose(gw.numpy(), [1.5])
-    np.testing.assert_allclose(gu.numpy(), [float(r + 1)])
+    np.testing.assert_allclose(gu.numpy(), [float(r + 1) / n])
+    # scale_local_gradients=False keeps the raw local gradient
+    with tf.GradientTape() as tape2b:
+        loss2b = float(r + 1) * tf.reduce_sum(u)
+    dtape2b = hvd.DistributedGradientTape(tape2b,
+                                          scale_local_gradients=False)
+    dtape2b.register_local_source(u)
+    gu2, = dtape2b.gradient(loss2b, [u])
+    np.testing.assert_allclose(gu2.numpy(), [float(r + 1)])
 
     # broadcast_variables: rank 1 sees rank 0's values; 0-d var keeps ()
     bv = tf.Variable(np.full(3, float(10 + r), np.float32))
@@ -114,8 +123,8 @@ def _tf_worker():
                                 + tf.reduce_sum(shared))
     ptape = hvd.PartialDistributedGradientTape(tp, local_layers=layer)
     gs_p = ptape.gradient(lossp, [layer.kernel, shared])
-    np.testing.assert_allclose(gs_p[0].numpy(),
-                               np.full((2, 1), float(r + 1)))  # local
+    np.testing.assert_allclose(gs_p[0].numpy(),                # local,
+                               np.full((2, 1), float(r + 1) / n))  # /n
     np.testing.assert_allclose(gs_p[1].numpy(), [1.5])          # averaged
 
     # tape scoped to a process set: use per-rank SINGLETON sets (both
