@@ -60,6 +60,14 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
             from ..native.shm import ShmComm
             gen = int(os.environ.get("HOROVOD_SHM_GEN", "1"))
             _comm = ShmComm(name, _rank, _size, gen=gen)
+    if _size > 1 and _comm is not None:
+        # Device data plane (reference NCCL-role split,
+        # nccl_operations.cc:185): large tensors reduce on the
+        # accelerators over jax.distributed collectives; the host comm
+        # keeps small/control traffic. Collective join — all ranks enter
+        # together or the plane stays off (_device_plane.maybe_init).
+        from . import _device_plane
+        _device_plane.maybe_init(_rank, _size)
 
 
 _timeline = None
@@ -102,30 +110,54 @@ def traced(kind: str, fn):
 
 
 # one traced call site per collective kind, shared by the *_np wrappers
-# below AND the torch binding's direct-comm fast path
+# below AND the torch binding's direct-comm fast path. Each dispatches
+# between the device plane (large tensors on the global set ->
+# accelerator collectives, interop/_device_plane.py) and the host comm
+# (everything else) — the reference's NCCL-data/Gloo-control split.
+# Routing keys only on rank-invariant facts, so the per-rank call-order
+# contract keeps both planes' rendezvous ops paired.
+
+def _dev_eligible(kind: str, comm, arr: np.ndarray,
+                  op: Optional[str] = None) -> bool:
+    from . import _device_plane
+    return _device_plane.eligible(kind, arr, op=op,
+                                  is_global_comm=comm is _comm)
+
 
 def comm_allreduce(comm, arr: np.ndarray, op: str = "sum") -> np.ndarray:
-    return traced("allreduce",
-                  lambda: comm.allreduce(np.ascontiguousarray(arr),
-                                         op=op))
+    arr = np.ascontiguousarray(arr)
+    if _dev_eligible("allreduce", comm, arr, op):
+        from . import _device_plane
+        return traced("allreduce", lambda: _device_plane.allreduce(arr, op))
+    return traced("allreduce", lambda: comm.allreduce(arr, op=op))
 
 
 def comm_allgather(comm, arr: np.ndarray) -> np.ndarray:
-    return traced("allgather",
-                  lambda: comm.allgather(np.ascontiguousarray(arr)))
+    arr = np.ascontiguousarray(arr)
+    if _dev_eligible("allgather", comm, arr):
+        from . import _device_plane
+        return traced("allgather", lambda: _device_plane.allgather(arr))
+    return traced("allgather", lambda: comm.allgather(arr))
 
 
 def comm_broadcast(comm, arr: np.ndarray, root: int) -> np.ndarray:
-    return traced("broadcast",
-                  lambda: comm.broadcast(np.ascontiguousarray(arr),
-                                         root=root))
+    arr = np.ascontiguousarray(arr)
+    if _dev_eligible("broadcast", comm, arr):
+        from . import _device_plane
+        return traced("broadcast",
+                      lambda: _device_plane.broadcast(arr, root))
+    return traced("broadcast", lambda: comm.broadcast(arr, root=root))
 
 
 def comm_reducescatter(comm, arr: np.ndarray,
                        op: str = "sum") -> np.ndarray:
+    arr = np.ascontiguousarray(arr)
+    if _dev_eligible("reducescatter", comm, arr, op):
+        from . import _device_plane
+        return traced("reducescatter",
+                      lambda: _device_plane.reducescatter(arr, op))
     return traced("reducescatter",
-                  lambda: comm.reducescatter(np.ascontiguousarray(arr),
-                                             op=op))
+                  lambda: comm.reducescatter(arr, op=op))
 
 
 def comm_alltoall(comm, chunks) -> list:
@@ -135,6 +167,8 @@ def comm_alltoall(comm, chunks) -> list:
 def shutdown() -> None:
     global _comm, _inited, _timeline
     _inited = False
+    from . import _device_plane
+    _device_plane.shutdown()
     if _timeline is not None:
         _timeline.stop()
         _timeline = None
@@ -309,6 +343,14 @@ def stop_timeline() -> None:
     if _timeline is not None:
         _timeline.stop()
         _timeline = None
+
+
+def device_plane_active() -> bool:
+    """True when large-tensor collectives route through the accelerator
+    data plane (interop/_device_plane.py — the NCCL-role split; the
+    reference's analog query is hvd.nccl_built())."""
+    from . import _device_plane
+    return _device_plane.is_active()
 
 
 def is_initialized() -> bool:

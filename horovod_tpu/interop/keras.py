@@ -55,6 +55,7 @@ def init(comm_name: Optional[str] = None) -> None:
     _plane.init(comm_name, default_job="local")
 
 
+device_plane_active = _plane.device_plane_active
 shutdown = _plane.shutdown
 rank = _plane.rank
 size = _plane.size

@@ -61,6 +61,7 @@ def shutdown() -> None:
     _plane.shutdown()
 
 
+device_plane_active = _plane.device_plane_active
 rank = _plane.rank
 size = _plane.size
 local_rank = _plane.local_rank

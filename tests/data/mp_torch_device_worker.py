@@ -1,0 +1,128 @@
+"""Torch-binding device-plane worker (launched by test_multiprocess.py).
+
+Eight ranks, one virtual CPU device each, form an 8-device jax mesh:
+large torch collectives route through the DEVICE plane (jax.distributed
++ shard_map collectives — the role NCCL plays for the reference's torch
+binding, nccl_operations.cc:185) and must agree EXACTLY with the host
+shm/store plane on the same inputs; small tensors stay on the host
+plane. Values are small integers in float32, so every summation order is
+exact and "exact-equal" is meaningful.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.interop.torch as hvd  # noqa: E402
+from horovod_tpu.interop import _device_plane as dp  # noqa: E402
+from horovod_tpu.interop import _plane  # noqa: E402
+
+
+def main(out_dir: str) -> None:
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    result = {"pid": r}
+    assert n == 8, n
+    assert dp.is_active(), "device plane must be up (HOROVOD_DEVICE_PLANE=1)"
+    assert dp.threshold() == 1024, dp.threshold()
+
+    # --- allreduce: device plane vs raw host comm, exact-equal -----------
+    arr = np.full((4096,), float(r + 1), np.float32)       # 16 KB >= 1 KB
+    before = dp.stats["allreduce"]
+    dev = _plane.comm_allreduce(_plane.comm(), arr.copy(), op="sum")
+    assert dp.stats["allreduce"] == before + 1, "big tensor must route device"
+    host = _plane.comm().allreduce(np.ascontiguousarray(arr.copy()),
+                                   op="sum")
+    assert np.array_equal(np.asarray(dev), np.asarray(host)), \
+        "device plane result != host plane result"
+    assert float(np.asarray(dev)[0]) == sum(range(1, n + 1))
+    result["allreduce_exact_equal"] = True
+
+    # --- threshold: small tensors stay on the host plane -----------------
+    small = np.full((8,), float(r), np.float32)            # 32 B < 1 KB
+    before = dp.stats["allreduce"]
+    _plane.comm_allreduce(_plane.comm(), small, op="sum")
+    assert dp.stats["allreduce"] == before, "small tensor must stay host"
+    result["threshold_respected"] = True
+
+    # --- torch surface over the device plane -----------------------------
+    t = torch.full((64, 16), float(r + 1))                 # 4 KB
+    hvd.allreduce_(t, op=hvd.Sum)
+    assert torch.equal(t, torch.full((64, 16), float(sum(range(1, n + 1)))))
+
+    g = hvd.allgather(torch.full((16, 32), float(r)))      # 2 KB padded rows
+    assert g.shape == (16 * n, 32)
+    for src in range(n):
+        assert torch.equal(g[16 * src:16 * (src + 1)],
+                           torch.full((16, 32), float(src)))
+    assert dp.stats["allgather"] >= 1
+
+    b = torch.full((2048,), float(r))                      # 8 KB
+    hvd.broadcast_(b, root_rank=3)
+    assert torch.equal(b, torch.full((2048,), 3.0))
+    assert dp.stats["broadcast"] >= 1
+
+    rs = hvd.reducescatter(torch.full((16, 64), float(r + 1)),  # 4 KB
+                           op=hvd.Sum)
+    assert rs.shape == (2, 64)
+    assert torch.equal(rs, torch.full((2, 64), float(sum(range(1, n + 1)))))
+    assert dp.stats["reducescatter"] >= 1
+    result["op_matrix"] = "ok"
+
+    # --- min/max/prod device allreduce ------------------------------------
+    mn = torch.full((512,), float(r + 1))
+    hvd.allreduce_(mn, op=hvd.Min)
+    assert torch.equal(mn, torch.full((512,), 1.0))
+    mx = torch.full((512,), float(r + 1))
+    hvd.allreduce_(mx, op=hvd.Max)
+    assert torch.equal(mx, torch.full((512,), float(n)))
+    pr = torch.full((512,), 2.0 if r % 2 == 0 else 0.5)
+    hvd.allreduce_(pr, op=hvd.Product)
+    assert torch.equal(pr, torch.full((512,), 1.0))
+    result["minmaxprod"] = "ok"
+
+    # --- DistributedOptimizer: grads reduce on the device plane ----------
+    torch.manual_seed(0)                  # same init on every rank
+    model = torch.nn.Linear(64, 8, bias=False)             # 2 KB grad
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.5),
+        named_parameters=model.named_parameters())
+    w0 = model.weight.detach().clone()
+    x = torch.full((4, 64), 1.0)          # same data; per-rank target
+    y = torch.full((4, 8), float(r))
+    before = dp.stats["allreduce"]
+    loss = ((model(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    assert dp.stats["allreduce"] > before, "grad must reduce on device"
+    # oracle: averaged-over-ranks gradient equals grad at mean target
+    ym = torch.full((4, 8), float(sum(range(n))) / n)
+    model2 = torch.nn.Linear(64, 8, bias=False)
+    with torch.no_grad():
+        model2.weight.copy_(w0)
+    loss2 = ((model2(x) - ym) ** 2).mean()
+    loss2.backward()
+    expect = w0 - 0.5 * model2.weight.grad
+    assert torch.allclose(model.weight.detach(), expect, atol=1e-6), \
+        (model.weight.detach() - expect).abs().max()
+    # replicas agree bit-exactly after the step
+    peers = hvd.allgather_object(model.weight.detach().numpy().tobytes())
+    assert all(p == peers[0] for p in peers)
+    result["optimizer"] = "ok"
+
+    result["ok"] = True
+    with open(os.path.join(out_dir, f"result.{r}.json"), "w") as f:
+        json.dump(result, f)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

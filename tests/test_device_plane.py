@@ -1,0 +1,84 @@
+"""Device data plane for bindings: program-level oracles + routing rules.
+
+Single-process tier: the very same jitted shard_map programs the
+multi-process plane runs are oracle-tested over the 8-device CPU mesh via
+init_local/run_stacked (tier-3 multi-process coverage lives in
+test_multiprocess.py::test_hvdrun_np8_torch_device_plane). The reference
+analog is NCCL op unit coverage in test/parallel/test_torch.py with the
+data plane swapped for the accelerator one.
+"""
+import numpy as np
+import pytest
+
+from horovod_tpu.interop import _device_plane as dp
+
+
+@pytest.fixture()
+def local_plane():
+    dp.init_local(8)
+    yield dp
+    dp.shutdown()
+
+
+def test_allreduce_programs_match_numpy(local_plane):
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        dp.run_stacked("allreduce", x, op="sum"),
+        np.tile(x.sum(0), (8, 1, 1)), rtol=1e-5)
+    np.testing.assert_array_equal(
+        dp.run_stacked("allreduce", x, op="min"),
+        np.tile(x.min(0), (8, 1, 1)))
+    np.testing.assert_array_equal(
+        dp.run_stacked("allreduce", x, op="max"),
+        np.tile(x.max(0), (8, 1, 1)))
+    small = rng.uniform(0.5, 1.5, (8, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        dp.run_stacked("allreduce", small, op="prod"),
+        np.tile(small.prod(0), (8, 1)), rtol=1e-5)
+
+
+def test_allgather_broadcast_reducescatter_programs(local_plane):
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 5, 2).astype(np.float32)
+    # allgather: every rank's [5, 2] row -> replicated [8, 5, 2]
+    np.testing.assert_array_equal(dp.run_stacked("allgather", x), x)
+    # broadcast from root 3: replicated copy of row 3
+    np.testing.assert_array_equal(
+        dp.run_stacked("broadcast", x, root=3), x[3])
+    # reducescatter: [8, 16] rows summed then split 2-per-rank
+    y = rng.randn(8, 16).astype(np.float32)
+    got = dp.run_stacked("reducescatter", y, op="sum")
+    np.testing.assert_allclose(got.reshape(-1), y.sum(0), rtol=1e-5)
+
+
+def test_int_broadcast_is_exact(local_plane):
+    # masked psum: non-roots contribute exact zeros, so narrow ints are
+    # exact at any magnitude
+    x = np.full((8, 64), 127, np.int8)
+    x[5] = -128
+    np.testing.assert_array_equal(
+        dp.run_stacked("broadcast", x, root=5), x[5])
+
+
+def test_eligibility_is_rank_invariant_facts_only(local_plane):
+    big = np.zeros((64, 64), np.float32)        # 16 KB
+    small = np.zeros((4,), np.float32)
+    dp._state["threshold"] = 1024
+    assert dp.eligible("allreduce", big, op="sum")
+    assert not dp.eligible("allreduce", small, op="sum")      # threshold
+    assert not dp.eligible("allreduce", big, op="adasum")     # op
+    assert not dp.eligible("allreduce", big.astype(np.float64), op="sum")
+    assert not dp.eligible("allreduce", big, op="sum",
+                           is_global_comm=False)              # subgroup
+    assert not dp.eligible("reducescatter", np.zeros((9, 64), np.float32),
+                           op="sum")                          # 8 ∤ 9
+    assert dp.eligible("reducescatter", np.zeros((16, 64), np.float32),
+                       op="sum")
+    assert not dp.eligible("allgather", np.zeros((64, 64), np.bool_))
+
+
+def test_inactive_plane_routes_nothing():
+    assert not dp.is_active()
+    assert not dp.eligible("allreduce", np.zeros((1 << 20,), np.float32),
+                           op="sum")

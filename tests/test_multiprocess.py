@@ -82,6 +82,26 @@ def test_hvdrun_np4_negotiation(tmp_path):
             stall_seconds=60)
 
 
+def test_hvdrun_np8_torch_device_plane(tmp_path):
+    """hvdrun -np 8 torch job over the DEVICE data plane (VERDICT r4
+    item 2): each rank owns one virtual CPU device; large tensors stage
+    into jax.distributed-backed shard_map collectives over the 8-device
+    mesh (exact-equal vs the host shm plane on the same inputs), small
+    tensors stay on the host plane (HOROVOD_DEVICE_PLANE_THRESHOLD).
+    Reference bar: NCCL data plane + Gloo control plane
+    (nccl_operations.cc:185 / gloo_controller.cc)."""
+    results = _hvdrun("mp_torch_device_worker.py", tmp_path, np_=8,
+                      timeout=420, stall_seconds=90,
+                      extra_env={"HOROVOD_DEVICE_PLANE": "1",
+                                 "HOROVOD_DEVICE_PLANE_THRESHOLD": "1024"})
+    for r in results:
+        assert r["allreduce_exact_equal"] is True
+        assert r["threshold_respected"] is True
+        assert r["op_matrix"] == "ok"
+        assert r["minmaxprod"] == "ok"
+        assert r["optimizer"] == "ok"
+
+
 def test_hvdrun_np2_engine_timeline_negotiate_spans(tmp_path):
     """HOROVOD_TIMELINE on a real 2-process engine job: rank 0 writes
     the trace (coordinator-written, reference timeline.cc) and every
