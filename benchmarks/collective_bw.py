@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Device-collective bandwidth microbench — the declared GB/s metric.
+
+VERDICT r4 weak-6: BASELINE.json names "allreduce GB/s" as a headline
+metric but no harness ever measured device-collective bandwidth on the
+chip. This measures it with the hardware available:
+
+  - single real TPU chip (the tunnel): `psum` over a 1-device mesh is a
+    loopback — XLA lowers it to (at most) a copy — so the honest
+    single-chip proxies are (a) HBM streaming bandwidth (read+write a
+    large buffer) and (b) the loopback-collective time, labelled as
+    such. The 8-way ICI number requires a pod and is captured by the
+    same harness when one appears.
+  - 8-device CPU mesh (--cpu-mesh): real cross-device all-reduce,
+    validating the harness end-to-end (a correctness run, not a
+    bandwidth claim).
+
+Tunnel-aware methodology: a per-op dispatch over the axon relay costs
+~50 ms RTT, so timing N separate dispatches measures the network, not
+the chip. Each measurement therefore runs the op N times INSIDE one jit
+(`lax.fori_loop` with a data-dependent carry, so XLA cannot elide
+iterations) and takes the slope between two loop lengths — one dispatch
+per timing, fixed costs cancelled, same discipline as bench.py.
+
+Reference bar: the reference argues scaling efficiency from allreduce
+bandwidth over RoCE/InfiniBand (/root/reference/docs/benchmarks.rst:
+16-28); its NCCL data plane is nccl_operations.cc. Our device data
+plane is XLA collectives over a jax mesh (ops/collective_ops.py), so
+the metric here is the bandwidth of exactly that path.
+
+Emits one JSON line per size per op; `--summary` adds a final summary
+line with the peak achieved GB/s per op.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+HBM_BW_BOUND_GB_S = 819.0  # v5e HBM spec, same bound resnet_roofline uses
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu-mesh", action="store_true",
+                    help="force an 8-device CPU mesh (harness validation)")
+    ap.add_argument("--loops", default="4,20",
+                    help="two on-device loop lengths for the slope")
+    ap.add_argument("--repeats", type=int, default=3)
+    # sizes must exceed VMEM (~128 MiB on v5e): a smaller fori_loop carry
+    # stays VMEM-resident and measures on-chip SRAM, not HBM — the first
+    # run of this harness found exactly that (op_us ~0 below 128 MB)
+    ap.add_argument("--sizes-mb", default="256,512,1024")
+    ap.add_argument("--summary", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu_mesh:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    import jax
+    if args.cpu_mesh:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:
+        pass
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    shard_map = jax.shard_map
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(devs, ("dp",))
+    platform = devs[0].platform
+    la, lb = (int(x) for x in args.loops.split(","))
+    rows = []
+
+    def slope_time(make_fn, x):
+        """Per-op time from the slope between two on-device loop
+        lengths; min over repeats (noise only ever adds time)."""
+        def run(nloops):
+            f = make_fn(nloops)
+            y = f(x)
+            y.block_until_ready()          # compile + warm
+            t0 = time.perf_counter()
+            y = f(x)
+            y.block_until_ready()
+            float(jnp.ravel(y)[0])         # tunnel completion fence
+            return time.perf_counter() - t0
+        ta = min(run(la) for _ in range(args.repeats))
+        tb = min(run(lb) for _ in range(args.repeats))
+        if tb <= ta:  # degenerate slope: op elided or pure noise
+            return None
+        return (tb - ta) / (lb - la)
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    inv_n = 1.0 / n
+    for mb in [float(s) for s in args.sizes_mb.split(",")]:
+        elems = int(mb * 1e6 / 4)
+        elems = max(1024 * n, (elems // (1024 * n)) * 1024 * n)
+        bytes_logical = elems * 4
+        x = jnp.ones((elems,), jnp.float32)
+        xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+        # (a) HBM streaming: each iteration reads + writes the buffer
+        def make_stream(nloops):
+            return jax.jit(lambda a: lax.fori_loop(
+                0, nloops, lambda i, c: c * 1.000001 + 1.0, a))
+        dt = slope_time(make_stream, x)
+        emit({"metric": "hbm_stream_gb_s", "mb": mb, "platform": platform,
+              "value": round(2 * bytes_logical / dt / 1e9, 1) if dt else None,
+              "unit": "GB/s", "op_us": round(dt * 1e6, 1) if dt else None,
+              "pct_of_hbm_bound": round(
+                  100 * 2 * bytes_logical / dt / 1e9 / HBM_BW_BOUND_GB_S, 1)
+              if (dt and platform == "tpu") else None})
+
+        # (b) allreduce: psum over the mesh. The producer scale keeps the
+        # carry finite across iterations AND (for n=1) keeps the body
+        # from collapsing to identity — a 1-device psum IS identity, so
+        # the loopback row measures one fused elementwise+copy pass,
+        # labelled as such.
+        scale = inv_n * 1.000001
+        def make_ar(nloops):
+            body = lambda c: lax.psum(c * scale, "dp")  # noqa: E731
+            return jax.jit(shard_map(
+                lambda a: lax.fori_loop(0, nloops, lambda i, c: body(c), a),
+                mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                check_vma=False))
+        dt = slope_time(make_ar, xs)
+        algo_bytes = 2 * (n - 1) / n * bytes_logical if n > 1 \
+            else 2 * bytes_logical  # loopback: labelled, not a wire claim
+        emit({"metric": "allreduce_gb_s", "mb": mb, "n_devices": n,
+              "platform": platform, "loopback_proxy": n == 1,
+              "value": round(algo_bytes / dt / 1e9, 1) if dt else None,
+              "unit": "GB/s",
+              "op_us": round(dt * 1e6, 1) if dt else None})
+
+        # (c) all_gather + keep-own-shard (shape-preserving so it loops)
+        shard = elems // n
+        def make_ag(nloops):
+            def body(c):
+                full = lax.all_gather(c, "dp", tiled=True)
+                return full[:shard] * 1.000001
+            return jax.jit(shard_map(
+                lambda a: lax.fori_loop(0, nloops, lambda i, c: body(c), a),
+                mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                check_vma=False))
+        dt = slope_time(make_ag, xs)
+        algo_bytes = (n - 1) / n * bytes_logical if n > 1 else bytes_logical
+        emit({"metric": "allgather_gb_s", "mb": mb, "n_devices": n,
+              "platform": platform, "loopback_proxy": n == 1,
+              "value": round(algo_bytes / dt / 1e9, 1) if dt else None,
+              "unit": "GB/s",
+              "op_us": round(dt * 1e6, 1) if dt else None})
+
+    if args.summary:
+        best = {}
+        for r in rows:
+            k = r["metric"]
+            if r["value"] is None:
+                continue
+            if k not in best or r["value"] > best[k]["value"]:
+                best[k] = r
+        print(json.dumps({
+            "metric": "device_collective_bw_summary",
+            "platform": platform, "n_devices": n,
+            "peaks": {k: {"gb_s": v["value"], "mb": v["mb"],
+                          "loopback_proxy": v.get("loopback_proxy")}
+                      for k, v in best.items()},
+            "hbm_bound_gb_s": HBM_BW_BOUND_GB_S if platform == "tpu"
+            else None}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Round-5 TPU hardware capture queue (VERDICT r4 item 1 + items 3/7).
+# Stage order is value-first so a tunnel drop mid-queue still leaves the
+# most important evidence on disk:
+#   1. the round-4 hardened model sweep (round4_tpu_queue.sh) — run it
+#      separately FIRST; this script assumes it already ran or runs it
+#      when round4_tpu_results.jsonl has no green capture yet
+#   2. xplane profile of ~20 rn50 B=32 steps -> measured-vs-modeled
+#      roofline validation (benchmarks/xplane_profile.py)
+#   3. device-collective GB/s sweep (benchmarks/collective_bw.py)
+#   4. BN-fusion lever A/B (HVD_BENCH_BN_LEVER=1 bench.py vs baseline)
+# Run on a QUIET machine; stop the probe loop and any test runs first.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/round5_tpu_results.jsonl
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+echo "{\"stage\": \"r5_queue_start\", \"t\": \"$(stamp)\"}" >> "$OUT"
+
+timeout 150 python -c "
+import jax, jax.numpy as jnp
+print(float(jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128)))),
+      jax.devices())
+" || {
+  echo "{\"stage\": \"probe\", \"ok\": false, \"t\": \"$(stamp)\"}" >> "$OUT"
+  echo "tunnel down; aborting" >&2
+  exit 1
+}
+echo "{\"stage\": \"probe\", \"ok\": true, \"t\": \"$(stamp)\"}" >> "$OUT"
+
+if ! grep -q '"value": [0-9]' benchmarks/round4_tpu_results.jsonl 2>/dev/null
+then
+  echo "== model sweep (round4 queue) ==" >&2
+  bash benchmarks/round4_tpu_queue.sh
+fi
+
+echo "== xplane profile rn50 B=32 ==" >&2
+timeout 900 python benchmarks/xplane_profile.py | tail -1 | tee -a "$OUT"
+
+echo "== device-collective GB/s sweep ==" >&2
+timeout 900 python benchmarks/collective_bw.py | tee -a "$OUT"
+timeout 900 python benchmarks/collective_bw.py --summary | tee -a "$OUT"
+
+echo "== stem lever A/B: space_to_depth (MXU-stem, round-3 feature, first" \
+     "hardware A/B) ==" >&2
+HVD_BENCH_STEM=space_to_depth HVD_BENCH_REPEATS=3 \
+  HVD_BENCH_TOTAL_TIMEOUT=900 \
+  timeout 1000 python bench.py | tee -a "$OUT"
+
+if [ "${HVD_R5_BN_LEVER:-0}" = 1 ]; then
+  echo "== BN lever A/B (lever on) ==" >&2
+  HVD_BENCH_BN_LEVER=1 HVD_BENCH_REPEATS=3 HVD_BENCH_TOTAL_TIMEOUT=900 \
+    timeout 1000 python bench.py | tee -a "$OUT"
+fi
+
+echo "{\"stage\": \"r5_queue_done\", \"t\": \"$(stamp)\"}" >> "$OUT"
+echo "round-5 queue complete; results in $OUT" >&2

@@ -22,6 +22,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -46,6 +47,12 @@ enum Op : uint8_t {
   OP_PING = 4,
   OP_GATHER = 5,   // join-and-collect: post a blob, reply with all blobs
   OP_STAT = 6,     // introspection: entry/gather counts (leak checks)
+  OP_REDUCE = 7,   // join-and-reduce: post a blob, reply with the
+                   // bitwise AND/OR of all members' blobs — the
+                   // negotiation bitvector fast path. Unlike OP_GATHER
+                   // the reply is O(blob), not O(P*blob): at P=64 the
+                   // gather reply fan-out alone busts the ~1 ms cadence
+                   // budget (benchmarks/store_service_time.py)
 };
 
 enum Status : uint8_t {
@@ -85,13 +92,94 @@ bool recv_all(int fd, void* buf, size_t len) {
 }
 
 bool send_frame(int fd, uint8_t status, const std::string& payload) {
+  // single vectored syscall per reply — a second send of the tiny
+  // header measurably dominates small-reply service time
+  // (benchmarks/store_service_time.py), and copying the payload into a
+  // header-prefixed buffer would cost O(P·blob) per gather reply
   uint32_t len = static_cast<uint32_t>(payload.size());
   char hdr[5];
   hdr[0] = static_cast<char>(status);
   std::memcpy(hdr + 1, &len, 4);
-  if (!send_all(fd, hdr, 5)) return false;
-  return payload.empty() || send_all(fd, payload.data(), payload.size());
+  struct iovec iov[2];
+  iov[0].iov_base = hdr;
+  iov[0].iov_len = 5;
+  iov[1].iov_base = const_cast<char*>(payload.data());
+  iov[1].iov_len = payload.size();
+  size_t total = 5 + payload.size();
+  size_t sent = 0;
+  int iovcnt = payload.empty() ? 1 : 2;
+  while (sent < total) {
+    // sendmsg, not writev: replies to DEAD clients are normal here (a
+    // handler that timed out waiting on a crashed peer still replies),
+    // and only msg-family syscalls take MSG_NOSIGNAL — a raw writev
+    // would raise SIGPIPE and kill the embedding process
+    struct msghdr msg {};
+    msg.msg_iov = iov;
+    msg.msg_iovlen = static_cast<size_t>(iovcnt);
+    ssize_t n = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    sent += static_cast<size_t>(n);
+    // advance the iovecs past what the kernel took (partial writes)
+    size_t done = static_cast<size_t>(n);
+    for (int i = 0; i < iovcnt && done > 0; ++i) {
+      size_t take = iov[i].iov_len < done ? iov[i].iov_len : done;
+      iov[i].iov_base = static_cast<char*>(iov[i].iov_base) + take;
+      iov[i].iov_len -= take;
+      done -= take;
+    }
+  }
+  return true;
 }
+
+// Per-connection buffered reader: one ::recv refill per (small) request
+// instead of five header-sized recvs — each tiny recv is a full syscall
+// and the store's per-request service time is syscall-bound.
+class BufReader {
+ public:
+  explicit BufReader(int fd) : fd_(fd) {}
+
+  bool ReadExact(void* out, size_t len) {
+    char* p = static_cast<char*>(out);
+    while (len > 0) {
+      size_t avail = end_ - pos_;
+      if (avail == 0) {
+        // large-payload bypass: nothing buffered and the remainder
+        // exceeds the buffer — recv straight into the destination, no
+        // staging copy and no 16 KB syscall cap
+        if (len >= sizeof(buf_)) return recv_all(fd_, p, len);
+        if (!Refill()) return false;
+        continue;
+      }
+      size_t take = avail < len ? avail : len;
+      std::memcpy(p, buf_ + pos_, take);
+      pos_ += take;
+      p += take;
+      len -= take;
+    }
+    return true;
+  }
+
+ private:
+  bool Refill() {
+    pos_ = end_ = 0;
+    for (;;) {
+      ssize_t n = ::recv(fd_, buf_, sizeof(buf_), 0);
+      if (n > 0) {
+        end_ = static_cast<size_t>(n);
+        return true;
+      }
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+  }
+
+  int fd_;
+  char buf_[16384];
+  size_t pos_ = 0, end_ = 0;
+};
 
 // Is the requesting connection still alive? A cheap nonblocking peek:
 // orderly EOF or a hard error means the client died and nobody will read
@@ -110,6 +198,17 @@ struct Entry {
   int reads_left = 0;  // 0 = persistent; >0 = erase after this many reads
   bool present = false;
   std::chrono::steady_clock::time_point touch;  // for the TTL sweep
+};
+
+struct ReduceState {
+  std::set<int> posted;  // ranks folded into acc (idempotent re-posts)
+  std::string acc;       // running AND/OR accumulator
+  uint8_t kind = 0;      // 0 = AND, 1 = OR (first post decides; members
+                         // of one round always agree by protocol)
+  bool complete = false;
+  int reads_left = 0;
+  int waiters = 0;
+  std::chrono::steady_clock::time_point touch;
 };
 
 struct GatherState {
@@ -199,15 +298,16 @@ class StoreServer {
   }
 
   void Handle(int fd) {
+    BufReader rd(fd);
     for (;;) {
       uint8_t op;
       uint32_t klen, vlen;
-      if (!recv_all(fd, &op, 1) || !recv_all(fd, &klen, 4)) break;
+      if (!rd.ReadExact(&op, 1) || !rd.ReadExact(&klen, 4)) break;
       std::string key(klen, '\0');
-      if (klen && !recv_all(fd, &key[0], klen)) break;
-      if (!recv_all(fd, &vlen, 4)) break;
+      if (klen && !rd.ReadExact(&key[0], klen)) break;
+      if (!rd.ReadExact(&vlen, 4)) break;
       std::string val(vlen, '\0');
-      if (vlen && !recv_all(fd, &val[0], vlen)) break;
+      if (vlen && !rd.ReadExact(&val[0], vlen)) break;
 
       bool alive = true;
       switch (op) {
@@ -291,6 +391,15 @@ class StoreServer {
           }
           std::unique_lock<std::mutex> lk(mu_);
           SweepLocked(false);
+          // Service-time instrumentation: count only the handler's WORK
+          // (post/merge under the lock + reply copy/send), never mutex
+          // acquisition, the rate-guarded sweep (excluded in the reduce
+          // handler too — the two counters must stay comparable), or
+          // the condvar wait for other members — the measurement must
+          // stay meaningful on an oversubscribed host where wait times
+          // are scheduling noise (docs/benchmarks.md round-5
+          // control-plane isolation).
+          auto svc_w1 = std::chrono::steady_clock::now();
           GatherState& g = gathers_[key];
           g.touch = std::chrono::steady_clock::now();
           if (!g.complete) {
@@ -316,8 +425,13 @@ class StoreServer {
                    shutting_down_.load();
           };
           g.waiters++;           // pin against the TTL sweep while blocked
+          uint64_t svc_pre_ns = static_cast<uint64_t>(
+              std::chrono::duration_cast<std::chrono::nanoseconds>(
+                  std::chrono::steady_clock::now() - svc_w1)
+                  .count());
           bool got = WaitPred(lk, timeout_s, fd, gready) &&
                      !shutting_down_.load();
+          auto svc_w2 = std::chrono::steady_clock::now();
           auto git = gathers_.find(key);
           if (git != gathers_.end()) {
             git->second.waiters--;
@@ -325,13 +439,106 @@ class StoreServer {
           }
           if (!got) {
             lk.unlock();
+            RecordSvc(&svc_gather_, svc_pre_ns, svc_w2,
+                      std::chrono::steady_clock::now());
+            auto ts = std::chrono::steady_clock::now();
             alive = send_frame(fd, ST_TIMEOUT, "");
+            RecordSend(&svc_gather_, ts);
             break;
           }
           std::string gout = git->second.result;
           if (--git->second.reads_left == 0) gathers_.erase(git);
           lk.unlock();
+          RecordSvc(&svc_gather_, svc_pre_ns, svc_w2,
+                    std::chrono::steady_clock::now());
+          auto ts = std::chrono::steady_clock::now();
           alive = send_frame(fd, ST_OK, gout);
+          RecordSend(&svc_gather_, ts);
+          break;
+        }
+        case OP_REDUCE: {
+          // value payload: double timeout_s + i32 group size + i32 rank
+          // + u8 kind (0 AND / 1 OR) + blob. Reply: the reduced blob.
+          if (val.size() < 17) {
+            alive = send_frame(fd, ST_ERROR, "bad reduce");
+            break;
+          }
+          double timeout_s;
+          int32_t gsize, grank;
+          uint8_t kind;
+          std::memcpy(&timeout_s, val.data(), 8);
+          std::memcpy(&gsize, val.data() + 8, 4);
+          std::memcpy(&grank, val.data() + 12, 4);
+          kind = static_cast<uint8_t>(val[16]);
+          if (gsize <= 0 || grank < 0 || grank >= gsize || kind > 1) {
+            alive = send_frame(fd, ST_ERROR, "bad reduce args");
+            break;
+          }
+          std::unique_lock<std::mutex> lk(mu_);
+          SweepLocked(false);
+          auto svc_w1 = std::chrono::steady_clock::now();
+          ReduceState& r = reduces_[key];
+          r.touch = std::chrono::steady_clock::now();
+          if (!r.complete && !r.posted.count(grank)) {
+            const char* blob = val.data() + 17;
+            size_t blen = val.size() - 17;
+            if (r.posted.empty()) {
+              r.acc.assign(blob, blen);
+              r.kind = kind;
+            } else if (blen != r.acc.size()) {
+              lk.unlock();
+              alive = send_frame(fd, ST_ERROR, "reduce size mismatch");
+              break;
+            } else {
+              uint8_t* a = reinterpret_cast<uint8_t*>(&r.acc[0]);
+              const uint8_t* b = reinterpret_cast<const uint8_t*>(blob);
+              if (r.kind == 0)
+                for (size_t i = 0; i < blen; ++i) a[i] &= b[i];
+              else
+                for (size_t i = 0; i < blen; ++i) a[i] |= b[i];
+            }
+            r.posted.insert(grank);
+            if (static_cast<int>(r.posted.size()) == gsize) {
+              r.complete = true;
+              r.reads_left = gsize;
+              cv_.notify_all();
+            }
+          }
+          auto rready = [&] {
+            auto it = reduces_.find(key);
+            return (it != reduces_.end() && it->second.complete) ||
+                   shutting_down_.load();
+          };
+          r.waiters++;
+          uint64_t svc_pre_ns = static_cast<uint64_t>(
+              std::chrono::duration_cast<std::chrono::nanoseconds>(
+                  std::chrono::steady_clock::now() - svc_w1)
+                  .count());
+          bool got = WaitPred(lk, timeout_s, fd, rready) &&
+                     !shutting_down_.load();
+          auto svc_w2 = std::chrono::steady_clock::now();
+          auto rit = reduces_.find(key);
+          if (rit != reduces_.end()) {
+            rit->second.waiters--;
+            rit->second.touch = std::chrono::steady_clock::now();
+          }
+          if (!got) {
+            lk.unlock();
+            RecordSvc(&svc_reduce_, svc_pre_ns, svc_w2,
+                      std::chrono::steady_clock::now());
+            auto ts = std::chrono::steady_clock::now();
+            alive = send_frame(fd, ST_TIMEOUT, "");
+            RecordSend(&svc_reduce_, ts);
+            break;
+          }
+          std::string rout = rit->second.acc;
+          if (--rit->second.reads_left == 0) reduces_.erase(rit);
+          lk.unlock();
+          RecordSvc(&svc_reduce_, svc_pre_ns, svc_w2,
+                    std::chrono::steady_clock::now());
+          auto ts = std::chrono::steady_clock::now();
+          alive = send_frame(fd, ST_OK, rout);
+          RecordSend(&svc_reduce_, ts);
           break;
         }
         case OP_STAT: {
@@ -341,7 +548,24 @@ class StoreServer {
           std::unique_lock<std::mutex> lk(mu_);
           SweepLocked(true);
           std::string st = "data=" + std::to_string(data_.size()) +
-                           " gathers=" + std::to_string(gathers_.size());
+                           " gathers=" + std::to_string(gathers_.size()) +
+                           " reduces=" + std::to_string(reduces_.size()) +
+                           " svc_gather_n=" +
+                           std::to_string(svc_gather_.n.load()) +
+                           " svc_gather_ns=" +
+                           std::to_string(svc_gather_.work_ns.load()) +
+                           " svc_gather_max_ns=" +
+                           std::to_string(svc_gather_.max_ns.load()) +
+                           " svc_gather_send_ns=" +
+                           std::to_string(svc_gather_.send_ns.load()) +
+                           " svc_reduce_n=" +
+                           std::to_string(svc_reduce_.n.load()) +
+                           " svc_reduce_ns=" +
+                           std::to_string(svc_reduce_.work_ns.load()) +
+                           " svc_reduce_max_ns=" +
+                           std::to_string(svc_reduce_.max_ns.load()) +
+                           " svc_reduce_send_ns=" +
+                           std::to_string(svc_reduce_.send_ns.load());
           lk.unlock();
           alive = send_frame(fd, ST_OK, st);
           break;
@@ -384,6 +608,46 @@ class StoreServer {
     }
   }
 
+  struct SvcCounters {
+    std::atomic<uint64_t> work_ns{0};
+    std::atomic<uint64_t> send_ns{0};  // reply syscall time, separately:
+                                       // the syscall itself is server
+                                       // CPU, but it can also absorb
+                                       // TCP drain blocking on a slow
+                                       // client — keeping it out of
+                                       // work_ns keeps that span
+                                       // scheduling-noise-free
+    std::atomic<uint64_t> n{0};
+    std::atomic<uint64_t> max_ns{0};
+  };
+
+  void RecordSend(SvcCounters* c,
+                  std::chrono::steady_clock::time_point t0) {
+    c->send_ns.fetch_add(static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - t0)
+            .count()), std::memory_order_relaxed);
+  }
+
+  // Fold one handler's work spans (pre-wait + post-wake-until-unlock,
+  // excluding lock/condvar waits AND the reply send — draining a reply
+  // into a slow client's socket is the client's wait, not server work)
+  // into a set of service-time counters.
+  void RecordSvc(SvcCounters* c, uint64_t pre_ns,
+                 std::chrono::steady_clock::time_point w2_start,
+                 std::chrono::steady_clock::time_point w2_end) {
+    uint64_t ns = pre_ns + static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            w2_end - w2_start)
+            .count());
+    c->work_ns.fetch_add(ns, std::memory_order_relaxed);
+    c->n.fetch_add(1, std::memory_order_relaxed);
+    uint64_t prev = c->max_ns.load(std::memory_order_relaxed);
+    while (ns > prev && !c->max_ns.compare_exchange_weak(
+                            prev, ns, std::memory_order_relaxed)) {
+    }
+  }
+
   // mu_ held. Expire orphaned state: read-counted entries and gather
   // rounds whose remaining readers died (reads_left can never reach 0),
   // and gather rounds that never completed (a member crashed before
@@ -406,6 +670,12 @@ class StoreServer {
       else
         ++it;
     }
+    for (auto it = reduces_.begin(); it != reduces_.end();) {
+      if (it->second.waiters == 0 && now - it->second.touch > state_ttl_)
+        it = reduces_.erase(it);
+      else
+        ++it;
+    }
   }
 
   int listen_fd_ = -1;
@@ -417,9 +687,13 @@ class StoreServer {
   std::condition_variable cv_;
   std::map<std::string, Entry> data_;
   std::map<std::string, GatherState> gathers_;
+  std::map<std::string, ReduceState> reduces_;
   std::set<int> conn_fds_;
   std::chrono::duration<double> state_ttl_{900.0};
   std::chrono::steady_clock::time_point last_sweep_;
+  // per-op service-time counters (work only; see RecordSvc)
+  SvcCounters svc_gather_;
+  SvcCounters svc_reduce_;
 };
 
 class StoreClient {
@@ -496,6 +770,18 @@ class StoreClient {
     std::memcpy(&arg[12], &r, 4);
     arg += blob;
     return Request(OP_GATHER, key, arg, out);
+  }
+
+  int Reduce(const std::string& key, double timeout_s, int size, int rank,
+             bool is_or, const std::string& blob, std::string* out) {
+    std::string arg(17, '\0');
+    std::memcpy(&arg[0], &timeout_s, 8);
+    int32_t s = size, r = rank;
+    std::memcpy(&arg[8], &s, 4);
+    std::memcpy(&arg[12], &r, 4);
+    arg[16] = is_or ? 1 : 0;
+    arg += blob;
+    return Request(OP_REDUCE, key, arg, out);
   }
 
   // Oversized-result stash: get/gather consume server-side read slots
@@ -584,30 +870,22 @@ class Coordinator {
   }
 
   // In-place bitwise AND/OR allreduce of a bitvector — the cache-coordination
-  // primitive (controller.cc:845 CoordinateCacheAndState).
+  // primitive (controller.cc:845 CoordinateCacheAndState). Server-side
+  // reduce (OP_REDUCE): one round trip and an O(nbytes) reply per member
+  // — the allgather-based variant's O(P*nbytes) reply fan-out was the
+  // dominant control-plane cost at P=64
+  // (benchmarks/store_service_time.py).
   int BitReduce(const std::string& tag, uint8_t* bits, uint32_t nbytes,
                 bool is_and, double timeout_s) {
     std::string blob(reinterpret_cast<char*>(bits), nbytes);
-    std::string all;
-    int st = Allgather(tag, blob, timeout_s, &all);
+    std::string acc;
+    uint64_t seq = SeqOf(tag);
+    int st = client_.Reduce(Key(tag, seq, -1), timeout_s, size_, rank_,
+                            !is_and, blob, &acc);
     if (st != ST_OK) return st;
-    size_t off = 0;
-    bool first = true;
-    for (int r = 0; r < size_; ++r) {
-      uint32_t len;
-      std::memcpy(&len, all.data() + off, 4);
-      off += 4;
-      if (len != nbytes) return ST_ERROR;
-      const uint8_t* v = reinterpret_cast<const uint8_t*>(all.data() + off);
-      off += len;
-      if (first) {
-        std::memcpy(bits, v, nbytes);
-        first = false;
-      } else {
-        for (uint32_t i = 0; i < nbytes; ++i)
-          bits[i] = is_and ? (bits[i] & v[i]) : (bits[i] | v[i]);
-      }
-    }
+    if (acc.size() != nbytes) return ST_ERROR;
+    std::memcpy(bits, acc.data(), nbytes);
+    Advance(tag, seq);
     return ST_OK;
   }
 
@@ -707,8 +985,26 @@ int hvd_client_gather(void* c, const char* key, double timeout_s, int size,
   return ST_OK;
 }
 
-// "data=<n> gathers=<m>" live-state counts after a forced TTL sweep —
-// the leak-check hook (tests + doctor tooling).
+int hvd_client_reduce(void* c, const char* key, double timeout_s, int size,
+                      int rank, int is_or, const uint8_t* blob,
+                      uint32_t bloblen, uint8_t* out, uint32_t outcap,
+                      uint32_t* outlen) {
+  std::string v;
+  int st = static_cast<StoreClient*>(c)->Reduce(
+      key, timeout_s, size, rank, is_or != 0,
+      std::string(reinterpret_cast<const char*>(blob), bloblen), &v);
+  if (st != ST_OK) return st;
+  *outlen = static_cast<uint32_t>(v.size());
+  if (*outlen > outcap) {
+    static_cast<StoreClient*>(c)->StashPending(std::move(v));
+    return ST_AGAIN;
+  }
+  std::memcpy(out, v.data(), v.size());
+  return ST_OK;
+}
+
+// "data=<n> gathers=<m> reduces=<k> svc_*=..." live-state counts after a
+// forced TTL sweep — the leak-check hook (tests + doctor tooling).
 int hvd_client_stat(void* c, uint8_t* out, uint32_t outcap,
                     uint32_t* outlen) {
   std::string v;

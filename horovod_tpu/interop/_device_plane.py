@@ -49,12 +49,13 @@ _state = {
     "n": 0,
     "me": -1,
     "threshold": 65536,
+    "alltoall_min_fill": 0.25,
     "owns_distributed": False,
 }
 
 # per-kind counters: tests assert the route actually taken
 stats = {"allreduce": 0, "allgather": 0, "broadcast": 0,
-         "reducescatter": 0}
+         "reducescatter": 0, "alltoall": 0}
 
 
 def _mode() -> str:
@@ -146,6 +147,8 @@ def _finish_init(rank: int, size: int) -> None:
         me=rank,
         threshold=int(os.environ.get("HOROVOD_DEVICE_PLANE_THRESHOLD",
                                      "65536")),
+        alltoall_min_fill=float(os.environ.get(
+            "HOROVOD_DEVICE_ALLTOALL_MIN_FILL", "0.25")),
     )
     logger.debug("device plane up: %d ranks over %s, threshold=%dB",
                  size, devs[0].platform, _state["threshold"])
@@ -165,7 +168,9 @@ def init_local(n: int) -> None:
                                          (AXIS,)),
                   device=devs[0], n=n, me=0,
                   threshold=int(os.environ.get(
-                      "HOROVOD_DEVICE_PLANE_THRESHOLD", "65536")))
+                      "HOROVOD_DEVICE_PLANE_THRESHOLD", "65536")),
+                  alltoall_min_fill=float(os.environ.get(
+                      "HOROVOD_DEVICE_ALLTOALL_MIN_FILL", "0.25")))
 
 
 def shutdown() -> None:
@@ -250,6 +255,14 @@ def _program(kind: str, op: Optional[str], root: Optional[int]):
                          AXIS)
             return r[0]                  # [1, ...] -> [...] replicated
         out_specs = P()
+    elif kind == "alltoall":
+        def blk(x):                      # [1, n, M, ...] per shard
+            # split the dst axis, concat received rows on a new src
+            # axis, then restore the [1, n, M, ...] shard convention
+            # (axis 1 = src on the way out)
+            r = lax.all_to_all(x, AXIS, split_axis=1, concat_axis=0)
+            return jnp.swapaxes(r, 0, 1)  # [n, 1, ...] -> [1, n, ...]
+        out_specs = P(AXIS)
     elif kind == "reducescatter":
         def blk(x):                      # [1, d0, ...]; n | d0
             if op == "sum":
@@ -319,6 +332,65 @@ def reducescatter(arr: np.ndarray, op: str = "sum") -> np.ndarray:
     stats["reducescatter"] += 1
     out = _program("reducescatter", op, None)(_stage_in(arr))
     return _my_shard(out)
+
+
+def alltoall_eligible(S: np.ndarray, dtype: np.dtype, row_bytes: int,
+                      is_global_comm: bool = True) -> bool:
+    """Rank-invariant routing for the ragged alltoall: S is the
+    NEGOTIATED (P, P) row-count matrix (identical on every rank after
+    the host-plane meta allgather), so total bytes, max chunk and the
+    pad fill ratio are global facts. Pad-to-max inflates device traffic
+    to P²·M rows, so heavily skewed payloads (fill below
+    HOROVOD_DEVICE_ALLTOALL_MIN_FILL, default 0.25) stay on the
+    wire-exact host ring."""
+    if not _state["active"] or not is_global_comm:
+        return False
+    if not _dtype_ok(np.dtype(dtype)):
+        return False
+    n = _state["n"]
+    if S.shape != (n, n):
+        return False
+    # threshold keeps ONE meaning across collectives: this-rank tensor
+    # bytes (eligible() uses arr.nbytes). The rank-invariant analog here
+    # is the max per-rank send total — every rank computes the same
+    # number from the negotiated S, and the cutover doesn't silently
+    # shrink as P grows the global sum.
+    per_rank_bytes = int(S.sum(axis=1).max()) * row_bytes
+    if per_rank_bytes < _state["threshold"]:
+        return False
+    m = int(S.max())
+    if m == 0:
+        return False
+    fill = float(S.sum()) / float(n * n * m)
+    return fill >= _state["alltoall_min_fill"]
+
+
+def alltoall(chunks, S: np.ndarray, dtype, trail) -> list:
+    """Ragged alltoall via pad-to-max + one XLA all_to_all over the
+    plane mesh (the reference's NCCLAlltoall role, nccl_operations.cc).
+    chunks[d] = this rank's rows for dst d; S[src, dst] = negotiated
+    row counts. Returns the received chunk list indexed by src."""
+    stats["alltoall"] += 1
+    me, n = _state["me"], _state["n"]
+    m = int(S.max())
+    local = np.zeros((n, m) + tuple(trail), dtype)
+    for d, c in enumerate(chunks):
+        if c.shape[0]:
+            local[d, :c.shape[0]] = c
+    out = _program("alltoall", None, None)(_stage_in(local))
+    mine = _my_shard(out)                # [n(src), m, ...]
+    return [np.ascontiguousarray(mine[s, :int(S[s, me])])
+            for s in range(n)]
+
+
+def run_stacked_alltoall(stacked: np.ndarray) -> np.ndarray:
+    """Oracle hook (init_local mode): stacked[src, dst] = padded chunk
+    rows; returns global [rank, src, M, ...] result."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    x = jax.device_put(np.ascontiguousarray(stacked),
+                       NamedSharding(_state["mesh"], P(AXIS)))
+    return np.asarray(_program("alltoall", None, None)(x))
 
 
 # -- single-controller oracle hook (init_local mode) --------------------------

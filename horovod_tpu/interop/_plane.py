@@ -161,6 +161,24 @@ def comm_reducescatter(comm, arr: np.ndarray,
 
 
 def comm_alltoall(comm, chunks) -> list:
+    from . import _device_plane
+    if _device_plane.is_active() and comm is _comm and comm.size > 1:
+        # Negotiate the (P, P) row matrix on the host plane FIRST (small
+        # control traffic — the plane split's whole point), then make
+        # the routing decision from the GLOBAL matrix so every rank
+        # takes the same branch.
+        from ..native.shm import negotiate_alltoall_meta
+        meta = negotiate_alltoall_meta(comm, chunks)
+        chunks2, dtype, trail, row_elems, S = meta
+        if _device_plane.alltoall_eligible(
+                S, dtype, row_elems * dtype.itemsize,
+                is_global_comm=True):
+            return traced("alltoall", lambda: _device_plane.alltoall(
+                chunks2, S, dtype, trail))
+        # host route: hand the negotiated meta down so the comm does
+        # not pay the negotiation allgather a second time
+        return traced("alltoall",
+                      lambda: comm.alltoall(chunks2, meta=meta))
     return traced("alltoall", lambda: comm.alltoall(chunks))
 
 

@@ -117,6 +117,10 @@ def _declare(lib):
                                         c.c_int, c.c_int, u8p, c.c_uint32,
                                         u8p, c.c_uint32,
                                         c.POINTER(c.c_uint32)]),
+        "hvd_client_reduce": (c.c_int, [c.c_void_p, c.c_char_p, c.c_double,
+                                        c.c_int, c.c_int, c.c_int, u8p,
+                                        c.c_uint32, u8p, c.c_uint32,
+                                        c.POINTER(c.c_uint32)]),
         "hvd_client_stat": (c.c_int, [c.c_void_p, u8p, c.c_uint32,
                                       c.POINTER(c.c_uint32)]),
         "hvd_client_take_pending": (c.c_int, [c.c_void_p, u8p, c.c_uint32,

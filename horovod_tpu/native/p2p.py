@@ -282,7 +282,7 @@ class RingComm:
             ufunc(rv, tmp, out=rv)
         return chunk(r).copy()
 
-    def alltoall(self, chunks) -> list:
+    def alltoall(self, chunks, meta=None) -> list:
         """Ragged alltoall: ``chunks[d]`` is delivered to rank ``d``;
         returns ``received[src]`` — the chunk each source sent here.
         Chunks share dtype and trailing shape; dim-0 row counts may
@@ -303,6 +303,7 @@ class RingComm:
             chunks = check_alltoall_chunks(P, chunks)
             return [chunks[0].copy()]
         chunks, dtype, trail, row_elems, S = \
+            meta if meta is not None else \
             negotiate_alltoall_meta(self, chunks)
         out: list = [None] * P
         out[r] = chunks[r].copy()

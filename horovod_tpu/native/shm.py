@@ -90,16 +90,20 @@ def negotiate_alltoall_meta(comm, chunks):
     return chunks, dtype, trail, row_elems, g[:, :-1]
 
 
-def alltoall_via_allgather(comm, chunks) -> list:
+def alltoall_via_allgather(comm, chunks, meta=None) -> list:
     """Ragged alltoall built from a comm's allgather: negotiate the
     (P, P) row matrix, gather every rank's padded concat, pick this
     rank's slices. O(P·N) read amplification — right for shm (memory
     bandwidth) and the star-store fallback; the p2p ring has a real
-    rotation instead (p2p.py alltoall)."""
+    rotation instead (p2p.py alltoall). `meta` carries an
+    already-negotiated (chunks, dtype, trail, row_elems, S) so a caller
+    that needed the matrix for routing (interop/_plane.comm_alltoall)
+    does not pay the negotiation allgather twice."""
     P, r = comm.size, comm.rank
     if P == 1:
         return [np.ascontiguousarray(chunks[0]).copy()]
     chunks, dtype, trail, row_elems, S = \
+        meta if meta is not None else \
         negotiate_alltoall_meta(comm, chunks)
     totals = S.sum(axis=1) * row_elems
     pad = int(totals.max())
@@ -195,11 +199,11 @@ class ShmComm:
             self.timeout), "reducescatter")
         return out
 
-    def alltoall(self, chunks) -> list:
+    def alltoall(self, chunks, meta=None) -> list:
         """Ragged alltoall via allgather-then-pick — within a host the
         shared segment is memory bandwidth, so the P× read amplification
         of gather-and-pick costs less than P extra barrier rounds."""
-        return alltoall_via_allgather(self, chunks)
+        return alltoall_via_allgather(self, chunks, meta=meta)
 
     def close(self) -> None:
         if getattr(self, "_h", None):

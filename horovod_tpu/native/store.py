@@ -138,12 +138,32 @@ class StoreClient:
             off += n
         return blobs
 
+    def reduce(self, key: str, size: int, rank: int, blob: bytes,
+               is_or: bool = False, timeout: Optional[float] = None,
+               max_bytes: int = 1 << 20) -> bytes:
+        """Join-and-reduce (OP_REDUCE): post `blob`, block until all
+        `size` members posted under `key`, return the bitwise AND (or
+        OR) of every member's blob. Reply is O(len(blob)) — unlike
+        gather's O(size*len(blob)) fan-out — which is what makes the
+        negotiation bitvector round affordable at P=64
+        (benchmarks/store_service_time.py)."""
+        out = _buf(max_bytes)
+        outlen = ctypes.c_uint32(0)
+        t = -1.0 if timeout is None else float(timeout)
+        with self._lock:
+            st = self._lib.hvd_client_reduce(
+                self._h, key.encode(), t, size, rank,
+                1 if is_or else 0, _as_u8p(blob), len(blob), out,
+                max_bytes, ctypes.byref(outlen))
+            return self._finish(st, out, outlen, f"reduce({key})")
+
     def stat(self) -> dict:
         """Server live-state counts after a forced TTL sweep
-        ({"data": n, "gathers": m}) — the leak-check hook."""
-        out = _buf(256)
+        ({"data": n, "gathers": m, "reduces": k, "svc_*": ...}) — the
+        leak-check + service-time hook."""
+        out = _buf(512)
         outlen = ctypes.c_uint32(0)
-        _check(self._lib.hvd_client_stat(self._h, out, 256,
+        _check(self._lib.hvd_client_stat(self._h, out, 512,
                                          ctypes.byref(outlen)), "stat")
         txt = bytes(out[:outlen.value]).decode()
         return {k: int(v) for k, v in

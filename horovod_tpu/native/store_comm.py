@@ -101,12 +101,12 @@ class StoreComm:
         return red.reshape(-1)[self.rank * chunk:
                                (self.rank + 1) * chunk].copy()
 
-    def alltoall(self, chunks) -> list:
+    def alltoall(self, chunks, meta=None) -> list:
         """Ragged alltoall — star fallback (gather-and-pick through the
         store server). The p2p ring is the wire-efficient default; this
         exists so HOROVOD_PLANE_P2P=0 networks keep the full op surface."""
         from .shm import alltoall_via_allgather
-        return alltoall_via_allgather(self, chunks)
+        return alltoall_via_allgather(self, chunks, meta=meta)
 
     def close(self) -> None:
         self._c.close()
@@ -188,7 +188,7 @@ class HybridComm:
         return red.reshape(-1)[self.rank * chunk:
                                (self.rank + 1) * chunk].copy()
 
-    def alltoall(self, chunks) -> list:
+    def alltoall(self, chunks, meta=None) -> list:
         """Ragged alltoall, two-level: intra-host pairs resolve in the
         shm segment; cross-host rows are aggregated into ONE bundle per
         (host, host) pair at the local roots and exchanged over the
@@ -201,10 +201,11 @@ class HybridComm:
             if self._store is None:                 # size 1
                 chunks = check_alltoall_chunks(self.size, chunks)
                 return [chunks[0].copy()]
-            return self._store.alltoall(chunks)
+            return self._store.alltoall(chunks, meta=meta)
         L, C = self._local_size, self._cross_size
         lr, xr = self._local_rank, self._cross_rank
         chunks, dtype, trail, row_elems, S = \
+            meta if meta is not None else \
             negotiate_alltoall_meta(self, chunks)
         out: list = [None] * self.size
         # stage A: shm-gather every local rank's full (padded) sendset;

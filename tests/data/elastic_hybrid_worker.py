@@ -1,14 +1,21 @@
 """Elastic x hybrid (tp>1) worker — launched by
-test_elastic_integration.py (VERDICT r3 item 9 tier-3 coverage).
+test_elastic_integration.py (VERDICT r3 item 9 + r4 item 6 tier-3
+coverage).
 
-4 processes x 1 CPU device train a tp=2-sharded model under
-`ElasticMeshSpec(tp=2)` (dp=2). At SHRINK_AT_STEP rank 0 rewrites the
-discovery hostfile to 2 slots; the driver terminates the round and
-relaunches 2 workers. The new incarnation rebuilds the mesh from the
-SAME spec (now dp=1, tp=2 — dp absorbed the resize), restores the last
-committed host-tree checkpoint, re-places it with the partition rules
-(reshard-on-restore), and trains to completion. Model-parallel layout
-never changes across the resize.
+ELASTIC_RESIZE_MODE=shrink (default): 4 processes x 1 CPU device train a
+tp=2-sharded model under `ElasticMeshSpec(tp=2)` (dp=2). At
+RESIZE_AT_STEP rank 0 rewrites the discovery hostfile to 2 slots; the
+driver terminates the round and relaunches 2 workers. The new
+incarnation rebuilds the mesh from the SAME spec (now dp=1, tp=2 — dp
+absorbed the resize), restores the last committed host-tree checkpoint,
+re-places it with the partition rules (reshard-on-restore), and trains
+to completion. Model-parallel layout never changes across the resize.
+
+ELASTIC_RESIZE_MODE=grow: the symmetric direction (reference
+driver.py:240-283 rank-preserving reassignment on ADDED hosts) — the
+job starts on 2 workers (dp=1 x tp=2), rank 0 grows the hostfile to 4
+slots mid-run, and the 4-worker relaunch expands dp 1 -> 2 under the
+unchanged tp=2 layout, resuming from the committed checkpoint.
 """
 import hashlib
 import json
@@ -32,12 +39,17 @@ from horovod_tpu.parallel.tp import PartitionRules, shard_params  # noqa: E402
 
 TARGET_STEPS = 12
 COMMIT_EVERY = 3
-SHRINK_AT_STEP = 5
+RESIZE_AT_STEP = 5
 
 OUT = os.environ["ELASTIC_TRAIN_OUT"]
 LOG = os.path.join(OUT, "events.log")
 HOSTFILE = os.environ["ELASTIC_TEST_HOSTFILE"]
-SHRINK_FLAG = os.path.join(OUT, "shrunk.flag")
+MODE = os.environ.get("ELASTIC_RESIZE_MODE", "shrink")
+#: world size of the FIRST incarnation (the one that triggers the resize)
+#: and the hostfile slot count it rewrites to
+FROM_WORLD, TO_SLOTS = (4, 2) if MODE == "shrink" else (2, 4)
+RESIZE_FLAG = os.path.join(
+    OUT, "shrunk.flag" if MODE == "shrink" else "grown.flag")
 CKPT_DIR = os.path.join(OUT, "ckpt")
 
 SPEC = ElasticMeshSpec(tp=2)
@@ -130,18 +142,18 @@ def main() -> None:
             log(f"commit rank={rank} step={state.step} "
                 f"hash={tree_hash(state.params)}")
 
-        if state.step == SHRINK_AT_STEP and world == 4 \
-                and not os.path.exists(SHRINK_FLAG):
+        if state.step == RESIZE_AT_STEP and world == FROM_WORLD \
+                and not os.path.exists(RESIZE_FLAG):
             if rank == 0:
-                with open(SHRINK_FLAG, "w") as f:
+                with open(RESIZE_FLAG, "w") as f:
                     f.write("1")
                 with open(HOSTFILE, "w") as f:
-                    f.write("localhost:2\n")
-                log(f"shrink rank={rank} step={state.step}")
+                    f.write(f"localhost:{TO_SLOTS}\n")
+                log(f"{MODE} rank={rank} step={state.step}")
 
-        if os.path.exists(SHRINK_FLAG) and world == 4:
+        if os.path.exists(RESIZE_FLAG) and world == FROM_WORLD:
             # parked: the driver observes the host-set change and
-            # terminates this incarnation; the 2-worker relaunch resumes
+            # terminates this incarnation; the resized relaunch resumes
             time.sleep(120)
             sys.exit(3)                  # driver should have killed us
 

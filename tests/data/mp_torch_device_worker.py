@@ -118,6 +118,33 @@ def main(out_dir: str) -> None:
     assert all(p == peers[0] for p in peers)
     result["optimizer"] = "ok"
 
+    # --- ragged alltoall on the device plane (round 5) -------------------
+    # rank r sends (r + d + 1) rows of value 100*r + d to dst d; total
+    # payload is over threshold and fill is high, so the route must be
+    # the device mesh's all_to_all (pad-to-max), and results must equal
+    # the host ring's exactly.
+    chunks = [np.full((r + d + 1, 8), float(100 * r + d), np.float32)
+              for d in range(n)]
+    before = dp.stats["alltoall"]
+    got = _plane.comm_alltoall(_plane.comm(),
+                               [c.copy() for c in chunks])
+    assert dp.stats["alltoall"] == before + 1, \
+        "ragged alltoall must route device"
+    host_a2a = _plane.comm().alltoall([c.copy() for c in chunks])
+    assert len(got) == n
+    for s in range(n):
+        expect = np.full((s + r + 1, 8), float(100 * s + r), np.float32)
+        assert np.array_equal(np.asarray(got[s]), expect), (s, got[s])
+        assert np.array_equal(np.asarray(got[s]),
+                              np.asarray(host_a2a[s]))
+    # skewed payload stays on the host ring (fill ratio gate)
+    skew = [np.zeros((512 if d == 0 and r == 0 else 0, 8), np.float32)
+            for d in range(n)]
+    before = dp.stats["alltoall"]
+    _plane.comm_alltoall(_plane.comm(), skew)
+    assert dp.stats["alltoall"] == before, "skewed alltoall must stay host"
+    result["alltoall"] = "ok"
+
     result["ok"] = True
     with open(os.path.join(out_dir, f"result.{r}.json"), "w") as f:
         json.dump(result, f)

@@ -78,6 +78,53 @@ def test_eligibility_is_rank_invariant_facts_only(local_plane):
     assert not dp.eligible("allgather", np.zeros((64, 64), np.bool_))
 
 
+def test_alltoall_program_matches_numpy(local_plane):
+    """Pad-to-max device alltoall (round 5): stacked[src, dst] rows land
+    transposed at [dst, src] with padding intact."""
+    rng = np.random.RandomState(2)
+    n, m = 8, 4
+    x = rng.randn(n, n, m, 3).astype(np.float32)
+    got = dp.run_stacked_alltoall(x)
+    np.testing.assert_array_equal(got, x.transpose(1, 0, 2, 3))
+
+
+def test_alltoall_ragged_roundtrip(local_plane):
+    """Full ragged path: chunks of uneven row counts, negotiated S, per-
+    src slices exactly equal the sender's rows (init_local me=0 view)."""
+    rng = np.random.RandomState(3)
+    n = 8
+    S = rng.randint(0, 5, (n, n)).astype(np.int64)
+    # device route sees only rank 0's staging in init_local mode, so
+    # oracle through run_stacked_alltoall with all ranks' padded rows
+    m = int(S.max())
+    stacked = np.zeros((n, n, m, 3), np.float32)
+    sent = {}
+    for s in range(n):
+        for d in range(n):
+            rows = rng.randn(int(S[s, d]), 3).astype(np.float32)
+            sent[(s, d)] = rows
+            stacked[s, d, :rows.shape[0]] = rows
+    got = dp.run_stacked_alltoall(stacked)       # [dst, src, m, 3]
+    for d in range(n):
+        for s in range(n):
+            np.testing.assert_array_equal(
+                got[d, s, :int(S[s, d])], sent[(s, d)])
+
+
+def test_alltoall_eligibility_fill_ratio(local_plane):
+    dp._state["threshold"] = 1024
+    n = 8
+    dense = np.full((n, n), 8, np.int64)         # fill = 1.0
+    assert dp.alltoall_eligible(dense, np.float32, row_bytes=256)
+    skewed = np.zeros((n, n), np.int64)
+    skewed[0, 0] = 512                           # fill = 1/64
+    assert not dp.alltoall_eligible(skewed, np.float32, row_bytes=256)
+    assert not dp.alltoall_eligible(dense, np.float32, row_bytes=1)
+    assert not dp.alltoall_eligible(dense, np.float64, row_bytes=256)
+    empty = np.zeros((n, n), np.int64)
+    assert not dp.alltoall_eligible(empty, np.float32, row_bytes=256)
+
+
 def test_inactive_plane_routes_nothing():
     assert not dp.is_active()
     assert not dp.eligible("allreduce", np.zeros((1 << 20,), np.float32),

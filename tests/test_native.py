@@ -141,6 +141,51 @@ def test_coordinator_bitand_bitor(server):
         assert or_bits == bytes([0x8F])
 
 
+def test_store_reduce_op(server):
+    """OP_REDUCE (round-5): server-side bitwise AND/OR with O(blob)
+    replies — the negotiation fast path's transport. Checks AND and OR
+    results, idempotent re-post after a timeout, and that completed
+    rounds leave no server state (leak check via stat)."""
+    import threading
+
+    from horovod_tpu.native.store import NativeTimeout, StoreClient
+
+    size = 4
+    clients = [StoreClient("127.0.0.1", server.port) for _ in range(size)]
+
+    # a lone early member with timeout=0 gets ST_TIMEOUT, then re-posts
+    try:
+        clients[0].reduce("red/and", size, 0, bytes([0x81]), timeout=0.0)
+        assert False, "expected timeout"
+    except NativeTimeout:
+        pass
+
+    results = [None] * size
+
+    def member(r):
+        mine = bytes([(1 << r) | 0x80])
+        results[r] = (
+            clients[r].reduce("red/and", size, r, mine, timeout=30.0),
+            clients[r].reduce("red/or", size, r, mine, is_or=True,
+                              timeout=30.0))
+
+    threads = [threading.Thread(target=member, args=(r,))
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for and_bits, or_bits in results:
+        assert and_bits == bytes([0x80])
+        assert or_bits == bytes([0x8F])
+
+    st = clients[0].stat()
+    assert st["reduces"] == 0          # both rounds fully drained
+    assert st["svc_reduce_n"] >= 2 * size
+    for c in clients:
+        c.close()
+
+
 def test_coordinator_single_rank(server):
     coord = Coordinator("127.0.0.1", server.port, 0, 1)
     coord.barrier("solo")
