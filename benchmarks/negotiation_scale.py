@@ -30,27 +30,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _worker(rank: int, size: int, port: int, rounds: int, payload: int,
-            out_q) -> None:
+            pattern: str, out_q) -> None:
     from horovod_tpu.native.store import Coordinator
     c = Coordinator("127.0.0.1", port, rank, size, timeout=120.0)
     blob = bytes(payload)
+    probe = bytes(16) + bytes([0xFF]) * 16   # [digest, ~digest] shape
     c.barrier("warmup")
     t0 = time.monotonic()
-    for r in range(rounds):
-        c.allgather(blob, tag=f"negot-{r}")
+    if pattern == "steady":
+        # the engine's round-5 steady-state wire op: ONE 32-byte
+        # OP_REDUCE equality probe per round (engine.py _negotiate)
+        for r in range(rounds):
+            c.bitand(probe, tag=f"negot-eq-{r}")
+    else:
+        for r in range(rounds):
+            c.allgather(blob, tag=f"negot-{r}")
     dt = time.monotonic() - t0
     if rank == 0:
         out_q.put(dt)
     c.close()
 
 
-def measure(procs: int, rounds: int, payload: int) -> dict:
+def measure(procs: int, rounds: int, payload: int,
+            pattern: str = "allgather") -> dict:
     from horovod_tpu.native.store import StoreServer
     server = StoreServer()
     ctx = mp.get_context("spawn")
     out_q = ctx.Queue()
     ps = [ctx.Process(target=_worker,
-                      args=(i, procs, server.port, rounds, payload, out_q),
+                      args=(i, procs, server.port, rounds, payload,
+                            pattern, out_q),
                       daemon=True)
           for i in range(procs)]
     t_start = time.monotonic()
@@ -62,8 +71,9 @@ def measure(procs: int, rounds: int, payload: int) -> dict:
     server.close()
     return {
         "procs": procs,
+        "pattern": pattern,
         "rounds": rounds,
-        "payload_bytes": payload,
+        "payload_bytes": payload if pattern != "steady" else 32,
         "rounds_per_s": round(rounds / dt, 1),
         "round_ms": round(1000.0 * dt / rounds, 3),
         "wall_s": round(time.monotonic() - t_start, 1),
@@ -75,10 +85,13 @@ def main() -> None:
     ap.add_argument("--procs", default="8,16,32,64")
     ap.add_argument("--rounds", type=int, default=200)
     ap.add_argument("--payload", type=int, default=90)
+    ap.add_argument("--patterns", default="allgather,steady")
     args = ap.parse_args()
-    for p in [int(x) for x in args.procs.split(",")]:
-        print(json.dumps(measure(p, args.rounds, args.payload)),
-              flush=True)
+    for pattern in args.patterns.split(","):
+        for p in [int(x) for x in args.procs.split(",")]:
+            print(json.dumps(measure(p, args.rounds, args.payload,
+                                     pattern)),
+                  flush=True)
 
 
 if __name__ == "__main__":

@@ -389,71 +389,27 @@ class StoreServer {
             alive = send_frame(fd, ST_ERROR, "bad gather args");
             break;
           }
-          std::unique_lock<std::mutex> lk(mu_);
-          SweepLocked(false);
-          // Service-time instrumentation: count only the handler's WORK
-          // (post/merge under the lock + reply copy/send), never mutex
-          // acquisition, the rate-guarded sweep (excluded in the reduce
-          // handler too — the two counters must stay comparable), or
-          // the condvar wait for other members — the measurement must
-          // stay meaningful on an oversubscribed host where wait times
-          // are scheduling noise (docs/benchmarks.md round-5
-          // control-plane isolation).
-          auto svc_w1 = std::chrono::steady_clock::now();
-          GatherState& g = gathers_[key];
-          g.touch = std::chrono::steady_clock::now();
-          if (!g.complete) {
-            // idempotent re-post (a member retrying after timeout)
-            g.blobs[grank] = val.substr(16);
-            if (static_cast<int>(g.blobs.size()) == gsize) {
-              std::string res;
-              for (auto& kv : g.blobs) {
-                uint32_t blen = static_cast<uint32_t>(kv.second.size());
-                res.append(reinterpret_cast<char*>(&blen), 4);
-                res.append(kv.second);
-              }
-              g.result = std::move(res);
-              g.complete = true;
-              g.reads_left = gsize;
-              g.blobs.clear();
-              cv_.notify_all();
-            }
-          }
-          auto gready = [&] {
-            auto it = gathers_.find(key);
-            return (it != gathers_.end() && it->second.complete) ||
-                   shutting_down_.load();
-          };
-          g.waiters++;           // pin against the TTL sweep while blocked
-          uint64_t svc_pre_ns = static_cast<uint64_t>(
-              std::chrono::duration_cast<std::chrono::nanoseconds>(
-                  std::chrono::steady_clock::now() - svc_w1)
-                  .count());
-          bool got = WaitPred(lk, timeout_s, fd, gready) &&
-                     !shutting_down_.load();
-          auto svc_w2 = std::chrono::steady_clock::now();
-          auto git = gathers_.find(key);
-          if (git != gathers_.end()) {
-            git->second.waiters--;
-            git->second.touch = std::chrono::steady_clock::now();
-          }
-          if (!got) {
-            lk.unlock();
-            RecordSvc(&svc_gather_, svc_pre_ns, svc_w2,
-                      std::chrono::steady_clock::now());
-            auto ts = std::chrono::steady_clock::now();
-            alive = send_frame(fd, ST_TIMEOUT, "");
-            RecordSend(&svc_gather_, ts);
-            break;
-          }
-          std::string gout = git->second.result;
-          if (--git->second.reads_left == 0) gathers_.erase(git);
-          lk.unlock();
-          RecordSvc(&svc_gather_, svc_pre_ns, svc_w2,
-                    std::chrono::steady_clock::now());
-          auto ts = std::chrono::steady_clock::now();
-          alive = send_frame(fd, ST_OK, gout);
-          RecordSend(&svc_gather_, ts);
+          alive = JoinRound(
+              fd, gathers_, &svc_gather_, key, timeout_s,
+              [&](GatherState& g) -> const char* {
+                if (g.complete) return nullptr;
+                // idempotent re-post (a member retrying after timeout)
+                g.blobs[grank] = val.substr(16);
+                if (static_cast<int>(g.blobs.size()) == gsize) {
+                  std::string res;
+                  for (auto& kv : g.blobs) {
+                    uint32_t blen =
+                        static_cast<uint32_t>(kv.second.size());
+                    res.append(reinterpret_cast<char*>(&blen), 4);
+                    res.append(kv.second);
+                  }
+                  g.result = std::move(res);
+                  g.blobs.clear();
+                  CompleteLocked(g, gsize);
+                }
+                return nullptr;
+              },
+              [](GatherState& g) -> std::string& { return g.result; });
           break;
         }
         case OP_REDUCE: {
@@ -474,71 +430,32 @@ class StoreServer {
             alive = send_frame(fd, ST_ERROR, "bad reduce args");
             break;
           }
-          std::unique_lock<std::mutex> lk(mu_);
-          SweepLocked(false);
-          auto svc_w1 = std::chrono::steady_clock::now();
-          ReduceState& r = reduces_[key];
-          r.touch = std::chrono::steady_clock::now();
-          if (!r.complete && !r.posted.count(grank)) {
-            const char* blob = val.data() + 17;
-            size_t blen = val.size() - 17;
-            if (r.posted.empty()) {
-              r.acc.assign(blob, blen);
-              r.kind = kind;
-            } else if (blen != r.acc.size()) {
-              lk.unlock();
-              alive = send_frame(fd, ST_ERROR, "reduce size mismatch");
-              break;
-            } else {
-              uint8_t* a = reinterpret_cast<uint8_t*>(&r.acc[0]);
-              const uint8_t* b = reinterpret_cast<const uint8_t*>(blob);
-              if (r.kind == 0)
-                for (size_t i = 0; i < blen; ++i) a[i] &= b[i];
-              else
-                for (size_t i = 0; i < blen; ++i) a[i] |= b[i];
-            }
-            r.posted.insert(grank);
-            if (static_cast<int>(r.posted.size()) == gsize) {
-              r.complete = true;
-              r.reads_left = gsize;
-              cv_.notify_all();
-            }
-          }
-          auto rready = [&] {
-            auto it = reduces_.find(key);
-            return (it != reduces_.end() && it->second.complete) ||
-                   shutting_down_.load();
-          };
-          r.waiters++;
-          uint64_t svc_pre_ns = static_cast<uint64_t>(
-              std::chrono::duration_cast<std::chrono::nanoseconds>(
-                  std::chrono::steady_clock::now() - svc_w1)
-                  .count());
-          bool got = WaitPred(lk, timeout_s, fd, rready) &&
-                     !shutting_down_.load();
-          auto svc_w2 = std::chrono::steady_clock::now();
-          auto rit = reduces_.find(key);
-          if (rit != reduces_.end()) {
-            rit->second.waiters--;
-            rit->second.touch = std::chrono::steady_clock::now();
-          }
-          if (!got) {
-            lk.unlock();
-            RecordSvc(&svc_reduce_, svc_pre_ns, svc_w2,
-                      std::chrono::steady_clock::now());
-            auto ts = std::chrono::steady_clock::now();
-            alive = send_frame(fd, ST_TIMEOUT, "");
-            RecordSend(&svc_reduce_, ts);
-            break;
-          }
-          std::string rout = rit->second.acc;
-          if (--rit->second.reads_left == 0) reduces_.erase(rit);
-          lk.unlock();
-          RecordSvc(&svc_reduce_, svc_pre_ns, svc_w2,
-                    std::chrono::steady_clock::now());
-          auto ts = std::chrono::steady_clock::now();
-          alive = send_frame(fd, ST_OK, rout);
-          RecordSend(&svc_reduce_, ts);
+          alive = JoinRound(
+              fd, reduces_, &svc_reduce_, key, timeout_s,
+              [&](ReduceState& r) -> const char* {
+                if (r.complete || r.posted.count(grank)) return nullptr;
+                const char* blob = val.data() + 17;
+                size_t blen = val.size() - 17;
+                if (r.posted.empty()) {
+                  r.acc.assign(blob, blen);
+                  r.kind = kind;
+                } else if (blen != r.acc.size()) {
+                  return "reduce size mismatch";
+                } else {
+                  uint8_t* a = reinterpret_cast<uint8_t*>(&r.acc[0]);
+                  const uint8_t* b =
+                      reinterpret_cast<const uint8_t*>(blob);
+                  if (r.kind == 0)
+                    for (size_t i = 0; i < blen; ++i) a[i] &= b[i];
+                  else
+                    for (size_t i = 0; i < blen; ++i) a[i] |= b[i];
+                }
+                r.posted.insert(grank);
+                if (static_cast<int>(r.posted.size()) == gsize)
+                  CompleteLocked(r, gsize);
+                return nullptr;
+              },
+              [](ReduceState& r) -> std::string& { return r.acc; });
           break;
         }
         case OP_STAT: {
@@ -646,6 +563,80 @@ class StoreServer {
     while (ns > prev && !c->max_ns.compare_exchange_weak(
                             prev, ns, std::memory_order_relaxed)) {
     }
+  }
+
+  // mu_ held. Mark a join-round complete and wake its waiters.
+  template <typename State>
+  void CompleteLocked(State& st, int gsize) {
+    st.complete = true;
+    st.reads_left = gsize;
+    cv_.notify_all();
+  }
+
+  // Shared join-round skeleton for OP_GATHER / OP_REDUCE: post/merge
+  // under the lock, wait for round completion (requester-death aware,
+  // TTL-sweep pinned), drain one read slot, reply. The service-time
+  // spans are measured HERE so the two ops' counters stay comparable by
+  // construction: only the handler's WORK (post/merge under the lock +
+  // result copy) counts — never mutex acquisition, the rate-guarded
+  // sweep, the condvar wait for other members, or the reply-send
+  // syscall (recorded separately; it can absorb TCP drain blocking) —
+  // so the measurement stays meaningful on an oversubscribed host where
+  // wait times are scheduling noise (docs/benchmarks.md round-5
+  // control-plane isolation).
+  //
+  // `post(state)` folds this member's payload in (completing the round
+  // when it is the last member); it returns nullptr or a protocol-error
+  // message. `result(state)` yields the completed round's reply.
+  template <typename StateMap, typename Post, typename Result>
+  bool JoinRound(int fd, StateMap& states, SvcCounters* svc,
+                 const std::string& key, double timeout_s, Post post,
+                 Result result) {
+    std::unique_lock<std::mutex> lk(mu_);
+    SweepLocked(false);
+    auto svc_w1 = std::chrono::steady_clock::now();
+    auto& st = states[key];
+    st.touch = svc_w1;
+    const char* err = post(st);
+    if (err != nullptr) {
+      lk.unlock();
+      return send_frame(fd, ST_ERROR, err);
+    }
+    auto ready = [&] {
+      auto it = states.find(key);
+      return (it != states.end() && it->second.complete) ||
+             shutting_down_.load();
+    };
+    st.waiters++;  // pin against the TTL sweep while blocked
+    uint64_t svc_pre_ns = static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - svc_w1)
+            .count());
+    bool got = WaitPred(lk, timeout_s, fd, ready) &&
+               !shutting_down_.load();
+    auto svc_w2 = std::chrono::steady_clock::now();
+    auto it = states.find(key);
+    if (it != states.end()) {
+      it->second.waiters--;
+      it->second.touch = std::chrono::steady_clock::now();
+    }
+    if (!got) {
+      lk.unlock();
+      RecordSvc(svc, svc_pre_ns, svc_w2,
+                std::chrono::steady_clock::now());
+      auto ts = std::chrono::steady_clock::now();
+      bool alive = send_frame(fd, ST_TIMEOUT, "");
+      RecordSend(svc, ts);
+      return alive;
+    }
+    std::string out = result(it->second);
+    if (--it->second.reads_left == 0) states.erase(it);
+    lk.unlock();
+    RecordSvc(svc, svc_pre_ns, svc_w2, std::chrono::steady_clock::now());
+    auto ts = std::chrono::steady_clock::now();
+    bool alive = send_frame(fd, ST_OK, out);
+    RecordSend(svc, ts);
+    return alive;
   }
 
   // mu_ held. Expire orphaned state: read-counted entries and gather

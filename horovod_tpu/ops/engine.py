@@ -201,6 +201,10 @@ class Engine:
         self._last_sent_sig = None
         self._peer_meta_cache: Dict[int, Tuple] = {}
         self.negot_cache_hits = 0
+        # steady-state equality rounds that skipped the blob allgather
+        # entirely (one O(blob)-reply OP_REDUCE probe instead of the
+        # O(P*blob) gather fan-out)
+        self.negot_eq_rounds = 0
         # join state (JoinOp, collective_operations.cc:418-432): while
         # _joined, the engine keeps negotiating with an empty queue and
         # contributes zero-filled tensors to peers' allreduces
@@ -643,18 +647,52 @@ class Engine:
         # retry coordinator timeouts until the engine stops. Re-posting the
         # same tag/value is idempotent in the native store.
         from ..native.store import NativeTimeout
-        while True:
-            try:
-                blobs = coord.allgather(json.dumps(payload).encode(),
-                                        tag=f"engine-negot-{rnd}")
-                break
-            except NativeTimeout:
-                if not self._running:
-                    raise
-                logger.warning(
-                    "negotiation round %d still waiting for peers "
-                    "(stall_inspector analog)", rnd)
-        peers = [json.loads(b.decode()) for b in blobs]
+
+        def _collective(fn, what):
+            while True:
+                try:
+                    return fn()
+                except NativeTimeout:
+                    if not self._running:
+                        raise
+                    logger.warning(
+                        "negotiation round %d still waiting for peers "
+                        "(%s; stall_inspector analog)", rnd, what)
+
+        # Steady-state fast path (round 5): ONE bitwise-AND OP_REDUCE of
+        # [digest, ~digest] decides whether every process's payload is
+        # byte-identical — AND(~x) == ~OR(x), so "all equal" is exactly
+        # first_half == ~second_half, computed from the REDUCED result
+        # the server hands every member identically (rank-invariant
+        # branch, no divergence possible). In the steady state of a
+        # training loop (same tensor batch, same tunables, no join
+        # transitions) this replaces the O(P*blob)-reply gather with an
+        # O(32B)-reply reduce — 531 us vs 1.65 ms per round at P=64
+        # (docs/benchmarks.md round-5 service-time table). On any
+        # mismatch (new tensor set, joined flag flip, autotune move,
+        # ragged metas whose per-rank sizes legitimately differ) the
+        # round falls back to the full blob allgather below.
+        payload_bytes = json.dumps(payload).encode()
+        digest = hashlib.sha1(payload_bytes,
+                              usedforsecurity=False).digest()[:16]
+        probe = digest + bytes(~b & 0xFF for b in digest)
+        red = _collective(
+            lambda: coord.bitand(probe, tag=f"engine-negot-eq-{rnd}"),
+            "equality probe")
+        all_equal = red[:16] == bytes(~b & 0xFF for b in red[16:]) and \
+            red[:16] == digest
+        if all_equal:
+            self.negot_eq_rounds += 1
+            # parse once; downstream only mutates the top-level "w" key,
+            # so per-peer shallow copies keep peer independence
+            template = json.loads(payload_bytes.decode())
+            peers = [dict(template) for _ in range(coord.size)]
+        else:
+            blobs = _collective(
+                lambda: coord.allgather(payload_bytes,
+                                        tag=f"engine-negot-{rnd}"),
+                "meta allgather")
+            peers = [json.loads(b.decode()) for b in blobs]
         self.fusion_threshold = peers[0].get("ft", self.fusion_threshold)
         self._state.config.hierarchical_allreduce = peers[0].get(
             "tl", self._state.config.hierarchical_allreduce)

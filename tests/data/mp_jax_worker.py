@@ -244,6 +244,10 @@ def main(out_dir: str) -> None:
     assert eng.negot_cache_hits > hits_before, (
         eng.negot_cache_hits, hits_before)
     result["negot_cache_hits"] = eng.negot_cache_hits
+    # round 5: identical steady-state payloads must ALSO skip the blob
+    # allgather via the OP_REDUCE equality probe (O(blob) reply)
+    assert eng.negot_eq_rounds > 0, eng.negot_eq_rounds
+    result["negot_eq_rounds"] = eng.negot_eq_rounds
 
     # --- GSPMD dp x tp train step across processes -----------------------
     # params sharded by Megatron rules over a mesh spanning both
