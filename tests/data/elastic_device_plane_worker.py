@@ -1,0 +1,86 @@
+"""Elastic x device-plane worker — launched by test_elastic_integration.py.
+
+Round-5 composition coverage: the torch binding's DEVICE data plane
+(interop/_device_plane.py — jax.distributed collectives over the plane
+mesh, the reference's NCCL role) must survive an elastic reset. Rank 1
+crashes mid-run; the driver resets and relaunches; the NEW incarnation's
+fresh processes must re-form the jax.distributed mesh from the new
+coordinator address, resume from the committed step, and keep routing
+large tensors through the device plane with exact results.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+os.environ["HOROVOD_DEVICE_PLANE"] = "1"
+os.environ["HOROVOD_DEVICE_PLANE_THRESHOLD"] = "1024"
+
+import torch  # noqa: E402
+
+import horovod_tpu.interop.torch as hvd  # noqa: E402
+from horovod_tpu.interop import _device_plane as dp  # noqa: E402
+
+TARGET_STEPS = 8
+KILL_AT_STEP = 3
+
+OUT = os.environ["ELASTIC_TRAIN_OUT"]
+LOG = os.path.join(OUT, "events.log")
+STATE = os.path.join(OUT, "state.json")
+KILLED = os.path.join(OUT, "killed.flag")
+
+
+def log(msg: str) -> None:
+    with open(LOG, "a") as f:
+        f.write(msg + "\n")
+
+
+def main() -> None:
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    # the plane must come up in EVERY incarnation (fresh processes, new
+    # coordinator address from the relaunched round)
+    assert dp.is_active(), "device plane must re-form after a reset"
+
+    step = 0
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            step = json.load(f)["step"]
+    log(f"incarnation rank={r} world={n} plane=1 resume_step={step}")
+
+    while step < TARGET_STEPS:
+        step += 1
+        before = dp.stats["allreduce"]
+        t = torch.full((1024,), float(r + step))       # 4 KB >= 1 KB
+        hvd.allreduce_(t, op=hvd.Sum)
+        want = float(n * step + n * (n - 1) // 2)
+        assert torch.equal(t, torch.full((1024,), want)), (step, t[0])
+        assert dp.stats["allreduce"] == before + 1, \
+            "large tensor must route through the device plane"
+        log(f"step rank={r} step={step}")
+
+        if r == 0:
+            tmp = STATE + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": step}, f)
+            os.replace(tmp, STATE)
+            log(f"commit rank=0 step={step}")
+
+        if step == KILL_AT_STEP and r == 1 and not os.path.exists(KILLED):
+            with open(KILLED, "w") as f:
+                f.write("1")
+            log(f"kill rank={r} step={step}")
+            os._exit(1)
+
+    with open(os.path.join(OUT, f"final.{r}.json"), "w") as f:
+        json.dump({"rank": r, "world": n, "step": step,
+                   "device_allreduces": dp.stats["allreduce"]}, f)
+    log(f"done rank={r} step={step}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

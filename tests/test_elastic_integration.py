@@ -199,6 +199,61 @@ def test_elastic_launcher_completes_without_change(tmp_path):
     assert {ln.split()[1] for ln in done} == {"rank=0", "rank=1"}
 
 
+def test_elastic_reset_reforms_device_plane(tmp_path):
+    """Round-5 composition: the torch binding's DEVICE data plane
+    (jax.distributed collectives — the NCCL role) must survive an
+    elastic reset. Rank 1 crashes mid-run; the relaunched incarnation
+    re-forms the plane mesh from the fresh coordinator address, resumes
+    from the committed step, and keeps routing large tensors through
+    the device plane with exact results."""
+    import glob
+    import json
+
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:2\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hostfile}\n")
+    disc.chmod(0o755)
+    worker = os.path.join(REPO, "tests", "data",
+                          "elastic_device_plane_worker.py")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TRAIN_OUT"] = str(tmp_path)
+
+    driver_log = open(tmp_path / "driver.log", "w")
+    try:
+        rc = subprocess.call(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "2", "--min-np", "2", "--max-np", "2",
+             "--host-discovery-script", str(disc),
+             sys.executable, worker],
+            env=env, stdout=driver_log, stderr=subprocess.STDOUT,
+            cwd=str(tmp_path), timeout=420)
+    finally:
+        driver_log.close()
+    log = _log_lines(str(tmp_path / "events.log"))
+    assert rc == 0, f"driver rc={rc}\nevents:\n" + "\n".join(log[-30:]) + \
+        "\ndriver:\n" + "\n".join(
+            _log_lines(str(tmp_path / "driver.log"))[-20:])
+
+    # the crash was injected, and BOTH incarnations had the plane up
+    assert os.path.exists(tmp_path / "killed.flag")
+    inc = [ln for ln in log if ln.startswith("incarnation ")]
+    assert len(inc) >= 4 and all("plane=1" in ln for ln in inc), inc
+    # the relaunch resumed from a committed step, not from scratch
+    resumes = [ln for ln in inc if "resume_step=0" not in ln]
+    assert len(resumes) >= 2, inc
+
+    finals = []
+    for path in sorted(glob.glob(str(tmp_path / "final.*.json"))):
+        with open(path) as f:
+            finals.append(json.load(f))
+    assert len(finals) == 2, (finals, log[-10:])
+    assert all(f["step"] == 8 and f["world"] == 2 and
+               f["device_allreduces"] > 0 for f in finals)
+
+
 def test_elastic_grow_under_hybrid_tp_mesh(tmp_path):
     """Elastic x hybrid, growth direction (VERDICT r4 item 6): a REAL
     hvdrun elastic job training a tp=2-sharded model on 2 workers grows
