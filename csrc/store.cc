@@ -841,8 +841,11 @@ class Coordinator {
   }
 
   int Barrier(const std::string& tag, double timeout_s) {
-    std::string out;
-    return Allgather(tag, "", timeout_s, &out);
+    // one-byte server-side reduce: same join semantics as the blob
+    // allgather but with O(1) replies instead of the O(P) per-member
+    // fan-out (store_service_time.py measures the difference)
+    uint8_t bit = 1;
+    return BitReduce(tag, &bit, 1, /*is_and=*/true, timeout_s);
   }
 
   int Bcast(const std::string& tag, int root, std::string* blob,
