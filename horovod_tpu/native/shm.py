@@ -131,9 +131,16 @@ class ShmComm:
     """
 
     def __init__(self, name: str, rank: int, size: int,
-                 capacity: int = 64 << 20, timeout: float = 60.0,
+                 capacity: int = 64 << 20,
+                 timeout: Optional[float] = None,
                  gen: Optional[int] = None):
         import os
+        if timeout is None:
+            # collective-op timeout; the reference's knob for exactly
+            # this (a peer stalled in compile/data beyond it kills the
+            # job) is HOROVOD_GLOO_TIMEOUT_SECONDS (launch.py:56)
+            from ..core.config import _env_float
+            timeout = _env_float("HOROVOD_GLOO_TIMEOUT_SECONDS", 60.0)
         self._lib = lib()
         self.rank, self.size, self.timeout = rank, size, timeout
         self.capacity = capacity

@@ -44,7 +44,13 @@ class StoreComm:
     """
 
     def __init__(self, host: str, port: int, rank: int, size: int,
-                 prefix: str = "iplane", timeout: float = 300.0):
+                 prefix: str = "iplane",
+                 timeout: Optional[float] = None):
+        if timeout is None:
+            # reference HOROVOD_GLOO_TIMEOUT_SECONDS (launch.py:56):
+            # the collective-op stall bound, shared with the shm plane
+            from ..core.config import _env_float
+            timeout = _env_float("HOROVOD_GLOO_TIMEOUT_SECONDS", 300.0)
         ip = socket.gethostbyname(host)
         self._c = Coordinator(ip, port, rank, size, timeout=timeout)
         self.rank, self.size = rank, size

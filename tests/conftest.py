@@ -13,6 +13,15 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8").strip()
 
+# Collective-op stall bound for the binding plane (reference
+# HOROVOD_GLOO_TIMEOUT_SECONDS). The product default (60 s shm / 300 s
+# store) is right for real jobs, but a full-suite run oversubscribes
+# this 1-core container so badly that a worker can be starved past 60 s
+# INSIDE a barrier — the one observed suite flake
+# (test_keras_estimator_multiprocess, docs/round5_notes.md). Children
+# of every multiprocess test inherit this.
+os.environ.setdefault("HOROVOD_GLOO_TIMEOUT_SECONDS", "600")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -22,8 +22,12 @@ from _cpu_mesh import force_cpu_devices  # noqa: E402
 
 force_cpu_devices(2)
 
-# fast control-plane timeouts so the stall path runs in test time
-os.environ.setdefault("HOROVOD_GLOO_TIMEOUT_SECONDS", "2")
+# fast control-plane timeouts so the stall path runs in test time —
+# hard-set, not setdefault: the suite conftest exports a LARGE
+# HOROVOD_GLOO_TIMEOUT_SECONDS (anti-starvation on the 1-core
+# container) which children inherit, and this worker's whole point is
+# the fast-timeout failure path
+os.environ["HOROVOD_GLOO_TIMEOUT_SECONDS"] = "2"
 os.environ.setdefault("HOROVOD_STALL_CHECK_TIME_SECONDS", "1")
 os.environ.setdefault("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "5")
 
