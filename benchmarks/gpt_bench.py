@@ -16,7 +16,6 @@ import json
 import os
 import sys
 
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -39,6 +38,12 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=50304)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--logits-dtype", default="f32",
+                    choices=["f32", "bf16"],
+                    help="lm_head compute dtype (gpt family): bf16 runs "
+                    "the largest GEMM at MXU bf16 rate and halves "
+                    "logits/dlogits HBM bytes; CE math stays f32 inside "
+                    "the kernel")
     ap.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel degree (ring attention); "
                          "dp = devices // sp")
@@ -52,56 +57,30 @@ def main():
         ap.error("--iters must be positive")
 
     import jax
-    import jax.numpy as jnp
-    import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models.gpt import GPT, GPTConfig
-    from horovod_tpu.parallel.mesh_utils import make_mesh
-    from horovod_tpu.parallel.tp import gpt_partition_rules, shard_params
-    from horovod_tpu.training import make_gspmd_train_step
+    from benchmarks._gpt_step import build_gpt_train_step
+
+    if args.logits_dtype != "f32" and args.family != "gpt":
+        ap.error("--logits-dtype applies to the gpt family only")
 
     hvd.init()
     n_dev = hvd.size()
     platform = jax.devices()[0].platform
     if n_dev % args.sp:
         ap.error(f"--sp {args.sp} must divide device count {n_dev}")
-    mesh = make_mesh(dp=n_dev // args.sp, sp=args.sp)
     attention = args.attention or ("ring" if args.sp > 1 else "dense")
     if attention in ("ring", "ulysses", "zigzag") and args.sp <= 1:
         ap.error(f"--attention {attention} requires --sp > 1")
 
-    if args.family == "llama":
-        from horovod_tpu.models.llama import (Llama, LlamaConfig,
-                                              llama_partition_rules)
-        cfg = LlamaConfig(vocab_size=args.vocab, num_layers=args.layers,
-                          num_heads=args.heads, num_kv_heads=args.kv_heads,
-                          head_dim=args.head_dim, max_seq_len=args.seq,
-                          mesh=mesh, attention=attention,
-                          attention_impl=args.impl)
-        model, rules = Llama(cfg), llama_partition_rules()
-    else:
-        cfg = GPTConfig(vocab_size=args.vocab, num_layers=args.layers,
-                        num_heads=args.heads, head_dim=args.head_dim,
-                        max_seq_len=args.seq, mesh=mesh,
-                        attention=attention,
-                        attention_impl=args.impl)
-        model, rules = GPT(cfg), gpt_partition_rules()
+    step, params, opt, tokens, targets, n_params, _mesh = \
+        build_gpt_train_step(
+            family=args.family, impl=args.impl, layers=args.layers,
+            heads=args.heads, kv_heads=args.kv_heads,
+            head_dim=args.head_dim, seq=args.seq, batch=args.batch,
+            vocab=args.vocab, sp=args.sp, attention=attention,
+            logits_dtype=args.logits_dtype)
     B, S = args.batch * n_dev, args.seq
-    tokens = jnp.asarray(
-        np.random.RandomState(0).randint(0, args.vocab, (B, S)), jnp.int32)
-    targets = jnp.roll(tokens, -1, axis=1)
-    # smallest dp-divisible slice for init (the sp shard_map needs
-    # batch % dp == 0; the full batch would trace a throwaway forward
-    # at benchmark scale)
-    init_rows = max(1, n_dev // args.sp)
-    params = model.init(jax.random.PRNGKey(0),
-                        tokens[:init_rows])["params"]
-    n_params = sum(x.size for x in jax.tree.leaves(params))
-    params = shard_params(params, mesh, rules)
-    tx = optax.adamw(1e-3)
-    opt = tx.init(params)
-    step = make_gspmd_train_step(model.apply, tx, mesh, rules)
 
     for _ in range(3):  # >1: the post-donation arg layouts can recompile
         params, opt, loss = step(params, opt, tokens, targets)
@@ -118,7 +97,8 @@ def main():
 
     tok_s = B * S / step_time
     flops_per_tok = 6 * n_params  # + attention term below
-    attn_flops = 12 * args.layers * cfg.embed_dim * S  # 2*6*L*E*S per tok
+    embed_dim = args.heads * args.head_dim
+    attn_flops = 12 * args.layers * embed_dim * S  # 2*6*L*E*S per tok
     mfu = ((flops_per_tok + attn_flops) * tok_s / (n_dev * V5E_BF16_PEAK)
            if platform == "tpu" else None)
     print(json.dumps({
@@ -126,7 +106,10 @@ def main():
         "unit": "tok/s", "impl": args.impl, "params_m": round(n_params / 1e6, 1),
         "batch": B, "seq": S, "ms_per_step": round(step_time * 1000, 2),
         "mfu_v5e": round(mfu, 3) if mfu is not None else None,
-        "attention": attention, "sp": args.sp,
+        "attention": attention,
+        **({"logits_dtype": args.logits_dtype}
+           if args.family == "gpt" else {}),
+        "sp": args.sp,
         "platform": platform, "n_devices": n_dev, "timing": timing,
     }))
 

@@ -38,6 +38,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _hlo_path(model: str) -> str:
+    suffix = "" if model == "resnet50" else f"_{model}"
+    return os.path.join(REPO, "benchmarks", f"xplane_hlo{suffix}.txt")
+
+
+def _op_table_path(model: str) -> str:
+    suffix = "" if model == "resnet50" else f"_{model}"
+    return os.path.join(REPO, "benchmarks",
+                        f"xplane_op_table{suffix}.json")
+
+
 def _category(name, stats):
     """Map one XLA-Ops event to a coarse roofline category.
 
@@ -142,6 +153,10 @@ def _load_hlo_categories(hlo_path):
             cats[inst] = "matmul"
         elif "reduce" in ops:
             cats[inst] = "reduce(bn-stats)"
+        elif "custom-call" in ops:
+            # Mosaic kernels (flash attention / fused CE) lower to
+            # tpu custom-calls
+            cats[inst] = "pallas(custom-call)"
         elif ops & {"copy", "copy-start", "copy-done", "transpose"}:
             cats[inst] = "copy/transpose"
         elif "fusion" in ops or ops & {"add", "multiply", "subtract",
@@ -153,6 +168,38 @@ def _load_hlo_categories(hlo_path):
                 if rtype.startswith(("(f32", "f32")) \
                 else "elementwise-bf16(act)"
     return cats
+
+
+def capture_gpt(trace_dir, steps, warmup, batch):
+    """GPT-2-small step — the SAME program gpt_bench.py benchmarks
+    (shared builder, benchmarks/_gpt_step.py) — profiles where the
+    non-MFU 36% of the 64%-MFU step goes."""
+    import jax
+
+    import horovod_tpu as hvd
+    from benchmarks._gpt_step import build_gpt_train_step, enable_jax_cache
+
+    enable_jax_cache(REPO)
+    hvd.init()
+    platform = jax.devices()[0].platform
+    seq = 1024 if platform == "tpu" else 128
+    vocab = 50304 if platform == "tpu" else 512
+    step, params, opt, tokens, targets, _n, _mesh = build_gpt_train_step(
+        seq=seq, vocab=vocab, batch=batch)
+    for _ in range(warmup):
+        params, opt, loss = step(params, opt, tokens, targets)
+        float(loss)
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            params, opt, loss = step(params, opt, tokens, targets)
+        float(loss)
+    try:
+        hlo = step.lower(params, opt, tokens, targets).compile().as_text()
+        with open(_hlo_path("gpt"), "w") as f:
+            f.write(hlo)
+    except Exception as e:
+        sys.stderr.write(f"hlo dump failed: {e!r}\n")
+    return platform
 
 
 def capture(trace_dir, steps, warmup, batch):
@@ -211,15 +258,14 @@ def capture(trace_dir, steps, warmup, batch):
         lowered = step.lower(params, opt_state, batch_stats, images,
                              labels)
         hlo = lowered.compile().as_text()
-        with open(os.path.join(REPO, "benchmarks", "xplane_hlo.txt"),
-                  "w") as f:
+        with open(_hlo_path("resnet50"), "w") as f:
             f.write(hlo)
     except Exception as e:  # profiling still useful without it
         sys.stderr.write(f"hlo dump failed: {e!r}\n")
     return platform
 
 
-def parse(trace_dir, batch, steps):
+def parse(trace_dir, batch, steps, model="resnet50"):
     from jax.profiler import ProfileData
     paths = sorted(glob.glob(
         os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
@@ -236,8 +282,7 @@ def parse(trace_dir, batch, steps):
         raise RuntimeError(
             f"no device plane; planes={[p.name for p in pd.planes]}")
 
-    hlo_cats = _load_hlo_categories(
-        os.path.join(REPO, "benchmarks", "xplane_hlo.txt"))
+    hlo_cats = _load_hlo_categories(_hlo_path(model))
     module_durs = []      # per-executed-module wall on device
     op_table = {}         # name -> [total_ns, count, category, bytes]
     stat_keys = set()
@@ -284,7 +329,7 @@ def parse(trace_dir, batch, steps):
 
     top = sorted(op_table.items(), key=lambda kv: -kv[1][0])[:40]
     result = {
-        "metric": "resnet50_xplane_profile",
+        "metric": f"{model}_xplane_profile",
         "trace_dir": trace_dir,
         "batch": batch,
         "profiled_steps": steps,
@@ -312,31 +357,45 @@ def parse(trace_dir, batch, steps):
     table = [{"op": k, "ms_total": round(v[0] / 1e6, 3), "count": v[1],
               "category": v[2], "gb": round(v[3] / 1e9, 4),
               "hlo": v[4]} for k, v in top]
-    with open(os.path.join(REPO, "benchmarks", "xplane_op_table.json"),
-              "w") as f:
+    with open(_op_table_path(model), "w") as f:
         json.dump(table, f, indent=1)
     return result
 
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "gpt"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=4)
-    ap.add_argument("--batch", type=int, default=32)
-    ap.add_argument("--trace-dir",
-                    default=os.path.join(REPO, "benchmarks", "xplane_trace"))
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--trace-dir", default=None)
     ap.add_argument("--parse-only", metavar="DIR", default=None)
     args = ap.parse_args()
+    if args.batch is None:
+        args.batch = 32 if args.model == "resnet50" else 8
+    if args.trace_dir is None:
+        args.trace_dir = os.path.join(
+            REPO, "benchmarks",
+            "xplane_trace" if args.model == "resnet50"
+            else "xplane_trace_gpt")
 
     if args.parse_only:
-        result = parse(args.parse_only, args.batch, args.steps)
+        result = parse(args.parse_only, args.batch, args.steps,
+                       model=args.model)
     else:
-        platform = capture(args.trace_dir, args.steps, args.warmup,
-                           args.batch)
-        result = parse(args.trace_dir, args.batch, args.steps)
+        cap = capture if args.model == "resnet50" else capture_gpt
+        platform = cap(args.trace_dir, args.steps, args.warmup,
+                       args.batch)
+        result = parse(args.trace_dir, args.batch, args.steps,
+                       model=args.model)
         result["platform"] = platform
 
     # measured-vs-modeled: pull the roofline's floors for the same batch
+    # (resnet only — no analytic model exists for the gpt step)
+    if args.model != "resnet50":
+        print(json.dumps(result), flush=True)
+        return 0
     try:
         roof = json.loads(subprocess.run(
             [sys.executable,

@@ -30,7 +30,8 @@ class GPTConfig:
                  sp_axis: str = "sp", dp_axis: str = "dp",
                  tp_axis: str = "tp", dtype=jnp.bfloat16,
                  attention_impl: Optional[str] = None,
-                 remat: bool = False):
+                 remat: bool = False,
+                 logits_dtype=jnp.float32):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -51,6 +52,13 @@ class GPTConfig:
         #: checkpointing, jax.checkpoint) — trades ~1/3 more FLOPs for
         #: O(layers) less activation HBM; essential at long context
         self.remat = remat
+        #: lm_head compute dtype. float32 is the conservative default;
+        #: bfloat16 runs the head matmul (the largest GEMM in the step)
+        #: at MXU bf16 rate and halves the [B, S, V] logits/dlogits HBM
+        #: traffic — the fused CE kernel upcasts to f32 INTERNALLY
+        #: either way (ops/pallas_ce.py), so only the stored logit
+        #: values lose precision (standard TPU LM recipe)
+        self.logits_dtype = logits_dtype
 
 
 class Attention(nn.Module):
@@ -152,6 +160,6 @@ class GPT(nn.Module):
             x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
-                          dtype=jnp.float32, param_dtype=jnp.float32,
-                          name="lm_head")(x)
+                          dtype=cfg.logits_dtype,
+                          param_dtype=jnp.float32, name="lm_head")(x)
         return logits
