@@ -53,7 +53,8 @@ def build_gpt_train_step(family="gpt", impl="pallas", layers=12, heads=12,
         cfg = LlamaConfig(vocab_size=vocab, num_layers=layers,
                           num_heads=heads, num_kv_heads=kv_heads,
                           head_dim=head_dim, max_seq_len=seq, mesh=mesh,
-                          attention=attention, attention_impl=impl)
+                          attention=attention, attention_impl=impl,
+                          logits_dtype=ldt)
         model, rules = Llama(cfg), llama_partition_rules()
     else:
         from horovod_tpu.models.gpt import GPT, GPTConfig

@@ -40,10 +40,9 @@ def main():
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--logits-dtype", default="f32",
                     choices=["f32", "bf16"],
-                    help="lm_head compute dtype (gpt family): bf16 runs "
-                    "the largest GEMM at MXU bf16 rate and halves "
-                    "logits/dlogits HBM bytes; CE math stays f32 inside "
-                    "the kernel")
+                    help="lm_head compute dtype (both families): bf16 "
+                    "halves logits/dlogits HBM bytes; CE math stays f32 "
+                    "inside the kernel")
     ap.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel degree (ring attention); "
                          "dp = devices // sp")
@@ -60,9 +59,6 @@ def main():
 
     import horovod_tpu as hvd
     from benchmarks._gpt_step import build_gpt_train_step
-
-    if args.logits_dtype != "f32" and args.family != "gpt":
-        ap.error("--logits-dtype applies to the gpt family only")
 
     hvd.init()
     n_dev = hvd.size()
@@ -107,9 +103,7 @@ def main():
         "batch": B, "seq": S, "ms_per_step": round(step_time * 1000, 2),
         "mfu_v5e": round(mfu, 3) if mfu is not None else None,
         "attention": attention,
-        **({"logits_dtype": args.logits_dtype}
-           if args.family == "gpt" else {}),
-        "sp": args.sp,
+        "logits_dtype": args.logits_dtype, "sp": args.sp,
         "platform": platform, "n_devices": n_dev, "timing": timing,
     }))
 

@@ -41,7 +41,8 @@ class LlamaConfig:
                  sp_axis: str = "sp", dp_axis: str = "dp",
                  tp_axis: str = "tp", dtype=jnp.bfloat16,
                  attention_impl: Optional[str] = None,
-                 remat: bool = False):
+                 remat: bool = False,
+                 logits_dtype=jnp.float32):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -68,6 +69,11 @@ class LlamaConfig:
         self.attention_impl = attention_impl
         #: per-block activation checkpointing (see GPTConfig.remat)
         self.remat = remat
+        #: lm_head compute dtype (see GPTConfig.logits_dtype): float32
+        #: is the conservative default; bfloat16 halves the [B, S, V]
+        #: logits/dlogits HBM traffic — the fused CE kernel computes in
+        #: f32 internally either way
+        self.logits_dtype = logits_dtype
 
 
 def _round_up(x: int, m: int) -> int:
@@ -239,7 +245,8 @@ class Llama(nn.Module):
         if zig:
             x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = RMSNorm(name="norm_f")(x)
-        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+        return nn.Dense(cfg.vocab_size, use_bias=False,
+                        dtype=cfg.logits_dtype,
                         param_dtype=jnp.float32, name="lm_head")(x)
 
 

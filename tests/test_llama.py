@@ -66,6 +66,39 @@ class TestLlamaModel:
         assert out.shape == (2, 16, 64)
         assert np.isfinite(np.asarray(out)).all()
 
+    def test_logits_dtype_knob(self):
+        """logits_dtype (round-5 measured lever, +4.8% on chip): the
+        default stays f32; bf16 must actually reach the lm_head output
+        AND still train through the fused-CE loss path."""
+        toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+        for want, cfg in ((jnp.float32, _tiny()),
+                          (jnp.bfloat16,
+                           _tiny(logits_dtype=jnp.bfloat16))):
+            model = Llama(cfg)
+            v = model.init(jax.random.PRNGKey(0), toks)
+            assert model.apply(v, toks).dtype == want
+
+        import optax
+        from horovod_tpu.parallel.mesh_utils import make_mesh
+        from horovod_tpu.parallel.tp import shard_params
+        from horovod_tpu.models.llama import llama_partition_rules
+        import horovod_tpu as hvd
+        hvd.init()
+        mesh = make_mesh(dp=hvd.size())
+        cfg = _tiny(logits_dtype=jnp.bfloat16, mesh=mesh)
+        model = Llama(cfg)
+        params = model.init(jax.random.PRNGKey(0), toks)["params"]
+        params = shard_params(params, mesh, llama_partition_rules())
+        tx = optax.adamw(1e-3)
+        step = make_gspmd_train_step(model.apply, tx, mesh,
+                                     llama_partition_rules())
+        big = jnp.asarray(np.random.RandomState(1).randint(
+            0, 64, (hvd.size(), 16)))
+        params, opt, loss = step(params, tx.init(params), big,
+                                 jnp.roll(big, -1, axis=1))
+        assert np.isfinite(float(loss))
+        hvd.shutdown()
+
     def test_gqa_param_shapes(self):
         cfg = _tiny(num_heads=4, num_kv_heads=2)
         model = Llama(cfg)
