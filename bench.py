@@ -162,6 +162,18 @@ def run_benchmark():
 
     img_sec = batch / step_time
     img_sec_per_chip = img_sec / n_dev
+    # wire_bytes_per_step: gradient-allreduce payload per step per chip
+    # under each wire format (fp32 native vs int8 block-scaled payload +
+    # scale sidecar, optim/compression.py wire_bytes) so BENCH_*.json
+    # tracks bytes-on-wire alongside img/s
+    from horovod_tpu.optim.compression import wire_bytes as _wire_bytes
+    n_params = sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree_util.tree_leaves(params))
+    block = hvd.core.basics.get_config().compression_block_size
+    wire_per_step = {
+        "fp32": _wire_bytes(n_params, "none", itemsize=4),
+        "int8": _wire_bytes(n_params, "int8", block),
+    }
     # the published figure is ResNet-101 img/sec/GPU — only the resnets
     # compare meaningfully against it
     vs_base = round(img_sec_per_chip / BASELINE_IMG_SEC_PER_CHIP, 3) \
@@ -177,6 +189,7 @@ def run_benchmark():
         "stem": stem,
         "batch": per_chip_batch,
         "repeats": repeats,
+        "wire_bytes_per_step": wire_per_step,
     }), flush=True)
 
 

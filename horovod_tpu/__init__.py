@@ -16,6 +16,7 @@ Public API mirrors `import horovod.torch as hvd`:
     out = hvd.synchronize(h)
 """
 
+from . import _compat                                          # noqa: F401
 from .core.types import (                                      # noqa: F401
     ReduceOp, Average, Sum, Adasum, Min, Max, Product,
     Status, StatusType, HorovodInternalError, HostsUpdatedInterrupt,

@@ -17,27 +17,38 @@ import numpy as np
 from .bayes import BayesianOptimizer
 
 # knob domains: fusion threshold 0..128 MB, cycle time 1..25 ms — the
-# reference's tunable ranges (parameter_manager.cc defaults) — plus the
-# two-level (hierarchical/torus) allreduce toggle as a 0/1 dimension,
-# matching the reference's categorical knobs (parameter_manager.h:59-84;
-# hier and torus share one code path here, ops/cross.py)
+# reference's tunable ranges (parameter_manager.cc defaults) — plus two
+# categorical 0/1 dimensions matching the reference's categorical knobs
+# (parameter_manager.h:59-84): the two-level (hierarchical/torus)
+# allreduce toggle (hier and torus share one code path, ops/cross.py) and
+# the int8 wire-format compression toggle (ops/engine.py fused wire path)
 FUSION_MB_RANGE = (0.0, 128.0)
 CYCLE_MS_RANGE = (1.0, 25.0)
 TWO_LEVEL_RANGE = (0.0, 1.0)
+COMPRESSION_RANGE = (0.0, 1.0)
 
 
 class ParameterManager:
     def __init__(self, warmup_samples: int = 3, steps_per_sample: int = 10,
                  max_samples: int = 20, log_path: Optional[str] = None,
                  seed: int = 0, tune_two_level: bool = True,
-                 gp_noise: Optional[float] = None):
+                 gp_noise: Optional[float] = None,
+                 tune_compression: bool = False):
         #: tune_two_level=False freezes the categorical dim (e.g. when
         #: HOROVOD_TORUS_ALLREDUCE already forces the two-level path and
-        #: the knob would be behaviorally inert)
+        #: the knob would be behaviorally inert); tune_compression=False
+        #: likewise freezes the wire format (an explicit
+        #: HOROVOD_COMPRESSION setting must stand)
         self.tune_two_level = tune_two_level
+        self.tune_compression = tune_compression
         dims = [FUSION_MB_RANGE, CYCLE_MS_RANGE]
+        self._two_level_idx = self._compression_idx = None
         if tune_two_level:
+            self._two_level_idx = len(dims)
             dims.append(TWO_LEVEL_RANGE)
+        if tune_compression:
+            self._compression_idx = len(dims)
+            dims.append(COMPRESSION_RANGE)
         self.opt = BayesianOptimizer(dims, seed=seed, noise=gp_noise)
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
@@ -48,7 +59,7 @@ class ParameterManager:
         self._steps = 0
         self._bytes = 0.0
         self._t0 = time.monotonic()
-        self._current = np.array([64.0, 1.0, 0.0][:len(dims)])
+        self._current = np.array([64.0, 1.0, 0.0, 0.0][:len(dims)])
         self._log_header_written = False
 
     # -- current knob values ------------------------------------------------
@@ -63,9 +74,17 @@ class ParameterManager:
     @property
     def two_level_allreduce(self) -> bool:
         """Hierarchical/torus two-level allreduce toggle (ops/cross.py)."""
-        if not self.tune_two_level:
+        if self._two_level_idx is None:
             return False
-        return bool(self._current[2])
+        return bool(self._current[self._two_level_idx])
+
+    @property
+    def compression_wire(self) -> str:
+        """Sampled wire format for the engine's fused collectives:
+        "int8" when the compression dim is on, else "none"."""
+        if self._compression_idx is None:
+            return "none"
+        return "int8" if self._current[self._compression_idx] else "none"
 
     # -- scoring (parameter_manager Update analog) ---------------------------
     def record(self, nbytes: int) -> bool:
@@ -104,8 +123,9 @@ class ParameterManager:
         told to the GP) matches what was measured — the GP must not
         attribute a measurement of round(0.45)=0 to the point 0.45."""
         x = np.asarray(x, float).copy()
-        if self.tune_two_level:
-            x[2] = float(round(x[2]))
+        for idx in (self._two_level_idx, self._compression_idx):
+            if idx is not None:
+                x[idx] = float(round(x[idx]))
         return x
 
     def _log(self, score: float, final: bool = False) -> None:
@@ -113,9 +133,10 @@ class ParameterManager:
             return
         with open(self.log_path, "a") as f:
             if not self._log_header_written:
-                f.write("fusion_mb,cycle_ms,two_level,bytes_per_sec,"
-                        "final\n")
+                f.write("fusion_mb,cycle_ms,two_level,compression,"
+                        "bytes_per_sec,final\n")
                 self._log_header_written = True
             f.write(f"{self._current[0]:.2f},{self._current[1]:.2f},"
                     f"{int(self.two_level_allreduce)},"
+                    f"{self.compression_wire},"
                     f"{score:.1f},{int(final)}\n")

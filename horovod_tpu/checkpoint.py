@@ -36,10 +36,16 @@ def _to_numpy_tree(tree: Any) -> Any:
     are safe. Arrays spanning non-addressable devices (multi-host GSPMD)
     are passed through unchanged — orbax coordinates those across all
     participating processes itself."""
-    return jax.tree_util.tree_map(
-        lambda x: np.asarray(x)
-        if isinstance(x, jax.Array) and x.is_fully_addressable else x,
-        tree)
+    def leaf(x):
+        if isinstance(x, jax.Array) and x.is_fully_addressable:
+            return np.asarray(x)
+        if isinstance(x, np.generic):
+            # numpy scalars -> 0-d ndarrays: older orbax standard handlers
+            # reject np.generic leaves outright
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
 
 
 def _is_multiprocess() -> bool:

@@ -90,6 +90,21 @@ class Config:
     # Adasum, local allgather. Off by default: the flat device-rank tree
     # is the reference's AdasumMPI semantic.
     adasum_hierarchical: bool = False
+    # Wire-format compression for fused collectives (HOROVOD_COMPRESSION):
+    # "none" | "bf16" (cast the fused buffer) | "int8" (block-scaled
+    # quantization with error feedback, optim/compression.py).
+    compression: str = "none"
+    # Elements per int8 quantization block (HOROVOD_COMPRESSION_BLOCK_SIZE).
+    # One fp32 scale travels per block; 128 keeps the sidecar under 4%.
+    compression_block_size: int = 128
+    # Restrict compression to the DCN hop of the hierarchical allreduce
+    # (HOROVOD_COMPRESSION_DCN_ONLY): ICI stays full precision; only the
+    # cross-slice hop — where bytes are expensive — is quantized. Without
+    # hierarchical/torus allreduce this means no compression at all.
+    compression_dcn_only: bool = False
+    # True when HOROVOD_COMPRESSION was set explicitly — freezes the knob
+    # against autotuning (same contract as hierarchical_allreduce_set).
+    compression_set: bool = False
     # Process sets (operations.cc:649 HOROVOD_DYNAMIC_PROCESS_SETS).
     dynamic_process_sets: bool = False
     # Grouped-op fusion (operations.cc:616 HOROVOD_DISABLE_GROUP_FUSION).
@@ -146,6 +161,13 @@ class Config:
             "HOROVOD_STALL_CHECK_TIME_SECONDS", c.stall_warning_time_seconds)
         c.stall_shutdown_time_seconds = _env_float(
             "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", c.stall_shutdown_time_seconds)
+        c.compression = os.environ.get(
+            "HOROVOD_COMPRESSION", c.compression).strip().lower()
+        c.compression_set = "HOROVOD_COMPRESSION" in os.environ
+        c.compression_block_size = _env_int(
+            "HOROVOD_COMPRESSION_BLOCK_SIZE", c.compression_block_size)
+        c.compression_dcn_only = _env_bool(
+            "HOROVOD_COMPRESSION_DCN_ONLY", c.compression_dcn_only)
         c.elastic_enabled = _env_bool("HOROVOD_ELASTIC", c.elastic_enabled)
         c.dynamic_process_sets = _env_bool(
             "HOROVOD_DYNAMIC_PROCESS_SETS", c.dynamic_process_sets)
@@ -163,4 +185,35 @@ class Config:
         c.local_size_env = _opt_int("HOROVOD_LOCAL_SIZE")
         c.cross_rank_env = _opt_int("HOROVOD_CROSS_RANK")
         c.cross_size_env = _opt_int("HOROVOD_CROSS_SIZE")
+        c.validate()
         return c
+
+    def validate(self) -> None:
+        """Fail fast with actionable messages instead of deep inside the
+        engine (a bad fusion threshold used to surface as a bucketization
+        TypeError cycles later)."""
+        if self.compression not in ("none", "bf16", "int8"):
+            raise ValueError(
+                f"HOROVOD_COMPRESSION must be one of 'none'|'bf16'|'int8'; "
+                f"got {self.compression!r}")
+        bs = self.compression_block_size
+        if not isinstance(bs, int) or not (8 <= bs <= 1 << 20):
+            raise ValueError(
+                f"HOROVOD_COMPRESSION_BLOCK_SIZE must be an int in "
+                f"[8, {1 << 20}] (one fp32 scale travels per block); "
+                f"got {bs!r}")
+        ft = self.fusion_threshold_bytes
+        if not isinstance(ft, int) or ft < 0:
+            raise ValueError(
+                f"HOROVOD_FUSION_THRESHOLD must be a non-negative byte "
+                f"count (0 disables fusion); got {ft!r}")
+        ct = self.cycle_time_ms
+        if not isinstance(ct, (int, float)) or not (0 <= ct < 60_000):
+            raise ValueError(
+                f"HOROVOD_CYCLE_TIME must be milliseconds in [0, 60000); "
+                f"got {ct!r}")
+        if not isinstance(self.cache_capacity, int) or \
+                self.cache_capacity < 0:
+            raise ValueError(
+                f"HOROVOD_CACHE_CAPACITY must be a non-negative int; got "
+                f"{self.cache_capacity!r}")

@@ -63,12 +63,21 @@ def _sources():
         os.path.join(_CSRC, f) for f in os.listdir(_CSRC) if f.endswith(".cc"))
 
 
+_EXTRA_LINK_FLAGS = (
+    # shm_open/shm_unlink live in librt until glibc 2.34; harmless (empty
+    # stub library) on newer systems
+    "-lrt",
+)
+
+
 def _fingerprint(sources):
     h = hashlib.sha256()
     for s in sources:
         h.update(s.encode())
         with open(s, "rb") as f:
             h.update(f.read())
+    # flags participate so a flag change invalidates cached builds
+    h.update(" ".join(_EXTRA_LINK_FLAGS).encode())
     return h.hexdigest()[:16]
 
 
@@ -84,7 +93,7 @@ def build(force: bool = False) -> str:
         return so_path
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        "-o", so_path + ".tmp", *sources,
+        "-o", so_path + ".tmp", *sources, *_EXTRA_LINK_FLAGS,
     ]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(so_path + ".tmp", so_path)

@@ -315,11 +315,17 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
               process_set: Optional[ProcessSet] = None,
               prescale_factor: float = 1.0,
               postscale_factor: float = 1.0,
-              name: Optional[str] = None) -> Array:
+              name: Optional[str] = None,
+              wire: Optional[str] = None) -> Array:
     """Reduce row-wise across ranks; every rank receives the result.
 
     reference semantics: hvd.allreduce (horovod/torch/mpi_ops.py:157;
     prescale/postscale handling operations.cc:1479).
+
+    `wire` overrides the cross-hop transport format of the hierarchical
+    path: None (default) follows HOROVOD_COMPRESSION; the engine passes
+    an explicit value so a payload it already compressed — or one whose
+    caller opted out — is never lossy-compressed a second time.
     """
     ps, mesh, n = _resolve(process_set)
     routed = _engine_route("allreduce", x, op=op, name=name, process_set=ps,
@@ -362,7 +368,16 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
         from .cross import two_level_allreduce
         hier = basics.get_hier_mesh()
         if hier.devices.size == n and hier.devices.shape[1] > 1:
-            return two_level_allreduce(x, op, hier)
+            # precision-aware hierarchy: when a wire format is configured
+            # (or the engine passed one explicitly), the expensive CROSS
+            # (DCN) hop compresses while ICI stays exact — this is where
+            # HOROVOD_COMPRESSION_DCN_ONLY lands
+            hop = cfg.compression if wire is None else wire
+            if not _is_float(x.dtype):
+                hop = "none"
+            return two_level_allreduce(
+                x, op, hier, wire=hop,
+                block_size=cfg.compression_block_size)
     f = _allreduce_fn(mesh, op, str(x.dtype), has_scale,
                       has_mask=mask is not None)
     pre = jnp.asarray(prescale_factor, jnp.float32)
@@ -370,6 +385,40 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     if mask is None:
         mask = jnp.ones((n,), jnp.float32)
     return f(x, pre, post, mask)
+
+
+@functools.lru_cache(maxsize=512)
+def _quantized_allreduce_fn(mesh: Mesh, average: bool):
+    """Int8 wire-format allreduce over the set mesh: each rank's row travels
+    as int8 blocks + fp32 scale sidecar (the only tensors inside the
+    all_gathers — what XLA puts on the wire), then every rank dequantizes
+    and sums in fp32. Gather-based because per-rank scales make a direct
+    int8 psum meaningless; for the small fused buckets this path exists for
+    (latency-bound regime) the gather is the right algorithm anyway."""
+    n = mesh.devices.size
+
+    def blk(q, s):                        # q: [1, nb, bs] int8, s: [1, nb]
+        from ..optim.compression import allgather_block_sum
+        r = allgather_block_sum(q[0], s[0], AXIS,
+                                q.shape[-2] * q.shape[-1])
+        if average:
+            r = r / n
+        return r.reshape(1, -1)           # [1, nb*bs] (padding still on)
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+                             out_specs=P(AXIS)))
+
+
+def quantized_allreduce(q: Array, scales: Array, average: bool,
+                        process_set: Optional[ProcessSet] = None) -> Array:
+    """Reduce pre-quantized stacked payload ``q`` [n, nb, bs] int8 with
+    ``scales`` [n, nb]: returns the stacked fp32 sum/average [n, nb*bs]
+    (block padding NOT sliced — callers unpack). The engine's fused wire
+    path quantizes in its pack program and calls this for the transport."""
+    ps, mesh, n = _resolve(process_set)
+    return _quantized_allreduce_fn(mesh, average)(
+        _place_stacked(q, mesh, n, "quantized_allreduce"),
+        _place_stacked(scales, mesh, n, "quantized_allreduce"))
 
 
 @functools.lru_cache(maxsize=512)

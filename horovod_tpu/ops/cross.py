@@ -28,10 +28,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.mesh import CROSS_AXIS, LOCAL_AXIS
 from ..core.types import ReduceOp
+from ..optim.compression import allgather_block_sum, block_quantize
 
 
 @functools.lru_cache(maxsize=256)
-def _two_level_allreduce_fn(mesh: Mesh, op: ReduceOp):
+def _two_level_allreduce_fn(mesh: Mesh, op: ReduceOp, wire: str = "none",
+                            block_size: int = 128):
     cross, local = mesh.devices.shape
     n = cross * local
 
@@ -42,12 +44,27 @@ def _two_level_allreduce_fn(mesh: Mesh, op: ReduceOp):
         pad = (-m) % local
         if pad:
             v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
-        # phase 1: reduce-scatter across the local (ICI) axis
+        # phase 1: reduce-scatter across the local (ICI) axis — always full
+        # precision: ICI bytes are cheap, and the partial sums feeding the
+        # cross hop must not lose bits before they even travel
         piece = lax.psum_scatter(v, LOCAL_AXIS, scatter_dimension=0,
                                  tiled=True)
         # phase 2: allreduce across the cross (DCN/inter-slice) axis — one
-        # per local rank, all running concurrently (the torus property)
-        piece = lax.psum(piece, CROSS_AXIS)
+        # per local rank, all running concurrently (the torus property).
+        # This is the expensive hop, so it is the one the wire format
+        # compresses (HOROVOD_COMPRESSION_DCN_ONLY semantics).
+        if wire == "int8":
+            # block-scaled int8: payload + fp32 scale sidecar travel, the
+            # sum itself runs in fp32 after dequantization (per-slice
+            # scales make a direct int8 psum meaningless)
+            q, s = block_quantize(piece, block_size)
+            piece = allgather_block_sum(
+                q, s, CROSS_AXIS, piece.shape[0]).astype(piece.dtype)
+        elif wire == "bf16":
+            piece = lax.psum(piece.astype(jnp.bfloat16),
+                             CROSS_AXIS).astype(piece.dtype)
+        else:
+            piece = lax.psum(piece, CROSS_AXIS)
         # phase 3: allgather back across the local axis
         v = lax.all_gather(piece, LOCAL_AXIS, tiled=True)
         if pad:
@@ -64,13 +81,24 @@ def _two_level_allreduce_fn(mesh: Mesh, op: ReduceOp):
     return jax.jit(f)
 
 
-def two_level_allreduce(x: jax.Array, op: ReduceOp, mesh: Mesh) -> jax.Array:
-    """Stacked [n, ...] allreduce via local-RS / cross-AR / local-AG."""
+def two_level_allreduce(x: jax.Array, op: ReduceOp, mesh: Mesh, *,
+                        wire: str = "none",
+                        block_size: int = 128) -> jax.Array:
+    """Stacked [n, ...] allreduce via local-RS / cross-AR / local-AG.
+
+    `wire` selects the CROSS-hop (DCN) transport precision: "none" keeps
+    the reference behavior, "bf16" casts the partial sums for the hop,
+    "int8" sends block-quantized payload + scales and sums dequantized
+    fp32 — the precision-aware hierarchy (compress where bytes are
+    expensive, keep ICI exact)."""
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
             "two-level allreduce supports Sum/Average only "
             "(reference hierarchical path is likewise sum-based)")
-    return _two_level_allreduce_fn(mesh, op)(x)
+    if wire != "none" and not jnp.issubdtype(
+            jnp.asarray(x).dtype, jnp.floating):
+        wire = "none"                     # non-float payloads pass through
+    return _two_level_allreduce_fn(mesh, op, wire, block_size)(x)
 
 
 @functools.lru_cache(maxsize=256)

@@ -53,6 +53,8 @@ import numpy as np
 from ..core import basics
 from ..core.process_sets import ProcessSet
 from ..core.types import DuplicateNameError, ReduceOp, RequestType, Status
+from ..optim.compression import (block_dequantize, block_quantize,
+                                 wire_bytes, wire_format_of)
 from . import collective_ops
 
 logger = logging.getLogger("horovod_tpu")
@@ -110,6 +112,11 @@ class _Work:
     postscale: float = 1.0
     splits: Optional[Sequence[Sequence[int]]] = None
     group_id: int = -1
+    # wire format for the fused transport: ""|"none"|"bf16"|"int8". ""
+    # means "no per-call request" — the engine substitutes the negotiated
+    # config default (HOROVOD_COMPRESSION / autotune) at execution time.
+    # Part of the fusion signature so buckets stay homogeneous.
+    wire: str = ""
     # negotiation-derived cross-rank info for ragged ops (per-rank sizes /
     # the full splits table) — the reference's controller response payload
     # (tensor_sizes, mpi_controller.cc:239)
@@ -145,6 +152,46 @@ def _unpack_fn(n: int, shapes: Tuple[Tuple[int, ...], ...]):
     return jax.jit(lambda fused: _unpack_impl(fused, n, shapes))
 
 
+def _pack_q_impl(ts, res, n: int, block_size: int, prescale: float):
+    """Quantizing pack program: concat -> prescale -> error-feedback add ->
+    block-quantize. Returns (q [n, nb, bs] int8, scales [n, nb] fp32,
+    new_residual [n, total] fp32). The residual is the exact quantization
+    error of THIS cycle's contribution; accumulated into the next cycle's
+    bucket it makes the noise unbiased over steps (EF-SGD)."""
+    flat = _pack_impl(ts, n).astype(jnp.float32)
+    if prescale != 1.0:
+        flat = flat * jnp.float32(prescale)
+    acc = flat + res
+    q, s = block_quantize(acc, block_size)
+    return q, s, acc - block_dequantize(q, s, acc.shape[1])
+
+
+@functools.lru_cache(maxsize=512)
+def _pack_q_fn(n: int, shapes: Tuple[Tuple[int, ...], ...],
+               block_size: int, prescale: float):
+    return jax.jit(
+        lambda ts, res: _pack_q_impl(ts, res, n, block_size, prescale))
+
+
+def _unpack_q_impl(fused, n: int, shapes: Tuple[Tuple[int, ...], ...],
+                   dtype_name: str, postscale: float):
+    """Dequantizing unpack: [n, padded_total] fp32 sum -> postscale ->
+    per-tensor split -> cast back to the bucket dtype."""
+    total = sum(int(np.prod(s)) for s in shapes) // n
+    out = fused[:, :total]
+    if postscale != 1.0:
+        out = out * jnp.float32(postscale)
+    return [o.astype(dtype_name) for o in _unpack_impl(out, n, shapes)]
+
+
+@functools.lru_cache(maxsize=512)
+def _unpack_q_fn(n: int, shapes: Tuple[Tuple[int, ...], ...],
+                 dtype_name: str, postscale: float):
+    return jax.jit(
+        lambda fused: _unpack_q_impl(fused, n, shapes, dtype_name,
+                                     postscale))
+
+
 _group_counter = 0
 
 
@@ -156,11 +203,12 @@ def _next_group_id() -> int:
 
 
 def _fusion_key(w: _Work) -> Tuple:
-    """Fusable iff same op kind/dtype/set/scale (FuseResponses rules,
-    controller.cc:901-1000)."""
+    """Fusable iff same op kind/dtype/set/scale/wire (FuseResponses rules,
+    controller.cc:901-1000; wire format added so a quantized bucket never
+    mixes with a full-precision one)."""
     dt = str(jnp.asarray(w.tensor).dtype)
     return (w.request_type, w.op, dt, w.process_set.process_set_id,
-            w.prescale, w.postscale)
+            w.prescale, w.postscale, w.wire)
 
 
 class Engine:
@@ -170,6 +218,7 @@ class Engine:
     def __init__(self, state):
         self._state = state
         cfg = state.config
+        cfg.validate()      # fail fast here, not cycles later in _bucketize
         self.cycle_time_s = max(cfg.cycle_time_ms, 0.0) / 1000.0
         self.fusion_threshold = cfg.fusion_threshold_bytes
         self._queue: List[_Work] = []
@@ -188,18 +237,40 @@ class Engine:
         # response-cache analog: signature -> hit count (jit owns the
         # executables; we track stats + LRU for observability/autotune).
         self.cache_stats: "OrderedDict[Tuple, int]" = OrderedDict()
+        # LRU bound for the promotion/EF side tables: cache_capacity can
+        # RAISE it but never lower it below the historical 4096 promotion
+        # bound — HOROVOD_CACHE_CAPACITY's documented effect is the
+        # response-cache STATS only, so a small setting must not demote
+        # buckets off the jitted fast path or drop error-feedback state
+        self._promo_cap = max(cfg.cache_capacity, 4096)
         # fused-bucket signatures seen at least once (promotion to the
-        # jitted pack/unpack path); independent of cache_capacity
+        # jitted pack/unpack path); LRU-bounded at _promo_cap
         self._fused_seen: "OrderedDict[Tuple, bool]" = OrderedDict()
+        # error-feedback residuals for the int8 wire path: signature ->
+        # [n, total] fp32 quantization error carried into the next cycle's
+        # bucket (1-bit-Adam-style EF). Entry-bounded like _fused_seen AND
+        # byte-bounded: each entry is a bucket-sized device array, so
+        # signature churn (e.g. the autotuner resampling the fusion
+        # threshold re-bucketizes every step) must not pin gigabytes of
+        # stale residuals in HBM. Steady-state training needs only the
+        # recurring signatures, which LRU keeps hot.
+        self._ef_budget_bytes = max(8 * self.fusion_threshold, 64 << 20)
+        self._ef_residuals: "OrderedDict[Tuple, Any]" = OrderedDict()
         self.cycles = 0
         self.tensors_fused = 0
         self.bytes_processed = 0
+        # wire-byte accounting: logical = payload in its original dtype,
+        # actual = what the configured wire format puts on the
+        # interconnect (int8 payload + scale sidecar for "int8")
+        self.wire_bytes_logical = 0
+        self.wire_bytes_actual = 0
         # cross-process negotiation round counter (multi-process mode)
         self._negot_round = 0
         # response-cache fast path over the wire: signature of the last
         # meta this process sent, and each peer's last full meta
+        # (LRU-bounded at _promo_cap — meta blobs can be large)
         self._last_sent_sig = None
-        self._peer_meta_cache: Dict[int, Tuple] = {}
+        self._peer_meta_cache: "OrderedDict[int, Tuple]" = OrderedDict()
         self.negot_cache_hits = 0
         # steady-state equality rounds that skipped the blob allgather
         # entirely (one O(blob)-reply OP_REDUCE probe instead of the
@@ -229,7 +300,11 @@ class Engine:
                 # --no-hierarchical-allreduce contract)
                 tune_two_level=not (cfg.torus_allreduce or
                                     cfg.hierarchical_allreduce or
-                                    cfg.hierarchical_allreduce_set))
+                                    cfg.hierarchical_allreduce_set),
+                # an explicit HOROVOD_COMPRESSION setting freezes the wire
+                # format against autotuning (same contract as the
+                # hierarchical knob)
+                tune_compression=not cfg.compression_set)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -540,8 +615,18 @@ class Engine:
         if tl is not None:
             tl.mark_cycle()
         bytes_before = self.bytes_processed
+        wire_log_before = self.wire_bytes_logical
+        wire_act_before = self.wire_bytes_actual
         for bucket in self._bucketize(batch):
             self._execute_bucket(bucket)
+        if tl is not None and self.wire_bytes_logical > wire_log_before:
+            # per-cycle wire traffic on its own timeline row, so a trace
+            # shows the compression win next to the collectives it bought
+            tl.instant("WIRE_BYTES", {
+                "logical": self.wire_bytes_logical - wire_log_before,
+                "wire": self.wire_bytes_actual - wire_act_before,
+                "cumulative_logical": self.wire_bytes_logical,
+                "cumulative_wire": self.wire_bytes_actual})
         if self.tuner is not None and self.tuner.active:
             if self.tuner.record(self.bytes_processed - bytes_before):
                 self.fusion_threshold = self.tuner.fusion_threshold_bytes
@@ -553,6 +638,9 @@ class Engine:
                 if self.tuner.tune_two_level:
                     self._state.config.hierarchical_allreduce = \
                         self.tuner.two_level_allreduce
+                if self.tuner.tune_compression:
+                    self._state.config.compression = \
+                        self.tuner.compression_wire
 
     @staticmethod
     def _work_meta(w: _Work) -> dict:
@@ -578,6 +666,14 @@ class Engine:
                  "dt": str(getattr(t, "dtype", "")),
                  "op": w.op.value, "pre": w.prescale, "post": w.postscale,
                  "root": w.root_rank}
+        if w.wire:
+            # an EXPLICIT per-call wire format is part of the program
+            # identity (SPMD callers pass the same argument everywhere);
+            # config-driven wire ("") is deliberately NOT in the meta —
+            # it is synchronized from rank 0 each round instead, so a
+            # tuner flipping the knob between enqueues on different ranks
+            # cannot produce a spurious meta mismatch
+            m["cwf"] = w.wire
         if w.splits is not None:
             m["sp"] = [[int(v) for v in row] for row in w.splits]
             m["rag"] = True
@@ -593,8 +689,9 @@ class Engine:
         if m.get("rag"):
             sh = m["sh"]
             trails = sorted({tuple(s[1:]) for s in sh}) if sh else []
-            return ("rag", trails, m["dt"], m["t"], m["op"])
-        return (m["sh"], m["dt"], m["t"], m["op"])
+            return ("rag", trails, m["dt"], m["t"], m["op"],
+                    m.get("cwf", ""))
+        return (m["sh"], m["dt"], m["t"], m["op"], m.get("cwf", ""))
 
     def _negotiate(self, coord, batch: List[_Work]
                    ) -> Tuple[List[_Work], List[_Work]]:
@@ -640,7 +737,11 @@ class Engine:
                    # identical across processes (SynchronizeParameters,
                    # operations.cc:843-846)
                    "ft": self.fusion_threshold,
-                   "tl": bool(self._state.config.hierarchical_allreduce)}
+                   "tl": bool(self._state.config.hierarchical_allreduce),
+                   # wire format must agree process-wide: a bucket whose
+                   # peers disagree on compression would launch different
+                   # XLA programs
+                   "cw": self._state.config.compression}
         # Block until every process reaches this round. A slow peer (long
         # compile / data stall) is NOT an error — the reference waits
         # indefinitely with stall-inspector warnings (stall_inspector.cc);
@@ -696,6 +797,8 @@ class Engine:
         self.fusion_threshold = peers[0].get("ft", self.fusion_threshold)
         self._state.config.hierarchical_allreduce = peers[0].get(
             "tl", self._state.config.hierarchical_allreduce)
+        self._state.config.compression = peers[0].get(
+            "cw", self._state.config.compression)
         # two phases so a replay failure can never leave full metas
         # uncached, and _last_sent_sig only advances on a fully
         # processed round — a failed round therefore falls back to a
@@ -703,6 +806,15 @@ class Engine:
         for p, msg in enumerate(peers):
             if msg.get("w") is not None:
                 self._peer_meta_cache[p] = (msg.get("sig"), msg["w"])
+                self._peer_meta_cache.move_to_end(p)
+        # bounded, but never below the world size: a peer decides to send
+        # the w=None fast-path replay based on ITS OWN _last_sent_sig — it
+        # cannot know this process evicted its meta, so evicting a live
+        # peer would turn the next steady-state round into a spurious
+        # "negotiation cache divergence" failure
+        peer_cap = max(self._promo_cap, len(peers))
+        while len(self._peer_meta_cache) > peer_cap:
+            self._peer_meta_cache.popitem(last=False)
         for p, msg in enumerate(peers):
             if msg.get("w") is None:    # fast path: replay cached meta
                 cached_sig, cached_meta = self._peer_meta_cache.get(
@@ -892,7 +1004,7 @@ class Engine:
                       zero, ps.mesh, ps.size(), "allreduce"),
                   ReduceOp(meta["op"]), ps, Handle(meta["n"]),
                   root_rank=meta["root"], prescale=meta["pre"],
-                  postscale=meta["post"])
+                  postscale=meta["post"], wire=meta.get("cwf", ""))
         return w
 
     def _bucketize(self, batch: List[_Work]) -> List[List[_Work]]:
@@ -956,10 +1068,17 @@ class Engine:
                     results = [self._execute_single(bucket[0])]
                 elif len(bucket) == 1:
                     w = bucket[0]
-                    results = [collective_ops.allreduce(
-                        w.tensor, w.op, process_set=w.process_set,
-                        prescale_factor=w.prescale,
-                        postscale_factor=w.postscale)]
+                    if self._bucket_wire(bucket) != "none":
+                        # compressed wire: singletons ride the same
+                        # quantizing pack/unpack programs as fused buckets
+                        results = self._execute_fused_allreduce(bucket)
+                    else:
+                        self._account_wire_plain(w)
+                        results = [collective_ops.allreduce(
+                            w.tensor, w.op, process_set=w.process_set,
+                            prescale_factor=w.prescale,
+                            postscale_factor=w.postscale,
+                            wire=self._cross_wire(bucket))]
                 else:
                     results = self._execute_fused_allreduce(bucket)
             status = Status.ok()
@@ -992,11 +1111,11 @@ class Engine:
                 singles.append(i)
         results: List = [None] * len(bucket)
         for idxs in sub.values():
-            if len(idxs) == 1:
+            members = [bucket[i] for i in idxs]
+            if len(idxs) == 1 and self._bucket_wire(members) == "none":
                 results[idxs[0]] = self._execute_single(bucket[idxs[0]])
             else:
-                outs = self._execute_fused_allreduce(
-                    [bucket[i] for i in idxs])
+                outs = self._execute_fused_allreduce(members)
                 for i, r in zip(idxs, outs):
                     results[i] = r
         for i in singles:
@@ -1010,7 +1129,82 @@ class Engine:
             if isinstance(leaf, jax.Array)])
         return results
 
+    def _wire_eligible(self, bucket: List[_Work]) -> str:
+        """Requested wire format after eligibility checks: only float
+        allreduce Sum/Average compresses; joined ranks force the exact
+        zero-fill path; a per-call wire ("" = unspecified) falls back to
+        the round-synchronized config default."""
+        w0 = bucket[0]
+        wire = w0.wire or self._state.config.compression
+        if wire == "none" or \
+                w0.request_type != RequestType.ALLREDUCE or \
+                w0.op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            return "none"
+        if getattr(self._state, "joined_ranks", None):
+            return "none"
+        if not jnp.issubdtype(jnp.asarray(w0.tensor).dtype, jnp.floating):
+            return "none"
+        return wire
+
+    def _bucket_wire(self, bucket: List[_Work]) -> str:
+        """Wire format the ENGINE applies to a bucket's transport; DCN-only
+        mode defers compression to the hierarchical cross hop instead
+        (_cross_wire / ops/cross.py)."""
+        if self._state.config.compression_dcn_only:
+            return "none"
+        return self._wire_eligible(bucket)
+
+    def _cross_wire(self, bucket: List[_Work]) -> str:
+        """Wire format for the hierarchical CROSS (DCN) hop when the engine
+        ships the bucket uncompressed itself: the requested format when
+        DCN-only mode deferred it, otherwise "none" — an ineligible or
+        explicitly-uncompressed bucket must not be quantized downstream,
+        and an in-engine-compressed one is already compressed."""
+        if self._state.config.compression_dcn_only:
+            return self._wire_eligible(bucket)
+        return "none"
+
+    def _account_wire_plain(self, w: _Work) -> None:
+        """Uncompressed transport: wire bytes == logical bytes."""
+        if isinstance(w.tensor, (list, tuple)):
+            nb = sum(int(np.prod(np.shape(a))) *
+                     np.dtype(getattr(a, "dtype", np.float32)).itemsize
+                     for a in w.tensor)
+        else:
+            t = jnp.asarray(w.tensor)
+            nb = t.size * t.dtype.itemsize
+        self.wire_bytes_logical += nb
+        self.wire_bytes_actual += nb
+
+    def _cache_record(self, kind: str, sig: Tuple) -> Tuple:
+        """Response-cache bookkeeping, keyed (kind, *sig) so fused-bucket
+        hit rates are not polluted by singleton/quantized signatures."""
+        key = (kind,) + sig
+        self.cache_stats[key] = self.cache_stats.get(key, 0) + 1
+        self.cache_stats.move_to_end(key)
+        cap = self._state.config.cache_capacity
+        while len(self.cache_stats) > cap:
+            self.cache_stats.popitem(last=False)
+        return key
+
+    def cache_summary(self) -> Dict[str, Dict[str, int]]:
+        """Per-kind response-cache stats: 'fused' (multi-tensor buckets)
+        vs 'single' (one-tensor programs). `hits` counts reuses beyond the
+        first sight of each signature — the number the reference's
+        100%-cache-hit fast path cares about."""
+        out: Dict[str, Dict[str, int]] = {}
+        for key, cnt in self.cache_stats.items():
+            kind = key[0] if key and key[0] in ("fused", "single") \
+                else "fused"
+            d = out.setdefault(kind,
+                               {"signatures": 0, "requests": 0, "hits": 0})
+            d["signatures"] += 1
+            d["requests"] += cnt
+            d["hits"] += cnt - 1
+        return out
+
     def _execute_single(self, w: _Work):
+        self._account_wire_plain(w)
         if w.request_type == RequestType.ALLGATHER:
             if isinstance(w.tensor, (list, tuple)) and \
                     w.negotiated is not None:
@@ -1033,7 +1227,8 @@ class Engine:
         if w.request_type == RequestType.ALLREDUCE:
             return collective_ops.allreduce(
                 w.tensor, w.op, process_set=w.process_set,
-                prescale_factor=w.prescale, postscale_factor=w.postscale)
+                prescale_factor=w.prescale, postscale_factor=w.postscale,
+                wire=self._cross_wire([w]))
         raise ValueError(f"Unknown request type {w.request_type}")
 
     def _execute_fused_allreduce(self, bucket: List[_Work]):
@@ -1054,32 +1249,88 @@ class Engine:
         tensors = [jnp.asarray(w.tensor) for w in bucket]
         n = w0.process_set.size()
         shapes = tuple(tuple(t.shape) for t in tensors)
-        sig = (_fusion_key(w0), tuple(
+        wire = self._bucket_wire(bucket)
+        sig = (_fusion_key(w0), wire, tuple(
             (s, str(t.dtype)) for s, t in zip(shapes, tensors)))
-        self.cache_stats[sig] = self.cache_stats.get(sig, 0) + 1
-        self.cache_stats.move_to_end(sig)
-        cap = self._state.config.cache_capacity
-        while len(self.cache_stats) > cap:
-            self.cache_stats.popitem(last=False)
+        self._cache_record("fused" if len(bucket) > 1 else "single", sig)
         self.tensors_fused += len(bucket)
         # promotion tracking is separate from the (user-capped) response
         # cache stats: HOROVOD_CACHE_CAPACITY=0 must not disable the
-        # jitted fast path
+        # jitted fast path (hence the _promo_cap floor)
         repeated = sig in self._fused_seen
-        if not repeated:
-            self._fused_seen[sig] = True
-            while len(self._fused_seen) > 4096:
-                self._fused_seen.popitem(last=False)
+        self._fused_seen[sig] = True
+        self._fused_seen.move_to_end(sig)
+        while len(self._fused_seen) > self._promo_cap:
+            self._fused_seen.popitem(last=False)
 
+        # wire-byte accounting: `logical` is the payload in its original
+        # dtype, `actual` what this bucket's wire format moves (int8
+        # payload padded to block multiples + fp32 scale sidecar)
+        cols = sum(t.size for t in tensors) // n
+        itemsize = tensors[0].dtype.itemsize
+        bs = self._state.config.compression_block_size
+        self.wire_bytes_logical += n * cols * itemsize
+        self.wire_bytes_actual += n * wire_bytes(cols, wire, bs, itemsize)
+
+        if wire == "int8":
+            return self._quantized_fused_allreduce(
+                bucket, tensors, n, shapes, sig, repeated, cols, bs)
         if repeated:                   # repeated signature: jitted 3-dispatch
             flat = _pack_fn(n, shapes)(tensors)
         else:                          # novel: eager, no compile
             flat = _pack_impl(tensors, n)
-        fused = collective_ops.allreduce(
-            flat, w0.op, process_set=w0.process_set,
-            prescale_factor=w0.prescale, postscale_factor=w0.postscale)
+        if wire == "bf16":
+            # one cast per bucket (not per tensor): pre/postscale applied
+            # around the cast in fp32 so only the TRANSPORT is 16-bit
+            if w0.prescale != 1.0:
+                flat = flat * jnp.asarray(w0.prescale, flat.dtype)
+            fused = collective_ops.allreduce(
+                flat.astype(jnp.bfloat16), w0.op, wire="none",
+                process_set=w0.process_set).astype(tensors[0].dtype)
+            if w0.postscale != 1.0:
+                fused = fused * jnp.asarray(w0.postscale, fused.dtype)
+        else:
+            fused = collective_ops.allreduce(
+                flat, w0.op, process_set=w0.process_set,
+                prescale_factor=w0.prescale, postscale_factor=w0.postscale,
+                wire=self._cross_wire(bucket))
         return _unpack_fn(n, shapes)(fused) if repeated \
             else _unpack_impl(fused, n, shapes)
+
+    def _quantized_fused_allreduce(self, bucket: List[_Work], tensors,
+                                   n: int, shapes, sig, repeated: bool,
+                                   cols: int, block_size: int):
+        """Int8 block-scaled wire path: the jitted pack program quantizes
+        the fused buffer (and folds in the persistent error-feedback
+        residual), `quantized_allreduce` moves int8 payload + scale sidecar
+        across the set, and the jitted unpack program splits the fp32 sum
+        back out. Residuals are per-signature so steady-state training
+        (same gradient bucket every step) accumulates its quantization
+        noise into the next step — unbiased over time."""
+        w0 = bucket[0]
+        res = self._ef_residuals.get(sig)
+        if res is None:
+            res = jnp.zeros((n, cols), jnp.float32)
+        if repeated:
+            q, scales, new_res = _pack_q_fn(
+                n, shapes, block_size, w0.prescale)(tensors, res)
+        else:
+            q, scales, new_res = _pack_q_impl(
+                tensors, res, n, block_size, w0.prescale)
+        self._ef_residuals[sig] = new_res
+        self._ef_residuals.move_to_end(sig)
+        ef_bytes = sum(4 * r.size for r in self._ef_residuals.values())
+        while len(self._ef_residuals) > 1 and (
+                len(self._ef_residuals) > self._promo_cap or
+                ef_bytes > self._ef_budget_bytes):
+            _, dropped = self._ef_residuals.popitem(last=False)
+            ef_bytes -= 4 * dropped.size
+        fused = collective_ops.quantized_allreduce(
+            q, scales, w0.op == ReduceOp.AVERAGE, w0.process_set)
+        dtype_name = str(tensors[0].dtype)
+        if repeated:
+            return _unpack_q_fn(n, shapes, dtype_name, w0.postscale)(fused)
+        return _unpack_q_impl(fused, n, shapes, dtype_name, w0.postscale)
 
     # -- stall inspector (stall_inspector.h:41-68) ---------------------------
     # Runs on its own watchdog thread so it still fires when the dispatch
@@ -1125,16 +1376,28 @@ def _engine() -> Engine:
     return basics.get_engine()
 
 
+def _resolve_wire(compression) -> str:
+    """Per-call compressor/wire-string -> engine wire format. Returns ""
+    when unspecified; the engine then falls back to the process-wide
+    config value at EXECUTION time. Deferring the config read matters in
+    multi-process mode: config.compression is synchronized from rank 0
+    each negotiation round, so an autotuner flipping the knob mid-stream
+    can never make peers build different programs for the same cycle —
+    an enqueue-time read on the application thread could."""
+    return wire_format_of(compression)
+
+
 def allreduce_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
                     name: Optional[str] = None, *,
                     process_set: Optional[ProcessSet] = None,
                     prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0) -> Handle:
+                    postscale_factor: float = 1.0,
+                    compression=None) -> Handle:
     ps = basics.get_process_set(process_set)
     name = name or _auto_name("allreduce")
     w = _Work(RequestType.ALLREDUCE, name, tensor, op, ps,
               Handle(name), prescale=prescale_factor,
-              postscale=postscale_factor)
+              postscale=postscale_factor, wire=_resolve_wire(compression))
     return _engine().enqueue(w)
 
 
@@ -1196,12 +1459,14 @@ def grouped_allreduce_async(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
                             name: Optional[str] = None, *,
                             process_set: Optional[ProcessSet] = None,
                             prescale_factor: float = 1.0,
-                            postscale_factor: float = 1.0) -> List[Handle]:
+                            postscale_factor: float = 1.0,
+                            compression=None) -> List[Handle]:
     ps = basics.get_process_set(process_set)
     base = name or _auto_name("grouped_allreduce")
+    wire = _resolve_wire(compression)
     works = [_Work(RequestType.ALLREDUCE, f"{base}.{i}", t, op, ps,
                    Handle(f"{base}.{i}"), prescale=prescale_factor,
-                   postscale=postscale_factor)
+                   postscale=postscale_factor, wire=wire)
              for i, t in enumerate(tensors)]
     return _engine().enqueue_group(works)
 
@@ -1210,10 +1475,12 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
                       name: Optional[str] = None, *,
                       process_set: Optional[ProcessSet] = None,
                       prescale_factor: float = 1.0,
-                      postscale_factor: float = 1.0) -> List:
+                      postscale_factor: float = 1.0,
+                      compression=None) -> List:
     hs = grouped_allreduce_async(tensors, op, name, process_set=process_set,
                                  prescale_factor=prescale_factor,
-                                 postscale_factor=postscale_factor)
+                                 postscale_factor=postscale_factor,
+                                 compression=compression)
     return [h.wait() for h in hs]
 
 

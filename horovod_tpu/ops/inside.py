@@ -58,6 +58,35 @@ def allreduce(x: jax.Array, op: ReduceOp = ReduceOp.AVERAGE,
     return r
 
 
+def quantized_allreduce(x: jax.Array, op: ReduceOp = ReduceOp.AVERAGE,
+                        axis_name: AxisName = GLOBAL_AXIS, *,
+                        block_size: int = 128,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0) -> jax.Array:
+    """In-graph int8 block-scaled allreduce: the all_gathers carry int8
+    payload + fp32 scales (the bytes on the wire), dequantization and the
+    sum run in fp32 after transport (ops/engine.py's fused wire path, made
+    available inside user shard_map/pjit programs). Stateless — error
+    feedback, which needs persistence across steps, lives in the engine
+    path; carry your own residual if you need it in-graph."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            "quantized allreduce supports Sum/Average only (per-rank "
+            "scales make other reductions meaningless on int8 payload)")
+    from ..optim.compression import allgather_block_sum, block_quantize
+    shape, dt = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    if prescale_factor != 1.0:
+        flat = flat * jnp.float32(prescale_factor)
+    q, s = block_quantize(flat, block_size)
+    r = allgather_block_sum(q, s, axis_name, flat.shape[0])
+    if op == ReduceOp.AVERAGE:
+        r = r / _axis_size(axis_name)
+    if postscale_factor != 1.0:
+        r = r * jnp.float32(postscale_factor)
+    return r.reshape(shape).astype(dt)
+
+
 def allgather(x: jax.Array, axis_name: AxisName = GLOBAL_AXIS,
               axis: int = 0, tiled: bool = True) -> jax.Array:
     """In-graph allgather, concatenating along `axis` (hvd.allgather)."""

@@ -40,9 +40,13 @@ NEG_INF = -1e30
 
 def _sds(ref_array, shape, dtype):
     """ShapeDtypeStruct carrying the reference array's varying-mesh-axes
-    annotation, so the kernels also work inside shard_map (check_vma)."""
-    return jax.ShapeDtypeStruct(shape, dtype,
-                                vma=jax.typeof(ref_array).vma)
+    annotation, so the kernels also work inside shard_map (check_vma).
+    Pre-vma jax (0.4.x) has neither jax.typeof nor the vma kwarg — there
+    the plain struct is the correct (and only) spelling."""
+    if hasattr(jax, "typeof"):
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    vma=jax.typeof(ref_array).vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _pos_mask(qi_base, kb_base, bq, bk, *, causal: bool,

@@ -4,11 +4,82 @@ Re-design of the reference compression module (horovod/torch/compression.py:
 NoneCompressor, FP16Compressor, and the fork-added SparCompressor — random
 30% sparsification, compression.py:66-93). On TPU, fp16 compression maps to
 a bfloat16 cast (the TPU-native 16-bit format) unless float16 is forced.
+
+Int8 block-scaled quantization (EQuARX-style, arxiv 2506.17615): tensors are
+split into fixed-size blocks along the last axis; each block travels as int8
+payload plus one fp32 absmax-derived scale. `block_quantize`/
+`block_dequantize` are jit-safe and are fused directly into the async
+engine's pack/unpack programs (ops/engine.py) and the hierarchical cross-hop
+(ops/cross.py), so the bytes that actually cross the wire are int8 + a small
+scale sidecar. The reduction itself stays in fp32 (dequantize-then-sum), the
+Adasum lesson (arxiv 2006.02924): compress the transport, not the math.
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def block_quantize(x: jax.Array, block_size: int):
+    """Quantize along the last axis into int8 blocks with fp32 scales.
+
+    Returns ``(q, scales)`` where ``q`` is int8 shaped
+    ``[..., nblocks, block_size]`` (zero-padded to a block multiple) and
+    ``scales`` is fp32 ``[..., nblocks]``. Dequantized value is
+    ``q * scales[..., None]``. Scales are absmax/127 per block; an all-zero
+    block gets scale 1 so the division stays finite.
+    """
+    x = jnp.asarray(x).astype(jnp.float32)
+    length = x.shape[-1]
+    pad = (-length) % block_size
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros(x.shape[:-1] + (pad,), x.dtype)], axis=-1)
+    b = x.reshape(x.shape[:-1] + (-1, block_size))
+    absmax = jnp.max(jnp.abs(b), axis=-1)
+    scales = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(b / scales[..., None]), -127, 127).astype(jnp.int8)
+    return q, scales
+
+
+def block_dequantize(q: jax.Array, scales: jax.Array, length: int,
+                     dtype=jnp.float32) -> jax.Array:
+    """Inverse of `block_quantize`: ``[..., nb, bs]`` int8 + ``[..., nb]``
+    scales -> ``[..., length]`` in `dtype` (padding sliced off)."""
+    d = q.astype(jnp.float32) * jnp.asarray(scales)[..., None]
+    d = d.reshape(d.shape[:-2] + (-1,))[..., :length]
+    return d.astype(dtype)
+
+
+def allgather_block_sum(q: jax.Array, scales: jax.Array, axis_name,
+                        length: int) -> jax.Array:
+    """Gather-based int8 reduction core shared by every quantized
+    collective (engine fused path, hierarchical cross hop, in-graph op):
+    int8 payload + fp32 scale sidecar are the only tensors inside the
+    all_gathers — the bytes that actually cross the wire — and
+    dequantization plus the fp32 sum run after transport. ``length``
+    slices off the block padding."""
+    gq = jax.lax.all_gather(q, axis_name)
+    gs = jax.lax.all_gather(scales, axis_name)
+    return jnp.sum(block_dequantize(gq, gs, length), axis=0)
+
+
+def wire_bytes(num_elements: int, wire: str, block_size: int = 128,
+               itemsize: int = 4) -> int:
+    """Bytes a float tensor of `num_elements` occupies on the wire under a
+    wire format: "none" (native `itemsize`), "bf16" (2B/elem), or "int8"
+    (1B/elem payload padded to a block multiple + 4B/block scale sidecar).
+    The accounting the engine's `wire_bytes_*` counters and bench.py's
+    `wire_bytes_per_step` metric share."""
+    if wire == "int8":
+        nblocks = math.ceil(num_elements / block_size) if num_elements else 0
+        return nblocks * block_size + nblocks * 4
+    if wire == "bf16":
+        return num_elements * 2
+    return num_elements * itemsize
 
 
 class Compressor:
@@ -42,6 +113,10 @@ class FP16Compressor(Compressor):
     """
 
     wire_dtype = jnp.bfloat16
+    #: engine wire format — DistributedOptimizer's eager mode routes this
+    #: compressor through the engine's fused wire path (one cast per fused
+    #: bucket) instead of casting per tensor
+    fused_wire = "bf16"
 
     @classmethod
     def compress(cls, tensor):
@@ -62,6 +137,8 @@ class Float16Compressor(FP16Compressor):
     intent (horovod/torch/compression.py:46)."""
 
     wire_dtype = jnp.float16
+    fused_wire = ""      # stays on the per-tensor path (engine wire formats
+    #                      are TPU-native: bf16/int8 only)
 
 
 class SparCompressor(Compressor):
@@ -103,6 +180,40 @@ class SparCompressor(Compressor):
         return tensor
 
 
+class BlockQuantCompressor(Compressor):
+    """Int8 block-scaled wire format (per-block absmax scales, fp32 master
+    scales). `fused_wire` marks it for the engine's fused wire path: the
+    DistributedOptimizer eager mode does NOT compress per tensor — it hands
+    raw tensors to the engine, whose jitted pack program quantizes the whole
+    fused bucket at once (with persistent error-feedback residuals), and the
+    in-graph mode lowers to `inside.quantized_allreduce`. The per-tensor
+    compress/decompress below exist for round-trip use and tests.
+
+    Summing int8 payloads directly would be wrong (each rank has its own
+    scales), so the quantized collective is gather-based: int8 + scales
+    travel, dequantization and the fp32 sum happen after transport.
+    """
+
+    fused_wire = "int8"
+    block_size = 128
+
+    @classmethod
+    def compress(cls, tensor):
+        tensor = jnp.asarray(tensor)
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor, None
+        q, scales = block_quantize(tensor.reshape(-1), cls.block_size)
+        return q, (scales, tensor.dtype, tensor.shape)
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is None:
+            return tensor
+        scales, dtype, shape = ctx
+        n = int(np.prod(shape)) if len(shape) else 1
+        return block_dequantize(tensor, scales, n, dtype).reshape(shape)
+
+
 class Compression:
     """Namespace mirroring hvd.Compression (horovod/torch/compression.py:96)."""
 
@@ -110,3 +221,23 @@ class Compression:
     fp16 = FP16Compressor
     float16 = Float16Compressor
     spar = SparCompressor
+    int8 = BlockQuantCompressor
+
+
+#: wire-format strings the engine's fused path understands
+WIRE_FORMATS = ("none", "bf16", "int8")
+
+
+def wire_format_of(compression) -> str:
+    """Resolve a compressor class/instance or wire string to the engine's
+    wire-format vocabulary ("none"|"bf16"|"int8"); None -> "" meaning
+    "defer to the configured default"."""
+    if compression is None:
+        return ""
+    if isinstance(compression, str):
+        if compression not in WIRE_FORMATS:
+            raise ValueError(
+                f"unknown wire format {compression!r}; expected one of "
+                f"{WIRE_FORMATS}")
+        return compression
+    return getattr(compression, "fused_wire", None) or "none"

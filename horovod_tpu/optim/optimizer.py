@@ -38,7 +38,7 @@ from .compression import Compression
 
 
 def _validate_reduce_knobs(op: ReduceOp, gradient_predivide_factor: float,
-                           axis_name) -> None:
+                           axis_name, compression=None) -> None:
     if gradient_predivide_factor != 1.0 and op != ReduceOp.AVERAGE:
         raise ValueError(
             "gradient_predivide_factor requires op=Average "
@@ -46,6 +46,12 @@ def _validate_reduce_knobs(op: ReduceOp, gradient_predivide_factor: float,
     if axis_name is not None and op == ReduceOp.ADASUM:
         raise ValueError("Adasum is not supported in in-graph mode yet; "
                          "use the stacked eager mode")
+    if getattr(compression, "fused_wire", "") == "int8" and \
+            op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            "Compression.int8 requires op=Sum or op=Average: the block-"
+            "quantized payload carries per-rank scales, so scale-sensitive "
+            "reductions (Adasum, min/max/product) cannot combine it")
 
 
 class _AggState(NamedTuple):
@@ -80,9 +86,19 @@ def _local_mask(grads, local_vars):
 
 def _reduce_tree_ingraph(grads, op, axis_name, prescale, postscale,
                          compression, local_mask=None):
+    wire = getattr(compression, "fused_wire", "")
+
     def one(g, is_local=False):
         if is_local:
             return g
+        if wire == "int8" and op in (ReduceOp.SUM, ReduceOp.AVERAGE) and \
+                jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            # real wire compression in-graph: int8 + scales are the only
+            # tensors inside the collective (inside.quantized_allreduce)
+            return inside.quantized_allreduce(
+                g, op, axis_name,
+                block_size=getattr(compression, "block_size", 128),
+                prescale_factor=prescale, postscale_factor=postscale)
         c, ctx = compression.compress(g)
         r = inside.allreduce(c, op, axis_name,
                              prescale_factor=prescale,
@@ -99,17 +115,45 @@ def _reduce_tree_eager(grads, op, process_set, prescale, postscale,
     local = jax.tree_util.tree_flatten(local_mask)[0] \
         if local_mask is not None else [False] * len(leaves)
     send = [g for g, loc in zip(leaves, local) if not loc]
-    comp = [compression.compress(g) for g in send]
-    tensors = [c for c, _ in comp]
+    # Fused-wire compressors (int8 block-quant, bf16) do NOT compress per
+    # tensor here: raw tensors go to the engine, whose jitted pack program
+    # compresses the whole fused bucket at once — so the smallest tensors
+    # (the ones fusion exists for) get the wire win too, and int8 gets
+    # persistent error feedback keyed by the bucket signature.
+    wire = getattr(compression, "fused_wire", "") \
+        if op in (ReduceOp.SUM, ReduceOp.AVERAGE) else ""
+    if wire:
+        comp = [(g, None) for g in send]
+        tensors = send
+        eng_comp = wire
+    elif getattr(compression, "fused_wire", "") == "int8":
+        # int8 block-quant is Sum/Average-only (per-rank scales make other
+        # reductions meaningless); the constructor rejects the combo, but
+        # a direct caller gets exact transport instead of scale-mixed
+        # garbage
+        comp = [(g, None) for g in send]
+        tensors = send
+        eng_comp = "none"
+    else:
+        comp = [compression.compress(g) for g in send]
+        tensors = [c for c, _ in comp]
+        # NoneCompressor defers to the configured/autotuned engine wire
+        # format; legacy per-tensor compressors (spar, strict fp16)
+        # already compressed — the engine must not quantize on top
+        eng_comp = None if compression is Compression.none else "none"
     # Adasum rides the same engine path (grouped; executed as per-tensor
     # tree programs) so multi-process ordering/negotiation and the Join
     # guard apply uniformly.
     reduced = engine.grouped_allreduce(
         tensors, op, process_set=process_set,
-        prescale_factor=prescale, postscale_factor=postscale) \
+        prescale_factor=prescale, postscale_factor=postscale,
+        compression=eng_comp) \
         if tensors else []
-    red_iter = iter(compression.decompress(r, ctx)
-                    for r, (_, ctx) in zip(reduced, comp))
+    if wire:
+        red_iter = iter(reduced)
+    else:
+        red_iter = iter(compression.decompress(r, ctx)
+                        for r, (_, ctx) in zip(reduced, comp))
     out = [g if loc else next(red_iter)
            for g, loc in zip(leaves, local)]
     return jax.tree_util.tree_unflatten(treedef, out)
@@ -132,7 +176,8 @@ def DistributedOptimizer(
     allreduced) — the reference's register_local_var surface
     (horovod/_keras/__init__.py:97, tensorflow/__init__.py:688); see
     `_local_mask` for the accepted forms."""
-    _validate_reduce_knobs(op, gradient_predivide_factor, axis_name)
+    _validate_reduce_knobs(op, gradient_predivide_factor, axis_name,
+                           compression)
 
     def reduce_grads(grads):
         # shared prescale/postscale folding + mode dispatch
@@ -207,7 +252,8 @@ def allreduce_gradients(grads, *,
     DistributedOptimizer: `axis_name` for in-graph shard_map/pjit use,
     stacked eager (grouped engine allreduce with fusion) otherwise.
     Leaves matched by `local_vars` pass through unreduced."""
-    _validate_reduce_knobs(op, gradient_predivide_factor, axis_name)
+    _validate_reduce_knobs(op, gradient_predivide_factor, axis_name,
+                           compression)
     prescale = 1.0 / gradient_predivide_factor
     postscale = gradient_predivide_factor
     mask = _local_mask(grads, local_vars)
@@ -267,13 +313,16 @@ def _to_varying(leaf, axis_name):
     """unvarying -> device-varying cast; pcast on current jax, pvary on
     older releases (pvary is deprecated in favor of pcast). Identity when
     the leaf is already device-varying over `axis_name` (a sharded input:
-    pcast varying->varying raises)."""
+    pcast varying->varying raises) — and on pre-vma jax (0.4.x), where
+    shard_map has no varying/unvarying distinction to reconcile."""
     vma = getattr(getattr(leaf, "aval", None), "vma", None)
     if vma and axis_name in vma:
         return leaf
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(leaf, axis_name, to="varying")
-    return jax.lax.pvary(leaf, axis_name)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(leaf, axis_name)
+    return leaf
 
 
 #: TF-flavored alias (scripts ported from hvd.DistributedGradientTape)

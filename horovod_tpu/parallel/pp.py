@@ -24,12 +24,15 @@ from jax import lax
 
 def _pvary(x, axes):
     """Mark `x` device-varying over `axes` (pcast on new jax, pvary on
-    old) — the one copy of the compatibility shim."""
+    old, identity on pre-vma 0.4.x where there is no varying/unvarying
+    distinction) — the one copy of the compatibility shim."""
     if isinstance(axes, str):
         axes = (axes,)
     for ax in axes:
-        x = lax.pcast(x, ax, to="varying") if hasattr(lax, "pcast") \
-            else lax.pvary(x, ax)
+        if hasattr(lax, "pcast"):
+            x = lax.pcast(x, ax, to="varying")
+        elif hasattr(lax, "pvary"):
+            x = lax.pvary(x, ax)
     return x
 
 

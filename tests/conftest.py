@@ -26,6 +26,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# jax-version shim (jax.shard_map moved namespaces across releases) must be
+# in place before test modules that do `from jax import shard_map` are
+# collected.
+import horovod_tpu._compat  # noqa: E402,F401
+
 import pytest  # noqa: E402
 
 

@@ -275,9 +275,12 @@ def test_autotune_end_to_end_pins_knobs(tmp_path, monkeypatch):
         # re-reads it per call)
         assert hvd_mod.core.basics.get_config().hierarchical_allreduce \
             == tuner.two_level_allreduce
+        assert hvd_mod.core.basics.get_config().compression \
+            == tuner.compression_wire
         # CSV log recorded sampled + final scores
         lines = log.read_text().strip().splitlines()
-        assert lines[0] == "fusion_mb,cycle_ms,two_level,bytes_per_sec,final"
+        assert lines[0] == \
+            "fusion_mb,cycle_ms,two_level,compression,bytes_per_sec,final"
         assert any(ln.endswith(",1") for ln in lines[1:]), lines
     finally:
         hvd_mod.shutdown()

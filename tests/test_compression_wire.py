@@ -1,0 +1,401 @@
+"""Int8 block-scaled wire-format collectives (ISSUE 1).
+
+Covers: compressor round-trips (error bound vs block size, non-float
+passthrough), the engine's fused quantized allreduce (numerics + the
+>=3.5x bytes-on-wire acceptance bar via the wire-byte counters), error
+feedback (residual persistence + 200-step toy-SGD convergence within 2%
+of fp32), the precision-aware hierarchical cross hop, DCN-only deferral,
+config validation, LRU bounds on the engine side tables, and the
+fused-vs-singleton cache_summary split.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _stacked(n, shape, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, *shape).astype(dtype)
+
+
+# -- compressor round-trips (pure functions, no hvd state) -----------------
+
+def test_block_quantize_roundtrip_and_padding():
+    from horovod_tpu.optim.compression import (block_dequantize,
+                                               block_quantize)
+    x = np.random.RandomState(0).randn(300).astype(np.float32)  # non-multiple
+    q, s = block_quantize(jnp.asarray(x), 128)
+    assert q.shape == (3, 128) and q.dtype == jnp.int8
+    assert s.shape == (3,) and s.dtype == jnp.float32
+    out = np.asarray(block_dequantize(q, s, 300))
+    assert out.shape == (300,)
+    # per-element error bounded by half a quantization step of its block
+    bound = np.asarray(s)[:, None] * 0.5 + 1e-6
+    err = np.abs(np.pad(x, (0, 84)).reshape(3, 128) -
+                 np.asarray(q, np.float32) * np.asarray(s)[:, None])
+    assert (err <= bound).all()
+
+
+def test_block_quantize_error_shrinks_with_block_size():
+    """Smaller blocks track local magnitude: heteroscedastic data must
+    quantize more accurately at bs=64 than at bs=1024."""
+    from horovod_tpu.optim.compression import (block_dequantize,
+                                               block_quantize)
+    rng = np.random.RandomState(1)
+    x = (rng.randn(4096) * np.linspace(0.01, 10.0, 4096)).astype(np.float32)
+    errs = {}
+    for bs in (64, 1024):
+        q, s = block_quantize(jnp.asarray(x), bs)
+        out = np.asarray(block_dequantize(q, s, 4096))
+        errs[bs] = np.abs(out - x).mean()
+    assert errs[64] < errs[1024]
+
+
+def test_block_quant_compressor_roundtrip_and_nonfloat_passthrough():
+    from horovod_tpu.optim.compression import Compression
+    comp = Compression.int8
+    x = np.random.RandomState(2).randn(5, 7).astype(np.float32)
+    c, ctx = comp.compress(jnp.asarray(x))
+    assert c.dtype == jnp.int8
+    out = np.asarray(comp.decompress(c, ctx))
+    assert out.shape == (5, 7) and out.dtype == np.float32
+    np.testing.assert_allclose(out, x, atol=0.05)
+    # non-float dtypes pass through untouched (ctx None)
+    ints = jnp.arange(12, dtype=jnp.int32)
+    c, ctx = comp.compress(ints)
+    assert ctx is None and c.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(comp.decompress(c, ctx)),
+                                  np.arange(12))
+
+
+def test_wire_bytes_accounting_math():
+    from horovod_tpu.optim.compression import wire_bytes
+    assert wire_bytes(1000, "none", itemsize=4) == 4000
+    assert wire_bytes(1000, "bf16") == 2000
+    # 8 blocks of 128 (padded) + 8 fp32 scales
+    assert wire_bytes(1000, "int8", 128) == 8 * 128 + 8 * 4
+    assert wire_bytes(0, "int8", 128) == 0
+    assert wire_bytes(4000, "none", itemsize=4) / wire_bytes(
+        4000, "int8", 128) >= 3.5
+
+
+def test_wire_format_of_resolution():
+    from horovod_tpu.optim.compression import Compression, wire_format_of
+    assert wire_format_of(None) == ""
+    assert wire_format_of("int8") == "int8"
+    assert wire_format_of(Compression.int8) == "int8"
+    assert wire_format_of(Compression.fp16) == "bf16"
+    assert wire_format_of(Compression.none) == "none"
+    assert wire_format_of(Compression.spar) == "none"
+    with pytest.raises(ValueError, match="unknown wire format"):
+        wire_format_of("lz4")
+
+
+# -- engine fused quantized path -------------------------------------------
+
+def test_fused_int8_allreduce_numerics_and_wire_ratio(hvd):
+    """Acceptance bar: a synthetic multi-tensor bucket travels >=3.5x
+    fewer bytes than fp32, measured by the engine's wire counters, while
+    staying numerically close to the exact sum."""
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    xs = [_stacked(8, (256,), seed=i) for i in range(4)]
+    log0, act0 = eng.wire_bytes_logical, eng.wire_bytes_actual
+    outs = hvd.grouped_allreduce(xs, hvd.Sum, compression="int8")
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o), np.tile(x.sum(0), (8, 1)),
+                                   atol=0.25)
+    dlog = eng.wire_bytes_logical - log0
+    dact = eng.wire_bytes_actual - act0
+    assert dlog == 8 * 4 * 256 * 4          # n * tensors * elems * fp32
+    assert dlog / dact >= 3.5, (dlog, dact)
+    # second identical call rides the jitted (repeated-signature) programs
+    # and the persistent error-feedback residual
+    outs2 = hvd.grouped_allreduce(xs, hvd.Sum, compression="int8")
+    for x, o in zip(xs, outs2):
+        np.testing.assert_allclose(np.asarray(o), np.tile(x.sum(0), (8, 1)),
+                                   atol=0.25)
+    assert len(eng._ef_residuals) == 1
+    res = np.asarray(next(iter(eng._ef_residuals.values())))
+    assert res.shape == (8, 4 * 256) and np.abs(res).max() > 0
+
+
+def test_singleton_rides_quantized_path(hvd):
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    x = _stacked(8, (1024,), seed=3)
+    log0, act0 = eng.wire_bytes_logical, eng.wire_bytes_actual
+    h = hvd.allreduce_async(x, hvd.Average, compression="int8")
+    out = np.asarray(h.wait())
+    np.testing.assert_allclose(out, np.tile(x.mean(0), (8, 1)), atol=0.05)
+    assert eng.wire_bytes_actual - act0 < eng.wire_bytes_logical - log0
+
+
+def test_bf16_wire_halves_bytes(hvd):
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    xs = [_stacked(8, (128,), seed=i) for i in range(3)]
+    log0, act0 = eng.wire_bytes_logical, eng.wire_bytes_actual
+    outs = hvd.grouped_allreduce(xs, hvd.Sum, compression="bf16")
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o), np.tile(x.sum(0), (8, 1)),
+                                   rtol=0.05, atol=0.1)
+    dlog = eng.wire_bytes_logical - log0
+    assert eng.wire_bytes_actual - act0 == dlog // 2
+
+
+def test_nonfloat_bucket_stays_uncompressed(hvd):
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    x = np.random.RandomState(4).randint(-50, 50, (8, 64)).astype(np.int32)
+    log0, act0 = eng.wire_bytes_logical, eng.wire_bytes_actual
+    out = hvd.grouped_allreduce([x], hvd.Sum, compression="int8")[0]
+    np.testing.assert_array_equal(np.asarray(out), np.tile(x.sum(0), (8, 1)))
+    assert eng.wire_bytes_actual - act0 == eng.wire_bytes_logical - log0
+
+
+def test_mixed_wire_formats_never_share_a_bucket(hvd):
+    """Same shape/dtype/op but different wire formats must fuse into
+    separate buckets — both must come back exact-ish."""
+    a = _stacked(8, (64,), seed=5)
+    b = _stacked(8, (64,), seed=6)
+    ha = hvd.allreduce_async(a, hvd.Sum, name="mixq", compression="int8")
+    hb = hvd.allreduce_async(b, hvd.Sum, name="mixp")
+    np.testing.assert_allclose(np.asarray(ha.wait()),
+                               np.tile(a.sum(0), (8, 1)), atol=0.25)
+    np.testing.assert_allclose(np.asarray(hb.wait()),
+                               np.tile(b.sum(0), (8, 1)), rtol=1e-5)
+
+
+def test_dcn_only_defers_engine_compression(hvd):
+    """compression_dcn_only=True: the flat engine path must stay exact and
+    uncompressed (compression happens only on the hierarchical cross hop,
+    exercised separately below)."""
+    import horovod_tpu as hv
+    cfg = hv.core.basics.get_config()
+    cfg.compression, cfg.compression_dcn_only = "int8", True
+    try:
+        eng = hv.core.basics.get_engine()
+        x = _stacked(8, (512,), seed=7)
+        log0, act0 = eng.wire_bytes_logical, eng.wire_bytes_actual
+        out = hvd.grouped_allreduce([x], hvd.Sum)[0]
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(x.sum(0), (8, 1)), rtol=1e-5)
+        assert eng.wire_bytes_actual - act0 == \
+            eng.wire_bytes_logical - log0
+    finally:
+        cfg.compression, cfg.compression_dcn_only = "none", False
+
+
+# -- error feedback: toy-SGD convergence (acceptance bar) ------------------
+
+def _toy_sgd_loss(hvd, wire, steps=200):
+    """8-rank linear regression with per-rank noisy shards; returns the
+    global MSE after `steps` of engine-reduced SGD under `wire`."""
+    rng = np.random.RandomState(42)
+    n, m, d = 8, 32, 16
+    w_true = rng.randn(d)
+    X = rng.randn(n, m, d)
+    y = X @ w_true + 0.3 * rng.randn(n, m)
+    w = np.zeros(d, np.float64)
+    lr = 0.1
+    for i in range(steps):
+        grads = np.einsum("nmd,nm->nd", X, X @ w - y) / m
+        g = hvd.grouped_allreduce(
+            [jnp.asarray(grads.astype(np.float32))], hvd.Average,
+            name=f"toy.{wire}.{i}", compression=wire)[0]
+        w = w - lr * np.asarray(g)[0].astype(np.float64)
+    return float(np.mean((X @ w - y) ** 2))
+
+
+def test_error_feedback_matches_fp32_within_2pct(hvd):
+    loss_fp32 = _toy_sgd_loss(hvd, "none")
+    loss_int8 = _toy_sgd_loss(hvd, "int8")
+    assert loss_fp32 < 0.2          # the baseline itself converged
+    assert abs(loss_int8 - loss_fp32) <= 0.02 * loss_fp32, \
+        (loss_int8, loss_fp32)
+
+
+# -- precision-aware hierarchy (cross.py) ----------------------------------
+
+def test_two_level_allreduce_wire_formats(hvd):
+    from horovod_tpu.core.mesh import build_hierarchical_mesh
+    from horovod_tpu.ops.cross import two_level_allreduce
+    mesh = build_hierarchical_mesh(jax.devices(), local_size=4)  # (2, 4)
+    x = _stacked(8, (300,), seed=8)                              # odd size
+    exact = np.tile(x.sum(0), (8, 1))
+    q8 = np.asarray(two_level_allreduce(
+        jnp.asarray(x), hvd.Sum, mesh, wire="int8", block_size=64))
+    np.testing.assert_allclose(q8, exact, atol=0.2)
+    b16 = np.asarray(two_level_allreduce(
+        jnp.asarray(x), hvd.Sum, mesh, wire="bf16"))
+    np.testing.assert_allclose(b16, exact, rtol=0.02, atol=0.1)
+    # non-float payloads pass through the exact path regardless of wire
+    xi = np.arange(8 * 16, dtype=np.int32).reshape(8, 16)
+    out = np.asarray(two_level_allreduce(
+        jnp.asarray(xi), hvd.Sum, mesh, wire="int8"))
+    np.testing.assert_array_equal(out, np.tile(xi.sum(0), (8, 1)))
+
+
+# -- in-graph + optimizer routing ------------------------------------------
+
+def test_inside_quantized_allreduce_under_shard_map(hvd):
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops import inside
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("hvd",))
+    x = _stacked(8, (33,), seed=9)
+
+    def f(v):
+        return inside.quantized_allreduce(v[0], hvd.Average, "hvd",
+                                          block_size=16)[None]
+
+    out = np.asarray(jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P("hvd"),), out_specs=P("hvd")))(
+            jnp.asarray(x)))
+    np.testing.assert_allclose(out, np.tile(x.mean(0), (8, 1)), atol=0.05)
+
+
+def test_optimizer_int8_eager_and_ingraph(hvd):
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.optim.compression import Compression
+    from horovod_tpu.optim.optimizer import DistributedOptimizer
+    import optax
+    grads = {"w": _stacked(8, (4, 3), seed=10), "b": _stacked(8, (3,),
+                                                              seed=11)}
+    # eager: raw tensors go to the engine's fused quantized path
+    opt = DistributedOptimizer(optax.sgd(1.0), compression=Compression.int8)
+    params = jax.tree_util.tree_map(jnp.zeros_like, grads)
+    state = opt.init(params)
+    updates, _ = opt.update(grads, state, params)
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               np.tile(-grads["w"].mean(0), (8, 1, 1)),
+                               atol=0.05)
+    # in-graph: lowers to inside.quantized_allreduce
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("hvd",))
+    opt2 = DistributedOptimizer(optax.sgd(0.1), axis_name="hvd",
+                                compression=Compression.int8)
+    g = _stacked(8, (4,), seed=12)
+
+    def step(p, gg):
+        st = opt2.init(p)
+        up, _ = opt2.update(gg, st, p)
+        return up
+
+    out = np.asarray(jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P("hvd"), P("hvd")),
+        out_specs=P("hvd")))(jnp.zeros((8, 4)), jnp.asarray(g)))
+    np.testing.assert_allclose(out, np.tile(-0.1 * g.mean(0), (8, 1)),
+                               atol=0.01)
+
+
+def test_int8_rejects_scale_sensitive_ops(hvd):
+    """Per-rank scales make the quantized payload meaningless under
+    scale-sensitive reductions — the constructor must fail fast."""
+    import optax
+    from horovod_tpu.optim.compression import Compression
+    from horovod_tpu.optim.optimizer import DistributedOptimizer
+    with pytest.raises(ValueError, match="Sum or op=Average"):
+        DistributedOptimizer(optax.sgd(1.0), op=hvd.Adasum,
+                             compression=Compression.int8)
+
+
+# -- config validation ------------------------------------------------------
+
+def test_config_validation_errors():
+    from horovod_tpu.core.config import Config
+    for field, bad, msg in [
+            ("compression", "lz4", "HOROVOD_COMPRESSION must"),
+            ("compression_block_size", 4, "COMPRESSION_BLOCK_SIZE"),
+            ("compression_block_size", "128", "COMPRESSION_BLOCK_SIZE"),
+            ("fusion_threshold_bytes", -1, "FUSION_THRESHOLD"),
+            ("cycle_time_ms", -3.0, "CYCLE_TIME"),
+            ("cycle_time_ms", 10 ** 9, "CYCLE_TIME"),
+            ("cache_capacity", -2, "CACHE_CAPACITY")]:
+        c = Config()
+        setattr(c, field, bad)
+        with pytest.raises(ValueError, match=msg):
+            c.validate()
+    Config().validate()                 # defaults are valid
+
+
+def test_config_validation_from_env(monkeypatch):
+    from horovod_tpu.core.config import Config
+    monkeypatch.setenv("HOROVOD_COMPRESSION", "gzip")
+    with pytest.raises(ValueError, match="HOROVOD_COMPRESSION"):
+        Config.from_env()
+    monkeypatch.setenv("HOROVOD_COMPRESSION", "INT8")   # case-insensitive
+    monkeypatch.setenv("HOROVOD_COMPRESSION_BLOCK_SIZE", "256")
+    c = Config.from_env()
+    assert c.compression == "int8" and c.compression_set
+    assert c.compression_block_size == 256
+
+
+# -- cache accounting + LRU bounds -----------------------------------------
+
+def test_cache_summary_splits_fused_from_singleton(hvd):
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    xs = [_stacked(8, (48,), seed=i) for i in range(2)]
+    for _ in range(2):
+        hvd.grouped_allreduce(xs, hvd.Sum)
+    x = _stacked(8, (99,), seed=13)
+    for _ in range(2):
+        hvd.allreduce_async(x, hvd.Sum, compression="int8").wait()
+    s = eng.cache_summary()
+    assert s["fused"] == {"signatures": 1, "requests": 2, "hits": 1}
+    assert s["single"] == {"signatures": 1, "requests": 2, "hits": 1}
+
+
+def test_engine_side_tables_are_lru_bounded():
+    """_fused_seen / _ef_residuals must not grow without bound across
+    signature churn. HOROVOD_CACHE_CAPACITY can only RAISE the bound
+    above the historical 4096 promotion cap — a small setting disables
+    only the response-cache stats, never the fast path or EF — so the
+    eviction path is exercised by shrinking the cap on the instance."""
+    import horovod_tpu as hv
+    os.environ["HOROVOD_CACHE_CAPACITY"] = "0"
+    try:
+        hv.init()
+        eng = hv.core.basics.get_engine()
+        assert eng._promo_cap == 4096
+        eng._promo_cap = 64
+        # 70 distinct bucket signatures (prescale is part of the fusion
+        # signature) over identical tensor shapes, so the churn exercises
+        # the tables without paying a fresh XLA compile per signature
+        xs = [_stacked(8, (4,), seed=0), _stacked(8, (4,), seed=100)]
+        for i in range(70):
+            hv.grouped_allreduce(xs, hv.Sum, name=f"churn.{i}",
+                                 prescale_factor=float(i + 1),
+                                 compression="int8")
+        assert len(eng._fused_seen) <= 64
+        assert len(eng._ef_residuals) <= 64
+        assert len(eng.cache_stats) == 0        # capacity 0 honored
+    finally:
+        del os.environ["HOROVOD_CACHE_CAPACITY"]
+        hv.shutdown()
+
+
+# -- autotune dimension + bench metric -------------------------------------
+
+def test_parameter_manager_compression_dimension():
+    from horovod_tpu.autotune.tuner import ParameterManager
+    pm = ParameterManager(tune_compression=True)
+    assert pm.compression_wire in ("none", "int8")
+    assert len(pm._current) == 4        # fusion, cycle, two_level, wire
+    x = pm._snap(np.array([3.0, 2.0, 0.6, 0.4]))
+    assert x[2] == 1.0 and x[3] == 0.0
+    frozen = ParameterManager(tune_compression=False)
+    assert frozen.compression_wire == "none"
+
+
+def test_bench_emits_wire_bytes_metric():
+    """bench.py's JSON line carries wire_bytes_per_step (fp32 vs int8) so
+    BENCH_*.json tracks bytes alongside img/s."""
+    src = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")).read()
+    assert "wire_bytes_per_step" in src
+    assert '"fp32"' in src and '"int8"' in src
