@@ -193,6 +193,77 @@ def run_benchmark():
     }), flush=True)
 
 
+def run_serve_benchmark() -> int:
+    """Loopback serving benchmark (`bench.py --serve`): drive the
+    continuous batcher (horovod_tpu/serve) over a tiny GPT decoder with
+    synthetic requests and print TWO JSON metric lines —
+    serve_tokens_per_s (aggregate decode throughput) and serve_p50_ms
+    (median request latency, submit -> resolve). No network, no engine:
+    this measures the scheduler + jitted decode step, the serving
+    analog of the synthetic img/sec harness above."""
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.core.config import Config
+        from horovod_tpu.models.gpt import GPT, GPTConfig
+        from horovod_tpu.serve import (AdmissionQueue, ContinuousBatcher,
+                                       ShardedExecutor)
+
+        cfg = Config.from_env()
+        platform = jax.devices()[0].platform
+        n_req = int(os.environ.get("HVD_BENCH_SERVE_REQUESTS", "32"))
+        prompt_len, max_new = 8, 16
+        model_cfg = GPTConfig(
+            vocab_size=256, num_layers=2, num_heads=4, head_dim=16,
+            max_seq_len=128, decode=True,
+            dtype=jnp.bfloat16 if platform == "tpu" else jnp.float32,
+            attention_impl=None if platform == "tpu" else "reference")
+        model = GPT(model_cfg)
+        toks = jnp.zeros((2, prompt_len), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks,
+                            positions=jnp.zeros((2,), jnp.int32),
+                            update_mask=jnp.zeros((2,), bool))["params"]
+        ex = ShardedExecutor(model, params,
+                             max_batch=cfg.serve_max_batch,
+                             max_len=model_cfg.max_seq_len)
+        queue = AdmissionQueue(max_queue=max(cfg.serve_max_queue, n_req),
+                               default_deadline_ms=cfg.serve_deadline_ms)
+        batcher = ContinuousBatcher(ex, queue, buckets=(16, 32))
+        batcher.warmup()
+        rng = np.random.RandomState(0)
+        t0 = time.perf_counter()
+        handles = [queue.submit(list(rng.randint(0, 256, prompt_len)),
+                                max_new_tokens=max_new)
+                   for _ in range(n_req)]
+        batcher.run()
+        wall = time.perf_counter() - t0
+        tokens = sum(len(h.tokens) for h in handles if h.status == "ok")
+        lat = sorted(h.latency_ms for h in handles
+                     if h.latency_ms is not None)
+        common = {"platform": platform, "requests": n_req,
+                  "max_batch": cfg.serve_max_batch,
+                  "prompt_len": prompt_len, "max_new_tokens": max_new}
+        print(json.dumps({
+            "metric": "serve_tokens_per_s",
+            "value": round(tokens / wall, 2), "unit": "tok/s",
+            **common}), flush=True)
+        print(json.dumps({
+            "metric": "serve_p50_ms",
+            "value": round(lat[len(lat) // 2], 2) if lat else None,
+            "unit": "ms", **common}), flush=True)
+        return 0
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        for metric, unit in (("serve_tokens_per_s", "tok/s"),
+                             ("serve_p50_ms", "ms")):
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": unit, "error": str(e)[-500:]}),
+                  flush=True)
+        return 1
+
+
 def main() -> int:
     stem = os.environ.get("HVD_BENCH_STEM", "conv7")
     model_name = os.environ.get("HVD_BENCH_MODEL", "resnet50")
@@ -294,5 +365,8 @@ def _last_hardware_capture(metric: str):
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         run_benchmark()
+    elif "--serve" in sys.argv or \
+            os.environ.get("HVD_BENCH_SERVE") == "1":
+        sys.exit(run_serve_benchmark())
     else:
         sys.exit(main())

@@ -203,8 +203,9 @@ struct Entry {
 struct ReduceState {
   std::set<int> posted;  // ranks folded into acc (idempotent re-posts)
   std::string acc;       // running AND/OR accumulator
-  uint8_t kind = 0;      // 0 = AND, 1 = OR (first post decides; members
-                         // of one round always agree by protocol)
+  uint8_t kind = 0;      // 0 = AND, 1 = OR (first post decides; a
+                         // non-first post that disagrees is a protocol
+                         // error, same as a size mismatch)
   bool complete = false;
   int reads_left = 0;
   int waiters = 0;
@@ -439,6 +440,11 @@ class StoreServer {
                 if (r.posted.empty()) {
                   r.acc.assign(blob, blen);
                   r.kind = kind;
+                } else if (kind != r.kind) {
+                  // protocol error, like the size-mismatch path below:
+                  // silently applying the first poster's kind would
+                  // hand a member an AND where it asked for an OR
+                  return "reduce kind mismatch";
                 } else if (blen != r.acc.size()) {
                   return "reduce size mismatch";
                 } else {
@@ -822,9 +828,31 @@ class Coordinator {
     return tag_seq_[tag];
   }
 
+  // Bound for tag_seq_: callers that bake a round/epoch into the tag
+  // (one collective per tag, seq 0 -> 1, never touched again) would
+  // otherwise grow the map for the job's lifetime. Far above the
+  // steady-state tag population of every in-tree caller.
+  static constexpr size_t kTagSeqCap = 4096;
+
   void Advance(const std::string& tag, uint64_t seq) {
     std::lock_guard<std::mutex> lk(seq_mu_);
     if (tag_seq_[tag] == seq) tag_seq_[tag] = seq + 1;
+    if (tag_seq_.size() <= kTagSeqCap) return;
+    // Prune advanced entries (seq > 0: their round completed; per-round
+    // tags are single-use and will never be queried again). The prune
+    // is DETERMINISTIC across ranks: every rank performs the identical
+    // sequence of successful Advances (the same-call-order contract
+    // above — retries don't advance), so all ranks drop the same
+    // entries at the same logical point. A pruned long-lived tag
+    // restarts at seq 0 on every rank simultaneously; its old rounds'
+    // server state is already read-drained or TTL-swept, so the reused
+    // keys cannot collide.
+    for (auto it = tag_seq_.begin(); it != tag_seq_.end();) {
+      if (it->first != tag && it->second > 0)
+        it = tag_seq_.erase(it);
+      else
+        ++it;
+    }
   }
 
   // Allgather of variable-size blobs. out = concat of u32-len-prefixed blobs

@@ -60,6 +60,7 @@ from .optim.functions import (                                 # noqa: F401
 )
 
 from . import elastic                                          # noqa: F401
+from . import serve                                            # noqa: F401
 from .runner.api import run                                    # noqa: F401
 from . import checkpoint                                       # noqa: F401
 from .checkpoint import (                                      # noqa: F401

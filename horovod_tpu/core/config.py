@@ -39,6 +39,28 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _env_int_strict(name: str, default: int) -> int:
+    """Like _env_int but a malformed value raises instead of silently
+    falling back — the serve knobs' fail-fast contract."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer; got {v!r}")
+
+
+def _env_float_strict(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name} must be a number; got {v!r}")
+
+
 @dataclass
 class Config:
     """All runtime knobs. Defaults mirror the reference where one exists."""
@@ -105,6 +127,20 @@ class Config:
     # True when HOROVOD_COMPRESSION was set explicitly — freezes the knob
     # against autotuning (same contract as hierarchical_allreduce_set).
     compression_set: bool = False
+    # Serving (horovod_tpu/serve): continuous-batching inference knobs.
+    # Decode slots the executor batches per iteration (the fixed jit
+    # batch shape — HOROVOD_SERVE_MAX_BATCH).
+    serve_max_batch: int = 8
+    # Admission-queue bound past which submits are load-shed with a
+    # structured retry-after rejection (HOROVOD_SERVE_MAX_QUEUE).
+    serve_max_queue: int = 64
+    # Default per-request deadline (HOROVOD_SERVE_DEADLINE_MS); expired
+    # requests resolve "expired" and free their KV slot.
+    serve_deadline_ms: float = 30000.0
+    # Prefill length buckets (HOROVOD_SERVE_BUCKETS, csv): prompts are
+    # right-padded to the smallest fitting bucket so jit compiles one
+    # prefill program per bucket and nothing else, ever.
+    serve_buckets: tuple = (32, 128, 512)
     # Process sets (operations.cc:649 HOROVOD_DYNAMIC_PROCESS_SETS).
     dynamic_process_sets: bool = False
     # Grouped-op fusion (operations.cc:616 HOROVOD_DISABLE_GROUP_FUSION).
@@ -168,6 +204,24 @@ class Config:
             "HOROVOD_COMPRESSION_BLOCK_SIZE", c.compression_block_size)
         c.compression_dcn_only = _env_bool(
             "HOROVOD_COMPRESSION_DCN_ONLY", c.compression_dcn_only)
+        # Serve knobs parse strictly (no silent default fallback): a
+        # typo'd shape knob must fail at startup, not surface as a
+        # recompile storm mid-traffic.
+        c.serve_max_batch = _env_int_strict(
+            "HOROVOD_SERVE_MAX_BATCH", c.serve_max_batch)
+        c.serve_max_queue = _env_int_strict(
+            "HOROVOD_SERVE_MAX_QUEUE", c.serve_max_queue)
+        c.serve_deadline_ms = _env_float_strict(
+            "HOROVOD_SERVE_DEADLINE_MS", c.serve_deadline_ms)
+        raw_buckets = os.environ.get("HOROVOD_SERVE_BUCKETS")
+        if raw_buckets is not None:
+            try:
+                c.serve_buckets = tuple(
+                    int(x) for x in raw_buckets.split(",") if x.strip())
+            except ValueError:
+                raise ValueError(
+                    f"HOROVOD_SERVE_BUCKETS must be a comma-separated "
+                    f"list of ints; got {raw_buckets!r}")
         c.elastic_enabled = _env_bool("HOROVOD_ELASTIC", c.elastic_enabled)
         c.dynamic_process_sets = _env_bool(
             "HOROVOD_DYNAMIC_PROCESS_SETS", c.dynamic_process_sets)
@@ -217,3 +271,27 @@ class Config:
             raise ValueError(
                 f"HOROVOD_CACHE_CAPACITY must be a non-negative int; got "
                 f"{self.cache_capacity!r}")
+        if not isinstance(self.serve_max_batch, int) or \
+                not (1 <= self.serve_max_batch <= 4096):
+            raise ValueError(
+                f"HOROVOD_SERVE_MAX_BATCH must be an int in [1, 4096] "
+                f"(the fixed decode batch shape); got "
+                f"{self.serve_max_batch!r}")
+        if not isinstance(self.serve_max_queue, int) or \
+                self.serve_max_queue < 1:
+            raise ValueError(
+                f"HOROVOD_SERVE_MAX_QUEUE must be a positive int; got "
+                f"{self.serve_max_queue!r}")
+        dl = self.serve_deadline_ms
+        if not isinstance(dl, (int, float)) or not (0 < dl <= 86_400_000):
+            raise ValueError(
+                f"HOROVOD_SERVE_DEADLINE_MS must be milliseconds in "
+                f"(0, 86400000]; got {dl!r}")
+        bk = self.serve_buckets
+        if (not isinstance(bk, (tuple, list)) or not bk
+                or not all(isinstance(b, int) and b > 0 for b in bk)
+                or list(bk) != sorted(set(bk))):
+            raise ValueError(
+                f"HOROVOD_SERVE_BUCKETS must be strictly ascending "
+                f"positive ints (one prefill program compiles per "
+                f"bucket); got {bk!r}")

@@ -31,7 +31,13 @@ class GPTConfig:
                  tp_axis: str = "tp", dtype=jnp.bfloat16,
                  attention_impl: Optional[str] = None,
                  remat: bool = False,
-                 logits_dtype=jnp.float32):
+                 logits_dtype=jnp.float32,
+                 decode: bool = False):
+        if decode and attention != "dense":
+            raise ValueError(
+                f"decode mode supports attention='dense' only (got "
+                f"{attention!r}); sequence parallelism shards the axis "
+                "the KV cache grows along")
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -59,6 +65,11 @@ class GPTConfig:
         #: either way (ops/pallas_ce.py), so only the stored logit
         #: values lose precision (standard TPU LM recipe)
         self.logits_dtype = logits_dtype
+        #: inference mode (horovod_tpu/serve): attention threads a
+        #: slotted KV cache (flax "cache" collection) and __call__ takes
+        #: per-row `positions` + `update_mask` at fixed [slots, T]
+        #: shapes — the serving executor's no-recompile contract
+        self.decode = decode
 
 
 class Attention(nn.Module):
@@ -68,12 +79,38 @@ class Attention(nn.Module):
     causal: bool = True
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None, update_mask=None):
         cfg = self.cfg
         B, S, _ = x.shape
         qkv = nn.Dense(3 * cfg.embed_dim, dtype=cfg.dtype,
                        param_dtype=jnp.float32, name="qkv")(x)
         qkv = qkv.reshape(B, S, 3, cfg.num_heads, cfg.head_dim)
+
+        # getattr: this Attention is shared by ViT/MoE whose configs
+        # predate the decode flag
+        if getattr(cfg, "decode", False):
+            # serving path: write the S new tokens' K/V into this
+            # layer's slotted cache at each row's offset, then attend
+            # over the cached prefix (horovod_tpu/serve/kv_cache.py).
+            # Same qkv/out params as training — the cache lives in the
+            # separate "cache" collection.
+            from ..serve import kv_cache as kvc
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+            ck = self.variable(
+                "cache", "k", jnp.zeros,
+                (B, cfg.max_seq_len, cfg.num_heads, cfg.head_dim),
+                cfg.dtype)
+            cv = self.variable(
+                "cache", "v", jnp.zeros,
+                (B, cfg.max_seq_len, cfg.num_heads, cfg.head_dim),
+                cfg.dtype)
+            ck.value, cv.value = kvc.write_kv(
+                ck.value, cv.value, k, v, positions, update_mask)
+            o = kvc.cached_attention(q, ck.value, cv.value, positions)
+            o = o.reshape(B, S, cfg.embed_dim)
+            return nn.Dense(cfg.embed_dim, dtype=cfg.dtype,
+                            param_dtype=jnp.float32, name="out")(o)
+
         q, k, v = [qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3)]
 
         if cfg.attention in ("ring", "ulysses", "zigzag") \
@@ -121,10 +158,11 @@ class Block(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None, update_mask=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + Attention(cfg, name="attn")(h)
+        x = x + Attention(cfg, name="attn")(h, positions=positions,
+                                            update_mask=update_mask)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         return x + MLP(cfg, name="mlp")(h)
 
@@ -133,14 +171,21 @@ class GPT(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None, update_mask=None):
         cfg = self.cfg
         B, S = tokens.shape
+        if cfg.decode and (positions is None or update_mask is None):
+            raise ValueError(
+                "decode mode needs per-row `positions` and `update_mask` "
+                "(see horovod_tpu/serve/executor.py)")
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
                      param_dtype=jnp.float32, name="embed")(tokens)
+        # decode: row i's S tokens sit at absolute positions
+        # positions[i] + [0, S) of that row's sequence
+        pos_idx = jnp.arange(S)[None] if positions is None \
+            else positions[:, None] + jnp.arange(S)[None, :]
         pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
-                       param_dtype=jnp.float32, name="pos_embed")(
-            jnp.arange(S)[None])
+                       param_dtype=jnp.float32, name="pos_embed")(pos_idx)
         x = (x + pos).astype(cfg.dtype)
         zig = (cfg.attention == "zigzag" and cfg.mesh is not None
                and cfg.sp_axis in cfg.mesh.axis_names)
@@ -155,7 +200,8 @@ class GPT(nn.Module):
             x = sp_lib.zigzag_shard(x, n_sp, seq_axis=1)
         block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layers_{i}")(x)
+            x = block_cls(cfg, name=f"layers_{i}")(
+                x, positions=positions, update_mask=update_mask)
         if zig:
             x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

@@ -42,7 +42,13 @@ class LlamaConfig:
                  tp_axis: str = "tp", dtype=jnp.bfloat16,
                  attention_impl: Optional[str] = None,
                  remat: bool = False,
-                 logits_dtype=jnp.float32):
+                 logits_dtype=jnp.float32,
+                 decode: bool = False):
+        if decode and attention != "dense":
+            raise ValueError(
+                f"decode mode supports attention='dense' only (got "
+                f"{attention!r}); sequence parallelism shards the axis "
+                "the KV cache grows along")
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -74,6 +80,11 @@ class LlamaConfig:
         #: logits/dlogits HBM traffic — the fused CE kernel computes in
         #: f32 internally either way
         self.logits_dtype = logits_dtype
+        #: inference mode (horovod_tpu/serve): attention threads a
+        #: slotted KV cache at kv width (GQA's H/KV HBM saving carries
+        #: straight into the cache) and __call__ takes per-row
+        #: `positions` + `update_mask` at fixed [slots, T] shapes
+        self.decode = decode
 
 
 def _round_up(x: int, m: int) -> int:
@@ -89,15 +100,21 @@ def rope_frequencies(head_dim: int, max_seq_len: int,
 
 
 def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
-    """Rotate-half RoPE. x [B, H, S, D]; angles [S, D/2] (f32).
+    """Rotate-half RoPE. x [B, H, S, D]; angles [S, D/2] or, for
+    per-row windows (decode: each cache slot sits at its own absolute
+    position), [B, S, D/2] (f32).
 
     Positions are absolute over the given angle slice, so sequence-
     parallel shards pass their own angle window (see Attention)."""
     B, H, S, D = x.shape
     xf = x.astype(jnp.float32).reshape(B, H, S, D // 2, 2)
     x1, x2 = xf[..., 0], xf[..., 1]
-    cos = jnp.cos(angles)[None, None]
-    sin = jnp.sin(angles)[None, None]
+    if angles.ndim == 3:     # [B, S, D/2] -> broadcast over heads
+        cos = jnp.cos(angles)[:, None]
+        sin = jnp.sin(angles)[:, None]
+    else:                    # [S, D/2] -> broadcast over batch + heads
+        cos = jnp.cos(angles)[None, None]
+        sin = jnp.sin(angles)[None, None]
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.reshape(B, H, S, D).astype(x.dtype)
 
@@ -121,7 +138,7 @@ class LlamaAttention(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None, update_mask=None):
         cfg = self.cfg
         B, S, _ = x.shape
         H, KV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -130,6 +147,29 @@ class LlamaAttention(nn.Module):
         q = dense(H * D, name="wq")(x).reshape(B, S, H, D)
         k = dense(KV * D, name="wk")(x).reshape(B, S, KV, D)
         v = dense(KV * D, name="wv")(x).reshape(B, S, KV, D)
+
+        if cfg.decode:
+            # serving path: rotate the S new tokens by each row's
+            # absolute positions, write K/V (kv width — GQA) into this
+            # layer's slotted cache, attend over the cached prefix
+            # (horovod_tpu/serve/kv_cache.py). Keys are cached
+            # post-RoPE, the standard absolute-rotation layout.
+            from ..serve import kv_cache as kvc
+            table = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
+            win = table[positions[:, None] + jnp.arange(S)[None, :]]
+            q = apply_rope(q.transpose(0, 2, 1, 3), win)
+            k = apply_rope(k.transpose(0, 2, 1, 3), win)
+            q, k = q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3)
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (B, cfg.max_seq_len, KV, D), cfg.dtype)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (B, cfg.max_seq_len, KV, D), cfg.dtype)
+            ck.value, cv.value = kvc.write_kv(
+                ck.value, cv.value, k, v, positions, update_mask)
+            o = kvc.cached_attention(q, ck.value, cv.value, positions)
+            return dense(cfg.embed_dim, name="wo")(
+                o.reshape(B, S, H * D))
+
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
         sp = (cfg.attention in ("ring", "ulysses", "zigzag")
@@ -204,9 +244,10 @@ class LlamaBlock(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None, update_mask=None):
         x = x + LlamaAttention(self.cfg, name="attn")(
-            RMSNorm(name="attn_norm")(x))
+            RMSNorm(name="attn_norm")(x), positions=positions,
+            update_mask=update_mask)
         return x + SwiGLU(self.cfg, name="mlp")(
             RMSNorm(name="mlp_norm")(x))
 
@@ -215,8 +256,12 @@ class Llama(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None, update_mask=None):
         cfg = self.cfg
+        if cfg.decode and (positions is None or update_mask is None):
+            raise ValueError(
+                "decode mode needs per-row `positions` and `update_mask` "
+                "(see horovod_tpu/serve/executor.py)")
         if tokens.shape[1] > cfg.max_seq_len:
             # fail loudly: the sp path would otherwise silently clamp
             # RoPE windows past the angle table (duplicated positions)
@@ -241,7 +286,8 @@ class Llama(nn.Module):
             x = sp_lib.zigzag_shard(x, n_sp, seq_axis=1)
         block_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layers_{i}")(x)
+            x = block_cls(cfg, name=f"layers_{i}")(
+                x, positions=positions, update_mask=update_mask)
         if zig:
             x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = RMSNorm(name="norm_f")(x)

@@ -62,6 +62,12 @@ logger = logging.getLogger("horovod_tpu")
 _name_counter = 0
 _name_lock = threading.Lock()
 
+#: equality-probe hysteresis width: consecutive probe misses before the
+#: probe is suspended, and the number of rounds it stays suspended
+#: (ADVICE round 5 — churning workloads must not pay a second blocking
+#: collective every negotiation round)
+_EQ_PROBE_HYSTERESIS = 4
+
 
 def _auto_name(prefix: str) -> str:
     global _name_counter
@@ -276,6 +282,18 @@ class Engine:
         # entirely (one O(blob)-reply OP_REDUCE probe instead of the
         # O(P*blob) gather fan-out)
         self.negot_eq_rounds = 0
+        # equality-probe hysteresis (ADVICE round 5): ragged/churning
+        # workloads fail the probe every round, paying a second blocking
+        # collective for nothing. After _EQ_PROBE_HYSTERESIS consecutive
+        # misses the probe is suspended for _EQ_PROBE_HYSTERESIS rounds
+        # (straight to the allgather), re-arming early the moment an
+        # allgathered round comes back byte-identical. Every transition
+        # is driven by rank-invariant data (the reduced probe result /
+        # the allgathered blob set / the round counter), so all
+        # processes keep issuing the same collective sequence.
+        self._eq_miss_streak = 0
+        self._eq_skip_left = 0
+        self.negot_eq_probe_skips = 0
         # join state (JoinOp, collective_operations.cc:418-432): while
         # _joined, the engine keeps negotiating with an empty queue and
         # contributes zero-filled tensors to peers' allreduces
@@ -776,12 +794,31 @@ class Engine:
         payload_bytes = json.dumps(payload).encode()
         digest = hashlib.sha1(payload_bytes,
                               usedforsecurity=False).digest()[:16]
-        probe = digest + bytes(~b & 0xFF for b in digest)
-        red = _collective(
-            lambda: coord.bitand(probe, tag=f"engine-negot-eq-{rnd}"),
-            "equality probe")
-        all_equal = red[:16] == bytes(~b & 0xFF for b in red[16:]) and \
-            red[:16] == digest
+        # Hysteresis: while suspended (N consecutive misses), skip the
+        # probe entirely and go straight to the allgather — no rank
+        # issues the probe collective, so the call sequence stays
+        # identical everywhere. Tags are FIXED strings (no round
+        # suffix): the coordinator's per-tag sequence number provides
+        # round uniqueness, so long jobs don't grow a per-round tag map
+        # (csrc/store.cc tag_seq_ — ADVICE round 5).
+        if self._eq_skip_left > 0:
+            self._eq_skip_left -= 1
+            self.negot_eq_probe_skips += 1
+            all_equal = False
+        else:
+            probe = digest + bytes(~b & 0xFF for b in digest)
+            red = _collective(
+                lambda: coord.bitand(probe, tag="engine-negot-eq"),
+                "equality probe")
+            all_equal = red[:16] == bytes(~b & 0xFF for b in red[16:]) \
+                and red[:16] == digest
+            if all_equal:
+                self._eq_miss_streak = 0
+            else:
+                self._eq_miss_streak += 1
+                if self._eq_miss_streak >= _EQ_PROBE_HYSTERESIS:
+                    self._eq_skip_left = _EQ_PROBE_HYSTERESIS
+                    self._eq_miss_streak = 0
         if all_equal:
             self.negot_eq_rounds += 1
             # parse once; downstream only mutates the top-level "w" key,
@@ -791,9 +828,14 @@ class Engine:
         else:
             blobs = _collective(
                 lambda: coord.allgather(payload_bytes,
-                                        tag=f"engine-negot-{rnd}"),
+                                        tag="engine-negot"),
                 "meta allgather")
             peers = [json.loads(b.decode()) for b in blobs]
+            if self._eq_skip_left and len(set(blobs)) == 1:
+                # payloads stabilized while the probe was suspended —
+                # re-arm it now (the allgather result is identical on
+                # every rank, so every rank re-arms in the same round)
+                self._eq_skip_left = 0
         self.fusion_threshold = peers[0].get("ft", self.fusion_threshold)
         self._state.config.hierarchical_allreduce = peers[0].get(
             "tl", self._state.config.hierarchical_allreduce)

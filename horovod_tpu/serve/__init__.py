@@ -1,0 +1,21 @@
+"""horovod_tpu.serve: TPU-native continuous-batching inference.
+
+The first request-path subsystem of the tree: an Orca/vLLM-style
+continuous batcher over the pjit-sharded decoder models, reusing the
+training stack's mesh/TP machinery for the forward path and the
+timeline for observability. See docs/serving.md for the architecture
+and the bucket/no-recompile contract.
+
+    queue.py     admission control: bounded queue, deadlines, load shed
+    kv_cache.py  slotted KV cache: device-side math + host accounting
+    batcher.py   iteration-level scheduler over fixed bucket shapes
+    executor.py  the one jitted step, sharded via parallel/tp rules
+    http.py      optional stdlib front end (/generate, /healthz)
+"""
+from .batcher import ContinuousBatcher                         # noqa: F401
+from .executor import ShardedExecutor                          # noqa: F401
+from .http import make_server, serve_http                      # noqa: F401
+from .kv_cache import SlotKVCache, cached_attention, write_kv  # noqa: F401
+from .queue import (                                           # noqa: F401
+    AdmissionQueue, Rejected, ServeHandle, ServeRequest,
+)

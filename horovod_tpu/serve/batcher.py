@@ -1,0 +1,225 @@
+"""Continuous batcher: iteration-level scheduling over fixed shapes.
+
+The Orca insight, TPU-flavored: requests join and leave the running
+batch *between decode iterations*, never mid-program, and every program
+the scheduler launches has one of a small closed set of shapes —
+``[max_batch, 1]`` for decode and ``[max_batch, bucket]`` for each
+configured prefill bucket (HOROVOD_SERVE_BUCKETS) — so jit compiles
+each exactly once and batch churn can never trigger a recompile.
+
+One `step()` is one scheduling iteration:
+
+1. **retire** — finished (max_new_tokens / EOS / context-full) and
+   deadline-expired sequences resolve their handles and free their KV
+   slot (serve/kv_cache.py `SlotKVCache`).
+2. **admit** — pop queued requests into free slots; newly admitted
+   prompts are packed into ONE prefill call at the smallest bucket that
+   fits the longest of them (rows right-padded, per-row `last_idx`
+   picks each prompt's true last logit). Rows owned by already-running
+   sequences ride along with `update_mask=False`, so their cache state
+   is untouched.
+3. **decode** — one `[max_batch, 1]` step for every live sequence; each
+   gets exactly one new token (the iteration-granularity fairness that
+   keeps p50 flat under mixed lengths).
+
+Prefill counts as producing the first generated token (its last-logit
+argmax), so a request admitted in iteration k has a token by k — no
+separate prefill queue.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .kv_cache import SlotKVCache
+from .queue import AdmissionQueue, ServeRequest
+
+
+@dataclass
+class _Active:
+    req: ServeRequest
+    slot: int
+    #: generated tokens so far (first comes from the prefill step)
+    out: List[int] = field(default_factory=list)
+    #: tokens written into the KV cache (prompt + confirmed generations)
+    cache_len: int = 0
+
+
+class ContinuousBatcher:
+    """Schedules an `AdmissionQueue` onto a `ShardedExecutor`."""
+
+    def __init__(self, executor, queue: AdmissionQueue, *,
+                 buckets: Sequence[int] = (32, 128, 512),
+                 eos_id: Optional[int] = None):
+        buckets = tuple(sorted(int(b) for b in buckets))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints; got {buckets}")
+        if buckets[-1] > executor.max_len:
+            raise ValueError(
+                f"largest prefill bucket {buckets[-1]} exceeds the model "
+                f"context {executor.max_len}")
+        self.executor = executor
+        self.queue = queue
+        self.buckets = buckets
+        self.eos_id = eos_id
+        # unservable prompts get shed at submit time, not discovered
+        # holding a decode slot
+        if queue.max_prompt_len is None or \
+                queue.max_prompt_len > buckets[-1]:
+            queue.max_prompt_len = buckets[-1]
+        self.kv = SlotKVCache(executor.max_batch, executor.max_len)
+        self._active: Dict[int, _Active] = {}   # slot -> sequence
+        self.iterations = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- shape warmup --------------------------------------------------------
+    def warmup(self) -> None:
+        """Compile every shape the scheduler can launch (decode + one
+        prefill per bucket) with all-False masks — state untouched. Run
+        once at startup so overload/churn never meets a compile."""
+        B = self.executor.max_batch
+        zero = np.zeros(B, np.int32)
+        off = np.zeros(B, bool)
+        for b in self.buckets:
+            self.executor.step(np.zeros((B, b), np.int32), zero, off, zero,
+                               kind="prefill")
+        self.executor.step(np.zeros((B, 1), np.int32), zero, off, zero,
+                           kind="decode")
+
+    # -- one scheduling iteration -------------------------------------------
+    def step(self) -> bool:
+        """Run one retire/admit/prefill/decode iteration; returns True
+        while there is (or may be) work in flight."""
+        self._retire()
+        admitted = self._admit()
+        if admitted:
+            self._prefill(admitted)
+            self._retire()  # a 1-token request finishes at prefill
+        if self._active:
+            self._decode()
+            self._retire()
+        self.iterations += 1
+        return bool(self._active) or self.queue.depth() > 0
+
+    def run(self, max_iterations: Optional[int] = None) -> None:
+        """Drive until drained (loopback/bench mode)."""
+        it = 0
+        while self.step():
+            it += 1
+            if max_iterations is not None and it >= max_iterations:
+                break
+
+    # -- background service mode (http front end) ---------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.step():
+                    # drained: sleep until a submit wakes us
+                    self.queue.wait_for_work(timeout=0.05)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="hvd-serve-batcher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- internals -----------------------------------------------------------
+    def _stats(self) -> dict:
+        return {"queue_depth": self.queue.depth(),
+                "occupancy": round(self.kv.occupancy(), 3),
+                "shed": self.queue.shed_count}
+
+    def _retire(self) -> None:
+        now = time.monotonic()
+        for slot in list(self._active):
+            seq = self._active[slot]
+            req = seq.req
+            done_ok = (len(seq.out) >= req.max_new_tokens
+                       or (self.eos_id is not None and seq.out
+                           and seq.out[-1] == self.eos_id)
+                       or seq.cache_len >= self.kv.max_len)
+            expired = req.expired(now)
+            if not (done_ok or expired):
+                continue
+            ms = (now - req.submitted_at) * 1000.0
+            if expired and not done_ok:
+                self.queue.expired_count += 1
+                req.handle._resolve(seq.out, "expired", latency_ms=ms)
+            else:
+                req.handle._resolve(seq.out, "ok", latency_ms=ms)
+                self.queue.note_service_ms(ms)
+            self.kv.free(slot)
+            del self._active[slot]
+
+    def _admit(self) -> List[_Active]:
+        free = self.kv.num_slots - self.kv.live()
+        if free <= 0:
+            return []
+        admitted: List[_Active] = []
+        for req in self.queue.pop(free):
+            slot = self.kv.alloc()  # free>=len(pop) => never None
+            admitted.append(_Active(req=req, slot=slot))
+            self._active[slot] = admitted[-1]
+        return admitted
+
+    def _bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise AssertionError(
+            f"prompt of {length} passed admission but fits no bucket "
+            f"{self.buckets}")  # queue.max_prompt_len makes this unreachable
+
+    def _prefill(self, admitted: List[_Active]) -> None:
+        B = self.executor.max_batch
+        bucket = self._bucket_for(max(len(a.req.prompt) for a in admitted))
+        tokens = np.zeros((B, bucket), np.int32)
+        positions = np.zeros(B, np.int32)
+        mask = np.zeros(B, bool)
+        last_idx = np.zeros(B, np.int32)
+        for a in admitted:
+            n = len(a.req.prompt)
+            tokens[a.slot, :n] = a.req.prompt
+            mask[a.slot] = True
+            last_idx[a.slot] = n - 1
+        nxt = self.executor.step(tokens, positions, mask, last_idx,
+                                 kind="prefill", stats=self._stats())
+        for a in admitted:
+            n = len(a.req.prompt)
+            a.cache_len = n
+            # the prompt is fully cached but only [0, n) is valid; the
+            # first generated token is the prompt's last-logit argmax
+            a.out.append(int(nxt[a.slot]))
+            self.kv.lengths[a.slot] = n
+
+    def _decode(self) -> None:
+        B = self.executor.max_batch
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros(B, np.int32)
+        mask = np.zeros(B, bool)
+        last_idx = np.zeros(B, np.int32)
+        for slot, seq in self._active.items():
+            # the newest token is not yet in the cache: this step writes
+            # it at position cache_len, attends, and samples the next
+            tokens[slot, 0] = seq.out[-1]
+            positions[slot] = seq.cache_len
+            mask[slot] = True
+        nxt = self.executor.step(tokens, positions, mask, last_idx,
+                                 kind="decode", stats=self._stats())
+        for slot, seq in self._active.items():
+            seq.cache_len += 1
+            self.kv.lengths[slot] = seq.cache_len
+            seq.out.append(int(nxt[slot]))

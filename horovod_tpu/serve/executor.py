@@ -1,0 +1,156 @@
+"""Sharded model executor: the single jitted entry point of the server.
+
+Drives a decode-mode model (models/gpt.py or models/llama.py with
+``cfg.decode=True``) at **fixed shapes**: every call is
+``[max_batch, T]`` tokens with per-row positions, an update mask and a
+per-row last-token index, where T is 1 (decode) or one of the configured
+prefill buckets. Because batch membership is carried in *data* (mask,
+positions) rather than *shape*, sequences can join and leave at
+iteration granularity without ever invalidating the jit cache — the
+no-recompile contract the continuous batcher (serve/batcher.py) is
+built on.
+
+Sharding rides the training stack unchanged: pass `mesh` plus the
+model's `PartitionRules` (parallel/tp.py) and parameters are placed with
+`shard_params`; jit/GSPMD then emits the same ICI collectives the
+training step uses. The KV cache and token buffers default to
+replicated, which is correct for TP (activations replicated, weights
+sharded) — the Megatron serving layout.
+
+Observability: each step lands on the timeline's **SERVE** row
+(`timeline.instant("SERVE", {...})`) with step latency, step kind,
+queue depth / batch occupancy / shed count (supplied by the batcher) and
+a rolling tokens/s, next to the engine's WIRE_BYTES row in the same
+trace.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ShardedExecutor:
+    """Owns the params, the device KV cache and the one jitted step."""
+
+    def __init__(self, model: Any, params: Any, *, max_batch: int,
+                 max_len: int, mesh=None, partition_rules=None,
+                 timeline=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1; got {max_batch}")
+        model_max = getattr(getattr(model, "cfg", None), "max_seq_len",
+                            None)
+        if model_max is not None and max_len > model_max:
+            # the cache arrays are shaped by the model's max_seq_len; a
+            # larger executor max_len would silently clamp cache writes
+            # and position lookups instead of erroring
+            raise ValueError(
+                f"max_len {max_len} exceeds the model's max_seq_len "
+                f"{model_max}")
+        self.model = model
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.timeline = timeline
+        if mesh is not None and partition_rules is not None:
+            from ..parallel.tp import shard_params
+            params = shard_params(params, mesh, partition_rules)
+        self.params = params
+        # -- metrics --
+        self.steps = 0
+        self.tokens_out = 0
+        self.step_latencies_ms: "deque[float]" = deque(maxlen=1024)
+        self._tok_window: "deque[Tuple[float, int]]" = deque(maxlen=1024)
+        #: distinct (kind, T) entry points actually executed — the
+        #: jit-signature ledger the no-recompile tests assert on
+        self.signatures: Set[Tuple[str, int]] = set()
+
+        def fwd(params, cache, tokens, positions, mask, last_idx):
+            logits, vout = self.model.apply(
+                {"params": params, "cache": cache}, tokens,
+                positions=positions, update_mask=mask, mutable=["cache"])
+            # next-token logits at each row's last REAL token (prompts
+            # are right-padded to the bucket length)
+            last = logits[jnp.arange(logits.shape[0]), last_idx]
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return nxt, vout["cache"]
+
+        # donating the cache lets XLA update it in place on TPU; CPU
+        # does not support donation and would only warn
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._fwd = jax.jit(fwd, donate_argnums=donate)
+
+        # materialize the zero cache once (a separate cache-creating
+        # trace; steady-state steps all go through self._fwd)
+        def make_cache(params, tokens, positions, mask):
+            _, v = self.model.apply(
+                {"params": params}, tokens, positions=positions,
+                update_mask=mask, mutable=["cache"])
+            return v["cache"]
+
+        z = jnp.zeros((max_batch, 1), jnp.int32)
+        self.cache = jax.jit(make_cache)(
+            params, z, jnp.zeros((max_batch,), jnp.int32),
+            jnp.zeros((max_batch,), bool))
+
+    # -- the one step --------------------------------------------------------
+    def step(self, tokens: np.ndarray, positions: np.ndarray,
+             mask: np.ndarray, last_idx: np.ndarray, *,
+             kind: str = "decode",
+             stats: Optional[Dict[str, Any]] = None) -> np.ndarray:
+        """Run one fixed-shape forward step; returns the sampled
+        (greedy) next token per row, valid where `mask` is set.
+
+        tokens [max_batch, T] int32; positions/last_idx [max_batch]
+        int32; mask [max_batch] bool. `stats` (queue depth, occupancy,
+        shed count — batcher-supplied) is folded into the SERVE event.
+        """
+        t0 = time.perf_counter()
+        self.signatures.add((kind, int(tokens.shape[1])))
+        nxt, self.cache = self._fwd(
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32), jnp.asarray(mask, bool),
+            jnp.asarray(last_idx, jnp.int32))
+        nxt = np.asarray(nxt)  # host readback doubles as completion fence
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self.steps += 1
+        self.step_latencies_ms.append(dt_ms)
+        n_tok = int(np.sum(mask))
+        self.tokens_out += n_tok
+        self._tok_window.append((time.perf_counter(), n_tok))
+        if self.timeline is not None:
+            ev = {"kind": kind, "step_ms": round(dt_ms, 3),
+                  "tokens": n_tok, "tokens_per_s": round(self.tokens_per_s(), 1)}
+            if stats:
+                ev.update(stats)
+            self.timeline.instant("SERVE", ev)
+        return nxt
+
+    # -- metrics -------------------------------------------------------------
+    def tokens_per_s(self) -> float:
+        """Rolling throughput over the retained step window."""
+        if len(self._tok_window) < 2:
+            return 0.0
+        t_first = self._tok_window[0][0]
+        t_last = self._tok_window[-1][0]
+        if t_last <= t_first:
+            return 0.0
+        toks = sum(n for _, n in self._tok_window) - self._tok_window[0][1]
+        return toks / (t_last - t_first)
+
+    def p50_step_ms(self) -> Optional[float]:
+        if not self.step_latencies_ms:
+            return None
+        return float(np.median(self.step_latencies_ms))
+
+    def jit_cache_size(self) -> int:
+        """Compiled-program count of the step function (falls back to
+        the executed-signature count on jax versions without the
+        introspection hook) — the churn tests assert this is flat."""
+        try:
+            return int(self._fwd._cache_size())
+        except Exception:  # noqa: BLE001 — private API across jax versions
+            return len(self.signatures)

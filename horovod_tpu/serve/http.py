@@ -1,0 +1,116 @@
+"""Thin stdlib HTTP front end for the serve stack (optional).
+
+Two endpoints, JSON in/out, zero dependencies beyond `http.server`:
+
+* ``POST /generate``  body ``{"tokens": [...], "max_new_tokens": N,
+  "deadline_ms": M?}`` -> ``200 {"tokens": [...], "status": "ok",
+  "latency_ms": ...}``. Over capacity the admission queue sheds and the
+  reply is ``429 {"error": "rejected", "reason": ...,
+  "retry_after_ms": ...}`` with a standard ``Retry-After`` header —
+  the structured load-shed contract (docs/serving.md).
+* ``GET /healthz`` -> ``200`` with the queue/batcher/executor counters
+  (queue depth, occupancy, shed count, tokens/s).
+
+Production serving would sit behind a real frontend; this exists so the
+whole vertical slice — socket to TPU decode step — is drivable from
+curl and coverable by a loopback test.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .queue import Rejected
+
+
+def make_server(batcher, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Build (not start) an HTTP server bound to `batcher`'s queue.
+    `port=0` picks a free port (see ``server.server_address``)."""
+    queue = batcher.queue
+
+    class Handler(BaseHTTPRequestHandler):
+        # requests are held open while the batcher generates; the
+        # threading server gives each its own thread
+        def log_message(self, *a):  # quiet: counters replace access logs
+            pass
+
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[Tuple[Tuple[str, str], ...]] = None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers or ():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path != "/healthz":
+                self._reply(404, {"error": "not found"})
+                return
+            ex = batcher.executor
+            info = {"ok": True,
+                    "occupancy": round(batcher.kv.occupancy(), 3),
+                    "tokens_per_s": round(ex.tokens_per_s(), 1),
+                    "iterations": batcher.iterations}
+            info.update(queue.counters())
+            self._reply(200, info)
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                prompt = req["tokens"]
+                max_new = int(req.get("max_new_tokens", 16))
+                deadline_ms = req.get("deadline_ms")
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)
+                handle = queue.submit(prompt, max_new_tokens=max_new,
+                                      deadline_ms=deadline_ms)
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                # covers submit's own validation too (bad token values,
+                # max_new_tokens < 1, non-dict body): malformed input is
+                # always a structured 400, never a dropped socket
+                self._reply(400, {"error": "bad request", "detail": str(e)})
+                return
+            except Rejected as e:
+                hdrs = ()
+                if e.retry_after_ms is not None:
+                    # Retry-After is whole seconds; round up so clients
+                    # never come back early
+                    hdrs = (("Retry-After",
+                             str(max(1, int(e.retry_after_ms / 1000) + 1))),)
+                self._reply(429, {"error": "rejected", "reason": e.reason,
+                                  "retry_after_ms": e.retry_after_ms}, hdrs)
+                return
+            # wait past the request's own deadline: the batcher resolves
+            # expiry itself and this must not race it
+            handle.wait(timeout=(deadline_ms or
+                                 queue.default_deadline_ms) / 1000.0 + 30.0)
+            if not handle.done():
+                self._reply(504, {"error": "timeout"})
+                return
+            self._reply(200, {"tokens": handle.tokens,
+                              "status": handle.status,
+                              "latency_ms": handle.latency_ms})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_http(batcher, host: str = "127.0.0.1", port: int = 0):
+    """Start the batcher thread + HTTP server; returns (server, thread).
+    Call ``server.shutdown()`` then ``batcher.stop()`` to tear down."""
+    batcher.start()
+    srv = make_server(batcher, host, port)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="hvd-serve-http")
+    t.start()
+    return srv, t

@@ -1,0 +1,143 @@
+"""Slotted KV-cache: the serving-side memory manager.
+
+Orca/vLLM-style continuous batching needs per-sequence key/value state
+that outlives any single forward call and can be handed to a *different*
+sequence the moment its owner retires. Two halves live here:
+
+1. **Functional cache math** (`write_kv`, `cached_attention`): pure
+   jittable updates of the device-resident cache arrays. The cache
+   layout is ``[num_slots, max_len, num_kv_heads, head_dim]`` — one row
+   ("slot") per in-flight sequence, written in place at per-row offsets
+   with a vmapped dynamic_update_slice and read back under a per-row
+   validity mask. Shapes never depend on which slots are live, so jit
+   compiles the decode program exactly once (the no-recompile contract,
+   docs/serving.md).
+2. **Host-side slot accounting** (`SlotKVCache`): a free list with
+   per-slot lengths, occupancy and reuse counters. Slots are recycled
+   LIFO; stale bytes from the previous owner are never cleared — the
+   validity mask (`key position <= row position`) makes them
+   unreachable, which is what makes reuse O(1).
+
+The device arrays themselves live in the model's flax ``"cache"``
+collection (models/gpt.py, models/llama.py decode paths) and are
+threaded through the executor (serve/executor.py); this module holds no
+jax arrays of its own.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: additive mask for invalid key positions — large-negative rather than
+#: -inf so fully-masked garbage rows (inactive slots) still softmax to
+#: finite numbers instead of NaN
+_MASK_VALUE = -1e30
+
+
+def write_kv(cache_k: jax.Array, cache_v: jax.Array, k_new: jax.Array,
+             v_new: jax.Array, positions: jax.Array,
+             update_mask: jax.Array):
+    """Write `T` new K/V vectors per row at that row's offset.
+
+    cache_k/cache_v: [B, max_len, H_kv, D]; k_new/v_new: [B, T, H_kv, D];
+    positions: [B] int32 write offsets; update_mask: [B] bool — rows with
+    False keep their cache untouched (slots owned by OTHER sequences
+    during a prefill of newly admitted ones, or free slots).
+    Returns the updated (cache_k, cache_v).
+    """
+    def upd(c, u, p):
+        return jax.lax.dynamic_update_slice(c, u.astype(c.dtype), (p, 0, 0))
+
+    nk = jax.vmap(upd)(cache_k, k_new, positions)
+    nv = jax.vmap(upd)(cache_v, v_new, positions)
+    m = update_mask[:, None, None, None]
+    return jnp.where(m, nk, cache_k), jnp.where(m, nv, cache_v)
+
+
+def cached_attention(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
+                     positions: jax.Array) -> jax.Array:
+    """Causal attention of `T` query tokens over each row's cache prefix.
+
+    q: [B, T, H, D]; cache_k/cache_v: [B, max_len, H_kv, D] (GQA: kv
+    heads are broadcast locally, H % H_kv == 0); positions: [B] — query
+    token t of row i sits at absolute position positions[i] + t and may
+    attend cache entries [0, positions[i] + t]. Call AFTER write_kv so a
+    token attends to itself. Softmax runs in f32 with a large-negative
+    additive mask; stale bytes past the valid prefix (slot-reuse
+    leftovers) are unreachable by construction.
+    """
+    B, T, H, D = q.shape
+    L, KV = cache_k.shape[1], cache_k.shape[2]
+    if KV != H:
+        cache_k = jnp.repeat(cache_k, H // KV, axis=2)
+        cache_v = jnp.repeat(cache_v, H // KV, axis=2)
+    qf = q.astype(jnp.float32)
+    kf = cache_k.astype(jnp.float32)
+    vf = cache_v.astype(jnp.float32)
+    scores = jnp.einsum("bthd,bjhd->bhtj", qf, kf) / np.sqrt(D)
+    valid = jnp.arange(L)[None, None, None, :] <= (
+        positions[:, None, None, None] + jnp.arange(T)[None, None, :, None])
+    scores = jnp.where(valid, scores, _MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhtj,bjhd->bthd", probs, vf)
+    return out.astype(q.dtype)
+
+
+class SlotKVCache:
+    """Host-side slot manager: free list + per-slot length accounting.
+
+    One instance per batcher; `num_slots` equals the executor's fixed
+    decode batch (HOROVOD_SERVE_MAX_BATCH). Occupancy / reuse counters
+    feed the SERVE timeline row and the /healthz payload.
+    """
+
+    def __init__(self, num_slots: int, max_len: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1; got {num_slots}")
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1; got {max_len}")
+        self.num_slots = num_slots
+        self.max_len = max_len
+        # LIFO reuse: the most recently freed slot is re-issued first,
+        # keeping the hot rows hot
+        self._free: List[int] = list(range(num_slots))[::-1]
+        #: tokens written into each slot's cache row (the valid prefix)
+        self.lengths = np.zeros(num_slots, dtype=np.int32)
+        self.active = np.zeros(num_slots, dtype=bool)
+        #: times each slot has been (re)allocated — the reuse ledger
+        self.generation = np.zeros(num_slots, dtype=np.int64)
+        self.allocs = 0
+        self.frees = 0
+        self.peak_live = 0
+
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot (None when all are live). The new owner's
+        length starts at 0; stale cache bytes need no clearing (masked
+        out by `cached_attention`)."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self.active[slot] = True
+        self.lengths[slot] = 0
+        self.generation[slot] += 1
+        self.allocs += 1
+        self.peak_live = max(self.peak_live, self.live())
+        return slot
+
+    def free(self, slot: int) -> None:
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not live")
+        self.active[slot] = False
+        self.lengths[slot] = 0
+        self._free.append(slot)
+        self.frees += 1
+
+    def live(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def occupancy(self) -> float:
+        """Live slots / total slots — the batch-occupancy counter."""
+        return self.live() / self.num_slots

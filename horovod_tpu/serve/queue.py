@@ -1,0 +1,205 @@
+"""Admission-controlled request queue: backpressure instead of collapse.
+
+The serving front door. Three properties the ROADMAP's "heavy traffic
+from millions of users" target demands of it:
+
+* **Bounded**: at most HOROVOD_SERVE_MAX_QUEUE requests wait; past that
+  the queue *sheds load* — `submit` raises a structured `Rejected`
+  carrying a `retry_after_ms` estimate (depth x observed per-request
+  service time / batch width) so clients back off instead of piling on.
+  Shedding is an accounting event (`shed_count`), never a crash.
+* **Deadlined**: every request carries an absolute deadline
+  (HOROVOD_SERVE_DEADLINE_MS default). The batcher resolves expired
+  requests with status "expired" and whatever tokens were produced —
+  a late answer is a wasted decode slot.
+* **Handle-based**: `submit` returns a `ServeHandle` the caller waits
+  on; resolution happens on the batcher thread (serve/batcher.py), the
+  same one-writer discipline the engine uses for collective handles.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+
+class Rejected(Exception):
+    """Structured load-shed rejection (the HTTP 429 analog).
+
+    `retry_after_ms` is the backoff hint (None when retrying cannot
+    help, e.g. a prompt that can never fit the configured buckets).
+    """
+
+    def __init__(self, reason: str, retry_after_ms: Optional[float] = None):
+        self.reason = reason
+        self.retry_after_ms = retry_after_ms
+        hint = "" if retry_after_ms is None \
+            else f" (retry after {retry_after_ms:.0f} ms)"
+        super().__init__(f"request rejected: {reason}{hint}")
+
+
+@dataclass
+class ServeRequest:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    #: absolute monotonic deadline (seconds)
+    deadline: float
+    submitted_at: float
+    handle: "ServeHandle" = field(repr=False, default=None)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (now if now is not None else time.monotonic()) > self.deadline
+
+
+class ServeHandle:
+    """Caller-side completion handle; resolved exactly once by the
+    batcher. `status` is "pending" | "ok" | "expired" | "error"."""
+
+    def __init__(self, rid: int):
+        self.rid = rid
+        self.status = "pending"
+        self.tokens: List[int] = []
+        self.error: Optional[str] = None
+        self.latency_ms: Optional[float] = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def _resolve(self, tokens: Sequence[int], status: str,
+                 latency_ms: Optional[float] = None,
+                 error: Optional[str] = None) -> None:
+        if self._event.is_set():  # one-shot; late expiry races are no-ops
+            return
+        self.tokens = list(tokens)
+        self.status = status
+        self.error = error
+        self.latency_ms = latency_ms
+        self._event.set()
+
+
+class AdmissionQueue:
+    """Bounded FIFO with load shedding and service-time-based backoff.
+
+    Thread-safe: HTTP handler threads submit; the batcher thread pops.
+    """
+
+    def __init__(self, max_queue: int = 64,
+                 default_deadline_ms: float = 30000.0,
+                 max_prompt_len: Optional[int] = None):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1; got {max_queue}")
+        if default_deadline_ms <= 0:
+            raise ValueError("default_deadline_ms must be > 0; got "
+                             f"{default_deadline_ms}")
+        self.max_queue = max_queue
+        self.default_deadline_ms = default_deadline_ms
+        #: longest admissible prompt (the batcher sets this to its
+        #: largest prefill bucket so an unservable prompt is rejected at
+        #: the door, not discovered holding a decode slot)
+        self.max_prompt_len = max_prompt_len
+        self._dq: "deque[ServeRequest]" = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._ids = itertools.count()
+        # -- counters (SERVE timeline row / healthz) --
+        self.shed_count = 0
+        self.admitted_count = 0
+        self.completed_count = 0
+        self.expired_count = 0
+        #: EWMA of per-request service time, fed back by the batcher on
+        #: retirement; drives the retry_after_ms hint
+        self._service_ms_ewma: Optional[float] = None
+
+    # -- producer side ------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               deadline_ms: Optional[float] = None) -> ServeHandle:
+        """Admit a request or raise `Rejected` (load shed / unservable)."""
+        prompt = [int(t) for t in prompt]
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1; got {max_new_tokens}")
+        with self._lock:
+            if self.max_prompt_len is not None and \
+                    (not prompt or len(prompt) > self.max_prompt_len):
+                self.shed_count += 1
+                raise Rejected(
+                    f"prompt length {len(prompt)} outside servable range "
+                    f"[1, {self.max_prompt_len}]", retry_after_ms=None)
+            if len(self._dq) >= self.max_queue:
+                self.shed_count += 1
+                raise Rejected("queue full",
+                               retry_after_ms=self._retry_after_ms_locked())
+            now = time.monotonic()
+            dl = (deadline_ms if deadline_ms is not None
+                  else self.default_deadline_ms)
+            rid = next(self._ids)
+            req = ServeRequest(rid=rid, prompt=prompt,
+                               max_new_tokens=max_new_tokens,
+                               deadline=now + dl / 1000.0,
+                               submitted_at=now)
+            req.handle = ServeHandle(rid)
+            self._dq.append(req)
+            self.admitted_count += 1
+            self._work.set()
+            return req.handle
+
+    def _retry_after_ms_locked(self) -> float:
+        # depth x EWMA service time is the expected drain time of the
+        # queue ahead of the retrying client; 100 ms floor before the
+        # first completion calibrates the estimator
+        est = self._service_ms_ewma if self._service_ms_ewma else 100.0
+        return max(1.0, len(self._dq) * est)
+
+    # -- consumer (batcher) side -------------------------------------------
+    def pop(self, n: int) -> List[ServeRequest]:
+        """Take up to `n` requests FIFO. Already-expired requests are
+        resolved "expired" here and do not count against `n`."""
+        out: List[ServeRequest] = []
+        with self._lock:
+            now = time.monotonic()
+            while self._dq and len(out) < n:
+                req = self._dq.popleft()
+                if req.expired(now):
+                    self.expired_count += 1
+                    req.handle._resolve(
+                        [], "expired",
+                        latency_ms=(now - req.submitted_at) * 1000.0)
+                    continue
+                out.append(req)
+            if not self._dq:
+                self._work.clear()
+        return out
+
+    def note_service_ms(self, ms: float) -> None:
+        """Batcher feedback on request retirement (EWMA, alpha=0.2)."""
+        with self._lock:
+            self.completed_count += 1
+            if self._service_ms_ewma is None:
+                self._service_ms_ewma = ms
+            else:
+                self._service_ms_ewma += 0.2 * (ms - self._service_ms_ewma)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+    def wait_for_work(self, timeout: Optional[float] = None) -> bool:
+        """Block until at least one request is queued (batcher idle loop)."""
+        return self._work.wait(timeout)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"queue_depth": len(self._dq),
+                    "admitted": self.admitted_count,
+                    "shed": self.shed_count,
+                    "completed": self.completed_count,
+                    "expired": self.expired_count,
+                    "service_ms_ewma": self._service_ms_ewma}

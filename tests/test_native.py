@@ -186,6 +186,57 @@ def test_store_reduce_op(server):
         c.close()
 
 
+def test_store_reduce_kind_mismatch_is_protocol_error(server):
+    """A non-first poster whose reduce kind (AND vs OR) disagrees with
+    the first poster's gets a protocol error, like the size-mismatch
+    path — not a silent apply of the first kind (ADVICE round 5). The
+    server stays healthy for matched rounds afterwards."""
+    import time
+
+    from horovod_tpu.native.store import (NativeError, NativeTimeout,
+                                          StoreClient)
+
+    c0 = StoreClient("127.0.0.1", server.port)
+    c1 = StoreClient("127.0.0.1", server.port)
+    first_result = {}
+
+    def first_poster():
+        try:
+            c0.reduce("red/kind", 2, 0, b"\xff", is_or=False, timeout=5.0)
+            first_result["v"] = "completed"
+        except NativeTimeout:
+            first_result["v"] = "timeout"
+
+    t = threading.Thread(target=first_poster)
+    t.start()
+    # wait until the first post registered server-side (stat forces a
+    # sweep but live waiters are pinned)
+    for _ in range(200):
+        if c1.stat().get("reduces", 0) >= 1:
+            break
+        time.sleep(0.01)
+    with pytest.raises(NativeError):
+        c1.reduce("red/kind", 2, 1, b"\xff", is_or=True, timeout=5.0)
+    t.join(timeout=30)
+    # the mismatched post never joined, so the round cannot complete:
+    # the first poster times out cleanly instead of getting a wrong kind
+    assert first_result["v"] == "timeout"
+
+    # matched kinds on a fresh round still reduce fine
+    out = {}
+
+    def a():
+        out["a"] = c0.reduce("red/ok", 2, 0, bytes([0x0F]), timeout=30.0)
+
+    t2 = threading.Thread(target=a)
+    t2.start()
+    out["b"] = c1.reduce("red/ok", 2, 1, bytes([0x3F]), timeout=30.0)
+    t2.join()
+    assert out["a"] == out["b"] == bytes([0x0F])
+    c0.close()
+    c1.close()
+
+
 def test_coordinator_single_rank(server):
     coord = Coordinator("127.0.0.1", server.port, 0, 1)
     coord.barrier("solo")
