@@ -14,6 +14,10 @@ HVD_BENCH_MODEL selects resnet50 (default) | resnet101 | vgg16 |
 inception3 — the reference's full headline scaling trio
 (docs/benchmarks.rst:8-13) plus the rebuild's flagship.
 
+`--metrics` (or HVD_BENCH_METRICS=1) folds step-time p50/p99 from the
+obs registry's histogram into the summary line and prints the end-of-run
+registry snapshot as a second JSON line (docs/metrics.md).
+
 vs_baseline compares per-chip throughput against the reference's documented
 tf_cnn_benchmarks ResNet-101 example output (1656.82 img/sec on 16 P100s =
 103.55 img/sec/GPU, /root/reference/docs/benchmarks.rst:30-42) — the only
@@ -42,6 +46,9 @@ BACKOFF_S = 20.0
 MAX_TOTAL_S = int(os.environ.get("HVD_BENCH_TOTAL_TIMEOUT", "600"))
 
 _MARK = "HVD_BENCH_RESULT:"
+#: --metrics: the worker prints the end-of-run registry snapshot on this
+#: marker line and the driver forwards it verbatim
+_MARK_METRICS = "HVD_BENCH_METRICS:"
 
 #: mirror of horovod_tpu.models.bench_zoo.BENCH_MODELS — kept literal so
 #: main() never imports the package (and thus jax) in the parent process;
@@ -160,6 +167,28 @@ def run_benchmark():
         step_time = dt_b / num_iters_b
         timing = "mean_fallback"  # latency-biased; marked so readers know
 
+    # --metrics: one extra observed pass with per-step readback, each
+    # step timed into the obs registry's step-time histogram, so the
+    # summary line carries p50/p99 and the snapshot shows the engine
+    # counters (wire bytes, cycles) for the whole run. Separate from
+    # the slope-timed runs above: per-step readback serializes the
+    # pipeline and would bias the throughput figure.
+    step_pcts = {}
+    if os.environ.get("HVD_BENCH_METRICS") == "1":
+        from horovod_tpu import obs
+        for _ in range(num_iters_a):
+            with obs.step_timer():
+                params, opt_state, batch_stats, loss = step(
+                    params, opt_state, batch_stats, images, labels)
+                float(loss)
+        hist = obs.get_registry().get("hvd_step_time_ms")
+        if hist is not None and hist.count:
+            step_pcts = {
+                "step_ms_p50": round(hist.percentile(0.50), 3),
+                "step_ms_p99": round(hist.percentile(0.99), 3)}
+        print(_MARK_METRICS + json.dumps(obs.get_registry().snapshot()),
+              flush=True)
+
     img_sec = batch / step_time
     img_sec_per_chip = img_sec / n_dev
     # wire_bytes_per_step: gradient-allreduce payload per step per chip
@@ -190,6 +219,7 @@ def run_benchmark():
         "batch": per_chip_batch,
         "repeats": repeats,
         "wire_bytes_per_step": wire_per_step,
+        **step_pcts,
     }), flush=True)
 
 
@@ -246,6 +276,16 @@ def run_serve_benchmark() -> int:
         common = {"platform": platform, "requests": n_req,
                   "max_batch": cfg.serve_max_batch,
                   "prompt_len": prompt_len, "max_new_tokens": max_new}
+        if os.environ.get("HVD_BENCH_METRICS") == "1":
+            from horovod_tpu import obs
+            hist = obs.get_registry().get("hvd_serve_step_ms",
+                                          {"kind": "decode"})
+            if hist is not None and hist.count:
+                common["step_ms_p50"] = round(hist.percentile(0.50), 3)
+                common["step_ms_p99"] = round(hist.percentile(0.99), 3)
+            print(json.dumps({"metric": "metrics_snapshot",
+                              "value": obs.get_registry().snapshot()}),
+                  flush=True)
         print(json.dumps({
             "metric": "serve_tokens_per_s",
             "value": round(tokens / wall, 2), "unit": "tok/s",
@@ -294,10 +334,19 @@ def main() -> int:
                 [sys.executable, "-u", __file__, "--worker"],
                 capture_output=True, text=True, timeout=budget,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+            result_line = metrics_line = None
             for line in out.stdout.splitlines():
                 if line.startswith(_MARK):
-                    print(line[len(_MARK):], flush=True)
-                    return 0
+                    result_line = line[len(_MARK):]
+                elif line.startswith(_MARK_METRICS):
+                    metrics_line = line[len(_MARK_METRICS):]
+            if result_line is not None:
+                print(result_line, flush=True)
+                if metrics_line is not None:
+                    print(json.dumps({"metric": "metrics_snapshot",
+                                      "value": json.loads(metrics_line)}),
+                          flush=True)
+                return 0
             tail = (out.stdout + out.stderr).strip().splitlines()[-6:]
             errors.append(f"attempt {attempt}: rc={out.returncode}: "
                           + " | ".join(tail))
@@ -363,6 +412,10 @@ def _last_hardware_capture(metric: str):
 
 
 if __name__ == "__main__":
+    # --metrics: fold step-time p50/p99 into the summary JSON and emit
+    # the end-of-run registry snapshot (docs/metrics.md)
+    if "--metrics" in sys.argv:
+        os.environ["HVD_BENCH_METRICS"] = "1"
     if "--worker" in sys.argv:
         run_benchmark()
     elif "--serve" in sys.argv or \

@@ -60,6 +60,8 @@ from .optim.functions import (                                 # noqa: F401
 )
 
 from . import elastic                                          # noqa: F401
+from . import obs                                              # noqa: F401
+from .obs import metrics_report                                # noqa: F401
 from . import serve                                            # noqa: F401
 from .runner.api import run                                    # noqa: F401
 from . import checkpoint                                       # noqa: F401

@@ -49,6 +49,8 @@ class _GlobalState:
         self.timeline = None          # timeline.Timeline
         self.parameter_manager = None # autotune.ParameterManager
         self.coordinator = None       # native.store.Coordinator (multi-proc)
+        self.metrics_exporter = None  # obs.exporter.Exporter (/metrics)
+        self.metrics_emitter = None   # obs.exporter.TimelineEmitter
         self.joined_ranks = set()
         self.last_joined_rank = -1
         self.shutdown_requested = False
@@ -173,6 +175,30 @@ def init(comm: Optional[Sequence[int]] = None,
             _state.timeline = timeline_mod.Timeline(cfg.timeline_filename)
             _state.timeline.start()
 
+        # /metrics exporter (HOROVOD_METRICS_PORT): every process
+        # exposes its own registry on port + process_index, so
+        # co-located controllers don't fight over one socket and a
+        # scraper sees one target per rank.
+        if cfg.metrics_port:
+            from ..obs import exporter as obs_exporter
+            try:
+                port = cfg.metrics_port + jax.process_index()
+                if port > 65535:
+                    raise ValueError(
+                        f"metrics port {port} (base + process_index) "
+                        f"exceeds 65535")
+                _state.metrics_exporter = obs_exporter.start_exporter(
+                    port=port)
+            except (OSError, ValueError) as e:
+                # observability must not take init down: a busy port /
+                # out-of-range offset degrades to a warning
+                logger.warning("metrics exporter unavailable: %s", e)
+        # periodic METRICS rows on the timeline
+        if cfg.metrics_timeline_period_s > 0 and _state.timeline is not None:
+            from ..obs import exporter as obs_exporter
+            _state.metrics_emitter = obs_exporter.TimelineEmitter(
+                _state.timeline, cfg.metrics_timeline_period_s)
+
         _state.initialized = True
 
     if process_sets:
@@ -204,6 +230,12 @@ def shutdown() -> None:
     if _state.engine is not None:
         _state.engine.stop()
         _state.engine = None
+    if _state.metrics_emitter is not None:
+        _state.metrics_emitter.stop()
+        _state.metrics_emitter = None
+    if _state.metrics_exporter is not None:
+        _state.metrics_exporter.stop()
+        _state.metrics_exporter = None
     if _state.timeline is not None:
         _state.timeline.stop()
         _state.timeline = None

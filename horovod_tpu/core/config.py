@@ -141,6 +141,15 @@ class Config:
     # right-padded to the smallest fitting bucket so jit compiles one
     # prefill program per bucket and nothing else, ever.
     serve_buckets: tuple = (32, 128, 512)
+    # Observability (horovod_tpu/obs): port for the stdlib /metrics +
+    # /healthz exporter (HOROVOD_METRICS_PORT; 0 disables). In
+    # multi-process mode each controller binds port + process_index so
+    # co-located processes don't fight over one socket.
+    metrics_port: int = 0
+    # Seconds between periodic METRICS instant rows on the timeline
+    # (HOROVOD_METRICS_TIMELINE_PERIOD; 0 disables). Only meaningful
+    # while a timeline is active.
+    metrics_timeline_period_s: float = 0.0
     # Process sets (operations.cc:649 HOROVOD_DYNAMIC_PROCESS_SETS).
     dynamic_process_sets: bool = False
     # Grouped-op fusion (operations.cc:616 HOROVOD_DISABLE_GROUP_FUSION).
@@ -222,6 +231,12 @@ class Config:
                 raise ValueError(
                     f"HOROVOD_SERVE_BUCKETS must be a comma-separated "
                     f"list of ints; got {raw_buckets!r}")
+        # Metrics knobs parse strictly too: a typo'd port must fail at
+        # startup, not silently leave the fleet unobservable.
+        c.metrics_port = _env_int_strict(
+            "HOROVOD_METRICS_PORT", c.metrics_port)
+        c.metrics_timeline_period_s = _env_float_strict(
+            "HOROVOD_METRICS_TIMELINE_PERIOD", c.metrics_timeline_period_s)
         c.elastic_enabled = _env_bool("HOROVOD_ELASTIC", c.elastic_enabled)
         c.dynamic_process_sets = _env_bool(
             "HOROVOD_DYNAMIC_PROCESS_SETS", c.dynamic_process_sets)
@@ -287,6 +302,16 @@ class Config:
             raise ValueError(
                 f"HOROVOD_SERVE_DEADLINE_MS must be milliseconds in "
                 f"(0, 86400000]; got {dl!r}")
+        mp = self.metrics_port
+        if not isinstance(mp, int) or not (0 <= mp <= 65535):
+            raise ValueError(
+                f"HOROVOD_METRICS_PORT must be an int in [0, 65535] "
+                f"(0 disables the exporter); got {mp!r}")
+        mtp = self.metrics_timeline_period_s
+        if not isinstance(mtp, (int, float)) or not (0 <= mtp <= 86_400):
+            raise ValueError(
+                f"HOROVOD_METRICS_TIMELINE_PERIOD must be seconds in "
+                f"[0, 86400] (0 disables); got {mtp!r}")
         bk = self.serve_buckets
         if (not isinstance(bk, (tuple, list)) or not bk
                 or not all(isinstance(b, int) and b > 0 for b in bk)

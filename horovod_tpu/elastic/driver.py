@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..obs import metrics as obs_metrics
 from ..runner import exec as exec_lib
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from ..runner.http_kv import RendezvousServer, make_secret
@@ -59,6 +60,23 @@ class ElasticDriver:
         self._secret = make_secret()
         self._stop = threading.Event()
         self._rc = 0
+        # -- metrics: membership churn events, scraped off the driver
+        # process's registry (HOROVOD_METRICS_PORT works here too)
+        R = obs_metrics.get_registry()
+        for fam in ("hvd_elastic_resets_total",
+                    "hvd_elastic_host_events_total",
+                    "hvd_elastic_worker_failures_total"):
+            R.unregister(fam)
+        self._m_resets = R.counter(
+            "hvd_elastic_resets_total",
+            "elastic reset rounds (relaunch + rank reassignment)")
+        self._m_host_events = {
+            k: R.counter("hvd_elastic_host_events_total",
+                         "hosts joining/leaving the discovered set",
+                         {"event": k}) for k in ("join", "leave")}
+        self._m_worker_failures = R.counter(
+            "hvd_elastic_worker_failures_total",
+            "worker exits with non-zero rc (host blacklisted)")
 
     # -- host assignment (driver.py:240 _update_host_assignments) ----------
     def _compute_slots(self, hosts: List[HostInfo],
@@ -97,6 +115,7 @@ class ElasticDriver:
                 if outcome == "done":
                     return self._rc
                 self.resets += 1
+                self._m_resets.inc()
                 if self.reset_limit is not None and \
                         self.resets > self.reset_limit:
                     raise RuntimeError(
@@ -154,6 +173,8 @@ class ElasticDriver:
                         "elastic: worker rank %d on %s failed (rc=%d); "
                         "blacklisting host and resetting",
                         w.slot.rank, w.slot.hostname, rc)
+                    self._m_worker_failures.inc()
+                    self._m_host_events["leave"].inc()
                     self.manager.blacklist(w.slot.hostname)
                     self._terminate_workers()
                     return "reset"
@@ -166,6 +187,12 @@ class ElasticDriver:
             if now != known:
                 logger.info("elastic: host set changed %s -> %s; resetting",
                             known, now)
+                joined = len(set(now) - set(known))
+                left = len(set(known) - set(now))
+                if joined:
+                    self._m_host_events["join"].inc(joined)
+                if left:
+                    self._m_host_events["leave"].inc(left)
                 self._terminate_workers()
                 return "reset"
             time.sleep(self.poll_interval)

@@ -1,0 +1,33 @@
+"""horovod_tpu.obs: the unified observability plane.
+
+Zero-dependency metrics for every runtime subsystem — the first-class
+counterpart of the reference's timeline writer + stall inspector
+machinery (SURVEY §2.1), extended with the fleet-wide visibility the
+ROADMAP's production target needs:
+
+    metrics.py   Counter/Gauge/Histogram + labeled MetricsRegistry,
+                 snapshot() and Prometheus text exposition
+    exporter.py  stdlib /metrics + /healthz HTTP endpoint
+                 (HOROVOD_METRICS_PORT) and the periodic METRICS
+                 timeline emitter
+    report.py    hvd.metrics_report(): cross-rank snapshot allgather,
+                 merged histograms, per-rank skew + straggler ranking
+
+Instrumented out of the box: ops/engine.py (negotiation latency, cycle
+time, fusion bucket sizes, cache hit/miss, wire bytes, stall warnings),
+serve/ (queue depth, admit/shed/expired, step + time-to-first-token
+latency histograms), optim/optimizer.py (eager step time) and elastic/
+(resets, host join/leave, worker failures). See docs/metrics.md.
+"""
+from .metrics import (                                          # noqa: F401
+    BYTES_BUCKETS, COUNT_BUCKETS, LATENCY_MS_BUCKETS,
+    Counter, Gauge, Histogram, MetricsRegistry,
+    get_registry, log_buckets, merge_snapshots, percentile_from_buckets,
+)
+from .exporter import (                                         # noqa: F401
+    Exporter, TimelineEmitter, make_metrics_server, start_exporter,
+    timeline_summary,
+)
+from .report import (                                           # noqa: F401
+    build_report, metrics_report, step_timer,
+)

@@ -1,0 +1,174 @@
+"""Cross-rank metrics aggregation: the fleet-wide straggler report.
+
+``hvd.metrics_report()`` allgathers every process's registry snapshot
+through the existing native coordinator (csrc/store.cc — the same
+control plane the engine negotiates over) and merges them on every
+rank: counters sum, fixed-bucket histograms add element-wise. On top of
+the merged snapshot it builds the load-imbalance view the ROADMAP's
+fleet target needs before anything can be tuned:
+
+* fleet p50/p99 of the step-time histogram,
+* a per-rank step-time table (count / mean / p50 / p99),
+* per-rank skew (each rank's mean over the fleet median), and
+* a named straggler ranking — slowest rank first.
+
+The call is COLLECTIVE in multi-process mode (every process must call
+it, like ``hvd.allreduce``); single-controller mode degenerates to a
+local report. When a timeline is active the report also lands there as
+a ``METRICS`` instant row.
+
+Step-time source: the first present of ``step_metrics`` (default: the
+bench/worker-loop ``hvd_step_time_ms`` timer, then the optimizer's
+``hvd_optimizer_step_ms``, then the serve executor's
+``hvd_serve_step_ms``, then the engine cycle histogram). Record your
+own with::
+
+    with hvd.obs.step_timer():
+        ...one training step...
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Optional, Sequence, Tuple
+
+from .metrics import (MetricsRegistry, get_registry, merge_snapshots,
+                      percentile_from_buckets)
+
+#: histogram the per-rank skew table is computed from, in preference order
+DEFAULT_STEP_METRICS = ("hvd_step_time_ms", "hvd_optimizer_step_ms",
+                        "hvd_serve_step_ms", "hvd_engine_cycle_ms")
+
+#: coordinator tag for the snapshot allgather; fixed string — the
+#: store's per-tag sequence numbers make repeated reports unique
+_REPORT_TAG = "obs-metrics-report"
+
+
+@contextlib.contextmanager
+def step_timer(name: str = "hvd_step_time_ms",
+               registry: Optional[MetricsRegistry] = None):
+    """Observe the wrapped block's wall time (ms) into the step-time
+    histogram the straggler report ranks by."""
+    h = (registry or get_registry()).histogram(
+        name, "per-step wall time (ms), worker-loop timed")
+    t0 = time.perf_counter()
+    try:
+        yield h
+    finally:
+        h.observe((time.perf_counter() - t0) * 1000.0)
+
+
+def _hist_rollup(entry: Optional[dict]) -> Optional[dict]:
+    if entry is None or not entry.get("count"):
+        return None
+    b, c = entry["bounds"], entry["counts"]
+    p50 = percentile_from_buckets(b, c, 0.50)
+    p99 = percentile_from_buckets(b, c, 0.99)
+    return {"count": int(entry["count"]),
+            "mean_ms": round(entry["sum"] / entry["count"], 3),
+            "p50_ms": None if p50 is None else round(p50, 3),
+            "p99_ms": None if p99 is None else round(p99, 3)}
+
+
+def _find_hist(snap: dict, name: str) -> Optional[dict]:
+    """The series' unlabeled child, or the sum of its labeled children
+    (e.g. hvd_serve_step_ms{kind=prefill|decode})."""
+    entries = [e for e in snap.get("histograms", []) if e["name"] == name]
+    if not entries:
+        return None
+    if len(entries) == 1:
+        return entries[0]
+    return merge_snapshots([{"histograms": [dict(e, labels={})]}
+                            for e in entries])["histograms"][0]
+
+
+def _median(vals: Sequence[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def build_report(snaps: Sequence[dict], *,
+                 step_metrics: Sequence[str] = DEFAULT_STEP_METRICS,
+                 rank: int = 0) -> dict:
+    """Pure merge+rank core of ``metrics_report`` (unit-testable without
+    a coordinator). ``snaps`` is one registry snapshot per rank, rank
+    order."""
+    merged = merge_snapshots(snaps)
+    step_metric = next(
+        (m for m in step_metrics if _find_hist(merged, m) is not None),
+        None)
+    report = {"world_size": len(snaps), "rank": rank, "merged": merged,
+              "step_metric": step_metric, "step_time": None,
+              "per_rank": {}, "skew": None, "stragglers": []}
+    if step_metric is None:
+        return report
+    report["step_time"] = _hist_rollup(_find_hist(merged, step_metric))
+    per_rank = {}
+    for r, snap in enumerate(snaps):
+        roll = _hist_rollup(_find_hist(snap, step_metric))
+        if roll is not None:
+            per_rank[r] = roll
+    report["per_rank"] = per_rank
+    if per_rank:
+        med = _median([v["mean_ms"] for v in per_rank.values()]) or None
+        ranking = sorted(per_rank.items(),
+                         key=lambda kv: kv[1]["mean_ms"], reverse=True)
+        report["stragglers"] = [
+            {"rank": r, **roll,
+             "skew": (round(roll["mean_ms"] / med, 3)
+                      if med else None)}
+            for r, roll in ranking]
+        if med:
+            report["skew"] = {
+                "median_mean_ms": round(med, 3),
+                "max_over_median": report["stragglers"][0]["skew"]}
+    return report
+
+
+def metrics_report(*, registry: Optional[MetricsRegistry] = None,
+                   step_metrics: Sequence[str] = DEFAULT_STEP_METRICS
+                   ) -> dict:
+    """Fleet-wide metrics report (collective in multi-process mode).
+
+    Every process contributes its registry snapshot over the native
+    coordinator; every process gets the same merged report back (so any
+    rank can act on it — e.g. the launcher's rank 0 logs the straggler
+    table). Single-process/SPMD mode reports locally.
+    """
+    reg = registry or get_registry()
+    snap = reg.snapshot()
+    snaps, rank = [snap], 0
+    coord, timeline = _runtime_handles()
+    if coord is not None and coord.size > 1:
+        blob = json.dumps(snap, sort_keys=True).encode()
+        # the allgather reply packs ALL ranks' blobs into one buffer:
+        # size the cap by the fleet (peers' snapshots are the same
+        # families, so 2x our own blob per rank is a generous bound)
+        cap = max(1 << 22, coord.size * (2 * len(blob) + 4096))
+        blobs = coord.allgather(blob, tag=_REPORT_TAG, max_bytes=cap)
+        snaps = [json.loads(b.decode()) for b in blobs]
+        rank = coord.rank
+    report = build_report(snaps, step_metrics=step_metrics, rank=rank)
+    if timeline is not None:
+        row = {"world_size": report["world_size"],
+               "step_metric": report["step_metric"],
+               "step_time": report["step_time"],
+               "skew": report["skew"],
+               "stragglers": report["stragglers"][:8]}
+        timeline.instant("METRICS", row)
+    return report
+
+
+def _runtime_handles() -> Tuple[Optional[object], Optional[object]]:
+    """(coordinator, timeline) of the live runtime, if initialized.
+    Imported lazily: obs must stay importable without jax."""
+    try:
+        from ..core import basics
+        if not basics.is_initialized():
+            return None, None
+        st = basics.get_state()
+        return st.coordinator, st.timeline
+    except Exception:  # noqa: BLE001 — report works standalone too
+        return None, None

@@ -53,6 +53,7 @@ import numpy as np
 from ..core import basics
 from ..core.process_sets import ProcessSet
 from ..core.types import DuplicateNameError, ReduceOp, RequestType, Status
+from ..obs import metrics as obs_metrics
 from ..optim.compression import (block_dequantize, block_quantize,
                                  wire_bytes, wire_format_of)
 from . import collective_ops
@@ -265,11 +266,55 @@ class Engine:
         self.cycles = 0
         self.tensors_fused = 0
         self.bytes_processed = 0
+        # -- metrics plane (horovod_tpu.obs): the engine's hot-path
+        # series, claimed fresh per Engine so the back-compat views
+        # (wire_bytes_logical/... properties) count from zero for THIS
+        # engine while /metrics shows the live one.
+        R = obs_metrics.get_registry()
+        for fam in ("hvd_wire_bytes_total", "hvd_engine_cycles_total",
+                    "hvd_engine_cycle_ms", "hvd_negotiation_ms",
+                    "hvd_negotiation_rounds_total",
+                    "hvd_fusion_bucket_tensors", "hvd_fusion_bucket_bytes",
+                    "hvd_cache_requests_total", "hvd_cache_hits_total",
+                    "hvd_stall_warnings_total"):
+            R.unregister(fam)
         # wire-byte accounting: logical = payload in its original dtype,
         # actual = what the configured wire format puts on the
         # interconnect (int8 payload + scale sidecar for "int8")
-        self.wire_bytes_logical = 0
-        self.wire_bytes_actual = 0
+        self._m_wire = {
+            k: R.counter("hvd_wire_bytes_total",
+                         "collective payload bytes: logical (native "
+                         "dtype) vs actual (configured wire format)",
+                         {"kind": k})
+            for k in ("logical", "actual")}
+        self._m_cycles = R.counter(
+            "hvd_engine_cycles_total", "dispatch cycles that executed work")
+        self._m_cycle_ms = R.histogram(
+            "hvd_engine_cycle_ms", "wall time of one dispatch cycle (ms)")
+        self._m_negot_ms = R.histogram(
+            "hvd_negotiation_ms",
+            "cross-process negotiation round latency (ms)")
+        self._m_negot_rounds = R.counter(
+            "hvd_negotiation_rounds_total",
+            "cross-process negotiation rounds")
+        self._m_bucket_tensors = R.histogram(
+            "hvd_fusion_bucket_tensors", "tensors per executed bucket",
+            bounds=obs_metrics.COUNT_BUCKETS)
+        self._m_bucket_bytes = R.histogram(
+            "hvd_fusion_bucket_bytes", "payload bytes per executed bucket",
+            bounds=obs_metrics.BYTES_BUCKETS)
+        self._m_cache_req = {
+            k: R.counter("hvd_cache_requests_total",
+                         "response-cache lookups by bucket kind",
+                         {"kind": k}) for k in ("fused", "single")}
+        self._m_cache_hit = {
+            k: R.counter("hvd_cache_hits_total",
+                         "response-cache signature reuses by bucket kind",
+                         {"kind": k}) for k in ("fused", "single")}
+        self._m_stall_warn = R.counter(
+            "hvd_stall_warnings_total",
+            "stall-inspector warnings (tensors stuck past the "
+            "warning threshold)")
         # cross-process negotiation round counter (multi-process mode)
         self._negot_round = 0
         # response-cache fast path over the wire: signature of the last
@@ -323,6 +368,17 @@ class Engine:
                 # format against autotuning (same contract as the
                 # hierarchical knob)
                 tune_compression=not cfg.compression_set)
+
+    # -- wire-byte back-compat views (the counters now live in the
+    # obs registry; these read them so `engine.wire_bytes_logical`
+    # keeps working for existing callers/tests) ----------------------------
+    @property
+    def wire_bytes_logical(self) -> int:
+        return int(self._m_wire["logical"].value)
+
+    @property
+    def wire_bytes_actual(self) -> int:
+        return int(self._m_wire["actual"].value)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -596,6 +652,7 @@ class Engine:
         coord = self._state.coordinator
         if coord is not None and coord.size > 1:
             tl_n = self._state.timeline
+            t_negot = time.perf_counter()
             if tl_n is not None:
                 # dedicated viewer row: negotiation wall time per cycle,
                 # so a trace shows how much of each cycle the control
@@ -621,6 +678,9 @@ class Engine:
                     w.handle._resolve(None, st)
                 return
             finally:
+                self._m_negot_rounds.inc()
+                self._m_negot_ms.observe(
+                    (time.perf_counter() - t_negot) * 1000.0)
                 if tl_n is not None:
                     tl_n.end("negotiation", "NEGOTIATE")
             if deferred:
@@ -629,6 +689,8 @@ class Engine:
             if not batch:
                 return
         self.cycles += 1
+        self._m_cycles.inc()
+        t_cycle = time.perf_counter()
         tl = self._state.timeline
         if tl is not None:
             tl.mark_cycle()
@@ -637,6 +699,7 @@ class Engine:
         wire_act_before = self.wire_bytes_actual
         for bucket in self._bucketize(batch):
             self._execute_bucket(bucket)
+        self._m_cycle_ms.observe((time.perf_counter() - t_cycle) * 1000.0)
         if tl is not None and self.wire_bytes_logical > wire_log_before:
             # per-cycle wire traffic on its own timeline row, so a trace
             # shows the compression win next to the collectives it bought
@@ -1085,10 +1148,15 @@ class Engine:
     def _execute_bucket(self, bucket: List[_Work]) -> None:
         tl = self._state.timeline
         names = [w.name for w in bucket]
+        bucket_bytes = 0
         for w in bucket:
             if not isinstance(w.tensor, (list, tuple)):
                 t = jnp.asarray(w.tensor)
-                self.bytes_processed += t.size * t.dtype.itemsize
+                bucket_bytes += t.size * t.dtype.itemsize
+        self.bytes_processed += bucket_bytes
+        self._m_bucket_tensors.observe(len(bucket))
+        if bucket_bytes:
+            self._m_bucket_bytes.observe(bucket_bytes)
         # Per-tensor phase transitions, mirroring the reference timeline's
         # state machine (timeline.h:102: QUEUED -> fused-op activity -> done).
         phase = bucket[0].request_type.name + \
@@ -1215,15 +1283,22 @@ class Engine:
         else:
             t = jnp.asarray(w.tensor)
             nb = t.size * t.dtype.itemsize
-        self.wire_bytes_logical += nb
-        self.wire_bytes_actual += nb
+        self._m_wire["logical"].inc(nb)
+        self._m_wire["actual"].inc(nb)
 
     def _cache_record(self, kind: str, sig: Tuple) -> Tuple:
         """Response-cache bookkeeping, keyed (kind, *sig) so fused-bucket
         hit rates are not polluted by singleton/quantized signatures."""
         key = (kind,) + sig
+        first = key not in self.cache_stats
         self.cache_stats[key] = self.cache_stats.get(key, 0) + 1
         self.cache_stats.move_to_end(key)
+        # registry series are monotonic (no LRU loss): the durable
+        # hit-rate record; cache_summary() below stays the per-signature
+        # LRU-bounded view it always was
+        self._m_cache_req[kind].inc()
+        if not first:
+            self._m_cache_hit[kind].inc()
         cap = self._state.config.cache_capacity
         while len(self.cache_stats) > cap:
             self.cache_stats.popitem(last=False)
@@ -1233,7 +1308,12 @@ class Engine:
         """Per-kind response-cache stats: 'fused' (multi-tensor buckets)
         vs 'single' (one-tensor programs). `hits` counts reuses beyond the
         first sight of each signature — the number the reference's
-        100%-cache-hit fast path cares about."""
+        100%-cache-hit fast path cares about.
+
+        This is the per-signature LRU-bounded view (evicted signatures
+        drop their counts with them); the monotonic record lives in the
+        obs registry as hvd_cache_requests_total / hvd_cache_hits_total
+        by kind (docs/metrics.md)."""
         out: Dict[str, Dict[str, int]] = {}
         for key, cnt in self.cache_stats.items():
             kind = key[0] if key and key[0] in ("fused", "single") \
@@ -1311,8 +1391,8 @@ class Engine:
         cols = sum(t.size for t in tensors) // n
         itemsize = tensors[0].dtype.itemsize
         bs = self._state.config.compression_block_size
-        self.wire_bytes_logical += n * cols * itemsize
-        self.wire_bytes_actual += n * wire_bytes(cols, wire, bs, itemsize)
+        self._m_wire["logical"].inc(n * cols * itemsize)
+        self._m_wire["actual"].inc(n * wire_bytes(cols, wire, bs, itemsize))
 
         if wire == "int8":
             return self._quantized_fused_allreduce(
@@ -1395,6 +1475,7 @@ class Engine:
                            and now - t > cfg.stall_shutdown_time_seconds]
             if stalled:
                 warned.update(stalled)
+                self._m_stall_warn.inc(len(stalled))
                 logger.warning(
                     "One or more tensors were submitted for collective "
                     "execution but have not completed for over %ss: %s "

@@ -24,6 +24,7 @@ aggregation, tensorflow/gradient_aggregation.py:23), `compression`,
 """
 from __future__ import annotations
 
+import time
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -33,6 +34,7 @@ import optax
 from ..core import basics
 from ..core.process_sets import ProcessSet
 from ..core.types import ReduceOp
+from ..obs import metrics as obs_metrics
 from ..ops import collective_ops, engine, inside
 from .compression import Compression
 
@@ -190,6 +192,17 @@ def DistributedOptimizer(
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
+    # step-time histogram (the straggler report's per-rank skew signal,
+    # obs/report.py). Host-timed, so EAGER mode only: the in-graph path
+    # is traced once and executed by XLA — time it from the train loop
+    # with obs.step_timer() instead.
+    m_step_ms = None
+    if axis_name is None:
+        m_step_ms = obs_metrics.get_registry().histogram(
+            "hvd_optimizer_step_ms",
+            "DistributedOptimizer update wall time (reduce + inner "
+            "update), ms — eager mode")
+
     def init_fn(params):
         inner = optimizer.init(params)
         if k == 1:
@@ -198,9 +211,12 @@ def DistributedOptimizer(
         return _AggState(inner, acc, jnp.zeros((), jnp.int32))
 
     def update_fn(grads, state: _AggState, params=None):
+        t0 = time.perf_counter() if m_step_ms is not None else None
         if k == 1:
             reduced = reduce_grads(grads)
             updates, inner = optimizer.update(reduced, state.inner, params)
+            if t0 is not None:
+                m_step_ms.observe((time.perf_counter() - t0) * 1000.0)
             return updates, _AggState(inner, state.acc, state.count)
 
         # Local gradient aggregation (gradient_aggregation.py:23): average k
@@ -233,6 +249,8 @@ def DistributedOptimizer(
                 count = jnp.zeros((), jnp.int32)
             else:
                 updates, acc, inner = skip_branch((acc, state.inner))
+            if t0 is not None:
+                m_step_ms.observe((time.perf_counter() - t0) * 1000.0)
         return updates, _AggState(inner, acc, count)
 
     return optax.GradientTransformation(init_fn, update_fn)

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from .kv_cache import SlotKVCache
 from .queue import AdmissionQueue, ServeRequest
 
@@ -76,6 +77,16 @@ class ContinuousBatcher:
         self.iterations = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # -- metrics: time-to-first-token (admission wait + prefill) and
+        # live KV occupancy, next to the queue's depth/shed series
+        R = obs_metrics.get_registry()
+        R.unregister("hvd_serve_ttft_ms")
+        R.unregister("hvd_serve_kv_occupancy")
+        self._m_ttft = R.histogram(
+            "hvd_serve_ttft_ms",
+            "time to first generated token (submit -> prefill), ms")
+        self._m_occupancy = R.gauge(
+            "hvd_serve_kv_occupancy", "fraction of KV slots in use")
 
     # -- shape warmup --------------------------------------------------------
     def warmup(self) -> None:
@@ -138,8 +149,10 @@ class ContinuousBatcher:
 
     # -- internals -----------------------------------------------------------
     def _stats(self) -> dict:
+        occ = self.kv.occupancy()
+        self._m_occupancy.set(occ)
         return {"queue_depth": self.queue.depth(),
-                "occupancy": round(self.kv.occupancy(), 3),
+                "occupancy": round(occ, 3),
                 "shed": self.queue.shed_count}
 
     def _retire(self) -> None:
@@ -197,7 +210,10 @@ class ContinuousBatcher:
             last_idx[a.slot] = n - 1
         nxt = self.executor.step(tokens, positions, mask, last_idx,
                                  kind="prefill", stats=self._stats())
+        t_first = time.monotonic()
         for a in admitted:
+            self._m_ttft.observe(
+                (t_first - a.req.submitted_at) * 1000.0)
             n = len(a.req.prompt)
             a.cache_len = n
             # the prompt is fully cached but only [0, n) is valid; the

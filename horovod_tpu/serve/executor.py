@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+
 
 class ShardedExecutor:
     """Owns the params, the device KV cache and the one jitted step."""
@@ -67,6 +69,19 @@ class ShardedExecutor:
         #: distinct (kind, T) entry points actually executed — the
         #: jit-signature ledger the no-recompile tests assert on
         self.signatures: Set[Tuple[str, int]] = set()
+        # registry series: per-kind step latency histogram + generated
+        # tokens (claimed fresh per executor — one serving stack per
+        # process)
+        R = obs_metrics.get_registry()
+        R.unregister("hvd_serve_step_ms")
+        R.unregister("hvd_serve_tokens_total")
+        self._m_step_ms = {
+            k: R.histogram("hvd_serve_step_ms",
+                           "executor step latency by kind (ms)",
+                           {"kind": k})
+            for k in ("prefill", "decode")}
+        self._m_tokens = R.counter(
+            "hvd_serve_tokens_total", "tokens generated")
 
         def fwd(params, cache, tokens, positions, mask, last_idx):
             logits, vout = self.model.apply(
@@ -118,8 +133,10 @@ class ShardedExecutor:
         dt_ms = (time.perf_counter() - t0) * 1000.0
         self.steps += 1
         self.step_latencies_ms.append(dt_ms)
+        self._m_step_ms.get(kind, self._m_step_ms["decode"]).observe(dt_ms)
         n_tok = int(np.sum(mask))
         self.tokens_out += n_tok
+        self._m_tokens.inc(n_tok)
         self._tok_window.append((time.perf_counter(), n_tok))
         if self.timeline is not None:
             ev = {"kind": kind, "step_ms": round(dt_ms, 3),

@@ -10,6 +10,9 @@ Two endpoints, JSON in/out, zero dependencies beyond `http.server`:
   the structured load-shed contract (docs/serving.md).
 * ``GET /healthz`` -> ``200`` with the queue/batcher/executor counters
   (queue depth, occupancy, shed count, tokens/s).
+* ``GET /metrics`` -> Prometheus text exposition of the process-global
+  registry (horovod_tpu.obs) — serve latency histograms next to the
+  engine's wire-byte counters, no second scrape port needed.
 
 Production serving would sit behind a real frontend; this exists so the
 whole vertical slice — socket to TPU decode step — is drivable from
@@ -22,6 +25,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
+from ..obs import metrics as obs_metrics
+from ..obs.exporter import PROMETHEUS_CONTENT_TYPE
 from .queue import Rejected
 
 
@@ -49,6 +54,15 @@ def make_server(batcher, host: str = "127.0.0.1",
             self.wfile.write(body)
 
         def do_GET(self):
+            # query-string tolerant, like the standalone exporter
+            if self.path.split("?", 1)[0] == "/metrics":
+                body = obs_metrics.get_registry().to_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path != "/healthz":
                 self._reply(404, {"error": "not found"})
                 return

@@ -25,6 +25,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from ..obs import metrics as obs_metrics
+
 
 class Rejected(Exception):
     """Structured load-shed rejection (the HTTP 429 analog).
@@ -109,14 +111,45 @@ class AdmissionQueue:
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._ids = itertools.count()
-        # -- counters (SERVE timeline row / healthz) --
-        self.shed_count = 0
-        self.admitted_count = 0
-        self.completed_count = 0
-        self.expired_count = 0
+        # -- counters: registry-backed (horovod_tpu.obs); the legacy
+        # attributes (shed_count & co) are properties over these, so the
+        # SERVE timeline row / healthz keep their numbers while /metrics
+        # exposes the same series fleet-wide. Claimed fresh per queue:
+        # one serving stack per process, and a new queue's views must
+        # count from zero.
+        R = obs_metrics.get_registry()
+        for fam in ("hvd_serve_admitted_total", "hvd_serve_shed_total",
+                    "hvd_serve_completed_total", "hvd_serve_expired_total",
+                    "hvd_serve_queue_depth"):
+            R.unregister(fam)
+        self._m_admitted = R.counter(
+            "hvd_serve_admitted_total", "requests admitted to the queue")
+        self._m_shed = R.counter(
+            "hvd_serve_shed_total",
+            "requests load-shed at admission (queue full / unservable)")
+        self._m_completed = R.counter(
+            "hvd_serve_completed_total", "requests retired ok")
+        self._m_expired = R.counter(
+            "hvd_serve_expired_total", "requests expired past deadline")
+        self._m_depth = R.gauge(
+            "hvd_serve_queue_depth", "requests waiting for a decode slot")
         #: EWMA of per-request service time, fed back by the batcher on
         #: retirement; drives the retry_after_ms hint
         self._service_ms_ewma: Optional[float] = None
+
+    # -- back-compat views over the registry counters ------------------------
+    shed_count = property(
+        lambda self: int(self._m_shed.value),
+        lambda self, v: self._m_shed._set(v))
+    admitted_count = property(
+        lambda self: int(self._m_admitted.value),
+        lambda self, v: self._m_admitted._set(v))
+    completed_count = property(
+        lambda self: int(self._m_completed.value),
+        lambda self, v: self._m_completed._set(v))
+    expired_count = property(
+        lambda self: int(self._m_expired.value),
+        lambda self, v: self._m_expired._set(v))
 
     # -- producer side ------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
@@ -129,12 +162,12 @@ class AdmissionQueue:
         with self._lock:
             if self.max_prompt_len is not None and \
                     (not prompt or len(prompt) > self.max_prompt_len):
-                self.shed_count += 1
+                self._m_shed.inc()
                 raise Rejected(
                     f"prompt length {len(prompt)} outside servable range "
                     f"[1, {self.max_prompt_len}]", retry_after_ms=None)
             if len(self._dq) >= self.max_queue:
-                self.shed_count += 1
+                self._m_shed.inc()
                 raise Rejected("queue full",
                                retry_after_ms=self._retry_after_ms_locked())
             now = time.monotonic()
@@ -147,7 +180,8 @@ class AdmissionQueue:
                                submitted_at=now)
             req.handle = ServeHandle(rid)
             self._dq.append(req)
-            self.admitted_count += 1
+            self._m_admitted.inc()
+            self._m_depth.set(len(self._dq))
             self._work.set()
             return req.handle
 
@@ -168,12 +202,13 @@ class AdmissionQueue:
             while self._dq and len(out) < n:
                 req = self._dq.popleft()
                 if req.expired(now):
-                    self.expired_count += 1
+                    self._m_expired.inc()
                     req.handle._resolve(
                         [], "expired",
                         latency_ms=(now - req.submitted_at) * 1000.0)
                     continue
                 out.append(req)
+            self._m_depth.set(len(self._dq))
             if not self._dq:
                 self._work.clear()
         return out
@@ -181,7 +216,7 @@ class AdmissionQueue:
     def note_service_ms(self, ms: float) -> None:
         """Batcher feedback on request retirement (EWMA, alpha=0.2)."""
         with self._lock:
-            self.completed_count += 1
+            self._m_completed.inc()
             if self._service_ms_ewma is None:
                 self._service_ms_ewma = ms
             else:

@@ -19,7 +19,16 @@ import os
 import queue
 import threading
 import time
+import zlib
 from typing import Optional
+
+
+def _tid(tensor_name: str) -> int:
+    """Stable per-tensor viewer row id. crc32, NOT Python hash():
+    hash(str) is salted per process (PYTHONHASHSEED), so tids would
+    differ across ranks and runs and multi-rank traces could never be
+    lined up event-by-event."""
+    return zlib.crc32(tensor_name.encode()) % (1 << 31)
 
 
 class Timeline:
@@ -102,13 +111,13 @@ class Timeline:
     def begin(self, tensor_name: str, phase: str) -> None:
         self._emit({"name": phase, "cat": phase, "ph": "B",
                     "ts": self._now_us(), "pid": 0,
-                    "tid": hash(tensor_name) % (1 << 31),
+                    "tid": _tid(tensor_name),
                     "args": {"tensor": tensor_name}})
 
     def end(self, tensor_name: str, phase: str) -> None:
         self._emit({"name": phase, "cat": phase, "ph": "E",
                     "ts": self._now_us(), "pid": 0,
-                    "tid": hash(tensor_name) % (1 << 31)})
+                    "tid": _tid(tensor_name)})
 
     def instant(self, name: str, args: Optional[dict] = None) -> None:
         self._emit({"name": name, "ph": "i", "s": "g",
@@ -122,38 +131,67 @@ class Timeline:
 
     # -- writer thread ------------------------------------------------------
     def _writer(self) -> None:
-        events = []
-        while True:
-            ev = self._q.get()
-            if ev is None:
-                break
-            events.append(ev)
-            # Drain opportunistically to batch writes.
-            try:
-                while True:
-                    nxt = self._q.get_nowait()
-                    if nxt is None:
-                        self._flush(events)
-                        return
-                    events.append(nxt)
-            except queue.Empty:
-                pass
-            if len(events) >= 4096:
-                self._flush(events)
-                events = []
-        self._flush(events)
-
-    def _flush(self, events) -> None:
-        # Rewrite the whole file each flush so it is always valid JSON
-        # (the reference streams and leaves the array unterminated; valid
-        # files are friendlier to tooling).
-        path = self.filename
+        # Stream-append with a valid-JSON finalize: the file is opened
+        # ONCE and each flush appends only the new events, then writes
+        # the "]}" terminator; the next flush seeks back over the
+        # terminator and continues with a comma. The file is valid JSON
+        # after every flush (friendlier to tooling than the reference's
+        # unterminated stream, timeline.cc) and a trace of n events
+        # costs O(n) I/O total — the old rewrite-the-whole-file scheme
+        # re-READ and re-wrote the entire JSON document every flush,
+        # O(n^2) for long traces.
+        #
+        # A previous writer's events on the same path (elastic restart,
+        # dynamic stop_timeline -> start_timeline) are carried forward
+        # by ONE read here at open — the append-across-restarts behavior
+        # the rewrite scheme provided, without its per-flush cost.
         existing = []
-        if os.path.exists(path):
+        if os.path.exists(self.filename):
             try:
-                with open(path) as f:
+                with open(self.filename) as f:
                     existing = json.load(f).get("traceEvents", [])
-            except Exception:
-                existing = []
-        with open(path, "w") as f:
-            json.dump({"traceEvents": existing + events}, f)
+            except Exception:  # noqa: BLE001 — corrupt/foreign file:
+                existing = []  # start a fresh trace
+        events = []
+        with open(self.filename, "w") as f:
+            f.write('{"traceEvents": [')
+            self._wrote_any = False
+            self._finalize(f)
+            if existing:
+                self._flush(f, existing)
+            while True:
+                ev = self._q.get()
+                if ev is None:
+                    break
+                events.append(ev)
+                # Drain opportunistically to batch writes.
+                try:
+                    while True:
+                        nxt = self._q.get_nowait()
+                        if nxt is None:
+                            self._flush(f, events)
+                            return
+                        events.append(nxt)
+                except queue.Empty:
+                    pass
+                if len(events) >= 4096:
+                    self._flush(f, events)
+                    events = []
+            self._flush(f, events)
+
+    def _flush(self, f, events) -> None:
+        if not events:
+            return
+        # rewind over the previous flush's "]}" terminator
+        f.seek(self._tail_pos)
+        for ev in events:
+            if self._wrote_any:
+                f.write(",")
+            f.write(json.dumps(ev))
+            self._wrote_any = True
+        self._finalize(f)
+
+    def _finalize(self, f) -> None:
+        self._tail_pos = f.tell()
+        f.write("]}")
+        f.flush()

@@ -1,0 +1,89 @@
+"""4-process metrics worker (1 device each): the cross-rank metrics
+plane end to end — per-rank step-time histograms with an artificially
+delayed rank 3, real engine traffic for the wire-byte counters, then a
+collective ``hvd.metrics_report()`` whose merged result must name rank 3
+the top straggler on EVERY rank (the allgather hands all ranks the same
+snapshot set)."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import obs  # noqa: E402
+
+STEPS = 6
+SLOW_RANK = 3
+SLOW_S, FAST_S = 0.08, 0.002
+
+
+def main(out_dir: str) -> None:
+    hvd.init()
+    pid = jax.process_index()
+    assert hvd.size() == 4, hvd.size()
+
+    R = obs.get_registry()
+    R.counter("mp_worker_events_total").inc(pid + 1)   # merged: 1+2+3+4
+
+    delay = SLOW_S if pid == SLOW_RANK else FAST_S
+    for i in range(STEPS):
+        # the timed region is this rank's LOCAL compute (the straggler
+        # signal); the engine allreduce stays outside it, because a
+        # synchronized collective absorbs the slowest rank's delay into
+        # everyone's wait time
+        with obs.step_timer():
+            time.sleep(delay)
+        # engine-routed (async) so the wire-byte counters see the
+        # traffic; sync eager ops bypass the engine
+        h = hvd.allreduce_async(
+            np.full((1, 2), float(pid), np.float32), hvd.Sum,
+            name=f"metrics_ar_{i}")
+        out = hvd.local_rows(hvd.synchronize(h))
+        np.testing.assert_allclose(out, 6.0)   # 0+1+2+3
+
+    rep = hvd.metrics_report()
+
+    per_rank_ok = (set(rep["per_rank"]) == {0, 1, 2, 3} and
+                   all(v["count"] == STEPS
+                       for v in rep["per_rank"].values()))
+    merged_events = sum(
+        e["value"] for e in rep["merged"]["counters"]
+        if e["name"] == "mp_worker_events_total")
+    # fleet wire bytes: 4 ranks x STEPS allreduces, each a [4, 2] fp32
+    # stacked payload -> 4 * STEPS * 32 logical bytes
+    wire_logical = sum(
+        e["value"] for e in rep["merged"]["counters"]
+        if e["name"] == "hvd_wire_bytes_total"
+        and e["labels"].get("kind") == "logical")
+    top = rep["stragglers"][0]
+    ok = (rep["world_size"] == 4
+          and rep["rank"] == pid
+          and rep["step_metric"] == "hvd_step_time_ms"
+          and per_rank_ok
+          and top["rank"] == SLOW_RANK
+          and top["skew"] > 3.0
+          and rep["skew"]["max_over_median"] == top["skew"]
+          and merged_events == 10.0
+          and wire_logical >= 4 * STEPS * 32)
+
+    with open(os.path.join(out_dir, f"result.{pid}.json"), "w") as f:
+        json.dump({"pid": pid, "ok": bool(ok),
+                   "top_straggler": top["rank"],
+                   "top_skew": top["skew"],
+                   "wire_logical": wire_logical,
+                   "per_rank": {str(k): v for k, v in
+                                rep["per_rank"].items()},
+                   "merged_events": merged_events}, f)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
