@@ -18,6 +18,11 @@ inception3 — the reference's full headline scaling trio
 obs registry's histogram into the summary line and prints the end-of-run
 registry snapshot as a second JSON line (docs/metrics.md).
 
+`--serve` runs the continuous-batching loopback benchmark and `--ckpt`
+the checkpoint-plane loopback (ckpt_save_ms / ckpt_blocking_ms /
+ckpt_restore_ms — docs/checkpoint.md), each emitting the same
+one-JSON-line-per-metric format.
+
 vs_baseline compares per-chip throughput against the reference's documented
 tf_cnn_benchmarks ResNet-101 example output (1656.82 img/sec on 16 P100s =
 103.55 img/sec/GPU, /root/reference/docs/benchmarks.rst:30-42) — the only
@@ -304,6 +309,96 @@ def run_serve_benchmark() -> int:
         return 1
 
 
+def run_ckpt_benchmark() -> int:
+    """Loopback checkpoint benchmark (`bench.py --ckpt`): drive the
+    sharded checkpoint plane (horovod_tpu/ckpt) over a synthetic
+    parameter tree and print THREE JSON metric lines consistent with
+    `--serve`/`--metrics` — ckpt_save_ms (synchronous save, submit ->
+    durable commit), ckpt_blocking_ms (async save()'s step-loop stall:
+    device sync + bounded handoff only) and ckpt_restore_ms (read ->
+    full CRC-verified tree). The async/sync ratio is the tentpole's
+    acceptance bar: blocking time <= 25% of the equivalent synchronous
+    save."""
+    import shutil
+    import statistics
+    import tempfile
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.ckpt import ShardedCheckpointer
+
+        platform = jax.devices()[0].platform
+        mb = int(os.environ.get("HVD_BENCH_CKPT_MB", "64"))
+        iters = int(os.environ.get("HVD_BENCH_CKPT_ITERS", "4"))
+        # a realistic tree shape: a few large matmul-ish leaves + many
+        # small ones (biases/scales), device-resident so save() pays a
+        # real device->host sync
+        rows = max((mb * (1 << 20)) // (4 * 1024) // 8, 8)
+        key = jax.random.PRNGKey(0)
+        tree = {"params": {}}
+        for i in range(8):
+            tree["params"][f"w{i}"] = jax.device_put(
+                jax.random.normal(jax.random.fold_in(key, i),
+                                  (rows, 1024), jnp.float32))
+        for i in range(32):
+            tree["params"][f"b{i}"] = jnp.full((128,), float(i))
+        tree["step"] = 0
+        jax.block_until_ready(tree["params"]["w0"])
+        root = tempfile.mkdtemp(prefix="hvd_ckpt_bench.")
+        try:
+            sync_ms, blocking_ms = [], []
+            with ShardedCheckpointer(
+                    os.path.join(root, "sync"), async_save=False,
+                    max_to_keep=2) as ck:
+                for i in range(iters):
+                    t0 = time.perf_counter()
+                    ck.save(i, tree, force=True)
+                    sync_ms.append((time.perf_counter() - t0) * 1000.0)
+                t0 = time.perf_counter()
+                out = ck.restore()
+                restore_ms = (time.perf_counter() - t0) * 1000.0
+                assert out["params"]["w0"].shape == (rows, 1024)
+            with ShardedCheckpointer(
+                    os.path.join(root, "async"), async_save=True,
+                    max_to_keep=2) as ck:
+                ck.save(0, tree, force=True)      # warmup: thread spinup
+                ck.wait_until_finished()
+                for i in range(1, iters + 1):
+                    t0 = time.perf_counter()
+                    ck.save(i, tree, force=True)
+                    blocking_ms.append(
+                        (time.perf_counter() - t0) * 1000.0)
+                    ck.wait_until_finished()   # isolate per-save stall
+                ck.wait_until_finished()
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        save = statistics.median(sync_ms)
+        blocking = statistics.median(blocking_ms)
+        common = {"platform": platform, "tree_mb": mb, "iters": iters,
+                  "blocking_over_sync": round(blocking / save, 4)}
+        if os.environ.get("HVD_BENCH_METRICS") == "1":
+            from horovod_tpu import obs
+            print(json.dumps({"metric": "metrics_snapshot",
+                              "value": obs.get_registry().snapshot()}),
+                  flush=True)
+        for metric, value in (("ckpt_save_ms", save),
+                              ("ckpt_blocking_ms", blocking),
+                              ("ckpt_restore_ms", restore_ms)):
+            print(json.dumps({"metric": metric,
+                              "value": round(value, 3), "unit": "ms",
+                              **common}), flush=True)
+        return 0
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        for metric in ("ckpt_save_ms", "ckpt_blocking_ms",
+                       "ckpt_restore_ms"):
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": "ms", "error": str(e)[-500:]}),
+                  flush=True)
+        return 1
+
+
 def main() -> int:
     stem = os.environ.get("HVD_BENCH_STEM", "conv7")
     model_name = os.environ.get("HVD_BENCH_MODEL", "resnet50")
@@ -421,5 +516,8 @@ if __name__ == "__main__":
     elif "--serve" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE") == "1":
         sys.exit(run_serve_benchmark())
+    elif "--ckpt" in sys.argv or \
+            os.environ.get("HVD_BENCH_CKPT") == "1":
+        sys.exit(run_ckpt_benchmark())
     else:
         sys.exit(main())

@@ -68,5 +68,7 @@ from . import checkpoint                                       # noqa: F401
 from .checkpoint import (                                      # noqa: F401
     Checkpointer, save_checkpoint, restore_checkpoint,
 )
+from . import ckpt                                             # noqa: F401
+from .ckpt import ShardedCheckpointer                          # noqa: F401
 
 __version__ = "0.2.0"

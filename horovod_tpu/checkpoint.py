@@ -203,38 +203,124 @@ def latest_step(directory: str) -> Optional[int]:
 
 # -- elastic integration --------------------------------------------------
 
+def _tree_fingerprint(tree: Any) -> bytes:
+    """Order-stable 128-bit blake2b over a pytree's structure + raw
+    leaf bytes — the change detector behind ``FileBackedState.commit``'s
+    skip-identical-write fast path. One linear pass, no serialization
+    allocations beyond per-leaf views. 128 bits, not crc32: this gates
+    a DURABILITY write, and a 2^-32 collision would silently skip
+    persisting a changed commit. Unfingerprintable leaves hash by
+    ``repr``, which can only over-report change (a spurious write,
+    never a skipped one)."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    h.update(str(treedef).encode())
+    for path, leaf in flat:
+        h.update(jax.tree_util.keystr(path).encode())
+        if isinstance(leaf, (np.ndarray, np.generic, jax.Array)):
+            arr = np.asarray(leaf)
+            h.update(f"{arr.dtype.str}{arr.shape}".encode())
+            # memoryview cast, not .view(uint8): works for 0-d scalar
+            # leaves too, still zero-copy for contiguous arrays
+            h.update(memoryview(np.ascontiguousarray(arr)).cast("B"))
+        else:
+            h.update(repr(leaf).encode())
+    return h.digest()
+
+
 class FileBackedState(State):
     """Elastic state whose commits also persist to disk.
 
     The reference's ``State.commit()`` is an in-memory snapshot + sync
     point (common/elastic.py:60-114) — it survives worker resets but not a
-    full job restart. ``FileBackedState`` extends commit to also write an
-    orbax checkpoint, so a relaunched job calls ``load_latest()`` and
-    continues from the last committed step.
+    full job restart. ``FileBackedState`` extends commit to also write a
+    checkpoint, so a relaunched job calls ``load_latest()`` and continues
+    from the last committed step.
+
+    ``backend`` selects the persistence plane: ``"orbax"`` (the rank-0
+    write convention above) or ``"ckpt"`` — the sharded plane
+    (horovod_tpu/ckpt): per-rank shard writes, CRC-verified restore, and
+    N->M resharding when the world size changed across a restart.
+
+    Commits are change-detected: a ``commit()`` whose tree is
+    byte-identical to the last persisted one skips the disk write
+    entirely (the in-memory snapshot still refreshes), so commit-often
+    training loops don't re-serialize an unchanged tree every sync
+    point. ``persist_count`` exposes the number of actual disk writes.
     """
 
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
-                 async_save: bool = False, **kwargs):
+                 async_save: bool = False, backend: str = "orbax",
+                 **kwargs):
         # async_save defaults OFF here: commit() must be durable — a crash
         # right after commit() with a queued async write would lose exactly
         # the state this class exists to preserve. Opt into async only if
         # losing the most recent commit on preemption is acceptable.
-        self._ckpt = Checkpointer(directory, max_to_keep=max_to_keep,
-                                  async_save=async_save)
+        if backend == "ckpt":
+            from .ckpt import ShardedCheckpointer
+            self._ckpt = ShardedCheckpointer(
+                directory, max_to_keep=max_to_keep, async_save=async_save)
+        elif backend == "orbax":
+            self._ckpt = Checkpointer(directory, max_to_keep=max_to_keep,
+                                      async_save=async_save)
+        else:
+            raise ValueError(
+                f"backend must be 'orbax' or 'ckpt'; got {backend!r}")
+        self._backend = backend
         self._commit_count = 0
+        self._persist_count = 0
+        self._last_fingerprint = None
         self._disk_enabled = False
         super().__init__(**kwargs)  # initial in-memory commit only
         self._disk_enabled = True
+
+    @property
+    def backend(self) -> str:
+        """Persistence plane: 'orbax' or 'ckpt'."""
+        return self._backend
+
+    @property
+    def persist_count(self) -> int:
+        """Disk writes performed by commit() (skipped identical commits
+        don't count)."""
+        return self._persist_count
+
+    def _fleet_agrees_unchanged(self, unchanged: bool) -> bool:
+        """The skip gates a COLLECTIVE save (every rank writes /
+        barriers), so a per-rank-varying leaf must not let one rank
+        skip while another saves — that deadlocks the commit. One
+        control-plane bit-AND round (cheap vs any disk write) makes the
+        decision unanimous: skip only when EVERY rank is unchanged."""
+        if not _is_multiprocess():
+            return unchanged
+        coord = basics.get_coordinator()
+        bits = coord.bitand(bytes([1 if unchanged else 0]),
+                            tag=f"ckpt.fpskip.{self._commit_count}")
+        return bool(bits[0])
 
     def commit(self) -> None:
         super().commit()
         if not self._disk_enabled:
             return
+        # materialize to host ONCE: the fingerprint pass and the save
+        # path share this copy, so change detection costs one crc sweep
+        # over host memory — not a second device->host transfer of the
+        # whole tree on every commit
+        host_saved = _to_numpy_tree(dict(self._saved))
+        fp = _tree_fingerprint(host_saved)
+        if self._fleet_agrees_unchanged(fp == self._last_fingerprint):
+            # byte-identical to the last persisted tree on ALL ranks:
+            # the disk copy is already this commit; skip the write
+            self._commit_count += 1
+            return
         step = self._values.get("step", None)
         if not isinstance(step, (int, np.integer)):
             step = self._commit_count
-        self._ckpt.save(int(step), dict(self._saved), force=True)
+        self._ckpt.save(int(step), host_saved, force=True)
         self._commit_count += 1
+        self._persist_count += 1
+        self._last_fingerprint = fp
 
     def load_latest(self, target: Optional[Any] = None) -> bool:
         """Restore the most recent on-disk commit into live values.
@@ -250,6 +336,19 @@ class FileBackedState(State):
         tree = self._ckpt.restore(step, target=target)
         self._values.update(tree)
         self.save()
+        # The loaded commit IS the persisted tree: seed the change
+        # detector so the next no-op commit() skips its disk write —
+        # but ONLY when the checkpoint covered every live field. A
+        # state with fields the (older) checkpoint lacks must persist
+        # on its next commit or those fields never reach disk.
+        if isinstance(tree, dict) and \
+                set(tree.keys()) == set(self._values.keys()):
+            # same host-materialized form commit() fingerprints, so
+            # the two crc streams are comparable byte-for-byte
+            self._last_fingerprint = _tree_fingerprint(
+                _to_numpy_tree(dict(self._saved)))
+        else:
+            self._last_fingerprint = None
         return True
 
     def close(self) -> None:

@@ -1,0 +1,36 @@
+"""horovod_tpu.ckpt: the resilient sharded checkpointing plane.
+
+Replaces the rank-0 orbax funnel (checkpoint.py) for per-controller
+state: every rank writes only its own row-blocks, saves are async behind
+a bounded device sync, shards carry buddy replicas, and a checkpoint
+saved on N ranks restores onto M ranks through a reshard-overlap plan —
+the elastic north-star's "resume after a topology change" path.
+
+    snapshot.py   device->host snapshot + double-buffered async writer
+                  (``save()`` blocks for the sync, not the write)
+    store.py      per-rank shard files + rank-0 manifest (treedef,
+                  shapes, shard->rank chunk map, per-chunk crc32),
+                  committed by atomic rename; CRC-verified fail-fast load
+    reshard.py    pure N->M shard-overlap plan + one-allgather restore
+                  over the native coordinator
+    replicate.py  buddy-rank shard mirroring over the p2p ring
+                  (HOROVOD_CKPT_REPLICATE)
+
+Entry points: :class:`ShardedCheckpointer` (same surface as the orbax
+``Checkpointer``) and ``FileBackedState(backend="ckpt")``. Knobs:
+``HOROVOD_CKPT_SNAPSHOT_DEPTH``, ``HOROVOD_CKPT_REPLICATE``,
+``HOROVOD_CKPT_MAX_TO_KEEP``, ``HOROVOD_CKPT_AUTO_RESTORE`` (strict
+fail-fast parsing, core/config.py). Observability: ``hvd_ckpt_save_ms``
+/ ``hvd_ckpt_blocking_ms`` / ``hvd_ckpt_restore_ms`` histograms,
+``hvd_ckpt_bytes_total{kind}`` and CKPT timeline rows. See
+docs/checkpoint.md for the format spec.
+"""
+from .store import (                                           # noqa: F401
+    CkptError, ShardedCheckpointer, list_steps, load_manifest,
+    replica_name, row_bounds, shard_name, step_dir, verify_step,
+)
+from .snapshot import AsyncSnapshotWriter, host_snapshot       # noqa: F401
+from .reshard import (                                         # noqa: F401
+    plan_reshard, read_block, restore_resharded,
+)
+from .replicate import exchange_shard                          # noqa: F401

@@ -1,0 +1,148 @@
+"""Device->host snapshotting and the double-buffered async writer.
+
+The step loop's contract with ``ShardedCheckpointer.save``: the only
+work on the calling thread is ``host_snapshot`` — a bounded device sync
+that copies every leaf to host memory — plus a queue handoff. Serialize,
+CRC, fsync and the commit rename all happen on ``AsyncSnapshotWriter``'s
+thread, so ``save()`` blocks for the device sync instead of the full
+write (the PR-4 tentpole's ``hvd_ckpt_blocking_ms`` vs
+``hvd_ckpt_save_ms`` split).
+
+Double buffering = a bounded in-flight queue: at most ``depth`` host
+snapshots exist at once. A ``save()`` beyond that blocks until the
+oldest write retires — bounded host memory, natural backpressure when
+the filesystem cannot keep up with the save cadence.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .store import CkptError
+
+
+def _key_name(k) -> str:
+    """One path component from a jax KeyEntry (DictKey/SequenceKey/
+    GetAttrKey/FlattenedIndexKey) — slash-joined into the manifest's
+    human-readable leaf paths."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def host_snapshot(tree: Any, copy_np: bool = True
+                  ) -> Tuple[List[str], List[Any], Any]:
+    """Flatten ``tree`` and pull every leaf to host memory.
+
+    Returns (paths, leaves, treedef): array leaves become host numpy
+    arrays (the bounded device sync — for a jax.Array this blocks until
+    the transfer lands), numpy scalars become 0-d arrays, everything
+    else passes through as a python object for the manifest. Arrays
+    spanning non-addressable devices (multi-host GSPMD) are rejected:
+    the sharded plane snapshots per-controller state; use the orbax
+    backend for cross-host arrays.
+
+    ``copy_np``: copy numpy leaves so the caller may keep mutating its
+    live tree while a writer thread serializes this snapshot. Pass
+    False for SYNCHRONOUS saves (the write completes inline before
+    save() returns) to skip a full-tree host memcpy per durable
+    commit."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths, leaves = [], []
+    for path, leaf in flat:
+        paths.append("/".join(_key_name(k) for k in path))
+        if isinstance(leaf, jax.Array):
+            # fully-replicated multi-host arrays (what elastic
+            # State.sync produces under jax.distributed) materialize
+            # locally even though is_fully_addressable is False; only
+            # genuinely PARTITIONED cross-host arrays are out of scope
+            if not (leaf.is_fully_addressable or
+                    getattr(leaf, "is_fully_replicated", False)):
+                raise CkptError(
+                    f"leaf {paths[-1]!r} is partitioned across "
+                    "non-addressable devices (multi-host GSPMD); the "
+                    "ckpt backend snapshots per-controller state — use "
+                    "backend='orbax' for cross-host sharded arrays")
+            leaves.append(np.asarray(leaf))
+        elif isinstance(leaf, np.generic):
+            leaves.append(np.asarray(leaf))
+        elif isinstance(leaf, np.ndarray):
+            leaves.append(leaf.copy() if copy_np else leaf)
+        else:
+            leaves.append(leaf)
+    return paths, leaves, treedef
+
+
+class AsyncSnapshotWriter:
+    """Ordered background executor with a bounded in-flight window.
+
+    ``submit(fn)`` enqueues a write job; jobs run strictly in submit
+    order on one thread (checkpoint commits must not reorder). The
+    queue holds at most ``depth`` jobs — a submit beyond that blocks,
+    which is the double-buffer backpressure bound. A job that raises is
+    stashed and re-raised on the NEXT submit/drain/stop so background
+    failures surface on the step loop instead of vanishing."""
+
+    def __init__(self, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"snapshot depth must be >= 1; got {depth}")
+        self._q: "queue.Queue[Optional[Callable[[], None]]]" = \
+            queue.Queue()
+        # EXACTLY depth jobs in flight, counting the one executing —
+        # a queue maxsize alone would admit depth+1 (depth queued plus
+        # one removed and running), overshooting the documented host
+        # memory bound by a full tree copy
+        self._slots = threading.Semaphore(depth)
+        self._err: Optional[BaseException] = None
+        self._err_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="hvd-ckpt-writer")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                self._q.task_done()
+                return
+            try:
+                job()
+            except BaseException as e:  # noqa: BLE001 — surfaced later
+                with self._err_lock:
+                    if self._err is None:
+                        self._err = e
+            finally:
+                self._slots.release()
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        with self._err_lock:
+            err, self._err = self._err, None
+        if err is not None:
+            raise CkptError(
+                f"async checkpoint write failed: {err}") from err
+
+    def submit(self, job: Callable[[], None]) -> None:
+        self._raise_pending()
+        if not self._thread.is_alive():
+            raise CkptError("snapshot writer already stopped")
+        self._slots.acquire()
+        self._q.put(job)
+
+    def drain(self) -> None:
+        """Block until every submitted job retired; re-raise a stashed
+        background failure."""
+        self._q.join()
+        self._raise_pending()
+
+    def stop(self) -> None:
+        if self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join(timeout=60)
+        self._raise_pending()

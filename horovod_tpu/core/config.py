@@ -141,6 +141,21 @@ class Config:
     # right-padded to the smallest fitting bucket so jit compiles one
     # prefill program per bucket and nothing else, ever.
     serve_buckets: tuple = (32, 128, 512)
+    # Checkpoint plane (horovod_tpu/ckpt): max in-flight async host
+    # snapshots — save() backpressures beyond this bound
+    # (HOROVOD_CKPT_SNAPSHOT_DEPTH; 2 = classic double buffering).
+    ckpt_snapshot_depth: int = 2
+    # Buddy-rank shard mirroring over the p2p ring so one lost host's
+    # shard is recoverable from its ring successor
+    # (HOROVOD_CKPT_REPLICATE).
+    ckpt_replicate: bool = False
+    # Committed checkpoints retained per directory; 0 keeps everything
+    # (HOROVOD_CKPT_MAX_TO_KEEP).
+    ckpt_max_to_keep: int = 3
+    # Elastic auto-restore: @hvd.elastic.run loads the state's last
+    # on-disk commit on (re)entry — through the reshard plan when the
+    # world size changed (HOROVOD_CKPT_AUTO_RESTORE).
+    ckpt_auto_restore: bool = False
     # Observability (horovod_tpu/obs): port for the stdlib /metrics +
     # /healthz exporter (HOROVOD_METRICS_PORT; 0 disables). In
     # multi-process mode each controller binds port + process_index so
@@ -231,6 +246,17 @@ class Config:
                 raise ValueError(
                     f"HOROVOD_SERVE_BUCKETS must be a comma-separated "
                     f"list of ints; got {raw_buckets!r}")
+        # Ckpt knobs parse strictly (the PR 1-3 convention): a typo'd
+        # depth/retention must fail at startup, not silently fall back
+        # and change durability semantics mid-job.
+        c.ckpt_snapshot_depth = _env_int_strict(
+            "HOROVOD_CKPT_SNAPSHOT_DEPTH", c.ckpt_snapshot_depth)
+        c.ckpt_max_to_keep = _env_int_strict(
+            "HOROVOD_CKPT_MAX_TO_KEEP", c.ckpt_max_to_keep)
+        c.ckpt_replicate = _env_bool(
+            "HOROVOD_CKPT_REPLICATE", c.ckpt_replicate)
+        c.ckpt_auto_restore = _env_bool(
+            "HOROVOD_CKPT_AUTO_RESTORE", c.ckpt_auto_restore)
         # Metrics knobs parse strictly too: a typo'd port must fail at
         # startup, not silently leave the fleet unobservable.
         c.metrics_port = _env_int_strict(
@@ -312,6 +338,17 @@ class Config:
             raise ValueError(
                 f"HOROVOD_METRICS_TIMELINE_PERIOD must be seconds in "
                 f"[0, 86400] (0 disables); got {mtp!r}")
+        sd = self.ckpt_snapshot_depth
+        if not isinstance(sd, int) or not (1 <= sd <= 64):
+            raise ValueError(
+                f"HOROVOD_CKPT_SNAPSHOT_DEPTH must be an int in [1, 64] "
+                f"(in-flight host snapshots, each a full tree copy); "
+                f"got {sd!r}")
+        mk = self.ckpt_max_to_keep
+        if not isinstance(mk, int) or not (0 <= mk <= 1_000_000):
+            raise ValueError(
+                f"HOROVOD_CKPT_MAX_TO_KEEP must be an int in "
+                f"[0, 1000000] (0 keeps every checkpoint); got {mk!r}")
         bk = self.serve_buckets
         if (not isinstance(bk, (tuple, list)) or not bk
                 or not all(isinstance(b, int) and b > 0 for b in bk)

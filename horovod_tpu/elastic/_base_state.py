@@ -49,6 +49,11 @@ class BaseFrameworkState:
         for cb in self._reset_callbacks:
             cb()
 
+    def load_latest(self, target=None) -> bool:
+        """Disk-commit restore hook (elastic/state.py State.load_latest
+        contract): memory-only framework states have nothing on disk."""
+        return False
+
     def save(self) -> None:
         self._saved = {"extras": copy.deepcopy(self._extras),
                        "payload": self._save_payload()}

@@ -151,6 +151,10 @@ class ElasticDriver:
         from ..native.shm import fresh_shm_gen
         env = dict(self.base_env)
         env["HOROVOD_SHM_GEN"] = fresh_shm_gen()
+        # Relaunched workers can tell a post-reset incarnation from the
+        # initial launch (epoch 0): the ckpt auto-restore path logs it,
+        # and user code can key recovery behavior off it.
+        env["HOROVOD_CKPT_RESET_EPOCH"] = str(self.resets)
         self._workers = exec_lib.launch_slots(
             slots, self.command, coord, kv_port, self._secret, env,
             ssh_port=self.ssh_port,

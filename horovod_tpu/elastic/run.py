@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 from typing import Callable, Optional
 
 from ..core import basics
@@ -30,11 +31,33 @@ def run(func: Callable) -> Callable:
     def wrapper(state: State, *args, **kwargs):
         reset_limit = kwargs.pop("reset_limit", None)
         resets = 0
+        restored_from_disk = False
         notification_manager.init()
         while True:
             try:
                 if not basics.is_initialized():
                     basics.init()
+                # HOROVOD_CKPT_AUTO_RESTORE: a (re)launched worker —
+                # the elastic driver restarts processes on every reset,
+                # possibly with a different world size — resumes from
+                # the state's last disk commit before the first sync.
+                # The ckpt backend reshards N->M automatically, so a
+                # topology change resumes instead of aborting. Only
+                # once per process: in-process resets roll back via the
+                # in-memory snapshot below, which is already current.
+                if not restored_from_disk:
+                    if basics.get_config().ckpt_auto_restore and \
+                            state.load_latest():
+                        logger.info(
+                            "elastic: auto-restored state from last "
+                            "disk commit (reset epoch %s)",
+                            os.environ.get("HOROVOD_CKPT_RESET_EPOCH",
+                                           "0"))
+                    # marked done only AFTER the attempt succeeded: a
+                    # collective load_latest interrupted by a comm
+                    # failure must retry on the next loop, not fall
+                    # through to training from initial state
+                    restored_from_disk = True
                 state.sync()
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:

@@ -76,6 +76,16 @@ class State:
         """Roll back to the last commit (common/elastic.py restore)."""
         self._values = {k: copy.deepcopy(v) for k, v in self._saved.items()}
 
+    def load_latest(self, target=None) -> bool:
+        """Restore the most recent DISK commit, when this state has one.
+
+        Base states are memory-only, so this is False; disk-backed
+        states (checkpoint.FileBackedState and its ckpt-plane backend)
+        override it. Declared here so the elastic wrapper's
+        HOROVOD_CKPT_AUTO_RESTORE path (elastic/run.py) can call it
+        uniformly on any state object."""
+        return False
+
     def sync(self, root_rank: int = 0) -> None:
         """Broadcast state from root so all workers agree
         (common/elastic.py sync)."""

@@ -325,6 +325,27 @@ class RingComm:
             send_buf = recv_buf[cut:]
         return out
 
+    def shift(self, arr: np.ndarray) -> np.ndarray:
+        """One-hop ragged rotation: send ``arr`` to the ring successor,
+        return what the predecessor sent here — as a uint8 byte array
+        (ragged payloads may differ in size AND dtype per rank, so the
+        bytes are never reinterpreted with the local dtype; callers
+        view/frombuffer with whatever framing they negotiated). The
+        checkpoint plane's buddy-replica exchange (ckpt/replicate.py) —
+        a single link crossing per rank, vs alltoall's (P-1)-step relay
+        rotation for payloads that only ever travel one hop.
+
+        One allgather of the byte counts frames the transfer (no tags
+        on the wire, same as every other collective here)."""
+        arr = np.ascontiguousarray(arr)
+        if self.size == 1:
+            return np.frombuffer(arr.tobytes(), np.uint8).copy()
+        counts = self.allgather(np.array([arr.nbytes], np.int64))
+        recv = np.empty(int(counts[(self.rank - 1) % self.size, 0]),
+                        np.uint8)
+        self._xfer(memoryview(arr).cast("B"), recv)
+        return recv
+
     def barrier(self) -> None:
         """Two token laps: everyone has entered after lap one, everyone
         may leave after lap two."""

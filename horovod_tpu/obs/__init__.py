@@ -16,8 +16,10 @@ ROADMAP's production target needs:
 Instrumented out of the box: ops/engine.py (negotiation latency, cycle
 time, fusion bucket sizes, cache hit/miss, wire bytes, stall warnings),
 serve/ (queue depth, admit/shed/expired, step + time-to-first-token
-latency histograms), optim/optimizer.py (eager step time) and elastic/
-(resets, host join/leave, worker failures). See docs/metrics.md.
+latency histograms), optim/optimizer.py (eager step time), elastic/
+(resets, host join/leave, worker failures) and ckpt/ (save/blocking/
+restore latency, bytes by kind, CKPT timeline rows). See
+docs/metrics.md.
 """
 from .metrics import (                                          # noqa: F401
     BYTES_BUCKETS, COUNT_BUCKETS, LATENCY_MS_BUCKETS,

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Inspect horovod_tpu.ckpt checkpoints (docs/checkpoint.md format):
+
+    python tools/ckpt_inspect.py dump   <dir> [--step N]
+    python tools/ckpt_inspect.py verify <dir> [--step N]
+    python tools/ckpt_inspect.py diff   <dirA> <dirB> [--step N] [--step-b M]
+
+``dump`` prints the manifest summary (step, writer world, leaf table,
+per-shard chunk/byte counts, replica coverage). ``verify`` re-reads
+every chunk (primaries and replicas) and recomputes CRCs — exit 1 with
+the failing chunk named on any mismatch. ``diff`` compares two
+checkpoints' tree structure (leaf paths, shapes, dtypes, partitioning) —
+exit 1 when they differ, with a line per difference.
+
+stdlib + numpy only — no jax, no hvd.init(); safe to point at a live
+training job's checkpoint directory from any host.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+
+def _load_store():
+    """Load ckpt/store.py standalone — its module level is
+    stdlib+numpy only, so the tool never imports jax (or initializes a
+    backend) just to read a manifest."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "horovod_tpu", "ckpt", "store.py")
+    spec = importlib.util.spec_from_file_location("_hvd_ckpt_store", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_store = _load_store()
+CkptError = _store.CkptError
+list_steps = _store.list_steps
+load_manifest = _store.load_manifest
+replica_name = _store.replica_name
+step_dir = _store.step_dir
+verify_step = _store.verify_step
+
+
+def _resolve_step(root: str, step) -> int:
+    if step is not None:
+        return int(step)
+    steps = list_steps(root)
+    if not steps:
+        raise CkptError(f"no committed checkpoints under {root}")
+    return steps[-1]
+
+
+def cmd_dump(args) -> int:
+    step = _resolve_step(args.dir, args.step)
+    man = load_manifest(args.dir, step)
+    sdir = step_dir(args.dir, step)
+    print(f"checkpoint {sdir}")
+    print(f"  format:  {man['format']}")
+    print(f"  step:    {man['step']}")
+    print(f"  world:   {man['world']} writer rank(s)")
+    print(f"  leaves:  {len(man['leaves'])}")
+    total = 0
+    for rank_s in sorted(man["chunks"], key=int):
+        chunks = man["chunks"][rank_s]
+        nbytes = sum(c["nbytes"] for c in chunks)
+        total += nbytes
+        rep = os.path.exists(os.path.join(
+            sdir, replica_name(int(rank_s))))
+        print(f"  shard {int(rank_s):5d}: {len(chunks):4d} chunks, "
+              f"{nbytes:12d} B{'  [+replica]' if rep else ''}")
+    print(f"  total:   {total} B"
+          f"{'  (replicated)' if man.get('replicated') else ''}")
+    print()
+    print(f"  {'path':<44} {'dtype':<10} {'part':<5} shape")
+    for e in man["leaves"]:
+        if e["kind"] == "array":
+            print(f"  {e['path']:<44} {e['dtype']:<10} "
+                  f"{e['partition']:<5} {tuple(e['shape'])}")
+        else:
+            val = repr(e.get("json", "<pickled>"))
+            print(f"  {e['path']:<44} {'pyobj':<10} {'rep':<5} "
+                  f"{val[:40]}")
+    return 0
+
+
+def cmd_verify(args) -> int:
+    step = _resolve_step(args.dir, args.step)
+    summary = verify_step(args.dir, step)
+    print(f"OK: step {summary['step']} — {summary['chunks']} chunks / "
+          f"{summary['leaves']} leaves / {summary['bytes']} B verified "
+          f"across {summary['world']} shard(s), "
+          f"{summary['replicas']} replica file(s) checked")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    step_a = _resolve_step(args.dir, args.step)
+    step_b = _resolve_step(args.dir_b, args.step_b
+                           if args.step_b is not None else None)
+    a = load_manifest(args.dir, step_a)
+    b = load_manifest(args.dir_b, step_b)
+
+    def table(man):
+        out = {}
+        for e in man["leaves"]:
+            if e["kind"] == "array":
+                out[e["path"]] = (e["dtype"], tuple(e["shape"]),
+                                  e["partition"])
+            else:
+                out[e["path"]] = ("pyobj",)
+        return out
+
+    ta, tb = table(a), table(b)
+    diffs = []
+    for p in sorted(set(ta) - set(tb)):
+        diffs.append(f"- only in A: {p} {ta[p]}")
+    for p in sorted(set(tb) - set(ta)):
+        diffs.append(f"- only in B: {p} {tb[p]}")
+    for p in sorted(set(ta) & set(tb)):
+        if ta[p] != tb[p]:
+            diffs.append(f"- differs: {p}  A={ta[p]}  B={tb[p]}")
+    if a["treedef"] != b["treedef"] and not diffs:
+        diffs.append("- identical leaf tables but different pytree "
+                     "structure (container types differ)")
+    if diffs:
+        print(f"treedefs differ (A step {step_a}, {len(ta)} leaves; "
+              f"B step {step_b}, {len(tb)} leaves):")
+        print("\n".join(diffs))
+        return 1
+    print(f"treedefs identical: {len(ta)} leaves "
+          f"(A step {step_a}, B step {step_b})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ckpt_inspect",
+        description="dump / verify / diff horovod_tpu.ckpt checkpoints")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("dump", help="print the manifest summary")
+    d.add_argument("dir")
+    d.add_argument("--step", type=int, default=None)
+    d.set_defaults(fn=cmd_dump)
+    v = sub.add_parser("verify", help="recompute every chunk CRC")
+    v.add_argument("dir")
+    v.add_argument("--step", type=int, default=None)
+    v.set_defaults(fn=cmd_verify)
+    f = sub.add_parser("diff", help="compare two checkpoints' treedefs")
+    f.add_argument("dir")
+    f.add_argument("dir_b")
+    f.add_argument("--step", type=int, default=None)
+    f.add_argument("--step-b", type=int, default=None)
+    f.set_defaults(fn=cmd_diff)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except CkptError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # `ckpt_inspect dump ... | head` closing stdout early is fine
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
