@@ -59,6 +59,7 @@ from .optim.functions import (                                 # noqa: F401
     broadcast_optimizer_state, broadcast_variables,
 )
 
+from . import chaos                                            # noqa: F401
 from . import elastic                                          # noqa: F401
 from . import obs                                              # noqa: F401
 from .obs import metrics_report                                # noqa: F401

@@ -1,0 +1,64 @@
+"""horovod_tpu.chaos: deterministic fault injection + failure detection.
+
+The robustness claims of the elastic + resilient-ckpt planes (survive a
+host loss, restore through a buddy replica, reshard N->M) are only as
+credible as the failure modes they are actually driven through. This
+package turns them from claimed into continuously verified:
+
+    plan.py      declarative, SEEDED fault plans (HOROVOD_CHAOS_PLAN —
+                 inline JSON or a file path): faults addressed by
+                 (rank, step/round, site) with kinds delay / drop /
+                 crash / corrupt / partition / slow_rank plus the ckpt
+                 filesystem faults torn_write and delete_chunk
+    inject.py    zero-overhead-when-disabled injection shims wrapped
+                 around the real wire and disk boundaries: the
+                 StoreClient request path (native/store.py), the p2p
+                 ring's send/recv (native/p2p.py _xfer — RingComm.shift
+                 and every ring collective), and the ckpt store's shard
+                 file I/O (ckpt/store.py)
+    detector.py  lease/accrual failure detector: each rank posts
+                 heartbeats through the coordinator KV store off the
+                 engine cycle, exposes hvd_peer_heartbeat_age_ms per
+                 peer, names the suspected rank in logs + HEALTH
+                 timeline rows, and escalates to the elastic driver so
+                 a dead host triggers a reset in O(heartbeat interval)
+                 instead of O(collective timeout)
+    soak.py      multi-process soak harness: N-rank elastic training
+                 under a randomized-but-seeded plan, asserting the
+                 recovery invariants (no deadlock, bounded recovery,
+                 post-recovery params bit-identical, ckpt shard loss
+                 recovered via the replica path). CLI: tools/soak.py.
+
+This module (and plan/inject) is stdlib-only at import time so the
+native and ckpt layers can hook it without dragging jax in; detector
+and soak are imported lazily (``from horovod_tpu.chaos import
+detector``) because they reach into the native store.
+"""
+from .plan import (                                            # noqa: F401
+    FAULT_KINDS, FAULT_SITES, ChaosPlan, Fault, PlanError, random_plan,
+)
+from .inject import (                                          # noqa: F401
+    Injector, armed, corrupt_copy, fire, install, install_from_env,
+    step_boundary, uninstall,
+)
+
+
+def process_identity():
+    """(rank, world) of this PROCESS from the launcher env contract —
+    the granularity faults are addressed at and heartbeats are posted
+    at (one controller process per host; identical to the coordinator
+    numbering, runner/gloo_run.py:66-78)."""
+    import os
+
+    def _first(*names, default="0"):
+        for n in names:
+            v = os.environ.get(n)
+            if v not in (None, ""):
+                return int(v)
+        return int(default)
+
+    rank = _first("HOROVOD_PROCESS_ID", "HOROVOD_CROSS_RANK",
+                  "HOROVOD_RANK", default="0")
+    world = _first("HOROVOD_NUM_PROCESSES", "HOROVOD_CROSS_SIZE",
+                   "HOROVOD_SIZE", default="1")
+    return rank, world

@@ -1,0 +1,312 @@
+"""Lease/accrual failure detector over the native KV store.
+
+Every process posts a heartbeat key (``chaos.hb.g<gen>.<rank>``) to the
+coordinator store on its own thread + its own TCP connection — fully
+off the engine dispatch cycle — and sweeps its peers' keys each
+interval. Per peer it tracks the heartbeat AGE (time since the peer's
+sequence number last advanced) and an accrual score ``phi`` (age over
+the observed mean inter-arrival), exposing both:
+
+* ``hvd_peer_heartbeat_age_ms{peer}`` gauges (scraped via /metrics),
+* ``hvd_detector_suspicions_total{peer}`` counters,
+* a ``HEALTH`` timeline instant row + a log line NAMING the suspected
+  rank the moment its age crosses the suspect threshold.
+
+Escalation: with ``escalate="exit"`` (what ``hvd.init`` configures
+under the elastic launcher) a confirmed suspicion exits the process
+with rc 70 after notifying listeners — the elastic driver observes the
+non-zero exit at its next poll and resets the job in O(heartbeat
+interval + driver poll), instead of every survivor blocking out the
+O(minutes) collective timeout first. The engine's stall inspector
+corroborates the other direction: a stalled collective whose detector
+names a dead peer escalates immediately (ops/engine.py _stall_loop).
+
+Why the KV store and not the ring: the store is the one plane that
+stays reachable when an arbitrary PEER dies (star topology through the
+launcher), and heartbeat posts are O(1) per rank per interval — no
+collective call sequence to keep in lockstep, so the detector needs no
+agreement protocol and survives any subset of peer deaths.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger("horovod_tpu")
+
+#: module-global running detector (one per process), see start_detector
+_DETECTOR: Optional["HeartbeatDetector"] = None
+
+#: exit code for escalate="exit" (EX_SOFTWARE — distinguishable from a
+#: crash's -9 and a clean 0 in the driver's logs)
+ESCALATE_EXIT_CODE = 70
+
+
+class HeartbeatDetector:
+    """Post own heartbeat + sweep peers every ``interval_s``; suspect a
+    peer once its heartbeat age exceeds ``suspect_s``."""
+
+    def __init__(self, host: str, port: int, rank: int, world: int, *,
+                 interval_s: float = 1.0, suspect_s: float = 5.0,
+                 gen: str = "1", escalate: Optional[str] = None,
+                 registry=None):
+        if world < 1 or not (0 <= rank < world):
+            raise ValueError(f"bad detector identity rank {rank} / "
+                             f"world {world}")
+        if escalate not in (None, "exit"):
+            raise ValueError(f"unknown escalate mode {escalate!r}")
+        self.host, self.port = host, int(port)
+        self.rank, self.world = int(rank), int(world)
+        self.interval_s = float(interval_s)
+        self.suspect_s = float(suspect_s)
+        self.gen = str(gen)
+        self.escalate_mode = escalate
+        self._kv = None
+        self._seq = 0
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._lock = threading.Lock()
+        self._listeners: List[Callable[[dict], None]] = []
+        now = time.monotonic()
+        self._last_seen: Dict[int, float] = {
+            p: now for p in range(self.world) if p != self.rank}
+        self._last_seq: Dict[int, int] = {}
+        self._arrivals: Dict[int, deque] = {
+            p: deque(maxlen=16) for p in self._last_seen}
+        self._suspected: Dict[int, float] = {}   # peer -> age_s at flag
+        self._escalated = False
+        # -- metrics (ownership claim: a fresh detector counts from 0)
+        if registry is None:
+            from ..obs import metrics as obs_metrics
+            registry = obs_metrics.get_registry()
+        for fam in ("hvd_peer_heartbeat_age_ms",
+                    "hvd_detector_suspicions_total"):
+            registry.unregister(fam)
+        self._m_age = {
+            p: registry.gauge(
+                "hvd_peer_heartbeat_age_ms",
+                "ms since this peer's heartbeat sequence last advanced",
+                {"peer": str(p)}) for p in self._last_seen}
+        self._m_susp = {
+            p: registry.counter(
+                "hvd_detector_suspicions_total",
+                "times this peer's heartbeat age crossed the suspect "
+                "threshold", {"peer": str(p)}) for p in self._last_seen}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "HeartbeatDetector":
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-heartbeat-detector")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s + 1)
+            self._thread = None
+        if self._kv is not None:
+            try:
+                self._kv.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._kv = None
+
+    def add_listener(self, fn: Callable[[dict], None]) -> None:
+        """``fn(event)`` on every suspicion/recovery transition; events
+        carry ``{"peer", "event": "suspect"|"recovered", "age_s",
+        "phi", "t"}``. Called before an escalation exit."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    # -- queries -----------------------------------------------------------
+    def suspects(self) -> Dict[int, float]:
+        """{peer: heartbeat age seconds} for currently suspected peers
+        (age re-read live, not the age at flag time)."""
+        now = time.monotonic()
+        with self._lock:
+            return {p: now - self._last_seen[p]
+                    for p in self._suspected}
+
+    def phi(self, peer: int) -> float:
+        """Accrual score: heartbeat age over the observed mean
+        inter-arrival (>= 1 means 'late'; grows without bound on a dead
+        peer)."""
+        now = time.monotonic()
+        with self._lock:
+            age = now - self._last_seen[peer]
+            arr = self._arrivals.get(peer)
+            mean = (sum(arr) / len(arr)) if arr else self.interval_s
+        return age / max(mean, 1e-6, self.interval_s / 10.0)
+
+    # -- internals ---------------------------------------------------------
+    def _key(self, rank: int) -> str:
+        return f"chaos.hb.g{self.gen}.{rank}"
+
+    def _connect(self):
+        from ..native.store import StoreClient
+        if self._kv is None:
+            # chaos_exempt: the detector is the OBSERVER — its probe
+            # traffic must neither be faulted by store.request plans
+            # nor perturb their deterministic site counters
+            self._kv = StoreClient(self.host, self.port, rank=self.rank,
+                                   chaos_exempt=True)
+        return self._kv
+
+    def _loop(self) -> None:
+        from ..native.store import NativeError, NativeTimeout
+        while self._running:
+            try:
+                kv = self._connect()
+                self._seq += 1
+                kv.set(self._key(self.rank),
+                       json.dumps({"seq": self._seq,
+                                   "t": time.time()}).encode())
+                for peer in list(self._last_seen):
+                    if not self._running:
+                        return
+                    try:
+                        raw = kv.get(self._key(peer),
+                                     timeout=min(self.interval_s / 4.0,
+                                                 0.25),
+                                     max_bytes=4096)
+                        seq = int(json.loads(raw.decode()).get("seq", 0))
+                    except (NativeTimeout, ValueError):
+                        seq = None   # not posted yet / unreadable: age grows
+                    self._observe(peer, seq)
+            except NativeError as e:
+                # store unreachable (launcher restarting / tearing
+                # down): drop the connection and retry next interval
+                logger.debug("heartbeat store unavailable: %s", e)
+                if self._kv is not None:
+                    try:
+                        self._kv.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._kv = None
+            except Exception as e:  # noqa: BLE001 — detector must not die
+                logger.debug("heartbeat loop error: %s", e)
+            self._wake.wait(self.interval_s)
+
+    def _observe(self, peer: int, seq: Optional[int]) -> None:
+        now = time.monotonic()
+        recovered = suspected = False
+        with self._lock:
+            if seq is not None and seq != self._last_seq.get(peer):
+                if peer in self._last_seq:
+                    self._arrivals[peer].append(now - self._last_seen[peer])
+                self._last_seq[peer] = seq
+                self._last_seen[peer] = now
+                if peer in self._suspected:
+                    del self._suspected[peer]
+                    recovered = True
+            age = now - self._last_seen[peer]
+            # Only a peer that HAS heartbeated can be suspected: ages
+            # start at detector construction, and startup skew across
+            # hosts (jax import, device init) routinely exceeds
+            # suspect_s — suspecting a never-seen peer would let the
+            # fastest rank escalate against a healthy slow one and loop
+            # the job through resets. A worker that never comes up at
+            # all is the DRIVER's case (spawn failure / elastic
+            # timeout), not this detector's.
+            if age > self.suspect_s and peer in self._last_seq \
+                    and peer not in self._suspected:
+                self._suspected[peer] = age
+                suspected = True
+        self._m_age[peer].set(age * 1000.0)
+        if recovered:
+            logger.info("HEALTH: rank %d heartbeat recovered (was "
+                        "suspected)", peer)
+            self._emit(peer, "recovered", age)
+        if suspected:
+            self._m_susp[peer].inc()
+            logger.error(
+                "HEALTH: rank %d SUSPECTED DEAD by rank %d — heartbeat "
+                "age %.2fs > suspect %.2fs (phi %.1f)", peer, self.rank,
+                age, self.suspect_s, self.phi(peer))
+            self._emit(peer, "suspect", age)
+            self._maybe_escalate(
+                f"peer rank {peer} heartbeat age {age:.2f}s")
+
+    def _emit(self, peer: int, event: str, age: float) -> None:
+        ev = {"peer": peer, "event": event, "age_s": round(age, 3),
+              "phi": round(self.phi(peer), 2), "rank": self.rank,
+              "t": time.time()}
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001
+                pass
+        from .inject import _live_timeline
+        tl = _live_timeline()
+        if tl is not None:
+            try:
+                tl.instant("HEALTH", {k: v for k, v in ev.items()
+                                      if k != "t"})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _maybe_escalate(self, reason: str) -> None:
+        if self.escalate_mode != "exit" or self._escalated:
+            return
+        self._escalated = True
+        logger.error(
+            "HEALTH: escalating to the elastic driver (%s) — exiting "
+            "with rc %d so the reset starts in O(heartbeat) instead of "
+            "O(collective timeout)", reason, ESCALATE_EXIT_CODE)
+        os._exit(ESCALATE_EXIT_CODE)
+
+    def escalate(self, reason: str) -> None:
+        """External corroboration hook (the engine's stall inspector):
+        escalate NOW if any peer is currently suspected."""
+        if self.suspects():
+            self._maybe_escalate(reason)
+
+
+# -- module-level plumbing ---------------------------------------------------
+
+def start_detector(host: str, port: int, rank: int, world: int,
+                   **kwargs) -> HeartbeatDetector:
+    """Start (replacing any previous) process-global detector."""
+    global _DETECTOR
+    if _DETECTOR is not None:
+        _DETECTOR.stop()
+    _DETECTOR = HeartbeatDetector(host, port, rank, world,
+                                  **kwargs).start()
+    return _DETECTOR
+
+
+def stop_detector() -> None:
+    global _DETECTOR
+    if _DETECTOR is not None:
+        _DETECTOR.stop()
+        _DETECTOR = None
+
+
+def get_detector() -> Optional[HeartbeatDetector]:
+    return _DETECTOR
+
+
+def current_suspects() -> Dict[int, float]:
+    """{peer: heartbeat age s} of the running detector, {} when none —
+    safe from any thread (the engine's stall inspector calls this)."""
+    d = _DETECTOR
+    return d.suspects() if d is not None else {}
+
+
+def escalate(reason: str) -> None:
+    d = _DETECTOR
+    if d is not None:
+        d.escalate(reason)

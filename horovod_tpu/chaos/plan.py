@@ -1,0 +1,285 @@
+"""Declarative, seeded fault plans.
+
+A plan is JSON — inline in ``HOROVOD_CHAOS_PLAN`` or a file path — of
+the shape::
+
+    {"seed": 1234,
+     "faults": [
+       {"rank": 1, "site": "step",          "at": 5, "kind": "crash"},
+       {"rank": 3, "site": "step",          "kind": "slow_rank",
+        "seconds": 0.05, "after": 2, "until": 6},
+       {"rank": 2, "site": "store.request", "at": 7, "kind": "delay",
+        "seconds": 0.2},
+       {"rank": 0, "site": "p2p.send",      "at": 3, "kind": "drop"},
+       {"rank": 0, "site": "p2p.send",      "at": 2, "kind": "corrupt"},
+       {"rank": 0, "site": "p2p.send",      "at": 1, "kind": "partition",
+        "peer": 1, "seconds": 3.0},
+       {"rank": 0, "site": "ckpt.write",    "at": 0, "kind": "torn_write"},
+       {"rank": 0, "site": "ckpt.commit",   "at": 1, "kind": "delete_chunk",
+        "shard": 2, "epoch": 0}]}
+
+Addressing: every fault names the (process) ``rank`` it fires on, the
+``site`` it lands at, and WHEN — ``at`` matches exactly the N-th
+invocation of that site on that rank (for ``site: "step"`` N is the
+training step the application reports via ``chaos.step_boundary``), or
+an ``after``/``until`` window, or always when neither is given.
+``epoch`` (optional) pins a fault to one elastic incarnation
+(HOROVOD_CKPT_RESET_EPOCH — the driver increments it per reset), so a
+crash scheduled in epoch 0 does not re-fire after the relaunch.
+
+Sites are the REAL wire/disk boundaries the injection shims wrap
+(inject.py); kinds are validated against the sites they make sense at.
+Parsing is fail-fast: unknown keys, kinds, sites, or missing kind
+parameters raise :class:`PlanError` at startup, never mid-run.
+
+Determinism: a plan is a pure value; :func:`random_plan` derives one
+from a seed via ``random.Random(seed)`` only — same seed, same world,
+same steps => byte-identical plan.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+FAULT_KINDS = ("delay", "drop", "crash", "corrupt", "partition",
+               "slow_rank", "torn_write", "delete_chunk")
+
+FAULT_SITES = ("step", "store.request", "p2p.send", "p2p.recv",
+               "ckpt.write", "ckpt.read", "ckpt.commit")
+
+#: which kinds are meaningful at which sites (a drop needs a connection
+#: to sever; a torn write needs a shard file; ...)
+_KIND_SITES = {
+    "delay": FAULT_SITES,
+    "slow_rank": ("step",),
+    "crash": FAULT_SITES,
+    "drop": ("store.request", "p2p.send", "p2p.recv"),
+    "corrupt": ("store.request", "p2p.send"),
+    "partition": ("store.request", "p2p.send", "p2p.recv"),
+    "torn_write": ("ckpt.write",),
+    "delete_chunk": ("ckpt.commit",),
+}
+
+#: kinds that require a positive "seconds" duration
+_NEEDS_SECONDS = ("delay", "slow_rank", "partition")
+
+_FIELDS = {"rank", "site", "kind", "at", "after", "until", "seconds",
+           "peer", "shard", "epoch"}
+
+
+class PlanError(ValueError):
+    """Malformed chaos plan — raised at parse time, fail-fast."""
+
+
+@dataclass
+class Fault:
+    """One scheduled fault. See the module docstring for semantics."""
+
+    rank: int
+    site: str
+    kind: str
+    at: Optional[int] = None
+    after: Optional[int] = None
+    until: Optional[int] = None
+    seconds: Optional[float] = None
+    peer: Optional[int] = None
+    shard: Optional[int] = None
+    epoch: Optional[int] = None
+
+    def validate(self) -> "Fault":
+        if not isinstance(self.rank, int) or self.rank < 0:
+            raise PlanError(f"fault rank must be a non-negative int; "
+                            f"got {self.rank!r}")
+        if self.site not in FAULT_SITES:
+            raise PlanError(f"unknown fault site {self.site!r} "
+                            f"(one of {FAULT_SITES})")
+        if self.kind not in FAULT_KINDS:
+            raise PlanError(f"unknown fault kind {self.kind!r} "
+                            f"(one of {FAULT_KINDS})")
+        if self.site not in _KIND_SITES[self.kind]:
+            raise PlanError(
+                f"fault kind {self.kind!r} cannot land at site "
+                f"{self.site!r} (valid sites: {_KIND_SITES[self.kind]})")
+        if self.at is not None and (self.after is not None
+                                    or self.until is not None):
+            raise PlanError(
+                "a fault schedules either an exact 'at' or an "
+                "'after'/'until' window, not both")
+        for name in ("at", "after", "until", "peer", "shard", "epoch"):
+            v = getattr(self, name)
+            if v is not None and (not isinstance(v, int) or v < 0):
+                raise PlanError(
+                    f"fault field {name!r} must be a non-negative int; "
+                    f"got {v!r}")
+        if self.after is not None and self.until is not None \
+                and self.until < self.after:
+            raise PlanError(
+                f"fault window empty: until={self.until} < "
+                f"after={self.after}")
+        if self.kind in _NEEDS_SECONDS:
+            s = self.seconds
+            if not isinstance(s, (int, float)) or not (0 < s <= 3600):
+                raise PlanError(
+                    f"fault kind {self.kind!r} needs 'seconds' in "
+                    f"(0, 3600]; got {s!r}")
+        if self.kind == "delete_chunk" and self.shard is None:
+            raise PlanError(
+                "fault kind 'delete_chunk' needs 'shard' (the rank "
+                "whose committed shard file to delete)")
+        return self
+
+    def matches(self, n: int, epoch: int) -> bool:
+        """Does this fault fire at the site's n-th invocation (or step
+        n) in elastic incarnation ``epoch``?"""
+        if self.epoch is not None and self.epoch != epoch:
+            return False
+        if self.at is not None:
+            return n == self.at
+        if self.after is not None and n < self.after:
+            return False
+        if self.until is not None and n > self.until:
+            return False
+        return True
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+@dataclass
+class ChaosPlan:
+    """A validated set of faults plus the seed that derives any
+    injection-time randomness (corrupt bit positions)."""
+
+    seed: int = 0
+    faults: List[Fault] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(obj: dict) -> "ChaosPlan":
+        if not isinstance(obj, dict):
+            raise PlanError(f"chaos plan must be a JSON object; "
+                            f"got {type(obj).__name__}")
+        unknown = set(obj) - {"seed", "faults"}
+        if unknown:
+            raise PlanError(f"unknown chaos plan keys {sorted(unknown)} "
+                            f"(expected 'seed', 'faults')")
+        seed = obj.get("seed", 0)
+        if not isinstance(seed, int):
+            raise PlanError(f"chaos plan seed must be an int; got {seed!r}")
+        raw = obj.get("faults", [])
+        if not isinstance(raw, list):
+            raise PlanError("chaos plan 'faults' must be a list")
+        faults = []
+        for i, f in enumerate(raw):
+            if not isinstance(f, dict):
+                raise PlanError(f"fault #{i} must be an object; got {f!r}")
+            bad = set(f) - _FIELDS
+            if bad:
+                raise PlanError(
+                    f"fault #{i} has unknown fields {sorted(bad)} "
+                    f"(expected a subset of {sorted(_FIELDS)})")
+            missing = {"rank", "site", "kind"} - set(f)
+            if missing:
+                raise PlanError(
+                    f"fault #{i} missing required fields "
+                    f"{sorted(missing)}")
+            try:
+                faults.append(Fault(**f).validate())
+            except PlanError as e:
+                raise PlanError(f"fault #{i}: {e}") from None
+        return ChaosPlan(seed=seed, faults=faults)
+
+    @staticmethod
+    def from_json(text: str) -> "ChaosPlan":
+        try:
+            obj = json.loads(text)
+        except ValueError as e:
+            raise PlanError(f"chaos plan is not valid JSON: {e}") from None
+        return ChaosPlan.from_dict(obj)
+
+    @staticmethod
+    def parse(spec: str) -> "ChaosPlan":
+        """HOROVOD_CHAOS_PLAN semantics: inline JSON when the value
+        starts with '{', otherwise a path to a JSON file."""
+        spec = spec.strip()
+        if spec.startswith("{"):
+            return ChaosPlan.from_json(spec)
+        try:
+            with open(spec) as f:
+                text = f.read()
+        except OSError as e:
+            raise PlanError(
+                f"HOROVOD_CHAOS_PLAN names a file that cannot be read "
+                f"({spec!r}): {e}") from None
+        return ChaosPlan.from_json(text)
+
+    @staticmethod
+    def from_env() -> Optional["ChaosPlan"]:
+        spec = os.environ.get("HOROVOD_CHAOS_PLAN")
+        if not spec:
+            return None
+        return ChaosPlan.parse(spec)
+
+    def for_rank(self, rank: int) -> List[Fault]:
+        return [f for f in self.faults if f.rank == rank]
+
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "faults": [f.to_dict() for f in self.faults]},
+                          sort_keys=True)
+
+
+def random_plan(seed: int, world: int, steps: int, *,
+                commit_every: int = 2, crash: bool = True,
+                shard_delete: bool = True, noise: int = 2) -> ChaosPlan:
+    """A randomized-but-SEEDED soak plan: same (seed, world, steps) =>
+    byte-identical schedule.
+
+    Composes the acceptance scenario — one worker SIGKILLed mid-step in
+    epoch 0, one committed ckpt shard deleted right after the last
+    commit preceding the crash (so the relaunched job must restore that
+    commit through the buddy-replica path) — plus ``noise`` benign
+    delay/slow faults sprinkled across ranks and sites.
+    """
+    if world < 2:
+        raise PlanError(f"random_plan needs world >= 2; got {world}")
+    if steps < 2 * commit_every + 2:
+        raise PlanError(
+            f"random_plan needs steps >= {2 * commit_every + 2} so a "
+            f"commit precedes the crash; got {steps}")
+    rng = random.Random(seed)
+    faults: List[Fault] = []
+    crash_step = None
+    if crash:
+        victim = rng.randrange(1, world)
+        # crash strictly after the first commit and before the last step
+        crash_step = rng.randrange(commit_every + 1, steps - 1)
+        faults.append(Fault(rank=victim, site="step", at=crash_step,
+                            kind="crash", epoch=0))
+    if shard_delete:
+        # the commit the relaunch will restore from: the last one
+        # before the crash (or the first commit in a crash-free plan)
+        n_commits = (crash_step // commit_every) if crash_step is not None \
+            else 1
+        faults.append(Fault(rank=0, site="ckpt.commit",
+                            at=max(n_commits - 1, 0), kind="delete_chunk",
+                            shard=rng.randrange(world), epoch=0))
+    for _ in range(noise):
+        kind = rng.choice(("delay", "slow_rank"))
+        if kind == "slow_rank":
+            a = rng.randrange(0, max(steps - 2, 1))
+            faults.append(Fault(
+                rank=rng.randrange(world), site="step", kind="slow_rank",
+                seconds=round(rng.uniform(0.01, 0.05), 3),
+                after=a, until=a + rng.randrange(1, 3)))
+        else:
+            faults.append(Fault(
+                rank=rng.randrange(world),
+                site=rng.choice(("store.request", "p2p.send")),
+                kind="delay", at=rng.randrange(0, 20),
+                seconds=round(rng.uniform(0.01, 0.1), 3)))
+    for f in faults:
+        f.validate()
+    return ChaosPlan(seed=seed, faults=faults)

@@ -1,0 +1,409 @@
+"""Multi-process chaos soak harness: prove recovery, don't claim it.
+
+``run_soak`` drives a REAL ``hvdrun`` elastic job (N localhost workers,
+1 CPU device each) through a seeded fault plan with buddy-replica
+checkpointing, auto-restore and the heartbeat failure detector armed,
+then parses the per-rank event logs and asserts the recovery
+invariants:
+
+* **no deadlock** — the launcher finishes within the harness timeout
+  and exits 0;
+* **detection** — every SURVIVOR's failure detector names the
+  SIGKILLed rank within ``2 x HOROVOD_HEARTBEAT_SUSPECT_S`` of the
+  crash;
+* **bounded recovery** — the relaunched incarnation reaches its first
+  training step within ``recovery_bound_s`` of the crash;
+* **replica restore** — the plan deleted a committed shard file, so the
+  auto-restore MUST have come back through the buddy replica: the
+  resumed params hash equals the hash logged when that commit was
+  written;
+* **bit-identical params** — every rank finishes all steps with the
+  same final params hash.
+
+The verdict is a JSON-able dict (``tools/soak.py`` prints it and exits
+non-zero unless every invariant holds). Worker mode (``python -m
+horovod_tpu.chaos.soak --worker OUT``) is what the launcher spawns —
+a deterministic training loop over the p2p-ring host plane with
+``FileBackedState(backend="ckpt")`` commits, chaos/detector events
+streamed to ``events.<rank>.jsonl``.
+
+Module-level imports are stdlib-only; jax/horovod load inside the
+worker so the harness side stays importable anywhere (CI drivers,
+tools/soak.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+DEFAULT_STEPS = 10
+DEFAULT_COMMIT_EVERY = 2
+DEFAULT_HEARTBEAT_INTERVAL_S = 0.25
+DEFAULT_HEARTBEAT_SUSPECT_S = 1.5
+DEFAULT_RECOVERY_BOUND_S = 90.0
+
+
+# --------------------------------------------------------------------------
+# harness side
+# --------------------------------------------------------------------------
+
+def _resolve_plan(plan, seed: int, np_: int, steps: int,
+                  commit_every: int):
+    from .plan import ChaosPlan, random_plan
+    if plan is None or plan == "random":
+        return random_plan(seed, np_, steps, commit_every=commit_every)
+    if isinstance(plan, ChaosPlan):
+        return plan
+    return ChaosPlan.parse(str(plan))
+
+
+def _read_events(out_dir: str) -> List[dict]:
+    events = []
+    for name in sorted(os.listdir(out_dir)):
+        if not (name.startswith("events.") and name.endswith(".jsonl")):
+            continue
+        with open(os.path.join(out_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        pass      # torn final line of a SIGKILLed rank
+    return sorted(events, key=lambda e: e.get("t", 0.0))
+
+
+def run_soak(out_dir: str, *, np_: int = 4, seed: int = 0,
+             steps: int = DEFAULT_STEPS,
+             commit_every: int = DEFAULT_COMMIT_EVERY,
+             plan=None,
+             heartbeat_interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S,
+             heartbeat_suspect_s: float = DEFAULT_HEARTBEAT_SUSPECT_S,
+             recovery_bound_s: float = DEFAULT_RECOVERY_BOUND_S,
+             timeout_s: float = 360.0, cpu: bool = True) -> dict:
+    """Run the soak and return the verdict dict (``ok`` plus one entry
+    per invariant). Never raises on a failed invariant — the verdict
+    carries the evidence; it raises only on harness misuse."""
+    os.makedirs(out_dir, exist_ok=True)
+    resolved = _resolve_plan(plan, seed, np_, steps, commit_every)
+    hostfile = os.path.join(out_dir, "hosts.txt")
+    with open(hostfile, "w") as f:
+        f.write(f"localhost:{np_}\n")
+    disc = os.path.join(out_dir, "discover.sh")
+    with open(disc, "w") as f:
+        f.write(f"#!/bin/sh\ncat {hostfile}\n")
+    os.chmod(disc, 0o755)
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({
+        "HOROVOD_CHAOS_PLAN": resolved.to_json(),
+        "HOROVOD_HEARTBEAT_INTERVAL_S": str(heartbeat_interval_s),
+        "HOROVOD_HEARTBEAT_SUSPECT_S": str(heartbeat_suspect_s),
+        "HOROVOD_CKPT_AUTO_RESTORE": "1",
+        "HOROVOD_CKPT_REPLICATE": "1",
+        "HOROVOD_GLOO_TIMEOUT_SECONDS": "120",
+        # a generous driver poll so survivors get their full detection
+        # window (name the dead rank, log, escalate) before teardown
+        "HOROVOD_ELASTIC_POLL_INTERVAL_S": "3.0",
+        "HVD_SOAK_STEPS": str(steps),
+        "HVD_SOAK_COMMIT_EVERY": str(commit_every),
+    })
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "-np", str(np_), "--min-np", str(np_), "--max-np", str(np_),
+           "--host-discovery-script", disc,
+           "--blacklist-cooldown-range", "1", "2",
+           sys.executable, "-m", "horovod_tpu.chaos.soak",
+           "--worker", out_dir]
+    t0 = time.time()
+    driver_log = os.path.join(out_dir, "driver.log")
+    with open(driver_log, "w") as dl:
+        try:
+            rc = subprocess.call(cmd, env=env, stdout=dl,
+                                 stderr=subprocess.STDOUT,
+                                 cwd=out_dir, timeout=timeout_s)
+            deadlocked = False
+        except subprocess.TimeoutExpired:
+            rc, deadlocked = -1, True
+    wall_s = time.time() - t0
+
+    verdict = evaluate(out_dir, resolved, np_=np_, steps=steps,
+                       heartbeat_suspect_s=heartbeat_suspect_s,
+                       recovery_bound_s=recovery_bound_s)
+    verdict.update({
+        "rc": rc, "wall_s": round(wall_s, 2),
+        "no_deadlock": not deadlocked and rc == 0,
+        "seed": resolved.seed, "np": np_, "steps": steps,
+        "plan": json.loads(resolved.to_json()),
+        "out_dir": out_dir,
+    })
+    # None = invariant not applicable (e.g. a crash-free custom plan
+    # has no detection/recovery leg); only an explicit False fails
+    verdict["ok"] = bool(
+        verdict["no_deadlock"] and verdict["params_bit_identical"]
+        and all(verdict[k] is not False
+                for k in ("detector_named_dead", "recovery_bounded",
+                          "replica_restore")))
+    return verdict
+
+
+def evaluate(out_dir: str, plan, *, np_: int, steps: int,
+             heartbeat_suspect_s: float,
+             recovery_bound_s: float) -> dict:
+    """Pure log->verdict core (unit-testable on synthetic event logs)."""
+    events = _read_events(out_dir)
+    crash = next((f for f in plan.faults if f.kind == "crash"), None)
+    delete = next((f for f in plan.faults
+                   if f.kind == "delete_chunk"), None)
+    v = {"detector_named_dead": None, "detection_s": None,
+         "recovery_bounded": None, "recovery_s": None,
+         "params_bit_identical": False, "replica_restore": None,
+         "final_steps": {}, "victim": None}
+
+    # -- final params: every rank finished all steps, identical hash
+    finals = {}
+    for name in sorted(os.listdir(out_dir)):
+        if name.startswith("final.") and name.endswith(".json"):
+            with open(os.path.join(out_dir, name)) as f:
+                r = json.load(f)
+            finals[int(r["rank"])] = r
+    v["final_steps"] = {r: f["step"] for r, f in finals.items()}
+    hashes = {f["hash"] for f in finals.values()}
+    v["params_bit_identical"] = (
+        len(finals) == np_ and len(hashes) == 1
+        and all(f["step"] == steps for f in finals.values()))
+
+    if crash is None:
+        return v
+    v["victim"] = crash.rank
+    t_crash = next((e["t"] for e in events
+                    if e.get("kind") == "chaos"
+                    and e.get("fault") == "crash"
+                    and e.get("rank") == crash.rank), None)
+    if t_crash is None:
+        # the plan scheduled a crash that never fired: the run did not
+        # exercise what it claims to prove — fail, don't skip
+        v["detector_named_dead"] = False
+        v["recovery_bounded"] = False
+        return v
+
+    # -- detection: every survivor's detector flagged the victim in
+    # time. Evidence is either the detector's own 'health' suspect
+    # event OR the worker's 'named_dead' record — the latter is the
+    # main thread reading current_suspects() (detector output too, and
+    # immune to the exit racing the detector thread's log write).
+    survivors = [r for r in range(np_) if r != crash.rank]
+    detect = {}
+    for r in survivors:
+        t = min((e["t"] for e in events
+                 if e.get("rank") == r and e["t"] >= t_crash
+                 and e.get("peer") == crash.rank
+                 and (e.get("event") == "suspect"
+                      or e.get("kind") == "named_dead")),
+                default=None)
+        if t is not None:
+            detect[r] = t - t_crash
+    v["detection_s"] = {r: round(d, 3) for r, d in detect.items()}
+    v["detector_named_dead"] = (
+        len(detect) == len(survivors)
+        and all(d <= 2 * heartbeat_suspect_s for d in detect.values()))
+
+    # -- recovery: first training step of the relaunched incarnation
+    t_resume = next((e["t"] for e in events
+                     if e.get("kind") == "step"
+                     and e.get("epoch", 0) >= 1), None)
+    if t_resume is not None:
+        v["recovery_s"] = round(t_resume - t_crash, 3)
+        v["recovery_bounded"] = v["recovery_s"] <= recovery_bound_s
+    else:
+        v["recovery_bounded"] = False
+
+    # -- replica restore: the resumed hash matches the commit the
+    # (shard-deleted) checkpoint was written with
+    if delete is not None:
+        resume = next((e for e in events
+                       if e.get("kind") == "resume"
+                       and e.get("epoch", 0) >= 1
+                       and e.get("step", 0) > 0), None)
+        if resume is None:
+            v["replica_restore"] = False
+        else:
+            commit = next((e for e in events
+                           if e.get("kind") == "commit"
+                           and e.get("epoch", 0) == 0
+                           and e.get("step") == resume["step"]), None)
+            v["replica_restore"] = (
+                commit is not None
+                and commit.get("hash") == resume.get("hash"))
+    return v
+
+
+# --------------------------------------------------------------------------
+# worker side (spawned by the elastic launcher)
+# --------------------------------------------------------------------------
+
+def _worker_main(out_dir: str) -> None:
+    # one virtual CPU device per process, set BEFORE jax loads
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1").strip()
+    # Do NOT join jax.distributed: its coordination service hard-aborts
+    # every surviving process the moment one task dies (pjrt
+    # client.h:80 fatal check) — before our detector can even name the
+    # dead rank. The soak's subject is THIS repo's recovery machinery
+    # (native ring/store planes, sharded ckpt, elastic driver,
+    # heartbeat detector); the XLA data plane's own reset path is
+    # covered by test_elastic_integration.py.
+    os.environ.pop("HOROVOD_COORDINATOR_ADDR", None)
+
+    import hashlib
+
+    import numpy as np
+
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    epoch = int(os.environ.get("HOROVOD_CKPT_RESET_EPOCH", "0"))
+    steps = int(os.environ.get("HVD_SOAK_STEPS", str(DEFAULT_STEPS)))
+    commit_every = int(os.environ.get("HVD_SOAK_COMMIT_EVERY",
+                                      str(DEFAULT_COMMIT_EVERY)))
+    ev_path = os.path.join(out_dir, f"events.{rank}.jsonl")
+
+    def log_event(kind: str, **kw) -> None:
+        kw.update({"kind": kind, "rank": rank, "epoch": epoch,
+                   "t": time.time()})
+        with open(ev_path, "a") as f:
+            f.write(json.dumps(kw) + "\n")
+
+    def phash(*arrays) -> str:
+        h = hashlib.sha256()
+        for a in arrays:
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()[:16]
+
+    import signal
+
+    import horovod_tpu as hvd
+    from horovod_tpu.checkpoint import FileBackedState
+    from horovod_tpu.chaos import detector as hb
+    from horovod_tpu.chaos import inject
+    from horovod_tpu.native.p2p import P2PError
+    from horovod_tpu.native.shm import ShmError
+    from horovod_tpu.native.store import NativeError
+    from horovod_tpu.native.store_comm import build_hybrid_comm
+
+    suspect_s = float(os.environ.get("HOROVOD_HEARTBEAT_SUSPECT_S",
+                                     str(DEFAULT_HEARTBEAT_SUSPECT_S)))
+
+    def _await_named_dead():
+        """Block (bounded by the 2x-suspect detection budget) until the
+        failure detector names a dead peer; returns it or None."""
+        deadline = time.monotonic() + 2 * suspect_s + 0.5
+        while time.monotonic() < deadline:
+            suspects = hb.current_suspects()
+            if suspects:
+                return sorted(suspects)[0]
+            time.sleep(0.05)
+        return None
+
+    def _on_sigterm(signum, frame):
+        # The driver tears survivors down as soon as it notices the
+        # crashed worker — which can be BEFORE their detectors crossed
+        # the suspect threshold. Finish the post-mortem first: the
+        # detection bar is 'every survivor names the dead rank', not
+        # 'every survivor that happened to outrace the driver'.
+        log_event("sigterm")
+        log_event("named_dead", peer=_await_named_dead())
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
+    hvd.init()
+    inj = inject.injector()
+    if inj is not None:
+        # the fault's own "kind" field is renamed "fault": the event
+        # log's "kind" names the LOG RECORD type (step/commit/chaos/...)
+        inj.add_listener(lambda ev: log_event(
+            "chaos", fault=ev["kind"],
+            **{k: v for k, v in ev.items()
+               if k not in ("rank", "epoch", "t", "kind")}))
+    det = hb.get_detector()
+    if det is not None:
+        det.add_listener(lambda ev: log_event(
+            "health", **{k: v for k, v in ev.items()
+                         if k not in ("rank", "epoch", "t")}))
+
+    # deterministic model: params identical on every rank; the grad
+    # each rank contributes depends on (step, rank) and flows through
+    # the p2p ring allreduce, so post-step params agree bit-exactly
+    # only if the wire worked
+    init_w = np.zeros((397, 3), np.float32)
+    init_b = np.zeros(6, np.float32)
+    state = FileBackedState(os.path.join(out_dir, "ckpt"),
+                            backend="ckpt", async_save=False,
+                            step=0, w=init_w, b=init_b)
+
+    @hvd.elastic.run
+    def train(state):
+        comm = build_hybrid_comm("soak", force_store=True)
+        log_event("resume", step=int(state.step),
+                  hash=phash(state.w, state.b))
+        try:
+            base = np.arange(397 * 3, dtype=np.float32).reshape(397, 3)
+            while state.step < steps:
+                inject.step_boundary(int(state.step))
+                s = float(int(state.step) + 1)
+                gw = np.sin(base * s).astype(np.float32) * (rank + 1)
+                gb = np.full(6, s * (rank + 1), np.float32)
+                rw = comm.allreduce(gw)
+                rb = comm.allreduce(gb)
+                state.w = state.w - 0.01 * rw
+                state.b = state.b - 0.01 * rb
+                state.step = int(state.step) + 1
+                log_event("step", step=int(state.step),
+                          hash=phash(state.w, state.b))
+                if int(state.step) % commit_every == 0:
+                    state.commit()
+                    log_event("commit", step=int(state.step),
+                              hash=phash(state.w, state.b))
+        finally:
+            comm.close()
+        return phash(state.w, state.b)
+
+    try:
+        final_hash = train(state)
+    except (P2PError, NativeError, ShmError) as e:
+        # a peer died mid-collective. Don't exit on the raw socket
+        # error: wait for the failure detector to NAME the dead rank
+        # (that is its job), then hand the reset to the elastic driver
+        # via a non-zero exit.
+        log_event("comm_error", error=str(e)[:300])
+        log_event("named_dead", peer=_await_named_dead())
+        os._exit(1)
+
+    log_event("done", step=int(state.step), hash=final_hash)
+    with open(os.path.join(out_dir, f"final.{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "step": int(state.step),
+                   "hash": final_hash, "epoch": epoch}, f)
+    hvd.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--worker":
+        _worker_main(argv[1])
+        return 0
+    raise SystemExit(
+        "horovod_tpu.chaos.soak is the worker entry point "
+        "(--worker OUT_DIR); drive a soak with tools/soak.py")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

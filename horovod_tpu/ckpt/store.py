@@ -45,6 +45,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+try:
+    from ..chaos import inject as _chaos
+except ImportError:
+    # standalone load (tools/ckpt_inspect.py spec-loads this file with
+    # no package context): injection is permanently disarmed there
+    import types as _types
+    _chaos = _types.SimpleNamespace(_INJ=None)
+
 FORMAT = "hvdckpt-v1"
 _STEP_RE = re.compile(r"^step_(\d{8})$")
 _META_POLL_S = 0.005
@@ -184,6 +192,10 @@ def write_shard(dir_: str, rank: int, world: int, leaves: List[dict],
     bytes written). Durable before return (fsync)."""
     chunks = my_chunks(leaves, rank, world)
     path = os.path.join(dir_, shard_name(rank))
+    torn = None
+    if _chaos._INJ is not None:
+        f_ = _chaos.fire("ckpt.write")
+        torn = f_ if f_ is not None and f_.kind == "torn_write" else None
     off = 0
     with open(path, "wb") as f:
         for c in chunks:
@@ -197,6 +209,12 @@ def write_shard(dir_: str, rank: int, world: int, leaves: List[dict],
             c["crc32"] = zlib.crc32(raw)
             f.write(raw)
             off += len(raw)
+        if torn is not None and off > 0:
+            # chaos torn_write: the shard loses its tail AFTER the
+            # chunk table recorded full sizes — a crash mid-write at
+            # the real disk boundary; restore must catch it by short
+            # read/CRC and recover via the buddy replica
+            f.truncate(max(off // 2, 1))
         f.flush()
         os.fsync(f.fileno())
     return chunks, off
@@ -217,6 +235,8 @@ def read_chunk(sdir: str, src_rank: int, chunk: dict,
     falling back to the shard's buddy replica when the primary file is
     missing or corrupt. Fail-fast: a chunk that is bad in BOTH places
     raises CkptError naming the chunk."""
+    if _chaos._INJ is not None:
+        _chaos.fire("ckpt.read")            # delay/crash on the read path
     rel = [os.path.join(sdir, shard_name(src_rank)),
            os.path.join(sdir, replica_name(src_rank))]
     reasons = []
@@ -544,6 +564,16 @@ class ShardedCheckpointer:
             shutil.rmtree(old, ignore_errors=True)
         else:
             os.rename(tmp, final)
+        if _chaos._INJ is not None:
+            f_ = _chaos.fire("ckpt.commit")
+            if f_ is not None and f_.kind == "delete_chunk":
+                # chaos delete_chunk: a committed shard file vanishes
+                # (lost disk / fat-fingered cleanup); a later restore
+                # must come back bit-exact through the buddy replica
+                try:
+                    os.remove(os.path.join(final, shard_name(f_.shard)))
+                except OSError:
+                    pass
         self._prune()
         _timeline_instant({"phase": "commit", "step": step,
                            "world": world})

@@ -49,6 +49,7 @@ class _GlobalState:
         self.timeline = None          # timeline.Timeline
         self.parameter_manager = None # autotune.ParameterManager
         self.coordinator = None       # native.store.Coordinator (multi-proc)
+        self.detector = None          # chaos.detector.HeartbeatDetector
         self.metrics_exporter = None  # obs.exporter.Exporter (/metrics)
         self.metrics_emitter = None   # obs.exporter.TimelineEmitter
         self.joined_ranks = set()
@@ -136,6 +137,39 @@ def _maybe_create_coordinator(cfg: Optional[Config] = None):
         return None
 
 
+def _maybe_start_detector(cfg: Config):
+    """Start the heartbeat failure detector (chaos/detector.py) when
+    enabled (HOROVOD_HEARTBEAT_INTERVAL_S > 0) and a native KV store is
+    reachable. Runs on its own thread + connection, fully off the
+    engine cycle. Under the elastic launcher (HOROVOD_ELASTIC) a
+    confirmed suspicion escalates by exiting, so the driver resets in
+    O(heartbeat interval) instead of O(collective timeout)."""
+    if cfg.heartbeat_interval_s <= 0:
+        return None
+    addr = os.environ.get("HOROVOD_NATIVE_KV_ADDR")
+    port = os.environ.get("HOROVOD_NATIVE_KV_PORT")
+    if not addr or not port:
+        logger.debug("heartbeat detector enabled but no native KV store "
+                     "(HOROVOD_NATIVE_KV_ADDR/PORT unset); skipping")
+        return None
+    try:
+        import socket
+        from ..chaos import detector as chaos_detector
+        from ..chaos import process_identity
+        rank_, world = process_identity()
+        if world < 2:
+            return None
+        return chaos_detector.start_detector(
+            socket.gethostbyname(addr), int(port), rank_, world,
+            interval_s=cfg.heartbeat_interval_s,
+            suspect_s=cfg.heartbeat_suspect_s,
+            gen=os.environ.get("HOROVOD_SHM_GEN", "1"),
+            escalate="exit" if cfg.elastic_enabled else None)
+    except Exception as e:  # noqa: BLE001 — detection must not take
+        logger.warning("heartbeat detector unavailable: %s", e)  # init down
+        return None
+
+
 def init(comm: Optional[Sequence[int]] = None,
          process_sets: Optional[Sequence[ProcessSet]] = None) -> None:
     """Initialize the framework (reference: hvd.init, basics.py:51).
@@ -151,6 +185,14 @@ def init(comm: Optional[Sequence[int]] = None,
         _state.config = cfg
         _maybe_init_distributed(cfg)
         _state.coordinator = _maybe_create_coordinator(cfg)
+        # chaos plane: arm the fault injector (HOROVOD_CHAOS_PLAN) and
+        # start the heartbeat failure detector. Arming is idempotent
+        # across in-process resets so site counters / once-fired faults
+        # are never replayed.
+        if cfg.chaos_plan:
+            from ..chaos import inject as chaos_inject
+            chaos_inject.install_from_env()
+        _state.detector = _maybe_start_detector(cfg)
 
         devices = global_devices()
         if comm is not None and not hasattr(comm, "Get_rank"):
@@ -239,6 +281,10 @@ def shutdown() -> None:
     if _state.timeline is not None:
         _state.timeline.stop()
         _state.timeline = None
+    if _state.detector is not None:
+        from ..chaos import detector as chaos_detector
+        chaos_detector.stop_detector()
+        _state.detector = None
     if _state.coordinator is not None:
         _state.coordinator.close()
         _state.coordinator = None
@@ -443,6 +489,14 @@ def get_coordinator():
     """The native host-level Coordinator, or None in single-process mode."""
     _require_init()
     return _state.coordinator
+
+
+def get_failure_detector():
+    """The running heartbeat failure detector (chaos/detector.py), or
+    None when disabled (HOROVOD_HEARTBEAT_INTERVAL_S=0, the default) or
+    single-process."""
+    _require_init()
+    return _state.detector
 
 
 def get_process_set(process_set: Optional[ProcessSet] = None) -> ProcessSet:

@@ -156,6 +156,17 @@ class Config:
     # on-disk commit on (re)entry — through the reshard plan when the
     # world size changed (HOROVOD_CKPT_AUTO_RESTORE).
     ckpt_auto_restore: bool = False
+    # Chaos plane (horovod_tpu/chaos): declarative seeded fault plan —
+    # inline JSON or a path to a JSON file (HOROVOD_CHAOS_PLAN). None
+    # leaves every injection shim a byte-identical pass-through.
+    chaos_plan: Optional[str] = None
+    # Failure-detector heartbeat period over the native KV store
+    # (HOROVOD_HEARTBEAT_INTERVAL_S; 0 disables the detector). Each
+    # process posts + sweeps off the engine cycle on its own thread.
+    heartbeat_interval_s: float = 0.0
+    # Heartbeat age past which a peer is suspected dead, named in
+    # logs/metrics/timeline and escalated (HOROVOD_HEARTBEAT_SUSPECT_S).
+    heartbeat_suspect_s: float = 5.0
     # Observability (horovod_tpu/obs): port for the stdlib /metrics +
     # /healthz exporter (HOROVOD_METRICS_PORT; 0 disables). In
     # multi-process mode each controller binds port + process_index so
@@ -257,6 +268,15 @@ class Config:
             "HOROVOD_CKPT_REPLICATE", c.ckpt_replicate)
         c.ckpt_auto_restore = _env_bool(
             "HOROVOD_CKPT_AUTO_RESTORE", c.ckpt_auto_restore)
+        # Chaos knobs parse strictly (same contract): a typo'd plan or
+        # heartbeat period must fail at startup — a soak run that
+        # silently injected nothing would "prove" recovery it never
+        # exercised.
+        c.chaos_plan = os.environ.get("HOROVOD_CHAOS_PLAN") or None
+        c.heartbeat_interval_s = _env_float_strict(
+            "HOROVOD_HEARTBEAT_INTERVAL_S", c.heartbeat_interval_s)
+        c.heartbeat_suspect_s = _env_float_strict(
+            "HOROVOD_HEARTBEAT_SUSPECT_S", c.heartbeat_suspect_s)
         # Metrics knobs parse strictly too: a typo'd port must fail at
         # startup, not silently leave the fleet unobservable.
         c.metrics_port = _env_int_strict(
@@ -349,6 +369,31 @@ class Config:
             raise ValueError(
                 f"HOROVOD_CKPT_MAX_TO_KEEP must be an int in "
                 f"[0, 1000000] (0 keeps every checkpoint); got {mk!r}")
+        hi = self.heartbeat_interval_s
+        if not isinstance(hi, (int, float)) or not (0 <= hi <= 3600):
+            raise ValueError(
+                f"HOROVOD_HEARTBEAT_INTERVAL_S must be seconds in "
+                f"[0, 3600] (0 disables the failure detector); got {hi!r}")
+        hs = self.heartbeat_suspect_s
+        if not isinstance(hs, (int, float)) or not (0 < hs <= 86_400):
+            raise ValueError(
+                f"HOROVOD_HEARTBEAT_SUSPECT_S must be seconds in "
+                f"(0, 86400]; got {hs!r}")
+        if hi > 0 and hs <= hi:
+            raise ValueError(
+                f"HOROVOD_HEARTBEAT_SUSPECT_S ({hs!r}) must exceed "
+                f"HOROVOD_HEARTBEAT_INTERVAL_S ({hi!r}) — a suspect "
+                f"threshold at or under one heartbeat period flags "
+                f"every healthy peer")
+        if self.chaos_plan is not None:
+            # full fail-fast parse (schema + kind/site/schedule
+            # validation) — chaos.plan is stdlib-only, no cycle
+            from ..chaos.plan import ChaosPlan, PlanError
+            try:
+                ChaosPlan.parse(self.chaos_plan)
+            except PlanError as e:
+                raise ValueError(f"HOROVOD_CHAOS_PLAN invalid: {e}") \
+                    from None
         bk = self.serve_buckets
         if (not isinstance(bk, (tuple, list)) or not bk
                 or not all(isinstance(b, int) and b > 0 for b in bk)

@@ -57,6 +57,7 @@ class ElasticDriver:
         self._assignments: Dict[str, List[SlotInfo]] = {}
         self._workers: List[exec_lib.WorkerProcess] = []
         self._server: Optional[RendezvousServer] = None
+        self._native_server = None      # native.store.StoreServer
         self._secret = make_secret()
         self._stop = threading.Event()
         self._rc = 0
@@ -65,11 +66,24 @@ class ElasticDriver:
         R = obs_metrics.get_registry()
         for fam in ("hvd_elastic_resets_total",
                     "hvd_elastic_host_events_total",
-                    "hvd_elastic_worker_failures_total"):
+                    "hvd_elastic_worker_failures_total",
+                    "hvd_elastic_recovery_ms",
+                    "hvd_elastic_last_recovery_ms"):
             R.unregister(fam)
         self._m_resets = R.counter(
             "hvd_elastic_resets_total",
             "elastic reset rounds (relaunch + rank reassignment)")
+        # driver-side recovery latency: failure observed -> replacement
+        # workers launched (workers observe their own leg in
+        # elastic/run.py under the same family)
+        self._m_recovery = R.histogram(
+            "hvd_elastic_recovery_ms",
+            "elastic recovery: failure caught -> state re-synced on "
+            "the new plane")
+        self._m_last_recovery = R.gauge(
+            "hvd_elastic_last_recovery_ms",
+            "latency of the most recent elastic recovery")
+        self._reset_t0: Optional[float] = None
         self._m_host_events = {
             k: R.counter("hvd_elastic_host_events_total",
                          "hosts joining/leaving the discovered set",
@@ -111,9 +125,20 @@ class ElasticDriver:
                 slots = self._compute_slots(hosts, slots)
                 self._server.init(slots)
                 self._launch(slots, port)
+                if self._reset_t0 is not None:
+                    # driver-side recovery leg: failure observed ->
+                    # replacement incarnation launched
+                    ms = (time.monotonic() - self._reset_t0) * 1000.0
+                    self._reset_t0 = None
+                    self._m_recovery.observe(ms)
+                    self._m_last_recovery.set(ms)
+                    logger.info("elastic: relaunched %d workers %.0f ms "
+                                "after the failure (reset %d)",
+                                len(self._workers), ms, self.resets)
                 outcome = self._supervise(slots)
                 if outcome == "done":
                     return self._rc
+                self._reset_t0 = time.monotonic()
                 self.resets += 1
                 self._m_resets.inc()
                 if self.reset_limit is not None and \
@@ -123,6 +148,9 @@ class ElasticDriver:
         finally:
             self._terminate_workers()
             self._server.stop()
+            if self._native_server is not None:
+                self._native_server.close()
+                self._native_server = None
         return self._rc
 
     def stop(self) -> None:
@@ -151,10 +179,34 @@ class ElasticDriver:
         from ..native.shm import fresh_shm_gen
         env = dict(self.base_env)
         env["HOROVOD_SHM_GEN"] = fresh_shm_gen()
+        # Native control-plane store, ONE per launch round (the static
+        # launcher's run_static analog): workers connect their
+        # Coordinator / p2p rendezvous / ckpt plane / heartbeat
+        # detector to it. Fresh per round — a dead incarnation's tag
+        # state and heartbeat keys can never leak into the next one.
+        if self._native_server is not None:
+            self._native_server.close()
+            self._native_server = None
+        try:
+            from ..native.store import StoreServer
+            hostnames = {s.hostname for s in slots}
+            kv_addr = "127.0.0.1" if hostnames <= {"localhost"} \
+                else os.uname().nodename
+            self._native_server = StoreServer()
+            env["HOROVOD_NATIVE_KV_ADDR"] = kv_addr
+            env["HOROVOD_NATIVE_KV_PORT"] = str(self._native_server.port)
+        except Exception:  # noqa: BLE001 — toolchain-less host: the
+            self._native_server = None   # Python rendezvous KV only
         # Relaunched workers can tell a post-reset incarnation from the
         # initial launch (epoch 0): the ckpt auto-restore path logs it,
+        # chaos plans pin epoch-addressed faults to one incarnation,
         # and user code can key recovery behavior off it.
         env["HOROVOD_CKPT_RESET_EPOCH"] = str(self.resets)
+        # Workers know they run under the elastic driver (reference
+        # operations.cc:501 HOROVOD_ELASTIC): the failure detector uses
+        # this to escalate suspicions by exiting, which this driver
+        # converts into a reset at the next poll.
+        env["HOROVOD_ELASTIC"] = "1"
         self._workers = exec_lib.launch_slots(
             slots, self.command, coord, kv_port, self._secret, env,
             ssh_port=self.ssh_port,
@@ -164,14 +216,28 @@ class ElasticDriver:
 
     def _supervise(self, slots: List[SlotInfo]) -> str:
         """Watch workers + host set. Returns 'done' or 'reset'."""
+        from ..chaos.detector import ESCALATE_EXIT_CODE
         known = {h.hostname: h.slots for h in self.manager.current_hosts()}
         while True:
             # worker exits (driver.py:304 _handle_worker_exit)
             all_done = True
+            failed = False
             for w in self._workers:
                 rc = w.proc.poll()
                 if rc is None:
                     all_done = False
+                elif rc == ESCALATE_EXIT_CODE:
+                    # the failure detector escalated: this worker is the
+                    # MESSENGER, not the failure — its host is healthy
+                    # and must NOT be blacklisted (the dead peer's own
+                    # exit, observed in this same sweep, is what
+                    # blacklists the failed host)
+                    logger.warning(
+                        "elastic: worker rank %d on %s reported a dead "
+                        "peer (detector escalation, rc=%d); resetting "
+                        "without blacklisting its host",
+                        w.slot.rank, w.slot.hostname, rc)
+                    failed = True
                 elif rc != 0:
                     logger.warning(
                         "elastic: worker rank %d on %s failed (rc=%d); "
@@ -180,8 +246,10 @@ class ElasticDriver:
                     self._m_worker_failures.inc()
                     self._m_host_events["leave"].inc()
                     self.manager.blacklist(w.slot.hostname)
-                    self._terminate_workers()
-                    return "reset"
+                    failed = True
+            if failed:
+                self._terminate_workers()
+                return "reset"
             if all_done:
                 self._rc = 0
                 return "done"
@@ -227,9 +295,16 @@ def run_elastic(args) -> int:
     discovery = HostDiscoveryScript(
         args.host_discovery_script,
         default_slots=getattr(args, "slots", None) or 1)
+    # HOROVOD_ELASTIC_POLL_INTERVAL_S: discovery/worker poll period.
+    # The chaos soak harness raises it so surviving workers get a full
+    # detection window (name the dead rank, log, escalate) before the
+    # driver's reset tears them down.
+    from ..core.config import _env_float
+    poll_interval = _env_float("HOROVOD_ELASTIC_POLL_INTERVAL_S", 1.0)
     driver = ElasticDriver(
         discovery, args.command,
         min_np=args.min_np or 1, max_np=args.max_np,
+        poll_interval=poll_interval,
         reset_limit=getattr(args, "reset_limit", None),
         base_env=base_env,
         ssh_port=getattr(args, "ssh_port", None),

@@ -15,6 +15,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import time
 from typing import Callable, Optional
 
 from ..core import basics
@@ -22,6 +23,18 @@ from ..core.types import HorovodInternalError, HostsUpdatedInterrupt
 from .state import State
 
 logger = logging.getLogger("horovod_tpu")
+
+
+def _recovery_metrics():
+    """(histogram, last-gauge) for elastic recovery latency — the
+    fleet report's last-recovery view (obs/report.py)."""
+    from ..obs import metrics as obs_metrics
+    R = obs_metrics.get_registry()
+    return (R.histogram("hvd_elastic_recovery_ms",
+                        "elastic recovery: failure caught -> state "
+                        "re-synced on the new plane"),
+            R.gauge("hvd_elastic_last_recovery_ms",
+                    "latency of the most recent elastic recovery"))
 
 
 def run(func: Callable) -> Callable:
@@ -32,6 +45,7 @@ def run(func: Callable) -> Callable:
         reset_limit = kwargs.pop("reset_limit", None)
         resets = 0
         restored_from_disk = False
+        recovery_t0 = None          # set when a failure is caught
         notification_manager.init()
         while True:
             try:
@@ -59,14 +73,26 @@ def run(func: Callable) -> Callable:
                     # through to training from initial state
                     restored_from_disk = True
                 state.sync()
+                if recovery_t0 is not None:
+                    # recovered: the state is consistent on the new
+                    # plane again — observe failure -> resync latency
+                    ms = (time.perf_counter() - recovery_t0) * 1000.0
+                    recovery_t0 = None
+                    hist, last = _recovery_metrics()
+                    hist.observe(ms)
+                    last.set(ms)
+                    logger.info("elastic: recovered in %.0f ms "
+                                "(reset %d)", ms, resets)
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
                 logger.warning("elastic: internal error, restoring: %s", e)
+                recovery_t0 = time.perf_counter()
                 _reinitialize()
                 state.restore()
                 state.on_reset()
             except HostsUpdatedInterrupt as e:
                 logger.info("elastic: hosts updated, re-initializing")
+                recovery_t0 = time.perf_counter()
                 _reinitialize()
                 if not e.skip_sync:
                     state.commit()

@@ -24,6 +24,7 @@ import time as _time
 
 import numpy as np
 
+from ..chaos import inject as _chaos
 from .store import NativeTimeout, StoreClient
 
 _CHUNK = 1 << 20          # recv_into slice; sendall handles its own loop
@@ -65,6 +66,10 @@ class RingComm:
                  epoch: int = 0):
         self.rank, self.size = rank, size
         self.timeout = timeout
+        # ring neighbors, named in every error message so a chaos-run
+        # log attributes a dead link to a rank, not just "peer"
+        self._succ = (rank + 1) % size
+        self._pred = (rank - 1) % size
         if size == 1:
             self._send = self._recv = None
             return
@@ -74,7 +79,7 @@ class RingComm:
         srv.listen(2)
         srv.settimeout(timeout)
         ip = _outbound_ip(kv_host, kv_port)
-        kv = StoreClient(socket.gethostbyname(kv_host), kv_port)
+        kv = StoreClient(socket.gethostbyname(kv_host), kv_port, rank=rank)
         try:
             # `epoch` distinguishes re-builds of the same ring (same
             # prefix) so a stale address from a previous round is never
@@ -96,7 +101,9 @@ class RingComm:
                 except NativeTimeout:
                     # module contract: a dead/absent peer surfaces as
                     # P2PError, the failure type elastic classifies on
-                    raise P2PError("ring successor never registered")
+                    raise P2PError(f"ring successor rank {self._succ} "
+                                   f"never registered (timeout "
+                                   f"{timeout:g}s)")
                 host, port, peer_epoch = nxt.decode().rsplit(":", 2)
                 if int(peer_epoch) == epoch:
                     break
@@ -134,7 +141,8 @@ class RingComm:
             self._send.sendall(struct.pack("!ii", rank, epoch))
             t.join(timeout)
             if "conn" not in accepted:
-                raise P2PError("ring predecessor never connected")
+                raise P2PError(f"ring predecessor rank {self._pred} "
+                               f"never connected (timeout {timeout:g}s)")
             if accepted["peer"] != (rank - 1) % size:
                 raise P2PError(
                     f"ring mis-wire: expected predecessor "
@@ -154,13 +162,48 @@ class RingComm:
     #: message fits the kernel send buffer), so skip the helper thread
     _INLINE_BYTES = 1 << 15
 
+    def _chaos_wire(self, send_view):
+        """Injection shim at the ring's single wire choke point (sites
+        ``p2p.send`` / ``p2p.recv``). Only reached when armed. A drop
+        REALLY closes the socket — the peer observes a genuine EOF on
+        its end of the wire, exactly what a dead host produces."""
+        f = _chaos.fire("p2p.send", peer=self._succ)
+        if f is not None:
+            if f.kind == "drop":
+                self._send.close()
+                raise P2PError(
+                    f"chaos: injected connection drop to successor "
+                    f"rank {self._succ}")
+            if f.kind == "partition":
+                raise P2PError(
+                    f"chaos: partitioned from successor rank "
+                    f"{self._succ}")
+            if f.kind == "corrupt":
+                send_view = memoryview(
+                    _chaos.corrupt_copy(memoryview(send_view).cast("B")))
+        f = _chaos.fire("p2p.recv", peer=self._pred)
+        if f is not None:
+            if f.kind == "drop":
+                self._recv.close()
+                raise P2PError(
+                    f"chaos: injected connection drop from predecessor "
+                    f"rank {self._pred}")
+            if f.kind == "partition":
+                raise P2PError(
+                    f"chaos: partitioned from predecessor rank "
+                    f"{self._pred}")
+        return send_view
+
     def _xfer(self, send_view, recv_view) -> None:
         """Full-duplex step: send to successor while receiving from the
         predecessor (sequential send-then-recv deadlocks once messages
         exceed the socket buffers)."""
+        if _chaos._INJ is not None:
+            send_view = self._chaos_wire(send_view)
         if memoryview(send_view).nbytes <= self._INLINE_BYTES:
             self._send.sendall(send_view)
-            _recv_into(self._recv, recv_view)
+            _recv_into(self._recv, recv_view,
+                       who=f"predecessor rank {self._pred}")
             return
         err = []
 
@@ -173,16 +216,20 @@ class RingComm:
         t = threading.Thread(target=tx, daemon=True)
         t.start()
         try:
-            _recv_into(self._recv, recv_view)
+            _recv_into(self._recv, recv_view,
+                       who=f"predecessor rank {self._pred}")
         finally:
             t.join(self.timeout)
         if t.is_alive():
             # a still-running sendall would interleave bytes with the
             # next step's send on the same socket — the stream has no
             # tags to detect that, so fail loud instead
-            raise P2PError("ring send timed out (peer died?)")
+            raise P2PError(f"ring send to successor rank {self._succ} "
+                           f"timed out after {self.timeout:g}s "
+                           f"(peer died?)")
         if err:
-            raise P2PError(f"ring send failed: {err[0]}")
+            raise P2PError(f"ring send to successor rank {self._succ} "
+                           f"failed: {err[0]}")
 
     # -- collectives -------------------------------------------------------
 
@@ -247,7 +294,8 @@ class RingComm:
         if r == root:
             self._send.sendall(memoryview(flat))
         else:
-            _recv_into(self._recv, flat)
+            _recv_into(self._recv, flat,
+                       who=f"predecessor rank {self._pred}")
             if (r + 1) % P != root:
                 self._send.sendall(memoryview(flat))
         return out
@@ -352,12 +400,13 @@ class RingComm:
         if self.size == 1:
             return
         token = np.zeros(1, np.uint8)
+        who = f"predecessor rank {self._pred}"
         for _ in range(2):
             if self.rank == 0:
                 self._send.sendall(memoryview(token))
-                _recv_into(self._recv, token)
+                _recv_into(self._recv, token, who=who)
             else:
-                _recv_into(self._recv, token)
+                _recv_into(self._recv, token, who=who)
                 self._send.sendall(memoryview(token))
 
     def close(self) -> None:
@@ -376,13 +425,17 @@ def _recv_exact(sock, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_into(sock, view) -> None:
+def _recv_into(sock, view, who: str = None) -> None:
     mv = memoryview(view).cast("B")
+    peer = who or "ring peer"
     while mv.nbytes:
         try:
             k = sock.recv_into(mv, min(mv.nbytes, _CHUNK))
         except socket.timeout as e:
-            raise P2PError("ring receive timed out (peer died?)") from e
+            t = sock.gettimeout()
+            after = f" after {t:g}s" if t else ""
+            raise P2PError(f"ring receive from {peer} timed "
+                           f"out{after} (peer died?)") from e
         if k == 0:
-            raise P2PError("ring peer closed the connection")
+            raise P2PError(f"{peer} closed the ring connection")
         mv = mv[k:]

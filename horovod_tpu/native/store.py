@@ -15,6 +15,7 @@ import threading
 from typing import List, Optional
 
 from . import lib
+from ..chaos import inject as _chaos
 
 _OK, _TIMEOUT, _ERROR, _AGAIN = 0, 1, 2, 3  # mirrors csrc/store.cc Status
 
@@ -27,11 +28,39 @@ class NativeTimeout(NativeError):
     pass
 
 
-def _check(status: int, what: str) -> None:
+def _check(status: int, what: str, *, rank: Optional[int] = None,
+           timeout: Optional[float] = None) -> None:
+    """Raise with an ATTRIBUTABLE message: the op + key/tag (callers
+    bake it into ``what``), the caller's rank when known, and the
+    configured timeout — a chaos-run log line must identify which rank
+    gave up on which key after how long."""
+    if status == _OK:
+        return
+    who = f" (rank {rank})" if rank is not None else ""
     if status == _TIMEOUT:
-        raise NativeTimeout(f"{what} timed out")
-    if status != _OK:
-        raise NativeError(f"{what} failed (status {status})")
+        after = "" if timeout is None or timeout < 0 \
+            else f" after {timeout:g}s"
+        raise NativeTimeout(f"{what} timed out{after}{who}")
+    raise NativeError(f"{what} failed (status {status}){who}")
+
+
+def _chaos_gate(what: str, payload: Optional[bytes] = None,
+                rank: Optional[int] = None) -> Optional[bytes]:
+    """StoreClient request-boundary injection shim (site
+    ``store.request``). Only reached when an injector is armed; returns
+    the (possibly corrupted) payload, or raises NativeError for
+    drop/partition — the same failure type a severed store connection
+    produces, so elastic/callers classify it identically."""
+    f = _chaos.fire("store.request")
+    if f is None:
+        return payload
+    if f.kind == "corrupt" and payload is not None:
+        return _chaos.corrupt_copy(payload)
+    if f.kind in ("drop", "partition"):
+        who = f" (rank {rank})" if rank is not None else ""
+        raise NativeError(
+            f"chaos: injected {f.kind} at store.request for {what}{who}")
+    return payload
 
 
 def _buf(n: int):
@@ -71,23 +100,40 @@ class StoreServer:
 
 
 class StoreClient:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 rank: Optional[int] = None,
+                 chaos_exempt: bool = False):
         self._lib = lib()
         self._h = self._lib.hvd_client_create(host.encode(), port)
         if not self._h:
             raise NativeError(f"could not connect to store {host}:{port}")
+        # optional caller identity, threaded into error messages so
+        # multi-rank logs are attributable
+        self.rank = rank
+        # chaos_exempt: this client's traffic never crosses the
+        # injection shims OR advances their site counters. The failure
+        # detector's heartbeat client sets it — the observer must not
+        # be faulted by store.request plans, and its timing-dependent
+        # background requests would otherwise make 'at:'-addressed
+        # store faults land on a different app operation every run,
+        # breaking the plan's determinism contract.
+        self._chaos_exempt = chaos_exempt
         # serializes request -> possible ST_AGAIN stash -> take_pending:
         # the stash is a single per-client slot, so a concurrent
         # oversized call from another thread would overwrite it
         self._lock = threading.Lock()
 
     def set(self, key: str, value: bytes) -> None:
+        if _chaos._INJ is not None and not self._chaos_exempt:
+            value = _chaos_gate(f"set({key})", value, self.rank)
         _check(self._lib.hvd_client_set(self._h, key.encode(),
                                         _as_u8p(value), len(value)),
-               f"set({key})")
+               f"set({key})", rank=self.rank)
 
     def get(self, key: str, timeout: Optional[float] = None,
             expected_reads: int = 0, max_bytes: int = 1 << 20) -> bytes:
+        if _chaos._INJ is not None and not self._chaos_exempt:
+            _chaos_gate(f"get({key})", None, self.rank)
         out = _buf(max_bytes)
         outlen = ctypes.c_uint32(0)
         t = -1.0 if timeout is None else float(timeout)
@@ -95,9 +141,11 @@ class StoreClient:
             st = self._lib.hvd_client_get(self._h, key.encode(), t,
                                           expected_reads, out, max_bytes,
                                           ctypes.byref(outlen))
-            return self._finish(st, out, outlen, f"get({key})")
+            return self._finish(st, out, outlen, f"get({key})",
+                                timeout=t)
 
-    def _finish(self, st: int, out, outlen, what: str) -> bytes:
+    def _finish(self, st: int, out, outlen, what: str,
+                timeout: Optional[float] = None) -> bytes:
         """Resolve a sized-reply call (self._lock held). _AGAIN = the
         value exceeded the caller buffer AFTER the server consumed the
         read slot; the client stashed it — drain with take_pending,
@@ -107,14 +155,15 @@ class StoreClient:
             out2 = _buf(need)
             outlen2 = ctypes.c_uint32(0)
             _check(self._lib.hvd_client_take_pending(
-                self._h, out2, need, ctypes.byref(outlen2)), what)
+                self._h, out2, need, ctypes.byref(outlen2)), what,
+                rank=self.rank)
             return bytes(out2[:outlen2.value])
-        _check(st, what)
+        _check(st, what, rank=self.rank, timeout=timeout)
         return bytes(out[:outlen.value])
 
     def delete(self, key: str) -> None:
         _check(self._lib.hvd_client_del(self._h, key.encode()),
-               f"delete({key})")
+               f"delete({key})", rank=self.rank)
 
     def gather(self, key: str, size: int, rank: int, blob: bytes,
                timeout: Optional[float] = None,
@@ -122,6 +171,8 @@ class StoreClient:
         """Join-and-collect (OP_GATHER): post `blob`, block until all
         `size` members posted under `key`, return the rank-ordered blob
         list. One round trip; idempotent re-post on retry."""
+        if _chaos._INJ is not None and not self._chaos_exempt:
+            blob = _chaos_gate(f"gather({key})", blob, rank)
         out = _buf(max_bytes)
         outlen = ctypes.c_uint32(0)
         t = -1.0 if timeout is None else float(timeout)
@@ -129,7 +180,8 @@ class StoreClient:
             st = self._lib.hvd_client_gather(
                 self._h, key.encode(), t, size, rank, _as_u8p(blob),
                 len(blob), out, max_bytes, ctypes.byref(outlen))
-            raw = self._finish(st, out, outlen, f"gather({key})")
+            raw = self._finish(st, out, outlen,
+                               f"gather({key}, rank {rank})", timeout=t)
         blobs, off = [], 0
         for _ in range(size):
             (n,) = struct.unpack_from("<I", raw, off)
@@ -147,6 +199,8 @@ class StoreClient:
         gather's O(size*len(blob)) fan-out — which is what makes the
         negotiation bitvector round affordable at P=64
         (benchmarks/store_service_time.py)."""
+        if _chaos._INJ is not None and not self._chaos_exempt:
+            blob = _chaos_gate(f"reduce({key})", blob, rank)
         out = _buf(max_bytes)
         outlen = ctypes.c_uint32(0)
         t = -1.0 if timeout is None else float(timeout)
@@ -155,7 +209,8 @@ class StoreClient:
                 self._h, key.encode(), t, size, rank,
                 1 if is_or else 0, _as_u8p(blob), len(blob), out,
                 max_bytes, ctypes.byref(outlen))
-            return self._finish(st, out, outlen, f"reduce({key})")
+            return self._finish(st, out, outlen,
+                                f"reduce({key}, rank {rank})", timeout=t)
 
     def stat(self) -> dict:
         """Server live-state counts after a forced TTL sweep
@@ -197,18 +252,24 @@ class Coordinator:
         self.rank, self.size, self.timeout = rank, size, timeout
 
     def barrier(self, tag: str = "barrier") -> None:
+        if _chaos._INJ is not None:
+            _chaos_gate(f"barrier({tag})", None, self.rank)
         _check(self._lib.hvd_coord_barrier(self._h, tag.encode(),
-                                           self.timeout), f"barrier({tag})")
+                                           self.timeout), f"barrier({tag})",
+               rank=self.rank, timeout=self.timeout)
 
     def allgather(self, blob: bytes, tag: str = "ag",
                   max_bytes: int = 1 << 22) -> List[bytes]:
+        if _chaos._INJ is not None:
+            blob = _chaos_gate(f"allgather({tag})", blob, self.rank)
         out = _buf(max_bytes)
         outlen = ctypes.c_uint32(0)
         st = self._lib.hvd_coord_allgather(self._h, tag.encode(),
                                            _as_u8p(blob), len(blob),
                                            self.timeout, out, max_bytes,
                                            ctypes.byref(outlen))
-        _check(st, f"allgather({tag})")
+        _check(st, f"allgather({tag})", rank=self.rank,
+               timeout=self.timeout)
         raw = bytes(out[:outlen.value])
         blobs, off = [], 0
         for _ in range(self.size):
@@ -220,27 +281,34 @@ class Coordinator:
 
     def broadcast(self, blob: Optional[bytes], root: int = 0, tag: str = "bc",
                   max_bytes: int = 1 << 22) -> bytes:
+        if _chaos._INJ is not None and blob is not None:
+            blob = _chaos_gate(f"broadcast({tag})", blob, self.rank)
         out = _buf(max_bytes)
         outlen = ctypes.c_uint32(0)
         data = blob if blob is not None else b""
         st = self._lib.hvd_coord_bcast(self._h, tag.encode(), root,
                                        _as_u8p(data), len(data), self.timeout,
                                        out, max_bytes, ctypes.byref(outlen))
-        _check(st, f"broadcast({tag})")
+        _check(st, f"broadcast({tag})", rank=self.rank,
+               timeout=self.timeout)
         return bytes(out[:outlen.value])
 
     def bitand(self, bits: bytes, tag: str = "and") -> bytes:
+        if _chaos._INJ is not None:
+            bits = _chaos_gate(f"bitand({tag})", bits, self.rank)
         buf = (ctypes.c_uint8 * len(bits)).from_buffer_copy(bits)
         _check(self._lib.hvd_coord_bitand(self._h, tag.encode(), buf,
                                           len(bits), self.timeout),
-               f"bitand({tag})")
+               f"bitand({tag})", rank=self.rank, timeout=self.timeout)
         return bytes(buf)
 
     def bitor(self, bits: bytes, tag: str = "or") -> bytes:
+        if _chaos._INJ is not None:
+            bits = _chaos_gate(f"bitor({tag})", bits, self.rank)
         buf = (ctypes.c_uint8 * len(bits)).from_buffer_copy(bits)
         _check(self._lib.hvd_coord_bitor(self._h, tag.encode(), buf,
                                          len(bits), self.timeout),
-               f"bitor({tag})")
+               f"bitor({tag})", rank=self.rank, timeout=self.timeout)
         return bytes(buf)
 
     def close(self) -> None:

@@ -337,6 +337,18 @@ def build_hybrid_comm(name_base: str, *, force_store: bool = False):
             # counter would desync them permanently.
             prefix = f"p2p.{name_base}.{role}.g{gen}"
             _ring_epochs[prefix] = _ring_epochs.get(prefix, 0) + 1
+            if _ring_epochs[prefix] > 1:
+                # epoch > 1 = this process is re-dialing a ring it
+                # already built once (in-process elastic reset) — the
+                # reconnect signal the fleet report watches
+                try:
+                    from ..obs import metrics as obs_metrics
+                    obs_metrics.get_registry().counter(
+                        "hvd_p2p_reconnects_total",
+                        "p2p ring rebuilds after the first "
+                        "(elastic resets re-dialing the ring)").inc()
+                except Exception:  # noqa: BLE001 — obs must not block
+                    pass           # the plane build
             return RingComm(addr, int(port), xr, xs, prefix=prefix,
                             epoch=_ring_epochs[prefix])
         return StoreComm(addr, int(port), xr, xs, prefix=role)

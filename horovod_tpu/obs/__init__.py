@@ -17,9 +17,11 @@ Instrumented out of the box: ops/engine.py (negotiation latency, cycle
 time, fusion bucket sizes, cache hit/miss, wire bytes, stall warnings),
 serve/ (queue depth, admit/shed/expired, step + time-to-first-token
 latency histograms), optim/optimizer.py (eager step time), elastic/
-(resets, host join/leave, worker failures) and ckpt/ (save/blocking/
-restore latency, bytes by kind, CKPT timeline rows). See
-docs/metrics.md.
+(resets, host join/leave, worker failures, recovery-latency histogram
++ last-recovery gauge), ckpt/ (save/blocking/restore latency, bytes by
+kind, CKPT timeline rows) and chaos/ (injected-fault counters,
+per-peer heartbeat-age gauges, detector suspicions, p2p ring
+reconnects). See docs/metrics.md and docs/chaos.md.
 """
 from .metrics import (                                          # noqa: F401
     BYTES_BUCKETS, COUNT_BUCKETS, LATENCY_MS_BUCKETS,

@@ -83,6 +83,23 @@ def _find_hist(snap: dict, name: str) -> Optional[dict]:
                             for e in entries])["histograms"][0]
 
 
+def _recovery_rollup(snaps: Sequence[dict],
+                     merged: dict) -> Optional[dict]:
+    """The fleet's elastic-recovery view: merged
+    ``hvd_elastic_recovery_ms`` histogram rollup plus ``last_ms`` — the
+    slowest rank's most recent recovery (gauges must NOT be read from
+    the merged snapshot, which sums them; take the per-rank max)."""
+    roll = _hist_rollup(_find_hist(merged, "hvd_elastic_recovery_ms"))
+    if roll is None:
+        return None
+    last = [e["value"] for snap in snaps
+            for e in snap.get("gauges", [])
+            if e["name"] == "hvd_elastic_last_recovery_ms"
+            and e["value"] > 0]
+    roll["last_ms"] = round(max(last), 3) if last else None
+    return roll
+
+
 def _median(vals: Sequence[float]) -> float:
     s = sorted(vals)
     n = len(s)
@@ -101,7 +118,8 @@ def build_report(snaps: Sequence[dict], *,
         None)
     report = {"world_size": len(snaps), "rank": rank, "merged": merged,
               "step_metric": step_metric, "step_time": None,
-              "per_rank": {}, "skew": None, "stragglers": []}
+              "per_rank": {}, "skew": None, "stragglers": [],
+              "recovery": _recovery_rollup(snaps, merged)}
     if step_metric is None:
         return report
     report["step_time"] = _hist_rollup(_find_hist(merged, step_metric))

@@ -1476,11 +1476,41 @@ class Engine:
             if stalled:
                 warned.update(stalled)
                 self._m_stall_warn.inc(len(stalled))
+                # corroborate with the heartbeat failure detector
+                # (chaos/detector.py): a stall caused by a dead peer
+                # is named — and escalated, because it will never
+                # resolve on its own — instead of warning anonymously
+                # until the collective timeout
+                suspect_note = ""
+                try:
+                    from ..chaos import detector as _hb
+                    suspects = _hb.current_suspects()
+                    if suspects:
+                        suspect_note = (
+                            "; failure detector suspects dead peer(s): "
+                            + ", ".join(
+                                f"rank {p} (heartbeat age {a:.1f}s)"
+                                for p, a in sorted(suspects.items())))
+                except Exception:  # noqa: BLE001
+                    suspects = {}
                 logger.warning(
                     "One or more tensors were submitted for collective "
                     "execution but have not completed for over %ss: %s "
-                    "(reference stall_inspector.cc warning)",
-                    cfg.stall_warning_time_seconds, stalled)
+                    "(reference stall_inspector.cc warning)%s",
+                    cfg.stall_warning_time_seconds, stalled, suspect_note)
+                if suspect_note:
+                    tl = self._state.timeline
+                    if tl is not None:
+                        tl.instant("HEALTH", {
+                            "event": "stall_with_suspect",
+                            "stalled": sorted(stalled)[:8],
+                            "suspects": {str(p): round(a, 2)
+                                         for p, a in suspects.items()}})
+                    try:
+                        _hb.escalate("engine stall corroborates "
+                                     "heartbeat suspicion")
+                    except Exception:  # noqa: BLE001
+                        pass
             if overdue:
                 logger.error(
                     "Stalled tensors exceeded "

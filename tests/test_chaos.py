@@ -1,0 +1,604 @@
+"""Tier-1 units for the chaos plane (ISSUE 5): plan parser, injection
+shims, failure detector, recovery metrics.
+
+The multi-process soak acceptance lives in tests/test_chaos_soak.py
+(slow-marked); everything here is single-process and fast. The
+load-bearing bar: with HOROVOD_CHAOS_PLAN unset the shims are
+byte-identical pass-throughs, and a seeded plan is deterministic.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import native
+from horovod_tpu.chaos import inject, process_identity
+from horovod_tpu.chaos.plan import (ChaosPlan, Fault, PlanError,
+                                    random_plan)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native toolchain unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends disarmed — an injector leaking into
+    other tests would fault unrelated suites."""
+    inject.uninstall()
+    yield
+    inject.uninstall()
+
+
+# --------------------------------------------------------------------------
+# plan parser
+# --------------------------------------------------------------------------
+
+class TestPlan:
+    def test_roundtrip_and_for_rank(self):
+        p = ChaosPlan.from_json(json.dumps({
+            "seed": 7, "faults": [
+                {"rank": 1, "site": "step", "at": 5, "kind": "crash"},
+                {"rank": 0, "site": "p2p.send", "kind": "delay",
+                 "seconds": 0.1, "after": 2, "until": 4}]}))
+        assert p.seed == 7 and len(p.faults) == 2
+        assert [f.kind for f in p.for_rank(1)] == ["crash"]
+        assert ChaosPlan.from_json(p.to_json()).to_json() == p.to_json()
+
+    def test_random_plan_deterministic(self):
+        a = random_plan(123, 4, 12)
+        b = random_plan(123, 4, 12)
+        c = random_plan(124, 4, 12)
+        assert a.to_json() == b.to_json()
+        assert a.to_json() != c.to_json()
+        kinds = {f.kind for f in a.faults}
+        assert "crash" in kinds and "delete_chunk" in kinds
+        # the crash is pinned to epoch 0 so a relaunch can't re-fire it
+        crash = next(f for f in a.faults if f.kind == "crash")
+        assert crash.epoch == 0 and crash.rank >= 1
+
+    def test_parse_file_and_inline(self, tmp_path):
+        inline = '{"seed": 1, "faults": []}'
+        assert ChaosPlan.parse(inline).seed == 1
+        f = tmp_path / "plan.json"
+        f.write_text(inline)
+        assert ChaosPlan.parse(str(f)).seed == 1
+        with pytest.raises(PlanError, match="cannot be read"):
+            ChaosPlan.parse(str(tmp_path / "missing.json"))
+
+    @pytest.mark.parametrize("fault,match", [
+        ({"rank": 0, "site": "nowhere", "kind": "delay", "seconds": 1},
+         "unknown fault site"),
+        ({"rank": 0, "site": "step", "kind": "sabotage"},
+         "unknown fault kind"),
+        ({"rank": -1, "site": "step", "kind": "crash"}, "rank"),
+        ({"rank": 0, "site": "step", "kind": "delay"}, "seconds"),
+        ({"rank": 0, "site": "step", "kind": "torn_write"},
+         "cannot land"),
+        ({"rank": 0, "site": "ckpt.commit", "kind": "delete_chunk"},
+         "shard"),
+        ({"rank": 0, "site": "step", "kind": "crash", "at": 1,
+          "after": 2}, "not both"),
+        ({"rank": 0, "site": "step", "kind": "crash", "surprise": 1},
+         "unknown fields"),
+    ])
+    def test_malformed_fail_fast(self, fault, match):
+        with pytest.raises(PlanError, match=match):
+            ChaosPlan.from_dict({"faults": [fault]})
+
+    def test_not_json_fail_fast(self):
+        with pytest.raises(PlanError, match="not valid JSON"):
+            ChaosPlan.from_json("{nope")
+        with pytest.raises(PlanError, match="unknown chaos plan keys"):
+            ChaosPlan.from_dict({"seed": 0, "fautls": []})
+
+    def test_epoch_pinning_and_windows(self):
+        f = Fault(rank=0, site="step", kind="crash", at=3,
+                  epoch=0).validate()
+        assert f.matches(3, 0) and not f.matches(3, 1)
+        w = Fault(rank=0, site="step", kind="slow_rank", seconds=0.1,
+                  after=2, until=4).validate()
+        assert not w.matches(1, 0) and w.matches(2, 0) \
+            and w.matches(4, 5) and not w.matches(5, 0)
+
+
+# --------------------------------------------------------------------------
+# config knobs
+# --------------------------------------------------------------------------
+
+class TestConfigKnobs:
+    def test_strict_parse_fail_fast(self, monkeypatch):
+        from horovod_tpu.core.config import Config
+        for var in ("HOROVOD_HEARTBEAT_INTERVAL_S",
+                    "HOROVOD_HEARTBEAT_SUSPECT_S"):
+            monkeypatch.setenv(var, "soon")
+            with pytest.raises(ValueError, match=var):
+                Config.from_env()
+            monkeypatch.delenv(var)
+
+    def test_suspect_must_exceed_interval(self, monkeypatch):
+        from horovod_tpu.core.config import Config
+        monkeypatch.setenv("HOROVOD_HEARTBEAT_INTERVAL_S", "2.0")
+        monkeypatch.setenv("HOROVOD_HEARTBEAT_SUSPECT_S", "1.0")
+        with pytest.raises(ValueError, match="must exceed"):
+            Config.from_env()
+
+    def test_bad_plan_fails_at_config(self, monkeypatch):
+        from horovod_tpu.core.config import Config
+        monkeypatch.setenv("HOROVOD_CHAOS_PLAN",
+                           '{"faults": [{"rank": 0}]}')
+        with pytest.raises(ValueError, match="HOROVOD_CHAOS_PLAN"):
+            Config.from_env()
+
+    def test_valid_knobs_land(self, monkeypatch):
+        from horovod_tpu.core.config import Config
+        monkeypatch.setenv("HOROVOD_HEARTBEAT_INTERVAL_S", "0.5")
+        monkeypatch.setenv("HOROVOD_HEARTBEAT_SUSPECT_S", "2.5")
+        monkeypatch.setenv("HOROVOD_CHAOS_PLAN",
+                           '{"seed": 3, "faults": []}')
+        c = Config.from_env()
+        assert c.heartbeat_interval_s == 0.5
+        assert c.heartbeat_suspect_s == 2.5
+        assert c.chaos_plan.startswith("{")
+
+
+# --------------------------------------------------------------------------
+# injection shims
+# --------------------------------------------------------------------------
+
+class TestInject:
+    def test_disarmed_is_identity(self):
+        assert not inject.armed()
+        assert inject.fire("p2p.send", peer=1) is None
+        payload = os.urandom(64)
+        assert inject.corrupt_copy(payload) == payload
+        inject.step_boundary(0)      # no-op, no error
+
+    def test_armed_nonmatching_is_identity(self):
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 9, "site": "step", "kind": "crash"}]}'),
+            rank=0, epoch=0)
+        assert inject.armed()
+        assert inject.fire("step", step=3) is None
+        assert inject.fire("p2p.send", peer=1) is None
+
+    def test_delay_sleeps(self):
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "store.request", '
+            '"kind": "delay", "at": 1, "seconds": 0.15}]}'), rank=0,
+            epoch=0)
+        t0 = time.perf_counter()
+        assert inject.fire("store.request") is None      # n=0
+        fast = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        inject.fire("store.request")                     # n=1: delay
+        slow = time.perf_counter() - t0
+        assert slow >= 0.15 > fast
+
+    def test_corrupt_flips_exactly_one_bit_deterministically(self):
+        plan = ChaosPlan.from_json('{"seed": 5, "faults": []}')
+        payload = bytes(range(256))
+        a = inject.install(plan, rank=2, epoch=0).corrupt_copy(payload)
+        inject.uninstall()
+        b = inject.install(plan, rank=2, epoch=0).corrupt_copy(payload)
+        assert a == b != payload
+        diff = [x ^ y for x, y in zip(a, payload) if x != y]
+        assert len(diff) == 1 and bin(diff[0]).count("1") == 1
+
+    def test_partition_window_does_not_swallow_scheduled_faults(self):
+        # an exact-'at' fault scheduled INSIDE an active partition
+        # window must still fire (regression: the early-return for the
+        # window used to consume the invocation unseen)
+        inject.install(ChaosPlan.from_json(
+            '{"faults": ['
+            '{"rank": 0, "site": "p2p.send", "kind": "partition", '
+            '"peer": 3, "at": 0, "seconds": 30},'
+            '{"rank": 0, "site": "p2p.send", "kind": "drop", '
+            '"at": 2}]}'), rank=0, epoch=0)
+        assert inject.fire("p2p.send", peer=3).kind == "partition"  # n=0
+        assert inject.fire("p2p.send", peer=3).kind == "partition"  # n=1
+        assert inject.fire("p2p.send", peer=3).kind == "drop"       # n=2
+        assert inject.fire("p2p.send", peer=3).kind == "partition"  # n=3
+
+    def test_partition_window_expires(self):
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "p2p.send", '
+            '"kind": "partition", "peer": 3, "at": 0, '
+            '"seconds": 0.2}]}'), rank=0, epoch=0)
+        f = inject.fire("p2p.send", peer=3)
+        assert f is not None and f.kind == "partition"
+        # other peers cross the site untouched during the window
+        assert inject.fire("p2p.send", peer=1) is None
+        assert inject.fire("p2p.send", peer=3).kind == "partition"
+        time.sleep(0.25)
+        assert inject.fire("p2p.send", peer=3) is None
+
+    def test_crash_sigkills_subprocess(self):
+        code = (
+            "from horovod_tpu.chaos import inject\n"
+            "from horovod_tpu.chaos.plan import ChaosPlan\n"
+            "inject.install(ChaosPlan.from_json('{\"faults\": [{\"rank\""
+            ": 0, \"site\": \"step\", \"at\": 3, \"kind\": \"crash\"}]}'"
+            "), rank=0, epoch=0)\n"
+            "for s in range(10):\n"
+            "    inject.step_boundary(s)\n"
+            "print('survived')\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert out.returncode == -signal.SIGKILL, (out.returncode,
+                                                   out.stderr[-500:])
+        assert "survived" not in out.stdout
+
+    def test_listener_sees_fired_faults(self):
+        inj = inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "ckpt.write", '
+            '"kind": "torn_write", "at": 0}]}'), rank=0, epoch=0)
+        seen = []
+        inj.add_listener(seen.append)
+        f = inject.fire("ckpt.write")
+        assert f.kind == "torn_write"
+        assert seen and seen[0]["site"] == "ckpt.write" \
+            and seen[0]["kind"] == "torn_write"
+
+    def test_install_idempotent_preserves_counters(self):
+        plan = ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "step", "at": 0, '
+            '"kind": "torn_write", "site": "ckpt.write"}]}')
+        a = inject.install(plan, rank=0, epoch=0)
+        assert inject.fire("ckpt.write") is not None     # n=0 fires
+        b = inject.install(plan, rank=0, epoch=0)        # re-init
+        assert b is a
+        assert inject.fire("ckpt.write") is None         # n=1: spent
+
+    def test_process_identity_env_chain(self, monkeypatch):
+        for v in ("HOROVOD_PROCESS_ID", "HOROVOD_CROSS_RANK",
+                  "HOROVOD_RANK", "HOROVOD_NUM_PROCESSES",
+                  "HOROVOD_CROSS_SIZE", "HOROVOD_SIZE"):
+            monkeypatch.delenv(v, raising=False)
+        assert process_identity() == (0, 1)
+        monkeypatch.setenv("HOROVOD_RANK", "3")
+        monkeypatch.setenv("HOROVOD_SIZE", "4")
+        assert process_identity() == (3, 4)
+        monkeypatch.setenv("HOROVOD_PROCESS_ID", "1")
+        monkeypatch.setenv("HOROVOD_NUM_PROCESSES", "2")
+        assert process_identity() == (1, 2)
+
+
+# --------------------------------------------------------------------------
+# shim integration at the real boundaries
+# --------------------------------------------------------------------------
+
+@needs_native
+class TestStoreShims:
+    def test_passthrough_byte_identical_when_unset(self):
+        from horovod_tpu.native.store import StoreClient, StoreServer
+        assert not inject.armed()
+        payload = os.urandom(4096)
+        with StoreServer() as srv:
+            c = StoreClient("127.0.0.1", srv.port)
+            c.set("k", payload)
+            assert c.get("k", timeout=5) == payload
+            c.close()
+
+    def test_timeout_message_names_key_rank_timeout(self):
+        from horovod_tpu.native.store import (NativeTimeout, StoreClient,
+                                              StoreServer)
+        with StoreServer() as srv:
+            c = StoreClient("127.0.0.1", srv.port, rank=3)
+            with pytest.raises(NativeTimeout) as ei:
+                c.get("absent-key", timeout=0.05)
+            msg = str(ei.value)
+            assert "get(absent-key)" in msg
+            assert "rank 3" in msg
+            assert "0.05s" in msg
+            c.close()
+
+    def test_injected_drop_and_corrupt_at_store_boundary(self):
+        from horovod_tpu.native.store import (NativeError, StoreClient,
+                                              StoreServer)
+        inject.install(ChaosPlan.from_json(
+            '{"seed": 1, "faults": ['
+            '{"rank": 0, "site": "store.request", "kind": "corrupt", '
+            '"at": 0},'
+            '{"rank": 0, "site": "store.request", "kind": "drop", '
+            '"at": 2}]}'), rank=0, epoch=0)
+        payload = bytes(1000)
+        with StoreServer() as srv:
+            c = StoreClient("127.0.0.1", srv.port, rank=0)
+            c.set("k", payload)                          # n=0: corrupt
+            stored = c.get("k", timeout=5)               # n=1: clean
+            assert stored != payload and len(stored) == len(payload)
+            with pytest.raises(NativeError, match="chaos.*drop"):
+                c.get("k", timeout=5)                    # n=2: drop
+            c.close()
+
+
+@needs_native
+class TestP2PShims:
+    def test_shift_passthrough_single_rank(self):
+        from horovod_tpu.native.p2p import RingComm
+        assert not inject.armed()
+        c = RingComm("127.0.0.1", 1, 0, 1)
+        a = np.arange(64, dtype=np.uint8)
+        np.testing.assert_array_equal(c.shift(a), a)
+        c.close()
+
+    def test_recv_error_names_predecessor(self):
+        import socket as socket_mod
+
+        from horovod_tpu.native.p2p import P2PError, _recv_into
+        a, b = socket_mod.socketpair()
+        try:
+            b.close()
+            buf = np.empty(4, np.uint8)
+            with pytest.raises(P2PError, match="predecessor rank 2"):
+                _recv_into(a, buf, who="predecessor rank 2")
+        finally:
+            a.close()
+
+
+class TestCkptShims:
+    def test_write_read_passthrough_when_unset(self, tmp_path):
+        from horovod_tpu.ckpt.store import (_leaf_entry, read_chunk,
+                                            write_shard)
+        assert not inject.armed()
+        arr = np.arange(48, dtype=np.float32).reshape(12, 4)
+        entries = [_leaf_entry("w", arr)]
+        chunks, n = write_shard(str(tmp_path), 0, 1, entries, [arr])
+        assert n == arr.nbytes
+        out = read_chunk(str(tmp_path), 0, chunks[0], entries[0])
+        np.testing.assert_array_equal(out, arr)
+
+    def test_torn_write_caught_by_crc(self, tmp_path):
+        from horovod_tpu.ckpt.store import (CkptError, _leaf_entry,
+                                            read_chunk, write_shard)
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "ckpt.write", '
+            '"kind": "torn_write", "at": 0}]}'), rank=0, epoch=0)
+        arr = np.arange(1024, dtype=np.float32)
+        entries = [_leaf_entry("w", arr)]
+        chunks, _ = write_shard(str(tmp_path), 0, 1, entries, [arr])
+        with pytest.raises(CkptError, match="short read|crc32"):
+            read_chunk(str(tmp_path), 0, chunks[0], entries[0])
+
+
+# --------------------------------------------------------------------------
+# failure detector
+# --------------------------------------------------------------------------
+
+@needs_native
+class TestDetector:
+    def test_suspects_dead_peer_and_recovers(self):
+        from horovod_tpu.chaos.detector import HeartbeatDetector
+        from horovod_tpu.native.store import StoreServer
+        from horovod_tpu.obs.metrics import MetricsRegistry
+        r0, r1 = MetricsRegistry(), MetricsRegistry()
+        with StoreServer() as srv:
+            d0 = HeartbeatDetector("127.0.0.1", srv.port, 0, 2,
+                                   interval_s=0.1, suspect_s=0.5,
+                                   gen="t1", registry=r0)
+            d1 = HeartbeatDetector("127.0.0.1", srv.port, 1, 2,
+                                   interval_s=0.1, suspect_s=0.5,
+                                   gen="t1", registry=r1)
+            events = []
+            d0.add_listener(events.append)
+            d0.start()
+            d1.start()
+            try:
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline and \
+                        1 not in d0._last_seq:
+                    time.sleep(0.02)
+                assert 1 in d0._last_seq, "peer heartbeat never seen"
+                assert d0.suspects() == {}
+                d1.stop()                    # rank 1 "dies"
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline and not d0.suspects():
+                    time.sleep(0.02)
+                assert 1 in d0.suspects()
+                assert d0.phi(1) > 1.0
+                sus = [e for e in events if e["event"] == "suspect"]
+                assert sus and sus[0]["peer"] == 1
+                assert r0.get("hvd_detector_suspicions_total",
+                              {"peer": "1"}).value == 1
+                age = r0.get("hvd_peer_heartbeat_age_ms", {"peer": "1"})
+                assert age is not None and age.value > 500
+                # resurrection: a fresh incarnation posts again
+                d1b = HeartbeatDetector("127.0.0.1", srv.port, 1, 2,
+                                        interval_s=0.1, suspect_s=0.5,
+                                        gen="t1", registry=r1)
+                d1b.start()
+                try:
+                    deadline = time.monotonic() + 5
+                    while time.monotonic() < deadline and d0.suspects():
+                        time.sleep(0.02)
+                    assert d0.suspects() == {}
+                    rec = [e for e in events
+                           if e["event"] == "recovered"]
+                    assert rec and rec[0]["peer"] == 1
+                finally:
+                    d1b.stop()
+            finally:
+                d0.stop()
+                d1.stop()
+
+    def test_never_seen_peer_not_suspected(self):
+        # startup skew: a peer that has not heartbeated YET must not be
+        # suspected (the fastest rank would otherwise escalate against
+        # a healthy slow-starting one and loop the job through resets);
+        # its age gauge still climbs for observability
+        from horovod_tpu.chaos.detector import HeartbeatDetector
+        from horovod_tpu.native.store import StoreServer
+        from horovod_tpu.obs.metrics import MetricsRegistry
+        r = MetricsRegistry()
+        with StoreServer() as srv:
+            d = HeartbeatDetector("127.0.0.1", srv.port, 0, 2,
+                                  interval_s=0.1, suspect_s=0.3,
+                                  gen="t2", registry=r).start()
+            try:
+                time.sleep(1.0)          # >> suspect_s, peer never posts
+                assert d.suspects() == {}
+                age = r.get("hvd_peer_heartbeat_age_ms", {"peer": "1"})
+                assert age is not None and age.value > 300
+            finally:
+                d.stop()
+
+    def test_detector_traffic_exempt_from_store_counters(self):
+        # the detector's own KV client must not advance the
+        # store.request site counter (it would make 'at:'-addressed
+        # store faults land on a different app op every run)
+        from horovod_tpu.native.store import StoreClient, StoreServer
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "store.request", '
+            '"kind": "drop", "at": 1}]}'), rank=0, epoch=0)
+        with StoreServer() as srv:
+            exempt = StoreClient("127.0.0.1", srv.port, rank=0,
+                                 chaos_exempt=True)
+            for _ in range(5):           # would consume n=0..4 if counted
+                exempt.set("hb", b"x")
+            exempt.close()
+            assert inject.injector()._counts.get("store.request", 0) == 0
+            c = StoreClient("127.0.0.1", srv.port, rank=0)
+            c.set("k", b"a")             # n=0: clean
+            from horovod_tpu.native.store import NativeError
+            with pytest.raises(NativeError, match="chaos.*drop"):
+                c.set("k", b"b")         # n=1: the scheduled drop
+            c.close()
+
+    def test_module_plumbing_and_stall_hook(self):
+        from horovod_tpu.chaos import detector as hb
+
+        class _Fake:
+            def __init__(self):
+                self.escalated = []
+
+            def suspects(self):
+                return {2: 7.5}
+
+            def escalate(self, reason):
+                self.escalated.append(reason)
+
+            def stop(self):
+                pass
+
+        assert hb.current_suspects() == {}
+        hb._DETECTOR = _Fake()
+        try:
+            assert hb.current_suspects() == {2: 7.5}
+            hb.escalate("engine stall")
+            assert hb._DETECTOR.escalated == ["engine stall"]
+        finally:
+            hb._DETECTOR = None
+
+    def test_bad_identity_rejected(self):
+        from horovod_tpu.chaos.detector import HeartbeatDetector
+        from horovod_tpu.obs.metrics import MetricsRegistry
+        with pytest.raises(ValueError, match="identity"):
+            HeartbeatDetector("127.0.0.1", 1, 5, 2,
+                              registry=MetricsRegistry())
+        with pytest.raises(ValueError, match="escalate"):
+            HeartbeatDetector("127.0.0.1", 1, 0, 2, escalate="panic",
+                              registry=MetricsRegistry())
+
+
+# --------------------------------------------------------------------------
+# recovery metrics in the fleet report
+# --------------------------------------------------------------------------
+
+class TestRecoveryReport:
+    def test_build_report_rolls_up_recovery(self):
+        from horovod_tpu.obs.metrics import MetricsRegistry
+        from horovod_tpu.obs.report import build_report
+        snaps = []
+        for ms in (120.0, 480.0):
+            r = MetricsRegistry()
+            r.histogram("hvd_elastic_recovery_ms", "t").observe(ms)
+            r.gauge("hvd_elastic_last_recovery_ms", "t").set(ms)
+            snaps.append(r.snapshot())
+        rep = build_report(snaps)
+        rec = rep["recovery"]
+        assert rec is not None and rec["count"] == 2
+        # last_ms is the slowest rank's gauge, NOT the summed merge
+        assert rec["last_ms"] == 480.0
+
+    def test_no_recovery_series_reports_none(self):
+        from horovod_tpu.obs.metrics import MetricsRegistry
+        from horovod_tpu.obs.report import build_report
+        rep = build_report([MetricsRegistry().snapshot()])
+        assert rep["recovery"] is None
+
+
+# --------------------------------------------------------------------------
+# soak verdict core (the np4 run itself is slow-marked elsewhere)
+# --------------------------------------------------------------------------
+
+class TestSoakEvaluate:
+    def _write_events(self, out_dir, events, rank):
+        with open(os.path.join(out_dir, f"events.{rank}.jsonl"),
+                  "a") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+    def test_verdict_on_synthetic_logs(self, tmp_path):
+        from horovod_tpu.chaos.soak import evaluate
+        plan = ChaosPlan.from_dict({"faults": [
+            {"rank": 2, "site": "step", "at": 5, "kind": "crash",
+             "epoch": 0},
+            {"rank": 0, "site": "ckpt.commit", "at": 1,
+             "kind": "delete_chunk", "shard": 1, "epoch": 0}]})
+        t0 = 1000.0
+        self._write_events(tmp_path, [
+            {"kind": "chaos", "fault": "crash", "rank": 2, "epoch": 0,
+             "site": "step", "n": 5, "t": t0}], 2)
+        for r in (0, 1, 3):
+            self._write_events(tmp_path, [
+                {"kind": "commit", "rank": r, "epoch": 0, "step": 4,
+                 "hash": "abcd", "t": t0 - 1},
+                {"kind": "health", "event": "suspect", "peer": 2,
+                 "rank": r, "t": t0 + 1.4},
+                {"kind": "resume", "rank": r, "epoch": 1, "step": 4,
+                 "hash": "abcd", "t": t0 + 9},
+                {"kind": "step", "rank": r, "epoch": 1, "step": 5,
+                 "t": t0 + 10}], r)
+        for r in range(4):
+            with open(tmp_path / f"final.{r}.json", "w") as f:
+                json.dump({"rank": r, "step": 10, "hash": "ffff"}, f)
+        v = evaluate(str(tmp_path), plan, np_=4, steps=10,
+                     heartbeat_suspect_s=1.5, recovery_bound_s=60)
+        assert v["victim"] == 2
+        assert v["detector_named_dead"] is True
+        assert v["detection_s"] == {0: 1.4, 1: 1.4, 3: 1.4}
+        assert v["recovery_bounded"] is True and v["recovery_s"] == 10
+        assert v["replica_restore"] is True
+        assert v["params_bit_identical"] is True
+
+    def test_verdict_catches_late_detection_and_divergence(self,
+                                                           tmp_path):
+        from horovod_tpu.chaos.soak import evaluate
+        plan = ChaosPlan.from_dict({"faults": [
+            {"rank": 1, "site": "step", "at": 3, "kind": "crash"}]})
+        t0 = 50.0
+        self._write_events(tmp_path, [
+            {"kind": "chaos", "fault": "crash", "rank": 1, "epoch": 0,
+             "site": "step", "n": 3, "t": t0}], 1)
+        for r in (0, 2, 3):
+            self._write_events(tmp_path, [
+                {"kind": "health", "event": "suspect", "peer": 1,
+                 "rank": r, "t": t0 + 99}], r)     # way past 2x suspect
+        for r in range(4):
+            with open(tmp_path / f"final.{r}.json", "w") as f:
+                json.dump({"rank": r, "step": 10,
+                           "hash": f"h{r % 2}"}, f)   # diverged
+        v = evaluate(str(tmp_path), plan, np_=4, steps=10,
+                     heartbeat_suspect_s=1.5, recovery_bound_s=60)
+        assert v["detector_named_dead"] is False
+        assert v["params_bit_identical"] is False

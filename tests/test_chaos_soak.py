@@ -1,0 +1,47 @@
+"""ISSUE 5 np4 chaos acceptance (slow tier): a REAL 4-process elastic
+job driven through a seeded fault plan by the soak harness.
+
+The plan SIGKILLs one worker mid-step (epoch 0) and deletes one
+committed ckpt shard right after the last pre-crash commit. The bar:
+
+* every survivor's failure detector names the dead rank within
+  2 x HOROVOD_HEARTBEAT_SUSPECT_S of the crash,
+* the job recovers through elastic auto-restore, coming back through
+  the buddy-replica path (the primary shard is gone),
+* post-recovery parameters are bit-identical across ranks and the job
+  runs to completion (no deadlock, bounded recovery).
+
+Driven through the tools/soak.py CLI so the CLI contract (JSON verdict
+on stdout, exit code) is covered by the same run.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.mark.slow
+def test_np4_chaos_soak_acceptance(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+         "--np", "4", "--seed", "7", "--steps", "10",
+         "--out", str(tmp_path), "--timeout", "300"],
+        env=env, capture_output=True, text=True, timeout=360)
+    assert out.stdout.strip(), out.stderr[-3000:]
+    verdict = json.loads(out.stdout)
+    detail = json.dumps(verdict, indent=2, sort_keys=True)[:3000]
+    assert verdict["no_deadlock"], detail
+    assert verdict["detector_named_dead"] is True, detail
+    assert all(d <= 2 * 1.5 for d in verdict["detection_s"].values()), \
+        detail
+    assert verdict["recovery_bounded"], detail
+    assert verdict["replica_restore"] is True, detail
+    assert verdict["params_bit_identical"] is True, detail
+    assert verdict["ok"] and out.returncode == 0, detail
