@@ -18,10 +18,12 @@ inception3 — the reference's full headline scaling trio
 obs registry's histogram into the summary line and prints the end-of-run
 registry snapshot as a second JSON line (docs/metrics.md).
 
-`--serve` runs the continuous-batching loopback benchmark and `--ckpt`
+`--serve` runs the continuous-batching loopback benchmark, `--ckpt`
 the checkpoint-plane loopback (ckpt_save_ms / ckpt_blocking_ms /
-ckpt_restore_ms — docs/checkpoint.md), each emitting the same
-one-JSON-line-per-metric format.
+ckpt_restore_ms — docs/checkpoint.md), and `--collectives` the
+collective-algorithm microbench (bytes/s per algorithm x tensor size
+plus the measured crossover table — docs/benchmarks.md), each emitting
+the same one-JSON-line-per-metric format.
 
 vs_baseline compares per-chip throughput against the reference's documented
 tf_cnn_benchmarks ResNet-101 example output (1656.82 img/sec on 16 P100s =
@@ -309,6 +311,115 @@ def run_serve_benchmark() -> int:
         return 1
 
 
+def run_collectives_benchmark() -> int:
+    """Collective-algorithm microbench (`bench.py --collectives`):
+    sweeps every runnable allreduce algorithm (ops/algo.py registry —
+    direct / rs_ag / rhd / two_level) across latency-bound-small to
+    bandwidth-bound-large tensor sizes and emits measured bytes/s per
+    (algorithm x size) as JSON lines, plus one crossover-table summary
+    line comparing the per-regime MEASURED best (what the autotuner
+    converges to) against the two previous fixed paths: flat psum
+    ("direct" everywhere) and the all-or-nothing two-level toggle. This
+    is how the algorithm-selection claim is measured, not asserted
+    (docs/benchmarks.md algorithm-selection section)."""
+    # a 1-device platform has no collectives to measure — force a
+    # multi-device host mesh on CPU (the conftest discipline)
+    ndev = int(os.environ.get("HVD_BENCH_COLL_DEVICES", "8"))
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") and ndev > 1:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={ndev}").strip()
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvd
+        from horovod_tpu.ops import algo as algo_mod
+        from horovod_tpu.ops import collective_ops as co
+
+        hvd.init()
+        n = hvd.size()
+        platform = jax.devices()[0].platform
+        from horovod_tpu.core.mesh import mesh_is_multiprocess
+        mesh_mp = mesh_is_multiprocess(hvd.core.basics.get_mesh())
+        hier = hvd.core.basics.get_hier_mesh()
+        hier_ok = hier is not None and hier.devices.size == n and \
+            hier.devices.shape[1] > 1
+        # sweep everything runnable-when-FORCED, including a degenerate
+        # cross==1 hierarchy (the sweep measures; only auto-selection
+        # excludes it)
+        algos = list(algo_mod.runnable_algorithms(
+            n, tuple(hier.devices.shape) if hier_ok else None,
+            require_cross=False))
+        sizes = [int(s) for s in os.environ.get(
+            "HVD_BENCH_COLL_SIZES", "4096,262144,4194304").split(",")]
+        iters = int(os.environ.get("HVD_BENCH_COLL_ITERS", "8"))
+        trials = int(os.environ.get("HVD_BENCH_COLL_TRIALS", "5"))
+        rng = np.random.RandomState(0)
+        table = []
+        for size in sizes:
+            elems = max(size // 4, n)
+            x = jnp.asarray(rng.randn(n, elems).astype(np.float32))
+            best = {}
+            # warmup (compile) every algorithm first so trials interleave
+            for a in algos:
+                jax.block_until_ready(co.allreduce(x, hvd.Sum, algo=a))
+            for _ in range(trials):
+                for a in algos:
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        r = co.allreduce(x, hvd.Sum, algo=a)
+                    jax.block_until_ready(r)
+                    dt = (time.perf_counter() - t0) / iters
+                    best[a] = min(best.get(a, float("inf")), dt)
+            nbytes = elems * 4
+            row = {"size_bytes": nbytes,
+                   "bytes_per_s": {a: round(nbytes / best[a], 1)
+                                   for a in algos},
+                   "model_pick": algo_mod.select_algorithm(
+                       nbytes, n,
+                       hier_shape=tuple(hier.devices.shape)
+                       if hier_ok else None,
+                       dcn=mesh_mp),
+                   "measured_best": min(best, key=best.get)}
+            for a in algos:
+                print(json.dumps({
+                    "metric": "collective_bytes_per_s", "value":
+                        round(nbytes / best[a], 1), "unit": "B/s",
+                    "collective": "allreduce", "algo": a,
+                    "size_bytes": nbytes, "platform": platform,
+                    "n_devices": n}), flush=True)
+            table.append(row)
+        # crossover summary: the per-regime measured best vs each
+        # previous FIXED path (flat direct everywhere / two-level
+        # everywhere when available)
+        fixed = ["direct"] + (["two_level"] if hier_ok else [])
+        summary = []
+        for row in table:
+            bw = row["bytes_per_s"]
+            sel = row["measured_best"]
+            entry = {"size_bytes": row["size_bytes"], "selected": sel,
+                     "model_pick": row["model_pick"],
+                     "selected_bytes_per_s": bw[sel]}
+            for f in fixed:
+                entry[f"win_vs_fixed_{f}"] = round(bw[sel] / bw[f], 3)
+            summary.append(entry)
+        print(json.dumps({
+            "metric": "collective_algo_crossover", "value": summary,
+            "unit": "table", "platform": platform, "n_devices": n,
+            "algorithms": algos,
+            "crossover_bytes_model": algo_mod.crossover_bytes(
+                n, dcn=mesh_mp)}), flush=True)
+        hvd.shutdown()
+        return 0
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        print(json.dumps({"metric": "collective_bytes_per_s",
+                          "value": None, "unit": "B/s",
+                          "error": str(e)[-500:]}), flush=True)
+        return 1
+
+
 def run_ckpt_benchmark() -> int:
     """Loopback checkpoint benchmark (`bench.py --ckpt`): drive the
     sharded checkpoint plane (horovod_tpu/ckpt) over a synthetic
@@ -519,5 +630,8 @@ if __name__ == "__main__":
     elif "--ckpt" in sys.argv or \
             os.environ.get("HVD_BENCH_CKPT") == "1":
         sys.exit(run_ckpt_benchmark())
+    elif "--collectives" in sys.argv or \
+            os.environ.get("HVD_BENCH_COLLECTIVES") == "1":
+        sys.exit(run_collectives_benchmark())
     else:
         sys.exit(main())

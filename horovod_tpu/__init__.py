@@ -38,7 +38,8 @@ from .core.mesh import (                                       # noqa: F401
 )
 from .ops.collective_ops import (                              # noqa: F401
     allreduce, allgather, broadcast, alltoall, reducescatter, barrier, join,
-    local_rows,
+    local_rows, quantized_allgather, quantized_reducescatter,
+    quantized_alltoall,
 )
 from .ops.sparse import (                                      # noqa: F401
     sparse_allreduce, sparse_allreduce_async)

@@ -66,7 +66,8 @@ class BayesianOptimizer:
 
     def __init__(self, bounds: Sequence[Tuple[float, float]],
                  seed: int = 0, n_candidates: int = 512,
-                 noise: Optional[float] = None):
+                 noise: Optional[float] = None,
+                 int_dims: Sequence[int] = ()):
         self.bounds = np.asarray(bounds, np.float64)
         self.rng = np.random.RandomState(seed)
         self.n_candidates = n_candidates
@@ -76,6 +77,13 @@ class BayesianOptimizer:
         self.gp = GaussianProcess(
             length_scale=0.3,
             sigma_n=1e-4 if noise is None else float(noise))
+        # integer/categorical dimensions: candidates are SNAPPED to the
+        # integer lattice before EI evaluation, so the acquisition is
+        # computed on realizable points and the GP never has to
+        # attribute a measurement of round(0.45)=0 to the point 0.45
+        # (the ParameterManager's categorical knobs — two-level, wire
+        # format, per-regime collective algorithms — all ride this)
+        self.int_dims = tuple(int_dims)
         self.xs: List[np.ndarray] = []
         self.ys: List[float] = []
 
@@ -87,6 +95,16 @@ class BayesianOptimizer:
         lo, hi = self.bounds[:, 0], self.bounds[:, 1]
         return lo + u * (hi - lo)
 
+    def _snap_int(self, x: np.ndarray) -> np.ndarray:
+        """Round integer dims (denormed space), clipped to bounds."""
+        if not self.int_dims:
+            return x
+        x = np.array(x, np.float64, copy=True)
+        for i in self.int_dims:
+            x[..., i] = np.clip(np.round(x[..., i]),
+                                self.bounds[i, 0], self.bounds[i, 1])
+        return x
+
     def tell(self, x: np.ndarray, y: float) -> None:
         self.xs.append(self._norm(np.asarray(x, np.float64)))
         self.ys.append(float(y))
@@ -95,11 +113,13 @@ class BayesianOptimizer:
     def suggest(self) -> np.ndarray:
         if len(self.xs) < 3:          # bootstrap: random exploration
             u = self.rng.rand(len(self.bounds))
-            return self._denorm(u)
-        cand = self.rng.rand(self.n_candidates, len(self.bounds))
-        mu, sigma = self.gp.predict(cand)
+            return self._snap_int(self._denorm(u))
+        cand = self._snap_int(
+            self._denorm(self.rng.rand(self.n_candidates,
+                                       len(self.bounds))))
+        mu, sigma = self.gp.predict(self._norm(cand))
         ei = expected_improvement(mu, sigma, max(self.ys))
-        return self._denorm(cand[int(np.argmax(ei))])
+        return cand[int(np.argmax(ei))]
 
     def best(self) -> Tuple[np.ndarray, float]:
         i = int(np.argmax(self.ys))

@@ -10,22 +10,32 @@ CSV log when HOROVOD_AUTOTUNE_LOG is set (operations.cc:630-637).
 from __future__ import annotations
 
 import time
-from typing import Optional, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .bayes import BayesianOptimizer
 
 # knob domains: fusion threshold 0..128 MB, cycle time 1..25 ms — the
-# reference's tunable ranges (parameter_manager.cc defaults) — plus two
-# categorical 0/1 dimensions matching the reference's categorical knobs
+# reference's tunable ranges (parameter_manager.cc defaults) — plus
+# categorical dimensions matching the reference's categorical knobs
 # (parameter_manager.h:59-84): the two-level (hierarchical/torus)
-# allreduce toggle (hier and torus share one code path, ops/cross.py) and
-# the int8 wire-format compression toggle (ops/engine.py fused wire path)
+# allreduce toggle (hier and torus share one code path, ops/cross.py),
+# the int8 wire-format compression toggle (ops/engine.py fused wire
+# path), and the per-regime collective-algorithm choices (ops/algo.py):
+# one algorithm for latency-bound small buckets, one for bandwidth-bound
+# large buckets, split at the alpha-beta crossover — the tuner learns
+# the crossover behavior per deployment instead of the static model
+# guessing it.
 FUSION_MB_RANGE = (0.0, 128.0)
 CYCLE_MS_RANGE = (1.0, 25.0)
 TWO_LEVEL_RANGE = (0.0, 1.0)
 COMPRESSION_RANGE = (0.0, 1.0)
+
+#: default algorithm vocabulary for the per-regime categorical dims; the
+#: engine narrows it to what the deployment can run (rhd needs a
+#: power-of-two world, two_level a real hierarchy)
+DEFAULT_ALGO_CHOICES = ("direct", "rs_ag", "rhd", "two_level")
 
 
 class ParameterManager:
@@ -33,23 +43,56 @@ class ParameterManager:
                  max_samples: int = 20, log_path: Optional[str] = None,
                  seed: int = 0, tune_two_level: bool = True,
                  gp_noise: Optional[float] = None,
-                 tune_compression: bool = False):
+                 tune_compression: bool = False,
+                 tune_algo: bool = False,
+                 algo_choices: Sequence[str] = DEFAULT_ALGO_CHOICES,
+                 clock: Callable[[], float] = time.monotonic):
         #: tune_two_level=False freezes the categorical dim (e.g. when
         #: HOROVOD_TORUS_ALLREDUCE already forces the two-level path and
         #: the knob would be behaviorally inert); tune_compression=False
         #: likewise freezes the wire format (an explicit
-        #: HOROVOD_COMPRESSION setting must stand)
+        #: HOROVOD_COMPRESSION setting must stand); tune_algo adds TWO
+        #: categorical dims — the small-bucket and large-bucket
+        #: collective algorithm — frozen when HOROVOD_COLLECTIVE_ALGO is
+        #: explicit. The algo dims may be conditionally inert: a sample
+        #: whose compression dim lands on int8 rides the gather-based
+        #: quantized transport regardless of algo values. That is sound
+        #: — the GP scores whole CONFIGURATIONS (the compression dim is
+        #: part of x, so the flat direction is conditioned on it) and
+        #: the pin picks the best measured config either way — it just
+        #: costs some sample efficiency, the same trade the reference
+        #: makes tuning hierarchical x cycle-time jointly. `clock` is
+        #: the timing source for scoring windows; injectable so a
+        #: synthetic (bytes, seconds) trace replays byte-identically
+        #: (the deterministic-tuner regression).
+        self.algo_choices = tuple(algo_choices)
+        if tune_algo and len(self.algo_choices) < 2:
+            tune_algo = False             # nothing to choose between
         self.tune_two_level = tune_two_level
         self.tune_compression = tune_compression
+        self.tune_algo = tune_algo
+        self._clock = clock
         dims = [FUSION_MB_RANGE, CYCLE_MS_RANGE]
         self._two_level_idx = self._compression_idx = None
+        self._algo_small_idx = self._algo_large_idx = None
         if tune_two_level:
             self._two_level_idx = len(dims)
             dims.append(TWO_LEVEL_RANGE)
         if tune_compression:
             self._compression_idx = len(dims)
             dims.append(COMPRESSION_RANGE)
-        self.opt = BayesianOptimizer(dims, seed=seed, noise=gp_noise)
+        if tune_algo:
+            algo_range = (0.0, float(len(self.algo_choices) - 1))
+            self._algo_small_idx = len(dims)
+            dims.append(algo_range)
+            self._algo_large_idx = len(dims)
+            dims.append(algo_range)
+        self._cat_dims = tuple(
+            i for i in (self._two_level_idx, self._compression_idx,
+                        self._algo_small_idx, self._algo_large_idx)
+            if i is not None)
+        self.opt = BayesianOptimizer(dims, seed=seed, noise=gp_noise,
+                                     int_dims=self._cat_dims)
         self.warmup_samples = warmup_samples
         self.steps_per_sample = steps_per_sample
         self.max_samples = max_samples
@@ -58,8 +101,9 @@ class ParameterManager:
         self.samples_taken = 0
         self._steps = 0
         self._bytes = 0.0
-        self._t0 = time.monotonic()
-        self._current = np.array([64.0, 1.0, 0.0, 0.0][:len(dims)])
+        self._t0 = self._clock()
+        # categorical dims all start at choice 0 ("off" / "direct")
+        self._current = np.array([64.0, 1.0] + [0.0] * (len(dims) - 2))
         self._log_header_written = False
 
     # -- current knob values ------------------------------------------------
@@ -86,6 +130,24 @@ class ParameterManager:
             return "none"
         return "int8" if self._current[self._compression_idx] else "none"
 
+    def _algo_at(self, idx: Optional[int]) -> str:
+        if idx is None:
+            return ""
+        k = int(round(self._current[idx]))
+        return self.algo_choices[min(max(k, 0), len(self.algo_choices) - 1)]
+
+    @property
+    def algo_small(self) -> str:
+        """Sampled allreduce algorithm for latency-bound small buckets
+        (below the crossover threshold, ops/algo.py); "" when frozen."""
+        return self._algo_at(self._algo_small_idx)
+
+    @property
+    def algo_large(self) -> str:
+        """Sampled allreduce algorithm for bandwidth-bound large
+        buckets; "" when frozen."""
+        return self._algo_at(self._algo_large_idx)
+
     # -- scoring (parameter_manager Update analog) ---------------------------
     def record(self, nbytes: int) -> bool:
         """Report one engine cycle's traffic; returns True when knob values
@@ -96,7 +158,7 @@ class ParameterManager:
         self._steps += 1
         if self._steps < self.steps_per_sample:
             return False
-        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        elapsed = max(self._clock() - self._t0, 1e-9)
         score = self._bytes / elapsed          # bytes/sec
         self._finish_sample(score)
         return True
@@ -116,16 +178,17 @@ class ParameterManager:
             self._current = self._snap(self.opt.suggest())
         self._steps = 0
         self._bytes = 0.0
-        self._t0 = time.monotonic()
+        self._t0 = self._clock()
 
     def _snap(self, x: np.ndarray) -> np.ndarray:
         """Round categorical dims so the executed config (and the x later
         told to the GP) matches what was measured — the GP must not
-        attribute a measurement of round(0.45)=0 to the point 0.45."""
+        attribute a measurement of round(0.45)=0 to the point 0.45.
+        (BayesianOptimizer.int_dims already snaps suggestions; this is
+        the belt-and-braces pass for values from best()/callers.)"""
         x = np.asarray(x, float).copy()
-        for idx in (self._two_level_idx, self._compression_idx):
-            if idx is not None:
-                x[idx] = float(round(x[idx]))
+        for idx in self._cat_dims:
+            x[idx] = float(round(x[idx]))
         return x
 
     def _log(self, score: float, final: bool = False) -> None:
@@ -134,9 +197,10 @@ class ParameterManager:
         with open(self.log_path, "a") as f:
             if not self._log_header_written:
                 f.write("fusion_mb,cycle_ms,two_level,compression,"
-                        "bytes_per_sec,final\n")
+                        "algo_small,algo_large,bytes_per_sec,final\n")
                 self._log_header_written = True
             f.write(f"{self._current[0]:.2f},{self._current[1]:.2f},"
                     f"{int(self.two_level_allreduce)},"
                     f"{self.compression_wire},"
+                    f"{self.algo_small or '-'},{self.algo_large or '-'},"
                     f"{score:.1f},{int(final)}\n")

@@ -127,6 +127,25 @@ class Config:
     # True when HOROVOD_COMPRESSION was set explicitly — freezes the knob
     # against autotuning (same contract as hierarchical_allreduce_set).
     compression_set: bool = False
+    # Collective algorithm plane (HOROVOD_COLLECTIVE_ALGO, ops/algo.py):
+    # "auto" resolves per bucket from the autotuner's learned per-regime
+    # choices / the alpha-beta cost model; an explicit algorithm
+    # ("direct" | "rs_ag" | "rhd" | "two_level") forces every eligible
+    # allreduce bucket onto that strategy and freezes autotuning.
+    collective_algo: str = "auto"
+    # True when HOROVOD_COLLECTIVE_ALGO was set explicitly.
+    collective_algo_set: bool = False
+    # Autotuner-learned per-regime algorithms ("" = not learned yet):
+    # buckets below/at-or-above collective_algo_threshold_bytes resolve
+    # to small/large respectively. Written by the engine when the tuner
+    # samples/pins the algo dims; round-synchronized from rank 0 like
+    # every other tunable.
+    collective_algo_small: str = ""
+    collective_algo_large: str = ""
+    # Small/large bucket split for the per-regime choices
+    # (HOROVOD_COLLECTIVE_ALGO_THRESHOLD, bytes); 0 uses the analytic
+    # alpha-beta crossover (ops/algo.py crossover_bytes).
+    collective_algo_threshold_bytes: int = 0
     # Serving (horovod_tpu/serve): continuous-batching inference knobs.
     # Decode slots the executor batches per iteration (the fixed jit
     # batch shape — HOROVOD_SERVE_MAX_BATCH).
@@ -239,6 +258,15 @@ class Config:
             "HOROVOD_COMPRESSION_BLOCK_SIZE", c.compression_block_size)
         c.compression_dcn_only = _env_bool(
             "HOROVOD_COMPRESSION_DCN_ONLY", c.compression_dcn_only)
+        # Collective-algorithm knobs parse strictly (fail-fast contract):
+        # a typo'd algorithm must fail at startup, not silently fall back
+        # to "auto" and change which XLA programs a job launches.
+        c.collective_algo = os.environ.get(
+            "HOROVOD_COLLECTIVE_ALGO", c.collective_algo).strip().lower()
+        c.collective_algo_set = "HOROVOD_COLLECTIVE_ALGO" in os.environ
+        c.collective_algo_threshold_bytes = _env_int_strict(
+            "HOROVOD_COLLECTIVE_ALGO_THRESHOLD",
+            c.collective_algo_threshold_bytes)
         # Serve knobs parse strictly (no silent default fallback): a
         # typo'd shape knob must fail at startup, not surface as a
         # recompile storm mid-traffic.
@@ -317,6 +345,23 @@ class Config:
                 f"HOROVOD_COMPRESSION_BLOCK_SIZE must be an int in "
                 f"[8, {1 << 20}] (one fp32 scale travels per block); "
                 f"got {bs!r}")
+        from ..ops.algo import ALGO_CHOICES, ALGORITHMS
+        if self.collective_algo not in ALGO_CHOICES:
+            raise ValueError(
+                f"HOROVOD_COLLECTIVE_ALGO must be one of "
+                f"{'|'.join(ALGO_CHOICES)}; got {self.collective_algo!r}")
+        for knob in ("collective_algo_small", "collective_algo_large"):
+            v = getattr(self, knob)
+            if v and v not in ALGORITHMS:
+                raise ValueError(
+                    f"{knob} must be empty or one of "
+                    f"{'|'.join(ALGORITHMS)}; got {v!r}")
+        at = self.collective_algo_threshold_bytes
+        if not isinstance(at, int) or at < 0:
+            raise ValueError(
+                f"HOROVOD_COLLECTIVE_ALGO_THRESHOLD must be a "
+                f"non-negative byte count (0 uses the analytic "
+                f"crossover); got {at!r}")
         ft = self.fusion_threshold_bytes
         if not isinstance(ft, int) or ft < 0:
             raise ValueError(

@@ -34,6 +34,9 @@ from ..core import basics
 from ..core.mesh import GLOBAL_AXIS, stacked_sharding
 from ..core.process_sets import ProcessSet
 from ..core.types import ReduceOp
+from ..optim.compression import (allgather_block_sum, block_dequantize,
+                                 block_quantize, wire_bytes)
+from . import algo as algo_mod
 
 Array = jax.Array
 AXIS = GLOBAL_AXIS
@@ -149,6 +152,112 @@ def local_rows(x) -> np.ndarray:
 
 def _is_float(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.floating)
+
+
+# last resolved algorithm per (collective kind, size regime), for the
+# ALGO timeline row (mirrors the WIRE_BYTES pattern: a row appears when
+# the value CHANGES, so a trace shows every algorithm flip next to the
+# collectives it affected). Keyed per REGIME because the per-regime
+# tuner choices legitimately alternate small/large algorithms every
+# step — steady state must stay silent. Cleared — together with the
+# counter-child cache below — by Engine.__init__ so each run starts
+# fresh.
+_algo_last: dict = {}
+_algo_counters: dict = {}
+_wire_counters: dict = {}
+
+#: one home for the hvd_wire_bytes_total family description — the
+#: engine's claimed children and the sync quantized collectives must
+#: register the same help text (the registry keeps whichever lands
+#: first)
+WIRE_BYTES_HELP = ("collective payload bytes: logical (native dtype) vs "
+                   "actual (configured wire format)")
+
+
+def _note_algo(collective: str, algo: str, nbytes: int,
+               regime: Optional[str] = None) -> None:
+    """Record an algorithm selection: bump the
+    hvd_collective_algo_total{algo,collective} counter and, when the
+    resolved algorithm changed for this (collective kind, size regime),
+    emit an ALGO timeline instant."""
+    c = _algo_counters.get((algo, collective))
+    if c is None:
+        from ..obs import metrics as obs_metrics
+        c = obs_metrics.get_registry().counter(
+            "hvd_collective_algo_total",
+            "collective transport algorithm selections by kind",
+            {"algo": algo, "collective": collective})
+        _algo_counters[(algo, collective)] = c
+    c.inc()
+    key = (collective, regime)
+    if _algo_last.get(key) != algo:
+        prev = _algo_last.get(key)
+        _algo_last[key] = algo
+        tl = basics.get_state().timeline
+        if tl is not None:
+            tl.instant("ALGO", {"collective": collective, "algo": algo,
+                                "prev": prev, "regime": regime,
+                                "bucket_bytes": int(nbytes)})
+
+
+def _rs_ag_sum(v, n: int):
+    """Reduce-scatter + allgather ring decomposition of a sum — the
+    bandwidth-optimal two-phase schedule (each phase moves
+    N*(P-1)/P bytes per rank)."""
+    m = v.size
+    if m == 0 or n == 1:
+        return lax.psum(v, AXIS)
+    flat = v.reshape(-1)
+    pad = (-m) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    piece = lax.psum_scatter(flat, AXIS, scatter_dimension=0, tiled=True)
+    full = lax.all_gather(piece, AXIS, tiled=True)
+    if pad:
+        full = full[:m]
+    return full.reshape(v.shape)
+
+
+def _rhd_sum(v, n: int):
+    """Recursive halving/doubling sum over `lax.ppermute`: log2(P)
+    halving rounds (partner r XOR 2^k, exchange the half the partner
+    owns, add) then log2(P) doubling rounds back — 2*log2(P) hops vs the
+    ring's 2*(P-1), the latency-optimal schedule for small buckets
+    (Thakur et al.; PAPERS.md "A Generalization of the Allreduce
+    Operation"). Power-of-two worlds only (resolve() guarantees)."""
+    m = v.size
+    if m == 0 or n == 1:
+        return lax.psum(v, AXIS)
+    rounds = n.bit_length() - 1
+    flat = v.reshape(-1)
+    pad = (-m) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    idx = lax.axis_index(AXIS)
+    buf = flat
+    # halving: bit k of the rank selects which half it keeps, so after
+    # round k every surviving partial sum is shared by a 2^(k+1)-group
+    for k in range(rounds):
+        half = buf.shape[0] // 2
+        bit = (idx >> k) & 1
+        lo, hi = buf[:half], buf[half:]
+        send = jnp.where(bit == 0, hi, lo)
+        keep = jnp.where(bit == 0, lo, hi)
+        recv = lax.ppermute(send, AXIS,
+                            [(i, i ^ (1 << k)) for i in range(n)])
+        buf = keep + recv
+    # doubling mirrors the halving exactly, so the concat order per bit
+    # reassembles the original layout
+    for k in reversed(range(rounds)):
+        recv = lax.ppermute(buf, AXIS,
+                            [(i, i ^ (1 << k)) for i in range(n)])
+        bit = (idx >> k) & 1
+        buf = jnp.where(bit == 0,
+                        jnp.concatenate([buf, recv]),
+                        jnp.concatenate([recv, buf]))
+    if pad:
+        buf = buf[:m]
+    return buf.reshape(v.shape)
 
 
 def _engine_route(kind: str, tensor, **fields):
@@ -267,7 +376,7 @@ def _mp_ragged_alltoall(rows: Sequence, splits: Sequence[Sequence[int]],
 
 @functools.lru_cache(maxsize=512)
 def _allreduce_fn(mesh: Mesh, op: ReduceOp, dtype_name: str, has_scale: bool,
-                  has_mask: bool = False):
+                  has_mask: bool = False, algo: str = "direct"):
     n = mesh.devices.size
 
     def blk(x, pre, post, mask):
@@ -283,7 +392,15 @@ def _allreduce_fn(mesh: Mesh, op: ReduceOp, dtype_name: str, has_scale: bool,
         if has_scale:
             x = x * pre.astype(x.dtype)
         if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
-            r = lax.psum(x, AXIS)
+            # algorithm plane (ops/algo.py): same sum, different
+            # schedule — ring decomposition or halving/doubling instead
+            # of the single fused psum when the resolver picked them
+            if algo == "rs_ag":
+                r = _rs_ag_sum(x, n)
+            elif algo == "rhd":
+                r = _rhd_sum(x, n)
+            else:
+                r = lax.psum(x, AXIS)
             if op == ReduceOp.AVERAGE:
                 if _is_float(r.dtype):
                     r = r / n
@@ -316,7 +433,8 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
               prescale_factor: float = 1.0,
               postscale_factor: float = 1.0,
               name: Optional[str] = None,
-              wire: Optional[str] = None) -> Array:
+              wire: Optional[str] = None,
+              algo: Optional[str] = None) -> Array:
     """Reduce row-wise across ranks; every rank receives the result.
 
     reference semantics: hvd.allreduce (horovod/torch/mpi_ops.py:157;
@@ -326,11 +444,19 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     path: None (default) follows HOROVOD_COMPRESSION; the engine passes
     an explicit value so a payload it already compressed — or one whose
     caller opted out — is never lossy-compressed a second time.
+
+    `algo` forces one transport algorithm (ops/algo.py ALGORITHMS);
+    None resolves per bucket from round-synchronized config — explicit
+    HOROVOD_COLLECTIVE_ALGO, legacy hierarchical/torus toggles, the
+    autotuner's learned per-regime choices, then the alpha-beta cost
+    model. Resolution happens HERE, at execution time, so a tuner flip
+    mid-flight can never make two ranks run different algorithms for
+    the same bucket (the PR 1 wire-format discipline).
     """
     ps, mesh, n = _resolve(process_set)
     routed = _engine_route("allreduce", x, op=op, name=name, process_set=ps,
                            prescale_factor=prescale_factor,
-                           postscale_factor=postscale_factor)
+                           postscale_factor=postscale_factor, algo=algo)
     if routed is not None:
         return routed
     if op == ReduceOp.ADASUM:
@@ -358,16 +484,45 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
         raise ValueError(
             f"allreduce({op}) is not supported with Join (zero-filled "
             "rows would corrupt min/max/product)")
-    # Topology-aware path (HOROVOD_HIERARCHICAL_ALLREDUCE /
-    # HOROVOD_TORUS_ALLREDUCE, operations.cc:548-606): two-level
-    # local-RS / cross-AR / local-AG over the (cross, local) mesh.
+    # Topology-aware algorithm plane (ops/algo.py): resolve the
+    # transport schedule per bucket from round-synchronized config +
+    # bucket properties — everything here is rank-invariant. The legacy
+    # HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE toggles
+    # (operations.cc:548-606) resolve to the "two_level" strategy.
     cfg = basics.get_config()
-    if (cfg.hierarchical_allreduce or cfg.torus_allreduce) and \
-            ps.process_set_id == 0 and not has_scale and mask is None and \
-            op in (ReduceOp.SUM, ReduceOp.AVERAGE):
-        from .cross import two_level_allreduce
-        hier = basics.get_hier_mesh()
-        if hier.devices.size == n and hier.devices.shape[1] > 1:
+    resolved = "direct"
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        if algo:
+            raise ValueError(
+                f"allreduce(algo={algo!r}) applies to Sum/Average only "
+                f"(op {op} has a single schedule); omit algo")
+    else:
+        from ..core.mesh import mesh_is_multiprocess
+        nbytes = (x.size // max(n, 1)) * x.dtype.itemsize
+        # two_level additionally needs the global set, no scale/mask and
+        # a 2-D hierarchical mesh (legality is part of the bucket's
+        # identity, so the fallback is rank-invariant too). cross==1 is
+        # admitted here — the legacy forced-toggle contract; the
+        # auto-selector itself requires a real cross axis.
+        hier = None
+        hier_ok = ps.process_set_id == 0 and not has_scale and mask is None
+        if hier_ok:
+            hier = basics.get_hier_mesh()
+            if hier is None or not algo_mod.hier_legal(
+                    n, tuple(hier.devices.shape), require_cross=False):
+                hier, hier_ok = None, False
+        dcn = mesh_is_multiprocess(mesh)
+        resolved = algo_mod.resolve(
+            cfg, nbytes, n, requested=algo, hier_ok=hier_ok,
+            hier_shape=tuple(hier.devices.shape) if hier is not None
+            else None, dcn=dcn)
+        # regime-keyed so per-regime tuner choices (small rhd / large
+        # rs_ag, alternating every step) stay silent in steady state
+        regime = "small" if nbytes < algo_mod.threshold_bytes(
+            cfg, n, dcn=dcn) else "large"
+        _note_algo("allreduce", resolved, nbytes, regime)
+        if resolved == "two_level":
+            from .cross import two_level_allreduce
             # precision-aware hierarchy: when a wire format is configured
             # (or the engine passed one explicitly), the expensive CROSS
             # (DCN) hop compresses while ICI stays exact — this is where
@@ -379,7 +534,7 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
                 x, op, hier, wire=hop,
                 block_size=cfg.compression_block_size)
     f = _allreduce_fn(mesh, op, str(x.dtype), has_scale,
-                      has_mask=mask is not None)
+                      has_mask=mask is not None, algo=resolved)
     pre = jnp.asarray(prescale_factor, jnp.float32)
     post = jnp.asarray(postscale_factor, jnp.float32)
     if mask is None:
@@ -419,6 +574,249 @@ def quantized_allreduce(q: Array, scales: Array, average: bool,
     return _quantized_allreduce_fn(mesh, average)(
         _place_stacked(q, mesh, n, "quantized_allreduce"),
         _place_stacked(scales, mesh, n, "quantized_allreduce"))
+
+
+# --------------------------------------------------------------------------
+# int8 block-scaled transport for the sharded-state collectives
+# (FSDP/EP-style traffic): allgather / reducescatter / alltoall variants
+# whose on-wire tensors are int8 payload + fp32 scale sidecar
+# (optim/compression.py). allgather/alltoall are pure transport (no
+# reduction -> no error feedback needed); reducescatter dequantizes and
+# sums in fp32 like the allreduce path. Non-float payloads pass through
+# the exact uncompressed programs.
+# --------------------------------------------------------------------------
+
+def _account_quant_wire(logical: int, actual: int) -> None:
+    """Wire-byte accounting for the sync quantized collectives, into the
+    same hvd_wire_bytes_total{kind} family the engine claims (shared
+    children — the fleet-wide logical/actual record stays one series)."""
+    for kind, nb in (("logical", logical), ("actual", actual)):
+        c = _wire_counters.get(kind)
+        if c is None:
+            from ..obs import metrics as obs_metrics
+            c = obs_metrics.get_registry().counter(
+                "hvd_wire_bytes_total", WIRE_BYTES_HELP, {"kind": kind})
+            _wire_counters[kind] = c
+        c.inc(nb)
+
+
+def _dcn_only_hier(ps: ProcessSet, n: int):
+    """The (cross, local) mesh the DCN-only quantized variants compress
+    over, or None when there is no real hierarchy (both axes > 1) — in
+    which case DCN-only mode means no compression at all, matching the
+    HOROVOD_COMPRESSION_DCN_ONLY contract for allreduce."""
+    if ps.process_set_id != 0:
+        return None
+    hier = basics.get_hier_mesh()
+    if hier is None or not algo_mod.hier_legal(
+            n, tuple(hier.devices.shape)):
+        return None
+    return hier
+
+
+@functools.lru_cache(maxsize=256)
+def _quantized_allgather_fn(mesh: Mesh, block_size: int):
+    n = mesh.devices.size
+
+    def blk(x):                      # [1, d0, ...]
+        v = x[0]
+        flat = v.reshape(-1)
+        q, s = block_quantize(flat, block_size)
+        gq = lax.all_gather(q, AXIS)              # [n, nb, bs] on the wire
+        gs = lax.all_gather(s, AXIS)              # [n, nb]
+        out = block_dequantize(gq, gs, flat.shape[0])        # [n, elems]
+        out = out.reshape((n,) + v.shape).astype(x.dtype)
+        return out.reshape((1, n * v.shape[0]) + v.shape[1:])
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS)))
+
+
+@_timeline_span
+def quantized_allgather(x: Array, *,
+                        process_set: Optional[ProcessSet] = None,
+                        block_size: Optional[int] = None,
+                        name: Optional[str] = None) -> Array:
+    """`allgather` whose wire tensors are int8 blocks + fp32 scales —
+    pure transport, so the only error is each rank's own quantization
+    noise on its row (no error feedback needed). Stacked [n, d0, ...] ->
+    stacked [n, n*d0, ...]. Under HOROVOD_COMPRESSION_DCN_ONLY the
+    gather runs two-level (ops/cross.py): the local ICI hop stays exact
+    and only the cross/DCN hop carries quantized bytes.
+
+    Multi-process mode routes through the engine like every sync
+    collective (same-order program launch on all ranks); the engine path
+    uses the CONFIG block size, so pass block_size only in
+    single-controller mode."""
+    ps, mesh, n = _resolve(process_set)
+    _reject_joined("Allgather")
+    routed = _engine_route("allgather", x, name=name, process_set=ps,
+                           compression="int8")
+    if routed is not None:
+        return routed
+    x = _place_stacked(x, mesh, n, "quantized_allgather")
+    if x.ndim < 2:
+        raise ValueError("allgather requires tensors of rank >= 1 per rank")
+    if not _is_float(x.dtype):
+        return allgather(x, process_set=ps)
+    cfg = basics.get_config()
+    bs = int(block_size or cfg.compression_block_size)
+    elems = x.size // n
+    logical = n * elems * x.dtype.itemsize
+    if cfg.compression_dcn_only:
+        hier = _dcn_only_hier(ps, n)
+        if hier is None:
+            _account_quant_wire(logical, logical)
+            return allgather(x, process_set=ps)
+        from .cross import two_level_allgather
+        _note_algo("allgather", "two_level_q8", elems * x.dtype.itemsize)
+        # PR 1 convention: DCN-only savings are not claimed by the flat
+        # counters (only the cross hop compresses)
+        _account_quant_wire(logical, logical)
+        return two_level_allgather(x, hier, wire="int8", block_size=bs)
+    _note_algo("allgather", "q8_gather", elems * x.dtype.itemsize)
+    _account_quant_wire(logical, n * wire_bytes(elems, "int8", bs))
+    return _quantized_allgather_fn(mesh, bs)(x)
+
+
+@functools.lru_cache(maxsize=256)
+def _quantized_reducescatter_fn(mesh: Mesh, average: bool, block_size: int,
+                                dtype_name: str):
+    n = mesh.devices.size
+
+    def blk(x):                      # [1, d0, ...], n | d0
+        v = x[0]
+        flat = v.reshape(-1)
+        # dequantize-then-sum in fp32, the allreduce-path discipline:
+        # int8 payload + scales are the only tensors inside the gathers
+        full = allgather_block_sum(*block_quantize(flat, block_size),
+                                   AXIS, flat.shape[0])
+        if average:
+            full = full / n
+        full = full.reshape(v.shape).astype(dtype_name)
+        i = lax.axis_index(AXIS)
+        chunk = v.shape[0] // n
+        return lax.dynamic_slice_in_dim(full, i * chunk, chunk,
+                                        axis=0)[None]
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS)))
+
+
+@_timeline_span
+def quantized_reducescatter(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
+                            process_set: Optional[ProcessSet] = None,
+                            block_size: Optional[int] = None,
+                            name: Optional[str] = None) -> Array:
+    """`reducescatter` over the int8 block-scaled wire: every rank's row
+    travels quantized, dequantization and the fp32 sum run after
+    transport (per-rank scales make a direct int8 reduction
+    meaningless), then each rank keeps its chunk. Sum/Average only.
+    Ragged first dims fall back to the exact path (chunk negotiation
+    happens above this layer). Multi-process mode routes through the
+    engine (config block size applies there)."""
+    ps, mesh, n = _resolve(process_set)
+    _reject_joined("Reducescatter")
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            "quantized reducescatter supports Sum/Average only (per-rank "
+            "scales make other reductions meaningless on int8 payload)")
+    routed = _engine_route("reducescatter", x, op=op, name=name,
+                           process_set=ps, compression="int8")
+    if routed is not None:
+        return routed
+    x = _place_stacked(x, mesh, n, "quantized_reducescatter")
+    if x.ndim < 2:
+        raise ValueError("reducescatter requires tensors of rank >= 1")
+    if not _is_float(x.dtype) or x.shape[1] % n != 0:
+        return reducescatter(x, op, process_set=ps)
+    cfg = basics.get_config()
+    bs = int(block_size or cfg.compression_block_size)
+    elems = x.size // n
+    logical = n * elems * x.dtype.itemsize
+    if cfg.compression_dcn_only:
+        hier = _dcn_only_hier(ps, n)
+        if hier is None:
+            _account_quant_wire(logical, logical)
+            return reducescatter(x, op, process_set=ps)
+        from .cross import two_level_reducescatter
+        _note_algo("reducescatter", "two_level_q8",
+                   elems * x.dtype.itemsize)
+        _account_quant_wire(logical, logical)
+        return two_level_reducescatter(x, op, hier, wire="int8",
+                                       block_size=bs)
+    _note_algo("reducescatter", "q8_gather", elems * x.dtype.itemsize)
+    _account_quant_wire(logical, n * wire_bytes(elems, "int8", bs))
+    return _quantized_reducescatter_fn(
+        mesh, op == ReduceOp.AVERAGE, bs, str(x.dtype))(x)
+
+
+@functools.lru_cache(maxsize=256)
+def _quantized_alltoall_fn(mesh: Mesh, block_size: int):
+    n = mesh.devices.size
+
+    def blk(x):                      # [1, m, ...], n | m
+        v = x[0]
+        # quantize PER destination chunk so no scale block straddles a
+        # chunk boundary — each receiver dequantizes exactly the blocks
+        # addressed to it
+        per = v.reshape(n, -1)                    # [n, chunk_elems]
+        q, s = block_quantize(per, block_size)    # [n, nb, bs], [n, nb]
+        tq = lax.all_to_all(q, AXIS, split_axis=0, concat_axis=0,
+                            tiled=True)
+        ts = lax.all_to_all(s, AXIS, split_axis=0, concat_axis=0,
+                            tiled=True)
+        out = block_dequantize(tq, ts, per.shape[1])      # [n, chunk]
+        return out.reshape(v.shape).astype(x.dtype)[None]
+
+    return jax.jit(shard_map(blk, mesh=mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS)))
+
+
+@_timeline_span
+def quantized_alltoall(x: Array, *,
+                       process_set: Optional[ProcessSet] = None,
+                       block_size: Optional[int] = None,
+                       name: Optional[str] = None) -> Array:
+    """Equal-split `alltoall` over the int8 block-scaled wire (pure
+    transport, quantized per destination chunk). Stacked [n, m, ...]
+    with n | m, same contract as the exact op; non-divisible m raises —
+    use `alltoall(splits=...)` (exact) for ragged sends. DCN-only mode
+    sends exact bytes (alltoall has no hierarchical decomposition to
+    isolate the DCN hop — documented in docs/benchmarks.md).
+    Multi-process mode routes through the engine (config block size
+    applies there)."""
+    ps, mesh, n = _resolve(process_set)
+    _reject_joined("Alltoall")
+    # validate BEFORE the engine route: the contract (non-divisible
+    # raises) must hold identically in single-controller and MP mode —
+    # the engine would otherwise silently fall back to exact transport
+    shape = np.shape(x)
+    if len(shape) < 2 or shape[1] % n != 0:
+        raise ValueError(
+            f"quantized alltoall needs dim1 divisible by set size {n}; "
+            f"got {tuple(shape)}; use alltoall(splits=...) otherwise")
+    routed = _engine_route("alltoall", x, name=name, process_set=ps,
+                           compression="int8")
+    if routed is not None:
+        return routed
+    x = _place_stacked(x, mesh, n, "quantized_alltoall")
+    cfg = basics.get_config()
+    if not _is_float(x.dtype):
+        return alltoall(x, process_set=ps)
+    elems = x.size // n
+    logical = n * elems * x.dtype.itemsize
+    if cfg.compression_dcn_only:
+        # no hierarchical decomposition for alltoall: DCN-only mode
+        # sends exact bytes, but the traffic still shows in the record
+        _account_quant_wire(logical, logical)
+        return alltoall(x, process_set=ps)
+    bs = int(block_size or cfg.compression_block_size)
+    chunk_elems = elems // n
+    _note_algo("alltoall", "q8_alltoall", elems * x.dtype.itemsize)
+    _account_quant_wire(logical,
+                        n * n * wire_bytes(chunk_elems, "int8", bs))
+    return _quantized_alltoall_fn(mesh, bs)(x)
 
 
 @functools.lru_cache(maxsize=512)

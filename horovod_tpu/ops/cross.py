@@ -28,7 +28,22 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.mesh import CROSS_AXIS, LOCAL_AXIS
 from ..core.types import ReduceOp
-from ..optim.compression import allgather_block_sum, block_quantize
+from ..optim.compression import (allgather_block_sum, block_dequantize,
+                                 block_quantize)
+
+
+def _check_two_level_mesh(mesh: Mesh, what: str) -> None:
+    """Fail fast on a malformed mesh: the two-level programs require the
+    2-D (cross, local) factorization from core.mesh.build_hierarchical_mesh
+    — anything else used to surface as an opaque unpack error at
+    `cross, local = mesh.devices.shape`."""
+    shape = tuple(getattr(mesh.devices, "shape", ()))
+    names = tuple(getattr(mesh, "axis_names", ()))
+    if len(shape) != 2 or names != (CROSS_AXIS, LOCAL_AXIS):
+        raise ValueError(
+            f"{what} requires a 2-D ({CROSS_AXIS}, {LOCAL_AXIS}) mesh "
+            f"(core.mesh.build_hierarchical_mesh); got axes {names} with "
+            f"device shape {shape}")
 
 
 @functools.lru_cache(maxsize=256)
@@ -95,6 +110,7 @@ def two_level_allreduce(x: jax.Array, op: ReduceOp, mesh: Mesh, *,
         raise ValueError(
             "two-level allreduce supports Sum/Average only "
             "(reference hierarchical path is likewise sum-based)")
+    _check_two_level_mesh(mesh, "two_level_allreduce")
     if wire != "none" and not jnp.issubdtype(
             jnp.asarray(x).dtype, jnp.floating):
         wire = "none"                     # non-float payloads pass through
@@ -102,15 +118,30 @@ def two_level_allreduce(x: jax.Array, op: ReduceOp, mesh: Mesh, *,
 
 
 @functools.lru_cache(maxsize=256)
-def _two_level_allgather_fn(mesh: Mesh):
+def _two_level_allgather_fn(mesh: Mesh, wire: str = "none",
+                            block_size: int = 128):
     cross, local = mesh.devices.shape
     n = cross * local
 
     def blk(x):                           # [1, d0, ...] per-device row
-        # phase 1: allgather within the local (ICI) group
+        # phase 1: allgather within the local (ICI) group — always exact
         g = lax.all_gather(x[0], LOCAL_AXIS)          # [local, d0, ...]
-        # phase 2: allgather the local blocks across the cross (DCN) axis
-        g = lax.all_gather(g, CROSS_AXIS)             # [cross, local, d0, ...]
+        # phase 2: allgather the local blocks across the cross (DCN)
+        # axis. With wire="int8" the DCN bytes are the quantized block
+        # payload + fp32 scale sidecar (compression_dcn_only semantics:
+        # compress where bytes are expensive, keep ICI exact).
+        if wire == "int8":
+            flat = g.reshape(-1)
+            q, s = block_quantize(flat, block_size)
+            gq = lax.all_gather(q, CROSS_AXIS)        # wire tensors
+            gs = lax.all_gather(s, CROSS_AXIS)
+            g = block_dequantize(gq, gs, flat.shape[0]).reshape(
+                (cross,) + g.shape).astype(x.dtype)
+        elif wire == "bf16":
+            g = lax.all_gather(g.astype(jnp.bfloat16),
+                               CROSS_AXIS).astype(x.dtype)
+        else:
+            g = lax.all_gather(g, CROSS_AXIS)     # [cross, local, d0, ...]
         # (cross, local) row-major is exactly global rank order
         # (build_hierarchical_mesh reshapes the global device list row-major)
         out = g.reshape((1, n * g.shape[2]) + g.shape[3:])
@@ -122,13 +153,91 @@ def _two_level_allgather_fn(mesh: Mesh):
     return jax.jit(f)
 
 
-def two_level_allgather(x: jax.Array, mesh: Mesh) -> jax.Array:
+def two_level_allgather(x: jax.Array, mesh: Mesh, *, wire: str = "none",
+                        block_size: int = 128) -> jax.Array:
     """Stacked [n, d0, ...] -> [n, n*d0, ...] via local-AG then cross-AG.
 
     TPU re-design of MPIHierarchicalAllgather
     (horovod/common/ops/mpi_operations.cc MPIHierarchicalAllgather): gather
     within the node over shared memory first, then exchange whole node-blocks
     across nodes. Here phase 1 rides the ICI local axis and phase 2 the
-    cross/DCN axis, each a native XLA all_gather.
+    cross/DCN axis, each a native XLA all_gather. `wire` selects the
+    CROSS-hop transport format ("none" | "bf16" | "int8") — the
+    DCN-only compression home for sharded-state allgather traffic.
     """
-    return _two_level_allgather_fn(mesh)(x)
+    _check_two_level_mesh(mesh, "two_level_allgather")
+    if wire != "none" and not jnp.issubdtype(
+            jnp.asarray(x).dtype, jnp.floating):
+        wire = "none"                     # non-float payloads pass through
+    return _two_level_allgather_fn(mesh, wire, block_size)(x)
+
+
+@functools.lru_cache(maxsize=256)
+def _two_level_reducescatter_fn(mesh: Mesh, average: bool,
+                                wire: str = "none", block_size: int = 128):
+    cross, local = mesh.devices.shape
+    n = cross * local
+
+    def blk(x):                           # [1, d0, ...], n | d0
+        v = x[0]
+        d0 = v.shape[0]
+        cs = d0 // n
+        # chunk permutation: the local-first scatter order hands rank
+        # (c, l) the chunk at position l*cross + c, but global rank order
+        # is c*local + l — pre-transpose the (cross, local) chunk grid so
+        # every rank ends up owning exactly its own chunk
+        perm = v.reshape((cross, local, cs) + v.shape[1:]) \
+                .swapaxes(0, 1).reshape(v.shape)
+        # phase 1: reduce-scatter across the local (ICI) axis — exact
+        piece = lax.psum_scatter(perm, LOCAL_AXIS, scatter_dimension=0,
+                                 tiled=True)          # [d0/local, ...]
+        # phase 2: reduce-scatter across the cross (DCN) axis — the
+        # expensive hop, so it is the one the wire format compresses
+        if wire == "int8":
+            flat = piece.reshape(-1)
+            full = allgather_block_sum(*block_quantize(flat, block_size),
+                                       CROSS_AXIS, flat.shape[0])
+            full = full.reshape(piece.shape).astype(v.dtype)
+            c = lax.axis_index(CROSS_AXIS)
+            r = lax.dynamic_slice_in_dim(full, c * cs, cs, axis=0)
+        elif wire == "bf16":
+            r = lax.psum_scatter(piece.astype(jnp.bfloat16), CROSS_AXIS,
+                                 scatter_dimension=0,
+                                 tiled=True).astype(v.dtype)
+        else:
+            r = lax.psum_scatter(piece, CROSS_AXIS, scatter_dimension=0,
+                                 tiled=True)          # [cs, ...]
+        if average:
+            r = r / n if jnp.issubdtype(r.dtype, jnp.floating) \
+                else (r // n).astype(r.dtype)
+        return r[None]
+
+    f = jax.shard_map(blk, mesh=mesh,
+                      in_specs=P((CROSS_AXIS, LOCAL_AXIS)),
+                      out_specs=P((CROSS_AXIS, LOCAL_AXIS)))
+    return jax.jit(f)
+
+
+def two_level_reducescatter(x: jax.Array, op: ReduceOp, mesh: Mesh, *,
+                            wire: str = "none",
+                            block_size: int = 128) -> jax.Array:
+    """Stacked [n, d0, ...] (n | d0) reduce-scatter via local-RS then
+    cross-RS over the (cross, local) mesh: DCN traffic is 1/local of the
+    flat schedule, and with `wire` the cross hop additionally travels
+    bf16 or block-scaled int8 (dequantize-then-sum, the allreduce-path
+    discipline). Rank g ends up owning chunk g, the same contract as the
+    flat reducescatter."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            "two-level reducescatter supports Sum/Average only")
+    _check_two_level_mesh(mesh, "two_level_reducescatter")
+    n = mesh.devices.size
+    if jnp.asarray(x).ndim < 2 or x.shape[1] % n != 0:
+        raise ValueError(
+            f"two-level reducescatter needs dim1 divisible by world "
+            f"size {n}; got {tuple(jnp.asarray(x).shape)}")
+    if wire != "none" and not jnp.issubdtype(
+            jnp.asarray(x).dtype, jnp.floating):
+        wire = "none"                     # non-float payloads pass through
+    return _two_level_reducescatter_fn(
+        mesh, op == ReduceOp.AVERAGE, wire, block_size)(x)

@@ -124,6 +124,11 @@ class _Work:
     # config default (HOROVOD_COMPRESSION / autotune) at execution time.
     # Part of the fusion signature so buckets stay homogeneous.
     wire: str = ""
+    # explicit per-call allreduce algorithm (ops/algo.py ALGORITHMS); ""
+    # defers to the round-synchronized config/tuner resolution at
+    # execution time. Like `wire`, an explicit value is program identity
+    # (part of the fusion signature + cross-rank meta).
+    algo: str = ""
     # negotiation-derived cross-rank info for ragged ops (per-rank sizes /
     # the full splits table) — the reference's controller response payload
     # (tensor_sizes, mpi_controller.cc:239)
@@ -210,12 +215,13 @@ def _next_group_id() -> int:
 
 
 def _fusion_key(w: _Work) -> Tuple:
-    """Fusable iff same op kind/dtype/set/scale/wire (FuseResponses rules,
-    controller.cc:901-1000; wire format added so a quantized bucket never
-    mixes with a full-precision one)."""
+    """Fusable iff same op kind/dtype/set/scale/wire/algo (FuseResponses
+    rules, controller.cc:901-1000; wire format and explicit algorithm
+    added so a quantized or algorithm-pinned bucket never mixes with a
+    default one)."""
     dt = str(jnp.asarray(w.tensor).dtype)
     return (w.request_type, w.op, dt, w.process_set.process_set_id,
-            w.prescale, w.postscale, w.wire)
+            w.prescale, w.postscale, w.wire, w.algo)
 
 
 class Engine:
@@ -276,16 +282,21 @@ class Engine:
                     "hvd_negotiation_rounds_total",
                     "hvd_fusion_bucket_tensors", "hvd_fusion_bucket_bytes",
                     "hvd_cache_requests_total", "hvd_cache_hits_total",
-                    "hvd_stall_warnings_total"):
+                    "hvd_stall_warnings_total",
+                    "hvd_collective_algo_total"):
             R.unregister(fam)
+        # algorithm-plane module state follows the engine lifecycle: the
+        # selection counters and last-algo record (ALGO timeline row)
+        # count fresh per engine, like every family claimed above
+        collective_ops._algo_last.clear()
+        collective_ops._algo_counters.clear()
+        collective_ops._wire_counters.clear()
         # wire-byte accounting: logical = payload in its original dtype,
         # actual = what the configured wire format puts on the
         # interconnect (int8 payload + scale sidecar for "int8")
         self._m_wire = {
             k: R.counter("hvd_wire_bytes_total",
-                         "collective payload bytes: logical (native "
-                         "dtype) vs actual (configured wire format)",
-                         {"kind": k})
+                         collective_ops.WIRE_BYTES_HELP, {"kind": k})
             for k in ("logical", "actual")}
         self._m_cycles = R.counter(
             "hvd_engine_cycles_total", "dispatch cycles that executed work")
@@ -350,6 +361,26 @@ class Engine:
         self.tuner = None
         if cfg.autotune:
             from ..autotune.tuner import ParameterManager
+            from . import algo as algo_mod
+            # categorical algorithm dims sample only the strategies this
+            # deployment can actually run: rhd needs a power-of-two
+            # world, two_level a real (cross>1, local>1) hierarchy —
+            # sampling a structurally-inert choice would just waste GP
+            # samples on a point that measures like its fallback
+            world = state.mesh.devices.size if state.mesh is not None \
+                else 1
+            hier = state.hier_mesh
+            choices = algo_mod.runnable_algorithms(
+                world, tuple(hier.devices.shape) if hier is not None
+                else None)
+            # explicit HOROVOD_COLLECTIVE_ALGO (or the legacy forced
+            # two-level toggles) freezes the algorithm plane against
+            # autotuning, the HOROVOD_COMPRESSION contract
+            tune_algo = not (cfg.collective_algo_set or
+                             cfg.torus_allreduce or
+                             cfg.hierarchical_allreduce or
+                             cfg.hierarchical_allreduce_set) \
+                and len(choices) > 1 and world > 1
             self.tuner = ParameterManager(
                 warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
@@ -357,17 +388,22 @@ class Engine:
                 log_path=cfg.autotune_log,
                 gp_noise=cfg.autotune_gaussian_process_noise,
                 # torus already forces the two-level path (knob inert),
-                # and an explicit HOROVOD_HIERARCHICAL_ALLREDUCE setting
+                # an explicit HOROVOD_HIERARCHICAL_ALLREDUCE setting
                 # (either value) must not be overwritten by sampled
-                # values — freeze in both cases (reference
-                # --no-hierarchical-allreduce contract)
-                tune_two_level=not (cfg.torus_allreduce or
+                # values, and the per-regime algo dims subsume the
+                # two-level toggle when they are live (two_level is one
+                # of their choices — two knobs steering one path would
+                # give the GP a confounded measurement)
+                tune_two_level=not (tune_algo or
+                                    cfg.torus_allreduce or
                                     cfg.hierarchical_allreduce or
                                     cfg.hierarchical_allreduce_set),
                 # an explicit HOROVOD_COMPRESSION setting freezes the wire
                 # format against autotuning (same contract as the
                 # hierarchical knob)
-                tune_compression=not cfg.compression_set)
+                tune_compression=not cfg.compression_set,
+                tune_algo=tune_algo,
+                algo_choices=tuple(choices))
 
     # -- wire-byte back-compat views (the counters now live in the
     # obs registry; these read them so `engine.wire_bytes_logical`
@@ -722,6 +758,15 @@ class Engine:
                 if self.tuner.tune_compression:
                     self._state.config.compression = \
                         self.tuner.compression_wire
+                if self.tuner.tune_algo:
+                    # per-regime algorithm choices: collective_ops
+                    # resolves small/large buckets against these at
+                    # execution time (round-synchronized below, so all
+                    # ranks flip together)
+                    self._state.config.collective_algo_small = \
+                        self.tuner.algo_small
+                    self._state.config.collective_algo_large = \
+                        self.tuner.algo_large
 
     @staticmethod
     def _work_meta(w: _Work) -> dict:
@@ -755,6 +800,11 @@ class Engine:
             # tuner flipping the knob between enqueues on different ranks
             # cannot produce a spurious meta mismatch
             m["cwf"] = w.wire
+        if w.algo:
+            # same contract for an explicit per-call algorithm; the
+            # config/tuner-resolved algorithm rides the round payload
+            # ("alg"), never the meta
+            m["calg"] = w.algo
         if w.splits is not None:
             m["sp"] = [[int(v) for v in row] for row in w.splits]
             m["rag"] = True
@@ -771,8 +821,9 @@ class Engine:
             sh = m["sh"]
             trails = sorted({tuple(s[1:]) for s in sh}) if sh else []
             return ("rag", trails, m["dt"], m["t"], m["op"],
-                    m.get("cwf", ""))
-        return (m["sh"], m["dt"], m["t"], m["op"], m.get("cwf", ""))
+                    m.get("cwf", ""), m.get("calg", ""))
+        return (m["sh"], m["dt"], m["t"], m["op"], m.get("cwf", ""),
+                m.get("calg", ""))
 
     def _negotiate(self, coord, batch: List[_Work]
                    ) -> Tuple[List[_Work], List[_Work]]:
@@ -822,7 +873,15 @@ class Engine:
                    # wire format must agree process-wide: a bucket whose
                    # peers disagree on compression would launch different
                    # XLA programs
-                   "cw": self._state.config.compression}
+                   "cw": self._state.config.compression,
+                   # collective-algorithm plane: the forced algorithm and
+                   # the tuner's per-regime choices travel with the round
+                   # so every rank resolves the SAME algorithm for the
+                   # same bucket at execution time — a tuner flip between
+                   # two ranks' enqueues can never diverge programs
+                   "alg": [self._state.config.collective_algo,
+                           self._state.config.collective_algo_small,
+                           self._state.config.collective_algo_large]}
         # Block until every process reaches this round. A slow peer (long
         # compile / data stall) is NOT an error — the reference waits
         # indefinitely with stall-inspector warnings (stall_inspector.cc);
@@ -904,6 +963,11 @@ class Engine:
             "tl", self._state.config.hierarchical_allreduce)
         self._state.config.compression = peers[0].get(
             "cw", self._state.config.compression)
+        alg = peers[0].get("alg")
+        if alg:
+            (self._state.config.collective_algo,
+             self._state.config.collective_algo_small,
+             self._state.config.collective_algo_large) = alg
         # two phases so a replay failure can never leave full metas
         # uncached, and _last_sent_sig only advances on a fully
         # processed round — a failed round therefore falls back to a
@@ -1109,7 +1173,8 @@ class Engine:
                       zero, ps.mesh, ps.size(), "allreduce"),
                   ReduceOp(meta["op"]), ps, Handle(meta["n"]),
                   root_rank=meta["root"], prescale=meta["pre"],
-                  postscale=meta["post"], wire=meta.get("cwf", ""))
+                  postscale=meta["post"], wire=meta.get("cwf", ""),
+                  algo=meta.get("calg", ""))
         return w
 
     def _bucketize(self, batch: List[_Work]) -> List[List[_Work]]:
@@ -1188,7 +1253,8 @@ class Engine:
                             w.tensor, w.op, process_set=w.process_set,
                             prescale_factor=w.prescale,
                             postscale_factor=w.postscale,
-                            wire=self._cross_wire(bucket))]
+                            wire=self._cross_wire(bucket),
+                            algo=w.algo or None)]
                 else:
                     results = self._execute_fused_allreduce(bucket)
             status = Status.ok()
@@ -1259,10 +1325,18 @@ class Engine:
     def _bucket_wire(self, bucket: List[_Work]) -> str:
         """Wire format the ENGINE applies to a bucket's transport; DCN-only
         mode defers compression to the hierarchical cross hop instead
-        (_cross_wire / ops/cross.py)."""
+        (_cross_wire / ops/cross.py). An explicit per-call algorithm
+        opts the bucket out of a CONFIG-driven int8 wire: the gather
+        transport has no schedule choice, so honoring the caller's
+        schedule wins (explicit algo + explicit int8 together are
+        rejected at enqueue). Rank-invariant: algo rides the fusion
+        key/meta, so every rank decides identically."""
         if self._state.config.compression_dcn_only:
             return "none"
-        return self._wire_eligible(bucket)
+        wire = self._wire_eligible(bucket)
+        if wire == "int8" and bucket[0].algo and not bucket[0].wire:
+            return "none"
+        return wire
 
     def _cross_wire(self, bucket: List[_Work]) -> str:
         """Wire format for the hierarchical CROSS (DCN) hop when the engine
@@ -1325,7 +1399,51 @@ class Engine:
             d["hits"] += cnt - 1
         return out
 
+    def _single_quant_eligible(self, w: _Work) -> bool:
+        """True when a non-allreduce single should ride the int8
+        block-scaled transport (quantized_allgather / _reducescatter /
+        _alltoall): the ROUND-SYNCHRONIZED config asks for int8, the
+        payload is a uniform float stacked array, and no rank has
+        joined. All inputs are rank-invariant, so every process routes
+        the same way — the sharded-state (FSDP/EP) traffic finally gets
+        the same wire savings as the gradient allreduce. A per-call
+        request (w.wire, from the async APIs' `compression=` or the
+        quantized_* entry points) beats the config default, so callers
+        can force int8 on or opt a bit-exact payload out."""
+        if (w.wire or self._state.config.compression) != "int8":
+            return False
+        if getattr(self._state, "joined_ranks", None):
+            return False
+        if w.request_type not in (RequestType.ALLGATHER,
+                                  RequestType.REDUCESCATTER,
+                                  RequestType.ALLTOALL):
+            return False
+        if isinstance(w.tensor, (list, tuple)) or w.splits is not None \
+                or w.negotiated is not None:
+            return False                    # ragged: exact path
+        t = jnp.asarray(w.tensor)
+        if t.ndim < 2 or not jnp.issubdtype(t.dtype, jnp.floating):
+            return False
+        n = w.process_set.size()
+        if w.request_type == RequestType.REDUCESCATTER:
+            return t.shape[1] % n == 0 and \
+                w.op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+        if w.request_type == RequestType.ALLTOALL:
+            return t.shape[1] % n == 0
+        return True
+
     def _execute_single(self, w: _Work):
+        if self._single_quant_eligible(w):
+            # wire accounting + algo note happen inside the quantized
+            # ops (they know whether DCN-only rerouted or fell back)
+            if w.request_type == RequestType.ALLGATHER:
+                return collective_ops.quantized_allgather(
+                    w.tensor, process_set=w.process_set)
+            if w.request_type == RequestType.REDUCESCATTER:
+                return collective_ops.quantized_reducescatter(
+                    w.tensor, w.op, process_set=w.process_set)
+            return collective_ops.quantized_alltoall(
+                w.tensor, process_set=w.process_set)
         self._account_wire_plain(w)
         if w.request_type == RequestType.ALLGATHER:
             if isinstance(w.tensor, (list, tuple)) and \
@@ -1350,7 +1468,7 @@ class Engine:
             return collective_ops.allreduce(
                 w.tensor, w.op, process_set=w.process_set,
                 prescale_factor=w.prescale, postscale_factor=w.postscale,
-                wire=self._cross_wire([w]))
+                wire=self._cross_wire([w]), algo=w.algo or None)
         raise ValueError(f"Unknown request type {w.request_type}")
 
     def _execute_fused_allreduce(self, bucket: List[_Work]):
@@ -1408,6 +1526,7 @@ class Engine:
                 flat = flat * jnp.asarray(w0.prescale, flat.dtype)
             fused = collective_ops.allreduce(
                 flat.astype(jnp.bfloat16), w0.op, wire="none",
+                algo=w0.algo or None,
                 process_set=w0.process_set).astype(tensors[0].dtype)
             if w0.postscale != 1.0:
                 fused = fused * jnp.asarray(w0.postscale, fused.dtype)
@@ -1415,7 +1534,7 @@ class Engine:
             fused = collective_ops.allreduce(
                 flat, w0.op, process_set=w0.process_set,
                 prescale_factor=w0.prescale, postscale_factor=w0.postscale,
-                wire=self._cross_wire(bucket))
+                wire=self._cross_wire(bucket), algo=w0.algo or None)
         return _unpack_fn(n, shapes)(fused) if repeated \
             else _unpack_impl(fused, n, shapes)
 
@@ -1540,26 +1659,73 @@ def _resolve_wire(compression) -> str:
     return wire_format_of(compression)
 
 
+def _resolve_transport_wire(compression, what: str) -> str:
+    """Per-call wire for the pure-transport collectives (allgather /
+    reducescatter / alltoall): only the int8 block-scaled format exists
+    for them, so an explicitly requested bf16 is rejected rather than
+    silently dropped (allreduce_async is the bf16 home)."""
+    wire = _resolve_wire(compression)
+    if wire == "bf16":
+        raise ValueError(
+            f"{what} supports compression 'int8'|'none' only (bf16 is an "
+            f"allreduce wire format); got {compression!r}")
+    return wire
+
+
+def _resolve_algo(algo) -> str:
+    """Per-call algorithm request -> _Work.algo: "" (defer to the
+    round-synchronized config/tuner resolution) or a validated
+    ALGORITHMS member."""
+    if algo is None or algo == "":
+        return ""
+    from . import algo as algo_mod
+    a = str(algo).strip().lower()
+    if a not in algo_mod.ALGORITHMS:
+        raise ValueError(
+            f"unknown collective algorithm {algo!r}; expected one of "
+            f"{algo_mod.ALGORITHMS}")
+    return a
+
+
 def allreduce_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
                     name: Optional[str] = None, *,
                     process_set: Optional[ProcessSet] = None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
-                    compression=None) -> Handle:
+                    compression=None, algo=None) -> Handle:
     ps = basics.get_process_set(process_set)
     name = name or _auto_name("allreduce")
+    a = _resolve_algo(algo)
+    if a and op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"allreduce(algo={algo!r}) applies to Sum/Average only "
+            f"(op {op} has a single schedule); omit algo")
+    wire = _resolve_wire(compression)
+    if a and wire == "int8":
+        raise ValueError(
+            f"allreduce(algo={algo!r}, compression='int8') conflict: the "
+            f"int8 wire is gather-based with no schedule choice — pick "
+            f"one (a config-driven int8 default is opted out "
+            f"automatically when algo is explicit)")
     w = _Work(RequestType.ALLREDUCE, name, tensor, op, ps,
               Handle(name), prescale=prescale_factor,
-              postscale=postscale_factor, wire=_resolve_wire(compression))
+              postscale=postscale_factor, wire=wire,
+              algo=a)
     return _engine().enqueue(w)
 
 
 def allgather_async(tensor, name: Optional[str] = None, *,
-                    process_set: Optional[ProcessSet] = None) -> Handle:
+                    process_set: Optional[ProcessSet] = None,
+                    compression=None) -> Handle:
+    """`compression` (wire string or Compressor, like allreduce_async):
+    "int8"/Compression.int8 forces the block-scaled wire for this call,
+    "none" opts a payload out of a config-driven int8 default, None
+    follows the round-synchronized config."""
     ps = basics.get_process_set(process_set)
     name = name or _auto_name("allgather")
     w = _Work(RequestType.ALLGATHER, name, tensor, ReduceOp.SUM, ps,
-              Handle(name))
+              Handle(name),
+              wire=_resolve_transport_wire(compression, "allgather_async"))
     return _engine().enqueue(w)
 
 
@@ -1574,20 +1740,25 @@ def broadcast_async(tensor, root_rank: int = 0,
 
 
 def alltoall_async(tensor, splits=None, name: Optional[str] = None, *,
-                   process_set: Optional[ProcessSet] = None) -> Handle:
+                   process_set: Optional[ProcessSet] = None,
+                   compression=None) -> Handle:
     ps = basics.get_process_set(process_set)
     name = name or _auto_name("alltoall")
     w = _Work(RequestType.ALLTOALL, name, tensor, ReduceOp.SUM, ps,
-              Handle(name), splits=splits)
+              Handle(name), splits=splits,
+              wire=_resolve_transport_wire(compression, "alltoall_async"))
     return _engine().enqueue(w)
 
 
 def reducescatter_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
                         name: Optional[str] = None, *,
-                        process_set: Optional[ProcessSet] = None) -> Handle:
+                        process_set: Optional[ProcessSet] = None,
+                        compression=None) -> Handle:
     ps = basics.get_process_set(process_set)
     name = name or _auto_name("reducescatter")
-    w = _Work(RequestType.REDUCESCATTER, name, tensor, op, ps, Handle(name))
+    w = _Work(RequestType.REDUCESCATTER, name, tensor, op, ps, Handle(name),
+              wire=_resolve_transport_wire(compression,
+                                           "reducescatter_async"))
     return _engine().enqueue(w)
 
 

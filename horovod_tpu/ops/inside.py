@@ -93,6 +93,78 @@ def allgather(x: jax.Array, axis_name: AxisName = GLOBAL_AXIS,
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
+def quantized_allgather(x: jax.Array, axis_name: AxisName = GLOBAL_AXIS, *,
+                        block_size: int = 128) -> jax.Array:
+    """In-graph int8 block-scaled allgather (dim-0 concat): the
+    all_gathers carry int8 payload + fp32 scales — the sharded-state
+    (FSDP param gather) wire — and each rank's row is dequantized after
+    transport. Pure transport: the only error is the sender's own
+    quantization noise, so no error feedback is needed."""
+    from ..optim.compression import block_dequantize, block_quantize
+    shape, dt = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    q, s = block_quantize(flat, block_size)
+    gq = lax.all_gather(q, axis_name)
+    gs = lax.all_gather(s, axis_name)
+    out = block_dequantize(gq, gs, flat.shape[0])     # [n, elems]
+    n = out.shape[0]
+    return out.reshape((n,) + shape).reshape(
+        (n * shape[0],) + shape[1:]).astype(dt)
+
+
+def quantized_reducescatter(x: jax.Array,
+                            op: ReduceOp = ReduceOp.AVERAGE,
+                            axis_name: AxisName = GLOBAL_AXIS, *,
+                            block_size: int = 128) -> jax.Array:
+    """In-graph int8 block-scaled reduce-scatter (dim-0 scatter): rows
+    travel quantized, the sum runs in fp32 after dequantization (the
+    allreduce-path discipline — per-rank scales make a direct int8
+    psum_scatter meaningless), then each rank keeps its own chunk."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            "quantized reducescatter supports Sum/Average only (per-rank "
+            "scales make other reductions meaningless on int8 payload)")
+    from ..optim.compression import allgather_block_sum, block_quantize
+    shape, dt = x.shape, x.dtype
+    n = int(_axis_size(axis_name))     # static under shard_map
+    if shape[0] % n != 0:
+        raise ValueError(
+            f"quantized reducescatter needs dim0 divisible by the axis "
+            f"size {n}; got {shape}")
+    flat = x.reshape(-1).astype(jnp.float32)
+    q, s = block_quantize(flat, block_size)
+    full = allgather_block_sum(q, s, axis_name, flat.shape[0])
+    if op == ReduceOp.AVERAGE:
+        full = full / n
+    full = full.reshape(shape)
+    i = lax.axis_index(axis_name)
+    chunk = shape[0] // n
+    return lax.dynamic_slice_in_dim(full, i * chunk, chunk,
+                                    axis=0).astype(dt)
+
+
+def quantized_alltoall(x: jax.Array, axis_name: AxisName = GLOBAL_AXIS, *,
+                       block_size: int = 128) -> jax.Array:
+    """In-graph int8 block-scaled alltoall (dim-0 split/concat, the
+    Ulysses-SP / expert-dispatch wire): quantized per destination chunk
+    so no scale block straddles a chunk boundary; pure transport."""
+    from ..optim.compression import block_dequantize, block_quantize
+    shape, dt = x.shape, x.dtype
+    n = int(_axis_size(axis_name))     # static under shard_map
+    if shape[0] % n != 0:
+        raise ValueError(
+            f"quantized alltoall needs dim0 divisible by the axis size "
+            f"{n}; got {shape}")
+    per = x.reshape(n, -1).astype(jnp.float32)    # [n, chunk_elems]
+    q, s = block_quantize(per, block_size)
+    tq = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)
+    ts = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)
+    out = block_dequantize(tq, ts, per.shape[1])
+    return out.reshape(shape).astype(dt)
+
+
 def broadcast(x: jax.Array, root_rank: int = 0,
               axis_name: AxisName = GLOBAL_AXIS) -> jax.Array:
     """In-graph broadcast from `root_rank` via masked psum."""

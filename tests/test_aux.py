@@ -279,8 +279,8 @@ def test_autotune_end_to_end_pins_knobs(tmp_path, monkeypatch):
             == tuner.compression_wire
         # CSV log recorded sampled + final scores
         lines = log.read_text().strip().splitlines()
-        assert lines[0] == \
-            "fusion_mb,cycle_ms,two_level,compression,bytes_per_sec,final"
+        assert lines[0] == ("fusion_mb,cycle_ms,two_level,compression,"
+                            "algo_small,algo_large,bytes_per_sec,final")
         assert any(ln.endswith(",1") for ln in lines[1:]), lines
     finally:
         hvd_mod.shutdown()

@@ -1,0 +1,832 @@
+"""Topology-aware collective algorithm plane (ISSUE 6).
+
+Covers: the ops/algo.py registry + alpha-beta cost model + resolution
+precedence, numerical parity of every allreduce strategy (direct /
+rs_ag / rhd / two_level) against numpy oracles, the quantized int8
+allgather / reducescatter / alltoall variants (>=3.5x wire-byte
+acceptance bar, bounded error, non-float passthrough, DCN-only routing
+through the two-level cross.py variants), engine routing + wire
+accounting, rank-invariant execution-time resolution (a tuner flip
+cannot diverge ranks), the autotuner's per-regime categorical dims
+(converging to DIFFERENT algorithms for small vs large buckets — the
+ROADMAP item-1 bar), the deterministic-tuner replay regression, the
+hvd_collective_algo_total counter + ALGO timeline row, and the
+two-level fail-fast mesh check.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _stacked(n, shape, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, *shape).astype(dtype)
+
+
+def _algo_count(algo, collective="allreduce"):
+    from horovod_tpu import obs
+    c = obs.get_registry().get("hvd_collective_algo_total",
+                               {"algo": algo, "collective": collective})
+    return 0 if c is None else int(c.value)
+
+
+def _wire_count(kind):
+    from horovod_tpu import obs
+    c = obs.get_registry().get("hvd_wire_bytes_total", {"kind": kind})
+    return 0 if c is None else int(c.value)
+
+
+# -- cost model + resolution (pure math, no hvd state) ---------------------
+
+def test_predict_cost_shapes_the_expected_crossovers():
+    from horovod_tpu.ops import algo
+    # latency regime, big power-of-two world: rhd's 2*log2(P) hops beat
+    # the ring's 2*(P-1)
+    assert algo.predict_cost("rhd", 1024, 64) < \
+        algo.predict_cost("direct", 1024, 64)
+    # bandwidth regime: all flat algorithms share the ring byte term, so
+    # direct's single launch wins in-model
+    big = 64 << 20
+    assert algo.predict_cost("direct", big, 64) <= \
+        algo.predict_cost("rs_ag", big, 64)
+    # DCN + hierarchy: the cross phase moves N/local, so two_level wins
+    # the bandwidth-bound regime
+    assert algo.predict_cost("two_level", big, 64, hier_shape=(8, 8),
+                             dcn=True) < \
+        algo.predict_cost("direct", big, 64, dcn=True)
+    # structural illegality costs infinity
+    assert algo.predict_cost("rhd", 1024, 6) == float("inf")
+    assert algo.predict_cost("two_level", 1024, 8) == float("inf")
+    with pytest.raises(ValueError, match="unknown collective algorithm"):
+        algo.predict_cost("ring3", 1, 8)
+
+
+def test_select_algorithm_and_crossover():
+    from horovod_tpu.ops import algo
+    assert algo.select_algorithm(1024, 64) == "rhd"
+    assert algo.select_algorithm(64 << 20, 64) == "direct"
+    assert algo.select_algorithm(64 << 20, 64, hier_shape=(8, 8),
+                                 dcn=True) == "two_level"
+    assert algo.select_algorithm(1024, 1) == "direct"
+    # closed form: N* = alpha * P / beta of the dominant link
+    assert algo.crossover_bytes(8) == int(
+        algo.ICI.alpha_s * 8 / algo.ICI.beta_s_per_byte)
+    assert algo.crossover_bytes(8, dcn=True) > algo.crossover_bytes(8)
+    # deterministic: same inputs, same answer
+    for _ in range(3):
+        assert algo.select_algorithm(1024, 64) == "rhd"
+
+
+def test_resolve_precedence_and_legalization():
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.ops import algo
+    cfg = Config()
+    # default: cost model (small world -> direct)
+    assert algo.resolve(cfg, 4096, 8) == "direct"
+    # tuner-learned per-regime choices split at the threshold
+    cfg.collective_algo_small = "rhd"
+    cfg.collective_algo_large = "rs_ag"
+    cfg.collective_algo_threshold_bytes = 1 << 20
+    assert algo.resolve(cfg, 4096, 8) == "rhd"
+    assert algo.resolve(cfg, 2 << 20, 8) == "rs_ag"
+    # rhd on a non-power-of-two world legalizes to direct (tuner choice,
+    # not explicit)
+    assert algo.resolve(cfg, 4096, 6) == "direct"
+    # legacy toggles force two_level when the hierarchy is real
+    cfg2 = Config()
+    cfg2.hierarchical_allreduce = True
+    assert algo.resolve(cfg2, 4096, 8, hier_ok=True) == "two_level"
+    assert algo.resolve(cfg2, 4096, 8, hier_ok=False) == "direct"
+    # explicit HOROVOD_COLLECTIVE_ALGO beats everything
+    cfg.collective_algo, cfg.collective_algo_set = "rs_ag", True
+    assert algo.resolve(cfg, 4096, 8) == "rs_ag"
+    # ... and an explicit structurally-impossible rhd fails fast
+    cfg3 = Config()
+    cfg3.collective_algo, cfg3.collective_algo_set = "rhd", True
+    with pytest.raises(ValueError, match="power-of-two"):
+        algo.resolve(cfg3, 4096, 6)
+    # per-call request beats config
+    assert algo.resolve(cfg, 4096, 8, requested="direct") == "direct"
+
+
+def test_config_validates_algo_knobs():
+    from horovod_tpu.core.config import Config
+    c = Config()
+    c.collective_algo = "ring"
+    with pytest.raises(ValueError, match="HOROVOD_COLLECTIVE_ALGO"):
+        c.validate()
+    c = Config()
+    c.collective_algo_small = "bogus"
+    with pytest.raises(ValueError, match="collective_algo_small"):
+        c.validate()
+    c = Config()
+    c.collective_algo_threshold_bytes = -1
+    with pytest.raises(ValueError, match="THRESHOLD"):
+        c.validate()
+    Config().validate()
+
+
+def test_config_algo_from_env(monkeypatch):
+    from horovod_tpu.core.config import Config
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_ALGO", "RS_AG")
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_ALGO_THRESHOLD", "65536")
+    c = Config.from_env()
+    assert c.collective_algo == "rs_ag" and c.collective_algo_set
+    assert c.collective_algo_threshold_bytes == 65536
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_ALGO", "gossip")
+    with pytest.raises(ValueError, match="HOROVOD_COLLECTIVE_ALGO"):
+        Config.from_env()
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_ALGO", "auto")
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_ALGO_THRESHOLD", "many")
+    with pytest.raises(ValueError, match="THRESHOLD"):
+        Config.from_env()
+
+
+# -- algorithm parity against numpy oracles --------------------------------
+
+@pytest.mark.parametrize("algo", ["direct", "rs_ag", "rhd", "two_level"])
+def test_allreduce_algorithms_numerical_parity(hvd, algo):
+    from horovod_tpu.ops import collective_ops as co
+    n = hvd.size()
+    x = _stacked(n, (301,), seed=3)          # odd size exercises padding
+    out = np.asarray(co.allreduce(x, hvd.Sum, algo=algo))
+    np.testing.assert_allclose(out, np.tile(x.sum(0), (n, 1)), rtol=2e-5,
+                               atol=1e-4)
+    avg = np.asarray(co.allreduce(x, hvd.Average, algo=algo))
+    np.testing.assert_allclose(avg, np.tile(x.mean(0), (n, 1)), rtol=2e-5,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("algo", ["rs_ag", "rhd"])
+def test_algorithms_handle_scale_int_and_bool(hvd, algo):
+    from horovod_tpu.ops import collective_ops as co
+    n = hvd.size()
+    # prescale/postscale ride the shared prologue/epilogue
+    x = _stacked(n, (64,), seed=4)
+    out = np.asarray(co.allreduce(x, hvd.Sum, algo=algo,
+                                  prescale_factor=0.5,
+                                  postscale_factor=2.0))
+    np.testing.assert_allclose(out, np.tile(x.sum(0), (n, 1)), rtol=1e-5,
+                               atol=1e-5)
+    # int payload sums exactly
+    xi = np.arange(n * 10, dtype=np.int32).reshape(n, 10)
+    np.testing.assert_array_equal(
+        np.asarray(co.allreduce(xi, hvd.Sum, algo=algo)),
+        np.tile(xi.sum(0), (n, 1)))
+    # bool goes through the int32 cast prologue
+    xb = (np.arange(n * 6).reshape(n, 6) % 2).astype(bool)
+    got = np.asarray(co.allreduce(xb, hvd.Sum, algo=algo))
+    np.testing.assert_array_equal(got, np.tile(xb.sum(0) > 0, (n, 1)))
+
+
+def test_forced_algo_via_config_and_counter(hvd):
+    import horovod_tpu as hv
+    from horovod_tpu.ops import collective_ops as co
+    cfg = hv.core.basics.get_config()
+    cfg.collective_algo = "rs_ag"
+    try:
+        n = hvd.size()
+        before = _algo_count("rs_ag")
+        x = _stacked(n, (32,), seed=5)
+        out = np.asarray(co.allreduce(x, hvd.Sum))
+        np.testing.assert_allclose(out, np.tile(x.sum(0), (n, 1)),
+                                   rtol=1e-5)
+        assert _algo_count("rs_ag") == before + 1
+    finally:
+        cfg.collective_algo = "auto"
+
+
+def test_algo_timeline_row_on_change(hvd):
+    import horovod_tpu as hv
+    from horovod_tpu.ops import collective_ops as co
+
+    class _FakeTl:
+        def __init__(self):
+            self.instants = []
+
+        def begin(self, *a, **k):
+            pass
+
+        def end(self, *a, **k):
+            pass
+
+        def instant(self, phase, args=None):
+            self.instants.append((phase, args))
+
+    st = hv.core.basics.get_state()
+    fake = _FakeTl()
+    old = st.timeline
+    st.timeline = fake
+    try:
+        n = hvd.size()
+        x = _stacked(n, (16,), seed=6)
+        co.allreduce(x, hvd.Sum, algo="direct")
+        co.allreduce(x, hvd.Sum, algo="direct")   # steady state: silent
+        co.allreduce(x, hvd.Sum, algo="rhd")      # change: one ALGO row
+        rows = [a for p, a in fake.instants if p == "ALGO"
+                and a["collective"] == "allreduce"]
+        assert rows, fake.instants
+        flip = rows[-1]
+        assert flip["algo"] == "rhd" and flip["prev"] == "direct"
+        # exactly one row for the direct->rhd flip (the repeat was silent)
+        assert sum(1 for r in rows if r["algo"] == "direct") <= 1
+        # per-regime steady state is SILENT: alternating small/large
+        # buckets under different per-regime algorithms must not spam
+        # a row per step (the dedup key includes the regime)
+        cfg = hv.core.basics.get_config()
+        cfg.collective_algo_small = "direct"
+        cfg.collective_algo_large = "rs_ag"
+        cfg.collective_algo_threshold_bytes = 64 * 1024
+        try:
+            small = _stacked(n, (16,), seed=7)
+            large = _stacked(n, (32 * 1024,), seed=8)
+            before = len([1 for p, _ in fake.instants if p == "ALGO"])
+            for _ in range(3):
+                co.allreduce(small, hvd.Sum)
+                co.allreduce(large, hvd.Sum)
+            after = len([1 for p, _ in fake.instants if p == "ALGO"])
+            assert after - before <= 2, fake.instants[before:]
+        finally:
+            cfg.collective_algo_small = ""
+            cfg.collective_algo_large = ""
+            cfg.collective_algo_threshold_bytes = 0
+    finally:
+        st.timeline = old
+
+
+# -- quantized allgather / reducescatter / alltoall ------------------------
+
+def test_quantized_allgather_roundtrip_and_wire_bar(hvd):
+    """Acceptance bar: >=3.5x fewer bytes on the wire than fp32, with
+    bounded quantization error."""
+    n = hvd.size()
+    x = _stacked(n, (2048,), seed=7)
+    log0, act0 = _wire_count("logical"), _wire_count("actual")
+    out = np.asarray(hvd.quantized_allgather(x))
+    exact = np.asarray(hvd.allgather(x))
+    assert out.shape == exact.shape
+    # each row is the sender's quantized copy: error bounded by the
+    # per-block scale (absmax/127)
+    np.testing.assert_allclose(out, exact, atol=0.05)
+    dlog = _wire_count("logical") - log0
+    dact = _wire_count("actual") - act0
+    assert dlog == n * 2048 * 4    # each rank's row counted once
+    assert dlog / dact >= 3.5, (dlog, dact)
+
+
+def test_quantized_reducescatter_roundtrip_and_wire_bar(hvd):
+    n = hvd.size()
+    x = _stacked(n, (n * 512,), seed=8)
+    log0, act0 = _wire_count("logical"), _wire_count("actual")
+    out = np.asarray(hvd.quantized_reducescatter(x, hvd.Sum))
+    exact = np.asarray(hvd.reducescatter(x, hvd.Sum))
+    np.testing.assert_allclose(out, exact, atol=0.3)
+    dlog = _wire_count("logical") - log0
+    dact = _wire_count("actual") - act0
+    assert dlog / dact >= 3.5, (dlog, dact)
+    # average divides the dequantized fp32 sum
+    avg = np.asarray(hvd.quantized_reducescatter(x, hvd.Average))
+    np.testing.assert_allclose(
+        avg, np.asarray(hvd.reducescatter(x, hvd.Average)), atol=0.05)
+    with pytest.raises(ValueError, match="Sum/Average"):
+        hvd.quantized_reducescatter(x, hvd.Max)
+
+
+def test_quantized_alltoall_roundtrip(hvd):
+    n = hvd.size()
+    x = _stacked(n, (n * 64, 3), seed=9)
+    log0, act0 = _wire_count("logical"), _wire_count("actual")
+    out = np.asarray(hvd.quantized_alltoall(x))
+    exact = np.asarray(hvd.alltoall(x))
+    np.testing.assert_allclose(out, exact, atol=0.05)
+    assert _wire_count("actual") - act0 < _wire_count("logical") - log0
+    with pytest.raises(ValueError, match="divisible"):
+        hvd.quantized_alltoall(_stacked(n, (n + 1,), seed=10))
+
+
+def test_quantized_nonfloat_passes_through_uncompressed(hvd):
+    n = hvd.size()
+    xi = np.arange(n * 12, dtype=np.int32).reshape(n, 12)
+    np.testing.assert_array_equal(np.asarray(hvd.quantized_allgather(xi)),
+                                  np.asarray(hvd.allgather(xi)))
+    xr = np.arange(n * n * 2, dtype=np.int64).reshape(n, n * 2)
+    got = hvd.quantized_reducescatter(xr, hvd.Sum)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(hvd.reducescatter(xr,
+                                                               hvd.Sum)))
+
+
+def test_quantized_dcn_only_routes_two_level(hvd):
+    """HOROVOD_COMPRESSION_DCN_ONLY: allgather/reducescatter ride the
+    two-level cross.py variants (ICI exact, DCN quantized) when a real
+    (cross>1, local>1) hierarchy exists, and stay exact otherwise."""
+    import horovod_tpu as hv
+    from horovod_tpu.core.mesh import build_hierarchical_mesh
+    st = hv.core.basics.get_state()
+    cfg = hv.core.basics.get_config()
+    n = hvd.size()
+    x = _stacked(n, (n * 32,), seed=11)
+    exact_ag = np.asarray(hvd.allgather(x))
+    exact_rs = np.asarray(hvd.reducescatter(x, hvd.Sum))
+    old_hier = st.hier_mesh
+    cfg.compression_dcn_only = True
+    try:
+        # flat hierarchy (cross=1): DCN-only means NO compression
+        before = _algo_count("two_level_q8", "allgather")
+        out = np.asarray(hvd.quantized_allgather(x))
+        np.testing.assert_array_equal(out, exact_ag)
+        assert _algo_count("two_level_q8", "allgather") == before
+        # real (2, local) hierarchy: quantized cross hop only
+        st.hier_mesh = build_hierarchical_mesh(jax.devices(),
+                                               local_size=n // 2)
+        out = np.asarray(hvd.quantized_allgather(x))
+        np.testing.assert_allclose(out, exact_ag, atol=0.05)
+        assert _algo_count("two_level_q8", "allgather") == before + 1
+        rs = np.asarray(hvd.quantized_reducescatter(x, hvd.Sum))
+        np.testing.assert_allclose(rs, exact_rs, atol=0.3)
+        assert _algo_count("two_level_q8", "reducescatter") >= 1
+        # alltoall has no hierarchical decomposition: exact under
+        # DCN-only
+        t = _stacked(n, (n * 4,), seed=12)
+        np.testing.assert_array_equal(
+            np.asarray(hvd.quantized_alltoall(t)),
+            np.asarray(hvd.alltoall(t)))
+    finally:
+        cfg.compression_dcn_only = False
+        st.hier_mesh = old_hier
+
+
+def test_engine_routes_quantized_sharded_state_singles(hvd):
+    """With HOROVOD_COMPRESSION=int8 the engine's single-op path moves
+    allgather/reducescatter/alltoall payloads over the int8 wire — the
+    FSDP/EP sharded-state traffic finally compresses."""
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    cfg = hv.core.basics.get_config()
+    cfg.compression = "int8"
+    try:
+        n = hvd.size()
+        x = _stacked(n, (1024,), seed=13)
+        log0, act0 = eng.wire_bytes_logical, eng.wire_bytes_actual
+        out = np.asarray(hvd.allgather_async(x, name="qag").wait())
+        np.testing.assert_allclose(out, np.asarray(hvd.allgather(x)),
+                                   atol=0.05)
+        dlog = eng.wire_bytes_logical - log0
+        dact = eng.wire_bytes_actual - act0
+        assert dlog / dact >= 3.5, (dlog, dact)
+        r = _stacked(n, (n * 256,), seed=14)
+        out = np.asarray(
+            hvd.reducescatter_async(r, hvd.Sum, name="qrs").wait())
+        np.testing.assert_allclose(
+            out, np.asarray(hvd.reducescatter(r, hvd.Sum)), atol=0.3)
+        t = _stacked(n, (n * 32,), seed=15)
+        out = np.asarray(hvd.alltoall_async(t, name="qa2a").wait())
+        np.testing.assert_allclose(out, np.asarray(hvd.alltoall(t)),
+                                   atol=0.05)
+        # non-float singles stay on the exact path
+        xi = np.arange(n * 8, dtype=np.int32).reshape(n, 8)
+        out = np.asarray(hvd.allgather_async(xi, name="qagi").wait())
+        np.testing.assert_array_equal(out, np.asarray(hvd.allgather(xi)))
+    finally:
+        cfg.compression = "none"
+
+
+def test_async_compression_override_and_optout(hvd):
+    """Per-call `compression=` on the async sharded-state collectives:
+    'int8' forces the quantized wire while the config default is exact,
+    and 'none' keeps a payload bit-exact under a config-int8 default
+    (the allreduce_async escape hatch, extended)."""
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    cfg = hv.core.basics.get_config()
+    n = hvd.size()
+    x = _stacked(n, (1024,), seed=30)
+    exact = np.asarray(hvd.allgather(x))
+    log0, act0 = eng.wire_bytes_logical, eng.wire_bytes_actual
+    out = np.asarray(hvd.allgather_async(x, name="force.q",
+                                         compression="int8").wait())
+    np.testing.assert_allclose(out, exact, atol=0.05)
+    assert eng.wire_bytes_actual - act0 < eng.wire_bytes_logical - log0
+    cfg.compression = "int8"
+    try:
+        out = np.asarray(hvd.allgather_async(x, name="opt.out",
+                                             compression="none").wait())
+        np.testing.assert_array_equal(out, exact)
+        r = _stacked(n, (n * 64,), seed=31)
+        out = np.asarray(hvd.reducescatter_async(
+            r, hvd.Sum, name="opt.out.rs", compression="none").wait())
+        np.testing.assert_array_equal(
+            out, np.asarray(hvd.reducescatter(r, hvd.Sum)))
+    finally:
+        cfg.compression = "none"
+
+
+def test_async_allreduce_explicit_algo_rides_engine(hvd):
+    """allreduce_async(algo=...) pins the schedule through the engine
+    path (the per-call contract survives the async route)."""
+    n = hvd.size()
+    x = _stacked(n, (128,), seed=32)
+    before = _algo_count("rhd")
+    out = np.asarray(hvd.allreduce_async(x, hvd.Sum, name="pin.rhd",
+                                         algo="rhd").wait())
+    np.testing.assert_allclose(out, np.tile(x.sum(0), (n, 1)), rtol=1e-5,
+                               atol=1e-4)
+    assert _algo_count("rhd") == before + 1
+    with pytest.raises(ValueError, match="unknown collective algorithm"):
+        hvd.allreduce_async(x, hvd.Sum, algo="ring3")
+    # an algo request on a single-schedule op is rejected, not dropped
+    with pytest.raises(ValueError, match="Sum/Average only"):
+        hvd.allreduce_async(x, hvd.Min, algo="rs_ag")
+    # explicit algo + explicit int8 wire is a contradiction (the gather
+    # transport has no schedule choice) — rejected at enqueue
+    with pytest.raises(ValueError, match="conflict"):
+        hvd.allreduce_async(x, hvd.Sum, algo="rs_ag", compression="int8")
+    # ... while a CONFIG-driven int8 default yields to the explicit
+    # schedule (opt-out, exact transport)
+    import horovod_tpu as hv
+    cfg = hv.core.basics.get_config()
+    cfg.compression = "int8"
+    try:
+        before2 = _algo_count("rs_ag")
+        out = np.asarray(hvd.allreduce_async(
+            x, hvd.Sum, name="pin.vs.cfg", algo="rs_ag").wait())
+        np.testing.assert_allclose(out, np.tile(x.sum(0), (n, 1)),
+                                   rtol=1e-5, atol=1e-4)
+        assert _algo_count("rs_ag") == before2 + 1
+    finally:
+        cfg.compression = "none"
+    from horovod_tpu.ops import collective_ops as co
+    with pytest.raises(ValueError, match="Sum/Average only"):
+        co.allreduce(x, hvd.Max, algo="rhd")
+    # transport collectives have no bf16 wire: explicit bf16 is rejected
+    # rather than silently ignored
+    with pytest.raises(ValueError, match="int8.*none"):
+        hvd.allgather_async(x, compression="bf16")
+
+
+def test_runnable_algorithms_one_home():
+    from horovod_tpu.ops import algo
+    assert algo.runnable_algorithms(8) == ("direct", "rs_ag", "rhd")
+    assert algo.runnable_algorithms(6) == ("direct", "rs_ag")
+    assert algo.runnable_algorithms(8, (2, 4)) == \
+        ("direct", "rs_ag", "rhd", "two_level")
+    # degenerate cross==1 hierarchy: runnable only when forced
+    assert "two_level" not in algo.runnable_algorithms(8, (1, 8))
+    assert "two_level" in algo.runnable_algorithms(8, (1, 8),
+                                                   require_cross=False)
+    # hierarchy not covering the world never qualifies
+    assert "two_level" not in algo.runnable_algorithms(8, (2, 2))
+
+
+# -- two-level variants + fail-fast mesh check -----------------------------
+
+def test_two_level_reducescatter_parity_and_wire(hvd):
+    from horovod_tpu.core.mesh import build_hierarchical_mesh
+    from horovod_tpu.ops.cross import two_level_reducescatter
+    n = hvd.size()
+    mesh = build_hierarchical_mesh(jax.devices(), local_size=n // 2)
+    x = _stacked(n, (n * 16,), seed=16)
+    exact = np.asarray(hvd.reducescatter(x, hvd.Sum))
+    out = np.asarray(two_level_reducescatter(jnp.asarray(x), hvd.Sum,
+                                             mesh))
+    np.testing.assert_allclose(out, exact, rtol=1e-5, atol=1e-5)
+    q = np.asarray(two_level_reducescatter(jnp.asarray(x), hvd.Sum, mesh,
+                                           wire="int8", block_size=32))
+    np.testing.assert_allclose(q, exact, atol=0.3)
+    b = np.asarray(two_level_reducescatter(jnp.asarray(x), hvd.Sum, mesh,
+                                           wire="bf16"))
+    np.testing.assert_allclose(b, exact, rtol=0.02, atol=0.2)
+    avg = np.asarray(two_level_reducescatter(jnp.asarray(x), hvd.Average,
+                                             mesh))
+    np.testing.assert_allclose(
+        avg, np.asarray(hvd.reducescatter(x, hvd.Average)), rtol=1e-5,
+        atol=1e-5)
+    # non-float passes through exact regardless of wire
+    xi = np.arange(n * n, dtype=np.int32).reshape(n, n)
+    qi = np.asarray(two_level_reducescatter(jnp.asarray(xi), hvd.Sum,
+                                            mesh, wire="int8"))
+    np.testing.assert_array_equal(
+        qi, np.asarray(hvd.reducescatter(xi, hvd.Sum)))
+
+
+def test_two_level_allgather_quantized_cross_hop(hvd):
+    from horovod_tpu.core.mesh import build_hierarchical_mesh
+    from horovod_tpu.ops.cross import two_level_allgather
+    n = hvd.size()
+    mesh = build_hierarchical_mesh(jax.devices(), local_size=n // 2)
+    x = _stacked(n, (24, 2), seed=17)
+    exact = np.asarray(hvd.allgather(x))
+    out = np.asarray(two_level_allgather(jnp.asarray(x), mesh))
+    np.testing.assert_array_equal(out, exact)
+    q = np.asarray(two_level_allgather(jnp.asarray(x), mesh, wire="int8",
+                                       block_size=32))
+    np.testing.assert_allclose(q, exact, atol=0.05)
+    b = np.asarray(two_level_allgather(jnp.asarray(x), mesh, wire="bf16"))
+    np.testing.assert_allclose(b, exact, rtol=0.02, atol=0.05)
+
+
+def test_two_level_fail_fast_on_malformed_mesh(hvd):
+    """Satellite: a non-(cross, local) mesh raises a clear ValueError
+    instead of an opaque unpack error."""
+    from horovod_tpu.ops.cross import (two_level_allgather,
+                                       two_level_allreduce,
+                                       two_level_reducescatter)
+    flat = hvd.core.basics.get_mesh()                 # 1-D ("hvd",)
+    n = hvd.size()
+    x = jnp.asarray(_stacked(n, (n,), seed=18))
+    for fn, args in ((two_level_allreduce, (x, hvd.Sum, flat)),
+                     (two_level_allgather, (x, flat)),
+                     (two_level_reducescatter, (x, hvd.Sum, flat))):
+        with pytest.raises(ValueError, match="2-D .*cross.*local"):
+            fn(*args)
+
+
+# -- in-graph quantized variants -------------------------------------------
+
+def test_inside_quantized_variants_under_shard_map(hvd):
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops import inside
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("hvd",))
+    n = hvd.size()
+
+    def run(fn, x):
+        f = jax.jit(jax.shard_map(lambda v: fn(v[0])[None], mesh=mesh,
+                                  in_specs=(P("hvd"),),
+                                  out_specs=P("hvd")))
+        return np.asarray(f(jnp.asarray(x)))
+
+    g = _stacked(n, (17,), seed=19)
+    out = run(lambda v: inside.quantized_allgather(v, "hvd",
+                                                   block_size=16), g)
+    np.testing.assert_allclose(out, np.asarray(hvd.allgather(g)),
+                               atol=0.05)
+    r = _stacked(n, (n * 8,), seed=20)
+    out = run(lambda v: inside.quantized_reducescatter(
+        v, hvd.Sum, "hvd", block_size=16), r)
+    np.testing.assert_allclose(out, np.asarray(hvd.reducescatter(
+        r, hvd.Sum)), atol=0.3)
+    t = _stacked(n, (n * 2, 3), seed=21)
+    out = run(lambda v: inside.quantized_alltoall(v, "hvd",
+                                                  block_size=16), t)
+    np.testing.assert_allclose(out, np.asarray(hvd.alltoall(t)),
+                               atol=0.05)
+
+
+# -- rank invariance (the PR 1 round-synchronization discipline) -----------
+
+def test_algo_resolution_is_execution_time_not_enqueue_time(hvd):
+    """A tuner/config flip between enqueue and the engine cycle must be
+    what EXECUTES — resolution reads round-synchronized config on the
+    dispatch thread, so all ranks (which share the synced config) run
+    the same algorithm for the same bucket."""
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    cfg = hv.core.basics.get_config()
+    old_cycle = eng.cycle_time_s
+    eng.cycle_time_s = 0.5          # widen the batching window
+    try:
+        n = hvd.size()
+        x = _stacked(n, (64,), seed=22)
+        before = _algo_count("rs_ag")
+        h = hvd.allreduce_async(x, hvd.Sum, name="flip.bucket")
+        # flip AFTER enqueue, before the cycle executes
+        cfg.collective_algo = "rs_ag"
+        out = np.asarray(h.wait())
+        np.testing.assert_allclose(out, np.tile(x.sum(0), (n, 1)),
+                                   rtol=1e-5)
+        assert _algo_count("rs_ag") == before + 1, \
+            "bucket executed with the enqueue-time algorithm"
+    finally:
+        cfg.collective_algo = "auto"
+        eng.cycle_time_s = old_cycle
+
+
+def test_work_meta_excludes_config_algo(hvd):
+    """The negotiation meta must NOT pin the config-driven algorithm at
+    enqueue time (only an explicit per-call wire is program identity) —
+    the algo travels in the round payload instead, synced from rank 0."""
+    import horovod_tpu as hv
+    from horovod_tpu.core.types import ReduceOp, RequestType
+    from horovod_tpu.ops.engine import Engine, Handle, _Work
+    cfg = hv.core.basics.get_config()
+    cfg.collective_algo = "rhd"
+    try:
+        ps = hv.core.basics.get_process_set(None)
+        w = _Work(RequestType.ALLREDUCE, "m", np.zeros((hv.size(), 4),
+                                                       np.float32),
+                  ReduceOp.SUM, ps, Handle("m"))
+        meta = Engine._work_meta(w)
+        assert "alg" not in meta and "rhd" not in json.dumps(meta)
+    finally:
+        cfg.collective_algo = "auto"
+
+
+def test_negotiation_adopts_rank0_algo_plane(hvd):
+    """Peers adopt rank 0's collective_algo / per-regime choices each
+    round (SynchronizeParameters discipline) — the mechanism that makes
+    a mid-flight tuner flip rank-invariant."""
+    import horovod_tpu as hv
+    eng = hv.core.basics.get_engine()
+    cfg = hv.core.basics.get_config()
+
+    class _FakeCoord:
+        size, rank = 2, 1
+
+        def bitand(self, probe, tag=""):
+            return bytes(32)               # never "all equal"
+
+        def allgather(self, payload, tag=""):
+            rank0 = json.loads(payload.decode())
+            rank0 = dict(rank0, alg=["rs_ag", "rhd", "rs_ag"], w=[])
+            return [json.dumps(rank0).encode(), payload]
+
+    old = (cfg.collective_algo, cfg.collective_algo_small,
+           cfg.collective_algo_large)
+    try:
+        ready, deferred = eng._negotiate(_FakeCoord(), [])
+        assert ready == [] and deferred == []
+        assert cfg.collective_algo == "rs_ag"
+        assert cfg.collective_algo_small == "rhd"
+        assert cfg.collective_algo_large == "rs_ag"
+    finally:
+        (cfg.collective_algo, cfg.collective_algo_small,
+         cfg.collective_algo_large) = old
+
+
+# -- autotuner: per-regime dims + determinism ------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+def _drive_tuner(pm, clock, score_fn, max_cycles=400):
+    """Feed the tuner a synthetic (bytes, seconds) trace: each scoring
+    window lasts 1 s and moves score_fn(knobs) bytes."""
+    sampled = []
+    cycles = 0
+    while pm.active and cycles < max_cycles:
+        nbytes = score_fn(pm)
+        for _ in range(pm.steps_per_sample):
+            clock.advance(1.0 / pm.steps_per_sample)
+            if pm.record(nbytes // pm.steps_per_sample):
+                sampled.append(pm._current.copy())
+        cycles += 1
+    return sampled
+
+
+def test_tuner_converges_to_different_algos_per_regime():
+    """ROADMAP item-1 acceptance: the tuner converges to DIFFERENT
+    algorithm choices for small vs large fusion buckets. Synthetic
+    deployment truth: rhd wins the latency-bound small regime, rs_ag
+    the bandwidth-bound large regime."""
+    from horovod_tpu.autotune.tuner import ParameterManager
+    clock = _FakeClock()
+    pm = ParameterManager(warmup_samples=1, steps_per_sample=1,
+                          max_samples=40, seed=0,
+                          tune_two_level=False, tune_compression=False,
+                          tune_algo=True,
+                          algo_choices=("direct", "rs_ag", "rhd"),
+                          clock=clock)
+
+    def score(p):
+        s = 100.0
+        if p.algo_small == "rhd":
+            s += 60.0                      # small buckets: latency win
+        elif p.algo_small == "rs_ag":
+            s -= 10.0
+        if p.algo_large == "rs_ag":
+            s += 60.0                      # large buckets: bandwidth win
+        elif p.algo_large == "rhd":
+            s -= 30.0
+        return int(s * 1000)
+
+    _drive_tuner(pm, clock, score)
+    assert not pm.active, "tuner never pinned"
+    assert pm.algo_small == "rhd", pm.algo_small
+    assert pm.algo_large == "rs_ag", pm.algo_large
+    assert pm.algo_small != pm.algo_large
+
+
+def test_tuner_deterministic_replay():
+    """CI regression: a fixed-seed ParameterManager over a synthetic
+    (bytes, seconds) trace reproduces a byte-identical sampled-knob
+    sequence — guards the categorical dims against nondeterministic GP
+    behavior."""
+    from horovod_tpu.autotune.tuner import ParameterManager
+
+    def run():
+        clock = _FakeClock()
+        pm = ParameterManager(warmup_samples=2, steps_per_sample=3,
+                              max_samples=12, seed=7,
+                              tune_two_level=True, tune_compression=True,
+                              tune_algo=True,
+                              algo_choices=("direct", "rs_ag", "rhd"),
+                              clock=clock)
+
+        def score(p):
+            return int(1000 * (p._current[0] + 10 * p._current[1]))
+
+        sampled = _drive_tuner(pm, clock, score)
+        return [s.tobytes() for s in sampled], pm._current.tobytes()
+
+    seq_a, final_a = run()
+    seq_b, final_b = run()
+    assert len(seq_a) > 5
+    assert seq_a == seq_b
+    assert final_a == final_b
+
+
+def test_tuner_algo_dims_frozen_and_snapped():
+    from horovod_tpu.autotune.tuner import ParameterManager
+    pm = ParameterManager(tune_algo=True,
+                          algo_choices=("direct", "rs_ag", "rhd"))
+    assert pm.algo_small in ("direct", "rs_ag", "rhd")
+    # fusion, cycle, two_level, algo_small, algo_large (compression off)
+    assert len(pm._current) == 5
+    x = pm._snap(np.array([3.0, 2.0, 0.6, 1.4, 2.0]))
+    assert x[3] == 1.0 and x[4] == 2.0
+    frozen = ParameterManager(tune_algo=False)
+    assert frozen.algo_small == "" and frozen.algo_large == ""
+    # a single-choice vocabulary silently freezes (nothing to choose)
+    solo = ParameterManager(tune_algo=True, algo_choices=("direct",))
+    assert not solo.tune_algo
+
+
+def test_engine_freezes_algo_dims_on_explicit_env(monkeypatch):
+    import horovod_tpu as hv
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_ALGO", "rs_ag")
+    hv.shutdown()
+    hv.init()
+    try:
+        eng = hv.core.basics.get_engine()
+        assert eng.tuner is not None
+        assert not eng.tuner.tune_algo
+        n = hv.size()
+        x = _stacked(n, (32,), seed=23)
+        before = _algo_count("rs_ag")
+        out = hv.grouped_allreduce([x], hv.Sum, name="frozen")[0]
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(x.sum(0), (n, 1)), rtol=1e-5)
+        assert _algo_count("rs_ag") == before + 1
+    finally:
+        hv.shutdown()
+
+
+def test_engine_autotune_samples_algo_dims(monkeypatch):
+    """With HOROVOD_AUTOTUNE=1 (and no explicit algo), the engine's
+    tuner carries the per-regime dims and writes sampled choices into
+    the live config."""
+    import horovod_tpu as hv
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+    hv.shutdown()
+    hv.init()
+    try:
+        eng = hv.core.basics.get_engine()
+        assert eng.tuner is not None and eng.tuner.tune_algo
+        # world 8, single process: rhd eligible, two_level not (cross=1)
+        assert "rhd" in eng.tuner.algo_choices
+        assert "two_level" not in eng.tuner.algo_choices
+        eng.tuner.max_samples = 2
+        n = hv.size()
+        x = np.ones((n, 64), np.float32)
+        step = 0
+        while eng.tuner.active and step < 200:
+            hv.synchronize(hv.allreduce_async(x, hv.Sum,
+                                              name=f"alg{step}"))
+            step += 1
+        assert not eng.tuner.active
+        import time
+        cfg = hv.core.basics.get_config()
+        deadline = time.monotonic() + 5.0
+        while cfg.collective_algo_small != eng.tuner.algo_small and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert cfg.collective_algo_small == eng.tuner.algo_small
+        assert cfg.collective_algo_large == eng.tuner.algo_large
+    finally:
+        hv.shutdown()
+
+
+# -- bench + docs presence --------------------------------------------------
+
+def test_bench_has_collectives_sweep():
+    import os
+    src = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")).read()
+    assert "--collectives" in src
+    assert "collective_bytes_per_s" in src
+    assert "collective_algo_crossover" in src

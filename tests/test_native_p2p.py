@@ -84,6 +84,85 @@ def test_ring_collectives(procs):
         server.close()
 
 
+def _ring_oracle_worker(kv_port):
+    """Numerical-parity oracle run (ISSUE 6 satellite): every rank
+    re-derives ALL peers' seeded random inputs locally and checks ring
+    reducescatter / alltoall / allreduce outputs against exact numpy
+    reductions — with sizes that do NOT divide evenly, so the
+    `(i * n) // P` uneven chunk-bound walk in allreduce is exercised on
+    DISTINCT per-position values (constant fills cannot catch a
+    boundary off-by-one)."""
+    import os
+    import numpy as np
+    from horovod_tpu.native.p2p import RingComm
+
+    r = int(os.environ["HOROVOD_RANK"])
+    n = int(os.environ["HOROVOD_SIZE"])
+
+    def rows(rank, size, seed_base=100):
+        return (np.random.RandomState(seed_base + rank)
+                .randn(size).astype(np.float32))
+
+    c = RingComm("127.0.0.1", kv_port, r, n,
+                 prefix=f"o.{os.environ['HOROVOD_JOB_ID']}")
+    try:
+        # allreduce at sizes around the uneven-bound regime: 13 % 4 != 0
+        # (bounds 0,3,6,9,13), plus size < P (some empty chunks) and a
+        # large non-multiple crossing the inline/full-duplex threshold
+        for size in (13, n - 1, (1 << 16) + 7):
+            if size <= 0:
+                continue
+            mine = rows(r, size)
+            all_rows = np.stack([rows(i, size) for i in range(n)])
+            for op, red in (("sum", np.sum), ("min", np.min),
+                            ("max", np.max), ("prod", np.prod)):
+                out = c.allreduce(mine, op)
+                np.testing.assert_allclose(
+                    out, red(all_rows, axis=0), rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(
+                c.allreduce(mine, "sum", average=True),
+                all_rows.mean(0), rtol=1e-5, atol=1e-5)
+        # reducescatter parity (divisible contract) on distinct values
+        size = 3 * n
+        mine = rows(r, size, seed_base=300)
+        all_rows = np.stack([rows(i, size, seed_base=300)
+                             for i in range(n)])
+        rs = c.reducescatter(mine, "sum")
+        cs = size // n
+        np.testing.assert_allclose(rs, all_rows.sum(0)[r * cs:(r + 1) * cs],
+                                   rtol=1e-5, atol=1e-5)
+        # ragged alltoall oracle: rows(src->dst) = (src + 2*dst) % 5,
+        # chunk values seeded per (src, dst) so a mis-routed or
+        # mis-sliced chunk cannot match
+        def chunk(src, dst):
+            m = (src + 2 * dst) % 5
+            return (np.random.RandomState(1000 + src * n + dst)
+                    .randn(m, 2).astype(np.float32))
+
+        out = c.alltoall([chunk(r, d) for d in range(n)])
+        for src in range(n):
+            np.testing.assert_allclose(out[src], chunk(src, r),
+                                       rtol=1e-6, atol=1e-6)
+    finally:
+        c.close()
+    return 1.0
+
+
+@pytest.mark.parametrize("procs", [3, 4])
+def test_ring_oracle_parity_uneven_bounds(procs):
+    from horovod_tpu.native.store import StoreServer
+    from horovod_tpu.spark import MultiprocessingJobRunner, run
+    server = StoreServer()
+    try:
+        results = run(_ring_oracle_worker, args=(server.port,),
+                      num_proc=procs,
+                      job_runner=MultiprocessingJobRunner(),
+                      env={"HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        assert results == [1.0] * procs
+    finally:
+        server.close()
+
+
 def test_ring_single_rank_identity():
     from horovod_tpu.native.p2p import RingComm
     c = RingComm("127.0.0.1", 1, 0, 1)
