@@ -20,10 +20,13 @@ registry snapshot as a second JSON line (docs/metrics.md).
 
 `--serve` runs the continuous-batching loopback benchmark, `--ckpt`
 the checkpoint-plane loopback (ckpt_save_ms / ckpt_blocking_ms /
-ckpt_restore_ms — docs/checkpoint.md), and `--collectives` the
+ckpt_restore_ms — docs/checkpoint.md), `--collectives` the
 collective-algorithm microbench (bytes/s per algorithm x tensor size
-plus the measured crossover table — docs/benchmarks.md), each emitting
-the same one-JSON-line-per-metric format.
+plus the measured crossover table — docs/benchmarks.md), and `--redist`
+the redistribution microbench (redist_ms / redist_bytes_per_s for an
+in-memory N->M vs the ckpt save+restore round trip, plus
+weight_swap_ms for a serve hot-swap — docs/redistribution.md), each
+emitting the same one-JSON-line-per-metric format.
 
 vs_baseline compares per-chip throughput against the reference's documented
 tf_cnn_benchmarks ResNet-101 example output (1656.82 img/sec on 16 P100s =
@@ -510,6 +513,174 @@ def run_ckpt_benchmark() -> int:
         return 1
 
 
+def _redist_bench_tree(rows, fill: bool):
+    import numpy as np
+    if fill:
+        tree = {f"w{i}": np.arange(rows * 1024, dtype=np.float32)
+                .reshape(rows, 1024) * (i + 1) for i in range(4)}
+        tree["step"] = 7
+    else:
+        tree = {f"w{i}": np.zeros((rows, 1024), np.float32)
+                for i in range(4)}
+        tree["step"] = 0
+    return tree
+
+
+def _redist_bench_worker(rows, world):
+    """One bench rank (real process via the multiprocessing runner —
+    threads would serialize the numpy/socket work on one GIL and
+    misreport the wire path by ~10x). Returns (ms, ok)."""
+    import os
+
+    import numpy as np
+
+    from horovod_tpu.redist import RingTransport, Spec, redistribute
+
+    r = int(os.environ["HOROVOD_RANK"])
+    local = _redist_bench_tree(rows, fill=(r == 0))
+    t = RingTransport.connect(r, world, prefix="bench.redist",
+                              timeout=120)
+    # align ranks before timing: process spawn + jax import skew would
+    # otherwise be billed to the transfer (the first rank waits in the
+    # rendezvous for the last one to start)
+    t._ring.barrier()
+    t0 = time.perf_counter()
+    out = redistribute(local, Spec.full(world, holders=(0,)),
+                       Spec.full(world), t, tag="bench")
+    ms = (time.perf_counter() - t0) * 1000.0
+    t.close()
+    oracle = _redist_bench_tree(rows, fill=True)
+    ok = all(np.array_equal(out[k], oracle[k]) for k in
+             ("w0", "w1", "w2", "w3")) and out["step"] == 7
+    return (ms, bool(ok))
+
+
+def run_redist_benchmark() -> int:
+    """Redistribution microbench (`bench.py --redist`): an in-memory
+    N->M weight redistribution over the p2p ring (one holder fanning a
+    synthetic tree out to W real worker processes, the elastic-grow
+    shape) timed against the checkpoint save+restore round trip it
+    replaces, at MATCHED tree sizes — plus a serve hot-swap latency
+    (`weight_swap_ms`: publish -> poll -> swap_params on a tiny GPT
+    executor). Emits one JSON line per metric consistent with
+    --serve/--ckpt: redist_ms, redist_bytes_per_s, weight_swap_ms
+    (each carrying ckpt_roundtrip_ms + in_memory_over_ckpt for the
+    comparison)."""
+    import shutil
+    import statistics
+    import tempfile
+    import uuid
+
+    try:
+        import numpy as np
+
+        from horovod_tpu.ckpt import ShardedCheckpointer
+        from horovod_tpu.native.store import StoreServer
+        from horovod_tpu.spark import MultiprocessingJobRunner
+        from horovod_tpu.spark import run as spark_run
+
+        mb = int(os.environ.get("HVD_BENCH_REDIST_MB", "32"))
+        world = int(os.environ.get("HVD_BENCH_REDIST_WORLD", "4"))
+        rows = max((mb * (1 << 20)) // (4 * 1024) // 4, 4)
+        tree = _redist_bench_tree(rows, fill=True)
+        tree_bytes = sum(v.nbytes for v in tree.values()
+                         if isinstance(v, np.ndarray))
+
+        srv = StoreServer()
+        returns = spark_run(
+            _redist_bench_worker, args=(rows, world), num_proc=world,
+            job_runner=MultiprocessingJobRunner(),
+            env={"HOROVOD_NATIVE_KV_ADDR": "127.0.0.1",
+                 "HOROVOD_NATIVE_KV_PORT": str(srv.port),
+                 "HOROVOD_JOB_ID": uuid.uuid4().hex[:8]})
+        srv.close()
+        assert all(ok for _, ok in returns), "bench tree mismatch"
+        redist_ms = max(ms for ms, _ in returns)
+        moved = tree_bytes * (world - 1)
+
+        # the round trip it replaces: durable save + one full restore
+        root = tempfile.mkdtemp(prefix="hvd_redist_bench.")
+        try:
+            with ShardedCheckpointer(root, rank=0, world=1,
+                                     async_save=False) as ck:
+                t0 = time.perf_counter()
+                ck.save(0, tree, force=True)
+                save_ms = (time.perf_counter() - t0) * 1000.0
+                t0 = time.perf_counter()
+                out = ck.restore(0, via="local")
+                restore_ms = (time.perf_counter() - t0) * 1000.0
+                assert np.array_equal(out["w0"], tree["w0"])
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        ckpt_roundtrip_ms = save_ms + restore_ms
+
+        # serve hot-swap: publish -> poll -> swap on a live executor
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.gpt import GPT, GPTConfig
+        from horovod_tpu.redist import WeightPublisher, WeightSubscriber
+        from horovod_tpu.serve import ShardedExecutor
+
+        srv = StoreServer()
+        cfg = GPTConfig(vocab_size=256, num_layers=2, num_heads=4,
+                        head_dim=16, max_seq_len=64, decode=True,
+                        dtype=jnp.float32,
+                        attention_impl="reference")
+        model = GPT(cfg)
+        toks = jnp.zeros((2, 8), jnp.int32)
+        params = model.init(
+            jax.random.PRNGKey(0), toks,
+            positions=jnp.zeros((2,), jnp.int32),
+            update_mask=jnp.zeros((2,), bool))["params"]
+        ex = ShardedExecutor(model, params, max_batch=2, max_len=64)
+        pub = WeightPublisher("bench", kv_addr="127.0.0.1",
+                              kv_port=srv.port)
+        sub = WeightSubscriber("bench", kv_addr="127.0.0.1",
+                               kv_port=srv.port, template=params)
+        swap_ms = []
+        for i in range(5):
+            nxt = jax.tree_util.tree_map(lambda x: x + 0.01, params)
+            pub.publish(nxt)
+            v, got = sub.poll()
+            # time the SWAP span only — the same span the production
+            # hvd_weight_swap_ms histogram covers (fetch/crc/assembly
+            # is the stream-adoption cost, not the swap fence)
+            t0 = time.perf_counter()
+            assert ex.swap_params(got, version=v)
+            swap_ms.append((time.perf_counter() - t0) * 1000.0)
+        pub.close()
+        sub.close()
+        srv.close()
+
+        common = {"world": world, "tree_mb": mb, "transport": "ring",
+                  "ckpt_roundtrip_ms": round(ckpt_roundtrip_ms, 3),
+                  "in_memory_over_ckpt": round(
+                      redist_ms / ckpt_roundtrip_ms, 4)}
+        if os.environ.get("HVD_BENCH_METRICS") == "1":
+            from horovod_tpu import obs
+            print(json.dumps({"metric": "metrics_snapshot",
+                              "value": obs.get_registry().snapshot()}),
+                  flush=True)
+        for metric, value, unit in (
+                ("redist_ms", round(redist_ms, 3), "ms"),
+                ("redist_bytes_per_s",
+                 round(moved / (redist_ms / 1000.0), 1), "B/s"),
+                ("weight_swap_ms",
+                 round(statistics.median(swap_ms), 3), "ms")):
+            print(json.dumps({"metric": metric, "value": value,
+                              "unit": unit, **common}), flush=True)
+        return 0
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        for metric, unit in (("redist_ms", "ms"),
+                             ("redist_bytes_per_s", "B/s"),
+                             ("weight_swap_ms", "ms")):
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": unit, "error": str(e)[-500:]}),
+                  flush=True)
+        return 1
+
+
 def main() -> int:
     stem = os.environ.get("HVD_BENCH_STEM", "conv7")
     model_name = os.environ.get("HVD_BENCH_MODEL", "resnet50")
@@ -633,5 +804,8 @@ if __name__ == "__main__":
     elif "--collectives" in sys.argv or \
             os.environ.get("HVD_BENCH_COLLECTIVES") == "1":
         sys.exit(run_collectives_benchmark())
+    elif "--redist" in sys.argv or \
+            os.environ.get("HVD_BENCH_REDIST") == "1":
+        sys.exit(run_redist_benchmark())
     else:
         sys.exit(main())

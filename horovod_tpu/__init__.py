@@ -72,5 +72,7 @@ from .checkpoint import (                                      # noqa: F401
 )
 from . import ckpt                                             # noqa: F401
 from .ckpt import ShardedCheckpointer                          # noqa: F401
+from . import redist                                           # noqa: F401
+from .redist import redistribute                               # noqa: F401
 
 __version__ = "0.2.0"

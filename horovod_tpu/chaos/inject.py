@@ -18,6 +18,11 @@ boundaries:
   must catch by CRC and recover via the buddy replica),
   ``delete_chunk`` removes a committed shard file, plus delay/crash on
   write/read/commit.
+* ``redist.transport``  — every redistribution wire exchange and
+  weight-stream chunk IO (redist/transport.py chaos_gate): delay,
+  drop/partition (surface as RedistError -> the collective disk
+  fallback), corrupt (one payload bit flipped — the per-frame crc32
+  must catch it), crash.
 * ``step``              — :func:`step_boundary`, called by the training
   loop (the soak worker does): crash (SIGKILL self — the host-loss
   scenario), slow_rank, delay.

@@ -48,7 +48,8 @@ FAULT_KINDS = ("delay", "drop", "crash", "corrupt", "partition",
                "slow_rank", "torn_write", "delete_chunk")
 
 FAULT_SITES = ("step", "store.request", "p2p.send", "p2p.recv",
-               "ckpt.write", "ckpt.read", "ckpt.commit")
+               "ckpt.write", "ckpt.read", "ckpt.commit",
+               "redist.transport")
 
 #: which kinds are meaningful at which sites (a drop needs a connection
 #: to sever; a torn write needs a shard file; ...)
@@ -56,9 +57,11 @@ _KIND_SITES = {
     "delay": FAULT_SITES,
     "slow_rank": ("step",),
     "crash": FAULT_SITES,
-    "drop": ("store.request", "p2p.send", "p2p.recv"),
-    "corrupt": ("store.request", "p2p.send"),
-    "partition": ("store.request", "p2p.send", "p2p.recv"),
+    "drop": ("store.request", "p2p.send", "p2p.recv",
+             "redist.transport"),
+    "corrupt": ("store.request", "p2p.send", "redist.transport"),
+    "partition": ("store.request", "p2p.send", "p2p.recv",
+                  "redist.transport"),
     "torn_write": ("ckpt.write",),
     "delete_chunk": ("ckpt.commit",),
 }

@@ -336,6 +336,12 @@ class FileBackedState(State):
         tree = self._ckpt.restore(step, target=target)
         self._values.update(tree)
         self.save()
+        # a loaded disk commit IS committed state: advance the liveness
+        # serial so the in-memory redistribution plane (redist/
+        # elastic.py) counts this rank as a holder on the next reset.
+        # All ranks load the same commit collectively, so the serial
+        # stays rank-invariant.
+        self._commit_serial = max(self._commit_serial, 1)
         # The loaded commit IS the persisted tree: seed the change
         # detector so the next no-op commit() skips its disk write —
         # but ONLY when the checkpoint covered every live field. A

@@ -45,35 +45,28 @@ def plan_reshard(man: dict, new_world: int,
     GLOBAL row coordinates (``rows`` is None for replicated leaves,
     which target rank 0 reads whole). Ops are emitted in leaf order —
     the same order blobs are packed in — so planner and assembler agree
-    byte-for-byte."""
+    byte-for-byte.
+
+    The overlap math itself lives in the shared plan layer
+    (redist/plan.py plan_redistribute — row->row); this wrapper binds it
+    to a manifest and verifies every planned source chunk actually
+    exists there. Lazy import: redist imports the ckpt package, so a
+    module-level import here would be circular."""
+    from ..redist.plan import Spec, plan_redistribute
     if new_world < 1:
         raise CkptError(f"new world must be >= 1; got {new_world}")
     idx = _chunk_index(man)
-    targets = range(new_world) if target_rank is None else [target_rank]
-    plans: Dict[int, List[dict]] = {t: [] for t in targets}
-    for i, e in enumerate(man["leaves"]):
-        if e["kind"] != "array":
-            continue
-        if e["partition"] == "rep":
-            if 0 in plans:
-                plans[0].append({"leaf": i, "src": 0, "rows": None})
-            continue
-        n = e["shape"][0]
-        sb = row_bounds(n, man["world"])
-        for t in targets:
-            tb = row_bounds(n, new_world)
-            tlo, thi = tb[t], tb[t + 1]
-            if thi <= tlo:
-                continue
-            for s in range(man["world"]):
-                lo, hi = max(tlo, sb[s]), min(thi, sb[s + 1])
-                if hi > lo:
-                    if (s, i) not in idx:
-                        raise CkptError(
-                            f"manifest names no chunk for leaf {i} on "
-                            f"shard {s} but rows [{lo}, {hi}) map there")
-                    plans[t].append({"leaf": i, "src": s,
-                                     "rows": [lo, hi]})
+    plans = plan_redistribute(man["leaves"], Spec.row(man["world"]),
+                              Spec.row(new_world),
+                              target_rank=target_rank)
+    for t, ops in plans.items():
+        for op in ops:
+            if op["rows"] is not None and \
+                    (op["src"], op["leaf"]) not in idx:
+                lo, hi = op["rows"]
+                raise CkptError(
+                    f"manifest names no chunk for leaf {op['leaf']} on "
+                    f"shard {op['src']} but rows [{lo}, {hi}) map there")
     return plans
 
 

@@ -175,6 +175,14 @@ class Config:
     # on-disk commit on (re)entry — through the reshard plan when the
     # world size changed (HOROVOD_CKPT_AUTO_RESTORE).
     ckpt_auto_restore: bool = False
+    # Redistribution plane (horovod_tpu/redist): elastic (re)entries
+    # first try the IN-MEMORY restore — surviving holders redistribute
+    # committed state over the wire, falling back to the checkpoint
+    # only when state was actually lost (HOROVOD_REDIST_ELASTIC).
+    redist_elastic: bool = True
+    # Bounded-memory transfer granularity: per-rank send/receive bytes
+    # per redistribution round (HOROVOD_REDIST_CHUNK_BYTES).
+    redist_chunk_bytes: int = 16 * 1024 * 1024
     # Chaos plane (horovod_tpu/chaos): declarative seeded fault plan —
     # inline JSON or a path to a JSON file (HOROVOD_CHAOS_PLAN). None
     # leaves every injection shim a byte-identical pass-through.
@@ -296,6 +304,10 @@ class Config:
             "HOROVOD_CKPT_REPLICATE", c.ckpt_replicate)
         c.ckpt_auto_restore = _env_bool(
             "HOROVOD_CKPT_AUTO_RESTORE", c.ckpt_auto_restore)
+        c.redist_elastic = _env_bool(
+            "HOROVOD_REDIST_ELASTIC", c.redist_elastic)
+        c.redist_chunk_bytes = _env_int_strict(
+            "HOROVOD_REDIST_CHUNK_BYTES", c.redist_chunk_bytes)
         # Chaos knobs parse strictly (same contract): a typo'd plan or
         # heartbeat period must fail at startup — a soak run that
         # silently injected nothing would "prove" recovery it never
@@ -414,6 +426,12 @@ class Config:
             raise ValueError(
                 f"HOROVOD_CKPT_MAX_TO_KEEP must be an int in "
                 f"[0, 1000000] (0 keeps every checkpoint); got {mk!r}")
+        rc = self.redist_chunk_bytes
+        if not isinstance(rc, int) or not (4096 <= rc <= 1 << 31):
+            raise ValueError(
+                f"HOROVOD_REDIST_CHUNK_BYTES must be an int in "
+                f"[4096, {1 << 31}] (per-rank bytes per "
+                f"redistribution round); got {rc!r}")
         hi = self.heartbeat_interval_s
         if not isinstance(hi, (int, float)) or not (0 <= hi <= 3600):
             raise ValueError(

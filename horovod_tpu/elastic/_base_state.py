@@ -28,6 +28,9 @@ class BaseFrameworkState:
         self._extras: Dict[str, Any] = dict(extras)
         self._saved = None
         self._reset_callbacks: List[Callable] = []
+        # same liveness token as elastic/state.py State.commit_serial
+        # (the jax State keeps its own implementation — change BOTH)
+        self._commit_serial = -1
         self.commit()
 
     def __getattr__(self, name):
@@ -58,8 +61,15 @@ class BaseFrameworkState:
         self._saved = {"extras": copy.deepcopy(self._extras),
                        "payload": self._save_payload()}
 
+    @property
+    def commit_serial(self) -> int:
+        """Monotone count of commit() calls (0 = construction only) —
+        the redist/elastic.py holder-election token."""
+        return self._commit_serial
+
     def commit(self) -> None:
         self.save()
+        self._commit_serial += 1
 
     def restore(self) -> None:
         self._extras = copy.deepcopy(self._saved["extras"])

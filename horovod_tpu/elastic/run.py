@@ -51,27 +51,48 @@ def run(func: Callable) -> Callable:
             try:
                 if not basics.is_initialized():
                     basics.init()
-                # HOROVOD_CKPT_AUTO_RESTORE: a (re)launched worker —
-                # the elastic driver restarts processes on every reset,
-                # possibly with a different world size — resumes from
-                # the state's last disk commit before the first sync.
-                # The ckpt backend reshards N->M automatically, so a
-                # topology change resumes instead of aborting. Only
-                # once per process: in-process resets roll back via the
-                # in-memory snapshot below, which is already current.
-                if not restored_from_disk:
-                    if basics.get_config().ckpt_auto_restore and \
-                            state.load_latest():
-                        logger.info(
-                            "elastic: auto-restored state from last "
-                            "disk commit (reset epoch %s)",
-                            os.environ.get("HOROVOD_CKPT_RESET_EPOCH",
-                                           "0"))
-                    # marked done only AFTER the attempt succeeded: a
-                    # collective load_latest interrupted by a comm
-                    # failure must retry on the next loop, not fall
-                    # through to training from initial state
-                    restored_from_disk = True
+                # HOROVOD_CKPT_AUTO_RESTORE: resume from committed
+                # state before the first sync on this plane. The
+                # in-memory path goes first (HOROVOD_REDIST_ELASTIC):
+                # a collective probe elects the ranks still holding the
+                # current commit and redistributes it over the wire —
+                # zero checkpoint reads (redist/elastic.py). Every rank
+                # of every incarnation runs the probe at this same
+                # point, so survivors re-entering after a reset and
+                # fresh joiners entering for the first time meet in the
+                # same collective. Only when no rank holds live state
+                # (a full process restart) does the disk fallback run —
+                # the ckpt backend reshards N->M automatically, so a
+                # topology change resumes instead of aborting; disk is
+                # tried once per process (in-process resets roll back
+                # via the in-memory snapshot below, already current).
+                cfg = basics.get_config()
+                if cfg.ckpt_auto_restore:
+                    restored_mem = False
+                    if cfg.redist_elastic:
+                        from ..redist.elastic import elastic_restore
+                        restored_mem = elastic_restore(state)
+                        if restored_mem:
+                            logger.info(
+                                "elastic: state restored in memory "
+                                "over the redistribution plane (no "
+                                "checkpoint reads, reset epoch %s)",
+                                os.environ.get(
+                                    "HOROVOD_CKPT_RESET_EPOCH", "0"))
+                    if restored_mem:
+                        restored_from_disk = True
+                    elif not restored_from_disk:
+                        if state.load_latest():
+                            logger.info(
+                                "elastic: auto-restored state from "
+                                "last disk commit (reset epoch %s)",
+                                os.environ.get(
+                                    "HOROVOD_CKPT_RESET_EPOCH", "0"))
+                        # marked done only AFTER the attempt succeeded:
+                        # a collective load_latest interrupted by a
+                        # comm failure must retry on the next loop, not
+                        # fall through to training from initial state
+                        restored_from_disk = True
                 state.sync()
                 if recovery_t0 is not None:
                     # recovered: the state is consistent on the new

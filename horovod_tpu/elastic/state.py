@@ -35,6 +35,12 @@ class State:
         self._saved: Dict[str, Any] = {}
         self._reset_callbacks: List[Callable] = []
         self._values: Dict[str, Any] = {}
+        # commit() calls since construction (the constructor's initial
+        # snapshot counts as 0): the liveness token the in-memory
+        # redistribution plane (redist/elastic.py) compares across
+        # ranks — a rank at serial 0 holds only initial values, a rank
+        # at the fleet-max serial holds the current committed state
+        self._commit_serial = -1
         for k, v in kwargs.items():
             self._values[k] = v
         self.commit()
@@ -68,9 +74,18 @@ class State:
             return np.asarray(v).copy()
         return copy.deepcopy(v)
 
+    @property
+    def commit_serial(self) -> int:
+        """Monotone count of commit() calls (0 = never committed past
+        construction). Commits are collective in training loops, so
+        equal serials across ranks mean equal committed state — what
+        redist/elastic.py keys its holder election on."""
+        return self._commit_serial
+
     def commit(self) -> None:
         """Save + sync point (common/elastic.py commit)."""
         self.save()
+        self._commit_serial += 1
 
     def restore(self) -> None:
         """Roll back to the last commit (common/elastic.py restore)."""

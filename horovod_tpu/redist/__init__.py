@@ -1,0 +1,38 @@
+"""horovod_tpu.redist: live N->M weight redistribution over the wire.
+
+The plane that moves parameter trees between layouts and worlds WITHOUT
+a filesystem round trip, split cleanly into **plan** and **transport**
+(PAPERS.md: "Memory-efficient array redistribution through portable
+collective communication"):
+
+    plan.py       pure overlap math: Spec (row/full layouts),
+                  plan_redistribute, bounded-round scheduling — the
+                  layer ckpt/reshard.py now consumes instead of owning
+    transport.py  interchangeable data planes: p2p ring alltoall,
+                  coordinator allgather, disk-backed ckpt (fallback);
+                  chaos fault site ``redist.transport``
+    core.py       redistribute(tree, src, dst, transport=...) — chunked
+                  bounded-memory transfers, per-frame crc32, no-copy
+                  N==M identity
+    elastic.py    elastic consumer: survivors of a reset redistribute
+                  committed state in memory (zero checkpoint reads);
+                  fallback to ckpt auto-restore decided COLLECTIVELY
+    stream.py     training->serving hot weight streaming: versioned
+                  publisher/subscriber over the native KV, monotone
+                  adoption, serve hot-swap between decode iterations
+
+Knobs: ``HOROVOD_REDIST_ELASTIC`` (in-memory elastic restore on/off),
+``HOROVOD_REDIST_CHUNK_BYTES`` (per-rank bytes per round).
+Observability: ``hvd_redist_bytes_total{transport}``,
+``hvd_redist_ms``, ``hvd_weight_swap_ms``, REDIST/SWAP timeline rows.
+See docs/redistribution.md.
+"""
+from .plan import (                                            # noqa: F401
+    RedistError, Spec, plan_redistribute, row_bounds, schedule_rounds,
+)
+from .transport import (                                       # noqa: F401
+    CkptTransport, CoordTransport, RingTransport,
+)
+from .core import redistribute                                 # noqa: F401
+from .stream import WeightPublisher, WeightSubscriber          # noqa: F401
+from .elastic import elastic_restore                           # noqa: F401

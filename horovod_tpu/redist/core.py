@@ -1,0 +1,463 @@
+"""``redistribute()`` — move a pytree from one layout/world to another.
+
+The orchestrator that executes a redistribution plan (redist/plan.py)
+over an interchangeable transport (redist/transport.py):
+
+1. flatten the local tree (receivers pass their *template* tree — same
+   shapes/dtypes, stale contents) and derive the leaf table;
+2. compute the pure global plan; ops whose source is this rank and
+   whose target is this rank are satisfied by local slicing, never
+   touching the wire;
+3. execute the wire ops in bounded rounds (``schedule_rounds`` caps
+   per-rank send AND receive bytes per round at
+   ``HOROVOD_REDIST_CHUNK_BYTES``), each round one transport exchange;
+   every frame carries a crc32 verified on receipt;
+4. assemble the destination layout and unflatten with the local
+   treedef.
+
+``src == dst`` is a true no-copy identity: the input tree object is
+returned untouched (no flatten, no exchange). A ``kind == "disk"``
+transport (CkptTransport) routes through a sharded-checkpoint
+save + reshard-restore round trip instead — same call site, different
+data plane, which is what lets elastic fall back from the ring to disk
+without a second code path.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .plan import (RedistError, Spec, op_nbytes, plan_redistribute,
+                   row_bounds, schedule_rounds)
+
+#: per-frame wire header: leaf u32, flags u32, lo i64, hi i64,
+#: nbytes i64, crc32 u32 — followed by exactly nbytes of payload
+_FRAME = struct.Struct("<IIqqqI")
+#: per-destination payload header: magic, plan crc32, frame count
+_HDR = struct.Struct("<4sII")
+_MAGIC = b"RDX1"
+_F_PYOBJ = 1      # payload is a pickled python leaf
+_F_WHOLE = 2      # payload is a whole (replicated / 0-d) array leaf
+
+#: measured sweet spot on the CPU container (bench.py --redist / the
+#: /tmp chunk sweep behind it): 16MB rounds pipeline frame building
+#: against the ring relay ~2x better than one monolithic round, and
+#: bound per-rank staging memory tighter
+DEFAULT_CHUNK_BYTES = 16 * 1024 * 1024
+
+#: single-sourced help strings (the WIRE_BYTES_HELP discipline): the
+#: registry keeps whichever help registers first, so every site —
+#: core wire path, disk path, weight stream — must share one literal
+REDIST_BYTES_HELP = "redistribution bytes sent over the transport"
+REDIST_MS_HELP = "one redistribute() call, plan -> assembled tree"
+
+
+def _chunk_bytes(override: Optional[int]) -> int:
+    if override is not None:
+        return int(override)
+    try:
+        from ..core import basics
+        if basics.is_initialized():
+            return basics.get_config().redist_chunk_bytes
+    except Exception:  # noqa: BLE001 — config must never block a move
+        pass
+    import os
+    v = os.environ.get("HOROVOD_REDIST_CHUNK_BYTES")
+    return int(v) if v else DEFAULT_CHUNK_BYTES
+
+
+def _obs(transport_name: str):
+    """Lazy redist metric handles (shared process registry)."""
+    from ..obs import metrics as m
+    R = m.get_registry()
+    return (R.counter("hvd_redist_bytes_total", REDIST_BYTES_HELP,
+                      {"transport": transport_name}),
+            R.histogram("hvd_redist_ms", REDIST_MS_HELP))
+
+
+def _timeline_instant(args: dict) -> None:
+    """One REDIST row on the live timeline (no-op without one)."""
+    try:
+        from ..core import basics
+        tl = basics.get_state().timeline
+        if tl is not None:
+            tl.instant("REDIST", args)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _is_identity(src: Spec, dst: Spec) -> bool:
+    """src == dst with every rank holding its data already — the
+    degenerate N==M fast path the caller gets back object-identical."""
+    if src.layout != dst.layout or src.world != dst.world:
+        return False
+    if src.layout == "full":
+        return src.holder_list() == dst.holder_list() \
+            and len(src.holder_list()) == src.world
+    return True
+
+
+def _plan_crc(entries: List[dict], src: Spec, dst: Spec,
+              chunk: int) -> int:
+    """Fingerprint of everything the round schedule derives from —
+    leaf table, specs, AND the chunk size (a per-host
+    HOROVOD_REDIST_CHUNK_BYTES drift would otherwise produce diverging
+    round schedules that surface as phantom corruption or a ring
+    timeout instead of this clean refusal). pyobj VALUES are excluded —
+    receivers hold stale template values by design; only the tree's
+    shape (paths/dtypes/shapes/partitions) must agree."""
+    canon = [{k: e.get(k) for k in
+              ("path", "kind", "dtype", "shape", "partition")}
+             for e in entries]
+    blob = json.dumps(
+        [canon, src.world, src.layout, src.holder_list(),
+         dst.world, dst.layout, int(chunk)], sort_keys=True).encode()
+    return zlib.crc32(blob)
+
+
+def _src_base(entry: dict, src: Spec, rank: int) -> int:
+    """Global row index of this source rank's first local row."""
+    if src.layout == "full":
+        return 0
+    return row_bounds(entry["shape"][0], src.world)[rank]
+
+
+def _frame(entries: List[dict], leaves_np: List[Any], src: Spec,
+           rank: int, op: dict) -> bytes:
+    """Serialize one op's payload from the local leaves."""
+    i = op["leaf"]
+    e = entries[i]
+    if op.get("pyobj"):
+        import pickle
+        raw = pickle.dumps(leaves_np[i])
+        flags = _F_PYOBJ
+        lo = hi = 0
+    elif op["rows"] is None:
+        raw = np.ascontiguousarray(leaves_np[i]).tobytes()
+        flags = _F_WHOLE
+        lo = hi = 0
+    else:
+        lo, hi = op["rows"]
+        base = _src_base(e, src, rank)
+        arr = leaves_np[i][lo - base:hi - base]
+        if arr.shape[0] != hi - lo:
+            raise RedistError(
+                f"local leaf {i} ({e['path']!r}) holds rows "
+                f"[{base}, {base + leaves_np[i].shape[0]}) but the plan "
+                f"asked this rank for [{lo}, {hi})")
+        raw = np.ascontiguousarray(arr).tobytes()
+        flags = 0
+    return _FRAME.pack(i, flags, lo, hi, len(raw),
+                       zlib.crc32(raw)) + raw
+
+
+def redistribute(tree: Any, src: Spec, dst: Spec, transport=None, *,
+                 tag: str = "redist",
+                 max_chunk_bytes: Optional[int] = None,
+                 entries: Optional[List[dict]] = None) -> Any:
+    """Redistribute ``tree`` from layout ``src`` to layout ``dst`` over
+    ``transport``; returns the tree in the destination layout (numpy
+    leaves), or ``None`` on ranks outside the destination world.
+
+    Every participating rank passes a structurally identical ``tree``
+    (receivers: their template — live shapes, stale contents; sources:
+    the live data). ``src == dst`` returns the INPUT OBJECT untouched.
+    For ``src.layout == "row"`` the local leaves are this rank's
+    row-blocks; the GLOBAL leaf table must then be supplied via
+    ``entries`` (a manifest-style leaf list) since it is not derivable
+    from a local flatten.
+
+    Bounded memory: wire ops are executed in rounds capped at
+    ``max_chunk_bytes`` (default ``HOROVOD_REDIST_CHUNK_BYTES``) per
+    rank per direction; each frame is crc32-verified on receipt and a
+    missing or corrupt frame raises :class:`RedistError` naming the
+    leaf — never a silently wrong tree. Leaves that did not move (a
+    holder target's full-span self-serve) may ALIAS the input tree's
+    arrays in the returned tree.
+    """
+    if _is_identity(src, dst):
+        return tree
+    if transport is None:
+        raise RedistError(
+            "redistribute() needs a transport unless src == dst "
+            "(the no-copy identity)")
+    t0 = time.perf_counter()
+    r, world = transport.rank, transport.world
+    # spec-vs-transport validation BEFORE the backend dispatch: a
+    # mis-specced disk call must fail fast here, not by a 300s
+    # visibility-poll timeout with no writer
+    if dst.world > world:
+        raise RedistError(
+            f"destination world {dst.world} exceeds transport world "
+            f"{world}")
+    if max(src.holder_list()) >= world:
+        raise RedistError(
+            f"source ranks {src.holder_list()} exceed transport world "
+            f"{world}")
+    if getattr(transport, "kind", "wire") == "disk":
+        return _redistribute_disk(tree, src, dst, transport, tag, t0)
+    from ..ckpt.snapshot import host_snapshot
+    from ..ckpt.store import _leaf_entry
+    paths, leaves_np, treedef = host_snapshot(tree, copy_np=False)
+    if entries is None:
+        if src.layout == "row":
+            raise RedistError(
+                "src layout 'row' needs the GLOBAL leaf table via "
+                "entries= (local leaves are row-blocks; global shapes "
+                "are not derivable from them)")
+        entries = [_leaf_entry(p, l) for p, l in zip(paths, leaves_np)]
+    if len(entries) != len(leaves_np):
+        raise RedistError(
+            f"leaf table has {len(entries)} entries but the local tree "
+            f"flattened to {len(leaves_np)} leaves")
+    chunk = _chunk_bytes(max_chunk_bytes)
+    crc = _plan_crc(entries, src, dst, chunk)
+    plans = plan_redistribute(entries, src, dst, include_pyobj=True)
+    my_plan = plans.get(r, [])
+    is_target = r < dst.world
+
+    # -- destination buffers + local ops (no wire) ------------------------
+    out: List[Any] = [None] * len(entries)
+    dst_base: Dict[int, int] = {}
+    if is_target:
+        for i, e in enumerate(entries):
+            if e["kind"] != "array":
+                out[i] = leaves_np[i]          # template value; a pyobj
+                continue                       # frame may overwrite it
+            shape = tuple(e["shape"])
+            if e["partition"] == "rep":
+                # row-layout destinations deliver rep leaves to target
+                # 0 only (the ckpt shard convention): other targets
+                # keep their template value rather than uninitialized
+                # memory
+                out[i] = np.asarray(leaves_np[i],
+                                    np.dtype(e["dtype"])).copy()
+                continue
+            if dst.layout == "row":
+                b = row_bounds(shape[0], dst.world)
+                dst_base[i] = b[r]
+                shape = (b[r + 1] - b[r],) + shape[1:]
+            out[i] = np.empty(shape, np.dtype(e["dtype"]))
+        for op in my_plan:
+            if op["src"] != r:
+                continue
+            i = op["leaf"]
+            e = entries[i]
+            if op.get("pyobj"):
+                out[i] = leaves_np[i]
+            elif op["rows"] is None:
+                out[i] = np.asarray(leaves_np[i],
+                                    np.dtype(e["dtype"])).copy()
+            else:
+                lo, hi = op["rows"]
+                base = _src_base(e, src, r)
+                if lo == 0 and base == 0 and hi == e["shape"][0] \
+                        and dst_base.get(i, 0) == 0:
+                    # full-span self-serve (a holder target): the local
+                    # leaf IS the destination block — alias it instead
+                    # of a whole-leaf memcpy (multi-GB trees on elastic
+                    # holders move zero bytes AND copy zero bytes)
+                    out[i] = leaves_np[i]
+                    continue
+                out[i][lo - dst_base.get(i, 0):
+                       hi - dst_base.get(i, 0)] = \
+                    leaves_np[i][lo - base:hi - base]
+
+    # -- wire rounds ------------------------------------------------------
+    # the expectation ledger is built from the ROUND SCHEDULE (chunked
+    # pieces), not the raw plan, so it matches the frames byte-for-byte
+    rounds = schedule_rounds(plans, entries, chunk)
+    expected: Dict[Tuple[int, int, int, int], int] = {}
+    if is_target:
+        for rnd in rounds:
+            for t, op in rnd:
+                if t != r or op["src"] == r:
+                    continue
+                lo, hi = op["rows"] if op["rows"] is not None else (0, 0)
+                key = (op["leaf"], op["src"], lo, hi)
+                expected[key] = expected.get(key, 0) + 1
+    sent_bytes = recv_bytes = 0
+    for k, rnd in enumerate(rounds):
+        frames: Dict[int, List[bytes]] = {}
+        round_total = 0
+        for t, op in rnd:
+            round_total += op_nbytes(op, entries)
+            if op["src"] != r or t == r:
+                continue
+            frames.setdefault(t, []).append(
+                _frame(entries, leaves_np, src, r, op))
+        outgoing = {d: _HDR.pack(_MAGIC, crc, len(fs)) + b"".join(fs)
+                    for d, fs in frames.items()}
+        sent_bytes += sum(len(p) for p in outgoing.values())
+        incoming = transport.exchange(
+            outgoing, tag=f"{tag}.r{k}",
+            max_bytes_hint=round_total + _FRAME.size * len(rnd)
+            + _HDR.size * world)
+        for s, payload in sorted(incoming.items()):
+            recv_bytes += len(payload)
+            _consume(payload, s, crc, entries, src, dst, r, dst_base,
+                     out, expected, tag)
+    if expected:
+        missing = sorted(expected)[:4]
+        raise RedistError(
+            f"redistribution {tag!r} incomplete on rank {r}: "
+            f"{len(expected)} expected block(s) never arrived "
+            f"(first: {missing})")
+
+    ms = (time.perf_counter() - t0) * 1000.0
+    try:
+        ctr, hist = _obs(transport.name)
+        ctr.inc(sent_bytes)
+        hist.observe(ms)
+    except Exception:  # noqa: BLE001 — obs must never block the move
+        pass
+    _timeline_instant({"transport": transport.name, "rank": r,
+                       "ms": round(ms, 3), "bytes_sent": sent_bytes,
+                       "bytes_recv": recv_bytes, "rounds": len(rounds),
+                       "src": f"{src.layout}/{src.world}",
+                       "dst": f"{dst.layout}/{dst.world}"})
+    if not is_target:
+        return None
+    import jax
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _consume(payload: bytes, src_rank: int, crc: int,
+             entries: List[dict], src: Spec, dst: Spec, r: int,
+             dst_base: Dict[int, int], out: List[Any],
+             expected: Dict[Tuple[int, int, int, int], int],
+             tag: str) -> None:
+    """Parse + verify one incoming per-source payload into ``out``."""
+    if len(payload) < _HDR.size:
+        raise RedistError(
+            f"truncated redistribution payload from rank {src_rank} "
+            f"({tag!r}): {len(payload)} bytes")
+    magic, their_crc, _ = _HDR.unpack_from(payload, 0)
+    if magic != _MAGIC:
+        raise RedistError(
+            f"bad redistribution payload magic from rank {src_rank} "
+            f"({tag!r})")
+    if their_crc != crc:
+        raise RedistError(
+            f"redistribution plan mismatch with rank {src_rank} "
+            f"({tag!r}): the two ranks derived different leaf tables "
+            f"or specs — refusing to assemble a torn tree")
+    off = _HDR.size
+    while off < len(payload):
+        if off + _FRAME.size > len(payload):
+            raise RedistError(
+                f"truncated frame header from rank {src_rank} ({tag!r})")
+        leaf, flags, lo, hi, nbytes, fcrc = _FRAME.unpack_from(
+            payload, off)
+        off += _FRAME.size
+        raw = payload[off:off + nbytes]
+        off += nbytes
+        if len(raw) != nbytes:
+            raise RedistError(
+                f"short frame for leaf {leaf} from rank {src_rank} "
+                f"({tag!r}): {len(raw)} of {nbytes} bytes")
+        if zlib.crc32(raw) != fcrc:
+            e = entries[leaf] if leaf < len(entries) else {}
+            raise RedistError(
+                f"crc32 mismatch on leaf {leaf} "
+                f"({e.get('path')!r}, rows [{lo}, {hi})) from rank "
+                f"{src_rank} ({tag!r}) — transport corrupted the "
+                f"payload; refusing to assemble")
+        if leaf >= len(entries):
+            raise RedistError(
+                f"frame names leaf {leaf} beyond the table "
+                f"({len(entries)} leaves) from rank {src_rank}")
+        e = entries[leaf]
+        key = (leaf, src_rank, lo, hi)
+        if key not in expected:
+            raise RedistError(
+                f"unexpected block {key} from rank {src_rank} "
+                f"({tag!r}) — not in this rank's plan")
+        expected[key] -= 1
+        if expected[key] == 0:
+            del expected[key]
+        if flags & _F_PYOBJ:
+            import pickle
+            out[leaf] = pickle.loads(raw)
+        elif flags & _F_WHOLE:
+            out[leaf] = np.frombuffer(
+                raw, np.dtype(e["dtype"])).reshape(e["shape"]).copy()
+        else:
+            trail = tuple(e["shape"][1:])
+            block = np.frombuffer(raw, np.dtype(e["dtype"])).reshape(
+                (hi - lo,) + trail)
+            base = dst_base.get(leaf, 0)
+            out[leaf][lo - base:hi - base] = block
+
+
+def _redistribute_disk(tree: Any, src: Spec, dst: Spec, transport,
+                       tag: str, t0: float) -> Any:
+    """The CkptTransport path: sources persist through the sharded
+    checkpoint store, targets restore through the reshard-overlap plan.
+    Slower than the wire (2x disk + fsync) but survives total loss of
+    in-memory state — the elastic fallback."""
+    from ..ckpt.store import ShardedCheckpointer, list_steps
+    if dst.layout != "full":
+        raise RedistError(
+            "the disk transport restores full trees only "
+            "(dst layout 'full')")
+    if src.layout == "row":
+        raise RedistError(
+            "the disk transport moves full-layout sources only — a "
+            "row-sharded source already has a manifest; restore it "
+            "through the ckpt plane (restore_resharded) instead")
+    r = transport.rank
+    # the step is derived from (call tag, transport call counter) —
+    # both rank-invariant, together unique per logical call even when
+    # one transport/directory is reused with the default tag: readers
+    # polling for visibility below must wait for THIS call's commit,
+    # not find a previous call's step and restore stale state
+    seq = transport.next_seq()
+    step = zlib.crc32(f"{tag}.{seq}".encode()) % 100_000_000
+    if r == src.holder_list()[0]:
+        ck = ShardedCheckpointer(
+            transport.directory, rank=0, world=1, async_save=False,
+            replicate=False, commit_timeout=transport.timeout)
+        ck.save(step, tree, force=True)
+        ck.close()
+    # commit visibility barrier: poll the shared directory (works with
+    # or without a coordinator; the committer raised if a writer died)
+    deadline = time.monotonic() + transport.timeout
+    while step not in list_steps(transport.directory):
+        if time.monotonic() >= deadline:
+            raise RedistError(
+                f"disk redistribution {tag!r}: commit never became "
+                f"visible within {transport.timeout:g}s")
+        time.sleep(0.005)
+    if transport.coordinator is not None:
+        transport.coordinator.barrier(tag=f"{tag}.disk")
+    if r >= dst.world:
+        return None
+    ck = ShardedCheckpointer(
+        transport.directory, rank=r, world=dst.world, async_save=False,
+        replicate=False, commit_timeout=transport.timeout)
+    try:
+        out = ck.restore(step, target=tree, via="local")
+    finally:
+        ck.close()
+    ms = (time.perf_counter() - t0) * 1000.0
+    try:
+        # disk BYTES are accounted by the ckpt plane's own counters
+        # (hvd_ckpt_bytes_total): only the redistribution latency is
+        # recorded here — deliberately no {transport="ckpt"} byte
+        # counter child, which would permanently read 0
+        from ..obs import metrics as m
+        m.get_registry().histogram("hvd_redist_ms",
+                                   REDIST_MS_HELP).observe(ms)
+    except Exception:  # noqa: BLE001
+        pass
+    _timeline_instant({"transport": transport.name, "rank": r,
+                       "ms": round(ms, 3),
+                       "src": f"{src.layout}/{src.world}",
+                       "dst": f"{dst.layout}/{dst.world}"})
+    return out

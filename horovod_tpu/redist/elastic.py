@@ -1,0 +1,193 @@
+"""The elastic consumer: in-memory state restore after a reset.
+
+Before this plane existed, every elastic (re)entry that wanted its
+state back round-tripped through the last committed checkpoint on a
+shared filesystem — even when surviving processes still held the exact
+committed tree in memory. :func:`elastic_restore` replaces that default
+with a collective three-step:
+
+1. **probe** — one coordinator allgather of each rank's
+   ``state.commit_serial`` (the liveness token elastic states carry).
+   Ranks at the fleet-max serial are *holders* of the current committed
+   state; ranks below it (fresh joiners, or survivors that lost their
+   snapshot) are receivers.
+2. **redistribute** — if any holder exists, receivers get the state
+   over the wire (``redistribute(..., Spec.full(holders) ->
+   Spec.full(world))``): the p2p ring when the launcher exported a KV
+   rendezvous, the coordinator allgather otherwise. Holders move ZERO
+   bytes for their own blocks; when every rank is already a current
+   holder the whole call is a no-op probe. No checkpoint file is read
+   on this path — the np4 acceptance test asserts the
+   ``hvd_ckpt_bytes_total{kind="read"}`` counter stays flat across it.
+3. **agree** — one coordinator bit-AND round decides success
+   COLLECTIVELY: a transport fault on any rank (chaos site
+   ``redist.transport``) sends EVERY rank down the ckpt auto-restore
+   fallback together — ranks can never split between the in-memory and
+   disk paths.
+
+Returns False (try disk) when there is no coordinator, no holder, or
+the collective vote failed; the caller (elastic/run.py) then runs the
+unchanged ``state.load_latest()`` fallback.
+
+Failure semantics: TRANSPORT faults are caught, rolled back and voted
+on (the whole fleet falls back together). A failure of the probe
+allgather or the vote itself — the control plane — is deliberately NOT
+caught: swallowing it locally would split the collective call sequence
+(peers proceed into exchanges this rank never joins), so it propagates
+like every other coordinator failure in this codebase
+(``load_latest`` has the identical exposure) and the elastic driver
+converts the worker exit into a clean reset.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import struct
+from typing import Optional
+
+from .core import redistribute
+from .plan import RedistError, Spec
+from .transport import CoordTransport, RingTransport, _kv_endpoint
+
+logger = logging.getLogger("horovod_tpu")
+
+#: per-process attempt counter; the fleet round id is the MAX across
+#: ranks so survivors (counter ahead) and fresh joiners (counter 0)
+#: still derive one shared id for ring prefixes and tags
+_attempts = 0
+
+_PROBE = struct.Struct("<qqB")
+
+
+def _values_dict(state):
+    """The state's named values, or None for state types the in-memory
+    plane does not cover. Framework states
+    (elastic/_base_state.py BaseFrameworkState: torch/keras/tf) keep
+    their REAL weights in ``_save_payload()``, not in ``_extras`` —
+    moving only the extras and claiming success would let a later
+    sync() broadcast a fresh joiner's reinitialized weights over the
+    fleet's committed ones. They fall back to the disk path until the
+    payload hook grows a redistribution surface."""
+    d = getattr(state, "_values", None)
+    return d if isinstance(d, dict) else None
+
+
+def elastic_restore(state, *, coord=None, transport=None,
+                    timeout: float = 300.0) -> bool:
+    """Collectively restore ``state`` in memory from surviving holders.
+
+    Every rank of the current plane must call this at the same point
+    (elastic/run.py does, once per wrapper-loop entry). Returns True
+    when the state is current on every rank afterwards (the disk
+    fallback must be skipped), False when the caller should fall back
+    to ``state.load_latest()``.
+    """
+    global _attempts
+    if coord is None:
+        from ..core import basics
+        coord = basics.get_coordinator() if basics.is_initialized() \
+            else None
+    if coord is None or coord.size <= 1:
+        return False
+    if _values_dict(state) is None:
+        # uniform across ranks (one state type per fleet), so skipping
+        # BEFORE the probe keeps the collective call sequence intact
+        logger.debug(
+            "elastic: %s keeps its weights outside _values — "
+            "in-memory redistribution skipped, disk path decides",
+            type(state).__name__)
+        return False
+    _attempts += 1
+    epoch = int(os.environ.get("HOROVOD_CKPT_RESET_EPOCH", "0"))
+    serial = int(getattr(state, "commit_serial", 0))
+    has = serial > 0
+    blobs = coord.allgather(
+        _PROBE.pack(serial, _attempts, 1 if has else 0),
+        tag=f"redist.probe.e{epoch}")
+    if len(blobs) != coord.size or any(len(b) != _PROBE.size
+                                       for b in blobs):
+        raise RedistError(
+            f"elastic redistribution probe returned {len(blobs)} "
+            f"malformed blob(s) for world {coord.size}")
+    probes = [_PROBE.unpack(b) for b in blobs]
+    rid = max(p[1] for p in probes)
+    _attempts = max(_attempts, rid)
+    held = [p[0] for p in probes if p[2]]
+    if not held:
+        return False                      # nobody survived: disk path
+    max_serial = max(held)
+    holders = tuple(r for r, p in enumerate(probes)
+                    if p[2] and p[0] == max_serial)
+    if len(holders) == coord.size:
+        # every rank already holds the current commit — nothing moves,
+        # nothing is read; the probe round IS the restore
+        return True
+    logger.info(
+        "elastic: redistributing committed state (serial %d) from "
+        "holders %s to %d rank(s) in memory", max_serial, list(holders),
+        coord.size - len(holders))
+    values = _values_dict(state)
+    owns_transport = False
+    ok = True
+    mutated = False
+    try:
+        if transport is None:
+            if _kv_endpoint() is not None:
+                transport = RingTransport.connect(
+                    coord.rank, coord.size,
+                    prefix=f"redist.e{epoch}.r{rid}",
+                    timeout=timeout, epoch=rid)
+            else:
+                transport = CoordTransport(coord)
+            owns_transport = True
+        src = Spec.full(coord.size, holders=holders)
+        dst = Spec.full(coord.size)
+        from ..elastic.state import _is_pytree_of_arrays
+        for k in sorted(values):
+            v = values[k]
+            if _is_pytree_of_arrays(v):
+                moved = redistribute(
+                    v, src, dst, transport,
+                    tag=f"redist.e{epoch}.r{rid}.{k}")
+                mutated = True
+                values[k] = moved
+            else:
+                # small python leaves (epoch/batch counters, tags) ride
+                # the control plane whole, pickled from the first holder
+                blob = pickle.dumps(v) if coord.rank == holders[0] \
+                    else None
+                out = coord.broadcast(
+                    blob, root=holders[0],
+                    tag=f"redist.obj.e{epoch}.r{rid}.{k}")
+                mutated = True
+                values[k] = pickle.loads(out)
+    except Exception as e:  # noqa: BLE001 — vote, then fall back as one
+        logger.warning(
+            "elastic: in-memory redistribution failed on rank %d "
+            "(%s); voting for the checkpoint fallback", coord.rank, e)
+        ok = False
+        if mutated:
+            # a failure mid-loop left a TORN mix (some values at the
+            # holders' commit, others stale): roll back to the
+            # pre-attempt snapshot so a memory-only state that later
+            # syncs from this rank never propagates the mix
+            try:
+                state.restore()
+            except Exception:  # noqa: BLE001 — fallback still decides
+                logger.warning(
+                    "elastic: post-failure rollback failed on rank %d",
+                    coord.rank)
+    finally:
+        if owns_transport and transport is not None:
+            transport.close()
+    bits = coord.bitand(bytes([1 if ok else 0]),
+                        tag=f"redist.ok.e{epoch}")
+    if not bits[0]:
+        return False
+    # adopt the holders' serial so the NEXT reset counts this rank as
+    # a holder too, then refresh the rollback snapshot: restore() after
+    # this point must reproduce the redistributed state
+    state._commit_serial = max_serial
+    state.save()
+    return True

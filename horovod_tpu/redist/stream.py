@@ -1,0 +1,338 @@
+"""Training -> serving hot weight streaming: no disk hop.
+
+A training job publishes versioned parameter snapshots into the native
+KV store's memory; a running serve fleet polls, verifies, and hot-swaps
+them between decode iterations. The online-learning path the north star
+asks for: fresh weights reach a live fleet without a checkpoint
+round-trip through a shared filesystem.
+
+Protocol (``hvdws-v1``), all in KV-server memory:
+
+* ``ws.<channel>.head``          — JSON: version, slot, chunk table
+  (nbytes + crc32 each), the manifest-style leaf table (pyobj leaves
+  ride here whole, like the ckpt manifest).
+* ``ws.<channel>.s<slot>.c<j>``  — raw payload chunks, leaf order.
+
+The publisher alternates between ``slots`` slot prefixes (default 2),
+writing every chunk BEFORE flipping the head — a reader always finds a
+complete slot behind the head, and server memory is bounded at
+``slots`` versions regardless of publish count. A subscriber that races
+an overwrite of the slot it is reading detects it by per-chunk crc32,
+re-reads the head, and simply skips to the newer version — torn reads
+are impossible to adopt by construction.
+
+Version adoption is MONOTONE per subscriber: ``poll()`` never returns a
+version <= the one already adopted, so replicas that poll at different
+cadences converge on the same latest version and never move backwards.
+The executor side of the fence (serve/executor.py ``swap_params``)
+guarantees no swap lands mid-step.
+
+Chaos: publish and fetch cross the ``redist.transport`` fault site —
+an injected ``corrupt`` is caught by the chunk crc32 exactly like a
+wire fault on the elastic path.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .plan import RedistError
+from .transport import chaos_gate
+
+logger = logging.getLogger("horovod_tpu")
+
+FORMAT = "hvdws-v1"
+
+
+def _resolve_client(client, kv_addr, kv_port, rank=None):
+    """(StoreClient, owns) — explicit client > explicit endpoint >
+    the launcher's HOROVOD_NATIVE_KV_ADDR/PORT export."""
+    if client is not None:
+        return client, False
+    import os
+    if kv_addr is None or kv_port is None:
+        kv_addr = os.environ.get("HOROVOD_NATIVE_KV_ADDR")
+        kv_port = os.environ.get("HOROVOD_NATIVE_KV_PORT")
+        if not kv_addr or not kv_port:
+            raise RedistError(
+                "weight streaming needs the native KV store — pass "
+                "kv_addr/kv_port (or a client) or export "
+                "HOROVOD_NATIVE_KV_ADDR/PORT")
+    from ..native.store import StoreClient
+    return StoreClient(socket.gethostbyname(kv_addr), int(kv_port),
+                       rank=rank), True
+
+
+def _stream_obs():
+    from ..obs import metrics as m
+    from .core import REDIST_BYTES_HELP
+    R = m.get_registry()
+    return R.counter("hvd_redist_bytes_total", REDIST_BYTES_HELP,
+                     {"transport": "stream"})
+
+
+class WeightPublisher:
+    """Publishes versioned parameter trees into the KV stream."""
+
+    def __init__(self, channel: str = "default", *,
+                 kv_addr: Optional[str] = None,
+                 kv_port: Optional[int] = None,
+                 client=None, slots: int = 2,
+                 chunk_bytes: int = 4 * 1024 * 1024,
+                 resume_timeout: float = 1.0):
+        if slots < 2:
+            raise RedistError(
+                f"weight streaming needs >= 2 slots (a reader must "
+                f"always have a complete slot behind the head); got "
+                f"{slots}")
+        if chunk_bytes < 4096:
+            raise RedistError(
+                f"chunk_bytes must be >= 4096; got {chunk_bytes}")
+        self.channel = channel
+        self.slots = int(slots)
+        self.chunk_bytes = int(chunk_bytes)
+        self._kv, self._owns = _resolve_client(client, kv_addr, kv_port)
+        # resume the channel's version sequence: a RESTARTED publisher
+        # (the elastic reality) must continue above the live head, or
+        # every subscriber would silently refuse its publishes forever
+        # under the monotone-adoption rule. The KV store cannot
+        # distinguish "key absent" from "store slow", so the resume
+        # probe waits a generous resume_timeout (a fresh channel pays
+        # it exactly once, at construction) rather than a tight poll
+        # that a busy store would mistake for a fresh channel.
+        self._version = 0
+        from ..native.store import NativeTimeout
+        try:
+            raw = self._kv.get(f"ws.{self.channel}.head",
+                               timeout=max(float(resume_timeout),
+                                           0.001))
+            head = json.loads(raw.decode())
+            if head.get("format") == FORMAT:
+                self._version = int(head["version"])
+        except (NativeTimeout, ValueError, KeyError, TypeError):
+            pass                         # fresh channel
+
+    def publish(self, tree: Any, version: Optional[int] = None) -> int:
+        """Snapshot ``tree`` to host and publish it; returns the
+        version. Versions must be strictly increasing per publisher
+        (default: last + 1)."""
+        from ..ckpt.snapshot import host_snapshot
+        paths, leaves, _ = host_snapshot(tree, copy_np=False)
+        return self.publish_flat(paths, leaves, version=version)
+
+    def publish_flat(self, paths: List[str], leaves: List[Any],
+                     version: Optional[int] = None) -> int:
+        """Publish an already-flattened (paths, leaves) pair — the
+        jax-free entry tools/weights_push.py uses."""
+        from ..ckpt.store import _leaf_entry
+        v = self._version + 1 if version is None else int(version)
+        if v <= self._version:
+            raise RedistError(
+                f"weight-stream versions must be strictly increasing; "
+                f"got {v} after {self._version}")
+        entries = [_leaf_entry(p, l) for p, l in zip(paths, leaves)]
+        slot = v % self.slots
+        # STREAM the chunks: leaf bytes flow through one chunk-sized
+        # staging buffer instead of a monolithic join of the whole tree
+        # (a multi-GB publish must cost ~chunk_bytes extra memory, not
+        # 2x the tree — the plane's bounded-memory discipline). crc is
+        # computed over the ORIGINAL bytes, THEN the chaos gate, so an
+        # injected publish-side corruption lands in the stored chunk
+        # but not its checksum and the subscriber's verify catches it.
+        table: List[dict] = []
+        total = 0
+
+        def emit(raw: bytes) -> None:
+            j = len(table)
+            table.append({"nbytes": len(raw), "crc32": zlib.crc32(raw)})
+            gated = chaos_gate({j: raw})
+            self._kv.set(f"ws.{self.channel}.s{slot}.c{j}", gated[j])
+
+        buf = bytearray()
+        for e, l in zip(entries, leaves):
+            if e["kind"] != "array":
+                continue
+            arr = np.ascontiguousarray(l)
+            if arr.size == 0:
+                continue      # zero-size leaf: no bytes in the stream
+            mv = memoryview(arr.reshape(-1)).cast("B")
+            total += mv.nbytes
+            off = 0
+            while off < mv.nbytes:
+                take = min(self.chunk_bytes - len(buf),
+                           mv.nbytes - off)
+                buf += mv[off:off + take]
+                off += take
+                if len(buf) == self.chunk_bytes:
+                    emit(bytes(buf))
+                    buf.clear()
+        if buf or not table:
+            emit(bytes(buf))  # tail, or the lone empty chunk of an
+        del buf               # array-free tree (poll expects >= 1)
+        head = {"format": FORMAT, "version": v, "slot": slot,
+                "total": total, "chunks": table,
+                "leaves": entries, "t": time.time()}
+        self._kv.set(f"ws.{self.channel}.head",
+                     json.dumps(head).encode())
+        # the tiny version key goes LAST: a subscriber that sees it can
+        # rely on the (potentially large) head already carrying >= this
+        # version. Polls check this handful of bytes first, so an idle
+        # channel costs a few bytes per poll — not a full head fetch +
+        # json parse of the leaf/chunk tables per replica per 250ms
+        self._kv.set(f"ws.{self.channel}.v", str(v).encode())
+        self._version = v
+        try:
+            _stream_obs().inc(total)
+        except Exception:  # noqa: BLE001
+            pass
+        logger.info("weight stream %r: published version %d "
+                    "(%d bytes, %d chunk(s), slot %d)", self.channel,
+                    v, total, len(table), slot)
+        return v
+
+    def close(self) -> None:
+        if self._owns and self._kv is not None:
+            self._kv.close()
+            self._kv = None
+
+
+class WeightSubscriber:
+    """Polls a channel and assembles newer versions; adoption is
+    monotone and torn reads are structurally impossible to return."""
+
+    def __init__(self, channel: str = "default", *,
+                 kv_addr: Optional[str] = None,
+                 kv_port: Optional[int] = None,
+                 client=None, template: Any = None,
+                 poll_timeout: float = 0.05):
+        self.channel = channel
+        self.template = template
+        self.poll_timeout = float(poll_timeout)
+        self._kv, self._owns = _resolve_client(client, kv_addr, kv_port)
+        self.version = 0
+
+    def _head(self) -> Optional[dict]:
+        from ..native.store import NativeTimeout
+        try:
+            raw = self._kv.get(f"ws.{self.channel}.head",
+                               timeout=self.poll_timeout)
+        except NativeTimeout:
+            return None
+        head = json.loads(raw.decode())
+        if head.get("format") != FORMAT:
+            raise RedistError(
+                f"weight stream {self.channel!r} head has format "
+                f"{head.get('format')!r} (this build reads {FORMAT!r})")
+        return head
+
+    def poll(self) -> Optional[Tuple[int, Any]]:
+        """Adopt a newer version if one is published: returns
+        ``(version, tree)`` or None (nothing new yet). A slot torn by a
+        concurrent overwrite is detected by crc32 and skipped — the
+        NEXT poll sees the overwriting version's head."""
+        from ..native.store import NativeTimeout
+        try:
+            raw = self._kv.get(f"ws.{self.channel}.v",
+                               timeout=self.poll_timeout)
+            if int(raw.decode()) <= self.version:
+                return None              # cheap steady-state no-op
+        except NativeTimeout:
+            return None                  # nothing published yet
+        except ValueError:
+            pass                         # malformed: let the head decide
+        head = self._head()
+        if head is None or head["version"] <= self.version:
+            return None
+        v, slot = head["version"], head["slot"]
+        # STREAM the assembly: each fetched chunk is crc-verified and
+        # copied straight into the preallocated leaf arrays — peak
+        # extra memory is one chunk, never the joined payload (the
+        # publish side mirrors this; a multi-GB adoption costs
+        # ~chunk_bytes over the tree itself)
+        from ..ckpt.store import pyobj_value
+        entries = head["leaves"]
+        leaves: List[Any] = []
+        fill: List[np.ndarray] = []      # flat uint8 views, leaf order
+        for e in entries:
+            if e["kind"] != "array":
+                leaves.append(pyobj_value(e))
+                continue
+            arr = np.empty(e["shape"], np.dtype(e["dtype"]))
+            leaves.append(arr)
+            fill.append(arr.reshape(-1).view(np.uint8))
+        li = off = got = 0
+        for j, c in enumerate(head["chunks"]):
+            raw = self._kv.get(f"ws.{self.channel}.s{slot}.c{j}",
+                               timeout=self.poll_timeout,
+                               max_bytes=max(c["nbytes"], 64) + 64)
+            gated = chaos_gate({0: raw})
+            raw = gated[0]
+            if len(raw) != c["nbytes"] or zlib.crc32(raw) != c["crc32"]:
+                again = self._head()
+                if again is not None and again["version"] != v:
+                    # the publisher lapped this slot mid-read: not
+                    # corruption, just a stale version — skip it
+                    return None
+                raise RedistError(
+                    f"weight stream {self.channel!r} version {v} chunk "
+                    f"{j} failed crc32 — refusing to adopt a torn or "
+                    f"corrupted snapshot")
+            got += len(raw)
+            mv = memoryview(raw)
+            while mv.nbytes:
+                if li >= len(fill):
+                    raise RedistError(
+                        f"weight stream {self.channel!r} version {v}: "
+                        f"chunk bytes overflow the leaf table")
+                dst = fill[li]
+                if dst.nbytes == 0:      # zero-size leaf: nothing to
+                    li += 1              # fill, never loop on take=0
+                    continue
+                take = min(dst.nbytes - off, mv.nbytes)
+                dst[off:off + take] = np.frombuffer(mv[:take],
+                                                    np.uint8)
+                off += take
+                mv = mv[take:]
+                if off == dst.nbytes:
+                    li += 1
+                    off = 0
+        while li < len(fill) and fill[li].nbytes == 0:
+            li += 1                      # trailing zero-size leaves
+        if got != head["total"] or li != len(fill) or off:
+            raise RedistError(
+                f"weight stream {self.channel!r} version {v}: "
+                f"{got} payload bytes, head says {head['total']} "
+                f"(assembly stopped at leaf {li}/{len(fill)})")
+        tree = self._finish_tree(entries, leaves)
+        self.version = v
+        return v, tree
+
+    def _finish_tree(self, entries: List[dict],
+                     leaves: List[Any]) -> Any:
+        if self.template is not None:
+            import jax
+            t_leaves, t_def = jax.tree_util.tree_flatten(self.template)
+            if len(t_leaves) != len(leaves):
+                raise RedistError(
+                    f"weight stream tree has {len(leaves)} leaves; "
+                    f"subscriber template has {len(t_leaves)}")
+            return jax.tree_util.tree_unflatten(t_def, leaves)
+        out: Dict[str, Any] = {}
+        for e, v in zip(entries, leaves):
+            node = out
+            parts = [p for p in e["path"].split("/") if p]
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1] if parts else e["path"]] = v
+        return out
+
+    def close(self) -> None:
+        if self._owns and self._kv is not None:
+            self._kv.close()
+            self._kv = None

@@ -87,6 +87,62 @@ class ContinuousBatcher:
             "time to first generated token (submit -> prefill), ms")
         self._m_occupancy = R.gauge(
             "hvd_serve_kv_occupancy", "fraction of KV slots in use")
+        #: optional weight-stream subscriber (redist/stream.py): polled
+        #: between scheduling iterations, rate-limited so an idle or
+        #: not-yet-published channel cannot stall the decode loop
+        self._weights = None
+        self._weights_interval = 0.25
+        self._weights_next_poll = 0.0
+
+    # -- hot weight streaming ------------------------------------------------
+    def attach_weights(self, subscriber,
+                       min_interval_s: float = 0.25) -> None:
+        """Attach a ``WeightSubscriber``: the scheduler polls it
+        between iterations — at most every ``min_interval_s`` seconds,
+        because a poll against a channel with no published head blocks
+        for the subscriber's KV timeout (~50 ms), which must not be
+        paid per ~ms decode iteration — and adopts newer param
+        versions via ``executor.swap_params`` (the executor's step
+        lock is the no-mid-step fence). A transient stream failure
+        logs and keeps serving on the current weights; it never takes
+        the fleet down."""
+        self._weights = subscriber
+        self._weights_interval = float(min_interval_s)
+        self._weights_next_poll = 0.0       # first step polls
+        self._weights_thread = None
+
+    def _maybe_swap_weights(self) -> None:
+        """Kick (never join) a background adoption: the KV fetch, crc
+        verify, assembly and device placement of a multi-GB tree must
+        not run inline on the decode scheduling thread — only the final
+        pointer swap is fenced, inside ``swap_params``'s step lock, so
+        in-flight requests pay at most one step of swap latency, never
+        the full adoption."""
+        if self._weights is None:
+            return
+        now = time.monotonic()
+        if now < self._weights_next_poll:
+            return
+        t = self._weights_thread
+        if t is not None and t.is_alive():
+            return                        # previous adoption in flight
+        self._weights_next_poll = now + self._weights_interval
+
+        def adopt():
+            try:
+                got = self._weights.poll()
+                if got is not None:
+                    version, tree = got
+                    self.executor.swap_params(tree, version=version)
+            except Exception as e:  # noqa: BLE001 — serve on stale
+                import logging
+                logging.getLogger("horovod_tpu").warning(
+                    "weight stream poll failed (serving continues on "
+                    "version %s): %s", self.executor.params_version, e)
+
+        self._weights_thread = threading.Thread(
+            target=adopt, daemon=True, name="hvd-serve-weights")
+        self._weights_thread.start()
 
     # -- shape warmup --------------------------------------------------------
     def warmup(self) -> None:
@@ -106,6 +162,7 @@ class ContinuousBatcher:
     def step(self) -> bool:
         """Run one retire/admit/prefill/decode iteration; returns True
         while there is (or may be) work in flight."""
+        self._maybe_swap_weights()
         self._retire()
         admitted = self._admit()
         if admitted:

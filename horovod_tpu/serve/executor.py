@@ -25,6 +25,7 @@ trace.
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, Optional, Set, Tuple
@@ -57,10 +58,21 @@ class ShardedExecutor:
         self.max_batch = max_batch
         self.max_len = max_len
         self.timeline = timeline
+        # kept for hot weight swaps (redist/stream.py): replacement
+        # params are placed exactly like the originals
+        self._mesh = mesh
+        self._rules = partition_rules
         if mesh is not None and partition_rules is not None:
             from ..parallel.tp import shard_params
             params = shard_params(params, mesh, partition_rules)
         self.params = params
+        # the swap/version fence: step() holds this lock for the whole
+        # forward, swap_params() takes it to replace self.params — a
+        # swap can therefore land only BETWEEN decode iterations, never
+        # mid-step, and no step ever mixes two param versions
+        self._swap_lock = threading.Lock()
+        self.params_version: Optional[int] = None
+        self.swaps = 0
         # -- metrics --
         self.steps = 0
         self.tokens_out = 0
@@ -75,6 +87,12 @@ class ShardedExecutor:
         R = obs_metrics.get_registry()
         R.unregister("hvd_serve_step_ms")
         R.unregister("hvd_serve_tokens_total")
+        # get-or-create, NOT claimed fresh: a multi-replica fleet runs
+        # several executors in one process and the swap series is
+        # fleet-shared (redist/stream.py)
+        self._m_swap_ms = R.histogram(
+            "hvd_weight_swap_ms",
+            "hot weight swap: new params placed + adopted (ms)")
         self._m_step_ms = {
             k: R.histogram("hvd_serve_step_ms",
                            "executor step latency by kind (ms)",
@@ -125,11 +143,15 @@ class ShardedExecutor:
         """
         t0 = time.perf_counter()
         self.signatures.add((kind, int(tokens.shape[1])))
-        nxt, self.cache = self._fwd(
-            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(positions, jnp.int32), jnp.asarray(mask, bool),
-            jnp.asarray(last_idx, jnp.int32))
-        nxt = np.asarray(nxt)  # host readback doubles as completion fence
+        with self._swap_lock:   # the weight-swap version fence
+            nxt, self.cache = self._fwd(
+                self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(mask, bool),
+                jnp.asarray(last_idx, jnp.int32))
+            # host readback doubles as completion fence — inside the
+            # lock so a swap never lands while this step is in flight
+            nxt = np.asarray(nxt)
         dt_ms = (time.perf_counter() - t0) * 1000.0
         self.steps += 1
         self.step_latencies_ms.append(dt_ms)
@@ -145,6 +167,72 @@ class ShardedExecutor:
                 ev.update(stats)
             self.timeline.instant("SERVE", ev)
         return nxt
+
+    # -- hot weight swap (redist/stream.py consumer) -------------------------
+    def swap_params(self, new_params: Any, *,
+                    version: Optional[int] = None) -> bool:
+        """Adopt ``new_params`` between decode iterations.
+
+        The version fence: the step lock guarantees no swap lands while
+        a forward is in flight (no torn step — every launched program
+        sees exactly one param version), and adoption is MONOTONE —
+        a ``version`` at or below the current one is refused (returns
+        False) so out-of-order polls across replicas can never roll
+        weights backwards. The structure must match the serving params
+        exactly (same treedef/shapes); placement (mesh + partition
+        rules) mirrors the constructor.
+
+        Returns True on adoption; observes ``hvd_weight_swap_ms`` and
+        emits a SWAP timeline instant."""
+        import jax
+
+        t0 = time.perf_counter()
+        if version is not None and self.params_version is not None \
+                and version <= self.params_version:
+            return False
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new_params)
+        if old_def != new_def or any(
+                np.shape(a) != np.shape(b)
+                # .dtype without np.asarray: materializing device
+                # arrays to host just to read their dtype would cost an
+                # O(model) transfer per swap (and raise on multi-host
+                # GSPMD leaves)
+                or getattr(a, "dtype", None) != getattr(b, "dtype",
+                                                        None)
+                for a, b in zip(old_leaves, new_leaves)):
+            # dtype is part of the jitted step's signature: adopting
+            # fp32 master weights into a bf16 executor would not error
+            # — it would recompile EVERY bucket mid-traffic. Fail fast
+            # instead; the publisher must cast to the serving dtype.
+            raise ValueError(
+                "swap_params: replacement tree does not match the "
+                "serving params (treedef/shape/dtype mismatch) — "
+                "refusing a structurally torn swap (a dtype change "
+                "would recompile every serving bucket mid-traffic)")
+        if self._mesh is not None and self._rules is not None:
+            from ..parallel.tp import shard_params
+            new_params = shard_params(new_params, self._mesh,
+                                      self._rules)
+        else:
+            new_params = jax.tree_util.tree_map(jnp.asarray, new_params)
+        with self._swap_lock:
+            # re-check under the lock: another subscriber thread may
+            # have adopted a newer version while we placed this one
+            if version is not None and self.params_version is not None \
+                    and version <= self.params_version:
+                return False
+            self.params = new_params
+            self.params_version = version if version is not None else \
+                (self.params_version or 0) + 1
+            self.swaps += 1
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self._m_swap_ms.observe(dt_ms)
+        if self.timeline is not None:
+            self.timeline.instant("SWAP", {
+                "version": self.params_version,
+                "swap_ms": round(dt_ms, 3)})
+        return True
 
     # -- metrics -------------------------------------------------------------
     def tokens_per_s(self) -> float:

@@ -1,0 +1,191 @@
+"""4-process redistribution-plane worker: the ISSUE 7 np4 elastic
+acceptance path end to end on a real coordinator + p2p ring.
+
+1. World 4: train a deterministic toy state (params + optax Adam
+   opt_state) through ``FileBackedState(backend="ckpt")`` — three
+   collective commits land on the sharded checkpoint plane.
+2. Kill NO ONE, shrink 4->2: ranks 2,3 leave cleanly; ranks 0,1 rebuild
+   a 2-rank sub-coordinator on the same native store (the in-process
+   reset shape) and restore state through ``redist.elastic_restore``:
+
+   * case A — both survivors hold the commit: the in-memory path is a
+     probe-only no-op. Assert ZERO checkpoint-file reads
+     (``hvd_ckpt_bytes_total{kind="read"}`` stays flat) and zero
+     redistribution wire bytes.
+   * case B — rank 1 "lost" its state (fresh template, serial 0): the
+     committed tree moves from rank 0 over the p2p ring. Assert the
+     restored params + optax opt_state are bit-identical to the oracle
+     and STILL zero checkpoint reads.
+   * case C — the disk path the plane replaced: restore the same
+     commit through the ckpt reshard plan onto the 2-rank world and
+     assert it is bit-identical to what the in-memory path produced
+     (the two restore paths agree byte-for-byte).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(1)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.core import basics  # noqa: E402
+
+STEPS = 3
+
+
+def _counter(name, labels=None):
+    from horovod_tpu import obs
+    c = obs.get_registry().get(name, labels)
+    return 0.0 if c is None else c.value
+
+
+def _init_tree():
+    params = {"w": np.arange(397 * 3, dtype=np.float32).reshape(397, 3)
+              / 100.0,
+              "b": np.arange(6, dtype=np.float32)}
+    tx = optax.adam(1e-2)
+    return params, tx, tx.init(params)
+
+
+def _train_step(params, tx, opt_state):
+    """Deterministic, identical on every rank: grad of sum(p^2)/2."""
+    grads = jax.tree_util.tree_map(lambda p: np.asarray(p, np.float32),
+                                   params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(
+        lambda p, u: np.asarray(p + u, np.float32), params, updates)
+    return params, opt_state
+
+
+def _equal(a, b) -> bool:
+    fa, da = jax.tree_util.tree_flatten(a)
+    fb, db = jax.tree_util.tree_flatten(b)
+    if da != db or len(fa) != len(fb):
+        return False
+    for la, lb in zip(fa, fb):
+        xa, xb = np.asarray(la), np.asarray(lb)
+        if xa.dtype != xb.dtype or xa.shape != xb.shape or \
+                not np.array_equal(xa, xb):
+            return False
+    return True
+
+
+def main(out_dir: str) -> None:
+    from horovod_tpu.checkpoint import FileBackedState
+    hvd.init()
+    coord = basics.get_coordinator()
+    assert coord is not None and coord.size == 4, coord
+    pid = coord.rank
+    root = os.path.join(out_dir, "state")
+
+    # -- phase 1: world 4 trains + commits through the ckpt plane -------
+    params, tx, opt_state = _init_tree()
+    state = FileBackedState(root, backend="ckpt", async_save=False,
+                            params=params, opt=opt_state, step=0)
+    for i in range(1, STEPS + 1):
+        p, o = _train_step(state.params, tx, state.opt)
+        state.params, state.opt = p, o
+        state.step = i
+        state.commit()
+    oracle = {"params": jax.tree_util.tree_map(np.asarray, state.params),
+              "opt": jax.tree_util.tree_map(np.asarray, state.opt),
+              "step": int(state.step)}
+    state.close()
+    coord.barrier("redist-trained")
+    hvd.shutdown()
+
+    result = {"pid": pid, "ok": True}
+    if pid in (2, 3):
+        # the shrink: these ranks leave cleanly — nobody is killed
+        with open(os.path.join(out_dir, f"result.{pid}.json"), "w") as f:
+            json.dump(result, f)
+        return
+
+    # -- phase 2: survivors 0,1 on a 2-rank sub-coordinator -------------
+    import socket
+    from horovod_tpu.elastic.state import State
+    from horovod_tpu.native.store import Coordinator
+    from horovod_tpu.redist import elastic_restore
+    kv_ip = socket.gethostbyname(os.environ["HOROVOD_NATIVE_KV_ADDR"])
+    sub = Coordinator(kv_ip, int(os.environ["HOROVOD_NATIVE_KV_PORT"]),
+                      pid, 2, timeout=120)
+    try:
+        def held_state():
+            s = State(params=jax.tree_util.tree_map(np.copy,
+                                                    oracle["params"]),
+                      opt=jax.tree_util.tree_map(np.copy, oracle["opt"]),
+                      step=0)
+            s.step = oracle["step"]
+            s.commit()                       # serial 1: a live holder
+            return s
+
+        def fresh_state():
+            _, tx2, opt0 = _init_tree()
+            return State(params={"w": np.zeros((397, 3), np.float32),
+                                 "b": np.zeros(6, np.float32)},
+                         opt=opt0, step=0)   # serial 0: template only
+
+        # case A: both survivors hold the commit -> probe-only no-op
+        read0 = _counter("hvd_ckpt_bytes_total", {"kind": "read"})
+        ring0 = _counter("hvd_redist_bytes_total", {"transport": "ring"})
+        sA = held_state()
+        okA = elastic_restore(sA, coord=sub, timeout=120)
+        result["case_a_ok"] = bool(
+            okA is True
+            and _equal({"params": sA.params, "opt": sA.opt},
+                       {"params": oracle["params"],
+                        "opt": oracle["opt"]})
+            and _counter("hvd_ckpt_bytes_total",
+                         {"kind": "read"}) == read0
+            and _counter("hvd_redist_bytes_total",
+                         {"transport": "ring"}) == ring0)
+
+        # case B: rank 1 lost its state -> bytes move over the RING,
+        # still zero checkpoint reads
+        sB = held_state() if pid == 0 else fresh_state()
+        okB = elastic_restore(sB, coord=sub, timeout=120)
+        moved = _counter("hvd_redist_bytes_total",
+                         {"transport": "ring"}) - ring0
+        treeB = {"params": jax.tree_util.tree_map(np.asarray, sB.params),
+                 "opt": jax.tree_util.tree_map(np.asarray, sB.opt)}
+        result["case_b_ok"] = bool(
+            okB is True
+            and int(sB.step) == oracle["step"]
+            and sB.commit_serial == 1
+            and _equal(treeB, {"params": oracle["params"],
+                               "opt": oracle["opt"]})
+            and _counter("hvd_ckpt_bytes_total",
+                         {"kind": "read"}) == read0
+            and (moved > 0 if pid == 0 else True))
+
+        # case C: the ckpt-restore path (4-rank commit resharded onto
+        # this 2-rank world) is bit-identical to the in-memory result
+        from horovod_tpu.ckpt import ShardedCheckpointer
+        ck = ShardedCheckpointer(root, rank=pid, world=2,
+                                 async_save=False)
+        target = {"params": oracle["params"], "opt": oracle["opt"],
+                  "step": 0}
+        disk = ck.restore(target=target, via="local")
+        ck.close()
+        result["case_c_ok"] = bool(
+            _equal({"params": disk["params"], "opt": disk["opt"]},
+                   treeB)
+            and int(disk["step"]) == oracle["step"])
+        result["ok"] = bool(result["case_a_ok"] and result["case_b_ok"]
+                            and result["case_c_ok"])
+        sub.barrier("redist-done")
+    finally:
+        sub.close()
+    with open(os.path.join(out_dir, f"result.{pid}.json"), "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

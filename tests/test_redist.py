@@ -1,0 +1,1005 @@
+"""horovod_tpu.redist: live N->M redistribution (tier-1, CPU).
+
+The acceptance bars of the redistribution subsystem
+(docs/redistribution.md):
+
+* the extracted plan layer is gap/overlap-free for uneven trees (leaf
+  rows < world), dtype-mixed trees, and full-layout holder fan-out;
+  N==M is a NO-COPY identity (same object back);
+* ckpt/reshard.py is a consumer of the shared plan — both derive the
+  identical op stream for a real manifest;
+* redistribute() moves bit-exact trees over BOTH wire transports
+  (p2p ring, coordinator allgather) and the disk (ckpt) backend, with
+  bounded rounds and per-frame crc32;
+* the elastic consumer restores committed state in memory from
+  surviving holders with ZERO checkpoint reads, and a chaos fault at
+  the new ``redist.transport`` boundary sends EVERY rank down the
+  ckpt-restore fallback together, bit-identical to the oracle;
+* a serve fleet adopts a published weight version mid-traffic with no
+  request dropped or torn and monotone version adoption across
+  replicas.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.chaos import inject as chaos_inject
+from horovod_tpu.chaos.plan import ChaosPlan
+from horovod_tpu.ckpt import ShardedCheckpointer
+from horovod_tpu.ckpt.store import _leaf_entry
+from horovod_tpu.redist import (CkptTransport, CoordTransport, RedistError,
+                                RingTransport, Spec, WeightPublisher,
+                                WeightSubscriber, elastic_restore,
+                                plan_redistribute, redistribute,
+                                schedule_rounds)
+from horovod_tpu.redist import row_bounds as r_bounds
+from horovod_tpu.redist.transport import chaos_gate
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _counter_value(name, labels=None):
+    from horovod_tpu import obs
+    c = obs.get_registry().get(name, labels)
+    return 0.0 if c is None else c.value
+
+
+@pytest.fixture
+def disarm_chaos():
+    yield
+    chaos_inject.uninstall()
+
+
+def _mixed_tree():
+    """Dtype-mixed + uneven: a leaf with fewer rows than any world we
+    test, a 0-d replicated leaf, and python (pyobj) leaves."""
+    return {
+        "w": np.arange(101 * 3, dtype=np.float32).reshape(101, 3),
+        "emb": np.arange(7 * 5, dtype=np.float16).reshape(7, 5),
+        "ids": np.arange(13, dtype=np.int64),
+        "tiny": np.array([1, 2, 3], dtype=np.uint8),
+        "flag": np.array([True, False, True, True]),
+        "scale": np.array(2.5, np.float64),
+        "meta": {"epoch": 7, "name": "x"},
+    }
+
+
+def _template_of(tree):
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out[k] = {kk: (type(vv)() if not isinstance(vv, np.ndarray)
+                           else np.zeros_like(vv)) for kk, vv in v.items()}
+        elif isinstance(v, np.ndarray):
+            out[k] = np.zeros_like(v)
+        else:
+            out[k] = type(v)()
+    return out
+
+
+def _trees_equal(a, b):
+    fa, da = jax.tree_util.tree_flatten(a)
+    fb, db = jax.tree_util.tree_flatten(b)
+    if da != db:
+        return False
+    for la, lb in zip(fa, fb):
+        if isinstance(la, np.ndarray) or isinstance(lb, np.ndarray):
+            xa, xb = np.asarray(la), np.asarray(lb)
+            if xa.dtype != xb.dtype or xa.shape != xb.shape or \
+                    not np.array_equal(xa, xb):
+                return False
+        elif la != lb:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# plan layer
+# ---------------------------------------------------------------------------
+
+class TestPlan:
+    def test_row_bounds_single_sourced_with_ckpt_store(self):
+        """ckpt/store.py keeps a standalone copy (it must spec-load with
+        no package context) — the two formulas must stay identical."""
+        from horovod_tpu.ckpt.store import row_bounds as ckpt_bounds
+        for n in (0, 1, 3, 7, 101, 4096):
+            for w in (1, 2, 3, 5, 8):
+                assert r_bounds(n, w) == ckpt_bounds(n, w)
+
+    @pytest.mark.parametrize("n_from,n_to", [(4, 2), (4, 3), (3, 5),
+                                             (1, 4), (5, 5)])
+    def test_row_to_row_gap_and_overlap_free(self, n_from, n_to):
+        leaves = [_leaf_entry("w", np.zeros((13, 2), np.float32)),
+                  _leaf_entry("u", np.zeros((3,), np.int32)),
+                  _leaf_entry("s", np.array(1.0, np.float32))]
+        plans = plan_redistribute(leaves, Spec.row(n_from),
+                                  Spec.row(n_to))
+        for leaf, n in ((0, 13), (1, 3)):
+            for t in range(n_to):
+                tb = r_bounds(n, n_to)
+                ops = [op for op in plans[t] if op["leaf"] == leaf]
+                covered = sorted(tuple(op["rows"]) for op in ops)
+                if tb[t + 1] > tb[t]:
+                    assert covered[0][0] == tb[t]
+                    assert covered[-1][1] == tb[t + 1]
+                    for (_, b), (c, _) in zip(covered, covered[1:]):
+                        assert b == c           # no gap, no overlap
+                else:
+                    assert covered == []        # uneven: empty block
+        rep_ops = [op for t in range(n_to) for op in plans[t]
+                   if op["leaf"] == 2]
+        assert rep_ops == [{"leaf": 2, "src": 0, "rows": None}]
+
+    def test_matches_ckpt_reshard_plan_on_a_real_manifest(self, tmp_path):
+        """ckpt/reshard.plan_reshard is now a consumer of the shared
+        plan: same manifest, same op stream."""
+        from horovod_tpu.ckpt.reshard import plan_reshard
+        from horovod_tpu.ckpt.store import load_manifest
+        tree = _mixed_tree()
+        with ShardedCheckpointer(str(tmp_path), rank=0, world=1,
+                                 async_save=False) as ck:
+            ck.save(3, tree)
+        man = load_manifest(str(tmp_path), 3)
+        for m in (1, 2, 5):
+            expect = plan_redistribute(man["leaves"],
+                                       Spec.row(man["world"]),
+                                       Spec.row(m))
+            assert plan_reshard(man, m) == expect
+
+    def test_full_source_holder_targets_serve_themselves(self):
+        leaves = [_leaf_entry("w", np.zeros((40, 2), np.float32))]
+        plans = plan_redistribute(leaves, Spec.full(4, holders=(1, 3)),
+                                  Spec.full(4))
+        for t in (1, 3):                       # holders: zero wire ops
+            assert plans[t] == [{"leaf": 0, "src": t, "rows": [0, 40]}]
+        for t in (0, 2):                       # split across holders
+            assert [op["src"] for op in plans[t]] == [1, 3]
+            spans = [tuple(op["rows"]) for op in plans[t]]
+            assert spans == [(0, 20), (20, 40)]
+
+    def test_identity_is_no_copy(self):
+        tree = {"w": np.arange(6.0)}
+        assert redistribute(tree, Spec.full(3), Spec.full(3)) is tree
+        assert redistribute(tree, Spec.row(4), Spec.row(4)) is tree
+
+    def test_non_identity_requires_transport(self):
+        with pytest.raises(RedistError, match="transport"):
+            redistribute({"w": np.zeros(3)}, Spec.full(2, holders=(0,)),
+                         Spec.full(2))
+
+    def test_spec_fail_fast(self):
+        with pytest.raises(RedistError, match="world"):
+            Spec(0)
+        with pytest.raises(RedistError, match="layout"):
+            Spec(2, layout="diag")
+        with pytest.raises(RedistError, match="holders"):
+            Spec(2, layout="row", holders=(0,))
+        with pytest.raises(RedistError, match="holders"):
+            Spec.full(2, holders=(0, 2))
+
+    def test_destination_holder_subsets_rejected(self):
+        """dst holder subsets are not a supported layout: refusing is
+        better than silently fanning out to every rank of dst.world."""
+        leaves = [_leaf_entry("w", np.zeros((8, 2), np.float32))]
+        with pytest.raises(RedistError, match="destination"):
+            plan_redistribute(leaves, Spec.full(4, holders=(0, 1)),
+                              Spec.full(4, holders=(0, 1)))
+        fake = SimpleNamespace(kind="wire", name="fake", rank=0, world=4)
+        with pytest.raises(RedistError, match="destination"):
+            redistribute({"w": np.zeros((8, 2), np.float32)},
+                         Spec.full(4, holders=(0, 1)),
+                         Spec.full(4, holders=(0, 1)), fake)
+
+    def test_schedule_rounds_bounds_send_and_receive(self):
+        leaves = [_leaf_entry("w", np.zeros((64, 4), np.float32))]
+        plans = plan_redistribute(leaves, Spec.full(3, holders=(0,)),
+                                  Spec.full(3))
+        rows_bytes = 16
+        rounds = schedule_rounds(plans, leaves, max_bytes=8 * rows_bytes)
+        assert len(rounds) > 1
+        for rnd in rounds:
+            sent, recv = {}, {}
+            for t, op in rnd:
+                assert op["src"] != t
+                nb = (op["rows"][1] - op["rows"][0]) * rows_bytes
+                assert nb <= 8 * rows_bytes
+                sent[op["src"]] = sent.get(op["src"], 0) + nb
+                recv[t] = recv.get(t, 0) + nb
+            assert all(v <= 8 * rows_bytes for v in sent.values())
+            assert all(v <= 8 * rows_bytes for v in recv.values())
+        # pure function: identical on re-derivation (every rank agrees)
+        assert rounds == schedule_rounds(plans, leaves,
+                                         max_bytes=8 * rows_bytes)
+
+    def test_row_source_requires_global_entries(self):
+        fake = SimpleNamespace(kind="wire", name="fake", rank=0, world=2)
+        with pytest.raises(RedistError, match="entries"):
+            redistribute({"w": np.zeros((3, 2))}, Spec.row(2),
+                         Spec.full(2), fake)
+
+    def test_disk_transport_rejects_row_source(self):
+        with pytest.raises(RedistError, match="row"):
+            redistribute({"w": np.zeros((3, 2))}, Spec.row(2),
+                         Spec.full(2), CkptTransport("/tmp/x", 0, 2),
+                         entries=[_leaf_entry(
+                             "w", np.zeros((6, 2), np.float32))])
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+def _with_server(fn):
+    from horovod_tpu.native.store import StoreServer
+    srv = StoreServer()
+    try:
+        return fn(srv)
+    finally:
+        srv.close()
+
+
+def _threaded(world, body, timeout=90):
+    results, errors = {}, []
+
+    def run(r):
+        try:
+            results[r] = body(r)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=run, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not errors, errors
+    return results
+
+
+class TestTransports:
+    def test_chaos_gate_disarmed_is_byte_identical(self):
+        payloads = {0: b"abc", 2: os.urandom(64)}
+        assert chaos_gate(payloads) is payloads
+
+    def test_coord_full_fanout_mixed_tree(self, monkeypatch):
+        from horovod_tpu.native.store import Coordinator
+        tree = _mixed_tree()
+
+        def go(srv):
+            def body(r):
+                c = Coordinator("127.0.0.1", srv.port, r, 3, timeout=60)
+                try:
+                    local = tree if r == 0 else _template_of(tree)
+                    return redistribute(
+                        local, Spec.full(3, holders=(0,)), Spec.full(3),
+                        CoordTransport(c), tag="t.coord",
+                        max_chunk_bytes=256)
+                finally:
+                    c.close()
+            return _threaded(3, body)
+
+        results = _with_server(go)
+        for r in range(3):
+            assert _trees_equal(results[r], tree), r
+
+    def test_ring_multi_holder_grow(self, monkeypatch):
+        tree = _mixed_tree()
+
+        def go(srv):
+            monkeypatch.setenv("HOROVOD_NATIVE_KV_ADDR", "127.0.0.1")
+            monkeypatch.setenv("HOROVOD_NATIVE_KV_PORT", str(srv.port))
+            before = _counter_value("hvd_redist_bytes_total",
+                                    {"transport": "ring"})
+
+            def body(r):
+                t = RingTransport.connect(
+                    r, 3, prefix=f"t.ring.{srv.port}", timeout=60)
+                try:
+                    local = tree if r in (0, 1) else _template_of(tree)
+                    return redistribute(
+                        local, Spec.full(3, holders=(0, 1)),
+                        Spec.full(3), t, tag="t.ring",
+                        max_chunk_bytes=512)
+                finally:
+                    t.close()
+            out = _threaded(3, body)
+            after = _counter_value("hvd_redist_bytes_total",
+                                   {"transport": "ring"})
+            assert after > before       # bytes accounted per transport
+            return out
+
+        results = _with_server(go)
+        for r in range(3):
+            assert _trees_equal(results[r], tree), r
+
+    def test_row_to_full_over_coord_with_entries(self):
+        from horovod_tpu.native.store import Coordinator
+        gw = np.arange(13 * 4, dtype=np.float32).reshape(13, 4)
+        entries = [_leaf_entry("w", gw)]
+
+        def go(srv):
+            def body(r):
+                c = Coordinator("127.0.0.1", srv.port, r, 4, timeout=60)
+                try:
+                    b = r_bounds(13, 4)
+                    local = {"w": gw[b[r]:b[r + 1]].copy()}
+                    return redistribute(
+                        local, Spec.row(4), Spec.row(3),
+                        CoordTransport(c), tag="t.row",
+                        entries=entries, max_chunk_bytes=64)
+                finally:
+                    c.close()
+            return _threaded(4, body)
+
+        results = _with_server(go)
+        b3 = r_bounds(13, 3)
+        for r in range(3):
+            np.testing.assert_array_equal(results[r]["w"],
+                                          gw[b3[r]:b3[r + 1]])
+        assert results[3] is None      # outside the destination world
+
+    def test_disk_transport_roundtrip(self, tmp_path):
+        tree = _mixed_tree()
+
+        def body(r):
+            t = CkptTransport(str(tmp_path), r, 2, timeout=60)
+            local = tree if r == 0 else _template_of(tree)
+            return redistribute(local, Spec.full(2, holders=(0,)),
+                                Spec.full(2), t, tag="t.disk")
+
+        results = _threaded(2, body)
+        for r in range(2):
+            assert _trees_equal(results[r], tree), r
+
+    def test_disk_transport_directory_reuse_same_tag(self, tmp_path):
+        """Two sequential disk redistributions through ONE directory
+        with the DEFAULT tag: the step folds in the transport's call
+        counter, so the second call's readers wait for the second
+        call's commit instead of silently restoring the first's."""
+        tree1 = {"w": np.arange(8, dtype=np.float32), "v": 1}
+        tree2 = {"w": np.arange(8, dtype=np.float32) * 3.0, "v": 2}
+        transports = {r: CkptTransport(str(tmp_path), r, 2, timeout=60)
+                      for r in range(2)}
+        for tree in (tree1, tree2):
+            def body(r, tree=tree):
+                local = tree if r == 0 else \
+                    {"w": np.zeros(8, np.float32), "v": 0}
+                return redistribute(local, Spec.full(2, holders=(0,)),
+                                    Spec.full(2), transports[r])
+            results = _threaded(2, body)
+            for r in range(2):
+                assert _trees_equal(results[r], tree), r
+
+    def test_chaos_corrupt_caught_by_frame_crc(self, disarm_chaos):
+        """An injected bit flip at the new boundary must be caught by
+        the per-frame crc32 on the RECEIVER (the sender has nothing to
+        receive and completes — its payload was corrupted in flight)."""
+        from horovod_tpu.native.store import Coordinator
+        tree = _mixed_tree()
+        chaos_inject.install(ChaosPlan.from_dict({"seed": 5, "faults": [
+            {"rank": 0, "site": "redist.transport",
+             "kind": "corrupt"}]}), rank=0)
+
+        def go(srv):
+            def body(r):
+                c = Coordinator("127.0.0.1", srv.port, r, 2, timeout=60)
+                try:
+                    local = tree if r == 0 else _template_of(tree)
+                    if r == 0:
+                        redistribute(local, Spec.full(2, holders=(0,)),
+                                     Spec.full(2), CoordTransport(c),
+                                     tag="t.corrupt")
+                    else:
+                        with pytest.raises(RedistError, match="crc32"):
+                            redistribute(local,
+                                         Spec.full(2, holders=(0,)),
+                                         Spec.full(2),
+                                         CoordTransport(c),
+                                         tag="t.corrupt")
+                    return True
+                finally:
+                    c.close()
+            return _threaded(2, body)
+
+        assert _with_server(go) == {0: True, 1: True}
+
+    def test_chaos_drop_raises_redist_error(self, disarm_chaos):
+        chaos_inject.install(ChaosPlan.from_dict({"seed": 5, "faults": [
+            {"rank": 0, "site": "redist.transport",
+             "kind": "drop"}]}), rank=0)
+        with pytest.raises(RedistError, match="drop"):
+            chaos_gate({1: b"payload"})
+
+
+# ---------------------------------------------------------------------------
+# elastic consumer
+# ---------------------------------------------------------------------------
+
+def _make_state(hold, oracle):
+    from horovod_tpu.elastic.state import State
+    if hold:
+        s = State(params={k: np.copy(v)
+                          for k, v in oracle["params"].items()},
+                  step=0)
+        s.step = oracle["step"]
+        s.commit()                      # serial 1: holds live state
+    else:
+        s = State(params={k: np.zeros_like(v)
+                          for k, v in oracle["params"].items()},
+                  step=0)
+    return s
+
+
+class TestElasticRestore:
+    ORACLE = {"params": {"w": np.arange(50 * 2, dtype=np.float32)
+                         .reshape(50, 2),
+                         "b": np.arange(5, dtype=np.int32)},
+              "step": 11}
+
+    def _run(self, srv, world, holders, transport=None):
+        from horovod_tpu.native.store import Coordinator
+
+        def body(r):
+            c = Coordinator("127.0.0.1", srv.port, r, world, timeout=60)
+            try:
+                s = _make_state(r in holders, self.ORACLE)
+                ok = elastic_restore(s, coord=c, timeout=60)
+                return (ok, {k: np.asarray(v)
+                             for k, v in s.params.items()},
+                        int(s.step), s.commit_serial)
+            finally:
+                c.close()
+        return _threaded(world, body)
+
+    def test_mixed_holders_restore_in_memory_zero_ckpt_reads(self):
+        read_before = _counter_value("hvd_ckpt_bytes_total",
+                                     {"kind": "read"})
+
+        def go(srv):
+            return self._run(srv, 3, holders=(0, 2))
+
+        results = _with_server(go)
+        for r in range(3):
+            ok, params, step, serial = results[r]
+            assert ok is True
+            np.testing.assert_array_equal(params["w"],
+                                          self.ORACLE["params"]["w"])
+            np.testing.assert_array_equal(params["b"],
+                                          self.ORACLE["params"]["b"])
+            assert step == 11 and serial == 1
+        # the in-memory path read NO checkpoint bytes
+        assert _counter_value("hvd_ckpt_bytes_total",
+                              {"kind": "read"}) == read_before
+
+    def test_all_holders_is_probe_only_noop(self):
+        sent_before = _counter_value("hvd_redist_bytes_total",
+                                     {"transport": "coord"})
+        read_before = _counter_value("hvd_ckpt_bytes_total",
+                                     {"kind": "read"})
+
+        def go(srv):
+            return self._run(srv, 3, holders=(0, 1, 2))
+
+        results = _with_server(go)
+        assert all(results[r][0] is True for r in range(3))
+        assert _counter_value("hvd_redist_bytes_total",
+                              {"transport": "coord"}) == sent_before
+        assert _counter_value("hvd_ckpt_bytes_total",
+                              {"kind": "read"}) == read_before
+
+    def test_no_holders_returns_false_everywhere(self):
+        def go(srv):
+            return self._run(srv, 3, holders=())
+
+        results = _with_server(go)
+        assert all(results[r][0] is False for r in range(3))
+
+    def test_no_coordinator_returns_false(self):
+        s = _make_state(True, self.ORACLE)
+        assert elastic_restore(s, coord=None) is False
+
+    def test_framework_states_fall_back_to_disk(self):
+        """BaseFrameworkState keeps its REAL weights in _save_payload,
+        not _values: moving only the extras and claiming success would
+        let sync() broadcast reinitialized weights — so the in-memory
+        plane refuses BEFORE the probe (uniform across ranks)."""
+        from horovod_tpu.elastic._base_state import BaseFrameworkState
+
+        class Mem(BaseFrameworkState):
+            def _save_payload(self):
+                return None
+
+            def _restore_payload(self, snap):
+                pass
+
+        m = Mem(step=3)
+        m.commit()                       # serial 1: would-be holder
+        fake_coord = SimpleNamespace(rank=0, size=2)  # never touched
+        assert elastic_restore(m, coord=fake_coord) is False
+
+    def test_chaos_fault_falls_back_to_ckpt_bit_identical(
+            self, tmp_path, disarm_chaos):
+        """The ISSUE satellite: a faulted in-memory reshard falls back
+        cleanly to ckpt restore with bit-identical params — and the
+        fallback decision is COLLECTIVE (every rank returns False, none
+        adopts a half-moved tree)."""
+        from horovod_tpu.native.store import Coordinator
+        # the commit the fallback restores from
+        with ShardedCheckpointer(str(tmp_path), rank=0, world=1,
+                                 async_save=False) as ck:
+            ck.save(0, self.ORACLE, force=True)
+        chaos_inject.install(
+            ChaosPlan.from_dict({"seed": 9, "faults": [
+                {"rank": 0, "site": "redist.transport",
+                 "kind": "drop"}]}), rank=0)
+
+        def go(srv):
+            def body(r):
+                c = Coordinator("127.0.0.1", srv.port, r, 2, timeout=60)
+                try:
+                    s = _make_state(r == 0, self.ORACLE)
+                    ok = elastic_restore(s, coord=c, timeout=60)
+                    if not ok:      # the disk fallback leg
+                        ck = ShardedCheckpointer(
+                            str(tmp_path), rank=r, world=2,
+                            async_save=False)
+                        tree = ck.restore(0, via="local")
+                        ck.close()
+                        return (ok, tree)
+                    return (ok, None)
+                finally:
+                    c.close()
+            return _threaded(2, body)
+
+        results = _with_server(go)
+        for r in range(2):
+            ok, tree = results[r]
+            assert ok is False, f"rank {r} split from the fallback"
+            assert _trees_equal(tree, self.ORACLE)
+
+    def test_failed_attempt_rolls_back_torn_values(self):
+        """A failure AFTER some state values already moved must not
+        leave a torn mix (some values at the holders' commit, others
+        stale): the failed rank rolls back to its pre-attempt snapshot
+        before voting for the disk fallback."""
+        from horovod_tpu.elastic.state import State
+        from horovod_tpu.native.store import Coordinator
+
+        class FailSecond(CoordTransport):
+            def exchange(self, outgoing, tag, max_bytes_hint=0):
+                if ".zz_second" in tag:
+                    raise RedistError("injected: second value move")
+                return super().exchange(outgoing, tag, max_bytes_hint)
+
+        first = np.arange(20, dtype=np.float32)
+        second = np.arange(8, dtype=np.float32) * 2
+
+        def go(srv):
+            def body(r):
+                c = Coordinator("127.0.0.1", srv.port, r, 2, timeout=60)
+                try:
+                    if r == 0:
+                        s = State(aa_first={"v": first.copy()},
+                                  zz_second={"v": second.copy()})
+                        s.commit()          # serial 1: holder
+                    else:
+                        s = State(
+                            aa_first={"v": np.zeros(20, np.float32)},
+                            zz_second={"v": np.zeros(8, np.float32)})
+                    ok = elastic_restore(s, coord=c,
+                                         transport=FailSecond(c),
+                                         timeout=60)
+                    return (ok, np.asarray(s.aa_first["v"]).copy())
+                finally:
+                    c.close()
+            return _threaded(2, body)
+
+        results = _with_server(go)
+        assert results[0][0] is False and results[1][0] is False
+        # the receiver's FIRST value had already moved when the second
+        # failed: it must be back at the pre-attempt template, not the
+        # holder's committed value
+        np.testing.assert_array_equal(results[1][1],
+                                      np.zeros(20, np.float32))
+        np.testing.assert_array_equal(results[0][1], first)
+
+    def test_redist_chunk_bytes_knob_fail_fast(self, monkeypatch):
+        from horovod_tpu.core.config import Config
+        monkeypatch.setenv("HOROVOD_REDIST_CHUNK_BYTES", "nope")
+        with pytest.raises(ValueError, match="HOROVOD_REDIST_CHUNK"):
+            Config.from_env()
+        # from_env validates too: out-of-range fails at startup
+        monkeypatch.setenv("HOROVOD_REDIST_CHUNK_BYTES", "12")
+        with pytest.raises(ValueError, match="HOROVOD_REDIST_CHUNK"):
+            Config.from_env()
+        monkeypatch.setenv("HOROVOD_REDIST_CHUNK_BYTES", "65536")
+        assert Config.from_env().redist_chunk_bytes == 65536
+
+    def test_commit_serial_semantics(self):
+        from horovod_tpu.elastic.state import State
+        s = State(x=1)
+        assert s.commit_serial == 0     # construction only
+        s.commit()
+        assert s.commit_serial == 1
+        s.save()                        # save() does not advance it
+        assert s.commit_serial == 1
+        from horovod_tpu.elastic._base_state import BaseFrameworkState
+
+        class Mem(BaseFrameworkState):
+            def _save_payload(self):
+                return None
+
+            def _restore_payload(self, snap):
+                pass
+
+        m = Mem(y=2)
+        assert m.commit_serial == 0
+        m.commit()
+        assert m.commit_serial == 1
+
+
+# ---------------------------------------------------------------------------
+# weight streaming + serve hot swap
+# ---------------------------------------------------------------------------
+
+_KW = dict(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+           max_seq_len=48, dtype=jnp.float32, attention_impl="reference")
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    from horovod_tpu.models.gpt import GPT, GPTConfig
+    dec = GPT(GPTConfig(decode=True, **_KW))
+    toks = jnp.zeros((2, 8), jnp.int32)
+    params_a = GPT(GPTConfig(**_KW)).init(
+        jax.random.PRNGKey(0), toks)["params"]
+    params_b = jax.tree_util.tree_map(
+        lambda x: x + 0.1 * jnp.sign(x + 0.5), params_a)
+    train_a = GPT(GPTConfig(**_KW))
+
+    @jax.jit
+    def oracle_next(p, padded, last):
+        logits = train_a.apply({"params": p}, padded)
+        return jnp.argmax(jnp.take(logits[0], last, axis=0))
+
+    def oracle(params, prompt, max_new):
+        seq, out = list(prompt), []
+        for _ in range(max_new):
+            padded = np.zeros((1, _KW["max_seq_len"]), np.int32)
+            padded[0, :len(seq)] = seq
+            nxt = int(oracle_next(params, jnp.asarray(padded),
+                                  jnp.asarray(len(seq) - 1)))
+            out.append(nxt)
+            seq.append(nxt)
+        return out
+
+    return SimpleNamespace(dec=dec, params_a=params_a,
+                           params_b=params_b, oracle=oracle)
+
+
+class TestWeightStream:
+    def test_publish_poll_roundtrip_monotone(self):
+        def go(srv):
+            tree = _mixed_tree()
+            pub = WeightPublisher("c1", kv_addr="127.0.0.1",
+                                  kv_port=srv.port, chunk_bytes=4096)
+            sub = WeightSubscriber("c1", kv_addr="127.0.0.1",
+                                   kv_port=srv.port, template=tree)
+            assert sub.poll() is None                 # nothing yet
+            v1 = pub.publish(tree)
+            assert v1 == 1
+            got_v, got = sub.poll()
+            assert got_v == 1 and _trees_equal(got, tree)
+            assert sub.poll() is None                 # monotone: no re-adopt
+            with pytest.raises(RedistError, match="increasing"):
+                pub.publish(tree, version=1)
+            tree2 = dict(tree, ids=tree["ids"] * 2)
+            assert pub.publish(tree2) == 2
+            got_v, got = sub.poll()
+            assert got_v == 2 and _trees_equal(got, tree2)
+            pub.close()
+            sub.close()
+            return True
+
+        assert _with_server(go)
+
+    def test_restarted_publisher_resumes_version_sequence(self):
+        """A relaunched publisher must continue ABOVE the live head —
+        restarting at 1 would make every subscriber silently refuse
+        its publishes forever under monotone adoption."""
+        def go(srv):
+            tree = {"w": np.arange(16.0)}
+            pub1 = WeightPublisher("c6", kv_addr="127.0.0.1",
+                                   kv_port=srv.port)
+            assert pub1.publish(tree) == 1
+            assert pub1.publish(tree) == 2
+            pub1.close()
+            pub2 = WeightPublisher("c6", kv_addr="127.0.0.1",
+                                   kv_port=srv.port)   # the relaunch
+            assert pub2.publish(tree) == 3
+            sub = WeightSubscriber("c6", kv_addr="127.0.0.1",
+                                   kv_port=srv.port)
+            v, _ = sub.poll()
+            assert v == 3
+            pub2.close()
+            sub.close()
+            return True
+
+        assert _with_server(go)
+
+    def test_multi_chunk_stream_with_zero_size_leaf(self):
+        """Chunk boundaries landing mid-leaf and zero-size leaves both
+        survive the streaming (no monolithic join) assembly."""
+        def go(srv):
+            tree = {"big": np.arange(3000, dtype=np.float32),
+                    "empty": np.empty((0, 4), np.float32),
+                    "tail": np.arange(5, dtype=np.int16),
+                    "n": 9}
+            pub = WeightPublisher("c7", kv_addr="127.0.0.1",
+                                  kv_port=srv.port, chunk_bytes=4096)
+            sub = WeightSubscriber("c7", kv_addr="127.0.0.1",
+                                   kv_port=srv.port, template=tree)
+            v = pub.publish(tree)
+            got_v, got = sub.poll()
+            assert got_v == v and _trees_equal(got, tree)
+            pub.close()
+            sub.close()
+            return True
+
+        assert _with_server(go)
+
+    def test_untemplated_subscriber_builds_path_tree(self):
+        def go(srv):
+            pub = WeightPublisher("c2", kv_addr="127.0.0.1",
+                                  kv_port=srv.port)
+            sub = WeightSubscriber("c2", kv_addr="127.0.0.1",
+                                   kv_port=srv.port)
+            pub.publish({"a": {"w": np.arange(4.0)}, "n": 3})
+            v, tree = sub.poll()
+            assert v == 1
+            np.testing.assert_array_equal(tree["a"]["w"],
+                                          np.arange(4.0))
+            assert tree["n"] == 3
+            pub.close()
+            sub.close()
+            return True
+
+        assert _with_server(go)
+
+    def test_corrupt_chunk_fails_fast_when_head_stable(self):
+        def go(srv):
+            from horovod_tpu.native.store import StoreClient
+            pub = WeightPublisher("c3", kv_addr="127.0.0.1",
+                                  kv_port=srv.port)
+            sub = WeightSubscriber("c3", kv_addr="127.0.0.1",
+                                   kv_port=srv.port)
+            v = pub.publish({"w": np.arange(64.0)})
+            kv = StoreClient("127.0.0.1", srv.port)
+            kv.set(f"ws.c3.s{v % 2}.c0", b"garbage")
+            with pytest.raises(RedistError, match="crc32"):
+                sub.poll()
+            kv.close()
+            pub.close()
+            sub.close()
+            return True
+
+        assert _with_server(go)
+
+    def test_publisher_side_chaos_corrupt_is_caught(self, disarm_chaos):
+        """The crc table is computed BEFORE the chaos gate: a
+        publish-side bit flip lands in the stored chunk but not its
+        checksum, so the subscriber refuses the snapshot instead of
+        silently adopting corrupted weights."""
+        def go(srv):
+            pub = WeightPublisher("c5", kv_addr="127.0.0.1",
+                                  kv_port=srv.port)
+            sub = WeightSubscriber("c5", kv_addr="127.0.0.1",
+                                   kv_port=srv.port)
+            chaos_inject.install(
+                ChaosPlan.from_dict({"seed": 3, "faults": [
+                    {"rank": 0, "site": "redist.transport",
+                     "kind": "corrupt"}]}), rank=0)
+            pub.publish({"w": np.arange(256.0)})
+            chaos_inject.uninstall()      # clean fetch of dirty bytes
+            with pytest.raises(RedistError, match="crc32"):
+                sub.poll()
+            pub.close()
+            sub.close()
+            return True
+
+        assert _with_server(go)
+
+    def test_server_memory_bounded_by_slots(self):
+        def go(srv):
+            from horovod_tpu.native.store import StoreClient
+            pub = WeightPublisher("c4", kv_addr="127.0.0.1",
+                                  kv_port=srv.port, slots=2)
+            for _ in range(6):
+                pub.publish({"w": np.arange(32.0)})
+            kv = StoreClient("127.0.0.1", srv.port)
+            n_keys = kv.stat()["data"]
+            kv.close()
+            pub.close()
+            # head + tiny version key + at most `slots` single-chunk
+            # payload slots
+            assert n_keys <= 2 + 2
+            return True
+
+        assert _with_server(go)
+
+
+class TestServeHotSwap:
+    def _stack(self, gpt, timeline=None):
+        from horovod_tpu.serve import (AdmissionQueue, ContinuousBatcher,
+                                       ShardedExecutor)
+        ex = ShardedExecutor(gpt.dec, gpt.params_a, max_batch=4,
+                             max_len=_KW["max_seq_len"],
+                             timeline=timeline)
+        q = AdmissionQueue(max_queue=32, default_deadline_ms=60000.0)
+        b = ContinuousBatcher(ex, q, buckets=(8,))
+        b.warmup()
+        return ex, q, b
+
+    def test_swap_fence_and_monotonicity(self, gpt):
+        ex, _, _ = self._stack(gpt)
+        assert ex.swap_params(gpt.params_b, version=3) is True
+        assert ex.params_version == 3 and ex.swaps == 1
+        assert ex.swap_params(gpt.params_a, version=3) is False
+        assert ex.swap_params(gpt.params_a, version=2) is False
+        assert ex.params_version == 3 and ex.swaps == 1
+        with pytest.raises(ValueError, match="structurally"):
+            ex.swap_params({"not": np.zeros(2)}, version=9)
+        # dtype is jit-signature: a cast tree must fail fast, not
+        # surface as a recompile storm mid-traffic
+        cast = jax.tree_util.tree_map(
+            lambda x: np.asarray(x, np.float16), gpt.params_a)
+        with pytest.raises(ValueError, match="dtype"):
+            ex.swap_params(cast, version=9)
+
+    def test_fleet_adopts_mid_traffic_no_drop_no_tear(self, gpt):
+        """The ISSUE acceptance (serve leg): a 2-replica fleet adopts a
+        published version mid-traffic — every request completes with
+        its full token budget (none dropped/torn), both replicas land
+        on the same version (monotone), and the swap latency lands in
+        hvd_weight_swap_ms."""
+        def go(srv):
+            from horovod_tpu import obs
+            pub = WeightPublisher("fleet", kv_addr="127.0.0.1",
+                                  kv_port=srv.port)
+            fleet = []
+            for _ in range(2):
+                ex, q, b = self._stack(gpt)
+                sub = WeightSubscriber("fleet", kv_addr="127.0.0.1",
+                                       kv_port=srv.port,
+                                       template=gpt.params_a)
+                # interval 0 so the short test traffic window adopts
+                # deterministically; production keeps the default
+                # anti-stall throttle
+                b.attach_weights(sub, min_interval_s=0.0)
+                fleet.append((ex, q, b, sub))
+            swap_hist = obs.get_registry().get("hvd_weight_swap_ms")
+            count_before = swap_hist.count if swap_hist else 0
+
+            handles = {i: [] for i in range(2)}
+            stop = threading.Event()
+
+            def serve(i):
+                _, q, b, _ = fleet[i]
+                while not stop.is_set() or q.depth() > 0 or b._active:
+                    if not b.step():
+                        q.wait_for_work(timeout=0.01)
+
+            threads = [threading.Thread(target=serve, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            rng = np.random.RandomState(0)
+            # first wave on version A
+            for i, (_, q, _, _) in enumerate(fleet):
+                handles[i] += [q.submit(list(rng.randint(1, 64, 5)),
+                                        max_new_tokens=6)
+                               for _ in range(4)]
+            pub.publish(gpt.params_b)            # hot swap mid-traffic
+            # adoption is ASYNC (a background thread fetches/places so
+            # the decode loop never stalls): wait for both replicas to
+            # land on v1 while traffic keeps flowing
+            deadline = time.monotonic() + 30
+            while any(f[0].params_version != 1 for f in fleet):
+                assert time.monotonic() < deadline, \
+                    [f[0].params_version for f in fleet]
+                time.sleep(0.01)
+            for i, (_, q, _, _) in enumerate(fleet):
+                handles[i] += [q.submit(list(rng.randint(1, 64, 5)),
+                                        max_new_tokens=6)
+                               for _ in range(4)]
+            for hs in handles.values():
+                for h in hs:
+                    h.wait(timeout=60)
+            stop.set()
+            for t in threads:
+                t.join(30)
+
+            for i in range(2):
+                ex = fleet[i][0]
+                # monotone adoption across replicas: both at version 1
+                assert ex.params_version == 1, (i, ex.params_version)
+                assert ex.swaps == 1
+                for h in handles[i]:
+                    # no dropped, no torn: every request completed with
+                    # its FULL token budget
+                    assert h.status == "ok", (i, h.status)
+                    assert len(h.tokens) == 6
+            hist = obs.get_registry().get("hvd_weight_swap_ms")
+            assert hist is not None and hist.count >= count_before + 2
+            # requests submitted entirely AFTER adoption decode exactly
+            # like the params_b oracle — the swap really took (driven
+            # inline: the serving threads are already joined)
+            _, q, b, _ = fleet[0]
+            prompt = [3, 1, 4, 1, 5]
+            h = q.submit(prompt, max_new_tokens=5)
+            b.run()
+            assert h.status == "ok"
+            assert h.tokens == gpt.oracle(gpt.params_b, prompt, 5)
+            pub.close()
+            for _, _, b, sub in fleet:
+                sub.close()
+            return True
+
+        assert _with_server(go)
+
+
+# ---------------------------------------------------------------------------
+# tooling
+# ---------------------------------------------------------------------------
+
+class TestWeightsPushCLI:
+    def test_demo_and_ckpt_push_smoke(self, tmp_path):
+        def go(srv):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO + os.pathsep + \
+                env.get("PYTHONPATH", "")
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "weights_push.py"),
+                 "--kv", f"127.0.0.1:{srv.port}", "--channel", "cli",
+                 "--demo-mb", "1"],
+                capture_output=True, text=True, timeout=180, env=env)
+            assert out.returncode == 0, out.stderr[-2000:]
+            rec = json.loads(out.stdout.strip())
+            assert rec["version"] == 1 and rec["bytes"] > 1 << 20
+            with ShardedCheckpointer(str(tmp_path), rank=0, world=1,
+                                     async_save=False) as ck:
+                ck.save(4, {"p": {"w": np.arange(6, dtype=np.float32)},
+                            "step": 4})
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "weights_push.py"),
+                 "--kv", f"127.0.0.1:{srv.port}", "--channel", "cli",
+                 "--ckpt", str(tmp_path)],
+                capture_output=True, text=True, timeout=180, env=env)
+            assert out.returncode == 0, out.stderr[-2000:]
+            rec = json.loads(out.stdout.strip())
+            assert rec["version"] == 2 and rec["step"] == 4
+            sub = WeightSubscriber("cli", kv_addr="127.0.0.1",
+                                   kv_port=srv.port)
+            v, tree = sub.poll()
+            assert v == 2
+            np.testing.assert_array_equal(
+                tree["p"]["w"], np.arange(6, dtype=np.float32))
+            sub.close()
+            return True
+
+        assert _with_server(go)

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Push a checkpoint (or a demo tree) into a running serve fleet's
+weight stream — the operational end of training->serving hot weight
+streaming (horovod_tpu/redist/stream.py, docs/redistribution.md):
+
+    # publish the latest committed checkpoint step on channel "prod"
+    python tools/weights_push.py --kv 10.0.0.5:41234 --channel prod \\
+        --ckpt /ckpts/run17
+
+    # publish a specific step with an explicit version
+    python tools/weights_push.py --kv 10.0.0.5:41234 --channel prod \\
+        --ckpt /ckpts/run17 --step 4200 --version 7
+
+    # synthetic smoke payload (CI / bring-up)
+    python tools/weights_push.py --kv 10.0.0.5:41234 --channel prod \\
+        --demo-mb 4
+
+Every ``ShardedExecutor`` fleet with a ``WeightSubscriber`` attached to
+the channel hot-swaps the published version between decode iterations
+(monotone adoption, crc-verified, no disk hop). The checkpoint is read
+through the ckpt store's plan layer (local chunk reads, CRC-verified,
+replica fallback) and published flat — jax never touches the tree, so
+this tool runs on any box that can reach the KV store and the
+checkpoint directory.
+
+Prints ONE JSON line: {"channel", "version", "bytes", "chunks",
+"leaves", "step"} on success; a structured {"error": ...} line and rc 1
+on failure.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_ckpt(root: str, step):
+    """(paths, leaves, step): the full tree of a committed step via the
+    shared plan layer — world-1 target, local CRC-verified chunk
+    reads."""
+    from horovod_tpu.ckpt.reshard import plan_reshard, read_block
+    from horovod_tpu.ckpt.store import (list_steps, load_manifest,
+                                        pyobj_value)
+    steps = list_steps(root)
+    if not steps:
+        raise SystemExit(f"no committed checkpoint under {root}")
+    if step is None:
+        step = steps[-1]
+    elif step not in steps:
+        raise SystemExit(
+            f"step {step} not committed under {root} (have {steps})")
+    man = load_manifest(root, step)
+    ops = plan_reshard(man, 1, target_rank=0)[0]
+    blocks, _ = read_block(root, step, man, ops)
+    paths, leaves = [], []
+    for i, e in enumerate(man["leaves"]):
+        paths.append(e["path"])
+        if e["kind"] == "array":
+            if i in blocks:
+                leaves.append(blocks[i])
+            else:
+                import numpy as np
+                leaves.append(np.empty(e["shape"],
+                                       np.dtype(e["dtype"])))
+        else:
+            leaves.append(pyobj_value(e))
+    return paths, leaves, step
+
+
+def _demo_tree(mb: int):
+    import numpy as np
+    rows = max((mb * (1 << 20)) // (4 * 256), 1)
+    return (["demo/w", "demo/b", "demo/step"],
+            [np.arange(rows * 256, dtype=np.float32).reshape(rows, 256),
+             np.arange(16, dtype=np.float32), 1], None)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="publish weights into a serve fleet's KV stream")
+    ap.add_argument("--kv", required=True, metavar="HOST:PORT",
+                    help="native KV store (HOROVOD_NATIVE_KV_ADDR/PORT "
+                         "of the fleet's launcher)")
+    ap.add_argument("--channel", default="default")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--ckpt", metavar="DIR",
+                     help="sharded checkpoint directory (hvdckpt-v1)")
+    src.add_argument("--demo-mb", type=int, metavar="MB",
+                     help="publish a synthetic tree of ~MB instead")
+    ap.add_argument("--step", type=int, default=None,
+                    help="checkpoint step (default: latest committed)")
+    ap.add_argument("--version", type=int, default=None,
+                    help="stream version (default: current head + 1)")
+    ap.add_argument("--chunk-bytes", type=int, default=4 * 1024 * 1024)
+    args = ap.parse_args(argv)
+    try:
+        host, port = args.kv.rsplit(":", 1)
+        from horovod_tpu.redist.stream import WeightPublisher
+        if args.ckpt:
+            paths, leaves, step = _load_ckpt(args.ckpt, args.step)
+        else:
+            paths, leaves, step = _demo_tree(args.demo_mb)
+        # WeightPublisher resumes the channel's version sequence from
+        # the live head at construction, and publish_flat enforces
+        # strict monotonicity — an explicit --version at or below the
+        # live head fails loudly instead of publishing a version every
+        # subscriber would silently refuse
+        pub = WeightPublisher(args.channel, kv_addr=host,
+                              kv_port=int(port),
+                              chunk_bytes=args.chunk_bytes)
+        v = pub.publish_flat(paths, leaves, version=args.version)
+        import numpy as np
+        nbytes = sum(l.nbytes for l in leaves
+                     if isinstance(l, np.ndarray))
+        pub.close()
+        print(json.dumps({"channel": args.channel, "version": v,
+                          "bytes": nbytes, "leaves": len(leaves),
+                          "chunks": -(-nbytes // args.chunk_bytes)
+                          if nbytes else 1,
+                          "step": step}))
+        return 0
+    except BrokenPipeError:  # pragma: no cover — piped to head
+        return 0
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — structured error line
+        print(json.dumps({"error": str(e)[-500:]}))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
