@@ -18,7 +18,10 @@ inception3 — the reference's full headline scaling trio
 obs registry's histogram into the summary line and prints the end-of-run
 registry snapshot as a second JSON line (docs/metrics.md).
 
-`--serve` runs the continuous-batching loopback benchmark, `--ckpt`
+`--serve` runs the continuous-batching loopback benchmark,
+`--serve-soak` the chaos-hardened fleet soak (serve_p99_under_fault_ms
++ failover_ms from a seeded crash/partition/corrupt/slow incident —
+docs/serving.md), `--ckpt`
 the checkpoint-plane loopback (ckpt_save_ms / ckpt_blocking_ms /
 ckpt_restore_ms — docs/checkpoint.md), `--collectives` the
 collective-algorithm microbench (bytes/s per algorithm x tensor size
@@ -231,6 +234,45 @@ def run_benchmark():
         "wire_bytes_per_step": wire_per_step,
         **step_pcts,
     }), flush=True)
+
+
+def run_serve_soak_benchmark() -> int:
+    """Serving-soak benchmark (`bench.py --serve-soak`): run the
+    chaos-hardened fleet soak (horovod_tpu/serve/soak.py — N replicas,
+    closed-loop traffic, seeded crash/partition/corrupt/slow plan) and
+    print TWO JSON metric lines — serve_p99_under_fault_ms (p99 request
+    latency OUTSIDE the bounded recovery windows, i.e. the latency a
+    client sees on a bad day once failover has done its job) and
+    failover_ms (replica death -> ejection + in-flight re-enqueued).
+    Exits non-zero when the soak verdict itself is red."""
+    try:
+        from horovod_tpu.serve.soak import run_serve_soak
+        replicas = int(os.environ.get("HVD_BENCH_SOAK_REPLICAS", "3"))
+        clients = int(os.environ.get("HVD_BENCH_SOAK_CLIENTS", "6"))
+        seed = int(os.environ.get("HVD_BENCH_SOAK_SEED", "7"))
+        verdict = run_serve_soak(replicas=replicas, clients=clients,
+                                 seed=seed)
+        common = {"replicas": replicas, "clients": clients,
+                  "seed": seed, "soak_ok": verdict["ok"],
+                  "error_rate_outside": verdict["error_rate_outside"],
+                  "submitted": verdict["submitted"],
+                  "wall_s": verdict["wall_s"]}
+        fo_ms = None if verdict.get("failover_s") is None \
+            else round(verdict["failover_s"] * 1000.0, 1)
+        print(json.dumps({
+            "metric": "serve_p99_under_fault_ms",
+            "value": verdict["p99_outside_ms"], "unit": "ms",
+            **common}), flush=True)
+        print(json.dumps({
+            "metric": "failover_ms", "value": fo_ms, "unit": "ms",
+            **common}), flush=True)
+        return 0 if verdict["ok"] else 1
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        for metric in ("serve_p99_under_fault_ms", "failover_ms"):
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": "ms", "error": str(e)[-500:]}),
+                  flush=True)
+        return 1
 
 
 def run_serve_benchmark() -> int:
@@ -795,6 +837,9 @@ if __name__ == "__main__":
         os.environ["HVD_BENCH_METRICS"] = "1"
     if "--worker" in sys.argv:
         run_benchmark()
+    elif "--serve-soak" in sys.argv or \
+            os.environ.get("HVD_BENCH_SERVE_SOAK") == "1":
+        sys.exit(run_serve_soak_benchmark())
     elif "--serve" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE") == "1":
         sys.exit(run_serve_benchmark())

@@ -47,6 +47,88 @@ _DETECTOR: Optional["HeartbeatDetector"] = None
 ESCALATE_EXIT_CODE = 70
 
 
+class AccrualTracker:
+    """The accrual bookkeeping core, factored out of the KV-store
+    heartbeat detector so the serve fleet's router (serve/fleet.py) can
+    eject replicas with the SAME suspicion semantics the training plane
+    uses: per-peer heartbeat AGE (time since the sequence number last
+    advanced), observed inter-arrival history, a phi score, and the
+    never-seen rule — a peer that has not heartbeated at least once
+    cannot be suspected (startup skew must not let the fastest observer
+    flag a healthy slow starter; a peer that never comes up at all is
+    its supervisor's case, not this tracker's).
+
+    Thread-safe; pure bookkeeping — no sockets, no metrics, no
+    escalation (those stay with the callers).
+    """
+
+    def __init__(self, peers, *, interval_s: float = 1.0,
+                 suspect_s: float = 5.0):
+        self.interval_s = float(interval_s)
+        self.suspect_s = float(suspect_s)
+        now = time.monotonic()
+        self._lock = threading.Lock()
+        self._last_seen: Dict[int, float] = {p: now for p in peers}
+        self._last_seq: Dict[int, int] = {}
+        self._arrivals: Dict[int, deque] = {
+            p: deque(maxlen=16) for p in self._last_seen}
+        self._suspected: Dict[int, float] = {}   # peer -> age_s at flag
+
+    def observe(self, peer: int, seq: Optional[int]):
+        """Fold one sweep of ``peer``'s heartbeat sequence in; returns
+        ``(event, age_s)`` where event is ``"suspect"`` (age just
+        crossed the threshold), ``"recovered"`` (the sequence advanced
+        while suspected) or None."""
+        now = time.monotonic()
+        recovered = suspected = False
+        with self._lock:
+            if seq is not None and seq != self._last_seq.get(peer):
+                if peer in self._last_seq:
+                    self._arrivals[peer].append(
+                        now - self._last_seen[peer])
+                self._last_seq[peer] = seq
+                self._last_seen[peer] = now
+                if peer in self._suspected:
+                    del self._suspected[peer]
+                    recovered = True
+            age = now - self._last_seen[peer]
+            if age > self.suspect_s and peer in self._last_seq \
+                    and peer not in self._suspected:
+                self._suspected[peer] = age
+                suspected = True
+        return (("suspect" if suspected else
+                 "recovered" if recovered else None), age)
+
+    def suspects(self) -> Dict[int, float]:
+        """{peer: heartbeat age seconds} for currently suspected peers
+        (age re-read live, not the age at flag time)."""
+        now = time.monotonic()
+        with self._lock:
+            return {p: now - self._last_seen[p] for p in self._suspected}
+
+    def phi(self, peer: int) -> float:
+        """Accrual score: heartbeat age over the observed mean
+        inter-arrival (>= 1 means 'late'; grows without bound on a dead
+        peer)."""
+        now = time.monotonic()
+        with self._lock:
+            age = now - self._last_seen[peer]
+            arr = self._arrivals.get(peer)
+            mean = (sum(arr) / len(arr)) if arr else self.interval_s
+        return age / max(mean, 1e-6, self.interval_s / 10.0)
+
+    def reset(self, peer: int) -> None:
+        """Forget ``peer``'s history (re-admission of a recovered
+        replica): its age restarts from now and it re-enters the
+        never-seen state, so it cannot be re-suspected until it has
+        heartbeated again."""
+        with self._lock:
+            self._last_seen[peer] = time.monotonic()
+            self._last_seq.pop(peer, None)
+            self._arrivals[peer].clear()
+            self._suspected.pop(peer, None)
+
+
 class HeartbeatDetector:
     """Post own heartbeat + sweep peers every ``interval_s``; suspect a
     peer once its heartbeat age exceeds ``suspect_s``."""
@@ -73,13 +155,9 @@ class HeartbeatDetector:
         self._wake = threading.Event()
         self._lock = threading.Lock()
         self._listeners: List[Callable[[dict], None]] = []
-        now = time.monotonic()
-        self._last_seen: Dict[int, float] = {
-            p: now for p in range(self.world) if p != self.rank}
-        self._last_seq: Dict[int, int] = {}
-        self._arrivals: Dict[int, deque] = {
-            p: deque(maxlen=16) for p in self._last_seen}
-        self._suspected: Dict[int, float] = {}   # peer -> age_s at flag
+        peers = [p for p in range(self.world) if p != self.rank]
+        self._acc = AccrualTracker(peers, interval_s=self.interval_s,
+                                   suspect_s=self.suspect_s)
         self._escalated = False
         # -- metrics (ownership claim: a fresh detector counts from 0)
         if registry is None:
@@ -92,12 +170,17 @@ class HeartbeatDetector:
             p: registry.gauge(
                 "hvd_peer_heartbeat_age_ms",
                 "ms since this peer's heartbeat sequence last advanced",
-                {"peer": str(p)}) for p in self._last_seen}
+                {"peer": str(p)}) for p in peers}
         self._m_susp = {
             p: registry.counter(
                 "hvd_detector_suspicions_total",
                 "times this peer's heartbeat age crossed the suspect "
-                "threshold", {"peer": str(p)}) for p in self._last_seen}
+                "threshold", {"peer": str(p)}) for p in peers}
+
+    # back-compat view (tests introspect which peers have been seen)
+    @property
+    def _last_seq(self) -> Dict[int, int]:
+        return self._acc._last_seq
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "HeartbeatDetector":
@@ -133,21 +216,13 @@ class HeartbeatDetector:
     def suspects(self) -> Dict[int, float]:
         """{peer: heartbeat age seconds} for currently suspected peers
         (age re-read live, not the age at flag time)."""
-        now = time.monotonic()
-        with self._lock:
-            return {p: now - self._last_seen[p]
-                    for p in self._suspected}
+        return self._acc.suspects()
 
     def phi(self, peer: int) -> float:
         """Accrual score: heartbeat age over the observed mean
         inter-arrival (>= 1 means 'late'; grows without bound on a dead
         peer)."""
-        now = time.monotonic()
-        with self._lock:
-            age = now - self._last_seen[peer]
-            arr = self._arrivals.get(peer)
-            mean = (sum(arr) / len(arr)) if arr else self.interval_s
-        return age / max(mean, 1e-6, self.interval_s / 10.0)
+        return self._acc.phi(peer)
 
     # -- internals ---------------------------------------------------------
     def _key(self, rank: int) -> str:
@@ -172,7 +247,7 @@ class HeartbeatDetector:
                 kv.set(self._key(self.rank),
                        json.dumps({"seq": self._seq,
                                    "t": time.time()}).encode())
-                for peer in list(self._last_seen):
+                for peer in list(self._m_age):
                     if not self._running:
                         return
                     try:
@@ -199,30 +274,17 @@ class HeartbeatDetector:
             self._wake.wait(self.interval_s)
 
     def _observe(self, peer: int, seq: Optional[int]) -> None:
-        now = time.monotonic()
-        recovered = suspected = False
-        with self._lock:
-            if seq is not None and seq != self._last_seq.get(peer):
-                if peer in self._last_seq:
-                    self._arrivals[peer].append(now - self._last_seen[peer])
-                self._last_seq[peer] = seq
-                self._last_seen[peer] = now
-                if peer in self._suspected:
-                    del self._suspected[peer]
-                    recovered = True
-            age = now - self._last_seen[peer]
-            # Only a peer that HAS heartbeated can be suspected: ages
-            # start at detector construction, and startup skew across
-            # hosts (jax import, device init) routinely exceeds
-            # suspect_s — suspecting a never-seen peer would let the
-            # fastest rank escalate against a healthy slow one and loop
-            # the job through resets. A worker that never comes up at
-            # all is the DRIVER's case (spawn failure / elastic
-            # timeout), not this detector's.
-            if age > self.suspect_s and peer in self._last_seq \
-                    and peer not in self._suspected:
-                self._suspected[peer] = age
-                suspected = True
+        # The never-seen rule lives in AccrualTracker.observe: only a
+        # peer that HAS heartbeated can be suspected — ages start at
+        # construction, and startup skew across hosts (jax import,
+        # device init) routinely exceeds suspect_s, so suspecting a
+        # never-seen peer would let the fastest rank escalate against a
+        # healthy slow one and loop the job through resets. A worker
+        # that never comes up at all is the DRIVER's case (spawn
+        # failure / elastic timeout), not this detector's.
+        event, age = self._acc.observe(peer, seq)
+        recovered = event == "recovered"
+        suspected = event == "suspect"
         self._m_age[peer].set(age * 1000.0)
         if recovered:
             logger.info("HEALTH: rank %d heartbeat recovered (was "

@@ -26,6 +26,16 @@ boundaries:
 * ``step``              — :func:`step_boundary`, called by the training
   loop (the soak worker does): crash (SIGKILL self — the host-loss
   scenario), slow_rank, delay.
+* ``serve/``            — the serving fleet's real boundaries
+  (serve/batcher.py, serve/queue.py, serve/fleet.py): ``serve.step``
+  crash/slow a replica mid-decode (crash kills the replica's scheduler
+  THREAD, not the process — the in-process replica-loss analog),
+  ``serve.kv`` corrupt (one live KV slot's device bytes bit-flipped;
+  the per-slot crc-on-write option must catch it before a client sees
+  output), ``serve.route`` partition (the router's dispatches to one
+  replica are refused for the window), ``serve.admit`` delay/drop at
+  the queue door. Serve faults address replicas via ``peer``; guards
+  pass the replica-local invocation counter explicitly.
 
 The guards read a single module attribute (``_INJ is not None``) when
 disarmed, execute no other code, and never touch the payload — the
@@ -166,9 +176,20 @@ class Injector:
             if f.kind in ("delay", "slow_rank"):
                 time.sleep(f.seconds)
             elif f.kind == "crash":
-                # the host-loss scenario: no cleanup, no atexit, no
-                # flushes — exactly what a dead machine looks like
-                os.kill(os.getpid(), signal.SIGKILL)
+                if site.startswith("serve."):
+                    # a serve-plane crash kills the REPLICA, not the
+                    # process: the caller (the batcher's step guard)
+                    # raises and its scheduler thread dies — the
+                    # in-process analog of a replica host loss, which
+                    # is what stops its heartbeats and triggers the
+                    # router's ejection path. SIGKILLing here would
+                    # take the router and the healthy replicas down
+                    # with the victim.
+                    returned = returned or f
+                else:
+                    # the host-loss scenario: no cleanup, no atexit, no
+                    # flushes — exactly what a dead machine looks like
+                    os.kill(os.getpid(), signal.SIGKILL)
             elif f.kind == "partition":
                 with self._lock:
                     self._partitions[(site, f.peer)] = \

@@ -49,19 +49,36 @@ FAULT_KINDS = ("delay", "drop", "crash", "corrupt", "partition",
 
 FAULT_SITES = ("step", "store.request", "p2p.send", "p2p.recv",
                "ckpt.write", "ckpt.read", "ckpt.commit",
-               "redist.transport")
+               "redist.transport",
+               # serve plane (horovod_tpu/serve): faults address a
+               # REPLICA via the "peer" field (the serving process is
+               # the plan's "rank"); "at"/"after"/"until" count that
+               # replica's own scheduler iterations (serve.step /
+               # serve.kv), its router dispatches (serve.route) or its
+               # queue submits (serve.admit) — the guards pass the
+               # replica-local counter explicitly, so addressing stays
+               # deterministic per replica across the whole fleet.
+               "serve.step", "serve.kv", "serve.route", "serve.admit")
 
 #: which kinds are meaningful at which sites (a drop needs a connection
-#: to sever; a torn write needs a shard file; ...)
+#: to sever; a torn write needs a shard file; a KV corruption needs a
+#: cache slot; ...)
 _KIND_SITES = {
     "delay": FAULT_SITES,
-    "slow_rank": ("step",),
-    "crash": FAULT_SITES,
+    "slow_rank": ("step", "serve.step"),
+    # serve-plane crashes land ONLY at serve.step (the scheduler loop,
+    # where the guard raises ReplicaDead): at the other serve sites no
+    # guard acts on a returned crash, so validating it there would let
+    # fire() record a "crash" that kills nothing — a soak could then
+    # prove recovery from a death that never happened
+    "crash": tuple(s for s in FAULT_SITES
+                   if not s.startswith("serve.")) + ("serve.step",),
     "drop": ("store.request", "p2p.send", "p2p.recv",
-             "redist.transport"),
-    "corrupt": ("store.request", "p2p.send", "redist.transport"),
+             "redist.transport", "serve.admit"),
+    "corrupt": ("store.request", "p2p.send", "redist.transport",
+                "serve.kv"),
     "partition": ("store.request", "p2p.send", "p2p.recv",
-                  "redist.transport"),
+                  "redist.transport", "serve.route"),
     "torn_write": ("ckpt.write",),
     "delete_chunk": ("ckpt.commit",),
 }
@@ -70,7 +87,7 @@ _KIND_SITES = {
 _NEEDS_SECONDS = ("delay", "slow_rank", "partition")
 
 _FIELDS = {"rank", "site", "kind", "at", "after", "until", "seconds",
-           "peer", "shard", "epoch"}
+           "peer", "shard", "slot", "epoch"}
 
 
 class PlanError(ValueError):
@@ -90,6 +107,9 @@ class Fault:
     seconds: Optional[float] = None
     peer: Optional[int] = None
     shard: Optional[int] = None
+    #: serve.kv corrupt only: the KV slot to hit (default: the lowest
+    #: live slot at fire time)
+    slot: Optional[int] = None
     epoch: Optional[int] = None
 
     def validate(self) -> "Fault":
@@ -111,7 +131,8 @@ class Fault:
             raise PlanError(
                 "a fault schedules either an exact 'at' or an "
                 "'after'/'until' window, not both")
-        for name in ("at", "after", "until", "peer", "shard", "epoch"):
+        for name in ("at", "after", "until", "peer", "shard", "slot",
+                     "epoch"):
             v = getattr(self, name)
             if v is not None and (not isinstance(v, int) or v < 0):
                 raise PlanError(
@@ -132,6 +153,10 @@ class Fault:
             raise PlanError(
                 "fault kind 'delete_chunk' needs 'shard' (the rank "
                 "whose committed shard file to delete)")
+        if self.slot is not None and self.site != "serve.kv":
+            raise PlanError(
+                f"fault field 'slot' only addresses KV slots at site "
+                f"'serve.kv'; got site {self.site!r}")
         return self
 
     def matches(self, n: int, epoch: int) -> bool:
@@ -236,16 +261,33 @@ class ChaosPlan:
 
 def random_plan(seed: int, world: int, steps: int, *,
                 commit_every: int = 2, crash: bool = True,
-                shard_delete: bool = True, noise: int = 2) -> ChaosPlan:
-    """A randomized-but-SEEDED soak plan: same (seed, world, steps) =>
-    byte-identical schedule.
+                shard_delete: bool = True, noise: int = 2,
+                profile: str = "train") -> ChaosPlan:
+    """A randomized-but-SEEDED soak plan: same (seed, world, steps,
+    profile) => byte-identical schedule.
 
-    Composes the acceptance scenario — one worker SIGKILLed mid-step in
-    epoch 0, one committed ckpt shard deleted right after the last
-    commit preceding the crash (so the relaunched job must restore that
-    commit through the buddy-replica path) — plus ``noise`` benign
-    delay/slow faults sprinkled across ranks and sites.
+    ``profile="train"`` (default) composes the training acceptance
+    scenario — one worker SIGKILLed mid-step in epoch 0, one committed
+    ckpt shard deleted right after the last commit preceding the crash
+    (so the relaunched job must restore that commit through the
+    buddy-replica path) — plus ``noise`` benign delay/slow faults
+    sprinkled across ranks and sites.
+
+    ``profile="serve"`` composes the serving acceptance scenario over a
+    ``world``-replica fleet (docs/serving.md): one replica crashed
+    mid-decode, a second partitioned from the router, a KV slot
+    corrupted on a third, one replica slowed past the suspect
+    threshold, and an admission-queue drop — ``steps`` is the scheduler
+    iteration horizon the crash/corrupt addresses land inside. All
+    serve faults fire on plan rank 0 (the serving process) and address
+    replicas via ``peer``.
     """
+    if profile == "serve":
+        return _random_serve_plan(seed, world, steps)
+    if profile != "train":
+        raise PlanError(
+            f"random_plan profile must be 'train' or 'serve'; "
+            f"got {profile!r}")
     if world < 2:
         raise PlanError(f"random_plan needs world >= 2; got {world}")
     if steps < 2 * commit_every + 2:
@@ -283,6 +325,55 @@ def random_plan(seed: int, world: int, steps: int, *,
                 site=rng.choice(("store.request", "p2p.send")),
                 kind="delay", at=rng.randrange(0, 20),
                 seconds=round(rng.uniform(0.01, 0.1), 3)))
+    for f in faults:
+        f.validate()
+    return ChaosPlan(seed=seed, faults=faults)
+
+
+def _random_serve_plan(seed: int, replicas: int, steps: int) -> ChaosPlan:
+    """The ``profile="serve"`` leg of :func:`random_plan`: the four
+    disruptions the serving SLO soak must survive (replica killed
+    mid-decode, router partition, KV corruption, slow host) plus one
+    admission drop, every address derived from ``random.Random(seed)``
+    alone."""
+    if replicas < 2:
+        raise PlanError(
+            f"a serve plan needs >= 2 replicas (a fleet of one has "
+            f"nothing to fail over to); got {replicas}")
+    if steps < 40:
+        raise PlanError(
+            f"a serve plan needs an iteration horizon >= 40 so the "
+            f"crash lands before the corrupt; got {steps}")
+    rng = random.Random(seed)
+    victim = rng.randrange(replicas)
+    others = [r for r in range(replicas) if r != victim]
+    partitioned = rng.choice(others)
+    slow = rng.choice(others)
+    corrupt = rng.choice(others)
+    faults = [
+        # kill one replica mid-decode: its batcher thread dies, its
+        # heartbeats stop, the router must eject + re-enqueue
+        Fault(rank=0, site="serve.step", kind="crash", peer=victim,
+              at=rng.randrange(steps // 4, steps // 2)),
+        # partition the router from a second replica: dispatches to it
+        # are refused for the window; the router must route around it
+        Fault(rank=0, site="serve.route", kind="partition",
+              peer=partitioned, at=rng.randrange(4, 12),
+              seconds=round(rng.uniform(1.5, 3.0), 3)),
+        # corrupt a KV slot on a third: the per-slot crc must catch it
+        # before any token of that sequence reaches a client
+        Fault(rank=0, site="serve.kv", kind="corrupt", peer=corrupt,
+              at=rng.randrange(steps // 2, (3 * steps) // 4)),
+        # slow one host past the suspect threshold: ejected while
+        # asleep, re-admitted when its heartbeats resume
+        Fault(rank=0, site="serve.step", kind="slow_rank", peer=slow,
+              at=rng.randrange((3 * steps) // 4, steps),
+              seconds=round(rng.uniform(2.2, 2.8), 3)),
+        # drop one admission: the router must absorb it (retry or
+        # reject-with-retry-after), never lose the request silently
+        Fault(rank=0, site="serve.admit", kind="drop",
+              peer=rng.randrange(replicas), at=rng.randrange(3, 10)),
+    ]
     for f in faults:
         f.validate()
     return ChaosPlan(seed=seed, faults=faults)

@@ -160,6 +160,13 @@ class Config:
     # right-padded to the smallest fitting bucket so jit compiles one
     # prefill program per bucket and nothing else, ever.
     serve_buckets: tuple = (32, 128, 512)
+    # Per-slot KV integrity: crc-on-write / verify-on-read of every
+    # retiring sequence's cache prefix (HOROVOD_SERVE_KV_CRC). Catches
+    # silent cache corruption before tokens reach a client (the chaos
+    # serve.kv fault's detection path) at the cost of one small
+    # device->host readback per step plus one prefix readback per
+    # retiring request. Off by default; the serving soak forces it on.
+    serve_kv_crc: bool = False
     # Checkpoint plane (horovod_tpu/ckpt): max in-flight async host
     # snapshots — save() backpressures beyond this bound
     # (HOROVOD_CKPT_SNAPSHOT_DEPTH; 2 = classic double buffering).
@@ -293,6 +300,8 @@ class Config:
                 raise ValueError(
                     f"HOROVOD_SERVE_BUCKETS must be a comma-separated "
                     f"list of ints; got {raw_buckets!r}")
+        c.serve_kv_crc = _env_bool("HOROVOD_SERVE_KV_CRC",
+                                   c.serve_kv_crc)
         # Ckpt knobs parse strictly (the PR 1-3 convention): a typo'd
         # depth/retention must fail at startup, not silently fall back
         # and change durability semantics mid-job.
@@ -405,6 +414,10 @@ class Config:
             raise ValueError(
                 f"HOROVOD_SERVE_DEADLINE_MS must be milliseconds in "
                 f"(0, 86400000]; got {dl!r}")
+        if not isinstance(self.serve_kv_crc, bool):
+            raise ValueError(
+                f"HOROVOD_SERVE_KV_CRC must be a boolean; got "
+                f"{self.serve_kv_crc!r}")
         mp = self.metrics_port
         if not isinstance(mp, int) or not (0 <= mp <= 65535):
             raise ValueError(

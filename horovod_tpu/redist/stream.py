@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import logging
 import socket
+import threading
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
@@ -216,6 +217,12 @@ class WeightSubscriber:
         self.poll_timeout = float(poll_timeout)
         self._kv, self._owns = _resolve_client(client, kv_addr, kv_port)
         self.version = 0
+        # poll()/peek_version() share one KV socket and the monotone
+        # version cursor: serialize them so a replica's background
+        # adoption thread and the fleet router's re-admission gate
+        # (serve/fleet.py) can share a subscriber without interleaving
+        # requests on the wire
+        self._plock = threading.Lock()
 
     def _head(self) -> Optional[dict]:
         from ..native.store import NativeTimeout
@@ -231,11 +238,33 @@ class WeightSubscriber:
                 f"{head.get('format')!r} (this build reads {FORMAT!r})")
         return head
 
+    def peek_version(self) -> Optional[int]:
+        """The channel's newest PUBLISHED version — a few bytes read
+        from the version key, no head fetch, no adoption, no side
+        effects. None when nothing is published (or the store is
+        slow). The fleet router's re-admission gate reads this: a
+        recovered replica must re-adopt at least this version before
+        it takes traffic again (serve/fleet.py)."""
+        from ..native.store import NativeTimeout
+        with self._plock:
+            try:
+                raw = self._kv.get(f"ws.{self.channel}.v",
+                                   timeout=self.poll_timeout)
+                return int(raw.decode())
+            except (NativeTimeout, ValueError):
+                return None
+
     def poll(self) -> Optional[Tuple[int, Any]]:
         """Adopt a newer version if one is published: returns
         ``(version, tree)`` or None (nothing new yet). A slot torn by a
         concurrent overwrite is detected by crc32 and skipped — the
-        NEXT poll sees the overwriting version's head."""
+        NEXT poll sees the overwriting version's head. Serialized:
+        concurrent callers (a batcher's adoption thread + the fleet
+        router's recovery gate) queue, they don't interleave."""
+        with self._plock:
+            return self._poll_locked()
+
+    def _poll_locked(self) -> Optional[Tuple[int, Any]]:
         from ..native.store import NativeTimeout
         try:
             raw = self._kv.get(f"ws.{self.channel}.v",

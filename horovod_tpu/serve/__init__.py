@@ -11,11 +11,17 @@ and the bucket/no-recompile contract.
     batcher.py   iteration-level scheduler over fixed bucket shapes
     executor.py  the one jitted step, sharded via parallel/tp rules
     http.py      optional stdlib front end (/generate, /healthz)
+    fleet.py     health-aware router over N replicas: accrual-driven
+                 ejection, at-most-once failover, drain-on-SIGTERM,
+                 re-admission on fresh streamed weights
+    soak.py      serving SLO soak under a seeded chaos plan
+                 (tools/serve_soak.py CLI; docs/serving.md)
 """
-from .batcher import ContinuousBatcher                         # noqa: F401
+from .batcher import ContinuousBatcher, ReplicaDead            # noqa: F401
 from .executor import ShardedExecutor                          # noqa: F401
+from .fleet import FleetHandle, FleetRouter, Replica           # noqa: F401
 from .http import make_server, serve_http                      # noqa: F401
 from .kv_cache import SlotKVCache, cached_attention, write_kv  # noqa: F401
 from .queue import (                                           # noqa: F401
-    AdmissionQueue, Rejected, ServeHandle, ServeRequest,
+    AdmissionQueue, AdmitDropped, Rejected, ServeHandle, ServeRequest,
 )

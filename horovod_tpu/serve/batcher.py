@@ -28,16 +28,27 @@ separate prefill queue.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..chaos import inject as _chaos
 from ..obs import metrics as obs_metrics
 from .kv_cache import SlotKVCache
 from .queue import AdmissionQueue, ServeRequest
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class ReplicaDead(RuntimeError):
+    """A chaos ``serve.step`` crash: this replica's scheduler thread
+    dies here — the in-process analog of losing the replica's host.
+    Its heartbeats stop, which is what the fleet router's accrual
+    tracker detects (serve/fleet.py)."""
 
 
 @dataclass
@@ -55,7 +66,10 @@ class ContinuousBatcher:
 
     def __init__(self, executor, queue: AdmissionQueue, *,
                  buckets: Sequence[int] = (32, 128, 512),
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 replica_id: Optional[int] = None,
+                 kv_crc: Optional[bool] = None,
+                 on_kv_corrupt: str = "reprefill"):
         buckets = tuple(sorted(int(b) for b in buckets))
         if not buckets or buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints; got {buckets}")
@@ -63,10 +77,37 @@ class ContinuousBatcher:
             raise ValueError(
                 f"largest prefill bucket {buckets[-1]} exceeds the model "
                 f"context {executor.max_len}")
+        if on_kv_corrupt not in ("reprefill", "error"):
+            raise ValueError(
+                f"on_kv_corrupt must be 'reprefill' or 'error'; "
+                f"got {on_kv_corrupt!r}")
         self.executor = executor
         self.queue = queue
         self.buckets = buckets
         self.eos_id = eos_id
+        #: fleet identity (None = standalone): labels the metric
+        #: series and addresses chaos serve.step / serve.kv faults
+        self.replica_id = replica_id
+        #: per-slot crc-on-write / verify-on-read (HOROVOD_SERVE_KV_CRC
+        #: or explicit): every cache write is folded into the slot's
+        #: crc ledger and every retiring request's valid prefix is
+        #: re-read and verified BEFORE its tokens can reach a client —
+        #: a corrupted slot either re-prefills from the prompt or fails
+        #: cleanly ("error"/kv_corrupt), never returns garbage. Costs
+        #: one device->host readback of the written slice per step plus
+        #: one full-prefix readback per retiring request; an integrity
+        #: option for chaos runs and paranoid deployments, off by
+        #: default.
+        if kv_crc is None:
+            from ..core.config import Config
+            kv_crc = Config.from_env().serve_kv_crc
+        self.kv_crc = bool(kv_crc)
+        self.on_kv_corrupt = on_kv_corrupt
+        self.kv_corruptions_detected = 0
+        self.kv_corruptions_injected = 0
+        self.kv_reprefills = 0
+        #: a fired serve.kv corrupt waiting for a written slot, (slot,)
+        self._pending_corrupt = None
         # unservable prompts get shed at submit time, not discovered
         # holding a decode slot
         if queue.max_prompt_len is None or \
@@ -74,19 +115,37 @@ class ContinuousBatcher:
             queue.max_prompt_len = buckets[-1]
         self.kv = SlotKVCache(executor.max_batch, executor.max_len)
         self._active: Dict[int, _Active] = {}   # slot -> sequence
+        self._reprefill: List[ServeRequest] = []
         self.iterations = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._dead = False
+        #: fleet liveness hook: called once per scheduling iteration
+        #: (busy or idle) on the batcher thread; a crashed/stuck
+        #: replica stops calling it, which is the router's signal
+        self.heartbeat: Optional[Callable[[], None]] = None
+        #: router-visible drain flag (mirrored into /healthz)
+        self.draining = False
         # -- metrics: time-to-first-token (admission wait + prefill) and
-        # live KV occupancy, next to the queue's depth/shed series
+        # live KV occupancy, next to the queue's depth/shed series.
+        # Standalone batchers claim fresh; fleet replicas use labeled
+        # children (same discipline as AdmissionQueue/ShardedExecutor).
+        rl = {} if replica_id is None else {"replica": str(replica_id)}
         R = obs_metrics.get_registry()
-        R.unregister("hvd_serve_ttft_ms")
-        R.unregister("hvd_serve_kv_occupancy")
+        if replica_id is None:
+            R.unregister("hvd_serve_ttft_ms")
+            R.unregister("hvd_serve_kv_occupancy")
         self._m_ttft = R.histogram(
             "hvd_serve_ttft_ms",
-            "time to first generated token (submit -> prefill), ms")
+            "time to first generated token (submit -> prefill), ms",
+            rl or None)
         self._m_occupancy = R.gauge(
-            "hvd_serve_kv_occupancy", "fraction of KV slots in use")
+            "hvd_serve_kv_occupancy", "fraction of KV slots in use",
+            rl or None)
+        self._m_kv_corrupt = R.counter(
+            "hvd_serve_kv_corruptions_total",
+            "KV slots whose verify-on-read crc failed (corruption "
+            "caught before reaching a client)", rl or None)
         #: optional weight-stream subscriber (redist/stream.py): polled
         #: between scheduling iterations, rate-limited so an idle or
         #: not-yet-published channel cannot stall the decode loop
@@ -158,11 +217,58 @@ class ContinuousBatcher:
         self.executor.step(np.zeros((B, 1), np.int32), zero, off, zero,
                            kind="decode")
 
+    # -- chaos guards (one attribute read when disarmed) ---------------------
+    def _fire_step_chaos(self) -> None:
+        """``serve.step`` site: crash kills THIS replica (the scheduler
+        thread dies and heartbeats stop — the router's problem from
+        here); delay/slow_rank sleep inside the injector, stalling the
+        replica mid-decode exactly like an overloaded host."""
+        if _chaos._INJ is None:
+            return
+        f = _chaos.fire("serve.step", peer=self.replica_id,
+                        step=self.iterations)
+        if f is not None and f.kind == "crash":
+            raise ReplicaDead(
+                f"chaos: replica {self.replica_id} crashed mid-decode "
+                f"(iteration {self.iterations})")
+
+    def _fire_kv_chaos(self) -> None:
+        """``serve.kv`` site: corrupt flips a real bit inside a live
+        slot's device cache prefix — detection must come from the crc
+        ledger, nothing else knows. A corrupt fired on an iteration
+        with no written slot is DEFERRED to the next one that has one,
+        so an exact-``at`` address always lands exactly one flip."""
+        if _chaos._INJ is None and self._pending_corrupt is None:
+            return
+        if _chaos._INJ is not None:
+            f = _chaos.fire("serve.kv", peer=self.replica_id,
+                            step=self.iterations)
+            if f is not None and f.kind == "corrupt" \
+                    and self._pending_corrupt is None:
+                self._pending_corrupt = (f.slot,)
+        if self._pending_corrupt is not None and self._active:
+            want = self._pending_corrupt[0]
+            slot = want if (want is not None and want in self._active) \
+                else min(self._active)
+            length = self._active[slot].cache_len
+            if length > 0:
+                self._pending_corrupt = None
+                self.executor.corrupt_kv_slot(slot, int(length))
+                self.kv_corruptions_injected += 1
+
     # -- one scheduling iteration -------------------------------------------
     def step(self) -> bool:
         """Run one retire/admit/prefill/decode iteration; returns True
         while there is (or may be) work in flight."""
+        hb = self.heartbeat
+        if hb is not None:
+            hb()
+        self._fire_step_chaos()
         self._maybe_swap_weights()
+        # expired-but-still-queued requests get their structured
+        # deadline completion NOW, even when every slot is busy —
+        # within one iteration, not at slot-drain time
+        self.queue.reap_expired()
         self._retire()
         admitted = self._admit()
         if admitted:
@@ -170,9 +276,17 @@ class ContinuousBatcher:
             self._retire()  # a 1-token request finishes at prefill
         if self._active:
             self._decode()
+        # evaluated EVERY iteration, busy or idle: the iteration counter
+        # below ticks regardless, so an exact-'at' corrupt landing while
+        # the replica is idle must still be captured (and deferred to
+        # the next written slot) — inside the busy branch the counter
+        # would walk past the address without fire() ever seeing it
+        self._fire_kv_chaos()
+        if self._active:
             self._retire()
         self.iterations += 1
-        return bool(self._active) or self.queue.depth() > 0
+        return bool(self._active) or bool(self._reprefill) \
+            or self.queue.depth() > 0
 
     def run(self, max_iterations: Optional[int] = None) -> None:
         """Drive until drained (loopback/bench mode)."""
@@ -182,17 +296,28 @@ class ContinuousBatcher:
             if max_iterations is not None and it >= max_iterations:
                 break
 
-    # -- background service mode (http front end) ---------------------------
+    # -- background service mode (http front end / fleet replica) -----------
     def start(self) -> None:
         if self._thread is not None:
             return
         self._stop.clear()
+        self._dead = False
 
         def loop():
-            while not self._stop.is_set():
-                if not self.step():
-                    # drained: sleep until a submit wakes us
-                    self.queue.wait_for_work(timeout=0.05)
+            try:
+                while not self._stop.is_set():
+                    if not self.step():
+                        # drained: sleep until a submit wakes us
+                        self.queue.wait_for_work(timeout=0.05)
+            except BaseException as e:  # noqa: BLE001 — replica death
+                # The thread dying IS the failure signal: alive() goes
+                # False, heartbeats stop, /healthz turns 503 and the
+                # fleet router ejects this replica. Nothing here may
+                # mask that by keeping the loop running.
+                self._dead = True
+                logger.error(
+                    "serve replica %s batcher thread died: %s",
+                    self.replica_id, e)
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="hvd-serve-batcher")
@@ -204,6 +329,16 @@ class ContinuousBatcher:
             self._thread.join(timeout=10)
             self._thread = None
 
+    def alive(self) -> bool:
+        """The liveness signal /healthz and the fleet router consume:
+        False once ``stop()`` ran or the scheduler thread died (chaos
+        crash, unhandled error). A not-yet-started batcher (loopback
+        ``run()`` mode) counts as alive — the caller drives it."""
+        if self._stop.is_set() or self._dead:
+            return False
+        t = self._thread
+        return t.is_alive() if t is not None else True
+
     # -- internals -----------------------------------------------------------
     def _stats(self) -> dict:
         occ = self.kv.occupancy()
@@ -211,6 +346,17 @@ class ContinuousBatcher:
         return {"queue_depth": self.queue.depth(),
                 "occupancy": round(occ, 3),
                 "shed": self.queue.shed_count}
+
+    def _kv_verify(self, seq: _Active) -> bool:
+        """Verify-on-read: re-read the slot's whole valid prefix and
+        check it against the write-side crc ledger. Runs only at
+        retirement (and only with kv_crc on), so a request's tokens are
+        NEVER released to a client from a cache row whose bytes changed
+        behind the scheduler's back."""
+        if not self.kv_crc or seq.cache_len <= 0:
+            return True
+        raw = self.executor.kv_slot_bytes(seq.slot, 0, seq.cache_len)
+        return self.kv.crc_check(seq.slot, raw)
 
     def _retire(self) -> None:
         now = time.monotonic()
@@ -225,6 +371,28 @@ class ContinuousBatcher:
             if not (done_ok or expired):
                 continue
             ms = (now - req.submitted_at) * 1000.0
+            if not self._kv_verify(seq):
+                # corrupted KV: the generated tokens are untrusted and
+                # must not reach the client. Re-prefill from the prompt
+                # (a fresh slot, a clean generation) while the deadline
+                # allows; otherwise fail cleanly.
+                self.kv_corruptions_detected += 1
+                self._m_kv_corrupt.inc()
+                logger.warning(
+                    "serve replica %s: KV slot %d failed crc "
+                    "verify-on-read (request %d) — %s",
+                    self.replica_id, slot, req.rid,
+                    "re-prefilling" if self.on_kv_corrupt == "reprefill"
+                    and not expired else "failing the request")
+                self.kv.free(slot)
+                del self._active[slot]
+                if self.on_kv_corrupt == "reprefill" and not expired:
+                    self.kv_reprefills += 1
+                    self._reprefill.append(req)
+                else:
+                    req.handle._resolve(
+                        [], "error", latency_ms=ms, error="kv_corrupt")
+                continue
             if expired and not done_ok:
                 self.queue.expired_count += 1
                 req.handle._resolve(seq.out, "expired", latency_ms=ms)
@@ -239,7 +407,14 @@ class ContinuousBatcher:
         if free <= 0:
             return []
         admitted: List[_Active] = []
-        for req in self.queue.pop(free):
+        # corrupted-and-reset sequences re-enter ahead of the queue
+        # (they already waited their turn once)
+        while self._reprefill and len(admitted) < free:
+            req = self._reprefill.pop(0)
+            slot = self.kv.alloc()
+            admitted.append(_Active(req=req, slot=slot))
+            self._active[slot] = admitted[-1]
+        for req in self.queue.pop(free - len(admitted)):
             slot = self.kv.alloc()  # free>=len(pop) => never None
             admitted.append(_Active(req=req, slot=slot))
             self._active[slot] = admitted[-1]
@@ -277,6 +452,11 @@ class ContinuousBatcher:
             # first generated token is the prompt's last-logit argmax
             a.out.append(int(nxt[a.slot]))
             self.kv.lengths[a.slot] = n
+            if self.kv_crc:
+                # crc-on-write covers exactly the valid prefix (pad
+                # positions past n are unreachable and unverified)
+                self.kv.crc_update(
+                    a.slot, self.executor.kv_slot_bytes(a.slot, 0, n))
 
     def _decode(self) -> None:
         B = self.executor.max_batch
@@ -293,6 +473,11 @@ class ContinuousBatcher:
         nxt = self.executor.step(tokens, positions, mask, last_idx,
                                  kind="decode", stats=self._stats())
         for slot, seq in self._active.items():
+            if self.kv_crc:
+                # this step wrote one K/V entry at the old cache_len
+                self.kv.crc_update(
+                    slot, self.executor.kv_slot_bytes(
+                        slot, seq.cache_len, seq.cache_len + 1))
             seq.cache_len += 1
             self.kv.lengths[slot] = seq.cache_len
             seq.out.append(int(nxt[slot]))

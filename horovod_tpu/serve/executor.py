@@ -42,7 +42,7 @@ class ShardedExecutor:
 
     def __init__(self, model: Any, params: Any, *, max_batch: int,
                  max_len: int, mesh=None, partition_rules=None,
-                 timeline=None):
+                 timeline=None, replica_id: Optional[int] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1; got {max_batch}")
         model_max = getattr(getattr(model, "cfg", None), "max_seq_len",
@@ -82,11 +82,18 @@ class ShardedExecutor:
         #: jit-signature ledger the no-recompile tests assert on
         self.signatures: Set[Tuple[str, int]] = set()
         # registry series: per-kind step latency histogram + generated
-        # tokens (claimed fresh per executor — one serving stack per
-        # process)
+        # tokens. Claimed fresh per executor when standalone (one
+        # serving stack per process); a FLEET replica instead passes
+        # replica_id and gets get-or-create labeled children, so one
+        # replica's (re)construction never clobbers its siblings'
+        # series and a restarted replica keeps counting where it left
+        # off (serve/fleet.py).
+        self.replica_id = replica_id
+        rl = {} if replica_id is None else {"replica": str(replica_id)}
         R = obs_metrics.get_registry()
-        R.unregister("hvd_serve_step_ms")
-        R.unregister("hvd_serve_tokens_total")
+        if replica_id is None:
+            R.unregister("hvd_serve_step_ms")
+            R.unregister("hvd_serve_tokens_total")
         # get-or-create, NOT claimed fresh: a multi-replica fleet runs
         # several executors in one process and the swap series is
         # fleet-shared (redist/stream.py)
@@ -96,10 +103,10 @@ class ShardedExecutor:
         self._m_step_ms = {
             k: R.histogram("hvd_serve_step_ms",
                            "executor step latency by kind (ms)",
-                           {"kind": k})
+                           dict(rl, kind=k))
             for k in ("prefill", "decode")}
         self._m_tokens = R.counter(
-            "hvd_serve_tokens_total", "tokens generated")
+            "hvd_serve_tokens_total", "tokens generated", rl or None)
 
         def fwd(params, cache, tokens, positions, mask, last_idx):
             logits, vout = self.model.apply(
@@ -233,6 +240,45 @@ class ShardedExecutor:
                 "version": self.params_version,
                 "swap_ms": round(dt_ms, 3)})
         return True
+
+    # -- KV-slot integrity hooks (serve.kv chaos + crc option) ---------------
+    def _cache_leaves(self) -> list:
+        """The device KV arrays inside the flax cache collection, in
+        flatten order: every ``[max_batch, L, H_kv, D]`` leaf (cache_k
+        and cache_v of each layer)."""
+        leaves = jax.tree_util.tree_leaves(self.cache)
+        return [l for l in leaves
+                if getattr(l, "ndim", 0) == 4
+                and l.shape[0] == self.max_batch]
+
+    def kv_slot_bytes(self, slot: int, start: int,
+                      stop: int) -> list:
+        """Host bytes of positions ``[start, stop)`` of ``slot``'s row
+        in each cache leaf (leaf order) — what the per-slot crc ledger
+        (SlotKVCache.crc_update/crc_check) streams over. Decode reads
+        one position; the verify-on-read pass re-reads the whole valid
+        prefix once per retiring request."""
+        return [np.asarray(l[slot, start:stop]).tobytes()
+                for l in self._cache_leaves()]
+
+    def corrupt_kv_slot(self, slot: int, length: int) -> None:
+        """Flip one deterministically chosen bit inside ``slot``'s
+        valid cache prefix — the chaos ``serve.kv`` fault body. Real
+        device bytes change, so detection must come from the crc
+        ledger, not from bookkeeping."""
+        from ..chaos import inject as _chaos
+        with self._swap_lock:   # never tear a step in flight
+            leaves, treedef = jax.tree_util.tree_flatten(self.cache)
+            idx = next(i for i, l in enumerate(leaves)
+                       if getattr(l, "ndim", 0) == 4
+                       and l.shape[0] == self.max_batch)
+            row = np.array(leaves[idx][slot, :length])
+            flipped = np.frombuffer(
+                _chaos.corrupt_copy(row.tobytes()),
+                dtype=row.dtype).reshape(row.shape)
+            leaves[idx] = leaves[idx].at[slot, :length].set(
+                jnp.asarray(flipped))
+            self.cache = jax.tree_util.tree_unflatten(treedef, leaves)
 
     # -- metrics -------------------------------------------------------------
     def tokens_per_s(self) -> float:

@@ -9,7 +9,10 @@ Two endpoints, JSON in/out, zero dependencies beyond `http.server`:
   "retry_after_ms": ...}`` with a standard ``Retry-After`` header —
   the structured load-shed contract (docs/serving.md).
 * ``GET /healthz`` -> ``200`` with the queue/batcher/executor counters
-  (queue depth, occupancy, shed count, tokens/s).
+  (queue depth, occupancy, shed count, tokens/s) plus ``replica_up`` /
+  ``draining``; ``503`` (same payload) once the batcher thread has died
+  or ``stop()`` ran — a real liveness signal a load balancer / the
+  fleet router can route on, not a bare reachability ping.
 * ``GET /metrics`` -> Prometheus text exposition of the process-global
   registry (horovod_tpu.obs) — serve latency histograms next to the
   engine's wire-byte counters, no second scrape port needed.
@@ -67,12 +70,22 @@ def make_server(batcher, host: str = "127.0.0.1",
                 self._reply(404, {"error": "not found"})
                 return
             ex = batcher.executor
-            info = {"ok": True,
+            # Liveness, not just reachability: once the batcher thread
+            # has died (chaos crash, unhandled error) or stop() ran, no
+            # queued request will ever be served again — a 200 here
+            # would keep a load balancer routing traffic into a black
+            # hole. 503 is what lets the router/LB actually use this
+            # endpoint as its health probe (docs/serving.md).
+            up = batcher.alive()
+            draining = bool(getattr(batcher, "draining", False))
+            info = {"ok": up and not draining,
+                    "replica_up": up,
+                    "draining": draining,
                     "occupancy": round(batcher.kv.occupancy(), 3),
                     "tokens_per_s": round(ex.tokens_per_s(), 1),
                     "iterations": batcher.iterations}
             info.update(queue.counters())
-            self._reply(200, info)
+            self._reply(200 if up else 503, info)
 
         def do_POST(self):
             if self.path != "/generate":
@@ -111,6 +124,19 @@ def make_server(batcher, host: str = "127.0.0.1",
                                  queue.default_deadline_ms) / 1000.0 + 30.0)
             if not handle.done():
                 self._reply(504, {"error": "timeout"})
+                return
+            if handle.status == "expired":
+                # the deadline completion is STRUCTURED: the batcher
+                # resolves expiry within one scheduling iteration
+                # (queue.reap_expired) and the client learns here, not
+                # by its own socket timeout
+                self._reply(504, {"error": "deadline",
+                                  "tokens": handle.tokens,
+                                  "latency_ms": handle.latency_ms})
+                return
+            if handle.status == "error":
+                self._reply(500, {"error": handle.error or "error",
+                                  "latency_ms": handle.latency_ms})
                 return
             self._reply(200, {"tokens": handle.tokens,
                               "status": handle.status,

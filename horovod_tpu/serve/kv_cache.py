@@ -25,7 +25,8 @@ jax arrays of its own.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+import zlib
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -112,6 +113,33 @@ class SlotKVCache:
         self.allocs = 0
         self.frees = 0
         self.peak_live = 0
+        #: per-slot streamed crc32 of the cache bytes written so far,
+        #: one running value PER CACHE LEAF (k/v x layer — write order
+        #: within one leaf is positional, so streaming holds per leaf
+        #: but not across leaves). Populated only when the batcher runs
+        #: with kv_crc enabled; the chaos serve.kv corrupt fault is
+        #: what this must catch (docs/serving.md).
+        self._crc: Dict[int, List[int]] = {}
+
+    # -- per-slot integrity (crc-on-write / verify-on-read option) ----------
+    def crc_update(self, slot: int, leaf_bytes: Sequence[bytes]) -> None:
+        """Fold the bytes just written to ``slot`` (one entry per cache
+        leaf, in leaf order) into the slot's running crc32s."""
+        cur = self._crc.get(slot)
+        if cur is None:
+            cur = self._crc[slot] = [0] * len(leaf_bytes)
+        for i, raw in enumerate(leaf_bytes):
+            cur[i] = zlib.crc32(raw, cur[i])
+
+    def crc_check(self, slot: int, leaf_bytes: Sequence[bytes]) -> bool:
+        """Verify a full re-read of ``slot``'s valid prefix (one entry
+        per cache leaf) against the streamed write-side crc32s. True
+        when every leaf matches; a slot never written checks clean."""
+        cur = self._crc.get(slot)
+        if cur is None:
+            return True
+        return len(cur) == len(leaf_bytes) and all(
+            zlib.crc32(raw) == c for raw, c in zip(leaf_bytes, cur))
 
     def alloc(self) -> Optional[int]:
         """Claim a free slot (None when all are live). The new owner's
@@ -124,6 +152,7 @@ class SlotKVCache:
         self.lengths[slot] = 0
         self.generation[slot] += 1
         self.allocs += 1
+        self._crc.pop(slot, None)   # the new owner's ledger starts empty
         self.peak_live = max(self.peak_live, self.live())
         return slot
 

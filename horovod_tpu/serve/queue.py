@@ -23,8 +23,9 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
+from ..chaos import inject as _chaos
 from ..obs import metrics as obs_metrics
 
 
@@ -43,6 +44,15 @@ class Rejected(Exception):
         super().__init__(f"request rejected: {reason}{hint}")
 
 
+class AdmitDropped(Rejected):
+    """A chaos ``serve.admit`` drop: the request was lost at the queue
+    door, as if the connection died mid-admission. A Rejected subclass
+    so a standalone replica still answers it structurally (429 +
+    retry-after — never a silent loss); the fleet router additionally
+    distinguishes it to retry the request on another replica
+    (serve/fleet.py)."""
+
+
 @dataclass
 class ServeRequest:
     rid: int
@@ -59,15 +69,25 @@ class ServeRequest:
 
 class ServeHandle:
     """Caller-side completion handle; resolved exactly once by the
-    batcher. `status` is "pending" | "ok" | "expired" | "error"."""
+    batcher. `status` is "pending" | "ok" | "expired" | "error".
 
-    def __init__(self, rid: int):
+    ``on_resolve`` (optional, set via ``submit``) is invoked exactly
+    once with the handle AFTER resolution — the fleet router's
+    completion hook. It runs on the resolving thread and must never be
+    called while a queue/batcher lock is held (lock-order discipline
+    with the router's own lock)."""
+
+    def __init__(self, rid: int,
+                 on_resolve: Optional[Callable[["ServeHandle"],
+                                               None]] = None):
         self.rid = rid
         self.status = "pending"
         self.tokens: List[int] = []
         self.error: Optional[str] = None
         self.latency_ms: Optional[float] = None
+        self.on_resolve = on_resolve
         self._event = threading.Event()
+        self._rlock = threading.Lock()
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -78,13 +98,20 @@ class ServeHandle:
     def _resolve(self, tokens: Sequence[int], status: str,
                  latency_ms: Optional[float] = None,
                  error: Optional[str] = None) -> None:
-        if self._event.is_set():  # one-shot; late expiry races are no-ops
-            return
-        self.tokens = list(tokens)
-        self.status = status
-        self.error = error
-        self.latency_ms = latency_ms
-        self._event.set()
+        with self._rlock:   # one-shot; late expiry races are no-ops
+            if self._event.is_set():
+                return
+            self.tokens = list(tokens)
+            self.status = status
+            self.error = error
+            self.latency_ms = latency_ms
+            self._event.set()
+        cb = self.on_resolve
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — a hook must not mask
+                pass           # the resolution it observes
 
 
 class AdmissionQueue:
@@ -95,7 +122,8 @@ class AdmissionQueue:
 
     def __init__(self, max_queue: int = 64,
                  default_deadline_ms: float = 30000.0,
-                 max_prompt_len: Optional[int] = None):
+                 max_prompt_len: Optional[int] = None,
+                 replica_id: Optional[int] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1; got {max_queue}")
         if default_deadline_ms <= 0:
@@ -107,32 +135,45 @@ class AdmissionQueue:
         #: largest prefill bucket so an unservable prompt is rejected at
         #: the door, not discovered holding a decode slot)
         self.max_prompt_len = max_prompt_len
+        #: fleet replica this queue fronts (None = standalone): labels
+        #: the metric series and addresses chaos serve.admit faults
+        self.replica_id = replica_id
         self._dq: "deque[ServeRequest]" = deque()
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._ids = itertools.count()
+        self._submits = 0      # serve.admit chaos site counter
         # -- counters: registry-backed (horovod_tpu.obs); the legacy
         # attributes (shed_count & co) are properties over these, so the
         # SERVE timeline row / healthz keep their numbers while /metrics
-        # exposes the same series fleet-wide. Claimed fresh per queue:
-        # one serving stack per process, and a new queue's views must
-        # count from zero.
+        # exposes the same series fleet-wide. Standalone queues claim
+        # their families fresh (one serving stack per process, and a new
+        # queue's views must count from zero); a FLEET replica's queue
+        # instead get-or-creates {replica=...}-labeled children, so one
+        # replica's restart neither clobbers its siblings nor resets its
+        # own fleet-visible counts.
+        rl = {} if replica_id is None else {"replica": str(replica_id)}
         R = obs_metrics.get_registry()
-        for fam in ("hvd_serve_admitted_total", "hvd_serve_shed_total",
-                    "hvd_serve_completed_total", "hvd_serve_expired_total",
-                    "hvd_serve_queue_depth"):
-            R.unregister(fam)
+        if replica_id is None:
+            for fam in ("hvd_serve_admitted_total", "hvd_serve_shed_total",
+                        "hvd_serve_completed_total",
+                        "hvd_serve_expired_total", "hvd_serve_queue_depth"):
+                R.unregister(fam)
         self._m_admitted = R.counter(
-            "hvd_serve_admitted_total", "requests admitted to the queue")
+            "hvd_serve_admitted_total", "requests admitted to the queue",
+            rl or None)
         self._m_shed = R.counter(
             "hvd_serve_shed_total",
-            "requests load-shed at admission (queue full / unservable)")
+            "requests load-shed at admission (queue full / unservable)",
+            rl or None)
         self._m_completed = R.counter(
-            "hvd_serve_completed_total", "requests retired ok")
+            "hvd_serve_completed_total", "requests retired ok", rl or None)
         self._m_expired = R.counter(
-            "hvd_serve_expired_total", "requests expired past deadline")
+            "hvd_serve_expired_total", "requests expired past deadline",
+            rl or None)
         self._m_depth = R.gauge(
-            "hvd_serve_queue_depth", "requests waiting for a decode slot")
+            "hvd_serve_queue_depth", "requests waiting for a decode slot",
+            rl or None)
         #: EWMA of per-request service time, fed back by the batcher on
         #: retirement; drives the retry_after_ms hint
         self._service_ms_ewma: Optional[float] = None
@@ -153,12 +194,30 @@ class AdmissionQueue:
 
     # -- producer side ------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               deadline_ms: Optional[float] = None) -> ServeHandle:
-        """Admit a request or raise `Rejected` (load shed / unservable)."""
+               deadline_ms: Optional[float] = None,
+               on_resolve: Optional[Callable[[ServeHandle],
+                                             None]] = None) -> ServeHandle:
+        """Admit a request or raise `Rejected` (load shed / unservable).
+
+        ``on_resolve`` is attached to the handle BEFORE it becomes
+        poppable, so a completion can never race past the hook."""
         prompt = [int(t) for t in prompt]
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1; got {max_new_tokens}")
+        # chaos serve.admit: the queue-door fault site. Disarmed cost is
+        # one attribute read; delay sleeps inside the injector; drop
+        # surfaces as AdmitDropped (a structured loss, never a silent
+        # one — the fleet router absorbs it by retrying elsewhere).
+        if _chaos._INJ is not None:
+            with self._lock:
+                n = self._submits
+                self._submits += 1
+            f = _chaos.fire("serve.admit", peer=self.replica_id, step=n)
+            if f is not None and f.kind == "drop":
+                self._m_shed.inc()
+                raise AdmitDropped("chaos: admission dropped",
+                                   retry_after_ms=self._retry_after_ms())
         with self._lock:
             if self.max_prompt_len is not None and \
                     (not prompt or len(prompt) > self.max_prompt_len):
@@ -178,7 +237,7 @@ class AdmissionQueue:
                                max_new_tokens=max_new_tokens,
                                deadline=now + dl / 1000.0,
                                submitted_at=now)
-            req.handle = ServeHandle(rid)
+            req.handle = ServeHandle(rid, on_resolve=on_resolve)
             self._dq.append(req)
             self._m_admitted.inc()
             self._m_depth.set(len(self._dq))
@@ -192,26 +251,64 @@ class AdmissionQueue:
         est = self._service_ms_ewma if self._service_ms_ewma else 100.0
         return max(1.0, len(self._dq) * est)
 
+    def _retry_after_ms(self) -> float:
+        with self._lock:
+            return self._retry_after_ms_locked()
+
     # -- consumer (batcher) side -------------------------------------------
     def pop(self, n: int) -> List[ServeRequest]:
         """Take up to `n` requests FIFO. Already-expired requests are
-        resolved "expired" here and do not count against `n`."""
+        resolved "expired" here and do not count against `n`.
+
+        Handle resolution (and therefore any ``on_resolve`` hook) runs
+        AFTER the queue lock is released: the fleet router's hook takes
+        its own lock and may submit back into a queue, so resolving
+        under this lock would invert the router->queue lock order."""
         out: List[ServeRequest] = []
+        dead: List[ServeRequest] = []
         with self._lock:
             now = time.monotonic()
             while self._dq and len(out) < n:
                 req = self._dq.popleft()
                 if req.expired(now):
                     self._m_expired.inc()
-                    req.handle._resolve(
-                        [], "expired",
-                        latency_ms=(now - req.submitted_at) * 1000.0)
+                    dead.append(req)
                     continue
                 out.append(req)
             self._m_depth.set(len(self._dq))
             if not self._dq:
                 self._work.clear()
+        for req in dead:
+            req.handle._resolve(
+                [], "expired",
+                latency_ms=(now - req.submitted_at) * 1000.0)
         return out
+
+    def reap_expired(self) -> int:
+        """Resolve every expired request still WAITING in the queue —
+        called by the batcher once per scheduling iteration, so a
+        client whose deadline passes while the fleet is saturated gets
+        its structured deadline completion (HTTP 504, serve/http.py)
+        within one iteration instead of discovering it by socket
+        timeout. Returns the number reaped."""
+        dead: List[ServeRequest] = []
+        with self._lock:
+            now = time.monotonic()
+            if self._dq:
+                keep: "deque[ServeRequest]" = deque()
+                for req in self._dq:
+                    (dead if req.expired(now) else keep).append(req)
+                if dead:
+                    self._dq = keep
+                    self._m_expired.inc(len(dead))
+                    self._m_depth.set(len(keep))
+                    if not keep:
+                        self._work.clear()
+        for req in dead:
+            req.handle._resolve(
+                [], "expired",
+                latency_ms=(now - req.submitted_at) * 1000.0)
+        return len(dead)
 
     def note_service_ms(self, ms: float) -> None:
         """Batcher feedback on request retirement (EWMA, alpha=0.2)."""

@@ -1,0 +1,425 @@
+"""Serving SLO soak: prove bad days are survivable, don't claim it.
+
+The serve-plane sibling of chaos/soak.py (same philosophy, same
+verdict discipline): ``run_serve_soak`` stands up an N-replica
+:class:`~horovod_tpu.serve.fleet.FleetRouter` over a tiny decode-mode
+GPT, drives CLOSED-LOOP synthetic traffic at a fixed offered load
+(``clients`` concurrent requesters, each with at most one request
+outstanding), and fires a seeded serve-profile chaos plan at it —
+one replica crashed mid-decode, a second partitioned from the router,
+a KV slot corrupted, one replica slowed past the suspect threshold,
+one admission dropped at the queue door — while a training-side
+:class:`~horovod_tpu.redist.stream.WeightPublisher` pushes a fresh
+weight version mid-incident. The verdict (a JSON-able dict,
+``tools/serve_soak.py`` prints it and exits non-zero unless every
+invariant holds) asserts:
+
+* **zero silent drops** — every submitted request reached a terminal
+  state (answered, deadline, clean error, or rejected), and every
+  shed/rejected answer carries ``retry_after_ms``;
+* **at-most-once** — no request was answered twice (``resolutions``
+  <= 1 on every handle; late ghost answers are counted as suppressed
+  duplicates, not deliveries);
+* **KV containment** — the injected cache corruption was caught by the
+  per-slot crc (``detected >= injected > 0``): a corrupted sequence
+  re-prefills or fails cleanly, never returns garbage;
+* **bounded failover** — the crashed replica was ejected within
+  ``2 x suspect_s`` of the crash (detection in O(heartbeat), not
+  O(request timeout));
+* **SLO held outside recovery windows** — p99 latency and error rate
+  of requests that do not overlap any fault's
+  ``[t_fault, t_fault + recovery_window_s]`` stay under the declared
+  bounds (inside the windows, shed-with-retry-after is the contract);
+* **capacity restored on fresh weights** — the fleet ends with every
+  replica up and every replica (the restarted victim included) serving
+  the NEWEST published weight version.
+
+``evaluate_serve`` is the pure records->verdict core, unit-testable on
+synthetic logs exactly like chaos/soak.py's ``evaluate``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("horovod_tpu")
+
+DEFAULT_REPLICAS = 3
+DEFAULT_CLIENTS = 6
+DEFAULT_STEPS = 240          # scheduler-iteration horizon the plan lands in
+DEFAULT_SUSPECT_S = 1.0
+DEFAULT_INTERVAL_S = 0.25
+DEFAULT_SLO_P99_MS = 15000.0
+DEFAULT_SLO_ERROR_RATE = 0.02
+DEFAULT_RECOVERY_WINDOW_S = 6.0
+#: disruptions that open a recovery window in the SLO evaluation
+_DISRUPTIVE = ("crash", "slow_rank", "partition", "corrupt", "drop",
+               "delay")
+
+
+def _resolve_plan(plan, seed: int, replicas: int, steps: int):
+    from ..chaos.plan import ChaosPlan, random_plan
+    if plan is None or plan == "random":
+        return random_plan(seed, replicas, steps, profile="serve")
+    if isinstance(plan, ChaosPlan):
+        return plan
+    return ChaosPlan.parse(str(plan))
+
+
+def evaluate_serve(records: List[dict], events: List[dict], plan,
+                   fleet_stats: dict, *, replicas: int,
+                   suspect_s: float, slo_p99_ms: float,
+                   slo_error_rate: float, recovery_window_s: float,
+                   newest_version: Optional[int],
+                   kv_injected: int, kv_detected: int) -> dict:
+    """Pure records->verdict core. ``records`` is one dict per client
+    request ({fid, t0, t1, status, retry_after_ms, latency_ms,
+    resolutions}); ``events`` mixes injector ({kind: "chaos", ...})
+    and router ({kind: "fleet", event: eject/readmit, ...}) entries,
+    each with a wall-clock ``t``."""
+    v: Dict[str, Any] = {
+        "submitted": len(records),
+        "statuses": {},
+        "no_silent_drops": None, "answered_once": None,
+        "shed_carry_retry_after": None, "kv_containment": None,
+        "failover_bounded": None, "failover_s": None,
+        "slo_held": None, "p99_outside_ms": None,
+        "error_rate_outside": None, "clean_ok_samples": None,
+        "capacity_restored": None, "victim": None,
+        "kv_injected": kv_injected, "kv_detected": kv_detected,
+        "duplicates_suppressed":
+            fleet_stats.get("duplicates_suppressed", 0),
+    }
+    statuses: Dict[str, int] = {}
+    for r in records:
+        statuses[r["status"]] = statuses.get(r["status"], 0) + 1
+    v["statuses"] = statuses
+
+    # -- zero silent drops: every request reached a terminal state
+    v["no_silent_drops"] = (
+        len(records) > 0
+        and all(r["status"] != "pending" for r in records)
+        and fleet_stats.get("inflight", 0) == 0)
+
+    # -- at-most-once: no handle resolved twice
+    v["answered_once"] = all(r.get("resolutions", 1) <= 1
+                             for r in records)
+
+    # -- every shed/rejected answer carries a retry hint
+    shed = [r for r in records if r["status"] in ("shed", "rejected")]
+    v["shed_carry_retry_after"] = all(
+        (r.get("retry_after_ms") or 0) > 0 for r in shed)
+
+    # -- KV containment: the scheduled corruption actually flipped
+    # bytes AND the crc caught it (a plan that schedules a corrupt
+    # which never lands proves nothing — fail, don't skip)
+    has_corrupt = any(f.kind == "corrupt" for f in plan.faults)
+    if has_corrupt:
+        v["kv_containment"] = kv_injected > 0 and \
+            kv_detected >= kv_injected
+    # requests must never carry garbage: an "ok" that raced a detected
+    # corruption is impossible by construction (verify-before-resolve),
+    # so the evidence is the counter pair above.
+
+    # -- bounded failover for the crashed replica
+    crash = next((f for f in plan.faults if f.kind == "crash"), None)
+    if crash is not None:
+        v["victim"] = crash.peer
+        t_crash = next((e["t"] for e in events
+                        if e.get("kind") == "chaos"
+                        and e.get("fault") == "crash"), None)
+        t_eject = next((e["t"] for e in events
+                        if e.get("kind") == "fleet"
+                        and e.get("event") == "eject"
+                        and e.get("replica") == crash.peer
+                        and (t_crash is None or e["t"] >= t_crash)),
+                       None)
+        if t_crash is None or t_eject is None:
+            v["failover_bounded"] = False   # never exercised: fail
+        else:
+            v["failover_s"] = round(t_eject - t_crash, 3)
+            v["failover_bounded"] = \
+                v["failover_s"] <= 2 * suspect_s
+
+    # -- SLO outside recovery windows
+    windows = [(e["t"], e["t"] + recovery_window_s) for e in events
+               if e.get("kind") == "chaos"
+               and e.get("fault") in _DISRUPTIVE]
+    # an ejection's repair tail counts as disruption too (restart +
+    # rewarm of the victim)
+    windows += [(e["t"], e["t"] + recovery_window_s) for e in events
+                if e.get("kind") == "fleet"
+                and e.get("event") == "eject"]
+
+    def outside(r):
+        return not any(r["t0"] < hi and r["t1"] > lo
+                       for lo, hi in windows)
+
+    clean = [r for r in records if outside(r)]
+    oks = sorted(r["latency_ms"] for r in clean
+                 if r["status"] == "ok"
+                 and r.get("latency_ms") is not None)
+    v["clean_ok_samples"] = len(oks)
+    served = [r for r in clean
+              if r["status"] not in ("shed", "rejected")]
+    errs = [r for r in served if r["status"] in ("error", "expired")]
+    if len(oks) >= 20:
+        # nearest-rank p99 over the outside-window completions
+        v["p99_outside_ms"] = round(
+            oks[min(len(oks) - 1, int(0.99 * len(oks)))], 1)
+        v["error_rate_outside"] = round(
+            len(errs) / max(len(served), 1), 4)
+        v["slo_held"] = (v["p99_outside_ms"] <= slo_p99_ms
+                         and v["error_rate_outside"] <= slo_error_rate)
+    else:
+        v["slo_held"] = False   # too few clean samples to claim an SLO
+
+    # -- capacity restored on fresh weights
+    versions = [r.get("weights_version")
+                for r in fleet_stats.get("replicas", {}).values()]
+    readmitted = (crash is None or any(
+        e.get("kind") == "fleet" and e.get("event") == "readmit"
+        and e.get("replica") == crash.peer for e in events))
+    v["capacity_restored"] = (
+        fleet_stats.get("replicas_up") == replicas
+        and readmitted
+        and newest_version is not None
+        and all(ver == newest_version for ver in versions))
+
+    v["ok"] = all(v[k] is not False for k in (
+        "no_silent_drops", "answered_once", "shed_carry_retry_after",
+        "kv_containment", "failover_bounded", "slo_held",
+        "capacity_restored"))
+    return v
+
+
+def run_serve_soak(out_dir: Optional[str] = None, *,
+                   replicas: int = DEFAULT_REPLICAS,
+                   clients: int = DEFAULT_CLIENTS,
+                   seed: int = 0, plan=None,
+                   steps: int = DEFAULT_STEPS,
+                   suspect_s: float = DEFAULT_SUSPECT_S,
+                   interval_s: float = DEFAULT_INTERVAL_S,
+                   slo_p99_ms: float = DEFAULT_SLO_P99_MS,
+                   slo_error_rate: float = DEFAULT_SLO_ERROR_RATE,
+                   recovery_window_s: float = DEFAULT_RECOVERY_WINDOW_S,
+                   min_duration_s: float = 8.0,
+                   max_duration_s: float = 45.0,
+                   max_new_tokens: int = 8,
+                   deadline_ms: float = 20000.0,
+                   kv_crc: Optional[bool] = None,
+                   sigterm_drain: bool = False) -> dict:
+    """Run the serving soak in-process and return the verdict dict.
+    Never raises on a failed invariant — the verdict carries the
+    evidence; it raises only on harness misuse."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..chaos import inject
+    from ..models.gpt import GPT, GPTConfig
+    from ..native.store import StoreServer
+    from ..redist.stream import WeightPublisher, WeightSubscriber
+    from .executor import ShardedExecutor
+    from .fleet import FleetRouter, Replica
+    from .queue import Rejected
+
+    if kv_crc is None:
+        kv_crc = True   # the corrupt invariant NEEDS the crc ledger
+    resolved = _resolve_plan(plan, seed, replicas, steps)
+
+    # -- tiny decode-mode model: identical params on every replica
+    kw = dict(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+              max_seq_len=48, dtype=jnp.float32,
+              attention_impl="reference")
+    model = GPT(GPTConfig(decode=True, **kw))
+    params = GPT(GPTConfig(**kw)).init(
+        jax.random.PRNGKey(seed), jnp.zeros((2, 8), jnp.int32))["params"]
+
+    events: List[dict] = []
+    records: List[dict] = []
+    ev_lock = threading.Lock()
+
+    def log_event(kind: str, ev: dict) -> None:
+        with ev_lock:
+            events.append(dict(ev, kind=kind))
+
+    srv = StoreServer()
+    pub = WeightPublisher("soak", kv_addr="127.0.0.1",
+                          kv_port=srv.port, resume_timeout=0.05)
+    pub.publish(params)                       # version 1, pre-incident
+    reps = [
+        Replica(i,
+                ShardedExecutor(model, params, max_batch=4, max_len=48,
+                                replica_id=i),
+                buckets=(8,), max_queue=max(32, 4 * clients),
+                deadline_ms=deadline_ms, kv_crc=kv_crc,
+                subscriber=WeightSubscriber(
+                    "soak", kv_addr="127.0.0.1", kv_port=srv.port,
+                    template=params))
+        for i in range(replicas)]
+    router = FleetRouter(reps, interval_s=interval_s,
+                         suspect_s=suspect_s)
+    router.add_listener(lambda ev: log_event("fleet", ev))
+
+    inj = inject.install(resolved, rank=0)
+    # the injector's "kind" names the FAULT; the event ledger's "kind"
+    # names the record type (chaos/fleet) — same renaming as chaos/soak
+    inj.add_listener(lambda ev: log_event(
+        "chaos", {"fault": ev["kind"],
+                  **{k: x for k, x in ev.items() if k != "kind"}}))
+
+    router.start()
+    if sigterm_drain:        # CLI mode (main thread): orderly shutdown
+        router.install_sigterm()
+
+    stop = threading.Event()
+    crash_seen = threading.Event()
+    for f in resolved.faults:
+        if f.kind == "crash":
+            break
+    else:
+        crash_seen.set()   # crash-free custom plan: publish mid-run
+
+    def watch_crash(ev):
+        if ev.get("kind") == "crash":
+            crash_seen.set()
+    inj.add_listener(watch_crash)
+
+    def publish_fresh():
+        # the online-learning leg: a NEW weight version lands while the
+        # fleet is mid-incident; the restarted victim must come back on
+        # it (and every healthy replica must adopt it) before the
+        # verdict calls the fleet recovered
+        crash_seen.wait(timeout=max_duration_s / 2.0)
+        time.sleep(0.5)
+        try:
+            pub.publish(params)               # version 2, same values
+        except Exception as e:  # noqa: BLE001
+            logger.error("soak: mid-incident publish failed: %s", e)
+
+    pub_thread = threading.Thread(target=publish_fresh, daemon=True)
+    pub_thread.start()
+
+    rec_lock = threading.Lock()
+
+    def client(cid: int) -> None:
+        rng = np.random.RandomState(10_000 + cid)
+        while not stop.is_set():
+            prompt = list(rng.randint(1, 64, int(rng.randint(2, 8))))
+            t0 = time.monotonic()
+            rec = {"fid": None, "t0": t0, "t1": None,
+                   "status": "pending", "latency_ms": None,
+                   "retry_after_ms": None, "resolutions": 0,
+                   "replica": None, "client": cid}
+            try:
+                h = router.submit(prompt,
+                                  max_new_tokens=max_new_tokens)
+            except Rejected as e:
+                rec.update(status="shed",
+                           retry_after_ms=e.retry_after_ms,
+                           t1=time.monotonic())
+                with rec_lock:
+                    records.append(rec)
+                # honor the hint (capped so the soak keeps offering)
+                time.sleep(min((e.retry_after_ms or 100.0), 500.0)
+                           / 1000.0)
+                continue
+            h.wait(timeout=deadline_ms / 1000.0 + 30.0)
+            rec.update(fid=h.fid, t1=time.monotonic(),
+                       status=h.status, latency_ms=h.latency_ms,
+                       retry_after_ms=h.retry_after_ms,
+                       resolutions=h.resolutions, replica=h.replica)
+            with rec_lock:
+                records.append(rec)
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    def recovered() -> bool:
+        s = router.stats()
+        newest = pub._version
+        return (s["replicas_up"] == replicas and newest >= 2
+                and all(r["weights_version"] == newest
+                        for r in s["replicas"].values()))
+
+    # distinct scheduled faults only: the injector also emits synthetic
+    # partition-window refusals, which must not count as "fired"
+    want = {(f.site, f.kind, f.peer) for f in resolved.faults}
+
+    def faults_all_fired() -> bool:
+        with ev_lock:
+            got = {(e.get("site"), e.get("fault"), e.get("peer"))
+                   for e in events if e.get("kind") == "chaos"}
+        return want <= got
+
+    # run until the WHOLE incident has played out (every scheduled
+    # fault fired) AND the fleet healed — and STAYED healed for a
+    # dwell longer than the detector's reaction time: a just-fired
+    # slow fault leaves the fleet looking healthy for up to suspect_s
+    # before its ejection lands, and sampling that gap would declare
+    # victory mid-incident. Traffic keeps flowing during recovery so
+    # the adoption/readmission paths run under load, like production
+    # would. (Bounded by max_duration_s either way.)
+    dwell_s = 2 * suspect_s + 1.0
+    last_unhealed = time.monotonic()
+    while time.monotonic() - t_start < max_duration_s:
+        if not (faults_all_fired() and recovered()):
+            last_unhealed = time.monotonic()
+        elif time.monotonic() - last_unhealed >= dwell_s \
+                and time.monotonic() - t_start >= min_duration_s:
+            break
+        time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=deadline_ms / 1000.0 + 35.0)
+
+    fleet_stats = router.stats()
+    kv_injected = sum(r.batcher.kv_corruptions_injected
+                      for r in reps if r.batcher is not None)
+    kv_detected = sum(r.batcher.kv_corruptions_detected
+                      for r in reps if r.batcher is not None)
+    newest_version = pub._version
+    router.close()
+    inject.uninstall()
+    pub.close()
+    for r in reps:
+        if r.subscriber is not None:
+            r.subscriber.close()
+    srv.close()
+
+    verdict = evaluate_serve(
+        records, sorted(events, key=lambda e: e.get("t", 0.0)),
+        resolved, fleet_stats, replicas=replicas, suspect_s=suspect_s,
+        slo_p99_ms=slo_p99_ms, slo_error_rate=slo_error_rate,
+        recovery_window_s=recovery_window_s,
+        newest_version=newest_version, kv_injected=kv_injected,
+        kv_detected=kv_detected)
+    verdict.update({
+        "seed": resolved.seed, "replicas": replicas,
+        "clients": clients, "kv_crc": bool(kv_crc),
+        "suspect_s": suspect_s,
+        "wall_s": round(time.monotonic() - t_start, 2),
+        "plan": json.loads(resolved.to_json()),
+        "fleet": fleet_stats,
+    })
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "events.jsonl"), "w") as f:
+            for e in events:
+                f.write(json.dumps(e, default=str) + "\n")
+        with open(os.path.join(out_dir, "requests.jsonl"), "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        with open(os.path.join(out_dir, "verdict.json"), "w") as f:
+            json.dump(verdict, f, indent=2, sort_keys=True)
+    return verdict

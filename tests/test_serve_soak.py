@@ -1,0 +1,61 @@
+"""ISSUE 8 serving-soak acceptance (slow tier): a REAL 3-replica fleet
+under closed-loop traffic driven through a seeded serve-profile chaos
+plan by the soak harness.
+
+The plan kills one replica mid-decode, partitions the router from a
+second, corrupts a KV slot, slows one replica past the suspect
+threshold and drops one admission, while a fresh weight version is
+published mid-incident. The bar (docs/serving.md):
+
+* the killed replica is ejected within 2 x suspect_s of the crash,
+* no request silently dropped or double-answered; every shed reply
+  carries retry-after,
+* the corrupted KV slot is caught by the per-slot crc (never reaches a
+  client),
+* p99 latency / error-rate SLOs hold outside the bounded recovery
+  windows,
+* the fleet returns to full capacity with every replica (the restarted
+  victim included) on the newest streamed weights.
+
+Driven through the tools/serve_soak.py CLI so the CLI contract (JSON
+verdict on stdout, exit code) is covered by the same run. Mirrors
+test_chaos_soak.py, including the 3-consecutive-green requirement
+verified at PR time.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.mark.slow
+def test_serve_soak_acceptance(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_soak.py"),
+         "--replicas", "3", "--clients", "6", "--seed", "7",
+         "--out", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.stdout.strip(), out.stderr[-3000:]
+    verdict = json.loads(out.stdout)
+    detail = json.dumps(verdict, indent=2, sort_keys=True)[:3000]
+    assert verdict["no_silent_drops"] is True, detail
+    assert verdict["answered_once"] is True, detail
+    assert verdict["shed_carry_retry_after"] is True, detail
+    assert verdict["kv_containment"] is True, detail
+    assert verdict["failover_bounded"] is True, detail
+    assert verdict["failover_s"] <= 2 * verdict["suspect_s"], detail
+    assert verdict["slo_held"] is True, detail
+    assert verdict["capacity_restored"] is True, detail
+    assert verdict["ok"] and out.returncode == 0, detail
+    # the evidence files land next to the verdict for post-mortems
+    assert (tmp_path / "events.jsonl").exists()
+    assert (tmp_path / "requests.jsonl").exists()
+    assert (tmp_path / "verdict.json").exists()
