@@ -33,6 +33,7 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <random>
 #include <set>
 #include <string>
 #include <thread>
@@ -61,6 +62,12 @@ enum Status : uint8_t {
   ST_ERROR = 2,
   ST_AGAIN = 3,  // client-side: result larger than the caller's buffer,
                  // stashed in the client — take with take_pending
+  ST_CONN = 4,   // client-side: the TRANSPORT to the server failed
+                 // (send/recv on a broken socket). Distinct from
+                 // ST_ERROR — a server-reported protocol error — so the
+                 // Python retry ladder can classify: connection faults
+                 // are retryable after Reconnect(), protocol errors and
+                 // timeouts are not.
 };
 
 bool send_all(int fd, const void* buf, size_t len) {
@@ -197,6 +204,12 @@ struct Entry {
   std::string value;
   int reads_left = 0;  // 0 = persistent; >0 = erase after this many reads
   bool present = false;
+  // retry bookkeeping for read-counted entries (broadcast fan-out):
+  // nonces whose read slot was already consumed — a replayed Get (same
+  // nonce, its reply lost to a connection break) is served the value
+  // again WITHOUT a second decrement, so a one-rank blip can never
+  // erase the key early and starve another reader into a timeout
+  std::set<uint64_t> served;
   std::chrono::steady_clock::time_point touch;  // for the TTL sweep
 };
 
@@ -209,6 +222,12 @@ struct ReduceState {
   bool complete = false;
   int reads_left = 0;
   int waiters = 0;
+  // retry bookkeeping (the reconnect-and-replay ladder): the nonce each
+  // member's logical request carried — a replayed post (same rank, same
+  // nonce) after its read slot was consumed is served the result again
+  // instead of consuming a second slot or starting a phantom round
+  std::map<int, uint64_t> nonces;
+  std::set<int> served;  // ranks whose read slot was consumed
   std::chrono::steady_clock::time_point touch;
 };
 
@@ -221,7 +240,24 @@ struct GatherState {
                                      // the sweep must not pull state out
                                      // from under a live (possibly
                                      // infinite-timeout) waiter
+  std::map<int, uint64_t> nonces;    // see ReduceState::nonces
+  std::set<int> served;
   std::chrono::steady_clock::time_point touch;  // for the TTL sweep
+};
+
+// A fully drained join round, kept briefly so a member whose reply was
+// lost to a connection break can re-post (same rank + nonce) and be
+// served the result instead of opening a phantom new round that would
+// hang every future caller of the key. Bounded by count and TTL; the
+// nonce check means a genuinely NEW round on a reused key (per-tag seqs
+// can restart after the tag_seq_ prune) falls through to the live path.
+struct DoneRound {
+  std::string result;
+  std::map<int, uint64_t> nonces;
+  std::set<uint64_t> get_served;  // read-counted Get replays (no rank
+                                  // on that wire op; the nonce alone
+                                  // identifies the logical request)
+  std::chrono::steady_clock::time_point t;
 };
 
 class StoreServer {
@@ -317,10 +353,21 @@ class StoreServer {
             std::lock_guard<std::mutex> lk(mu_);
             SweepLocked(false);
             auto& e = data_[key];
-            e.value = std::move(val);
-            e.present = true;
-            e.reads_left = 0;
-            e.touch = std::chrono::steady_clock::now();
+            if (e.present && e.reads_left > 0 && e.value == val) {
+              // an identical re-Set while a read-counted drain is in
+              // flight is a transport replay (the Set's reply was lost,
+              // the ladder re-posted): keep the drain's bookkeeping —
+              // resetting it would re-arm reads_left past the
+              // remaining readers and leak the entry until the TTL
+              e.touch = std::chrono::steady_clock::now();
+            } else {
+              e.value = std::move(val);
+              e.present = true;
+              e.reads_left = 0;
+              e.served.clear();  // a re-Set key starts a fresh round:
+                                 // old replay nonces must not shadow it
+              e.touch = std::chrono::steady_clock::now();
+            }
           }
           cv_.notify_all();
           alive = send_frame(fd, ST_OK, "");
@@ -328,17 +375,33 @@ class StoreServer {
         }
         case OP_GET: {
           // value payload: double timeout_s + int32 expected_reads
+          // [+ u64 nonce — identifies the LOGICAL request across
+          // transport retries (reconnect-and-replay); 0/absent =
+          // legacy, no dedupe]
           double timeout_s = -1.0;
           int32_t expected = 0;
+          uint64_t nonce = 0;
           if (val.size() >= 12) {
             std::memcpy(&timeout_s, val.data(), 8);
             std::memcpy(&expected, val.data() + 8, 4);
           }
+          if (val.size() >= 20) std::memcpy(&nonce, val.data() + 12, 8);
           std::unique_lock<std::mutex> lk(mu_);
+          auto replay_done = [&]() -> DoneRound* {
+            // a replay of the FINAL read: the entry was erased by this
+            // very nonce's first (reply-lost) pass — serve the retained
+            // value instead of blocking for a value that will never
+            // reappear
+            if (expected <= 0 || nonce == 0) return nullptr;
+            auto dit = done_.find(key);
+            return (dit != done_.end() && dit->second.get_served.count(nonce))
+                       ? &dit->second
+                       : nullptr;
+          };
           auto ready = [&] {
             auto it = data_.find(key);
             return (it != data_.end() && it->second.present) ||
-                   shutting_down_.load();
+                   replay_done() != nullptr || shutting_down_.load();
           };
           bool got = WaitPred(lk, timeout_s, fd, ready) &&
                      !shutting_down_.load();
@@ -347,12 +410,32 @@ class StoreServer {
             alive = send_frame(fd, ST_TIMEOUT, "");
             break;
           }
-          auto it = data_.find(key);
-          std::string out = it->second.value;
-          if (expected > 0) {
-            if (it->second.reads_left == 0) it->second.reads_left = expected;
-            it->second.touch = std::chrono::steady_clock::now();
-            if (--it->second.reads_left == 0) data_.erase(it);
+          std::string out;
+          if (DoneRound* d = replay_done()) {
+            out = d->result;
+          } else {
+            auto it = data_.find(key);
+            out = it->second.value;
+            if (expected > 0) {
+              Entry& e = it->second;
+              // consume a read slot only ONCE per logical request: a
+              // replayed Get whose first reply was lost must not eat
+              // another reader's slot (the gather/reduce rule)
+              bool fresh = nonce == 0 || e.served.insert(nonce).second;
+              if (fresh) {
+                if (e.reads_left == 0) e.reads_left = expected;
+                e.touch = std::chrono::steady_clock::now();
+                if (--e.reads_left == 0) {
+                  DoneRound d;
+                  d.result = std::move(e.value);
+                  d.get_served = std::move(e.served);
+                  d.t = std::chrono::steady_clock::now();
+                  done_[key] = std::move(d);
+                  PruneDoneLocked();
+                  data_.erase(it);
+                }
+              }
+            }
           }
           lk.unlock();
           alive = send_frame(fd, ST_OK, out);
@@ -376,26 +459,31 @@ class StoreServer {
           // reference controller does at the coordinator rank,
           // controller.cc:124 RecvReadyTensors).
           // value payload: double timeout_s + i32 group size + i32 rank
-          // + blob. Reply: concat of u32-len-prefixed blobs rank-order.
-          if (val.size() < 16) {
+          // + u64 nonce + blob. Reply: concat of u32-len-prefixed blobs
+          // rank-order. The nonce identifies the LOGICAL request across
+          // transport retries (reconnect-and-replay).
+          if (val.size() < 24) {
             alive = send_frame(fd, ST_ERROR, "bad gather");
             break;
           }
           double timeout_s;
           int32_t gsize, grank;
+          uint64_t nonce;
           std::memcpy(&timeout_s, val.data(), 8);
           std::memcpy(&gsize, val.data() + 8, 4);
           std::memcpy(&grank, val.data() + 12, 4);
+          std::memcpy(&nonce, val.data() + 16, 8);
           if (gsize <= 0 || grank < 0 || grank >= gsize) {
             alive = send_frame(fd, ST_ERROR, "bad gather args");
             break;
           }
           alive = JoinRound(
-              fd, gathers_, &svc_gather_, key, timeout_s,
+              fd, gathers_, &svc_gather_, key, timeout_s, grank, nonce,
               [&](GatherState& g) -> const char* {
                 if (g.complete) return nullptr;
                 // idempotent re-post (a member retrying after timeout)
-                g.blobs[grank] = val.substr(16);
+                g.blobs[grank] = val.substr(24);
+                g.nonces[grank] = nonce;
                 if (static_cast<int>(g.blobs.size()) == gsize) {
                   std::string res;
                   for (auto& kv : g.blobs) {
@@ -415,28 +503,38 @@ class StoreServer {
         }
         case OP_REDUCE: {
           // value payload: double timeout_s + i32 group size + i32 rank
-          // + u8 kind (0 AND / 1 OR) + blob. Reply: the reduced blob.
-          if (val.size() < 17) {
+          // + u64 nonce + u8 kind (0 AND / 1 OR) + blob. Reply: the
+          // reduced blob.
+          if (val.size() < 25) {
             alive = send_frame(fd, ST_ERROR, "bad reduce");
             break;
           }
           double timeout_s;
           int32_t gsize, grank;
+          uint64_t nonce;
           uint8_t kind;
           std::memcpy(&timeout_s, val.data(), 8);
           std::memcpy(&gsize, val.data() + 8, 4);
           std::memcpy(&grank, val.data() + 12, 4);
-          kind = static_cast<uint8_t>(val[16]);
+          std::memcpy(&nonce, val.data() + 16, 8);
+          kind = static_cast<uint8_t>(val[24]);
           if (gsize <= 0 || grank < 0 || grank >= gsize || kind > 1) {
             alive = send_frame(fd, ST_ERROR, "bad reduce args");
             break;
           }
           alive = JoinRound(
-              fd, reduces_, &svc_reduce_, key, timeout_s,
+              fd, reduces_, &svc_reduce_, key, timeout_s, grank, nonce,
               [&](ReduceState& r) -> const char* {
-                if (r.complete || r.posted.count(grank)) return nullptr;
-                const char* blob = val.data() + 17;
-                size_t blen = val.size() - 17;
+                if (r.complete) return nullptr;
+                // refresh the nonce on EVERY re-post (gather's rule):
+                // a timeout retry is a new logical request with a new
+                // nonce, and the done-round cache must be keyed by the
+                // LATEST one — a stale nonce would let that retry's
+                // replay erase the cache and open a phantom round
+                r.nonces[grank] = nonce;
+                if (r.posted.count(grank)) return nullptr;
+                const char* blob = val.data() + 25;
+                size_t blen = val.size() - 25;
                 if (r.posted.empty()) {
                   r.acc.assign(blob, blen);
                   r.kind = kind;
@@ -473,6 +571,7 @@ class StoreServer {
           std::string st = "data=" + std::to_string(data_.size()) +
                            " gathers=" + std::to_string(gathers_.size()) +
                            " reduces=" + std::to_string(reduces_.size()) +
+                           " done=" + std::to_string(done_.size()) +
                            " svc_gather_n=" +
                            std::to_string(svc_gather_.n.load()) +
                            " svc_gather_ns=" +
@@ -594,12 +693,33 @@ class StoreServer {
   // `post(state)` folds this member's payload in (completing the round
   // when it is the last member); it returns nullptr or a protocol-error
   // message. `result(state)` yields the completed round's reply.
+  //
+  // Replay semantics (the reconnect-and-replay ladder): `grank`/`nonce`
+  // identify the member's LOGICAL request across transport retries. A
+  // re-post after the member's read slot was already consumed (its
+  // reply was lost on the wire) is served the round result again
+  // without consuming another slot; a re-post after the round fully
+  // drained is served from the bounded done-round cache instead of
+  // opening a phantom new round under the same key.
   template <typename StateMap, typename Post, typename Result>
   bool JoinRound(int fd, StateMap& states, SvcCounters* svc,
-                 const std::string& key, double timeout_s, Post post,
-                 Result result) {
+                 const std::string& key, double timeout_s, int grank,
+                 uint64_t nonce, Post post, Result result) {
     std::unique_lock<std::mutex> lk(mu_);
     SweepLocked(false);
+    auto dit = done_.find(key);
+    if (dit != done_.end()) {
+      auto nit = dit->second.nonces.find(grank);
+      if (nit != dit->second.nonces.end() && nit->second == nonce) {
+        std::string out = dit->second.result;
+        dit->second.t = std::chrono::steady_clock::now();
+        lk.unlock();
+        return send_frame(fd, ST_OK, out);
+      }
+      // different nonce: a genuinely new round reusing the key — the
+      // stale cache entry must not shadow it
+      done_.erase(dit);
+    }
     auto svc_w1 = std::chrono::steady_clock::now();
     auto& st = states[key];
     st.touch = svc_w1;
@@ -636,13 +756,37 @@ class StoreServer {
       return alive;
     }
     std::string out = result(it->second);
-    if (--it->second.reads_left == 0) states.erase(it);
+    // consume a read slot only ONCE per member: a replayed request
+    // whose first reply was lost must not eat another member's slot
+    if (it->second.served.insert(grank).second &&
+        --it->second.reads_left == 0) {
+      DoneRound d;
+      d.result = out;
+      d.nonces = std::move(it->second.nonces);
+      d.t = std::chrono::steady_clock::now();
+      done_[key] = std::move(d);
+      PruneDoneLocked();
+      states.erase(it);
+    }
     lk.unlock();
     RecordSvc(svc, svc_pre_ns, svc_w2, std::chrono::steady_clock::now());
     auto ts = std::chrono::steady_clock::now();
     bool alive = send_frame(fd, ST_OK, out);
     RecordSend(svc, ts);
     return alive;
+  }
+
+  // mu_ held. Bound the done-round replay cache by count (TTL expiry
+  // lives in SweepLocked). Oldest-first eviction: a round old enough to
+  // be evicted is past every client's retry budget.
+  void PruneDoneLocked() {
+    const size_t kDoneCap = 256;
+    while (done_.size() > kDoneCap) {
+      auto oldest = done_.begin();
+      for (auto it = done_.begin(); it != done_.end(); ++it)
+        if (it->second.t < oldest->second.t) oldest = it;
+      done_.erase(oldest);
+    }
   }
 
   // mu_ held. Expire orphaned state: read-counted entries and gather
@@ -673,6 +817,14 @@ class StoreServer {
       else
         ++it;
     }
+    // done-round replay cache: only useful within a client retry
+    // budget, so its TTL is much shorter than the orphan sweep's
+    for (auto it = done_.begin(); it != done_.end();) {
+      if (now - it->second.t > done_ttl_)
+        it = done_.erase(it);
+      else
+        ++it;
+    }
   }
 
   int listen_fd_ = -1;
@@ -685,8 +837,10 @@ class StoreServer {
   std::map<std::string, Entry> data_;
   std::map<std::string, GatherState> gathers_;
   std::map<std::string, ReduceState> reduces_;
+  std::map<std::string, DoneRound> done_;
   std::set<int> conn_fds_;
   std::chrono::duration<double> state_ttl_{900.0};
+  std::chrono::duration<double> done_ttl_{120.0};
   std::chrono::steady_clock::time_point last_sweep_;
   // per-op service-time counters (work only; see RecordSvc)
   SvcCounters svc_gather_;
@@ -695,23 +849,8 @@ class StoreServer {
 
 class StoreClient {
  public:
-  StoreClient(const std::string& host, int port) {
-    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (fd_ < 0) return;
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons(static_cast<uint16_t>(port));
-    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-      // not a dotted quad — resolve via loopback fallback
-      addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-    }
-    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
-      ::close(fd_);
-      fd_ = -1;
-      return;
-    }
-    int one = 1;
-    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  StoreClient(const std::string& host, int port) : host_(host), port_(port) {
+    Connect();
   }
 
   ~StoreClient() {
@@ -720,10 +859,23 @@ class StoreClient {
 
   bool ok() const { return fd_ >= 0; }
 
+  // Re-dial the server after a transport failure (ST_CONN). The old
+  // socket — if any — is abandoned first: the server's handler observes
+  // EOF and cleans up its end. Safe to call repeatedly; returns whether
+  // the new connection came up.
+  bool Reconnect() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+    Connect();
+    return fd_ >= 0;
+  }
+
   // Returns status; fills out on ST_OK.
   int Request(uint8_t op, const std::string& key, const std::string& val,
               std::string* out) {
     std::lock_guard<std::mutex> lk(mu_);
+    if (fd_ < 0) return ST_CONN;
     uint32_t klen = static_cast<uint32_t>(key.size());
     uint32_t vlen = static_cast<uint32_t>(val.size());
     std::string frame;
@@ -733,12 +885,13 @@ class StoreClient {
     frame.append(key);
     frame.append(reinterpret_cast<char*>(&vlen), 4);
     frame.append(val);
-    if (!send_all(fd_, frame.data(), frame.size())) return ST_ERROR;
+    if (!send_all(fd_, frame.data(), frame.size())) return Broken();
     uint8_t status;
     uint32_t len;
-    if (!recv_all(fd_, &status, 1) || !recv_all(fd_, &len, 4)) return ST_ERROR;
+    if (!recv_all(fd_, &status, 1) || !recv_all(fd_, &len, 4))
+      return Broken();
     std::string payload(len, '\0');
-    if (len && !recv_all(fd_, &payload[0], len)) return ST_ERROR;
+    if (len && !recv_all(fd_, &payload[0], len)) return Broken();
     if (out) *out = std::move(payload);
     return status;
   }
@@ -748,35 +901,39 @@ class StoreClient {
   }
 
   int Get(const std::string& key, double timeout_s, int expected_reads,
-          std::string* out) {
-    std::string arg(12, '\0');
+          uint64_t nonce, std::string* out) {
+    std::string arg(20, '\0');
     std::memcpy(&arg[0], &timeout_s, 8);
     int32_t er = expected_reads;
     std::memcpy(&arg[8], &er, 4);
+    std::memcpy(&arg[12], &nonce, 8);
     return Request(OP_GET, key, arg, out);
   }
 
   int Del(const std::string& key) { return Request(OP_DEL, key, "", nullptr); }
 
   int Gather(const std::string& key, double timeout_s, int size, int rank,
-             const std::string& blob, std::string* out) {
-    std::string arg(16, '\0');
+             uint64_t nonce, const std::string& blob, std::string* out) {
+    std::string arg(24, '\0');
     std::memcpy(&arg[0], &timeout_s, 8);
     int32_t s = size, r = rank;
     std::memcpy(&arg[8], &s, 4);
     std::memcpy(&arg[12], &r, 4);
+    std::memcpy(&arg[16], &nonce, 8);
     arg += blob;
     return Request(OP_GATHER, key, arg, out);
   }
 
   int Reduce(const std::string& key, double timeout_s, int size, int rank,
-             bool is_or, const std::string& blob, std::string* out) {
-    std::string arg(17, '\0');
+             bool is_or, uint64_t nonce, const std::string& blob,
+             std::string* out) {
+    std::string arg(25, '\0');
     std::memcpy(&arg[0], &timeout_s, 8);
     int32_t s = size, r = rank;
     std::memcpy(&arg[8], &s, 4);
     std::memcpy(&arg[12], &r, 4);
-    arg[16] = is_or ? 1 : 0;
+    std::memcpy(&arg[16], &nonce, 8);
+    arg[24] = is_or ? 1 : 0;
     arg += blob;
     return Request(OP_REDUCE, key, arg, out);
   }
@@ -799,6 +956,36 @@ class StoreClient {
   }
 
  private:
+  // mu_ held (or ctor). Dial the server; leaves fd_ = -1 on failure.
+  void Connect() {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1) {
+      // not a dotted quad — resolve via loopback fallback
+      addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    }
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return;
+    }
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+
+  // mu_ held. Mark the transport broken: close the socket so state is
+  // never half-trusted, and surface ST_CONN for the retry ladder.
+  int Broken() {
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+    return ST_CONN;
+  }
+
+  std::string host_;
+  int port_;
   int fd_ = -1;
   std::mutex mu_;
   std::string pending_;
@@ -811,13 +998,34 @@ class StoreClient {
 class Coordinator {
  public:
   Coordinator(const std::string& host, int port, int rank, int size)
-      : client_(host, port), rank_(rank), size_(size) {}
+      : client_(host, port), rank_(rank), size_(size) {
+    // per-instance random salt for request nonces: stable for this
+    // incarnation (retries of one logical collective reuse the nonce —
+    // the server's replay dedupe key), distinct across relaunches so a
+    // resurrected rank's fresh round is never mistaken for a replay
+    std::random_device rd;
+    inst_ = (static_cast<uint64_t>(rd()) << 32) ^ rd();
+  }
 
   bool ok() const { return client_.ok(); }
+
+  // Re-dial the underlying store connection after ST_CONN. Per-tag
+  // sequence numbers are PRESERVED — that is the point of reconnecting
+  // in place instead of rebuilding the coordinator: a replayed post
+  // reuses the same key and nonce, so the server dedupes it.
+  bool Reconnect() { return client_.Reconnect(); }
 
   std::string Key(const std::string& tag, uint64_t seq, int rank) {
     return "hvd/" + tag + "/" + std::to_string(seq) + "/" +
            std::to_string(rank);
+  }
+
+  // The request nonce for (tag, seq): deterministic for this instance,
+  // so a transport retry of the same logical collective replays with
+  // the same nonce; unique per round because seq advances on success.
+  uint64_t NonceOf(const std::string& tag, uint64_t seq) {
+    uint64_t h = std::hash<std::string>{}(tag);
+    return (inst_ ^ (h * 0x9E3779B97F4A7C15ULL) ^ (seq + 1)) | 1;
   }
 
   // Per-tag sequence numbers, advanced only on SUCCESS: a retry of a
@@ -863,7 +1071,7 @@ class Coordinator {
                 double timeout_s, std::string* out) {
     uint64_t seq = SeqOf(tag);
     int st = client_.Gather(Key(tag, seq, -1), timeout_s, size_, rank_,
-                            blob, out);
+                            NonceOf(tag, seq), blob, out);
     if (st == ST_OK) Advance(tag, seq);
     return st;
   }
@@ -882,10 +1090,15 @@ class Coordinator {
     int st;
     if (rank_ == root) {
       if (size_ == 1) return ST_OK;
-      st = client_.Set(Key(tag, seq, root), *blob) == ST_OK ? ST_OK
-                                                            : ST_ERROR;
+      // pass the status through untouched: ST_CONN must reach the
+      // retry ladder as a connection fault, not a generic error
+      st = client_.Set(Key(tag, seq, root), *blob);
     } else {
-      st = client_.Get(Key(tag, seq, root), timeout_s, size_ - 1, blob);
+      // the read-counted Get carries the round nonce so a replay after
+      // a lost reply is served again instead of double-decrementing
+      // the fan-out count and starving a sibling reader
+      st = client_.Get(Key(tag, seq, root), timeout_s, size_ - 1,
+                       NonceOf(tag, seq), blob);
     }
     if (st == ST_OK) Advance(tag, seq);
     return st;
@@ -903,7 +1116,7 @@ class Coordinator {
     std::string acc;
     uint64_t seq = SeqOf(tag);
     int st = client_.Reduce(Key(tag, seq, -1), timeout_s, size_, rank_,
-                            !is_and, blob, &acc);
+                            !is_and, NonceOf(tag, seq), blob, &acc);
     if (st != ST_OK) return st;
     if (acc.size() != nbytes) return ST_ERROR;
     std::memcpy(bits, acc.data(), nbytes);
@@ -913,6 +1126,7 @@ class Coordinator {
 
   StoreClient client_;
   int rank_, size_;
+  uint64_t inst_ = 0;
   std::mutex seq_mu_;
   std::map<std::string, uint64_t> tag_seq_;
 };
@@ -959,11 +1173,11 @@ int hvd_client_set(void* c, const char* key, const uint8_t* val,
 // state — the value is stashed client-side and ST_AGAIN returned; drain
 // it with hvd_client_take_pending(outlen bytes).
 int hvd_client_get(void* c, const char* key, double timeout_s,
-                   int expected_reads, uint8_t* out, uint32_t outcap,
-                   uint32_t* outlen) {
+                   int expected_reads, uint64_t nonce, uint8_t* out,
+                   uint32_t outcap, uint32_t* outlen) {
   std::string v;
   int st = static_cast<StoreClient*>(c)->Get(key, timeout_s, expected_reads,
-                                             &v);
+                                             nonce, &v);
   if (st != ST_OK) return st;
   *outlen = static_cast<uint32_t>(v.size());
   if (*outlen > outcap) {
@@ -990,12 +1204,18 @@ int hvd_client_del(void* c, const char* key) {
   return static_cast<StoreClient*>(c)->Del(key);
 }
 
+// Reconnect after ST_CONN; returns ST_OK / ST_CONN.
+int hvd_client_reconnect(void* c) {
+  return static_cast<StoreClient*>(c)->Reconnect() ? ST_OK : ST_CONN;
+}
+
 int hvd_client_gather(void* c, const char* key, double timeout_s, int size,
-                      int rank, const uint8_t* blob, uint32_t bloblen,
-                      uint8_t* out, uint32_t outcap, uint32_t* outlen) {
+                      int rank, uint64_t nonce, const uint8_t* blob,
+                      uint32_t bloblen, uint8_t* out, uint32_t outcap,
+                      uint32_t* outlen) {
   std::string v;
   int st = static_cast<StoreClient*>(c)->Gather(
-      key, timeout_s, size, rank,
+      key, timeout_s, size, rank, nonce,
       std::string(reinterpret_cast<const char*>(blob), bloblen), &v);
   if (st != ST_OK) return st;
   *outlen = static_cast<uint32_t>(v.size());
@@ -1008,12 +1228,12 @@ int hvd_client_gather(void* c, const char* key, double timeout_s, int size,
 }
 
 int hvd_client_reduce(void* c, const char* key, double timeout_s, int size,
-                      int rank, int is_or, const uint8_t* blob,
-                      uint32_t bloblen, uint8_t* out, uint32_t outcap,
-                      uint32_t* outlen) {
+                      int rank, int is_or, uint64_t nonce,
+                      const uint8_t* blob, uint32_t bloblen, uint8_t* out,
+                      uint32_t outcap, uint32_t* outlen) {
   std::string v;
   int st = static_cast<StoreClient*>(c)->Reduce(
-      key, timeout_s, size, rank, is_or != 0,
+      key, timeout_s, size, rank, is_or != 0, nonce,
       std::string(reinterpret_cast<const char*>(blob), bloblen), &v);
   if (st != ST_OK) return st;
   *outlen = static_cast<uint32_t>(v.size());
@@ -1048,6 +1268,12 @@ void* hvd_coord_create(const char* host, int port, int rank, int size) {
 }
 
 void hvd_coord_destroy(void* c) { delete static_cast<Coordinator*>(c); }
+
+// Reconnect the coordinator's store connection after ST_CONN,
+// preserving per-tag sequence state; returns ST_OK / ST_CONN.
+int hvd_coord_reconnect(void* c) {
+  return static_cast<Coordinator*>(c)->Reconnect() ? ST_OK : ST_CONN;
+}
 
 int hvd_coord_barrier(void* c, const char* tag, double timeout_s) {
   return static_cast<Coordinator*>(c)->Barrier(tag, timeout_s);

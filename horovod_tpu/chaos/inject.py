@@ -8,11 +8,16 @@ boundaries:
 * ``native/store.py``   — every StoreClient request (set/get/gather/
   reduce): delay, drop (the request fails like a severed connection),
   corrupt (the outgoing payload bytes are bit-flipped), partition,
-  crash.
+  crash; TRANSIENT kinds conn_reset/flaky (a retryable connection
+  fault the native/resilience.py ladder absorbs by re-dialing and
+  replaying) and jitter (seeded random request latency).
 * ``native/p2p.py``     — ``RingComm._xfer`` (the single choke point
   every ring collective and ``shift`` passes through): delay, corrupt
   (tx payload), drop (the socket is REALLY closed, so the peer sees a
-  genuine EOF at its end of the wire), partition, crash.
+  genuine EOF at its end of the wire), partition, crash; TRANSIENT
+  kinds conn_reset/flaky really close the live socket too, but the
+  framed reconnect ladder re-rendezvouses over the KV and RESUMES the
+  transfer instead of escalating; jitter sleeps.
 * ``ckpt/store.py``     — shard file I/O: ``torn_write`` truncates the
   shard mid-file after the bytes were written (a torn write a restore
   must catch by CRC and recover via the buddy replica),
@@ -172,9 +177,23 @@ class Injector:
                 continue
             if f.peer is not None and peer is not None and f.peer != peer:
                 continue
+            if f.kind == "flaky":
+                # seeded per-crossing draw: most crossings of the
+                # window pass clean; a hit is returned like conn_reset
+                # (the caller severs and the retry ladder heals)
+                with self._lock:
+                    draw = self._rng.random()
+                if draw >= f.prob:
+                    continue
             self._notify(f, n, peer)
             if f.kind in ("delay", "slow_rank"):
                 time.sleep(f.seconds)
+            elif f.kind == "jitter":
+                # seeded random latency in (0, seconds] — pure delay,
+                # nothing returned to the caller
+                with self._lock:
+                    d = self._rng.uniform(0.0, f.seconds)
+                time.sleep(d)
             elif f.kind == "crash":
                 if site.startswith("serve."):
                     # a serve-plane crash kills the REPLICA, not the

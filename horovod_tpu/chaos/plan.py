@@ -45,7 +45,15 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 FAULT_KINDS = ("delay", "drop", "crash", "corrupt", "partition",
-               "slow_rank", "torn_write", "delete_chunk")
+               "slow_rank", "torn_write", "delete_chunk",
+               # TRANSIENT kinds (the retry-ladder's subjects — blips
+               # the wire plane must absorb without an elastic reset):
+               # conn_reset REALLY closes the live connection once and
+               # then heals (the reconnect ladder re-dials and
+               # resumes); flaky drops messages with seeded probability
+               # 'prob' inside an after/until window; jitter sleeps a
+               # seeded random duration in (0, seconds] per crossing.
+               "conn_reset", "flaky", "jitter")
 
 FAULT_SITES = ("step", "store.request", "p2p.send", "p2p.recv",
                "ckpt.write", "ckpt.read", "ckpt.commit",
@@ -81,13 +89,22 @@ _KIND_SITES = {
                   "redist.transport", "serve.route"),
     "torn_write": ("ckpt.write",),
     "delete_chunk": ("ckpt.commit",),
+    # transient kinds land only where a retry ladder exists to absorb
+    # them: the store/coordinator client, the p2p ring, and redist's
+    # wire transports
+    "conn_reset": ("store.request", "p2p.send", "p2p.recv",
+                   "redist.transport"),
+    "flaky": ("store.request", "p2p.send", "p2p.recv",
+              "redist.transport"),
+    "jitter": ("store.request", "p2p.send", "p2p.recv",
+               "redist.transport"),
 }
 
 #: kinds that require a positive "seconds" duration
-_NEEDS_SECONDS = ("delay", "slow_rank", "partition")
+_NEEDS_SECONDS = ("delay", "slow_rank", "partition", "jitter")
 
 _FIELDS = {"rank", "site", "kind", "at", "after", "until", "seconds",
-           "peer", "shard", "slot", "epoch"}
+           "peer", "shard", "slot", "epoch", "prob"}
 
 
 class PlanError(ValueError):
@@ -111,6 +128,9 @@ class Fault:
     #: live slot at fire time)
     slot: Optional[int] = None
     epoch: Optional[int] = None
+    #: flaky only: per-crossing drop probability in (0, 1], drawn from
+    #: the injector's seeded rng — same seed, same drop pattern
+    prob: Optional[float] = None
 
     def validate(self) -> "Fault":
         if not isinstance(self.rank, int) or self.rank < 0:
@@ -153,6 +173,16 @@ class Fault:
             raise PlanError(
                 "fault kind 'delete_chunk' needs 'shard' (the rank "
                 "whose committed shard file to delete)")
+        if self.kind == "flaky":
+            p = self.prob
+            if not isinstance(p, (int, float)) or not (0 < p <= 1):
+                raise PlanError(
+                    f"fault kind 'flaky' needs 'prob' in (0, 1] (the "
+                    f"seeded per-message drop probability); got {p!r}")
+        elif self.prob is not None:
+            raise PlanError(
+                f"fault field 'prob' only applies to kind 'flaky'; "
+                f"got kind {self.kind!r}")
         if self.slot is not None and self.site != "serve.kv":
             raise PlanError(
                 f"fault field 'slot' only addresses KV slots at site "
@@ -273,6 +303,14 @@ def random_plan(seed: int, world: int, steps: int, *,
     buddy-replica path) — plus ``noise`` benign delay/slow faults
     sprinkled across ranks and sites.
 
+    ``profile="transient"`` composes the BLIP scenario the retry ladder
+    must absorb with ZERO elastic resets (docs/elastic.md): connection
+    resets on the p2p ring and the store client, a seeded flaky window
+    on the ring, and request jitter — no crash, no shard delete. The
+    transient soak asserts the run finishes bit-identical to a
+    fault-free run with ``hvd_net_retries_total > 0`` and the recovery
+    counters flat.
+
     ``profile="serve"`` composes the serving acceptance scenario over a
     ``world``-replica fleet (docs/serving.md): one replica crashed
     mid-decode, a second partitioned from the router, a KV slot
@@ -284,10 +322,12 @@ def random_plan(seed: int, world: int, steps: int, *,
     """
     if profile == "serve":
         return _random_serve_plan(seed, world, steps)
+    if profile == "transient":
+        return _random_transient_plan(seed, world, steps)
     if profile != "train":
         raise PlanError(
-            f"random_plan profile must be 'train' or 'serve'; "
-            f"got {profile!r}")
+            f"random_plan profile must be 'train', 'transient' or "
+            f"'serve'; got {profile!r}")
     if world < 2:
         raise PlanError(f"random_plan needs world >= 2; got {world}")
     if steps < 2 * commit_every + 2:
@@ -325,6 +365,51 @@ def random_plan(seed: int, world: int, steps: int, *,
                 site=rng.choice(("store.request", "p2p.send")),
                 kind="delay", at=rng.randrange(0, 20),
                 seconds=round(rng.uniform(0.01, 0.1), 3)))
+    for f in faults:
+        f.validate()
+    return ChaosPlan(seed=seed, faults=faults)
+
+
+def _random_transient_plan(seed: int, world: int, steps: int) -> ChaosPlan:
+    """The ``profile="transient"`` leg of :func:`random_plan`: blips
+    only — every fault is one the retry/reconnect/backoff ladder must
+    absorb in milliseconds, so the soak can assert ZERO elastic resets
+    and bit-identical final params.
+
+    Resets land at ``p2p.send`` (a close() delivers queued bytes + FIN,
+    so the receiver's committed offset is exact and the resume loses
+    nothing) and ``store.request``; addressing is in site-invocation
+    counters, sized for the soak worker's ~12 ring crossings per step.
+    """
+    if world < 2:
+        raise PlanError(
+            f"a transient plan needs world >= 2 (a lone rank has no "
+            f"wire to blip); got {world}")
+    if steps < 6:
+        raise PlanError(
+            f"a transient plan needs steps >= 6 so blips land "
+            f"mid-run; got {steps}")
+    rng = random.Random(seed)
+    a = rng.randrange(30, 60)
+    b = rng.randrange(4, 10)
+    faults = [
+        # two hard connection resets on the ring, different ranks/times
+        Fault(rank=rng.randrange(world), site="p2p.send",
+              kind="conn_reset", at=rng.randrange(8, 30)),
+        Fault(rank=rng.randrange(world), site="p2p.send",
+              kind="conn_reset", at=rng.randrange(60, 100)),
+        # one reset on the store/coordinator client
+        Fault(rank=rng.randrange(world), site="store.request",
+              kind="conn_reset", at=rng.randrange(4, 24)),
+        # a flaky window on the ring: seeded per-message drops
+        Fault(rank=rng.randrange(world), site="p2p.send", kind="flaky",
+              prob=round(rng.uniform(0.3, 0.5), 2),
+              after=a, until=a + rng.randrange(4, 8)),
+        # request jitter on the store
+        Fault(rank=rng.randrange(world), site="store.request",
+              kind="jitter", seconds=round(rng.uniform(0.02, 0.05), 3),
+              after=b, until=b + rng.randrange(4, 8)),
+    ]
     for f in faults:
         f.validate()
     return ChaosPlan(seed=seed, faults=faults)

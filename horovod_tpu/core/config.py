@@ -201,6 +201,20 @@ class Config:
     # Heartbeat age past which a peer is suspected dead, named in
     # logs/metrics/timeline and escalated (HOROVOD_HEARTBEAT_SUSPECT_S).
     heartbeat_suspect_s: float = 5.0
+    # Transient-fault absorption (native/resilience.py): max retries a
+    # wire request survives before its connection fault escalates
+    # (HOROVOD_NET_RETRIES; 0 disables the ladder — every blip is
+    # fatal, the pre-PR 9 behavior).
+    net_retries: int = 4
+    # First backoff delay in ms; delay k doubles with seeded jitter
+    # (HOROVOD_NET_BACKOFF_BASE_MS).
+    net_backoff_base_ms: float = 25.0
+    # Total retry time budget per logical request, seconds
+    # (HOROVOD_NET_RETRY_BUDGET_S). MUST stay below the collective
+    # timeout: retries may delay an escalation, never mask one. When
+    # unset, from_env derives min(10, gloo_timeout/2) so a shortened
+    # stall bound never invalidates the default.
+    net_retry_budget_s: float = 10.0
     # Observability (horovod_tpu/obs): port for the stdlib /metrics +
     # /healthz exporter (HOROVOD_METRICS_PORT; 0 disables). In
     # multi-process mode each controller binds port + process_index so
@@ -326,6 +340,21 @@ class Config:
             "HOROVOD_HEARTBEAT_INTERVAL_S", c.heartbeat_interval_s)
         c.heartbeat_suspect_s = _env_float_strict(
             "HOROVOD_HEARTBEAT_SUSPECT_S", c.heartbeat_suspect_s)
+        # Net-resilience knobs parse strictly too: a typo'd retry count
+        # must fail at startup — a job that silently ran without the
+        # ladder would turn every blip back into a 17 s elastic reset.
+        c.net_retries = _env_int_strict(
+            "HOROVOD_NET_RETRIES", c.net_retries)
+        c.net_backoff_base_ms = _env_float_strict(
+            "HOROVOD_NET_BACKOFF_BASE_MS", c.net_backoff_base_ms)
+        # the unset-budget default derives from the collective timeout
+        # (min(10, timeout/2), native/resilience.py default_budget_s)
+        # so shortening the stall bound never trips the budget-below-
+        # timeout validation on a knob the deployment never set
+        from ..native.resilience import default_budget_s
+        c.net_retry_budget_s = _env_float_strict(
+            "HOROVOD_NET_RETRY_BUDGET_S",
+            default_budget_s(c.gloo_timeout_seconds))
         # Metrics knobs parse strictly too: a typo'd port must fail at
         # startup, not silently leave the fleet unobservable.
         c.metrics_port = _env_int_strict(
@@ -461,6 +490,28 @@ class Config:
                 f"HOROVOD_HEARTBEAT_INTERVAL_S ({hi!r}) — a suspect "
                 f"threshold at or under one heartbeat period flags "
                 f"every healthy peer")
+        nr = self.net_retries
+        if not isinstance(nr, int) or not (0 <= nr <= 100):
+            raise ValueError(
+                f"HOROVOD_NET_RETRIES must be an int in [0, 100] "
+                f"(0 disables the retry ladder); got {nr!r}")
+        nb = self.net_backoff_base_ms
+        if not isinstance(nb, (int, float)) or not (0 < nb <= 60_000):
+            raise ValueError(
+                f"HOROVOD_NET_BACKOFF_BASE_MS must be milliseconds in "
+                f"(0, 60000]; got {nb!r}")
+        nbd = self.net_retry_budget_s
+        if not isinstance(nbd, (int, float)) or not (0 < nbd <= 86_400):
+            raise ValueError(
+                f"HOROVOD_NET_RETRY_BUDGET_S must be seconds in "
+                f"(0, 86400]; got {nbd!r}")
+        if nr > 0 and nbd >= self.gloo_timeout_seconds:
+            raise ValueError(
+                f"HOROVOD_NET_RETRY_BUDGET_S ({nbd!r}) must stay BELOW "
+                f"the collective timeout "
+                f"HOROVOD_GLOO_TIMEOUT_SECONDS "
+                f"({self.gloo_timeout_seconds!r}) — the retry ladder "
+                f"may delay an escalation, never mask one")
         if self.chaos_plan is not None:
             # full fail-fast parse (schema + kind/site/schedule
             # validation) — chaos.plan is stdlib-only, no cycle

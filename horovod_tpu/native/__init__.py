@@ -40,7 +40,7 @@ def _build_dir() -> str:
             pass
         os.unlink(probe)
         return in_pkg
-    except OSError:
+    except OSError:  # resilience: exempt (build-cache probe, not wire IO)
         cache = os.path.join(
             os.environ.get("XDG_CACHE_HOME",
                            os.path.expanduser("~/.cache")),
@@ -103,8 +103,8 @@ def build(force: bool = False) -> str:
         if p != so_path and f.startswith("libhvd_native-"):
             try:
                 os.unlink(p)
-            except OSError:
-                pass
+            except OSError:  # resilience: exempt (stale-build prune,
+                pass         # not wire IO)
     return so_path
 
 
@@ -117,18 +117,20 @@ def _declare(lib):
         "hvd_store_server_destroy": (None, [c.c_void_p]),
         "hvd_client_create": (c.c_void_p, [c.c_char_p, c.c_int]),
         "hvd_client_destroy": (None, [c.c_void_p]),
+        "hvd_client_reconnect": (c.c_int, [c.c_void_p]),
         "hvd_client_set": (c.c_int, [c.c_void_p, c.c_char_p, u8p, c.c_uint32]),
         "hvd_client_get": (c.c_int, [c.c_void_p, c.c_char_p, c.c_double,
-                                     c.c_int, u8p, c.c_uint32,
+                                     c.c_int, c.c_uint64, u8p, c.c_uint32,
                                      c.POINTER(c.c_uint32)]),
         "hvd_client_del": (c.c_int, [c.c_void_p, c.c_char_p]),
         "hvd_client_gather": (c.c_int, [c.c_void_p, c.c_char_p, c.c_double,
-                                        c.c_int, c.c_int, u8p, c.c_uint32,
-                                        u8p, c.c_uint32,
+                                        c.c_int, c.c_int, c.c_uint64, u8p,
+                                        c.c_uint32, u8p, c.c_uint32,
                                         c.POINTER(c.c_uint32)]),
         "hvd_client_reduce": (c.c_int, [c.c_void_p, c.c_char_p, c.c_double,
-                                        c.c_int, c.c_int, c.c_int, u8p,
-                                        c.c_uint32, u8p, c.c_uint32,
+                                        c.c_int, c.c_int, c.c_int,
+                                        c.c_uint64, u8p, c.c_uint32, u8p,
+                                        c.c_uint32,
                                         c.POINTER(c.c_uint32)]),
         "hvd_client_stat": (c.c_int, [c.c_void_p, u8p, c.c_uint32,
                                       c.POINTER(c.c_uint32)]),
@@ -137,6 +139,7 @@ def _declare(lib):
         "hvd_coord_create": (c.c_void_p, [c.c_char_p, c.c_int, c.c_int,
                                           c.c_int]),
         "hvd_coord_destroy": (None, [c.c_void_p]),
+        "hvd_coord_reconnect": (c.c_int, [c.c_void_p]),
         "hvd_coord_barrier": (c.c_int, [c.c_void_p, c.c_char_p, c.c_double]),
         "hvd_coord_allgather": (c.c_int, [c.c_void_p, c.c_char_p, u8p,
                                           c.c_uint32, c.c_double, u8p,

@@ -10,9 +10,26 @@ ufunc.
 
 Rendezvous rides the native store KV: each member publishes its
 listening address under a prefixed key and dials its ring successor.
-Failure semantics match the shm plane: a dead peer surfaces as a
-P2PError (socket timeout/EOF) within `timeout`, which elastic treats
-like any other communication failure.
+
+Failure semantics (the transient-fault absorption ladder,
+native/resilience.py): every byte on a link travels inside a small
+frame (seq, offset, length, crc32), and both ends keep the listening
+socket + the KV registration alive for the comm's lifetime. A
+connection-class fault mid-transfer (RST, EOF, a chaos ``conn_reset``/
+``flaky``) is absorbed in place: the sender re-fetches the successor's
+registered address (epoch-checked), re-dials with a reconnect
+handshake, the receiver answers with its committed (seq, offset), and
+the transfer RESUMES from there — frames sent after a reconnect carry
+a real crc32 so neither side can double-apply bytes. The sender also
+retains the previous transfer's bytes so a reset that struck between
+transfers (bytes buffered but never delivered) is replayable one
+transfer back. Retries are seeded-backoff bounded
+(HOROVOD_NET_RETRY_BUDGET_S, below the collective timeout) and
+short-circuit the moment the failure detector names the peer in
+``current_suspects()`` — a genuinely dead peer still surfaces as a
+P2PError within the PR 5 detection bound, which elastic treats like
+any other communication failure. Timeouts stay fatal: the stall bound
+already elapsed.
 """
 from __future__ import annotations
 
@@ -20,12 +37,15 @@ import socket
 import struct
 import threading
 import time as _time
+import zlib
+from typing import Optional
 
 
 import numpy as np
 
+from . import resilience
 from ..chaos import inject as _chaos
-from .store import NativeTimeout, StoreClient
+from .store import NativeError, NativeTimeout, StoreClient
 
 _CHUNK = 1 << 20          # recv_into slice; sendall handles its own loop
 
@@ -39,6 +59,13 @@ _REDUCE_UFUNC = {
 
 class P2PError(RuntimeError):
     pass
+
+
+class P2PConnError(P2PError, resilience.Retryable):
+    """A connection-class fault on a ring link (reset, EOF, refused
+    re-dial) — the retryable subclass the reconnect ladder absorbs.
+    Still a P2PError, so callers that classify on the base type see no
+    change when the ladder gives up."""
 
 
 def _outbound_ip(kv_host: str, kv_port: int) -> str:
@@ -61,6 +88,16 @@ class RingComm:
     counts, so no tags are needed on the wire.
     """
 
+    #: wire frame: seq (u64), offset (u64), length (u32), crc32 (u32).
+    #: crc is 0 on the hot path; real only on frames sent after a
+    #: reconnect, where the receiver verifies it (resume stitching).
+    _HDR = struct.Struct("!QQII")
+    #: reconnect handshake reply: receiver's (expected seq, committed
+    #: offset of the in-progress transfer)
+    _RESUME = struct.Struct("!QQ")
+    #: bytes per frame on the wire
+    _FRAME = 1 << 20
+
     def __init__(self, kv_host: str, kv_port: int, rank: int, size: int,
                  prefix: str = "p2p", timeout: float = 300.0,
                  epoch: int = 0):
@@ -70,8 +107,20 @@ class RingComm:
         # log attributes a dead link to a rank, not just "peer"
         self._succ = (rank + 1) % size
         self._pred = (rank - 1) % size
+        # reconnect state: the KV rendezvous endpoint + prefix/epoch so
+        # a broken link can re-fetch the successor's address, and the
+        # per-direction frame sequence/commit counters
+        self._kv_host, self._kv_port = kv_host, kv_port
+        self._prefix, self._epoch = prefix, epoch
+        self._tx_seq = 0
+        self._tx_keep = None     # (seq, bytes) of the previous transfer
+        self._tx_crc = False     # crc frames until the transfer ends
+        self._rx_seq = 0
+        self._rx_committed = 0   # bytes committed of the current transfer
+        self._rx_verify = False  # verify crc until the transfer ends
         if size == 1:
             self._send = self._recv = None
+            self._srv = None
             return
         srv = socket.socket()
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -126,7 +175,8 @@ class RingComm:
                 conn, _ = srv.accept()
                 conn.settimeout(timeout)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                peer, peer_e = struct.unpack("!ii", _recv_exact(conn, 8))
+                peer, peer_e, _flags = struct.unpack(
+                    "!iii", _recv_exact(conn, 12))
                 accepted["conn"] = conn
                 accepted["peer"] = peer
                 accepted["epoch"] = peer_e
@@ -138,7 +188,7 @@ class RingComm:
             self._send.settimeout(timeout)
             self._send.setsockopt(socket.IPPROTO_TCP,
                                   socket.TCP_NODELAY, 1)
-            self._send.sendall(struct.pack("!ii", rank, epoch))
+            self._send.sendall(struct.pack("!iii", rank, epoch, 0))
             t.join(timeout)
             if "conn" not in accepted:
                 raise P2PError(f"ring predecessor rank {self._pred} "
@@ -152,9 +202,14 @@ class RingComm:
                     f"ring epoch mismatch: predecessor at "
                     f"e{accepted['epoch']}, local e{epoch}")
             self._recv = accepted["conn"]
+            # the listener stays up for the comm's lifetime: it is the
+            # re-rendezvous point a reconnecting predecessor dials
+            self._srv = srv
+        except BaseException:
+            srv.close()
+            raise
         finally:
             kv.close()
-            srv.close()
 
     # -- wire helpers ------------------------------------------------------
 
@@ -166,7 +221,11 @@ class RingComm:
         """Injection shim at the ring's single wire choke point (sites
         ``p2p.send`` / ``p2p.recv``). Only reached when armed. A drop
         REALLY closes the socket — the peer observes a genuine EOF on
-        its end of the wire, exactly what a dead host produces."""
+        its end of the wire, exactly what a dead host produces — and
+        stays fatal. The TRANSIENT kinds (``conn_reset``, ``flaky``)
+        also really close the socket but do NOT raise: the framed
+        reconnect ladder re-dials and resumes, which is the blip the
+        plan is simulating. ``jitter`` sleeps inside the injector."""
         f = _chaos.fire("p2p.send", peer=self._succ)
         if f is not None:
             if f.kind == "drop":
@@ -174,6 +233,10 @@ class RingComm:
                 raise P2PError(
                     f"chaos: injected connection drop to successor "
                     f"rank {self._succ}")
+            if f.kind in ("conn_reset", "flaky"):
+                if self._send is not None:
+                    self._send.close()
+                    self._send = None
             if f.kind == "partition":
                 raise P2PError(
                     f"chaos: partitioned from successor rank "
@@ -188,11 +251,354 @@ class RingComm:
                 raise P2PError(
                     f"chaos: injected connection drop from predecessor "
                     f"rank {self._pred}")
+            if f.kind in ("conn_reset", "flaky"):
+                if self._recv is not None:
+                    self._recv.close()
+                    self._recv = None
             if f.kind == "partition":
                 raise P2PError(
                     f"chaos: partitioned from predecessor rank "
                     f"{self._pred}")
         return send_view
+
+    @staticmethod
+    def _transient(e: BaseException) -> bool:
+        """Connection-class wire faults the reconnect ladder absorbs —
+        routed through the resilience classifier; a bare OSError that
+        is not a timeout (EOF, RST, EPIPE, a refused re-dial) counts
+        too. Timeouts are the stall bound: always fatal."""
+        if isinstance(e, socket.timeout):
+            return False
+        return resilience.is_retryable(e) or isinstance(e, OSError)
+
+    # -- framed transmit with reconnect-and-resume -------------------------
+
+    def _tx(self, view) -> None:
+        """Send one transfer to the successor as framed bytes. On a
+        connection-class fault: re-dial (KV re-rendezvous, epoch
+        checked), learn the receiver's committed (seq, offset), resume
+        from there. The previous transfer's bytes are retained so a
+        reset that struck after sendall returned (bytes buffered, never
+        delivered) is replayable one transfer back."""
+        mv = memoryview(view).cast("B")
+        total = mv.nbytes
+        seq = self._tx_seq
+        off = 0
+        while True:
+            try:
+                if self._send is None:
+                    off = self._redial_send(seq, total, None)
+                while off < total:
+                    ln = min(total - off, self._FRAME)
+                    chunk = mv[off:off + ln]
+                    crc = zlib.crc32(chunk) if self._tx_crc else 0
+                    self._send.sendall(self._HDR.pack(seq, off, ln, crc))
+                    self._send.sendall(chunk)
+                    off += ln
+                break
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not self._transient(e):
+                    raise
+                off = self._redial_send(seq, total, e)
+        self._tx_seq = seq + 1
+        # the replay copy is the price of the one-transfer resume
+        # window; with the ladder disabled it could never be used, so
+        # skip the memcpy (and the retention) entirely
+        self._tx_keep = (seq, bytes(mv)) \
+            if resilience.policy().retries else None
+        self._tx_crc = False
+
+    def _redial_send(self, seq: int, total: int,
+                     cause: Optional[BaseException]) -> int:
+        """The sender-side reconnect ladder. Returns the offset to
+        resume the current transfer from (``total`` when the receiver
+        already has it all). Raises P2PError on exhaustion, mis-sync,
+        or when the failure detector already suspects the successor."""
+        if self._send is not None:
+            try:
+                self._send.close()
+            except OSError:  # resilience: exempt (teardown of a socket
+                pass         # already classified broken)
+            self._send = None
+        pol = resilience.policy()
+        if pol.retries == 0:
+            raise P2PConnError(
+                f"ring send to successor rank {self._succ} failed "
+                f"(retries disabled): {cause}") from cause
+        t0 = _time.monotonic()
+        last: Optional[BaseException] = cause
+        for attempt in range(pol.retries + 1):
+            if resilience.suspected(self._succ):
+                resilience.count_retry("p2p.send", "short_circuit")
+                raise P2PError(
+                    f"ring successor rank {self._succ} suspected dead "
+                    f"by the failure detector — not retrying "
+                    f"(last error: {last})") from last
+            if attempt > 0:
+                delay = pol.delays[min(attempt - 1,
+                                       len(pol.delays) - 1)]
+                if _time.monotonic() - t0 + delay > pol.budget_s:
+                    break
+                resilience.observe_backoff(delay)
+                _time.sleep(delay)
+            try:
+                host, port = self._lookup_succ_addr()
+                s = socket.create_connection(
+                    (host, port), timeout=min(5.0, pol.budget_s))
+                try:
+                    s.settimeout(min(5.0, pol.budget_s))
+                    s.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+                    s.sendall(struct.pack("!iii", self.rank,
+                                          self._epoch, 1))
+                    # handshake reply read: EOF/timeout here means the
+                    # receiver has not reached its accept loop yet (or
+                    # a stale backlog dial raced) — transient, unlike
+                    # the in-transfer stall bound
+                    raw = bytearray(self._RESUME.size)
+                    mvh = memoryview(raw)
+                    try:
+                        while mvh.nbytes:
+                            k = s.recv_into(mvh)
+                            if k == 0:
+                                raise P2PConnError(
+                                    f"reconnect handshake EOF from "
+                                    f"successor rank {self._succ}")
+                            mvh = mvh[k:]
+                    except socket.timeout as te:
+                        raise P2PConnError(
+                            f"reconnect handshake to successor rank "
+                            f"{self._succ} timed out") from te
+                    rseq, rcommitted = self._RESUME.unpack(bytes(raw))
+                    s.settimeout(self.timeout)
+                except BaseException:
+                    s.close()
+                    raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                # dial timeouts and store glitches are transient inside
+                # the (budget-bounded) redial; epoch divergence and
+                # every other non-connection P2PError stay fatal
+                if isinstance(e, (socket.timeout, NativeError)) \
+                        or self._transient(e):
+                    last = e
+                    continue
+                raise
+            self._send = s
+            self._tx_crc = True
+            resilience.observe_reconnect("p2p")
+            resilience.count_retry("p2p.send", "absorbed")
+            resilience.timeline_net(
+                {"site": "p2p.send", "peer": self._succ,
+                 "seq": seq, "resume": int(rcommitted)})
+            if rseq == seq:
+                return int(rcommitted)
+            if rseq == seq + 1:
+                return total     # receiver already holds the transfer
+            if rseq == seq - 1 and self._tx_keep is not None \
+                    and self._tx_keep[0] == rseq:
+                # the reset struck between transfers: the receiver is
+                # still missing the tail of the PREVIOUS transfer whose
+                # bytes we retained — replay it, then start the current
+                # transfer from 0
+                try:
+                    self._replay_kept(rseq, int(rcommitted))
+                except Exception as e:  # noqa: BLE001
+                    if not self._transient(e):
+                        raise
+                    last = e
+                    continue
+                return 0
+            raise P2PError(
+                f"ring link to successor rank {self._succ} cannot "
+                f"resume: receiver at transfer {rseq}, sender at "
+                f"{seq} — beyond the one-transfer replay window")
+        resilience.count_retry("p2p.send", "exhausted")
+        raise P2PError(
+            f"ring send to successor rank {self._succ} failed after "
+            f"{pol.retries} reconnect attempts "
+            f"({pol.budget_s:g}s budget): {last}") from last
+
+    def _replay_kept(self, seq: int, start: int) -> None:
+        """Re-send the retained previous transfer from ``start`` (crc
+        framed — the receiver verifies resumed bytes)."""
+        kept = memoryview(self._tx_keep[1])
+        off = start
+        while off < kept.nbytes:
+            ln = min(kept.nbytes - off, self._FRAME)
+            chunk = kept[off:off + ln]
+            self._send.sendall(self._HDR.pack(seq, off, ln,
+                                              zlib.crc32(chunk)))
+            self._send.sendall(chunk)
+            off += ln
+
+    def _lookup_succ_addr(self):
+        """Re-fetch the successor's registered ring address from the KV
+        (chaos-exempt observer traffic, like the failure detector's).
+        An epoch ahead of ours is fatal: a collective ring rebuild is in
+        progress and this link must not be resurrected."""
+        kv = StoreClient(socket.gethostbyname(self._kv_host),
+                         self._kv_port, rank=self.rank,
+                         chaos_exempt=True)
+        try:
+            raw = kv.get(f"{self._prefix}.addr.{self._succ}",
+                         timeout=2.0)
+        finally:
+            kv.close()
+        host, port, ep = raw.decode().rsplit(":", 2)
+        if int(ep) != self._epoch:
+            raise P2PError(
+                f"ring epoch changed during reconnect: successor at "
+                f"e{ep}, local e{self._epoch} — a collective rebuild "
+                f"superseded this link")
+        return host, int(port)
+
+    # -- framed receive with accept-and-resume -----------------------------
+
+    def _rx(self, view) -> None:
+        """Receive one transfer from the predecessor. On EOF/reset:
+        wait (budget-bounded, suspect-short-circuited) for the
+        predecessor to re-dial our persistent listener, answer with the
+        committed (seq, offset), and resume — verifying the crc of
+        every resumed frame so stitching can never double-apply.
+
+        Healing is SENDER-driven: the re-dial only arrives when the
+        sender's next _tx (or its one-transfer replay) hits the broken
+        link. Continuous ring traffic heals within a hop; a reset that
+        ate the final transfer before a quiet period longer than the
+        budget exhausts the wait below and escalates — the safe
+        pre-ladder path, never a hang or silently-missing bytes."""
+        mv = memoryview(view).cast("B")
+        total = mv.nbytes
+        seq = self._rx_seq
+        hdr = bytearray(self._HDR.size)
+        while self._rx_committed < total:
+            try:
+                if self._recv is None:
+                    self._reaccept(None)
+                self._recv_raw(memoryview(hdr))
+                hseq, off, ln, crc = self._HDR.unpack(bytes(hdr))
+                if hseq != seq or off > self._rx_committed \
+                        or off + ln > total:
+                    raise P2PError(
+                        f"ring frame mis-sync from predecessor rank "
+                        f"{self._pred}: got transfer {hseq} offset "
+                        f"{off}, expected {seq} offset "
+                        f"{self._rx_committed}")
+                if off + ln <= self._rx_committed:
+                    self._drain(ln)      # duplicate after resume: drop
+                    continue
+                self._recv_raw(mv[off:off + ln])
+                if self._rx_verify and crc and \
+                        zlib.crc32(mv[off:off + ln]) != crc:
+                    raise P2PError(
+                        f"ring frame crc mismatch from predecessor "
+                        f"rank {self._pred} after reconnect (transfer "
+                        f"{seq}, offset {off})")
+                self._rx_committed = off + ln
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not self._transient(e):
+                    raise
+                self._reaccept(e)
+        self._rx_seq = seq + 1
+        self._rx_committed = 0
+        self._rx_verify = False
+
+    def _reaccept(self, cause: Optional[BaseException]) -> None:
+        """The receiver-side reconnect ladder: accept the predecessor's
+        re-dial on the persistent listener, validate the handshake, and
+        answer with the committed (seq, offset) it should resume from."""
+        if self._recv is not None:
+            try:
+                self._recv.close()
+            except OSError:  # resilience: exempt (teardown of a socket
+                pass         # already classified broken)
+            self._recv = None
+        pol = resilience.policy()
+        if pol.retries == 0 or self._srv is None:
+            raise P2PConnError(
+                f"ring receive from predecessor rank {self._pred} "
+                f"failed (retries disabled): {cause}") from cause
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < pol.budget_s:
+            if resilience.suspected(self._pred):
+                resilience.count_retry("p2p.recv", "short_circuit")
+                raise P2PError(
+                    f"ring predecessor rank {self._pred} suspected "
+                    f"dead by the failure detector — not waiting for "
+                    f"a reconnect (last error: {cause})") from cause
+            self._srv.settimeout(0.25)
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:  # resilience: exempt (accept slice;
+                continue            # the ladder loop IS the retry)
+            except OSError as e:
+                if not self._transient(e):  # routes via resilience
+                    raise
+                continue
+            try:
+                conn.settimeout(min(5.0, pol.budget_s))
+                peer, peer_e, flags = struct.unpack(
+                    "!iii", _recv_exact(conn, 12))
+                if peer != self._pred or peer_e != self._epoch \
+                        or flags != 1:
+                    conn.close()     # stale/mis-wired dial: ignore it
+                    continue
+                conn.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+                conn.sendall(self._RESUME.pack(self._rx_seq,
+                                               self._rx_committed))
+                conn.settimeout(self.timeout)
+            except (OSError, P2PError):  # resilience: exempt (the dial
+                conn.close()             # died mid-handshake; keep
+                continue                 # waiting within the budget)
+            self._recv = conn
+            self._rx_verify = True
+            resilience.observe_reconnect("p2p")
+            resilience.count_retry("p2p.recv", "absorbed")
+            resilience.timeline_net(
+                {"site": "p2p.recv", "peer": self._pred,
+                 "seq": self._rx_seq, "resume": self._rx_committed})
+            return
+        resilience.count_retry("p2p.recv", "exhausted")
+        raise P2PError(
+            f"ring receive from predecessor rank {self._pred} failed "
+            f"and no reconnect arrived within {pol.budget_s:g}s: "
+            f"{cause}") from cause
+
+    def _recv_raw(self, view) -> None:
+        """recv_into the current _recv socket; EOF/reset surface as
+        P2PConnError (reconnectable), timeout as fatal P2PError (the
+        stall bound elapsed)."""
+        mv = memoryview(view).cast("B")
+        while mv.nbytes:
+            try:
+                k = self._recv.recv_into(mv, min(mv.nbytes, _CHUNK))
+            except socket.timeout as e:
+                # resilience: exempt (timeout IS the stall bound —
+                # deliberately fatal, never retried)
+                t = self._recv.gettimeout()
+                after = f" after {t:g}s" if t else ""
+                raise P2PError(
+                    f"ring receive from predecessor rank {self._pred} "
+                    f"timed out{after} (peer died?)") from e
+            except OSError as e:
+                raise P2PConnError(   # routed via resilience.Retryable
+                    f"ring receive from predecessor rank {self._pred} "
+                    f"failed: {e}") from e
+            if k == 0:
+                raise P2PConnError(
+                    f"predecessor rank {self._pred} closed the ring "
+                    f"connection")
+            mv = mv[k:]
+
+    def _drain(self, n: int) -> None:
+        """Read and discard ``n`` payload bytes (a duplicate frame
+        received after a resume)."""
+        scratch = bytearray(min(n, _CHUNK))
+        while n:
+            take = min(n, len(scratch))
+            self._recv_raw(memoryview(scratch)[:take])
+            n -= take
 
     def _xfer(self, send_view, recv_view) -> None:
         """Full-duplex step: send to successor while receiving from the
@@ -201,23 +607,21 @@ class RingComm:
         if _chaos._INJ is not None:
             send_view = self._chaos_wire(send_view)
         if memoryview(send_view).nbytes <= self._INLINE_BYTES:
-            self._send.sendall(send_view)
-            _recv_into(self._recv, recv_view,
-                       who=f"predecessor rank {self._pred}")
+            self._tx(send_view)
+            self._rx(recv_view)
             return
         err = []
 
         def tx():
             try:
-                self._send.sendall(send_view)
-            except OSError as e:  # pragma: no cover — peer death
+                self._tx(send_view)
+            except Exception as e:  # noqa: BLE001 — re-raised below
                 err.append(e)
 
         t = threading.Thread(target=tx, daemon=True)
         t.start()
         try:
-            _recv_into(self._recv, recv_view,
-                       who=f"predecessor rank {self._pred}")
+            self._rx(recv_view)
         finally:
             t.join(self.timeout)
         if t.is_alive():
@@ -228,6 +632,8 @@ class RingComm:
                            f"timed out after {self.timeout:g}s "
                            f"(peer died?)")
         if err:
+            if isinstance(err[0], P2PError):
+                raise err[0]
             raise P2PError(f"ring send to successor rank {self._succ} "
                            f"failed: {err[0]}")
 
@@ -292,12 +698,11 @@ class RingComm:
         flat = out.reshape(-1)
         # chain around the ring from the root; the last hop stops
         if r == root:
-            self._send.sendall(memoryview(flat))
+            self._tx(memoryview(flat))
         else:
-            _recv_into(self._recv, flat,
-                       who=f"predecessor rank {self._pred}")
+            self._rx(memoryview(flat))
             if (r + 1) % P != root:
-                self._send.sendall(memoryview(flat))
+                self._tx(memoryview(flat))
         return out
 
     def reducescatter(self, arr: np.ndarray, op: str = "sum"
@@ -400,23 +805,23 @@ class RingComm:
         if self.size == 1:
             return
         token = np.zeros(1, np.uint8)
-        who = f"predecessor rank {self._pred}"
         for _ in range(2):
             if self.rank == 0:
-                self._send.sendall(memoryview(token))
-                _recv_into(self._recv, token, who=who)
+                self._tx(memoryview(token))
+                self._rx(memoryview(token))
             else:
-                _recv_into(self._recv, token, who=who)
-                self._send.sendall(memoryview(token))
+                self._rx(memoryview(token))
+                self._tx(memoryview(token))
 
     def close(self) -> None:
-        for s in (self._send, self._recv):
+        for s in (self._send, self._recv, self._srv):
             if s is not None:
                 try:
                     s.close()
                 except OSError:  # pragma: no cover
-                    pass
-        self._send = self._recv = None
+                    pass         # resilience: exempt (teardown)
+
+        self._send = self._recv = self._srv = None
 
 
 def _recv_exact(sock, n: int) -> bytes:
@@ -426,12 +831,17 @@ def _recv_exact(sock, n: int) -> bytes:
 
 
 def _recv_into(sock, view, who: str = None) -> None:
+    """Raw exact read — ONLY for the init-time rendezvous handshake,
+    where failures are deliberately fatal (no link exists yet to
+    resume); in-transfer reads go through RingComm._recv_raw, which
+    classifies EOF/reset as retryable for the reconnect ladder."""
     mv = memoryview(view).cast("B")
     peer = who or "ring peer"
     while mv.nbytes:
         try:
             k = sock.recv_into(mv, min(mv.nbytes, _CHUNK))
         except socket.timeout as e:
+            # resilience: exempt (init rendezvous — fatal by design)
             t = sock.gettimeout()
             after = f" after {t:g}s" if t else ""
             raise P2PError(f"ring receive from {peer} timed "

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import socket
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
@@ -24,7 +25,12 @@ import numpy as np
 from .store import Coordinator
 
 
-_ring_epochs: dict = {}   # rendezvous prefix -> last epoch built here
+# rendezvous prefix -> last epoch built here. LRU-bounded: every
+# elastic round mints a fresh gen (and so a fresh prefix), and the
+# old gens' entries are dead weight — without the cap this grew one
+# entry per rendezvous prefix for the process's lifetime.
+_ring_epochs: "OrderedDict[str, int]" = OrderedDict()
+_RING_EPOCHS_CAP = 64
 
 _REDUCERS = {
     "sum": lambda mats: np.sum(mats, axis=0),
@@ -336,7 +342,11 @@ def build_hybrid_comm(name_base: str, *, force_store: bool = False):
             # start the new round's ring at epoch 1 — a module-global
             # counter would desync them permanently.
             prefix = f"p2p.{name_base}.{role}.g{gen}"
-            _ring_epochs[prefix] = _ring_epochs.get(prefix, 0) + 1
+            # pop+reinsert = LRU touch; the live prefix stays, stale
+            # gens from previous elastic rounds age out at the cap
+            _ring_epochs[prefix] = _ring_epochs.pop(prefix, 0) + 1
+            while len(_ring_epochs) > _RING_EPOCHS_CAP:
+                _ring_epochs.popitem(last=False)
             if _ring_epochs[prefix] > 1:
                 # epoch > 1 = this process is re-dialing a ring it
                 # already built once (in-process elastic reset) — the

@@ -33,24 +33,43 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..chaos import inject as _chaos
+from ..native import resilience
 from .plan import RedistError
 
 #: the chaos fault site at this boundary (chaos/plan.py FAULT_SITES)
 CHAOS_SITE = "redist.transport"
 
 
+def _wrap(msg: str, cause: Optional[BaseException] = None) -> RedistError:
+    """Build a RedistError whose ``retryable`` flag is ROUTED THROUGH
+    the resilience classifier (native/resilience.py is_retryable): a
+    retryable blip retries in place inside the transport before the
+    collective disk-fallback vote ever sees it; everything else keeps
+    the PR 7 fallback semantics."""
+    e = RedistError(msg)
+    e.retryable = cause is not None and resilience.is_retryable(cause)
+    return e
+
+
 def chaos_gate(outgoing: Dict[int, bytes],
                peer: Optional[int] = None) -> Dict[int, bytes]:
     """One injector consultation per exchange/IO call. ``corrupt``
     flips a bit in the largest payload (deterministic pick — the crc
-    layer must catch it); drop/partition raise :class:`RedistError`;
-    delay/crash are handled inside the injector. Disarmed: one
+    layer must catch it); drop/partition raise :class:`RedistError`
+    (fatal: the collective disk-fallback path); conn_reset/flaky raise
+    it flagged ``retryable`` so the transport retries in place;
+    delay/jitter/crash are handled inside the injector. Disarmed: one
     attribute read, payloads untouched."""
     if _chaos._INJ is None:
         return outgoing
     f = _chaos.fire(CHAOS_SITE, peer=peer)
     if f is None:
         return outgoing
+    if f.kind in ("conn_reset", "flaky"):
+        e = RedistError(
+            f"chaos: injected {f.kind} at {CHAOS_SITE}")
+        e.retryable = True
+        raise e
     if f.kind in ("drop", "partition"):
         raise RedistError(
             f"chaos: injected {f.kind} at {CHAOS_SITE}")
@@ -142,22 +161,34 @@ class RingTransport(BaseTransport):
 
     def exchange(self, outgoing: Dict[int, bytes], tag: str,
                  max_bytes_hint: int = 0) -> Dict[int, bytes]:
-        outgoing = chaos_gate(outgoing)
-        if self.world == 1:
-            return {}
-        chunks = [np.frombuffer(outgoing.get(d, b""), np.uint8)
-                  for d in range(self.world)]
-        try:
-            received = self._ring.alltoall(chunks)
-        except Exception as e:
-            # abandon the sockets: peers blocked mid-relay must observe
-            # EOF and fail into their own fallback, not hang the reset
-            self.close()
-            raise RedistError(
-                f"ring redistribution exchange {tag!r} failed: {e}") from e
-        return {s: received[s].tobytes()
-                for s in range(self.world)
-                if s != self.rank and received[s].size}
+        def attempt():
+            og = chaos_gate(outgoing)
+            if self.world == 1:
+                return {}
+            chunks = [np.frombuffer(og.get(d, b""), np.uint8)
+                      for d in range(self.world)]
+            try:
+                received = self._ring.alltoall(chunks)
+            except Exception as e:
+                # transient wire faults were already absorbed INSIDE
+                # RingComm's reconnect ladder; anything escaping it is
+                # post-ladder fatal — abandon the sockets so peers
+                # blocked mid-relay observe EOF and fail into their own
+                # fallback, not hang the reset
+                self.close()
+                raise RedistError(
+                    f"ring redistribution exchange {tag!r} failed: "
+                    f"{e}") from e
+            return {s: received[s].tobytes()
+                    for s in range(self.world)
+                    if s != self.rank and received[s].size}
+
+        # retryable blips surfacing AT this boundary (the chaos gate's
+        # conn_reset/flaky) retry in place before the collective
+        # disk-fallback vote ever sees a failure
+        return resilience.policy().run(
+            attempt, what=f"redist exchange {tag!r}",
+            site="redist.transport", plane="p2p")
 
     def close(self) -> None:
         if self._owns and self._ring is not None:
@@ -182,21 +213,31 @@ class CoordTransport(BaseTransport):
 
     def exchange(self, outgoing: Dict[int, bytes], tag: str,
                  max_bytes_hint: int = 0) -> Dict[int, bytes]:
-        outgoing = chaos_gate(outgoing)
-        blob = b"".join(self._REC.pack(d, len(p)) + p
-                        for d, p in sorted(outgoing.items()))
-        # every rank receives every payload: bound by the global round
-        # total (the orchestrator's hint) plus framing slack
-        cap = max(max_bytes_hint, len(blob) * self.world) \
-            + 16 * self.world * self.world + 1024
-        try:
-            blobs = self._c.allgather(blob, tag=tag, max_bytes=cap)
-        except RedistError:
-            raise
-        except Exception as e:
-            raise RedistError(
-                f"coordinator redistribution exchange {tag!r} "
-                f"failed: {e}") from e
+        def attempt():
+            og = chaos_gate(outgoing)
+            blob = b"".join(self._REC.pack(d, len(p)) + p
+                            for d, p in sorted(og.items()))
+            # every rank receives every payload: bound by the global
+            # round total (the orchestrator's hint) plus framing slack
+            cap = max(max_bytes_hint, len(blob) * self.world) \
+                + 16 * self.world * self.world + 1024
+            try:
+                return self._c.allgather(blob, tag=tag, max_bytes=cap)
+            except RedistError:
+                raise
+            except Exception as e:
+                # route the wrap through the resilience classifier: a
+                # connection-class cause keeps its retryable flag, so
+                # the ladder below replays the allgather (sequence
+                # numbers advance only on success; posts are
+                # nonce-deduped) instead of voting for disk fallback
+                raise _wrap(
+                    f"coordinator redistribution exchange {tag!r} "
+                    f"failed: {e}", e) from e
+
+        blobs = resilience.policy().run(
+            attempt, what=f"redist exchange {tag!r}",
+            site="redist.transport", plane="coord")
         out: Dict[int, bytes] = {}
         for s, b in enumerate(blobs):
             if s == self.rank:

@@ -99,6 +99,57 @@ class TestPlan:
         with pytest.raises(PlanError, match="unknown chaos plan keys"):
             ChaosPlan.from_dict({"seed": 0, "fautls": []})
 
+    def test_transient_kinds_validate(self):
+        # the transient kinds land only where a retry ladder exists
+        Fault(rank=0, site="p2p.send", kind="conn_reset",
+              at=3).validate()
+        Fault(rank=0, site="store.request", kind="flaky", prob=0.5,
+              after=1, until=4).validate()
+        Fault(rank=0, site="p2p.recv", kind="jitter", seconds=0.1,
+              after=0, until=2).validate()
+        with pytest.raises(PlanError, match="cannot land"):
+            Fault(rank=0, site="step", kind="conn_reset").validate()
+        with pytest.raises(PlanError, match="prob"):
+            Fault(rank=0, site="p2p.send", kind="flaky").validate()
+        with pytest.raises(PlanError, match="prob"):
+            Fault(rank=0, site="p2p.send", kind="flaky",
+                  prob=1.5).validate()
+        with pytest.raises(PlanError, match="only applies"):
+            Fault(rank=0, site="p2p.send", kind="conn_reset",
+                  prob=0.5).validate()
+        with pytest.raises(PlanError, match="seconds"):
+            Fault(rank=0, site="p2p.send", kind="jitter").validate()
+
+    def test_transient_profile_deterministic_and_blip_only(self):
+        a = random_plan(7, 4, 10, profile="transient")
+        b = random_plan(7, 4, 10, profile="transient")
+        c = random_plan(8, 4, 10, profile="transient")
+        assert a.to_json() == b.to_json() != c.to_json()
+        kinds = {f.kind for f in a.faults}
+        assert kinds == {"conn_reset", "flaky", "jitter"}
+        # blips only: nothing permanent, nothing that kills a rank
+        assert not kinds & {"crash", "drop", "delete_chunk",
+                            "partition", "torn_write"}
+        with pytest.raises(PlanError, match="world"):
+            random_plan(7, 1, 10, profile="transient")
+
+    def test_retry_policy_backoff_deterministic(self):
+        # satellite: the seeded RetryPolicy emits a byte-identical
+        # delay sequence per (seed, rank), and jitter never exceeds
+        # the budget — same determinism contract as the plan above
+        from horovod_tpu.native.resilience import RetryPolicy
+        for seed, rank in ((0, 0), (7, 3), (123, 1)):
+            a = RetryPolicy(retries=8, backoff_base_ms=25,
+                            budget_s=2.0, seed=seed, rank=rank)
+            b = RetryPolicy(retries=8, backoff_base_ms=25,
+                            budget_s=2.0, seed=seed, rank=rank)
+            assert a.delays == b.delays
+            assert sum(a.delays) <= 2.0 + 1e-9
+            assert all(0 <= d <= 2.0 for d in a.delays)
+        ranks = {RetryPolicy(retries=4, seed=7, rank=r).delays
+                 for r in range(4)}
+        assert len(ranks) == 4    # per-rank desynchronized backoff
+
     def test_epoch_pinning_and_windows(self):
         f = Fault(rank=0, site="step", kind="crash", at=3,
                   epoch=0).validate()
@@ -237,6 +288,37 @@ class TestInject:
         assert out.returncode == -signal.SIGKILL, (out.returncode,
                                                    out.stderr[-500:])
         assert "survived" not in out.stdout
+
+    def test_flaky_draws_seeded_and_windowed(self):
+        # same seed => identical drop pattern across the window; the
+        # injector's rng is the single source of flaky randomness
+        def pattern():
+            inject.uninstall()
+            inject.install(ChaosPlan.from_json(
+                '{"seed": 21, "faults": [{"rank": 0, '
+                '"site": "p2p.send", "kind": "flaky", "prob": 0.5, '
+                '"after": 0, "until": 19}]}'), rank=0, epoch=0)
+            return tuple(inject.fire("p2p.send") is not None
+                         for _ in range(20))
+
+        a, b = pattern(), pattern()
+        assert a == b
+        assert any(a) and not all(a)     # drops AND passes in-window
+        # outside the window: clean
+        assert inject.fire("p2p.send") is None
+
+    def test_jitter_sleeps_within_bound(self):
+        inject.install(ChaosPlan.from_json(
+            '{"seed": 3, "faults": [{"rank": 0, '
+            '"site": "store.request", "kind": "jitter", '
+            '"seconds": 0.08, "at": 0}]}'), rank=0, epoch=0)
+        t0 = time.perf_counter()
+        f = inject.fire("store.request")
+        dt = time.perf_counter() - t0
+        assert f is None                 # pure latency, nothing returned
+        assert dt <= 0.5                 # bounded by 'seconds' + noise
+        fired = inject.injector().fired
+        assert fired and fired[0]["kind"] == "jitter"
 
     def test_listener_sees_fired_faults(self):
         inj = inject.install(ChaosPlan.from_json(

@@ -1,0 +1,803 @@
+"""Tier-1 units for the transient-fault absorption ladder (ISSUE 9):
+the retryable-vs-fatal classifier, the seeded backoff policy, store
+reconnect-and-replay (with the csrc nonce dedupe), ring reconnect +
+resume, redist retry-in-place, the transient soak verdict core, and
+the lint asserting every native/ socket-error path routes through the
+resilience classifier.
+
+The np4 transient soak acceptance lives in tests/test_chaos_soak.py
+(slow-marked); everything here is single-process and fast.
+"""
+import json
+import os
+import re
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import native
+from horovod_tpu.chaos import inject
+from horovod_tpu.chaos.plan import ChaosPlan
+from horovod_tpu.native import resilience
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native toolchain unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    inject.uninstall()
+    yield
+    inject.uninstall()
+
+
+def _retry_count(site=None, outcome=None):
+    """Sum of hvd_net_retries_total matching the label filter."""
+    from horovod_tpu.obs.metrics import get_registry
+    total = 0
+    for c in get_registry().snapshot()["counters"]:
+        if c["name"] != "hvd_net_retries_total":
+            continue
+        lb = c["labels"]
+        if site is not None and lb.get("site") != site:
+            continue
+        if outcome is not None and lb.get("outcome") != outcome:
+            continue
+        total += c["value"]
+    return total
+
+
+def _reconnect_count(plane=None):
+    from horovod_tpu.obs.metrics import get_registry
+    total = 0
+    for c in get_registry().snapshot()["counters"]:
+        if c["name"] != "hvd_net_reconnects_total":
+            continue
+        if plane is not None and c["labels"].get("plane") != plane:
+            continue
+        total += c["value"]
+    return total
+
+
+# --------------------------------------------------------------------------
+# classifier
+# --------------------------------------------------------------------------
+
+class TestClassifier:
+    def test_retryable_vs_fatal(self):
+        from horovod_tpu.native.p2p import P2PConnError, P2PError
+        from horovod_tpu.native.store import (NativeConnError,
+                                              NativeError, NativeTimeout)
+        assert resilience.is_retryable(NativeConnError("x"))
+        assert resilience.is_retryable(P2PConnError("x"))
+        assert resilience.is_retryable(ConnectionResetError())
+        assert resilience.is_retryable(BrokenPipeError())
+        # fatal: timeouts (the stall bound elapsed), protocol errors
+        assert not resilience.is_retryable(NativeTimeout("x"))
+        assert not resilience.is_retryable(NativeError("x"))
+        assert not resilience.is_retryable(P2PError("x"))
+        assert not resilience.is_retryable(socket.timeout())
+        assert not resilience.is_retryable(ValueError("x"))
+
+    def test_explicit_retryable_attr_routes_redist_errors(self):
+        from horovod_tpu.redist.plan import RedistError
+        e = RedistError("blip")
+        assert not resilience.is_retryable(e)
+        e.retryable = True
+        assert resilience.is_retryable(e)
+        e.retryable = False
+        assert not resilience.is_retryable(e)
+
+    def test_redist_wrap_inherits_cause_classification(self):
+        from horovod_tpu.native.store import (NativeConnError,
+                                              NativeTimeout)
+        from horovod_tpu.redist.transport import _wrap
+        assert _wrap("x", NativeConnError("c")).retryable is True
+        assert _wrap("x", NativeTimeout("t")).retryable is False
+        assert _wrap("x", None).retryable is False
+
+
+# --------------------------------------------------------------------------
+# seeded backoff policy
+# --------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_backoff_deterministic_per_seed_rank(self):
+        a = resilience.RetryPolicy(retries=6, backoff_base_ms=25,
+                                   budget_s=10, seed=3, rank=1)
+        b = resilience.RetryPolicy(retries=6, backoff_base_ms=25,
+                                   budget_s=10, seed=3, rank=1)
+        c = resilience.RetryPolicy(retries=6, backoff_base_ms=25,
+                                   budget_s=10, seed=3, rank=2)
+        assert a.delays == b.delays
+        assert a.delays != c.delays
+        assert len(a.delays) == 6
+
+    def test_jitter_never_exceeds_budget(self):
+        for seed in range(20):
+            p = resilience.RetryPolicy(retries=10, backoff_base_ms=100,
+                                       budget_s=0.75, seed=seed, rank=0)
+            assert sum(p.delays) <= 0.75 + 1e-9
+            assert all(d <= 0.75 for d in p.delays)
+            # doubling with jitter in [1.0, 1.5) until the budget caps
+            assert p.delays[0] >= 0.1
+
+    def test_run_absorbs_then_succeeds(self):
+        from horovod_tpu.native.store import NativeConnError
+        p = resilience.RetryPolicy(retries=3, backoff_base_ms=1,
+                                   budget_s=5)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise NativeConnError("blip")
+            return "ok"
+
+        base = _retry_count(site="t", outcome="absorbed")
+        assert p.run(fn, what="t", site="t", plane="store") == "ok"
+        assert len(calls) == 3
+        assert _retry_count(site="t", outcome="absorbed") == base + 2
+
+    def test_run_exhausts_and_reraises_original(self):
+        from horovod_tpu.native.store import NativeConnError
+        p = resilience.RetryPolicy(retries=2, backoff_base_ms=1,
+                                   budget_s=5)
+        base = _retry_count(site="tx", outcome="exhausted")
+        with pytest.raises(NativeConnError, match="blip"):
+            p.run(lambda: (_ for _ in ()).throw(NativeConnError("blip")),
+                  what="tx", site="tx", plane="store")
+        assert _retry_count(site="tx", outcome="exhausted") == base + 1
+
+    def test_run_fatal_not_retried(self):
+        from horovod_tpu.native.store import NativeTimeout
+        p = resilience.RetryPolicy(retries=5, backoff_base_ms=1,
+                                   budget_s=5)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise NativeTimeout("gone")
+
+        with pytest.raises(NativeTimeout):
+            p.run(fn, what="t", site="t", plane="store")
+        assert len(calls) == 1
+
+    def test_run_short_circuits_on_suspected_peer(self):
+        from horovod_tpu.chaos import detector as hb
+        from horovod_tpu.native.store import NativeConnError
+
+        class _Fake:
+            def suspects(self):
+                return {2: 9.9}
+
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise NativeConnError("blip")
+
+        p = resilience.RetryPolicy(retries=5, backoff_base_ms=1,
+                                   budget_s=5)
+        hb._DETECTOR = _Fake()
+        try:
+            base = _retry_count(site="sc", outcome="short_circuit")
+            with pytest.raises(NativeConnError):
+                p.run(fn, what="t", site="sc", plane="store", peer=2)
+            assert len(calls) == 1       # the detector's verdict wins
+            assert _retry_count(site="sc",
+                                outcome="short_circuit") == base + 1
+            # an unrelated peer still retries
+            with pytest.raises(NativeConnError):
+                p.run(fn, what="t", site="sc", plane="store", peer=0)
+            assert len(calls) == 1 + 6
+        finally:
+            hb._DETECTOR = None
+
+    def test_retries_zero_is_passthrough(self):
+        from horovod_tpu.native.store import NativeConnError
+        p = resilience.RetryPolicy(retries=0, backoff_base_ms=1,
+                                   budget_s=5)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise NativeConnError("blip")
+
+        with pytest.raises(NativeConnError):
+            p.run(fn, what="t", site="t", plane="store")
+        assert len(calls) == 1
+
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError, match="retries"):
+            resilience.RetryPolicy(retries=-1)
+        with pytest.raises(ValueError, match="backoff"):
+            resilience.RetryPolicy(backoff_base_ms=0)
+        with pytest.raises(ValueError, match="budget"):
+            resilience.RetryPolicy(budget_s=0)
+
+
+# --------------------------------------------------------------------------
+# config knobs
+# --------------------------------------------------------------------------
+
+class TestConfigKnobs:
+    def test_strict_parse_fail_fast(self, monkeypatch):
+        from horovod_tpu.core.config import Config
+        for var in ("HOROVOD_NET_RETRIES", "HOROVOD_NET_BACKOFF_BASE_MS",
+                    "HOROVOD_NET_RETRY_BUDGET_S"):
+            monkeypatch.setenv(var, "many")
+            with pytest.raises(ValueError, match=var):
+                Config.from_env()
+            monkeypatch.delenv(var)
+
+    def test_budget_must_stay_below_collective_timeout(self, monkeypatch):
+        from horovod_tpu.core.config import Config
+        monkeypatch.setenv("HOROVOD_GLOO_TIMEOUT_SECONDS", "30")
+        monkeypatch.setenv("HOROVOD_NET_RETRY_BUDGET_S", "30")
+        with pytest.raises(ValueError, match="BELOW"):
+            Config.from_env()
+        monkeypatch.setenv("HOROVOD_NET_RETRY_BUDGET_S", "5")
+        c = Config.from_env()
+        assert c.net_retry_budget_s == 5.0
+        # retries disabled: the bound is vacuous
+        monkeypatch.setenv("HOROVOD_NET_RETRIES", "0")
+        monkeypatch.setenv("HOROVOD_NET_RETRY_BUDGET_S", "30")
+        Config.from_env()
+
+    def test_unset_budget_adapts_to_short_collective_timeout(
+            self, monkeypatch):
+        # regression: a deployment that only SHORTENS the stall bound
+        # (e.g. the np2 negotiation failure-mode test runs at 2 s) must
+        # not trip the budget-below-timeout validation on a knob it
+        # never set — the unset default derives min(10, timeout/2)
+        from horovod_tpu.core.config import Config
+        from horovod_tpu.native.resilience import default_budget_s
+        monkeypatch.delenv("HOROVOD_NET_RETRY_BUDGET_S", raising=False)
+        monkeypatch.setenv("HOROVOD_GLOO_TIMEOUT_SECONDS", "2")
+        c = Config.from_env()
+        assert c.net_retry_budget_s == 1.0 == default_budget_s(2.0)
+        # a long timeout keeps the flat 10 s default
+        monkeypatch.setenv("HOROVOD_GLOO_TIMEOUT_SECONDS", "300")
+        assert Config.from_env().net_retry_budget_s == 10.0
+        # an EXPLICIT bad budget still fails fast at the same timeout
+        monkeypatch.setenv("HOROVOD_GLOO_TIMEOUT_SECONDS", "2")
+        monkeypatch.setenv("HOROVOD_NET_RETRY_BUDGET_S", "10")
+        with pytest.raises(ValueError, match="BELOW"):
+            Config.from_env()
+
+    def test_valid_knobs_land(self, monkeypatch):
+        from horovod_tpu.core.config import Config
+        monkeypatch.setenv("HOROVOD_NET_RETRIES", "7")
+        monkeypatch.setenv("HOROVOD_NET_BACKOFF_BASE_MS", "12.5")
+        monkeypatch.setenv("HOROVOD_NET_RETRY_BUDGET_S", "3.5")
+        c = Config.from_env()
+        assert (c.net_retries, c.net_backoff_base_ms,
+                c.net_retry_budget_s) == (7, 12.5, 3.5)
+
+
+# --------------------------------------------------------------------------
+# store client: reconnect-and-replay
+# --------------------------------------------------------------------------
+
+@needs_native
+class TestStoreLadder:
+    def test_conn_reset_absorbed_and_reconnects(self):
+        from horovod_tpu.native.store import StoreClient, StoreServer
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "store.request", '
+            '"kind": "conn_reset", "at": 1}]}'), rank=0, epoch=0)
+        base_abs = _retry_count(site="store.client", outcome="absorbed")
+        base_rec = _reconnect_count(plane="store")
+        with StoreServer() as srv:
+            c = StoreClient("127.0.0.1", srv.port, rank=0)
+            c.set("k", b"v1")                    # n=0: clean
+            c.set("k", b"v2")                    # n=1: reset, absorbed
+            assert c.get("k", timeout=5) == b"v2"
+            c.close()
+        assert _retry_count(site="store.client",
+                            outcome="absorbed") == base_abs + 1
+        assert _reconnect_count(plane="store") == base_rec + 1
+
+    def test_flaky_window_absorbed(self):
+        from horovod_tpu.native.store import StoreClient, StoreServer
+        inject.install(ChaosPlan.from_json(
+            '{"seed": 11, "faults": [{"rank": 0, '
+            '"site": "store.request", "kind": "flaky", "prob": 0.99, '
+            '"after": 1, "until": 2}]}'), rank=0, epoch=0)
+        with StoreServer() as srv:
+            c = StoreClient("127.0.0.1", srv.port, rank=0)
+            c.set("a", b"1")                     # n=0
+            c.set("b", b"2")                     # n=1..: flaky, retried
+            assert c.get("a", timeout=5) == b"1"
+            assert c.get("b", timeout=5) == b"2"
+            c.close()
+
+    def test_drop_stays_fatal(self):
+        # the PERMANENT kind keeps its PR 5 semantics: NativeError,
+        # never absorbed — the retryable class is conn_reset/flaky only
+        from horovod_tpu.native.store import (NativeConnError,
+                                              NativeError, StoreClient,
+                                              StoreServer)
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "store.request", '
+            '"kind": "drop", "at": 0}]}'), rank=0, epoch=0)
+        with StoreServer() as srv:
+            c = StoreClient("127.0.0.1", srv.port, rank=0)
+            with pytest.raises(NativeError, match="chaos.*drop") as ei:
+                c.set("k", b"x")
+            assert not isinstance(ei.value, NativeConnError)
+            c.close()
+
+    def test_gather_replay_same_nonce_served_from_cache(self):
+        """A replayed post (same rank + nonce) after the round fully
+        drained gets the cached result instead of opening a phantom
+        new round — the csrc/store.cc dedupe the reconnect ladder
+        leans on."""
+        from horovod_tpu.native.store import StoreClient, StoreServer
+        with StoreServer() as srv:
+            res = {}
+
+            def member(r):
+                c = StoreClient("127.0.0.1", srv.port, rank=r)
+                res[r] = c.gather("rk", 2, r, f"b{r}".encode(),
+                                  timeout=10, nonce=500 + r)
+                c.close()
+
+            ts = [threading.Thread(target=member, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert res[0] == res[1] == [b"b0", b"b1"]
+            # replay with the SAME nonce: cached, returns immediately
+            c = StoreClient("127.0.0.1", srv.port, rank=0)
+            assert c.gather("rk", 2, 0, b"b0", timeout=2,
+                            nonce=500) == [b"b0", b"b1"]
+            # a NEW logical round on the reused key (different nonces)
+            # still works — the stale cache entry must not shadow it
+            res2 = {}
+
+            def member2(r):
+                c2 = StoreClient("127.0.0.1", srv.port, rank=r)
+                res2[r] = c2.gather("rk", 2, r, f"n{r}".encode(),
+                                    timeout=10, nonce=900 + r)
+                c2.close()
+
+            ts = [threading.Thread(target=member2, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert res2[0] == [b"n0", b"n1"]
+            c.close()
+
+    def test_reduce_replay_same_nonce_served_from_cache(self):
+        from horovod_tpu.native.store import StoreClient, StoreServer
+        with StoreServer() as srv:
+            res = {}
+
+            def member(r):
+                c = StoreClient("127.0.0.1", srv.port, rank=r)
+                res[r] = c.reduce("rd", 2, r, bytes([0x0F | (r << 6)]),
+                                  timeout=10, nonce=700 + r)
+                c.close()
+
+            ts = [threading.Thread(target=member, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            expect = bytes([0x0F])           # AND of 0x0F and 0x4F
+            assert res[0] == res[1] == expect
+            c = StoreClient("127.0.0.1", srv.port, rank=1)
+            assert c.reduce("rd", 2, 1, bytes([0x4F]), timeout=2,
+                            nonce=701) == expect
+            c.close()
+
+    def test_reduce_timeout_retry_refreshes_replay_nonce(self):
+        """A timeout retry is a NEW logical request with a new nonce;
+        the server must key the done-round cache by the LATEST nonce
+        (gather's rule) — a stale one would let the retry's replay
+        erase the cache and open a phantom round that hangs."""
+        from horovod_tpu.native.store import (NativeTimeout, StoreClient,
+                                              StoreServer)
+        with StoreServer() as srv:
+            c0 = StoreClient("127.0.0.1", srv.port, rank=0)
+            # first post times out (member 1 absent) — posted={0},
+            # server keeps nonce 100
+            with pytest.raises(NativeTimeout):
+                c0.reduce("rt", 2, 0, b"\x0f", timeout=0.3, nonce=100)
+            res = {}
+
+            def retry0():
+                res[0] = c0.reduce("rt", 2, 0, b"\x0f", timeout=10,
+                                   nonce=101)   # the retry's new nonce
+
+            def member1():
+                c1 = StoreClient("127.0.0.1", srv.port, rank=1)
+                res[1] = c1.reduce("rt", 2, 1, b"\x4f", timeout=10,
+                                   nonce=200)
+                c1.close()
+
+            ts = [threading.Thread(target=f) for f in (retry0, member1)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            assert res[0] == res[1] == bytes([0x0F])
+            # the retry's replay (reply lost) must hit the done cache —
+            # with a stale nonce key it would erase it and hang here
+            assert c0.reduce("rt", 2, 0, b"\x0f", timeout=2,
+                             nonce=101) == bytes([0x0F])
+            c0.close()
+
+    def test_replayed_identical_set_keeps_drain_bookkeeping(self):
+        """An identical re-Set while a read-counted drain is in flight
+        is a transport replay (the Set's reply was lost): it must not
+        re-arm reads_left past the remaining readers and leak the
+        entry until the TTL sweep."""
+        from horovod_tpu.native.store import (NativeTimeout, StoreClient,
+                                              StoreServer)
+        with StoreServer() as srv:
+            c = StoreClient("127.0.0.1", srv.port, rank=0)
+            c.set("rk", b"v1")
+            assert c.get("rk", timeout=5, expected_reads=2,
+                         nonce=11) == b"v1"          # slot 1 consumed
+            c.set("rk", b"v1")                       # replayed Set
+            assert c.get("rk", timeout=5, expected_reads=2,
+                         nonce=12) == b"v1"          # final slot
+            # the entry must be GONE now — a leaked (re-armed) entry
+            # would serve this new nonce instead of blocking
+            with pytest.raises(NativeTimeout):
+                c.get("rk", timeout=0.3, expected_reads=2, nonce=13)
+            # a genuinely new round (different value) still resets
+            c.set("rk", b"v2")
+            assert c.get("rk", timeout=5, expected_reads=1,
+                         nonce=14) == b"v2"
+            c.close()
+
+    def test_readcounted_get_replay_does_not_eat_sibling_slot(self):
+        """A replayed read-counted Get (same nonce, reply lost) must be
+        served again WITHOUT a second reads_left decrement — otherwise
+        a one-rank blip erases the broadcast key early and a sibling
+        reader times out (the OP_GET twin of the gather/reduce nonce
+        dedupe)."""
+        from horovod_tpu.native.store import (NativeTimeout, StoreClient,
+                                              StoreServer)
+        with StoreServer() as srv:
+            c = StoreClient("127.0.0.1", srv.port, rank=0)
+            c.set("bc", b"payload")
+            # reader A consumes its slot (reads_left 2 -> 1), then
+            # replays with the SAME nonce: served again, NO decrement
+            assert c.get("bc", timeout=5, expected_reads=2,
+                         nonce=41) == b"payload"
+            assert c.get("bc", timeout=5, expected_reads=2,
+                         nonce=41) == b"payload"
+            # the sibling's slot survived the replay
+            assert c.get("bc", timeout=5, expected_reads=2,
+                         nonce=42) == b"payload"
+            # ...and the final read erased the key: a NEW nonce blocks
+            with pytest.raises(NativeTimeout):
+                c.get("bc", timeout=0.3, expected_reads=2, nonce=43)
+            # a replay of the FINAL read (its reply lost) is served
+            # from the done cache even though the entry is gone
+            assert c.get("bc", timeout=5, expected_reads=2,
+                         nonce=42) == b"payload"
+            # a re-Set key starts a fresh round: old nonces don't shadow
+            c.set("bc", b"round2")
+            assert c.get("bc", timeout=5, expected_reads=1,
+                         nonce=44) == b"round2"
+            c.close()
+
+    def test_coordinator_conn_reset_absorbed(self):
+        from horovod_tpu.native.store import Coordinator, StoreServer
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "store.request", '
+            '"kind": "conn_reset", "at": 0}]}'), rank=0, epoch=0)
+        with StoreServer() as srv:
+            res = {}
+
+            def member(r):
+                co = Coordinator("127.0.0.1", srv.port, r, 2,
+                                 timeout=20.0)
+                res[r] = co.allgather(f"m{r}".encode(), tag="lad")
+                co.barrier(tag="lad-bar")
+                co.close()
+
+            ts = [threading.Thread(target=member, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert res[0] == res[1] == [b"m0", b"m1"]
+
+
+# --------------------------------------------------------------------------
+# p2p ring: reconnect + resume
+# --------------------------------------------------------------------------
+
+@needs_native
+class TestRingLadder:
+    def _ring_pair(self, srv_port, prefix, body):
+        out, errs = {}, []
+
+        def member(r):
+            from horovod_tpu.native.p2p import RingComm
+            try:
+                rc = RingComm("127.0.0.1", srv_port, r, 2,
+                              prefix=prefix, timeout=30)
+                try:
+                    body(r, rc, out)
+                finally:
+                    rc.close()
+            except Exception as e:  # noqa: BLE001
+                errs.append((r, e))
+
+        ts = [threading.Thread(target=member, args=(r,))
+              for r in range(2)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs, errs
+        return out
+
+    def test_socket_kill_mid_run_heals_bit_exact(self):
+        from horovod_tpu.native.store import StoreServer
+        base_rec = _reconnect_count(plane="p2p")
+        with StoreServer() as srv:
+            def body(r, rc, out):
+                a = np.arange(2048, dtype=np.float64) * (r + 1)
+                out[(r, 0)] = rc.allreduce(a)
+                if r == 0:           # a real mid-run connection kill
+                    rc._send.close()
+                    rc._send = None
+                out[(r, 1)] = rc.allreduce(a * 3)
+                rc.barrier()
+
+            out = self._ring_pair(srv.port, "heal", body)
+        exp = np.arange(2048, dtype=np.float64) * 3
+        np.testing.assert_array_equal(out[(0, 0)], exp)
+        np.testing.assert_array_equal(out[(0, 1)], exp * 3)
+        np.testing.assert_array_equal(out[(1, 1)], exp * 3)
+        assert _reconnect_count(plane="p2p") >= base_rec + 1
+
+    def test_chaos_conn_reset_window_absorbed(self):
+        # peer-addressed so only ring-rank 0's sends (succ == 1) reset;
+        # every crossing in the window severs the link and the ladder
+        # re-dials + resumes — the collective stays bit-exact
+        from horovod_tpu.native.store import StoreServer
+        inject.install(ChaosPlan.from_json(
+            '{"seed": 5, "faults": [{"rank": 0, "site": "p2p.send", '
+            '"kind": "conn_reset", "peer": 1, "after": 1, '
+            '"until": 3}]}'), rank=0, epoch=0)
+        base_abs = _retry_count(site="p2p.send", outcome="absorbed")
+        with StoreServer() as srv:
+            def body(r, rc, out):
+                for i in range(5):
+                    a = np.arange(512, dtype=np.float32) * (r + 1 + i)
+                    out[(r, i)] = rc.allreduce(a)
+                rc.barrier()
+
+            out = self._ring_pair(srv.port, "cr", body)
+        for i in range(5):
+            exp = np.arange(512, dtype=np.float32) * (1 + i) \
+                + np.arange(512, dtype=np.float32) * (2 + i)
+            np.testing.assert_array_equal(out[(0, i)], exp)
+            np.testing.assert_array_equal(out[(1, i)], exp)
+        assert _retry_count(site="p2p.send",
+                            outcome="absorbed") > base_abs
+
+    def test_large_transfer_resumes_not_restarts(self):
+        # kill the link mid-large-transfer: the resume must continue
+        # from the committed offset (bit-exact result proves no bytes
+        # were double-applied or lost)
+        from horovod_tpu.native.store import StoreServer
+        inject.install(ChaosPlan.from_json(
+            '{"seed": 9, "faults": [{"rank": 0, "site": "p2p.send", '
+            '"kind": "conn_reset", "peer": 1, "at": 1}]}'),
+            rank=0, epoch=0)
+        with StoreServer() as srv:
+            def body(r, rc, out):
+                rng = np.random.default_rng(42 + r)
+                a = rng.integers(0, 255, size=3 << 20,
+                                 dtype=np.uint8).astype(np.float32)
+                out[(r, "sum")] = rc.allreduce(a)
+                rc.barrier()
+
+            out = self._ring_pair(srv.port, "big", body)
+        ra = np.random.default_rng(42).integers(
+            0, 255, size=3 << 20, dtype=np.uint8).astype(np.float32)
+        rb = np.random.default_rng(43).integers(
+            0, 255, size=3 << 20, dtype=np.uint8).astype(np.float32)
+        np.testing.assert_array_equal(out[(0, "sum")], out[(1, "sum")])
+        np.testing.assert_allclose(out[(0, "sum")], ra + rb)
+
+    def test_jitter_is_pure_latency(self):
+        from horovod_tpu.native.store import StoreServer
+        inject.install(ChaosPlan.from_json(
+            '{"seed": 2, "faults": [{"rank": 0, "site": "p2p.send", '
+            '"kind": "jitter", "seconds": 0.05, "after": 0, '
+            '"until": 10}]}'), rank=0, epoch=0)
+        with StoreServer() as srv:
+            def body(r, rc, out):
+                a = np.full(64, r + 1.0, np.float32)
+                out[r] = rc.allreduce(a)
+                rc.barrier()
+
+            out = self._ring_pair(srv.port, "jit", body)
+        np.testing.assert_array_equal(out[0], np.full(64, 3.0,
+                                                      np.float32))
+        fired = [e for e in inject.injector().fired
+                 if e["kind"] == "jitter"]
+        assert fired, "jitter never fired"
+
+
+# --------------------------------------------------------------------------
+# redist: retryable blips retry in place before the fallback vote
+# --------------------------------------------------------------------------
+
+@needs_native
+class TestRedistRetryInPlace:
+    def test_coord_transport_absorbs_conn_reset(self):
+        from horovod_tpu.native.store import Coordinator, StoreServer
+        from horovod_tpu.redist.transport import CoordTransport
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "redist.transport", '
+            '"kind": "conn_reset", "at": 0}]}'), rank=0, epoch=0)
+        base = _retry_count(site="redist.transport", outcome="absorbed")
+        with StoreServer() as srv:
+            res = {}
+
+            def member(r):
+                co = Coordinator("127.0.0.1", srv.port, r, 2,
+                                 timeout=20.0)
+                tr = CoordTransport(co)
+                res[r] = tr.exchange({1 - r: f"pay{r}".encode()},
+                                     tag="rt")
+                co.close()
+
+            ts = [threading.Thread(target=member, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+        assert res[0] == {1: b"pay1"}
+        assert res[1] == {0: b"pay0"}
+        assert _retry_count(site="redist.transport",
+                            outcome="absorbed") == base + 1
+
+    def test_drop_still_raises_for_the_fallback_vote(self):
+        from horovod_tpu.redist.plan import RedistError
+        from horovod_tpu.redist.transport import chaos_gate
+        inject.install(ChaosPlan.from_json(
+            '{"faults": [{"rank": 0, "site": "redist.transport", '
+            '"kind": "drop", "at": 0}]}'), rank=0, epoch=0)
+        with pytest.raises(RedistError) as ei:
+            chaos_gate({0: b"x"})
+        assert not getattr(ei.value, "retryable", False)
+
+
+# --------------------------------------------------------------------------
+# transient soak verdict core (synthetic logs)
+# --------------------------------------------------------------------------
+
+class TestTransientEvaluate:
+    def _write(self, out_dir, rank, events):
+        with open(os.path.join(out_dir, f"events.{rank}.jsonl"),
+                  "a") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+    def _green_logs(self, out_dir, np_, steps, hash_):
+        t0 = 100.0
+        for r in range(np_):
+            evs = [{"kind": "step", "rank": r, "epoch": 0, "step": s,
+                    "t": t0 + 0.05 * s} for s in range(1, steps + 1)]
+            evs.append({"kind": "netstats", "rank": r, "epoch": 0,
+                        "retries": 2 if r == 0 else 0,
+                        "reconnects": 2 if r == 0 else 0,
+                        "elastic_resets": 0, "t": t0 + 10})
+            self._write(out_dir, r, evs)
+            with open(os.path.join(out_dir, f"final.{r}.json"),
+                      "w") as f:
+                json.dump({"rank": r, "step": steps, "hash": hash_,
+                           "epoch": 0}, f)
+
+    def test_green_verdict(self, tmp_path):
+        from horovod_tpu.chaos.soak import (_fault_free_final_hash,
+                                            evaluate_transient)
+        plan = ChaosPlan.from_dict({"faults": []})
+        self._green_logs(str(tmp_path), 2, 3,
+                         _fault_free_final_hash(2, 3))
+        v = evaluate_transient(str(tmp_path), plan, np_=2, steps=3)
+        assert v["zero_resets"] is True
+        assert v["params_bit_identical_to_fault_free"] is True
+        assert v["retries_absorbed"] and v["net_retries_total"] == 2
+        assert v["step_time_bounded"] is True
+
+    def test_red_on_divergent_hash(self, tmp_path):
+        from horovod_tpu.chaos.soak import evaluate_transient
+        plan = ChaosPlan.from_dict({"faults": []})
+        self._green_logs(str(tmp_path), 2, 3, "deadbeefdeadbeef")
+        v = evaluate_transient(str(tmp_path), plan, np_=2, steps=3)
+        assert v["params_bit_identical_to_fault_free"] is False
+
+    def test_red_on_any_reset(self, tmp_path):
+        from horovod_tpu.chaos.soak import (_fault_free_final_hash,
+                                            evaluate_transient)
+        plan = ChaosPlan.from_dict({"faults": []})
+        self._green_logs(str(tmp_path), 2, 3,
+                         _fault_free_final_hash(2, 3))
+        self._write(str(tmp_path), 0, [{"kind": "resume", "rank": 0,
+                                        "epoch": 1, "step": 2,
+                                        "t": 105.0}])
+        v = evaluate_transient(str(tmp_path), plan, np_=2, steps=3)
+        assert v["zero_resets"] is False
+
+    def test_red_when_nothing_absorbed(self, tmp_path):
+        # a transient run where the ladder never fired did not exercise
+        # what it claims to prove — fail, don't skip
+        from horovod_tpu.chaos.soak import (_fault_free_final_hash,
+                                            evaluate_transient)
+        plan = ChaosPlan.from_dict({"faults": []})
+        t0 = 100.0
+        for r in range(2):
+            self._write(str(tmp_path), r, [
+                {"kind": "step", "rank": r, "epoch": 0, "step": 1,
+                 "t": t0},
+                {"kind": "netstats", "rank": r, "epoch": 0,
+                 "retries": 0, "reconnects": 0, "elastic_resets": 0,
+                 "t": t0 + 1}])
+            with open(os.path.join(str(tmp_path), f"final.{r}.json"),
+                      "w") as f:
+                json.dump({"rank": r, "step": 3,
+                           "hash": _fault_free_final_hash(2, 3),
+                           "epoch": 0}, f)
+        v = evaluate_transient(str(tmp_path), plan, np_=2, steps=3)
+        assert v["retries_absorbed"] is False
+
+    def test_ring_reference_matches_plain_sum_shape(self):
+        from horovod_tpu.chaos.soak import _ring_allreduce_reference
+        arrs = [np.arange(13, dtype=np.float32) * (r + 1)
+                for r in range(4)]
+        out = _ring_allreduce_reference(arrs)
+        np.testing.assert_allclose(out, np.arange(13,
+                                                  dtype=np.float32) * 10)
+
+
+# --------------------------------------------------------------------------
+# lint: no unwrapped fatal socket path can sneak into native/
+# --------------------------------------------------------------------------
+
+_EXC_PAT = re.compile(
+    r"except\s+(\(?[\w.\s,]*\b(OSError|ConnectionError|socket\.error|"
+    r"socket\.timeout)\b)")
+#: evidence the handler routes through the resilience plane: the
+#: classifier / a classified retryable raise / an explicit, justified
+#: exemption marker
+_ROUTED_TOKENS = ("resilience", "P2PConnError", "NativeConnError",
+                  "_transient(")
+
+
+def test_native_socket_error_paths_route_through_resilience():
+    """Every ``except OSError``/``socket.*`` in horovod_tpu/native/
+    must either route through the resilience classifier (raise a
+    classified Conn error, consult is_retryable/_transient) or carry
+    an explicit ``# resilience: exempt (<reason>)`` marker — no future
+    unwrapped fatal wire path can sneak in."""
+    native_dir = os.path.join(REPO, "horovod_tpu", "native")
+    offenders = []
+    for fn in sorted(os.listdir(native_dir)):
+        if not fn.endswith(".py"):
+            continue
+        lines = open(os.path.join(native_dir, fn)).read().splitlines()
+        for i, ln in enumerate(lines):
+            if not _EXC_PAT.search(ln):
+                continue
+            window = "\n".join(lines[i:i + 6])
+            if not any(tok in window for tok in _ROUTED_TOKENS):
+                offenders.append(f"{fn}:{i + 1}: {ln.strip()}")
+    assert not offenders, (
+        "unclassified socket-error handler(s) in native/ — route them "
+        "through native/resilience.py (raise NativeConnError/"
+        "P2PConnError or consult is_retryable) or mark "
+        "'# resilience: exempt (<reason>)':\n" + "\n".join(offenders))

@@ -4,12 +4,16 @@ plan and print the JSON verdict (exit 0 iff every invariant held).
 
     python tools/soak.py --np 4 --seed 7 --steps 10 --plan random
     python tools/soak.py --np 4 --plan my_plan.json --out /tmp/soak1
+    python tools/soak.py --np 4 --seed 7 --profile transient
 
 The verdict (stdout, one JSON object) carries the evidence for each
-invariant: detector_named_dead (+ per-survivor detection_s),
-recovery_s/recovery_bounded, replica_restore, params_bit_identical,
-no_deadlock, plus the resolved plan itself for reproduction. See
-docs/chaos.md for recipes.
+invariant. ``--profile train`` (default): detector_named_dead (+
+per-survivor detection_s), recovery_s/recovery_bounded,
+replica_restore, params_bit_identical, no_deadlock. ``--profile
+transient`` (blips only — the retry-ladder bar): zero_resets,
+params_bit_identical_to_fault_free, net_retries_total > 0,
+step_time_bounded. Plus the resolved plan itself for reproduction.
+See docs/chaos.md for recipes.
 """
 import argparse
 import json
@@ -31,6 +35,12 @@ def main(argv=None) -> int:
                    help="training steps to complete (default 10)")
     p.add_argument("--plan", default="random",
                    help="'random' (seeded) or a path to a plan JSON")
+    p.add_argument("--profile", default="train",
+                   choices=("train", "transient"),
+                   help="random-plan profile: 'train' = the PR 5 "
+                        "persistent-fault scenario (crash + shard "
+                        "delete); 'transient' = blips only, asserting "
+                        "zero elastic resets")
     p.add_argument("--commit-every", type=int, default=2,
                    help="commit cadence in steps (default 2)")
     p.add_argument("--out", default=None,
@@ -47,6 +57,7 @@ def main(argv=None) -> int:
         out, np_=args.np_, seed=args.seed, steps=args.steps,
         commit_every=args.commit_every,
         plan=None if args.plan == "random" else args.plan,
+        profile=args.profile,
         timeout_s=args.timeout, recovery_bound_s=args.recovery_bound)
     json.dump(verdict, sys.stdout, indent=2, sort_keys=True)
     print()
