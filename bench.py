@@ -18,9 +18,13 @@ inception3 — the reference's full headline scaling trio
 obs registry's histogram into the summary line and prints the end-of-run
 registry snapshot as a second JSON line (docs/metrics.md).
 
-`--serve` runs the continuous-batching loopback benchmark,
+`--serve` runs the serving ACCEPTANCE GATE (slotted vs paged+prefix vs
+speculative over a shared-system-prompt overload burst; bit-identity,
+>=1.5x paged speedup, token-bounded KV, TTFT/jit-flat/spec bars all
+asserted — exit nonzero on violation, docs/serving.md),
 `--serve-soak` the chaos-hardened fleet soak (serve_p99_under_fault_ms
-+ failover_ms from a seeded crash/partition/corrupt/slow incident —
++ failover_ms from a seeded crash/partition/corrupt/slow incident,
+now paged+prefix+speculative by default —
 docs/serving.md), `--ckpt`
 the checkpoint-plane loopback (ckpt_save_ms / ckpt_blocking_ms /
 ckpt_restore_ms — docs/checkpoint.md), `--collectives` the
@@ -276,13 +280,38 @@ def run_serve_soak_benchmark() -> int:
 
 
 def run_serve_benchmark() -> int:
-    """Loopback serving benchmark (`bench.py --serve`): drive the
-    continuous batcher (horovod_tpu/serve) over a tiny GPT decoder with
-    synthetic requests and print TWO JSON metric lines —
-    serve_tokens_per_s (aggregate decode throughput) and serve_p50_ms
-    (median request latency, submit -> resolve). No network, no engine:
-    this measures the scheduler + jitted decode step, the serving
-    analog of the synthetic img/sec harness above."""
+    """Serving acceptance GATE (`bench.py --serve`): the ROADMAP item 2
+    bars, asserted — not just reported. One workload (a long shared
+    system prompt + short unique tails, submitted as a 2x-overload
+    burst) is driven through three configurations of the continuous
+    batcher over one tiny GPT decoder:
+
+      slotted            the PR 2 baseline layout (slots x max_len)
+      paged+prefix       HOROVOD_SERVE_KV_BLOCK + _PREFIX_CACHE on
+      paged+prefix+spec  ... + HOROVOD_SERVE_SPEC_K (drafter attached)
+
+    and the gate asserts (exit nonzero on any violation, each verdict
+    printed as a JSON line):
+
+      * bit-identical output: every configuration emits exactly the
+        slotted greedy baseline's tokens (same tokens, same stops);
+      * speedup: paged+prefix tokens/s >= 1.5x slotted on this
+        shared-prefix workload;
+      * tokens/s floor: the full configuration sustains >=
+        HVD_BENCH_SERVE_TOKS_BAR tok/s per chip;
+      * memory: peak KV tokens RESIDENT in the paged pool stay under
+        a bound computed from tokens actually touched — and under the
+        slotted layout's slots x max_len worst case (which the paged
+        pool is provisioned below by construction);
+      * p99 TTFT under the 2x-overload burst <=
+        HVD_BENCH_SERVE_TTFT_P99_MS, with zero expiries/errors;
+      * jit-cache-flat: the admission churn of the overload burst adds
+        zero compiled programs after warmup in every configuration;
+      * speculation: < 0.7 target-model steps per generated token
+        (machine-independent), acceptance rate exported via obs.
+
+    Keeps emitting serve_tokens_per_s / serve_p50_ms (now for the full
+    configuration) so the bench trajectory stays comparable."""
     import numpy as np
 
     try:
@@ -291,43 +320,146 @@ def run_serve_benchmark() -> int:
 
         from horovod_tpu.core.config import Config
         from horovod_tpu.models.gpt import GPT, GPTConfig
+        from horovod_tpu.obs import metrics as obs_metrics
         from horovod_tpu.serve import (AdmissionQueue, ContinuousBatcher,
                                        ShardedExecutor)
 
         cfg = Config.from_env()
         platform = jax.devices()[0].platform
         n_req = int(os.environ.get("HVD_BENCH_SERVE_REQUESTS", "32"))
-        prompt_len, max_new = 8, 16
-        model_cfg = GPTConfig(
-            vocab_size=256, num_layers=2, num_heads=4, head_dim=16,
-            max_seq_len=128, decode=True,
-            dtype=jnp.bfloat16 if platform == "tpu" else jnp.float32,
-            attention_impl=None if platform == "tpu" else "reference")
-        model = GPT(model_cfg)
-        toks = jnp.zeros((2, prompt_len), jnp.int32)
-        params = model.init(jax.random.PRNGKey(0), toks,
-                            positions=jnp.zeros((2,), jnp.int32),
-                            update_mask=jnp.zeros((2,), bool))["params"]
-        ex = ShardedExecutor(model, params,
-                             max_batch=cfg.serve_max_batch,
-                             max_len=model_cfg.max_seq_len)
-        queue = AdmissionQueue(max_queue=max(cfg.serve_max_queue, n_req),
-                               default_deadline_ms=cfg.serve_deadline_ms)
-        batcher = ContinuousBatcher(ex, queue, buckets=(16, 32))
-        batcher.warmup()
+        toks_bar = float(os.environ.get("HVD_BENCH_SERVE_TOKS_BAR", "25"))
+        ttft_bar_ms = float(os.environ.get(
+            "HVD_BENCH_SERVE_TTFT_P99_MS", "5000"))
+        max_batch = cfg.serve_max_batch
+        # prefill-dominated on purpose: the speedup under test is
+        # "shared system prompts computed once", so the workload keeps
+        # the generation tail short and the shared prefix long
+        sys_len, tail_max, max_new, spec_k = 160, 8, 4, 3
+        max_len = 192
+        buckets = (8, 168)
+        # the three knobs ARE the configuration under test: block size
+        # from HOROVOD_SERVE_KV_BLOCK (default 8 for the tiny bench
+        # model), spec depth from HOROVOD_SERVE_SPEC_K, prefix cache
+        # forced on for the paged phases
+        block = cfg.serve_kv_block or 8
+        spec_k = cfg.serve_spec_k or spec_k
+        from horovod_tpu.serve import pool_blocks_for
+        pool_blocks = pool_blocks_for(cfg.serve_max_batch, max_len,
+                                      block)
+        kw = dict(vocab_size=256, num_layers=2, num_heads=4, head_dim=16,
+                  max_seq_len=max_len,
+                  dtype=jnp.bfloat16 if platform == "tpu" else jnp.float32,
+                  attention_impl=None if platform == "tpu" else "reference")
+        params = GPT(GPTConfig(**kw)).init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
+
+        # the workload: one long system prompt shared by every request,
+        # each with a short unique tail — the shape the radix cache is
+        # for. The PRIME request warms the prefix cache (production
+        # serves a standing system prompt); the burst is 2x-overload
+        # high concurrency: all n_req land at once on max_batch rows.
         rng = np.random.RandomState(0)
-        t0 = time.perf_counter()
-        handles = [queue.submit(list(rng.randint(0, 256, prompt_len)),
-                                max_new_tokens=max_new)
+        system = list(rng.randint(0, 256, sys_len))
+        prompts = [system + list(rng.randint(0, 256,
+                                             rng.randint(4, tail_max + 1)))
                    for _ in range(n_req)]
-        batcher.run()
-        wall = time.perf_counter() - t0
-        tokens = sum(len(h.tokens) for h in handles if h.status == "ok")
-        lat = sorted(h.latency_ms for h in handles
-                     if h.latency_ms is not None)
+        prime = system + list(rng.randint(0, 256, tail_max))
+
+        def drive(paged, prefix, spec):
+            mcfg = GPTConfig(decode=True, **kw,
+                             kv_block_size=block if paged else 0,
+                             kv_pool_blocks=pool_blocks if paged else 0)
+            ex = ShardedExecutor(GPT(mcfg), params, max_batch=max_batch,
+                                 max_len=max_len)
+            draft = None
+            if spec:
+                draft = ShardedExecutor(
+                    GPT(GPTConfig(decode=True, **kw)), params,
+                    max_batch=max_batch, max_len=max_len, role="draft")
+            q = AdmissionQueue(max_queue=max(cfg.serve_max_queue,
+                                             n_req + 1),
+                               default_deadline_ms=cfg.serve_deadline_ms)
+            b = ContinuousBatcher(ex, q, buckets=buckets,
+                                  prefix_cache=prefix,
+                                  draft_executor=draft, spec_k=spec_k)
+            b.warmup()
+            jit0 = ex.jit_cache_size()
+            q.submit(prime, max_new_tokens=max_new)
+            b.run()                      # prime: publishes the prefix run
+            # best-of-2 bursts: one shared-machine hiccup must not turn
+            # a real 2x layout win into a flaky gate verdict
+            wall, handles = None, None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                hs = [q.submit(p, max_new_tokens=max_new)
+                      for p in prompts]
+                b.run()
+                dt = time.perf_counter() - t0
+                bad = [h.status for h in hs if h.status != "ok"]
+                if bad:
+                    raise RuntimeError(
+                        f"burst requests failed under the gate: {bad[:5]}")
+                if wall is None or dt < wall:
+                    wall = dt
+                if handles is None:
+                    handles = hs
+            ttft = obs_metrics.get_registry().get("hvd_serve_ttft_ms")
+            return {
+                "tokens": [h.tokens for h in handles],
+                "tok_s": sum(len(h.tokens) for h in handles) / wall,
+                "p50_ms": sorted(h.latency_ms for h in handles)[
+                    len(handles) // 2],
+                "ttft_p99_ms": (ttft.percentile(0.99)
+                                if ttft is not None and ttft.count
+                                else None),
+                "jit_flat": ex.jit_cache_size() == jit0,
+                "peak_tokens": (b.kv.pool.peak_in_use * block
+                                if paged else max_batch * max_len),
+                "prefix_hits": b.prefix.hits if b.prefix else 0,
+                "tokens_saved": (b.prefix.tokens_saved
+                                 if b.prefix else 0),
+                "steps_per_token": (b.gen_steps / b.gen_tokens
+                                    if b.gen_tokens else None),
+            }
+
+        slotted = drive(False, False, False)
+        paged = drive(True, True, False)
+        full = drive(True, True, True)
+
+        accept = obs_metrics.get_registry().get(
+            "hvd_serve_spec_accept_rate")
+        speedup = paged["tok_s"] / slotted["tok_s"]
+        # tokens-resident bound: the shared prefix run plus each row's
+        # private tail+generation+speculative-margin blocks, with 1.5x
+        # slack for re-prefills/CoW — far under slots x max_len
+        bs = block
+        per_row = -(-(tail_max + max_new + spec_k + 1) // bs) + 1
+        token_bound = 1.5 * ((-(-len(prime) // bs)) * bs
+                             + max_batch * per_row * bs)
+        slot_bound = max_batch * max_len
+        gates = {
+            "bit_identical_paged": paged["tokens"] == slotted["tokens"],
+            "bit_identical_spec": full["tokens"] == slotted["tokens"],
+            "speedup_ge_1p5": speedup >= 1.5,
+            "tokens_per_s_ge_bar": full["tok_s"] >= toks_bar,
+            "kv_peak_bounded_by_tokens":
+                paged["peak_tokens"] <= token_bound < slot_bound
+                and full["peak_tokens"] <= token_bound,
+            "ttft_p99_under_2x_overload":
+                full["ttft_p99_ms"] is not None
+                and full["ttft_p99_ms"] <= ttft_bar_ms,
+            "jit_cache_flat": (slotted["jit_flat"] and paged["jit_flat"]
+                               and full["jit_flat"]),
+            "spec_steps_per_token_lt_0p7":
+                full["steps_per_token"] is not None
+                and full["steps_per_token"] < 0.7,
+            "spec_accept_rate_exported":
+                accept is not None and accept.count > 0,
+        }
         common = {"platform": platform, "requests": n_req,
-                  "max_batch": cfg.serve_max_batch,
-                  "prompt_len": prompt_len, "max_new_tokens": max_new}
+                  "max_batch": max_batch, "system_prompt_len": sys_len,
+                  "max_new_tokens": max_new, "spec_k": spec_k,
+                  "kv_block": block, "kv_pool_blocks": pool_blocks}
         if os.environ.get("HVD_BENCH_METRICS") == "1":
             from horovod_tpu import obs
             hist = obs.get_registry().get("hvd_serve_step_ms",
@@ -340,12 +472,42 @@ def run_serve_benchmark() -> int:
                   flush=True)
         print(json.dumps({
             "metric": "serve_tokens_per_s",
-            "value": round(tokens / wall, 2), "unit": "tok/s",
+            "value": round(full["tok_s"], 2), "unit": "tok/s",
+            "slotted_tokens_per_s": round(slotted["tok_s"], 2),
+            "paged_prefix_tokens_per_s": round(paged["tok_s"], 2),
             **common}), flush=True)
         print(json.dumps({
             "metric": "serve_p50_ms",
-            "value": round(lat[len(lat) // 2], 2) if lat else None,
-            "unit": "ms", **common}), flush=True)
+            "value": round(full["p50_ms"], 2), "unit": "ms",
+            **common}), flush=True)
+        print(json.dumps({
+            "metric": "serve_paged_speedup",
+            "value": round(speedup, 3), "unit": "x", "bar": 1.5,
+            "prefix_hits": paged["prefix_hits"],
+            "prefix_tokens_saved": paged["tokens_saved"],
+            **common}), flush=True)
+        print(json.dumps({
+            "metric": "serve_kv_peak_tokens",
+            "value": paged["peak_tokens"], "unit": "tokens",
+            "token_bound": int(token_bound),
+            "slots_x_max_len": slot_bound, **common}), flush=True)
+        print(json.dumps({
+            "metric": "serve_ttft_p99_ms",
+            "value": (None if full["ttft_p99_ms"] is None
+                      else round(full["ttft_p99_ms"], 1)),
+            "unit": "ms", "bar": ttft_bar_ms, **common}), flush=True)
+        print(json.dumps({
+            "metric": "serve_spec_steps_per_token",
+            "value": (None if full["steps_per_token"] is None
+                      else round(full["steps_per_token"], 3)),
+            "unit": "steps/tok", "bar": 0.7,
+            "accept_rate_samples": int(accept.count) if accept else 0,
+            **common}), flush=True)
+        print(json.dumps({"metric": "serve_gate",
+                          "value": all(gates.values()),
+                          "gates": gates, **common}), flush=True)
+        if not all(gates.values()):
+            return 1
         return 0
     except Exception as e:  # noqa: BLE001 — structured error, no traceback
         for metric, unit in (("serve_tokens_per_s", "tok/s"),

@@ -167,6 +167,27 @@ class Config:
     # device->host readback per step plus one prefix readback per
     # retiring request. Off by default; the serving soak forces it on.
     serve_kv_crc: bool = False
+    # Paged KV block size in tokens (HOROVOD_SERVE_KV_BLOCK): 0 keeps
+    # the slotted [slots, max_seq_len] cache layout; > 0 switches
+    # decode-mode models to vLLM-style block-pool storage
+    # (serve/kv_cache.py BlockPool/PagedKVCache) where occupancy is
+    # bounded by tokens resident, not slots x max_seq_len. The model
+    # config (kv_block_size/kv_pool_blocks) is what actually shapes the
+    # device arrays; this knob is the serving default the helpers read.
+    serve_kv_block: int = 0
+    # Radix prefix cache over prompt token ids (HOROVOD_SERVE_PREFIX_
+    # CACHE): shared system prompts map to refcounted read-only block
+    # runs, so a cached prefix copies block references instead of
+    # recomputing attention. Paged-only (the slotted layout has no
+    # shareable unit); flushed on every weight-version swap.
+    serve_prefix_cache: bool = True
+    # Speculative decoding draft depth (HOROVOD_SERVE_SPEC_K): with a
+    # draft executor attached, the drafter proposes up to this many
+    # tokens per iteration and the target verifies them in ONE
+    # [max_batch, spec_k+1] step — emitted tokens stay bit-identical
+    # to target-only greedy decode. 0 disables speculation even when a
+    # drafter is wired up.
+    serve_spec_k: int = 3
     # Checkpoint plane (horovod_tpu/ckpt): max in-flight async host
     # snapshots — save() backpressures beyond this bound
     # (HOROVOD_CKPT_SNAPSHOT_DEPTH; 2 = classic double buffering).
@@ -316,6 +337,12 @@ class Config:
                     f"list of ints; got {raw_buckets!r}")
         c.serve_kv_crc = _env_bool("HOROVOD_SERVE_KV_CRC",
                                    c.serve_kv_crc)
+        c.serve_kv_block = _env_int_strict(
+            "HOROVOD_SERVE_KV_BLOCK", c.serve_kv_block)
+        c.serve_prefix_cache = _env_bool(
+            "HOROVOD_SERVE_PREFIX_CACHE", c.serve_prefix_cache)
+        c.serve_spec_k = _env_int_strict(
+            "HOROVOD_SERVE_SPEC_K", c.serve_spec_k)
         # Ckpt knobs parse strictly (the PR 1-3 convention): a typo'd
         # depth/retention must fail at startup, not silently fall back
         # and change durability semantics mid-job.
@@ -447,6 +474,23 @@ class Config:
             raise ValueError(
                 f"HOROVOD_SERVE_KV_CRC must be a boolean; got "
                 f"{self.serve_kv_crc!r}")
+        kb = self.serve_kv_block
+        if not isinstance(kb, int) or not (0 <= kb <= 4096):
+            raise ValueError(
+                f"HOROVOD_SERVE_KV_BLOCK must be an int in [0, 4096] "
+                f"tokens (0 keeps the slotted layout; the block size "
+                f"shapes the device pool, so a typo here would change "
+                f"every compiled serving program); got {kb!r}")
+        if not isinstance(self.serve_prefix_cache, bool):
+            raise ValueError(
+                f"HOROVOD_SERVE_PREFIX_CACHE must be a boolean; got "
+                f"{self.serve_prefix_cache!r}")
+        sk = self.serve_spec_k
+        if not isinstance(sk, int) or not (0 <= sk <= 64):
+            raise ValueError(
+                f"HOROVOD_SERVE_SPEC_K must be an int in [0, 64] (the "
+                f"verify step's shape is [max_batch, spec_k+1] — it "
+                f"joins the precompiled bucket set); got {sk!r}")
         mp = self.metrics_port
         if not isinstance(mp, int) or not (0 <= mp <= 65535):
             raise ValueError(

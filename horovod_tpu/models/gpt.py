@@ -32,12 +32,20 @@ class GPTConfig:
                  attention_impl: Optional[str] = None,
                  remat: bool = False,
                  logits_dtype=jnp.float32,
-                 decode: bool = False):
+                 decode: bool = False,
+                 kv_block_size: int = 0,
+                 kv_pool_blocks: int = 0):
         if decode and attention != "dense":
             raise ValueError(
                 f"decode mode supports attention='dense' only (got "
                 f"{attention!r}); sequence parallelism shards the axis "
                 "the KV cache grows along")
+        if kv_block_size and not decode:
+            raise ValueError("kv_block_size is a decode-mode knob")
+        if kv_block_size and kv_pool_blocks < 1:
+            raise ValueError(
+                "paged decode (kv_block_size > 0) needs kv_pool_blocks "
+                ">= 1 — the device pool shape is static")
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -70,6 +78,13 @@ class GPTConfig:
         #: per-row `positions` + `update_mask` at fixed [slots, T]
         #: shapes — the serving executor's no-recompile contract
         self.decode = decode
+        #: paged decode: cache blocks of this many tokens in a pool of
+        #: kv_pool_blocks (serve/kv_cache.py write_kv_paged), addressed
+        #: by per-row block tables passed to __call__ — occupancy is
+        #: bounded by tokens resident, not slots x max_seq_len. 0 keeps
+        #: the slotted layout.
+        self.kv_block_size = kv_block_size
+        self.kv_pool_blocks = kv_pool_blocks
 
 
 class Attention(nn.Module):
@@ -79,7 +94,8 @@ class Attention(nn.Module):
     causal: bool = True
 
     @nn.compact
-    def __call__(self, x, positions=None, update_mask=None):
+    def __call__(self, x, positions=None, update_mask=None,
+                 block_tables=None):
         cfg = self.cfg
         B, S, _ = x.shape
         qkv = nn.Dense(3 * cfg.embed_dim, dtype=cfg.dtype,
@@ -90,23 +106,44 @@ class Attention(nn.Module):
         # predate the decode flag
         if getattr(cfg, "decode", False):
             # serving path: write the S new tokens' K/V into this
-            # layer's slotted cache at each row's offset, then attend
-            # over the cached prefix (horovod_tpu/serve/kv_cache.py).
-            # Same qkv/out params as training — the cache lives in the
-            # separate "cache" collection.
+            # layer's cache at each row's offset, then attend over the
+            # cached prefix (horovod_tpu/serve/kv_cache.py). Same
+            # qkv/out params as training — the cache lives in the
+            # separate "cache" collection. Paged configs store a block
+            # POOL addressed through per-row block tables; slotted ones
+            # a [slots, max_seq_len] row per sequence.
             from ..serve import kv_cache as kvc
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
-            ck = self.variable(
-                "cache", "k", jnp.zeros,
-                (B, cfg.max_seq_len, cfg.num_heads, cfg.head_dim),
-                cfg.dtype)
-            cv = self.variable(
-                "cache", "v", jnp.zeros,
-                (B, cfg.max_seq_len, cfg.num_heads, cfg.head_dim),
-                cfg.dtype)
-            ck.value, cv.value = kvc.write_kv(
-                ck.value, cv.value, k, v, positions, update_mask)
-            o = kvc.cached_attention(q, ck.value, cv.value, positions)
+            if getattr(cfg, "kv_block_size", 0):
+                if block_tables is None:
+                    raise ValueError(
+                        "paged decode needs per-row `block_tables` "
+                        "(see horovod_tpu/serve/executor.py)")
+                ck = self.variable(
+                    "cache", "k", jnp.zeros,
+                    (cfg.kv_pool_blocks, cfg.kv_block_size,
+                     cfg.num_heads, cfg.head_dim), cfg.dtype)
+                cv = self.variable(
+                    "cache", "v", jnp.zeros,
+                    (cfg.kv_pool_blocks, cfg.kv_block_size,
+                     cfg.num_heads, cfg.head_dim), cfg.dtype)
+                ck.value, cv.value = kvc.write_kv_paged(
+                    ck.value, cv.value, k, v, positions, update_mask,
+                    block_tables)
+                o = kvc.paged_attention(q, ck.value, cv.value,
+                                        block_tables, positions)
+            else:
+                ck = self.variable(
+                    "cache", "k", jnp.zeros,
+                    (B, cfg.max_seq_len, cfg.num_heads, cfg.head_dim),
+                    cfg.dtype)
+                cv = self.variable(
+                    "cache", "v", jnp.zeros,
+                    (B, cfg.max_seq_len, cfg.num_heads, cfg.head_dim),
+                    cfg.dtype)
+                ck.value, cv.value = kvc.write_kv(
+                    ck.value, cv.value, k, v, positions, update_mask)
+                o = kvc.cached_attention(q, ck.value, cv.value, positions)
             o = o.reshape(B, S, cfg.embed_dim)
             return nn.Dense(cfg.embed_dim, dtype=cfg.dtype,
                             param_dtype=jnp.float32, name="out")(o)
@@ -158,11 +195,13 @@ class Block(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, x, positions=None, update_mask=None):
+    def __call__(self, x, positions=None, update_mask=None,
+                 block_tables=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + Attention(cfg, name="attn")(h, positions=positions,
-                                            update_mask=update_mask)
+                                            update_mask=update_mask,
+                                            block_tables=block_tables)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         return x + MLP(cfg, name="mlp")(h)
 
@@ -171,7 +210,8 @@ class GPT(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, tokens, positions=None, update_mask=None):
+    def __call__(self, tokens, positions=None, update_mask=None,
+                 block_tables=None):
         cfg = self.cfg
         B, S = tokens.shape
         if cfg.decode and (positions is None or update_mask is None):
@@ -201,7 +241,8 @@ class GPT(nn.Module):
         block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layers_{i}")(
-                x, positions=positions, update_mask=update_mask)
+                x, positions=positions, update_mask=update_mask,
+                block_tables=block_tables)
         if zig:
             x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

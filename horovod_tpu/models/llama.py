@@ -43,12 +43,20 @@ class LlamaConfig:
                  attention_impl: Optional[str] = None,
                  remat: bool = False,
                  logits_dtype=jnp.float32,
-                 decode: bool = False):
+                 decode: bool = False,
+                 kv_block_size: int = 0,
+                 kv_pool_blocks: int = 0):
         if decode and attention != "dense":
             raise ValueError(
                 f"decode mode supports attention='dense' only (got "
                 f"{attention!r}); sequence parallelism shards the axis "
                 "the KV cache grows along")
+        if kv_block_size and not decode:
+            raise ValueError("kv_block_size is a decode-mode knob")
+        if kv_block_size and kv_pool_blocks < 1:
+            raise ValueError(
+                "paged decode (kv_block_size > 0) needs kv_pool_blocks "
+                ">= 1 — the device pool shape is static")
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -85,6 +93,11 @@ class LlamaConfig:
         #: straight into the cache) and __call__ takes per-row
         #: `positions` + `update_mask` at fixed [slots, T] shapes
         self.decode = decode
+        #: paged decode (see GPTConfig.kv_block_size): block-pool cache
+        #: at kv width, addressed by per-row block tables — GQA's HBM
+        #: saving compounds with token-bounded occupancy
+        self.kv_block_size = kv_block_size
+        self.kv_pool_blocks = kv_pool_blocks
 
 
 def _round_up(x: int, m: int) -> int:
@@ -138,7 +151,8 @@ class LlamaAttention(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, x, positions=None, update_mask=None):
+    def __call__(self, x, positions=None, update_mask=None,
+                 block_tables=None):
         cfg = self.cfg
         B, S, _ = x.shape
         H, KV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -151,22 +165,43 @@ class LlamaAttention(nn.Module):
         if cfg.decode:
             # serving path: rotate the S new tokens by each row's
             # absolute positions, write K/V (kv width — GQA) into this
-            # layer's slotted cache, attend over the cached prefix
+            # layer's cache, attend over the cached prefix
             # (horovod_tpu/serve/kv_cache.py). Keys are cached
-            # post-RoPE, the standard absolute-rotation layout.
+            # post-RoPE, the standard absolute-rotation layout (which
+            # is also what makes a cached shared-prefix block reusable
+            # verbatim across sequences: the rotation is absolute).
             from ..serve import kv_cache as kvc
             table = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
             win = table[positions[:, None] + jnp.arange(S)[None, :]]
             q = apply_rope(q.transpose(0, 2, 1, 3), win)
             k = apply_rope(k.transpose(0, 2, 1, 3), win)
             q, k = q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3)
-            ck = self.variable("cache", "k", jnp.zeros,
-                               (B, cfg.max_seq_len, KV, D), cfg.dtype)
-            cv = self.variable("cache", "v", jnp.zeros,
-                               (B, cfg.max_seq_len, KV, D), cfg.dtype)
-            ck.value, cv.value = kvc.write_kv(
-                ck.value, cv.value, k, v, positions, update_mask)
-            o = kvc.cached_attention(q, ck.value, cv.value, positions)
+            if cfg.kv_block_size:
+                if block_tables is None:
+                    raise ValueError(
+                        "paged decode needs per-row `block_tables` "
+                        "(see horovod_tpu/serve/executor.py)")
+                ck = self.variable(
+                    "cache", "k", jnp.zeros,
+                    (cfg.kv_pool_blocks, cfg.kv_block_size, KV, D),
+                    cfg.dtype)
+                cv = self.variable(
+                    "cache", "v", jnp.zeros,
+                    (cfg.kv_pool_blocks, cfg.kv_block_size, KV, D),
+                    cfg.dtype)
+                ck.value, cv.value = kvc.write_kv_paged(
+                    ck.value, cv.value, k, v, positions, update_mask,
+                    block_tables)
+                o = kvc.paged_attention(q, ck.value, cv.value,
+                                        block_tables, positions)
+            else:
+                ck = self.variable("cache", "k", jnp.zeros,
+                                   (B, cfg.max_seq_len, KV, D), cfg.dtype)
+                cv = self.variable("cache", "v", jnp.zeros,
+                                   (B, cfg.max_seq_len, KV, D), cfg.dtype)
+                ck.value, cv.value = kvc.write_kv(
+                    ck.value, cv.value, k, v, positions, update_mask)
+                o = kvc.cached_attention(q, ck.value, cv.value, positions)
             return dense(cfg.embed_dim, name="wo")(
                 o.reshape(B, S, H * D))
 
@@ -244,10 +279,11 @@ class LlamaBlock(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, x, positions=None, update_mask=None):
+    def __call__(self, x, positions=None, update_mask=None,
+                 block_tables=None):
         x = x + LlamaAttention(self.cfg, name="attn")(
             RMSNorm(name="attn_norm")(x), positions=positions,
-            update_mask=update_mask)
+            update_mask=update_mask, block_tables=block_tables)
         return x + SwiGLU(self.cfg, name="mlp")(
             RMSNorm(name="mlp_norm")(x))
 
@@ -256,7 +292,8 @@ class Llama(nn.Module):
     cfg: Any
 
     @nn.compact
-    def __call__(self, tokens, positions=None, update_mask=None):
+    def __call__(self, tokens, positions=None, update_mask=None,
+                 block_tables=None):
         cfg = self.cfg
         if cfg.decode and (positions is None or update_mask is None):
             raise ValueError(
@@ -287,7 +324,8 @@ class Llama(nn.Module):
         block_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layers_{i}")(
-                x, positions=positions, update_mask=update_mask)
+                x, positions=positions, update_mask=update_mask,
+                block_tables=block_tables)
         if zig:
             x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = RMSNorm(name="norm_f")(x)

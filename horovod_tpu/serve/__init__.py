@@ -7,8 +7,14 @@ timeline for observability. See docs/serving.md for the architecture
 and the bucket/no-recompile contract.
 
     queue.py     admission control: bounded queue, deadlines, load shed
-    kv_cache.py  slotted KV cache: device-side math + host accounting
-    batcher.py   iteration-level scheduler over fixed bucket shapes
+    kv_cache.py  KV storage: slotted rows and vLLM-style paged blocks
+                 (BlockPool free-list allocator, per-block crc ledger)
+    prefix.py    radix prefix cache: shared system prompts computed
+                 once, refcounted block runs, CoW at divergence, LRU
+                 eviction, weight-version flush
+    batcher.py   iteration-level scheduler over fixed bucket shapes,
+                 with optional speculative decoding (draft proposes k,
+                 target verifies in one step, bit-identical greedy)
     executor.py  the one jitted step, sharded via parallel/tp rules
     http.py      optional stdlib front end (/generate, /healthz)
     fleet.py     health-aware router over N replicas: accrual-driven
@@ -21,7 +27,12 @@ from .batcher import ContinuousBatcher, ReplicaDead            # noqa: F401
 from .executor import ShardedExecutor                          # noqa: F401
 from .fleet import FleetHandle, FleetRouter, Replica           # noqa: F401
 from .http import make_server, serve_http                      # noqa: F401
-from .kv_cache import SlotKVCache, cached_attention, write_kv  # noqa: F401
+from .kv_cache import (                                        # noqa: F401
+    BlockPool, PagedKVCache, SlotKVCache, cached_attention,
+    paged_attention, paged_model_kwargs, pool_blocks_for, write_kv,
+    write_kv_paged,
+)
+from .prefix import RadixPrefixCache                           # noqa: F401
 from .queue import (                                           # noqa: F401
     AdmissionQueue, AdmitDropped, Rejected, ServeHandle, ServeRequest,
 )

@@ -3,24 +3,33 @@
 The Orca insight, TPU-flavored: requests join and leave the running
 batch *between decode iterations*, never mid-program, and every program
 the scheduler launches has one of a small closed set of shapes —
-``[max_batch, 1]`` for decode and ``[max_batch, bucket]`` for each
-configured prefill bucket (HOROVOD_SERVE_BUCKETS) — so jit compiles
-each exactly once and batch churn can never trigger a recompile.
+``[max_batch, 1]`` for decode, ``[max_batch, bucket]`` for each
+configured prefill bucket (HOROVOD_SERVE_BUCKETS), and
+``[max_batch, spec_k + 1]`` for the speculative verify step — so jit
+compiles each exactly once and batch churn can never trigger a
+recompile.
 
 One `step()` is one scheduling iteration:
 
 1. **retire** — finished (max_new_tokens / EOS / context-full) and
    deadline-expired sequences resolve their handles and free their KV
-   slot (serve/kv_cache.py `SlotKVCache`).
-2. **admit** — pop queued requests into free slots; newly admitted
-   prompts are packed into ONE prefill call at the smallest bucket that
-   fits the longest of them (rows right-padded, per-row `last_idx`
-   picks each prompt's true last logit). Rows owned by already-running
-   sequences ride along with `update_mask=False`, so their cache state
-   is untouched.
-3. **decode** — one `[max_batch, 1]` step for every live sequence; each
-   gets exactly one new token (the iteration-granularity fairness that
-   keeps p50 flat under mixed lengths).
+   capacity (slot, or block-table references + prefix refcounts) in
+   the SAME iteration — a leaked block is capacity gone forever.
+2. **admit** — pop queued requests into free capacity. Slotted caches
+   admit on free slots; paged caches (serve/kv_cache.py `PagedKVCache`)
+   admit on free BLOCKS — tokens, not slots — through
+   `queue.pop_fitting`. With the radix prefix cache enabled
+   (serve/prefix.py), each prompt is first matched against cached
+   shared prefixes: matched blocks join the sequence's table by
+   reference (copy-on-write at a mid-block divergence) and only the
+   suffix is prefilled.
+3. **decode** — one `[max_batch, 1]` step for every live sequence; or,
+   with a draft executor attached, SPECULATIVE decoding: the drafter
+   proposes up to `spec_k` tokens per row ([max_batch, 1] draft steps),
+   the target scores all of them in ONE `[max_batch, spec_k+1]` verify
+   step, and the greedy accept/rollback rule emits tokens BIT-IDENTICAL
+   to target-only greedy decode — between 1 and spec_k+1 of them per
+   target step.
 
 Prefill counts as producing the first generated token (its last-logit
 argmax), so a request admitted in iteration k has a token by k — no
@@ -38,10 +47,14 @@ import numpy as np
 
 from ..chaos import inject as _chaos
 from ..obs import metrics as obs_metrics
-from .kv_cache import SlotKVCache
+from .kv_cache import BlockPool, PagedKVCache, SlotKVCache
+from .prefix import RadixPrefixCache
 from .queue import AdmissionQueue, ServeRequest
 
 logger = logging.getLogger("horovod_tpu")
+
+#: acceptance-rate histogram bounds: fractions in (0, 1]
+_ACCEPT_BOUNDS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0)
 
 
 class ReplicaDead(RuntimeError):
@@ -59,6 +72,12 @@ class _Active:
     out: List[int] = field(default_factory=list)
     #: tokens written into the KV cache (prompt + confirmed generations)
     cache_len: int = 0
+    #: paged admission plan: prefix-matched blocks awaiting attachment
+    plan: Optional[dict] = None
+    #: prompt tokens served from the prefix cache instead of recompute
+    prefix_tokens: int = 0
+    #: tokens of this sequence VALIDLY ingested into the drafter cache
+    draft_len: int = 0
 
 
 class ContinuousBatcher:
@@ -69,7 +88,10 @@ class ContinuousBatcher:
                  eos_id: Optional[int] = None,
                  replica_id: Optional[int] = None,
                  kv_crc: Optional[bool] = None,
-                 on_kv_corrupt: str = "reprefill"):
+                 on_kv_corrupt: str = "reprefill",
+                 draft_executor=None,
+                 spec_k: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         buckets = tuple(sorted(int(b) for b in buckets))
         if not buckets or buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints; got {buckets}")
@@ -88,20 +110,21 @@ class ContinuousBatcher:
         #: fleet identity (None = standalone): labels the metric
         #: series and addresses chaos serve.step / serve.kv faults
         self.replica_id = replica_id
-        #: per-slot crc-on-write / verify-on-read (HOROVOD_SERVE_KV_CRC
-        #: or explicit): every cache write is folded into the slot's
-        #: crc ledger and every retiring request's valid prefix is
-        #: re-read and verified BEFORE its tokens can reach a client —
-        #: a corrupted slot either re-prefills from the prompt or fails
-        #: cleanly ("error"/kv_corrupt), never returns garbage. Costs
-        #: one device->host readback of the written slice per step plus
-        #: one full-prefix readback per retiring request; an integrity
-        #: option for chaos runs and paranoid deployments, off by
-        #: default.
-        if kv_crc is None:
+        cfg = None
+        if kv_crc is None or spec_k is None or prefix_cache is None:
             from ..core.config import Config
-            kv_crc = Config.from_env().serve_kv_crc
-        self.kv_crc = bool(kv_crc)
+            cfg = Config.from_env()
+        #: per-slot/per-block crc-on-write / verify-on-read
+        #: (HOROVOD_SERVE_KV_CRC or explicit): every cache write is
+        #: folded into the crc ledger and every retiring request's
+        #: valid prefix is re-read and verified BEFORE its tokens can
+        #: reach a client — a corrupted cache either re-prefills from
+        #: the prompt or fails cleanly ("error"/kv_corrupt), never
+        #: returns garbage. Costs one device->host readback of the
+        #: written slice per step plus one full-prefix readback per
+        #: retiring request; an integrity option for chaos runs and
+        #: paranoid deployments, off by default.
+        self.kv_crc = bool(cfg.serve_kv_crc if kv_crc is None else kv_crc)
         self.on_kv_corrupt = on_kv_corrupt
         self.kv_corruptions_detected = 0
         self.kv_corruptions_injected = 0
@@ -113,8 +136,63 @@ class ContinuousBatcher:
         if queue.max_prompt_len is None or \
                 queue.max_prompt_len > buckets[-1]:
             queue.max_prompt_len = buckets[-1]
-        self.kv = SlotKVCache(executor.max_batch, executor.max_len)
-        self._active: Dict[int, _Active] = {}   # slot -> sequence
+
+        # -- KV storage: paged (block pool + optional radix prefix
+        # cache) when the model config says so, slotted otherwise
+        self.paged = bool(getattr(executor, "paged", False))
+        if self.paged:
+            pool = BlockPool(executor.kv_pool_blocks,
+                             executor.kv_block_size)
+            self.kv = PagedKVCache(executor.max_batch,
+                                   executor.blocks_per_seq, pool)
+            if prefix_cache is None:
+                prefix_cache = cfg.serve_prefix_cache
+            self.prefix: Optional[RadixPrefixCache] = (
+                RadixPrefixCache(pool, replica_id=replica_id)
+                if prefix_cache else None)
+            if self.prefix is not None:
+                self.kv.evictable = self.prefix.evictable_blocks
+                self.kv.evictor = self.prefix.evict
+        else:
+            self.kv = SlotKVCache(executor.max_batch, executor.max_len)
+            self.prefix = None
+        #: params version the prefix cache's contents were computed
+        #: under; a swap flushes the cache before any further lookup
+        self._prefix_version = executor.params_version
+        #: router-raised out-of-band flush (re-admission gate)
+        self._prefix_flush = threading.Event()
+
+        # -- speculative decoding: a draft executor proposes spec_k
+        # tokens per iteration; the target verifies them in one step
+        if spec_k is None:
+            spec_k = cfg.serve_spec_k
+        self.spec_k = int(spec_k) if draft_executor is not None else 0
+        self.draft = draft_executor if self.spec_k > 0 else None
+        if self.draft is not None:
+            if getattr(self.draft, "paged", False):
+                raise ValueError(
+                    "the draft executor must use the slotted cache "
+                    "(its rows mirror the target batch 1:1; paging the "
+                    "throwaway draft state buys nothing)")
+            if self.draft.max_batch != executor.max_batch:
+                raise ValueError(
+                    f"draft max_batch {self.draft.max_batch} must equal "
+                    f"the target's {executor.max_batch} (rows pair 1:1)")
+            if buckets[-1] > self.draft.max_len:
+                raise ValueError(
+                    f"largest prefill bucket {buckets[-1]} exceeds the "
+                    f"draft model context {self.draft.max_len}")
+        #: (per-SEQUENCE target verify+decode step participations,
+        #: tokens emitted by them) — the machine-independent
+        #: speculative win the bench gate asserts (< 0.7 target steps
+        #: per generated token). Row-granular on purpose: batched plain
+        #: decode pegs at exactly 1.0 (each row pays one target step
+        #: per token it emits), so only speculation can push the ratio
+        #: below 1 — batching wins cannot masquerade as draft wins.
+        self.gen_steps = 0
+        self.gen_tokens = 0
+
+        self._active: Dict[int, _Active] = {}   # slot/row -> sequence
         self._reprefill: List[ServeRequest] = []
         self.iterations = 0
         self._stop = threading.Event()
@@ -126,8 +204,8 @@ class ContinuousBatcher:
         self.heartbeat: Optional[Callable[[], None]] = None
         #: router-visible drain flag (mirrored into /healthz)
         self.draining = False
-        # -- metrics: time-to-first-token (admission wait + prefill) and
-        # live KV occupancy, next to the queue's depth/shed series.
+        # -- metrics: time-to-first-token (admission wait + prefill),
+        # live KV occupancy, and — paged — the block-occupancy gauge.
         # Standalone batchers claim fresh; fleet replicas use labeled
         # children (same discipline as AdmissionQueue/ShardedExecutor).
         rl = {} if replica_id is None else {"replica": str(replica_id)}
@@ -135,13 +213,24 @@ class ContinuousBatcher:
         if replica_id is None:
             R.unregister("hvd_serve_ttft_ms")
             R.unregister("hvd_serve_kv_occupancy")
+            R.unregister("hvd_serve_kv_blocks_in_use")
+            R.unregister("hvd_serve_spec_accept_rate")
         self._m_ttft = R.histogram(
             "hvd_serve_ttft_ms",
             "time to first generated token (submit -> prefill), ms",
             rl or None)
         self._m_occupancy = R.gauge(
-            "hvd_serve_kv_occupancy", "fraction of KV slots in use",
+            "hvd_serve_kv_occupancy",
+            "fraction of KV capacity in use (slots, or pool blocks "
+            "when paged — tokens resident, not sequences)", rl or None)
+        self._m_blocks = R.gauge(
+            "hvd_serve_kv_blocks_in_use",
+            "paged KV blocks currently allocated (0 when slotted)",
             rl or None)
+        self._m_accept = R.histogram(
+            "hvd_serve_spec_accept_rate",
+            "speculative decode: fraction of draft tokens accepted per "
+            "verify step", rl or None, bounds=_ACCEPT_BOUNDS)
         self._m_kv_corrupt = R.counter(
             "hvd_serve_kv_corruptions_total",
             "KV slots whose verify-on-read crc failed (corruption "
@@ -203,19 +292,58 @@ class ContinuousBatcher:
             target=adopt, daemon=True, name="hvd-serve-weights")
         self._weights_thread.start()
 
+    # -- prefix-cache version fencing ---------------------------------------
+    def request_prefix_flush(self) -> None:
+        """Out-of-band invalidation (fleet re-admission gate): the
+        flush itself runs on the scheduler thread at the top of the
+        next iteration, BEFORE any admission can match — single-writer
+        discipline, no lock needed."""
+        self._prefix_flush.set()
+
+    def _maybe_flush_prefix(self) -> None:
+        if self.prefix is None:
+            return
+        v = self.executor.params_version
+        if v != self._prefix_version or self._prefix_flush.is_set():
+            dropped = self.prefix.flush()
+            self._prefix_version = v
+            self._prefix_flush.clear()
+            if dropped:
+                logger.info(
+                    "serve replica %s: prefix cache flushed (%d runs) "
+                    "on weight version change -> %s",
+                    self.replica_id, dropped, v)
+
     # -- shape warmup --------------------------------------------------------
     def warmup(self) -> None:
-        """Compile every shape the scheduler can launch (decode + one
-        prefill per bucket) with all-False masks — state untouched. Run
-        once at startup so overload/churn never meets a compile."""
+        """Compile every shape the scheduler can launch — decode, one
+        prefill per bucket, the speculative verify ([max_batch,
+        spec_k+1]) and draft shapes, and the CoW block copy — with
+        all-False masks (state untouched). Run once at startup so
+        overload/churn never meets a compile; the draft/verify shapes
+        joining this set is what keeps the jit cache flat when
+        speculation is on."""
         B = self.executor.max_batch
         zero = np.zeros(B, np.int32)
         off = np.zeros(B, bool)
+        tbl = (np.full((B, self.executor.blocks_per_seq), -1, np.int32)
+               if self.paged else None)
         for b in self.buckets:
-            self.executor.step(np.zeros((B, b), np.int32), zero, off, zero,
-                               kind="prefill")
+            self.executor.step(np.zeros((B, b), np.int32), zero, off,
+                               zero, kind="prefill", block_tables=tbl)
         self.executor.step(np.zeros((B, 1), np.int32), zero, off, zero,
-                           kind="decode")
+                           kind="decode", block_tables=tbl)
+        if self.paged:
+            self.executor.copy_kv_block(0, 0)   # compile the CoW copy
+        if self.draft is not None:
+            self.executor.step(
+                np.zeros((B, self.spec_k + 1), np.int32), zero, off,
+                zero, kind="verify", block_tables=tbl)
+            for b in self.buckets:
+                self.draft.step(np.zeros((B, b), np.int32), zero, off,
+                                zero, kind="prefill")
+            self.draft.step(np.zeros((B, 1), np.int32), zero, off, zero,
+                            kind="decode")
 
     # -- chaos guards (one attribute read when disarmed) ---------------------
     def _fire_step_chaos(self) -> None:
@@ -234,9 +362,10 @@ class ContinuousBatcher:
 
     def _fire_kv_chaos(self) -> None:
         """``serve.kv`` site: corrupt flips a real bit inside a live
-        slot's device cache prefix — detection must come from the crc
-        ledger, nothing else knows. A corrupt fired on an iteration
-        with no written slot is DEFERRED to the next one that has one,
+        sequence's device cache — a slot row when slotted, a BLOCK of
+        the pool when paged (detection must come from the per-block crc
+        ledger, nothing else knows). A corrupt fired on an iteration
+        with no written data is DEFERRED to the next one that has some,
         so an exact-``at`` address always lands exactly one flip."""
         if _chaos._INJ is None and self._pending_corrupt is None:
             return
@@ -253,7 +382,14 @@ class ContinuousBatcher:
             length = self._active[slot].cache_len
             if length > 0:
                 self._pending_corrupt = None
-                self.executor.corrupt_kv_slot(slot, int(length))
+                if self.paged:
+                    bs = self.kv.block_size
+                    bi = (int(length) - 1) // bs
+                    blk = self.kv.blocks[slot][bi]
+                    self.executor.corrupt_kv_block(
+                        blk, ((int(length) - 1) % bs) + 1)
+                else:
+                    self.executor.corrupt_kv_slot(slot, int(length))
                 self.kv_corruptions_injected += 1
 
     # -- one scheduling iteration -------------------------------------------
@@ -265,6 +401,10 @@ class ContinuousBatcher:
             hb()
         self._fire_step_chaos()
         self._maybe_swap_weights()
+        # stale-weight KV must never serve a new version: any adopted
+        # swap (or router-requested flush) invalidates the prefix cache
+        # BEFORE this iteration can match against it
+        self._maybe_flush_prefix()
         # expired-but-still-queued requests get their structured
         # deadline completion NOW, even when every slot is busy —
         # within one iteration, not at slot-drain time
@@ -339,24 +479,106 @@ class ContinuousBatcher:
         t = self._thread
         return t.is_alive() if t is not None else True
 
+    def load(self) -> float:
+        """The fleet router's capacity signal: waiting plus in-flight,
+        with in-flight measured in the unit that actually limits this
+        batcher — live rows when slotted, BLOCKS in use scaled to
+        row-equivalents when paged. Two paged replicas with the same
+        sequence count can differ several-fold in memory pressure (one
+        long context vs many short ones); routing on blocks sends the
+        next long prompt to the replica that can actually hold it."""
+        if self.paged:
+            per_row = max(self.executor.blocks_per_seq, 1)
+            return self.queue.depth() + self.kv.pool.in_use() / per_row
+        return self.queue.depth() + float(self.kv.live())
+
     # -- internals -----------------------------------------------------------
     def _stats(self) -> dict:
         occ = self.kv.occupancy()
         self._m_occupancy.set(occ)
+        if self.paged:
+            self._m_blocks.set(self.kv.pool.in_use())
         return {"queue_depth": self.queue.depth(),
                 "occupancy": round(occ, 3),
                 "shed": self.queue.shed_count}
 
+    # -- crc plumbing (slot- or block-granular) ------------------------------
+    def _crc_write(self, slot: int, lo: int, hi: int) -> None:
+        """Fold cache positions ``[lo, hi)`` just written for ``slot``
+        into the crc ledger. Paged: per-BLOCK ledger entries; an
+        overwrite below a block's high-water mark (speculative
+        rollback) recomputes that block's crc from a fresh readback —
+        streaming crc32 cannot be truncated."""
+        if not self.kv_crc or hi <= lo:
+            return
+        if not self.paged:
+            filled = self.kv.crc_filled(slot)
+            if lo == filled:
+                self.kv.crc_update(
+                    slot, self.executor.kv_slot_bytes(slot, lo, hi), hi)
+            else:
+                # speculative rollback overwrote below the high-water
+                # mark: the append-only stream breaks — recompute the
+                # slot's ledger from a full re-read
+                new_filled = max(filled, hi)
+                self.kv.crc_reset(
+                    slot,
+                    self.executor.kv_slot_bytes(slot, 0, new_filled),
+                    new_filled)
+            return
+        bs = self.kv.block_size
+        pool = self.kv.pool
+        blocks = self.kv.blocks[slot]
+        for bi in range(lo // bs, (hi - 1) // bs + 1):
+            blk = blocks[bi]
+            blo = max(lo - bi * bs, 0)
+            bhi = min(hi - bi * bs, bs)
+            filled = pool.crc_filled(blk)
+            if blo == filled:
+                pool.crc_stream(
+                    blk, self.executor.kv_block_bytes(blk, blo, bhi),
+                    bhi)
+            else:
+                new_filled = max(filled, bhi)
+                pool.crc_reset(
+                    blk,
+                    self.executor.kv_block_bytes(blk, 0, new_filled),
+                    new_filled)
+
     def _kv_verify(self, seq: _Active) -> bool:
-        """Verify-on-read: re-read the slot's whole valid prefix and
-        check it against the write-side crc ledger. Runs only at
+        """Verify-on-read: re-read the sequence's whole valid prefix
+        and check it against the write-side crc ledger. Runs only at
         retirement (and only with kv_crc on), so a request's tokens are
-        NEVER released to a client from a cache row whose bytes changed
-        behind the scheduler's back."""
+        NEVER released to a client from cache bytes that changed behind
+        the scheduler's back. Paged sequences verify per BLOCK — shared
+        prefix blocks included, under the pool-wide ledger."""
         if not self.kv_crc or seq.cache_len <= 0:
             return True
-        raw = self.executor.kv_slot_bytes(seq.slot, 0, seq.cache_len)
-        return self.kv.crc_check(seq.slot, raw)
+        if not self.paged:
+            # the ledger's high-water mark can exceed cache_len (a
+            # verify step's rejected tail is written but not accepted);
+            # verify exactly the covered prefix
+            hi = self.kv.crc_filled(seq.slot) or seq.cache_len
+            raw = self.executor.kv_slot_bytes(seq.slot, 0, hi)
+            return self.kv.crc_check(seq.slot, raw)
+        pool = self.kv.pool
+        for blk in self.kv.blocks[seq.slot]:
+            filled = pool.crc_filled(blk)
+            if filled == 0:
+                continue
+            if not pool.crc_check(
+                    blk, self.executor.kv_block_bytes(blk, 0, filled)):
+                return False
+        return True
+
+    def _free_seq(self, slot: int) -> None:
+        """Release a retiring sequence's KV capacity — its slot, or its
+        whole block table (decrementing shared-prefix refcounts) — in
+        the SAME iteration it retires."""
+        if self.paged:
+            self.kv.free_row(slot)
+        else:
+            self.kv.free(slot)
 
     def _retire(self) -> None:
         now = time.monotonic()
@@ -366,7 +588,7 @@ class ContinuousBatcher:
             done_ok = (len(seq.out) >= req.max_new_tokens
                        or (self.eos_id is not None and seq.out
                            and seq.out[-1] == self.eos_id)
-                       or seq.cache_len >= self.kv.max_len)
+                       or seq.cache_len >= self.executor.max_len)
             expired = req.expired(now)
             if not (done_ok or expired):
                 continue
@@ -379,12 +601,17 @@ class ContinuousBatcher:
                 self.kv_corruptions_detected += 1
                 self._m_kv_corrupt.inc()
                 logger.warning(
-                    "serve replica %s: KV slot %d failed crc "
+                    "serve replica %s: KV %s %d failed crc "
                     "verify-on-read (request %d) — %s",
-                    self.replica_id, slot, req.rid,
+                    self.replica_id,
+                    "row" if self.paged else "slot", slot, req.rid,
                     "re-prefilling" if self.on_kv_corrupt == "reprefill"
                     and not expired else "failing the request")
-                self.kv.free(slot)
+                if self.prefix is not None:
+                    # the corrupt block may BE a cached prefix run; a
+                    # re-prefill matching it would corrupt again
+                    self.prefix.flush()
+                self._free_seq(slot)
                 del self._active[slot]
                 if self.on_kv_corrupt == "reprefill" and not expired:
                     self.kv_reprefills += 1
@@ -399,16 +626,107 @@ class ContinuousBatcher:
             else:
                 req.handle._resolve(seq.out, "ok", latency_ms=ms)
                 self.queue.note_service_ms(ms)
-            self.kv.free(slot)
+            self._free_seq(slot)
             del self._active[slot]
 
+    # -- admission -----------------------------------------------------------
+    def _seq_token_budget(self, req: ServeRequest) -> int:
+        """Worst-case cache positions this request can touch: prompt +
+        generation budget + the speculative write-ahead margin."""
+        margin = self.spec_k + 1 if self.draft is not None else 0
+        return min(len(req.prompt) + req.max_new_tokens + margin,
+                   self.executor.max_len)
+
+    def _plan(self, req: ServeRequest) -> dict:
+        """Paged admission plan: prefix match (references pinned) plus
+        the fresh-block budget the admission gate charges."""
+        if self.prefix is not None:
+            full, partial, m = self.prefix.match(req.prompt)
+        else:
+            full, partial, m = [], None, 0
+        total = self.kv.blocks_needed(self._seq_token_budget(req))
+        # the partially matched block still costs a fresh block (its
+        # copy-on-write copy), so only FULL shared blocks are free
+        return {"full": full, "partial": partial, "m": m,
+                "new_blocks": max(total - len(full), 0)}
+
+    def _release_plan(self, plan: dict) -> None:
+        if self.prefix is None:
+            return
+        self.prefix.release(plan["full"])
+        if plan["partial"] is not None:
+            self.prefix.release([plan["partial"][0]])
+
     def _admit(self) -> List[_Active]:
+        if not self.paged:
+            return self._admit_slotted()
+        free_rows = self.kv.num_rows - self.kv.live()
+        if free_rows <= 0:
+            return []
+        admitted: List[_Active] = []
+        # ONE evictable-tree walk per admission wave (the live hook is
+        # O(cached blocks) and fits() runs under the queue lock); the
+        # wave's own acceptances are charged against the snapshot:
+        # `planned` for reservations that land at alloc_row, `pinned`
+        # for matched prefix blocks whose new reference may have made
+        # a previously-evictable run un-evictable. Both only ever
+        # UNDER-admit — the reservation invariant cannot be pierced.
+        ev0 = (self.prefix.evictable_blocks()
+               if self.prefix is not None else 0)
+        planned = 0
+        pinned = 0
+
+        def pins_of(plan: dict) -> int:
+            return len(plan["full"]) + \
+                (1 if plan["partial"] is not None else 0)
+
+        def admit_one(req: ServeRequest, plan: dict) -> None:
+            row = self.kv.alloc_row(plan["new_blocks"])
+            a = _Active(req=req, slot=row, plan=plan)
+            admitted.append(a)
+            self._active[row] = a
+
+        # corrupted-and-reset sequences re-enter ahead of the queue
+        # (they already waited their turn once)
+        while self._reprefill and len(admitted) < free_rows:
+            plan = self._plan(self._reprefill[0])
+            if not self.kv.can_admit(plan["new_blocks"] + planned,
+                                     max(ev0 - pinned, 0)):
+                self._release_plan(plan)
+                # ahead-of-queue means AHEAD: admitting smaller queue
+                # requests past a blocked reprefill would let them eat
+                # the blocks it is waiting for (priority inversion —
+                # it could starve to its deadline while parked here)
+                return admitted
+            # no `planned` charge here: admit_one's alloc_row reserves
+            # immediately, so reserved_total already carries it
+            pinned += pins_of(plan)
+            admit_one(self._reprefill.pop(0), plan)
+
+        plans: Dict[int, dict] = {}
+
+        def fits(req: ServeRequest) -> bool:
+            nonlocal planned, pinned
+            plan = self._plan(req)
+            if self.kv.can_admit(plan["new_blocks"] + planned,
+                                 max(ev0 - pinned, 0)):
+                plans[req.rid] = plan
+                planned += plan["new_blocks"]
+                pinned += pins_of(plan)
+                return True
+            self._release_plan(plan)
+            return False
+
+        for req in self.queue.pop_fitting(free_rows - len(admitted),
+                                          fits):
+            admit_one(req, plans[req.rid])
+        return admitted
+
+    def _admit_slotted(self) -> List[_Active]:
         free = self.kv.num_slots - self.kv.live()
         if free <= 0:
             return []
         admitted: List[_Active] = []
-        # corrupted-and-reset sequences re-enter ahead of the queue
-        # (they already waited their turn once)
         while self._reprefill and len(admitted) < free:
             req = self._reprefill.pop(0)
             slot = self.kv.alloc()
@@ -428,20 +746,71 @@ class ContinuousBatcher:
             f"prompt of {length} passed admission but fits no bucket "
             f"{self.buckets}")  # queue.max_prompt_len makes this unreachable
 
+    # -- prefill -------------------------------------------------------------
     def _prefill(self, admitted: List[_Active]) -> None:
         B = self.executor.max_batch
-        bucket = self._bucket_for(max(len(a.req.prompt) for a in admitted))
+        hit_rows: List[_Active] = []
+        if self.paged:
+            # materialize each admission plan: shared full blocks join
+            # the table by reference; a mid-block partial match is
+            # copy-on-written into a fresh block the suffix then
+            # overwrites from its divergence point
+            for a in admitted:
+                plan, row = a.plan, a.slot
+                for blk in plan["full"]:
+                    self.kv.attach_shared(row, blk)
+                if plan["partial"] is not None:
+                    src, _j = plan["partial"]
+                    dst = self.kv.append_block(row)
+                    self.executor.copy_kv_block(src, dst)
+                    self.kv.pool.crc_clone(src, dst)
+                    self.prefix.release([src])   # drop the CoW pin
+                a.prefix_tokens = plan["m"]
+                a.plan = None
+                if self.prefix is not None:
+                    self.prefix.note_lookup(a.prefix_tokens)
+                if a.prefix_tokens:
+                    hit_rows.append(a)
+                self.kv.ensure(row, len(a.req.prompt))
+        # ONE packed prefill at the smallest bucket fitting the longest
+        # SUFFIX (the unmatched prompt tail; the whole prompt when the
+        # prefix cache missed or is off)
+        bucket = self._bucket_for(
+            max(len(a.req.prompt) - a.prefix_tokens for a in admitted))
         tokens = np.zeros((B, bucket), np.int32)
         positions = np.zeros(B, np.int32)
         mask = np.zeros(B, bool)
         last_idx = np.zeros(B, np.int32)
         for a in admitted:
-            n = len(a.req.prompt)
-            tokens[a.slot, :n] = a.req.prompt
+            m = a.prefix_tokens
+            suffix = a.req.prompt[m:]
+            tokens[a.slot, :len(suffix)] = suffix
+            positions[a.slot] = m
             mask[a.slot] = True
-            last_idx[a.slot] = n - 1
-        nxt = self.executor.step(tokens, positions, mask, last_idx,
-                                 kind="prefill", stats=self._stats())
+            last_idx[a.slot] = len(suffix) - 1
+        expected_v = self._prefix_version
+        nxt = self.executor.step(
+            tokens, positions, mask, last_idx, kind="prefill",
+            stats=self._stats(),
+            block_tables=self.kv.table() if self.paged else None)
+        if hit_rows and self.executor.last_step_version != expected_v:
+            # a weight swap landed between the prefix match and this
+            # prefill: the hit rows mixed old-version cached KV with
+            # new-version compute. Tear them down and re-prefill from
+            # scratch (the flush at the next step top drops the stale
+            # cache); miss rows ran entirely under one version and
+            # stand.
+            logger.warning(
+                "serve replica %s: weight swap landed mid-prefill — "
+                "re-prefilling %d prefix-hit sequences on version %s",
+                self.replica_id, len(hit_rows),
+                self.executor.params_version)
+            self._prefix_flush.set()
+            for a in hit_rows:
+                self._free_seq(a.slot)
+                del self._active[a.slot]
+                self._reprefill.append(a.req)
+            admitted = [a for a in admitted if a not in hit_rows]
         t_first = time.monotonic()
         for a in admitted:
             self._m_ttft.observe(
@@ -452,32 +821,189 @@ class ContinuousBatcher:
             # first generated token is the prompt's last-logit argmax
             a.out.append(int(nxt[a.slot]))
             self.kv.lengths[a.slot] = n
-            if self.kv_crc:
-                # crc-on-write covers exactly the valid prefix (pad
-                # positions past n are unreachable and unverified)
-                self.kv.crc_update(
-                    a.slot, self.executor.kv_slot_bytes(a.slot, 0, n))
+            # crc-on-write covers exactly the written span [m, n) (pad
+            # positions past n are unreachable and unverified; shared
+            # prefix blocks carry their writer's ledger already)
+            self._crc_write(a.slot, a.prefix_tokens, n)
+            if self.paged and self.prefix is not None:
+                # publish this prompt's FULL blocks for future sharing
+                self.prefix.insert(a.req.prompt,
+                                   self.kv.blocks[a.slot])
+        if self.draft is not None and admitted:
+            self._draft_prefill(admitted)
 
+    def _draft_prefill(self, admitted: List[_Active]) -> None:
+        """Ingest each admitted prompt into the DRAFT model's cache
+        (full prompt — the drafter has no prefix cache; it is small,
+        that is the point). Its last-logit output is discarded: the
+        first draft of the next iteration feeds the target's first
+        emitted token."""
+        B = self.draft.max_batch
+        bucket = self._bucket_for(
+            max(len(a.req.prompt) for a in admitted))
+        tokens = np.zeros((B, bucket), np.int32)
+        positions = np.zeros(B, np.int32)
+        mask = np.zeros(B, bool)
+        last_idx = np.zeros(B, np.int32)
+        for a in admitted:
+            n = len(a.req.prompt)
+            tokens[a.slot, :n] = a.req.prompt
+            mask[a.slot] = True
+            last_idx[a.slot] = n - 1
+        self.draft.step(tokens, positions, mask, last_idx,
+                        kind="prefill")
+        for a in admitted:
+            a.draft_len = len(a.req.prompt)
+
+    # -- decode --------------------------------------------------------------
     def _decode(self) -> None:
+        if self.draft is None:
+            self._decode_plain(list(self._active))
+            return
+        spec_rows, plain_rows = [], []
+        for slot, seq in self._active.items():
+            # speculative write-ahead must stay inside both contexts;
+            # boundary sequences fall back to plain decode
+            if seq.cache_len + self.spec_k + 1 <= self.executor.max_len \
+                    and seq.draft_len + self.spec_k <= self.draft.max_len:
+                spec_rows.append(slot)
+            else:
+                plain_rows.append(slot)
+        if spec_rows:
+            self._decode_spec(spec_rows)
+        if plain_rows:
+            self._decode_plain(plain_rows)
+
+    def _decode_plain(self, rows: List[int]) -> None:
         B = self.executor.max_batch
         tokens = np.zeros((B, 1), np.int32)
         positions = np.zeros(B, np.int32)
         mask = np.zeros(B, bool)
         last_idx = np.zeros(B, np.int32)
-        for slot, seq in self._active.items():
+        for slot in rows:
+            seq = self._active[slot]
             # the newest token is not yet in the cache: this step writes
             # it at position cache_len, attends, and samples the next
             tokens[slot, 0] = seq.out[-1]
             positions[slot] = seq.cache_len
             mask[slot] = True
-        nxt = self.executor.step(tokens, positions, mask, last_idx,
-                                 kind="decode", stats=self._stats())
-        for slot, seq in self._active.items():
-            if self.kv_crc:
-                # this step wrote one K/V entry at the old cache_len
-                self.kv.crc_update(
-                    slot, self.executor.kv_slot_bytes(
-                        slot, seq.cache_len, seq.cache_len + 1))
+            if self.paged:
+                self.kv.ensure(slot, seq.cache_len + 1)
+        nxt = self.executor.step(
+            tokens, positions, mask, last_idx, kind="decode",
+            stats=self._stats(),
+            block_tables=self.kv.table() if self.paged else None)
+        self.gen_steps += len(rows)
+        for slot in rows:
+            seq = self._active[slot]
+            # this step wrote one K/V entry at the old cache_len
+            self._crc_write(slot, seq.cache_len, seq.cache_len + 1)
             seq.cache_len += 1
             self.kv.lengths[slot] = seq.cache_len
             seq.out.append(int(nxt[slot]))
+            self.gen_tokens += 1
+
+    def _decode_spec(self, rows: List[int]) -> None:
+        """One speculative iteration: k draft proposals per row, ONE
+        target verify step, greedy accept + rollback.
+
+        Greedy accept is what makes the output BIT-IDENTICAL to
+        target-only greedy decode: draft token i+1 is emitted iff it
+        equals the target's argmax at position i (exactly the token
+        plain decode would have produced there), and the first
+        disagreement emits the target's own argmax instead — so the
+        emitted stream is the target's greedy stream, just produced
+        1..k+1 tokens per target step. Rejected draft positions were
+        written into the cache by the verify step; they sit beyond the
+        new cache_len, unreachable by the positional validity mask,
+        and are overwritten by the next iteration — rollback is
+        bookkeeping, not data movement.
+        """
+        k = self.spec_k
+        B = self.executor.max_batch
+        known = {slot: self._active[slot].req.prompt
+                 + self._active[slot].out for slot in rows}
+        # tokens the drafter has NOT validly ingested yet; feeding them
+        # (forced) re-syncs its cache after a full-accept iteration
+        # left it one token behind
+        forced = {slot: known[slot][self._active[slot].draft_len:]
+                  for slot in rows}
+        drafts: Dict[int, List[int]] = {slot: [] for slot in rows}
+        fed: Dict[int, List[int]] = {slot: [] for slot in rows}
+        prev: Dict[int, int] = {}
+        for i in range(k):
+            tokens = np.zeros((B, 1), np.int32)
+            positions = np.zeros(B, np.int32)
+            mask = np.zeros(B, bool)
+            zero = np.zeros(B, np.int32)
+            for slot in rows:
+                seq = self._active[slot]
+                if forced[slot]:
+                    tok = forced[slot][0]
+                else:
+                    tok = prev[slot]
+                tokens[slot, 0] = tok
+                positions[slot] = seq.draft_len + i
+                mask[slot] = True
+            out = self.draft.step(tokens, positions, mask, zero,
+                                  kind="decode")
+            for slot in rows:
+                o = int(out[slot])
+                if forced[slot]:
+                    fed[slot].append(forced[slot].pop(0))
+                    if not forced[slot]:
+                        drafts[slot].append(o)   # drafted past known
+                else:
+                    fed[slot].append(prev[slot])
+                    drafts[slot].append(o)
+                prev[slot] = o
+        # ONE batched verify: token 0 is each row's last emitted token
+        # (its K/V enters the cache here, same as plain decode), tokens
+        # 1..n_d are the drafts; the target scores every position
+        tokens = np.zeros((B, k + 1), np.int32)
+        positions = np.zeros(B, np.int32)
+        mask = np.zeros(B, bool)
+        zero = np.zeros(B, np.int32)
+        for slot in rows:
+            seq = self._active[slot]
+            row_toks = [known[slot][-1]] + drafts[slot][:k]
+            tokens[slot, :len(row_toks)] = row_toks
+            positions[slot] = seq.cache_len
+            mask[slot] = True
+            if self.paged:
+                self.kv.ensure(slot, seq.cache_len + k + 1)
+        preds = self.executor.step(
+            tokens, positions, mask, zero, kind="verify",
+            stats=self._stats(),
+            block_tables=self.kv.table() if self.paged else None)
+        self.gen_steps += len(rows)
+        for slot in rows:
+            seq = self._active[slot]
+            n_d = len(drafts[slot])
+            a = 0
+            while a < n_d and drafts[slot][a] == int(preds[slot][a]):
+                a += 1
+            if n_d:
+                self._m_accept.observe(a / n_d)
+            emitted = drafts[slot][:a] + [int(preds[slot][a])]
+            remaining = seq.req.max_new_tokens - len(seq.out)
+            emitted = emitted[:remaining]
+            if self.eos_id is not None and self.eos_id in emitted:
+                emitted = emitted[:emitted.index(self.eos_id) + 1]
+            # the verify step wrote k+1 cache positions regardless;
+            # crc them all — a later overwrite of the rejected tail
+            # recomputes those blocks' ledgers
+            self._crc_write(slot, seq.cache_len, seq.cache_len + k + 1)
+            seq.out.extend(emitted)
+            seq.cache_len += len(emitted)
+            self.kv.lengths[slot] = seq.cache_len
+            self.gen_tokens += len(emitted)
+            # drafter rollback: its valid prefix is however far the fed
+            # token stream still agrees with the true sequence
+            nk = known[slot] + emitted
+            base = seq.draft_len
+            p = 0
+            while p < len(fed[slot]) and base + p < len(nk) \
+                    and fed[slot][p] == nk[base + p]:
+                p += 1
+            seq.draft_len = base + p

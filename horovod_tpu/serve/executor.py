@@ -42,9 +42,12 @@ class ShardedExecutor:
 
     def __init__(self, model: Any, params: Any, *, max_batch: int,
                  max_len: int, mesh=None, partition_rules=None,
-                 timeline=None, replica_id: Optional[int] = None):
+                 timeline=None, replica_id: Optional[int] = None,
+                 role: str = "target"):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1; got {max_batch}")
+        if role not in ("target", "draft"):
+            raise ValueError(f"role must be 'target'|'draft'; got {role!r}")
         model_max = getattr(getattr(model, "cfg", None), "max_seq_len",
                             None)
         if model_max is not None and max_len > model_max:
@@ -58,6 +61,25 @@ class ShardedExecutor:
         self.max_batch = max_batch
         self.max_len = max_len
         self.timeline = timeline
+        #: "draft" executors (speculative decoding proposers) share the
+        #: process with a target executor: they must neither reclaim
+        #: the serve metric families nor blend into the target's series
+        self.role = role
+        # -- paged layout (model-config driven): the device cache is a
+        # block pool and every step takes per-row block tables
+        cfg = getattr(model, "cfg", None)
+        self.kv_block_size = int(getattr(cfg, "kv_block_size", 0) or 0)
+        self.kv_pool_blocks = int(getattr(cfg, "kv_pool_blocks", 0) or 0)
+        self.paged = self.kv_block_size > 0
+        #: fixed block-table width: enough entries to address max_len
+        self.blocks_per_seq = (
+            -(-max_len // self.kv_block_size) if self.paged else 0)
+        if self.paged and \
+                self.kv_pool_blocks < self.blocks_per_seq:
+            raise ValueError(
+                f"kv_pool_blocks {self.kv_pool_blocks} cannot cover one "
+                f"max_len sequence ({self.blocks_per_seq} blocks of "
+                f"{self.kv_block_size})")
         # kept for hot weight swaps (redist/stream.py): replacement
         # params are placed exactly like the originals
         self._mesh = mesh
@@ -90,8 +112,13 @@ class ShardedExecutor:
         # off (serve/fleet.py).
         self.replica_id = replica_id
         rl = {} if replica_id is None else {"replica": str(replica_id)}
+        if role == "draft":
+            rl = dict(rl, role="draft")
         R = obs_metrics.get_registry()
-        if replica_id is None:
+        if replica_id is None and role == "target":
+            # only the TARGET standalone executor claims the families
+            # fresh: a draft executor is constructed NEXT TO a target in
+            # the same process and must not clobber its series
             R.unregister("hvd_serve_step_ms")
             R.unregister("hvd_serve_tokens_total")
         # get-or-create, NOT claimed fresh: a multi-replica fleet runs
@@ -104,19 +131,30 @@ class ShardedExecutor:
             k: R.histogram("hvd_serve_step_ms",
                            "executor step latency by kind (ms)",
                            dict(rl, kind=k))
-            for k in ("prefill", "decode")}
+            for k in ("prefill", "decode", "verify")}
         self._m_tokens = R.counter(
             "hvd_serve_tokens_total", "tokens generated", rl or None)
 
-        def fwd(params, cache, tokens, positions, mask, last_idx):
-            logits, vout = self.model.apply(
-                {"params": params, "cache": cache}, tokens,
-                positions=positions, update_mask=mask, mutable=["cache"])
-            # next-token logits at each row's last REAL token (prompts
-            # are right-padded to the bucket length)
-            last = logits[jnp.arange(logits.shape[0]), last_idx]
-            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            return nxt, vout["cache"]
+        # the jitted step returns the greedy argmax at EVERY position
+        # ([B, T] int32): prefill picks each row's last real token on
+        # the host, decode reads column 0, and speculative VERIFY needs
+        # the whole row (one batched step scores all k draft positions)
+        if self.paged:
+            def fwd(params, cache, tokens, positions, mask, tables):
+                logits, vout = self.model.apply(
+                    {"params": params, "cache": cache}, tokens,
+                    positions=positions, update_mask=mask,
+                    block_tables=tables, mutable=["cache"])
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, vout["cache"]
+        else:
+            def fwd(params, cache, tokens, positions, mask):
+                logits, vout = self.model.apply(
+                    {"params": params, "cache": cache}, tokens,
+                    positions=positions, update_mask=mask,
+                    mutable=["cache"])
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, vout["cache"]
 
         # donating the cache lets XLA update it in place on TPU; CPU
         # does not support donation and would only warn
@@ -125,40 +163,83 @@ class ShardedExecutor:
 
         # materialize the zero cache once (a separate cache-creating
         # trace; steady-state steps all go through self._fwd)
-        def make_cache(params, tokens, positions, mask):
+        def make_cache(params, tokens, positions, mask, tables):
+            kw = {"block_tables": tables} if self.paged else {}
             _, v = self.model.apply(
                 {"params": params}, tokens, positions=positions,
-                update_mask=mask, mutable=["cache"])
+                update_mask=mask, mutable=["cache"], **kw)
             return v["cache"]
 
         z = jnp.zeros((max_batch, 1), jnp.int32)
-        self.cache = jax.jit(make_cache)(
+        zt = jnp.full((max_batch, max(self.blocks_per_seq, 1)), -1,
+                      jnp.int32)
+        self.cache = jax.jit(make_cache, static_argnums=())(
             params, z, jnp.zeros((max_batch,), jnp.int32),
-            jnp.zeros((max_batch,), bool))
+            jnp.zeros((max_batch,), bool), zt)
+
+        if self.paged:
+            # CoW block copy, jitted once (shapes are static): donation
+            # makes it an in-place pool write on TPU instead of a full
+            # pool copy per CoW
+            NB, BS = self.kv_pool_blocks, self.kv_block_size
+
+            def copy_block(cache, src, dst):
+                def cp(leaf):
+                    if getattr(leaf, "ndim", 0) == 4 and \
+                            leaf.shape[0] == NB and leaf.shape[1] == BS:
+                        return leaf.at[dst].set(leaf[src])
+                    return leaf
+                return jax.tree_util.tree_map(cp, cache)
+
+            self._copy_block = jax.jit(
+                copy_block, donate_argnums=() if
+                jax.default_backend() == "cpu" else (0,))
+        #: params_version the most recent step actually ran under (set
+        #: inside the step lock) — what lets the batcher detect a swap
+        #: landing between its prefix-cache lookup and the prefill
+        self.last_step_version: Optional[int] = None
 
     # -- the one step --------------------------------------------------------
     def step(self, tokens: np.ndarray, positions: np.ndarray,
              mask: np.ndarray, last_idx: np.ndarray, *,
              kind: str = "decode",
-             stats: Optional[Dict[str, Any]] = None) -> np.ndarray:
+             stats: Optional[Dict[str, Any]] = None,
+             block_tables: Optional[np.ndarray] = None) -> np.ndarray:
         """Run one fixed-shape forward step; returns the sampled
-        (greedy) next token per row, valid where `mask` is set.
+        (greedy) next token per row, valid where `mask` is set —
+        ``[max_batch]`` for prefill (each row's last real token) and
+        decode (T=1), ``[max_batch, T]`` for ``kind="verify"`` (the
+        speculative scoring step needs the argmax at every draft
+        position).
 
         tokens [max_batch, T] int32; positions/last_idx [max_batch]
-        int32; mask [max_batch] bool. `stats` (queue depth, occupancy,
-        shed count — batcher-supplied) is folded into the SERVE event.
+        int32; mask [max_batch] bool; block_tables
+        [max_batch, blocks_per_seq] int32 (paged executors only).
+        `stats` (queue depth, occupancy, shed count — batcher-supplied)
+        is folded into the SERVE event.
         """
         t0 = time.perf_counter()
         self.signatures.add((kind, int(tokens.shape[1])))
+        if self.paged:
+            if block_tables is None:
+                raise ValueError("a paged executor step needs "
+                                 "block_tables")
+            extra = (jnp.asarray(block_tables, jnp.int32),)
+        else:
+            extra = ()
         with self._swap_lock:   # the weight-swap version fence
+            self.last_step_version = self.params_version
             nxt, self.cache = self._fwd(
                 self.params, self.cache, jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(positions, jnp.int32),
-                jnp.asarray(mask, bool),
-                jnp.asarray(last_idx, jnp.int32))
+                jnp.asarray(mask, bool), *extra)
             # host readback doubles as completion fence — inside the
             # lock so a swap never lands while this step is in flight
             nxt = np.asarray(nxt)
+        if kind == "prefill":
+            nxt = nxt[np.arange(self.max_batch), np.asarray(last_idx)]
+        elif kind != "verify":
+            nxt = nxt[:, 0]
         dt_ms = (time.perf_counter() - t0) * 1000.0
         self.steps += 1
         self.step_latencies_ms.append(dt_ms)
@@ -241,12 +322,18 @@ class ShardedExecutor:
                 "swap_ms": round(dt_ms, 3)})
         return True
 
-    # -- KV-slot integrity hooks (serve.kv chaos + crc option) ---------------
+    # -- KV integrity hooks (serve.kv chaos + crc option) --------------------
     def _cache_leaves(self) -> list:
         """The device KV arrays inside the flax cache collection, in
-        flatten order: every ``[max_batch, L, H_kv, D]`` leaf (cache_k
-        and cache_v of each layer)."""
+        flatten order: every ``[max_batch, L, H_kv, D]`` slotted leaf —
+        or, for a paged executor, every ``[pool_blocks, block_size,
+        H_kv, D]`` pool leaf — (cache_k and cache_v of each layer)."""
         leaves = jax.tree_util.tree_leaves(self.cache)
+        if self.paged:
+            return [l for l in leaves
+                    if getattr(l, "ndim", 0) == 4
+                    and l.shape[0] == self.kv_pool_blocks
+                    and l.shape[1] == self.kv_block_size]
         return [l for l in leaves
                 if getattr(l, "ndim", 0) == 4
                 and l.shape[0] == self.max_batch]
@@ -260,6 +347,28 @@ class ShardedExecutor:
         prefix once per retiring request."""
         return [np.asarray(l[slot, start:stop]).tobytes()
                 for l in self._cache_leaves()]
+
+    def kv_block_bytes(self, block: int, start: int,
+                       stop: int) -> list:
+        """Paged sibling of :meth:`kv_slot_bytes`: host bytes of
+        positions ``[start, stop)`` of pool block ``block`` in each
+        cache leaf — what the per-BLOCK crc ledger
+        (BlockPool.crc_stream/crc_check) runs over."""
+        return [np.asarray(l[block, start:stop]).tobytes()
+                for l in self._cache_leaves()]
+
+    def copy_kv_block(self, src: int, dst: int) -> None:
+        """Device-side copy of pool block ``src`` onto ``dst`` in every
+        cache leaf — the copy-on-write body behind partial prefix-block
+        sharing (serve/prefix.py). One precompiled program; call once
+        from warmup so the first divergent prompt never meets a
+        compile."""
+        if not self.paged:
+            raise RuntimeError("copy_kv_block is paged-only")
+        with self._swap_lock:   # never tear a step in flight
+            self.cache = self._copy_block(
+                self.cache, jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32))
 
     def corrupt_kv_slot(self, slot: int, length: int) -> None:
         """Flip one deterministically chosen bit inside ``slot``'s
@@ -277,6 +386,27 @@ class ShardedExecutor:
                 _chaos.corrupt_copy(row.tobytes()),
                 dtype=row.dtype).reshape(row.shape)
             leaves[idx] = leaves[idx].at[slot, :length].set(
+                jnp.asarray(flipped))
+            self.cache = jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def corrupt_kv_block(self, block: int, length: int) -> None:
+        """Paged ``serve.kv`` fault body: flip one bit inside the first
+        ``length`` positions of pool block ``block`` — real device
+        bytes, caught only by the per-block crc ledger."""
+        from ..chaos import inject as _chaos
+        if not self.paged:
+            raise RuntimeError("corrupt_kv_block is paged-only")
+        with self._swap_lock:
+            leaves, treedef = jax.tree_util.tree_flatten(self.cache)
+            idx = next(i for i, l in enumerate(leaves)
+                       if getattr(l, "ndim", 0) == 4
+                       and l.shape[0] == self.kv_pool_blocks
+                       and l.shape[1] == self.kv_block_size)
+            row = np.array(leaves[idx][block, :length])
+            flipped = np.frombuffer(
+                _chaos.corrupt_copy(row.tobytes()),
+                dtype=row.dtype).reshape(row.shape)
+            leaves[idx] = leaves[idx].at[block, :length].set(
                 jnp.asarray(flipped))
             self.cache = jax.tree_util.tree_unflatten(treedef, leaves)
 

@@ -26,7 +26,9 @@ accrual heartbeat semantics the training plane's failure detector uses
   NEWEST streamed weight version (redist/stream.py
   ``WeightSubscriber.peek_version``), and only then re-admitted; a
   slow replica that resumes heartbeating is re-admitted through the
-  same weight gate without a rebuild.
+  same weight gate without a rebuild — in both cases with its radix
+  prefix cache flushed first, so KV computed under the pre-ejection
+  weights can never be matched by a post-re-admission prompt.
 * **Drain on SIGTERM.** ``drain()`` (or the installed SIGTERM handler)
   stops admitting — new submits are shed with retry-after — waits out
   the in-flight tail, then resolves any stragglers as rejected; the
@@ -144,7 +146,10 @@ class Replica:
                  kv_crc: Optional[bool] = None,
                  on_kv_corrupt: str = "reprefill",
                  subscriber=None,
-                 weights_interval_s: float = 0.25):
+                 weights_interval_s: float = 0.25,
+                 draft_executor=None,
+                 spec_k: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         if getattr(executor, "replica_id", None) != rid:
             raise ValueError(
                 f"replica {rid}: its executor must be constructed with "
@@ -160,6 +165,12 @@ class Replica:
         self.deadline_ms = float(deadline_ms)
         self.kv_crc = kv_crc   # None defers to HOROVOD_SERVE_KV_CRC
         self.on_kv_corrupt = on_kv_corrupt
+        #: speculative decoding pair: the draft executor survives
+        #: rebuilds exactly like the target (its params and jit cache
+        #: are device state; its throwaway KV re-syncs per sequence)
+        self.draft_executor = draft_executor
+        self.spec_k = spec_k         # None defers to HOROVOD_SERVE_SPEC_K
+        self.prefix_cache = prefix_cache   # None defers to env knob
         #: optional WeightSubscriber (redist/stream.py): polled by the
         #: live batcher, and the router's re-admission gate
         self.subscriber = subscriber
@@ -198,7 +209,9 @@ class Replica:
         self.batcher = ContinuousBatcher(
             self.executor, self.queue, buckets=self.buckets,
             eos_id=self.eos_id, replica_id=self.id,
-            kv_crc=self.kv_crc, on_kv_corrupt=self.on_kv_corrupt)
+            kv_crc=self.kv_crc, on_kv_corrupt=self.on_kv_corrupt,
+            draft_executor=self.draft_executor, spec_k=self.spec_k,
+            prefix_cache=self.prefix_cache)
         self.batcher.iterations = self._iters_base
         self.batcher.heartbeat = self._heartbeat
         if self.subscriber is not None:
@@ -393,14 +406,16 @@ class FleetRouter:
 
     def _candidates(self, exclude: Optional[int] = None) -> List[Replica]:
         """Healthy replicas, least-loaded first — load is waiting PLUS
-        in-flight (live KV slots), so a replica that drains its queue
-        into the batch instantly doesn't look idle; ties break to the
+        in-flight, so a replica that drains its queue into the batch
+        instantly doesn't look idle. The in-flight unit is whatever
+        actually limits the replica's capacity: live KV slots when
+        slotted, BLOCKS in use (tokens resident, row-normalized) when
+        paged — see ``ContinuousBatcher.load``. Ties break to the
         lowest id (deterministic)."""
         out = [r for r in self.replicas.values()
                if r.state == "up" and r.id != exclude
                and r.batcher is not None and r.batcher.alive()]
-        return sorted(out, key=lambda r: (
-            r.queue.depth() + r.batcher.kv.live(), r.id))
+        return sorted(out, key=lambda r: (r.batcher.load(), r.id))
 
     def _dispatch(self, tr: _Tracked,
                   exclude: Optional[int] = None) -> Optional[Rejected]:
@@ -643,6 +658,16 @@ class FleetRouter:
                             self.rewarm_timeout_s)
                         return      # next sweep retries recovery
                     time.sleep(self.interval_s / 4.0)
+            # the re-admission WEIGHT gate must also be a KV gate: a
+            # slow-but-alive replica kept its batcher — and with it a
+            # prefix cache (and block pool contents) computed under the
+            # version it served BEFORE ejection. Re-warming on v2 while
+            # v1 prefix blocks remain matchable would serve
+            # stale-weight KV; the batcher's own version fence covers
+            # the swap-observed path, this covers every other way back
+            # in (the flush runs on the scheduler thread at the top of
+            # its next iteration, before any admission can match).
+            rep.batcher.request_prefix_flush()
             if rebuilt:
                 rep.batcher.start()
             # fresh accrual history: a re-admitted replica re-enters

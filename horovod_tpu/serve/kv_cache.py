@@ -1,22 +1,28 @@
-"""Slotted KV-cache: the serving-side memory manager.
+"""KV-cache memory managers: slotted rows and paged blocks.
 
 Orca/vLLM-style continuous batching needs per-sequence key/value state
 that outlives any single forward call and can be handed to a *different*
-sequence the moment its owner retires. Two halves live here:
+sequence the moment its owner retires. Two storage layouts live here:
 
-1. **Functional cache math** (`write_kv`, `cached_attention`): pure
-   jittable updates of the device-resident cache arrays. The cache
-   layout is ``[num_slots, max_len, num_kv_heads, head_dim]`` — one row
-   ("slot") per in-flight sequence, written in place at per-row offsets
-   with a vmapped dynamic_update_slice and read back under a per-row
-   validity mask. Shapes never depend on which slots are live, so jit
-   compiles the decode program exactly once (the no-recompile contract,
-   docs/serving.md).
-2. **Host-side slot accounting** (`SlotKVCache`): a free list with
-   per-slot lengths, occupancy and reuse counters. Slots are recycled
-   LIFO; stale bytes from the previous owner are never cleared — the
-   validity mask (`key position <= row position`) makes them
-   unreachable, which is what makes reuse O(1).
+1. **Slotted** (`write_kv`, `cached_attention`, `SlotKVCache`): the
+   original layout — one ``[num_slots, max_len, H_kv, D]`` row per
+   in-flight sequence, written in place at per-row offsets and read
+   back under a per-row validity mask. Simple, but occupancy is
+   ``slots x max_len`` regardless of how many tokens are resident.
+2. **Paged** (`write_kv_paged`, `paged_attention`, `BlockPool`,
+   `PagedKVCache`): vLLM-style block storage — the device arrays are a
+   pool ``[num_blocks, block_size, H_kv, D]`` and each sequence owns an
+   ordered *block table* of pool indices. Virtual position ``p`` of a
+   sequence lives at ``pool[table[p // bs], p % bs]``; attention
+   gathers the table and applies the same positional validity mask, so
+   occupancy is bounded by **tokens resident** (blocks actually
+   allocated), not ``slots x max_len``. Blocks are refcounted, which is
+   what lets the radix prefix cache (serve/prefix.py) share read-only
+   prompt-prefix runs across sequences.
+
+Shapes never depend on which rows/blocks are live — liveness is data
+(masks, tables, positions), so jit compiles each program exactly once
+(the no-recompile contract, docs/serving.md).
 
 The device arrays themselves live in the model's flax ``"cache"``
 collection (models/gpt.py, models/llama.py decode paths) and are
@@ -26,7 +32,7 @@ jax arrays of its own.
 from __future__ import annotations
 
 import zlib
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -70,14 +76,22 @@ def cached_attention(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
     additive mask; stale bytes past the valid prefix (slot-reuse
     leftovers) are unreachable by construction.
     """
+    return _masked_attention(q, cache_k, cache_v, positions)
+
+
+def _masked_attention(q: jax.Array, keys: jax.Array, vals: jax.Array,
+                      positions: jax.Array) -> jax.Array:
+    """Shared body of the slotted and paged reads: causal attention of
+    `T` query tokens over each row's `[B, L, H_kv, D]` key/value view,
+    valid positions `[0, positions[b] + t]` only."""
     B, T, H, D = q.shape
-    L, KV = cache_k.shape[1], cache_k.shape[2]
+    L, KV = keys.shape[1], keys.shape[2]
     if KV != H:
-        cache_k = jnp.repeat(cache_k, H // KV, axis=2)
-        cache_v = jnp.repeat(cache_v, H // KV, axis=2)
+        keys = jnp.repeat(keys, H // KV, axis=2)
+        vals = jnp.repeat(vals, H // KV, axis=2)
     qf = q.astype(jnp.float32)
-    kf = cache_k.astype(jnp.float32)
-    vf = cache_v.astype(jnp.float32)
+    kf = keys.astype(jnp.float32)
+    vf = vals.astype(jnp.float32)
     scores = jnp.einsum("bthd,bjhd->bhtj", qf, kf) / np.sqrt(D)
     valid = jnp.arange(L)[None, None, None, :] <= (
         positions[:, None, None, None] + jnp.arange(T)[None, None, :, None])
@@ -85,6 +99,102 @@ def cached_attention(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhtj,bjhd->bthd", probs, vf)
     return out.astype(q.dtype)
+
+
+# -- paged (block) storage ---------------------------------------------------
+
+def write_kv_paged(pool_k: jax.Array, pool_v: jax.Array, k_new: jax.Array,
+                   v_new: jax.Array, positions: jax.Array,
+                   update_mask: jax.Array, block_tables: jax.Array):
+    """Scatter `T` new K/V vectors per row into the block pool.
+
+    pool_k/pool_v: [num_blocks, block_size, H_kv, D]; k_new/v_new:
+    [B, T, H_kv, D]; positions: [B] int32 — row b's token t lands at
+    virtual position positions[b] + t, i.e. pool slot
+    ``(block_tables[b, p // bs], p % bs)``; block_tables:
+    [B, blocks_per_seq] int32, -1 for unassigned entries. Writes whose
+    row mask is False, whose virtual position runs past the table, or
+    whose table entry is -1 are DROPPED (never land anywhere) — the
+    paged analog of the slotted update_mask discipline, which is what
+    keeps bucket-padding garbage out of other sequences' blocks.
+    Returns the updated (pool_k, pool_v).
+    """
+    NB, BS = pool_k.shape[0], pool_k.shape[1]
+    B, T = k_new.shape[0], k_new.shape[1]
+    nblk = block_tables.shape[1]
+    abs_pos = positions[:, None] + jnp.arange(T, dtype=positions.dtype)[None]
+    blk_idx = abs_pos // BS                                   # [B, T]
+    off = abs_pos % BS
+    safe_idx = jnp.clip(blk_idx, 0, nblk - 1)
+    blocks = jnp.take_along_axis(block_tables, safe_idx, axis=1)  # [B, T]
+    valid = (update_mask[:, None] & (blk_idx < nblk) & (blocks >= 0))
+    flat = blocks * BS + off
+    # invalid writes get an out-of-range index and mode="drop" discards
+    # them at the scatter (deterministic on every backend)
+    flat = jnp.where(valid, flat, NB * BS).reshape(-1)
+
+    def scatter(pool, new):
+        out = pool.reshape(NB * BS, *pool.shape[2:]).at[flat].set(
+            new.reshape(B * T, *new.shape[2:]).astype(pool.dtype),
+            mode="drop")
+        return out.reshape(pool.shape)
+
+    return scatter(pool_k, k_new), scatter(pool_v, v_new)
+
+
+def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                    block_tables: jax.Array,
+                    positions: jax.Array) -> jax.Array:
+    """Block-table-aware masked attention over the pooled cache.
+
+    Gathers each row's blocks into a contiguous
+    ``[B, blocks_per_seq * block_size, H_kv, D]`` view and applies the
+    same positional validity mask as the slotted read. Unassigned table
+    entries (-1) are sanitized to block 0; whatever they gather is
+    unreachable — a sequence's valid prefix never extends past its
+    assigned blocks.
+    """
+    NB, BS = pool_k.shape[0], pool_k.shape[1]
+    B, nblk = block_tables.shape
+    tbl = jnp.maximum(block_tables, 0)
+    keys = pool_k[tbl].reshape(B, nblk * BS, *pool_k.shape[2:])
+    vals = pool_v[tbl].reshape(B, nblk * BS, *pool_v.shape[2:])
+    return _masked_attention(q, keys, vals, positions)
+
+
+def pool_blocks_for(max_batch: int, max_len: int, block_size: int,
+                    fraction: float = 0.5) -> int:
+    """A sane device pool size: ``fraction`` of the slotted layout's
+    ``max_batch x max_len`` worst case (the whole point of paging is to
+    provision for tokens actually resident), floored so every row can
+    hold at least one block plus headroom for a shared prefix run."""
+    worst = max_batch * -(-max_len // block_size)
+    want = int(worst * fraction)
+    return max(want, 2 * max_batch, -(-max_len // block_size) + max_batch)
+
+
+def paged_model_kwargs(max_batch: int, max_len: int, *, config=None,
+                       fraction: float = 0.5) -> dict:
+    """The HOROVOD_SERVE_KV_BLOCK knob's one consumer: model-config
+    kwargs for the serving layout the environment asks for — ``{}``
+    when the knob is 0 (slotted), else ``kv_block_size`` plus a
+    :func:`pool_blocks_for`-provisioned ``kv_pool_blocks``. The model
+    config stays authoritative (the pool shape is static and compiles
+    into every serving program); this is the one place the env knob
+    becomes device-array shapes::
+
+        cfg = GPTConfig(decode=True, **kw,
+                        **paged_model_kwargs(max_batch, max_len))
+    """
+    if config is None:
+        from ..core.config import Config
+        config = Config.from_env()
+    bs = int(config.serve_kv_block)
+    if bs <= 0:
+        return {}
+    return {"kv_block_size": bs,
+            "kv_pool_blocks": pool_blocks_for(max_batch, max_len, bs,
+                                              fraction)}
 
 
 class SlotKVCache:
@@ -120,16 +230,36 @@ class SlotKVCache:
         #: with kv_crc enabled; the chaos serve.kv corrupt fault is
         #: what this must catch (docs/serving.md).
         self._crc: Dict[int, List[int]] = {}
+        #: per-slot high-water mark of positions the ledger covers —
+        #: what lets verify-on-read know how far to re-read when the
+        #: speculative verify step wrote past the accepted prefix
+        self._crc_filled: Dict[int, int] = {}
 
     # -- per-slot integrity (crc-on-write / verify-on-read option) ----------
-    def crc_update(self, slot: int, leaf_bytes: Sequence[bytes]) -> None:
+    def crc_filled(self, slot: int) -> int:
+        return self._crc_filled.get(slot, 0)
+
+    def crc_update(self, slot: int, leaf_bytes: Sequence[bytes],
+                   new_filled: Optional[int] = None) -> None:
         """Fold the bytes just written to ``slot`` (one entry per cache
-        leaf, in leaf order) into the slot's running crc32s."""
+        leaf, in leaf order) into the slot's running crc32s. The caller
+        guarantees the bytes extend the stream contiguously;
+        ``new_filled`` records the covered prefix length."""
         cur = self._crc.get(slot)
         if cur is None:
             cur = self._crc[slot] = [0] * len(leaf_bytes)
         for i, raw in enumerate(leaf_bytes):
             cur[i] = zlib.crc32(raw, cur[i])
+        if new_filled is not None:
+            self._crc_filled[slot] = new_filled
+
+    def crc_reset(self, slot: int, leaf_bytes: Sequence[bytes],
+                  filled: int) -> None:
+        """Recompute the ledger from a full re-read of positions
+        [0, filled) — the speculative-rollback path (an overwrite below
+        the high-water mark breaks the append-only stream)."""
+        self._crc[slot] = [zlib.crc32(raw) for raw in leaf_bytes]
+        self._crc_filled[slot] = filled
 
     def crc_check(self, slot: int, leaf_bytes: Sequence[bytes]) -> bool:
         """Verify a full re-read of ``slot``'s valid prefix (one entry
@@ -153,6 +283,7 @@ class SlotKVCache:
         self.generation[slot] += 1
         self.allocs += 1
         self._crc.pop(slot, None)   # the new owner's ledger starts empty
+        self._crc_filled.pop(slot, None)
         self.peak_live = max(self.peak_live, self.live())
         return slot
 
@@ -170,3 +301,274 @@ class SlotKVCache:
     def occupancy(self) -> float:
         """Live slots / total slots — the batch-occupancy counter."""
         return self.live() / self.num_slots
+
+
+class BlockPool:
+    """Host-side free-list allocator over the device block pool.
+
+    Blocks are REFCOUNTED: a block is held by the sequence that wrote
+    it, plus one count per radix-prefix-cache node referencing it, plus
+    one per additional sequence sharing it. It returns to the free list
+    only when the last reference drops, so a shared system-prompt run
+    can never be handed to a new owner while anyone still reads it.
+
+    Also owns the per-BLOCK crc ledger (the PR 8 per-slot ledger moved
+    to block granularity): one running crc32 per cache leaf per block
+    over the block's written prefix (``filled`` positions). Keyed by
+    pool index, so a shared block carries ONE ledger entry no matter
+    how many sequences reference it, and verify-on-read of a sequence
+    covers its shared prefix for free.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1; got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1; got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO reuse, same rationale as SlotKVCache
+        self._free: List[int] = list(range(num_blocks))[::-1]
+        self.refcount = np.zeros(num_blocks, dtype=np.int32)
+        self.allocs = 0
+        self.frees = 0
+        self.peak_in_use = 0
+        #: block -> (filled positions, [running crc32 per cache leaf])
+        self._crc: Dict[int, Tuple[int, List[int]]] = {}
+
+    # -- allocation ----------------------------------------------------------
+    def alloc(self) -> Optional[int]:
+        """Claim a free block (None when exhausted); refcount starts at
+        1 (the caller's reference). Stale bytes need no clearing —
+        positional masking makes them unreachable."""
+        if not self._free:
+            return None
+        blk = self._free.pop()
+        assert self.refcount[blk] == 0, \
+            f"free list handed out in-use block {blk}"
+        self.refcount[blk] = 1
+        self.allocs += 1
+        self._crc.pop(blk, None)
+        self.peak_in_use = max(self.peak_in_use, self.in_use())
+        return blk
+
+    def incref(self, blk: int) -> None:
+        if self.refcount[blk] < 1:
+            raise ValueError(f"block {blk} is not live")
+        self.refcount[blk] += 1
+
+    def decref(self, blk: int) -> bool:
+        """Drop one reference; returns True when the block was freed."""
+        if self.refcount[blk] < 1:
+            raise ValueError(f"block {blk} is not live")
+        self.refcount[blk] -= 1
+        if self.refcount[blk] == 0:
+            self._free.append(blk)
+            self.frees += 1
+            self._crc.pop(blk, None)
+            return True
+        return False
+
+    def in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def occupancy(self) -> float:
+        return self.in_use() / self.num_blocks
+
+    # -- per-block integrity ledger ------------------------------------------
+    def crc_filled(self, blk: int) -> int:
+        ent = self._crc.get(blk)
+        return 0 if ent is None else ent[0]
+
+    def crc_stream(self, blk: int, leaf_bytes: Sequence[bytes],
+                   new_filled: int) -> None:
+        """Fold bytes just written at positions [filled, new_filled) of
+        ``blk`` (one entry per cache leaf, leaf order) into the block's
+        running crcs. The caller guarantees the bytes ARE that range."""
+        ent = self._crc.get(blk)
+        crcs = [0] * len(leaf_bytes) if ent is None else ent[1]
+        for i, raw in enumerate(leaf_bytes):
+            crcs[i] = zlib.crc32(raw, crcs[i])
+        self._crc[blk] = (new_filled, crcs)
+
+    def crc_reset(self, blk: int, leaf_bytes: Sequence[bytes],
+                  filled: int) -> None:
+        """Recompute the ledger from a full re-read of positions
+        [0, filled) — the rollback path (speculative decode overwrites
+        rejected positions, which breaks the append-only stream)."""
+        self._crc[blk] = (filled, [zlib.crc32(raw) for raw in leaf_bytes])
+
+    def crc_clone(self, src: int, dst: int) -> None:
+        """Copy-on-write bookkeeping: ``dst`` now holds byte-identical
+        content to ``src``'s written prefix."""
+        ent = self._crc.get(src)
+        if ent is not None:
+            self._crc[dst] = (ent[0], list(ent[1]))
+        else:
+            self._crc.pop(dst, None)
+
+    def crc_check(self, blk: int, leaf_bytes: Sequence[bytes]) -> bool:
+        """Verify a re-read of ``blk``'s written prefix (positions
+        [0, crc_filled)) against the ledger. A block never written
+        checks clean."""
+        ent = self._crc.get(blk)
+        if ent is None:
+            return True
+        return len(ent[1]) == len(leaf_bytes) and all(
+            zlib.crc32(raw) == c for raw, c in zip(leaf_bytes, ent[1]))
+
+
+class PagedKVCache:
+    """Per-batcher paged sequence accounting over a :class:`BlockPool`.
+
+    Rows are decode-batch positions (the executor's fixed
+    ``max_batch``); each live row owns an ordered block list. Blocks
+    are allocated LAZILY as the sequence grows, but admission RESERVES
+    the row's worst-case block budget up front
+    (``prompt + max_new_tokens [+ speculative margin]``), so a running
+    sequence can never hit an empty pool mid-decode: the admission gate
+    (`can_admit`) only opens when free + evictable blocks cover every
+    outstanding reservation plus the newcomer. Peak bytes resident
+    still track blocks actually allocated — tokens, not slots x
+    max_len.
+
+    ``evictor`` (set by the batcher) is asked to release prefix-cache
+    blocks when the free list runs dry; with the reservation invariant
+    it must always be able to satisfy a reserved append.
+    """
+
+    def __init__(self, num_rows: int, blocks_per_seq: int,
+                 pool: BlockPool):
+        if num_rows < 1:
+            raise ValueError(f"num_rows must be >= 1; got {num_rows}")
+        self.num_rows = num_rows
+        self.blocks_per_seq = blocks_per_seq
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._free_rows: List[int] = list(range(num_rows))[::-1]
+        self.blocks: Dict[int, List[int]] = {}
+        #: per-row outstanding new-block reservation (worst case growth)
+        self.reserved: Dict[int, int] = {}
+        self.lengths = np.zeros(num_rows, dtype=np.int32)
+        self.active = np.zeros(num_rows, dtype=bool)
+        self.generation = np.zeros(num_rows, dtype=np.int64)
+        self.allocs = 0
+        self.frees = 0
+        self.peak_live = 0
+        #: batcher-installed hook: evict(n) -> blocks actually released
+        #: from the prefix cache back to the pool
+        self.evictor: Optional[Callable[[int], int]] = None
+        #: batcher-installed hook: evictable() -> prefix-cache blocks
+        #: releasable on demand (refcount held only by the cache)
+        self.evictable: Optional[Callable[[], int]] = None
+
+    # -- admission capacity (the free-BLOCK signal) --------------------------
+    def blocks_needed(self, tokens: int) -> int:
+        return -(-max(int(tokens), 1) // self.block_size)
+
+    def reserved_total(self) -> int:
+        return sum(self.reserved.values())
+
+    def available_blocks(self, evictable: Optional[int] = None) -> int:
+        """Free + evictable - reserved. Pass ``evictable`` to reuse a
+        snapshot across an admission wave — the live hook walks the
+        whole radix tree, and one walk per wave (not per candidate,
+        under the queue lock) is plenty; the batcher charges the wave's
+        own pins against the snapshot, which only ever under-admits."""
+        if evictable is None:
+            evictable = (self.evictable()
+                         if self.evictable is not None else 0)
+        return self.pool.free_count() + evictable - \
+            self.reserved_total()
+
+    def can_admit(self, new_blocks: int,
+                  evictable: Optional[int] = None) -> bool:
+        """True when a newcomer needing ``new_blocks`` fresh blocks fits
+        without ever starving an already-admitted sequence."""
+        return bool(self._free_rows) and \
+            self.available_blocks(evictable) >= new_blocks
+
+    # -- row lifecycle -------------------------------------------------------
+    def alloc_row(self, reserve_blocks: int) -> Optional[int]:
+        if not self._free_rows:
+            return None
+        row = self._free_rows.pop()
+        self.active[row] = True
+        self.lengths[row] = 0
+        self.generation[row] += 1
+        self.blocks[row] = []
+        self.reserved[row] = int(reserve_blocks)
+        self.allocs += 1
+        self.peak_live = max(self.peak_live, self.live())
+        return row
+
+    def attach_shared(self, row: int, blk: int) -> None:
+        """Append an already-referenced (shared prefix) block to the
+        row's table; the caller transferred one refcount to this row."""
+        self.blocks[row].append(blk)
+
+    def append_block(self, row: int) -> int:
+        """Allocate the row's next block from the pool, evicting
+        prefix-cache runs when the free list is dry. Guaranteed to
+        succeed for reserved growth (the admission invariant)."""
+        blk = self.pool.alloc()
+        if blk is None and self.evictor is not None:
+            self.evictor(1)
+            blk = self.pool.alloc()
+        if blk is None:
+            raise RuntimeError(
+                "paged KV pool exhausted on a RESERVED append — the "
+                "admission gate must make this unreachable")
+        self.blocks[row].append(blk)
+        if self.reserved.get(row, 0) > 0:
+            self.reserved[row] -= 1
+        return blk
+
+    def ensure(self, row: int, tokens: int) -> List[int]:
+        """Grow the row's table to cover ``tokens`` virtual positions;
+        returns the pool indices of any newly allocated blocks."""
+        fresh = []
+        while len(self.blocks[row]) * self.block_size < tokens:
+            fresh.append(self.append_block(row))
+        return fresh
+
+    def free_row(self, row: int) -> None:
+        """Release the row and every block reference it holds — shared
+        prefix blocks survive under the prefix cache's own refcount.
+        MUST run in the same scheduling iteration the sequence retires
+        (deadline-expired and shed sequences included): a leaked block
+        reference is capacity gone forever."""
+        if not self.active[row]:
+            raise ValueError(f"row {row} is not live")
+        for blk in self.blocks.pop(row, []):
+            self.pool.decref(blk)
+        self.reserved.pop(row, None)
+        self.active[row] = False
+        self.lengths[row] = 0
+        self._free_rows.append(row)
+        self.frees += 1
+
+    # -- views ---------------------------------------------------------------
+    def table(self) -> np.ndarray:
+        """The `[num_rows, blocks_per_seq]` int32 block-table matrix the
+        executor step consumes; -1 marks unassigned entries."""
+        t = np.full((self.num_rows, self.blocks_per_seq), -1, np.int32)
+        for row, blks in self.blocks.items():
+            t[row, :len(blks)] = blks
+        return t
+
+    def live(self) -> int:
+        return self.num_rows - len(self._free_rows)
+
+    def occupancy(self) -> float:
+        """Blocks in use / pool size — the token-resident occupancy the
+        block-occupancy gauge exports (NOT a row count: rows are free,
+        memory is not)."""
+        return self.pool.occupancy()
+
+    @property
+    def num_slots(self) -> int:   # row-capacity view (fleet/http compat)
+        return self.num_rows

@@ -1,0 +1,285 @@
+"""Radix prefix cache: shared system prompts are computed once.
+
+A host-side radix tree over prompt TOKEN IDS at block granularity —
+each node is one full KV block (``block_size`` consecutive tokens) plus
+the pool index holding that block's K/V. A prefill whose prompt walks
+down an existing path COPIES BLOCK REFERENCES instead of recomputing
+attention: the matched run joins the new sequence's block table with a
+refcount each (serve/kv_cache.py ``BlockPool``), and only the suffix
+past the match is fed to the model. Correctness rests on causality —
+a block's K/V depends only on the tokens at and before it, and both
+model families cache position-absolute values (learned positions /
+post-RoPE keys), so a shared block is valid verbatim for every sequence
+sharing that token prefix.
+
+Three policies the serving contract needs:
+
+* **Copy-on-write at the divergence block.** When the match ends
+  MID-block (the prompt diverges inside a cached block, or simply ends
+  there), the partially matching block is CoW'd: a fresh block is
+  allocated, the cached one is device-copied onto it
+  (``executor.copy_kv_block``), and the sequence writes its divergent
+  tokens into the copy. The cached original is never written by a
+  non-owner — a refcount > 1 block is read-only by construction.
+* **LRU eviction of refcount-zero runs.** The tree holds one refcount
+  per node; a node whose block's ONLY reference is the tree itself
+  (pool refcount == 1) is evictable, leaves first, least-recently
+  matched first. `evictable_blocks()` feeds the paged admission gate,
+  so cached-but-unreferenced runs count as free capacity.
+* **Version fencing.** Cached K/V is only valid for the params that
+  computed it: the batcher flushes this cache whenever
+  ``swap_params`` adopts a new version (and the fleet router flushes a
+  recovering replica before re-admission) — stale-weight KV can never
+  serve a new model version (docs/serving.md).
+
+Single-threaded by design: every method runs on the batcher's
+scheduling thread, the same one-writer discipline as the block
+allocator.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+from .kv_cache import BlockPool
+
+
+class _Node:
+    __slots__ = ("tokens", "block", "children", "parent", "last_used")
+
+    def __init__(self, tokens: Tuple[int, ...], block: int,
+                 parent: Optional["_Node"]):
+        self.tokens = tokens
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Block-granularity radix tree over prompt token ids."""
+
+    def __init__(self, pool: BlockPool,
+                 replica_id: Optional[int] = None):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._children: Dict[Tuple[int, ...], _Node] = {}   # root level
+        self._nodes = 0
+        self._tick = 0
+        # -- counters (obs): standalone stacks claim fresh, fleet
+        # replicas get labeled children (the serve-wide discipline)
+        rl = {} if replica_id is None else {"replica": str(replica_id)}
+        R = obs_metrics.get_registry()
+        if replica_id is None:
+            for fam in ("hvd_serve_prefix_hits_total",
+                        "hvd_serve_prefix_misses_total",
+                        "hvd_serve_prefix_tokens_saved_total",
+                        "hvd_serve_prefix_evictions_total"):
+                R.unregister(fam)
+        self._m_hits = R.counter(
+            "hvd_serve_prefix_hits_total",
+            "prefills that reused at least one cached prefix block",
+            rl or None)
+        self._m_misses = R.counter(
+            "hvd_serve_prefix_misses_total",
+            "prefills that matched no cached prefix", rl or None)
+        self._m_saved = R.counter(
+            "hvd_serve_prefix_tokens_saved_total",
+            "prompt tokens served from cached KV instead of recompute",
+            rl or None)
+        self._m_evict = R.counter(
+            "hvd_serve_prefix_evictions_total",
+            "prefix blocks evicted (LRU, refcount-zero runs)", rl or None)
+
+    # -- introspection -------------------------------------------------------
+    def __len__(self) -> int:
+        return self._nodes
+
+    @property
+    def hits(self) -> int:
+        return int(self._m_hits.value)
+
+    @property
+    def misses(self) -> int:
+        return int(self._m_misses.value)
+
+    @property
+    def tokens_saved(self) -> int:
+        return int(self._m_saved.value)
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.last_used = self._tick
+
+    # -- lookup --------------------------------------------------------------
+    def match(self, prompt) -> Tuple[List[int], Optional[Tuple[int, int]],
+                                     int]:
+        """Longest cached prefix of ``prompt``, capped at
+        ``len(prompt) - 1`` tokens (at least one prompt token must be
+        prefilled so the request has a last-logit to sample from).
+
+        Returns ``(full_blocks, partial, matched_tokens)`` where
+        ``full_blocks`` are pool indices whose refcount was BUMPED for
+        the caller (they become the sequence's references), and
+        ``partial`` is ``(block, tokens_matched_in_block)`` for a
+        mid-block match — also bumped, but as a TEMPORARY pin the
+        caller must drop after the copy-on-write copy (the pin
+        guarantees eviction cannot free the source mid-wave).
+
+        Hit/miss accounting is the caller's (`note_lookup`): a match
+        whose admission falls through must not count as a hit.
+        """
+        bs = self.block_size
+        cap = len(prompt) - 1
+        full: List[int] = []
+        children = self._children
+        pos = 0
+        node = None
+        while pos + bs <= cap:
+            seg = tuple(int(t) for t in prompt[pos:pos + bs])
+            child = children.get(seg)
+            if child is None:
+                break
+            self.pool.incref(child.block)
+            self._touch(child)
+            full.append(child.block)
+            node = child
+            children = child.children
+            pos += bs
+        # partial (copy-on-write) match inside the next block
+        partial: Optional[Tuple[int, int]] = None
+        want = [int(t) for t in prompt[pos:cap]]
+        if want:
+            best, best_j = None, 0
+            for child in children.values():
+                j = 0
+                for a, b in zip(child.tokens, want):
+                    if a != b:
+                        break
+                    j += 1
+                if j > best_j:
+                    best, best_j = child, j
+            if best is not None:
+                self.pool.incref(best.block)      # temp pin, see above
+                self._touch(best)
+                partial = (best.block, best_j)
+                pos += best_j
+        return full, partial, pos
+
+    def note_lookup(self, matched_tokens: int) -> None:
+        """Fold one ADMITTED prefill into the hit/miss/tokens-saved
+        counters (docs/metrics.md)."""
+        if matched_tokens > 0:
+            self._m_hits.inc()
+            self._m_saved.inc(matched_tokens)
+        else:
+            self._m_misses.inc()
+
+    def release(self, blocks) -> None:
+        """Drop references handed out by :meth:`match` (an admission
+        that fell through, or the CoW temp pin after the copy)."""
+        for blk in blocks:
+            self.pool.decref(blk)
+
+    # -- insertion -----------------------------------------------------------
+    def insert(self, prompt, seq_blocks: List[int]) -> int:
+        """Record ``prompt``'s full blocks (computed KV now resident in
+        ``seq_blocks``, the sequence's table) into the tree; each newly
+        created node takes its own refcount on the block. Existing
+        nodes win (first writer of a prefix keeps it — contents are
+        identical by construction). Returns nodes created."""
+        bs = self.block_size
+        children = self._children
+        parent: Optional[_Node] = None
+        created = 0
+        pos = 0
+        while pos + bs <= len(prompt) and (pos // bs) < len(seq_blocks):
+            seg = tuple(int(t) for t in prompt[pos:pos + bs])
+            child = children.get(seg)
+            if child is None:
+                blk = seq_blocks[pos // bs]
+                self.pool.incref(blk)
+                child = _Node(seg, blk, parent)
+                children[seg] = child
+                self._nodes += 1
+                created += 1
+            self._touch(child)
+            parent = child
+            children = child.children
+            pos += bs
+        return created
+
+    # -- eviction ------------------------------------------------------------
+    def _leaves(self) -> List[_Node]:
+        out: List[_Node] = []
+        stack = list(self._children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                out.append(n)
+        return out
+
+    def evictable_blocks(self) -> int:
+        """Blocks releasable on demand: nodes whose subtree holds no
+        externally referenced block (pool refcount > 1 anywhere below
+        pins the whole path — leaf-first eviction cannot reach it).
+        Iterative post-order: the tree is a chain of prompt_len /
+        block_size nodes per cached prompt, deep enough to blow the
+        recursion limit on a long system prompt."""
+        count = 0
+        ok: Dict[int, bool] = {}            # id(node) -> subtree clear
+        stack = [(n, False) for n in self._children.values()]
+        while stack:
+            node, visited = stack.pop()
+            if not visited:
+                stack.append((node, True))
+                stack.extend((ch, False)
+                             for ch in node.children.values())
+                continue
+            good = self.pool.refcount[node.block] == 1 and all(
+                ok[id(ch)] for ch in node.children.values())
+            ok[id(node)] = good
+            if good:
+                count += 1
+        return count
+
+    def evict(self, n_blocks: int) -> int:
+        """Release at least ``n_blocks`` back to the pool if possible:
+        LRU leaves first, cascading up as parents become leaves.
+        Returns blocks actually freed."""
+        freed = 0
+        while freed < n_blocks:
+            cands = [lf for lf in self._leaves()
+                     if self.pool.refcount[lf.block] == 1]
+            if not cands:
+                break
+            victim = min(cands, key=lambda lf: lf.last_used)
+            self._remove(victim)
+            freed += 1
+            self._m_evict.inc()
+        return freed
+
+    def _remove(self, node: _Node) -> None:
+        siblings = (node.parent.children if node.parent is not None
+                    else self._children)
+        siblings.pop(node.tokens, None)
+        self.pool.decref(node.block)
+        self._nodes -= 1
+
+    def flush(self) -> int:
+        """Drop EVERY cached run (weight-swap invalidation): all tree
+        references return to the pool; blocks still shared by live
+        sequences survive under their owners' refcounts and die with
+        them. Returns nodes dropped."""
+        dropped = 0
+        stack = list(self._children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self.pool.decref(n.block)
+            dropped += 1
+        self._children = {}
+        self._nodes = 0
+        return dropped

@@ -258,22 +258,41 @@ class AdmissionQueue:
     # -- consumer (batcher) side -------------------------------------------
     def pop(self, n: int) -> List[ServeRequest]:
         """Take up to `n` requests FIFO. Already-expired requests are
-        resolved "expired" here and do not count against `n`.
+        resolved "expired" here and do not count against `n`."""
+        return self.pop_fitting(n, lambda req: True)
 
-        Handle resolution (and therefore any ``on_resolve`` hook) runs
-        AFTER the queue lock is released: the fleet router's hook takes
-        its own lock and may submit back into a queue, so resolving
-        under this lock would invert the router->queue lock order."""
+    def pop_fitting(self, n: int,
+                    fits: Callable[[ServeRequest], bool]
+                    ) -> List[ServeRequest]:
+        """Take up to `n` unexpired requests FIFO, stopping at the
+        FIRST one ``fits`` rejects — the paged-KV admission gate:
+        capacity is measured in free BLOCKS (can this prompt + its
+        generation budget be allocated without starving a running
+        sequence?), not free slots, and a too-big head request is never
+        queue-jumped (FIFO fairness; it admits once blocks free up).
+        Already-expired requests are resolved "expired" and count
+        against nothing.
+
+        ``fits`` runs under the queue lock and must not take locks of
+        its own. Handle resolution (and therefore any ``on_resolve``
+        hook) runs AFTER the queue lock is released: the fleet
+        router's hook takes its own lock and may submit back into a
+        queue, so resolving under this lock would invert the
+        router->queue lock order."""
         out: List[ServeRequest] = []
         dead: List[ServeRequest] = []
         with self._lock:
             now = time.monotonic()
             while self._dq and len(out) < n:
-                req = self._dq.popleft()
+                req = self._dq[0]
                 if req.expired(now):
+                    self._dq.popleft()
                     self._m_expired.inc()
                     dead.append(req)
                     continue
+                if not fits(req):
+                    break
+                self._dq.popleft()
                 out.append(req)
             self._m_depth.set(len(self._dq))
             if not self._dq:

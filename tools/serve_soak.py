@@ -1,7 +1,11 @@
 #!/usr/bin/env python
 """Serving SLO soak CLI: drive an N-replica serve fleet through a
 seeded serve-profile chaos plan under closed-loop traffic and print the
-JSON verdict (exit 0 iff every invariant held).
+JSON verdict (exit 0 iff every invariant held). The default
+configuration is the full serving tier — paged KV blocks + radix
+prefix cache + speculative decoding — so this soak is the regression
+harness for those paths; `--slotted` / `--no-prefix-cache` /
+`--spec-k 0` peel the layers back off.
 
     python tools/serve_soak.py --replicas 3 --clients 6 --seed 7
     python tools/serve_soak.py --plan my_serve_plan.json --out /tmp/s1
@@ -53,8 +57,16 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None,
                    help="dump events/requests/verdict into this dir")
     p.add_argument("--no-kv-crc", action="store_true",
-                   help="disable the per-slot KV crc (the corrupt "
+                   help="disable the KV crc ledger (the corrupt "
                         "invariant will fail — for demonstration only)")
+    p.add_argument("--slotted", action="store_true",
+                   help="run the legacy slotted KV layout instead of "
+                        "the default paged block pool")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the radix prefix cache (paged only)")
+    p.add_argument("--spec-k", type=int, default=3,
+                   help="speculative draft depth (0 disables the "
+                        "drafter; default 3)")
     args = p.parse_args(argv)
 
     # one in-process fleet on CPU devices; keep the run reproducible
@@ -72,6 +84,9 @@ def main(argv=None) -> int:
         min_duration_s=args.min_duration,
         max_duration_s=args.max_duration,
         kv_crc=False if args.no_kv_crc else None,
+        paged=not args.slotted,
+        prefix_cache=False if args.no_prefix_cache else None,
+        spec_k=args.spec_k,
         sigterm_drain=True)
     json.dump(verdict, sys.stdout, indent=2, sort_keys=True)
     print()
