@@ -25,7 +25,10 @@ asserted — exit nonzero on violation, docs/serving.md),
 `--serve-soak` the chaos-hardened fleet soak (serve_p99_under_fault_ms
 + failover_ms from a seeded crash/partition/corrupt/slow incident,
 now paged+prefix+speculative by default —
-docs/serving.md), `--ckpt`
+docs/serving.md), `--serve-fleet` the MULTI-PROCESS fleet loopback
+(fleet_failover_ms + degraded-capacity shed rate from real replica
+worker processes under a seeded SIGKILL + dispatch blips —
+docs/serving.md process-fleet section), `--ckpt`
 the checkpoint-plane loopback (ckpt_save_ms / ckpt_blocking_ms /
 ckpt_restore_ms — docs/checkpoint.md), `--collectives` the
 collective-algorithm microbench (bytes/s per algorithm x tensor size
@@ -275,6 +278,83 @@ def run_serve_soak_benchmark() -> int:
         for metric in ("serve_p99_under_fault_ms", "failover_ms"):
             print(json.dumps({"metric": metric, "value": None,
                               "unit": "ms", "error": str(e)[-500:]}),
+                  flush=True)
+        return 1
+
+
+def run_fleet_benchmark() -> int:
+    """Multi-process fleet benchmark (`bench.py --serve-fleet`): run
+    the PROCESS-fleet soak (horovod_tpu/serve/soak.py run_fleet_soak —
+    real replica worker processes, a seeded SIGKILL of one worker plus
+    conn_reset/flaky blips on the dispatch wire) and print JSON metric
+    lines from the real-process loopback:
+
+    * ``fleet_failover_ms`` — worker SIGKILL -> accrual ejection +
+      in-flight re-enqueued (the O(heartbeat) detection bound, across
+      a REAL process boundary);
+    * ``fleet_shed_rate_degraded`` — the fraction of requests shed
+      (always with retry_after_ms, capacity-scaled) while the fleet
+      ran at degraded capacity — graceful degradation, quantified;
+    * ``fleet_dispatch_absorbed`` — transient dispatch blips absorbed
+      by the retry ladder with zero failovers.
+
+    Exits non-zero when the soak verdict itself is red."""
+    try:
+        from horovod_tpu.serve.soak import run_fleet_soak
+        replicas = int(os.environ.get("HVD_BENCH_FLEET_REPLICAS", "2"))
+        clients = int(os.environ.get("HVD_BENCH_FLEET_CLIENTS", "4"))
+        seed = int(os.environ.get("HVD_BENCH_FLEET_SEED", "7"))
+        verdict = run_fleet_soak(replicas=replicas, clients=clients,
+                                 seed=seed)
+        # shed rate while degraded: sheds over submissions inside the
+        # window from the first ejection to the victim's re-admission
+        evs = []
+        try:
+            with open(os.path.join(verdict["out_dir"],
+                                   "events.jsonl")) as f:
+                evs = [json.loads(x) for x in f if x.strip()]
+            with open(os.path.join(verdict["out_dir"],
+                                   "requests.jsonl")) as f:
+                reqs = [json.loads(x) for x in f if x.strip()]
+        except OSError:
+            reqs = []
+        t0 = next((e["t"] for e in evs if e.get("event") == "eject"),
+                  None)
+        t1 = next((e["t"] for e in evs if e.get("event") == "readmit"),
+                  None)
+        shed_rate = None
+        if t0 is not None and t1 is not None and reqs:
+            # request records and events both carry wall-clock stamps
+            inside = [r for r in reqs if t0 <= r["t0"] <= t1]
+            if inside:
+                shed = [r for r in inside
+                        if r["status"] in ("shed", "rejected")]
+                shed_rate = round(len(shed) / len(inside), 4)
+        common = {"replicas": replicas, "clients": clients,
+                  "seed": seed, "soak_ok": verdict["ok"],
+                  "failovers": verdict["fleet"]["failovers"],
+                  "respawns": verdict["fleet"]["respawns"],
+                  "submitted": verdict["submitted"],
+                  "wall_s": verdict["wall_s"]}
+        fo_ms = None if verdict.get("failover_s") is None \
+            else round(verdict["failover_s"] * 1000.0, 1)
+        print(json.dumps({
+            "metric": "fleet_failover_ms", "value": fo_ms,
+            "unit": "ms", **common}), flush=True)
+        print(json.dumps({
+            "metric": "fleet_shed_rate_degraded", "value": shed_rate,
+            "unit": "fraction", **common}), flush=True)
+        print(json.dumps({
+            "metric": "fleet_dispatch_absorbed",
+            "value": verdict["dispatch_absorbed"], "unit": "count",
+            **common}), flush=True)
+        return 0 if verdict["ok"] else 1
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        for metric, unit in (("fleet_failover_ms", "ms"),
+                             ("fleet_shed_rate_degraded", "fraction"),
+                             ("fleet_dispatch_absorbed", "count")):
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": unit, "error": str(e)[-500:]}),
                   flush=True)
         return 1
 
@@ -1002,6 +1082,9 @@ if __name__ == "__main__":
     elif "--serve-soak" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE_SOAK") == "1":
         sys.exit(run_serve_soak_benchmark())
+    elif "--serve-fleet" in sys.argv or \
+            os.environ.get("HVD_BENCH_SERVE_FLEET") == "1":
+        sys.exit(run_fleet_benchmark())
     elif "--serve" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE") == "1":
         sys.exit(run_serve_benchmark())

@@ -43,6 +43,20 @@ boundaries:
   replica are refused for the window), ``serve.admit`` delay/drop at
   the queue door. Serve faults address replicas via ``peer``; guards
   pass the replica-local invocation counter explicitly.
+* ``serve.proc`` / ``serve.dispatch`` — the MULTI-PROCESS fleet's
+  boundaries (serve/proc_fleet.py, serve/worker.py): ``serve.proc``
+  fires inside the replica WORKER PROCESS once per scheduler
+  iteration, and ``crash`` there is interpreted by the worker's guard
+  as a real ``os.kill(getpid(), SIGKILL)`` — safe precisely because
+  that process IS the replica, unlike the in-process serve sites where
+  a SIGKILL would take the router down too (fire() still returns
+  serve.* crashes to the caller; the worker's guard pulls the
+  trigger). ``serve.dispatch`` fires in the ROUTER process on its wire
+  to one replica: ``conn_reset`` really severs the dispatch socket
+  after the request frame was sent (the reply is lost — the retry
+  ladder must re-dial and be served the replica's DEDUPED result),
+  ``flaky`` drops the dispatch before it is sent, ``jitter``/``delay``
+  sleep.
 
 The guards read a single module attribute (``_INJ is not None``) when
 disarmed, execute no other code, and never touch the payload — the

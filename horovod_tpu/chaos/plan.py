@@ -66,21 +66,35 @@ FAULT_SITES = ("step", "store.request", "p2p.send", "p2p.recv",
                # queue submits (serve.admit) — the guards pass the
                # replica-local counter explicitly, so addressing stays
                # deterministic per replica across the whole fleet.
-               "serve.step", "serve.kv", "serve.route", "serve.admit")
+               "serve.step", "serve.kv", "serve.route", "serve.admit",
+               # multi-process fleet (serve/proc_fleet.py): serve.proc
+               # fires inside the REPLICA WORKER PROCESS at its own
+               # scheduler-iteration boundary — crash there is a REAL
+               # os.kill(SIGKILL) of the worker, the host-loss scenario
+               # the accrual heartbeat sweep must detect; serve.dispatch
+               # fires in the ROUTER process on its wire to one replica
+               # (peer), where conn_reset/flaky sever the live dispatch
+               # socket and the native/resilience.py ladder must absorb
+               # the blip WITHOUT a failover.
+               "serve.proc", "serve.dispatch")
 
 #: which kinds are meaningful at which sites (a drop needs a connection
 #: to sever; a torn write needs a shard file; a KV corruption needs a
 #: cache slot; ...)
 _KIND_SITES = {
     "delay": FAULT_SITES,
-    "slow_rank": ("step", "serve.step"),
-    # serve-plane crashes land ONLY at serve.step (the scheduler loop,
-    # where the guard raises ReplicaDead): at the other serve sites no
-    # guard acts on a returned crash, so validating it there would let
-    # fire() record a "crash" that kills nothing — a soak could then
-    # prove recovery from a death that never happened
+    "slow_rank": ("step", "serve.step", "serve.proc"),
+    # serve-plane crashes land ONLY where a guard acts on them:
+    # serve.step (the scheduler loop raises ReplicaDead — the
+    # in-process replica-loss analog) and serve.proc (the worker
+    # PROCESS guard SIGKILLs itself — the real host loss of the
+    # multi-process fleet). At the other serve sites no guard acts on
+    # a returned crash, so validating it there would let fire() record
+    # a "crash" that kills nothing — a soak could then prove recovery
+    # from a death that never happened
     "crash": tuple(s for s in FAULT_SITES
-                   if not s.startswith("serve.")) + ("serve.step",),
+                   if not s.startswith("serve.")) + ("serve.step",
+                                                     "serve.proc"),
     "drop": ("store.request", "p2p.send", "p2p.recv",
              "redist.transport", "serve.admit"),
     "corrupt": ("store.request", "p2p.send", "redist.transport",
@@ -90,14 +104,14 @@ _KIND_SITES = {
     "torn_write": ("ckpt.write",),
     "delete_chunk": ("ckpt.commit",),
     # transient kinds land only where a retry ladder exists to absorb
-    # them: the store/coordinator client, the p2p ring, and redist's
-    # wire transports
+    # them: the store/coordinator client, the p2p ring, redist's wire
+    # transports, and the fleet router's dispatch channel
     "conn_reset": ("store.request", "p2p.send", "p2p.recv",
-                   "redist.transport"),
+                   "redist.transport", "serve.dispatch"),
     "flaky": ("store.request", "p2p.send", "p2p.recv",
-              "redist.transport"),
+              "redist.transport", "serve.dispatch"),
     "jitter": ("store.request", "p2p.send", "p2p.recv",
-               "redist.transport"),
+               "redist.transport", "serve.dispatch"),
 }
 
 #: kinds that require a positive "seconds" duration
@@ -293,7 +307,8 @@ class ChaosPlan:
 def random_plan(seed: int, world: int, steps: int, *,
                 commit_every: int = 2, crash: bool = True,
                 shard_delete: bool = True, noise: int = 2,
-                profile: str = "train") -> ChaosPlan:
+                profile: str = "train",
+                processes: bool = False) -> ChaosPlan:
     """A randomized-but-SEEDED soak plan: same (seed, world, steps,
     profile) => byte-identical schedule.
 
@@ -319,10 +334,22 @@ def random_plan(seed: int, world: int, steps: int, *,
     threshold, and an admission-queue drop — ``steps`` is the scheduler
     iteration horizon the crash/corrupt addresses land inside. All
     serve faults fire on plan rank 0 (the serving process) and address
-    replicas via ``peer``.
+    replicas via ``peer``. With ``processes=True`` the composition
+    becomes the MULTI-PROCESS fleet scenario (serve/proc_fleet.py):
+    one replica worker process SIGKILLed mid-traffic (``serve.proc``
+    crash — a real host loss the accrual heartbeat sweep must detect
+    and respawn from), a hard ``conn_reset`` plus a seeded ``flaky``
+    window on surviving replicas' DISPATCH channels (``serve.dispatch``
+    — blips the retry ladder must absorb with ZERO failovers), and an
+    admission-queue drop absorbed by router re-dispatch.
     """
     if profile == "serve":
-        return _random_serve_plan(seed, world, steps)
+        return _random_serve_plan(seed, world, steps,
+                                  processes=processes)
+    if processes:
+        raise PlanError(
+            f"random_plan processes=True is a serve-profile "
+            f"composition; got profile {profile!r}")
     if profile == "transient":
         return _random_transient_plan(seed, world, steps)
     if profile != "train":
@@ -416,12 +443,14 @@ def _random_transient_plan(seed: int, world: int, steps: int) -> ChaosPlan:
     return ChaosPlan(seed=seed, faults=faults)
 
 
-def _random_serve_plan(seed: int, replicas: int, steps: int) -> ChaosPlan:
+def _random_serve_plan(seed: int, replicas: int, steps: int,
+                       processes: bool = False) -> ChaosPlan:
     """The ``profile="serve"`` leg of :func:`random_plan`: the four
     disruptions the serving SLO soak must survive (replica killed
     mid-decode, router partition, KV corruption, slow host) plus one
     admission drop, every address derived from ``random.Random(seed)``
-    alone."""
+    alone. ``processes=True`` swaps in the multi-process composition
+    (worker SIGKILL + dispatch-channel blips, see :func:`random_plan`)."""
     if replicas < 2:
         raise PlanError(
             f"a serve plan needs >= 2 replicas (a fleet of one has "
@@ -431,6 +460,50 @@ def _random_serve_plan(seed: int, replicas: int, steps: int) -> ChaosPlan:
             f"a serve plan needs an iteration horizon >= 40 so the "
             f"crash lands before the corrupt; got {steps}")
     rng = random.Random(seed)
+    if processes:
+        victim = rng.randrange(replicas)
+        others = [r for r in range(replicas) if r != victim]
+        blipped = rng.choice(others)
+        flaked = rng.choice(others)
+        a = rng.randrange(20, 40)
+        faults = [
+            # SIGKILL one replica's worker PROCESS mid-traffic: its
+            # heartbeat key goes stale, the router's accrual sweep must
+            # eject within 2x suspect_s, respawn a fresh process, and
+            # re-admit it on the newest published weight version.
+            # epoch=0 pins the kill to the worker's FIRST incarnation
+            # (workers install the injector with epoch=generation): the
+            # respawn's fresh iteration counter re-crosses the same
+            # 'at' address, and without the pin the victim would
+            # SIGKILL itself again every generation, forever
+            Fault(rank=0, site="serve.proc", kind="crash", peer=victim,
+                  at=rng.randrange(steps // 4, steps // 2), epoch=0),
+            # hard reset on a SURVIVOR's dispatch channel: the request
+            # was sent, the reply socket is severed — the retry ladder
+            # must re-dial and be served the deduped result, with ZERO
+            # failovers and zero duplicate deliveries
+            Fault(rank=0, site="serve.dispatch", kind="conn_reset",
+                  peer=blipped, at=rng.randrange(4, 14)),
+            # seeded flaky window on another survivor's channel:
+            # per-dispatch drops the ladder absorbs in milliseconds.
+            # The window is kept NARROWER than the ladder's depth
+            # (default 4 retries) so even a worst-case all-drops window
+            # still resolves within one request's ladder — blips must
+            # never be able to exhaust into a failover by construction
+            Fault(rank=0, site="serve.dispatch", kind="flaky",
+                  peer=flaked, prob=round(rng.uniform(0.4, 0.6), 2),
+                  after=a, until=a + rng.randrange(2, 4)),
+            # one admission drop at a worker's queue door, absorbed by
+            # router re-dispatch (never the client's problem); pinned
+            # to incarnation 0 like the kill (a respawn resets the
+            # submit counter too)
+            Fault(rank=0, site="serve.admit", kind="drop",
+                  peer=rng.randrange(replicas), at=rng.randrange(3, 10),
+                  epoch=0),
+        ]
+        for f in faults:
+            f.validate()
+        return ChaosPlan(seed=seed, faults=faults)
     victim = rng.randrange(replicas)
     others = [r for r in range(replicas) if r != victim]
     partitioned = rng.choice(others)

@@ -225,7 +225,8 @@ class RetryPolicy:
 
     def run(self, fn: Callable, *, what: str, site: str, plane: str,
             reconnect: Optional[Callable[[], None]] = None,
-            peer: Optional[int] = None):
+            peer: Optional[int] = None,
+            abort: Optional[Callable[[], bool]] = None):
         """Execute ``fn`` under the ladder.
 
         Retryable failures (per :func:`is_retryable`) are absorbed:
@@ -234,6 +235,16 @@ class RetryPolicy:
         failures, ladder exhaustion, budget exhaustion, and peers the
         failure detector already suspects all re-raise the ORIGINAL
         exception so callers' classification is unchanged.
+
+        ``abort`` (optional) is the caller-local short-circuit twin of
+        the suspect check: consulted before every retry, and when it
+        returns True the ladder stops hoping and re-raises immediately
+        (counted ``short_circuit``). The serve fleet's dispatch path
+        passes "has this request already failed over / this replica
+        already been ejected?" here — its replicas are not peers the
+        global failure detector monitors, but retrying a request the
+        router already re-dispatched elsewhere would be the same futile
+        theater the suspect rule exists to prevent.
         """
         if self.retries == 0:
             return fn()
@@ -271,6 +282,12 @@ class RetryPolicy:
                     logger.warning(
                         "NET: %s NOT retried — failure detector already "
                         "suspects peer %s: %s", what, peer, e)
+                    raise
+                if abort is not None and abort():
+                    count_retry(site, "short_circuit")
+                    logger.warning(
+                        "NET: %s NOT retried — caller aborted the "
+                        "ladder: %s", what, e)
                     raise
                 attempt += 1
                 absorbed += 1

@@ -51,6 +51,14 @@ logger = logging.getLogger("horovod_tpu")
 FORMAT = "hvdws-v1"
 
 
+def version_key(channel: str) -> str:
+    """The tiny newest-published-version key of a channel — the ONLY
+    key external consumers (the serve fleets' re-admission gates) may
+    read directly; every other ``ws.*`` key layout is this module's
+    private business."""
+    return f"ws.{channel}.v"
+
+
 def _resolve_client(client, kv_addr, kv_port, rank=None):
     """(StoreClient, owns) — explicit client > explicit endpoint >
     the launcher's HOROVOD_NATIVE_KV_ADDR/PORT export."""
@@ -186,7 +194,7 @@ class WeightPublisher:
         # version. Polls check this handful of bytes first, so an idle
         # channel costs a few bytes per poll — not a full head fetch +
         # json parse of the leaf/chunk tables per replica per 250ms
-        self._kv.set(f"ws.{self.channel}.v", str(v).encode())
+        self._kv.set(version_key(self.channel), str(v).encode())
         self._version = v
         try:
             _stream_obs().inc(total)
@@ -248,7 +256,7 @@ class WeightSubscriber:
         from ..native.store import NativeTimeout
         with self._plock:
             try:
-                raw = self._kv.get(f"ws.{self.channel}.v",
+                raw = self._kv.get(version_key(self.channel),
                                    timeout=self.poll_timeout)
                 return int(raw.decode())
             except (NativeTimeout, ValueError):
@@ -267,7 +275,7 @@ class WeightSubscriber:
     def _poll_locked(self) -> Optional[Tuple[int, Any]]:
         from ..native.store import NativeTimeout
         try:
-            raw = self._kv.get(f"ws.{self.channel}.v",
+            raw = self._kv.get(version_key(self.channel),
                                timeout=self.poll_timeout)
             if int(raw.decode()) <= self.version:
                 return None              # cheap steady-state no-op

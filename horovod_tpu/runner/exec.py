@@ -81,12 +81,17 @@ class WorkerProcess:
                  ssh_port: Optional[int] = None,
                  ssh_identity_file: Optional[str] = None,
                  output_dir: Optional[str] = None,
+                 output_path: Optional[str] = None,
                  prefix_timestamp: bool = False):
         self.slot = slot
         self.prefix = f"[{slot.rank}]<stdout>:" if prefix_output else ""
         self.prefix_timestamp = prefix_timestamp
         self._sink = None
-        if output_dir:
+        if output_path:
+            # explicit sink file (the serve fleet names replica logs
+            # itself: replica.<id>.g<gen>); exclusive with output_dir
+            self._sink = open(output_path, "w")
+        elif output_dir:
             os.makedirs(output_dir, exist_ok=True)
             self._sink = open(
                 os.path.join(output_dir, f"rank.{slot.rank}"), "w")
@@ -131,6 +136,33 @@ class WorkerProcess:
             os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
         except ProcessLookupError:
             pass
+
+    def kill(self) -> None:
+        """SIGKILL the whole process group (safe_shell_exec's hard
+        stop): the supervisor's last word when a terminate was ignored
+        or a stale incarnation must not outlive its replacement."""
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+
+def spawn_local(command: List[str], env: Dict[str, str], *,
+                rank: int = 0, output_path: Optional[str] = None,
+                prefix_output: bool = False) -> WorkerProcess:
+    """Spawn ONE local process through the WorkerProcess machinery
+    (process-group isolation, streamed/sunk output) without the slot
+    plan — the serve fleet's replica spawner (serve/proc_fleet.py)
+    and other single-process supervisors use this instead of a bare
+    Popen so kill semantics and log plumbing stay in one place."""
+    slot = SlotInfo(hostname="localhost", rank=rank, local_rank=rank,
+                    cross_rank=0, size=1, local_size=1, cross_size=1)
+    return WorkerProcess(slot, command, dict(env),
+                         prefix_output=prefix_output,
+                         output_path=output_path)
 
 
 def launch_slots(slots: List[SlotInfo], command: List[str],

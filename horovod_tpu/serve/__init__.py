@@ -20,13 +20,24 @@ and the bucket/no-recompile contract.
     fleet.py     health-aware router over N replicas: accrual-driven
                  ejection, at-most-once failover, drain-on-SIGTERM,
                  re-admission on fresh streamed weights
-    soak.py      serving SLO soak under a seeded chaos plan
-                 (tools/serve_soak.py CLI; docs/serving.md)
+    wire.py      framed dispatch protocol + retryable-vs-fatal
+                 classification for the multi-process fleet
+    worker.py    one replica as one OS process: endpoint with replay
+                 dedupe, KV heartbeats, startup weight gate
+    proc_fleet.py multi-process fleet router: accrual sweep over real
+                 heartbeat keys, dispatch over the resilience ladder,
+                 SIGKILL-survivable respawn gated on fresh weights
+    soak.py      serving SLO soaks under seeded chaos plans — in-
+                 process and multi-process (tools/serve_soak.py CLI;
+                 docs/serving.md)
 """
 from .batcher import ContinuousBatcher, ReplicaDead            # noqa: F401
 from .executor import ShardedExecutor                          # noqa: F401
 from .fleet import FleetHandle, FleetRouter, Replica           # noqa: F401
-from .http import make_server, serve_http                      # noqa: F401
+from .http import (                                            # noqa: F401
+    make_fleet_server, make_server, retry_after_seconds, serve_http,
+)
+from .proc_fleet import ProcessFleetRouter, ProcessReplica     # noqa: F401
 from .kv_cache import (                                        # noqa: F401
     BlockPool, PagedKVCache, SlotKVCache, cached_attention,
     paged_attention, paged_model_kwargs, pool_blocks_for, write_kv,

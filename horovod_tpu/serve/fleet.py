@@ -84,6 +84,11 @@ class FleetHandle:
         #: times this request was (re)dispatched to a replica
         self.attempts = 0
         self.resolutions = 0
+        #: optional router hook invoked exactly once, AFTER the
+        #: accepted resolution (the process fleet releases its
+        #: in-flight reservation here); never called for suppressed
+        #: duplicates
+        self.on_done: Optional[Callable[[], None]] = None
         self._event = threading.Event()
         self._rlock = threading.Lock()
 
@@ -111,7 +116,13 @@ class FleetHandle:
             self.replica = replica
             self.resolutions += 1
             self._event.set()
-            return True
+        cb = self.on_done
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a hook must not mask
+                pass           # the resolution it observes
+        return True
 
 
 class _Tracked:
@@ -217,6 +228,46 @@ class Replica:
         if self.subscriber is not None:
             self.batcher.attach_weights(
                 self.subscriber, min_interval_s=self.weights_interval_s)
+
+
+def aggregate_healthz(replicas_info: Dict[int, dict], *,
+                      draining: bool,
+                      retry_after_ms: float) -> dict:
+    """Build the aggregate fleet ``/healthz`` payload BOTH router
+    flavors serve through ``make_fleet_server`` — one place for the
+    contract (per-replica state + live capacity, ``ok`` False at zero
+    capacity), so the in-process and multi-process faces cannot drift.
+
+    ``replicas_info[rid]`` supplies ``state``/``up``/``draining``/
+    ``queue_depth``/``weights_version``/``restarts``/``queue_free``
+    and, when paged, ``kv_blocks_total``/``kv_blocks_in_use``; each
+    router sources those from what it actually has (live batchers vs
+    the health-poll cache).
+    """
+    reps: Dict[str, dict] = {}
+    q_free = blocks_free = 0
+    for rid, info in replicas_info.items():
+        entry = {k: info.get(k) for k in
+                 ("state", "up", "draining", "queue_depth",
+                  "weights_version", "restarts")}
+        if info.get("up"):
+            q_free += max(int(info.get("queue_free") or 0), 0)
+            if info.get("kv_blocks_total") is not None:
+                blocks_free += (int(info["kv_blocks_total"])
+                                - int(info.get("kv_blocks_in_use") or 0))
+                entry["kv_blocks_in_use"] = info.get("kv_blocks_in_use")
+        reps[str(rid)] = entry
+    up_n = sum(1 for r in reps.values() if r["up"])
+    return {
+        "ok": up_n > 0 and q_free > 0 and not draining,
+        "draining": draining,
+        "replicas": reps,
+        "capacity": {"replicas_up": up_n,
+                     "replicas_total": len(reps),
+                     "queue_free": q_free,
+                     "kv_blocks_free": blocks_free},
+        "retry_after_ms": retry_after_ms,
+    }
 
 
 class FleetRouter:
@@ -616,6 +667,8 @@ class FleetRouter:
         re-adopt the newest streamed weights, re-admit."""
         rid = rep.id
         try:
+            if self.draining or self._stop.is_set():
+                return   # drain owns every in-flight handle from here
             rebuilt = False
             if not rep.batcher.alive():
                 rep.build()
@@ -668,6 +721,14 @@ class FleetRouter:
             # in (the flush runs on the scheduler thread at the top of
             # its next iteration, before any admission can match).
             rep.batcher.request_prefix_flush()
+            # a drain that started while this recovery ran owns every
+            # in-flight handle and is stopping the fleet: re-admitting
+            # (and restarting a batcher drain just stopped) would leave
+            # a replica running after drain() returned — abort instead;
+            # drain's final sweep resolves any leftovers
+            if self.draining or self._stop.is_set():
+                rep.state = "down"
+                return
             if rebuilt:
                 rep.batcher.start()
             # fresh accrual history: a re-admitted replica re-enters
@@ -689,6 +750,36 @@ class FleetRouter:
                 self._restarting.discard(rid)
 
     # -- introspection -------------------------------------------------------
+    def healthz(self) -> dict:
+        """Aggregate fleet liveness — the front door's ``/healthz``
+        payload (serve/http.py ``make_fleet_server``), same contract as
+        the per-replica endpoint: per-replica up/draining/warming state
+        plus LIVE capacity (free queue depth and free KV blocks summed
+        over admitted replicas). ``ok`` goes False — the HTTP face
+        answers 503 — once live capacity is zero. Shape built by the
+        shared :func:`aggregate_healthz`."""
+        infos = {}
+        for rid, rep in self.replicas.items():
+            b = rep.batcher
+            up = rep.state == "up" and b is not None and b.alive()
+            depth = rep.queue.depth() if rep.queue is not None else 0
+            info = {
+                "state": rep.state, "up": up,
+                "draining": bool(getattr(b, "draining", False))
+                if b is not None else False,
+                "queue_depth": depth,
+                "weights_version": rep.executor.params_version,
+                "restarts": rep.restarts,
+                "queue_free": max(rep.max_queue - depth, 0),
+            }
+            if up and getattr(b, "paged", False):
+                info["kv_blocks_total"] = b.kv.pool.num_blocks
+                info["kv_blocks_in_use"] = b.kv.pool.in_use()
+            infos[rid] = info
+        return aggregate_healthz(
+            infos, draining=self.draining,
+            retry_after_ms=self.drain_retry_after_ms)
+
     def stats(self) -> dict:
         with self._lock:
             inflight = len(self._inflight)

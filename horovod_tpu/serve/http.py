@@ -17,6 +17,12 @@ Two endpoints, JSON in/out, zero dependencies beyond `http.server`:
   registry (horovod_tpu.obs) — serve latency histograms next to the
   engine's wire-byte counters, no second scrape port needed.
 
+:func:`make_fleet_server` lifts the same contract fleet-wide: one
+front door over a ``FleetRouter``/``ProcessFleetRouter`` whose
+``/healthz`` aggregates per-replica state + live capacity (503 at zero
+capacity) and whose ``/generate`` rides the failover/at-most-once/
+capacity-scaled-shed machinery.
+
 Production serving would sit behind a real frontend; this exists so the
 whole vertical slice — socket to TPU decode step — is drivable from
 curl and coverable by a loopback test.
@@ -33,38 +39,80 @@ from ..obs.exporter import PROMETHEUS_CONTENT_TYPE
 from .queue import Rejected
 
 
+def retry_after_seconds(ms: float) -> int:
+    """``Retry-After`` is whole seconds; round UP with a true ceiling
+    so clients never come back early — and an exact 2000 ms maps to
+    2 s, not 3 (the old ``int(ms/1000)+1`` overshot every
+    exact-second hint by a full second). Floor of 1: a sub-second hint
+    must not round to an immediate retry."""
+    return max(1, int(-(-float(ms) // 1000.0)))
+
+
+class _JsonHandler(BaseHTTPRequestHandler):
+    """Shared plumbing for the per-replica and fleet front doors —
+    one place for the reply/metrics/429 mechanics, so the two handlers
+    cannot drift (the Retry-After rounding already did once)."""
+
+    def log_message(self, *a):  # quiet: counters replace access logs
+        pass
+
+    def _reply(self, code: int, payload: dict,
+               headers: Optional[Tuple[Tuple[str, str], ...]] = None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers or ():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_metrics(self):
+        body = obs_metrics.get_registry().to_prometheus().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_rejected(self, reason, retry_after_ms):
+        """The structured 429: payload always carries the ms hint, the
+        header its true-ceiling whole-second rendering."""
+        hdrs = ()
+        if retry_after_ms is not None:
+            hdrs = (("Retry-After",
+                     str(retry_after_seconds(retry_after_ms))),)
+        self._reply(429, {"error": "rejected", "reason": reason,
+                          "retry_after_ms": retry_after_ms}, hdrs)
+
+    def _read_generate_request(self):
+        """Parse a /generate body -> (prompt, max_new, deadline_ms);
+        raises the (KeyError, ValueError, TypeError) family the caller
+        maps to a structured 400."""
+        n = int(self.headers.get("Content-Length", "0"))
+        req = json.loads(self.rfile.read(n) or b"{}")
+        prompt = req["tokens"]
+        max_new = int(req.get("max_new_tokens", 16))
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)
+        return prompt, max_new, deadline_ms
+
+
 def make_server(batcher, host: str = "127.0.0.1",
                 port: int = 0) -> ThreadingHTTPServer:
     """Build (not start) an HTTP server bound to `batcher`'s queue.
     `port=0` picks a free port (see ``server.server_address``)."""
     queue = batcher.queue
 
-    class Handler(BaseHTTPRequestHandler):
+    class Handler(_JsonHandler):
         # requests are held open while the batcher generates; the
         # threading server gives each its own thread
-        def log_message(self, *a):  # quiet: counters replace access logs
-            pass
-
-        def _reply(self, code: int, payload: dict,
-                   headers: Optional[Tuple[Tuple[str, str], ...]] = None):
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            for k, v in headers or ():
-                self.send_header(k, v)
-            self.end_headers()
-            self.wfile.write(body)
 
         def do_GET(self):
             # query-string tolerant, like the standalone exporter
             if self.path.split("?", 1)[0] == "/metrics":
-                body = obs_metrics.get_registry().to_prometheus().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._reply_metrics()
                 return
             if self.path != "/healthz":
                 self._reply(404, {"error": "not found"})
@@ -102,13 +150,8 @@ def make_server(batcher, host: str = "127.0.0.1",
                 self._reply(404, {"error": "not found"})
                 return
             try:
-                n = int(self.headers.get("Content-Length", "0"))
-                req = json.loads(self.rfile.read(n) or b"{}")
-                prompt = req["tokens"]
-                max_new = int(req.get("max_new_tokens", 16))
-                deadline_ms = req.get("deadline_ms")
-                if deadline_ms is not None:
-                    deadline_ms = float(deadline_ms)
+                prompt, max_new, deadline_ms = \
+                    self._read_generate_request()
                 handle = queue.submit(prompt, max_new_tokens=max_new,
                                       deadline_ms=deadline_ms)
             except (KeyError, ValueError, TypeError,
@@ -119,14 +162,7 @@ def make_server(batcher, host: str = "127.0.0.1",
                 self._reply(400, {"error": "bad request", "detail": str(e)})
                 return
             except Rejected as e:
-                hdrs = ()
-                if e.retry_after_ms is not None:
-                    # Retry-After is whole seconds; round up so clients
-                    # never come back early
-                    hdrs = (("Retry-After",
-                             str(max(1, int(e.retry_after_ms / 1000) + 1))),)
-                self._reply(429, {"error": "rejected", "reason": e.reason,
-                                  "retry_after_ms": e.retry_after_ms}, hdrs)
+                self._reply_rejected(e.reason, e.retry_after_ms)
                 return
             # wait past the request's own deadline: the batcher resolves
             # expiry itself and this must not race it
@@ -164,3 +200,77 @@ def serve_http(batcher, host: str = "127.0.0.1", port: int = 0):
                          name="hvd-serve-http")
     t.start()
     return srv, t
+
+
+def make_fleet_server(router, host: str = "127.0.0.1",
+                      port: int = 0) -> ThreadingHTTPServer:
+    """The FLEET front door: one HTTP face over a ``FleetRouter`` or
+    ``ProcessFleetRouter`` (anything with ``submit``/``healthz``).
+
+    * ``POST /generate`` routes through the router — failover,
+      at-most-once and capacity-scaled shedding all apply; a shed
+      answers ``429`` with ``Retry-After`` (true-ceiling seconds) and
+      ``retry_after_ms``, never a dropped socket.
+    * ``GET /healthz`` serves the router's AGGREGATE liveness: per-
+      replica up/draining/respawning plus live capacity (free queue
+      depth + free KV blocks) — ``503`` once live capacity is zero,
+      the same contract as the per-replica endpoint, lifted fleet-wide
+      so a load balancer can front the whole fleet on one probe.
+    * ``GET /metrics`` — the process-global Prometheus registry
+      (router legs, failovers, respawns, net retries).
+    """
+
+    class Handler(_JsonHandler):
+        def do_GET(self):
+            if self.path.split("?", 1)[0] == "/metrics":
+                self._reply_metrics()
+                return
+            if self.path != "/healthz":
+                self._reply(404, {"error": "not found"})
+                return
+            info = router.healthz()
+            self._reply(200 if info.get("ok") else 503, info)
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                prompt, max_new, deadline_ms = \
+                    self._read_generate_request()
+                handle = router.submit(prompt, max_new_tokens=max_new,
+                                       deadline_ms=deadline_ms)
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {"error": "bad request",
+                                  "detail": str(e)})
+                return
+            except Rejected as e:
+                self._reply_rejected(e.reason, e.retry_after_ms)
+                return
+            handle.wait(timeout=(deadline_ms or 30000.0) / 1000.0 + 60.0)
+            if not handle.done():
+                self._reply(504, {"error": "timeout"})
+                return
+            if handle.status == "rejected":
+                # async fleet-level shed (every worker's queue door
+                # said no): same 429 + Retry-After contract as the
+                # synchronous path
+                self._reply_rejected(handle.error or "shed",
+                                     handle.retry_after_ms)
+                return
+            if handle.status == "expired":
+                self._reply(504, {"error": "deadline",
+                                  "tokens": handle.tokens,
+                                  "latency_ms": handle.latency_ms})
+                return
+            if handle.status == "error":
+                self._reply(500, {"error": handle.error or "error",
+                                  "latency_ms": handle.latency_ms})
+                return
+            self._reply(200, {"tokens": handle.tokens,
+                              "status": handle.status,
+                              "latency_ms": handle.latency_ms,
+                              "replica": handle.replica})
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -38,6 +38,16 @@ invariant holds) asserts:
 
 ``evaluate_serve`` is the pure records->verdict core, unit-testable on
 synthetic logs exactly like chaos/soak.py's ``evaluate``.
+
+``run_fleet_soak`` / ``evaluate_fleet`` are the MULTI-PROCESS siblings
+(``tools/serve_soak.py --processes``): real replica worker processes
+behind a ``ProcessFleetRouter``, a seeded plan that SIGKILLs one
+worker mid-traffic and fires ``conn_reset``/``flaky`` blips on the
+dispatch wire, and a verdict that additionally asserts the blips were
+absorbed by the retry ladder with ZERO failovers, replayed dispatches
+were served deduped results (answered-exactly-once across the process
+boundary), and the respawned victim re-admitted on the newest
+published weight version.
 """
 from __future__ import annotations
 
@@ -61,6 +71,14 @@ DEFAULT_RECOVERY_WINDOW_S = 6.0
 #: disruptions that open a recovery window in the SLO evaluation
 _DISRUPTIVE = ("crash", "slow_rank", "partition", "corrupt", "drop",
                "delay")
+#: the PROCESS-fleet soak's default suspect threshold: heartbeats now
+#: cross a real process boundary, and on a small/oversubscribed box
+#: (CI runs this on 2 cores) two worker processes can co-stall past
+#: 1 s without either being dead — a margin that tight turns scheduler
+#: hiccups into unscheduled failovers the verdict rightly refuses to
+#: call green. 2 s keeps detection O(heartbeat) (bound 2x = 4 s) while
+#: staying honest about what a loaded host can promise.
+FLEET_SUSPECT_S = 2.0
 
 
 def _resolve_plan(plan, seed: int, replicas: int, steps: int):
@@ -197,6 +215,398 @@ def evaluate_serve(records: List[dict], events: List[dict], plan,
         "kv_containment", "failover_bounded", "slo_held",
         "capacity_restored"))
     return v
+
+
+def evaluate_fleet(records: List[dict], events: List[dict], plan,
+                   fleet_stats: dict, *, replicas: int,
+                   suspect_s: float, slo_p99_ms: float,
+                   slo_error_rate: float, recovery_window_s: float,
+                   newest_version: Optional[int],
+                   dispatch_absorbed: int,
+                   dedupe_hits: int) -> dict:
+    """The MULTI-PROCESS fleet verdict: everything
+    :func:`evaluate_serve` asserts (no silent drops, answered-once,
+    shed-carries-retry-after, bounded failover, SLO outside recovery
+    windows, capacity restored on the newest weights), plus the
+    process-boundary invariants:
+
+    * **blips_absorbed** — the scheduled ``serve.dispatch``
+      ``conn_reset``/``flaky`` blips were absorbed by the retry ladder
+      (``hvd_net_retries_total{site="serve.dispatch",
+      outcome="absorbed"}`` > 0) …
+    * **failovers_only_kills** — … and triggered ZERO failovers: the
+      fleet's failover count equals exactly the number of SCHEDULED
+      process kills. A blip that escalated into an ejection fails
+      this.
+    * **replays_deduped** — a ``conn_reset`` severs the dispatch
+      socket AFTER the request frame was sent, so its ladder replay
+      MUST have been served the worker's deduped result (worker
+      ``dedupe_hits`` > 0): the evidence that a lost reply never
+      became a duplicate execution.
+    * **respawned_on_newest** — the killed replica's re-admission
+      event carries the newest published weight version (the respawn
+      weight gate actually gated).
+    """
+    v = evaluate_serve(
+        records, events, plan, fleet_stats, replicas=replicas,
+        suspect_s=suspect_s, slo_p99_ms=slo_p99_ms,
+        slo_error_rate=slo_error_rate,
+        recovery_window_s=recovery_window_s,
+        newest_version=newest_version, kv_injected=0, kv_detected=0)
+    kills = [f for f in plan.faults if f.kind == "crash"]
+    blips = [f for f in plan.faults
+             if f.site == "serve.dispatch"
+             and f.kind in ("conn_reset", "flaky")]
+    v["dispatch_absorbed"] = int(dispatch_absorbed)
+    v["dedupe_hits"] = int(dedupe_hits)
+    v["respawns"] = fleet_stats.get("respawns", 0)
+    if blips:
+        v["blips_absorbed"] = dispatch_absorbed > 0
+    v["failovers_only_kills"] = \
+        fleet_stats.get("failovers", 0) == len(kills)
+    if any(f.kind == "conn_reset" for f in blips):
+        v["replays_deduped"] = dedupe_hits > 0
+    if kills:
+        victim = kills[0].peer
+        readmit = next((e for e in events
+                        if e.get("kind") == "fleet"
+                        and e.get("event") == "readmit"
+                        and e.get("replica") == victim), None)
+        v["respawned_on_newest"] = (
+            readmit is not None and newest_version is not None
+            and readmit.get("weights_version") == newest_version)
+    v["ok"] = all(v.get(k) is not False for k in (
+        "ok", "blips_absorbed", "failovers_only_kills",
+        "replays_deduped", "respawned_on_newest"))
+    return v
+
+
+def run_fleet_soak(out_dir: Optional[str] = None, *,
+                   replicas: int = 2,
+                   clients: int = 4,
+                   seed: int = 0, plan=None,
+                   steps: int = DEFAULT_STEPS,
+                   suspect_s: float = FLEET_SUSPECT_S,
+                   interval_s: float = DEFAULT_INTERVAL_S,
+                   slo_p99_ms: float = DEFAULT_SLO_P99_MS,
+                   slo_error_rate: float = DEFAULT_SLO_ERROR_RATE,
+                   recovery_window_s: float = 8.0,
+                   min_duration_s: float = 8.0,
+                   max_duration_s: float = 150.0,
+                   max_new_tokens: int = 8,
+                   deadline_ms: float = 20000.0,
+                   spec_k: int = 0,
+                   paged: bool = True,
+                   kv_crc: Optional[bool] = None,
+                   prefix_cache: Optional[bool] = None,
+                   spawn_timeout_s: float = 120.0) -> dict:
+    """The MULTI-PROCESS serve soak (acceptance for the process-fleet
+    tentpole): N replica WORKER PROCESSES behind a
+    :class:`~horovod_tpu.serve.proc_fleet.ProcessFleetRouter`, a
+    seeded serve-profile plan with ``processes=True`` (one worker
+    SIGKILLed mid-traffic, ``conn_reset``/``flaky`` blips on the
+    dispatch wire, an admission drop), closed-loop traffic, and a v2
+    weight publish mid-incident. Returns the :func:`evaluate_fleet`
+    verdict; never raises on a failed invariant."""
+    import tempfile
+
+    from ..chaos import inject
+    from ..native.store import StoreServer
+    from ..redist.stream import WeightPublisher
+    from .proc_fleet import ProcessFleetRouter
+    from .worker import tiny_gpt_builder
+
+    from ..chaos.plan import ChaosPlan, random_plan
+    if plan is None or plan == "random":
+        resolved = random_plan(seed, replicas, steps, profile="serve",
+                               processes=True)
+    elif isinstance(plan, ChaosPlan):
+        resolved = plan
+    else:
+        resolved = ChaosPlan.parse(str(plan))
+
+    work_dir = out_dir or tempfile.mkdtemp(prefix="hvd_fleet_soak.")
+    os.makedirs(work_dir, exist_ok=True)
+    events_dir = os.path.join(work_dir, "worker_events")
+    channel = f"fleetsoak{seed}"
+
+    events: List[dict] = []
+    records: List[dict] = []
+    ev_lock = threading.Lock()
+
+    def log_event(kind: str, ev: dict) -> None:
+        with ev_lock:
+            events.append(dict(ev, kind=kind))
+
+    srv = StoreServer()
+    # the publisher derives the SAME params every worker builds
+    # (deterministic per seed) — v1 lands before any worker spawns, so
+    # every startup passes the weight gate against a live channel
+    built = tiny_gpt_builder(seed=seed, paged=paged,
+                             draft=spec_k > 0)
+    pub = WeightPublisher(channel, kv_addr="127.0.0.1",
+                          kv_port=srv.port, resume_timeout=0.05)
+    pub.publish(built["params"])              # version 1, pre-incident
+
+    router = ProcessFleetRouter(
+        replicas, kv_addr="127.0.0.1", kv_port=srv.port,
+        worker={
+            "builder": "horovod_tpu.serve.worker:tiny_gpt_builder",
+            "builder_kwargs": {"seed": seed, "paged": paged,
+                               "draft": spec_k > 0},
+            "buckets": [8], "max_queue": max(32, 4 * clients),
+            "deadline_ms": deadline_ms,
+            "kv_crc": True if kv_crc is None else kv_crc,
+            "spec_k": spec_k,
+            "prefix_cache": paged if prefix_cache is None
+            else prefix_cache},
+        channel=channel, ns=f"soak{seed}", interval_s=interval_s,
+        suspect_s=suspect_s, chaos_plan=resolved,
+        events_dir=events_dir,
+        log_dir=os.path.join(work_dir, "logs"),
+        spawn_timeout_s=spawn_timeout_s)
+    router.add_listener(lambda ev: log_event("fleet", ev))
+
+    # arm the ROUTER process (serve.dispatch fires here; serve.proc /
+    # serve.admit fire inside the workers, which install the same plan
+    # from their spawn config and ledger into events_dir)
+    inj = inject.install(resolved, rank=0)
+    inj.add_listener(lambda ev: log_event(
+        "chaos", {"fault": ev["kind"],
+                  **{k: x for k, x in ev.items() if k != "kind"}}))
+
+    crash_scheduled = any(f.kind == "crash" for f in resolved.faults)
+    eject_seen = threading.Event()
+    if not crash_scheduled:
+        eject_seen.set()
+
+    def watch_eject(ev):
+        if ev.get("event") == "eject":
+            eject_seen.set()
+    router.add_listener(watch_eject)
+
+    stop = threading.Event()
+    torn_down = []
+
+    def _teardown() -> None:
+        # idempotent, best-effort, and REACHED ON EVERY EXIT PATH: the
+        # replicas are real OS processes in their own sessions — an
+        # exception anywhere in the soak body must not orphan them
+        # spinning forever
+        if torn_down:
+            return
+        torn_down.append(True)
+        stop.set()
+        try:
+            router.close()
+        except Exception:  # noqa: BLE001
+            pass
+        inject.uninstall()
+        try:
+            pub.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            srv.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    try:
+        return _fleet_soak_body(
+            router, resolved, events, records, ev_lock, events_dir,
+            work_dir, pub, built, eject_seen, stop, _teardown,
+            replicas=replicas, clients=clients,
+            suspect_s=suspect_s, slo_p99_ms=slo_p99_ms,
+            slo_error_rate=slo_error_rate,
+            recovery_window_s=recovery_window_s,
+            min_duration_s=min_duration_s,
+            max_duration_s=max_duration_s,
+            max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
+            spec_k=spec_k, paged=paged)
+    finally:
+        _teardown()
+
+
+def _fleet_soak_body(router, resolved, events, records, ev_lock,
+                     events_dir, work_dir, pub, built, eject_seen,
+                     stop, teardown, *, replicas, clients, suspect_s,
+                     slo_p99_ms, slo_error_rate, recovery_window_s,
+                     min_duration_s, max_duration_s, max_new_tokens,
+                     deadline_ms, spec_k, paged) -> dict:
+    """The guarded body of :func:`run_fleet_soak` — every exit path
+    runs the caller's teardown (worker processes must never outlive
+    the soak)."""
+    import glob
+
+    from .queue import Rejected
+
+    router.start()
+
+    def publish_fresh():
+        # the online-learning leg: v2 lands while the fleet is mid-
+        # incident; the RESPAWNED victim must come back gated on it
+        eject_seen.wait(timeout=max_duration_s / 2.0)
+        time.sleep(0.5)
+        try:
+            pub.publish(built["params"])      # version 2, same values
+        except Exception as e:  # noqa: BLE001
+            logger.error("fleet soak: mid-incident publish failed: %s",
+                         e)
+
+    threading.Thread(target=publish_fresh, daemon=True).start()
+
+    rec_lock = threading.Lock()
+
+    def client(cid: int) -> None:
+        import numpy as np
+        rng = np.random.RandomState(20_000 + cid)
+        while not stop.is_set():
+            prompt = list(rng.randint(1, 64, int(rng.randint(2, 8))))
+            # WALL-clock stamps: the verdict intersects these with the
+            # event ledger's time.time() recovery windows — a monotonic
+            # stamp here would make every request look "outside" every
+            # window and quietly disable the SLO exclusion
+            t0 = time.time()
+            rec = {"fid": None, "t0": t0, "t1": None,
+                   "status": "pending", "latency_ms": None,
+                   "retry_after_ms": None, "resolutions": 0,
+                   "replica": None, "client": cid}
+            try:
+                h = router.submit(prompt,
+                                  max_new_tokens=max_new_tokens)
+            except Rejected as e:
+                rec.update(status="shed",
+                           retry_after_ms=e.retry_after_ms,
+                           t1=time.time())
+                with rec_lock:
+                    records.append(rec)
+                time.sleep(min((e.retry_after_ms or 100.0), 500.0)
+                           / 1000.0)
+                continue
+            h.wait(timeout=deadline_ms / 1000.0 + 60.0)
+            rec.update(fid=h.fid, t1=time.time(),
+                       status=h.status, latency_ms=h.latency_ms,
+                       retry_after_ms=h.retry_after_ms,
+                       resolutions=h.resolutions, replica=h.replica)
+            with rec_lock:
+                records.append(rec)
+            if h.status == "rejected" and h.retry_after_ms:
+                time.sleep(min(h.retry_after_ms, 500.0) / 1000.0)
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    def worker_chaos_events() -> List[dict]:
+        """Read the workers' fsync'd injector ledgers (the victim's
+        SIGKILL is recorded there a syscall before it dies)."""
+        out = []
+        for path in sorted(glob.glob(
+                os.path.join(events_dir, "*.events.jsonl"))):
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        out.append({"kind": "chaos",
+                                    "fault": ev.get("kind"),
+                                    **{k: x for k, x in ev.items()
+                                       if k != "kind"}})
+            except (OSError, ValueError):
+                # resilience: exempt (local event-ledger file read, not
+                # a wire path — a half-written line is re-read next poll)
+                continue
+        return out
+
+    # distinct scheduled faults only, flaky excluded: its seeded draws
+    # may legitimately never hit inside the window, and waiting on a
+    # fault that cannot be forced would stall the soak to its cap
+    want = {(f.site, f.kind, f.peer) for f in resolved.faults
+            if f.kind != "flaky"}
+
+    def faults_all_fired(worker_evs: List[dict]) -> bool:
+        with ev_lock:
+            got = {(e.get("site"), e.get("fault"), e.get("peer"))
+                   for e in events if e.get("kind") == "chaos"}
+        got |= {(e.get("site"), e.get("fault"), e.get("peer"))
+                for e in worker_evs}
+        return want <= got
+
+    def recovered() -> bool:
+        s = router.stats()
+        newest = pub._version
+        return (s["replicas_up"] == replicas and newest >= 2
+                and all(r["weights_version"] == newest
+                        for r in s["replicas"].values()))
+
+    dwell_s = 2 * suspect_s + 1.0
+    last_unhealed = time.monotonic()
+    while time.monotonic() - t_start < max_duration_s:
+        if not (faults_all_fired(worker_chaos_events())
+                and recovered()):
+            last_unhealed = time.monotonic()
+        elif time.monotonic() - last_unhealed >= dwell_s \
+                and time.monotonic() - t_start >= min_duration_s:
+            break
+        time.sleep(0.25)
+    stop.set()
+    for t in threads:
+        t.join(timeout=deadline_ms / 1000.0 + 65.0)
+
+    # final, fresh evidence pulls before teardown; per replica, a
+    # missed probe (loaded box, transient connect failure) falls back
+    # to the sweep's cached count — evidence the dedupe DID happen
+    # must not evaporate because one last poll did
+    dedupe_hits = 0
+    for rep in router.replicas.values():
+        h = router._fetch_healthz(rep, timeout=1.0)
+        probed = int(h.get("dedupe_hits") or 0) if h is not None else 0
+        dedupe_hits += max(probed, int(rep.dedupe_hits or 0))
+    fleet_stats = router.stats()
+    from ..obs import metrics as obs_metrics
+    from ..native.resilience import RETRIES_HELP
+    dispatch_absorbed = int(obs_metrics.get_registry().counter(
+        "hvd_net_retries_total", RETRIES_HELP,
+        {"site": "serve.dispatch", "outcome": "absorbed"}).value)
+    newest_version = pub._version
+    worker_evs = worker_chaos_events()
+    with ev_lock:
+        all_events = sorted(events + worker_evs,
+                            key=lambda e: e.get("t", 0.0))
+    teardown()
+
+    verdict = evaluate_fleet(
+        records, all_events, resolved, fleet_stats,
+        replicas=replicas, suspect_s=suspect_s,
+        slo_p99_ms=slo_p99_ms, slo_error_rate=slo_error_rate,
+        recovery_window_s=recovery_window_s,
+        newest_version=newest_version,
+        dispatch_absorbed=dispatch_absorbed,
+        dedupe_hits=dedupe_hits)
+    verdict.update({
+        "seed": resolved.seed, "replicas": replicas,
+        "clients": clients, "processes": True,
+        "paged": bool(paged), "spec_k": int(spec_k),
+        "suspect_s": suspect_s,
+        "wall_s": round(time.monotonic() - t_start, 2),
+        "plan": json.loads(resolved.to_json()),
+        "fleet": fleet_stats,
+        "out_dir": work_dir,
+    })
+    with open(os.path.join(work_dir, "events.jsonl"), "w") as f:
+        for e in all_events:
+            f.write(json.dumps(e, default=str) + "\n")
+    with open(os.path.join(work_dir, "requests.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    with open(os.path.join(work_dir, "verdict.json"), "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    return verdict
 
 
 def run_serve_soak(out_dir: Optional[str] = None, *,
@@ -336,7 +746,11 @@ def run_serve_soak(out_dir: Optional[str] = None, *,
         rng = np.random.RandomState(10_000 + cid)
         while not stop.is_set():
             prompt = list(rng.randint(1, 64, int(rng.randint(2, 8))))
-            t0 = time.monotonic()
+            # WALL-clock stamps: the recovery windows in the verdict
+            # are built from the event ledger's time.time() — monotonic
+            # stamps here would never intersect them, silently
+            # disabling the SLO window exclusion
+            t0 = time.time()
             rec = {"fid": None, "t0": t0, "t1": None,
                    "status": "pending", "latency_ms": None,
                    "retry_after_ms": None, "resolutions": 0,
@@ -347,7 +761,7 @@ def run_serve_soak(out_dir: Optional[str] = None, *,
             except Rejected as e:
                 rec.update(status="shed",
                            retry_after_ms=e.retry_after_ms,
-                           t1=time.monotonic())
+                           t1=time.time())
                 with rec_lock:
                     records.append(rec)
                 # honor the hint (capped so the soak keeps offering)
@@ -355,7 +769,7 @@ def run_serve_soak(out_dir: Optional[str] = None, *,
                            / 1000.0)
                 continue
             h.wait(timeout=deadline_ms / 1000.0 + 30.0)
-            rec.update(fid=h.fid, t1=time.monotonic(),
+            rec.update(fid=h.fid, t1=time.time(),
                        status=h.status, latency_ms=h.latency_ms,
                        retry_after_ms=h.retry_after_ms,
                        resolutions=h.resolutions, replica=h.replica)

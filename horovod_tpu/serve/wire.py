@@ -1,0 +1,123 @@
+"""Framed JSON wire protocol for the multi-process serve fleet.
+
+The dispatch channel between a :class:`~horovod_tpu.serve.proc_fleet.
+ProcessFleetRouter` and its replica worker processes
+(serve/worker.py): length-prefixed JSON frames over TCP, small enough
+to audit and stdlib-only, because the payloads are token id lists and
+counters — the heavy bytes (weights, KV) ride the redist planes.
+
+Failure classification is the whole point of this module existing
+separately: every socket fault crossing these helpers is routed
+through ``native/resilience.is_retryable`` and re-raised as
+:class:`DispatchConnError` — a ``Retryable`` — when it is a
+connection-class blip (reset, refused dial, EOF mid-frame), so the
+router's retry ladder absorbs it in milliseconds; timeouts and
+protocol garbage stay fatal and escalate exactly like every other
+wire plane (docs/chaos.md).
+
+Frame: 4-byte big-endian length + UTF-8 JSON object. One request per
+connection for the submit path (the reply can be seconds away — a
+generation — and a one-shot socket keeps replay-after-reconnect
+trivially safe: the worker dedupes on the request ``fid``, mirroring
+the csrc/store.cc nonce pattern).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+from ..native import resilience
+
+#: a healthz/ack reply must fit here; submit replies carry at most
+#: max_new_tokens ints — far below this
+MAX_FRAME_BYTES = 4 << 20
+
+
+class DispatchConnError(RuntimeError, resilience.Retryable):
+    """The dispatch TRANSPORT failed (reset, refused dial, EOF
+    mid-frame) — the request may never have arrived, or its reply may
+    be lost. Retryable: replaying the dispatch is safe because the
+    worker dedupes on the request id (serve/worker.py) and serves a
+    replayed request its cached (or still-in-flight) result."""
+
+
+class DispatchError(RuntimeError):
+    """A NON-retryable dispatch failure: protocol garbage, an oversized
+    frame, a stall past the reply timeout. Escalates to failover."""
+
+
+def _classify(e: OSError, what: str) -> Exception:
+    # route through the resilience classifier: connection-class blips
+    # become the Retryable DispatchConnError the ladder absorbs;
+    # timeouts and the rest stay fatal (the stall bound elapsed)
+    if resilience.is_retryable(e):
+        return DispatchConnError(f"{what}: {e}")
+    if isinstance(e, socket.timeout):
+        return DispatchError(f"{what}: timed out ({e})")
+    return e
+
+
+def connect(addr: Tuple[str, int], timeout: float) -> socket.socket:
+    """Dial a replica endpoint; refused/reset dials raise the
+    Retryable :class:`DispatchConnError` (the ladder re-dials)."""
+    try:
+        s = socket.create_connection(addr, timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+    except OSError as e:
+        # resilience classifier decides retryable vs fatal
+        raise _classify(e, f"dial {addr[0]}:{addr[1]}") from None
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    raw = json.dumps(obj).encode()
+    if len(raw) > MAX_FRAME_BYTES:
+        raise DispatchError(
+            f"frame of {len(raw)} bytes exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES})")
+    try:
+        sock.sendall(struct.pack(">I", len(raw)) + raw)
+    except OSError as e:
+        # resilience classifier decides retryable vs fatal
+        raise _classify(e, "send") from None
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            got = sock.recv(n - len(buf))
+        except OSError as e:
+            # resilience classifier decides retryable vs fatal
+            raise _classify(e, "recv") from None
+        if not got:
+            raise DispatchConnError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+        buf += got
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket,
+             timeout: Optional[float] = None) -> dict:
+    """Read one frame; EOF/reset raise the Retryable
+    :class:`DispatchConnError`, a timeout raises the fatal
+    :class:`DispatchError` (the reply bound elapsed — retrying would
+    mask a stalled replica the router should fail over instead)."""
+    if timeout is not None:
+        sock.settimeout(timeout)
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if n > MAX_FRAME_BYTES:
+        raise DispatchError(
+            f"peer announced a {n}-byte frame (> {MAX_FRAME_BYTES}) — "
+            f"protocol garbage, not retryable")
+    raw = _recv_exact(sock, n)
+    try:
+        obj = json.loads(raw.decode())
+    except ValueError as e:
+        raise DispatchError(f"undecodable frame: {e}") from None
+    if not isinstance(obj, dict):
+        raise DispatchError(
+            f"frame must be a JSON object; got {type(obj).__name__}")
+    return obj

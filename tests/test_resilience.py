@@ -775,29 +775,41 @@ _EXC_PAT = re.compile(
 #: classifier / a classified retryable raise / an explicit, justified
 #: exemption marker
 _ROUTED_TOKENS = ("resilience", "P2PConnError", "NativeConnError",
-                  "_transient(")
+                  "DispatchConnError", "_transient(", "_classify(")
+
+#: directories whose socket-error handlers must be classified — the
+#: native wire plane, and (since the multi-process fleet) the serve
+#: plane's dispatch path (serve/wire.py, worker.py, proc_fleet.py)
+_LINTED_DIRS = ("native", "serve")
 
 
-def test_native_socket_error_paths_route_through_resilience():
-    """Every ``except OSError``/``socket.*`` in horovod_tpu/native/
-    must either route through the resilience classifier (raise a
-    classified Conn error, consult is_retryable/_transient) or carry
-    an explicit ``# resilience: exempt (<reason>)`` marker — no future
-    unwrapped fatal wire path can sneak in."""
-    native_dir = os.path.join(REPO, "horovod_tpu", "native")
+def _socket_handler_offenders(subdir: str):
+    d = os.path.join(REPO, "horovod_tpu", subdir)
     offenders = []
-    for fn in sorted(os.listdir(native_dir)):
+    for fn in sorted(os.listdir(d)):
         if not fn.endswith(".py"):
             continue
-        lines = open(os.path.join(native_dir, fn)).read().splitlines()
+        lines = open(os.path.join(d, fn)).read().splitlines()
         for i, ln in enumerate(lines):
             if not _EXC_PAT.search(ln):
                 continue
             window = "\n".join(lines[i:i + 6])
             if not any(tok in window for tok in _ROUTED_TOKENS):
-                offenders.append(f"{fn}:{i + 1}: {ln.strip()}")
+                offenders.append(f"{subdir}/{fn}:{i + 1}: {ln.strip()}")
+    return offenders
+
+
+@pytest.mark.parametrize("subdir", _LINTED_DIRS)
+def test_socket_error_paths_route_through_resilience(subdir):
+    """Every ``except OSError``/``socket.*`` in the linted wire planes
+    (horovod_tpu/native/ AND horovod_tpu/serve/ — the fleet's dispatch
+    path) must either route through the resilience classifier (raise a
+    classified Conn error, consult is_retryable/_classify/_transient)
+    or carry an explicit ``# resilience: exempt (<reason>)`` marker —
+    no future unwrapped fatal wire path can sneak in."""
+    offenders = _socket_handler_offenders(subdir)
     assert not offenders, (
-        "unclassified socket-error handler(s) in native/ — route them "
-        "through native/resilience.py (raise NativeConnError/"
-        "P2PConnError or consult is_retryable) or mark "
+        "unclassified socket-error handler(s) — route them through "
+        "native/resilience.py (raise NativeConnError/P2PConnError/"
+        "DispatchConnError or consult is_retryable) or mark "
         "'# resilience: exempt (<reason>)':\n" + "\n".join(offenders))

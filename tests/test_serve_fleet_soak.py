@@ -1,0 +1,74 @@
+"""ISSUE 11 multi-process fleet acceptance (slow tier): REAL replica
+worker OS processes behind a ProcessFleetRouter, driven through the
+seeded serve-profile plan with ``processes=True`` by the soak harness.
+
+The plan SIGKILLs one worker process mid-traffic, fires a hard
+``conn_reset`` plus a seeded ``flaky`` window on surviving replicas'
+DISPATCH channels, and drops one admission, while a fresh weight
+version is published mid-incident. The bar (docs/serving.md,
+process-fleet section):
+
+* the SIGKILLed worker is ejected by the ACCRUAL sweep over real
+  heartbeat KV keys within 2 x suspect_s,
+* the dispatch blips are absorbed by the retry ladder
+  (``hvd_net_retries_total{site="serve.dispatch",outcome="absorbed"}``
+  > 0) with ZERO failovers beyond the scheduled kill,
+* a replayed dispatch whose reply was severed is served the worker's
+  DEDUPED result — answered-exactly-once across the process boundary,
+* the victim is RESPAWNED as a fresh process and re-admitted gated on
+  the newest published weight version,
+* p99 / error-rate SLOs hold outside the bounded recovery windows and
+  every shed reply carries retry-after.
+
+Driven through the tools/serve_soak.py --processes CLI so the CLI
+contract is covered by the same run. Mirrors test_serve_soak.py,
+including the 3-consecutive-green requirement verified at PR time.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.mark.slow
+def test_serve_fleet_soak_acceptance(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_soak.py"),
+         "--processes", "--replicas", "2", "--clients", "4",
+         "--seed", "7", "--out", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert out.stdout.strip(), out.stderr[-3000:]
+    verdict = json.loads(out.stdout)
+    detail = json.dumps(verdict, indent=2, sort_keys=True)[:3000]
+    assert verdict["processes"] is True, detail
+    assert verdict["no_silent_drops"] is True, detail
+    assert verdict["answered_once"] is True, detail
+    assert verdict["shed_carry_retry_after"] is True, detail
+    # the kill: accrual detection over real heartbeat keys, bounded
+    assert verdict["failover_bounded"] is True, detail
+    assert verdict["failover_s"] <= 2 * verdict["suspect_s"], detail
+    # the blips: absorbed by the ladder, ZERO failovers beyond the kill
+    assert verdict["blips_absorbed"] is True, detail
+    assert verdict["dispatch_absorbed"] > 0, detail
+    assert verdict["failovers_only_kills"] is True, detail
+    assert verdict["fleet"]["failovers"] == 1, detail
+    # the replay: deduped, never a duplicate execution/delivery
+    assert verdict["replays_deduped"] is True, detail
+    assert verdict["dedupe_hits"] > 0, detail
+    # the respawn: fresh process, newest published weights
+    assert verdict["respawned_on_newest"] is True, detail
+    assert verdict["fleet"]["respawns"] == 1, detail
+    assert verdict["capacity_restored"] is True, detail
+    assert verdict["slo_held"] is True, detail
+    assert verdict["ok"] and out.returncode == 0, detail
+    assert (tmp_path / "events.jsonl").exists()
+    assert (tmp_path / "requests.jsonl").exists()
+    assert (tmp_path / "verdict.json").exists()

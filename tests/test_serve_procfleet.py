@@ -1,0 +1,586 @@
+"""Multi-process serve fleet (ISSUE 11), tier-1 bars — everything that
+can be proven WITHOUT spawning worker processes (the real-process
+acceptance lives in test_serve_fleet_soak.py, slow tier):
+
+* the framed dispatch protocol classifies faults through the
+  resilience plane (EOF/reset -> Retryable DispatchConnError; garbage
+  and timeouts fatal);
+* the worker endpoint's fid dedupe serves a REPLAYED dispatch its
+  cached (or in-flight) result — a severed reply never becomes a
+  duplicate execution;
+* the router's dispatch ladder absorbs conn_reset/flaky blips with
+  ZERO failovers, and the replay after a post-send sever is served the
+  deduped result;
+* dispatch failure to the last replica resolves the handle as a
+  structured rejection with a capacity-scaled retry_after — never a
+  silent drop or a hang;
+* Retry-After rounding is a TRUE ceiling (2000 ms -> 2 s, boundary
+  values asserted);
+* the aggregate fleet /healthz reports per-replica state + live
+  capacity, 200 while capacity exists, 503 at zero;
+* drain() racing a replica respawn resolves every in-flight request
+  exactly once and never re-admits after the drain;
+* the processes=True serve plan composition is seed-deterministic,
+  epoch-pins the kill, and fail-fast validates its sites;
+* evaluate_fleet goes red on each process-boundary invariant.
+"""
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.chaos import inject
+from horovod_tpu.chaos.plan import ChaosPlan, PlanError, random_plan
+from horovod_tpu.models.gpt import GPT, GPTConfig
+from horovod_tpu.native import resilience
+from horovod_tpu.native.store import StoreServer
+from horovod_tpu.obs import metrics as obs_metrics
+from horovod_tpu.serve import (AdmissionQueue, ContinuousBatcher,
+                               FleetRouter, Rejected, Replica,
+                               ShardedExecutor, make_fleet_server,
+                               retry_after_seconds, wire)
+from horovod_tpu.serve.proc_fleet import ProcessFleetRouter
+from horovod_tpu.serve.soak import evaluate_fleet
+from horovod_tpu.serve.worker import ReplicaEndpoint
+
+_KW = dict(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+           max_seq_len=48, dtype=jnp.float32, attention_impl="reference")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    inject.uninstall()
+    yield
+    inject.uninstall()
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    train = GPT(GPTConfig(**_KW))
+    dec = GPT(GPTConfig(decode=True, **_KW))
+    params = train.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 8), jnp.int32))["params"]
+    return SimpleNamespace(dec=dec, params=params)
+
+
+@pytest.fixture(scope="module")
+def expool(gpt):
+    cache = {}
+
+    def get(rid=None, max_batch=4):
+        key = (rid, max_batch)
+        if key not in cache:
+            cache[key] = ShardedExecutor(
+                gpt.dec, gpt.params, max_batch=max_batch,
+                max_len=_KW["max_seq_len"], replica_id=rid)
+        return cache[key]
+
+    return get
+
+
+def _stack(expool, rid=0, *, max_queue=32, deadline_ms=8000.0,
+           start=True):
+    """An in-thread replica: executor + queue + batcher + endpoint."""
+    q = AdmissionQueue(max_queue=max_queue,
+                       default_deadline_ms=deadline_ms, replica_id=rid)
+    b = ContinuousBatcher(expool(rid=rid), q, buckets=(8,),
+                          replica_id=rid, kv_crc=False, spec_k=0,
+                          prefix_cache=False)
+    b.warmup()
+    if start:
+        b.start()
+    ep = ReplicaEndpoint(b, rid=rid).start()
+    return SimpleNamespace(queue=q, batcher=b, ep=ep)
+
+
+def _rpc(addr, fid, prompt, max_new=4, deadline_ms=8000):
+    s = wire.connect(addr, timeout=2.0)
+    try:
+        wire.send_msg(s, {"op": "submit", "fid": fid, "prompt": prompt,
+                          "max_new_tokens": max_new,
+                          "deadline_ms": deadline_ms})
+        ack = wire.recv_msg(s, timeout=5.0)
+        if ack.get("ack") != "accepted":
+            return ack, None
+        return ack, wire.recv_msg(s, timeout=20.0)
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# Retry-After: a true ceiling
+# ---------------------------------------------------------------------------
+
+class TestRetryAfterCeiling:
+    @pytest.mark.parametrize("ms,expect", [
+        (1, 1), (999, 1), (1000, 1), (1000.5, 2), (1999, 2),
+        (2000, 2),          # the old int(ms/1000)+1 said 3 here
+        (2000.5, 3), (2001, 3), (60000, 60), (0.5, 1),
+    ])
+    def test_boundaries(self, ms, expect):
+        assert retry_after_seconds(ms) == expect
+
+    def test_never_zero(self):
+        # a sub-second hint must not become an immediate retry
+        assert retry_after_seconds(0.001) == 1
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: classification through the resilience plane
+# ---------------------------------------------------------------------------
+
+class TestWire:
+    def test_roundtrip(self):
+        a, b = socket.socketpair()
+        try:
+            wire.send_msg(a, {"op": "submit", "tokens": [1, 2, 3]})
+            got = wire.recv_msg(b, timeout=2.0)
+            assert got == {"op": "submit", "tokens": [1, 2, 3]}
+        finally:
+            a.close()
+            b.close()
+
+    def test_eof_mid_frame_is_retryable(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", 100) + b'{"par')
+            a.close()
+            with pytest.raises(wire.DispatchConnError) as ei:
+                wire.recv_msg(b, timeout=2.0)
+            assert resilience.is_retryable(ei.value)
+        finally:
+            b.close()
+
+    def test_oversized_frame_is_fatal(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", wire.MAX_FRAME_BYTES + 1))
+            with pytest.raises(wire.DispatchError) as ei:
+                wire.recv_msg(b, timeout=2.0)
+            assert not resilience.is_retryable(ei.value)
+        finally:
+            a.close()
+            b.close()
+
+    def test_refused_dial_is_retryable(self):
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            free = probe.getsockname()[1]
+        with pytest.raises(wire.DispatchConnError) as ei:
+            wire.connect(("127.0.0.1", free), timeout=0.5)
+        assert resilience.is_retryable(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# worker endpoint: fid replay dedupe across the process boundary
+# ---------------------------------------------------------------------------
+
+class TestEndpointDedupe:
+    def test_replay_served_cached_result(self, expool):
+        st = _stack(expool)
+        try:
+            ack, r1 = _rpc(st.ep.address, "f.1", [1, 2, 3])
+            assert ack["ack"] == "accepted" and r1["status"] == "ok"
+            assert len(r1["tokens"]) == 4
+            ack, r2 = _rpc(st.ep.address, "f.1", [1, 2, 3])
+            assert r2 == r1
+            assert st.ep.dedupe_hits == 1
+            # a fresh fid is NOT deduped
+            _, r3 = _rpc(st.ep.address, "f.2", [1, 2, 3])
+            assert r3["tokens"] == r1["tokens"]   # greedy, same prompt
+            assert st.ep.dedupe_hits == 1
+        finally:
+            st.batcher.stop()
+            st.ep.close()
+
+    def test_severed_reply_replay_not_executed_twice(self, expool):
+        """The conn_reset scenario: the request frame lands, the
+        socket dies before the reply — the replay must be served the
+        SAME result and the queue must have admitted exactly once."""
+        st = _stack(expool)
+        try:
+            admitted0 = st.queue.admitted_count
+            s = wire.connect(st.ep.address, timeout=2.0)
+            wire.send_msg(s, {"op": "submit", "fid": "sever.1",
+                              "prompt": [5, 6], "max_new_tokens": 3,
+                              "deadline_ms": 8000})
+            time.sleep(0.05)
+            s.close()                      # the reply is lost
+            deadline = time.monotonic() + 5.0
+            while st.queue.admitted_count == admitted0:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            ack, r = _rpc(st.ep.address, "sever.1", [5, 6], max_new=3)
+            assert ack["ack"] == "accepted"
+            assert r["status"] == "ok" and len(r["tokens"]) == 3
+            assert st.ep.dedupe_hits == 1
+            # executed ONCE: the replay joined, it did not re-enqueue
+            assert st.queue.admitted_count == admitted0 + 1
+        finally:
+            st.batcher.stop()
+            st.ep.close()
+
+    def test_queue_full_and_draining_acks(self, expool):
+        st = _stack(expool, rid=1, max_queue=2, start=False)
+        try:
+            st.queue.submit([1, 2], max_new_tokens=2)
+            st.queue.submit([3, 4], max_new_tokens=2)
+            ack, _ = _rpc(st.ep.address, "full.1", [5, 6])
+            assert ack["ack"] == "rejected"
+            assert (ack["retry_after_ms"] or 0) > 0
+            st.batcher.draining = True
+            ack, _ = _rpc(st.ep.address, "drain.1", [5, 6])
+            assert ack["ack"] == "rejected"
+            assert "draining" in ack["reason"]
+            assert (ack["retry_after_ms"] or 0) > 0
+        finally:
+            st.ep.close()
+
+
+# ---------------------------------------------------------------------------
+# dispatch ladder: blips absorb, failures shed structurally
+# ---------------------------------------------------------------------------
+
+def _absorbed() -> int:
+    return int(obs_metrics.get_registry().counter(
+        "hvd_net_retries_total", resilience.RETRIES_HELP,
+        {"site": "serve.dispatch", "outcome": "absorbed"}).value)
+
+
+def _wire_router(srv, ep_addr, *, n=1, deadline_ms=8000.0):
+    """A ProcessFleetRouter pointed at an IN-THREAD endpoint: no
+    process spawn, same dispatch path (ladder, chaos gate, dedupe)."""
+    router = ProcessFleetRouter(
+        n, kv_addr="127.0.0.1", kv_port=srv.port,
+        worker={"deadline_ms": deadline_ms, "max_queue": 32})
+    for rid, rep in router.replicas.items():
+        rep.state = "up"
+        rep.addr = ep_addr if rid == 0 else None
+    router.started = True
+    return router
+
+
+class TestDispatchLadder:
+    def test_conn_reset_absorbed_and_replay_deduped(self, expool):
+        st = _stack(expool, rid=2)
+        srv = StoreServer()
+        router = _wire_router(srv, st.ep.address)
+        try:
+            inject.install(ChaosPlan.from_dict({"faults": [
+                {"rank": 0, "site": "serve.dispatch",
+                 "kind": "conn_reset", "peer": 0, "at": 0}]}), rank=0)
+            before = _absorbed()
+            h = router.submit([1, 2, 3], max_new_tokens=4)
+            assert h.wait(15.0) and h.status == "ok"
+            assert len(h.tokens) == 4
+            assert h.resolutions == 1
+            # the blip was ABSORBED: >=1 ladder retry, ZERO failovers,
+            # and the replay was served the worker's deduped result
+            assert _absorbed() > before
+            assert router.stats()["failovers"] == 0
+            assert st.ep.dedupe_hits == 1
+        finally:
+            router._kv.close()
+            st.batcher.stop()
+            st.ep.close()
+            srv.close()
+
+    def test_flaky_window_absorbed(self, expool):
+        st = _stack(expool, rid=3)
+        srv = StoreServer()
+        router = _wire_router(srv, st.ep.address)
+        try:
+            # prob=1.0 drops every crossing of [0, 1]: attempts 0 and 1
+            # drop deterministically, attempt 2 exits the window
+            inject.install(ChaosPlan.from_dict({"faults": [
+                {"rank": 0, "site": "serve.dispatch", "kind": "flaky",
+                 "peer": 0, "prob": 1.0, "after": 0, "until": 1}]}),
+                rank=0)
+            before = _absorbed()
+            h = router.submit([4, 5], max_new_tokens=2)
+            assert h.wait(15.0) and h.status == "ok"
+            assert _absorbed() >= before + 2
+            assert router.stats()["failovers"] == 0
+        finally:
+            router._kv.close()
+            st.batcher.stop()
+            st.ep.close()
+            srv.close()
+
+    def test_dead_endpoint_sheds_with_scaled_retry_after(self, expool):
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            free = probe.getsockname()[1]
+        srv = StoreServer()
+        router = _wire_router(srv, ("127.0.0.1", free))
+        router._ladder = resilience.RetryPolicy(
+            retries=1, backoff_base_ms=5.0, budget_s=0.5)
+        try:
+            h = router.submit([1, 2], max_new_tokens=2)
+            assert h.wait(10.0), "handle must never hang"
+            assert h.status == "rejected"
+            assert h.resolutions == 1
+            assert (h.retry_after_ms or 0) > 0
+        finally:
+            router._kv.close()
+            srv.close()
+
+    def test_zero_capacity_sheds_synchronously(self, expool):
+        srv = StoreServer()
+        router = _wire_router(srv, None)
+        router.replicas[0].state = "down"
+        try:
+            with pytest.raises(Rejected) as ei:
+                router.submit([1, 2])
+            assert (ei.value.retry_after_ms or 0) > 0
+        finally:
+            router._kv.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# aggregate fleet /healthz + front door
+# ---------------------------------------------------------------------------
+
+class TestFleetHealthz:
+    def test_aggregate_and_zero_capacity_503(self, expool):
+        reps = [Replica(i, expool(rid=i), buckets=(8,), max_queue=8,
+                        kv_crc=False)
+                for i in range(2)]
+        router = FleetRouter(reps, interval_s=0.1, suspect_s=0.5,
+                             auto_restart=False)
+        router.start()
+        srv = make_fleet_server(router)
+        port = srv.server_address[1]
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                info = json.loads(r.read())
+                assert r.status == 200
+            assert info["ok"] is True
+            assert info["capacity"]["replicas_up"] == 2
+            assert info["capacity"]["queue_free"] > 0
+            assert set(info["replicas"]) == {"0", "1"}
+            assert all(v["state"] == "up"
+                       for v in info["replicas"].values())
+            # the front door routes: one generate through the fleet
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"tokens": [1, 2, 3],
+                                 "max_new_tokens": 2}).encode(),
+                method="POST")
+            with urllib.request.urlopen(req, timeout=15) as r:
+                out = json.loads(r.read())
+            assert out["status"] == "ok" and len(out["tokens"]) == 2
+            # zero live capacity -> 503, same payload shape
+            for rep in reps:
+                rep.batcher.stop()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5)
+            assert ei.value.code == 503
+            info = json.loads(ei.value.read())
+            assert info["ok"] is False
+            assert info["capacity"]["replicas_up"] == 0
+        finally:
+            srv.shutdown()
+            router.close()
+
+    def test_process_router_healthz_shape(self, expool):
+        srv = StoreServer()
+        router = _wire_router(srv, None, n=2)
+        router.replicas[1].state = "respawning"
+        try:
+            info = router.healthz()
+            assert info["replicas"]["1"]["state"] == "respawning"
+            assert info["capacity"]["replicas_total"] == 2
+            assert info["retry_after_ms"] > 0
+        finally:
+            router._kv.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# drain racing a respawn (satellite): exactly-once, no zombie re-admit
+# ---------------------------------------------------------------------------
+
+class TestDrainDuringRespawn:
+    def test_drain_racing_recover_resolves_every_handle(self, expool):
+        reps = [Replica(i, expool(rid=i), buckets=(8,), max_queue=32,
+                        kv_crc=False)
+                for i in range(2)]
+        router = FleetRouter(reps, interval_s=0.05, suspect_s=0.2,
+                             auto_restart=True, rewarm_timeout_s=5.0)
+        router.start()
+        handles = []
+        # slow the victim's rebuild so drain() lands MID-respawn
+        victim = reps[0]
+        orig_build = victim.build
+
+        def slow_build():
+            time.sleep(0.4)
+            orig_build()
+
+        victim.build = slow_build
+        try:
+            # keep a tail of work in flight on both replicas
+            rng = np.random.RandomState(3)
+            for _ in range(8):
+                handles.append(router.submit(
+                    list(rng.randint(1, 64, 4)), max_new_tokens=24))
+            # kill the victim's scheduler: eject + auto-restart begin
+            victim.batcher._dead = True
+            deadline = time.monotonic() + 5.0
+            while victim.state != "down" and not router._restarting:
+                assert time.monotonic() < deadline, "never ejected"
+                time.sleep(0.01)
+            router.drain(timeout_s=5.0)
+            # EVERY handle resolved exactly once, never silently
+            for h in handles:
+                assert h.done(), "drain left a handle unresolved"
+                assert h.resolutions <= 1
+                assert h.status in ("ok", "expired", "rejected")
+                if h.status == "rejected":
+                    assert (h.retry_after_ms or 0) > 0
+            # and the respawn did NOT re-admit into a drained fleet
+            time.sleep(0.8)   # let the slow recover thread finish
+            assert victim.state != "up"
+        finally:
+            victim.build = orig_build
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# plan composition + verdict reds
+# ---------------------------------------------------------------------------
+
+class TestProcessPlan:
+    def test_deterministic_and_composed(self):
+        p1 = random_plan(7, 2, 240, profile="serve", processes=True)
+        p2 = random_plan(7, 2, 240, profile="serve", processes=True)
+        assert p1.to_json() == p2.to_json()
+        sites = {(f.site, f.kind) for f in p1.faults}
+        assert ("serve.proc", "crash") in sites
+        assert ("serve.dispatch", "conn_reset") in sites
+        assert ("serve.dispatch", "flaky") in sites
+        assert ("serve.admit", "drop") in sites
+        kill = next(f for f in p1.faults if f.kind == "crash")
+        # epoch-pinned: the respawned worker's fresh counters must not
+        # re-fire the kill every generation
+        assert kill.epoch == 0
+        # blips never target the victim (nothing to absorb INTO)
+        for f in p1.faults:
+            if f.site == "serve.dispatch":
+                assert f.peer != kill.peer
+
+    def test_fail_fast_validation(self):
+        with pytest.raises(PlanError):
+            random_plan(7, 4, 40, profile="train", processes=True)
+        with pytest.raises(PlanError):
+            ChaosPlan.from_dict({"faults": [
+                {"rank": 0, "site": "serve.dispatch", "kind": "crash",
+                 "peer": 0, "at": 1}]})
+        with pytest.raises(PlanError):
+            ChaosPlan.from_dict({"faults": [
+                {"rank": 0, "site": "serve.proc", "kind": "conn_reset",
+                 "peer": 0, "at": 1}]})
+        # the new sites accept their kinds
+        ChaosPlan.from_dict({"faults": [
+            {"rank": 0, "site": "serve.proc", "kind": "crash",
+             "peer": 1, "at": 5, "epoch": 0},
+            {"rank": 0, "site": "serve.proc", "kind": "slow_rank",
+             "peer": 0, "at": 3, "seconds": 1.5},
+            {"rank": 0, "site": "serve.dispatch", "kind": "conn_reset",
+             "peer": 1, "at": 2},
+            {"rank": 0, "site": "serve.dispatch", "kind": "flaky",
+             "peer": 1, "prob": 0.5, "after": 1, "until": 4},
+            {"rank": 0, "site": "serve.dispatch", "kind": "jitter",
+             "peer": 1, "seconds": 0.05, "after": 0, "until": 9},
+        ]})
+
+
+def _green_fixture():
+    plan = random_plan(7, 2, 240, profile="serve", processes=True)
+    kill = next(f for f in plan.faults if f.kind == "crash")
+    victim = kill.peer
+    records = [{"fid": i, "t0": 1.0 + i, "t1": 1.05 + i,
+                "status": "ok", "latency_ms": 50.0,
+                "retry_after_ms": None, "resolutions": 1}
+               for i in range(30)]
+    events = [
+        {"kind": "chaos", "fault": "crash", "site": "serve.proc",
+         "peer": victim, "t": 100.0},
+        {"kind": "fleet", "event": "eject", "replica": victim,
+         "t": 101.0},
+        {"kind": "fleet", "event": "readmit", "replica": victim,
+         "weights_version": 2, "t": 108.0},
+    ]
+    fleet_stats = {
+        "replicas_up": 2, "inflight": 0, "failovers": 1,
+        "respawns": 1, "duplicates_suppressed": 0,
+        "replicas": {0: {"weights_version": 2},
+                     1: {"weights_version": 2}},
+    }
+    return plan, records, events, fleet_stats
+
+
+def _eval(plan, records, events, fleet_stats, **kw):
+    base = dict(replicas=2, suspect_s=1.0, slo_p99_ms=15000.0,
+                slo_error_rate=0.02, recovery_window_s=6.0,
+                newest_version=2, dispatch_absorbed=3, dedupe_hits=1)
+    base.update(kw)
+    return evaluate_fleet(records, events, plan, fleet_stats, **base)
+
+
+class TestFleetVerdict:
+    def test_green(self):
+        v = _eval(*_green_fixture())
+        assert v["blips_absorbed"] is True
+        assert v["failovers_only_kills"] is True
+        assert v["replays_deduped"] is True
+        assert v["respawned_on_newest"] is True
+        assert v["ok"] is True, json.dumps(v, indent=2, default=str)
+
+    def test_red_blip_not_absorbed(self):
+        v = _eval(*_green_fixture(), dispatch_absorbed=0)
+        assert v["blips_absorbed"] is False and v["ok"] is False
+
+    def test_red_replay_not_deduped(self):
+        v = _eval(*_green_fixture(), dedupe_hits=0)
+        assert v["replays_deduped"] is False and v["ok"] is False
+
+    def test_red_blip_caused_failover(self):
+        plan, records, events, stats = _green_fixture()
+        stats = dict(stats, failovers=2)
+        v = _eval(plan, records, events, stats)
+        assert v["failovers_only_kills"] is False and v["ok"] is False
+
+    def test_red_respawn_on_stale_weights(self):
+        plan, records, events, stats = _green_fixture()
+        events = [dict(e) for e in events]
+        for e in events:
+            if e.get("event") == "readmit":
+                e["weights_version"] = 1
+        v = _eval(plan, records, events, stats)
+        assert v["respawned_on_newest"] is False and v["ok"] is False
+
+    def test_red_unbounded_failover(self):
+        plan, records, events, stats = _green_fixture()
+        events = [dict(e) for e in events]
+        for e in events:
+            if e.get("event") == "eject":
+                e["t"] = 103.5          # 3.5s > 2 x suspect_s
+        v = _eval(plan, records, events, stats)
+        assert v["failover_bounded"] is False and v["ok"] is False

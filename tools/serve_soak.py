@@ -9,6 +9,15 @@ harness for those paths; `--slotted` / `--no-prefix-cache` /
 
     python tools/serve_soak.py --replicas 3 --clients 6 --seed 7
     python tools/serve_soak.py --plan my_serve_plan.json --out /tmp/s1
+    python tools/serve_soak.py --processes --replicas 2 --seed 7
+
+`--processes` switches to the MULTI-PROCESS fleet soak: replicas are
+real worker OS processes (horovod_tpu/serve/worker.py) behind a
+ProcessFleetRouter, the seeded plan SIGKILLs one worker mid-traffic
+and fires conn_reset/flaky blips on the dispatch wire, and the verdict
+additionally asserts blips absorbed with zero failovers, replayed
+dispatches deduped, and the respawned victim re-admitted on the newest
+published weight version.
 
 The verdict (stdout, one JSON object) carries the evidence for each
 invariant: no_silent_drops, answered_once, shed_carry_retry_after,
@@ -44,8 +53,11 @@ def main(argv=None) -> int:
                         "a plan JSON")
     p.add_argument("--steps", type=int, default=240,
                    help="scheduler-iteration horizon the plan lands in")
-    p.add_argument("--suspect-s", type=float, default=1.0,
-                   help="heartbeat age past which a replica is ejected")
+    p.add_argument("--suspect-s", type=float, default=None,
+                   help="heartbeat age past which a replica is ejected "
+                        "(default 1.0 in-process, 2.0 with --processes "
+                        "— cross-process heartbeats on a small box "
+                        "need the margin)")
     p.add_argument("--slo-p99-ms", type=float, default=15000.0,
                    help="p99 latency bound outside recovery windows")
     p.add_argument("--slo-error-rate", type=float, default=0.02,
@@ -53,7 +65,10 @@ def main(argv=None) -> int:
     p.add_argument("--recovery-window", type=float, default=6.0,
                    help="seconds after each fault excluded from SLO")
     p.add_argument("--min-duration", type=float, default=8.0)
-    p.add_argument("--max-duration", type=float, default=45.0)
+    p.add_argument("--max-duration", type=float, default=None,
+                   help="soak wall-clock cap (default 45 in-process, "
+                        "150 with --processes — a respawn is a full "
+                        "worker startup and the kill may fire late)")
     p.add_argument("--out", default=None,
                    help="dump events/requests/verdict into this dir")
     p.add_argument("--no-kv-crc", action="store_true",
@@ -64,29 +79,64 @@ def main(argv=None) -> int:
                         "the default paged block pool")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable the radix prefix cache (paged only)")
-    p.add_argument("--spec-k", type=int, default=3,
+    p.add_argument("--spec-k", type=int, default=None,
                    help="speculative draft depth (0 disables the "
-                        "drafter; default 3)")
+                        "drafter; default 3 in-process, 0 with "
+                        "--processes — worker startup cost)")
+    p.add_argument("--processes", action="store_true",
+                   help="MULTI-PROCESS fleet soak: replicas are real "
+                        "worker OS processes behind a "
+                        "ProcessFleetRouter; the seeded plan SIGKILLs "
+                        "one worker and blips the dispatch wire "
+                        "(docs/serving.md, process-fleet section)")
+    p.add_argument("--spawn-timeout", type=float, default=120.0,
+                   help="--processes: seconds to wait for a worker "
+                        "process to register ready")
     args = p.parse_args(argv)
 
-    # one in-process fleet on CPU devices; keep the run reproducible
+    # one fleet on CPU devices; keep the run reproducible
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.processes:
+        from horovod_tpu.serve.soak import run_fleet_soak
+        verdict = run_fleet_soak(
+            args.out, replicas=args.replicas, clients=args.clients,
+            seed=args.seed,
+            plan=None if args.plan == "random" else args.plan,
+            steps=args.steps,
+            suspect_s=2.0 if args.suspect_s is None else args.suspect_s,
+            slo_p99_ms=args.slo_p99_ms,
+            slo_error_rate=args.slo_error_rate,
+            recovery_window_s=args.recovery_window,
+            min_duration_s=args.min_duration,
+            max_duration_s=(150.0 if args.max_duration is None
+                            else args.max_duration),
+            spec_k=0 if args.spec_k is None else args.spec_k,
+            paged=not args.slotted,
+            kv_crc=False if args.no_kv_crc else None,
+            prefix_cache=False if args.no_prefix_cache else None,
+            spawn_timeout_s=args.spawn_timeout)
+        json.dump(verdict, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0 if verdict["ok"] else 1
 
     from horovod_tpu.serve.soak import run_serve_soak
     verdict = run_serve_soak(
         args.out, replicas=args.replicas, clients=args.clients,
         seed=args.seed,
         plan=None if args.plan == "random" else args.plan,
-        steps=args.steps, suspect_s=args.suspect_s,
+        steps=args.steps,
+        suspect_s=1.0 if args.suspect_s is None else args.suspect_s,
         slo_p99_ms=args.slo_p99_ms,
         slo_error_rate=args.slo_error_rate,
         recovery_window_s=args.recovery_window,
         min_duration_s=args.min_duration,
-        max_duration_s=args.max_duration,
+        max_duration_s=(45.0 if args.max_duration is None
+                        else args.max_duration),
         kv_crc=False if args.no_kv_crc else None,
         paged=not args.slotted,
         prefix_cache=False if args.no_prefix_cache else None,
-        spec_k=args.spec_k,
+        spec_k=3 if args.spec_k is None else args.spec_k,
         sigterm_drain=True)
     json.dump(verdict, sys.stdout, indent=2, sort_keys=True)
     print()
