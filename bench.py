@@ -20,8 +20,14 @@ registry snapshot as a second JSON line (docs/metrics.md).
 
 `--serve` runs the serving ACCEPTANCE GATE (slotted vs paged+prefix vs
 speculative over a shared-system-prompt overload burst; bit-identity,
->=1.5x paged speedup, token-bounded KV, TTFT/jit-flat/spec bars all
-asserted — exit nonzero on violation, docs/serving.md),
+paged-speedup bar (HVD_BENCH_SERVE_SPEEDUP_BAR, default 1.25 since the
+round-6 last_idx baseline speedup), token-bounded KV,
+TTFT/jit-flat/spec bars all
+asserted — exit nonzero on violation — plus the decode-KERNEL bars:
+the full configuration on pallas vs xla, parity gated everywhere,
+speed gated on TPU, docs/serving.md), `--kernel-parity` the standalone
+pallas==xla token-stream gate ({GPT, Llama-GQA} x {greedy, spec,
+sampled}),
 `--serve-soak` the chaos-hardened fleet soak (serve_p99_under_fault_ms
 + failover_ms from a seeded crash/partition/corrupt/slow incident,
 now paged+prefix+speculative by default —
@@ -375,8 +381,11 @@ def run_serve_benchmark() -> int:
 
       * bit-identical output: every configuration emits exactly the
         slotted greedy baseline's tokens (same tokens, same stops);
-      * speedup: paged+prefix tokens/s >= 1.5x slotted on this
-        shared-prefix workload;
+      * speedup: paged+prefix tokens/s >= HVD_BENCH_SERVE_SPEEDUP_BAR
+        (default 1.25) x slotted on this shared-prefix workload — the
+        bar was 1.5 until round 6's last_idx logits restriction sped
+        the slotted BASELINE's prefill (every absolute number
+        improved; the ratio honestly shrank);
       * tokens/s floor: the full configuration sustains >=
         HVD_BENCH_SERVE_TOKS_BAR tok/s per chip;
       * memory: peak KV tokens RESIDENT in the paged pool stay under
@@ -408,6 +417,18 @@ def run_serve_benchmark() -> int:
         platform = jax.devices()[0].platform
         n_req = int(os.environ.get("HVD_BENCH_SERVE_REQUESTS", "32"))
         toks_bar = float(os.environ.get("HVD_BENCH_SERVE_TOKS_BAR", "25"))
+        # paged-vs-slotted ratio bar. Recalibrated 1.5 -> 1.25 in round
+        # 6: the last_idx logits restriction (serve/executor.py) cut
+        # the SLOTTED baseline's per-prefill cost by the whole
+        # [B, bucket, V] lm_head + argmax (~30-50% on this tiny-vocab
+        # bench model), so the ratio shrank while every absolute
+        # number improved (slotted 700->1050 tok/s class on the CPU
+        # container; paged+prefix unchanged ~1370). The absolute floor
+        # (toks_bar) and the token-bounded-KV gate still ratchet the
+        # layout's value; this bar guards the prefix cache's RELATIVE
+        # win on the shared-prompt workload.
+        speedup_bar = float(os.environ.get(
+            "HVD_BENCH_SERVE_SPEEDUP_BAR", "1.25"))
         ttft_bar_ms = float(os.environ.get(
             "HVD_BENCH_SERVE_TTFT_P99_MS", "5000"))
         max_batch = cfg.serve_max_batch
@@ -445,10 +466,11 @@ def run_serve_benchmark() -> int:
                    for _ in range(n_req)]
         prime = system + list(rng.randint(0, 256, tail_max))
 
-        def drive(paged, prefix, spec):
+        def drive(paged, prefix, spec, kernel="xla"):
             mcfg = GPTConfig(decode=True, **kw,
                              kv_block_size=block if paged else 0,
-                             kv_pool_blocks=pool_blocks if paged else 0)
+                             kv_pool_blocks=pool_blocks if paged else 0,
+                             decode_kernel=kernel if paged else None)
             ex = ShardedExecutor(GPT(mcfg), params, max_batch=max_batch,
                                  max_len=max_len)
             draft = None
@@ -505,10 +527,16 @@ def run_serve_benchmark() -> int:
         slotted = drive(False, False, False)
         paged = drive(True, True, False)
         full = drive(True, True, True)
+        # kernel bars: the identical full configuration on the fused
+        # Pallas kernels (compiled on TPU; interpret mode on CPU —
+        # an EMULATOR, so off-TPU the speed ratio only documents the
+        # emulation cost and the gate asserts PARITY, not speed)
+        full_pallas = drive(True, True, True, kernel="pallas")
 
         accept = obs_metrics.get_registry().get(
             "hvd_serve_spec_accept_rate")
         speedup = paged["tok_s"] / slotted["tok_s"]
+        kernel_speedup = full_pallas["tok_s"] / full["tok_s"]
         # tokens-resident bound: the shared prefix run plus each row's
         # private tail+generation+speculative-margin blocks, with 1.5x
         # slack for re-prefills/CoW — far under slots x max_len
@@ -520,7 +548,7 @@ def run_serve_benchmark() -> int:
         gates = {
             "bit_identical_paged": paged["tokens"] == slotted["tokens"],
             "bit_identical_spec": full["tokens"] == slotted["tokens"],
-            "speedup_ge_1p5": speedup >= 1.5,
+            "speedup_ge_bar": speedup >= speedup_bar,
             "tokens_per_s_ge_bar": full["tok_s"] >= toks_bar,
             "kv_peak_bounded_by_tokens":
                 paged["peak_tokens"] <= token_bound < slot_bound
@@ -535,6 +563,13 @@ def run_serve_benchmark() -> int:
                 and full["steps_per_token"] < 0.7,
             "spec_accept_rate_exported":
                 accept is not None and accept.count > 0,
+            # the Pallas path must emit the identical token stream;
+            # the tokens/s ratchet is asserted only where the kernel
+            # actually compiles (TPU) — interpret mode is an emulator
+            "kernel_parity": full_pallas["tokens"] == slotted["tokens"],
+            "kernel_jit_flat": full_pallas["jit_flat"],
+            **({"kernel_speedup_ge_1": kernel_speedup >= 1.0}
+               if platform == "tpu" else {}),
         }
         common = {"platform": platform, "requests": n_req,
                   "max_batch": max_batch, "system_prompt_len": sys_len,
@@ -542,8 +577,9 @@ def run_serve_benchmark() -> int:
                   "kv_block": block, "kv_pool_blocks": pool_blocks}
         if os.environ.get("HVD_BENCH_METRICS") == "1":
             from horovod_tpu import obs
-            hist = obs.get_registry().get("hvd_serve_step_ms",
-                                          {"kind": "decode"})
+            hist = obs.get_registry().get(
+                "hvd_serve_step_ms",
+                {"kind": "decode", "kernel": "pallas"})
             if hist is not None and hist.count:
                 common["step_ms_p50"] = round(hist.percentile(0.50), 3)
                 common["step_ms_p99"] = round(hist.percentile(0.99), 3)
@@ -562,7 +598,7 @@ def run_serve_benchmark() -> int:
             **common}), flush=True)
         print(json.dumps({
             "metric": "serve_paged_speedup",
-            "value": round(speedup, 3), "unit": "x", "bar": 1.5,
+            "value": round(speedup, 3), "unit": "x", "bar": speedup_bar,
             "prefix_hits": paged["prefix_hits"],
             "prefix_tokens_saved": paged["tokens_saved"],
             **common}), flush=True)
@@ -576,6 +612,18 @@ def run_serve_benchmark() -> int:
             "value": (None if full["ttft_p99_ms"] is None
                       else round(full["ttft_p99_ms"], 1)),
             "unit": "ms", "bar": ttft_bar_ms, **common}), flush=True)
+        print(json.dumps({
+            "metric": "serve_kernel_speedup",
+            "value": round(kernel_speedup, 3), "unit": "x",
+            "pallas_tokens_per_s": round(full_pallas["tok_s"], 2),
+            "xla_tokens_per_s": round(full["tok_s"], 2),
+            "pallas_ttft_p99_ms": (
+                None if full_pallas["ttft_p99_ms"] is None
+                else round(full_pallas["ttft_p99_ms"], 1)),
+            "xla_ttft_p99_ms": (None if full["ttft_p99_ms"] is None
+                                else round(full["ttft_p99_ms"], 1)),
+            "gated_on_speed": platform == "tpu",
+            **common}), flush=True)
         print(json.dumps({
             "metric": "serve_spec_steps_per_token",
             "value": (None if full["steps_per_token"] is None
@@ -595,6 +643,96 @@ def run_serve_benchmark() -> int:
             print(json.dumps({"metric": metric, "value": None,
                               "unit": unit, "error": str(e)[-500:]}),
                   flush=True)
+        return 1
+
+
+def run_kernel_parity() -> int:
+    """`bench.py --kernel-parity`: assert the fused Pallas serving
+    kernels emit TOKEN STREAMS identical to the XLA oracle across the
+    matrix {GPT, Llama-GQA} x {greedy, speculative, sampled} on the
+    full paged+prefix stack (interpret mode off TPU — the same parity
+    tier the tier-1 suite guards, here as a standalone CI/bench gate).
+    One JSON verdict line per cell; exit nonzero on any mismatch."""
+    try:
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.gpt import GPT, GPTConfig
+        from horovod_tpu.models.llama import Llama, LlamaConfig
+        from horovod_tpu.serve import (AdmissionQueue,
+                                       ContinuousBatcher,
+                                       ShardedExecutor)
+
+        platform = jax.devices()[0].platform
+        block, pool = 4, 48
+        ok_all = True
+
+        def family(name):
+            if name == "gpt":
+                kw = dict(vocab_size=64, num_layers=2, num_heads=4,
+                          head_dim=8, max_seq_len=48, dtype=jnp.float32,
+                          attention_impl=None if platform == "tpu"
+                          else "reference")
+                mk = lambda **d: GPT(GPTConfig(**kw, **d))  # noqa: E731
+            else:
+                kw = dict(vocab_size=64, num_layers=2, num_heads=4,
+                          num_kv_heads=2, head_dim=8, max_seq_len=48,
+                          dtype=jnp.float32,
+                          attention_impl=None if platform == "tpu"
+                          else "reference")
+                mk = lambda **d: Llama(LlamaConfig(**kw, **d))  # noqa: E731
+            params = mk().init(jax.random.PRNGKey(0),
+                               jnp.zeros((2, 8), jnp.int32))["params"]
+            return mk, params
+
+        def drive(mk, params, kernel, spec, sampling):
+            ex = ShardedExecutor(
+                mk(decode=True, kv_block_size=block,
+                   kv_pool_blocks=pool, decode_kernel=kernel),
+                params, max_batch=4, max_len=48)
+            draft = ShardedExecutor(mk(decode=True), params,
+                                    max_batch=4, max_len=48,
+                                    role="draft") if spec else None
+            q = AdmissionQueue(max_queue=32)
+            b = ContinuousBatcher(ex, q, buckets=(8, 16),
+                                  prefix_cache=True,
+                                  draft_executor=draft, spec_k=3)
+            b.warmup()
+            # varied, mostly-divergent prompts (one fixed stream per
+            # CELL so xla/pallas see identical inputs): shared-prefix
+            # rows would all hit the radix cache and under-exercise
+            # divergent block tables
+            prng = np.random.RandomState(5)
+            prompts = [list(prng.randint(0, 64, 2 + (i % 6)))
+                       for i in range(8)]
+            hs = [q.submit(p, max_new_tokens=5, **(sampling or {}))
+                  for p in prompts]
+            b.run()
+            assert all(h.status == "ok" for h in hs)
+            return [h.tokens for h in hs]
+
+        sampled = dict(temperature=0.8, top_p=0.9, seed=11)
+        for fam_name in ("gpt", "llama"):
+            mk, params = family(fam_name)
+            for mode, spec, samp in (("greedy", False, None),
+                                     ("spec", True, None),
+                                     ("sampled", False, sampled)):
+                xla = drive(mk, params, "xla", spec, samp)
+                pal = drive(mk, params, "pallas", spec, samp)
+                ok = xla == pal
+                ok_all = ok_all and ok
+                print(json.dumps({
+                    "metric": "serve_kernel_parity", "model": fam_name,
+                    "mode": mode, "value": ok,
+                    "platform": platform}), flush=True)
+        print(json.dumps({"metric": "serve_kernel_parity_gate",
+                          "value": ok_all}), flush=True)
+        return 0 if ok_all else 1
+    except Exception as e:  # noqa: BLE001 — structured error
+        print(json.dumps({"metric": "serve_kernel_parity_gate",
+                          "value": None, "error": str(e)[-500:]}),
+              flush=True)
         return 1
 
 
@@ -1085,6 +1223,9 @@ if __name__ == "__main__":
     elif "--serve-fleet" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE_FLEET") == "1":
         sys.exit(run_fleet_benchmark())
+    elif "--kernel-parity" in sys.argv or \
+            os.environ.get("HVD_BENCH_KERNEL_PARITY") == "1":
+        sys.exit(run_kernel_parity())
     elif "--serve" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE") == "1":
         sys.exit(run_serve_benchmark())

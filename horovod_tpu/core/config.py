@@ -181,6 +181,16 @@ class Config:
     # recomputing attention. Paged-only (the slotted layout has no
     # shareable unit); flushed on every weight-version swap.
     serve_prefix_cache: bool = True
+    # Paged decode attention kernel (HOROVOD_SERVE_KERNEL): "pallas"
+    # runs the fused block-table-aware Pallas kernels
+    # (ops/pallas_paged.py — interpret mode off TPU, the parity/CI
+    # tier), "xla" the gather+masked-einsum oracle, "auto" (default)
+    # pallas on TPU and xla elsewhere. Resolved ONCE at executor build
+    # (serve/executor.py) so the jit cache stays flat; the resolved
+    # path is named by a one-shot KERNEL timeline instant and the
+    # hvd_serve_step_ms {kernel=...} label, so a silent fallback to
+    # XLA on TPU is visible.
+    serve_kernel: str = "auto"
     # Speculative decoding draft depth (HOROVOD_SERVE_SPEC_K): with a
     # draft executor attached, the drafter proposes up to this many
     # tokens per iteration and the target verifies them in ONE
@@ -343,6 +353,9 @@ class Config:
             "HOROVOD_SERVE_PREFIX_CACHE", c.serve_prefix_cache)
         c.serve_spec_k = _env_int_strict(
             "HOROVOD_SERVE_SPEC_K", c.serve_spec_k)
+        raw = os.environ.get("HOROVOD_SERVE_KERNEL")
+        if raw is not None:
+            c.serve_kernel = raw.strip().lower()
         # Ckpt knobs parse strictly (the PR 1-3 convention): a typo'd
         # depth/retention must fail at startup, not silently fall back
         # and change durability semantics mid-job.
@@ -491,6 +504,11 @@ class Config:
                 f"HOROVOD_SERVE_SPEC_K must be an int in [0, 64] (the "
                 f"verify step's shape is [max_batch, spec_k+1] — it "
                 f"joins the precompiled bucket set); got {sk!r}")
+        if self.serve_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"HOROVOD_SERVE_KERNEL must be 'auto', 'pallas' or "
+                f"'xla' (the paged decode attention kernel — resolved "
+                f"once at executor build); got {self.serve_kernel!r}")
         mp = self.metrics_port
         if not isinstance(mp, int) or not (0 <= mp <= 65535):
             raise ValueError(

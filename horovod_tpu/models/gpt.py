@@ -34,7 +34,8 @@ class GPTConfig:
                  logits_dtype=jnp.float32,
                  decode: bool = False,
                  kv_block_size: int = 0,
-                 kv_pool_blocks: int = 0):
+                 kv_pool_blocks: int = 0,
+                 decode_kernel: Optional[str] = None):
         if decode and attention != "dense":
             raise ValueError(
                 f"decode mode supports attention='dense' only (got "
@@ -46,6 +47,16 @@ class GPTConfig:
             raise ValueError(
                 "paged decode (kv_block_size > 0) needs kv_pool_blocks "
                 ">= 1 — the device pool shape is static")
+        if decode_kernel not in (None, "pallas", "xla"):
+            raise ValueError(
+                f"decode_kernel must be None (resolve from "
+                f"HOROVOD_SERVE_KERNEL at executor build), 'pallas' or "
+                f"'xla'; got {decode_kernel!r}")
+        if decode_kernel == "pallas" and not kv_block_size:
+            raise ValueError(
+                "decode_kernel='pallas' is paged-only (the fused kernel "
+                "reads the block pool in place); set kv_block_size > 0 "
+                "or keep the slotted XLA path")
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -85,6 +96,12 @@ class GPTConfig:
         #: the slotted layout.
         self.kv_block_size = kv_block_size
         self.kv_pool_blocks = kv_pool_blocks
+        #: paged decode attention implementation: "pallas" (the fused
+        #: block-table-aware kernel, ops/pallas_paged.py — interpret
+        #: mode off TPU), "xla" (the gather+masked-einsum oracle), or
+        #: None — resolve from HOROVOD_SERVE_KERNEL once at executor
+        #: build (serve/executor.py)
+        self.decode_kernel = decode_kernel
 
 
 class Attention(nn.Module):
@@ -130,8 +147,13 @@ class Attention(nn.Module):
                 ck.value, cv.value = kvc.write_kv_paged(
                     ck.value, cv.value, k, v, positions, update_mask,
                     block_tables)
-                o = kvc.paged_attention(q, ck.value, cv.value,
-                                        block_tables, positions)
+                if getattr(cfg, "decode_kernel", None) == "pallas":
+                    from ..ops.pallas_paged import paged_attention_fused
+                    o = paged_attention_fused(q, ck.value, cv.value,
+                                              block_tables, positions)
+                else:
+                    o = kvc.paged_attention(q, ck.value, cv.value,
+                                            block_tables, positions)
             else:
                 ck = self.variable(
                     "cache", "k", jnp.zeros,
@@ -211,7 +233,7 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, update_mask=None,
-                 block_tables=None):
+                 block_tables=None, logits_idx=None):
         cfg = self.cfg
         B, S = tokens.shape
         if cfg.decode and (positions is None or update_mask is None):
@@ -246,6 +268,14 @@ class GPT(nn.Module):
         if zig:
             x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if logits_idx is not None:
+            # decode/prefill serving: only the per-row emitting
+            # position's logits are ever consumed — gather it BEFORE
+            # the lm_head so the largest GEMM of the step (and the
+            # sampling work downstream) runs at [B, 1, V], not
+            # [B, bucket, V] (serve/executor.py)
+            x = jnp.take_along_axis(
+                x, logits_idx.astype(jnp.int32)[:, None, None], axis=1)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
                           dtype=cfg.logits_dtype,
                           param_dtype=jnp.float32, name="lm_head")(x)

@@ -45,7 +45,18 @@ class LlamaConfig:
                  logits_dtype=jnp.float32,
                  decode: bool = False,
                  kv_block_size: int = 0,
-                 kv_pool_blocks: int = 0):
+                 kv_pool_blocks: int = 0,
+                 decode_kernel: Optional[str] = None):
+        if decode_kernel not in (None, "pallas", "xla"):
+            raise ValueError(
+                f"decode_kernel must be None (resolve from "
+                f"HOROVOD_SERVE_KERNEL at executor build), 'pallas' or "
+                f"'xla'; got {decode_kernel!r}")
+        if decode_kernel == "pallas" and not kv_block_size:
+            raise ValueError(
+                "decode_kernel='pallas' is paged-only (the fused kernel "
+                "reads the block pool in place); set kv_block_size > 0 "
+                "or keep the slotted XLA path")
         if decode and attention != "dense":
             raise ValueError(
                 f"decode mode supports attention='dense' only (got "
@@ -98,6 +109,10 @@ class LlamaConfig:
         #: saving compounds with token-bounded occupancy
         self.kv_block_size = kv_block_size
         self.kv_pool_blocks = kv_pool_blocks
+        #: paged decode attention implementation (see
+        #: GPTConfig.decode_kernel): "pallas" | "xla" | None = resolve
+        #: from HOROVOD_SERVE_KERNEL at executor build
+        self.decode_kernel = decode_kernel
 
 
 def _round_up(x: int, m: int) -> int:
@@ -192,8 +207,13 @@ class LlamaAttention(nn.Module):
                 ck.value, cv.value = kvc.write_kv_paged(
                     ck.value, cv.value, k, v, positions, update_mask,
                     block_tables)
-                o = kvc.paged_attention(q, ck.value, cv.value,
-                                        block_tables, positions)
+                if getattr(cfg, "decode_kernel", None) == "pallas":
+                    from ..ops.pallas_paged import paged_attention_fused
+                    o = paged_attention_fused(q, ck.value, cv.value,
+                                              block_tables, positions)
+                else:
+                    o = kvc.paged_attention(q, ck.value, cv.value,
+                                            block_tables, positions)
             else:
                 ck = self.variable("cache", "k", jnp.zeros,
                                    (B, cfg.max_seq_len, KV, D), cfg.dtype)
@@ -293,7 +313,7 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, update_mask=None,
-                 block_tables=None):
+                 block_tables=None, logits_idx=None):
         cfg = self.cfg
         if cfg.decode and (positions is None or update_mask is None):
             raise ValueError(
@@ -329,6 +349,12 @@ class Llama(nn.Module):
         if zig:
             x = sp_lib.zigzag_unshard(x, n_sp, seq_axis=1)
         x = RMSNorm(name="norm_f")(x)
+        if logits_idx is not None:
+            # serving: gather each row's emitting position BEFORE the
+            # lm_head so the step's largest GEMM runs at [B, 1, V]
+            # (see models/gpt.py)
+            x = jnp.take_along_axis(
+                x, logits_idx.astype(jnp.int32)[:, None, None], axis=1)
         return nn.Dense(cfg.vocab_size, use_bias=False,
                         dtype=cfg.logits_dtype,
                         param_dtype=jnp.float32, name="lm_head")(x)

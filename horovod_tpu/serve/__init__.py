@@ -14,8 +14,14 @@ and the bucket/no-recompile contract.
                  eviction, weight-version flush
     batcher.py   iteration-level scheduler over fixed bucket shapes,
                  with optional speculative decoding (draft proposes k,
-                 target verifies in one step, bit-identical greedy)
-    executor.py  the one jitted step, sharded via parallel/tp rules
+                 target verifies in one fused step; greedy accept is
+                 bit-identical, sampled accept is rejection-sampling
+                 distribution-correct)
+    executor.py  the one jitted step, sharded via parallel/tp rules;
+                 decode kernel (HOROVOD_SERVE_KERNEL: fused Pallas vs
+                 XLA oracle, ops/pallas_paged.py) and on-device
+                 sampling (temperature/top-p, per-request seeds as
+                 row data) resolved/fused at build
     http.py      optional stdlib front end (/generate, /healthz)
     fleet.py     health-aware router over N replicas: accrual-driven
                  ejection, at-most-once failover, drain-on-SIGTERM,
@@ -40,8 +46,8 @@ from .http import (                                            # noqa: F401
 from .proc_fleet import ProcessFleetRouter, ProcessReplica     # noqa: F401
 from .kv_cache import (                                        # noqa: F401
     BlockPool, PagedKVCache, SlotKVCache, cached_attention,
-    paged_attention, paged_model_kwargs, pool_blocks_for, write_kv,
-    write_kv_paged,
+    masked_attention, paged_attention, paged_model_kwargs,
+    pool_blocks_for, write_kv, write_kv_paged,
 )
 from .prefix import RadixPrefixCache                           # noqa: F401
 from .queue import (                                           # noqa: F401

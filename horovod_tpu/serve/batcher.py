@@ -78,6 +78,13 @@ class _Active:
     prefix_tokens: int = 0
     #: tokens of this sequence VALIDLY ingested into the drafter cache
     draft_len: int = 0
+    #: per-row random-draw counter: every sampling event (prefill,
+    #: decode, each speculative position) consumes a fixed counter
+    #: budget, so a request's token stream depends only on its own
+    #: (seed, counter) history — deterministic across batch positions
+    #: and restarts (a re-prefill replays from 0 and reproduces the
+    #: original stream)
+    rng_ctr: int = 0
 
 
 class ContinuousBatcher:
@@ -502,6 +509,26 @@ class ContinuousBatcher:
                 "occupancy": round(occ, 3),
                 "shed": self.queue.shed_count}
 
+    # -- on-device sampling row data -----------------------------------------
+    def _sample_args(self, rows, ctr_offset: int = 0) -> dict:
+        """Per-row sampling arrays for the jitted step: each active
+        row's request temperature / top-p / seed plus its draw counter
+        (``rng_ctr + ctr_offset``). Rows not listed stay at the greedy
+        defaults (temperature 0) and are masked out anyway."""
+        B = self.executor.max_batch
+        s = {"temperature": np.zeros(B, np.float32),
+             "top_p": np.ones(B, np.float32),
+             "seed": np.zeros(B, np.uint32),
+             "ctr": np.zeros(B, np.int32)}
+        for slot in rows:
+            seq = self._active[slot]
+            req = seq.req
+            s["temperature"][slot] = getattr(req, "temperature", 0.0)
+            s["top_p"][slot] = getattr(req, "top_p", 1.0)
+            s["seed"][slot] = int(getattr(req, "seed", 0)) & 0xFFFFFFFF
+            s["ctr"][slot] = seq.rng_ctr + ctr_offset
+        return s
+
     # -- crc plumbing (slot- or block-granular) ------------------------------
     def _crc_write(self, slot: int, lo: int, hi: int) -> None:
         """Fold cache positions ``[lo, hi)`` just written for ``slot``
@@ -792,6 +819,7 @@ class ContinuousBatcher:
         nxt = self.executor.step(
             tokens, positions, mask, last_idx, kind="prefill",
             stats=self._stats(),
+            sample=self._sample_args([a.slot for a in admitted]),
             block_tables=self.kv.table() if self.paged else None)
         if hit_rows and self.executor.last_step_version != expected_v:
             # a weight swap landed between the prefix match and this
@@ -817,6 +845,7 @@ class ContinuousBatcher:
                 (t_first - a.req.submitted_at) * 1000.0)
             n = len(a.req.prompt)
             a.cache_len = n
+            a.rng_ctr = 1   # the prefill's first token consumed draw 0
             # the prompt is fully cached but only [0, n) is valid; the
             # first generated token is the prompt's last-logit argmax
             a.out.append(int(nxt[a.slot]))
@@ -891,7 +920,7 @@ class ContinuousBatcher:
                 self.kv.ensure(slot, seq.cache_len + 1)
         nxt = self.executor.step(
             tokens, positions, mask, last_idx, kind="decode",
-            stats=self._stats(),
+            stats=self._stats(), sample=self._sample_args(rows),
             block_tables=self.kv.table() if self.paged else None)
         self.gen_steps += len(rows)
         for slot in rows:
@@ -901,24 +930,30 @@ class ContinuousBatcher:
             seq.cache_len += 1
             self.kv.lengths[slot] = seq.cache_len
             seq.out.append(int(nxt[slot]))
+            seq.rng_ctr += 1
             self.gen_tokens += 1
 
     def _decode_spec(self, rows: List[int]) -> None:
         """One speculative iteration: k draft proposals per row, ONE
-        target verify step, greedy accept + rollback.
+        fused target verify step, on-device accept + rollback.
 
-        Greedy accept is what makes the output BIT-IDENTICAL to
-        target-only greedy decode: draft token i+1 is emitted iff it
-        equals the target's argmax at position i (exactly the token
-        plain decode would have produced there), and the first
-        disagreement emits the target's own argmax instead — so the
-        emitted stream is the target's greedy stream, just produced
-        1..k+1 tokens per target step. Rejected draft positions were
-        written into the cache by the verify step; they sit beyond the
-        new cache_len, unreachable by the positional validity mask,
-        and are overwritten by the next iteration — rollback is
-        bookkeeping, not data movement.
+        The accept rule runs INSIDE the verify step
+        (ops/pallas_paged.py speculative_accept): at temperature 0 it
+        is the argmax rule — draft token i+1 is emitted iff it equals
+        the target's argmax at position i, the first disagreement
+        emits the target's own argmax — which keeps the emitted stream
+        BIT-IDENTICAL to target-only greedy decode, just produced
+        1..k+1 tokens per target step. Sampled rows instead apply
+        rejection sampling against each proposal's draft distribution
+        (kept on device from the draft steps), so the emitted stream
+        is distribution-identical to target-only sampling. Rejected
+        draft positions were written into the cache by the verify
+        step; they sit beyond the new cache_len, unreachable by the
+        positional validity mask, and are overwritten by the next
+        iteration — rollback is bookkeeping, not data movement.
         """
+        import jax.numpy as jnp
+
         k = self.spec_k
         B = self.executor.max_batch
         known = {slot: self._active[slot].req.prompt
@@ -928,9 +963,15 @@ class ContinuousBatcher:
         # left it one token behind
         forced = {slot: known[slot][self._active[slot].draft_len:]
                   for slot in rows}
+        #: proposals of row r start at draft step len(forced_r) - 1
+        #: (the step that consumes the last forced token emits the
+        #: first proposal) — what aligns each proposal with the step
+        #: whose distribution it was drawn from
+        first_prop = {slot: len(forced[slot]) - 1 for slot in rows}
         drafts: Dict[int, List[int]] = {slot: [] for slot in rows}
         fed: Dict[int, List[int]] = {slot: [] for slot in rows}
         prev: Dict[int, int] = {}
+        step_probs = []
         for i in range(k):
             tokens = np.zeros((B, 1), np.int32)
             positions = np.zeros(B, np.int32)
@@ -945,8 +986,10 @@ class ContinuousBatcher:
                 tokens[slot, 0] = tok
                 positions[slot] = seq.draft_len + i
                 mask[slot] = True
-            out = self.draft.step(tokens, positions, mask, zero,
-                                  kind="decode")
+            out, probs = self.draft.step(
+                tokens, positions, mask, zero, kind="decode",
+                sample=self._sample_args(rows, ctr_offset=i))
+            step_probs.append(probs)
             for slot in rows:
                 o = int(out[slot])
                 if forced[slot]:
@@ -959,33 +1002,43 @@ class ContinuousBatcher:
                 prev[slot] = o
         # ONE batched verify: token 0 is each row's last emitted token
         # (its K/V enters the cache here, same as plain decode), tokens
-        # 1..n_d are the drafts; the target scores every position
+        # 1..n_d are the drafts; the target scores every position and
+        # applies the accept rule on device against each proposal's
+        # draft distribution (gathered per row: proposal j of row r
+        # came from draft step first_prop[r] + j)
         tokens = np.zeros((B, k + 1), np.int32)
         positions = np.zeros(B, np.int32)
         mask = np.zeros(B, bool)
         zero = np.zeros(B, np.int32)
+        n_draft = np.zeros(B, np.int32)
+        offs = np.zeros(B, np.int32)
         for slot in rows:
             seq = self._active[slot]
             row_toks = [known[slot][-1]] + drafts[slot][:k]
             tokens[slot, :len(row_toks)] = row_toks
             positions[slot] = seq.cache_len
             mask[slot] = True
+            n_draft[slot] = len(drafts[slot])
+            offs[slot] = max(first_prop[slot], 0)
             if self.paged:
                 self.kv.ensure(slot, seq.cache_len + k + 1)
-        preds = self.executor.step(
+        stacked = jnp.stack(step_probs)                    # [k, B, V]
+        idx = np.clip(offs[:, None] + np.arange(k)[None, :], 0, k - 1)
+        dprobs = stacked[jnp.asarray(idx),
+                         jnp.arange(B)[:, None]]           # [B, k, V]
+        emitted_all, n_acc = self.executor.step(
             tokens, positions, mask, zero, kind="verify",
-            stats=self._stats(),
+            stats=self._stats(), sample=self._sample_args(rows),
+            draft_probs=dprobs, n_draft=n_draft,
             block_tables=self.kv.table() if self.paged else None)
         self.gen_steps += len(rows)
         for slot in rows:
             seq = self._active[slot]
             n_d = len(drafts[slot])
-            a = 0
-            while a < n_d and drafts[slot][a] == int(preds[slot][a]):
-                a += 1
+            a = int(n_acc[slot])
             if n_d:
                 self._m_accept.observe(a / n_d)
-            emitted = drafts[slot][:a] + [int(preds[slot][a])]
+            emitted = [int(t) for t in emitted_all[slot, :a + 1]]
             remaining = seq.req.max_new_tokens - len(seq.out)
             emitted = emitted[:remaining]
             if self.eos_id is not None and self.eos_id in emitted:
@@ -998,6 +1051,10 @@ class ContinuousBatcher:
             seq.cache_len += len(emitted)
             self.kv.lengths[slot] = seq.cache_len
             self.gen_tokens += len(emitted)
+            # every speculative iteration consumes a FIXED draw budget
+            # (k proposal draws + the verify's per-position draws), so
+            # the stream stays deterministic however many were accepted
+            seq.rng_ctr += k + 1
             # drafter rollback: its valid prefix is however far the fed
             # token stream still agrees with the true sequence
             nk = known[slot] + emitted

@@ -10,6 +10,25 @@ iteration granularity without ever invalidating the jit cache — the
 no-recompile contract the continuous batcher (serve/batcher.py) is
 built on.
 
+Two decisions are frozen at build time so the jit cache stays flat:
+
+* **Kernel**: paged executors resolve the decode-attention kernel ONCE
+  (``HOROVOD_SERVE_KERNEL`` via `ops.pallas_paged.resolve_kernel` —
+  fused Pallas on TPU by default, the XLA gather oracle as CPU
+  fallback) and stamp it into the model config before the first trace.
+  The resolved path is named by a one-shot **KERNEL** timeline instant
+  and the ``kernel`` label on ``hvd_serve_step_ms``, so a silent
+  fallback to XLA on TPU is visible in the trace and in /metrics.
+* **Sampling**: token selection runs ON DEVICE inside the jitted step
+  — temperature / top-p with per-request seeds threaded as row data
+  (``sample=`` arrays), greedy being the ``temperature == 0`` special
+  case (an all-greedy batch takes a sort-free `lax.cond` branch of the
+  same program). Only the per-row EMITTING position's logits are
+  computed (``logits_idx`` gathers before the lm_head), and the
+  speculative verify step applies the rejection-sampling accept rule
+  on device (`ops.pallas_paged.speculative_accept`), returning the
+  emitted tokens instead of raw argmaxes.
+
 Sharding rides the training stack unchanged: pass `mesh` plus the
 model's `PartitionRules` (parallel/tp.py) and parameters are placed with
 `shard_params`; jit/GSPMD then emits the same ICI collectives the
@@ -25,6 +44,7 @@ trace.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -35,6 +55,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+
+logger = logging.getLogger("horovod_tpu")
 
 
 class ShardedExecutor:
@@ -80,6 +102,22 @@ class ShardedExecutor:
                 f"kv_pool_blocks {self.kv_pool_blocks} cannot cover one "
                 f"max_len sequence ({self.blocks_per_seq} blocks of "
                 f"{self.kv_block_size})")
+        #: vocab width — the verify step's draft-probs row shape
+        self.vocab_size = int(getattr(cfg, "vocab_size", 0) or 0)
+        # -- decode kernel, resolved ONCE before the first trace: the
+        # model reads cfg.decode_kernel at trace time, so stamping the
+        # resolution here keeps every compiled program on one path and
+        # the jit cache flat. Slotted executors (draft models included)
+        # always run the XLA path — the fused kernel is block-table
+        # shaped; HOROVOD_SERVE_KERNEL names the PAGED hot path.
+        from ..ops.pallas_paged import resolve_kernel
+        if self.paged:
+            self.kernel = resolve_kernel(
+                getattr(cfg, "decode_kernel", None))
+            if cfg is not None:
+                cfg.decode_kernel = self.kernel
+        else:
+            self.kernel = "xla"
         # kept for hot weight swaps (redist/stream.py): replacement
         # params are placed exactly like the originals
         self._mesh = mesh
@@ -130,39 +168,92 @@ class ShardedExecutor:
         self._m_step_ms = {
             k: R.histogram("hvd_serve_step_ms",
                            "executor step latency by kind (ms)",
-                           dict(rl, kind=k))
+                           dict(rl, kind=k, kernel=self.kernel))
             for k in ("prefill", "decode", "verify")}
         self._m_tokens = R.counter(
             "hvd_serve_tokens_total", "tokens generated", rl or None)
 
-        # the jitted step returns the greedy argmax at EVERY position
-        # ([B, T] int32): prefill picks each row's last real token on
-        # the host, decode reads column 0, and speculative VERIFY needs
-        # the whole row (one batched step scores all k draft positions)
+        # -- the jitted steps. Token selection runs ON DEVICE
+        # (ops/pallas_paged.py sampling): per-row temperature / top-p /
+        # seed / draw-counter ride as data through the fixed shapes.
+        #
+        #   _fwd_token   prefill + decode: only the per-row EMITTING
+        #                position's logits are computed (logits_idx
+        #                gathers hidden states before the lm_head — the
+        #                step's largest GEMM runs [B, 1, V], never
+        #                [B, bucket, V]); returns the sampled token
+        #                [B], plus the filtered sampling distribution
+        #                [B, V] on DRAFT executors (what the verify
+        #                step consumes as q).
+        #   _fwd_verify  the fused speculative verify: full [B, T, V]
+        #                logits (every draft position emits), the
+        #                rejection-sampling accept rule applied on
+        #                device -> (emitted [B, T], n_accept [B]).
+        from ..ops.pallas_paged import (STREAM_DRAFT, STREAM_SAMPLE,
+                                        sample_with_probs,
+                                        speculative_accept)
+        stream = STREAM_DRAFT if role == "draft" else STREAM_SAMPLE
+        emit_probs = role == "draft"
+
+        def apply_model(params, cache, tokens, positions, mask, tables,
+                        logits_idx):
+            kw = {"block_tables": tables} if self.paged else {}
+            return self.model.apply(
+                {"params": params, "cache": cache}, tokens,
+                positions=positions, update_mask=mask,
+                logits_idx=logits_idx, mutable=["cache"], **kw)
+
         if self.paged:
-            def fwd(params, cache, tokens, positions, mask, tables):
-                logits, vout = self.model.apply(
-                    {"params": params, "cache": cache}, tokens,
-                    positions=positions, update_mask=mask,
-                    block_tables=tables, mutable=["cache"])
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return nxt, vout["cache"]
+            def fwd_token(params, cache, tokens, positions, mask,
+                          last_idx, temp, top_p, seed, ctr, tables):
+                logits, vout = apply_model(params, cache, tokens,
+                                           positions, mask, tables,
+                                           last_idx)
+                tok, probs = sample_with_probs(
+                    logits[:, 0], temp, top_p, seed, ctr, stream=stream)
+                if emit_probs:
+                    return tok, probs, vout["cache"]
+                return tok, vout["cache"]
+
+            def fwd_verify(params, cache, tokens, positions, mask,
+                           temp, top_p, seed, ctr, dprobs, n_draft,
+                           tables):
+                logits, vout = apply_model(params, cache, tokens,
+                                           positions, mask, tables,
+                                           None)
+                emitted, n_acc = speculative_accept(
+                    tokens, dprobs, logits, n_draft, temp, top_p, seed,
+                    ctr)
+                return emitted, n_acc, vout["cache"]
         else:
-            def fwd(params, cache, tokens, positions, mask):
-                logits, vout = self.model.apply(
-                    {"params": params, "cache": cache}, tokens,
-                    positions=positions, update_mask=mask,
-                    mutable=["cache"])
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return nxt, vout["cache"]
+            def fwd_token(params, cache, tokens, positions, mask,
+                          last_idx, temp, top_p, seed, ctr):
+                logits, vout = apply_model(params, cache, tokens,
+                                           positions, mask, None,
+                                           last_idx)
+                tok, probs = sample_with_probs(
+                    logits[:, 0], temp, top_p, seed, ctr, stream=stream)
+                if emit_probs:
+                    return tok, probs, vout["cache"]
+                return tok, vout["cache"]
+
+            def fwd_verify(params, cache, tokens, positions, mask,
+                           temp, top_p, seed, ctr, dprobs, n_draft):
+                logits, vout = apply_model(params, cache, tokens,
+                                           positions, mask, None, None)
+                emitted, n_acc = speculative_accept(
+                    tokens, dprobs, logits, n_draft, temp, top_p, seed,
+                    ctr)
+                return emitted, n_acc, vout["cache"]
 
         # donating the cache lets XLA update it in place on TPU; CPU
         # does not support donation and would only warn
         donate = () if jax.default_backend() == "cpu" else (1,)
-        self._fwd = jax.jit(fwd, donate_argnums=donate)
+        self._fwd_token = jax.jit(fwd_token, donate_argnums=donate)
+        self._fwd_verify = jax.jit(fwd_verify, donate_argnums=donate)
 
         # materialize the zero cache once (a separate cache-creating
-        # trace; steady-state steps all go through self._fwd)
+        # trace; steady-state steps all go through _fwd_token/_fwd_verify)
         def make_cache(params, tokens, positions, mask, tables):
             kw = {"block_tables": tables} if self.paged else {}
             _, v = self.model.apply(
@@ -198,25 +289,57 @@ class ShardedExecutor:
         #: inside the step lock) — what lets the batcher detect a swap
         #: landing between its prefix-cache lookup and the prefill
         self.last_step_version: Optional[int] = None
+        # one-shot KERNEL instant: names the RESOLVED decode kernel so
+        # a silent fallback to XLA on TPU is visible in the trace
+        logger.info(
+            "serve executor (replica=%s role=%s): decode kernel=%s "
+            "paged=%s backend=%s", replica_id, role, self.kernel,
+            self.paged, jax.default_backend())
+        if self.timeline is not None:
+            self.timeline.instant("KERNEL", {
+                "kernel": self.kernel, "paged": self.paged,
+                "role": role, "backend": jax.default_backend()})
 
     # -- the one step --------------------------------------------------------
+    def _default_sample(self) -> Dict[str, np.ndarray]:
+        """Greedy row data: temperature 0 everywhere (the all-greedy
+        `lax.cond` fast path inside the jitted step)."""
+        B = self.max_batch
+        return {"temperature": np.zeros(B, np.float32),
+                "top_p": np.ones(B, np.float32),
+                "seed": np.zeros(B, np.uint32),
+                "ctr": np.zeros(B, np.int32)}
+
     def step(self, tokens: np.ndarray, positions: np.ndarray,
              mask: np.ndarray, last_idx: np.ndarray, *,
              kind: str = "decode",
              stats: Optional[Dict[str, Any]] = None,
-             block_tables: Optional[np.ndarray] = None) -> np.ndarray:
-        """Run one fixed-shape forward step; returns the sampled
-        (greedy) next token per row, valid where `mask` is set —
-        ``[max_batch]`` for prefill (each row's last real token) and
-        decode (T=1), ``[max_batch, T]`` for ``kind="verify"`` (the
-        speculative scoring step needs the argmax at every draft
-        position).
+             block_tables: Optional[np.ndarray] = None,
+             sample: Optional[Dict[str, np.ndarray]] = None,
+             draft_probs=None, n_draft: Optional[np.ndarray] = None):
+        """Run one fixed-shape forward step.
 
         tokens [max_batch, T] int32; positions/last_idx [max_batch]
         int32; mask [max_batch] bool; block_tables
         [max_batch, blocks_per_seq] int32 (paged executors only).
+        ``sample`` carries the per-row sampling data (temperature /
+        top_p / seed / ctr arrays, [max_batch] each); None is greedy.
         `stats` (queue depth, occupancy, shed count — batcher-supplied)
         is folded into the SERVE event.
+
+        Returns, valid where `mask` is set:
+
+        * ``kind="prefill"`` / ``"decode"``: the sampled next token per
+          row, ``[max_batch]`` int32 (the emitting position is
+          ``last_idx`` — its logits are the only ones computed). A
+          DRAFT executor returns ``(tokens, probs)`` where ``probs``
+          [max_batch, V] is the on-device filtered distribution each
+          token was drawn from.
+        * ``kind="verify"``: ``(emitted [max_batch, T] int32,
+          n_accept [max_batch] int32)`` — the rejection-sampling (or,
+          at temperature 0, bit-identical greedy) accept rule applied
+          on device against ``draft_probs`` [max_batch, T-1, V] with
+          per-row real proposal counts ``n_draft``.
         """
         t0 = time.perf_counter()
         self.signatures.add((kind, int(tokens.shape[1])))
@@ -227,19 +350,43 @@ class ShardedExecutor:
             extra = (jnp.asarray(block_tables, jnp.int32),)
         else:
             extra = ()
+        s = sample if sample is not None else self._default_sample()
+        sargs = (jnp.asarray(s["temperature"], jnp.float32),
+                 jnp.asarray(s["top_p"], jnp.float32),
+                 jnp.asarray(s["seed"], jnp.uint32),
+                 jnp.asarray(s["ctr"], jnp.int32))
+        probs = None
         with self._swap_lock:   # the weight-swap version fence
             self.last_step_version = self.params_version
-            nxt, self.cache = self._fwd(
-                self.params, self.cache, jnp.asarray(tokens, jnp.int32),
-                jnp.asarray(positions, jnp.int32),
-                jnp.asarray(mask, bool), *extra)
-            # host readback doubles as completion fence — inside the
-            # lock so a swap never lands while this step is in flight
-            nxt = np.asarray(nxt)
-        if kind == "prefill":
-            nxt = nxt[np.arange(self.max_batch), np.asarray(last_idx)]
-        elif kind != "verify":
-            nxt = nxt[:, 0]
+            if kind == "verify":
+                B, T = self.max_batch, int(tokens.shape[1])
+                if draft_probs is None:
+                    draft_probs = jnp.zeros((B, T - 1, self.vocab_size),
+                                            jnp.float32)
+                nd = jnp.asarray(
+                    n_draft if n_draft is not None
+                    else np.zeros(B, np.int32), jnp.int32)
+                emitted, n_acc, self.cache = self._fwd_verify(
+                    self.params, self.cache,
+                    jnp.asarray(tokens, jnp.int32),
+                    jnp.asarray(positions, jnp.int32),
+                    jnp.asarray(mask, bool), *sargs, draft_probs, nd,
+                    *extra)
+                # host readback doubles as completion fence — inside
+                # the lock so a swap never lands mid-step
+                nxt = (np.asarray(emitted), np.asarray(n_acc))
+            else:
+                out = self._fwd_token(
+                    self.params, self.cache,
+                    jnp.asarray(tokens, jnp.int32),
+                    jnp.asarray(positions, jnp.int32),
+                    jnp.asarray(mask, bool),
+                    jnp.asarray(last_idx, jnp.int32), *sargs, *extra)
+                if self.role == "draft":
+                    tok, probs, self.cache = out
+                else:
+                    tok, self.cache = out
+                nxt = np.asarray(tok)
         dt_ms = (time.perf_counter() - t0) * 1000.0
         self.steps += 1
         self.step_latencies_ms.append(dt_ms)
@@ -254,6 +401,10 @@ class ShardedExecutor:
             if stats:
                 ev.update(stats)
             self.timeline.instant("SERVE", ev)
+        if self.role == "draft" and kind != "verify":
+            # the filtered proposal distribution stays ON DEVICE — the
+            # batcher hands it straight to the target's verify step
+            return nxt, probs
         return nxt
 
     # -- hot weight swap (redist/stream.py consumer) -------------------------
@@ -428,10 +579,11 @@ class ShardedExecutor:
         return float(np.median(self.step_latencies_ms))
 
     def jit_cache_size(self) -> int:
-        """Compiled-program count of the step function (falls back to
-        the executed-signature count on jax versions without the
+        """Compiled-program count across the step functions (falls back
+        to the executed-signature count on jax versions without the
         introspection hook) — the churn tests assert this is flat."""
         try:
-            return int(self._fwd._cache_size())
+            return int(self._fwd_token._cache_size()
+                       + self._fwd_verify._cache_size())
         except Exception:  # noqa: BLE001 — private API across jax versions
             return len(self.signatures)

@@ -3,7 +3,9 @@
 Two endpoints, JSON in/out, zero dependencies beyond `http.server`:
 
 * ``POST /generate``  body ``{"tokens": [...], "max_new_tokens": N,
-  "deadline_ms": M?}`` -> ``200 {"tokens": [...], "status": "ok",
+  "deadline_ms": M?, "temperature": T?, "top_p": P?, "seed": S?}``
+  (sampling keys optional; temperature 0 = greedy)
+  -> ``200 {"tokens": [...], "status": "ok",
   "latency_ms": ...}``. Over capacity the admission queue sheds and the
   reply is ``429 {"error": "rejected", "reason": ...,
   "retry_after_ms": ...}`` with a standard ``Retry-After`` header —
@@ -86,9 +88,11 @@ class _JsonHandler(BaseHTTPRequestHandler):
                           "retry_after_ms": retry_after_ms}, hdrs)
 
     def _read_generate_request(self):
-        """Parse a /generate body -> (prompt, max_new, deadline_ms);
-        raises the (KeyError, ValueError, TypeError) family the caller
-        maps to a structured 400."""
+        """Parse a /generate body -> (prompt, max_new, deadline_ms,
+        sampling kwargs); raises the (KeyError, ValueError, TypeError)
+        family the caller maps to a structured 400. ``temperature`` /
+        ``top_p`` / ``seed`` are optional (greedy default); their
+        range validation is the queue's (fail-fast at submit)."""
         n = int(self.headers.get("Content-Length", "0"))
         req = json.loads(self.rfile.read(n) or b"{}")
         prompt = req["tokens"]
@@ -96,7 +100,10 @@ class _JsonHandler(BaseHTTPRequestHandler):
         deadline_ms = req.get("deadline_ms")
         if deadline_ms is not None:
             deadline_ms = float(deadline_ms)
-        return prompt, max_new, deadline_ms
+        sampling = {"temperature": float(req.get("temperature", 0.0)),
+                    "top_p": float(req.get("top_p", 1.0)),
+                    "seed": int(req.get("seed", 0))}
+        return prompt, max_new, deadline_ms, sampling
 
 
 def make_server(batcher, host: str = "127.0.0.1",
@@ -150,10 +157,11 @@ def make_server(batcher, host: str = "127.0.0.1",
                 self._reply(404, {"error": "not found"})
                 return
             try:
-                prompt, max_new, deadline_ms = \
+                prompt, max_new, deadline_ms, sampling = \
                     self._read_generate_request()
                 handle = queue.submit(prompt, max_new_tokens=max_new,
-                                      deadline_ms=deadline_ms)
+                                      deadline_ms=deadline_ms,
+                                      **sampling)
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 # covers submit's own validation too (bad token values,
@@ -236,8 +244,17 @@ def make_fleet_server(router, host: str = "127.0.0.1",
                 self._reply(404, {"error": "not found"})
                 return
             try:
-                prompt, max_new, deadline_ms = \
+                prompt, max_new, deadline_ms, sampling = \
                     self._read_generate_request()
+                if sampling.get("temperature", 0.0) > 0:
+                    # fleet routers track (prompt, max_new, deadline)
+                    # for failover re-submit and stay greedy-only for
+                    # now; a sampled request must not silently decode
+                    # greedy (docs/serving.md)
+                    raise ValueError(
+                        "sampled generation (temperature > 0) is "
+                        "standalone-replica only; the fleet front door "
+                        "serves greedy requests")
                 handle = router.submit(prompt, max_new_tokens=max_new,
                                        deadline_ms=deadline_ms)
             except (KeyError, ValueError, TypeError,

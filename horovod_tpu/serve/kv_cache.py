@@ -76,29 +76,59 @@ def cached_attention(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
     additive mask; stale bytes past the valid prefix (slot-reuse
     leftovers) are unreachable by construction.
     """
-    return _masked_attention(q, cache_k, cache_v, positions)
+    return masked_attention(q, cache_k, cache_v, positions)
 
 
-def _masked_attention(q: jax.Array, keys: jax.Array, vals: jax.Array,
-                      positions: jax.Array) -> jax.Array:
-    """Shared body of the slotted and paged reads: causal attention of
-    `T` query tokens over each row's `[B, L, H_kv, D]` key/value view,
-    valid positions `[0, positions[b] + t]` only."""
+def masked_attention(q: jax.Array, keys: jax.Array, vals: jax.Array,
+                     positions: jax.Array) -> jax.Array:
+    """THE masked-attention contract — the single reference
+    implementation shared by every decode read in the tree.
+
+    Causal attention of `T` query tokens over each row's
+    ``[B, L, H_kv, D]`` key/value view, valid positions
+    ``[0, positions[b] + t]`` only; f32 score math, divide-after-dot
+    ``1/sqrt(D)`` scaling, large-negative additive masking, output cast
+    back to ``q.dtype``. `cached_attention` (slotted), `paged_attention`
+    (the gathered-pool XLA path) and the models' decode attention all
+    delegate here, and the fused Pallas kernels
+    (ops/pallas_paged.py) mirror this math operation-for-operation —
+    it is the bit-exactness ORACLE the interpret-mode parity suite
+    asserts against (tests/test_serve_kernels.py).
+
+    The GQA group is folded into the matmul M dimension
+    (``[T * G, D] x [L, D]`` per (row, kv head), exactly the kernel's
+    slice shapes) rather than repeating K/V to H heads: batched
+    `dot_general` over (B, KV) and the kernel's per-program dot then
+    hit the same XLA gemm micro-kernels, which is what makes bit-match
+    achievable at all (micro-kernel choice is shape-dependent).
+    """
     B, T, H, D = q.shape
     L, KV = keys.shape[1], keys.shape[2]
-    if KV != H:
-        keys = jnp.repeat(keys, H // KV, axis=2)
-        vals = jnp.repeat(vals, H // KV, axis=2)
-    qf = q.astype(jnp.float32)
-    kf = keys.astype(jnp.float32)
-    vf = vals.astype(jnp.float32)
-    scores = jnp.einsum("bthd,bjhd->bhtj", qf, kf) / np.sqrt(D)
+    G = H // KV
+    # [B, T, H, D] -> [B, KV, T*G, D]; row order t*G + g matches the
+    # kernel's [T, G, D] block flattening
+    qf = q.astype(jnp.float32).reshape(B, T, KV, G, D).transpose(
+        0, 2, 1, 3, 4).reshape(B, KV, T * G, D)
+    kf = keys.astype(jnp.float32).transpose(0, 2, 1, 3)   # [B, KV, L, D]
+    vf = vals.astype(jnp.float32).transpose(0, 2, 1, 3)
+    scores = jax.lax.dot_general(
+        qf, kf, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32) / np.sqrt(D)  # [B, KV, TG, L]
+    t_of = jnp.arange(T * G) // G
     valid = jnp.arange(L)[None, None, None, :] <= (
-        positions[:, None, None, None] + jnp.arange(T)[None, None, :, None])
+        positions[:, None, None, None] + t_of[None, None, :, None])
     scores = jnp.where(valid, scores, _MASK_VALUE)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhtj,bjhd->bthd", probs, vf)
+    out = jax.lax.dot_general(
+        probs, vf, (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32)               # [B, KV, TG, D]
+    out = out.reshape(B, KV, T, G, D).transpose(
+        0, 2, 1, 3, 4).reshape(B, T, H, D)
     return out.astype(q.dtype)
+
+
+#: back-compat alias (pre-PR-12 private name)
+_masked_attention = masked_attention
 
 
 # -- paged (block) storage ---------------------------------------------------
@@ -159,7 +189,7 @@ def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
     tbl = jnp.maximum(block_tables, 0)
     keys = pool_k[tbl].reshape(B, nblk * BS, *pool_k.shape[2:])
     vals = pool_v[tbl].reshape(B, nblk * BS, *pool_v.shape[2:])
-    return _masked_attention(q, keys, vals, positions)
+    return masked_attention(q, keys, vals, positions)
 
 
 def pool_blocks_for(max_batch: int, max_len: int, block_size: int,

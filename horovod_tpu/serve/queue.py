@@ -62,6 +62,16 @@ class ServeRequest:
     deadline: float
     submitted_at: float
     handle: "ServeHandle" = field(repr=False, default=None)
+    #: on-device sampling controls (serve/executor.py): temperature 0
+    #: is greedy (the default — argmax semantics, deterministic, and
+    #: bit-identical across kernels/configs WITHIN a version; exact
+    #: float values may shift across code versions as program shapes
+    #: change); top_p restricts to the smallest nucleus covering that
+    #: probability mass; seed makes the request's token stream
+    #: deterministic independent of batch placement and restarts
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (now if now is not None else time.monotonic()) > self.deadline
@@ -196,8 +206,14 @@ class AdmissionQueue:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
                on_resolve: Optional[Callable[[ServeHandle],
-                                             None]] = None) -> ServeHandle:
+                                             None]] = None,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int = 0) -> ServeHandle:
         """Admit a request or raise `Rejected` (load shed / unservable).
+
+        ``temperature`` / ``top_p`` / ``seed`` ride the request into
+        the executor's on-device sampler (temperature 0 = greedy, the
+        default); validation is fail-fast here at the door.
 
         ``on_resolve`` is attached to the handle BEFORE it becomes
         poppable, so a completion can never race past the hook."""
@@ -205,6 +221,16 @@ class AdmissionQueue:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1; got {max_new_tokens}")
+        temperature = float(temperature)
+        top_p = float(top_p)
+        seed = int(seed)
+        if not (temperature >= 0.0):
+            raise ValueError(
+                f"temperature must be >= 0 (0 = greedy); got "
+                f"{temperature!r}")
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError(
+                f"top_p must be in (0, 1]; got {top_p!r}")
         # chaos serve.admit: the queue-door fault site. Disarmed cost is
         # one attribute read; delay sleeps inside the injector; drop
         # surfaces as AdmitDropped (a structured loss, never a silent
@@ -236,7 +262,9 @@ class AdmissionQueue:
             req = ServeRequest(rid=rid, prompt=prompt,
                                max_new_tokens=max_new_tokens,
                                deadline=now + dl / 1000.0,
-                               submitted_at=now)
+                               submitted_at=now,
+                               temperature=temperature, top_p=top_p,
+                               seed=seed)
             req.handle = ServeHandle(rid, on_resolve=on_resolve)
             self._dq.append(req)
             self._m_admitted.inc()

@@ -402,9 +402,10 @@ def test_serve_http_metrics_endpoint(hvd):
         # engine wire-bytes + serve latency-histogram series, in valid
         # Prometheus text
         assert 'hvd_wire_bytes_total{kind="logical"}' in body
-        assert 'hvd_serve_step_ms_bucket{kind="decode",le="+Inf"}' in body
-        assert re.search(r"^hvd_serve_step_ms_count\{kind=\"prefill\"\} "
-                         r"[1-9]", body, re.M)
+        assert ('hvd_serve_step_ms_bucket{kernel="xla",kind="decode",'
+                'le="+Inf"}') in body
+        assert re.search(r"^hvd_serve_step_ms_count\{kernel=\"xla\","
+                         r"kind=\"prefill\"\} [1-9]", body, re.M)
         assert re.search(r"^hvd_serve_ttft_ms_count [1-9]", body, re.M)
         assert re.search(r"^hvd_serve_admitted_total [1-9]", body, re.M)
     finally:
