@@ -16,6 +16,12 @@ Public API mirrors `import horovod.torch as hvd`:
     out = hvd.synchronize(h)
 """
 
+# The runtime lock-order witness must arm BEFORE any horovod_tpu
+# module creates a lock, so it comes first (no-op unless
+# HOROVOD_ANALYSIS_WITNESS=1; stdlib-only import — docs/analysis.md).
+from .analysis import witness as _witness                      # noqa: F401
+_witness.maybe_install()
+
 from . import _compat                                          # noqa: F401
 from .core.types import (                                      # noqa: F401
     ReduceOp, Average, Sum, Adasum, Min, Max, Product,

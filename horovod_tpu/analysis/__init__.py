@@ -1,0 +1,73 @@
+"""horovod_tpu.analysis — the repo-native static-analysis plane.
+
+Five stdlib-``ast`` passes over ``horovod_tpu/`` plus a runtime
+lock-order witness, all jax-free (importable standalone by
+``tools/check.py`` on a box with no accelerator stack):
+
+================== ===================== ==============================
+pass id            annotation tag        checks
+================== ===================== ==============================
+collective-        ``rank-invariant``    collective calls control-
+divergence                               dependent on rank-local
+                                         sources (env/fs/clock/random)
+lock-order         ``lock-order``        cyclic lock acquisition
+                                         orders; blocking calls under
+                                         a held lock
+knob-registry      ``knob``              HOROVOD_* env reads declared
+                                         in core/config.py, documented
+                                         in docs/knobs.md, strict-
+                                         parsed, single-reader
+metric-help        ``metric-help``       one help-string source per
+                                         metric family; docs/metrics.md
+                                         row
+resilience         ``resilience``        socket-error handlers in the
+                                         wire planes route through the
+                                         resilience classifier
+================== ===================== ==============================
+
+CLI: ``python tools/check.py`` (``--pass``, ``--baseline``,
+``--update-baseline``); tier-1 gate: ``tests/test_static_analysis.py``.
+Grammar + workflow: docs/analysis.md.
+
+Only :mod:`.witness` is imported eagerly — ``horovod_tpu/__init__``
+pulls this package on EVERY product import to arm the witness, and the
+AST pass machinery (needed only by ``tools/check.py`` and the tests)
+must not tax that path. Everything else resolves lazily (PEP 562).
+"""
+import importlib
+
+from . import witness
+
+#: lazy surface: submodules + the core names re-exported from .core.
+_LAZY_MODULES = ("core", "collective", "knobs", "locks",
+                 "metrics_drift", "resilience_lint")
+_CORE_NAMES = ("Finding", "SourceFile", "collect_files",
+               "load_baseline", "read_baseline_entries", "run_passes",
+               "write_baseline")
+#: registry order = report order.
+_PASS_MODULE_ORDER = ("collective", "locks", "knobs", "metrics_drift",
+                      "resilience_lint")
+
+__all__ = ["ALL_PASSES", "PASS_BY_ID", "witness",
+           *_LAZY_MODULES, *_CORE_NAMES]
+
+
+def __getattr__(name):
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod          # cache for next access
+        return mod
+    if name in _CORE_NAMES:
+        val = getattr(importlib.import_module(".core", __name__), name)
+        globals()[name] = val
+        return val
+    if name == "ALL_PASSES":
+        val = tuple(importlib.import_module(f".{m}", __name__)
+                    for m in _PASS_MODULE_ORDER)
+        globals()[name] = val
+        return val
+    if name == "PASS_BY_ID":
+        val = {p.PASS_ID: p for p in __getattr__("ALL_PASSES")}
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,274 @@
+"""Collective-divergence lint (pass ``collective-divergence``).
+
+The deadlock class this repo has actually shipped: one rank takes a
+branch another rank does not, and inside that branch sits a call into
+the collective surface — a coordinator allgather/gather/reduce/
+barrier, a ``RingComm`` transfer, a bit-AND vote, ``elastic_restore``,
+a collective checkpoint save/restore. The peers enter the round, the
+divergent rank never does, and the job hangs until the collective
+timeout. PR 4 hit it twice (the change-detection skip, divergent
+``latest_step()`` views), PR 7 once (``elastic_restore`` split between
+restore paths); each fix's core was *make the branch condition
+rank-invariant* (a collective vote / a rank-0 broadcast).
+
+This pass flags collective calls that are control-dependent on a
+**rank-local source**: ``os.environ`` reads, filesystem probes
+(``os.path.exists``, ``os.listdir``, ``open``...), wall-clock reads
+(``time.*``), ``random``, pid/hostname. Those are exactly the inputs
+whose value can differ between ranks mid-round (divergent shared-FS
+visibility was the PR 4 root cause). The taint walk is deliberately
+shallow — the condition expression itself, plus one assignment hop
+within the enclosing function — because a review-pass lint must have
+near-zero false negatives on the shapes we have been burned by while
+staying readable; deeper dataflow belongs in the runtime witness, not
+here.
+
+Suppression: ``# rank-invariant: <why every rank takes the same
+branch>`` on the collective call, on the governing condition, or on
+the enclosing ``def``. The reason is the regression note.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile, call_name, dotted_name
+
+PASS_ID = "collective-divergence"
+ANNOTATION = "rank-invariant"
+DESCRIPTION = ("collective calls control-dependent on rank-local "
+               "sources (env/filesystem/clock/random)")
+
+#: method names that are collective entries on any receiver. The list
+#: is the repo's actual collective surface, kept tight on purpose —
+#: over-matching would drown the review signal in noise:
+#: coordinator ops (store_comm.Coordinator / csrc/store.cc),
+#: RingComm transfers (native/p2p.py), the redistribution entry
+#: points, the collective ckpt save/restore.
+COLLECTIVE_METHODS = {
+    "allgather", "allgather_bytes", "allgather_object",
+    "gather", "reduce_and", "reduce_or", "barrier",
+    "shift",                      # RingComm one-hop rotation
+    "restore_resharded",          # ckpt N->M collective restore
+}
+
+#: bare / dotted function names that are collective entries.
+COLLECTIVE_FUNCS = {
+    "elastic_restore",            # redist/elastic.py collective probe+vote
+    "restore_resharded",
+    "metrics_report",             # obs/report.py collective snapshot
+}
+
+#: ``.reduce(`` is the coordinator bit-AND vote — but also
+#: ``functools.reduce``; receivers named here are never collectives.
+_REDUCE_NONCOLLECTIVE_RECV = {"functools", "np", "numpy", "jnp", "jax"}
+
+#: ``.save(`` / ``.restore(`` are collective only on checkpointer-ish
+#: receivers (ShardedCheckpointer barriers the world / allgathers).
+_CKPT_RECV_HINTS = ("checkpointer", "ckpt", "_cp")
+
+#: rank-local taint sources: dotted-call prefixes -> reason.
+_TAINT_CALLS = {
+    "os.path.exists": "filesystem probe",
+    "os.path.isfile": "filesystem probe",
+    "os.path.isdir": "filesystem probe",
+    "os.path.getmtime": "filesystem probe",
+    "os.path.getsize": "filesystem probe",
+    "os.listdir": "filesystem probe",
+    "os.scandir": "filesystem probe",
+    "os.stat": "filesystem probe",
+    "os.access": "filesystem probe",
+    "open": "filesystem read",
+    "time.time": "wall clock",
+    "time.monotonic": "wall clock",
+    "time.perf_counter": "wall clock",
+    "os.getpid": "process-local id",
+    "socket.gethostname": "host-local id",
+}
+
+_TAINT_PREFIXES = {
+    "random.": "random",
+    "os.environ.": "os.environ read",
+}
+
+
+def _expr_taint(node: ast.AST, assigned_taint: Dict[str, str],
+                ) -> Optional[str]:
+    """Reason string when the expression reads a rank-local source."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            cn = call_name(sub)
+            if cn:
+                if cn in _TAINT_CALLS:
+                    return _TAINT_CALLS[cn]
+                for pref, why in _TAINT_PREFIXES.items():
+                    if cn.startswith(pref):
+                        return why
+        elif isinstance(sub, ast.Attribute):
+            dn = dotted_name(sub)
+            if dn == "os.environ":
+                return "os.environ read"
+        elif isinstance(sub, ast.Name):
+            if sub.id in assigned_taint:
+                return f"`{sub.id}` <- {assigned_taint[sub.id]}"
+    return None
+
+
+def _function_assigned_taint(fn: ast.AST) -> Dict[str, str]:
+    """One-hop taint: names assigned from a rank-local expression
+    anywhere in the function (flow-insensitive, two fixpoint rounds so
+    ``a = os.environ.get(..); b = a`` still taints ``b``)."""
+    taint: Dict[str, str] = {}
+    for _ in range(2):
+        changed = False
+        for sub in ast.walk(fn):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                targets, value = [sub.target], sub.value
+            elif isinstance(sub, ast.AugAssign):
+                targets, value = [sub.target], sub.value
+            elif isinstance(sub, (ast.NamedExpr,)):
+                targets, value = [sub.target], sub.value
+            if value is None:
+                continue
+            why = _expr_taint(value, taint)
+            if not why:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id not in taint:
+                    taint[t.id] = why
+                    changed = True
+                elif isinstance(t, ast.Tuple):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name) and el.id not in taint:
+                            taint[el.id] = why
+                            changed = True
+        if not changed:
+            break
+    return taint
+
+
+def _is_collective_call(call: ast.Call) -> Optional[str]:
+    """Collective-surface description for a Call, else None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        recv = dotted_name(func.value) or ""
+        recv_last = recv.rsplit(".", 1)[-1].lower()
+        if attr in COLLECTIVE_METHODS:
+            return f".{attr}()"
+        if attr == "reduce":
+            if recv_last in _REDUCE_NONCOLLECTIVE_RECV:
+                return None
+            return ".reduce() vote"
+        if attr in ("save", "restore"):
+            if any(h in recv.lower() for h in _CKPT_RECV_HINTS):
+                return f"collective ckpt .{attr}()"
+            return None
+        if attr in ("broadcast",):
+            # RingComm.broadcast / coordinator broadcast both qualify
+            return ".broadcast()"
+        return None
+    name = call_name(call)
+    if name:
+        last = name.rsplit(".", 1)[-1]
+        if last in COLLECTIVE_FUNCS:
+            return f"{last}()"
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    """Descend with a stack of governing (condition, lineno) pairs."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.cond_stack: List[Tuple[ast.AST, int]] = []
+        self.fn_stack: List[ast.AST] = []
+        self.taint_stack: List[Dict[str, str]] = [{}]
+        self.findings: List[Finding] = []
+
+    # -- scope tracking
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_fn(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_fn(node)
+
+    def _visit_fn(self, node: ast.AST) -> None:
+        self.fn_stack.append(node)
+        self.taint_stack.append(_function_assigned_taint(node))
+        saved = self.cond_stack
+        self.cond_stack = []       # conditions don't cross fn boundaries
+        self.generic_visit(node)
+        self.cond_stack = saved
+        self.taint_stack.pop()
+        self.fn_stack.pop()
+
+    # -- control structures whose test creates a divergence hazard
+    def visit_If(self, node: ast.If) -> None:
+        self._visit_cond(node.test, node.body + node.orelse)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._visit_cond(node.test, node.body + node.orelse)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self.cond_stack.append((node.test, node.test.lineno))
+        self.visit(node.body)
+        self.visit(node.orelse)
+        self.cond_stack.pop()
+        self.visit(node.test)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        # assert never guards a collective body; nothing to do
+        self.generic_visit(node)
+
+    def _visit_cond(self, test: ast.AST, body: List[ast.stmt]) -> None:
+        self.visit(test)
+        self.cond_stack.append((test, test.lineno))
+        for stmt in body:
+            self.visit(stmt)
+        self.cond_stack.pop()
+
+    # -- the collective surface
+    def visit_Call(self, node: ast.Call) -> None:
+        desc = _is_collective_call(node)
+        if desc:
+            self._check(node, desc)
+        self.generic_visit(node)
+
+    def _check(self, node: ast.Call, desc: str) -> None:
+        taint = self.taint_stack[-1]
+        for test, cond_line in self.cond_stack:
+            why = _expr_taint(test, taint)
+            if not why:
+                continue
+            fn = self.fn_stack[-1] if self.fn_stack else None
+            extra = [cond_line]
+            if fn is not None:
+                extra.append(fn.lineno)
+            if self.sf.annotated(ANNOTATION, node.lineno,
+                                 getattr(node, "end_lineno", node.lineno),
+                                 extra_lines=extra):
+                return
+            self.findings.append(self.sf.make_finding(
+                PASS_ID, node.lineno, "divergent-collective",
+                f"collective {desc} is control-dependent on a rank-local "
+                f"source ({why}, condition at line {cond_line}) — if "
+                f"ranks can disagree here, peers deadlock in the round; "
+                f"make the condition collective (vote/broadcast) or "
+                f"annotate '# rank-invariant: <why>'"))
+            return      # one finding per call is enough
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        v = _Visitor(sf)
+        v.visit(sf.tree)
+        out.extend(v.findings)
+    return out

@@ -1,0 +1,317 @@
+"""Walker core + finding model for the repo-native static-analysis plane.
+
+The costliest bugs in this repo's history are *invariant* errors, not
+logic errors: one rank skipping a collective another rank enters (the
+PR 4 change-detection deadlock, the `latest_step()` divergence), a
+blocking call made under the wrong lock (the PR 8 router lock-order
+fix), silent drift between knobs, metric help strings and docs. Each
+was found by hand in a review pass. This package turns those review
+passes into machine-checked passes over the stdlib ``ast``, so every
+future PR gets them for free.
+
+Everything in ``horovod_tpu/analysis/`` is **jax-free, stdlib-only**:
+``tools/check.py`` must run on a box with no accelerator stack at all
+(the same contract as ``tools/ckpt_inspect.py``), and the runtime
+lock-order witness must be importable before ``hvd.init()``.
+
+Shared model
+------------
+
+* :class:`SourceFile` — one parsed file: text, split lines, the ``ast``
+  tree (``None`` plus a finding when the file does not parse).
+* :class:`Finding` — one diagnostic with a stable ``key`` used by the
+  committed baseline: ``pass|path|code|crc32(stripped line text)``.
+  Keying on the line *text* rather than the line *number* keeps
+  grandfathered findings pinned through unrelated edits above them.
+* **Annotation grammar** — mirrors the existing
+  ``# resilience: exempt (<reason>)`` convention from the PR 9 lint.
+  Every pass owns one tag; ``# <tag>: <non-empty reason>`` on the
+  flagged line, the line above it, anywhere inside the flagged
+  statement's span, or on the enclosing ``def`` line suppresses the
+  finding. Canonical spellings (see docs/analysis.md):
+
+  - ``# rank-invariant: <why this branch is identical on every rank>``
+  - ``# lock-order: exempt (<why this blocking call is safe here>)``
+  - ``# knob: exempt (<why this env read bypasses core/config.py>)``
+  - ``# metric-help: exempt (<why this help string is duplicated>)``
+  - ``# resilience: exempt (<why this handler skips the classifier>)``
+
+  A reason is REQUIRED — a bare tag does not suppress. The reason is
+  the regression note future reviewers read.
+* **Baseline** — a committed JSON file of grandfathered finding keys.
+  ``tools/check.py --update-baseline`` rewrites it; a clean tree keeps
+  it empty so new findings fail the gate immediately.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: annotation line grammar: ``# <tag>: <reason>`` — reason mandatory.
+_ANN_RE = re.compile(r"#\s*(?P<tag>[A-Za-z][\w-]*)\s*:\s*(?P<reason>\S.*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic from one pass at one source location."""
+    pass_id: str
+    path: str          # repo-relative posix path
+    line: int          # 1-based
+    code: str          # short stable slug, e.g. "divergent-collective"
+    message: str
+    key: str           # stable baseline key
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}/{self.code}] " \
+               f"{self.message}"
+
+
+def finding_key(pass_id: str, path: str, code: str, line_text: str) -> str:
+    crc = zlib.crc32(line_text.strip().encode("utf-8", "replace"))
+    return f"{pass_id}|{path}|{code}|{crc:08x}"
+
+
+class SourceFile:
+    """One loaded + parsed python file with annotation lookup."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        with open(abspath, "r", encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines: List[str] = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text, filename=self.path)
+        except SyntaxError as e:     # surfaced as its own finding
+            self.syntax_error = f"{e.msg} (line {e.lineno})"
+        # tag -> set of annotated line numbers (1-based)
+        self._ann: Dict[str, Set[int]] = {}
+        for i, ln in enumerate(self.lines, start=1):
+            if "#" not in ln:
+                continue
+            m = _ANN_RE.search(ln)
+            if m:
+                self._ann.setdefault(m.group("tag").lower(), set()).add(i)
+
+    def annotated(self, tag: str, start: int,
+                  end: Optional[int] = None,
+                  extra_lines: Sequence[int] = ()) -> bool:
+        """True when a ``# <tag>: <reason>`` annotation covers the span.
+
+        Coverage = any line in ``[start-1, end]`` (the statement span
+        plus the conventional line-above placement) or any of
+        ``extra_lines`` (callers pass the enclosing ``def`` line and
+        the governing condition's line)."""
+        anns = self._ann.get(tag.lower())
+        if not anns:
+            return False
+        end = end if end is not None else start
+        for ln in range(max(1, start - 1), end + 1):
+            if ln in anns:
+                return True
+        # a multi-line annotation comment block directly above the
+        # statement counts: scan upward through contiguous comments
+        ln = start - 1
+        while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
+            if ln in anns:
+                return True
+            ln -= 1
+        for ln in extra_lines:
+            if ln and (ln in anns or (ln - 1) in anns or (ln + 1) in anns):
+                return True
+        return False
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def make_finding(self, pass_id: str, lineno: int, code: str,
+                     message: str,
+                     key_text: Optional[str] = None) -> Finding:
+        """``key_text`` overrides the line text in the baseline key —
+        REQUIRED for aggregate findings anchored at a shared line
+        (e.g. file-level doc-drift findings at line 1), which would
+        otherwise collide and let one baselined entry grandfather
+        every future sibling."""
+        return Finding(
+            pass_id=pass_id, path=self.path, line=lineno, code=code,
+            message=message,
+            key=finding_key(pass_id, self.path, code,
+                            key_text if key_text is not None
+                            else self.line_text(lineno)))
+
+
+def collect_files(root: str,
+                  subdirs: Sequence[str] = ("horovod_tpu",),
+                  exclude_parts: Sequence[str] = ("__pycache__",),
+                  ) -> List[SourceFile]:
+    """Load every ``.py`` file under ``root/<subdir>`` (sorted, stable)."""
+    out: List[SourceFile] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in exclude_parts)
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                ap = os.path.join(dirpath, fn)
+                out.append(SourceFile(ap, os.path.relpath(ap, root)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# small AST helpers shared by the passes
+# --------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of the called object, else None."""
+    return dotted_name(call.func)
+
+
+def enclosing_def_lines(tree: ast.AST) -> Dict[int, int]:
+    """line -> the nearest (innermost) enclosing def's lineno — the
+    annotation-scope map shared by the passes (an annotation on the
+    ``def`` line covers the whole function body)."""
+    out: Dict[int, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            for ln in range(node.lineno, end + 1):
+                # innermost wins: a nested def starts later
+                if ln not in out or node.lineno > out[ln]:
+                    out[ln] = node.lineno
+    return out
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Set[str]:
+    """Committed grandfather file -> set of suppressed finding keys."""
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    if not text.strip():        # empty file / /dev/null = no baseline
+        return set()
+    data = json.loads(text)
+    if not isinstance(data, dict) or data.get("version") != 1:
+        raise ValueError(
+            f"baseline {path}: expected {{'version': 1, 'entries': "
+            f"[...]}}; got {type(data).__name__}")
+    keys: Set[str] = set()
+    for ent in data.get("entries", []):
+        keys.add(ent["key"] if isinstance(ent, dict) else str(ent))
+    return keys
+
+
+def read_baseline_entries(path: str) -> List[dict]:
+    """Raw ``{"key", "hint"}`` entries (hints preserved), [] if absent."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    if not text.strip():
+        return []
+    data = json.loads(text)
+    out = []
+    for ent in data.get("entries", []) if isinstance(data, dict) else []:
+        if isinstance(ent, dict) and "key" in ent:
+            out.append({"key": ent["key"], "hint": ent.get("hint", "")})
+        else:
+            out.append({"key": str(ent), "hint": ""})
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   keep_entries: Iterable[dict] = ()) -> None:
+    """Rewrite the baseline from the current unsuppressed findings
+    plus ``keep_entries`` (raw entries preserved from a previous
+    baseline, for partial --pass updates).
+
+    The ``hint`` is human context only — matching is by ``key``."""
+    entries = {f.key: {"key": f.key, "hint": f.render()}
+               for f in findings}
+    for ent in keep_entries:
+        entries.setdefault(ent["key"], ent)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "entries": sorted(entries.values(),
+                                     key=lambda e: (e["hint"], e["key"]))},
+                  f, indent=1)
+        f.write("\n")
+
+
+# --------------------------------------------------------------------------
+# pass registry + driver
+# --------------------------------------------------------------------------
+
+@dataclass
+class PassResult:
+    pass_id: str
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+
+
+def run_passes(root: str, passes: Sequence,
+               baseline: Optional[Set[str]] = None,
+               ) -> Tuple[List[Finding], List[PassResult]]:
+    """Run each pass over the repo; return (unsuppressed, per-pass).
+
+    A pass is a module exposing ``PASS_ID`` and
+    ``run(files, root) -> List[Finding]``; annotation suppression is
+    the pass's own job (it knows its scoping rules), baseline
+    suppression happens here."""
+    baseline = baseline or set()
+    files = collect_files(root)
+    unsuppressed: List[Finding] = []
+    results: List[PassResult] = []
+    syntax_reported: Set[str] = set()
+    for p in passes:
+        res = PassResult(pass_id=p.PASS_ID)
+        for f in p.run(files, root):
+            if f.key in baseline:
+                res.suppressed.append(f)
+            else:
+                res.findings.append(f)
+                unsuppressed.append(f)
+        results.append(res)
+    # a file that does not parse is a finding of its own, reported once
+    for sf in files:
+        if sf.syntax_error and sf.path not in syntax_reported:
+            syntax_reported.add(sf.path)
+            f = sf.make_finding("core", 1, "syntax-error",
+                                f"file does not parse: {sf.syntax_error}")
+            if f.key not in baseline:
+                unsuppressed.append(f)
+    return unsuppressed, results

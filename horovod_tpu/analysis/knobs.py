@@ -1,0 +1,207 @@
+"""Knob-registry lint (pass ``knob-registry``).
+
+The drift this repo has fixed by hand three separate times: a
+``HOROVOD_*`` env var is read somewhere deep in a module, never
+declared in ``core/config.py``, never documented, and its parse
+semantics quietly diverge from the strict fail-fast contract every
+declared knob follows. Four checks:
+
+1. **Declared.** Every ``HOROVOD_*``/``HVD_*`` env var read anywhere
+   in ``horovod_tpu/`` must be read by ``core/config.py``'s
+   ``from_env`` (the single registry), be a **wiring var** (launcher-
+   provided identity/addressing — ``HOROVOD_RANK``,
+   ``HOROVOD_NATIVE_KV_ADDR``... — listed in :data:`WIRING_VARS`
+   below, the allowlist IS the declaration), or carry a
+   ``# knob: exempt (<why>)`` annotation.
+2. **Documented.** Every knob ``core/config.py`` reads must have a row
+   in the canonical knob table ``docs/knobs.md``, and every
+   ``HOROVOD_*`` row in that table must correspond to a config read —
+   both directions, so the doc can never go stale silently.
+3. **Single reader.** No module outside ``core/config.py`` and the
+   launcher package ``runner/`` may read ``os.environ`` for a
+   non-wiring knob without an exemption annotation — config flows
+   through the ``Config`` object, which is what the engine round-
+   synchronizes across ranks (a direct env read is exactly how a
+   per-host divergence sneaks into "shared" state).
+4. **Strict-parsed.** Inside ``core/config.py``, knob reads must use
+   the strict helpers (``_env_int_strict``/``_env_float_strict``/
+   ``os.environ.get`` + explicit validation); the lenient
+   ``_env_int``/``_env_float`` silently swallow a typo'd value, so a
+   lenient read needs a ``# knob: exempt`` stating why (the legacy
+   reference-compat knobs carry exactly that).
+
+Suppression: ``# knob: exempt (<why>)`` on the read line or the
+enclosing ``def``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, SourceFile, call_name, dotted_name,
+                   enclosing_def_lines, str_const)
+
+PASS_ID = "knob-registry"
+ANNOTATION = "knob"
+DESCRIPTION = ("HOROVOD_* env reads must be declared in core/config.py, "
+               "documented in docs/knobs.md, and strict-parsed")
+
+_KNOB_RE = re.compile(r"^(HOROVOD|HVD)_[A-Z0-9_]+$")
+
+#: launcher-provided identity / wiring vars: process identity, the KV
+#: rendezvous address, internal cross-process handshakes. These are not
+#: *configuration* — they are the contract between the launcher
+#: (runner/, elastic/driver.py) and the process it spawns, they differ
+#: between ranks BY DESIGN, and reading them anywhere is fine.
+WIRING_VARS = {
+    "HOROVOD_RANK", "HOROVOD_SIZE",
+    "HOROVOD_LOCAL_RANK", "HOROVOD_LOCAL_SIZE",
+    "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
+    "HOROVOD_PROCESS_ID", "HOROVOD_NUM_PROCESSES",
+    "HOROVOD_NATIVE_KV_ADDR", "HOROVOD_NATIVE_KV_PORT",
+    "HOROVOD_COORDINATOR_ADDR", "HOROVOD_SHM_GEN",
+    "HOROVOD_JOB_ID", "HOROVOD_HOSTNAME",
+    "HOROVOD_CKPT_RESET_EPOCH",       # elastic incarnation counter
+    "HOROVOD_SERVE_WORKER_CFG",       # worker-process spawn contract
+}
+
+#: env-read call shapes: (dotted callee, arg index of the var name).
+_READ_CALLS = {
+    "os.environ.get": 0,
+    "os.getenv": 0,
+    "_env_bool": 0, "_env_int": 0, "_env_float": 0,
+    "_env_int_strict": 0, "_env_float_strict": 0,
+}
+
+#: lenient parse helpers (silent fallback on malformed values).
+_LENIENT_HELPERS = {"_env_int", "_env_float"}
+
+_CONFIG_PATH = "horovod_tpu/core/config.py"
+_LAUNCHER_PREFIX = "horovod_tpu/runner/"
+_DOCS_TABLE = "docs/knobs.md"
+
+
+def _env_reads(sf: SourceFile,
+               ) -> List[Tuple[str, int, int, Optional[str]]]:
+    """(var, line, end_line, lenient_helper|None) for every env read
+    of a HOROVOD_*/HVD_* name in the file."""
+    out: List[Tuple[str, int, int, Optional[str]]] = []
+    if sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        var: Optional[str] = None
+        helper: Optional[str] = None
+        if isinstance(node, ast.Call):
+            cn = call_name(node)
+            if cn is None:
+                continue
+            base = cn.rsplit(".", 1)[-1] if cn.startswith("self.") else cn
+            idx = _READ_CALLS.get(base)
+            if idx is None or len(node.args) <= idx:
+                continue
+            var = str_const(node.args[idx])
+            if base in _LENIENT_HELPERS:
+                helper = base
+        elif isinstance(node, ast.Subscript):
+            if dotted_name(node.value) == "os.environ":
+                var = str_const(node.slice)
+        if var and _KNOB_RE.match(var):
+            out.append((var, node.lineno,
+                        getattr(node, "end_lineno", node.lineno), helper))
+    return out
+
+
+def _doc_table_vars(root: str) -> Optional[Set[str]]:
+    """HOROVOD_* names appearing as table rows in docs/knobs.md."""
+    path = os.path.join(root, _DOCS_TABLE)
+    if not os.path.exists(path):
+        return None
+    out: Set[str] = set()
+    with open(path, "r", encoding="utf-8") as f:
+        for ln in f:
+            if not ln.lstrip().startswith("|"):
+                continue
+            m = re.search(r"`((HOROVOD|HVD)_[A-Z0-9_]+)`", ln)
+            if m:
+                out.add(m.group(1))
+    return out
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    config_sf: Optional[SourceFile] = None
+    declared: Set[str] = set()
+    # 1st sweep: what does config.py read?
+    for sf in files:
+        if sf.path == _CONFIG_PATH:
+            config_sf = sf
+            for var, _, _, _ in _env_reads(sf):
+                declared.add(var)
+    doc_vars = _doc_table_vars(root)
+
+    for sf in files:
+        if not sf.path.startswith("horovod_tpu/"):
+            continue
+        def_lines = (enclosing_def_lines(sf.tree)
+                     if sf.tree is not None else {})
+        in_config = sf.path == _CONFIG_PATH
+        in_launcher = sf.path.startswith(_LAUNCHER_PREFIX)
+        for var, line, end, lenient in _env_reads(sf):
+            extra = [def_lines[line]] if line in def_lines else []
+            if in_config:
+                if lenient and not sf.annotated(ANNOTATION, line, end,
+                                                extra_lines=extra):
+                    findings.append(sf.make_finding(
+                        PASS_ID, line, "lenient-parse",
+                        f"{var} parsed with the lenient {lenient}() — a "
+                        f"typo'd value silently falls back to the "
+                        f"default; use the _strict helper or annotate "
+                        f"'# knob: exempt (<why lenient>)'"))
+                continue
+            if var in WIRING_VARS:
+                continue
+            if in_launcher:
+                continue
+            if sf.annotated(ANNOTATION, line, end, extra_lines=extra):
+                continue
+            if var in declared:
+                findings.append(sf.make_finding(
+                    PASS_ID, line, "bypass-config",
+                    f"{var} is a declared knob but read directly from "
+                    f"os.environ here — config flows through the "
+                    f"round-synchronized Config object; route through "
+                    f"core/config.py or annotate "
+                    f"'# knob: exempt (<why>)'"))
+            else:
+                findings.append(sf.make_finding(
+                    PASS_ID, line, "undeclared-knob",
+                    f"{var} read from os.environ but never declared in "
+                    f"core/config.py from_env — declare + strict-parse "
+                    f"it there (and add a docs/knobs.md row) or "
+                    f"annotate '# knob: exempt (<why>)'"))
+
+    # 2nd sweep: config <-> docs table, both directions.
+    if config_sf is not None:
+        if doc_vars is None:
+            findings.append(config_sf.make_finding(
+                PASS_ID, 1, "missing-doc-table",
+                f"{_DOCS_TABLE} does not exist — the canonical knob "
+                f"table every declared knob must appear in",
+                key_text=_DOCS_TABLE))
+        else:
+            for var in sorted(declared - doc_vars):
+                findings.append(config_sf.make_finding(
+                    PASS_ID, 1, "undocumented-knob",
+                    f"{var} is read by core/config.py but has no row "
+                    f"in {_DOCS_TABLE}", key_text=var))
+            for var in sorted(v for v in doc_vars
+                              if v not in declared
+                              and v not in WIRING_VARS):
+                findings.append(config_sf.make_finding(
+                    PASS_ID, 1, "stale-doc-row",
+                    f"{var} has a row in {_DOCS_TABLE} but "
+                    f"core/config.py never reads it — remove the row "
+                    f"or declare the knob", key_text=var))
+    return findings

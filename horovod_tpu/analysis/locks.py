@@ -1,0 +1,297 @@
+"""Lock-order / blocking-under-lock analyzer (pass ``lock-order``).
+
+Two checks over the same per-function walk:
+
+1. **Acquisition-order graph.** Every ``with <lock>:`` nesting (and
+   every bare ``.acquire()`` made while a ``with`` lock is held) adds
+   a directed edge *held -> acquired* between lock identities. The
+   union of edges across the whole codebase is checked for cycles: a
+   cycle means two call paths take the same pair of locks in opposite
+   orders — the textbook ABBA deadlock the PR 8 router fix removed by
+   hand. Lock identity is the normalized expression text, qualified by
+   the enclosing class for ``self.*`` attributes (``fleet:FleetRouter.
+   _lock``); two *instances* of the same class attribute share an
+   identity, which is exactly the lockdep convention — ordering
+   violations between instances of one class are real hazards even
+   when today's object graph happens not to deadlock.
+
+2. **Blocking calls under a held lock.** Socket ``accept``/``recv``,
+   ``Queue.get``, ``subprocess.wait``/``communicate``, ``Thread.join``,
+   future ``.result()``, ``Event.wait``, ``time.sleep`` and the native
+   KV/dispatch request surface, made while any ``with`` lock is held.
+   A blocking call under a lock stalls every sibling of that lock for
+   the call's full timeout — the shape behind the PR 8 handle-
+   resolution-under-lock fix. ``cond.wait()`` on the lock object that
+   is itself held is NOT flagged (releasing the held lock is the
+   entire point of a condition variable).
+
+Static identity cannot see through aliasing (two names for one lock
+object in different modules) — the runtime witness
+(:mod:`horovod_tpu.analysis.witness`) validates the same invariant on
+real executions and covers that gap.
+
+Suppression: ``# lock-order: exempt (<why>)`` on the blocking call /
+acquisition line, the ``with`` line holding the lock, or the
+enclosing ``def``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, call_name, dotted_name
+
+PASS_ID = "lock-order"
+ANNOTATION = "lock-order"
+DESCRIPTION = ("cyclic lock-acquisition orders and blocking calls "
+               "made while holding a lock")
+
+#: an expression is lock-ish when its last dotted segment matches.
+_LOCK_NAME_RE = re.compile(
+    r"(^|_)(lock|locks|mutex|mu|rlock|cv|cond)$|lock$", re.IGNORECASE)
+
+#: attribute calls that block regardless of receiver.
+_BLOCKING_ATTRS = {
+    "accept": "socket.accept",
+    "recv": "socket.recv",
+    "recv_into": "socket.recv_into",
+    "recvfrom": "socket.recvfrom",
+    "connect": "socket.connect",
+    "makefile": "socket.makefile",
+    "communicate": "subprocess.communicate",
+    "result": "future.result",
+}
+
+#: dotted-call names that block.
+_BLOCKING_FUNCS = {
+    "time.sleep": "time.sleep",
+    "socket.create_connection": "socket.create_connection",
+    "subprocess.run": "subprocess.run",
+    "subprocess.check_output": "subprocess.check_output",
+    "subprocess.check_call": "subprocess.check_call",
+}
+
+#: the repo's own blocking wire surface: a KV/coordinator/dispatch
+#: request under a lock holds every sibling for the request timeout.
+_WIRE_ATTRS = {
+    "gather": "KV gather", "barrier": "KV barrier",
+    "allgather": "KV allgather", "allgather_bytes": "KV allgather",
+    "wait_key": "KV wait", "dispatch": "dispatch request",
+}
+_QUEUEISH_RE = re.compile(r"(^|_)(q|queue|inbox|outbox|jobs)s?$",
+                          re.IGNORECASE)
+_THREADISH_RE = re.compile(
+    r"(thread|worker|proc|sweeper|poller|_t)\w*$", re.IGNORECASE)
+
+
+def _lockish(expr: ast.AST) -> Optional[str]:
+    """Normalized identity text when ``expr`` looks like a lock."""
+    dn = dotted_name(expr)
+    if not dn:
+        return None
+    last = dn.rsplit(".", 1)[-1]
+    if _LOCK_NAME_RE.search(last):
+        return dn
+    return None
+
+
+def _blocking_reason(call: ast.Call, held_exprs: Sequence[str],
+                     ) -> Optional[str]:
+    """Reason string when the call is blocking; None otherwise."""
+    func = call.func
+    cn = call_name(call)
+    if cn and cn in _BLOCKING_FUNCS:
+        return _BLOCKING_FUNCS[cn]
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    recv = dotted_name(func.value) or ""
+    recv_last = recv.rsplit(".", 1)[-1]
+    if attr in _BLOCKING_ATTRS:
+        # x.recv() where x is a dict-style .get store? no — these names
+        # are unambiguous; flag unconditionally.
+        return _BLOCKING_ATTRS[attr]
+    if attr == "wait":
+        # cond.wait() while holding that very cond releases it — legal.
+        if recv and recv in held_exprs:
+            return None
+        return "wait()"
+    if attr == "join":
+        if not call.args and not call.keywords:
+            if recv and (_THREADISH_RE.search(recv_last)
+                         or recv_last in ("t", "p")):
+                return "thread/process join"
+            return None
+        if any(k.arg == "timeout" for k in call.keywords):
+            return "thread/process join"
+        return None
+    if attr == "get":
+        if any(k.arg in ("timeout", "block") for k in call.keywords):
+            return "queue.get"
+        if recv and _QUEUEISH_RE.search(recv_last):
+            return "queue.get"
+        return None
+    if attr in _WIRE_ATTRS:
+        return _WIRE_ATTRS[attr]
+    if attr == "request" and recv:
+        return "wire request"
+    return None
+
+
+class _FnWalker(ast.NodeVisitor):
+    """One function: track held ``with`` locks, emit edges + findings."""
+
+    def __init__(self, sf: SourceFile, module_id: str,
+                 class_name: Optional[str], fn: ast.AST):
+        self.sf = sf
+        self.module_id = module_id
+        self.class_name = class_name
+        self.fn = fn
+        # (identity, with-stmt lineno, raw expr text)
+        self.held: List[Tuple[str, int, str]] = []
+        self.edges: List[Tuple[str, str, int]] = []     # (a, b, line)
+        self.findings: List[Finding] = []
+
+    def _qualify(self, dn: str) -> str:
+        if dn.startswith("self.") and self.class_name:
+            return f"{self.module_id}:{self.class_name}.{dn[5:]}"
+        if dn.startswith("cls.") and self.class_name:
+            return f"{self.module_id}:{self.class_name}.{dn[4:]}"
+        return f"{self.module_id}:{dn}"
+
+    def _extra_ann_lines(self) -> List[int]:
+        out = [self.fn.lineno]
+        out.extend(line for _, line, _ in self.held)
+        return out
+
+    def _suppressed(self, node: ast.AST) -> bool:
+        return self.sf.annotated(
+            ANNOTATION, node.lineno,
+            getattr(node, "end_lineno", node.lineno),
+            extra_lines=self._extra_ann_lines())
+
+    # -- nested defs are walked separately by the pass driver
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is not self.fn:
+            return
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        if node is not self.fn:
+            return
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            ctx = item.context_expr
+            # with lock.acquire_timeout(...) style: unwrap simple calls
+            target = ctx.func if isinstance(ctx, ast.Call) else ctx
+            ident = _lockish(target)
+            if ident is None:
+                continue
+            q = self._qualify(ident)
+            for held_q, _, _ in self.held:
+                if held_q != q:
+                    self.edges.append((held_q, q, node.lineno))
+            self.held.append((q, node.lineno, ident))
+            pushed += 1
+        self.generic_visit(node)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            ident = _lockish(func.value)
+            if ident is not None and self.held:
+                q = self._qualify(ident)
+                for held_q, _, _ in self.held:
+                    if held_q != q:
+                        self.edges.append((held_q, q, node.lineno))
+        elif self.held:
+            held_exprs = [raw for _, _, raw in self.held]
+            why = _blocking_reason(node, held_exprs)
+            if why is not None and not self._suppressed(node):
+                holder, hline, hraw = self.held[-1]
+                self.findings.append(self.sf.make_finding(
+                    PASS_ID, node.lineno, "blocking-under-lock",
+                    f"blocking call ({why}) while holding `{hraw}` "
+                    f"(acquired line {hline}) — every sibling of this "
+                    f"lock stalls for the call's timeout; move the "
+                    f"call outside the lock or annotate "
+                    f"'# lock-order: exempt (<why>)'"))
+        self.generic_visit(node)
+
+
+def _cycle_findings(edges: Dict[Tuple[str, str], Tuple[SourceFile, int]],
+                    ) -> List[Finding]:
+    """DFS the union acquisition graph; one finding per cycle found."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    findings: List[Finding] = []
+    seen_cycles: Set[frozenset] = set()
+
+    for start in sorted(graph):
+        stack: List[str] = [start]
+        on_path: Set[str] = {start}
+
+        def dfs(node: str) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start and len(stack) > 1:
+                    cyc = frozenset(stack)
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    order = " -> ".join(stack + [start])
+                    sf, line = edges[(stack[0], stack[1])]
+                    if sf.annotated(ANNOTATION, line, line):
+                        continue
+                    findings.append(sf.make_finding(
+                        PASS_ID, line, "lock-cycle",
+                        f"cyclic lock acquisition order: {order} — two "
+                        f"paths take these locks in opposite orders "
+                        f"(ABBA deadlock); pick one global order or "
+                        f"annotate '# lock-order: exempt (<why>)'"))
+                elif nxt not in on_path:
+                    stack.append(nxt)
+                    on_path.add(nxt)
+                    dfs(nxt)
+                    on_path.discard(nxt)
+                    stack.pop()
+        dfs(start)
+    return findings
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    out: List[Finding] = []
+    # (a, b) -> first (file, line) exhibiting the edge
+    union_edges: Dict[Tuple[str, str], Tuple[SourceFile, int]] = {}
+    for sf in files:
+        if sf.tree is None:
+            continue
+        # full repo-relative identity: basename alone would merge
+        # same-named modules (native/store.py vs ckpt/store.py)
+        # into one graph node and fabricate or hide cycles
+        module_id = sf.path[:-3] if sf.path.endswith(".py") \
+            else sf.path
+        # walk every function with its enclosing class name
+        def walk_scope(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk_scope(child, child.name)
+                elif isinstance(child,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    w = _FnWalker(sf, module_id, cls, child)
+                    w.visit(child)
+                    out.extend(w.findings)
+                    for a, b, line in w.edges:
+                        union_edges.setdefault((a, b), (sf, line))
+                    walk_scope(child, cls)
+                else:
+                    walk_scope(child, cls)
+        walk_scope(sf.tree, None)
+    out.extend(_cycle_findings(union_edges))
+    return out

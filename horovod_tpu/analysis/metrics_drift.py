@@ -1,0 +1,153 @@
+"""Metric/help-drift lint (pass ``metric-help``).
+
+Every metric family this repo exposes is created through the obs
+registry (``R.counter(name, help, labels)`` / ``.gauge`` /
+``.histogram``). Two drift modes have actually bitten:
+
+* the same family constructed at several sites, each with its own
+  literal help string — the strings drift apart and Prometheus scrapes
+  whichever site registered first (PR 6/PR 7 each fixed one of these
+  by extracting a shared ``*_HELP`` constant);
+* a family added in code but never given a row in ``docs/metrics.md``,
+  so the fleet dashboard doc goes quietly stale.
+
+Checks:
+
+1. **Single help source.** A metric family name may carry a non-empty
+   *literal* help string at at most ONE construction site. Additional
+   sites must pass ``""`` (get-or-create against the first site) or a
+   shared ``*_HELP`` constant (a ``Name`` reference — single-sourced
+   by construction).
+2. **Documented.** Every literal family name constructed anywhere must
+   appear in ``docs/metrics.md`` (the instrumented-out-of-the-box
+   table or surrounding prose).
+
+Non-literal names (f-strings, variables) are skipped — they are
+already single-sourced by whatever builds them.
+
+Suppression: ``# metric-help: exempt (<why>)`` on the construction
+line or the enclosing ``def``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile, enclosing_def_lines, str_const
+
+PASS_ID = "metric-help"
+ANNOTATION = "metric-help"
+DESCRIPTION = ("metric families need one help-string source and a "
+               "docs/metrics.md row")
+
+_CTOR_ATTRS = {"counter", "gauge", "histogram"}
+_DOCS = "docs/metrics.md"
+
+#: registry-ish receivers; bare ``collections.Counter(...)`` or other
+#: same-named calls on non-registry objects are excluded by requiring
+#: the first positional arg to be a string literal metric name.
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+class _Site:
+    __slots__ = ("sf", "line", "end", "def_line", "literal_help")
+
+    def __init__(self, sf: SourceFile, line: int, end: int,
+                 def_line: Optional[int], literal_help: Optional[str]):
+        self.sf = sf
+        self.line = line
+        self.end = end
+        self.def_line = def_line
+        self.literal_help = literal_help
+
+
+def _help_arg(call: ast.Call) -> Tuple[Optional[str], bool]:
+    """(literal_help|None, has_any_help). Name/constant refs count as
+    non-literal (single-sourced)."""
+    node: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg in ("help", "help_"):
+            node = kw.value
+    if node is None:
+        return None, False
+    lit = str_const(node)
+    if lit is not None and lit.strip():
+        return lit, True
+    return None, True
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    sites: Dict[str, List[_Site]] = {}
+    for sf in files:
+        if sf.tree is None or not sf.path.startswith("horovod_tpu/"):
+            continue
+        def_of = enclosing_def_lines(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in _CTOR_ATTRS):
+                continue
+            if not node.args:
+                continue
+            name = str_const(node.args[0])
+            if name is None or not _NAME_OK.match(name):
+                continue
+            lit, _ = _help_arg(node)
+            sites.setdefault(name, []).append(_Site(
+                sf, node.lineno,
+                getattr(node, "end_lineno", node.lineno),
+                def_of.get(node.lineno), lit))
+
+    docs_path = os.path.join(root, _DOCS)
+    docs_text = ""
+    if os.path.exists(docs_path):
+        with open(docs_path, "r", encoding="utf-8") as f:
+            docs_text = f.read()
+    if not docs_text and sites:
+        # a missing table is a finding, not a silent skip — otherwise
+        # deleting docs/metrics.md would turn off check 2 green
+        first = min((fam[0] for fam in sites.values()),
+                    key=lambda s: (s.sf.path, s.line))
+        findings.append(first.sf.make_finding(
+            PASS_ID, 1, "missing-doc-table",
+            f"{_DOCS} does not exist (or is empty) — the table every "
+            f"metric family must appear in", key_text=_DOCS))
+
+    for name in sorted(sites):
+        fam = sites[name]
+        literal_sites = [s for s in fam if s.literal_help is not None]
+        if len(literal_sites) > 1:
+            # keep the first (registration order) as the source; flag
+            # the rest — the fix is a shared *_HELP constant.
+            for s in literal_sites[1:]:
+                extra = [s.def_line] if s.def_line else []
+                if s.sf.annotated(ANNOTATION, s.line, s.end,
+                                  extra_lines=extra):
+                    continue
+                first = literal_sites[0]
+                findings.append(s.sf.make_finding(
+                    PASS_ID, s.line, "duplicate-help",
+                    f"metric `{name}` gets a literal help string here "
+                    f"AND at {first.sf.path}:{first.line} — the copies "
+                    f"will drift; extract one shared *_HELP constant "
+                    f"or annotate '# metric-help: exempt (<why>)'"))
+        if docs_text and name not in docs_text:
+            s = fam[0]
+            extra = [s.def_line] if s.def_line else []
+            if s.sf.annotated(ANNOTATION, s.line, s.end,
+                              extra_lines=extra):
+                continue
+            findings.append(s.sf.make_finding(
+                PASS_ID, s.line, "undocumented-metric",
+                f"metric `{name}` is constructed here but {_DOCS} "
+                f"never mentions it — add a row to the instrumented "
+                f"table or annotate "
+                f"'# metric-help: exempt (<why>)'"))
+    return findings

@@ -1,0 +1,82 @@
+"""Socket-error classification lint (pass ``resilience``).
+
+Migrated from ``tests/test_resilience.py`` (where it started life as a
+regex sweep and caught a real offender during the PR 11 fleet work)
+onto the shared analyzer framework; the original test id survives as a
+thin shim calling this pass.
+
+Every ``except OSError`` / ``ConnectionError`` / ``socket.error`` /
+``socket.timeout`` handler in the wire planes (``horovod_tpu/native/``
+and ``horovod_tpu/serve/`` — the fleet's dispatch path) must either
+route through the resilience classifier — raise a classified
+``NativeConnError``/``P2PConnError``/``DispatchConnError``, or consult
+``is_retryable``/``_classify``/``_transient`` — or carry an explicit
+``# resilience: exempt (<reason>)`` annotation. An unwrapped handler
+is a wire fault the retry ladder never sees: a transient blip becomes
+a fatal error and a 17 s elastic reset instead of a millisecond retry.
+
+The check is AST-shaped now (real ``ExceptHandler`` nodes, the full
+handler body as the evidence window instead of a fixed 6-line peek)
+but the contract and the annotation grammar are unchanged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Finding, SourceFile, dotted_name
+
+PASS_ID = "resilience"
+ANNOTATION = "resilience"
+DESCRIPTION = ("except OSError/socket.* in the wire planes must route "
+               "through the resilience classifier")
+
+#: directories whose socket-error handlers must be classified.
+LINTED_DIRS = ("horovod_tpu/native/", "horovod_tpu/serve/")
+
+_SOCKET_EXCS = {"OSError", "ConnectionError", "socket.error",
+                "socket.timeout", "ConnectionResetError",
+                "BrokenPipeError", "ConnectionRefusedError"}
+
+#: evidence the handler routes through the resilience plane.
+ROUTED_TOKENS = ("resilience", "P2PConnError", "NativeConnError",
+                 "DispatchConnError", "_transient(", "_classify(",
+                 "is_retryable")
+
+
+def _names_socket_exc(node: ast.AST) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Tuple):
+        return any(_names_socket_exc(e) for e in node.elts)
+    dn = dotted_name(node)
+    return dn in _SOCKET_EXCS if dn else False
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        if not any(sf.path.startswith(d) for d in LINTED_DIRS):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _names_socket_exc(node.type):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            window = "\n".join(
+                sf.lines[node.lineno - 1:end])
+            if any(tok in window for tok in ROUTED_TOKENS):
+                continue
+            if sf.annotated(ANNOTATION, node.lineno, end):
+                continue
+            findings.append(sf.make_finding(
+                PASS_ID, node.lineno, "unclassified-socket-handler",
+                f"socket-error handler never consults the resilience "
+                f"classifier — route it through native/resilience.py "
+                f"(raise NativeConnError/P2PConnError/DispatchConnError "
+                f"or consult is_retryable) or mark "
+                f"'# resilience: exempt (<reason>)'"))
+    return findings

@@ -1,0 +1,288 @@
+"""Runtime lock-order witness — a cheap Python-level lockdep.
+
+Opt-in via ``HOROVOD_ANALYSIS_WITNESS=1``: ``threading.Lock`` /
+``threading.RLock`` *creation* inside ``horovod_tpu`` modules is
+instrumented (creation elsewhere — pytest, stdlib, user code — is left
+untouched, decided by the creating frame's filename). Every
+acquisition records *held -> acquired* edges on a global graph keyed
+by the lock's **creation site** (``serve/fleet.py:331``), the lockdep
+convention: two instances of one class attribute share a node, so an
+order inversion between *instances* is caught even when today's object
+graph happens not to deadlock. Same-site pairs (two replicas' queue
+locks held together) are deliberately not edges — ordering within one
+site is an instance-level property the static pass and this graph
+cannot judge.
+
+A cycle in the graph is an ABBA deadlock witnessed on a real
+execution: the static lock-order pass (:mod:`.locks`) proves the same
+invariant over names it can see; this witness validates it against
+real lock *objects*, through aliasing the static pass cannot follow.
+
+Wiring: ``horovod_tpu/__init__`` calls :func:`maybe_install` at import
+time, and ``tests/conftest.py`` installs + checks it around tier-1
+when the env knob is set, so
+
+.. code-block:: bash
+
+   HOROVOD_ANALYSIS_WITNESS=1 python -m pytest tests/test_serve_fleet.py tests/test_redist.py -q
+
+runs those thread-heavy suites under the witness and fails on any
+cycle. Overhead is one dict probe + list append per acquisition on
+instrumented locks only; uninstrumented locks pay nothing.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["install", "uninstall", "installed", "maybe_install",
+           "reset", "snapshot", "check", "violations",
+           "WitnessCycleError"]
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+#: module-global state, guarded by an UNTRACKED lock
+_state_lock = _REAL_LOCK()
+_installed = False
+_edges: Dict[Tuple[str, str], str] = {}      # (a, b) -> witness detail
+_graph: Dict[str, Set[str]] = {}
+_violations: List[str] = []
+_seen_cycles: Set[frozenset] = set()
+_tls = threading.local()
+
+
+class WitnessCycleError(AssertionError):
+    """Raised by :func:`check` when the witnessed graph has a cycle."""
+
+
+def _held_stack() -> List["_Tracked"]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = []
+        _tls.stack = st
+    return st
+
+
+def _creation_site() -> Optional[str]:
+    """Repo-relative ``file:line`` of the frame creating the lock, or
+    None when the creator is outside horovod_tpu."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if "analysis/witness" not in fn and "threading" not in fn:
+            break
+        f = f.f_back
+    if f is None:
+        return None
+    fn = f.f_code.co_filename.replace(os.sep, "/")
+    idx = fn.rfind("/horovod_tpu/")
+    if idx < 0:
+        return None
+    return f"{fn[idx + 1:]}:{f.f_lineno}"
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> dst over the current graph."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in sorted(_graph.get(node, ())):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquire(lk: "_Tracked") -> None:
+    st = _held_stack()
+    if any(h is lk for h in st):        # reentrant re-acquire: no edges
+        st.append(lk)
+        return
+    new_edges: List[Tuple[str, str]] = []
+    for h in st:
+        if h._site != lk._site:
+            new_edges.append((h._site, lk._site))
+    st.append(lk)
+    if not new_edges:
+        return
+    with _state_lock:
+        for a, b in new_edges:
+            if (a, b) in _edges:
+                continue
+            # adding a->b: a cycle exists iff b already reaches a
+            back = _find_path(b, a)
+            _edges[(a, b)] = threading.current_thread().name
+            _graph.setdefault(a, set()).add(b)
+            if back is not None:
+                cyc_nodes = frozenset(back)
+                if cyc_nodes in _seen_cycles:
+                    continue
+                _seen_cycles.add(cyc_nodes)
+                order = " -> ".join([a] + back)
+                _violations.append(
+                    f"lock-order cycle witnessed: {order} (edge "
+                    f"{a} -> {b} taken on thread "
+                    f"{threading.current_thread().name!r}; reverse "
+                    f"path {' -> '.join(back)} witnessed earlier)")
+
+
+def _note_release(lk: "_Tracked") -> None:
+    st = _held_stack()
+    for i in range(len(st) - 1, -1, -1):
+        if st[i] is lk:
+            del st[i]
+            return
+
+
+class _Tracked:
+    """Context-manager/acquire/release proxy over a real lock."""
+    __slots__ = ("_lk", "_site")
+
+    def __init__(self, lk, site: str):
+        self._lk = lk
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._lk.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._lk.release()
+        _note_release(self)
+
+    def __enter__(self) -> "_Tracked":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lk.locked()
+
+    # -- threading.Condition integration. Condition binds
+    #    _release_save/_acquire_restore/_is_owned when the lock has
+    #    them (RLock) and falls back to acquire/release otherwise
+    #    (plain Lock). Resolving through __getattr__ keeps that
+    #    AttributeError contract intact for plain locks while keeping
+    #    cond.wait()'s release/reacquire inside our held-stack
+    #    bookkeeping for RLocks.
+    def __getattr__(self, name: str):
+        lk = object.__getattribute__(self, "_lk")
+        if name == "_release_save":
+            real = lk._release_save      # AttributeError for plain Lock
+            me = self
+
+            def _release_save():
+                st = _held_stack()
+                n = sum(1 for h in st if h is me)
+                for _ in range(n):
+                    _note_release(me)
+                return (real(), n)
+            return _release_save
+        if name == "_acquire_restore":
+            real = lk._acquire_restore
+            me = self
+
+            def _acquire_restore(state):
+                real_state, n = state
+                real(real_state)
+                for _ in range(n):
+                    _note_acquire(me)
+            return _acquire_restore
+        return getattr(lk, name)
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._lk!r} from {self._site}>"
+
+
+def _make_factory(real):
+    def factory():
+        site = _creation_site()
+        lk = real()
+        if site is None or not _installed:
+            return lk
+        return _Tracked(lk, site)
+    return factory
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock`` to witness horovod_tpu locks.
+
+    Idempotent. Locks created BEFORE install (or via
+    ``from threading import Lock`` bindings captured earlier) stay
+    untracked — install as early as possible (package import time via
+    :func:`maybe_install`)."""
+    global _installed
+    with _state_lock:
+        if _installed:
+            return
+        _installed = True
+    threading.Lock = _make_factory(_REAL_LOCK)
+    threading.RLock = _make_factory(_REAL_RLOCK)
+
+
+def uninstall() -> None:
+    global _installed
+    with _state_lock:
+        _installed = False
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+
+
+def installed() -> bool:
+    return _installed
+
+
+def maybe_install() -> bool:
+    """Install iff HOROVOD_ANALYSIS_WITNESS=1 (the opt-in knob).
+
+    Read directly — this runs at ``horovod_tpu`` import time, before a
+    Config object can exist."""
+    from ..core.config import _env_bool
+    # knob: exempt (armed at package import, pre-Config; declared in
+    # core/config.py, and parsed with config's own _env_bool so the
+    # accepted spellings can never drift from the declared contract.
+    # The import above is function-level: tools/check.py imports this
+    # module through a stub package and must stay core-free.)
+    if _env_bool("HOROVOD_ANALYSIS_WITNESS", False):
+        install()
+        return True
+    return False
+
+
+def reset() -> None:
+    """Drop every recorded edge/violation (between test cases)."""
+    with _state_lock:
+        _edges.clear()
+        _graph.clear()
+        _violations.clear()
+        _seen_cycles.clear()
+
+
+def snapshot() -> Dict[str, List[str]]:
+    """The witnessed acquisition graph, JSON-shaped."""
+    with _state_lock:
+        return {a: sorted(bs) for a, bs in sorted(_graph.items())}
+
+
+def violations() -> List[str]:
+    with _state_lock:
+        return list(_violations)
+
+
+def check() -> None:
+    """Raise :class:`WitnessCycleError` if any cycle was witnessed."""
+    v = violations()
+    if v:
+        raise WitnessCycleError(
+            "runtime lock-order witness found cycle(s):\n" +
+            "\n".join(f"  - {x}" for x in v))

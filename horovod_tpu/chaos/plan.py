@@ -290,6 +290,9 @@ class ChaosPlan:
 
     @staticmethod
     def from_env() -> Optional["ChaosPlan"]:
+        # knob: exempt (config.validate() delegates its fail-fast parse
+        # HERE — the chaos plane is stdlib-only and routing this read
+        # back through Config would cycle)
         spec = os.environ.get("HOROVOD_CHAOS_PLAN")
         if not spec:
             return None

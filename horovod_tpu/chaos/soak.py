@@ -430,7 +430,10 @@ def _worker_main(out_dir: str) -> None:
 
     rank = int(os.environ.get("HOROVOD_RANK", "0"))
     epoch = int(os.environ.get("HOROVOD_CKPT_RESET_EPOCH", "0"))
+    # knob: exempt (driver->soak-worker process contract, not runtime
+    # config — the CLI (tools/soak.py) is the only writer)
     steps = int(os.environ.get("HVD_SOAK_STEPS", str(DEFAULT_STEPS)))
+    # knob: exempt (driver->soak-worker process contract, see above)
     commit_every = int(os.environ.get("HVD_SOAK_COMMIT_EVERY",
                                       str(DEFAULT_COMMIT_EVERY)))
     ev_path = os.path.join(out_dir, f"events.{rank}.jsonl")
@@ -458,6 +461,9 @@ def _worker_main(out_dir: str) -> None:
     from horovod_tpu.native.store import NativeError
     from horovod_tpu.native.store_comm import build_hybrid_comm
 
+    # knob: exempt (worker sizes its post-mortem wait from the SAME env
+    # the detector reads; building a Config here would re-validate the
+    # full knob surface inside a dying SIGTERM handler path)
     suspect_s = float(os.environ.get("HOROVOD_HEARTBEAT_SUSPECT_S",
                                      str(DEFAULT_HEARTBEAT_SUSPECT_S)))
 

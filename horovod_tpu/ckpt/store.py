@@ -317,6 +317,11 @@ def _plane_identity() -> Tuple[int, int, Optional[object]]:
     return 0, 1, None
 
 
+#: one help source for the three labeled children
+#: (metric-help lint)
+CKPT_BYTES_HELP = "checkpoint bytes moved"
+
+
 def _obs():
     """Lazy ckpt metric handles on the process registry (get-or-create:
     families are shared across manager instances)."""
@@ -331,14 +336,11 @@ def _obs():
         "restore": R.histogram("hvd_ckpt_restore_ms",
                                "checkpoint restore, read -> full tree"),
         "bytes_shard": R.counter("hvd_ckpt_bytes_total",
-                                 "checkpoint bytes moved",
-                                 {"kind": "shard"}),
+                                 CKPT_BYTES_HELP, {"kind": "shard"}),
         "bytes_replica": R.counter("hvd_ckpt_bytes_total",
-                                   "checkpoint bytes moved",
-                                   {"kind": "replica"}),
+                                   CKPT_BYTES_HELP, {"kind": "replica"}),
         "bytes_read": R.counter("hvd_ckpt_bytes_total",
-                                "checkpoint bytes moved",
-                                {"kind": "read"}),
+                                CKPT_BYTES_HELP, {"kind": "read"}),
     }
 
 

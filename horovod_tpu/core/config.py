@@ -61,6 +61,15 @@ def _env_float_strict(name: str, default: float) -> float:
         raise ValueError(f"{name} must be a number; got {v!r}")
 
 
+#: pre-Config read-site defaults, single-sourced: these knobs are also
+#: read directly (annotated) on paths where no Config exists yet — the
+#: binding plane (interop/_device_plane.py) and the elastic driver —
+#: and the default must not fork between the dataclass and those sites.
+DEVICE_PLANE_THRESHOLD_DEFAULT = 65536
+DEVICE_ALLTOALL_MIN_FILL_DEFAULT = 0.25
+ELASTIC_POLL_INTERVAL_S_DEFAULT = 1.0
+
+
 @dataclass
 class Config:
     """All runtime knobs. Defaults mirror the reference where one exists."""
@@ -255,6 +264,42 @@ class Config:
     # (HOROVOD_METRICS_TIMELINE_PERIOD; 0 disables). Only meaningful
     # while a timeline is active.
     metrics_timeline_period_s: float = 0.0
+    # Native timeline writer (HOROVOD_TIMELINE_NATIVE): the csrc
+    # stream-append writer behind Timeline; 0 falls back to the pure-
+    # python writer. Read at timeline start (timeline.py) — declared
+    # here so the knob registry + docs stay the single source.
+    timeline_native: bool = True
+    # Cross-host transport for the interop binding plane
+    # (HOROVOD_PLANE_P2P): 1 (default) forms the wire-optimal p2p ring,
+    # 0 falls back to the star-topology store comm (unroutable-peer
+    # networks). Env-driven ONLY and must match on every rank — a
+    # per-rank fallback would split one communicator across two
+    # transports and deadlock it (native/store_comm.py).
+    plane_p2p: bool = True
+    # Device plane for the torch/tf/keras bindings
+    # (HOROVOD_DEVICE_PLANE): "auto" activates only with TPU hardware
+    # attached; "1"/"jax"/"on" force it; "0"/"off" disable.
+    device_plane: str = "auto"
+    # Payload bytes past which binding-plane collectives stage onto the
+    # device mesh (HOROVOD_DEVICE_PLANE_THRESHOLD).
+    device_plane_threshold: int = DEVICE_PLANE_THRESHOLD_DEFAULT
+    # Global fill ratio the ragged alltoall must clear before riding
+    # the device mesh (HOROVOD_DEVICE_ALLTOALL_MIN_FILL) — pad-to-max
+    # inflates device traffic on skewed payloads.
+    device_alltoall_min_fill: float = DEVICE_ALLTOALL_MIN_FILL_DEFAULT
+    # Elastic driver discovery/worker poll period, seconds
+    # (HOROVOD_ELASTIC_POLL_INTERVAL_S). The chaos soak raises it so
+    # surviving workers get a full detection window before the reset.
+    elastic_poll_interval_s: float = ELASTIC_POLL_INTERVAL_S_DEFAULT
+    # Runtime lock-order witness (HOROVOD_ANALYSIS_WITNESS): 1
+    # instruments threading.Lock/RLock creation in horovod_tpu and
+    # fails tier-1 on a witnessed acquisition cycle
+    # (horovod_tpu/analysis/witness.py, docs/analysis.md).
+    analysis_witness: bool = False
+    # Profiler trace annotations around collectives
+    # (HOROVOD_DISABLE_NVTX_RANGES, mirroring the reference's NVTX
+    # switch; read lazily in ops/collective_ops.py profiler_range).
+    disable_nvtx_ranges: bool = False
     # Process sets (operations.cc:649 HOROVOD_DYNAMIC_PROCESS_SETS).
     dynamic_process_sets: bool = False
     # Grouped-op fusion (operations.cc:616 HOROVOD_DISABLE_GROUP_FUSION).
@@ -272,11 +317,14 @@ class Config:
     @staticmethod
     def from_env() -> "Config":
         c = Config()
-        mb = _env_float("HOROVOD_FUSION_THRESHOLD", -1.0)
+        mb = _env_float(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
+            "HOROVOD_FUSION_THRESHOLD", -1.0)
         if mb >= 0:
             c.fusion_threshold_bytes = int(mb)
-        c.cycle_time_ms = _env_float("HOROVOD_CYCLE_TIME", c.cycle_time_ms)
-        c.cache_capacity = _env_int("HOROVOD_CACHE_CAPACITY", c.cache_capacity)
+        c.cycle_time_ms = _env_float(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
+            "HOROVOD_CYCLE_TIME", c.cycle_time_ms)
+        c.cache_capacity = _env_int(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
+            "HOROVOD_CACHE_CAPACITY", c.cache_capacity)
         c.hierarchical_allreduce = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
         c.hierarchical_allreduce_set = \
@@ -288,17 +336,18 @@ class Config:
             "HOROVOD_ADASUM_HIERARCHICAL", c.adasum_hierarchical)
         c.autotune = _env_bool("HOROVOD_AUTOTUNE", c.autotune)
         c.autotune_log = os.environ.get("HOROVOD_AUTOTUNE_LOG", c.autotune_log)
-        c.autotune_warmup_samples = _env_int(
+        c.autotune_warmup_samples = _env_int(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
             "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", c.autotune_warmup_samples)
-        c.autotune_steps_per_sample = _env_int(
+        c.autotune_steps_per_sample = _env_int(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
             "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", c.autotune_steps_per_sample)
-        c.autotune_bayes_opt_max_samples = _env_int(
+        c.autotune_bayes_opt_max_samples = _env_int(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
             "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
             c.autotune_bayes_opt_max_samples)
-        noise = _env_float("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", -1.0)
+        noise = _env_float(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
+            "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", -1.0)
         if noise >= 0:
             c.autotune_gaussian_process_noise = noise
-        c.gloo_timeout_seconds = _env_float(
+        c.gloo_timeout_seconds = _env_float(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
             "HOROVOD_GLOO_TIMEOUT_SECONDS", c.gloo_timeout_seconds)
         c.log_with_timestamp = _env_bool(
             "HOROVOD_LOG_WITH_TIMESTAMP", c.log_with_timestamp)
@@ -307,14 +356,18 @@ class Config:
             "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)
         c.stall_check_disable = _env_bool(
             "HOROVOD_STALL_CHECK_DISABLE", c.stall_check_disable)
-        c.stall_warning_time_seconds = _env_float(
+        c.stall_warning_time_seconds = _env_float(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
             "HOROVOD_STALL_CHECK_TIME_SECONDS", c.stall_warning_time_seconds)
-        c.stall_shutdown_time_seconds = _env_float(
+        c.stall_shutdown_time_seconds = _env_float(  # knob: exempt (lenient by reference contract — horovod's env_parser falls back on malformed values for this legacy knob)
             "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", c.stall_shutdown_time_seconds)
         c.compression = os.environ.get(
             "HOROVOD_COMPRESSION", c.compression).strip().lower()
         c.compression_set = "HOROVOD_COMPRESSION" in os.environ
-        c.compression_block_size = _env_int(
+        # strict since the analysis plane landed: PR 1 documented this
+        # knob as fail-fast, but the parse was silently lenient — a
+        # typo'd block size fell back to 128 and changed every wire
+        # payload without a word (knob-registry lint finding)
+        c.compression_block_size = _env_int_strict(
             "HOROVOD_COMPRESSION_BLOCK_SIZE", c.compression_block_size)
         c.compression_dcn_only = _env_bool(
             "HOROVOD_COMPRESSION_DCN_ONLY", c.compression_dcn_only)
@@ -402,6 +455,22 @@ class Config:
         c.metrics_timeline_period_s = _env_float_strict(
             "HOROVOD_METRICS_TIMELINE_PERIOD", c.metrics_timeline_period_s)
         c.elastic_enabled = _env_bool("HOROVOD_ELASTIC", c.elastic_enabled)
+        c.timeline_native = _env_bool(
+            "HOROVOD_TIMELINE_NATIVE", c.timeline_native)
+        c.plane_p2p = _env_bool("HOROVOD_PLANE_P2P", c.plane_p2p)
+        c.device_plane = os.environ.get(
+            "HOROVOD_DEVICE_PLANE", c.device_plane).strip().lower()
+        c.device_plane_threshold = _env_int_strict(
+            "HOROVOD_DEVICE_PLANE_THRESHOLD", c.device_plane_threshold)
+        c.device_alltoall_min_fill = _env_float_strict(
+            "HOROVOD_DEVICE_ALLTOALL_MIN_FILL",
+            c.device_alltoall_min_fill)
+        c.elastic_poll_interval_s = _env_float_strict(
+            "HOROVOD_ELASTIC_POLL_INTERVAL_S", c.elastic_poll_interval_s)
+        c.analysis_witness = _env_bool(
+            "HOROVOD_ANALYSIS_WITNESS", c.analysis_witness)
+        c.disable_nvtx_ranges = _env_bool(
+            "HOROVOD_DISABLE_NVTX_RANGES", c.disable_nvtx_ranges)
         c.dynamic_process_sets = _env_bool(
             "HOROVOD_DYNAMIC_PROCESS_SETS", c.dynamic_process_sets)
         c.disable_group_fusion = _env_bool(
@@ -574,6 +643,28 @@ class Config:
                 f"HOROVOD_GLOO_TIMEOUT_SECONDS "
                 f"({self.gloo_timeout_seconds!r}) — the retry ladder "
                 f"may delay an escalation, never mask one")
+        if self.device_plane not in ("auto", "0", "off", "false", "no",
+                                     "1", "jax", "on", "true", "yes"):
+            raise ValueError(
+                f"HOROVOD_DEVICE_PLANE must be 'auto', an off value "
+                f"('0'|'off'|'false'|'no') or a force value "
+                f"('1'|'jax'|'on'|'true'|'yes'); got "
+                f"{self.device_plane!r}")
+        dpt = self.device_plane_threshold
+        if not isinstance(dpt, int) or dpt < 0:
+            raise ValueError(
+                f"HOROVOD_DEVICE_PLANE_THRESHOLD must be a non-negative "
+                f"byte count; got {dpt!r}")
+        mf = self.device_alltoall_min_fill
+        if not isinstance(mf, (int, float)) or not (0 <= mf <= 1):
+            raise ValueError(
+                f"HOROVOD_DEVICE_ALLTOALL_MIN_FILL must be a fill "
+                f"ratio in [0, 1]; got {mf!r}")
+        ep = self.elastic_poll_interval_s
+        if not isinstance(ep, (int, float)) or not (0 < ep <= 3600):
+            raise ValueError(
+                f"HOROVOD_ELASTIC_POLL_INTERVAL_S must be seconds in "
+                f"(0, 3600]; got {ep!r}")
         if self.chaos_plan is not None:
             # full fail-fast parse (schema + kind/site/schedule
             # validation) — chaos.plan is stdlib-only, no cycle

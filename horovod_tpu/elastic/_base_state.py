@@ -18,6 +18,14 @@ from __future__ import annotations
 import copy
 from typing import Any, Callable, Dict, List
 
+#: elastic recovery metric help — shared by the driver leg
+#: (driver.py) and the worker leg (run.py); single-sourced so the
+#: copies cannot drift (metric-help lint).
+RECOVERY_MS_HELP = ("elastic recovery: failure caught -> state "
+                    "re-synced on the new plane")
+LAST_RECOVERY_MS_HELP = "latency of the most recent elastic recovery"
+
+
 
 class BaseFrameworkState:
     """Subclasses implement `_save_payload() -> Any`,

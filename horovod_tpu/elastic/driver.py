@@ -23,6 +23,7 @@ from ..obs import metrics as obs_metrics
 from ..runner import exec as exec_lib
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from ..runner.http_kv import RendezvousServer, make_secret
+from ._base_state import LAST_RECOVERY_MS_HELP, RECOVERY_MS_HELP
 from .discovery import HostDiscoveryScript, HostManager
 
 logger = logging.getLogger("horovod_tpu")
@@ -77,12 +78,9 @@ class ElasticDriver:
         # workers launched (workers observe their own leg in
         # elastic/run.py under the same family)
         self._m_recovery = R.histogram(
-            "hvd_elastic_recovery_ms",
-            "elastic recovery: failure caught -> state re-synced on "
-            "the new plane")
+            "hvd_elastic_recovery_ms", RECOVERY_MS_HELP)
         self._m_last_recovery = R.gauge(
-            "hvd_elastic_last_recovery_ms",
-            "latency of the most recent elastic recovery")
+            "hvd_elastic_last_recovery_ms", LAST_RECOVERY_MS_HELP)
         self._reset_t0: Optional[float] = None
         self._m_host_events = {
             k: R.counter("hvd_elastic_host_events_total",
@@ -299,8 +297,12 @@ def run_elastic(args) -> int:
     # The chaos soak harness raises it so surviving workers get a full
     # detection window (name the dead rank, log, escalate) before the
     # driver's reset tears them down.
-    from ..core.config import _env_float
-    poll_interval = _env_float("HOROVOD_ELASTIC_POLL_INTERVAL_S", 1.0)
+    from ..core.config import (ELASTIC_POLL_INTERVAL_S_DEFAULT,
+                               _env_float_strict)
+    # knob: exempt (driver-process launcher leg — the knob is declared
+    # + validated in core/config.py; workers inherit it via the env)
+    poll_interval = _env_float_strict("HOROVOD_ELASTIC_POLL_INTERVAL_S",
+                                      ELASTIC_POLL_INTERVAL_S_DEFAULT)
     driver = ElasticDriver(
         discovery, args.command,
         min_np=args.min_np or 1, max_np=args.max_np,

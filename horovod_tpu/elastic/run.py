@@ -29,12 +29,11 @@ def _recovery_metrics():
     """(histogram, last-gauge) for elastic recovery latency — the
     fleet report's last-recovery view (obs/report.py)."""
     from ..obs import metrics as obs_metrics
+    from ._base_state import LAST_RECOVERY_MS_HELP, RECOVERY_MS_HELP
     R = obs_metrics.get_registry()
-    return (R.histogram("hvd_elastic_recovery_ms",
-                        "elastic recovery: failure caught -> state "
-                        "re-synced on the new plane"),
+    return (R.histogram("hvd_elastic_recovery_ms", RECOVERY_MS_HELP),
             R.gauge("hvd_elastic_last_recovery_ms",
-                    "latency of the most recent elastic recovery"))
+                    LAST_RECOVERY_MS_HELP))
 
 
 def run(func: Callable) -> Callable:

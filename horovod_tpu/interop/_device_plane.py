@@ -38,6 +38,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..core.config import (DEVICE_ALLTOALL_MIN_FILL_DEFAULT,
+                           DEVICE_PLANE_THRESHOLD_DEFAULT)
+
 logger = logging.getLogger("horovod_tpu")
 
 AXIS = "proc"
@@ -59,6 +62,8 @@ stats = {"allreduce": 0, "allgather": 0, "broadcast": 0,
 
 
 def _mode() -> str:
+    # knob: exempt (binding plane boots pre-Config; declared +
+    # validated in core/config.py as device_plane)
     return os.environ.get("HOROVOD_DEVICE_PLANE", "auto").strip().lower()
 
 
@@ -145,10 +150,14 @@ def _finish_init(rank: int, size: int) -> None:
         device=per_proc[rank],
         n=size,
         me=rank,
-        threshold=int(os.environ.get("HOROVOD_DEVICE_PLANE_THRESHOLD",
-                                     "65536")),
-        alltoall_min_fill=float(os.environ.get(
-            "HOROVOD_DEVICE_ALLTOALL_MIN_FILL", "0.25")),
+        # knob: exempt (binding plane boots pre-Config; both knobs are
+        # declared + validated in core/config.py, defaults shared)
+        threshold=int(os.environ.get(
+            "HOROVOD_DEVICE_PLANE_THRESHOLD",
+            str(DEVICE_PLANE_THRESHOLD_DEFAULT))),
+        alltoall_min_fill=float(os.environ.get(  # knob: exempt (see above)
+            "HOROVOD_DEVICE_ALLTOALL_MIN_FILL",
+            str(DEVICE_ALLTOALL_MIN_FILL_DEFAULT))),
     )
     logger.debug("device plane up: %d ranks over %s, threshold=%dB",
                  size, devs[0].platform, _state["threshold"])
@@ -167,10 +176,13 @@ def init_local(n: int) -> None:
     _state.update(active=True, mesh=Mesh(np.asarray(devs, dtype=object),
                                          (AXIS,)),
                   device=devs[0], n=n, me=0,
+                  # knob: exempt (dryrun leg, same contract as maybe_init)
                   threshold=int(os.environ.get(
-                      "HOROVOD_DEVICE_PLANE_THRESHOLD", "65536")),
-                  alltoall_min_fill=float(os.environ.get(
-                      "HOROVOD_DEVICE_ALLTOALL_MIN_FILL", "0.25")))
+                      "HOROVOD_DEVICE_PLANE_THRESHOLD",
+                      str(DEVICE_PLANE_THRESHOLD_DEFAULT))),
+                  alltoall_min_fill=float(os.environ.get(  # knob: exempt (see above)
+                      "HOROVOD_DEVICE_ALLTOALL_MIN_FILL",
+                      str(DEVICE_ALLTOALL_MIN_FILL_DEFAULT))))
 
 
 def shutdown() -> None:

@@ -52,7 +52,8 @@ def init(comm_name: Optional[str] = None, default_job: str = "local") -> None:
         _name = name
         from ..core.config import _env_bool
         cross_size = int(os.environ.get("HOROVOD_CROSS_SIZE", "1"))
-        force_store = _env_bool("HOROVOD_INTEROP_FORCE_STORE", False)
+        force_store = _env_bool(  # knob: exempt (test-only transport override, tests/test_multiprocess.py)
+            "HOROVOD_INTEROP_FORCE_STORE", False)
         if cross_size > 1 or force_store:
             from ..native.store_comm import build_hybrid_comm
             _comm = build_hybrid_comm(name, force_store=force_store)
@@ -85,6 +86,9 @@ def _tl():
     global _timeline
     if _timeline is None and not _timeline_stopped \
             and _rank == 0 and _size > 1:
+        # knob: exempt (binding plane starts its writer pre-hvd.init —
+        # no Config exists yet; the knob itself is declared in
+        # core/config.py as timeline_filename)
         fn = os.environ.get("HOROVOD_TIMELINE")
         if fn and fn.upper() != "DYNAMIC":
             from .. import timeline as timeline_mod

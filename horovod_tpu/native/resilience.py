@@ -325,15 +325,19 @@ def policy() -> RetryPolicy:
                                        _env_int_strict)
             import os
             rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+            # knob: exempt (stdlib-only fallback mirroring the Config
+            # defaults — core/config.py imports THIS module for
+            # default_budget_s, so reading Config here would cycle)
             gloo = _env_float("HOROVOD_GLOO_TIMEOUT_SECONDS", 300.0)
             _POLICY = RetryPolicy(
-                retries=_env_int_strict("HOROVOD_NET_RETRIES",
-                                        DEFAULT_RETRIES),
-                backoff_base_ms=_env_float_strict(
+                retries=_env_int_strict(  # knob: exempt (see gloo above)
+                    "HOROVOD_NET_RETRIES", DEFAULT_RETRIES),
+                backoff_base_ms=_env_float_strict(  # knob: exempt (see above)
                     "HOROVOD_NET_BACKOFF_BASE_MS",
                     DEFAULT_BACKOFF_BASE_MS),
-                budget_s=_env_float_strict("HOROVOD_NET_RETRY_BUDGET_S",
-                                           default_budget_s(gloo)),
+                budget_s=_env_float_strict(  # knob: exempt (see above)
+                    "HOROVOD_NET_RETRY_BUDGET_S",
+                    default_budget_s(gloo)),
                 rank=rank)
         return _POLICY
 

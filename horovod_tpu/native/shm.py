@@ -140,6 +140,9 @@ class ShmComm:
             # this (a peer stalled in compile/data beyond it kills the
             # job) is HOROVOD_GLOO_TIMEOUT_SECONDS (launch.py:56)
             from ..core.config import _env_float
+            # knob: exempt (native-plane default when no timeout is
+            # passed; the knob is declared in core/config.py — this
+            # jax-free path cannot assume an initialized Config)
             timeout = _env_float("HOROVOD_GLOO_TIMEOUT_SECONDS", 60.0)
         self._lib = lib()
         self.rank, self.size, self.timeout = rank, size, timeout

@@ -56,6 +56,9 @@ class StoreComm:
             # reference HOROVOD_GLOO_TIMEOUT_SECONDS (launch.py:56):
             # the collective-op stall bound, shared with the shm plane
             from ..core.config import _env_float
+            # knob: exempt (native-plane default when no timeout is
+            # passed; declared in core/config.py — jax-free path with
+            # no initialized Config)
             timeout = _env_float("HOROVOD_GLOO_TIMEOUT_SECONDS", 300.0)
         ip = socket.gethostbyname(host)
         self._c = Coordinator(ip, port, rank, size, timeout=timeout)
@@ -325,6 +328,9 @@ def build_hybrid_comm(name_base: str, *, force_store: bool = False):
         carries the shm generation token so a restarted incarnation can
         never dial a previous round's stale address."""
         from ..core.config import _env_bool
+        # knob: exempt (declared in core/config.py as plane_p2p; the
+        # binding plane builds its comm pre-Config, and the choice must
+        # come from the env EVERY rank shares — see docstring)
         if xs > 1 and _env_bool("HOROVOD_PLANE_P2P", True):
             from .p2p import RingComm
             gen = os.environ.get("HOROVOD_SHM_GEN", "1")

@@ -93,9 +93,9 @@ def profiler_range(name: str):
     NVTX switch)."""
     global _profiler_disabled
     if _profiler_disabled is None:
-        import os
-        _profiler_disabled = os.environ.get(
-            "HOROVOD_DISABLE_NVTX_RANGES", "").strip() in ("1", "true")
+        from ..core.config import _env_bool
+        _profiler_disabled = _env_bool(  # knob: exempt (lazy one-shot read on the hot path; declared in core/config.py)
+            "HOROVOD_DISABLE_NVTX_RANGES", False)
     if _profiler_disabled:
         return _NULL_RANGE
     return jax.profiler.TraceAnnotation(name)

@@ -67,6 +67,9 @@ def _chunk_bytes(override: Optional[int]) -> int:
     except Exception:  # noqa: BLE001 — config must never block a move
         pass
     import os
+    # knob: exempt (jax-free standalone fallback — tools/weights_push.py
+    # runs this module with no initialized plane; the live path above
+    # reads the round-synchronized Config)
     v = os.environ.get("HOROVOD_REDIST_CHUNK_BYTES")
     return int(v) if v else DEFAULT_CHUNK_BYTES
 

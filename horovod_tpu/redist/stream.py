@@ -256,6 +256,11 @@ class WeightSubscriber:
         from ..native.store import NativeTimeout
         with self._plock:
             try:
+                # lock-order: exempt (_plock EXISTS to serialize this
+                # one KV socket between the batcher adoption thread and
+                # the router's re-admission gate; nothing else is
+                # guarded by it, so holding it across the bounded
+                # poll_timeout read is its entire job — PR 11)
                 raw = self._kv.get(version_key(self.channel),
                                    timeout=self.poll_timeout)
                 return int(raw.decode())

@@ -59,6 +59,22 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ..chaos import inject as _chaos
 from ..chaos.detector import AccrualTracker
 from ..obs import metrics as obs_metrics
+
+#: metric help strings shared with the multi-process router
+#: (proc_fleet.py) — single-sourced so the copies cannot drift
+#: (metric-help lint; the Retry-After rounding drifted between copies
+#: once already, same failure mode).
+REPLICA_UP_HELP = "1 while this replica is admitted to the fleet"
+FAILOVERS_HELP = ("replicas ejected (heartbeat suspicion or dead "
+                  "scheduler)")
+REQUEUED_HELP = "in-flight requests re-enqueued off an ejected replica"
+FLEET_REJECTED_HELP = ("requests rejected fleet-wide (always with "
+                       "retry_after_ms)")
+ROUTER_MS_HELP = ("router leg latency: dispatch (pick+enqueue) and e2e "
+                  "(submit -> resolution)")
+FAILOVER_MS_HELP = ("replica death -> ejection + in-flight re-enqueued "
+                    "(ms)")
+
 from .batcher import ContinuousBatcher
 from .queue import AdmissionQueue, AdmitDropped, Rejected, ServeHandle
 
@@ -320,27 +336,20 @@ class FleetRouter:
                     "hvd_serve_router_ms", "hvd_serve_failover_ms"):
             R.unregister(fam)
         self._m_up = {
-            r: R.gauge("hvd_serve_replica_up",
-                       "1 while this replica is admitted to the fleet",
+            r: R.gauge("hvd_serve_replica_up", REPLICA_UP_HELP,
                        {"replica": str(r)}) for r in ids}
         self._m_failovers = R.counter(
-            "hvd_serve_failovers_total",
-            "replicas ejected (heartbeat suspicion or dead scheduler)")
+            "hvd_serve_failovers_total", FAILOVERS_HELP)
         self._m_requeued = R.counter(
-            "hvd_serve_requeued_total",
-            "in-flight requests re-enqueued off an ejected replica")
+            "hvd_serve_requeued_total", REQUEUED_HELP)
         self._m_rejected = R.counter(
-            "hvd_serve_fleet_rejected_total",
-            "requests rejected fleet-wide (always with retry_after_ms)")
+            "hvd_serve_fleet_rejected_total", FLEET_REJECTED_HELP)
         self._m_router = {
             leg: R.histogram(
-                "hvd_serve_router_ms",
-                "router leg latency: dispatch (pick+enqueue) and e2e "
-                "(submit -> resolution)", {"leg": leg})
+                "hvd_serve_router_ms", ROUTER_MS_HELP, {"leg": leg})
             for leg in ("dispatch", "e2e")}
         self._m_failover_ms = R.histogram(
-            "hvd_serve_failover_ms",
-            "replica death -> ejection + in-flight re-enqueued (ms)")
+            "hvd_serve_failover_ms", FAILOVER_MS_HELP)
 
     # -- events --------------------------------------------------------------
     def add_listener(self, fn: Callable[[dict], None]) -> None:

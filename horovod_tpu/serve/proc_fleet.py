@@ -59,7 +59,9 @@ from ..chaos.detector import AccrualTracker
 from ..native import resilience
 from ..obs import metrics as obs_metrics
 from . import wire
-from .fleet import FleetHandle, _Tracked
+from .fleet import (FAILOVER_MS_HELP, FAILOVERS_HELP,
+                    FLEET_REJECTED_HELP, FleetHandle, REPLICA_UP_HELP,
+                    REQUEUED_HELP, ROUTER_MS_HELP, _Tracked)
 from .queue import Rejected
 
 logger = logging.getLogger("horovod_tpu")
@@ -223,27 +225,20 @@ class ProcessFleetRouter:
                     "hvd_serve_fleet_capacity"):
             R.unregister(fam)
         self._m_up = {
-            r: R.gauge("hvd_serve_replica_up",
-                       "1 while this replica is admitted to the fleet",
+            r: R.gauge("hvd_serve_replica_up", REPLICA_UP_HELP,
                        {"replica": str(r)}) for r in ids}
         self._m_failovers = R.counter(
-            "hvd_serve_failovers_total",
-            "replicas ejected (heartbeat suspicion or dead scheduler)")
+            "hvd_serve_failovers_total", FAILOVERS_HELP)
         self._m_requeued = R.counter(
-            "hvd_serve_requeued_total",
-            "in-flight requests re-enqueued off an ejected replica")
+            "hvd_serve_requeued_total", REQUEUED_HELP)
         self._m_rejected = R.counter(
-            "hvd_serve_fleet_rejected_total",
-            "requests rejected fleet-wide (always with retry_after_ms)")
+            "hvd_serve_fleet_rejected_total", FLEET_REJECTED_HELP)
         self._m_router = {
             leg: R.histogram(
-                "hvd_serve_router_ms",
-                "router leg latency: dispatch (pick+enqueue) and e2e "
-                "(submit -> resolution)", {"leg": leg})
+                "hvd_serve_router_ms", ROUTER_MS_HELP, {"leg": leg})
             for leg in ("dispatch", "e2e")}
         self._m_failover_ms = R.histogram(
-            "hvd_serve_failover_ms",
-            "replica death -> ejection + in-flight re-enqueued (ms)")
+            "hvd_serve_failover_ms", FAILOVER_MS_HELP)
         self._m_respawns = R.counter(
             "hvd_serve_respawns_total",
             "replica worker processes respawned after ejection")

@@ -59,7 +59,12 @@ class Timeline:
         if self._running:
             return
         self._running = True
-        if os.environ.get("HOROVOD_TIMELINE_NATIVE", "1") != "0":
+        from .core.config import _env_bool
+        # knob: exempt (read at writer start — timelines outlive and
+        # predate Config instances (interop plane); declared in
+        # core/config.py as timeline_native and parsed with config's
+        # own _env_bool so the spellings cannot drift)
+        if _env_bool("HOROVOD_TIMELINE_NATIVE", True):
             try:
                 from . import native
                 lib = native.lib()

@@ -40,3 +40,22 @@ def hvd():
     hvd.init()
     yield hvd
     hvd.shutdown()
+
+
+# --------------------------------------------------------------------------
+# runtime lock-order witness (docs/analysis.md): opt-in via
+#   HOROVOD_ANALYSIS_WITNESS=1 python -m pytest tests/... -q
+# Locks created by horovod_tpu modules are instrumented for the whole
+# session (armed at horovod_tpu import, above); the teardown assertion
+# fails the run on any witnessed acquisition cycle.
+# --------------------------------------------------------------------------
+from horovod_tpu.core.config import _env_bool as _hvd_env_bool  # noqa: E402
+
+if _hvd_env_bool("HOROVOD_ANALYSIS_WITNESS", False):
+    from horovod_tpu.analysis import witness as _witness
+    _witness.install()
+
+    @pytest.fixture(scope="session", autouse=True)
+    def _lock_order_witness():
+        yield
+        _witness.check()   # raises WitnessCycleError on a cycle

@@ -1,0 +1,20 @@
+"""Seeded-bad fixture: collective entries control-dependent on
+rank-local sources (the PR 4 deadlock shape). Every call below MUST be
+flagged by the collective-divergence pass."""
+import os
+import time
+
+
+class Committer:
+    def commit(self, step):
+        if os.path.exists(self.path):          # divergent FS visibility
+            self.coordinator.allgather(b"probe")
+
+    def vote(self):
+        flag = os.environ.get("FIXTURE_FLAG")
+        if flag:                               # one-hop env taint
+            self.coordinator.reduce(1, kind="and")
+
+    def deadline(self):
+        while time.time() < self.t_end:        # wall-clock condition
+            self.ring.shift(b"x")

@@ -1,0 +1,11 @@
+"""Seeded-bad fixture: an undeclared knob read and a config bypass of
+a declared knob. Both MUST be flagged by the knob-registry pass."""
+import os
+
+
+def config_from_thin_air():
+    return os.environ.get("HOROVOD_FIXTURE_UNDECLARED")
+
+
+def bypass():
+    return os.environ["HOROVOD_FIXTURE_DECLARED"]

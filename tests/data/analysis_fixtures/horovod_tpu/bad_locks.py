@@ -1,0 +1,28 @@
+"""Seeded-bad fixture: a blocking call under a held lock AND an ABBA
+acquisition-order cycle. Both MUST be flagged by the lock-order pass."""
+import threading
+import time
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)
+
+    def bad_recv(self, sock):
+        with self._lock:
+            return sock.recv(4096)
+
+    def order_ab(self):
+        with self._lock:
+            with self._aux_lock:
+                pass
+
+    def order_ba(self):
+        with self._aux_lock:
+            with self._lock:
+                pass

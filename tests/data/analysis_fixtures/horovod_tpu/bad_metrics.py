@@ -1,0 +1,9 @@
+"""Seeded-bad fixture: duplicated literal help strings and an
+undocumented metric family. Both MUST be flagged by metric-help."""
+
+
+def setup(R):
+    a = R.counter("fixture_dup_total", "bytes moved")
+    b = R.counter("fixture_dup_total", "bytes moved (drifting copy)")
+    c = R.gauge("fixture_undoc_gauge", "a family docs never mention")
+    return a, b, c

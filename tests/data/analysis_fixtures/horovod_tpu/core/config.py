@@ -1,0 +1,20 @@
+"""Fixture config registry: one documented knob, one lenient parse
+(flagged), one declared-but-undocumented knob (flagged)."""
+import os
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def from_env():
+    a = os.environ.get("HOROVOD_FIXTURE_DECLARED", "1")
+    b = _env_int("HOROVOD_FIXTURE_LENIENT", 3)
+    c = os.environ.get("HOROVOD_FIXTURE_UNDOCUMENTED")
+    return a, b, c

@@ -1,0 +1,19 @@
+"""Annotated twin: the same shapes carrying rank-invariance reasons
+(or genuinely rank-invariant conditions). MUST produce zero findings."""
+import os
+
+
+class Committer:
+    def commit(self, step):
+        # rank-invariant: the probe result is allgathered and voted on
+        # below; every rank enters the round regardless of its local view
+        if os.path.exists(self.path):
+            self.coordinator.allgather(b"probe")
+
+    def sized(self):
+        if self.world > 1:                     # rank-invariant input
+            self.coordinator.allgather(b"probe")
+
+    def annotated_call(self):
+        if os.environ.get("FIXTURE_FLAG"):
+            self.coordinator.barrier()  # rank-invariant: flag exported by the launcher to every rank identically

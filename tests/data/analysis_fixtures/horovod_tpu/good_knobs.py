@@ -1,0 +1,12 @@
+"""Annotated twin. MUST produce zero findings."""
+import os
+
+
+def wiring_is_fine():
+    return os.environ.get("HOROVOD_RANK", "0")
+
+
+def annotated():
+    # knob: exempt (fixture twin — worker-side read of its process
+    # contract, the launcher is the only writer)
+    return os.environ.get("HOROVOD_FIXTURE_UNDECLARED")

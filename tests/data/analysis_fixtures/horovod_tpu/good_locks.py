@@ -1,0 +1,30 @@
+"""Annotated twin: the blocking call carries its exemption reason and
+the two locks keep ONE global order. MUST produce zero findings."""
+import threading
+import time
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+
+    def ok_sleep(self):
+        with self._lock:
+            # lock-order: exempt (fixture twin — the pause is bounded
+            # and nothing else contends this lock during setup)
+            time.sleep(0.1)
+
+    def order_ab(self):
+        with self._lock:
+            with self._aux_lock:
+                pass
+
+    def order_ab_again(self):
+        with self._lock:
+            with self._aux_lock:
+                pass
+
+    def cond_wait_is_fine(self):
+        with self._cv:
+            self._cv.wait(timeout=1.0)

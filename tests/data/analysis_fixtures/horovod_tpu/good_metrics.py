@@ -1,0 +1,11 @@
+"""Annotated twin: one help source (shared constant / get-or-create
+with empty help) and documented names. MUST produce zero findings."""
+
+GOOD_HELP = "bytes moved"
+
+
+def setup(R):
+    a = R.counter("fixture_good_total", GOOD_HELP)
+    b = R.counter("fixture_good_total", GOOD_HELP)
+    c = R.counter("fixture_good_total")
+    return a, b, c

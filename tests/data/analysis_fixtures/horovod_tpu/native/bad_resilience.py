@@ -1,0 +1,10 @@
+"""Seeded-bad fixture: an unclassified socket-error handler on the
+wire plane. MUST be flagged by the resilience pass."""
+import socket
+
+
+def read_one(sock):
+    try:
+        return sock.recv(1)
+    except OSError:
+        return None

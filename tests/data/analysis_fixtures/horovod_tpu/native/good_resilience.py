@@ -1,0 +1,16 @@
+"""Annotated twin: classified or exempted handlers. MUST pass."""
+import socket
+
+
+def read_one(sock, _classify):
+    try:
+        return sock.recv(1)
+    except OSError as e:
+        raise _classify(e)
+
+
+def teardown(sock):
+    try:
+        sock.close()
+    except OSError:  # resilience: exempt (teardown of a dying socket)
+        pass

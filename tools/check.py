@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Run the horovod_tpu static-analysis plane (horovod_tpu/analysis/).
+
+Standalone by design: loads the analysis package WITHOUT importing
+``horovod_tpu/__init__`` (which drags in jax), the same trick
+``tools/ckpt_inspect.py`` uses — this runs on any box with a bare
+python, CI included.
+
+Usage::
+
+    python tools/check.py                      # all passes, gate mode
+    python tools/check.py --pass lock-order,knob-registry
+    python tools/check.py --update-baseline    # grandfather current findings
+    python tools/check.py --baseline /dev/null # ignore the baseline
+    python tools/check.py --list               # pass catalog
+
+Exit status: 0 when every finding is suppressed (annotation or
+baseline), 1 when unsuppressed findings remain, 2 on usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "analysis_baseline.json")
+
+
+def _load_analysis():
+    """Import horovod_tpu.analysis without executing horovod_tpu/__init__
+    (jax-free contract)."""
+    if "horovod_tpu" not in sys.modules:
+        stub = types.ModuleType("horovod_tpu")
+        stub.__path__ = [os.path.join(REPO, "horovod_tpu")]
+        stub.__package__ = "horovod_tpu"
+        sys.modules["horovod_tpu"] = stub
+    return importlib.import_module("horovod_tpu.analysis")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check.py",
+        description="repo-native static-analysis gate")
+    ap.add_argument("--pass", dest="passes", default="",
+                    help="comma-separated pass ids (default: all)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of grandfathered finding keys")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--root", default=REPO,
+                    help="repo root to analyze (default: this repo)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the pass catalog and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the per-pass summary")
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+
+    if args.list:
+        for p in analysis.ALL_PASSES:
+            print(f"{p.PASS_ID:22s} [# {p.ANNOTATION}: ...]  "
+                  f"{p.DESCRIPTION}")
+        return 0
+
+    if args.passes:
+        passes = []
+        for pid in args.passes.split(","):
+            pid = pid.strip()
+            if pid not in analysis.PASS_BY_ID:
+                print(f"check.py: unknown pass {pid!r}; known: "
+                      f"{', '.join(analysis.PASS_BY_ID)}",
+                      file=sys.stderr)
+                return 2
+            passes.append(analysis.PASS_BY_ID[pid])
+    else:
+        passes = list(analysis.ALL_PASSES)
+
+    baseline = set()
+    if not args.update_baseline:
+        try:
+            baseline = analysis.load_baseline(args.baseline)
+        except (ValueError, OSError) as e:
+            print(f"check.py: bad baseline: {e}", file=sys.stderr)
+            return 2
+
+    t0 = time.time()
+    unsuppressed, results = analysis.run_passes(
+        args.root, passes, baseline=baseline)
+    dt = time.time() - t0
+
+    if args.update_baseline:
+        kept = []
+        if args.passes:
+            # partial update: preserve grandfathered entries belonging
+            # to passes that did NOT run — only the selected passes'
+            # slices are rewritten (keys are "pass_id|..."-prefixed)
+            ran = {p.PASS_ID for p in passes}
+            kept = [e for e in
+                    analysis.core.read_baseline_entries(args.baseline)
+                    if e["key"].split("|", 1)[0] not in ran]
+        analysis.write_baseline(args.baseline, unsuppressed,
+                                keep_entries=kept)
+        print(f"check.py: baseline updated with "
+              f"{len(unsuppressed)} finding(s) "
+              f"(+{len(kept)} kept from other passes) -> "
+              f"{args.baseline}")
+        return 0
+
+    for f in sorted(unsuppressed, key=lambda f: (f.path, f.line)):
+        print(f.render())
+    if not args.quiet:
+        for r in results:
+            extra = (f" ({len(r.suppressed)} baselined)"
+                     if r.suppressed else "")
+            print(f"# {r.pass_id}: {len(r.findings)} finding(s){extra}")
+        print(f"# {len(passes)} pass(es) over {args.root} in {dt:.1f}s")
+    if unsuppressed:
+        print(f"check.py: {len(unsuppressed)} unsuppressed finding(s) — "
+              f"fix, annotate (see docs/analysis.md), or "
+              f"--update-baseline", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:      # | head
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(1)
