@@ -365,6 +365,199 @@ def run_fleet_benchmark() -> int:
         return 1
 
 
+def run_disagg_benchmark() -> int:
+    """Disaggregation acceptance GATE (`bench.py --serve-disagg`):
+    p99 TTFT under mixed long-prompt/short-decode overload —
+    DISAGGREGATED pools (1 prefill + 1 decode worker process,
+    serve/disagg.py) vs the COLOCATED process fleet (2 workers,
+    serve/proc_fleet.py) at matched process count, matched model,
+    matched traffic.
+
+    Traffic: enough closed-loop background clients to keep every
+    COLOCATED row/block busy with long-prompt + long-decode
+    generations (the head-of-line pressure: a colocated replica's
+    rows and pool blocks are held hostage for a WHOLE generation, so
+    a new prompt waits out someone else's decode tail before it can
+    even prefill), while a probe stream submits short 1-token
+    requests whose e2e latency IS time-to-first-token in both
+    systems. In the disaggregated fleet probes resolve entirely in
+    the prefill pool — whose rows turn over at prefill+migrate speed,
+    never held for a generation — which is exactly the DistServe
+    separation claim, measured.
+
+    Gate (exit nonzero on violation, each verdict a JSON line):
+
+      * p99 TTFT ratio disagg/colocated <=
+        HVD_BENCH_DISAGG_TTFT_BAR (default 1.0 — disaggregation must
+        BEAT colocated under this overload);
+      * zero silent drops on BOTH sides: every submitted request
+        reached a terminal state (sheds carry retry_after_ms);
+      * the disagg leg actually migrated (long requests crossed
+        pools) and answered its long requests.
+    """
+    import threading
+
+    import numpy as np
+
+    try:
+        from horovod_tpu.native.store import StoreServer
+        from horovod_tpu.serve.disagg import DisaggRouter
+        from horovod_tpu.serve.proc_fleet import ProcessFleetRouter
+        from horovod_tpu.serve.queue import Rejected
+
+        bar = float(os.environ.get("HVD_BENCH_DISAGG_TTFT_BAR", "1.0"))
+        duration_s = float(os.environ.get(
+            "HVD_BENCH_DISAGG_DURATION_S", "12"))
+        # 8 long clients x (24-token prompt + 24-token budget) pin all
+        # 2x4 colocated rows (and their worst-case block
+        # reservations) for whole generations — the overload the
+        # split exists for
+        n_long = int(os.environ.get("HVD_BENCH_DISAGG_LONG_CLIENTS",
+                                    "8"))
+        long_len, long_new = 24, 24
+        worker = {
+            "builder": "horovod_tpu.serve.worker:tiny_gpt_builder",
+            "builder_kwargs": {"seed": 0, "paged": True,
+                               "kv_pool_blocks": 48},
+            "buckets": [8, 32], "max_queue": 64,
+            "deadline_ms": 20000.0, "kv_crc": False, "spec_k": 0,
+            "prefix_cache": False}
+        # per-pool sizing is the POINT of disaggregation: the prefill
+        # worker is provisioned for admission throughput (wide batch,
+        # rows turn over at prefill+migrate speed; parked sequences
+        # stage here while decode capacity frees), the decode worker
+        # for resident capacity — total chip-equivalent budget stays
+        # comparable to the 2-worker colocated fleet
+        prefill_worker = dict(worker, builder_kwargs={
+            "seed": 0, "paged": True, "max_batch": 8,
+            "kv_pool_blocks": 96})
+
+        def drive(router) -> dict:
+            stop = threading.Event()
+            lock = threading.Lock()
+            probes, longs = [], []
+
+            def long_client(cid):
+                rng = np.random.RandomState(100 + cid)
+                while not stop.is_set():
+                    prompt = list(rng.randint(1, 64, long_len))
+                    try:
+                        h = router.submit(prompt,
+                                          max_new_tokens=long_new)
+                    except Rejected as e:
+                        with lock:
+                            longs.append("shed")
+                        time.sleep(min((e.retry_after_ms or 100.0),
+                                       300.0) / 1000.0)
+                        continue
+                    h.wait(timeout=25.0)
+                    with lock:
+                        longs.append(h.status if h.done()
+                                     else "pending")
+
+            def probe_client():
+                rng = np.random.RandomState(999)
+                while not stop.is_set():
+                    prompt = list(rng.randint(1, 64, 4))
+                    t0 = time.monotonic()
+                    try:
+                        h = router.submit(prompt, max_new_tokens=1)
+                    except Rejected:
+                        with lock:
+                            probes.append(("shed", None))
+                        time.sleep(0.1)
+                        continue
+                    h.wait(timeout=25.0)
+                    ms = (time.monotonic() - t0) * 1000.0
+                    with lock:
+                        probes.append((h.status if h.done()
+                                       else "pending", ms))
+                    time.sleep(0.04)
+
+            threads = [threading.Thread(target=long_client, args=(c,),
+                                        daemon=True)
+                       for c in range(n_long)]
+            threads.append(threading.Thread(target=probe_client,
+                                            daemon=True))
+            for t in threads:
+                t.start()
+            time.sleep(duration_s)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            oks = sorted(ms for st, ms in probes
+                         if st == "ok" and ms is not None)
+            p99 = (oks[min(len(oks) - 1, int(0.99 * len(oks)))]
+                   if len(oks) >= 20 else None)
+            return {
+                "probe_p99_ms": None if p99 is None else round(p99, 1),
+                "probe_ok": len(oks),
+                "probe_statuses": {
+                    s: sum(1 for st, _ in probes if st == s)
+                    for s in {st for st, _ in probes}},
+                "long_statuses": {
+                    s: longs.count(s) for s in set(longs)},
+                "silent_drops": (
+                    sum(1 for st, _ in probes if st == "pending")
+                    + longs.count("pending")),
+            }
+
+        srv = StoreServer()
+        try:
+            colo = ProcessFleetRouter(
+                2, kv_addr="127.0.0.1", kv_port=srv.port,
+                worker=worker, ns="benchcolo", suspect_s=3.0).start()
+            try:
+                colo_r = drive(colo)
+            finally:
+                colo.close()
+            dis = DisaggRouter(
+                1, 1, kv_addr="127.0.0.1", kv_port=srv.port,
+                prefill_worker=prefill_worker, decode_worker=worker,
+                ns="benchdis", suspect_s=3.0).start()
+            try:
+                dis_r = drive(dis)
+                migrations = int(
+                    dis.stats().get("migrate_bytes") or 0)
+            finally:
+                dis.close()
+        finally:
+            srv.close()
+
+        ratio = None
+        if colo_r["probe_p99_ms"] and dis_r["probe_p99_ms"]:
+            ratio = round(dis_r["probe_p99_ms"]
+                          / colo_r["probe_p99_ms"], 3)
+        gates = {
+            "ttft_ratio_under_bar": ratio is not None
+            and ratio <= bar,
+            "no_silent_drops": (colo_r["silent_drops"] == 0
+                                and dis_r["silent_drops"] == 0),
+            "migrations_happened": migrations > 0,
+            "longs_answered": dis_r["long_statuses"].get("ok", 0) > 0,
+        }
+        common = {"bar": bar, "duration_s": duration_s,
+                  "long_clients": n_long,
+                  "colocated": colo_r, "disagg": dis_r,
+                  "migrate_bytes": migrations, "gates": gates}
+        print(json.dumps({
+            "metric": "disagg_ttft_p99_ms",
+            "value": dis_r["probe_p99_ms"], "unit": "ms", **common}),
+            flush=True)
+        print(json.dumps({
+            "metric": "disagg_ttft_ratio_vs_colocated",
+            "value": ratio, "unit": "ratio", **common}), flush=True)
+        return 0 if all(gates.values()) else 1
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        for metric, unit in (("disagg_ttft_p99_ms", "ms"),
+                             ("disagg_ttft_ratio_vs_colocated",
+                              "ratio")):
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": unit, "error": str(e)[-500:]}),
+                  flush=True)
+        return 1
+
+
 def run_serve_benchmark() -> int:
     """Serving acceptance GATE (`bench.py --serve`): the ROADMAP item 2
     bars, asserted — not just reported. One workload (a long shared
@@ -1223,6 +1416,9 @@ if __name__ == "__main__":
     elif "--serve-fleet" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE_FLEET") == "1":
         sys.exit(run_fleet_benchmark())
+    elif "--serve-disagg" in sys.argv or \
+            os.environ.get("HVD_BENCH_SERVE_DISAGG") == "1":
+        sys.exit(run_disagg_benchmark())
     elif "--kernel-parity" in sys.argv or \
             os.environ.get("HVD_BENCH_KERNEL_PARITY") == "1":
         sys.exit(run_kernel_parity())

@@ -76,7 +76,20 @@ FAULT_SITES = ("step", "store.request", "p2p.send", "p2p.recv",
                # (peer), where conn_reset/flaky sever the live dispatch
                # socket and the native/resilience.py ladder must absorb
                # the blip WITHOUT a failover.
-               "serve.proc", "serve.dispatch")
+               "serve.proc", "serve.dispatch",
+               # disaggregated serving (serve/disagg.py +
+               # serve/kv_migrate.py): serve.migrate fires in the
+               # PREFILL worker process on its KV-block push to one
+               # decode replica (peer = the decode replica id;
+               # "at"/"after"/"until" count that worker's own migration
+               # attempts). conn_reset severs the migration socket
+               # AFTER the kv_install frame landed (the decode side
+               # installed; the ladder replay must be served the
+               # deduped install ack), corrupt flips one payload bit
+               # BEFORE framing so the per-block crc ledger — not the
+               # frame crc — must catch it on arrival, drop loses the
+               # push before it is sent, delay sleeps.
+               "serve.migrate")
 
 #: which kinds are meaningful at which sites (a drop needs a connection
 #: to sever; a torn write needs a shard file; a KV corruption needs a
@@ -96,9 +109,9 @@ _KIND_SITES = {
                    if not s.startswith("serve.")) + ("serve.step",
                                                      "serve.proc"),
     "drop": ("store.request", "p2p.send", "p2p.recv",
-             "redist.transport", "serve.admit"),
+             "redist.transport", "serve.admit", "serve.migrate"),
     "corrupt": ("store.request", "p2p.send", "redist.transport",
-                "serve.kv"),
+                "serve.kv", "serve.migrate"),
     "partition": ("store.request", "p2p.send", "p2p.recv",
                   "redist.transport", "serve.route"),
     "torn_write": ("ckpt.write",),
@@ -107,9 +120,10 @@ _KIND_SITES = {
     # them: the store/coordinator client, the p2p ring, redist's wire
     # transports, and the fleet router's dispatch channel
     "conn_reset": ("store.request", "p2p.send", "p2p.recv",
-                   "redist.transport", "serve.dispatch"),
+                   "redist.transport", "serve.dispatch",
+                   "serve.migrate"),
     "flaky": ("store.request", "p2p.send", "p2p.recv",
-              "redist.transport", "serve.dispatch"),
+              "redist.transport", "serve.dispatch", "serve.migrate"),
     "jitter": ("store.request", "p2p.send", "p2p.recv",
                "redist.transport", "serve.dispatch"),
 }
@@ -311,7 +325,8 @@ def random_plan(seed: int, world: int, steps: int, *,
                 commit_every: int = 2, crash: bool = True,
                 shard_delete: bool = True, noise: int = 2,
                 profile: str = "train",
-                processes: bool = False) -> ChaosPlan:
+                processes: bool = False,
+                prefill: Optional[int] = None) -> ChaosPlan:
     """A randomized-but-SEEDED soak plan: same (seed, world, steps,
     profile) => byte-identical schedule.
 
@@ -346,6 +361,15 @@ def random_plan(seed: int, world: int, steps: int, *,
     — blips the retry ladder must absorb with ZERO failovers), and an
     admission-queue drop absorbed by router re-dispatch.
     """
+    if profile == "disagg":
+        if prefill is None:
+            prefill = max(world - 1, 1)
+        return _random_disagg_plan(seed, prefill, world - prefill,
+                                   steps)
+    if prefill is not None:
+        raise PlanError(
+            f"random_plan prefill= names the disagg profile's prefill "
+            f"pool size; got profile {profile!r}")
     if profile == "serve":
         return _random_serve_plan(seed, world, steps,
                                   processes=processes)
@@ -357,8 +381,8 @@ def random_plan(seed: int, world: int, steps: int, *,
         return _random_transient_plan(seed, world, steps)
     if profile != "train":
         raise PlanError(
-            f"random_plan profile must be 'train', 'transient' or "
-            f"'serve'; got {profile!r}")
+            f"random_plan profile must be 'train', 'transient', "
+            f"'serve' or 'disagg'; got {profile!r}")
     if world < 2:
         raise PlanError(f"random_plan needs world >= 2; got {world}")
     if steps < 2 * commit_every + 2:
@@ -440,6 +464,77 @@ def _random_transient_plan(seed: int, world: int, steps: int) -> ChaosPlan:
         Fault(rank=rng.randrange(world), site="store.request",
               kind="jitter", seconds=round(rng.uniform(0.02, 0.05), 3),
               after=b, until=b + rng.randrange(4, 8)),
+    ]
+    for f in faults:
+        f.validate()
+    return ChaosPlan(seed=seed, faults=faults)
+
+
+def _random_disagg_plan(seed: int, prefill_n: int, decode_n: int,
+                        steps: int) -> ChaosPlan:
+    """The ``profile="disagg"`` leg of :func:`random_plan`: the
+    disaggregated-serving acceptance scenario (serve/disagg.py,
+    docs/serving.md). Replica ids are fleet-wide — prefill replicas
+    are ``0..prefill_n-1``, decode replicas ``prefill_n..`` (the
+    DisaggRouter's ``rid_base`` convention) — so ``peer`` addressing
+    stays unambiguous across the two pools. Composition:
+
+    * one PREFILL worker SIGKILLed mid-traffic (``serve.proc`` crash,
+      epoch-pinned to incarnation 0): in-flight requests it owned —
+      including sequences parked awaiting migration — must re-prefill
+      on a sibling exactly once while the pool respawns the victim;
+    * a hard ``conn_reset`` on the KV-migration push to one decode
+      replica (``serve.migrate``): the kv_install frame LANDED, the
+      ack is lost — the retry ladder's replay must be served the
+      decode endpoint's deduped install ack, never a double install;
+    * a ``corrupt`` on a later migration: one payload bit flipped
+      BEFORE framing, so only the per-block crc ledger travelling in
+      the header can catch it — the push fails structurally and the
+      router re-packs/re-prefills, never serving garbage KV.
+    """
+    if prefill_n < 2:
+        raise PlanError(
+            f"a disagg plan needs >= 2 prefill replicas (killing the "
+            f"only one leaves nothing to re-prefill on); got "
+            f"{prefill_n}")
+    if decode_n < 1:
+        raise PlanError(
+            f"a disagg plan needs >= 1 decode replica; got {decode_n}")
+    if steps < 40:
+        raise PlanError(
+            f"a disagg plan needs an iteration horizon >= 40; got "
+            f"{steps}")
+    rng = random.Random(seed)
+    victim = rng.randrange(prefill_n)
+    decode_rids = list(range(prefill_n, prefill_n + decode_n))
+    faults = [
+        # SIGKILL one PREFILL worker mid-traffic (epoch 0: a respawn's
+        # fresh iteration counter re-crosses the address — same pin as
+        # the fleet profile). The accrual sweep must eject within
+        # 2x suspect_s, in-flight prefills/parked migrations must
+        # re-prefill on the surviving sibling exactly once, and the
+        # pool respawns the victim gated on the newest weights.
+        Fault(rank=0, site="serve.proc", kind="crash", peer=victim,
+              at=rng.randrange(steps // 4, steps // 2), epoch=0),
+        # sever the migration socket after the kv_install frame lands:
+        # the decode side installed, the ack is lost — the ladder
+        # replay must hit the install dedupe (epoch 0: migration
+        # counters reset on respawn too)
+        Fault(rank=0, site="serve.migrate", kind="conn_reset",
+              peer=rng.choice(decode_rids), at=rng.randrange(1, 4),
+              epoch=0),
+        # flip one payload bit pre-framing on later migrations: the
+        # frame crc passes, the per-BLOCK crc ledger must catch it on
+        # arrival before any token is generated from the blocks. A
+        # WINDOW rather than an exact address: migration attempts are
+        # counted per crossing, and a crossing can be a ladder REPLAY
+        # of an already-installed fid — whose dedupe ack rightly
+        # short-circuits before any payload look. Three crossings make
+        # a fresh-push hit certain under real traffic.
+        Fault(rank=0, site="serve.migrate", kind="corrupt",
+              peer=rng.choice(decode_rids),
+              after=(a := rng.randrange(5, 9)), until=a + 2,
+              epoch=0),
     ]
     for f in faults:
         f.validate()

@@ -200,6 +200,14 @@ class Config:
     # hvd_serve_step_ms {kernel=...} label, so a silent fallback to
     # XLA on TPU is visible.
     serve_kernel: str = "auto"
+    # Serve wire frame ceiling in bytes (HOROVOD_SERVE_WIRE_MAX_FRAME):
+    # the largest frame serve/wire.py will send or accept. Dispatch
+    # frames (token ids, acks) never approach it; KV-block MIGRATION
+    # frames (serve/kv_migrate.py) carry a whole sequence's paged
+    # blocks as binary payload and scale with model size x context, so
+    # disaggregated deployments with big pools raise this. Oversize is
+    # always a loud DispatchError naming the knob, never a truncation.
+    serve_wire_max_frame: int = 4 * 1024 * 1024
     # Speculative decoding draft depth (HOROVOD_SERVE_SPEC_K): with a
     # draft executor attached, the drafter proposes up to this many
     # tokens per iteration and the target verifies them in ONE
@@ -406,6 +414,8 @@ class Config:
             "HOROVOD_SERVE_PREFIX_CACHE", c.serve_prefix_cache)
         c.serve_spec_k = _env_int_strict(
             "HOROVOD_SERVE_SPEC_K", c.serve_spec_k)
+        c.serve_wire_max_frame = _env_int_strict(
+            "HOROVOD_SERVE_WIRE_MAX_FRAME", c.serve_wire_max_frame)
         raw = os.environ.get("HOROVOD_SERVE_KERNEL")
         if raw is not None:
             c.serve_kernel = raw.strip().lower()
@@ -573,6 +583,15 @@ class Config:
                 f"HOROVOD_SERVE_SPEC_K must be an int in [0, 64] (the "
                 f"verify step's shape is [max_batch, spec_k+1] — it "
                 f"joins the precompiled bucket set); got {sk!r}")
+        wf = self.serve_wire_max_frame
+        if not isinstance(wf, int) or \
+                not (1 << 16 <= wf <= (1 << 31) - 1):
+            raise ValueError(
+                f"HOROVOD_SERVE_WIRE_MAX_FRAME must be bytes in "
+                f"[{1 << 16}, {(1 << 31) - 1}] (the serve wire frame "
+                f"ceiling; bit 31 of the length word is the binary-"
+                f"frame flag, so a full 2 GiB frame cannot be "
+                f"represented); got {wf!r}")
         if self.serve_kernel not in ("auto", "pallas", "xla"):
             raise ValueError(
                 f"HOROVOD_SERVE_KERNEL must be 'auto', 'pallas' or "

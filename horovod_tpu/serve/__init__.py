@@ -33,11 +33,20 @@ and the bucket/no-recompile contract.
     proc_fleet.py multi-process fleet router: accrual sweep over real
                  heartbeat keys, dispatch over the resilience ladder,
                  SIGKILL-survivable respawn gated on fresh weights
+    disagg.py    prefill/decode DISAGGREGATED serving: two dedicated
+                 worker-process pools, prompt KV computed in the
+                 prefill pool and MIGRATED block-by-block to a decode
+                 replica (bit-identical continuation, bounded
+                 re-prefill on any failure, per-pool healthz)
+    kv_migrate.py live paged-KV block migration: pack/verify/install
+                 with per-block crc32 ledgers, binary wire frames and
+                 weight-version fencing (plan/transport split)
     soak.py      serving SLO soaks under seeded chaos plans — in-
-                 process and multi-process (tools/serve_soak.py CLI;
-                 docs/serving.md)
+                 process, multi-process and disaggregated
+                 (tools/serve_soak.py CLI; docs/serving.md)
 """
 from .batcher import ContinuousBatcher, ReplicaDead            # noqa: F401
+from .disagg import DisaggRouter                               # noqa: F401
 from .executor import ShardedExecutor                          # noqa: F401
 from .fleet import FleetHandle, FleetRouter, Replica           # noqa: F401
 from .http import (                                            # noqa: F401

@@ -85,6 +85,12 @@ class _Active:
     #: and restarts (a re-prefill replays from 0 and reproduces the
     #: original stream)
     rng_ctr: int = 0
+    #: params version the PREFILL step actually ran under — what the
+    #: migration packet stamps as its weight fence. Captured at the
+    #: prefill (not at pack time): a hot swap landing between prefill
+    #: and migration must fence the packet OUT, not relabel stale KV
+    #: as current.
+    params_version: Optional[int] = None
 
 
 class ContinuousBatcher:
@@ -201,6 +207,37 @@ class ContinuousBatcher:
 
         self._active: Dict[int, _Active] = {}   # slot/row -> sequence
         self._reprefill: List[ServeRequest] = []
+        # -- disaggregated serving (serve/disagg.py, serve/kv_migrate.py)
+        #: PARKED sequences: cleanly retired hold_kv requests whose row
+        #: + blocks stay allocated awaiting KV-block migration to a
+        #: decode replica. Keyed by request rid; mutations under
+        #: _parked_lock (a LEAF lock: nothing else is ever taken under
+        #: it), reads from the endpoint thread are snapshot copies.
+        self.parked: Dict[int, _Active] = {}
+        self._parked_lock = threading.Lock()
+        #: rids whose parked row the endpoint released (migration done
+        #: or abandoned) — freed on the scheduler thread at step top
+        self._parked_release: List[int] = []
+        #: pin counts: a parked row being PACKED for migration must
+        #: not be freed (released or TTL-reaped) mid-read — the pool
+        #: could re-issue its blocks to a new owner and the pack would
+        #: stamp self-consistent crcs over the wrong sequence's bytes
+        self._parked_pins: Dict[int, int] = {}
+        #: pending migrated-sequence installs (endpoint-submitted;
+        #: installed on the scheduler thread through the same
+        #: reservation-gated capacity check admission uses)
+        self._migrate_in: List[dict] = []
+        self._migrate_lock = threading.Lock()
+        #: how long a parked row outlives its request deadline before
+        #: the reaper frees it (the router died / abandoned it)
+        self.parked_grace_s = 5.0
+        self.migrations_in = 0
+        self.migrate_rejects = 0
+        self.parked_reaped = 0
+        #: migration payloads whose per-block crc failed on arrival —
+        #: incremented by the endpoint (note_migrate_corrupt), counted
+        #: here so /healthz and the soak verdict see one number
+        self.migrate_corrupt_detected = 0
         self.iterations = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -242,6 +279,10 @@ class ContinuousBatcher:
             "hvd_serve_kv_corruptions_total",
             "KV slots whose verify-on-read crc failed (corruption "
             "caught before reaching a client)", rl or None)
+        self._m_migrate_corrupt = R.counter(
+            "hvd_serve_migrate_corrupt_total",
+            "migrated-KV payloads whose per-block crc failed on "
+            "arrival (corruption caught before install)", rl or None)
         #: optional weight-stream subscriber (redist/stream.py): polled
         #: between scheduling iterations, rate-limited so an idle or
         #: not-yet-published channel cannot stall the decode loop
@@ -416,6 +457,13 @@ class ContinuousBatcher:
         # deadline completion NOW, even when every slot is busy —
         # within one iteration, not at slot-drain time
         self.queue.reap_expired()
+        # migration plumbing (single-writer: all pool/row bookkeeping
+        # happens HERE, on the scheduler thread — the endpoint only
+        # enqueues): free rows the endpoint released, reap abandoned
+        # parked rows, install migrated sequences BEFORE admission so
+        # a mid-stream arrival is never starved by local newcomers
+        self._drain_parked_release()
+        self._install_migrated()
         self._retire()
         admitted = self._admit()
         if admitted:
@@ -433,7 +481,8 @@ class ContinuousBatcher:
             self._retire()
         self.iterations += 1
         return bool(self._active) or bool(self._reprefill) \
-            or self.queue.depth() > 0
+            or self.queue.depth() > 0 or bool(self._migrate_in) \
+            or bool(self._parked_release)
 
     def run(self, max_iterations: Optional[int] = None) -> None:
         """Drive until drained (loopback/bench mode)."""
@@ -498,6 +547,201 @@ class ContinuousBatcher:
             per_row = max(self.executor.blocks_per_seq, 1)
             return self.queue.depth() + self.kv.pool.in_use() / per_row
         return self.queue.depth() + float(self.kv.live())
+
+    # -- disaggregated serving: park / migrate-install ----------------------
+    def parked_seq(self, rid: int) -> Optional[_Active]:
+        """The parked sequence for request ``rid`` (None if unknown /
+        already released). A point-in-time read; callers that go on
+        to READ the row's blocks must hold a pin
+        (:meth:`pin_parked`) or the TTL reaper could free — and the
+        pool re-issue — those blocks mid-read."""
+        with self._parked_lock:
+            return self.parked.get(rid)
+
+    def pin_parked(self, rid: int) -> Optional[_Active]:
+        """Claim a read pin on ``rid``'s parked row (None if not
+        parked): while any pin is held, neither release_parked nor
+        the TTL reaper will free the row — the migration pack's
+        device reads see stable blocks. Balance with
+        :meth:`unpin_parked`."""
+        with self._parked_lock:
+            seq = self.parked.get(rid)
+            if seq is not None:
+                self._parked_pins[rid] = \
+                    self._parked_pins.get(rid, 0) + 1
+            return seq
+
+    def unpin_parked(self, rid: int) -> None:
+        with self._parked_lock:
+            n = self._parked_pins.get(rid, 0) - 1
+            if n > 0:
+                self._parked_pins[rid] = n
+            else:
+                self._parked_pins.pop(rid, None)
+
+    def release_parked(self, rid: int) -> None:
+        """Ask the scheduler to free ``rid``'s parked row (migration
+        landed or was abandoned). Endpoint-thread safe; idempotent."""
+        with self._parked_lock:
+            if rid in self.parked:
+                self._parked_release.append(rid)
+        self.queue._work.set()   # wake an idle scheduler to free it
+
+    def _drain_parked_release(self) -> None:
+        """Scheduler-thread half of release_parked, plus the TTL
+        reaper: a parked row whose router died mid-orchestration must
+        not hold pool blocks forever."""
+        now = time.monotonic()
+        with self._parked_lock:
+            pinned = set(self._parked_pins)
+            # a pinned row (mid-pack on the endpoint thread) is never
+            # freed this iteration: releases defer to the next drain,
+            # reaps re-qualify next time around
+            rids = [r for r in self._parked_release
+                    if r not in pinned]
+            self._parked_release = [r for r in self._parked_release
+                                    if r in pinned]
+            reap = [rid for rid, seq in self.parked.items()
+                    if now > seq.req.deadline + self.parked_grace_s
+                    and rid not in rids and rid not in pinned]
+            self.parked_reaped += len(reap)
+            seqs = [self.parked.pop(rid) for rid in rids + reap
+                    if rid in self.parked]
+        for seq in seqs:
+            self._free_seq(seq.slot)
+
+    def submit_migrated(self, meta: dict,
+                        blocks: List[dict]) -> dict:
+        """Enqueue a migrated sequence for install (the decode-side
+        receive path, serve/kv_migrate.py). ``meta`` carries the
+        sequence state (prompt, emitted tokens, cache_len, sampling,
+        rng_ctr, weights_version, deadline_ms); ``blocks`` is one dict
+        per KV block — {"filled", "leaf_bytes", "crcs"} — already
+        crc-VERIFIED by the caller. Returns the pending entry; the
+        caller waits on ``entry["evt"]`` and reads
+        ``entry["outcome"]``/``entry["handle"]`` — the actual install
+        (capacity reservation, device writes, ledger seeding, version
+        fence) runs on the scheduler thread at the top of the next
+        iteration."""
+        from .queue import ServeHandle
+        handle = ServeHandle(int(meta.get("rid", -1)))
+        entry = {"meta": dict(meta), "blocks": blocks,
+                 "handle": handle, "outcome": None,
+                 "evt": threading.Event()}
+        with self._migrate_lock:
+            self._migrate_in.append(entry)
+        self.queue._work.set()   # wake an idle scheduler to install
+        return entry
+
+    def note_migrate_corrupt(self) -> None:
+        """Endpoint hook: a migration payload failed its per-block crc
+        on arrival (counted before any install could happen)."""
+        self.migrate_corrupt_detected += 1
+        self._m_migrate_corrupt.inc()
+
+    def _install_migrated(self) -> None:
+        with self._migrate_lock:
+            pending, self._migrate_in = self._migrate_in, []
+        for ent in pending:
+            try:
+                outcome = self._install_one(ent)
+            except Exception as e:  # noqa: BLE001 — a torn install must
+                # surface as a structured reject, never kill the
+                # scheduler thread (the sender re-prefills)
+                logger.error(
+                    "serve replica %s: migrated install failed: %s",
+                    self.replica_id, e)
+                outcome = ("error", str(e)[:200])
+            if outcome[0] != "installed":
+                self.migrate_rejects += 1
+            ent["outcome"] = outcome
+            ent["evt"].set()
+
+    def _install_one(self, ent: dict) -> tuple:
+        """Install one migrated sequence: weight-version fence,
+        reservation-gated capacity, device block writes, crc-ledger
+        seeding, batch enrollment. Returns ("installed", None) or a
+        structured ("version_mismatch"|"rejected"|"incompatible",
+        detail) the endpoint acks back to the sender."""
+        if not self.paged:
+            return ("incompatible", "decode replica is not paged")
+        meta, blocks = ent["meta"], ent["blocks"]
+        # -- weight-version FENCE: migrated KV was computed under the
+        # sender's version; installing it under any other version
+        # would mix cache bytes across versions — the sender
+        # re-prefills instead, never stale-KV tokens
+        want = meta.get("weights_version")
+        have = self.executor.params_version
+        if want != have:
+            return ("version_mismatch",
+                    {"have": have, "want": want})
+        cache_len = int(meta["cache_len"])
+        out = [int(t) for t in meta.get("out", [])]
+        max_new = int(meta["max_new_tokens"])
+        remaining = max_new - len(out)
+        if remaining <= 0 or cache_len >= self.executor.max_len:
+            return ("incompatible", "sequence already complete")
+        margin = self.spec_k + 1 if self.draft is not None else 0
+        budget = min(cache_len + remaining + margin,
+                     self.executor.max_len)
+        bs = self.kv.block_size
+        if int(meta.get("block_size", bs)) != bs:
+            return ("incompatible",
+                    f"block size {meta.get('block_size')} != {bs}")
+        n_payload = -(-cache_len // bs)
+        if len(blocks) != n_payload:
+            return ("incompatible",
+                    f"{len(blocks)} payload blocks for cache_len "
+                    f"{cache_len} (need {n_payload})")
+        need_total = self.kv.blocks_needed(budget)
+        # the RESERVATION-GATED admission check local newcomers pass
+        # through — a migrated install can never starve an admitted
+        # sequence either
+        if not self.kv.can_admit(need_total):
+            return ("rejected", self.queue._retry_after_ms())
+        row = self.kv.alloc_row(need_total)
+        try:
+            fresh = self.kv.ensure(row, cache_len)
+            assert len(fresh) == n_payload
+            self.executor.install_kv_blocks(
+                fresh, [b["leaf_bytes"] for b in blocks],
+                [int(b["filled"]) for b in blocks])
+            if self.kv_crc:
+                # seed the per-block ledger from the VERIFIED bytes
+                # so verify-on-read covers the migrated prefix
+                # exactly like locally written KV
+                for blk, b in zip(fresh, blocks):
+                    self.kv.pool.crc_reset(
+                        blk, b["leaf_bytes"], int(b["filled"]))
+        except ValueError as e:
+            self.kv.free_row(row)
+            return ("incompatible", str(e)[:200])
+        # re-check the fence: a hot swap may have landed between the
+        # check above and the last device write (swap_params only
+        # fences individual steps/writes, not this whole span)
+        if self.executor.params_version != want:
+            self.kv.free_row(row)
+            return ("version_mismatch",
+                    {"have": self.executor.params_version,
+                     "want": want})
+        now = time.monotonic()
+        req = ServeRequest(
+            rid=int(meta.get("rid", -1)),
+            prompt=[int(t) for t in meta["prompt"]],
+            max_new_tokens=max_new,
+            deadline=now + float(meta.get("deadline_ms", 30000.0))
+            / 1000.0,
+            submitted_at=now, handle=ent["handle"],
+            temperature=float(meta.get("temperature", 0.0)),
+            top_p=float(meta.get("top_p", 1.0)),
+            seed=int(meta.get("seed", 0)))
+        seq = _Active(req=req, slot=row, out=out,
+                      cache_len=cache_len,
+                      rng_ctr=int(meta.get("rng_ctr", 1)))
+        self.kv.lengths[row] = cache_len
+        self._active[row] = seq
+        self.migrations_in += 1
+        return ("installed", None)
 
     # -- internals -----------------------------------------------------------
     def _stats(self) -> dict:
@@ -650,6 +894,19 @@ class ContinuousBatcher:
             if expired and not done_ok:
                 self.queue.expired_count += 1
                 req.handle._resolve(seq.out, "expired", latency_ms=ms)
+            elif req.hold_kv and self.paged:
+                # disaggregated prefill: PARK the verified sequence —
+                # row and blocks stay allocated so the endpoint can
+                # migrate them (serve/kv_migrate.py pack_parked).
+                # Parked BEFORE the handle resolves: the endpoint's
+                # migrate op keys off the resolution and must find the
+                # entry already there.
+                with self._parked_lock:
+                    self.parked[req.rid] = seq
+                del self._active[slot]
+                req.handle._resolve(seq.out, "ok", latency_ms=ms)
+                self.queue.note_service_ms(ms)
+                continue
             else:
                 req.handle._resolve(seq.out, "ok", latency_ms=ms)
                 self.queue.note_service_ms(ms)
@@ -845,6 +1102,7 @@ class ContinuousBatcher:
                 (t_first - a.req.submitted_at) * 1000.0)
             n = len(a.req.prompt)
             a.cache_len = n
+            a.params_version = self.executor.last_step_version
             a.rng_ctr = 1   # the prefill's first token consumed draw 0
             # the prompt is fully cached but only [0, n) is valid; the
             # first generated token is the prompt's last-logit argmax

@@ -521,6 +521,62 @@ class ShardedExecutor:
                 self.cache, jnp.asarray(src, jnp.int32),
                 jnp.asarray(dst, jnp.int32))
 
+    def install_kv_blocks(self, blocks: "list[int]",
+                          block_leaf_bytes: "list[list[bytes]]",
+                          lengths: "list[int]") -> None:
+        """Write migrated KV bytes into pool blocks ``blocks``:
+        ``block_leaf_bytes[j]`` carries one bytes object per cache
+        leaf (leaf order, the order :meth:`kv_block_bytes` reads) for
+        block ``blocks[j]``, covering positions ``[0, lengths[j])`` —
+        the receive half of paged KV-block migration
+        (serve/kv_migrate.py). BATCHED: one scatter per cache leaf
+        for the whole sequence (positions past ``lengths[j]`` land as
+        zeros — unreachable by the positional mask, and overwritten
+        by the first decode write that needs them), not a full-pool
+        functional update per (block, leaf). Byte counts are
+        validated against the leaf dtype/shape before anything lands,
+        and the write runs under the swap lock so it can never tear a
+        step in flight."""
+        if not self.paged:
+            raise RuntimeError("install_kv_blocks is paged-only")
+        if not blocks:
+            return
+        bs = self.kv_block_size
+        with self._swap_lock:
+            leaves, treedef = jax.tree_util.tree_flatten(self.cache)
+            idxs = [i for i, l in enumerate(leaves)
+                    if getattr(l, "ndim", 0) == 4
+                    and l.shape[0] == self.kv_pool_blocks
+                    and l.shape[1] == bs]
+            if any(len(lb) != len(idxs) for lb in block_leaf_bytes):
+                raise ValueError(
+                    f"install_kv_blocks: payload leaf counts "
+                    f"{[len(lb) for lb in block_leaf_bytes]} do not "
+                    f"match the {len(idxs)} cache leaves — the "
+                    f"sender's model layout does not match")
+            ids = jnp.asarray(blocks, jnp.int32)
+            for li, i in enumerate(idxs):
+                leaf = leaves[i]
+                tail = leaf.shape[2:]
+                row = int(np.prod(tail)) * leaf.dtype.itemsize
+                stacked = np.zeros((len(blocks), bs) + tail,
+                                   leaf.dtype)
+                for j, (lb, length) in enumerate(
+                        zip(block_leaf_bytes, lengths)):
+                    raw = lb[li]
+                    if len(raw) != int(length) * row:
+                        raise ValueError(
+                            f"install_kv_blocks: leaf payload of "
+                            f"{len(raw)} bytes != expected "
+                            f"{int(length) * row} for {length} "
+                            f"positions of {tail} {leaf.dtype} — "
+                            f"incompatible pool layouts")
+                    stacked[j, :int(length)] = np.frombuffer(
+                        raw, dtype=leaf.dtype).reshape(
+                        (int(length),) + tail)
+                leaves[i] = leaf.at[ids].set(jnp.asarray(stacked))
+            self.cache = jax.tree_util.tree_unflatten(treedef, leaves)
+
     def corrupt_kv_slot(self, slot: int, length: int) -> None:
         """Flip one deterministically chosen bit inside ``slot``'s
         valid cache prefix — the chaos ``serve.kv`` fault body. Real

@@ -54,7 +54,7 @@ import logging
 import signal
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..chaos import inject as _chaos
 from ..chaos.detector import AccrualTracker
@@ -142,13 +142,22 @@ class FleetHandle:
 
 
 class _Tracked:
-    """Router-side bookkeeping for one in-flight fleet request."""
+    """Router-side bookkeeping for one in-flight fleet request.
+
+    Sampling state (temperature/top-p/seed) rides along because
+    failover RE-SUBMITS from this record: per-row seeded streams are
+    deterministic across re-dispatch (the rng counter replays from 0
+    on a re-prefill and reproduces the original stream), so a sampled
+    request fails over with the same at-most-once bookkeeping as a
+    greedy one."""
 
     __slots__ = ("fid", "prompt", "max_new_tokens", "deadline",
-                 "submitted_at", "handle", "rid", "inner")
+                 "submitted_at", "handle", "rid", "inner",
+                 "temperature", "top_p", "seed")
 
     def __init__(self, fid, prompt, max_new_tokens, deadline,
-                 submitted_at, handle):
+                 submitted_at, handle, temperature=0.0, top_p=1.0,
+                 seed=0):
         self.fid = fid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -157,6 +166,9 @@ class _Tracked:
         self.handle = handle
         self.rid: Optional[int] = None      # current replica
         self.inner: Optional[ServeHandle] = None
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
 
 
 class Replica:
@@ -248,33 +260,51 @@ class Replica:
 
 def aggregate_healthz(replicas_info: Dict[int, dict], *,
                       draining: bool,
-                      retry_after_ms: float) -> dict:
-    """Build the aggregate fleet ``/healthz`` payload BOTH router
-    flavors serve through ``make_fleet_server`` — one place for the
+                      retry_after_ms: float,
+                      pools: Optional[Dict[str, dict]] = None) -> dict:
+    """Build the aggregate fleet ``/healthz`` payload every router
+    flavor serves through ``make_fleet_server`` — one place for the
     contract (per-replica state + live capacity, ``ok`` False at zero
-    capacity), so the in-process and multi-process faces cannot drift.
+    capacity), so the in-process, multi-process and disaggregated
+    faces cannot drift.
 
     ``replicas_info[rid]`` supplies ``state``/``up``/``draining``/
     ``queue_depth``/``weights_version``/``restarts``/``queue_free``
     and, when paged, ``kv_blocks_total``/``kv_blocks_in_use``; each
     router sources those from what it actually has (live batchers vs
     the health-poll cache).
+
+    ``pools`` (disaggregated serving, serve/disagg.py) names the
+    per-pool breakdown: ``pools[name]`` carries ``replicas`` (the rids
+    belonging to that pool), ``admitting`` (True for the pool whose
+    capacity gates ADMISSION — prefill) and any extra facts to surface
+    (``migration_backlog``). The payload then grows a ``pools``
+    section with each pool's own capacity rollup, and ``ok`` goes
+    False ONLY when an admitting pool's live capacity is zero: a
+    saturated decode pool degrades honestly (``degraded`` names it)
+    but the front door keeps answering 200 — new prompts can still be
+    admitted, parked and migrated once decode capacity frees.
     """
     reps: Dict[str, dict] = {}
     q_free = blocks_free = 0
+    per_rid: Dict[int, Tuple[int, int]] = {}
     for rid, info in replicas_info.items():
         entry = {k: info.get(k) for k in
                  ("state", "up", "draining", "queue_depth",
                   "weights_version", "restarts")}
+        rq = rb = 0
         if info.get("up"):
-            q_free += max(int(info.get("queue_free") or 0), 0)
+            rq = max(int(info.get("queue_free") or 0), 0)
+            q_free += rq
             if info.get("kv_blocks_total") is not None:
-                blocks_free += (int(info["kv_blocks_total"])
-                                - int(info.get("kv_blocks_in_use") or 0))
+                rb = (int(info["kv_blocks_total"])
+                      - int(info.get("kv_blocks_in_use") or 0))
+                blocks_free += rb
                 entry["kv_blocks_in_use"] = info.get("kv_blocks_in_use")
+        per_rid[rid] = (rq, rb)
         reps[str(rid)] = entry
     up_n = sum(1 for r in reps.values() if r["up"])
-    return {
+    out = {
         "ok": up_n > 0 and q_free > 0 and not draining,
         "draining": draining,
         "replicas": reps,
@@ -284,6 +314,37 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
                      "kv_blocks_free": blocks_free},
         "retry_after_ms": retry_after_ms,
     }
+    if pools:
+        out["pools"] = {}
+        admit_free = 0
+        any_admitting = False
+        degraded = []
+        for name, spec in pools.items():
+            rids = list(spec.get("replicas", ()))
+            pq = sum(per_rid.get(r, (0, 0))[0] for r in rids)
+            pb = sum(per_rid.get(r, (0, 0))[1] for r in rids)
+            pup = sum(1 for r in rids
+                      if reps.get(str(r), {}).get("up"))
+            entry = {"replicas": [str(r) for r in rids],
+                     "replicas_up": pup,
+                     "queue_free": pq, "kv_blocks_free": pb,
+                     "admitting": bool(spec.get("admitting", False))}
+            for k, v in spec.items():
+                if k not in ("replicas", "admitting"):
+                    entry[k] = v
+            out["pools"][name] = entry
+            if entry["admitting"]:
+                any_admitting = True
+                admit_free += pq
+            if pup == 0 or pq == 0:
+                degraded.append(name)
+        if any_admitting:
+            # 503 only when ADMITTING capacity (prefill) is zero —
+            # a saturated/down decode pool degrades, never lies
+            out["ok"] = admit_free > 0 and not draining
+        if degraded:
+            out["degraded"] = sorted(degraded)
+    return out
 
 
 class FleetRouter:
@@ -437,11 +498,17 @@ class FleetRouter:
 
     # -- request path --------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               deadline_ms: Optional[float] = None) -> FleetHandle:
+               deadline_ms: Optional[float] = None,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int = 0) -> FleetHandle:
         """Route a request to a healthy replica; returns a
         :class:`FleetHandle`. Raises :class:`Rejected` (with
         ``retry_after_ms``) when no replica can take it — the
-        fleet-level load-shed contract."""
+        fleet-level load-shed contract. Sampling controls
+        (``temperature``/``top_p``/``seed``) ride the at-most-once
+        bookkeeping: per-row seeded streams are deterministic across
+        re-dispatch, so a mid-request failover reproduces the same
+        sampled tokens."""
         if not self.started:
             raise RuntimeError("FleetRouter.start() first")
         t0 = time.monotonic()
@@ -455,7 +522,8 @@ class FleetRouter:
         fid = next(self._fids)
         handle = FleetHandle(fid)
         tr = _Tracked(fid, [int(t) for t in prompt], int(max_new_tokens),
-                      t0 + deadline_ms / 1000.0, t0, handle)
+                      t0 + deadline_ms / 1000.0, t0, handle,
+                      temperature=temperature, top_p=top_p, seed=seed)
         err = self._dispatch(tr)
         if err is not None:
             self._m_rejected.inc()
@@ -528,6 +596,8 @@ class FleetRouter:
                 inner = rep.queue.submit(
                     tr.prompt, max_new_tokens=tr.max_new_tokens,
                     deadline_ms=remaining_ms,
+                    temperature=tr.temperature, top_p=tr.top_p,
+                    seed=tr.seed,
                     on_resolve=self._make_on_resolve(tr, rep.id))
             except AdmitDropped as e:
                 # the queue door ate the request: absorb by trying the

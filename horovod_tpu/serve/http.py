@@ -246,17 +246,14 @@ def make_fleet_server(router, host: str = "127.0.0.1",
             try:
                 prompt, max_new, deadline_ms, sampling = \
                     self._read_generate_request()
-                if sampling.get("temperature", 0.0) > 0:
-                    # fleet routers track (prompt, max_new, deadline)
-                    # for failover re-submit and stay greedy-only for
-                    # now; a sampled request must not silently decode
-                    # greedy (docs/serving.md)
-                    raise ValueError(
-                        "sampled generation (temperature > 0) is "
-                        "standalone-replica only; the fleet front door "
-                        "serves greedy requests")
+                # sampling rides the fleet path since the routers
+                # track (prompt, sampling) for failover re-submit:
+                # per-row seeded streams are deterministic across
+                # re-dispatch, so a sampled request fails over with
+                # the same at-most-once bookkeeping as a greedy one
                 handle = router.submit(prompt, max_new_tokens=max_new,
-                                       deadline_ms=deadline_ms)
+                                       deadline_ms=deadline_ms,
+                                       **sampling)
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {"error": "bad request",

@@ -68,6 +68,10 @@ logger = logging.getLogger("horovod_tpu")
 
 #: base shed hint before capacity scaling (ms)
 SHED_BASE_MS = 250.0
+#: metric help strings (single-sourced — metric-help lint)
+RESPAWNS_HELP = "replica worker processes respawned after ejection"
+FLEET_CAPACITY_HELP = \
+    "replicas currently admitted (up) in the process fleet"
 #: how long the router waits for a spawned worker to register ready
 DEFAULT_SPAWN_TIMEOUT_S = 120.0
 
@@ -148,9 +152,19 @@ class ProcessFleetRouter:
                  chaos_plan=None, events_dir: Optional[str] = None,
                  log_dir: Optional[str] = None,
                  max_inflight: int = 256,
-                 python: Optional[str] = None):
+                 python: Optional[str] = None,
+                 pool: Optional[str] = None, rid_base: int = 0):
         if n_replicas < 1:
             raise ValueError("a fleet needs at least one replica")
+        #: pool identity (disaggregated serving, serve/disagg.py):
+        #: names this router's slice of a split fleet. Metric series
+        #: get a {pool=...} label INSTEAD of being claimed fresh (two
+        #: pools share one router process and must not clobber each
+        #: other), and replica ids start at ``rid_base`` so chaos
+        #: ``peer`` addressing and labels stay unambiguous fleet-wide.
+        self.pool = pool
+        if rid_base < 0:
+            raise ValueError(f"rid_base must be >= 0; got {rid_base}")
         if suspect_s <= interval_s:
             raise ValueError(
                 f"suspect_s ({suspect_s}) must exceed the heartbeat "
@@ -174,7 +188,8 @@ class ProcessFleetRouter:
         self.max_inflight = int(max_inflight)
         self.events_dir = events_dir
         self.chaos_plan = chaos_plan
-        ids = list(range(int(n_replicas)))
+        ids = list(range(int(rid_base),
+                         int(rid_base) + int(n_replicas)))
         self.replicas: Dict[int, ProcessReplica] = {
             r: ProcessReplica(r, python=python, log_dir=log_dir)
             for r in ids}
@@ -215,36 +230,43 @@ class ProcessFleetRouter:
         self._kv = StoreClient(self.kv_addr, self.kv_port,
                                chaos_exempt=True)
         self._hb_clients: Dict[int, object] = {}
-        # -- metrics (claimed fresh: one router per routing process)
+        # -- metrics: claimed fresh when this router IS the routing
+        # process's one fleet; a POOL router instead get-or-creates
+        # {pool=...}-labeled children (two pools share the process and
+        # must not clobber each other's series)
         R = obs_metrics.get_registry()
-        for fam in ("hvd_serve_replica_up", "hvd_serve_failovers_total",
-                    "hvd_serve_requeued_total",
-                    "hvd_serve_fleet_rejected_total",
-                    "hvd_serve_router_ms", "hvd_serve_failover_ms",
-                    "hvd_serve_respawns_total",
-                    "hvd_serve_fleet_capacity"):
-            R.unregister(fam)
+        pl = {} if pool is None else {"pool": str(pool)}
+        if pool is None:
+            for fam in ("hvd_serve_replica_up",
+                        "hvd_serve_failovers_total",
+                        "hvd_serve_requeued_total",
+                        "hvd_serve_fleet_rejected_total",
+                        "hvd_serve_router_ms", "hvd_serve_failover_ms",
+                        "hvd_serve_respawns_total",
+                        "hvd_serve_fleet_capacity"):
+                R.unregister(fam)
         self._m_up = {
             r: R.gauge("hvd_serve_replica_up", REPLICA_UP_HELP,
-                       {"replica": str(r)}) for r in ids}
+                       dict(pl, replica=str(r))) for r in ids}
         self._m_failovers = R.counter(
-            "hvd_serve_failovers_total", FAILOVERS_HELP)
+            "hvd_serve_failovers_total", FAILOVERS_HELP, pl or None)
         self._m_requeued = R.counter(
-            "hvd_serve_requeued_total", REQUEUED_HELP)
+            "hvd_serve_requeued_total", REQUEUED_HELP, pl or None)
         self._m_rejected = R.counter(
-            "hvd_serve_fleet_rejected_total", FLEET_REJECTED_HELP)
+            "hvd_serve_fleet_rejected_total", FLEET_REJECTED_HELP,
+            pl or None)
         self._m_router = {
             leg: R.histogram(
-                "hvd_serve_router_ms", ROUTER_MS_HELP, {"leg": leg})
+                "hvd_serve_router_ms", ROUTER_MS_HELP,
+                dict(pl, leg=leg))
             for leg in ("dispatch", "e2e")}
         self._m_failover_ms = R.histogram(
-            "hvd_serve_failover_ms", FAILOVER_MS_HELP)
+            "hvd_serve_failover_ms", FAILOVER_MS_HELP, pl or None)
         self._m_respawns = R.counter(
-            "hvd_serve_respawns_total",
-            "replica worker processes respawned after ejection")
+            "hvd_serve_respawns_total", RESPAWNS_HELP, pl or None)
         self._m_capacity = R.gauge(
-            "hvd_serve_fleet_capacity",
-            "replicas currently admitted (up) in the process fleet")
+            "hvd_serve_fleet_capacity", FLEET_CAPACITY_HELP,
+            pl or None)
 
     # -- events --------------------------------------------------------------
     def add_listener(self, fn: Callable[[dict], None]) -> None:
@@ -438,15 +460,29 @@ class ProcessFleetRouter:
         return len(self.replicas) / max(up, 1)
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               deadline_ms: Optional[float] = None) -> FleetHandle:
+               deadline_ms: Optional[float] = None,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int = 0) -> FleetHandle:
         """Route a request; returns a :class:`FleetHandle`. Raises
         :class:`Rejected` synchronously only when the fleet cannot
         accept at all (draining, zero live replicas) — queue-level
         shed from the workers resolves the handle as ``rejected``
         asynchronously, always with a ``retry_after_ms`` scaled to
-        live capacity."""
+        live capacity. Sampling controls ride the same at-most-once
+        bookkeeping as greedy requests: seeded streams are
+        deterministic across re-dispatch, so a failover replays the
+        SAME tokens (validated here, fail-fast, mirroring the worker
+        queue's door checks — a bad value must be a 400, not an async
+        shed)."""
         if not self.started:
             raise RuntimeError("ProcessFleetRouter.start() first")
+        temperature, top_p = float(temperature), float(top_p)
+        if not (temperature >= 0.0):
+            raise ValueError(
+                f"temperature must be >= 0 (0 = greedy); got "
+                f"{temperature!r}")
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1]; got {top_p!r}")
         t0 = time.monotonic()
         if self.draining:
             self._m_rejected.inc()
@@ -486,7 +522,9 @@ class ProcessFleetRouter:
         handle.on_done = self._release_slot   # exactly once, on the
         tr = _Tracked(fid, [int(t) for t in prompt],   # accepted
                       int(max_new_tokens),             # resolution
-                      t0 + deadline_ms / 1000.0, t0, handle)
+                      t0 + deadline_ms / 1000.0, t0, handle,
+                      temperature=temperature, top_p=top_p,
+                      seed=int(seed))
         threading.Thread(
             target=self._run_request, args=(tr,), daemon=True,
             name=f"hvd-procfleet-dispatch-{fid}").start()
@@ -609,6 +647,12 @@ class ProcessFleetRouter:
         ejected) stops it."""
         fid = f"{self._fid_ns}.{tr.fid}"
         addr = rep.addr
+        submit_msg = {
+            "op": "submit", "fid": fid, "prompt": tr.prompt,
+            "max_new_tokens": tr.max_new_tokens,
+            "deadline_ms": remaining_ms,
+            "temperature": tr.temperature, "top_p": tr.top_p,
+            "seed": tr.seed}
 
         def attempt() -> Tuple[str, dict]:
             if _chaos._INJ is not None:
@@ -622,11 +666,7 @@ class ProcessFleetRouter:
                     # the replay must be served the deduped result
                     s = wire.connect(addr, timeout=2.0)
                     try:
-                        wire.send_msg(s, {
-                            "op": "submit", "fid": fid,
-                            "prompt": tr.prompt,
-                            "max_new_tokens": tr.max_new_tokens,
-                            "deadline_ms": remaining_ms})
+                        wire.send_msg(s, submit_msg)
                         time.sleep(0.01)   # let the frame land
                     finally:
                         s.close()
@@ -637,22 +677,10 @@ class ProcessFleetRouter:
                     raise wire.DispatchConnError(
                         f"chaos: injected flaky drop at serve.dispatch "
                         f"(replica {rep.id})")
-            sock = wire.connect(addr, timeout=2.0)
-            try:
-                wire.send_msg(sock, {
-                    "op": "submit", "fid": fid, "prompt": tr.prompt,
-                    "max_new_tokens": tr.max_new_tokens,
-                    "deadline_ms": remaining_ms})
-                ack = wire.recv_msg(sock, timeout=10.0)
-                if ack.get("ack") != "accepted":
-                    return ("ctrl", ack)
-                if on_ack is not None:
-                    on_ack()
-                reply = wire.recv_msg(
-                    sock, timeout=remaining_ms / 1000.0 + 35.0)
-                return ("ok", reply)
-            finally:
-                sock.close()
+            return wire.two_frame_request(
+                addr, submit_msg,
+                reply_timeout=remaining_ms / 1000.0 + 35.0,
+                on_ack=on_ack)
 
         return self._ladder.run(
             attempt, what=f"dispatch(fid {fid})",
@@ -898,16 +926,11 @@ class ProcessFleetRouter:
             "last_failover_ms": self.last_failover_ms,
         }
 
-    def healthz(self) -> dict:
-        """The fleet front door's aggregate liveness payload
-        (serve/http.py ``make_fleet_server``): per-replica
-        up/draining/respawning plus LIVE capacity (free queue depth and
-        free KV blocks summed over admitted replicas). ``ok`` is False
-        — the HTTP face answers 503 — once live capacity is zero.
-        Shape built by the shared ``fleet.aggregate_healthz``; this
-        router sources the per-replica facts from its health-poll
-        cache (the workers are separate processes)."""
-        from .fleet import aggregate_healthz
+    def healthz_infos(self) -> Dict[int, dict]:
+        """Per-replica healthz facts from the health-poll cache — the
+        ``aggregate_healthz`` input, exposed separately so a pool-split
+        router (serve/disagg.py) can merge several pools' infos into
+        one front-door payload."""
         max_q = int(self.worker_cfg.get("max_queue", 64))
         infos = {}
         for rid, rep in self.replicas.items():
@@ -925,6 +948,18 @@ class ProcessFleetRouter:
                 info["kv_blocks_total"] = h["kv_blocks_total"]
                 info["kv_blocks_in_use"] = h.get("kv_blocks_in_use", 0)
             infos[rid] = info
+        return infos
+
+    def healthz(self) -> dict:
+        """The fleet front door's aggregate liveness payload
+        (serve/http.py ``make_fleet_server``): per-replica
+        up/draining/respawning plus LIVE capacity (free queue depth and
+        free KV blocks summed over admitted replicas). ``ok`` is False
+        — the HTTP face answers 503 — once live capacity is zero.
+        Shape built by the shared ``fleet.aggregate_healthz``; this
+        router sources the per-replica facts from its health-poll
+        cache (the workers are separate processes)."""
+        from .fleet import aggregate_healthz
         return aggregate_healthz(
-            infos, draining=self.draining,
+            self.healthz_infos(), draining=self.draining,
             retry_after_ms=SHED_BASE_MS * self._capacity_scale())

@@ -72,6 +72,12 @@ class ServeRequest:
     temperature: float = 0.0
     top_p: float = 1.0
     seed: int = 0
+    #: disaggregated prefill (serve/disagg.py): when True the batcher
+    #: PARKS the sequence's KV (row + blocks stay allocated) at clean
+    #: retirement instead of freeing it, so the endpoint can migrate
+    #: the blocks to a decode replica (serve/kv_migrate.py). Parked
+    #: rows are released by release_parked() or reaped past deadline.
+    hold_kv: bool = False
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (now if now is not None else time.monotonic()) > self.deadline
@@ -208,7 +214,7 @@ class AdmissionQueue:
                on_resolve: Optional[Callable[[ServeHandle],
                                              None]] = None,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int = 0) -> ServeHandle:
+               seed: int = 0, hold_kv: bool = False) -> ServeHandle:
         """Admit a request or raise `Rejected` (load shed / unservable).
 
         ``temperature`` / ``top_p`` / ``seed`` ride the request into
@@ -264,7 +270,7 @@ class AdmissionQueue:
                                deadline=now + dl / 1000.0,
                                submitted_at=now,
                                temperature=temperature, top_p=top_p,
-                               seed=seed)
+                               seed=seed, hold_kv=bool(hold_kv))
             req.handle = ServeHandle(rid, on_resolve=on_resolve)
             self._dq.append(req)
             self._m_admitted.inc()

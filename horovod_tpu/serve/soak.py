@@ -136,8 +136,12 @@ def evaluate_serve(records: List[dict], events: List[dict], plan,
 
     # -- KV containment: the scheduled corruption actually flipped
     # bytes AND the crc caught it (a plan that schedules a corrupt
-    # which never lands proves nothing — fail, don't skip)
-    has_corrupt = any(f.kind == "corrupt" for f in plan.faults)
+    # which never lands proves nothing — fail, don't skip). Keyed on
+    # the serve.kv site: a serve.migrate corrupt is the DISAGG soak's
+    # business (evaluate_disagg migrate_corrupt_caught), not this
+    # counter pair's.
+    has_corrupt = any(f.kind == "corrupt" and f.site == "serve.kv"
+                      for f in plan.faults)
     if has_corrupt:
         v["kv_containment"] = kv_injected > 0 and \
             kv_detected >= kv_injected
@@ -279,6 +283,396 @@ def evaluate_fleet(records: List[dict], events: List[dict], plan,
         "ok", "blips_absorbed", "failovers_only_kills",
         "replays_deduped", "respawned_on_newest"))
     return v
+
+
+def evaluate_disagg(records: List[dict], events: List[dict], plan,
+                    fleet_stats: dict, *, replicas: int,
+                    suspect_s: float, slo_p99_ms: float,
+                    slo_error_rate: float, recovery_window_s: float,
+                    newest_version: Optional[int],
+                    migrations_in: int, migrate_absorbed: int,
+                    migrate_corrupt_detected: int,
+                    reprefills: int) -> dict:
+    """The DISAGGREGATED-fleet verdict: everything
+    :func:`evaluate_serve` asserts (no silent drops, answered-once,
+    shed-carries-retry-after, bounded failover for the SIGKILLed
+    prefill worker, SLO outside recovery windows, capacity restored on
+    the newest weights), plus the migration-plane invariants:
+
+    * **migrations_ok** — KV-block migration actually carried traffic
+      (decode-pool installs > 0): a soak where every request happened
+      to resolve at prefill proves nothing about the new plane.
+    * **migrate_corrupt_caught** — the scheduled ``serve.migrate``
+      ``corrupt`` (one payload bit flipped BEFORE framing, so the
+      frame crc passes) was caught by the per-BLOCK crc ledger on
+      arrival, before any token could be generated from the blocks.
+    * **migrate_blips_recovered** — the scheduled ``conn_reset``
+      (socket severed AFTER the kv_install frame landed) was survived:
+      either the push ladder's replay was served the decode endpoint's
+      deduped install ack (``migrate_absorbed`` > 0), or the request
+      re-prefilled exactly once (``reprefills`` counts stay bounded by
+      the at-most-once bookkeeping either way).
+    * **failovers_only_kills** — pool ejections equal exactly the
+      scheduled process kills: neither migration chaos kind may
+      escalate into an ejection.
+    * **respawned_on_newest** — the killed prefill worker re-admitted
+      on the newest published weight version.
+    """
+    v = evaluate_serve(
+        records, events, plan, fleet_stats, replicas=replicas,
+        suspect_s=suspect_s, slo_p99_ms=slo_p99_ms,
+        slo_error_rate=slo_error_rate,
+        recovery_window_s=recovery_window_s,
+        newest_version=newest_version, kv_injected=0, kv_detected=0)
+    kills = [f for f in plan.faults if f.kind == "crash"]
+    v["migrations_in"] = int(migrations_in)
+    v["migrate_absorbed"] = int(migrate_absorbed)
+    v["migrate_corrupt_detected"] = int(migrate_corrupt_detected)
+    v["reprefills"] = int(reprefills)
+    v["respawns"] = fleet_stats.get("respawns", 0)
+    v["migrations_ok"] = migrations_in > 0
+    if any(f.site == "serve.migrate" and f.kind == "corrupt"
+           for f in plan.faults):
+        v["migrate_corrupt_caught"] = migrate_corrupt_detected > 0
+    if any(f.site == "serve.migrate" and f.kind == "conn_reset"
+           for f in plan.faults):
+        v["migrate_blips_recovered"] = (migrate_absorbed > 0
+                                        or reprefills > 0)
+    v["failovers_only_kills"] = \
+        fleet_stats.get("failovers", 0) == len(kills)
+    if kills:
+        victim = kills[0].peer
+        readmit = next((e for e in events
+                        if e.get("kind") == "fleet"
+                        and e.get("event") == "readmit"
+                        and e.get("replica") == victim), None)
+        v["respawned_on_newest"] = (
+            readmit is not None and newest_version is not None
+            and readmit.get("weights_version") == newest_version)
+    v["ok"] = all(v.get(k) is not False for k in (
+        "ok", "migrations_ok", "migrate_corrupt_caught",
+        "migrate_blips_recovered", "failovers_only_kills",
+        "respawned_on_newest"))
+    return v
+
+
+def run_disagg_soak(out_dir: Optional[str] = None, *,
+                    prefill: int = 2,
+                    decode: int = 1,
+                    clients: int = 4,
+                    seed: int = 0, plan=None,
+                    steps: int = DEFAULT_STEPS,
+                    suspect_s: float = FLEET_SUSPECT_S,
+                    interval_s: float = DEFAULT_INTERVAL_S,
+                    slo_p99_ms: float = DEFAULT_SLO_P99_MS,
+                    slo_error_rate: float = DEFAULT_SLO_ERROR_RATE,
+                    recovery_window_s: float = 8.0,
+                    min_duration_s: float = 8.0,
+                    max_duration_s: float = 180.0,
+                    max_new_tokens: int = 8,
+                    deadline_ms: float = 20000.0,
+                    spec_k: int = 0,
+                    kv_crc: Optional[bool] = None,
+                    prefix_cache: Optional[bool] = None,
+                    spawn_timeout_s: float = 120.0) -> dict:
+    """The DISAGGREGATED serve soak (acceptance for the disagg
+    tentpole): ``prefill`` + ``decode`` worker processes behind a
+    :class:`~horovod_tpu.serve.disagg.DisaggRouter`, a seeded
+    disagg-profile plan (one PREFILL worker SIGKILLed mid-traffic, a
+    ``serve.migrate`` ``conn_reset`` severing a migration after its
+    frame landed, a ``corrupt`` flipping a payload bit the block crc
+    must catch), closed-loop traffic, and a v2 weight publish
+    mid-incident. Returns the :func:`evaluate_disagg` verdict; never
+    raises on a failed invariant."""
+    import tempfile
+
+    from ..chaos import inject
+    from ..native.store import StoreServer
+    from ..redist.stream import WeightPublisher
+    from .disagg import DisaggRouter
+    from .worker import tiny_gpt_builder
+
+    from ..chaos.plan import ChaosPlan, random_plan
+    if plan is None or plan == "random":
+        resolved = random_plan(seed, prefill + decode, steps,
+                               profile="disagg", prefill=prefill)
+    elif isinstance(plan, ChaosPlan):
+        resolved = plan
+    else:
+        resolved = ChaosPlan.parse(str(plan))
+
+    work_dir = out_dir or tempfile.mkdtemp(prefix="hvd_disagg_soak.")
+    os.makedirs(work_dir, exist_ok=True)
+    events_dir = os.path.join(work_dir, "worker_events")
+    channel = f"disaggsoak{seed}"
+
+    events: List[dict] = []
+    records: List[dict] = []
+    ev_lock = threading.Lock()
+
+    def log_event(kind: str, ev: dict) -> None:
+        with ev_lock:
+            events.append(dict(ev, kind=kind))
+
+    srv = StoreServer()
+    built = tiny_gpt_builder(seed=seed, paged=True, draft=spec_k > 0)
+    pub = WeightPublisher(channel, kv_addr="127.0.0.1",
+                          kv_port=srv.port, resume_timeout=0.05)
+    pub.publish(built["params"])              # version 1, pre-incident
+
+    stop = threading.Event()
+    torn_down = []
+    router = None
+
+    def _teardown() -> None:
+        # idempotent and reached on EVERY exit path — INCLUDING a
+        # router-construction or injector-install failure, so the
+        # store server/publisher/global injector never leak into the
+        # caller's process, and the two pools' real OS processes
+        # never outlive the soak
+        if torn_down:
+            return
+        torn_down.append(True)
+        stop.set()
+        if router is not None:
+            try:
+                router.close()
+            except Exception:  # noqa: BLE001
+                pass
+        inject.uninstall()
+        try:
+            pub.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            srv.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    try:
+        worker = {
+            "builder": "horovod_tpu.serve.worker:tiny_gpt_builder",
+            "builder_kwargs": {"seed": seed, "paged": True,
+                               "draft": spec_k > 0},
+            "buckets": [8], "max_queue": max(32, 4 * clients),
+            "deadline_ms": deadline_ms,
+            "kv_crc": True if kv_crc is None else kv_crc,
+            "spec_k": spec_k,
+            "prefix_cache": True if prefix_cache is None
+            else prefix_cache}
+        router = DisaggRouter(
+            prefill, decode, kv_addr="127.0.0.1", kv_port=srv.port,
+            prefill_worker=dict(worker, spec_k=0),
+            decode_worker=worker,
+            channel=channel, ns=f"dsoak{seed}", interval_s=interval_s,
+            suspect_s=suspect_s, chaos_plan=resolved,
+            events_dir=events_dir,
+            log_dir=os.path.join(work_dir, "logs"),
+            spawn_timeout_s=spawn_timeout_s)
+        router.add_listener(lambda ev: log_event("fleet", ev))
+
+        inj = inject.install(resolved, rank=0)
+        inj.add_listener(lambda ev: log_event(
+            "chaos", {"fault": ev["kind"],
+                      **{k: x for k, x in ev.items() if k != "kind"}}))
+
+        crash_scheduled = any(f.kind == "crash"
+                              for f in resolved.faults)
+        eject_seen = threading.Event()
+        if not crash_scheduled:
+            eject_seen.set()
+
+        def watch_eject(ev):
+            if ev.get("event") == "eject":
+                eject_seen.set()
+        router.add_listener(watch_eject)
+
+        return _disagg_soak_body(
+            router, resolved, events, records, ev_lock, events_dir,
+            work_dir, pub, built, eject_seen, stop, _teardown,
+            prefill=prefill, decode=decode, clients=clients,
+            suspect_s=suspect_s, slo_p99_ms=slo_p99_ms,
+            slo_error_rate=slo_error_rate,
+            recovery_window_s=recovery_window_s,
+            min_duration_s=min_duration_s,
+            max_duration_s=max_duration_s,
+            max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
+            spec_k=spec_k)
+    finally:
+        _teardown()
+
+
+def _disagg_soak_body(router, resolved, events, records, ev_lock,
+                      events_dir, work_dir, pub, built, eject_seen,
+                      stop, teardown, *, prefill, decode, clients,
+                      suspect_s, slo_p99_ms, slo_error_rate,
+                      recovery_window_s, min_duration_s,
+                      max_duration_s, max_new_tokens, deadline_ms,
+                      spec_k) -> dict:
+    """The guarded body of :func:`run_disagg_soak` — every exit path
+    runs the caller's teardown."""
+    import glob
+
+    from .queue import Rejected
+
+    router.start()
+    replicas = prefill + decode
+
+    def publish_fresh():
+        eject_seen.wait(timeout=max_duration_s / 2.0)
+        time.sleep(0.5)
+        try:
+            pub.publish(built["params"])      # version 2, same values
+        except Exception as e:  # noqa: BLE001
+            logger.error("disagg soak: mid-incident publish failed: "
+                         "%s", e)
+
+    threading.Thread(target=publish_fresh, daemon=True).start()
+
+    rec_lock = threading.Lock()
+
+    def client(cid: int) -> None:
+        import numpy as np
+        rng = np.random.RandomState(30_000 + cid)
+        while not stop.is_set():
+            prompt = list(rng.randint(1, 64, int(rng.randint(2, 8))))
+            # WALL-clock stamps: the verdict intersects these with the
+            # event ledger's time.time() recovery windows
+            t0 = time.time()
+            rec = {"fid": None, "t0": t0, "t1": None,
+                   "status": "pending", "latency_ms": None,
+                   "retry_after_ms": None, "resolutions": 0,
+                   "replica": None, "client": cid}
+            try:
+                h = router.submit(prompt,
+                                  max_new_tokens=max_new_tokens)
+            except Rejected as e:
+                rec.update(status="shed",
+                           retry_after_ms=e.retry_after_ms,
+                           t1=time.time())
+                with rec_lock:
+                    records.append(rec)
+                time.sleep(min((e.retry_after_ms or 100.0), 500.0)
+                           / 1000.0)
+                continue
+            h.wait(timeout=deadline_ms / 1000.0 + 60.0)
+            rec.update(fid=h.fid, t1=time.time(),
+                       status=h.status, latency_ms=h.latency_ms,
+                       retry_after_ms=h.retry_after_ms,
+                       resolutions=h.resolutions, replica=h.replica)
+            with rec_lock:
+                records.append(rec)
+            if h.status == "rejected" and h.retry_after_ms:
+                time.sleep(min(h.retry_after_ms, 500.0) / 1000.0)
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    def worker_chaos_events() -> List[dict]:
+        out = []
+        for path in sorted(glob.glob(
+                os.path.join(events_dir, "*.events.jsonl"))):
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        out.append({"kind": "chaos",
+                                    "fault": ev.get("kind"),
+                                    **{k: x for k, x in ev.items()
+                                       if k != "kind"}})
+            except (OSError, ValueError):
+                # resilience: exempt (local event-ledger file read —
+                # a half-written line is re-read next poll)
+                continue
+        return out
+
+    want = {(f.site, f.kind, f.peer) for f in resolved.faults
+            if f.kind != "flaky"}
+
+    def faults_all_fired(worker_evs: List[dict]) -> bool:
+        with ev_lock:
+            got = {(e.get("site"), e.get("fault"), e.get("peer"))
+                   for e in events if e.get("kind") == "chaos"}
+        got |= {(e.get("site"), e.get("fault"), e.get("peer"))
+                for e in worker_evs}
+        return want <= got
+
+    def recovered() -> bool:
+        s = router.stats()
+        newest = pub._version
+        return (s["replicas_up"] == replicas and newest >= 2
+                and all(r["weights_version"] == newest
+                        for r in s["replicas"].values()))
+
+    dwell_s = 2 * suspect_s + 1.0
+    last_unhealed = time.monotonic()
+    while time.monotonic() - t_start < max_duration_s:
+        if not (faults_all_fired(worker_chaos_events())
+                and recovered()):
+            last_unhealed = time.monotonic()
+        elif time.monotonic() - last_unhealed >= dwell_s \
+                and time.monotonic() - t_start >= min_duration_s:
+            break
+        time.sleep(0.25)
+    stop.set()
+    for t in threads:
+        t.join(timeout=deadline_ms / 1000.0 + 65.0)
+
+    # final evidence pulls, per replica with the cached-sweep fallback
+    # (same rule as the fleet soak: one missed last poll must not
+    # evaporate evidence a fault DID recover)
+    migrations_in = migrate_corrupt = migrate_absorbed = 0
+    for pool in (router.prefill, router.decode):
+        for rep in pool.replicas.values():
+            h = pool._fetch_healthz(rep, timeout=1.0) or \
+                rep.healthz_cache or {}
+            migrations_in += int(h.get("migrations_in") or 0)
+            migrate_corrupt += int(
+                h.get("migrate_corrupt_detected") or 0)
+            migrate_absorbed += int(h.get("migrate_absorbed") or 0)
+    fleet_stats = router.stats()
+    newest_version = pub._version
+    worker_evs = worker_chaos_events()
+    with ev_lock:
+        all_events = sorted(events + worker_evs,
+                            key=lambda e: e.get("t", 0.0))
+    teardown()
+
+    verdict = evaluate_disagg(
+        records, all_events, resolved, fleet_stats,
+        replicas=replicas, suspect_s=suspect_s,
+        slo_p99_ms=slo_p99_ms, slo_error_rate=slo_error_rate,
+        recovery_window_s=recovery_window_s,
+        newest_version=newest_version,
+        migrations_in=migrations_in,
+        migrate_absorbed=migrate_absorbed,
+        migrate_corrupt_detected=migrate_corrupt,
+        reprefills=fleet_stats.get("reprefills", 0))
+    verdict.update({
+        "seed": resolved.seed, "prefill": prefill, "decode": decode,
+        "clients": clients, "processes": True, "disagg": True,
+        "spec_k": int(spec_k), "suspect_s": suspect_s,
+        "wall_s": round(time.monotonic() - t_start, 2),
+        "plan": json.loads(resolved.to_json()),
+        "fleet": fleet_stats,
+        "out_dir": work_dir,
+    })
+    with open(os.path.join(work_dir, "events.jsonl"), "w") as f:
+        for e in all_events:
+            f.write(json.dumps(e, default=str) + "\n")
+    with open(os.path.join(work_dir, "requests.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    with open(os.path.join(work_dir, "verdict.json"), "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    return verdict
 
 
 def run_fleet_soak(out_dir: Optional[str] = None, *,

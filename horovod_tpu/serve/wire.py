@@ -1,4 +1,4 @@
-"""Framed JSON wire protocol for the multi-process serve fleet.
+"""Framed wire protocol for the multi-process serve fleet.
 
 The dispatch channel between a :class:`~horovod_tpu.serve.proc_fleet.
 ProcessFleetRouter` and its replica worker processes
@@ -15,24 +15,62 @@ router's retry ladder absorbs it in milliseconds; timeouts and
 protocol garbage stay fatal and escalate exactly like every other
 wire plane (docs/chaos.md).
 
-Frame: 4-byte big-endian length + UTF-8 JSON object. One request per
-connection for the submit path (the reply can be seconds away — a
-generation — and a one-shot socket keeps replay-after-reconnect
-trivially safe: the worker dedupes on the request ``fid``, mirroring
-the csrc/store.cc nonce pattern).
+Two frame types share one length-prefixed framing:
+
+* **JSON frame**: 4-byte big-endian length + UTF-8 JSON object. One
+  request per connection for the submit path (the reply can be seconds
+  away — a generation — and a one-shot socket keeps
+  replay-after-reconnect trivially safe: the worker dedupes on the
+  request ``fid``, mirroring the csrc/store.cc nonce pattern).
+* **BINARY frame** (KV-block migration, serve/kv_migrate.py): the high
+  bit of the length word marks a frame carrying a JSON header PLUS a
+  raw byte payload — ``[len|BIN][4B header len][header JSON][payload]``
+  — so migrated KV blocks ride the wire as bytes with a crc32 in the
+  header (the redist framing discipline), never base64 inside JSON.
+
+The frame ceiling is the declared knob ``HOROVOD_SERVE_WIRE_MAX_FRAME``
+(docs/knobs.md): dispatch frames never approach it, but migration
+frames carry whole sequences' KV blocks and deployments with big
+models/pools raise it. Resolved once per process through
+``core/config.py`` (strict parse, validated range).
 """
 from __future__ import annotations
 
 import json
 import socket
 import struct
+import zlib
 from typing import Optional, Tuple
 
 from ..native import resilience
 
-#: a healthz/ack reply must fit here; submit replies carry at most
-#: max_new_tokens ints — far below this
+#: default frame ceiling (bytes) — the HOROVOD_SERVE_WIRE_MAX_FRAME
+#: knob's default, kept importable for back-compat and the config
+#: dataclass default (core/config.py serve_wire_max_frame)
 MAX_FRAME_BYTES = 4 << 20
+
+#: high bit of the length word: this frame is binary (header + payload)
+_BIN_FLAG = 0x80000000
+
+_max_frame_cached: Optional[int] = None
+
+
+def max_frame_bytes() -> int:
+    """The live frame ceiling: ``HOROVOD_SERVE_WIRE_MAX_FRAME``
+    strict-parsed through ``Config.from_env`` once per process (every
+    endpoint and router shares one resolution; a malformed value fails
+    the first wire call loudly instead of silently shrinking frames)."""
+    global _max_frame_cached
+    if _max_frame_cached is None:
+        from ..core.config import Config
+        _max_frame_cached = int(Config.from_env().serve_wire_max_frame)
+    return _max_frame_cached
+
+
+def _reset_max_frame_cache() -> None:
+    """Test hook: re-resolve the ceiling from the environment."""
+    global _max_frame_cached
+    _max_frame_cached = None
 
 
 class DispatchConnError(RuntimeError, resilience.Retryable):
@@ -73,12 +111,35 @@ def connect(addr: Tuple[str, int], timeout: float) -> socket.socket:
 
 def send_msg(sock: socket.socket, obj: dict) -> None:
     raw = json.dumps(obj).encode()
-    if len(raw) > MAX_FRAME_BYTES:
+    limit = max_frame_bytes()
+    if len(raw) > limit:
         raise DispatchError(
-            f"frame of {len(raw)} bytes exceeds MAX_FRAME_BYTES "
-            f"({MAX_FRAME_BYTES})")
+            f"frame of {len(raw)} bytes exceeds "
+            f"HOROVOD_SERVE_WIRE_MAX_FRAME ({limit})")
     try:
         sock.sendall(struct.pack(">I", len(raw)) + raw)
+    except OSError as e:
+        # resilience classifier decides retryable vs fatal
+        raise _classify(e, "send") from None
+
+
+def send_bin(sock: socket.socket, obj: dict, payload: bytes) -> None:
+    """Send a BINARY frame: JSON header ``obj`` plus raw ``payload``
+    bytes. The header should carry a crc32 of the payload (the
+    migration layer stamps ``payload_crc``); :func:`recv_any` verifies
+    it on the far side so in-flight corruption is caught at the frame
+    boundary, same discipline as redist/transport.py."""
+    head = json.dumps(obj).encode()
+    total = 4 + len(head) + len(payload)
+    limit = max_frame_bytes()
+    if total > limit:
+        raise DispatchError(
+            f"binary frame of {total} bytes exceeds "
+            f"HOROVOD_SERVE_WIRE_MAX_FRAME ({limit}) — raise the knob "
+            f"for KV-migration payloads this large")
+    try:
+        sock.sendall(struct.pack(">II", total | _BIN_FLAG, len(head))
+                     + head + payload)
     except OSError as e:
         # resilience classifier decides retryable vs fatal
         raise _classify(e, "send") from None
@@ -88,7 +149,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         try:
-            got = sock.recv(n - len(buf))
+            got = sock.recv(min(n - len(buf), 1 << 20))
         except OSError as e:
             # resilience classifier decides retryable vs fatal
             raise _classify(e, "recv") from None
@@ -99,20 +160,51 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def recv_msg(sock: socket.socket,
-             timeout: Optional[float] = None) -> dict:
-    """Read one frame; EOF/reset raise the Retryable
-    :class:`DispatchConnError`, a timeout raises the fatal
+def recv_any(sock: socket.socket,
+             timeout: Optional[float] = None
+             ) -> Tuple[dict, Optional[bytes]]:
+    """Read one frame of either type; returns ``(obj, payload)`` where
+    ``payload`` is None for plain JSON frames. EOF/reset raise the
+    Retryable :class:`DispatchConnError`, a timeout raises the fatal
     :class:`DispatchError` (the reply bound elapsed — retrying would
-    mask a stalled replica the router should fail over instead)."""
+    mask a stalled replica the router should fail over instead). A
+    binary frame whose ``payload_crc`` header does not match the
+    received bytes raises :class:`DispatchError` — corruption on this
+    wire is NOT retryable blindly; the migration layer re-packs from
+    the source ledger instead."""
     if timeout is not None:
         sock.settimeout(timeout)
-    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
-    if n > MAX_FRAME_BYTES:
+    (word,) = struct.unpack(">I", _recv_exact(sock, 4))
+    is_bin = bool(word & _BIN_FLAG)
+    n = word & ~_BIN_FLAG
+    limit = max_frame_bytes()
+    if n > limit:
         raise DispatchError(
-            f"peer announced a {n}-byte frame (> {MAX_FRAME_BYTES}) — "
-            f"protocol garbage, not retryable")
-    raw = _recv_exact(sock, n)
+            f"peer announced a {n}-byte frame "
+            f"(> HOROVOD_SERVE_WIRE_MAX_FRAME {limit}) — protocol "
+            f"garbage, not retryable")
+    if not is_bin:
+        raw = _recv_exact(sock, n)
+        return _decode_obj(raw), None
+    if n < 4:
+        raise DispatchError(
+            f"binary frame of {n} bytes cannot hold its header length")
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if hlen > n - 4:
+        raise DispatchError(
+            f"binary frame header length {hlen} exceeds the frame "
+            f"({n} bytes)")
+    obj = _decode_obj(_recv_exact(sock, hlen))
+    payload = _recv_exact(sock, n - 4 - hlen)
+    want = obj.get("payload_crc")
+    if want is not None and zlib.crc32(payload) != int(want):
+        raise DispatchError(
+            f"binary frame payload failed crc32 "
+            f"({zlib.crc32(payload)} != {want}) — corrupted in flight")
+    return obj, payload
+
+
+def _decode_obj(raw: bytes) -> dict:
     try:
         obj = json.loads(raw.decode())
     except ValueError as e:
@@ -121,3 +213,36 @@ def recv_msg(sock: socket.socket,
         raise DispatchError(
             f"frame must be a JSON object; got {type(obj).__name__}")
     return obj
+
+
+def recv_msg(sock: socket.socket,
+             timeout: Optional[float] = None) -> dict:
+    """Read one frame and return its JSON object (a binary frame's
+    payload is dropped — callers that expect KV bytes use
+    :func:`recv_any`)."""
+    obj, _ = recv_any(sock, timeout)
+    return obj
+
+
+def two_frame_request(addr: Tuple[str, int], msg: dict, *,
+                      connect_timeout: float = 2.0,
+                      ack_timeout: float = 10.0,
+                      reply_timeout: float = 30.0,
+                      on_ack=None) -> Tuple[str, dict]:
+    """THE dispatch exchange every router leg speaks: dial, send one
+    request frame, read the control ack, then block for the (possibly
+    seconds-away) reply. Returns ``("ctrl", ack)`` when the peer's
+    door answered anything but ``accepted``, else ``("ok", reply)``.
+    One shared shape so the submit / result / requeue legs cannot
+    drift on timeouts or the ack contract."""
+    sock = connect(addr, timeout=connect_timeout)
+    try:
+        send_msg(sock, msg)
+        ack = recv_msg(sock, timeout=ack_timeout)
+        if ack.get("ack") != "accepted":
+            return ("ctrl", ack)
+        if on_ack is not None:
+            on_ack()    # the dispatch-leg latency hook
+        return ("ok", recv_msg(sock, timeout=reply_timeout))
+    finally:
+        sock.close()

@@ -125,13 +125,18 @@ class ReplicaEndpoint:
         #: that a lost reply never becomes a duplicate execution
         self.dedupe_hits = 0
         self.submits = 0
+        #: in-progress kv_install entries keyed by fid: a replayed
+        #: install arriving while the original is still installing
+        #: joins its outcome instead of double-installing
+        self._installing: Dict[str, dict] = {}
         ep = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
-                    msg = wire.recv_msg(self.request, timeout=30.0)
-                    ep._handle(self.request, msg)
+                    msg, payload = wire.recv_any(self.request,
+                                                 timeout=30.0)
+                    ep._handle(self.request, msg, payload)
                 except (wire.DispatchConnError, wire.DispatchError,
                         OSError):
                     # resilience: exempt (the client vanished or spoke
@@ -159,10 +164,17 @@ class ReplicaEndpoint:
         self._server.server_close()
 
     # -- request handling ---------------------------------------------------
-    def _handle(self, sock, msg: dict) -> None:
+    def _handle(self, sock, msg: dict,
+                payload: Optional[bytes] = None) -> None:
         op = msg.get("op")
         if op == "healthz":
             wire.send_msg(sock, self.healthz())
+            return
+        if op == "kv_install":
+            self._handle_kv_install(sock, msg, payload or b"")
+            return
+        if op in ("migrate", "release", "result"):
+            self._handle_disagg(sock, op, msg)
             return
         if op != "submit":
             wire.send_msg(sock, {"ack": "bad_request",
@@ -178,19 +190,7 @@ class ReplicaEndpoint:
         fid = str(msg["fid"])
         with self._lock:
             self.submits += 1
-            # lazily migrate resolved orphans (a client that vanished
-            # before the ack leaves its entry here) into the bounded
-            # done cache, so the in-flight table cannot grow past the
-            # queue's own bounds
-            for k in [k for k, h in self._inflight.items()
-                      if h.done()]:
-                h = self._inflight.pop(k)
-                self._done[k] = {"status": h.status,
-                                 "tokens": list(h.tokens),
-                                 "error": h.error,
-                                 "latency_ms": h.latency_ms}
-                while len(self._done) > self._dedupe_cap:
-                    self._done.popitem(last=False)
+            self._sweep_orphans_locked()
             cached = self._done.get(fid)
             handle = None if cached is not None \
                 else self._inflight.get(fid)
@@ -209,7 +209,11 @@ class ReplicaEndpoint:
                     handle = self.batcher.queue.submit(
                         msg["prompt"],
                         max_new_tokens=int(msg.get("max_new_tokens", 16)),
-                        deadline_ms=msg.get("deadline_ms"))
+                        deadline_ms=msg.get("deadline_ms"),
+                        temperature=float(msg.get("temperature", 0.0)),
+                        top_p=float(msg.get("top_p", 1.0)),
+                        seed=int(msg.get("seed", 0)),
+                        hold_kv=bool(msg.get("hold_kv", False)))
                 except AdmitDropped as e:
                     wire.send_msg(sock, {
                         "ack": "admit_dropped",
@@ -227,16 +231,41 @@ class ReplicaEndpoint:
                 self._inflight[fid] = handle
         # accepted (fresh or replayed): ack now, result when it lands
         wire.send_msg(sock, {"ack": "accepted"})
+        deadline_ms = msg.get("deadline_ms") \
+            or self.batcher.queue.default_deadline_ms
+        self._await_and_reply(sock, fid, handle, cached, deadline_ms)
+
+    def _record(self, handle) -> dict:
+        """The cached (replay-servable) rendering of a resolved
+        handle. ``rid`` rides along so the disagg ``migrate`` op can
+        find the parked sequence a hold_kv prefill left behind."""
+        return {"status": handle.status, "tokens": list(handle.tokens),
+                "error": handle.error, "latency_ms": handle.latency_ms,
+                "rid": handle.rid}
+
+    def _sweep_orphans_locked(self) -> None:
+        """Lazily migrate resolved orphans (a client that vanished
+        before the ack leaves its entry here) into the bounded done
+        cache, so the in-flight table cannot grow past the queue's own
+        bounds. Caller holds ``self._lock``."""
+        for k in [k for k, h in self._inflight.items() if h.done()]:
+            h = self._inflight.pop(k)
+            self._done[k] = self._record(h)
+            while len(self._done) > self._dedupe_cap:
+                self._done.popitem(last=False)
+
+    def _await_and_reply(self, sock, fid: str, handle,
+                         cached: Optional[dict],
+                         deadline_ms: float) -> None:
+        """The shared result tail of ``submit`` and ``result``: wait
+        out the handle (unless a cached record already answers the
+        replay), cache BEFORE sending — if the send dies with the
+        reply, the replay finds the result here."""
         if cached is None:
-            deadline_ms = msg.get("deadline_ms") \
-                or self.batcher.queue.default_deadline_ms
             handle.wait(timeout=float(deadline_ms) / 1000.0
                         + REPLY_GRACE_S)
             if handle.done():
-                cached = {"status": handle.status,
-                          "tokens": list(handle.tokens),
-                          "error": handle.error,
-                          "latency_ms": handle.latency_ms}
+                cached = self._record(handle)
             else:
                 # scheduler wedged past deadline + grace: a structured
                 # error, not a dropped socket (NOT cached — a replay
@@ -245,14 +274,203 @@ class ReplicaEndpoint:
                                      "error": "replica stalled",
                                      "tokens": [], "latency_ms": None})
                 return
-            # cache BEFORE sending: if this send dies with the reply,
-            # the replay finds the result here
             with self._lock:
                 self._done[fid] = cached
                 self._inflight.pop(fid, None)
                 while len(self._done) > self._dedupe_cap:
                     self._done.popitem(last=False)
         wire.send_msg(sock, cached)
+
+    # -- disaggregated serving ops (serve/disagg.py orchestration) ----------
+    def _handle_disagg(self, sock, op: str, msg: dict) -> None:
+        """``migrate`` / ``release`` / ``result``: the decode-pool and
+        prefill-pool halves of KV-block migration, addressed by the
+        SAME fid namespace (and dedupe discipline) as ``submit``."""
+        from . import kv_migrate
+        fid = str(msg.get("fid") or "")
+        if not fid:
+            wire.send_msg(sock, {"ack": "bad_request",
+                                 "error": f"{op} requires a fid"})
+            return
+        with self._lock:
+            self._sweep_orphans_locked()
+            cached = self._done.get(fid)
+            handle = self._inflight.get(fid)
+            if op == "result" and cached is not None:
+                self.dedupe_hits += 1
+        if op == "result":
+            # the decode-side completion wait: same contract as a
+            # submit's reply leg (ack, block, cached-replay dedupe)
+            if cached is None and handle is None:
+                wire.send_msg(sock, {"ack": "unknown_fid"})
+                return
+            wire.send_msg(sock, {"ack": "accepted"})
+            deadline_ms = msg.get("deadline_ms") \
+                or self.batcher.queue.default_deadline_ms
+            self._await_and_reply(sock, fid, handle, cached,
+                                  deadline_ms)
+            return
+        rid = cached.get("rid") if cached is not None else \
+            (handle.rid if handle is not None else None)
+        if rid is None:
+            wire.send_msg(sock, {"ack": "migrate_failed",
+                                 "reason": "unknown_fid"})
+            return
+        if op == "release":
+            self.batcher.release_parked(int(rid))
+            wire.send_msg(sock, {"ack": "released"})
+            return
+        # op == "migrate": pack the parked sequence and PUSH it to the
+        # decode endpoint the router chose (serve.migrate chaos +
+        # retry ladder live inside kv_migrate.push)
+        t0 = time.monotonic()
+        try:
+            packet = kv_migrate.pack_parked(
+                self.batcher, int(rid), fid=str(msg["dfid"]),
+                max_new_tokens=int(msg["max_new_tokens"]),
+                deadline_ms=float(msg.get("deadline_ms") or 30000.0))
+        except kv_migrate.MigrateCorrupt as e:
+            # the SOURCE blocks are untrusted: release them so the
+            # inevitable re-prefill runs on clean capacity
+            self.batcher.release_parked(int(rid))
+            wire.send_msg(sock, {"ack": "migrate_failed",
+                                 "reason": "source_corrupt",
+                                 "detail": str(e)[:200]})
+            return
+        except (KeyError, ValueError, TypeError) as e:
+            wire.send_msg(sock, {"ack": "bad_request",
+                                 "error": str(e)[:200]})
+            return
+        if packet is None:
+            wire.send_msg(sock, {"ack": "migrate_failed",
+                                 "reason": "not_parked"})
+            return
+        header, payload = packet
+        try:
+            target = (str(msg["target"][0]), int(msg["target"][1]))
+            ack = kv_migrate.push(target, header, payload,
+                                  peer=msg.get("peer"))
+        except (wire.DispatchConnError, wire.DispatchError) as e:
+            wire.send_msg(sock, {"ack": "migrate_failed",
+                                 "reason": "unreachable",
+                                 "detail": str(e)[:200]})
+            return
+        if ack.get("ack") == "installed":
+            # the blocks live on the decode replica now — free the
+            # parked row (scheduler-thread free, endpoint-safe)
+            self.batcher.release_parked(int(rid))
+            wire.send_msg(sock, {
+                "ack": "migrated", "bytes": len(payload),
+                "blocks": len(header["blocks"]),
+                "ms": round((time.monotonic() - t0) * 1000.0, 3),
+                "dedupe": bool(ack.get("dedupe", False))})
+            return
+        wire.send_msg(sock, {
+            "ack": "migrate_failed",
+            "reason": str(ack.get("ack", "unknown")),
+            "detail": ack.get("detail") or ack.get("error"),
+            "retry_after_ms": ack.get("retry_after_ms")})
+
+    def _handle_kv_install(self, sock, msg: dict,
+                           payload: bytes) -> None:
+        """Receive a migrated sequence (the decode-pool side): crc
+        verification + reservation-gated install ride
+        kv_migrate.install; the fid dedupe (done cache, in-flight
+        table, in-progress installs) makes a ladder REPLAY of a
+        severed push converge on one install and one ack."""
+        from . import kv_migrate
+        fid = str(msg.get("fid") or "")
+        if not fid:
+            wire.send_msg(sock, {"ack": "bad_request",
+                                 "error": "kv_install requires a fid"})
+            return
+        mine = False
+        with self._lock:
+            self._sweep_orphans_locked()
+            if fid in self._done or fid in self._inflight:
+                self.dedupe_hits += 1
+                ent = None
+            else:
+                ent = self._installing.get(fid)
+                if ent is None:
+                    mine = True
+                    ent = {"evt": threading.Event(), "outcome": None,
+                           "handle": None}
+                    self._installing[fid] = ent
+                else:
+                    self.dedupe_hits += 1
+        if ent is None:
+            # already installed (or even resolved): the replay of a
+            # severed push is served the same ack, never a second copy
+            wire.send_msg(sock, {"ack": "installed", "dedupe": True})
+            return
+        if mine:
+            try:
+                blocks = kv_migrate.unpack_blocks(msg, payload)
+            except kv_migrate.MigrateCorrupt as e:
+                self.batcher.note_migrate_corrupt()
+                self._finalize_install(fid, ent, ("corrupt", str(e)),
+                                       None)
+            else:
+                pending = self.batcher.submit_migrated(msg, blocks)
+                if pending["evt"].wait(
+                        kv_migrate.INSTALL_ACK_TIMEOUT_S):
+                    out = pending["outcome"]
+                    self._finalize_install(
+                        fid, ent, out,
+                        pending["handle"] if out[0] == "installed"
+                        else None)
+                else:
+                    # the decode scheduler has not picked the entry up
+                    # yet: the install is still PENDING, not dead. The
+                    # _installing entry stays registered so a ladder
+                    # replay JOINS this install instead of starting a
+                    # second one (the double-install the fid dedupe
+                    # exists to prevent), and a finisher thread
+                    # completes the bookkeeping — registering the
+                    # handle for the result op — whenever it lands.
+                    def finish():
+                        pending["evt"].wait(REPLY_GRACE_S * 10)
+                        out = pending["outcome"] or ("stalled", None)
+                        self._finalize_install(
+                            fid, ent, out,
+                            pending["handle"] if out[0] == "installed"
+                            else None)
+                    threading.Thread(
+                        target=finish, daemon=True,
+                        name=f"hvd-install-finish-{self.rid}").start()
+        else:
+            ent["evt"].wait(kv_migrate.INSTALL_ACK_TIMEOUT_S + 5.0)
+        outcome, detail = ent["outcome"] or ("stalled", None)
+        if outcome == "installed":
+            wire.send_msg(sock, {"ack": "installed",
+                                 "dedupe": not mine})
+        elif outcome == "corrupt":
+            wire.send_msg(sock, {"ack": "migrate_corrupt",
+                                 "detail": detail})
+        elif outcome == "version_mismatch":
+            wire.send_msg(sock, {"ack": "version_mismatch",
+                                 "detail": detail})
+        elif outcome == "rejected":
+            wire.send_msg(sock, {"ack": "rejected",
+                                 "retry_after_ms": detail})
+        else:
+            wire.send_msg(sock, {"ack": "bad_request",
+                                 "error": f"{outcome}: {detail}"})
+
+    def _finalize_install(self, fid: str, ent: dict, outcome: tuple,
+                          handle) -> None:
+        """Complete a kv_install's endpoint bookkeeping exactly once:
+        record the outcome, register the handle for the result op,
+        release the in-progress entry, wake every waiter (the original
+        requester and any replays that joined it)."""
+        with self._lock:
+            ent["outcome"] = outcome
+            ent["handle"] = handle
+            if handle is not None:
+                self._inflight[fid] = handle
+            self._installing.pop(fid, None)
+        ent["evt"].set()
 
     def healthz(self) -> dict:
         b = self.batcher
@@ -268,6 +486,18 @@ class ReplicaEndpoint:
         if getattr(b, "paged", False):
             info["kv_blocks_in_use"] = b.kv.pool.in_use()
             info["kv_blocks_total"] = b.kv.pool.num_blocks
+        # disaggregated-serving evidence (serve/disagg.py healthz +
+        # the disagg soak verdict read these per pool)
+        info["migrations_in"] = b.migrations_in
+        info["migrate_rejects"] = b.migrate_rejects
+        info["migrate_corrupt_detected"] = b.migrate_corrupt_detected
+        with b._parked_lock:
+            info["parked"] = len(b.parked)
+        from ..native.resilience import RETRIES_HELP
+        from ..obs import metrics as obs_metrics
+        info["migrate_absorbed"] = int(obs_metrics.get_registry().counter(
+            "hvd_net_retries_total", RETRIES_HELP,
+            {"site": "serve.migrate", "outcome": "absorbed"}).value)
         info.update(b.queue.counters())
         return info
 

@@ -1,0 +1,71 @@
+"""ISSUE 15 disaggregated-serving acceptance (slow tier): REAL
+prefill + decode worker OS processes behind a DisaggRouter, driven
+through the seeded ``profile="disagg"`` plan by the soak harness.
+
+The plan SIGKILLs one PREFILL worker mid-traffic, severs one
+KV-block migration with a ``serve.migrate`` ``conn_reset`` AFTER its
+frame landed, and flips a payload bit pre-framing inside a
+``corrupt`` window, while a fresh weight version is published
+mid-incident. The bar (docs/serving.md, disaggregation section):
+
+* migration actually carried traffic (decode-pool installs > 0),
+* the corrupt was caught by the per-BLOCK crc on arrival — before
+  any token could be generated from the migrated cache,
+* the severed migration recovered: the ladder replay was served the
+  decode endpoint's deduped install ack, or the request re-prefilled
+  exactly once,
+* migration chaos never escalated into an ejection (failovers ==
+  scheduled kills exactly),
+* the killed prefill worker was ejected by the accrual sweep within
+  2 x suspect_s and respawned on the newest published weights,
+* every request answered exactly once or shed with retry-after; p99
+  and error-rate SLOs hold outside the bounded recovery windows.
+
+Driven through the tools/serve_soak.py --disagg CLI so the CLI
+contract is covered by the same run. Mirrors
+test_serve_fleet_soak.py, including the 3-consecutive-green
+requirement verified at PR time.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.mark.slow
+def test_serve_disagg_soak_acceptance(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_soak.py"),
+         "--disagg", "--prefill", "2", "--decode", "1",
+         "--clients", "4", "--seed", "7", "--out", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=480)
+    assert out.stdout.strip(), out.stderr[-3000:]
+    verdict = json.loads(out.stdout)
+    detail = json.dumps(verdict, indent=2, sort_keys=True)[:3000]
+    assert verdict["disagg"] is True, detail
+    assert verdict["no_silent_drops"] is True, detail
+    assert verdict["answered_once"] is True, detail
+    assert verdict["shed_carry_retry_after"] is True, detail
+    # the migration plane actually ran, under faults
+    assert verdict["migrations_ok"] is True, detail
+    assert verdict["migrations_in"] > 0, detail
+    assert verdict["migrate_corrupt_caught"] is True, detail
+    assert verdict["migrate_corrupt_detected"] > 0, detail
+    assert verdict["migrate_blips_recovered"] is True, detail
+    # migration chaos must never escalate into an ejection
+    assert verdict["failovers_only_kills"] is True, detail
+    # the prefill kill: accrual detection, bounded; weight-gated respawn
+    assert verdict["failover_bounded"] is True, detail
+    assert verdict["failover_s"] <= 2 * verdict["suspect_s"], detail
+    assert verdict["respawned_on_newest"] is True, detail
+    assert verdict["capacity_restored"] is True, detail
+    assert verdict["slo_held"] is True, detail
+    assert verdict["ok"] is True, detail

@@ -92,10 +92,43 @@ def main(argv=None) -> int:
     p.add_argument("--spawn-timeout", type=float, default=120.0,
                    help="--processes: seconds to wait for a worker "
                         "process to register ready")
+    p.add_argument("--disagg", action="store_true",
+                   help="DISAGGREGATED soak: --prefill + --decode "
+                        "worker processes behind a DisaggRouter; the "
+                        "seeded plan SIGKILLs a prefill worker "
+                        "mid-migration and fires serve.migrate "
+                        "conn_reset/corrupt at the KV-block push "
+                        "(docs/serving.md, disaggregation section)")
+    p.add_argument("--prefill", type=int, default=2,
+                   help="--disagg: prefill pool size (default 2)")
+    p.add_argument("--decode", type=int, default=1,
+                   help="--disagg: decode pool size (default 1)")
     args = p.parse_args(argv)
 
     # one fleet on CPU devices; keep the run reproducible
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.disagg:
+        from horovod_tpu.serve.soak import run_disagg_soak
+        verdict = run_disagg_soak(
+            args.out, prefill=args.prefill, decode=args.decode,
+            clients=args.clients, seed=args.seed,
+            plan=None if args.plan == "random" else args.plan,
+            steps=args.steps,
+            suspect_s=2.0 if args.suspect_s is None else args.suspect_s,
+            slo_p99_ms=args.slo_p99_ms,
+            slo_error_rate=args.slo_error_rate,
+            recovery_window_s=args.recovery_window,
+            min_duration_s=args.min_duration,
+            max_duration_s=(180.0 if args.max_duration is None
+                            else args.max_duration),
+            spec_k=0 if args.spec_k is None else args.spec_k,
+            kv_crc=False if args.no_kv_crc else None,
+            prefix_cache=False if args.no_prefix_cache else None,
+            spawn_timeout_s=args.spawn_timeout)
+        json.dump(verdict, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0 if verdict["ok"] else 1
 
     if args.processes:
         from horovod_tpu.serve.soak import run_fleet_soak
