@@ -558,6 +558,66 @@ def run_disagg_benchmark() -> int:
         return 1
 
 
+def run_autoscale_benchmark() -> int:
+    """Autoscale acceptance GATE (`bench.py --autoscale`): the full
+    loop — signals -> policy -> actuator — driven end to end on a 1+1
+    disaggregated fleet under phased bursty traffic (the chaos-free
+    autoscale soak, serve/soak.py run_autoscale_soak), with the
+    verdict asserted rather than just reported.
+
+    Gate (exit nonzero on violation, each verdict a JSON line):
+
+      * capacity tracked load: BOTH pools scaled up under the
+        long-prompt burst and back down in the cool phase;
+      * p99 TTFT SLO held outside the planned disruption windows
+        (<= HVD_BENCH_AUTOSCALE_P99_MS, default 15000);
+      * zero silent drops and answered-exactly-once across every
+        scale event (drains requeue, newcomers dedupe);
+      * every newcomer admitted on the newest streamed weight version
+        (the respawn gate, generalized to scale-up).
+    """
+    try:
+        from horovod_tpu.serve.soak import run_autoscale_soak
+
+        slo = float(os.environ.get("HVD_BENCH_AUTOSCALE_P99_MS",
+                                   "15000"))
+        duration = float(os.environ.get(
+            "HVD_BENCH_AUTOSCALE_DURATION_S", "240"))
+        v = run_autoscale_soak(None, plan=None, slo_p99_ms=slo,
+                               max_duration_s=duration)
+        gates = {
+            "capacity_tracks_load": bool(v.get("scaled_up")
+                                         and v.get("scaled_down")),
+            "ttft_slo_held": v.get("slo_held") is True,
+            "no_silent_drops": (v.get("no_silent_drops") is True
+                                and v.get("answered_once") is True),
+            "newcomers_on_newest":
+                v.get("newcomers_on_newest") is True,
+        }
+        events = v.get("scale_events") or {}
+        common = {"slo_p99_ms": slo, "scale_events": events,
+                  "statuses": v.get("statuses"), "gates": gates,
+                  "wall_s": v.get("wall_s"),
+                  "out_dir": v.get("out_dir")}
+        print(json.dumps({
+            "metric": "autoscale_ttft_p99_outside_ms",
+            "value": v.get("p99_outside_ms"), "unit": "ms",
+            **common}), flush=True)
+        print(json.dumps({
+            "metric": "autoscale_scale_events",
+            "value": sum(c.get("up", 0) + c.get("down", 0)
+                         for c in events.values()),
+            "unit": "events", **common}), flush=True)
+        return 0 if all(gates.values()) else 1
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        for metric, unit in (("autoscale_ttft_p99_outside_ms", "ms"),
+                             ("autoscale_scale_events", "events")):
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": unit, "error": str(e)[-500:]}),
+                  flush=True)
+        return 1
+
+
 def run_serve_benchmark() -> int:
     """Serving acceptance GATE (`bench.py --serve`): the ROADMAP item 2
     bars, asserted — not just reported. One workload (a long shared
@@ -1419,6 +1479,9 @@ if __name__ == "__main__":
     elif "--serve-disagg" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE_DISAGG") == "1":
         sys.exit(run_disagg_benchmark())
+    elif "--autoscale" in sys.argv or \
+            os.environ.get("HVD_BENCH_AUTOSCALE") == "1":
+        sys.exit(run_autoscale_benchmark())
     elif "--kernel-parity" in sys.argv or \
             os.environ.get("HVD_BENCH_KERNEL_PARITY") == "1":
         sys.exit(run_kernel_parity())

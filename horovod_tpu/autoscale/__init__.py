@@ -1,0 +1,47 @@
+"""horovod_tpu.autoscale: traffic-driven autoscaling for the serve
+fleet, closing the loop between elastic, redist and disaggregated
+serving.
+
+The loop has four planes, each its own module and each testable alone:
+
+    signals.py   pure load facts: ``LoadSnapshot`` assembled from the
+                 per-pool healthz caches and router counters that
+                 already exist (queue/KV occupancy, migration backlog,
+                 shed rate, windowed p99 TTFT, prompt-length mix) —
+                 jax-free and JSON-round-trippable so decisions replay
+    policy.py    deterministic ``ScalePolicy(snapshot) -> ScalePlan``
+                 with hysteresis bands and per-direction cooldowns;
+                 long-prompt bursts grow prefill, decode saturation
+                 (the staging-buffer wait) grows decode
+    actuator.py  ``Autoscaler``: the poll loop plus runtime
+                 ``add_replica``/``remove_replica`` — newcomers are
+                 admission-gated behind weight streaming + warmup +
+                 the newest-version audit; drains ride the parked-row
+                 migration machinery so no sequence is dropped; every
+                 applied action crosses the ``autoscale.scale`` chaos
+                 site and lands a SCALE timeline row
+    cosched.py   the chip-budget arbiter: at traffic peaks training
+                 shrinks N->M through the elastic driver (survivors
+                 elastic-restore IN MEMORY — zero checkpoint reads)
+                 to donate chips to serving, and reclaims off-peak
+
+Knobs: ``HOROVOD_AUTOSCALE_*`` (core/config.py; docs/knobs.md).
+Stdlib-only at import time — safe from router health threads and from
+pure policy tests alike.
+"""
+from .signals import LoadSnapshot, PoolLoad, SignalSource  # noqa: F401
+from .policy import (                                      # noqa: F401
+    PolicyConfig, PoolAction, ScalePlan, ScalePolicy, replay,
+)
+from .actuator import Autoscaler                           # noqa: F401
+from .cosched import (                                     # noqa: F401
+    ChipBudgetArbiter, CoschedConfig, CoScheduler, ElasticDriverLever,
+)
+
+__all__ = [
+    "LoadSnapshot", "PoolLoad", "SignalSource",
+    "PolicyConfig", "PoolAction", "ScalePlan", "ScalePolicy", "replay",
+    "Autoscaler",
+    "ChipBudgetArbiter", "CoschedConfig", "CoScheduler",
+    "ElasticDriverLever",
+]

@@ -1,0 +1,254 @@
+"""The actuator: turns :class:`ScalePlan` actions into fleet changes.
+
+The runtime half of the autoscaler.  A poll thread samples the signal
+plane (signals.py), asks the pure policy (policy.py) for a plan, lets
+the co-scheduler (cosched.py) mediate it against the chip budget, and
+then applies each action through the routers' ``add_replica`` /
+``remove_replica`` surface:
+
+* **scale-up** rides the worker-process substrate — the newcomer is
+  registered first (so healthz counts it as PENDING capacity and the
+  front door answers 200/degraded, not 503, mid-spawn), then spawned,
+  weight-streamed and warmed, and only admitted behind the same
+  readiness gate a respawn uses (ready key + newest-weights audit).
+* **scale-down** picks the victim, stops routing to it, waits for its
+  queue AND parked rows to drain (the parked-row migration machinery
+  moves its sequences), SIGTERMs it, and requeues anything that was
+  still in flight — no sequence is dropped.
+
+Every applied action crosses the ``autoscale.scale`` chaos site first:
+a ``crash`` fault kills the newcomer mid-warmup (the admission gate's
+retry respawns it), a ``delay`` stalls the actuator past the weight
+stream, and a ``drop`` turns a graceful drain into a hard kill (the
+requeue discipline still delivers exactly-once).  Each action also
+emits a SCALE timeline instant and bumps
+``hvd_autoscale_events_total{pool,direction}``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..chaos import inject as _chaos
+from ..obs.metrics import get_registry
+from .policy import PolicyConfig, PoolAction, ScalePlan, ScalePolicy
+from .signals import LoadSnapshot, SignalSource
+
+__all__ = ["Autoscaler", "EVENTS_HELP", "TARGET_HELP"]
+
+EVENTS_HELP = ("applied autoscale actions by pool and direction "
+               "(direction=up|down); failures count under "
+               "direction=up_failed|down_failed")
+TARGET_HELP = ("the autoscaler's current per-pool replica target "
+               "(total including pending)")
+
+
+def _timeline_instant(args: dict) -> None:
+    """One SCALE row on the live timeline (no-op without one)."""
+    try:
+        tl = _chaos._live_timeline()
+        if tl is not None:
+            tl.instant("SCALE", args)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class Autoscaler:
+    """Closes the loop: sample -> decide -> mediate -> apply.
+
+    ``router`` is either a ``DisaggRouter`` (pool-addressed actions)
+    or a plain ``ProcessFleetRouter`` (single ``"fleet"`` pool) —
+    duck-typed the same way as :class:`SignalSource`.
+
+    ``step()`` runs one full cycle synchronously and is the unit the
+    tests and the soak harness drive; ``start()`` runs it on a daemon
+    poll thread every ``interval_s``.
+    """
+
+    def __init__(self, router, *,
+                 policy: Optional[ScalePolicy] = None,
+                 policy_config: Optional[PolicyConfig] = None,
+                 source: Optional[SignalSource] = None,
+                 cosched=None,
+                 interval_s: float = 1.0,
+                 trace_path: Optional[str] = None,
+                 graceful_timeout_s: float = 30.0,
+                 spawn_timeout_s: Optional[float] = None):
+        self.router = router
+        self.policy = policy or ScalePolicy(policy_config)
+        self.source = source or SignalSource(
+            router, long_prompt_tokens=self.policy.cfg.long_prompt_tokens)
+        self.cosched = cosched
+        self.interval_s = float(interval_s)
+        self.trace_path = trace_path
+        self.graceful_timeout_s = float(graceful_timeout_s)
+        self.spawn_timeout_s = spawn_timeout_s
+        # scale-EVENT ordinal: the chaos plan's step axis for the
+        # autoscale.scale site (at/after/until count applied events)
+        self._scale_events = 0
+        self.events: deque = deque(maxlen=4096)
+        self._listeners: List[Callable[[dict], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        R = get_registry()
+        # claim the families fresh: a previous instance in this process
+        # must not leak its children into ours
+        for name in ("hvd_autoscale_events_total", "hvd_autoscale_target"):
+            R.unregister(name)
+        self._m_events: Dict[tuple, object] = {}
+        self._m_target: Dict[str, object] = {}
+
+    # -- wiring ------------------------------------------------------------
+    def add_listener(self, fn: Callable[[dict], None]) -> None:
+        """``fn(event_dict)`` after every applied (or failed) action —
+        the soak harness's event log hook."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _emit(self, ev: dict) -> None:
+        self.events.append(ev)
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001
+                pass
+        _timeline_instant({k: v for k, v in ev.items() if k != "t"})
+
+    def _count(self, pool: str, direction: str) -> None:
+        key = (pool, direction)
+        c = self._m_events.get(key)
+        if c is None:
+            c = get_registry().counter(
+                "hvd_autoscale_events_total", EVENTS_HELP,
+                {"pool": pool, "direction": direction})
+            self._m_events[key] = c
+        c.inc()
+
+    def _set_target(self, pool: str, n: int) -> None:
+        g = self._m_target.get(pool)
+        if g is None:
+            g = get_registry().gauge(
+                "hvd_autoscale_target", TARGET_HELP, {"pool": pool})
+            self._m_target[pool] = g
+        g.set(n)
+
+    # -- router addressing -------------------------------------------------
+    def _disagg(self) -> bool:
+        return hasattr(self.router, "prefill") and hasattr(
+            self.router, "decode")
+
+    def _pool_router(self, pool: str):
+        if self._disagg():
+            return getattr(self.router, pool, None) or self.router.prefill
+        return self.router
+
+    def _add(self, pool: str, pre_admit) -> int:
+        if self._disagg():
+            return self.router.add_replica(
+                pool, pre_admit=pre_admit, timeout_s=self.spawn_timeout_s)
+        return self.router.add_replica(
+            pre_admit=pre_admit, timeout_s=self.spawn_timeout_s)
+
+    def _remove(self, pool: str, graceful: bool) -> int:
+        if self._disagg():
+            return self.router.remove_replica(
+                pool, graceful=graceful, timeout_s=self.graceful_timeout_s)
+        return self.router.remove_replica(
+            graceful=graceful, timeout_s=self.graceful_timeout_s)
+
+    # -- one applied action ------------------------------------------------
+    def _apply(self, act: PoolAction, snap: LoadSnapshot) -> dict:
+        n = self._scale_events
+        self._scale_events += 1
+        # the chaos site: delay faults sleep HERE (stalling the
+        # actuator), crash/drop faults are returned for us to act on
+        fault = _chaos.fire("autoscale.scale", step=n)
+        ev = {"t": time.time(), "event": n, "pool": act.pool,
+              "direction": "up" if act.delta > 0 else "down",
+              "reason": act.reason, "ok": False, "rid": None,
+              "fault": fault.kind if fault is not None else None}
+        try:
+            if act.delta > 0:
+                pre_admit = None
+                if fault is not None and fault.kind == "crash":
+                    def pre_admit(rep):
+                        # kill the newcomer mid-warmup: the admission
+                        # gate times out and the spawn retry brings up
+                        # a replacement — admission stays exactly-once
+                        time.sleep(0.05)
+                        rep.kill()
+                rid = self._add(act.pool, pre_admit)
+                ev["rid"] = rid
+                p = self._pool_router(act.pool)
+                rep = p.replicas.get(rid) if p is not None else None
+                if rep is not None:
+                    ev["weights_version"] = rep.weights_version
+            else:
+                graceful = not (fault is not None
+                                and fault.kind in ("crash", "drop"))
+                ev["graceful"] = graceful
+                ev["rid"] = self._remove(act.pool, graceful)
+            ev["ok"] = True
+        except Exception as e:  # noqa: BLE001 — a failed action must
+            ev["error"] = str(e)     # not kill the poll loop
+        self._count(act.pool,
+                    ev["direction"] if ev["ok"]
+                    else ev["direction"] + "_failed")
+        pl = snap.pool(act.pool)
+        if pl is not None:
+            self._set_target(act.pool,
+                             pl.replicas_total + (act.delta if ev["ok"]
+                                                  else 0))
+        self._emit(ev)
+        return ev
+
+    def _record_trace(self, snap: LoadSnapshot, plan: ScalePlan) -> None:
+        if not self.trace_path:
+            return
+        try:
+            with open(self.trace_path, "a") as f:
+                f.write(json.dumps({"snapshot": snap.to_dict(),
+                                    "plan": plan.to_dict()},
+                                   sort_keys=True) + "\n")
+        except OSError:
+            pass
+
+    # -- the loop ----------------------------------------------------------
+    def step(self) -> ScalePlan:
+        """One full cycle; returns the MEDIATED plan that was applied."""
+        snap = self.source.sample()
+        plan = self.policy.decide(snap)
+        if self.cosched is not None:
+            plan = self.cosched.mediate(plan, snap)
+        self._record_trace(snap, plan)
+        for act in plan.actions:
+            self._apply(act, snap)
+        return plan
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — the poll loop survives
+                pass               # a mid-teardown router
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("autoscaler already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-autoscale", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.graceful_timeout_s + 10.0)
+            self._thread = None

@@ -1,0 +1,167 @@
+"""The chip-budget co-scheduler: one arbiter over training AND serving.
+
+A TPU pod is one pool of chips.  At a traffic peak the serve fleet
+wants more decode workers while the training job idles them; off-peak
+the reverse.  This module closes that loop:
+
+* the **arbiter** is a pure decision core (unit-testable like the
+  scale policy): given a snapshot, the training world size and the
+  serve fleet's chip count, it answers "shrink training to M" /
+  "grow training back" / "nothing", with its own cooldown so the
+  training job is not resized every poll.
+* the **lever** is the training side's actuation surface.  The real
+  one (:class:`ElasticDriverLever`) drives the elastic driver's
+  ``request_resize`` — a shrink is an ordinary elastic reset whose
+  survivors restore IN MEMORY through ``redist.elastic_restore``
+  (zero checkpoint reads: ``hvd_ckpt_bytes_total{kind=read}`` stays
+  flat), and the reclaim resumes bit-identical to an unshrunk run.
+* the **co-scheduler** mediates each :class:`ScalePlan` before the
+  actuator applies it: a serve scale-up only proceeds if a chip is
+  free, shrinking training first when it is not; off-peak, with every
+  pool quiet, training grows back toward its full world.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .policy import PoolAction, ScalePlan
+from .signals import LoadSnapshot
+
+__all__ = ["CoschedConfig", "ChipBudgetArbiter", "ElasticDriverLever",
+           "CoScheduler"]
+
+
+@dataclass(frozen=True)
+class CoschedConfig:
+    """The arbiter's budget: ``total_chips`` is the pod; training may
+    float between ``train_min_np`` and ``train_max_np``; serve workers
+    cost one chip each."""
+
+    total_chips: int
+    train_min_np: int
+    train_max_np: int
+    donate_util: float = 0.85   # any pool this hot -> shrink training
+    reclaim_util: float = 0.30  # every pool this quiet -> grow it back
+    cooldown_s: float = 30.0    # between training resizes
+
+    def __post_init__(self):
+        if not (1 <= self.train_min_np <= self.train_max_np
+                <= self.total_chips):
+            raise ValueError(
+                f"cosched needs 1 <= train_min_np <= train_max_np <= "
+                f"total_chips; got min={self.train_min_np} "
+                f"max={self.train_max_np} total={self.total_chips}")
+        if not (0.0 <= self.reclaim_util < self.donate_util <= 1.0):
+            raise ValueError(
+                f"cosched bands need 0 <= reclaim_util < donate_util "
+                f"<= 1; got reclaim={self.reclaim_util} "
+                f"donate={self.donate_util}")
+
+
+class ChipBudgetArbiter:
+    """Pure training-resize decisions, one chip at a time (each serve
+    worker displaces one training rank).  Stateful only in the resize
+    cooldown clock, which keys off ``snapshot.t`` — so a recorded
+    trace replays deterministically, same as the scale policy."""
+
+    def __init__(self, cfg: CoschedConfig):
+        self.cfg = cfg
+        self._last_resize = float("-inf")
+
+    def donate(self, train_np: int, t: float) -> Optional[int]:
+        """Target np if training should give up a chip NOW, else
+        None.  Caller has already established serve pressure."""
+        cfg = self.cfg
+        if train_np <= cfg.train_min_np:
+            return None
+        if t - self._last_resize < cfg.cooldown_s:
+            return None
+        self._last_resize = t
+        return train_np - 1
+
+    def reclaim(self, train_np: int, free_chips: int,
+                t: float) -> Optional[int]:
+        """Target np if training should take a chip back, else None.
+        Caller has already established that every pool is quiet."""
+        cfg = self.cfg
+        if train_np >= cfg.train_max_np or free_chips < 1:
+            return None
+        if t - self._last_resize < cfg.cooldown_s:
+            return None
+        self._last_resize = t
+        return train_np + 1
+
+    def reset(self) -> None:
+        self._last_resize = float("-inf")
+
+
+class ElasticDriverLever:
+    """The real training lever: wraps the elastic driver's resize
+    surface.  ``resize`` only REQUESTS — the driver notices at its
+    next supervise poll, triggers an ordinary elastic reset, and the
+    survivors elastic-restore in memory."""
+
+    def __init__(self, driver):
+        self._driver = driver
+
+    def current_np(self) -> int:
+        return int(self._driver.current_np())
+
+    def resize(self, target_np: int) -> None:
+        self._driver.request_resize(int(target_np))
+
+
+class CoScheduler:
+    """Mediates scale plans against the chip budget.  Sits between
+    policy and actuator (``Autoscaler(cosched=...)``): it never
+    originates serve actions, only gates them and moves the training
+    boundary."""
+
+    def __init__(self, lever, cfg: CoschedConfig,
+                 arbiter: Optional[ChipBudgetArbiter] = None):
+        self.lever = lever
+        self.cfg = cfg
+        self.arbiter = arbiter or ChipBudgetArbiter(cfg)
+        self.donated = 0    # training shrinks applied
+        self.reclaimed = 0  # training grows applied
+        self.dropped = 0    # serve scale-ups dropped for lack of chips
+
+    def _serve_chips(self, snap: LoadSnapshot) -> int:
+        return sum(p.replicas_total for p in snap.pools)
+
+    def mediate(self, plan: ScalePlan, snap: LoadSnapshot) -> ScalePlan:
+        t = snap.t
+        train_np = self.lever.current_np()
+        serve = self._serve_chips(snap)
+        kept: List[PoolAction] = []
+        for act in plan.actions:
+            if act.delta > 0:
+                free = self.cfg.total_chips - serve - train_np
+                if free < 1:
+                    target = self.arbiter.donate(train_np, t)
+                    if target is not None:
+                        self.lever.resize(target)
+                        self.donated += 1
+                        train_np = target
+                        free = self.cfg.total_chips - serve - train_np
+                if free < 1:
+                    # no chip and training already at its floor (or in
+                    # cooldown): the scale-up waits for a later poll
+                    self.dropped += 1
+                    continue
+                serve += 1
+            else:
+                serve -= 1
+            kept.append(act)
+        if not any(a.delta > 0 for a in plan.actions):
+            # off-peak: every pool quiet -> training takes chips back
+            if snap.pools and all(p.utilization() <= self.cfg.reclaim_util
+                                  and p.migration_backlog == 0
+                                  for p in snap.pools):
+                free = self.cfg.total_chips - serve - train_np
+                target = self.arbiter.reclaim(train_np, free, t)
+                if target is not None:
+                    self.lever.resize(target)
+                    self.reclaimed += 1
+        return ScalePlan(t=plan.t, actions=tuple(kept))

@@ -1,0 +1,183 @@
+"""The scale policy: deterministic ``LoadSnapshot -> ScalePlan``.
+
+Pure by construction — no clocks, no processes, no registry reads.
+Time enters only through ``snapshot.t``, so a recorded trace replayed
+through a fresh :class:`ScalePolicy` reproduces the original plan
+sequence byte-for-byte (``replay`` below is exactly that, and the
+policy tests assert it on canned burst / sinusoid / prompt-mix /
+flapping traces).
+
+Decision shape per pool, in priority order:
+
+* **scale up** when any pressure signal fires — utilization at or over
+  the high-water band, a migration backlog on the decode pool (the
+  staging-buffer wait: prefilled sequences parked because no decode
+  slot frees up), or a long-prompt mix pushing p99 TTFT past the SLO
+  (grows the PREFILL pool, where long prompts burn their time).  Gated
+  on: nothing already pending, below ``max_replicas``, and the up
+  cooldown elapsed.
+* **scale down** only when EVERY pressure signal is quiet AND
+  utilization is at or under the low-water band — the gap between the
+  bands is the hysteresis that stops flapping — and the (longer) down
+  cooldown has elapsed since the pool's last action in either
+  direction.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .signals import LoadSnapshot, PoolLoad
+
+__all__ = ["PolicyConfig", "PoolAction", "ScalePlan", "ScalePolicy",
+           "replay"]
+
+
+@dataclass(frozen=True)
+class PolicyConfig:
+    """The policy's knobs — mirrors the ``HOROVOD_AUTOSCALE_*`` rows in
+    core/config.py (``from_config`` lifts them); duplicated here as a
+    plain value so policy tests never touch the env."""
+
+    up_util: float = 0.75
+    down_util: float = 0.25
+    cooldown_up_s: float = 5.0
+    cooldown_down_s: float = 20.0
+    min_replicas: int = 1
+    max_replicas: int = 4
+    long_prompt_tokens: int = 64
+    long_prompt_frac: float = 0.5
+    ttft_slo_ms: float = 5000.0
+
+    def __post_init__(self):
+        if not (0.0 <= self.down_util < self.up_util <= 1.0):
+            raise ValueError(
+                f"autoscale bands need 0 <= down_util < up_util <= 1 "
+                f"(the gap is the hysteresis); got down={self.down_util} "
+                f"up={self.up_util}")
+        if not (1 <= self.min_replicas <= self.max_replicas):
+            raise ValueError(
+                f"autoscale replica bounds need 1 <= min <= max; got "
+                f"min={self.min_replicas} max={self.max_replicas}")
+
+    @classmethod
+    def from_config(cls, c) -> "PolicyConfig":
+        """Lift the knobs from a validated ``core.config.Config``."""
+        return cls(up_util=c.autoscale_up_util,
+                   down_util=c.autoscale_down_util,
+                   cooldown_up_s=c.autoscale_cooldown_up_s,
+                   cooldown_down_s=c.autoscale_cooldown_down_s,
+                   min_replicas=c.autoscale_min_replicas,
+                   max_replicas=c.autoscale_max_replicas,
+                   long_prompt_tokens=c.autoscale_long_prompt_tokens,
+                   long_prompt_frac=c.autoscale_long_prompt_frac,
+                   ttft_slo_ms=c.autoscale_ttft_slo_ms)
+
+
+@dataclass(frozen=True)
+class PoolAction:
+    """One pool's resize decision: ``delta`` is +1 (grow) or -1
+    (shrink); ``reason`` names the signal that fired, for the SCALE
+    timeline row and the trace log."""
+
+    pool: str
+    delta: int
+    reason: str
+
+    def to_dict(self) -> dict:
+        return {"pool": self.pool, "delta": self.delta,
+                "reason": self.reason}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PoolAction":
+        return cls(pool=str(d["pool"]), delta=int(d["delta"]),
+                   reason=str(d["reason"]))
+
+
+@dataclass(frozen=True)
+class ScalePlan:
+    """The policy's full answer for one snapshot (possibly empty)."""
+
+    t: float
+    actions: Tuple[PoolAction, ...] = ()
+
+    def __bool__(self) -> bool:
+        return bool(self.actions)
+
+    def to_dict(self) -> dict:
+        return {"t": self.t, "actions": [a.to_dict() for a in self.actions]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScalePlan":
+        return cls(t=float(d["t"]),
+                   actions=tuple(PoolAction.from_dict(a)
+                                 for a in d.get("actions", [])))
+
+
+class ScalePolicy:
+    """Stateful only in the cooldown ledger (last up/down time per
+    pool); everything else is a pure function of the snapshot."""
+
+    def __init__(self, cfg: Optional[PolicyConfig] = None):
+        self.cfg = cfg or PolicyConfig()
+        self._last_up: Dict[str, float] = {}
+        self._last_down: Dict[str, float] = {}
+
+    # -- signal predicates -------------------------------------------------
+    def _up_reasons(self, p: PoolLoad, snap: LoadSnapshot) -> List[str]:
+        cfg = self.cfg
+        reasons = []
+        if p.replicas_up > 0 and p.utilization() >= cfg.up_util:
+            reasons.append("util")
+        if p.migration_backlog > 0:
+            # decode saturation: prefilled sequences parked in the
+            # migrate phase because no decode slot frees up
+            reasons.append("migration_backlog")
+        if (p.pool != "decode"
+                and snap.long_prompt_frac >= cfg.long_prompt_frac
+                and snap.p99_ttft_ms is not None
+                and snap.p99_ttft_ms > cfg.ttft_slo_ms):
+            # long-prompt burst over the TTFT SLO: prefill is where
+            # long prompts spend their time, so grow that side
+            reasons.append("long_prompts")
+        return reasons
+
+    # -- the decision ------------------------------------------------------
+    def decide(self, snap: LoadSnapshot) -> ScalePlan:
+        cfg = self.cfg
+        t = snap.t
+        actions: List[PoolAction] = []
+        for p in snap.pools:
+            last_up = self._last_up.get(p.pool, float("-inf"))
+            last_any = max(last_up, self._last_down.get(p.pool,
+                                                        float("-inf")))
+            up = self._up_reasons(p, snap)
+            if up:
+                if (p.replicas_pending == 0
+                        and p.replicas_total < cfg.max_replicas
+                        and t - last_up >= cfg.cooldown_up_s):
+                    actions.append(PoolAction(p.pool, +1, "+".join(up)))
+                    self._last_up[p.pool] = t
+                # pressure present: never consider shrinking this pool
+                continue
+            if (p.utilization() <= cfg.down_util
+                    and p.migration_backlog == 0
+                    and p.replicas_pending == 0
+                    and p.replicas_up > cfg.min_replicas
+                    and t - last_any >= cfg.cooldown_down_s):
+                actions.append(PoolAction(p.pool, -1, "idle"))
+                self._last_down[p.pool] = t
+        return ScalePlan(t=t, actions=tuple(actions))
+
+    def reset(self) -> None:
+        """Forget the cooldown ledger (fresh replay)."""
+        self._last_up.clear()
+        self._last_down.clear()
+
+
+def replay(cfg: Optional[PolicyConfig],
+           snapshots: Iterable[LoadSnapshot]) -> List[ScalePlan]:
+    """Run a recorded snapshot trace through a FRESH policy — the
+    determinism harness: same trace, same config, same plans."""
+    policy = ScalePolicy(cfg)
+    return [policy.decide(s) for s in snapshots]

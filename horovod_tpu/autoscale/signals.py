@@ -1,0 +1,280 @@
+"""Load signals for the autoscaler: one immutable, replayable snapshot.
+
+The policy plane (policy.py) is deliberately pure — it sees the serve
+fleet only through a :class:`LoadSnapshot`, a frozen value assembled
+here from the per-pool healthz caches and router counters that already
+exist.  That split is what makes every scaling decision replayable: a
+recorded snapshot trace fed back through ``ScalePolicy`` reproduces the
+plan sequence byte-for-byte, with no processes and no clocks.
+
+``SignalSource`` is the only stateful piece, and only because two of
+the signals are *rates*: shed rate is the diff of the router's
+``rejected`` counter over the sample interval, and the p99 TTFT is a
+WINDOWED percentile computed by diffing a latency histogram's bucket
+counts between samples (``obs.metrics.percentile_from_buckets`` on the
+deltas) so a burst shows up within one poll instead of being averaged
+away by the process-lifetime histogram.
+
+Stdlib-only: no jax, no processes — safe to import from the router's
+health thread and from pure policy tests alike.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["PoolLoad", "LoadSnapshot", "SignalSource"]
+
+# Replica states that count as PENDING capacity: a worker that has been
+# registered and is being spawned / weight-streamed / warmed but is not
+# admitted yet.  Mirrors serve.fleet.aggregate_healthz.
+_PENDING_STATES = ("spawning", "respawning")
+
+
+@dataclass(frozen=True)
+class PoolLoad:
+    """One pool's load facts at a sample instant (all sums are over
+    ADMITTED replicas; pending ones contribute to ``replicas_pending``
+    and ``replicas_total`` only)."""
+
+    pool: str
+    replicas_up: int
+    replicas_pending: int
+    replicas_total: int
+    queue_depth: int
+    queue_free: int
+    kv_blocks_in_use: int
+    kv_blocks_total: int
+    migration_backlog: int = 0
+
+    def queue_util(self) -> float:
+        cap = self.queue_depth + self.queue_free
+        return (self.queue_depth / cap) if cap > 0 else 0.0
+
+    def kv_util(self) -> float:
+        return ((self.kv_blocks_in_use / self.kv_blocks_total)
+                if self.kv_blocks_total > 0 else 0.0)
+
+    def utilization(self) -> float:
+        """The pool's scalar pressure: the WORSE of queue and KV
+        occupancy — either resource running out alone stalls the
+        pool, so the max is the binding constraint."""
+        return max(self.queue_util(), self.kv_util())
+
+    def to_dict(self) -> dict:
+        return {
+            "pool": self.pool,
+            "replicas_up": self.replicas_up,
+            "replicas_pending": self.replicas_pending,
+            "replicas_total": self.replicas_total,
+            "queue_depth": self.queue_depth,
+            "queue_free": self.queue_free,
+            "kv_blocks_in_use": self.kv_blocks_in_use,
+            "kv_blocks_total": self.kv_blocks_total,
+            "migration_backlog": self.migration_backlog,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PoolLoad":
+        return cls(pool=str(d["pool"]),
+                   replicas_up=int(d["replicas_up"]),
+                   replicas_pending=int(d["replicas_pending"]),
+                   replicas_total=int(d["replicas_total"]),
+                   queue_depth=int(d["queue_depth"]),
+                   queue_free=int(d["queue_free"]),
+                   kv_blocks_in_use=int(d["kv_blocks_in_use"]),
+                   kv_blocks_total=int(d["kv_blocks_total"]),
+                   migration_backlog=int(d.get("migration_backlog", 0)))
+
+
+@dataclass(frozen=True)
+class LoadSnapshot:
+    """Everything the scale policy is allowed to see, at one instant.
+
+    ``t`` is the sampler's monotonic clock — policy cooldowns are
+    computed against it, so a recorded trace replays with the original
+    timing semantics regardless of when the replay runs.
+    """
+
+    t: float
+    pools: Tuple[PoolLoad, ...]
+    inflight: int = 0
+    shed_rate: float = 0.0          # structured rejections / second (EWMA)
+    p99_ttft_ms: Optional[float] = None   # windowed; None until sampled
+    long_prompt_frac: float = 0.0   # share of recent prompts over the bar
+
+    def pool(self, name: str) -> Optional[PoolLoad]:
+        for p in self.pools:
+            if p.pool == name:
+                return p
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "t": self.t,
+            "pools": [p.to_dict() for p in self.pools],
+            "inflight": self.inflight,
+            "shed_rate": self.shed_rate,
+            "p99_ttft_ms": self.p99_ttft_ms,
+            "long_prompt_frac": self.long_prompt_frac,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LoadSnapshot":
+        p99 = d.get("p99_ttft_ms")
+        return cls(t=float(d["t"]),
+                   pools=tuple(PoolLoad.from_dict(x) for x in d["pools"]),
+                   inflight=int(d.get("inflight", 0)),
+                   shed_rate=float(d.get("shed_rate", 0.0)),
+                   p99_ttft_ms=None if p99 is None else float(p99),
+                   long_prompt_frac=float(d.get("long_prompt_frac", 0.0)))
+
+
+def _pool_load(name: str, infos: Dict[int, dict], *,
+               migration_backlog: int = 0) -> PoolLoad:
+    """Fold a router's ``healthz_infos()`` into one :class:`PoolLoad`."""
+    up = pend = qd = qf = kvu = kvt = 0
+    for info in infos.values():
+        state = str(info.get("state", ""))
+        if info.get("up"):
+            up += 1
+            qd += int(info.get("queue_depth", 0))
+            qf += int(info.get("queue_free", 0))
+            # prefix-cache-retained blocks (refcount-zero runs) are
+            # resident but reclaimable on demand: counting them as
+            # pressure would pin an idle prefill pool at high kv_util
+            # forever and block every scale-down
+            kvu += max(int(info.get("kv_blocks_in_use", 0))
+                       - int(info.get("kv_blocks_evictable", 0)), 0)
+            kvt += int(info.get("kv_blocks_total", 0))
+        elif state in _PENDING_STATES:
+            pend += 1
+    return PoolLoad(pool=name, replicas_up=up, replicas_pending=pend,
+                    replicas_total=len(infos), queue_depth=qd,
+                    queue_free=qf, kv_blocks_in_use=kvu,
+                    kv_blocks_total=kvt,
+                    migration_backlog=migration_backlog)
+
+
+class SignalSource:
+    """Samples a router into :class:`LoadSnapshot` values.
+
+    Works against either fleet shape by duck-typing: a
+    ``DisaggRouter`` (has ``.prefill`` / ``.decode`` pools and a
+    ``migration_backlog()``) yields two named pools; a plain
+    ``ProcessFleetRouter`` yields one pool named ``"fleet"``.
+
+    Holds the between-sample state for the two rate signals (rejected
+    counter for shed rate, histogram bucket counts for windowed p99
+    TTFT); everything else is read fresh from the health-poll caches.
+    """
+
+    # EWMA smoothing for the rate signals: ~2 samples of memory, enough
+    # to ride out a single empty poll without masking a real burst.
+    _ALPHA = 0.5
+
+    def __init__(self, router, *, long_prompt_tokens: int = 64,
+                 clock: Callable[[], float] = time.monotonic):
+        self._router = router
+        self._long = int(long_prompt_tokens)
+        self._clock = clock
+        self._last_t: Optional[float] = None
+        self._last_rejected: Optional[int] = None
+        self._shed_ewma = 0.0
+        # histogram identity -> last seen bucket counts (for windowing)
+        self._last_counts: Dict[int, List[int]] = {}
+        self._p99_ewma: Optional[float] = None
+
+    # -- pool discovery ----------------------------------------------------
+    def _pools(self) -> List[Tuple[str, object]]:
+        r = self._router
+        if hasattr(r, "prefill") and hasattr(r, "decode"):
+            return [("prefill", r.prefill), ("decode", r.decode)]
+        return [("fleet", r)]
+
+    # -- rate signals ------------------------------------------------------
+    def _sample_shed_rate(self, now: float, rejected: int) -> float:
+        last_t, last_r = self._last_t, self._last_rejected
+        self._last_rejected = rejected
+        if last_t is None or last_r is None:
+            return 0.0
+        dt = max(now - last_t, 1e-6)
+        rate = max(rejected - last_r, 0) / dt
+        self._shed_ewma += self._ALPHA * (rate - self._shed_ewma)
+        return self._shed_ewma
+
+    def _ttft_histogram(self):
+        """The latency histogram closest to TTFT for this fleet shape:
+        the prefill leg for a disagg fleet (submit -> first token),
+        the e2e router latency otherwise.  Resolved through the
+        metrics registry so the sampler needs no new plumbing."""
+        from ..obs.metrics import get_registry
+        reg = get_registry()
+        for name, labels in (("hvd_serve_pool_leg_ms", {"pool": "prefill"}),
+                             ("hvd_serve_router_ms", {"leg": "e2e"})):
+            h = reg.get(name, labels)
+            if h is not None:
+                return h
+        return None
+
+    def _sample_p99_ttft(self) -> Optional[float]:
+        from ..obs.metrics import percentile_from_buckets
+        h = self._ttft_histogram()
+        if h is None:
+            return self._p99_ewma
+        counts = list(h.counts)
+        prev = self._last_counts.get(id(h))
+        self._last_counts = {id(h): counts}
+        if prev is None or len(prev) != len(counts):
+            return self._p99_ewma
+        delta = [max(c - p, 0) for c, p in zip(counts, prev)]
+        p99 = percentile_from_buckets(h.bounds, delta, 0.99)
+        if p99 is None:
+            # no new samples this window: carry the smoothed value so
+            # a quiet poll does not read as "latency recovered"
+            return self._p99_ewma
+        if self._p99_ewma is None:
+            self._p99_ewma = float(p99)
+        else:
+            self._p99_ewma += self._ALPHA * (float(p99) - self._p99_ewma)
+        return self._p99_ewma
+
+    def _long_prompt_frac(self) -> float:
+        lens: Sequence[int] = ()
+        if hasattr(self._router, "recent_prompt_lens"):
+            try:
+                lens = self._router.recent_prompt_lens()
+            except Exception:  # noqa: BLE001
+                lens = ()
+        if not lens:
+            return 0.0
+        return sum(1 for n in lens if n >= self._long) / len(lens)
+
+    # -- the sample --------------------------------------------------------
+    def sample(self) -> LoadSnapshot:
+        now = float(self._clock())
+        backlog = 0
+        if hasattr(self._router, "migration_backlog"):
+            try:
+                backlog = int(self._router.migration_backlog())
+            except Exception:  # noqa: BLE001
+                backlog = 0
+        pools = []
+        for name, p in self._pools():
+            infos = p.healthz_infos()
+            pools.append(_pool_load(
+                name, infos,
+                migration_backlog=backlog if name == "decode" else 0))
+        try:
+            stats = self._router.stats()
+        except Exception:  # noqa: BLE001 — a mid-teardown router must
+            stats = {}        # not kill the sampler thread
+        inflight = int(stats.get("inflight", 0))
+        shed = self._sample_shed_rate(now, int(stats.get("rejected", 0)))
+        p99 = self._sample_p99_ttft()
+        frac = self._long_prompt_frac()
+        self._last_t = now
+        return LoadSnapshot(t=now, pools=tuple(pools), inflight=inflight,
+                            shed_rate=shed, p99_ttft_ms=p99,
+                            long_prompt_frac=frac)

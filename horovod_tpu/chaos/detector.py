@@ -82,6 +82,12 @@ class AccrualTracker:
         now = time.monotonic()
         recovered = suspected = False
         with self._lock:
+            if peer not in self._last_seen:
+                # a sweep can race a scale-down remove() (or see a
+                # newcomer before add()): auto-admit as never-seen
+                # rather than crash the health thread
+                self._last_seen[peer] = now
+                self._arrivals.setdefault(peer, deque(maxlen=16))
             if seq is not None and seq != self._last_seq.get(peer):
                 if peer in self._last_seq:
                     self._arrivals[peer].append(
@@ -125,7 +131,31 @@ class AccrualTracker:
         with self._lock:
             self._last_seen[peer] = time.monotonic()
             self._last_seq.pop(peer, None)
-            self._arrivals[peer].clear()
+            arr = self._arrivals.get(peer)
+            if arr is None:
+                self._arrivals[peer] = deque(maxlen=16)
+            else:
+                arr.clear()
+            self._suspected.pop(peer, None)
+
+    def add(self, peer: int) -> None:
+        """Admit a NEW peer (dynamic membership — fleet scale-up): it
+        enters in the never-seen state, so startup warmup can take as
+        long as it takes without the sweep flagging the newcomer."""
+        with self._lock:
+            self._last_seen[peer] = time.monotonic()
+            self._last_seq.pop(peer, None)
+            self._arrivals[peer] = deque(maxlen=16)
+            self._suspected.pop(peer, None)
+
+    def remove(self, peer: int) -> None:
+        """Forget ``peer`` entirely (fleet scale-down): a drained and
+        terminated replica's silence must never read as a suspicion.
+        Idempotent — removing an unknown peer is a no-op."""
+        with self._lock:
+            self._last_seen.pop(peer, None)
+            self._last_seq.pop(peer, None)
+            self._arrivals.pop(peer, None)
             self._suspected.pop(peer, None)
 
 

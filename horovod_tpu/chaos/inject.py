@@ -211,7 +211,8 @@ class Injector:
                     d = self._rng.uniform(0.0, f.seconds)
                 time.sleep(d)
             elif f.kind == "crash":
-                if site.startswith("serve."):
+                if site.startswith("serve.") \
+                        or site.startswith("autoscale."):
                     # a serve-plane crash kills the REPLICA, not the
                     # process: the caller (the batcher's step guard)
                     # raises and its scheduler thread dies — the
@@ -219,7 +220,10 @@ class Injector:
                     # is what stops its heartbeats and triggers the
                     # router's ejection path. SIGKILLing here would
                     # take the router and the healthy replicas down
-                    # with the victim.
+                    # with the victim. An autoscale.scale crash is
+                    # likewise RETURNED: the actuator is the guard —
+                    # it SIGKILLs the newcomer worker it just spawned,
+                    # never the router process.
                     returned = returned or f
                 else:
                     # the host-loss scenario: no cleanup, no atexit, no

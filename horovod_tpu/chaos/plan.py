@@ -89,7 +89,20 @@ FAULT_SITES = ("step", "store.request", "p2p.send", "p2p.recv",
                # BEFORE framing so the per-block crc ledger — not the
                # frame crc — must catch it on arrival, drop loses the
                # push before it is sent, delay sleeps.
-               "serve.migrate")
+               "serve.migrate",
+               # autoscale control plane (horovod_tpu/autoscale): fires
+               # in the ACTUATOR (router process, plan rank 0) at each
+               # APPLIED scale event — "at"/"after"/"until" count scale
+               # events, not iterations. crash kills the newcomer worker
+               # mid-warmup (admission must fail loudly and retry the
+               # spawn; live traffic is untouched because the newcomer
+               # was never admitted); delay stalls the actuator between
+               # spawn and the weight-stream admission gate (the gate
+               # must still refuse a stale-version newcomer); drop turns
+               # a graceful scale-down drain into a hard kill, so the
+               # parked-row/eject machinery must still answer every
+               # in-flight sequence exactly once.
+               "autoscale.scale")
 
 #: which kinds are meaningful at which sites (a drop needs a connection
 #: to sever; a torn write needs a shard file; a KV corruption needs a
@@ -104,12 +117,16 @@ _KIND_SITES = {
     # multi-process fleet). At the other serve sites no guard acts on
     # a returned crash, so validating it there would let fire() record
     # a "crash" that kills nothing — a soak could then prove recovery
-    # from a death that never happened
+    # from a death that never happened. (autoscale.scale qualifies: the
+    # actuator IS the guard — it SIGKILLs the newcomer it just spawned.)
     "crash": tuple(s for s in FAULT_SITES
                    if not s.startswith("serve.")) + ("serve.step",
                                                      "serve.proc"),
     "drop": ("store.request", "p2p.send", "p2p.recv",
-             "redist.transport", "serve.admit", "serve.migrate"),
+             "redist.transport", "serve.admit", "serve.migrate",
+             # drop at a scale event = the graceful drain is dropped
+             # (hard kill instead), exercising the eject/requeue path
+             "autoscale.scale"),
     "corrupt": ("store.request", "p2p.send", "redist.transport",
                 "serve.kv", "serve.migrate"),
     "partition": ("store.request", "p2p.send", "p2p.recv",
@@ -360,6 +377,14 @@ def random_plan(seed: int, world: int, steps: int, *,
     window on surviving replicas' DISPATCH channels (``serve.dispatch``
     — blips the retry ladder must absorb with ZERO failovers), and an
     admission-queue drop absorbed by router re-dispatch.
+
+    ``profile="autoscale"`` composes the scale-event scenario
+    (docs/autoscale.md): a newcomer SIGKILLed mid-warmup, the actuator
+    delayed past the weight-stream admission gate, and a scale-down
+    drain dropped — here ``steps`` is the SCALE-EVENT horizon (the
+    actuator counts applied scale events, not iterations) and
+    ``world`` is unused. The soak verdict asserts exactly-once answers
+    through every faulted scale event.
     """
     if profile == "disagg":
         if prefill is None:
@@ -379,10 +404,12 @@ def random_plan(seed: int, world: int, steps: int, *,
             f"composition; got profile {profile!r}")
     if profile == "transient":
         return _random_transient_plan(seed, world, steps)
+    if profile == "autoscale":
+        return _random_autoscale_plan(seed, steps)
     if profile != "train":
         raise PlanError(
             f"random_plan profile must be 'train', 'transient', "
-            f"'serve' or 'disagg'; got {profile!r}")
+            f"'serve', 'disagg' or 'autoscale'; got {profile!r}")
     if world < 2:
         raise PlanError(f"random_plan needs world >= 2; got {world}")
     if steps < 2 * commit_every + 2:
@@ -535,6 +562,52 @@ def _random_disagg_plan(seed: int, prefill_n: int, decode_n: int,
               peer=rng.choice(decode_rids),
               after=(a := rng.randrange(5, 9)), until=a + 2,
               epoch=0),
+    ]
+    for f in faults:
+        f.validate()
+    return ChaosPlan(seed=seed, faults=faults)
+
+
+def _random_autoscale_plan(seed: int, events: int) -> ChaosPlan:
+    """The ``profile="autoscale"`` leg of :func:`random_plan`: the
+    three disruptions a scale event must survive (docs/autoscale.md),
+    addressed in SCALE-EVENT counters — the actuator passes its own
+    applied-event ordinal to ``fire("autoscale.scale", step=n)``, so
+    a fault at event 0 lands on the very first scale-up regardless of
+    wall time. All faults fire on plan rank 0 (the router/actuator
+    process). Composition:
+
+    * ``crash`` on an early event: the newcomer worker is SIGKILLed
+      mid-warmup, BEFORE admission — the actuator must retry the spawn
+      and the front door must never 503 (pending capacity counts);
+    * a ``delay`` window: the actuator stalls between spawn and the
+      weight-stream admission gate, so a fresh version can be published
+      underneath it — the gate must still admit only the newest;
+    * a ``drop`` window on later events: a graceful scale-down drain is
+      dropped (hard kill instead) — the parked-row/eject machinery must
+      still answer every in-flight sequence exactly once.
+    """
+    if events < 6:
+        raise PlanError(
+            f"an autoscale plan needs a scale-event horizon >= 6 so "
+            f"the drop window lands on a scale-down; got {events}")
+    rng = random.Random(seed)
+    a = rng.randrange(1, 3)
+    b = rng.randrange(events // 2, events - 1)
+    faults = [
+        # SIGKILL the newcomer of the first scale-up (event 0): it was
+        # never admitted, so no live traffic is touched — the actuator
+        # must observe the death, re-spawn, and only then admit
+        Fault(rank=0, site="autoscale.scale", kind="crash", at=0),
+        # stall the actuator past the admission gate on an early event
+        Fault(rank=0, site="autoscale.scale", kind="delay",
+              seconds=round(rng.uniform(0.5, 1.5), 3),
+              after=a, until=a + 2),
+        # drop the drain of a later (scale-down) event: hard kill —
+        # fires on every crossing in the window so it is certain to
+        # land on at least one scale-down under a peak-then-cool load
+        Fault(rank=0, site="autoscale.scale", kind="drop",
+              after=b, until=events),
     ]
     for f in faults:
         f.validate()

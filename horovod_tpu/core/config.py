@@ -215,6 +215,43 @@ class Config:
     # to target-only greedy decode. 0 disables speculation even when a
     # drafter is wired up.
     serve_spec_k: int = 3
+    # Autoscale plane (horovod_tpu/autoscale): master enable — the
+    # soak/bench harnesses attach an Autoscaler to the serve router
+    # when set (HOROVOD_AUTOSCALE). Library callers construct
+    # Autoscaler directly; this knob is how the CLI surfaces opt in.
+    autoscale: bool = False
+    # Seconds between load-snapshot samples on the autoscaler's poll
+    # thread (HOROVOD_AUTOSCALE_INTERVAL_S).
+    autoscale_interval_s: float = 1.0
+    # Pool-utilization band (max of queue occupancy and paged-KV
+    # occupancy): at/above the high bar the policy grows the pool
+    # (HOROVOD_AUTOSCALE_UP_UTIL), at/below the low bar it shrinks
+    # (HOROVOD_AUTOSCALE_DOWN_UTIL); between the two it HOLDS — the
+    # hysteresis band that stops thrash.
+    autoscale_up_util: float = 0.75
+    autoscale_down_util: float = 0.25
+    # Cooldowns: minimum seconds between scale-ups of one pool
+    # (HOROVOD_AUTOSCALE_COOLDOWN_UP_S) and quiet seconds — no scale
+    # action on the pool — before a scale-down
+    # (HOROVOD_AUTOSCALE_COOLDOWN_DOWN_S; down > up so capacity is
+    # quick to arrive and slow to leave).
+    autoscale_cooldown_up_s: float = 5.0
+    autoscale_cooldown_down_s: float = 20.0
+    # Per-pool replica-count floor/ceiling the policy clamps targets
+    # to (HOROVOD_AUTOSCALE_MIN_REPLICAS /
+    # HOROVOD_AUTOSCALE_MAX_REPLICAS).
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 4
+    # Prompt-length mix: prompts at/above this many tokens count as
+    # LONG (HOROVOD_AUTOSCALE_LONG_PROMPT_TOKENS); when the long
+    # fraction of the recent-prompt window crosses
+    # HOROVOD_AUTOSCALE_LONG_PROMPT_FRAC and TTFT is over SLO, the
+    # policy grows the PREFILL pool specifically.
+    autoscale_long_prompt_tokens: int = 64
+    autoscale_long_prompt_frac: float = 0.5
+    # p99 time-to-first-token the policy defends, in ms
+    # (HOROVOD_AUTOSCALE_TTFT_SLO_MS).
+    autoscale_ttft_slo_ms: float = 5000.0
     # Checkpoint plane (horovod_tpu/ckpt): max in-flight async host
     # snapshots — save() backpressures beyond this bound
     # (HOROVOD_CKPT_SNAPSHOT_DEPTH; 2 = classic double buffering).
@@ -419,6 +456,36 @@ class Config:
         raw = os.environ.get("HOROVOD_SERVE_KERNEL")
         if raw is not None:
             c.serve_kernel = raw.strip().lower()
+        # Autoscale knobs parse strictly (same contract): a typo'd
+        # threshold must fail at startup — a policy silently running
+        # with a default band would scale on bars nobody chose.
+        c.autoscale = _env_bool("HOROVOD_AUTOSCALE", c.autoscale)
+        c.autoscale_interval_s = _env_float_strict(
+            "HOROVOD_AUTOSCALE_INTERVAL_S", c.autoscale_interval_s)
+        c.autoscale_up_util = _env_float_strict(
+            "HOROVOD_AUTOSCALE_UP_UTIL", c.autoscale_up_util)
+        c.autoscale_down_util = _env_float_strict(
+            "HOROVOD_AUTOSCALE_DOWN_UTIL", c.autoscale_down_util)
+        c.autoscale_cooldown_up_s = _env_float_strict(
+            "HOROVOD_AUTOSCALE_COOLDOWN_UP_S",
+            c.autoscale_cooldown_up_s)
+        c.autoscale_cooldown_down_s = _env_float_strict(
+            "HOROVOD_AUTOSCALE_COOLDOWN_DOWN_S",
+            c.autoscale_cooldown_down_s)
+        c.autoscale_min_replicas = _env_int_strict(
+            "HOROVOD_AUTOSCALE_MIN_REPLICAS",
+            c.autoscale_min_replicas)
+        c.autoscale_max_replicas = _env_int_strict(
+            "HOROVOD_AUTOSCALE_MAX_REPLICAS",
+            c.autoscale_max_replicas)
+        c.autoscale_long_prompt_tokens = _env_int_strict(
+            "HOROVOD_AUTOSCALE_LONG_PROMPT_TOKENS",
+            c.autoscale_long_prompt_tokens)
+        c.autoscale_long_prompt_frac = _env_float_strict(
+            "HOROVOD_AUTOSCALE_LONG_PROMPT_FRAC",
+            c.autoscale_long_prompt_frac)
+        c.autoscale_ttft_slo_ms = _env_float_strict(
+            "HOROVOD_AUTOSCALE_TTFT_SLO_MS", c.autoscale_ttft_slo_ms)
         # Ckpt knobs parse strictly (the PR 1-3 convention): a typo'd
         # depth/retention must fail at startup, not silently fall back
         # and change durability semantics mid-job.
@@ -597,6 +664,61 @@ class Config:
                 f"HOROVOD_SERVE_KERNEL must be 'auto', 'pallas' or "
                 f"'xla' (the paged decode attention kernel — resolved "
                 f"once at executor build); got {self.serve_kernel!r}")
+        if not isinstance(self.autoscale, bool):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE must be a boolean; got "
+                f"{self.autoscale!r}")
+        ai = self.autoscale_interval_s
+        if not isinstance(ai, (int, float)) or not (0 < ai <= 3600):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_INTERVAL_S must be seconds in "
+                f"(0, 3600]; got {ai!r}")
+        au, ad = self.autoscale_up_util, self.autoscale_down_util
+        if not isinstance(au, (int, float)) or not (0 < au <= 1):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_UP_UTIL must be a utilization in "
+                f"(0, 1]; got {au!r}")
+        if not isinstance(ad, (int, float)) or not (0 <= ad < 1):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_DOWN_UTIL must be a utilization "
+                f"in [0, 1); got {ad!r}")
+        if ad >= au:
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_DOWN_UTIL ({ad!r}) must be below "
+                f"HOROVOD_AUTOSCALE_UP_UTIL ({au!r}) — the gap is the "
+                f"hysteresis band; an empty band thrashes")
+        for name, v in (("HOROVOD_AUTOSCALE_COOLDOWN_UP_S",
+                         self.autoscale_cooldown_up_s),
+                        ("HOROVOD_AUTOSCALE_COOLDOWN_DOWN_S",
+                         self.autoscale_cooldown_down_s)):
+            if not isinstance(v, (int, float)) or not (0 <= v <= 86_400):
+                raise ValueError(
+                    f"{name} must be seconds in [0, 86400]; got {v!r}")
+        amin = self.autoscale_min_replicas
+        amax = self.autoscale_max_replicas
+        if not isinstance(amin, int) or not (1 <= amin <= 4096):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_MIN_REPLICAS must be an int in "
+                f"[1, 4096]; got {amin!r}")
+        if not isinstance(amax, int) or not (amin <= amax <= 4096):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_MAX_REPLICAS must be an int in "
+                f"[{amin}, 4096] (>= the replica floor); got {amax!r}")
+        lt = self.autoscale_long_prompt_tokens
+        if not isinstance(lt, int) or not (1 <= lt <= 1_000_000):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_LONG_PROMPT_TOKENS must be an int "
+                f"in [1, 1000000]; got {lt!r}")
+        lf = self.autoscale_long_prompt_frac
+        if not isinstance(lf, (int, float)) or not (0 < lf <= 1):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_LONG_PROMPT_FRAC must be a "
+                f"fraction in (0, 1]; got {lf!r}")
+        ts = self.autoscale_ttft_slo_ms
+        if not isinstance(ts, (int, float)) or not (0 < ts <= 86_400_000):
+            raise ValueError(
+                f"HOROVOD_AUTOSCALE_TTFT_SLO_MS must be milliseconds "
+                f"in (0, 86400000]; got {ts!r}")
         mp = self.metrics_port
         if not isinstance(mp, int) or not (0 <= mp <= 65535):
             raise ValueError(

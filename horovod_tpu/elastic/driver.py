@@ -62,6 +62,14 @@ class ElasticDriver:
         self._secret = make_secret()
         self._stop = threading.Event()
         self._rc = 0
+        # -- co-scheduling (autoscale/cosched.py): a requested world
+        # size narrows the next slot computation; the supervise loop
+        # converts a pending request into an ordinary elastic reset,
+        # so survivors elastic-restore in memory (redist/elastic.py)
+        # exactly as they would after a host loss.
+        self._requested_np: Optional[int] = None
+        self._current_np = 0
+        self._resize_lock = threading.Lock()
         # -- metrics: membership churn events, scraped off the driver
         # process's registry (HOROVOD_METRICS_PORT works here too)
         R = obs_metrics.get_registry()
@@ -69,7 +77,8 @@ class ElasticDriver:
                     "hvd_elastic_host_events_total",
                     "hvd_elastic_worker_failures_total",
                     "hvd_elastic_recovery_ms",
-                    "hvd_elastic_last_recovery_ms"):
+                    "hvd_elastic_last_recovery_ms",
+                    "hvd_elastic_resize_requests_total"):
             R.unregister(fam)
         self._m_resets = R.counter(
             "hvd_elastic_resets_total",
@@ -89,6 +98,38 @@ class ElasticDriver:
         self._m_worker_failures = R.counter(
             "hvd_elastic_worker_failures_total",
             "worker exits with non-zero rc (host blacklisted)")
+        self._m_resize = {
+            k: R.counter("hvd_elastic_resize_requests_total",
+                         "co-scheduler resize requests accepted by the "
+                         "elastic driver", {"direction": k})
+            for k in ("shrink", "grow")}
+
+    # -- co-scheduling resize surface (autoscale/cosched.py lever) ---------
+    def current_np(self) -> int:
+        """World size of the running incarnation (0 before the first
+        launch)."""
+        return self._current_np
+
+    def request_resize(self, target_np: int) -> None:
+        """Ask for a world of ``target_np`` at the next supervise poll.
+
+        Clamped into [min_np, max_np]; a no-op request (already at the
+        target) clears any pending one.  The actual resize is an
+        ordinary elastic reset: workers are torn down and relaunched
+        at the new size, and the survivors restore training state IN
+        MEMORY through ``redist.elastic_restore`` — no checkpoint
+        reads."""
+        target = max(int(target_np), self.min_np)
+        if self.max_np is not None:
+            target = min(target, self.max_np)
+        with self._resize_lock:
+            cur = self._current_np
+            self._requested_np = target
+            if target != cur and cur > 0:
+                self._m_resize["shrink" if target < cur
+                               else "grow"].inc()
+        logger.info("elastic: resize requested np=%d (current %d)",
+                    target, cur)
 
     # -- host assignment (driver.py:240 _update_host_assignments) ----------
     def _compute_slots(self, hosts: List[HostInfo],
@@ -96,9 +137,16 @@ class ElasticDriver:
         np_ = sum(h.slots for h in hosts)
         if self.max_np is not None:
             np_ = min(np_, self.max_np)
+        with self._resize_lock:
+            req = self._requested_np
+        if req is not None:
+            # co-scheduler shrink: use fewer slots than discovered
+            # (growth stays bounded by what discovery actually offers)
+            np_ = min(np_, max(req, self.min_np))
         if np_ < self.min_np:
             raise RuntimeError(
                 f"Only {np_} slots available, below min_np={self.min_np}")
+        self._current_np = np_
         # order hosts so surviving ones keep their rank blocks
         if previous:
             prev_order = []
@@ -265,6 +313,23 @@ class ElasticDriver:
                     self._m_host_events["leave"].inc(left)
                 self._terminate_workers()
                 return "reset"
+            # co-scheduler resize poll: a pending request that changes
+            # the ACHIEVABLE world size (bounded by the discovered
+            # slots, so an unmeetable grow does not reset-loop) is an
+            # ordinary elastic reset at the new size
+            with self._resize_lock:
+                req = self._requested_np
+            if req is not None:
+                avail = sum(now.values())
+                if self.max_np is not None:
+                    avail = min(avail, self.max_np)
+                achievable = max(min(req, avail), self.min_np)
+                if achievable != self._current_np:
+                    logger.info(
+                        "elastic: resize %d -> %d (requested %d); "
+                        "resetting", self._current_np, achievable, req)
+                    self._terminate_workers()
+                    return "reset"
             time.sleep(self.poll_interval)
 
     def _terminate_workers(self) -> None:

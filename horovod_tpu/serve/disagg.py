@@ -68,13 +68,14 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..obs import metrics as obs_metrics
 from . import wire
 from .fleet import FLEET_REJECTED_HELP, FleetHandle
 from .proc_fleet import (DEFAULT_SPAWN_TIMEOUT_S, ProcessFleetRouter,
-                         SHED_BASE_MS)
+                         SHED_BASE_MS, _PROMPT_WINDOW)
 from .queue import Rejected
 
 logger = logging.getLogger("horovod_tpu")
@@ -95,6 +96,10 @@ REPREFILLS_HELP = ("requests re-prefilled after a prefill death, "
 POOL_LEG_HELP = ("disaggregated request legs by pool: prefill = "
                  "submit -> first token (TTFT), decode = migration "
                  "done -> final resolution (ms)")
+MIGRATION_BACKLOG_HELP = (
+    "requests parked in the migrate phase awaiting free decode "
+    "capacity (the staging-buffer wait — the autoscale policy's "
+    "decode-saturation signal)")
 
 
 class _DisaggTracked:
@@ -177,7 +182,11 @@ class DisaggRouter:
                     "hvd_serve_migrate_bytes_total",
                     "hvd_serve_migrations_total",
                     "hvd_serve_reprefills_total",
-                    "hvd_serve_pool_leg_ms"):
+                    "hvd_serve_pool_leg_ms",
+                    "hvd_serve_pool_queue_free",
+                    "hvd_serve_pool_kv_blocks_free",
+                    "hvd_serve_pool_replicas_up",
+                    "hvd_serve_pool_migration_backlog"):
             R.unregister(fam)
         common = dict(kv_addr=kv_addr, kv_port=kv_port,
                       channel=channel, interval_s=interval_s,
@@ -207,6 +216,11 @@ class DisaggRouter:
         self._fids = itertools.count()
         self.draining = False
         self.started = False
+        #: fleet-unique replica id allocator for runtime scale-ups:
+        #: BOTH pools draw from one counter, so a prefill newcomer can
+        #: never collide with the decode pool's rid_base range
+        self._next_rid = int(prefill_replicas) + int(decode_replicas)
+        self._recent_prompts: deque = deque(maxlen=_PROMPT_WINDOW)
         self._m_migrate_ms = R.histogram(
             "hvd_serve_migrate_ms", MIGRATE_MS_HELP)
         self._m_migrate_bytes = R.counter(
@@ -221,6 +235,9 @@ class DisaggRouter:
         self._m_rejected = R.counter(
             "hvd_serve_fleet_rejected_total", FLEET_REJECTED_HELP,
             {"pool": "disagg"})
+        self._m_backlog = R.gauge(
+            "hvd_serve_pool_migration_backlog", MIGRATION_BACKLOG_HELP,
+            {"pool": "decode"})
 
     def _count_migration(self, outcome: str) -> None:
         m = self._m_migrations.get(outcome)
@@ -352,6 +369,8 @@ class DisaggRouter:
             raise Rejected(
                 f"fleet at max in-flight ({self.max_inflight})",
                 retry_after_ms=SHED_BASE_MS * self._capacity_scale())
+        with self._lock:
+            self._recent_prompts.append(len(prompt))
         fid = next(self._fids)
         handle = FleetHandle(fid)
         handle.on_done = self._release_slot
@@ -373,8 +392,51 @@ class DisaggRouter:
 
     def migration_backlog(self) -> int:
         with self._lock:
-            return sum(1 for tr in self._inflight.values()
-                       if tr.phase == "migrate")
+            n = sum(1 for tr in self._inflight.values()
+                    if tr.phase == "migrate")
+        # refreshed on every read — healthz() and the autoscale signal
+        # sampler both poll this, so the gauge tracks at poll cadence
+        self._m_backlog.set(n)
+        return n
+
+    # -- runtime scaling (autoscale actuator) --------------------------------
+    def _pool_named(self, pool: str) -> ProcessFleetRouter:
+        if pool == "prefill":
+            return self.prefill
+        if pool == "decode":
+            return self.decode
+        raise ValueError(
+            f"pool must be 'prefill' or 'decode'; got {pool!r}")
+
+    def add_replica(self, pool: str, *, pre_admit=None,
+                    timeout_s: Optional[float] = None) -> int:
+        """Grow ``pool`` by one replica at runtime (the pool router's
+        :meth:`ProcessFleetRouter.add_replica` admission discipline),
+        with the replica id drawn from THIS router's fleet-unique
+        allocator — a prefill newcomer must never collide with a
+        decode rid for chaos ``peer`` addressing or metric labels."""
+        p = self._pool_named(pool)
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        return p.add_replica(rid=rid, pre_admit=pre_admit,
+                             timeout_s=timeout_s)
+
+    def remove_replica(self, pool: str, rid: Optional[int] = None, *,
+                       graceful: bool = True,
+                       timeout_s: float = 30.0) -> int:
+        """Shrink ``pool`` by one replica at runtime; the graceful
+        path waits out in-flight dispatches AND parked migration rows
+        before terminating (see
+        :meth:`ProcessFleetRouter.remove_replica`)."""
+        return self._pool_named(pool).remove_replica(
+            rid, graceful=graceful, timeout_s=timeout_s)
+
+    def recent_prompt_lens(self) -> List[int]:
+        """Prompt lengths of recently admitted requests (bounded
+        window) — the autoscale signal plane's prompt-mix source."""
+        with self._lock:
+            return list(self._recent_prompts)
 
     def _run_request(self, tr: _DisaggTracked) -> None:
         try:

@@ -284,15 +284,26 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
     saturated decode pool degrades honestly (``degraded`` names it)
     but the front door keeps answering 200 — new prompts can still be
     admitted, parked and migrated once decode capacity frees.
+
+    PENDING capacity counts toward liveness: a replica mid-spawn or
+    mid-warmup (state ``spawning``/``respawning`` — a scale-up
+    newcomer or a respawn in flight) is capacity that is seconds away,
+    so the front door answers 200 with the pool listed in
+    ``degraded`` rather than 503 — a scale event must never flap the
+    front door into telling clients the fleet is gone.
     """
     reps: Dict[str, dict] = {}
     q_free = blocks_free = 0
-    per_rid: Dict[int, Tuple[int, int]] = {}
+    pend_n = 0
+    per_rid: Dict[int, Tuple[int, int, int]] = {}
     for rid, info in replicas_info.items():
         entry = {k: info.get(k) for k in
                  ("state", "up", "draining", "queue_depth",
                   "weights_version", "restarts")}
         rq = rb = 0
+        pending = 1 if str(info.get("state")) in (
+            "spawning", "respawning") else 0
+        pend_n += pending
         if info.get("up"):
             rq = max(int(info.get("queue_free") or 0), 0)
             q_free += rq
@@ -301,15 +312,17 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
                       - int(info.get("kv_blocks_in_use") or 0))
                 blocks_free += rb
                 entry["kv_blocks_in_use"] = info.get("kv_blocks_in_use")
-        per_rid[rid] = (rq, rb)
+        per_rid[rid] = (rq, rb, pending)
         reps[str(rid)] = entry
     up_n = sum(1 for r in reps.values() if r["up"])
     out = {
-        "ok": up_n > 0 and q_free > 0 and not draining,
+        "ok": ((up_n > 0 and q_free > 0) or pend_n > 0)
+        and not draining,
         "draining": draining,
         "replicas": reps,
         "capacity": {"replicas_up": up_n,
                      "replicas_total": len(reps),
+                     "replicas_pending": pend_n,
                      "queue_free": q_free,
                      "kv_blocks_free": blocks_free},
         "retry_after_ms": retry_after_ms,
@@ -317,16 +330,19 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
     if pools:
         out["pools"] = {}
         admit_free = 0
+        admit_pending = 0
         any_admitting = False
         degraded = []
         for name, spec in pools.items():
             rids = list(spec.get("replicas", ()))
-            pq = sum(per_rid.get(r, (0, 0))[0] for r in rids)
-            pb = sum(per_rid.get(r, (0, 0))[1] for r in rids)
+            pq = sum(per_rid.get(r, (0, 0, 0))[0] for r in rids)
+            pb = sum(per_rid.get(r, (0, 0, 0))[1] for r in rids)
+            ppend = sum(per_rid.get(r, (0, 0, 0))[2] for r in rids)
             pup = sum(1 for r in rids
                       if reps.get(str(r), {}).get("up"))
             entry = {"replicas": [str(r) for r in rids],
                      "replicas_up": pup,
+                     "replicas_pending": ppend,
                      "queue_free": pq, "kv_blocks_free": pb,
                      "admitting": bool(spec.get("admitting", False))}
             for k, v in spec.items():
@@ -336,12 +352,15 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
             if entry["admitting"]:
                 any_admitting = True
                 admit_free += pq
+                admit_pending += ppend
             if pup == 0 or pq == 0:
                 degraded.append(name)
         if any_admitting:
-            # 503 only when ADMITTING capacity (prefill) is zero —
-            # a saturated/down decode pool degrades, never lies
-            out["ok"] = admit_free > 0 and not draining
+            # 503 only when ADMITTING capacity (prefill) is zero AND
+            # none is pending — a saturated/down decode pool degrades,
+            # and a pool mid-scale-up keeps answering 200, never lies
+            out["ok"] = (admit_free > 0 or admit_pending > 0) \
+                and not draining
         if degraded:
             out["degraded"] = sorted(degraded)
     return out

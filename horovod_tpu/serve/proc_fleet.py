@@ -52,6 +52,7 @@ import os
 import sys
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..chaos import inject as _chaos
@@ -72,8 +73,17 @@ SHED_BASE_MS = 250.0
 RESPAWNS_HELP = "replica worker processes respawned after ejection"
 FLEET_CAPACITY_HELP = \
     "replicas currently admitted (up) in the process fleet"
+POOL_QUEUE_FREE_HELP = \
+    "free admission-queue slots summed over the pool's admitted replicas"
+POOL_KV_FREE_HELP = \
+    "free paged-KV blocks summed over the pool's admitted replicas"
+POOL_REPLICAS_UP_HELP = \
+    "replicas currently admitted (up) in this pool"
 #: how long the router waits for a spawned worker to register ready
 DEFAULT_SPAWN_TIMEOUT_S = 120.0
+#: bounded window of recently admitted prompt lengths (the autoscale
+#: signal plane's prompt-mix source)
+_PROMPT_WINDOW = 512
 
 
 class ProcessReplica:
@@ -190,12 +200,19 @@ class ProcessFleetRouter:
         self.chaos_plan = chaos_plan
         ids = list(range(int(rid_base),
                          int(rid_base) + int(n_replicas)))
+        self._python = python
+        self._log_dir = log_dir
         self.replicas: Dict[int, ProcessReplica] = {
             r: ProcessReplica(r, python=python, log_dir=log_dir)
             for r in ids}
         self._tracker = AccrualTracker(
             ids, interval_s=interval_s, suspect_s=suspect_s)
         self._lock = threading.Lock()
+        # serializes runtime membership changes (autoscale actuator):
+        # one add/remove at a time, so rid allocation and the
+        # below-one-replica floor stay race-free
+        self._scale_lock = threading.Lock()
+        self._recent_prompts: deque = deque(maxlen=_PROMPT_WINDOW)
         self._inflight: Dict[int, _Tracked] = {}
         #: submit-time in-flight reservations (released on resolution)
         self._reserved = 0
@@ -243,8 +260,12 @@ class ProcessFleetRouter:
                         "hvd_serve_fleet_rejected_total",
                         "hvd_serve_router_ms", "hvd_serve_failover_ms",
                         "hvd_serve_respawns_total",
-                        "hvd_serve_fleet_capacity"):
+                        "hvd_serve_fleet_capacity",
+                        "hvd_serve_pool_queue_free",
+                        "hvd_serve_pool_kv_blocks_free",
+                        "hvd_serve_pool_replicas_up"):
                 R.unregister(fam)
+        self._pl = pl
         self._m_up = {
             r: R.gauge("hvd_serve_replica_up", REPLICA_UP_HELP,
                        dict(pl, replica=str(r))) for r in ids}
@@ -267,6 +288,20 @@ class ProcessFleetRouter:
         self._m_capacity = R.gauge(
             "hvd_serve_fleet_capacity", FLEET_CAPACITY_HELP,
             pl or None)
+        # metrics-plane mirror of the /healthz capacity facts: the
+        # autoscale signal plane and external monitors read THESE, not
+        # the JSON front door. An un-pooled fleet labels itself "fleet"
+        # so the family shape is uniform across deployments.
+        pool_label = {"pool": str(pool) if pool is not None else "fleet"}
+        self._m_pool_qfree = R.gauge(
+            "hvd_serve_pool_queue_free", POOL_QUEUE_FREE_HELP,
+            pool_label)
+        self._m_pool_kvfree = R.gauge(
+            "hvd_serve_pool_kv_blocks_free", POOL_KV_FREE_HELP,
+            pool_label)
+        self._m_pool_up = R.gauge(
+            "hvd_serve_pool_replicas_up", POOL_REPLICAS_UP_HELP,
+            pool_label)
 
     # -- events --------------------------------------------------------------
     def add_listener(self, fn: Callable[[dict], None]) -> None:
@@ -391,6 +426,7 @@ class ProcessFleetRouter:
             rep.state = "up"
             self._m_up[rep.id].set(1)
         self._m_capacity.set(len(self.replicas))
+        self._update_pool_gauges(len(self.replicas))
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True,
             name="hvd-procfleet-health")
@@ -517,6 +553,8 @@ class ProcessFleetRouter:
             raise Rejected(
                 f"fleet at max in-flight ({self.max_inflight})",
                 retry_after_ms=SHED_BASE_MS * self._capacity_scale())
+        with self._lock:
+            self._recent_prompts.append(len(prompt))
         fid = next(self._fids)
         handle = FleetHandle(fid)
         handle.on_done = self._release_slot   # exactly once, on the
@@ -657,7 +695,9 @@ class ProcessFleetRouter:
         def attempt() -> Tuple[str, dict]:
             if _chaos._INJ is not None:
                 with self._lock:
-                    n = self._dispatches[rep.id]
+                    # .get: the replica may have been removed (scale
+                    # down) between candidate pick and a ladder replay
+                    n = self._dispatches.get(rep.id, 0)
                     self._dispatches[rep.id] = n + 1
                 f = _chaos.fire("serve.dispatch", peer=rep.id, step=n)
                 if f is not None and f.kind == "conn_reset":
@@ -811,17 +851,40 @@ class ProcessFleetRouter:
                 threading.Thread(
                     target=self._respawn, args=(rep,), daemon=True,
                     name=f"hvd-procfleet-respawn-{rid}").start()
-        self._m_capacity.set(sum(
-            1 for r in self.replicas.values() if r.state == "up"))
+        up_n = sum(1 for r in self.replicas.values()
+                   if r.state == "up")
+        self._m_capacity.set(up_n)
+        self._update_pool_gauges(up_n)
 
-    def _eject(self, rid: int, reason: str) -> None:
-        rep = self.replicas[rid]
-        t0 = time.monotonic()
-        rep.state = "down"
-        self._m_up[rid].set(0)
-        self._m_failovers.inc()
-        logger.error("fleet: EJECTING replica %d process (%s) — "
-                     "re-enqueueing its in-flight requests", rid, reason)
+    def _update_pool_gauges(self, up_n: Optional[int] = None) -> None:
+        """Mirror the pool's live capacity facts onto the labeled
+        ``hvd_serve_pool_*{pool=...}`` gauges (refreshed per sweep and
+        on every membership change)."""
+        max_q = int(self.worker_cfg.get("max_queue", 64))
+        q_free = kv_free = n_up = 0
+        for rep in self.replicas.values():
+            if rep.state != "up":
+                continue
+            n_up += 1
+            q_free += max(max_q - rep.queue_depth, 0)
+            h = rep.healthz_cache
+            if "kv_blocks_total" in h:
+                # evictable = prefix-cache-retained blocks, reclaimed
+                # on demand by the paged admission gate — headroom,
+                # not occupancy
+                kv_free += max(
+                    int(h["kv_blocks_total"])
+                    - int(h.get("kv_blocks_in_use") or 0)
+                    + int(h.get("kv_blocks_evictable") or 0), 0)
+        self._m_pool_qfree.set(q_free)
+        self._m_pool_kvfree.set(kv_free)
+        self._m_pool_up.set(up_n if up_n is not None else n_up)
+
+    def _requeue_victims(self, rid: int) -> Tuple[int, int]:
+        """Detach every in-flight request owned by ``rid`` and see each
+        to a resolution exactly once: re-dispatch onto a sibling while
+        attempts remain, else a structured rejection — never a silent
+        drop. Shared by ejection and hard scale-down."""
         with self._lock:
             victims = [tr for tr in self._inflight.values()
                        if tr.rid == rid and not tr.handle.done()]
@@ -845,6 +908,17 @@ class ProcessFleetRouter:
             threading.Thread(
                 target=self._run_request, args=(tr, rid), daemon=True,
                 name=f"hvd-procfleet-requeue-{tr.fid}").start()
+        return requeued, rejected
+
+    def _eject(self, rid: int, reason: str) -> None:
+        rep = self.replicas[rid]
+        t0 = time.monotonic()
+        rep.state = "down"
+        self._m_up[rid].set(0)
+        self._m_failovers.inc()
+        logger.error("fleet: EJECTING replica %d process (%s) — "
+                     "re-enqueueing its in-flight requests", rid, reason)
+        requeued, rejected = self._requeue_victims(rid)
         failover_ms = (time.monotonic() - t0) * 1000.0
         self.last_failover_ms = failover_ms
         self._m_failover_ms.observe(failover_ms)
@@ -898,6 +972,216 @@ class ProcessFleetRouter:
             with self._lock:
                 self._respawning.discard(rid)
 
+    # -- runtime scaling (autoscale actuator) --------------------------------
+    def add_replica(self, *, rid: Optional[int] = None,
+                    pre_admit: Optional[
+                        Callable[[ProcessReplica], None]] = None,
+                    timeout_s: Optional[float] = None) -> int:
+        """Grow the fleet by ONE replica at runtime.
+
+        Rides the exact respawn substrate: spawn a fresh worker
+        process, wait for its endpoint registration, audit the weight
+        gate (the newcomer must serve the channel's newest published
+        version — ``_wait_ready``'s existing re-admission check,
+        generalized), and only then admit it to the candidate set. Live
+        traffic never routes to the newcomer before admission
+        (``_candidates`` reads state "up" only), so a newcomer dying
+        mid-warmup costs nothing but the retry.
+
+        ``pre_admit`` is the chaos hook for the ``autoscale.scale``
+        fault site, called between spawn and the readiness wait — it
+        may kill or stall the newcomer. A newcomer that fails to
+        register is retried ONCE before the call fails loudly; the
+        hook is not re-fired on the retry.
+
+        Returns the new replica id; raises RuntimeError when no worker
+        could be admitted within the timeout.
+        """
+        if not self.started:
+            raise RuntimeError("ProcessFleetRouter.start() first")
+        timeout = (self.spawn_timeout_s if timeout_s is None
+                   else float(timeout_s))
+        with self._scale_lock:
+            if self.draining:
+                raise RuntimeError("fleet draining — cannot scale up")
+            with self._lock:
+                if rid is None:
+                    rid = max(self.replicas) + 1
+                elif int(rid) in self.replicas:
+                    raise ValueError(
+                        f"replica id {rid} already exists")
+            rid = int(rid)
+            rep = ProcessReplica(rid, python=self._python,
+                                 log_dir=self._log_dir)
+            R = obs_metrics.get_registry()
+            g = R.gauge("hvd_serve_replica_up", REPLICA_UP_HELP,
+                        dict(self._pl, replica=str(rid)))
+            g.set(0)
+            with self._lock:
+                # register BEFORE spawning (atomic dict swaps —
+                # _candidates/_sweep iterate these without the lock):
+                # the warming newcomer must read as PENDING capacity in
+                # healthz_infos(), so a scale event never 503s the
+                # front door. It cannot take traffic — _candidates and
+                # the sweep both act on state "up" only.
+                reps = dict(self.replicas)
+                reps[rid] = rep
+                disp = dict(self._dispatches)
+                disp.setdefault(rid, 0)
+                mu = dict(self._m_up)
+                mu[rid] = g
+                self.replicas, self._dispatches = reps, disp
+                self._m_up = mu
+            self._emit("scale_up_begin", rid)
+            admitted = False
+            for _ in range(2):
+                self._spawn(rep)
+                if pre_admit is not None:
+                    hook, pre_admit = pre_admit, None
+                    hook(rep)
+                if self._wait_ready(rep, timeout):
+                    admitted = True
+                    break
+                rep.kill()
+                rep.restarts += 1
+                self._emit("scale_up_retry", rid)
+            if not admitted or self.draining or self._stop.is_set():
+                rep.kill()
+                with self._lock:
+                    reps = dict(self.replicas)
+                    reps.pop(rid, None)
+                    disp = dict(self._dispatches)
+                    disp.pop(rid, None)
+                    mu = dict(self._m_up)
+                    mu.pop(rid, None)
+                    self.replicas, self._dispatches = reps, disp
+                    self._m_up = mu
+                self._emit("scale_up_failed", rid)
+                raise RuntimeError(
+                    f"fleet: scale-up replica {rid} was not admitted "
+                    f"within {timeout:.0f}s")
+            self._tracker.add(rid)
+            rep.state = "up"
+            g.set(1)
+            up_n = sum(1 for r in self.replicas.values()
+                       if r.state == "up")
+            self._m_capacity.set(up_n)
+            self._update_pool_gauges(up_n)
+            logger.info(
+                "fleet: replica %d admitted by scale-up (pid %s, "
+                "weights v%s)", rid, rep.pid, rep.weights_version)
+            self._emit("scale_up", rid, pid=rep.pid,
+                       weights_version=rep.weights_version)
+            return rid
+
+    def remove_replica(self, rid: Optional[int] = None, *,
+                       graceful: bool = True,
+                       timeout_s: float = 30.0) -> int:
+        """Shrink the fleet by ONE replica at runtime.
+
+        Graceful (the default): the victim leaves the candidate set
+        immediately (state "removing" — new dispatches skip it), the
+        router waits out the victim's own in-flight dispatches AND the
+        worker's reported queue/parked tail (a parked row is a
+        sequence mid-migration — killing its host would drop it), then
+        sends SIGTERM so the worker's drain path finishes the rest.
+
+        On drain timeout — or with ``graceful=False`` (the chaos
+        "drop the drain" fault) — the process is SIGKILLed and the
+        victim's in-flight requests ride the exact ejection discipline
+        (:meth:`_requeue_victims`): re-dispatch or structured reject,
+        exactly once, never a silent drop.
+
+        Picks the highest-id admitted replica when ``rid`` is None.
+        Refuses (ValueError) to take the fleet below one admitted
+        replica. Returns the removed replica id.
+        """
+        with self._scale_lock:
+            with self._lock:
+                ups = [r for r in self.replicas.values()
+                       if r.state == "up"]
+                if rid is None:
+                    if not ups:
+                        raise ValueError(
+                            "no admitted replica to remove")
+                    rep = max(ups, key=lambda r: r.id)
+                else:
+                    rep = self.replicas.get(int(rid))
+                    if rep is None:
+                        raise ValueError(f"unknown replica id {rid}")
+                if rep.state == "up" and len(ups) <= 1:
+                    raise ValueError(
+                        "refusing to scale below one admitted replica")
+                rid = rep.id
+                rep.state = "removing"
+            self._m_up[rid].set(0)
+            self._emit("scale_down_begin", rid,
+                       graceful=bool(graceful))
+            drained = False
+            if graceful:
+                deadline = time.monotonic() + float(timeout_s)
+                while time.monotonic() < deadline \
+                        and not self._stop.is_set():
+                    with self._lock:
+                        busy = any(
+                            tr.rid == rid and not tr.handle.done()
+                            for tr in self._inflight.values())
+                    if not busy:
+                        h = self._fetch_healthz(rep, timeout=0.5)
+                        if h is None:
+                            break   # worker already gone
+                        if int(h.get("queue_depth") or 0) == 0 \
+                                and int(h.get("parked") or 0) == 0:
+                            drained = True
+                            break
+                    # lock-order: exempt (_scale_lock EXISTS to
+                    # serialize add/remove_replica against each other
+                    # across the whole drain; dispatch runs under the
+                    # separate self._lock, which is NOT held here)
+                    time.sleep(0.05)
+                rep.terminate()   # SIGTERM: the worker drains itself
+                deadline = time.monotonic() + 10.0
+                while rep.proc is not None \
+                        and rep.proc.poll() is None \
+                        and time.monotonic() < deadline:
+                    # lock-order: exempt (same: only the scale-op
+                    # serialization lock is held while waiting out the
+                    # victim's exit — siblings are other scale ops)
+                    time.sleep(0.05)
+            rep.kill()            # hard kill (no-op after clean exit)
+            requeued, rejected = self._requeue_victims(rid)
+            with self._lock:
+                reps = dict(self.replicas)
+                reps.pop(rid, None)
+                disp = dict(self._dispatches)
+                disp.pop(rid, None)
+                mu = dict(self._m_up)
+                mu.pop(rid, None)
+                self.replicas, self._dispatches = reps, disp
+                self._m_up = mu
+                hb = self._hb_clients.pop(rid, None)
+            self._tracker.remove(rid)
+            if hb is not None:
+                hb.close()
+            up_n = sum(1 for r in self.replicas.values()
+                       if r.state == "up")
+            self._m_capacity.set(up_n)
+            self._update_pool_gauges(up_n)
+            logger.info(
+                "fleet: replica %d removed by scale-down (graceful=%s "
+                "drained=%s requeued=%d rejected=%d)", rid,
+                bool(graceful), drained, requeued, rejected)
+            self._emit("scale_down", rid, graceful=bool(graceful),
+                       drained=drained, requeued=requeued,
+                       rejected=rejected)
+            return rid
+
+    def recent_prompt_lens(self) -> List[int]:
+        """Prompt lengths of recently admitted requests (bounded
+        window) — the autoscale signal plane's prompt-mix source."""
+        with self._lock:
+            return list(self._recent_prompts)
+
     # -- introspection -------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
@@ -947,6 +1231,8 @@ class ProcessFleetRouter:
             if up and "kv_blocks_total" in h:
                 info["kv_blocks_total"] = h["kv_blocks_total"]
                 info["kv_blocks_in_use"] = h.get("kv_blocks_in_use", 0)
+                info["kv_blocks_evictable"] = h.get(
+                    "kv_blocks_evictable", 0)
             infos[rid] = info
         return infos
 

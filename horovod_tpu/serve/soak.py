@@ -1274,3 +1274,448 @@ def run_serve_soak(out_dir: Optional[str] = None, *,
         with open(os.path.join(out_dir, "verdict.json"), "w") as f:
             json.dump(verdict, f, indent=2, sort_keys=True)
     return verdict
+
+def evaluate_autoscale(records: List[dict], events: List[dict], plan,
+                       fleet_stats: dict, *, slo_p99_ms: float,
+                       slo_error_rate: float,
+                       recovery_window_s: float,
+                       newest_version: Optional[int],
+                       min_per_pool: int) -> dict:
+    """The AUTOSCALE verdict: the serve invariants (zero silent drops,
+    answered-once, sheds carry retry hints, SLO outside recovery
+    windows) plus the scaling-loop invariants:
+
+    * **scaled_up / scaled_down** — EVERY pool (prefill and decode)
+      grew at least once under the burst and shrank at least once in
+      the cool phase: a soak where one pool never moved proves nothing
+      about that pool's loop.
+    * **scale_actions_ok** — no applied action failed: a crash-faulted
+      scale-up must end admitted (the spawn retry), a drop-faulted
+      scale-down must end removed (the hard-kill path with its
+      requeue discipline).
+    * **newcomers_on_newest** — every admitted newcomer entered on the
+      newest published weight version (the respawn gate, generalized).
+    * **faults_all_fired** — when a chaos plan was installed, every
+      scheduled ``autoscale.scale`` fault actually landed.
+    * **capacity_restored** — the fleet ends scaled back down: every
+      pool at its floor with every survivor on the newest weights.
+
+    Recovery windows open around every chaos fault AND every applied
+    scale event (a spawn or drain is a planned disruption: the SLO is
+    asserted on traffic that does not overlap one).
+    """
+    v: Dict[str, Any] = {
+        "submitted": len(records), "statuses": {},
+        "no_silent_drops": None, "answered_once": None,
+        "shed_carry_retry_after": None,
+        "scaled_up": None, "scaled_down": None,
+        "scale_actions_ok": None, "newcomers_on_newest": None,
+        "faults_all_fired": None, "slo_held": None,
+        "p99_outside_ms": None, "error_rate_outside": None,
+        "clean_ok_samples": None, "capacity_restored": None,
+        "duplicates_suppressed":
+            fleet_stats.get("duplicates_suppressed", 0),
+    }
+    statuses: Dict[str, int] = {}
+    for r in records:
+        statuses[r["status"]] = statuses.get(r["status"], 0) + 1
+    v["statuses"] = statuses
+    v["no_silent_drops"] = (
+        len(records) > 0
+        and all(r["status"] != "pending" for r in records)
+        and fleet_stats.get("inflight", 0) == 0)
+    v["answered_once"] = all(r.get("resolutions", 1) <= 1
+                             for r in records)
+    shed = [r for r in records if r["status"] in ("shed", "rejected")]
+    v["shed_carry_retry_after"] = all(
+        (r.get("retry_after_ms") or 0) > 0 for r in shed)
+
+    # -- the scaling loop actually closed, in BOTH directions, per pool
+    scale = [e for e in events if e.get("kind") == "scale"]
+    counts: Dict[str, Dict[str, int]] = {}
+    for e in scale:
+        if e.get("ok"):
+            c = counts.setdefault(e.get("pool"), {"up": 0, "down": 0})
+            c[e.get("direction")] = c.get(e.get("direction"), 0) + 1
+    v["scale_events"] = {p: dict(c) for p, c in sorted(counts.items())}
+    pools = ("prefill", "decode")
+    v["scaled_up"] = all(counts.get(p, {}).get("up", 0) > 0
+                         for p in pools)
+    v["scaled_down"] = all(counts.get(p, {}).get("down", 0) > 0
+                           for p in pools)
+    v["scale_actions_ok"] = (len(scale) > 0
+                             and all(e.get("ok") for e in scale))
+
+    ups = [e for e in scale if e.get("direction") == "up"
+           and e.get("ok")]
+    v["newcomers_on_newest"] = (
+        len(ups) > 0 and newest_version is not None
+        and all(e.get("weights_version") == newest_version
+                for e in ups))
+
+    if plan is not None and plan.faults:
+        want = {(f.site, f.kind) for f in plan.faults}
+        got = {(e.get("site"), e.get("fault")) for e in events
+               if e.get("kind") == "chaos"}
+        v["faults_all_fired"] = want <= got
+
+    # -- SLO outside recovery windows: chaos faults AND scale events
+    # are both planned disruptions
+    windows = [(e["t"], e["t"] + recovery_window_s) for e in events
+               if (e.get("kind") == "chaos"
+                   and e.get("fault") in _DISRUPTIVE)
+               or e.get("kind") == "scale"]
+
+    def outside(r):
+        return not any(r["t0"] < hi and r["t1"] > lo
+                       for lo, hi in windows)
+
+    clean = [r for r in records if outside(r)]
+    oks = sorted(r["latency_ms"] for r in clean
+                 if r["status"] == "ok"
+                 and r.get("latency_ms") is not None)
+    v["clean_ok_samples"] = len(oks)
+    served = [r for r in clean
+              if r["status"] not in ("shed", "rejected")]
+    errs = [r for r in served if r["status"] in ("error", "expired")]
+    if len(oks) >= 20:
+        v["p99_outside_ms"] = round(
+            oks[min(len(oks) - 1, int(0.99 * len(oks)))], 1)
+        v["error_rate_outside"] = round(
+            len(errs) / max(len(served), 1), 4)
+        v["slo_held"] = (v["p99_outside_ms"] <= slo_p99_ms
+                         and v["error_rate_outside"] <= slo_error_rate)
+    else:
+        v["slo_held"] = False   # too few clean samples to claim an SLO
+
+    # -- ends scaled back to the floor, everyone on newest weights
+    p_stats = fleet_stats.get("prefill", {})
+    d_stats = fleet_stats.get("decode", {})
+    versions = [r.get("weights_version")
+                for r in fleet_stats.get("replicas", {}).values()]
+    v["capacity_restored"] = (
+        p_stats.get("replicas_up") == min_per_pool
+        and d_stats.get("replicas_up") == min_per_pool
+        and newest_version is not None
+        and all(ver == newest_version for ver in versions))
+
+    v["ok"] = all(v[k] is not False for k in (
+        "no_silent_drops", "answered_once", "shed_carry_retry_after",
+        "scaled_up", "scaled_down", "scale_actions_ok",
+        "newcomers_on_newest", "faults_all_fired", "slo_held",
+        "capacity_restored"))
+    return v
+
+
+def run_autoscale_soak(out_dir: Optional[str] = None, *,
+                       clients: int = 4,
+                       seed: int = 0, plan=None,
+                       scale_horizon: int = 8,
+                       suspect_s: float = FLEET_SUSPECT_S,
+                       interval_s: float = DEFAULT_INTERVAL_S,
+                       slo_p99_ms: float = DEFAULT_SLO_P99_MS,
+                       slo_error_rate: float = DEFAULT_SLO_ERROR_RATE,
+                       recovery_window_s: float = 8.0,
+                       max_duration_s: float = 240.0,
+                       max_new_tokens: int = 8,
+                       deadline_ms: float = 20000.0,
+                       max_replicas: int = 2,
+                       spawn_timeout_s: float = 120.0) -> dict:
+    """The AUTOSCALE soak (acceptance for the autoscale tentpole): a
+    1+1 disaggregated fleet behind a live :class:`Autoscaler`, driven
+    with PHASED closed-loop traffic — a light warmup, then a
+    long-prompt burst that must grow both pools to ``max_replicas``,
+    then a cool-down that must drain them back to the floor with no
+    sequence dropped — cycling until every pool has scaled BOTH
+    directions (and, under a chaos plan, every ``autoscale.scale``
+    fault has landed). A fresh weight version is published before the
+    first burst so every newcomer must admit on it. Returns the
+    :func:`evaluate_autoscale` verdict; never raises on a failed
+    invariant.
+
+    ``plan`` follows the other soaks: None for no chaos, ``"random"``
+    for the seeded autoscale profile (newcomer killed mid-warmup, the
+    actuator stalled past the weight stream, a drain turned into a
+    hard kill), or an explicit :class:`ChaosPlan`/JSON.
+    """
+    import tempfile
+
+    from ..autoscale import Autoscaler, PolicyConfig, SignalSource
+    from ..chaos import inject
+    from ..chaos.plan import ChaosPlan, random_plan
+    from ..native.store import StoreServer
+    from ..redist.stream import WeightPublisher
+    from .disagg import DisaggRouter
+    from .worker import tiny_gpt_builder
+
+    resolved = None
+    if plan == "random":
+        resolved = random_plan(seed, 2, scale_horizon,
+                               profile="autoscale")
+    elif isinstance(plan, ChaosPlan):
+        resolved = plan
+    elif plan is not None:
+        resolved = ChaosPlan.parse(str(plan))
+
+    work_dir = out_dir or tempfile.mkdtemp(prefix="hvd_autoscale_soak.")
+    os.makedirs(work_dir, exist_ok=True)
+    channel = f"assoak{seed}"
+
+    events: List[dict] = []
+    records: List[dict] = []
+    ev_lock = threading.Lock()
+
+    def log_event(kind: str, ev: dict) -> None:
+        with ev_lock:
+            events.append(dict(ev, kind=kind))
+
+    srv = StoreServer()
+    built = tiny_gpt_builder(seed=seed, paged=True)
+    pub = WeightPublisher(channel, kv_addr="127.0.0.1",
+                          kv_port=srv.port, resume_timeout=0.05)
+    pub.publish(built["params"])              # version 1, pre-burst
+
+    stop = threading.Event()
+    torn_down = []
+    router = None
+    scaler = None
+
+    def _teardown() -> None:
+        # idempotent and reached on EVERY exit path, so the poll
+        # thread, worker processes, store server, publisher and global
+        # injector never leak into the caller's process
+        if torn_down:
+            return
+        torn_down.append(True)
+        stop.set()
+        if scaler is not None:
+            try:
+                scaler.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if router is not None:
+            try:
+                router.close()
+            except Exception:  # noqa: BLE001
+                pass
+        inject.uninstall()
+        try:
+            pub.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            srv.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    try:
+        worker = {
+            "builder": "horovod_tpu.serve.worker:tiny_gpt_builder",
+            "builder_kwargs": {"seed": seed, "paged": True},
+            "buckets": [32], "max_queue": 8,
+            "deadline_ms": deadline_ms, "kv_crc": True}
+        router = DisaggRouter(
+            1, 1, kv_addr="127.0.0.1", kv_port=srv.port,
+            prefill_worker=worker, decode_worker=worker,
+            channel=channel, ns=f"asoak{seed}", interval_s=interval_s,
+            suspect_s=suspect_s, chaos_plan=resolved,
+            events_dir=os.path.join(work_dir, "worker_events"),
+            log_dir=os.path.join(work_dir, "logs"),
+            spawn_timeout_s=spawn_timeout_s)
+        router.add_listener(lambda ev: log_event("fleet", ev))
+
+        if resolved is not None:
+            inj = inject.install(resolved, rank=0)
+            inj.add_listener(lambda ev: log_event(
+                "chaos", {"fault": ev["kind"],
+                          **{k: x for k, x in ev.items()
+                             if k != "kind"}}))
+
+        # aggressive thresholds so the tiny fleet's burst crosses the
+        # bands within seconds: the POLICY is what the tier-1 replay
+        # tests pin down; the soak proves the LOOP end to end
+        cfg = PolicyConfig(
+            up_util=0.3, down_util=0.1,
+            cooldown_up_s=1.0, cooldown_down_s=3.0,
+            min_replicas=1, max_replicas=max_replicas,
+            long_prompt_tokens=24, long_prompt_frac=0.5,
+            ttft_slo_ms=5.0)
+        scaler = Autoscaler(
+            router, policy_config=cfg,
+            source=SignalSource(router, long_prompt_tokens=24),
+            interval_s=0.25,
+            trace_path=os.path.join(work_dir, "trace.jsonl"),
+            graceful_timeout_s=30.0,
+            spawn_timeout_s=spawn_timeout_s)
+        scaler.add_listener(lambda ev: log_event("scale", ev))
+
+        return _autoscale_soak_body(
+            router, scaler, resolved, events, records, ev_lock,
+            work_dir, pub, built, stop, _teardown,
+            clients=clients, slo_p99_ms=slo_p99_ms,
+            slo_error_rate=slo_error_rate,
+            recovery_window_s=recovery_window_s,
+            max_duration_s=max_duration_s,
+            max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
+            max_replicas=max_replicas, seed=seed)
+    finally:
+        _teardown()
+
+
+def _autoscale_soak_body(router, scaler, resolved, events, records,
+                         ev_lock, work_dir, pub, built, stop,
+                         teardown, *, clients, slo_p99_ms,
+                         slo_error_rate, recovery_window_s,
+                         max_duration_s, max_new_tokens, deadline_ms,
+                         max_replicas, seed) -> dict:
+    """The guarded body of :func:`run_autoscale_soak` — every exit
+    path runs the caller's teardown."""
+    from .queue import Rejected
+
+    router.start()
+    burst = threading.Event()   # clients read this: burst vs light load
+    rec_lock = threading.Lock()
+
+    def client(cid: int) -> None:
+        import numpy as np
+        rng = np.random.RandomState(40_000 + cid)
+        while not stop.is_set():
+            if burst.is_set():
+                # long-prompt burst: every prompt over the 24-token
+                # bar (and under the 32-token bucket / 48 context),
+                # no pacing — the mix shift the policy must see
+                n = int(rng.randint(25, 33))
+                pace = 0.0
+            else:
+                n = int(rng.randint(2, 8))
+                pace = 0.1
+            prompt = list(rng.randint(1, 64, n))
+            t0 = time.time()
+            rec = {"fid": None, "t0": t0, "t1": None,
+                   "status": "pending", "latency_ms": None,
+                   "retry_after_ms": None, "resolutions": 0,
+                   "replica": None, "client": cid}
+            try:
+                h = router.submit(prompt,
+                                  max_new_tokens=max_new_tokens)
+            except Rejected as e:
+                rec.update(status="shed",
+                           retry_after_ms=e.retry_after_ms,
+                           t1=time.time())
+                with rec_lock:
+                    records.append(rec)
+                time.sleep(min((e.retry_after_ms or 100.0), 500.0)
+                           / 1000.0)
+                continue
+            h.wait(timeout=deadline_ms / 1000.0 + 60.0)
+            rec.update(fid=h.fid, t1=time.time(),
+                       status=h.status, latency_ms=h.latency_ms,
+                       retry_after_ms=h.retry_after_ms,
+                       resolutions=h.resolutions, replica=h.replica)
+            with rec_lock:
+                records.append(rec)
+            if h.status == "rejected" and h.retry_after_ms:
+                time.sleep(min(h.retry_after_ms, 500.0) / 1000.0)
+            time.sleep(pace)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    # fresh weights BEFORE any scale-up: every newcomer must stream
+    # and admit on v2 while the founding replicas re-admit onto it
+    time.sleep(1.0)
+    pub.publish(built["params"])              # version 2
+
+    scaler.start()
+
+    def scale_counts() -> Dict[str, Dict[str, int]]:
+        with ev_lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for e in events:
+                if e.get("kind") == "scale" and e.get("ok"):
+                    c = out.setdefault(e.get("pool"),
+                                       {"up": 0, "down": 0})
+                    c[e.get("direction")] += 1
+            return out
+
+    def goals_met() -> bool:
+        c = scale_counts()
+        both = all(c.get(p, {}).get("up", 0) > 0
+                   and c.get(p, {}).get("down", 0) > 0
+                   for p in ("prefill", "decode"))
+        if not both:
+            return False
+        if resolved is not None:
+            want = {(f.site, f.kind) for f in resolved.faults}
+            with ev_lock:
+                got = {(e.get("site"), e.get("fault")) for e in events
+                       if e.get("kind") == "chaos"}
+            if not want <= got:
+                return False
+        return True
+
+    def at_floor() -> bool:
+        s = router.stats()
+        return (s["prefill"]["replicas_up"] == 1
+                and s["decode"]["replicas_up"] == 1)
+
+    def at_ceiling() -> bool:
+        s = router.stats()
+        return (s["prefill"]["replicas_up"] >= max_replicas
+                and s["decode"]["replicas_up"] >= max_replicas)
+
+    def wait_until(pred, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if stop.is_set() or pred():
+                return True
+            time.sleep(0.25)
+        return pred()
+
+    deadline = t_start + max_duration_s
+    while time.monotonic() < deadline and not goals_met():
+        burst.set()
+        wait_until(at_ceiling, min(60.0, deadline - time.monotonic()))
+        burst.clear()
+        wait_until(at_floor, min(60.0, deadline - time.monotonic()))
+    # final cool: end at the floor for capacity_restored
+    burst.clear()
+    wait_until(at_floor, max(deadline - time.monotonic(), 10.0))
+    scaler.stop()
+    stop.set()
+    for t in threads:
+        t.join(timeout=deadline_ms / 1000.0 + 65.0)
+
+    fleet_stats = router.stats()
+    newest_version = pub._version
+    with ev_lock:
+        all_events = sorted(events, key=lambda e: e.get("t", 0.0))
+    teardown()
+
+    verdict = evaluate_autoscale(
+        records, all_events, resolved, fleet_stats,
+        slo_p99_ms=slo_p99_ms, slo_error_rate=slo_error_rate,
+        recovery_window_s=recovery_window_s,
+        newest_version=newest_version, min_per_pool=1)
+    verdict.update({
+        "seed": seed, "clients": clients, "processes": True,
+        "disagg": True, "autoscale": True,
+        "max_replicas": max_replicas,
+        "wall_s": round(time.monotonic() - t_start, 2),
+        "plan": (json.loads(resolved.to_json())
+                 if resolved is not None else None),
+        "fleet": fleet_stats,
+        "out_dir": work_dir,
+    })
+    with open(os.path.join(work_dir, "events.jsonl"), "w") as f:
+        for e in all_events:
+            f.write(json.dumps(e, default=str) + "\n")
+    with open(os.path.join(work_dir, "requests.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    with open(os.path.join(work_dir, "verdict.json"), "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+    return verdict

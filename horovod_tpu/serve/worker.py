@@ -486,6 +486,12 @@ class ReplicaEndpoint:
         if getattr(b, "paged", False):
             info["kv_blocks_in_use"] = b.kv.pool.in_use()
             info["kv_blocks_total"] = b.kv.pool.num_blocks
+            # blocks held ONLY by the prefix cache (refcount-zero
+            # runs): resident but reclaimable on demand — load signals
+            # must not read cache residency as capacity pressure
+            info["kv_blocks_evictable"] = (
+                b.prefix.evictable_blocks()
+                if getattr(b, "prefix", None) is not None else 0)
         # disaggregated-serving evidence (serve/disagg.py healthz +
         # the disagg soak verdict read these per pool)
         info["migrations_in"] = b.migrations_in

@@ -1,0 +1,69 @@
+"""ISSUE 17 autoscale acceptance (slow tier): a 1+1 disaggregated
+fleet of REAL worker OS processes behind a live ``Autoscaler``, driven
+with phased bursty traffic through the seeded ``profile="autoscale"``
+chaos plan.
+
+The plan crashes the FIRST scale-up's newcomer mid-warmup, stalls the
+actuator past the admission gate inside a delay window, and turns a
+drain into a hard kill inside a drop window. The bar
+(docs/autoscale.md):
+
+* capacity tracked load: each pool scaled UP under the burst and back
+  DOWN off-peak (scale_events per pool in both directions),
+* every applied scale action crossed the ``autoscale.scale`` site and
+  every planned fault actually fired,
+* every request answered exactly once or shed with retry-after —
+  drains dropped no sequence even when chaos turned them hard,
+* newcomers admitted only on the NEWEST published weight version
+  (a fresh version is published before the scaler starts),
+* p99 TTFT SLO held outside the bounded windows around faults and
+  scale events,
+* the fleet cooled back to the 1+1 floor on the newest weights.
+
+Driven through the tools/serve_soak.py --autoscale CLI so the CLI
+contract is covered by the same run. Mirrors
+test_serve_disagg_soak.py, including the 3-consecutive-green
+requirement verified at PR time.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.mark.slow
+def test_autoscale_soak_acceptance(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_soak.py"),
+         "--autoscale", "--clients", "4", "--seed", "7",
+         "--out", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=480)
+    assert out.stdout.strip(), out.stderr[-3000:]
+    verdict = json.loads(out.stdout)
+    detail = json.dumps(verdict, indent=2, sort_keys=True)[:3000]
+    assert verdict["autoscale"] is True, detail
+    # capacity tracked load: both directions, in every pool
+    assert verdict["scaled_up"] is True, detail
+    assert verdict["scaled_down"] is True, detail
+    assert verdict["scale_actions_ok"] is True, detail
+    # chaos: every planned autoscale.scale fault actually fired
+    assert verdict["faults_all_fired"] is True, detail
+    # exactly-once through every faulted scale event
+    assert verdict["no_silent_drops"] is True, detail
+    assert verdict["answered_once"] is True, detail
+    assert verdict["shed_carry_retry_after"] is True, detail
+    # admission gate: newcomers only on the newest published weights
+    assert verdict["newcomers_on_newest"] is True, detail
+    # SLO held outside the bounded fault/scale windows
+    assert verdict["slo_held"] is True, detail
+    # cooled back to the floor on the newest weights
+    assert verdict["capacity_restored"] is True, detail
+    assert verdict["ok"] is True, detail

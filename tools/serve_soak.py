@@ -103,10 +103,39 @@ def main(argv=None) -> int:
                    help="--disagg: prefill pool size (default 2)")
     p.add_argument("--decode", type=int, default=1,
                    help="--disagg: decode pool size (default 1)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="AUTOSCALE soak: a 1+1 disaggregated fleet "
+                        "behind a live Autoscaler driven with phased "
+                        "bursty traffic; both pools must scale up AND "
+                        "back down with zero dropped sequences and "
+                        "newcomers admitted on the newest weights "
+                        "(docs/autoscale.md)")
+    p.add_argument("--max-replicas", type=int, default=2,
+                   help="--autoscale: per-pool ceiling (default 2)")
+    p.add_argument("--no-chaos", action="store_true",
+                   help="--autoscale: skip the autoscale-profile chaos "
+                        "plan (scale events run unfaulted)")
     args = p.parse_args(argv)
 
     # one fleet on CPU devices; keep the run reproducible
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.autoscale:
+        from horovod_tpu.serve.soak import run_autoscale_soak
+        verdict = run_autoscale_soak(
+            args.out, clients=args.clients, seed=args.seed,
+            plan=None if args.no_chaos else args.plan,
+            suspect_s=2.0 if args.suspect_s is None else args.suspect_s,
+            slo_p99_ms=args.slo_p99_ms,
+            slo_error_rate=args.slo_error_rate,
+            recovery_window_s=max(args.recovery_window, 8.0),
+            max_duration_s=(240.0 if args.max_duration is None
+                            else args.max_duration),
+            max_replicas=args.max_replicas,
+            spawn_timeout_s=args.spawn_timeout)
+        json.dump(verdict, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0 if verdict["ok"] else 1
 
     if args.disagg:
         from horovod_tpu.serve.soak import run_disagg_soak
