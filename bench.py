@@ -650,7 +650,12 @@ def run_serve_benchmark() -> int:
       * jit-cache-flat: the admission churn of the overload burst adds
         zero compiled programs after warmup in every configuration;
       * speculation: < 0.7 target-model steps per generated token
-        (machine-independent), acceptance rate exported via obs.
+        (machine-independent), acceptance rate exported via obs;
+      * tracing overhead: the full configuration with the tracing
+        plane armed (a per-request context, every batcher record site
+        live) emits bit-identical tokens, stays within
+        HVD_BENCH_SERVE_TRACE_OVERHEAD (default 3%) of untraced
+        tokens/s, and adds zero compiled programs.
 
     Keeps emitting serve_tokens_per_s / serve_p50_ms (now for the full
     configuration) so the bench trajectory stays comparable."""
@@ -719,7 +724,15 @@ def run_serve_benchmark() -> int:
                    for _ in range(n_req)]
         prime = system + list(rng.randint(0, 256, tail_max))
 
-        def drive(paged, prefix, spec, kernel="xla"):
+        def drive(paged, prefix, spec, kernel="xla", traced=False):
+            from horovod_tpu.trace.context import TraceContext
+
+            def _trace():
+                # a fresh wire-form context per request: every record
+                # site in the batcher goes live, exactly the armed-
+                # tracing cost a traced fleet pays per request
+                return (TraceContext.mint().to_wire() if traced
+                        else None)
             mcfg = GPTConfig(decode=True, **kw,
                              kv_block_size=block if paged else 0,
                              kv_pool_blocks=pool_blocks if paged else 0,
@@ -739,14 +752,15 @@ def run_serve_benchmark() -> int:
                                   draft_executor=draft, spec_k=spec_k)
             b.warmup()
             jit0 = ex.jit_cache_size()
-            q.submit(prime, max_new_tokens=max_new)
+            q.submit(prime, max_new_tokens=max_new, trace=_trace())
             b.run()                      # prime: publishes the prefix run
             # best-of-2 bursts: one shared-machine hiccup must not turn
             # a real 2x layout win into a flaky gate verdict
             wall, handles = None, None
             for _ in range(2):
                 t0 = time.perf_counter()
-                hs = [q.submit(p, max_new_tokens=max_new)
+                hs = [q.submit(p, max_new_tokens=max_new,
+                               trace=_trace())
                       for p in prompts]
                 b.run()
                 dt = time.perf_counter() - t0
@@ -785,11 +799,18 @@ def run_serve_benchmark() -> int:
         # an EMULATOR, so off-TPU the speed ratio only documents the
         # emulation cost and the gate asserts PARITY, not speed)
         full_pallas = drive(True, True, True, kernel="pallas")
+        # tracing armed: identical full configuration, every batcher
+        # record site live with a per-request context — the tracing
+        # plane's overhead gate (docs/tracing.md)
+        trace_bar = float(os.environ.get(
+            "HVD_BENCH_SERVE_TRACE_OVERHEAD", "0.03"))
+        full_traced = drive(True, True, True, traced=True)
 
         accept = obs_metrics.get_registry().get(
             "hvd_serve_spec_accept_rate")
         speedup = paged["tok_s"] / slotted["tok_s"]
         kernel_speedup = full_pallas["tok_s"] / full["tok_s"]
+        trace_ratio = full_traced["tok_s"] / full["tok_s"]
         # tokens-resident bound: the shared prefix run plus each row's
         # private tail+generation+speculative-margin blocks, with 1.5x
         # slack for re-prefills/CoW — far under slots x max_len
@@ -823,6 +844,14 @@ def run_serve_benchmark() -> int:
             "kernel_jit_flat": full_pallas["jit_flat"],
             **({"kernel_speedup_ge_1": kernel_speedup >= 1.0}
                if platform == "tpu" else {}),
+            # tracing must be free where it matters: identical
+            # tokens, tokens/s within the overhead bar, zero new
+            # compiled programs (spans never touch traced jax code)
+            "trace_bit_identical":
+                full_traced["tokens"] == slotted["tokens"],
+            "trace_overhead_within_bar":
+                trace_ratio >= 1.0 - trace_bar,
+            "trace_jit_flat": full_traced["jit_flat"],
         }
         common = {"platform": platform, "requests": n_req,
                   "max_batch": max_batch, "system_prompt_len": sys_len,
@@ -876,6 +905,13 @@ def run_serve_benchmark() -> int:
             "xla_ttft_p99_ms": (None if full["ttft_p99_ms"] is None
                                 else round(full["ttft_p99_ms"], 1)),
             "gated_on_speed": platform == "tpu",
+            **common}), flush=True)
+        print(json.dumps({
+            "metric": "serve_trace_overhead",
+            "value": round(1.0 - trace_ratio, 4), "unit": "fraction",
+            "bar": trace_bar,
+            "traced_tokens_per_s": round(full_traced["tok_s"], 2),
+            "untraced_tokens_per_s": round(full["tok_s"], 2),
             **common}), flush=True)
         print(json.dumps({
             "metric": "serve_spec_steps_per_token",

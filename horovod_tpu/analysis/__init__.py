@@ -1,6 +1,6 @@
 """horovod_tpu.analysis — the repo-native static-analysis plane.
 
-Five stdlib-``ast`` passes over ``horovod_tpu/`` plus a runtime
+Six stdlib-``ast`` passes over ``horovod_tpu/`` plus a runtime
 lock-order witness, all jax-free (importable standalone by
 ``tools/check.py`` on a box with no accelerator stack):
 
@@ -23,6 +23,10 @@ metric-help        ``metric-help``       one help-string source per
 resilience         ``resilience``        socket-error handlers in the
                                          wire planes route through the
                                          resilience classifier
+trace-registry     ``trace``             span names recorded anywhere
+                                         declared in trace/spans.py
+                                         SPAN_LEGS + docs/tracing.md;
+                                         hvd_trace_leg_ms legs likewise
 ================== ===================== ==============================
 
 CLI: ``python tools/check.py`` (``--pass``, ``--baseline``,
@@ -40,13 +44,13 @@ from . import witness
 
 #: lazy surface: submodules + the core names re-exported from .core.
 _LAZY_MODULES = ("core", "collective", "knobs", "locks",
-                 "metrics_drift", "resilience_lint")
+                 "metrics_drift", "resilience_lint", "trace_registry")
 _CORE_NAMES = ("Finding", "SourceFile", "collect_files",
                "load_baseline", "read_baseline_entries", "run_passes",
                "write_baseline")
 #: registry order = report order.
 _PASS_MODULE_ORDER = ("collective", "locks", "knobs", "metrics_drift",
-                      "resilience_lint")
+                      "resilience_lint", "trace_registry")
 
 __all__ = ["ALL_PASSES", "PASS_BY_ID", "witness",
            *_LAZY_MODULES, *_CORE_NAMES]

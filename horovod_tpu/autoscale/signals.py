@@ -11,9 +11,10 @@ plan sequence byte-for-byte, with no processes and no clocks.
 the signals are *rates*: shed rate is the diff of the router's
 ``rejected`` counter over the sample interval, and the p99 TTFT is a
 WINDOWED percentile computed by diffing a latency histogram's bucket
-counts between samples (``obs.metrics.percentile_from_buckets`` on the
-deltas) so a burst shows up within one poll instead of being averaged
-away by the process-lifetime histogram.
+counts between samples (``obs.metrics.HistogramWindow`` — the shared
+snapshot-delta engine the trace plane's leg attribution also rides) so
+a burst shows up within one poll instead of being averaged away by the
+process-lifetime histogram.
 
 Stdlib-only: no jax, no processes — safe to import from the router's
 health thread and from pure policy tests alike.
@@ -182,9 +183,11 @@ class SignalSource:
         self._last_t: Optional[float] = None
         self._last_rejected: Optional[int] = None
         self._shed_ewma = 0.0
-        # histogram identity -> last seen bucket counts (for windowing)
-        self._last_counts: Dict[int, List[int]] = {}
-        self._p99_ewma: Optional[float] = None
+        # the shared snapshot-delta windower (obs.metrics): same ALPHA
+        # as the shed-rate EWMA, same carry-previous-on-quiet-poll
+        # semantics the inline implementation had
+        from ..obs.metrics import HistogramWindow
+        self._p99_window = HistogramWindow(q=0.99, alpha=self._ALPHA)
 
     # -- pool discovery ----------------------------------------------------
     def _pools(self) -> List[Tuple[str, object]]:
@@ -219,26 +222,7 @@ class SignalSource:
         return None
 
     def _sample_p99_ttft(self) -> Optional[float]:
-        from ..obs.metrics import percentile_from_buckets
-        h = self._ttft_histogram()
-        if h is None:
-            return self._p99_ewma
-        counts = list(h.counts)
-        prev = self._last_counts.get(id(h))
-        self._last_counts = {id(h): counts}
-        if prev is None or len(prev) != len(counts):
-            return self._p99_ewma
-        delta = [max(c - p, 0) for c, p in zip(counts, prev)]
-        p99 = percentile_from_buckets(h.bounds, delta, 0.99)
-        if p99 is None:
-            # no new samples this window: carry the smoothed value so
-            # a quiet poll does not read as "latency recovered"
-            return self._p99_ewma
-        if self._p99_ewma is None:
-            self._p99_ewma = float(p99)
-        else:
-            self._p99_ewma += self._ALPHA * (float(p99) - self._p99_ewma)
-        return self._p99_ewma
+        return self._p99_window.sample(self._ttft_histogram())
 
     def _long_prompt_frac(self) -> float:
         lens: Sequence[int] = ()

@@ -341,6 +341,27 @@ class Config:
     # fails tier-1 on a witnessed acquisition cycle
     # (horovod_tpu/analysis/witness.py, docs/analysis.md).
     analysis_witness: bool = False
+    # Distributed request tracing over the serve fleet (HOROVOD_TRACE):
+    # 1 arms the router-side TraceAssembler — span contexts minted at
+    # admission, piggyback collection, leg attribution, tail sampling,
+    # flight recorder (horovod_tpu/trace, docs/tracing.md). Workers
+    # need no knob: they record for any message carrying a context.
+    trace: bool = False
+    # Head-sample rate in [0, 1] (HOROVOD_TRACE_SAMPLE): fraction of
+    # requests whose FULL trace is retained even when nothing
+    # interesting happened; tail sampling keeps the interesting ones
+    # regardless.
+    trace_sample: float = 0.0
+    # Per-process span-ring capacity, total spans (HOROVOD_TRACE_RING):
+    # a worker whose router never collects evicts oldest-trace-first
+    # past this bound.
+    trace_ring: int = 4096
+    # Retained-trace ring on the router (HOROVOD_TRACE_RETAIN): the
+    # last N tail-sampled traces kept for the flight recorder.
+    trace_retain: int = 256
+    # e2e milliseconds at/above which a request counts as SLOW and its
+    # trace is retained (HOROVOD_TRACE_SLOW_MS).
+    trace_slow_ms: float = 2000.0
     # Profiler trace annotations around collectives
     # (HOROVOD_DISABLE_NVTX_RANGES, mirroring the reference's NVTX
     # switch; read lazily in ops/collective_ops.py profiler_range).
@@ -546,6 +567,15 @@ class Config:
             "HOROVOD_ELASTIC_POLL_INTERVAL_S", c.elastic_poll_interval_s)
         c.analysis_witness = _env_bool(
             "HOROVOD_ANALYSIS_WITNESS", c.analysis_witness)
+        c.trace = _env_bool("HOROVOD_TRACE", c.trace)
+        c.trace_sample = _env_float_strict(
+            "HOROVOD_TRACE_SAMPLE", c.trace_sample)
+        c.trace_ring = _env_int_strict(
+            "HOROVOD_TRACE_RING", c.trace_ring)
+        c.trace_retain = _env_int_strict(
+            "HOROVOD_TRACE_RETAIN", c.trace_retain)
+        c.trace_slow_ms = _env_float_strict(
+            "HOROVOD_TRACE_SLOW_MS", c.trace_slow_ms)
         c.disable_nvtx_ranges = _env_bool(
             "HOROVOD_DISABLE_NVTX_RANGES", c.disable_nvtx_ranges)
         c.dynamic_process_sets = _env_bool(
@@ -729,6 +759,27 @@ class Config:
             raise ValueError(
                 f"HOROVOD_METRICS_TIMELINE_PERIOD must be seconds in "
                 f"[0, 86400] (0 disables); got {mtp!r}")
+        tsr = self.trace_sample
+        if not isinstance(tsr, (int, float)) or not (0 <= tsr <= 1):
+            raise ValueError(
+                f"HOROVOD_TRACE_SAMPLE must be a fraction in [0, 1]; "
+                f"got {tsr!r}")
+        tring = self.trace_ring
+        if not isinstance(tring, int) or not (1 <= tring <= 10_000_000):
+            raise ValueError(
+                f"HOROVOD_TRACE_RING must be an int in [1, 10000000] "
+                f"(total spans buffered per process); got {tring!r}")
+        tret = self.trace_retain
+        if not isinstance(tret, int) or not (1 <= tret <= 1_000_000):
+            raise ValueError(
+                f"HOROVOD_TRACE_RETAIN must be an int in [1, 1000000] "
+                f"(tail-sampled traces kept); got {tret!r}")
+        tslow = self.trace_slow_ms
+        if not isinstance(tslow, (int, float)) \
+                or not (0 < tslow <= 86_400_000):
+            raise ValueError(
+                f"HOROVOD_TRACE_SLOW_MS must be milliseconds in "
+                f"(0, 86400000]; got {tslow!r}")
         sd = self.ckpt_snapshot_depth
         if not isinstance(sd, int) or not (1 <= sd <= 64):
             raise ValueError(

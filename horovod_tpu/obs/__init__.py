@@ -25,8 +25,9 @@ reconnects). See docs/metrics.md and docs/chaos.md.
 """
 from .metrics import (                                          # noqa: F401
     BYTES_BUCKETS, COUNT_BUCKETS, LATENCY_MS_BUCKETS,
-    Counter, Gauge, Histogram, MetricsRegistry,
+    Counter, Gauge, Histogram, HistogramWindow, MetricsRegistry,
     get_registry, log_buckets, merge_snapshots, percentile_from_buckets,
+    snapshot_to_prometheus,
 )
 from .exporter import (                                         # noqa: F401
     Exporter, TimelineEmitter, make_metrics_server, start_exporter,

@@ -242,6 +242,69 @@ def percentile_from_buckets(bounds: Sequence[float],
     return float(bounds[-1])
 
 
+class HistogramWindow:
+    """Windowed percentiles over a live :class:`Histogram`: diff the
+    bucket counts against the previous ``sample()`` and interpolate the
+    percentile from the DELTA — so a burst shows up within one poll
+    instead of being averaged away by the process-lifetime histogram.
+
+    The shared snapshot-delta engine behind the autoscaler's windowed
+    p99 TTFT signal (autoscale/signals.py) and the tracing plane's
+    per-leg attribution (trace/collect.py): one implementation, so the
+    two consumers cannot drift on the delta/EWMA semantics. Optional
+    EWMA smoothing (``alpha`` in (0, 1]; ``alpha=1`` disables the
+    memory) matches the signal sampler's historical behavior exactly —
+    the autoscale replay-trace pin test asserts byte-identical
+    snapshots across the extraction.
+
+    Stateful but histogram-agnostic: ``sample(h)`` windows whichever
+    histogram it is handed (keyed by object identity, like the signal
+    sampler it replaces), returning the smoothed windowed percentile or
+    the previous value when the window saw no new observations (a quiet
+    poll must not read as "latency recovered"). Not thread-safe; each
+    sampler thread owns its window.
+    """
+
+    __slots__ = ("_q", "_alpha", "_last_counts", "_ewma")
+
+    def __init__(self, q: float = 0.99, alpha: float = 1.0):
+        if not (0.0 <= q <= 1.0):
+            raise ValueError(f"quantile must be in [0, 1]; got {q}")
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError(f"alpha must be in (0, 1]; got {alpha}")
+        self._q = float(q)
+        self._alpha = float(alpha)
+        self._last_counts: Dict[int, List[int]] = {}
+        self._ewma: Optional[float] = None
+
+    @property
+    def value(self) -> Optional[float]:
+        """The last smoothed sample (None until one lands)."""
+        return self._ewma
+
+    def sample(self, h: Optional[Histogram]) -> Optional[float]:
+        """Window ``h`` against the previous call: percentile of the
+        bucket-count delta, EWMA-merged. ``h=None`` (series not created
+        yet) and an empty window both carry the previous value."""
+        if h is None:
+            return self._ewma
+        with h._lock:
+            counts = list(h.counts)
+        prev = self._last_counts.get(id(h))
+        self._last_counts = {id(h): counts}
+        if prev is None or len(prev) != len(counts):
+            return self._ewma
+        delta = [max(c - p, 0) for c, p in zip(counts, prev)]
+        p = percentile_from_buckets(h.bounds, delta, self._q)
+        if p is None:
+            return self._ewma
+        if self._ewma is None:
+            self._ewma = float(p)
+        else:
+            self._ewma += self._alpha * (float(p) - self._ewma)
+        return self._ewma
+
+
 class _Family:
     __slots__ = ("name", "kind", "help", "bounds", "children")
 
@@ -435,6 +498,54 @@ def merge_snapshots(snaps: Iterable[dict]) -> dict:
     return {"counters": list(counters.values()),
             "gauges": list(gauges.values()),
             "histograms": list(hists.values())}
+
+
+def snapshot_to_prometheus(snap: dict,
+                           help_from: Optional["MetricsRegistry"] = None
+                           ) -> str:
+    """Render a ``snapshot()``/``merge_snapshots()`` dict as Prometheus
+    text exposition — the fleet-wide ``/metrics?fleet=1`` read path
+    (serve/http.py), where the merged series exist only as a snapshot,
+    never as a live registry. HELP/TYPE lines come from ``help_from``
+    (the local registry, which carries the same families) when the
+    family exists there; TYPE is always derivable from the snapshot
+    section."""
+    lines: List[str] = []
+    by_name: Dict[str, Tuple[str, List[dict]]] = {}
+    for kind, section in (("counter", "counters"), ("gauge", "gauges"),
+                          ("histogram", "histograms")):
+        for e in snap.get(section, []):
+            by_name.setdefault(e["name"], (kind, []))[1].append(e)
+    for name in sorted(by_name):
+        kind, entries = by_name[name]
+        help_ = ""
+        if help_from is not None:
+            fam = help_from._families.get(name)
+            if fam is not None:
+                help_ = fam.help
+        if help_:
+            lines.append(f"# HELP {name} {_escape(help_)}")
+        lines.append(f"# TYPE {name} {kind}")
+        for e in sorted(entries,
+                        key=lambda m: sorted(m.get("labels", {}).items())):
+            labels = {str(k): str(v)
+                      for k, v in e.get("labels", {}).items()}
+            if kind in ("counter", "gauge"):
+                lines.append(
+                    f"{name}{_label_str(labels)} {_fmt(e['value'])}")
+                continue
+            cum = 0
+            for bound, cnt in zip(e["bounds"], e["counts"]):
+                cum += cnt
+                lb = dict(labels, le=_fmt(float(bound)))
+                lines.append(f"{name}_bucket{_label_str(lb)} {cum}")
+            lb = dict(labels, le="+Inf")
+            lines.append(f"{name}_bucket{_label_str(lb)} {e['count']}")
+            lines.append(f"{name}_sum{_label_str(labels)} "
+                         f"{_fmt(e['sum'])}")
+            lines.append(f"{name}_count{_label_str(labels)} "
+                         f"{e['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 #: the process-global registry every runtime component instruments into

@@ -47,6 +47,7 @@ import numpy as np
 
 from ..chaos import inject as _chaos
 from ..obs import metrics as obs_metrics
+from ..trace.spans import get_recorder as _trace_recorder
 from .kv_cache import BlockPool, PagedKVCache, SlotKVCache
 from .prefix import RadixPrefixCache
 from .queue import AdmissionQueue, ServeRequest
@@ -91,6 +92,14 @@ class _Active:
     #: and migration must fence the packet OUT, not relabel stale KV
     #: as current.
     params_version: Optional[int] = None
+    #: monotonic stamp of the first generated token (prefill-step end,
+    #: or install time for a migrated sequence) — the traced decode
+    #: span's start (docs/tracing.md)
+    t_first: Optional[float] = None
+    #: monotonic stamp when the sequence parked for migration — the
+    #: traced park span's start (serve/kv_migrate.py records its end
+    #: at pack time)
+    parked_at: Optional[float] = None
 
 
 class ContinuousBatcher:
@@ -329,7 +338,11 @@ class ContinuousBatcher:
                 got = self._weights.poll()
                 if got is not None:
                     version, tree = got
+                    t_sw = time.time()
                     self.executor.swap_params(tree, version=version)
+                    _trace_recorder().record_process(
+                        "weight_fence", t_sw, time.time(),
+                        version=version)
             except Exception as e:  # noqa: BLE001 — serve on stale
                 import logging
                 logging.getLogger("horovod_tpu").warning(
@@ -734,10 +747,12 @@ class ContinuousBatcher:
             submitted_at=now, handle=ent["handle"],
             temperature=float(meta.get("temperature", 0.0)),
             top_p=float(meta.get("top_p", 1.0)),
-            seed=int(meta.get("seed", 0)))
+            seed=int(meta.get("seed", 0)),
+            trace=meta.get("trace"))
         seq = _Active(req=req, slot=row, out=out,
                       cache_len=cache_len,
-                      rng_ctr=int(meta.get("rng_ctr", 1)))
+                      rng_ctr=int(meta.get("rng_ctr", 1)),
+                      t_first=now)
         self.kv.lengths[row] = cache_len
         self._active[row] = seq
         self.migrations_in += 1
@@ -891,6 +906,13 @@ class ContinuousBatcher:
                     req.handle._resolve(
                         [], "error", latency_ms=ms, error="kv_corrupt")
                 continue
+            if req.trace is not None and seq.t_first is not None \
+                    and not (req.hold_kv and self.paged):
+                base = time.time() - time.monotonic()
+                _trace_recorder().record(
+                    req.trace, "decode",
+                    seq.t_first + base, now + base,
+                    rid=req.rid, tokens=len(seq.out))
             if expired and not done_ok:
                 self.queue.expired_count += 1
                 req.handle._resolve(seq.out, "expired", latency_ms=ms)
@@ -901,6 +923,7 @@ class ContinuousBatcher:
                 # Parked BEFORE the handle resolves: the endpoint's
                 # migrate op keys off the resolution and must find the
                 # entry already there.
+                seq.parked_at = now
                 with self._parked_lock:
                     self.parked[req.rid] = seq
                 del self._active[slot]
@@ -1033,6 +1056,7 @@ class ContinuousBatcher:
     # -- prefill -------------------------------------------------------------
     def _prefill(self, admitted: List[_Active]) -> None:
         B = self.executor.max_batch
+        t_p0 = time.monotonic()   # queue_wait ends / prefill begins
         hit_rows: List[_Active] = []
         if self.paged:
             # materialize each admission plan: shared full blocks join
@@ -1097,9 +1121,20 @@ class ContinuousBatcher:
                 self._reprefill.append(a.req)
             admitted = [a for a in admitted if a not in hit_rows]
         t_first = time.monotonic()
+        # spans are wall-clock (cross-process merge); map the
+        # scheduler's monotonic stamps through one base per batch
+        base = time.time() - time.monotonic()
+        rec = _trace_recorder()
         for a in admitted:
             self._m_ttft.observe(
                 (t_first - a.req.submitted_at) * 1000.0)
+            a.t_first = t_first
+            if a.req.trace is not None:
+                rec.record(a.req.trace, "queue_wait",
+                           a.req.submitted_at + base, t_p0 + base)
+                rec.record(a.req.trace, "prefill",
+                           t_p0 + base, t_first + base,
+                           rid=a.req.rid)
             n = len(a.req.prompt)
             a.cache_len = n
             a.params_version = self.executor.last_step_version

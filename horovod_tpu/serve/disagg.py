@@ -72,6 +72,7 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..obs import metrics as obs_metrics
+from ..trace import collect as _tr_collect
 from . import wire
 from .fleet import FLEET_REJECTED_HELP, FleetHandle
 from .proc_fleet import (DEFAULT_SPAWN_TIMEOUT_S, ProcessFleetRouter,
@@ -107,7 +108,7 @@ class _DisaggTracked:
 
     __slots__ = ("fid", "prompt", "max_new_tokens", "deadline",
                  "submitted_at", "handle", "temperature", "top_p",
-                 "seed", "phase", "ttft_observed")
+                 "seed", "phase", "ttft_observed", "trace")
 
     def __init__(self, fid, prompt, max_new_tokens, deadline,
                  submitted_at, handle, temperature, top_p, seed):
@@ -127,6 +128,10 @@ class _DisaggTracked:
         #: successful prefill — a re-prefill after a failed migration
         #: must not contribute a second, migration-wait-inflated sample
         self.ttft_observed = False
+        #: wire-form trace context (None = untraced); rides every
+        #: phase RPC so the prefill, migration and decode spans join
+        #: one tree (docs/tracing.md)
+        self.trace: Optional[dict] = None
 
 
 class DisaggRouter:
@@ -186,7 +191,8 @@ class DisaggRouter:
                     "hvd_serve_pool_queue_free",
                     "hvd_serve_pool_kv_blocks_free",
                     "hvd_serve_pool_replicas_up",
-                    "hvd_serve_pool_migration_backlog"):
+                    "hvd_serve_pool_migration_backlog",
+                    "hvd_trace_leg_ms", "hvd_trace_retained_total"):
             R.unregister(fam)
         common = dict(kv_addr=kv_addr, kv_port=kv_port,
                       channel=channel, interval_s=interval_s,
@@ -209,6 +215,13 @@ class DisaggRouter:
             decode_replicas, worker=decode_worker,
             ns=f"{ns}.d", pool="decode",
             rid_base=prefill_replicas, **common)
+        #: distributed-tracing assembler, shared with BOTH pool
+        #: routers (their health sweeps feed its clock samples, their
+        #: eject paths its flight recorder) — the e2e owner is this
+        #: router, so the merge lives here (trace/collect.py)
+        self.tracer = _tr_collect.assembler_from_env("disagg")
+        self.prefill.tracer = self.tracer
+        self.decode.tracer = self.tracer
         self._lock = threading.Lock()
         self._inflight: Dict[int, _DisaggTracked] = {}
         self._reserved = 0
@@ -345,6 +358,7 @@ class DisaggRouter:
         t0 = time.monotonic()
         if self.draining:
             self._m_rejected.inc()
+            self._trace_shed("draining")
             raise Rejected("fleet draining",
                            retry_after_ms=self.drain_retry_after_ms)
         if not any(r.state == "up"
@@ -352,6 +366,7 @@ class DisaggRouter:
             # ADMITTING capacity is zero: nothing can compute prompt
             # KV — shed loudly (decode-pool health is irrelevant here)
             self._m_rejected.inc()
+            self._trace_shed("zero_prefill_capacity")
             raise Rejected(
                 "no live prefill replica (admitting capacity is zero)",
                 retry_after_ms=SHED_BASE_MS * self._capacity_scale())
@@ -366,6 +381,7 @@ class DisaggRouter:
                 self._reserved += 1
         if over:
             self._m_rejected.inc()
+            self._trace_shed("max_inflight")
             raise Rejected(
                 f"fleet at max in-flight ({self.max_inflight})",
                 retry_after_ms=SHED_BASE_MS * self._capacity_scale())
@@ -378,6 +394,8 @@ class DisaggRouter:
                             int(max_new_tokens),
                             t0 + float(deadline_ms) / 1000.0, t0,
                             handle, temperature, top_p, seed)
+        if self.tracer is not None:
+            tr.trace = self.tracer.start(rid=fid).to_wire()
         with self._lock:
             self._inflight[tr.fid] = tr
         threading.Thread(
@@ -389,6 +407,15 @@ class DisaggRouter:
         with self._lock:
             if self._reserved > 0:
                 self._reserved -= 1
+
+    def _trace_shed(self, reason: str) -> None:
+        """Synchronous front-door sheds never mint a FleetHandle, but
+        the tail sampler must still see them: mint, flag, finish."""
+        if self.tracer is None:
+            return
+        ctx = self.tracer.start(rid=None)
+        self.tracer.mark(ctx, f"shed:{reason}")
+        self.tracer.finish(ctx, "shed", e2e_ms=0.0)
 
     def migration_backlog(self) -> int:
         with self._lock:
@@ -453,6 +480,11 @@ class DisaggRouter:
             if tr.handle._resolve("rejected",
                                   retry_after_ms=err.retry_after_ms):
                 self._m_rejected.inc()
+        if self.tracer is not None and tr.trace is not None \
+                and tr.handle.done():
+            self.tracer.finish(tr.trace, tr.handle.status,
+                               e2e_ms=tr.handle.latency_ms,
+                               attempts=tr.handle.attempts)
 
     def _expired(self, tr: _DisaggTracked) -> bool:
         if (tr.deadline - time.monotonic()) > 0:
@@ -484,6 +516,13 @@ class DisaggRouter:
                 return val2
             if st2 == "reprefill":
                 self._m_reprefills.inc()
+                if self.tracer is not None and tr.trace is not None:
+                    self.tracer.mark(tr.trace, "failover")
+                    now_w = time.time()
+                    self.tracer.span(
+                        tr.trace, "re_prefill",
+                        now_w - (time.monotonic() - t_mig), now_w,
+                        reason=str(val2))
                 if tr.handle.attempts >= self.max_attempts:
                     return Rejected(
                         f"migration failed ({val2}) and re-prefill "
@@ -504,6 +543,13 @@ class DisaggRouter:
                 return None
             # decode death / lost fid: re-enqueue to prefill
             self._m_reprefills.inc()
+            if self.tracer is not None and tr.trace is not None:
+                self.tracer.mark(tr.trace, "failover")
+                now_w = time.time()
+                self.tracer.span(
+                    tr.trace, "re_prefill",
+                    now_w - (time.monotonic() - t_mig), now_w,
+                    reason=str(val3))
             if tr.handle.attempts >= self.max_attempts:
                 return Rejected(
                     f"decode failed ({val3}) and re-prefill attempts "
@@ -548,6 +594,11 @@ class DisaggRouter:
                 return ("shed", Rejected(
                     payload.get("error", f"bad ack {ack!r}"),
                     retry_after_ms=None))
+            # prefill-side spans (queue_wait/prefill) piggyback on the
+            # reply frame — merge them into the request's trace tree
+            if self.tracer is not None and tr.trace is not None \
+                    and payload.get("spans"):
+                self.tracer.add_spans(tr.trace, payload["spans"])
             status = payload.get("status")
             toks = list(payload.get("tokens") or ())
             if status != "ok":
@@ -586,6 +637,8 @@ class DisaggRouter:
                "max_new_tokens": 1, "deadline_ms": remaining_ms,
                "temperature": tr.temperature, "top_p": tr.top_p,
                "seed": tr.seed, "hold_kv": True}
+        if tr.trace is not None:
+            msg["trace"] = tr.trace
         return self.prefill._ladder.run(
             lambda: wire.two_frame_request(
                 rep.addr, msg,
@@ -683,6 +736,11 @@ class DisaggRouter:
                 return ("reprefill", f"prefill {prep.id} unreachable "
                                      f"mid-migration: {e}")
             if ack.get("ack") == "migrated":
+                # park/migrate_push spans ride the migrate ack (they
+                # post-date the prefill reply's drain)
+                if self.tracer is not None and tr.trace is not None \
+                        and ack.get("spans"):
+                    self.tracer.add_spans(tr.trace, ack["spans"])
                 self._count_migration("ok")
                 self._m_migrate_ms.observe(
                     float(ack.get("ms")
@@ -739,6 +797,8 @@ class DisaggRouter:
         remaining_ms = (tr.deadline - time.monotonic()) * 1000.0
         msg = {"op": "result", "fid": dfid,
                "deadline_ms": remaining_ms}
+        if tr.trace is not None:
+            msg["trace"] = tr.trace
         try:
             kind, payload = self.decode._ladder.run(
                 lambda: wire.two_frame_request(
@@ -753,6 +813,10 @@ class DisaggRouter:
         if kind == "ctrl":
             return ("lost", f"decode {drep.id}: "
                             f"{payload.get('ack', 'bad ack')}")
+        # decode-side spans (migrate_install/decode) ride the result
+        if self.tracer is not None and tr.trace is not None \
+                and payload.get("spans"):
+            self.tracer.add_spans(tr.trace, payload["spans"])
         tr.handle._resolve(
             payload.get("status", "error"),
             tokens=payload.get("tokens") or (),
@@ -782,6 +846,13 @@ class DisaggRouter:
                                       + d["duplicates_suppressed"]),
             "replicas": {**p["replicas"], **d["replicas"]},
         }
+
+    def metrics_snapshots(self, timeout: float = 2.0) -> List[dict]:
+        """Both pools' worker metrics snapshots, for the front door's
+        ``/metrics?fleet=1`` merge (worker labels already carry
+        ``pool=...`` so the merged series stay distinguishable)."""
+        return (self.prefill.metrics_snapshots(timeout=timeout)
+                + self.decode.metrics_snapshots(timeout=timeout))
 
     def healthz(self) -> dict:
         """The front door's aggregate payload with the per-pool

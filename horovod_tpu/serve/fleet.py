@@ -153,7 +153,7 @@ class _Tracked:
 
     __slots__ = ("fid", "prompt", "max_new_tokens", "deadline",
                  "submitted_at", "handle", "rid", "inner",
-                 "temperature", "top_p", "seed")
+                 "temperature", "top_p", "seed", "trace")
 
     def __init__(self, fid, prompt, max_new_tokens, deadline,
                  submitted_at, handle, temperature=0.0, top_p=1.0,
@@ -169,6 +169,9 @@ class _Tracked:
         self.temperature = float(temperature)
         self.top_p = float(top_p)
         self.seed = int(seed)
+        #: wire-form trace context (None = untraced) — survives
+        #: failover so the re-dispatch joins the same trace tree
+        self.trace: Optional[dict] = None
 
 
 class Replica:

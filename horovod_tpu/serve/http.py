@@ -77,6 +77,22 @@ class _JsonHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_fleet_metrics(self, router):
+        """``/metrics?fleet=1``: the router process's own registry
+        snapshot merged with every live worker's (scraped over the
+        ctrl socket, ``{"op": "metrics"}``) — one exposition for the
+        whole fleet, HELP text borrowed from the local registry."""
+        R = obs_metrics.get_registry()
+        snaps = [R.snapshot()]
+        snaps.extend(router.metrics_snapshots())
+        body = obs_metrics.snapshot_to_prometheus(
+            obs_metrics.merge_snapshots(snaps), help_from=R).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _reply_rejected(self, reason, retry_after_ms):
         """The structured 429: payload always carries the ms hint, the
         header its true-ceiling whole-second rendering."""
@@ -226,12 +242,22 @@ def make_fleet_server(router, host: str = "127.0.0.1",
       so a load balancer can front the whole fleet on one probe.
     * ``GET /metrics`` — the process-global Prometheus registry
       (router legs, failovers, respawns, net retries).
+      ``GET /metrics?fleet=1`` additionally scrapes every live worker
+      process's snapshot over the ctrl socket and serves the MERGED
+      exposition (obs ``merge_snapshots``) — batcher/executor series
+      from inside the workers next to the router's own, one scrape
+      for the whole fleet (docs/metrics.md).
     """
 
     class Handler(_JsonHandler):
         def do_GET(self):
-            if self.path.split("?", 1)[0] == "/metrics":
-                self._reply_metrics()
+            path, _, query = self.path.partition("?")
+            if path == "/metrics":
+                if ("fleet=1" in query.split("&")
+                        and hasattr(router, "metrics_snapshots")):
+                    self._reply_fleet_metrics(router)
+                else:
+                    self._reply_metrics()
                 return
             if self.path != "/healthz":
                 self._reply(404, {"error": "not found"})

@@ -47,6 +47,7 @@ from typing import List, Optional, Tuple
 
 from ..chaos import inject as _chaos
 from ..native import resilience
+from ..trace.spans import get_recorder as _trace_recorder
 from . import wire
 
 #: how long the pushing side waits for the decode endpoint's install
@@ -145,6 +146,16 @@ def pack_parked(batcher, rid: int, *, fid: str,
             "blocks": metas,
             "payload_crc": zlib.crc32(payload),
         }
+        if req.trace is not None:
+            # the trace context rides the migration header so the
+            # decode side's spans join the same tree; the park span
+            # covers parked-in-_retire -> packed-here
+            header["trace"] = req.trace
+            if seq.parked_at is not None:
+                base = time.time() - time.monotonic()
+                _trace_recorder().record(
+                    req.trace, "park",
+                    seq.parked_at + base, time.time(), rid=int(rid))
         return header, payload
     finally:
         batcher.unpin_parked(rid)

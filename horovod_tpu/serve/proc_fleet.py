@@ -59,6 +59,7 @@ from ..chaos import inject as _chaos
 from ..chaos.detector import AccrualTracker
 from ..native import resilience
 from ..obs import metrics as obs_metrics
+from ..trace import collect as _tr_collect
 from . import wire
 from .fleet import (FAILOVER_MS_HELP, FAILOVERS_HELP,
                     FLEET_REJECTED_HELP, FleetHandle, REPLICA_UP_HELP,
@@ -302,6 +303,14 @@ class ProcessFleetRouter:
         self._m_pool_up = R.gauge(
             "hvd_serve_pool_replicas_up", POOL_REPLICAS_UP_HELP,
             pool_label)
+        #: distributed-tracing assembler (trace/collect.py): armed by
+        #: HOROVOD_TRACE when this router IS the front door (pool is
+        #: None); a POOL router instead has the owning DisaggRouter's
+        #: shared assembler assigned after construction, so clock
+        #: samples and fleet events from both pools feed ONE merge
+        self.tracer = (_tr_collect.assembler_from_env(self.ns)
+                       if pool is None else None)
+        self._incident_seq = itertools.count()
 
     # -- events --------------------------------------------------------------
     def add_listener(self, fn: Callable[[dict], None]) -> None:
@@ -310,6 +319,9 @@ class ProcessFleetRouter:
 
     def _emit(self, event: str, rid: int, **kw) -> None:
         ev = dict(kw, event=event, replica=rid, t=time.time())
+        if self.tracer is not None:
+            # fleet lifecycle events join the flight recorder's ring
+            self.tracer.note_event(ev)
         with self._lock:
             listeners = list(self._listeners)
         for fn in listeners:
@@ -331,6 +343,10 @@ class ProcessFleetRouter:
                 self.events_dir, f"replica.{rep.id}.events.jsonl")
         cfg.update({
             "rid": rep.id, "gen": gen, "ns": self.ns,
+            # the worker stamps its span recorder with this — it MUST
+            # match the clock_key the router notes heartbeats under,
+            # or spans never clock-align
+            "pool": self.pool or self.ns,
             "kv_addr": self.kv_addr, "kv_port": self.kv_port,
             "channel": self.channel,
             "hb_interval_s": self.interval_s / 2.0,
@@ -522,12 +538,14 @@ class ProcessFleetRouter:
         t0 = time.monotonic()
         if self.draining:
             self._m_rejected.inc()
+            self._trace_shed("draining")
             raise Rejected("fleet draining",
                            retry_after_ms=self.drain_retry_after_ms)
         if not any(r.state == "up" for r in self.replicas.values()):
             # capacity is ZERO: shed loudly, hint scaled to the whole
             # fleet being gone (never a silent drop, never a hang)
             self._m_rejected.inc()
+            self._trace_shed("zero_capacity")
             raise Rejected(
                 "no live replica (fleet at zero capacity)",
                 retry_after_ms=SHED_BASE_MS * self._capacity_scale())
@@ -550,6 +568,7 @@ class ProcessFleetRouter:
                 self._reserved += 1
         if over:
             self._m_rejected.inc()
+            self._trace_shed("max_inflight")
             raise Rejected(
                 f"fleet at max in-flight ({self.max_inflight})",
                 retry_after_ms=SHED_BASE_MS * self._capacity_scale())
@@ -563,6 +582,8 @@ class ProcessFleetRouter:
                       t0 + deadline_ms / 1000.0, t0, handle,
                       temperature=temperature, top_p=top_p,
                       seed=int(seed))
+        if self.tracer is not None:
+            tr.trace = self.tracer.start(rid=fid).to_wire()
         threading.Thread(
             target=self._run_request, args=(tr,), daemon=True,
             name=f"hvd-procfleet-dispatch-{fid}").start()
@@ -572,6 +593,16 @@ class ProcessFleetRouter:
         with self._lock:
             if self._reserved > 0:
                 self._reserved -= 1
+
+    def _trace_shed(self, reason: str) -> None:
+        """A synchronous front-door shed still leaves a retained trace
+        (the tail sampler keeps every shed), so 'why was I rejected'
+        is answerable from the flight recorder."""
+        if self.tracer is None:
+            return
+        ctx = self.tracer.start(rid=None)
+        self.tracer.mark(ctx, f"shed:{reason}")
+        self.tracer.finish(ctx, "shed", e2e_ms=0.0)
 
     def _candidates(self, exclude: Optional[int] = None
                     ) -> List[ProcessReplica]:
@@ -587,6 +618,15 @@ class ProcessFleetRouter:
             if tr.handle._resolve("rejected",
                                   retry_after_ms=err.retry_after_ms):
                 self._m_rejected.inc()
+        # close the trace only at a real resolution: a dispatcher
+        # thread that returned because a FAILOVER now owns the request
+        # must leave the trace open for the requeue thread
+        if self.tracer is not None and tr.trace is not None \
+                and tr.handle.done():
+            self.tracer.finish(
+                tr.trace, tr.handle.status,
+                e2e_ms=tr.handle.latency_ms,
+                attempts=tr.handle.attempts)
 
     def _dispatch_blocking(self, tr: _Tracked,
                            exclude: Optional[int] = None
@@ -643,6 +683,12 @@ class ProcessFleetRouter:
                 if acked:
                     self._m_router["dispatch"].observe(
                         (acked[0] - t_d0) * 1000.0)
+                    if self.tracer is not None \
+                            and tr.trace is not None:
+                        base = time.time() - time.monotonic()
+                        self.tracer.span(
+                            tr.trace, "dispatch", t_d0 + base,
+                            acked[0] + base, replica=rep.id)
                 self._on_reply(tr, rep.id, payload)
                 return None
             # control ack: the worker's queue door spoke
@@ -691,6 +737,10 @@ class ProcessFleetRouter:
             "deadline_ms": remaining_ms,
             "temperature": tr.temperature, "top_p": tr.top_p,
             "seed": tr.seed}
+        if tr.trace is not None:
+            # one JSON field carries the whole context; untraced
+            # requests leave the frame byte-identical to before
+            submit_msg["trace"] = tr.trace
 
         def attempt() -> Tuple[str, dict]:
             if _chaos._INJ is not None:
@@ -735,6 +785,9 @@ class ProcessFleetRouter:
                 self.duplicates_suppressed += 1
                 return
             self._inflight.pop(tr.fid, None)
+        if self.tracer is not None and tr.trace is not None \
+                and reply.get("spans"):
+            self.tracer.add_spans(tr.trace, reply["spans"])
         accepted = tr.handle._resolve(
             reply.get("status", "error"),
             tokens=reply.get("tokens") or (),
@@ -776,11 +829,25 @@ class ProcessFleetRouter:
     def _read_hb(self, rep: ProcessReplica) -> Optional[int]:
         from ..native.store import NativeError
         try:
+            t_before = time.time()
             raw = self._hb_client(rep.id).get(self._hb_key(rep),
                                               timeout=0.1)
-            return int(raw.decode())
+            t_after = time.time()
+            seq_s, _, wall_s = raw.decode().partition(":")
+            seq = int(seq_s)
         except (NativeError, ValueError):
             return None
+        if wall_s and self.tracer is not None:
+            # a timestamped heartbeat (<seq>:<wall>) doubles as a free
+            # round-trip clock sample for span alignment; a bare
+            # integer (an older worker) simply contributes none
+            try:
+                self.tracer.note_heartbeat(
+                    self.pool or self.ns, rep.id, float(wall_s),
+                    t_before, t_after)
+            except ValueError:
+                pass
+        return seq
 
     def _read_hb_all(self, reps: List[ProcessReplica]
                      ) -> Dict[int, Optional[int]]:
@@ -889,6 +956,7 @@ class ProcessFleetRouter:
             victims = [tr for tr in self._inflight.values()
                        if tr.rid == rid and not tr.handle.done()]
         requeued = rejected = 0
+        t_f0 = time.time()
         for tr in victims:
             with self._lock:
                 if tr.handle.done() or tr.rid != rid:
@@ -896,12 +964,22 @@ class ProcessFleetRouter:
                 tr.rid = None   # detach: the waiter thread's ladder
                 self._inflight.pop(tr.fid, None)   # aborts, its late
                 # answer (if any) suppresses as a ghost
+            if self.tracer is not None and tr.trace is not None:
+                # failover-touched traces are always retained
+                self.tracer.mark(tr.trace, "failover")
+                self.tracer.span(tr.trace, "failover", t_f0,
+                                 time.time(), victim_replica=rid)
             if tr.handle.attempts >= self.max_attempts:
                 if tr.handle._resolve(
                         "rejected",
                         retry_after_ms=self.drain_retry_after_ms):
                     self._m_rejected.inc()
                     rejected += 1
+                if self.tracer is not None and tr.trace is not None:
+                    self.tracer.finish(
+                        tr.trace, tr.handle.status,
+                        e2e_ms=tr.handle.latency_ms,
+                        attempts=tr.handle.attempts)
                 continue
             requeued += 1
             self._m_requeued.inc()
@@ -924,6 +1002,25 @@ class ProcessFleetRouter:
         self._m_failover_ms.observe(failover_ms)
         self._emit("eject", rid, reason=reason, requeued=requeued,
                    rejected=rejected, failover_ms=round(failover_ms, 2))
+        if self.tracer is not None and self.events_dir:
+            # flight recorder: the victim's in-flight traces (with the
+            # failover/re-dispatch spans just attached) + the event
+            # ring + the retained tail, archived next to the fleet's
+            # event log
+            try:
+                os.makedirs(self.events_dir, exist_ok=True)
+                path = os.path.join(
+                    self.events_dir,
+                    f"incident.eject.r{rid}"
+                    f".{next(self._incident_seq)}.jsonl")
+                self.tracer.dump_incident(
+                    path, reason=f"eject replica {rid}: {reason}")
+            except OSError as e:
+                # resilience: exempt (local filesystem write, not a
+                # wire fault — a failed dump must never stall failover)
+                logger.warning(
+                    "fleet: incident dump for replica %d failed: %s",
+                    rid, e)
 
     def _respawn(self, rep: ProcessReplica) -> None:
         """Replace a dead replica with a fresh worker process, gated on
@@ -1235,6 +1332,33 @@ class ProcessFleetRouter:
                     "kv_blocks_evictable", 0)
             infos[rid] = info
         return infos
+
+    def metrics_snapshots(self, timeout: float = 2.0) -> List[dict]:
+        """Scrape every live replica's in-process metrics snapshot
+        (the worker's ``{"op": "metrics"}`` ctrl endpoint) — the
+        ``/metrics?fleet=1`` merge input (obs ``merge_snapshots``).
+        Unreachable replicas are skipped: a scrape must degrade the
+        merge, never wedge the front door."""
+        snaps: List[dict] = []
+        for rep in list(self.replicas.values()):
+            if rep.state != "up" or rep.addr is None:
+                continue
+            try:
+                sock = wire.connect(rep.addr, timeout=timeout)
+                try:
+                    wire.send_msg(sock, {"op": "metrics"})
+                    reply = wire.recv_msg(sock, timeout=timeout)
+                finally:
+                    sock.close()
+            except (wire.DispatchConnError, wire.DispatchError,
+                    OSError):
+                # resilience: exempt (observer scrape — a missing
+                # snapshot is a gap in one scrape, not a fault)
+                continue
+            snap = reply.get("snapshot")
+            if isinstance(snap, dict):
+                snaps.append(snap)
+        return snaps
 
     def healthz(self) -> dict:
         """The fleet front door's aggregate liveness payload

@@ -78,6 +78,12 @@ class ServeRequest:
     #: the blocks to a decode replica (serve/kv_migrate.py). Parked
     #: rows are released by release_parked() or reaped past deadline.
     hold_kv: bool = False
+    #: distributed-tracing context (horovod_tpu/trace): the wire-form
+    #: ``{"trace", "span", "parent"}`` dict the router minted at
+    #: admission, or None (untraced — the back-compat default). The
+    #: batcher records queue_wait/prefill/decode spans against it and
+    #: migration packets carry it forward (docs/tracing.md).
+    trace: Optional[dict] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (now if now is not None else time.monotonic()) > self.deadline
@@ -214,12 +220,16 @@ class AdmissionQueue:
                on_resolve: Optional[Callable[[ServeHandle],
                                              None]] = None,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int = 0, hold_kv: bool = False) -> ServeHandle:
+               seed: int = 0, hold_kv: bool = False,
+               trace: Optional[dict] = None) -> ServeHandle:
         """Admit a request or raise `Rejected` (load shed / unservable).
 
         ``temperature`` / ``top_p`` / ``seed`` ride the request into
         the executor's on-device sampler (temperature 0 = greedy, the
         default); validation is fail-fast here at the door.
+        ``trace`` is the wire-form tracing context (or None —
+        untraced); it rides the request so the batcher can record its
+        queue_wait/prefill/decode spans (docs/tracing.md).
 
         ``on_resolve`` is attached to the handle BEFORE it becomes
         poppable, so a completion can never race past the hook."""
@@ -270,7 +280,8 @@ class AdmissionQueue:
                                deadline=now + dl / 1000.0,
                                submitted_at=now,
                                temperature=temperature, top_p=top_p,
-                               seed=seed, hold_kv=bool(hold_kv))
+                               seed=seed, hold_kv=bool(hold_kv),
+                               trace=trace)
             req.handle = ServeHandle(rid, on_resolve=on_resolve)
             self._dq.append(req)
             self._m_admitted.inc()

@@ -292,7 +292,9 @@ def evaluate_disagg(records: List[dict], events: List[dict], plan,
                     newest_version: Optional[int],
                     migrations_in: int, migrate_absorbed: int,
                     migrate_corrupt_detected: int,
-                    reprefills: int) -> dict:
+                    reprefills: int,
+                    traces: Optional[List[dict]] = None,
+                    trace_slow_ms: float = 2000.0) -> dict:
     """The DISAGGREGATED-fleet verdict: everything
     :func:`evaluate_serve` asserts (no silent drops, answered-once,
     shed-carries-retry-after, bounded failover for the SIGKILLed
@@ -317,6 +319,14 @@ def evaluate_disagg(records: List[dict], events: List[dict], plan,
       escalate into an ejection.
     * **respawned_on_newest** — the killed prefill worker re-admitted
       on the newest published weight version.
+    * **traces_complete** (only when ``traces`` — the tracer's
+      retained set — is passed, back-compat None skips it) — every
+      interesting request the CLIENTS saw (errored / expired /
+      async-shed / slower than ``trace_slow_ms``) has a retained
+      trace under its fid; every synchronous front-door shed has a
+      rid-less ``shed`` trace; and ≥99% of retained traces' leg
+      decomposition tiles the router-measured e2e within 5% (the
+      tiling error is the clock-alignment error — docs/tracing.md).
     """
     v = evaluate_serve(
         records, events, plan, fleet_stats, replicas=replicas,
@@ -349,10 +359,52 @@ def evaluate_disagg(records: List[dict], events: List[dict], plan,
         v["respawned_on_newest"] = (
             readmit is not None and newest_version is not None
             and readmit.get("weights_version") == newest_version)
+    if traces is not None:
+        by_rid: Dict[object, List[dict]] = {}
+        for t in traces:
+            if t.get("rid") is not None:
+                by_rid.setdefault(t["rid"], []).append(t)
+        interesting = missing = 0
+        for r in records:
+            if r.get("fid") is None:
+                continue
+            slow = (r.get("latency_ms") is not None
+                    and float(r["latency_ms"]) >= float(trace_slow_ms))
+            if r.get("status") in ("error", "expired", "rejected") \
+                    or slow:
+                interesting += 1
+                if r["fid"] not in by_rid:
+                    missing += 1
+        sync_sheds = sum(1 for r in records
+                         if r.get("fid") is None
+                         and r.get("status") == "shed")
+        shed_traces = sum(1 for t in traces
+                          if t.get("rid") is None
+                          and t.get("status") == "shed")
+        checked = bad = 0
+        for t in traces:
+            e2e, legs = t.get("e2e_ms"), t.get("legs_ms") or {}
+            if e2e is None or not legs or float(e2e) <= 0.0:
+                continue
+            checked += 1
+            if abs(sum(legs.values()) - float(e2e)) \
+                    > 0.05 * float(e2e):
+                bad += 1
+        v["traces_retained"] = len(traces)
+        v["traces_interesting"] = interesting
+        v["traces_missing"] = missing
+        v["trace_sync_sheds"] = sync_sheds
+        v["trace_shed_traces"] = shed_traces
+        v["trace_legs_checked"] = checked
+        v["trace_leg_mismatches"] = bad
+        v["traces_complete"] = (
+            missing == 0
+            and (sync_sheds == 0 or shed_traces >= sync_sheds)
+            and (checked == 0 or (checked - bad) / checked >= 0.99))
     v["ok"] = all(v.get(k) is not False for k in (
         "ok", "migrations_ok", "migrate_corrupt_caught",
         "migrate_blips_recovered", "failovers_only_kills",
-        "respawned_on_newest"))
+        "respawned_on_newest", "traces_complete"))
     return v
 
 
@@ -374,7 +426,8 @@ def run_disagg_soak(out_dir: Optional[str] = None, *,
                     spec_k: int = 0,
                     kv_crc: Optional[bool] = None,
                     prefix_cache: Optional[bool] = None,
-                    spawn_timeout_s: float = 120.0) -> dict:
+                    spawn_timeout_s: float = 120.0,
+                    trace: bool = True) -> dict:
     """The DISAGGREGATED serve soak (acceptance for the disagg
     tentpole): ``prefill`` + ``decode`` worker processes behind a
     :class:`~horovod_tpu.serve.disagg.DisaggRouter`, a seeded
@@ -382,8 +435,11 @@ def run_disagg_soak(out_dir: Optional[str] = None, *,
     ``serve.migrate`` ``conn_reset`` severing a migration after its
     frame landed, a ``corrupt`` flipping a payload bit the block crc
     must catch), closed-loop traffic, and a v2 weight publish
-    mid-incident. Returns the :func:`evaluate_disagg` verdict; never
-    raises on a failed invariant."""
+    mid-incident. ``trace=True`` (the default) arms the distributed-
+    tracing plane for the run — the verdict gains ``traces_complete``
+    and the out dir ``traces.jsonl`` + ``trace.json`` (merged Chrome
+    trace, docs/tracing.md). Returns the :func:`evaluate_disagg`
+    verdict; never raises on a failed invariant."""
     import tempfile
 
     from ..chaos import inject
@@ -460,21 +516,43 @@ def run_disagg_soak(out_dir: Optional[str] = None, *,
             "spec_k": spec_k,
             "prefix_cache": True if prefix_cache is None
             else prefix_cache}
-        router = DisaggRouter(
-            prefill, decode, kv_addr="127.0.0.1", kv_port=srv.port,
-            prefill_worker=dict(worker, spec_k=0),
-            decode_worker=worker,
-            channel=channel, ns=f"dsoak{seed}", interval_s=interval_s,
-            suspect_s=suspect_s, chaos_plan=resolved,
-            events_dir=events_dir,
-            log_dir=os.path.join(work_dir, "logs"),
-            spawn_timeout_s=spawn_timeout_s)
+        # arm tracing for the router's assembler_from_env read, then
+        # restore — the soak must not leak the knob into the caller
+        # knob: exempt (harness save/restore around router construction)
+        prev_trace = os.environ.get("HOROVOD_TRACE")
+        if trace:
+            # knob: exempt (harness arms the knob for the construction)
+            os.environ["HOROVOD_TRACE"] = "1"
+        try:
+            router = DisaggRouter(
+                prefill, decode, kv_addr="127.0.0.1",
+                kv_port=srv.port,
+                prefill_worker=dict(worker, spec_k=0),
+                decode_worker=worker,
+                channel=channel, ns=f"dsoak{seed}",
+                interval_s=interval_s,
+                suspect_s=suspect_s, chaos_plan=resolved,
+                events_dir=events_dir,
+                log_dir=os.path.join(work_dir, "logs"),
+                spawn_timeout_s=spawn_timeout_s)
+        finally:
+            if trace:
+                if prev_trace is None:
+                    os.environ.pop("HOROVOD_TRACE", None)
+                else:
+                    # knob: exempt (harness restores the caller's env)
+                    os.environ["HOROVOD_TRACE"] = prev_trace
         router.add_listener(lambda ev: log_event("fleet", ev))
 
         inj = inject.install(resolved, rank=0)
         inj.add_listener(lambda ev: log_event(
             "chaos", {"fault": ev["kind"],
                       **{k: x for k, x in ev.items() if k != "kind"}}))
+        if router.tracer is not None:
+            # feed chaos injections into the flight recorder's event
+            # ring (fleet events already arrive via the pool routers)
+            inj.add_listener(lambda ev: router.tracer.note_event(
+                {"kind": "chaos", **ev}))
 
         crash_scheduled = any(f.kind == "crash"
                               for f in resolved.faults)
@@ -643,6 +721,21 @@ def _disagg_soak_body(router, resolved, events, records, ev_lock,
     with ev_lock:
         all_events = sorted(events + worker_evs,
                             key=lambda e: e.get("t", 0.0))
+    traces = None
+    if router.tracer is not None:
+        # pull the retained set + merged artifacts BEFORE teardown
+        # tears the pools down (the assembler is in-memory state)
+        traces = router.tracer.retained()
+        try:
+            router.tracer.write_jsonl(
+                os.path.join(work_dir, "traces.jsonl"))
+            router.tracer.write_chrome(
+                os.path.join(work_dir, "trace.json"))
+        except OSError as e:
+            # resilience: exempt (local filesystem write of a soak
+            # artifact — not a wire fault; the verdict still runs)
+            logger.warning(
+                "disagg soak: trace artifact write failed: %s", e)
     teardown()
 
     verdict = evaluate_disagg(
@@ -654,10 +747,12 @@ def _disagg_soak_body(router, resolved, events, records, ev_lock,
         migrations_in=migrations_in,
         migrate_absorbed=migrate_absorbed,
         migrate_corrupt_detected=migrate_corrupt,
-        reprefills=fleet_stats.get("reprefills", 0))
+        reprefills=fleet_stats.get("reprefills", 0),
+        traces=traces)
     verdict.update({
         "seed": resolved.seed, "prefill": prefill, "decode": decode,
         "clients": clients, "processes": True, "disagg": True,
+        "traced": traces is not None,
         "spec_k": int(spec_k), "suspect_s": suspect_s,
         "wall_s": round(time.monotonic() - t_start, 2),
         "plan": json.loads(resolved.to_json()),

@@ -51,6 +51,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, Optional
 
+from ..trace.spans import configure_recorder as _trace_configure
+from ..trace.spans import get_recorder as _trace_recorder
 from . import wire
 from .queue import AdmitDropped, Rejected
 
@@ -170,6 +172,15 @@ class ReplicaEndpoint:
         if op == "healthz":
             wire.send_msg(sock, self.healthz())
             return
+        if op == "metrics":
+            # the fleet /metrics?fleet=1 scrape leg: the worker's whole
+            # registry snapshot rides one JSON reply (serve/http.py
+            # merges it with its siblings' via merge_snapshots)
+            from ..obs import metrics as obs_metrics
+            wire.send_msg(sock, {
+                "ack": "metrics",
+                "snapshot": obs_metrics.get_registry().snapshot()})
+            return
         if op == "kv_install":
             self._handle_kv_install(sock, msg, payload or b"")
             return
@@ -213,7 +224,8 @@ class ReplicaEndpoint:
                         temperature=float(msg.get("temperature", 0.0)),
                         top_p=float(msg.get("top_p", 1.0)),
                         seed=int(msg.get("seed", 0)),
-                        hold_kv=bool(msg.get("hold_kv", False)))
+                        hold_kv=bool(msg.get("hold_kv", False)),
+                        trace=msg.get("trace"))
                 except AdmitDropped as e:
                     wire.send_msg(sock, {
                         "ack": "admit_dropped",
@@ -233,7 +245,8 @@ class ReplicaEndpoint:
         wire.send_msg(sock, {"ack": "accepted"})
         deadline_ms = msg.get("deadline_ms") \
             or self.batcher.queue.default_deadline_ms
-        self._await_and_reply(sock, fid, handle, cached, deadline_ms)
+        self._await_and_reply(sock, fid, handle, cached, deadline_ms,
+                              trace=msg.get("trace"))
 
     def _record(self, handle) -> dict:
         """The cached (replay-servable) rendering of a resolved
@@ -256,11 +269,15 @@ class ReplicaEndpoint:
 
     def _await_and_reply(self, sock, fid: str, handle,
                          cached: Optional[dict],
-                         deadline_ms: float) -> None:
+                         deadline_ms: float,
+                         trace: Optional[dict] = None) -> None:
         """The shared result tail of ``submit`` and ``result``: wait
         out the handle (unless a cached record already answers the
         replay), cache BEFORE sending — if the send dies with the
-        reply, the replay finds the result here."""
+        reply, the replay finds the result here. When the request was
+        traced, the recorder's completed spans for it piggyback on the
+        reply as ``spans`` (drained at send time, NOT cached: a replay
+        re-reads the result, not the telemetry)."""
         if cached is None:
             handle.wait(timeout=float(deadline_ms) / 1000.0
                         + REPLY_GRACE_S)
@@ -279,7 +296,12 @@ class ReplicaEndpoint:
                 self._inflight.pop(fid, None)
                 while len(self._done) > self._dedupe_cap:
                     self._done.popitem(last=False)
-        wire.send_msg(sock, cached)
+        reply = cached
+        if isinstance(trace, dict) and trace.get("trace"):
+            spans = _trace_recorder().drain(str(trace["trace"]))
+            if spans:
+                reply = dict(cached, spans=spans)
+        wire.send_msg(sock, reply)
 
     # -- disaggregated serving ops (serve/disagg.py orchestration) ----------
     def _handle_disagg(self, sock, op: str, msg: dict) -> None:
@@ -308,7 +330,8 @@ class ReplicaEndpoint:
             deadline_ms = msg.get("deadline_ms") \
                 or self.batcher.queue.default_deadline_ms
             self._await_and_reply(sock, fid, handle, cached,
-                                  deadline_ms)
+                                  deadline_ms,
+                                  trace=msg.get("trace"))
             return
         rid = cached.get("rid") if cached is not None else \
             (handle.rid if handle is not None else None)
@@ -359,11 +382,21 @@ class ReplicaEndpoint:
             # the blocks live on the decode replica now — free the
             # parked row (scheduler-thread free, endpoint-safe)
             self.batcher.release_parked(int(rid))
-            wire.send_msg(sock, {
+            reply = {
                 "ack": "migrated", "bytes": len(payload),
                 "blocks": len(header["blocks"]),
                 "ms": round((time.monotonic() - t0) * 1000.0, 3),
-                "dedupe": bool(ack.get("dedupe", False))})
+                "dedupe": bool(ack.get("dedupe", False))}
+            tr = header.get("trace")
+            if isinstance(tr, dict) and tr.get("trace"):
+                base = time.time() - time.monotonic()
+                _trace_recorder().record(
+                    tr, "migrate_push", t0 + base, time.time(),
+                    fid=str(msg.get("dfid")), bytes=len(payload))
+                spans = _trace_recorder().drain(str(tr["trace"]))
+                if spans:
+                    reply["spans"] = spans
+            wire.send_msg(sock, reply)
             return
         wire.send_msg(sock, {
             "ack": "migrate_failed",
@@ -405,6 +438,7 @@ class ReplicaEndpoint:
             wire.send_msg(sock, {"ack": "installed", "dedupe": True})
             return
         if mine:
+            t_i0 = time.time()
             try:
                 blocks = kv_migrate.unpack_blocks(msg, payload)
             except kv_migrate.MigrateCorrupt as e:
@@ -416,6 +450,13 @@ class ReplicaEndpoint:
                 if pending["evt"].wait(
                         kv_migrate.INSTALL_ACK_TIMEOUT_S):
                     out = pending["outcome"]
+                    tr = msg.get("trace")
+                    if isinstance(tr, dict) and tr.get("trace"):
+                        # decode-side receive span; drained later with
+                        # the result op's reply
+                        _trace_recorder().record(
+                            tr, "migrate_install", t_i0, time.time(),
+                            fid=fid, outcome=str(out[0]))
                     self._finalize_install(
                         fid, ent, out,
                         pending["handle"] if out[0] == "installed"
@@ -522,6 +563,10 @@ class ReplicaWorker:
         self.rid = int(cfg["rid"])
         self.gen = int(cfg.get("gen", 0))
         self.ns = str(cfg.get("ns", "fleet"))
+        # stamp this process's span recorder with its fleet identity
+        # (pool/replica/generation name the Chrome-trace pid row)
+        _trace_configure(pool=str(cfg.get("pool") or self.ns),
+                         replica=self.rid, gen=self.gen)
         self.hb_interval_s = float(cfg.get("hb_interval_s", 0.125))
         self._events_f = None
         events_path = cfg.get("events_path")
@@ -634,10 +679,18 @@ class ReplicaWorker:
     def ep_key(self) -> str:
         return f"serve.ep.{self.ns}.g{self.gen}.{self.rid}"
 
+    def _hb_value(self) -> bytes:
+        """``<seq>:<wall>`` — the sequence the accrual sweep reads plus
+        this process's wall clock, the free round-trip clock sample the
+        router's trace assembler estimates per-worker offsets from
+        (trace/clock.py). Readers that predate the stamp parse the int
+        prefix and ignore the rest."""
+        return f"{self.seq}:{time.time():.6f}".encode()
+
     def _post_heartbeats(self) -> None:
         while not self._hb_stop.wait(self.hb_interval_s):
             try:
-                self._kv.set(self.hb_key(), str(self.seq).encode())
+                self._kv.set(self.hb_key(), self._hb_value())
             except Exception as e:  # noqa: BLE001 — a KV blip must not
                 logger.warning(     # kill the poster; stale age is the
                     "replica %d heartbeat post failed: %s",  # signal
@@ -680,7 +733,7 @@ class ReplicaWorker:
         self.endpoint.start()
         self.batcher.start()
         if self._kv is not None:
-            self._kv.set(self.hb_key(), str(self.seq).encode())
+            self._kv.set(self.hb_key(), self._hb_value())
             self._hb_thread = threading.Thread(
                 target=self._post_heartbeats, daemon=True,
                 name=f"hvd-replica-hb-{self.rid}")

@@ -1,0 +1,6 @@
+"""Seeded-bad fixture: records a span name the SPAN_LEGS table never
+declares. MUST be flagged by trace-registry (undeclared-span)."""
+
+
+def record_spans(rec, ctx, t0, t1):
+    rec.record(ctx, "rogue_span", t0, t1)

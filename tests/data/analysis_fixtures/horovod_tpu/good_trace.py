@@ -1,0 +1,10 @@
+"""Annotated twin: declared span names only, plus one deliberate
+exemption. MUST produce zero findings."""
+
+
+def record_spans(rec, asm, ctx, t0, t1):
+    rec.record(ctx, "good_span", t0, t1)
+    rec.record_process("ghost_span", t0, t1)
+    asm.span(ctx, "lost_span", t0, t1)
+    # trace: exempt (fixture: ad-hoc name, suppressed on purpose)
+    rec.record(ctx, "suppressed_span", t0, t1)

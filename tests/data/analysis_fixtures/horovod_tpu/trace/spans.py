@@ -1,0 +1,19 @@
+"""Fixture declaration table for the trace-registry pass.
+
+Seeded findings against the fixture docs/tracing.md:
+* ``ghost_span`` — declared, no docs row (undocumented-span);
+* ``lost_span`` — mapped to leg ``warp`` that LEGS never declares
+  (unknown-leg);
+* leg ``hidden`` — declared in LEGS, no docs row (undocumented-leg).
+"""
+from collections import OrderedDict
+
+SPAN_LEGS = OrderedDict([
+    ("good_span", "queue"),
+    ("ghost_span", None),
+    ("lost_span", "warp"),
+])
+
+SPAN_NAMES = tuple(SPAN_LEGS)
+
+LEGS = ("queue", "hidden")

@@ -550,6 +550,114 @@ class TestSignalSource:
             R.unregister("hvd_serve_pool_leg_ms")
 
 
+class TestHistogramWindowExtractionPin:
+    """The windowed-p99 engine moved to ``obs.metrics.HistogramWindow``
+    (shared with the tracing plane).  These tests pin the extraction:
+    the sampled p99 sequence — and therefore the recorded snapshot
+    trace and every plan ``replay()`` derives from it — must be
+    byte-identical to the inline implementation it replaced."""
+
+    # deterministic observation schedule: quiet poll, burst, regime
+    # shift, empty window, recovery — every carry/EWMA branch fires
+    _SCHEDULE = ([], [5.0] * 40, [5.0] * 30 + [400.0] * 10,
+                 [500.0] * 50, [], [7.0] * 25, [3.0] * 60, [])
+
+    @staticmethod
+    def _reference_p99(rounds, q=0.99, alpha=0.5):
+        """The pre-extraction signals.py logic, inlined verbatim:
+        bucket-delta percentile + EWMA, carry previous on a quiet or
+        not-yet-created window."""
+        from horovod_tpu.obs.metrics import (LATENCY_MS_BUCKETS,
+                                             Histogram,
+                                             percentile_from_buckets)
+        h = Histogram(LATENCY_MS_BUCKETS)
+        out, last_counts, ewma = [], None, None
+        for obs in rounds:
+            for v in obs:
+                h.observe(v)
+            counts = list(h.counts)
+            prev, last_counts = last_counts, counts
+            if prev is None:
+                out.append(ewma)
+                continue
+            delta = [max(c - p, 0) for c, p in zip(counts, prev)]
+            p = percentile_from_buckets(h.bounds, delta, q)
+            if p is None:
+                out.append(ewma)
+                continue
+            ewma = (float(p) if ewma is None
+                    else ewma + alpha * (float(p) - ewma))
+            out.append(ewma)
+        return out
+
+    def _sampled_p99(self):
+        """The same schedule through the real extracted path: a live
+        registry histogram sampled by SignalSource (which now delegates
+        to ``HistogramWindow``)."""
+        from horovod_tpu.obs.metrics import get_registry
+        from horovod_tpu.serve.disagg import POOL_LEG_HELP
+        R = get_registry()
+        R.unregister("hvd_serve_pool_leg_ms")
+        try:
+            h = R.histogram("hvd_serve_pool_leg_ms", POOL_LEG_HELP,
+                            {"pool": "prefill"})
+            r = _FakeDisagg()
+            clock = [0.0]
+            src = SignalSource(r, long_prompt_tokens=32,
+                               clock=lambda: clock[0])
+            snaps = []
+            for t, obs in enumerate(self._SCHEDULE):
+                for v in obs:
+                    h.observe(v)
+                clock[0] = float(t)
+                snaps.append(src.sample())
+            return snaps
+        finally:
+            R.unregister("hvd_serve_pool_leg_ms")
+
+    def test_p99_sequence_pins_to_inline_reference(self):
+        snaps = self._sampled_p99()
+        ref = self._reference_p99(self._SCHEDULE)
+        assert [s.p99_ttft_ms for s in snaps] == ref
+        # the interesting branches actually fired
+        assert ref[0] is None                        # baseline poll
+        assert ref[1] is not None                    # first window
+        assert ref[4] == ref[3]                      # quiet poll carries
+
+    def test_recorded_trace_replays_byte_identical(self):
+        snaps = self._sampled_p99()
+        trace_json = json.dumps([s.to_dict() for s in snaps],
+                                sort_keys=True)
+        rebuilt = [LoadSnapshot.from_dict(d)
+                   for d in json.loads(trace_json)]
+        assert json.dumps([s.to_dict() for s in rebuilt],
+                          sort_keys=True) == trace_json
+        plans = json.dumps([p.to_dict()
+                            for p in replay(CFG, rebuilt)],
+                           sort_keys=True)
+        assert plans == json.dumps([p.to_dict()
+                                    for p in replay(CFG, snaps)],
+                                   sort_keys=True)
+
+    def test_window_validates_and_carries(self):
+        from horovod_tpu.obs.metrics import (LATENCY_MS_BUCKETS,
+                                             Histogram, HistogramWindow)
+        with pytest.raises(ValueError):
+            HistogramWindow(q=1.5)
+        with pytest.raises(ValueError):
+            HistogramWindow(alpha=0.0)
+        w = HistogramWindow(q=0.5, alpha=1.0)
+        assert w.sample(None) is None                # not created yet
+        h = Histogram(LATENCY_MS_BUCKETS)
+        assert w.sample(h) is None                   # baseline only
+        for _ in range(10):
+            h.observe(8.0)
+        first = w.sample(h)
+        assert first is not None
+        assert w.sample(h) == first                  # quiet poll
+        assert w.value == first
+
+
 # ---------------------------------------------------------------------------
 # actuator (fake scalable router; chaos-driven hooks)
 # ---------------------------------------------------------------------------

@@ -24,7 +24,8 @@ import pytest
 
 from horovod_tpu import analysis
 from horovod_tpu.analysis import (collective, core, knobs, locks,
-                                  metrics_drift, resilience_lint, witness)
+                                  metrics_drift, resilience_lint,
+                                  trace_registry, witness)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "data", "analysis_fixtures")
@@ -92,6 +93,20 @@ class TestFixtures:
 
     def test_resilience_good_green(self):
         assert _codes(_run_pass(resilience_lint), "good_resilience") == []
+
+    def test_trace_bad_flagged(self):
+        f = _run_pass(trace_registry)
+        assert _codes(f, "bad_trace") == ["undeclared-span"]
+        reg = _codes(f, "trace/spans")
+        # declaration <-> docs drift, both directions, plus the
+        # unregistered leg label
+        for code in ("unknown-leg", "undocumented-span",
+                     "stale-doc-span", "undocumented-leg",
+                     "stale-doc-leg"):
+            assert code in reg, (code, reg)
+
+    def test_trace_good_green(self):
+        assert _codes(_run_pass(trace_registry), "good_trace") == []
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Inspect horovod_tpu distributed-trace artifacts (docs/tracing.md):
+
+    python tools/trace_inspect.py list   <file> [filters]
+    python tools/trace_inspect.py show   <file> [filters]
+    python tools/trace_inspect.py events <file> [--kind K]
+
+``<file>`` is either a retained-trace JSONL (the soak's
+``traces.jsonl``, one trace record per line) or a flight-recorder
+incident dump (``incident.*.jsonl`` — an incident header line, then
+``kind: event`` lines, then ``kind: trace`` lines); the format is
+sniffed per line, so both work everywhere.
+
+``list`` prints one row per trace (id, pool, status, e2e, attempts,
+leg breakdown, flags). ``show`` pretty-prints each selected trace's
+span tree — spans sorted by start time, parent/child indentation,
+per-span duration and recording replica. ``events`` prints an
+incident dump's recent-event ring (CHAOS/HEALTH/SCALE ...).
+
+Filters (list/show):
+    --trace ID      trace id, prefix match
+    --leg NAME      only traces whose leg breakdown has NAME > 0
+    --min-ms X      only traces with e2e_ms >= X
+    --fault         only fault-touched traces (non-ok status, flags,
+                    or >1 attempt — the tail sampler's own criteria)
+
+stdlib only — no jax, no horovod_tpu import; safe to point at a live
+soak's events directory from any host.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def read_records(path: str) -> Tuple[Optional[dict], List[dict],
+                                     List[dict]]:
+    """Parse a trace JSONL or incident dump ->
+    ``(incident_header, events, traces)``. Malformed lines are
+    skipped with a note on stderr (a half-written incident dump from
+    a dying process should still be inspectable)."""
+    header: Optional[dict] = None
+    events: List[dict] = []
+    traces: List[dict] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                print(f"note: {path}:{i}: unparseable line skipped",
+                      file=sys.stderr)
+                continue
+            if not isinstance(rec, dict):
+                continue
+            kind = rec.get("kind")
+            if kind == "incident":
+                header = rec
+            elif kind == "event":
+                events.append(rec)
+            elif kind == "trace" or "trace" in rec:
+                traces.append(rec)
+    return header, events, traces
+
+
+def fault_touched(rec: dict) -> bool:
+    """The tail sampler's own retention criteria, minus slowness:
+    anything that went wrong, retried or was flagged."""
+    return bool(rec.get("flags")) \
+        or rec.get("status") not in ("ok", None) \
+        or int(rec.get("attempts") or 0) > 1
+
+
+def select(traces: Iterable[dict], *, trace: Optional[str] = None,
+           leg: Optional[str] = None, min_ms: Optional[float] = None,
+           fault: bool = False) -> List[dict]:
+    out = []
+    for rec in traces:
+        if trace and not str(rec.get("trace", "")).startswith(trace):
+            continue
+        if leg is not None:
+            legs = rec.get("legs_ms") or {}
+            if not float(legs.get(leg) or 0.0) > 0.0:
+                continue
+        if min_ms is not None:
+            e2e = rec.get("e2e_ms")
+            if e2e is None or float(e2e) < float(min_ms):
+                continue
+        if fault and not fault_touched(rec):
+            continue
+        out.append(rec)
+    return out
+
+
+def _legs_str(rec: dict) -> str:
+    legs = rec.get("legs_ms") or {}
+    return " ".join(f"{k}={legs[k]:.1f}" for k in sorted(legs)
+                    if float(legs[k] or 0.0) > 0.0)
+
+
+def _e2e_str(rec: dict) -> str:
+    e2e = rec.get("e2e_ms")
+    return f"{float(e2e):9.1f}" if e2e is not None else "        -"
+
+
+def cmd_list(args) -> int:
+    header, events, traces = read_records(args.file)
+    if header is not None:
+        print(f"incident: {header.get('reason', '')!r} "
+              f"pool={header.get('pool')} "
+              f"({len(events)} events, {len(traces)} traces)")
+    picked = select(traces, trace=args.trace, leg=args.leg,
+                    min_ms=args.min_ms, fault=args.fault)
+    print(f"{'trace':<12} {'rid':>6} {'pool':<8} {'status':<9} "
+          f"{'e2e_ms':>9} {'att':>3}  legs / flags")
+    for rec in picked:
+        extra = _legs_str(rec)
+        flags = rec.get("flags") or ()
+        if flags:
+            extra = (extra + "  " if extra else "") \
+                + "[" + ",".join(map(str, flags)) + "]"
+        print(f"{str(rec.get('trace', ''))[:12]:<12} "
+              f"{str(rec.get('rid', '-')):>6} "
+              f"{str(rec.get('pool', '')):<8} "
+              f"{str(rec.get('status', '')):<9} "
+              f"{_e2e_str(rec)} "
+              f"{int(rec.get('attempts') or 0):>3}  {extra}")
+    print(f"{len(picked)}/{len(traces)} trace(s)")
+    return 0
+
+
+def _span_rows(spans: List[dict]) -> List[Tuple[int, dict]]:
+    """(depth, span) rows: children indented under their parent,
+    siblings ordered by start time. Orphans (parent span not in this
+    trace's recorded set) sit at depth 0 in time order."""
+    by_id: Dict[str, dict] = {s.get("span"): s for s in spans
+                              if s.get("span")}
+    kids: Dict[Optional[str], List[dict]] = {}
+    for s in spans:
+        parent = s.get("parent")
+        if parent is not None and parent not in by_id:
+            parent = None
+        kids.setdefault(parent, []).append(s)
+    rows: List[Tuple[int, dict]] = []
+
+    def walk(sid: Optional[str], depth: int) -> None:
+        for s in sorted(kids.get(sid, ()),
+                        key=lambda s: float(s.get("t0") or 0.0)):
+            rows.append((depth, s))
+            if s.get("span"):
+                walk(s["span"], depth + 1)
+
+    walk(None, 0)
+    return rows
+
+
+def cmd_show(args) -> int:
+    _, _, traces = read_records(args.file)
+    picked = select(traces, trace=args.trace, leg=args.leg,
+                    min_ms=args.min_ms, fault=args.fault)
+    for rec in picked:
+        flags = rec.get("flags") or ()
+        print(f"trace {rec.get('trace')}  rid={rec.get('rid')} "
+              f"pool={rec.get('pool')} status={rec.get('status')} "
+              f"e2e_ms={rec.get('e2e_ms')} "
+              f"attempts={rec.get('attempts')}"
+              + (f" flags={','.join(map(str, flags))}" if flags
+                 else ""))
+        legs = _legs_str(rec)
+        if legs:
+            print(f"  legs: {legs}")
+        spans = [s for s in rec.get("spans") or ()
+                 if isinstance(s, dict)]
+        t_base = min((float(s.get("t0") or 0.0) for s in spans),
+                     default=0.0)
+        for depth, s in _span_rows(spans):
+            t0 = float(s.get("t0") or 0.0)
+            dur = (float(s.get("t1") or t0) - t0) * 1000.0
+            where = ""
+            if s.get("replica") is not None:
+                where = (f"  @{s.get('pool') or 'pool'}"
+                         f"/r{s['replica']}")
+                if s.get("gen") is not None:
+                    where += f".g{s['gen']}"
+            extra = s.get("extra") or {}
+            ex = ("  " + " ".join(f"{k}={extra[k]}"
+                                  for k in sorted(extra))
+                  if extra else "")
+            print(f"  {'  ' * depth}{s.get('name', '?'):<18} "
+                  f"+{(t0 - t_base) * 1000.0:8.1f}ms "
+                  f"{dur:8.1f}ms{where}{ex}")
+        print()
+    print(f"{len(picked)}/{len(traces)} trace(s)")
+    return 0
+
+
+def cmd_events(args) -> int:
+    header, events, _ = read_records(args.file)
+    if header is not None:
+        print(f"incident: {header.get('reason', '')!r} "
+              f"pool={header.get('pool')} t={header.get('t')}")
+    n = 0
+    for ev in events:
+        kind = str(ev.get("event", ev.get("type", "?")))
+        if args.kind and args.kind not in kind:
+            continue
+        n += 1
+        rest = {k: v for k, v in ev.items()
+                if k not in ("kind", "event", "type")}
+        print(f"  {kind:<16} "
+              + " ".join(f"{k}={rest[k]}" for k in sorted(rest)))
+    print(f"{n}/{len(events)} event(s)")
+    return 0
+
+
+def _add_filters(p: argparse.ArgumentParser) -> None:
+    p.add_argument("file", help="trace JSONL or incident dump")
+    p.add_argument("--trace", help="trace id (prefix match)")
+    p.add_argument("--leg", help="require this leg > 0 in the "
+                                 "trace's breakdown")
+    p.add_argument("--min-ms", type=float, dest="min_ms",
+                   help="minimum e2e_ms")
+    p.add_argument("--fault", action="store_true",
+                   help="only fault-touched traces (non-ok / "
+                        "flagged / retried)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_inspect",
+        description=__doc__.split("\n\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list", help="one row per trace")
+    _add_filters(p)
+    p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("show", help="pretty-print span trees")
+    _add_filters(p)
+    p.set_defaults(fn=cmd_show)
+    p = sub.add_parser("events",
+                       help="an incident dump's event ring")
+    p.add_argument("file")
+    p.add_argument("--kind", help="substring filter on event kind")
+    p.set_defaults(fn=cmd_events)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        return 0
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
