@@ -935,6 +935,212 @@ def run_serve_benchmark() -> int:
         return 1
 
 
+def run_kvtier_benchmark() -> int:
+    """Fleet-KV-tier acceptance GATE (`bench.py --kv-tier`): prove the
+    eviction ladder EARNS its bytes — a returning conversation whose
+    prefix runs were demoted to the DISK rung (the slowest one: 0 MiB
+    host ring, every demotion spills to an hvdkv-v1 file) must still
+    beat recomputing the prefix from scratch. One tiny GPT decoder,
+    two identically-driven stacks:
+
+      tier       paged + prefix + kv_tier (host ring 0 -> disk spill)
+      re-prefill paged + prefix, NO tier (evicted runs just die)
+
+    Each trial: serve the first turn of a long conversation (the
+    prefix cache inserts its runs), evict EVERY refcount-zero run
+    (tier: demote to disk; baseline: die), then serve the returning
+    turn and time it. Gates (exit nonzero, JSON verdict lines):
+
+      * returning-turn latency: best-of-N tier <=
+        HVD_BENCH_KVTIER_TTFT_RATIO (default 0.95) x best-of-N
+        re-prefill — promotion must beat recompute even from disk;
+      * promotion actually happened (> 0 blocks on every tier trial —
+        a win that came from anything else is not this gate's win);
+      * bit-identical tokens: tier first-turn AND returning-turn
+        tokens equal the no-tier stack's exactly;
+      * crc ledger intact: zero corrupt promotions detected, and every
+        spill file left on disk re-verifies (per-leaf crc32);
+      * jit cache flat: demote/promote churn adds zero compiled
+        programs after the warm trial in both stacks.
+    """
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.gpt import GPT, GPTConfig
+        from horovod_tpu.serve import (AdmissionQueue, ContinuousBatcher,
+                                       ShardedExecutor, pool_blocks_for)
+        from horovod_tpu.serve.kvtier.tier import (TierEntry,
+                                                   read_spill_file)
+
+        platform = jax.devices()[0].platform
+        trials = int(os.environ.get("HVD_BENCH_KVTIER_TRIALS", "3"))
+        ratio_bar = float(os.environ.get(
+            "HVD_BENCH_KVTIER_TTFT_RATIO", "0.95"))
+        sys_len, tail_len, max_new = 160, 4, 4
+        max_len, block, max_batch = 192, 8, 4
+        buckets = (8, 176)
+        kw = dict(vocab_size=256, num_layers=2, num_heads=4,
+                  head_dim=16, max_seq_len=max_len,
+                  dtype=jnp.bfloat16 if platform == "tpu"
+                  else jnp.float32,
+                  attention_impl=None if platform == "tpu"
+                  else "reference")
+        params = GPT(GPTConfig(**kw)).init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
+        pool_blocks = pool_blocks_for(max_batch, max_len, block)
+        rng = np.random.RandomState(0)
+        first_turn = list(rng.randint(0, 256, sys_len + tail_len))
+
+        import tempfile
+        spill_root = tempfile.mkdtemp(prefix="hvd-kvtier-bench-")
+
+        def build(tier: bool):
+            mcfg = GPTConfig(decode=True, **kw, kv_block_size=block,
+                             kv_pool_blocks=pool_blocks)
+            ex = ShardedExecutor(GPT(mcfg), params,
+                                 max_batch=max_batch, max_len=max_len)
+            q = AdmissionQueue(max_queue=16,
+                               default_deadline_ms=60000.0)
+            b = ContinuousBatcher(
+                ex, q, buckets=buckets, prefix_cache=True,
+                kv_crc=True, kv_tier=tier, kvtier_host_mb=0,
+                kvtier_dir=(os.path.join(spill_root, "tier")
+                            if tier else None))
+            b.warmup()
+            return ex, q, b
+
+        def evict_all(b) -> int:
+            n = 0
+            while b.prefix.evictable_blocks() > 0:
+                got = b.prefix.evict(64)
+                if not got:
+                    break
+                n += got
+            return n
+
+        def drive(tier: bool):
+            ex, q, b = build(tier)
+            h = q.submit(first_turn, max_new_tokens=max_new)
+            b.run()
+            if h.status != "ok":
+                raise RuntimeError(
+                    f"first turn failed: {h.status} {h.error}")
+            first_tokens = list(h.tokens)
+            returning = first_turn + first_tokens + [7]
+            walls, promoted_each, ret_tokens = [], [], None
+            jit0 = None
+            # trial 0 warms the returning-turn bucket; jit flatness is
+            # asserted over the MEASURED trials
+            for t in range(trials + 1):
+                evict_all(b)
+                t0 = time.perf_counter()
+                h2 = q.submit(returning, max_new_tokens=max_new)
+                b.run()
+                dt = (time.perf_counter() - t0) * 1000.0
+                if h2.status != "ok":
+                    raise RuntimeError(
+                        f"returning turn failed: {h2.status} {h2.error}")
+                if ret_tokens is None:
+                    ret_tokens = list(h2.tokens)
+                elif list(h2.tokens) != ret_tokens:
+                    raise RuntimeError(
+                        "returning turn tokens changed across trials")
+                if t == 0:
+                    jit0 = ex.jit_cache_size()
+                    if tier and b.kvtier is not None:
+                        promoted0 = b.kvtier.promoted_blocks
+                    continue
+                walls.append(dt)
+                if tier and b.kvtier is not None:
+                    promoted_each.append(
+                        b.kvtier.promoted_blocks - promoted0)
+                    promoted0 = b.kvtier.promoted_blocks
+            out = {
+                "first_tokens": first_tokens,
+                "ret_tokens": ret_tokens,
+                "best_ms": min(walls),
+                "walls_ms": [round(w, 2) for w in walls],
+                "jit_flat": ex.jit_cache_size() == jit0,
+                "promoted_each": promoted_each,
+                "corrupt_detected": (b.kvtier.corrupt_detected
+                                     if tier and b.kvtier is not None
+                                     else 0),
+                "tier_stats": (b.kvtier.stats()
+                               if tier and b.kvtier is not None
+                               else None),
+            }
+            return out
+
+        tier = drive(True)
+        base = drive(False)
+
+        # every spill file still on disk must re-verify its ledger
+        spill_ok, spill_files = True, 0
+        tier_dir = os.path.join(spill_root, "tier")
+        if os.path.isdir(tier_dir):
+            for name in os.listdir(tier_dir):
+                if not name.endswith(".hvdkv"):
+                    continue
+                spill_files += 1
+                header, payload = read_spill_file(
+                    os.path.join(tier_dir, name))
+                leaf_bytes, off = [], 0
+                for n in header["nbytes"]:
+                    leaf_bytes.append(payload[off:off + int(n)])
+                    off += int(n)
+                ent = TierEntry(header["tokens"], leaf_bytes,
+                                header["crcs"], header["filled"],
+                                header.get("weights_version"))
+                if not ent.verify():
+                    spill_ok = False
+
+        ratio = tier["best_ms"] / base["best_ms"]
+        gates = {
+            "returning_beats_reprefill": ratio <= ratio_bar,
+            "promoted_every_trial": (len(tier["promoted_each"]) > 0
+                                     and all(p > 0 for p in
+                                             tier["promoted_each"])),
+            "bit_identical_first":
+                tier["first_tokens"] == base["first_tokens"],
+            "bit_identical_returning":
+                tier["ret_tokens"] == base["ret_tokens"],
+            "crc_ledger_intact":
+                tier["corrupt_detected"] == 0 and spill_ok,
+            "jit_cache_flat": tier["jit_flat"] and base["jit_flat"],
+        }
+        common = {"platform": platform, "trials": trials,
+                  "kv_block": block, "first_turn_len": len(first_turn),
+                  "max_new_tokens": max_new}
+        print(json.dumps({
+            "metric": "kvtier_returning_ttft_ms",
+            "value": round(tier["best_ms"], 2), "unit": "ms",
+            "reprefill_ms": round(base["best_ms"], 2),
+            "ratio": round(ratio, 3), "bar": ratio_bar,
+            "tier_walls_ms": tier["walls_ms"],
+            "reprefill_walls_ms": base["walls_ms"],
+            **common}), flush=True)
+        print(json.dumps({
+            "metric": "kvtier_promoted_blocks",
+            "value": tier["promoted_each"], "unit": "blocks/trial",
+            "spill_files_left": spill_files,
+            "tier": tier["tier_stats"], **common}), flush=True)
+        print(json.dumps({"metric": "kvtier_gate",
+                          "value": all(gates.values()),
+                          "gates": gates, **common}), flush=True)
+        import shutil
+        shutil.rmtree(spill_root, ignore_errors=True)
+        if not all(gates.values()):
+            return 1
+        return 0
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        print(json.dumps({"metric": "kvtier_gate", "value": None,
+                          "error": str(e)[-500:]}), flush=True)
+        return 1
+
+
 def run_kernel_parity() -> int:
     """`bench.py --kernel-parity`: assert the fused Pallas serving
     kernels emit TOKEN STREAMS identical to the XLA oracle across the
@@ -1521,6 +1727,9 @@ if __name__ == "__main__":
     elif "--kernel-parity" in sys.argv or \
             os.environ.get("HVD_BENCH_KERNEL_PARITY") == "1":
         sys.exit(run_kernel_parity())
+    elif "--kv-tier" in sys.argv or \
+            os.environ.get("HVD_BENCH_KVTIER") == "1":
+        sys.exit(run_kvtier_benchmark())
     elif "--serve" in sys.argv or \
             os.environ.get("HVD_BENCH_SERVE") == "1":
         sys.exit(run_serve_benchmark())

@@ -102,7 +102,22 @@ FAULT_SITES = ("step", "store.request", "p2p.send", "p2p.recv",
                # a graceful scale-down drain into a hard kill, so the
                # parked-row/eject machinery must still answer every
                # in-flight sequence exactly once.
-               "autoscale.scale")
+               "autoscale.scale",
+               # fleet KV tier (serve/kvtier/): kvtier.demote fires on
+               # the REPLICA's scheduler thread as a refcount-zero
+               # prefix run demotes down the ladder ("at"/"after"/
+               # "until" count that replica's demotion ops) — drop
+               # skips the demotion (the run dies; a follow-up
+               # re-prefills, the miss path), corrupt flips one bit in
+               # the demoted copy AFTER its crc ledger is stamped so
+               # only the promote-side crc gate can catch it.
+               # kvtier.promote fires as a ladder-held run is promoted
+               # back toward HBM (counting promotion ops) — drop loses
+               # the promotion (re-prefill fallback, never an error),
+               # corrupt flips a bit in the bytes about to be verified,
+               # which the crc gate must refuse BEFORE any device byte
+               # lands.
+               "kvtier.demote", "kvtier.promote")
 
 #: which kinds are meaningful at which sites (a drop needs a connection
 #: to sever; a torn write needs a shard file; a KV corruption needs a
@@ -118,17 +133,24 @@ _KIND_SITES = {
     # a returned crash, so validating it there would let fire() record
     # a "crash" that kills nothing — a soak could then prove recovery
     # from a death that never happened. (autoscale.scale qualifies: the
-    # actuator IS the guard — it SIGKILLs the newcomer it just spawned.)
+    # actuator IS the guard — it SIGKILLs the newcomer it just spawned.
+    # kvtier.* sites are tier moves, not processes — nothing to crash.)
     "crash": tuple(s for s in FAULT_SITES
-                   if not s.startswith("serve.")) + ("serve.step",
-                                                     "serve.proc"),
+                   if not s.startswith(("serve.", "kvtier."))) + (
+                       "serve.step", "serve.proc"),
     "drop": ("store.request", "p2p.send", "p2p.recv",
              "redist.transport", "serve.admit", "serve.migrate",
              # drop at a scale event = the graceful drain is dropped
              # (hard kill instead), exercising the eject/requeue path
-             "autoscale.scale"),
+             "autoscale.scale",
+             # drop at a tier move = the move is lost, the run
+             # re-prefills on next use — the miss path, never an error
+             "kvtier.demote", "kvtier.promote"),
     "corrupt": ("store.request", "p2p.send", "redist.transport",
-                "serve.kv", "serve.migrate"),
+                "serve.kv", "serve.migrate",
+                # corrupt at a tier move = one flipped bit the per-leaf
+                # crc gate must catch before any device byte lands
+                "kvtier.demote", "kvtier.promote"),
     "partition": ("store.request", "p2p.send", "p2p.recv",
                   "redist.transport", "serve.route"),
     "torn_write": ("ckpt.write",),
@@ -385,6 +407,16 @@ def random_plan(seed: int, world: int, steps: int, *,
     actuator counts applied scale events, not iterations) and
     ``world`` is unused. The soak verdict asserts exactly-once answers
     through every faulted scale event.
+
+    ``profile="kvtier"`` composes the fleet-KV-tier scenario
+    (docs/serving.md) over a ``world``-replica fleet: one replica's
+    demotion corrupted (a bit flipped AFTER the crc ledger is stamped —
+    the promote-side crc gate must catch it before any device byte
+    lands), one promotion corrupted pre-verify (same gate), one
+    demotion and one promotion dropped (the run dies / the promotion is
+    lost — both degrade to re-prefill, never an error). ``steps`` is
+    the TIER-OP horizon (each replica counts its own demote/promote
+    ops).
     """
     if profile == "disagg":
         if prefill is None:
@@ -406,10 +438,13 @@ def random_plan(seed: int, world: int, steps: int, *,
         return _random_transient_plan(seed, world, steps)
     if profile == "autoscale":
         return _random_autoscale_plan(seed, steps)
+    if profile == "kvtier":
+        return _random_kvtier_plan(seed, world, steps)
     if profile != "train":
         raise PlanError(
             f"random_plan profile must be 'train', 'transient', "
-            f"'serve', 'disagg' or 'autoscale'; got {profile!r}")
+            f"'serve', 'disagg', 'autoscale' or 'kvtier'; got "
+            f"{profile!r}")
     if world < 2:
         raise PlanError(f"random_plan needs world >= 2; got {world}")
     if steps < 2 * commit_every + 2:
@@ -608,6 +643,57 @@ def _random_autoscale_plan(seed: int, events: int) -> ChaosPlan:
         # land on at least one scale-down under a peak-then-cool load
         Fault(rank=0, site="autoscale.scale", kind="drop",
               after=b, until=events),
+    ]
+    for f in faults:
+        f.validate()
+    return ChaosPlan(seed=seed, faults=faults)
+
+
+def _random_kvtier_plan(seed: int, replicas: int,
+                        steps: int) -> ChaosPlan:
+    """The ``profile="kvtier"`` leg of :func:`random_plan`: the four
+    disruptions a tier move must survive (docs/serving.md failure
+    matrix), addressed in per-replica TIER-OP counters — each replica's
+    :class:`~horovod_tpu.serve.kvtier.tier.ReplicaKVTier` passes its
+    own demote/promote ordinal to ``fire(..., step=n)``, so addressing
+    is deterministic per replica regardless of fleet interleaving. All
+    faults fire on plan rank 0 (the serving process) and address
+    replicas via ``peer``. Composition:
+
+    * ``corrupt`` on one replica's early demotion: the bit flips AFTER
+      the crc ledger is stamped over the clean bytes, so ONLY the
+      promote-side per-leaf crc gate can catch it — before any device
+      byte lands, falling back to re-prefill;
+    * ``corrupt`` on another replica's early promotion: same gate,
+      corrupting the bytes about to be verified;
+    * ``drop`` on a demotion (the run dies — re-prefill on next use)
+      and on a promotion (the promotion is lost — same fallback),
+      both on later ops so clean moves happen first.
+    """
+    if replicas < 2:
+        raise PlanError(
+            f"a kvtier plan needs >= 2 replicas (the fleet index has "
+            f"nothing to route across with one); got {replicas}")
+    if steps < 8:
+        raise PlanError(
+            f"a kvtier plan needs a tier-op horizon >= 8 so drops "
+            f"land after clean moves; got {steps}")
+    rng = random.Random(seed)
+    r_dc = rng.randrange(replicas)               # demote-corrupt victim
+    r_pc = rng.randrange(replicas)               # promote-corrupt victim
+    d_at = rng.randrange(1, 3)
+    p_at = rng.randrange(1, 3)
+    drop_d = rng.randrange(steps // 2, steps)
+    drop_p = rng.randrange(steps // 2, steps)
+    faults = [
+        Fault(rank=0, site="kvtier.demote", kind="corrupt",
+              peer=r_dc, at=d_at),
+        Fault(rank=0, site="kvtier.promote", kind="corrupt",
+              peer=r_pc, at=p_at),
+        Fault(rank=0, site="kvtier.demote", kind="drop",
+              peer=rng.randrange(replicas), at=drop_d),
+        Fault(rank=0, site="kvtier.promote", kind="drop",
+              peer=rng.randrange(replicas), at=drop_p),
     ]
     for f in faults:
         f.validate()

@@ -215,6 +215,24 @@ class Config:
     # to target-only greedy decode. 0 disables speculation even when a
     # drafter is wired up.
     serve_spec_k: int = 3
+    # Fleet KV tier (HOROVOD_SERVE_KVTIER): promote the radix prefix
+    # cache to a fleet resource (serve/kvtier/) — evicted refcount-zero
+    # runs demote HBM -> host-RAM -> disk instead of dying, returning
+    # conversations promote them back through the crc-gated
+    # version-fenced install path, and the fleet routers steer
+    # prefix-heavy requests to the replica holding the longest cached
+    # run. Paged + prefix-cache only; off by default.
+    serve_kvtier: bool = False
+    # Host-RAM ring bound for demoted KV blocks, in MiB per replica
+    # (HOROVOD_SERVE_KVTIER_HOST_MB). Overflow spills to the disk tier
+    # when HOROVOD_SERVE_KVTIER_DIR is set, else the oldest run drops
+    # (re-prefill on next use — the miss path, never an error).
+    serve_kvtier_host_mb: int = 64
+    # Disk spill directory for the KV tier (HOROVOD_SERVE_KVTIER_DIR):
+    # one hvdkv-v1 file per demoted block (per-leaf bytes + crc table +
+    # weight version; tools/kvtier_inspect.py audits them offline).
+    # Empty (default) disables the disk rung of the ladder.
+    serve_kvtier_dir: str = ""
     # Autoscale plane (horovod_tpu/autoscale): master enable — the
     # soak/bench harnesses attach an Autoscaler to the serve router
     # when set (HOROVOD_AUTOSCALE). Library callers construct
@@ -477,6 +495,13 @@ class Config:
         raw = os.environ.get("HOROVOD_SERVE_KERNEL")
         if raw is not None:
             c.serve_kernel = raw.strip().lower()
+        c.serve_kvtier = _env_bool("HOROVOD_SERVE_KVTIER",
+                                   c.serve_kvtier)
+        c.serve_kvtier_host_mb = _env_int_strict(
+            "HOROVOD_SERVE_KVTIER_HOST_MB", c.serve_kvtier_host_mb)
+        raw = os.environ.get("HOROVOD_SERVE_KVTIER_DIR")
+        if raw is not None:
+            c.serve_kvtier_dir = raw.strip()
         # Autoscale knobs parse strictly (same contract): a typo'd
         # threshold must fail at startup — a policy silently running
         # with a default band would scale on bars nobody chose.
@@ -694,6 +719,22 @@ class Config:
                 f"HOROVOD_SERVE_KERNEL must be 'auto', 'pallas' or "
                 f"'xla' (the paged decode attention kernel — resolved "
                 f"once at executor build); got {self.serve_kernel!r}")
+        if not isinstance(self.serve_kvtier, bool):
+            raise ValueError(
+                f"HOROVOD_SERVE_KVTIER must be a boolean; got "
+                f"{self.serve_kvtier!r}")
+        hm = self.serve_kvtier_host_mb
+        if not isinstance(hm, int) or not (0 <= hm <= 1_048_576):
+            raise ValueError(
+                f"HOROVOD_SERVE_KVTIER_HOST_MB must be MiB in "
+                f"[0, 1048576] (the host-RAM ring bound for demoted KV "
+                f"blocks; 0 spills every demotion straight to disk or "
+                f"drops it); got {hm!r}")
+        if not isinstance(self.serve_kvtier_dir, str):
+            raise ValueError(
+                f"HOROVOD_SERVE_KVTIER_DIR must be a directory path "
+                f"string ('' disables the disk tier); got "
+                f"{self.serve_kvtier_dir!r}")
         if not isinstance(self.autoscale, bool):
             raise ValueError(
                 f"HOROVOD_AUTOSCALE must be a boolean; got "

@@ -41,6 +41,11 @@ and the bucket/no-recompile contract.
     kv_migrate.py live paged-KV block migration: pack/verify/install
                  with per-block crc32 ledgers, binary wire frames and
                  weight-version fencing (plan/transport split)
+    kvtier/      fleet-wide KV tier: router-side radix index over
+                 cached prefix runs (cross-replica prefix routing +
+                 run pulls) and the per-replica HBM -> host-RAM ->
+                 disk eviction ladder with crc-verified promotion
+                 and weight-version fencing
     soak.py      serving SLO soaks under seeded chaos plans — in-
                  process, multi-process and disaggregated
                  (tools/serve_soak.py CLI; docs/serving.md)
@@ -57,6 +62,10 @@ from .kv_cache import (                                        # noqa: F401
     BlockPool, PagedKVCache, SlotKVCache, cached_attention,
     masked_attention, paged_attention, paged_model_kwargs,
     pool_blocks_for, write_kv, write_kv_paged,
+)
+from .kvtier import (                                          # noqa: F401
+    DiskTier, FleetRadixIndex, HostRing, ReplicaKVTier, TierEntry,
+    prefer_holders, read_spill_file,
 )
 from .prefix import RadixPrefixCache                           # noqa: F401
 from .queue import (                                           # noqa: F401

@@ -49,6 +49,7 @@ from ..chaos import inject as _chaos
 from ..obs import metrics as obs_metrics
 from ..trace.spans import get_recorder as _trace_recorder
 from .kv_cache import BlockPool, PagedKVCache, SlotKVCache
+from .kvtier.tier import ReplicaKVTier
 from .prefix import RadixPrefixCache
 from .queue import AdmissionQueue, ServeRequest
 
@@ -113,7 +114,10 @@ class ContinuousBatcher:
                  on_kv_corrupt: str = "reprefill",
                  draft_executor=None,
                  spec_k: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 kv_tier: Optional[bool] = None,
+                 kvtier_host_mb: Optional[int] = None,
+                 kvtier_dir: Optional[str] = None):
         buckets = tuple(sorted(int(b) for b in buckets))
         if not buckets or buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints; got {buckets}")
@@ -133,7 +137,8 @@ class ContinuousBatcher:
         #: series and addresses chaos serve.step / serve.kv faults
         self.replica_id = replica_id
         cfg = None
-        if kv_crc is None or spec_k is None or prefix_cache is None:
+        if kv_crc is None or spec_k is None or prefix_cache is None \
+                or kv_tier is None:
             from ..core.config import Config
             cfg = Config.from_env()
         #: per-slot/per-block crc-on-write / verify-on-read
@@ -183,6 +188,30 @@ class ContinuousBatcher:
         self._prefix_version = executor.params_version
         #: router-raised out-of-band flush (re-admission gate)
         self._prefix_flush = threading.Event()
+
+        # -- fleet KV tier (serve/kvtier/): evicted prefix runs demote
+        # down the HBM -> host -> disk ladder and promote back through
+        # the verified install path. Paged + prefix-cache only — with
+        # either off the knob is inert (same contract as the prefix
+        # cache itself being paged-only).
+        if kv_tier is None:
+            kv_tier = cfg.serve_kvtier
+        self.kvtier: Optional[ReplicaKVTier] = None
+        if kv_tier and self.paged and self.prefix is not None:
+            if kvtier_host_mb is None or kvtier_dir is None:
+                if cfg is None:
+                    from ..core.config import Config
+                    cfg = Config.from_env()
+                if kvtier_host_mb is None:
+                    kvtier_host_mb = cfg.serve_kvtier_host_mb
+                if kvtier_dir is None:
+                    kvtier_dir = cfg.serve_kvtier_dir
+            self.kvtier = ReplicaKVTier(
+                executor, self.kv.pool, self.prefix,
+                replica_id=replica_id, kv_crc=self.kv_crc,
+                host_bytes=int(kvtier_host_mb) * 1024 * 1024,
+                spill_dir=kvtier_dir or None)
+            self.prefix.on_evict = self.kvtier.on_evict
 
         # -- speculative decoding: a draft executor proposes spec_k
         # tokens per iteration; the target verifies them in one step
@@ -369,6 +398,11 @@ class ContinuousBatcher:
             dropped = self.prefix.flush()
             self._prefix_version = v
             self._prefix_flush.clear()
+            if self.kvtier is not None:
+                # ladder entries under the old version can never
+                # promote (the fence refuses them): drop the host ring
+                # and tell the fleet index this replica holds nothing
+                self.kvtier.on_flush()
             if dropped:
                 logger.info(
                     "serve replica %s: prefix cache flushed (%d runs) "
@@ -478,6 +512,17 @@ class ContinuousBatcher:
         self._drain_parked_release()
         self._install_migrated()
         self._retire()
+        # KV tier (serve/kvtier/): install router-pulled runs, then
+        # promote ladder-held prefixes of waiting prompts BEFORE the
+        # admission wave matches — a promoted block is indistinguishable
+        # from a locally cached one by the time _plan walks the tree
+        if self.kvtier is not None:
+            if self.kvtier.has_grafts():
+                self.kvtier.install_grafts()
+            if not self.kvtier.empty():
+                for p in self.queue.peek_prompts(
+                        self.executor.max_batch):
+                    self.kvtier.promote_for(p)
         admitted = self._admit()
         if admitted:
             self._prefill(admitted)
@@ -495,7 +540,8 @@ class ContinuousBatcher:
         self.iterations += 1
         return bool(self._active) or bool(self._reprefill) \
             or self.queue.depth() > 0 or bool(self._migrate_in) \
-            or bool(self._parked_release)
+            or bool(self._parked_release) \
+            or (self.kvtier is not None and self.kvtier.has_grafts())
 
     def run(self, max_iterations: Optional[int] = None) -> None:
         """Drive until drained (loopback/bench mode)."""
@@ -976,8 +1022,14 @@ class ContinuousBatcher:
         # wave's own acceptances are charged against the snapshot:
         # `planned` for reservations that land at alloc_row, `pinned`
         # for matched prefix blocks whose new reference may have made
-        # a previously-evictable run un-evictable. Both only ever
-        # UNDER-admit — the reservation invariant cannot be pierced.
+        # a previously-evictable run un-evictable. Each candidate is
+        # charged for its OWN pins too, not just its predecessors' —
+        # a request whose match pins the last evictable runs must not
+        # be admitted against them (free + evictable - reserved would
+        # go negative the moment the pins land, and a RESERVED append
+        # of an already-running sequence would find the pool dry).
+        # All three charges only ever UNDER-admit — the reservation
+        # invariant cannot be pierced.
         ev0 = (self.prefix.evictable_blocks()
                if self.prefix is not None else 0)
         planned = 0
@@ -997,8 +1049,9 @@ class ContinuousBatcher:
         # (they already waited their turn once)
         while self._reprefill and len(admitted) < free_rows:
             plan = self._plan(self._reprefill[0])
-            if not self.kv.can_admit(plan["new_blocks"] + planned,
-                                     max(ev0 - pinned, 0)):
+            if not self.kv.can_admit(
+                    plan["new_blocks"] + planned,
+                    max(ev0 - pinned - pins_of(plan), 0)):
                 self._release_plan(plan)
                 # ahead-of-queue means AHEAD: admitting smaller queue
                 # requests past a blocked reprefill would let them eat
@@ -1016,7 +1069,7 @@ class ContinuousBatcher:
             nonlocal planned, pinned
             plan = self._plan(req)
             if self.kv.can_admit(plan["new_blocks"] + planned,
-                                 max(ev0 - pinned, 0)):
+                                 max(ev0 - pinned - pins_of(plan), 0)):
                 plans[req.rid] = plan
                 planned += plan["new_blocks"]
                 pinned += pins_of(plan)
@@ -1151,6 +1204,10 @@ class ContinuousBatcher:
                 # publish this prompt's FULL blocks for future sharing
                 self.prefix.insert(a.req.prompt,
                                    self.kv.blocks[a.slot])
+                if self.kvtier is not None:
+                    # fleet index event: this replica now holds the run
+                    self.kvtier.note_insert(a.req.prompt,
+                                            a.params_version)
         if self.draft is not None and admitted:
             self._draft_prefill(admitted)
 

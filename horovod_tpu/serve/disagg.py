@@ -75,6 +75,7 @@ from ..obs import metrics as obs_metrics
 from ..trace import collect as _tr_collect
 from . import wire
 from .fleet import FLEET_REJECTED_HELP, FleetHandle
+from .kvtier import prefer_holders
 from .proc_fleet import (DEFAULT_SPAWN_TIMEOUT_S, ProcessFleetRouter,
                          SHED_BASE_MS, _PROMPT_WINDOW)
 from .queue import Rejected
@@ -563,7 +564,16 @@ class DisaggRouter:
     def _phase_prefill(self, tr: _DisaggTracked,
                        exclude: Optional[int] = None) -> Tuple[str, object]:
         retry_hint: Optional[float] = None
-        for rep in self.prefill._candidates(exclude=exclude):
+        cands = self.prefill._candidates(exclude=exclude)
+        matched: Dict[int, int] = {}
+        if self.prefill.kvtier_index is not None and cands:
+            # fleet KV tier: steer the prefill leg at the pool replica
+            # holding the longest cached run of this prompt (advisory —
+            # an evicted run just re-prefills)
+            cands, matched = prefer_holders(
+                cands, tr.prompt, self.prefill.kvtier_index,
+                versions={r.id: r.weights_version for r in cands})
+        for rep in cands:
             if self._expired(tr):
                 return ("resolved", None)
             if self.draining:
@@ -594,6 +604,9 @@ class DisaggRouter:
                 return ("shed", Rejected(
                     payload.get("error", f"bad ack {ack!r}"),
                     retry_after_ms=None))
+            if matched.get(rep.id):
+                # landed on the index-preferred holder
+                self.prefill._m_kvtier_routed.inc()
             # prefill-side spans (queue_wait/prefill) piggyback on the
             # reply frame — merge them into the request's trace tree
             if self.tracer is not None and tr.trace is not None \

@@ -75,7 +75,11 @@ ROUTER_MS_HELP = ("router leg latency: dispatch (pick+enqueue) and e2e "
 FAILOVER_MS_HELP = ("replica death -> ejection + in-flight re-enqueued "
                     "(ms)")
 
+from ..trace.spans import get_recorder as _trace_recorder
 from .batcher import ContinuousBatcher
+from .kv_migrate import MigrateCorrupt, unpack_blocks
+from .kvtier import FleetRadixIndex, prefer_holders
+from .kvtier.tier import PULLS_HELP, ROUTED_HELP
 from .queue import AdmissionQueue, AdmitDropped, Rejected, ServeHandle
 
 logger = logging.getLogger("horovod_tpu")
@@ -191,7 +195,10 @@ class Replica:
                  weights_interval_s: float = 0.25,
                  draft_executor=None,
                  spec_k: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 kv_tier: Optional[bool] = None,
+                 kvtier_host_mb: Optional[int] = None,
+                 kvtier_dir: Optional[str] = None):
         if getattr(executor, "replica_id", None) != rid:
             raise ValueError(
                 f"replica {rid}: its executor must be constructed with "
@@ -213,6 +220,10 @@ class Replica:
         self.draft_executor = draft_executor
         self.spec_k = spec_k         # None defers to HOROVOD_SERVE_SPEC_K
         self.prefix_cache = prefix_cache   # None defers to env knob
+        # fleet KV tier passthrough (None defers to the env knobs)
+        self.kv_tier = kv_tier
+        self.kvtier_host_mb = kvtier_host_mb
+        self.kvtier_dir = kvtier_dir
         #: optional WeightSubscriber (redist/stream.py): polled by the
         #: live batcher, and the router's re-admission gate
         self.subscriber = subscriber
@@ -253,7 +264,9 @@ class Replica:
             eos_id=self.eos_id, replica_id=self.id,
             kv_crc=self.kv_crc, on_kv_corrupt=self.on_kv_corrupt,
             draft_executor=self.draft_executor, spec_k=self.spec_k,
-            prefix_cache=self.prefix_cache)
+            prefix_cache=self.prefix_cache, kv_tier=self.kv_tier,
+            kvtier_host_mb=self.kvtier_host_mb,
+            kvtier_dir=self.kvtier_dir)
         self.batcher.iterations = self._iters_base
         self.batcher.heartbeat = self._heartbeat
         if self.subscriber is not None:
@@ -273,9 +286,13 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
 
     ``replicas_info[rid]`` supplies ``state``/``up``/``draining``/
     ``queue_depth``/``weights_version``/``restarts``/``queue_free``
-    and, when paged, ``kv_blocks_total``/``kv_blocks_in_use``; each
-    router sources those from what it actually has (live batchers vs
-    the health-poll cache).
+    and, when paged, ``kv_blocks_total``/``kv_blocks_in_use`` plus the
+    prefix cache's ``prefix_tokens_resident``/
+    ``prefix_tokens_evictable`` TOKEN counts (the fleet KV tier's and
+    the autoscale signals' shared definition of cacheable capacity —
+    blocks are a pool-shape detail, tokens are the unit prompts are
+    measured in); each router sources those from what it actually has
+    (live batchers vs the health-poll cache).
 
     ``pools`` (disaggregated serving, serve/disagg.py) names the
     per-pool breakdown: ``pools[name]`` carries ``replicas`` (the rids
@@ -298,6 +315,7 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
     reps: Dict[str, dict] = {}
     q_free = blocks_free = 0
     pend_n = 0
+    tok_resident = tok_evictable = 0
     per_rid: Dict[int, Tuple[int, int, int]] = {}
     for rid, info in replicas_info.items():
         entry = {k: info.get(k) for k in
@@ -315,6 +333,13 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
                       - int(info.get("kv_blocks_in_use") or 0))
                 blocks_free += rb
                 entry["kv_blocks_in_use"] = info.get("kv_blocks_in_use")
+            if info.get("prefix_tokens_resident") is not None:
+                entry["prefix_tokens_resident"] = \
+                    int(info["prefix_tokens_resident"])
+                entry["prefix_tokens_evictable"] = \
+                    int(info.get("prefix_tokens_evictable") or 0)
+                tok_resident += entry["prefix_tokens_resident"]
+                tok_evictable += entry["prefix_tokens_evictable"]
         per_rid[rid] = (rq, rb, pending)
         reps[str(rid)] = entry
     up_n = sum(1 for r in reps.values() if r["up"])
@@ -327,7 +352,9 @@ def aggregate_healthz(replicas_info: Dict[int, dict], *,
                      "replicas_total": len(reps),
                      "replicas_pending": pend_n,
                      "queue_free": q_free,
-                     "kv_blocks_free": blocks_free},
+                     "kv_blocks_free": blocks_free,
+                     "prefix_tokens_resident": tok_resident,
+                     "prefix_tokens_evictable": tok_evictable},
         "retry_after_ms": retry_after_ms,
     }
     if pools:
@@ -411,13 +438,24 @@ class FleetRouter:
         # -- bookkeeping the soak verdict audits
         self.duplicates_suppressed = 0
         self.last_failover_ms: Optional[float] = None
+        #: fleet radix index (serve/kvtier/): created at start() when
+        #: any replica runs a KV tier; None keeps every kvtier branch
+        #: on the dispatch path dead
+        self.kvtier_index: Optional[FleetRadixIndex] = None
+        self.kvtier_pull_corrupt = 0
         # -- metrics (claimed fresh: one router per serving process)
         R = obs_metrics.get_registry()
         for fam in ("hvd_serve_replica_up", "hvd_serve_failovers_total",
                     "hvd_serve_requeued_total",
                     "hvd_serve_fleet_rejected_total",
-                    "hvd_serve_router_ms", "hvd_serve_failover_ms"):
+                    "hvd_serve_router_ms", "hvd_serve_failover_ms",
+                    "hvd_serve_kvtier_routed_total",
+                    "hvd_serve_kvtier_pulls_total"):
             R.unregister(fam)
+        self._m_kvtier_routed = R.counter(
+            "hvd_serve_kvtier_routed_total", ROUTED_HELP)
+        self._m_kvtier_pulls = R.counter(
+            "hvd_serve_kvtier_pulls_total", PULLS_HELP)
         self._m_up = {
             r: R.gauge("hvd_serve_replica_up", REPLICA_UP_HELP,
                        {"replica": str(r)}) for r in ids}
@@ -464,6 +502,13 @@ class FleetRouter:
             rep.batcher.start()
             rep.state = "up"
             self._m_up[rep.id].set(1)
+        # fleet radix index over whatever block size the tiered
+        # replicas share (one model config per fleet)
+        for rep in self.replicas.values():
+            kt = rep.batcher.kvtier
+            if kt is not None:
+                self.kvtier_index = FleetRadixIndex(kt.block_size)
+                break
         self._stop.clear()
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True,
@@ -583,7 +628,18 @@ class FleetRouter:
                     * 1000.0):
                 pass
             return None
-        for rep in self._candidates(exclude=exclude):
+        # KV tier (serve/kvtier/): stable-reorder the least-loaded
+        # candidate list so replicas holding the longest cached prefix
+        # run of this prompt are tried first — advisory (the index lags
+        # by one sweep), so a stale preference just costs nothing
+        cands = self._candidates(exclude=exclude)
+        matched: Dict[int, int] = {}
+        if self.kvtier_index is not None:
+            cands, matched = prefer_holders(
+                cands, tr.prompt, self.kvtier_index,
+                versions={r.id: r.executor.params_version
+                          for r in cands})
+        for rep in cands:
             # chaos serve.route: the router's own wire to this replica.
             # An active partition refuses the dispatch; the router
             # fails over to the next candidate — that IS the handling.
@@ -643,9 +699,59 @@ class FleetRouter:
             with self._lock:
                 if tr.rid == rep.id:   # not already resolved + cleaned
                     tr.inner = inner
+            if matched:
+                if matched.get(rep.id):
+                    self._m_kvtier_routed.inc()
+                self._maybe_pull_run(rep, tr.prompt, matched)
             return None
         return Rejected("no healthy replica available",
                         retry_after_ms=retry_hint or 250.0)
+
+    def _maybe_pull_run(self, rep: Replica, prompt,
+                        matched: Dict[int, int]) -> None:
+        """The cross-replica leg: when a DIFFERENT replica's ladder
+        holds a deeper run of ``prompt`` than the replica this request
+        just landed on, pull it over the kv_migrate wire shape — pack
+        on the source (locked ladder reads only), crc-verify HERE via
+        ``unpack_blocks`` (a corrupted payload never reaches the
+        destination's install queue), graft on the destination's
+        scheduler thread through the verified install path. Only
+        ladder-held (host/disk) runs are exportable; HBM-resident runs
+        attract ROUTING preference instead, which is what ``matched``
+        already encoded. Best-effort and advisory: any miss here means
+        the request re-prefills — the normal path."""
+        best_rid, best = None, matched.get(rep.id, 0)
+        for rid, depth in matched.items():
+            if rid != rep.id and depth > best:
+                best_rid, best = rid, depth
+        if best_rid is None:
+            return
+        src = self.replicas.get(best_rid)
+        dst_tier = rep.batcher.kvtier if rep.batcher is not None \
+            else None
+        if src is None or src.batcher is None or dst_tier is None \
+                or src.batcher.kvtier is None:
+            return
+        t0 = time.time()
+        packed = src.batcher.kvtier.export_run(
+            prompt, rep.executor.params_version)
+        if packed is None:
+            return                    # shallow blocks still HBM-held
+        header, payload = packed
+        try:
+            blocks = unpack_blocks(header, payload)
+        except MigrateCorrupt as e:
+            self.kvtier_pull_corrupt += 1
+            logger.warning(
+                "fleet: kvtier pull %d -> %d failed its crc gate "
+                "(%s) — dropped, destination re-prefills",
+                best_rid, rep.id, e)
+            return
+        dst_tier.submit_graft(header, blocks)
+        self._m_kvtier_pulls.inc()
+        _trace_recorder().record_process(
+            "kvtier_pull", t0, time.time(), blocks=len(blocks),
+            src=best_rid, dst=rep.id)
 
     def _make_on_resolve(self, tr: _Tracked, rid: int):
         def hook(inner: ServeHandle) -> None:
@@ -688,6 +794,14 @@ class FleetRouter:
     def _sweep(self) -> None:
         for rid, rep in list(self.replicas.items()):
             if rep.state == "up":
+                # kvtier event drain rides the health sweep — the
+                # heartbeat channel the index protocol piggybacks on
+                if self.kvtier_index is not None \
+                        and rep.batcher is not None \
+                        and rep.batcher.kvtier is not None:
+                    evs = rep.batcher.kvtier.drain_events()
+                    if evs:
+                        self.kvtier_index.apply_events(rid, evs)
                 if not rep.batcher.alive():
                     self._eject(rid, "scheduler thread dead")
                     continue
@@ -714,6 +828,10 @@ class FleetRouter:
         rep.state = "down"
         self._m_up[rid].set(0)
         self._m_failovers.inc()
+        if self.kvtier_index is not None:
+            # its cache state is about to be rebuilt/flushed — stop
+            # steering prefix traffic at a corpse
+            self.kvtier_index.drop_replica(rid)
         logger.error("fleet: EJECTING replica %d (%s) — re-enqueueing "
                      "its in-flight requests", rid, reason)
         with self._lock:
@@ -876,6 +994,16 @@ class FleetRouter:
             if up and getattr(b, "paged", False):
                 info["kv_blocks_total"] = b.kv.pool.num_blocks
                 info["kv_blocks_in_use"] = b.kv.pool.in_use()
+                if b.prefix is not None:
+                    # TOKEN counts, the fleet-wide definition of
+                    # cacheable capacity (the index and autoscale
+                    # signals must agree; docs/serving.md). Simple
+                    # cross-thread reads, same discipline as the
+                    # worker's evictable-blocks healthz read.
+                    info["prefix_tokens_resident"] = \
+                        b.prefix.resident_tokens()
+                    info["prefix_tokens_evictable"] = \
+                        b.prefix.evictable_tokens()
             infos[rid] = info
         return aggregate_healthz(
             infos, draining=self.draining,

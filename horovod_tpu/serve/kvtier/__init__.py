@@ -1,0 +1,29 @@
+"""Fleet-wide KV tier: cross-replica prefix routing + the
+HBM -> host-RAM -> disk eviction ladder (docs/serving.md).
+
+Two halves:
+
+* :mod:`.index` — the router-side, jax-free fleet radix index mapping
+  block-granular prefix runs to their holders, fed by replica tier
+  events over the healthz/heartbeat channel; ``prefer_holders`` is the
+  candidate-ordering helper every router face shares.
+* :mod:`.tier` — the replica-side eviction ladder: a refcount-zero
+  prefix run demotes to a bounded host-RAM ring, overflows to hvdkv-v1
+  disk spill files, and promotes back through the crc-gated,
+  version-fenced ``install_kv_blocks`` path.
+"""
+from .index import FleetRadixIndex, TIERS, prefer_holders
+from .tier import (DiskTier, HostRing, ReplicaKVTier, TierEntry,
+                   read_spill_file, spill_file_name)
+
+__all__ = [
+    "FleetRadixIndex",
+    "TIERS",
+    "prefer_holders",
+    "DiskTier",
+    "HostRing",
+    "ReplicaKVTier",
+    "TierEntry",
+    "read_spill_file",
+    "spill_file_name",
+]

@@ -38,10 +38,14 @@ allocator.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+import logging
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs import metrics as obs_metrics
 from .kv_cache import BlockPool
+
+logger = logging.getLogger("horovod_tpu")
 
 
 class _Node:
@@ -66,6 +70,15 @@ class RadixPrefixCache:
         self._children: Dict[Tuple[int, ...], _Node] = {}   # root level
         self._nodes = 0
         self._tick = 0
+        #: eviction hook (the KV tier's demotion trigger,
+        #: serve/kvtier/): called with a structured event dict — run id,
+        #: block index, block count (depth of the run), token length and
+        #: the run's root->node token path — BEFORE the tree reference
+        #: is dropped, so the subscriber can still read the block's
+        #: device bytes and crc ledger. Runs on the scheduler thread
+        #: (eviction is an admission-wave step); a raising hook is
+        #: logged and dropped, never the scheduler's problem.
+        self.on_evict: Optional[Callable[[dict], None]] = None
         # -- counters (obs): standalone stacks claim fresh, fleet
         # replicas get labeled children (the serve-wide discipline)
         rl = {} if replica_id is None else {"replica": str(replica_id)}
@@ -110,6 +123,33 @@ class RadixPrefixCache:
     def _touch(self, node: _Node) -> None:
         self._tick += 1
         node.last_used = self._tick
+
+    # -- capacity in TOKENS (the fleet index / autoscale definition) ---------
+    def resident_tokens(self) -> int:
+        """Prompt tokens whose KV is resident in the tree — every node
+        is one full block, so this is nodes x block_size. The
+        fleet-wide definition of cacheable capacity (``aggregate_
+        healthz`` reports it per replica; docs/serving.md)."""
+        return self._nodes * self.block_size
+
+    def evictable_tokens(self) -> int:
+        """Tokens releasable on demand (the token-granular view of
+        :meth:`evictable_blocks` — same subtree walk, same refcount
+        rule)."""
+        return self.evictable_blocks() * self.block_size
+
+    def run_tokens(self, node: _Node) -> Tuple[int, ...]:
+        """The root->node token path — the run identity the eviction
+        event and the fleet KV tier key on."""
+        segs: List[Tuple[int, ...]] = []
+        cur: Optional[_Node] = node
+        while cur is not None:
+            segs.append(cur.tokens)
+            cur = cur.parent
+        out: List[int] = []
+        for seg in reversed(segs):
+            out.extend(seg)
+        return tuple(out)
 
     # -- lookup --------------------------------------------------------------
     def match(self, prompt) -> Tuple[List[int], Optional[Tuple[int, int]],
@@ -209,6 +249,39 @@ class RadixPrefixCache:
             pos += bs
         return created
 
+    def attach(self, tokens, block: int) -> bool:
+        """Graft ONE block back onto the tree (the KV tier's promotion
+        path, serve/kvtier/): ``tokens`` is the full root->node token
+        path (a multiple of ``block_size``; the last ``block_size``
+        tokens are the new node's segment) and ``block`` a pool index
+        whose bytes already hold that segment's KV (installed through
+        the verified path). Takes its OWN refcount on success — the
+        caller keeps/releases whatever reference it held. Returns False
+        without touching anything when the parent path is missing (the
+        caller promotes shallower blocks first) or the node already
+        exists (someone recomputed it; the existing node wins, exactly
+        like :meth:`insert`)."""
+        bs = self.block_size
+        toks = tuple(int(t) for t in tokens)
+        if not toks or len(toks) % bs != 0:
+            return False
+        children = self._children
+        parent: Optional[_Node] = None
+        for pos in range(0, len(toks) - bs, bs):
+            parent = children.get(toks[pos:pos + bs])
+            if parent is None:
+                return False
+            children = parent.children
+        seg = toks[-bs:]
+        if seg in children:
+            return False
+        self.pool.incref(block)
+        node = _Node(seg, block, parent)
+        children[seg] = node
+        self._nodes += 1
+        self._touch(node)
+        return True
+
     # -- eviction ------------------------------------------------------------
     def _leaves(self) -> List[_Node]:
         out: List[_Node] = []
@@ -256,6 +329,30 @@ class RadixPrefixCache:
             if not cands:
                 break
             victim = min(cands, key=lambda lf: lf.last_used)
+            hook = self.on_evict
+            if hook is not None:
+                # structured eviction event, emitted BEFORE the decref:
+                # the run's block is still owned by the tree here, so a
+                # demotion subscriber (serve/kvtier/) can read its
+                # device bytes and crc ledger. "run" is a stable id of
+                # the root->node token path; "blocks" its depth.
+                tokens = self.run_tokens(victim)
+                depth = len(tokens) // self.block_size
+                ev = {"run": "%08x" % zlib.crc32(
+                          b"".join(int(t).to_bytes(4, "little")
+                                   for t in tokens)),
+                      "tokens": tokens,
+                      "block": victim.block,
+                      "blocks": depth,
+                      "token_len": len(tokens)}
+                try:
+                    hook(ev)
+                except Exception as e:  # noqa: BLE001 — a demotion
+                    # failure must degrade to plain eviction (the run
+                    # re-prefills later), never kill the scheduler
+                    logger.warning(
+                        "prefix eviction hook failed (run dropped, "
+                        "will re-prefill on next use): %s", e)
             self._remove(victim)
             freed += 1
             self._m_evict.inc()

@@ -64,6 +64,8 @@ from . import wire
 from .fleet import (FAILOVER_MS_HELP, FAILOVERS_HELP,
                     FLEET_REJECTED_HELP, FleetHandle, REPLICA_UP_HELP,
                     REQUEUED_HELP, ROUTER_MS_HELP, _Tracked)
+from .kvtier import FleetRadixIndex, prefer_holders
+from .kvtier.tier import ROUTED_HELP
 from .queue import Rejected
 
 logger = logging.getLogger("horovod_tpu")
@@ -264,7 +266,8 @@ class ProcessFleetRouter:
                         "hvd_serve_fleet_capacity",
                         "hvd_serve_pool_queue_free",
                         "hvd_serve_pool_kv_blocks_free",
-                        "hvd_serve_pool_replicas_up"):
+                        "hvd_serve_pool_replicas_up",
+                        "hvd_serve_kvtier_routed_total"):
                 R.unregister(fam)
         self._pl = pl
         self._m_up = {
@@ -286,6 +289,12 @@ class ProcessFleetRouter:
             "hvd_serve_failover_ms", FAILOVER_MS_HELP, pl or None)
         self._m_respawns = R.counter(
             "hvd_serve_respawns_total", RESPAWNS_HELP, pl or None)
+        self._m_kvtier_routed = R.counter(
+            "hvd_serve_kvtier_routed_total", ROUTED_HELP, pl or None)
+        #: fleet KV-tier radix index, built lazily from the first
+        #: healthz reply that carries kvtier events (the worker only
+        #: emits them when its batcher runs a ReplicaKVTier)
+        self.kvtier_index: Optional[FleetRadixIndex] = None
         self._m_capacity = R.gauge(
             "hvd_serve_fleet_capacity", FLEET_CAPACITY_HELP,
             pl or None)
@@ -637,7 +646,13 @@ class ProcessFleetRouter:
         it), or the Rejected the caller must deliver."""
         retry_hint: Optional[float] = None
         t_d0 = time.monotonic()
-        for rep in self._candidates(exclude=exclude):
+        cands = self._candidates(exclude=exclude)
+        matched: Dict[int, int] = {}
+        if self.kvtier_index is not None and cands:
+            cands, matched = prefer_holders(
+                cands, tr.prompt, self.kvtier_index,
+                versions={r.id: r.weights_version for r in cands})
+        for rep in cands:
             # re-derived PER candidate: time burned on a failed
             # predecessor (a stalled ack, a spent ladder) must shrink
             # the budget the next replica enforces, not silently extend
@@ -677,6 +692,10 @@ class ProcessFleetRouter:
                     tr.fid, rep.id, e)
                 continue
             if kind == "ok":
+                if matched.get(rep.id):
+                    # placed on a replica the index said holds a run
+                    # of this prompt — the cross-replica locality win
+                    self._m_kvtier_routed.inc()
                 # the dispatch leg = pick + place: submit-thread start
                 # to the replica's ACCEPTED ack (the generation itself
                 # is the e2e leg's business)
@@ -909,6 +928,18 @@ class ProcessFleetRouter:
                     rep.weights_version = h.get("weights_version")
                     rep.dedupe_hits = int(h.get("dedupe_hits") or 0)
                     rep.healthz_cache = h
+                    # fleet KV-tier index feed: tier events piggyback
+                    # the healthz reply (worker.py) — same channel, one
+                    # heartbeat of advisory lag
+                    evs = h.get("kvtier_events")
+                    if evs:
+                        if self.kvtier_index is None:
+                            bs = int(h.get("kv_block_size") or 0)
+                            if bs > 0:
+                                self.kvtier_index = \
+                                    FleetRadixIndex(bs)
+                        if self.kvtier_index is not None:
+                            self.kvtier_index.apply_events(rid, evs)
             elif rep.state == "down" and self.auto_respawn \
                     and not self.draining:
                 with self._lock:
@@ -994,6 +1025,10 @@ class ProcessFleetRouter:
         rep.state = "down"
         self._m_up[rid].set(0)
         self._m_failovers.inc()
+        if self.kvtier_index is not None:
+            # a dead process holds nothing — forget its runs so the
+            # index stops steering prefix traffic at a ghost
+            self.kvtier_index.drop_replica(rid)
         logger.error("fleet: EJECTING replica %d process (%s) — "
                      "re-enqueueing its in-flight requests", rid, reason)
         requeued, rejected = self._requeue_victims(rid)
@@ -1330,6 +1365,11 @@ class ProcessFleetRouter:
                 info["kv_blocks_in_use"] = h.get("kv_blocks_in_use", 0)
                 info["kv_blocks_evictable"] = h.get(
                     "kv_blocks_evictable", 0)
+            if up and "prefix_tokens_resident" in h:
+                info["prefix_tokens_resident"] = \
+                    h["prefix_tokens_resident"]
+                info["prefix_tokens_evictable"] = h.get(
+                    "prefix_tokens_evictable", 0)
             infos[rid] = info
         return infos
 

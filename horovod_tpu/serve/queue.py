@@ -383,6 +383,16 @@ class AdmissionQueue:
             else:
                 self._service_ms_ewma += 0.2 * (ms - self._service_ms_ewma)
 
+    def peek_prompts(self, n: int) -> List[Sequence[int]]:
+        """Snapshot the first ``n`` waiting prompts (no pop, no
+        resolution) — the KV tier's pre-admission promotion scan
+        (serve/kvtier/): the batcher promotes ladder-held prefix runs
+        for queued prompts BEFORE the admission wave matches against
+        the tree, outside the queue lock."""
+        with self._lock:
+            return [req.prompt for _, req in
+                    zip(range(n), self._dq)]
+
     def depth(self) -> int:
         with self._lock:
             return len(self._dq)

@@ -1814,3 +1814,310 @@ def _autoscale_soak_body(router, scaler, resolved, events, records,
     with open(os.path.join(work_dir, "verdict.json"), "w") as f:
         json.dump(verdict, f, indent=2, sort_keys=True)
     return verdict
+
+
+def evaluate_kvtier(records: List[dict], events: List[dict], plan,
+                    fleet_stats: dict, tier: dict) -> dict:
+    """The FLEET-KV-TIER verdict: the serve hygiene invariants (zero
+    silent drops, answered-once, sheds carry retry hints) plus the
+    tier's own contract —
+
+    * the ladder actually moved: demotions AND promotions > 0 (a soak
+      whose pool never pressured the prefix cache proves nothing);
+    * **cross-replica hits**: the fleet index steered > 0 dispatches at
+      the replica holding the request's longest cached run
+      (``hvd_serve_kvtier_routed_total``);
+    * **bit-identical tokens**: every repeat of the same (prompt,
+      max_new_tokens) — cold, promoted, or re-prefilled after a drop —
+      produced the same token sequence;
+    * **corrupt caught before install**: every ``kvtier.promote``
+      corrupt that fired was caught by the per-leaf crc gate
+      (``corrupt_detected`` >= fired), and no request errored;
+    * **drop degrades to re-prefill**: the scheduled drops fired, the
+      drop counters moved, and still zero ``error`` statuses — a lost
+      tier move is a cache miss, never a failure.
+    """
+    v: Dict[str, Any] = {
+        "submitted": len(records), "statuses": {},
+        "no_silent_drops": None, "answered_once": None,
+        "shed_carry_retry_after": None,
+        "ladder_exercised": None, "cross_replica_hit": None,
+        "tokens_bit_identical": None, "corrupt_caught": None,
+        "drops_degraded": None, "no_errors": None,
+        "faults_fired": None, "tier": tier,
+    }
+    statuses: Dict[str, int] = {}
+    for r in records:
+        statuses[r["status"]] = statuses.get(r["status"], 0) + 1
+    v["statuses"] = statuses
+    v["no_silent_drops"] = (
+        len(records) > 0
+        and all(r["status"] != "pending" for r in records)
+        and fleet_stats.get("inflight", 0) == 0)
+    v["answered_once"] = all(r.get("resolutions", 1) <= 1
+                             for r in records)
+    shed = [r for r in records if r["status"] in ("shed", "rejected")]
+    v["shed_carry_retry_after"] = all(
+        (r.get("retry_after_ms") or 0) > 0 for r in shed)
+    v["no_errors"] = statuses.get("error", 0) == 0
+
+    v["ladder_exercised"] = (tier.get("demoted_blocks", 0) > 0
+                             and tier.get("promoted_blocks", 0) > 0)
+    v["cross_replica_hit"] = tier.get("routed", 0) > 0
+
+    # bit-identity across every repeat of the same prompt
+    by_prompt: Dict[str, set] = {}
+    for r in records:
+        if r["status"] == "ok" and r.get("pkey"):
+            by_prompt.setdefault(r["pkey"], set()).add(
+                tuple(r.get("tokens") or ()))
+    v["prompt_repeats"] = sum(1 for _ in by_prompt)
+    v["tokens_bit_identical"] = (
+        len(by_prompt) > 0
+        and all(len(s) == 1 for s in by_prompt.values()))
+
+    fired = [e for e in events if e.get("kind") == "chaos"]
+    want = {(f.site, f.kind, f.peer) for f in plan.faults}
+    got = {(e.get("site"), e.get("fault"), e.get("peer"))
+           for e in fired}
+    v["faults_fired"] = want <= got
+    promote_corrupts = sum(
+        1 for e in fired if e.get("site") == "kvtier.promote"
+        and e.get("fault") == "corrupt")
+    v["corrupt_caught"] = (
+        promote_corrupts > 0
+        and tier.get("corrupt_detected", 0) >= promote_corrupts
+        and v["no_errors"])
+    drops_fired = sum(1 for e in fired if e.get("fault") == "drop"
+                      and str(e.get("site", "")).startswith("kvtier."))
+    v["drops_degraded"] = (
+        drops_fired > 0
+        and (tier.get("demote_drops", 0)
+             + tier.get("promote_drops", 0)) > 0
+        and v["no_errors"])
+
+    v["ok"] = all(v[k] is not False for k in (
+        "no_silent_drops", "answered_once", "shed_carry_retry_after",
+        "ladder_exercised", "cross_replica_hit",
+        "tokens_bit_identical", "corrupt_caught", "drops_degraded",
+        "no_errors", "faults_fired"))
+    return v
+
+
+def run_kvtier_soak(out_dir: Optional[str] = None, *,
+                    replicas: int = 2, clients: int = 4,
+                    seed: int = 0, plan=None, steps: int = 8,
+                    suspect_s: float = DEFAULT_SUSPECT_S,
+                    interval_s: float = DEFAULT_INTERVAL_S,
+                    min_duration_s: float = 6.0,
+                    max_duration_s: float = 60.0,
+                    max_new_tokens: int = 4,
+                    deadline_ms: float = 20000.0) -> dict:
+    """The fleet-KV-tier soak: multi-turn conversations with a shared
+    system prefix over an in-process fleet running the full tier —
+    small pool + tiny host rings so prefix evictions DEMOTE down the
+    ladder (one replica rings at 1 MiB for the host rung, one at 0 so
+    every demotion spills to disk), returning turns PROMOTE back, the
+    fleet index steers follow-ups at the holder — under the seeded
+    ``kvtier`` chaos profile (corrupt demote + corrupt promote + drop
+    both). Conversations replay deterministically (greedy decode,
+    derived follow-up tokens), so every prompt repeats and the verdict
+    can assert bit-identical tokens across cold/promoted/re-prefilled
+    serves. Returns the :func:`evaluate_kvtier` verdict; never raises
+    on a failed invariant."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..chaos import inject
+    from ..chaos.plan import ChaosPlan, random_plan
+    from ..models.gpt import GPT, GPTConfig
+    from .executor import ShardedExecutor
+    from .fleet import FleetRouter, Replica
+    from .queue import Rejected
+
+    if plan is None or plan == "random":
+        resolved = random_plan(seed, replicas, steps, profile="kvtier")
+    elif isinstance(plan, ChaosPlan):
+        resolved = plan
+    else:
+        resolved = ChaosPlan.parse(str(plan))
+
+    work = out_dir or tempfile.mkdtemp(prefix="hvd-kvtier-soak-")
+    os.makedirs(work, exist_ok=True)
+
+    kw = dict(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+              max_seq_len=48, dtype=jnp.float32,
+              attention_impl="reference")
+    # 16 blocks is UNDER one deep conversation pair (two 32-token
+    # prompts need 18) — the admission gate must evict prefix runs
+    # every wave, which is exactly the demotion pressure the ladder
+    # soak exists to exercise
+    model = GPT(GPTConfig(decode=True, **kw, kv_block_size=4,
+                          kv_pool_blocks=16))
+    params = GPT(GPTConfig(**kw)).init(
+        jax.random.PRNGKey(seed), jnp.zeros((2, 8), jnp.int32))["params"]
+
+    events: List[dict] = []
+    records: List[dict] = []
+    ev_lock = threading.Lock()
+    rec_lock = threading.Lock()
+
+    def log_event(kind: str, ev: dict) -> None:
+        with ev_lock:
+            events.append(dict(ev, kind=kind))
+
+    reps = [
+        Replica(i,
+                ShardedExecutor(model, params, max_batch=4, max_len=48,
+                                replica_id=i),
+                # conversations grow to 32 prompt tokens — the bucket
+                # set must cover the deepest turn
+                buckets=(16, 32), max_queue=max(32, 4 * clients),
+                deadline_ms=deadline_ms, kv_crc=True,
+                prefix_cache=True, kv_tier=True,
+                # replica 0 spills straight to disk (0 MiB ring);
+                # the others keep the host rung — both ladder rungs
+                # are exercised in one soak
+                kvtier_host_mb=(0 if i == 0 else 1),
+                kvtier_dir=os.path.join(work, "spill", f"r{i}"))
+        for i in range(replicas)]
+    router = FleetRouter(reps, interval_s=interval_s,
+                         suspect_s=suspect_s)
+    router.add_listener(lambda ev: log_event("fleet", ev))
+
+    inj = inject.install(resolved, rank=0)
+    inj.add_listener(lambda ev: log_event(
+        "chaos", {"fault": ev["kind"],
+                  **{k: x for k, x in ev.items() if k != "kind"}}))
+
+    router.start()
+    stop = threading.Event()
+
+    # one shared system prefix (2 full blocks) across EVERY client —
+    # the cross-replica routing signal
+    grng = np.random.RandomState(seed + 777)
+    sys_prefix = [int(t) for t in grng.randint(1, 64, 8)]
+
+    def client(cid: int) -> None:
+        rng = np.random.RandomState(10_000 + cid)
+        openers = [[int(t) for t in rng.randint(1, 64, 4)]
+                   for _ in range(2)]
+        conv = 0
+        while not stop.is_set():
+            prompt = list(sys_prefix) + openers[conv % 2]
+            conv += 1
+            while len(prompt) <= 32 and not stop.is_set():
+                t0 = time.time()
+                rec = {"fid": None, "t0": t0, "t1": None,
+                       "status": "pending", "latency_ms": None,
+                       "retry_after_ms": None, "resolutions": 0,
+                       "replica": None, "client": cid,
+                       "pkey": ",".join(map(str, prompt)),
+                       "tokens": None}
+                try:
+                    h = router.submit(prompt,
+                                      max_new_tokens=max_new_tokens)
+                except Rejected as e:
+                    rec.update(status="shed",
+                               retry_after_ms=e.retry_after_ms,
+                               t1=time.time())
+                    with rec_lock:
+                        records.append(rec)
+                    time.sleep(min((e.retry_after_ms or 100.0), 500.0)
+                               / 1000.0)
+                    continue
+                h.wait(timeout=deadline_ms / 1000.0 + 30.0)
+                rec.update(fid=h.fid, t1=time.time(),
+                           status=h.status, latency_ms=h.latency_ms,
+                           retry_after_ms=h.retry_after_ms,
+                           resolutions=h.resolutions,
+                           replica=h.replica,
+                           tokens=[int(t) for t in (h.tokens or ())])
+                with rec_lock:
+                    records.append(rec)
+                if h.status != "ok":
+                    break
+                # the follow-up turn: generated tokens plus ONE derived
+                # user token — deterministic, so conversation replays
+                # repeat the exact prompts (the bit-identity probe)
+                prompt = prompt + [int(t) for t in h.tokens] + [
+                    (cid * 7 + len(prompt)) % 63 + 1]
+                time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    want = {(f.site, f.kind, f.peer) for f in resolved.faults}
+
+    def faults_all_fired() -> bool:
+        with ev_lock:
+            got = {(e.get("site"), e.get("fault"), e.get("peer"))
+                   for e in events if e.get("kind") == "chaos"}
+        return want <= got
+
+    def tier_exercised() -> bool:
+        promoted = sum(r.batcher.kvtier.promoted_blocks for r in reps
+                       if r.batcher is not None
+                       and r.batcher.kvtier is not None)
+        return (promoted > 0
+                and int(router._m_kvtier_routed.value) > 0)
+
+    while time.monotonic() - t_start < max_duration_s:
+        if faults_all_fired() and tier_exercised() \
+                and time.monotonic() - t_start >= min_duration_s:
+            break
+        time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=deadline_ms / 1000.0 + 35.0)
+
+    fleet_stats = router.stats()
+    tier: Dict[str, int] = {
+        "demoted_blocks": 0, "promoted_blocks": 0, "demote_drops": 0,
+        "promote_drops": 0, "corrupt_detected": 0, "pulls_in": 0,
+        "host_runs": 0, "disk_runs": 0,
+    }
+    for r in reps:
+        if r.batcher is None or r.batcher.kvtier is None:
+            continue
+        for k, val in r.batcher.kvtier.stats().items():
+            if k in tier:
+                tier[k] += int(val)
+    tier["routed"] = int(router._m_kvtier_routed.value)
+    tier["pulls"] = int(router._m_kvtier_pulls.value)
+    tier["pull_corrupt"] = int(router.kvtier_pull_corrupt)
+    if router.kvtier_index is not None:
+        tier["index"] = router.kvtier_index.stats()
+    router.close()
+    inject.uninstall()
+
+    verdict = evaluate_kvtier(
+        records, sorted(events, key=lambda e: e.get("t", 0.0)),
+        resolved, fleet_stats, tier)
+    verdict.update({
+        "seed": resolved.seed, "replicas": replicas,
+        "clients": clients,
+        "wall_s": round(time.monotonic() - t_start, 2),
+        "plan": json.loads(resolved.to_json()),
+        "fleet": fleet_stats,
+    })
+    if out_dir:
+        with open(os.path.join(out_dir, "events.jsonl"), "w") as f:
+            for e in events:
+                f.write(json.dumps(e, default=str) + "\n")
+        with open(os.path.join(out_dir, "requests.jsonl"), "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        with open(os.path.join(out_dir, "verdict.json"), "w") as f:
+            json.dump(verdict, f, indent=2, sort_keys=True)
+    else:
+        shutil.rmtree(work, ignore_errors=True)
+    return verdict

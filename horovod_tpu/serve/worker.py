@@ -527,12 +527,25 @@ class ReplicaEndpoint:
         if getattr(b, "paged", False):
             info["kv_blocks_in_use"] = b.kv.pool.in_use()
             info["kv_blocks_total"] = b.kv.pool.num_blocks
+            info["kv_block_size"] = b.kv.pool.block_size
             # blocks held ONLY by the prefix cache (refcount-zero
             # runs): resident but reclaimable on demand — load signals
             # must not read cache residency as capacity pressure
             info["kv_blocks_evictable"] = (
                 b.prefix.evictable_blocks()
                 if getattr(b, "prefix", None) is not None else 0)
+            if getattr(b, "prefix", None) is not None:
+                # TOKEN counts — the fleet-wide cacheable-capacity
+                # definition the index and autoscale signals share
+                info["prefix_tokens_resident"] = \
+                    b.prefix.resident_tokens()
+                info["prefix_tokens_evictable"] = \
+                    b.prefix.evictable_tokens()
+            if getattr(b, "kvtier", None) is not None:
+                # fleet-index event feed piggybacks the healthz reply
+                # (the heartbeat channel the router already polls)
+                info["kvtier_events"] = b.kvtier.drain_events()
+                info["kvtier"] = b.kvtier.stats()
         # disaggregated-serving evidence (serve/disagg.py healthz +
         # the disagg soak verdict read these per pool)
         info["migrations_in"] = b.migrations_in
@@ -601,7 +614,14 @@ class ReplicaWorker:
             kv_crc=cfg.get("kv_crc"),
             draft_executor=self.draft_executor,
             spec_k=cfg.get("spec_k"),
-            prefix_cache=cfg.get("prefix_cache"))
+            prefix_cache=cfg.get("prefix_cache"),
+            kv_tier=cfg.get("kv_tier"),
+            kvtier_host_mb=cfg.get("kvtier_host_mb"),
+            # a shared spill root is partitioned per replica: two
+            # workers scanning one directory would double-count runs
+            kvtier_dir=(os.path.join(str(cfg["kvtier_dir"]),
+                                     f"r{self.rid}")
+                        if cfg.get("kvtier_dir") else None))
         # scheduler-iteration pulse: advances the heartbeat seq AND
         # crosses the serve.proc chaos gate (crash there = SIGKILL of
         # THIS process — the real host loss, see module docstring)

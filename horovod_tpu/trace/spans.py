@@ -45,6 +45,8 @@ SPAN_LEGS: "OrderedDict[str, Optional[str]]" = OrderedDict([
     ("failover",        None),        # eject -> victims re-dispatched
     ("re_prefill",      None),        # a migration leg fell back
     ("weight_fence",    None),        # hot-swap adoption fence
+    ("kvtier_promote",  None),        # ladder -> HBM verified install
+    ("kvtier_pull",     None),        # cross-replica run pull (router)
 ])
 
 #: every declared span name, in declaration order

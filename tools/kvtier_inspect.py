@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Inspect a fleet KV tier disk-spill directory (docs/serving.md,
+hvdkv-v1 format):
+
+    python tools/kvtier_inspect.py list   <dir>
+    python tools/kvtier_inspect.py show   <dir> <file>
+    python tools/kvtier_inspect.py verify <dir> [file]
+
+``list`` prints one row per spill file (token depth, filled length,
+weight version, payload bytes). ``show`` dumps one file's full header —
+token path, per-leaf byte counts and crc32 ledger. ``verify`` re-reads
+every file (or one) and recomputes the payload crc32 AND every per-leaf
+crc32 against the demotion-time ledger — exit 1 with the failing file
+and leaf named on any mismatch.
+
+Pure stdlib, and deliberately a second, independent implementation of
+the hvdkv-v1 parser (serve/kvtier/tier.py writes it): the tool never
+imports horovod_tpu — or jax — so it is safe to point at a live
+replica's spill directory from any host, and it doubles as a format
+cross-check in the test suite.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+import zlib
+
+MAGIC = b"hvdkv-v1\n"
+FORMAT = "hvdkv-v1"
+
+
+class SpillError(Exception):
+    pass
+
+
+def read_file(path: str) -> tuple:
+    """Parse one hvdkv-v1 file -> (header dict, payload bytes)."""
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise SpillError(
+                f"{path}: not an {FORMAT} spill file (magic {magic!r})")
+        raw = f.read(4)
+        if len(raw) != 4:
+            raise SpillError(f"{path}: truncated header length")
+        (hlen,) = struct.unpack("<I", raw)
+        hraw = f.read(hlen)
+        if len(hraw) != hlen:
+            raise SpillError(f"{path}: truncated header")
+        try:
+            header = json.loads(hraw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise SpillError(f"{path}: bad header json ({e})")
+        payload = f.read()
+    if header.get("format") != FORMAT:
+        raise SpillError(f"{path}: header format "
+                         f"{header.get('format')!r} != {FORMAT}")
+    return header, payload
+
+
+def spill_files(root: str) -> list:
+    if not os.path.isdir(root):
+        raise SpillError(f"{root}: not a directory")
+    return sorted(n for n in os.listdir(root) if n.endswith(".hvdkv"))
+
+
+def verify_file(path: str) -> list:
+    """Every crc complaint for one file (empty = clean)."""
+    header, payload = read_file(path)
+    bad = []
+    want = header.get("payload_crc")
+    if want is not None and zlib.crc32(payload) != int(want):
+        bad.append(f"{path}: payload crc32 mismatch "
+                   f"(got {zlib.crc32(payload):#010x}, "
+                   f"header says {int(want):#010x})")
+    nbytes = [int(n) for n in header.get("nbytes", [])]
+    crcs = [int(c) for c in header.get("crcs", [])]
+    if sum(nbytes) != len(payload):
+        bad.append(f"{path}: payload is {len(payload)} B but the "
+                   f"header's leaf table sums to {sum(nbytes)} B")
+    if len(nbytes) != len(crcs):
+        bad.append(f"{path}: {len(nbytes)} leaves but {len(crcs)} "
+                   f"crc32 entries")
+    off = 0
+    for i, (n, c) in enumerate(zip(nbytes, crcs)):
+        got = zlib.crc32(payload[off:off + n])
+        if got != c:
+            bad.append(f"{path}: leaf {i} crc32 mismatch "
+                       f"(got {got:#010x}, ledger says {c:#010x})")
+        off += n
+    return bad
+
+
+def cmd_list(args) -> int:
+    names = spill_files(args.dir)
+    print(f"{len(names)} spill file(s) under {args.dir}")
+    print(f"  {'file':<28} {'depth':>5} {'filled':>6} "
+          f"{'version':>8} {'bytes':>10}")
+    for name in names:
+        try:
+            header, payload = read_file(os.path.join(args.dir, name))
+        except SpillError as e:
+            print(f"  {name:<28} UNREADABLE: {e}")
+            continue
+        ver = header.get("weights_version")
+        print(f"  {name:<28} {len(header.get('tokens', ())):>5} "
+              f"{header.get('filled', 0):>6} "
+              f"{('-' if ver is None else str(ver)):>8} "
+              f"{len(payload):>10}")
+    return 0
+
+
+def cmd_show(args) -> int:
+    path = os.path.join(args.dir, args.file)
+    header, payload = read_file(path)
+    print(f"spill file {path}")
+    print(f"  format:   {header.get('format')}")
+    print(f"  tokens:   {header.get('tokens')}")
+    print(f"  block:    size {header.get('block_size')}, "
+          f"filled {header.get('filled')}")
+    print(f"  version:  {header.get('weights_version')}")
+    print(f"  payload:  {len(payload)} B, "
+          f"crc32 {int(header.get('payload_crc', 0)):#010x}")
+    print(f"  {'leaf':>4} {'bytes':>10} crc32")
+    for i, (n, c) in enumerate(zip(header.get("nbytes", []),
+                                   header.get("crcs", []))):
+        print(f"  {i:>4} {int(n):>10} {int(c):#010x}")
+    return 0
+
+
+def cmd_verify(args) -> int:
+    names = [args.file] if args.file else spill_files(args.dir)
+    bad, nbytes, nleaves = [], 0, 0
+    for name in names:
+        path = os.path.join(args.dir, name)
+        try:
+            complaints = verify_file(path)
+        except SpillError as e:
+            complaints = [str(e)]
+        if complaints:
+            bad.extend(complaints)
+            continue
+        header, payload = read_file(path)
+        nbytes += len(payload)
+        nleaves += len(header.get("crcs", []))
+    if bad:
+        for line in bad:
+            print(f"CORRUPT: {line}")
+        return 1
+    print(f"OK: {len(names)} spill file(s) — {nleaves} leaf crc32(s) / "
+          f"{nbytes} payload B verified")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kvtier_inspect",
+        description="list / show / verify hvdkv-v1 KV-tier spill files")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ls = sub.add_parser("list", help="one row per spill file")
+    ls.add_argument("dir")
+    ls.set_defaults(fn=cmd_list)
+    sh = sub.add_parser("show", help="dump one file's header")
+    sh.add_argument("dir")
+    sh.add_argument("file")
+    sh.set_defaults(fn=cmd_show)
+    vf = sub.add_parser("verify",
+                        help="recompute every crc32 against the ledger")
+    vf.add_argument("dir")
+    vf.add_argument("file", nargs="?", default=None)
+    vf.set_defaults(fn=cmd_verify)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except SpillError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # `kvtier_inspect list ... | head` closing stdout early is fine
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,10 +115,34 @@ def main(argv=None) -> int:
     p.add_argument("--no-chaos", action="store_true",
                    help="--autoscale: skip the autoscale-profile chaos "
                         "plan (scale events run unfaulted)")
+    p.add_argument("--kv-tier", action="store_true",
+                   help="FLEET-KV-TIER soak: multi-turn conversations "
+                        "with a shared system prefix over a 2-replica "
+                        "fleet running the HBM->host->disk eviction "
+                        "ladder + fleet radix index, under the seeded "
+                        "kvtier chaos profile (corrupt/drop on "
+                        "demote/promote); asserts cross-replica hits, "
+                        "bit-identical tokens and crc containment "
+                        "(docs/serving.md, fleet-KV-tier section)")
     args = p.parse_args(argv)
 
     # one fleet on CPU devices; keep the run reproducible
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.kv_tier:
+        from horovod_tpu.serve.soak import run_kvtier_soak
+        verdict = run_kvtier_soak(
+            args.out,
+            replicas=2 if args.replicas == 3 else max(args.replicas, 2),
+            clients=args.clients, seed=args.seed,
+            plan=args.plan if args.plan != "random" else None,
+            steps=args.steps if args.steps != 240 else 8,
+            suspect_s=1.0 if args.suspect_s is None else args.suspect_s,
+            min_duration_s=args.min_duration,
+            max_duration_s=args.max_duration or 60.0)
+        print(json.dumps(verdict, indent=2, sort_keys=True,
+                         default=str))
+        return 0 if verdict.get("ok") else 1
 
     if args.autoscale:
         from horovod_tpu.serve.soak import run_autoscale_soak
