@@ -38,7 +38,11 @@ docs/serving.md process-fleet section), `--ckpt`
 the checkpoint-plane loopback (ckpt_save_ms / ckpt_blocking_ms /
 ckpt_restore_ms — docs/checkpoint.md), `--collectives` the
 collective-algorithm microbench (bytes/s per algorithm x tensor size
-plus the measured crossover table — docs/benchmarks.md), and `--redist`
+plus the measured crossover table — docs/benchmarks.md), `--converge`
+the convergence-matrix gate (every runnable wire-format x op x
+algorithm cell trained to its documented tolerance, rejected cells
+asserted fail-fast — docs/benchmarks.md convergence section), and
+`--redist`
 the redistribution microbench (redist_ms / redist_bytes_per_s for an
 in-memory N->M vs the ckpt save+restore round trip, plus
 weight_swap_ms for a serve hot-swap — docs/redistribution.md), each
@@ -1340,6 +1344,52 @@ def run_collectives_benchmark() -> int:
         return 1
 
 
+def run_converge_benchmark() -> int:
+    """Convergence-matrix gate (`bench.py --converge`): train every
+    runnable (wire format x reduction op x algorithm) cell of the
+    horovod_tpu/converge matrix on the HOROVOD_CONVERGE_MODELS rows
+    (default resnet18,gpt_tiny) and gate on the verdict — every
+    runnable cell within its documented tolerance vs its baseline
+    (docs/benchmarks.md tolerance table), every rejected-by-design
+    cell failing fast with its structured error. Prints the verdict as
+    ONE JSON line; exits nonzero unless ``ok``. This is the gate every
+    wire-format or algorithm change runs before it ships (ROADMAP
+    item 1)."""
+    ndev = int(os.environ.get("HVD_BENCH_CONVERGE_DEVICES", "8"))
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") and ndev > 1:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={ndev}").strip()
+    try:
+        import horovod_tpu as hvd
+        from horovod_tpu.converge import run_matrix
+
+        hvd.init()
+        verdict = run_matrix()
+        # one compact line: drop per-step curves, keep the judgments
+        summary = {"metric": "converge_matrix", "ok": verdict["ok"],
+                   "world": verdict["world"],
+                   "tol_scale": verdict["tol_scale"], "models": {}}
+        for model, cells in verdict["models"].items():
+            summary["models"][model] = {
+                name: ({"status": "ran", "pass": e["pass"],
+                        "final": round(e["final"], 4),
+                        "final_rel": e["final_rel"],
+                        "area_rel": e["area_rel"],
+                        "baseline": e["baseline"]}
+                       if e["status"] == "ran" else
+                       {"status": e["status"],
+                        "error_ok": e.get("error_ok")})
+                for name, e in cells.items()}
+        print(json.dumps(summary), flush=True)
+        hvd.shutdown()
+        return 0 if verdict["ok"] else 1
+    except Exception as e:  # noqa: BLE001 — structured error, no traceback
+        print(json.dumps({"metric": "converge_matrix", "ok": False,
+                          "error": str(e)[-500:]}), flush=True)
+        return 1
+
+
 def run_ckpt_benchmark() -> int:
     """Loopback checkpoint benchmark (`bench.py --ckpt`): drive the
     sharded checkpoint plane (horovod_tpu/ckpt) over a synthetic
@@ -1739,6 +1789,9 @@ if __name__ == "__main__":
     elif "--collectives" in sys.argv or \
             os.environ.get("HVD_BENCH_COLLECTIVES") == "1":
         sys.exit(run_collectives_benchmark())
+    elif "--converge" in sys.argv or \
+            os.environ.get("HVD_BENCH_CONVERGE") == "1":
+        sys.exit(run_converge_benchmark())
     elif "--redist" in sys.argv or \
             os.environ.get("HVD_BENCH_REDIST") == "1":
         sys.exit(run_redist_benchmark())

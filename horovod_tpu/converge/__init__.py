@@ -1,0 +1,26 @@
+"""Convergence-at-scale harness (ROADMAP item 1).
+
+The PR 6 algorithm plane multiplied the wire surface to
+{fp32, bf16, int8-EF} x {sum, avg, adasum} x {direct, rs_ag, rhd,
+two_level}; this package proves each (format, op, algo) cell actually
+*optimizes* — the gate every future wire-format or algorithm change
+runs before it ships.
+
+* `matrix` — the cell vocabulary, per-cell legality (runnable /
+  rejected-by-design / topology-skipped) and the per-cell tolerance
+  table versus the fp32 x sum x direct reference.
+* `harness` — the deterministic short-real-optimization loop: seeded
+  data + model rows from models/bench_zoo.py, rank-stacked SGD with
+  the engine's grouped allreduce per cell, per-step loss curves, and
+  `run_matrix` producing a soak-style verdict dict.
+* `proc` — the N-process acceptance mode: the same loop under a real
+  `hvdrun -np N` launch (one CPU device per worker), asserting every
+  rank records the same curve.
+
+`bench.py --converge` is the CLI entry (verdict-gated, exit 0/1).
+"""
+from .matrix import (                                          # noqa: F401
+    ADASUM_REFERENCE, Cell, REFERENCE, REJECTED, RUNNABLE, SKIPPED,
+    Tolerance, all_cells, cell_status, tolerance_for)
+from .harness import run_cell, run_matrix                      # noqa: F401
+from .proc import run_converge_proc                            # noqa: F401

@@ -1,0 +1,265 @@
+"""Deterministic short-real-optimization harness.
+
+`run_cell` trains ONE matrix cell: a seeded bench_zoo model, rank-
+stacked SGD where the gradient exchange is the engine's grouped
+allreduce configured exactly as that cell prescribes (wire format,
+reduction op, transport algorithm), recording the loss curve on a
+fixed eval pool. `run_matrix` sweeps every cell for every requested
+model, asserts rejected-by-design cells raise their structured error
+at enqueue, holds each runnable cell to `matrix.tolerance_for`, and
+returns a soak-style verdict dict (``ok`` + per-cell evidence;
+bench.py --converge prints it and gates on it).
+
+Everything is a pure function of (model, cell, nranks, steps, batch,
+lr, seed): two runs with the same inputs produce identical curves —
+the determinism invariant tests pin. Module-level imports are
+stdlib-only (CI drivers import this without jax); jax loads inside the
+functions.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .matrix import (ADASUM_REFERENCE, Cell, REFERENCE, REJECTED, RUNNABLE,
+                     SKIPPED, all_cells, cell_status, tolerance_for)
+
+#: single-sourced hvd_converge_* help strings (metric-help pass: one
+#: literal per family; every construction site references these names)
+CELLS_HELP = ("Convergence-matrix cells evaluated, by terminal status "
+              "(ran/rejected/skipped)")
+STEPS_HELP = "Optimization steps executed by the convergence harness"
+FINAL_HELP = "Final eval loss of the last run for a (model, cell)"
+DELTA_HELP = ("Relative final-loss delta of the last (model, cell) run "
+              "vs its baseline cell")
+
+#: rank-stacked replicas must stay numerically together: the combine's
+#: per-rank fp noise is ulp-level per step, so any real divergence
+#: (a broken symmetric exchange) blows through this immediately
+RANK_COHERENCE_BOUND = 1e-3
+
+_EPS = 1e-9
+
+
+def _registry():
+    from ..obs.metrics import get_registry
+    return get_registry()
+
+
+def _count_cell(status: str) -> None:
+    _registry().counter("hvd_converge_cells_total", CELLS_HELP,
+                        {"status": status}).inc()
+
+
+class _Bundle:
+    """One model's compiled pieces, shared across every cell so jit
+    caches carry over (the per-cell work is the exchange, not the
+    model)."""
+
+    def __init__(self, model: str, nranks: int, batch_size: int,
+                 seed: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.bench_zoo import build_converge_model
+        loss_fn, params, batch_fn = build_converge_model(
+            model, nranks=nranks, batch_size=batch_size, seed=seed)
+        self.nranks = nranks
+        self.batch_fn = batch_fn
+        self.params0 = jax.tree_util.tree_map(
+            lambda a: jnp.tile(a[None], (nranks,) + (1,) * a.ndim), params)
+        self.grad_fn = jax.jit(jax.vmap(jax.grad(loss_fn)))
+
+        def _eval(p0):
+            per = jax.vmap(loss_fn, in_axes=(None, 0))
+            return (jnp.mean(per(p0, batch_fn(0))) +
+                    jnp.mean(per(p0, batch_fn(1)))) / 2.0
+
+        self.eval_fn = jax.jit(_eval)
+
+
+def _cell_reduce_args(cell: Cell, nranks: int):
+    from ..core.types import ReduceOp
+    op = {"sum": ReduceOp.SUM, "avg": ReduceOp.AVERAGE,
+          "adasum": ReduceOp.ADASUM}[cell.op]
+    prescale = 1.0 / nranks if cell.op == "sum" else 1.0
+    algo = None if cell.algo == "direct" else cell.algo
+    return op, prescale, cell.fmt, algo
+
+
+def run_cell(model: str, cell: Cell, *, nranks: Optional[int] = None,
+             steps: Optional[int] = None, batch_size: Optional[int] = None,
+             lr: Optional[float] = None, seed: Optional[int] = None,
+             _bundle: Optional[_Bundle] = None) -> dict:
+    """Train one runnable cell; returns the JSON-able evidence dict:
+    curve (initial + per-step eval loss), final/area, and the max
+    cross-rank parameter divergence (`rank_coherence`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import basics
+    from ..ops import adasum as adasum_mod
+    from ..ops import engine
+
+    from ..models.bench_zoo import CONVERGE_LRS
+
+    cfg = basics.get_config()
+    n = nranks if nranks is not None else basics.size()
+    steps = cfg.converge_steps if steps is None else steps
+    batch_size = cfg.converge_batch if batch_size is None else batch_size
+    if lr is None:                # knob override, else the calibrated rate
+        lr = cfg.converge_lr or CONVERGE_LRS.get(model, 0.1)
+    seed = cfg.converge_seed if seed is None else seed
+
+    # a fresh run must not inherit another cell's quantization noise
+    adasum_mod.reset_error_feedback()
+    b = _bundle or _Bundle(model, n, batch_size, seed)
+    op, prescale, compression, algo = _cell_reduce_args(cell, n)
+
+    p = b.params0
+    curve: List[float] = [float(b.eval_fn(
+        jax.tree_util.tree_map(lambda a: a[0], p)))]
+    for step in range(steps):
+        g = b.grad_fn(p, b.batch_fn(step))
+        leaves, td = jax.tree_util.tree_flatten(g)
+        red = engine.grouped_allreduce(
+            leaves, op, prescale_factor=prescale,
+            compression=compression, algo=algo)
+        g = jax.tree_util.tree_unflatten(td, red)
+        p = jax.tree_util.tree_map(
+            lambda a, d: a - lr * jnp.asarray(d, a.dtype), p, g)
+        curve.append(float(b.eval_fn(
+            jax.tree_util.tree_map(lambda a: a[0], p))))
+
+    coherence = max(float(jnp.max(jnp.abs(a - a[0:1])))
+                    for a in jax.tree_util.tree_leaves(p))
+    R = _registry()
+    R.counter("hvd_converge_steps_total", STEPS_HELP).inc(steps)
+    R.gauge("hvd_converge_final_loss", FINAL_HELP,
+            {"model": model, "cell": cell.name}).set(curve[-1])
+    return {"cell": cell.name, "model": model, "steps": steps,
+            "curve": [round(v, 6) for v in curve],
+            "initial": curve[0], "final": curve[-1],
+            "area": sum(curve) / len(curve),
+            "rank_coherence": coherence}
+
+
+def check_rejection(cell: Cell, detail: str,
+                    nranks: Optional[int] = None) -> dict:
+    """Drive the rejected cell through the REAL enqueue surface and
+    record whether it failed fast with the structured message. A
+    rejected cell that enqueues (or raises something else) fails the
+    matrix — silent fallback is the failure mode this harness exists
+    to catch."""
+    import jax.numpy as jnp
+
+    from ..core import basics
+    from ..ops import engine
+
+    n = nranks if nranks is not None else basics.size()
+    op, prescale, compression, algo = _cell_reduce_args(cell, n)
+    probe = jnp.ones((n, 8), jnp.float32)
+    try:
+        engine.grouped_allreduce([probe], op, prescale_factor=prescale,
+                                 compression=compression, algo=algo)
+    except ValueError as e:
+        return {"status": "rejected", "error_ok": detail in str(e),
+                "expect": detail, "message": str(e)}
+    return {"status": "rejected", "error_ok": False, "expect": detail,
+            "message": "enqueue succeeded (silent fallback!)"}
+
+
+def _judge(entry: dict, cell: Cell, baselines: Dict[str, dict],
+           tol_scale: float) -> dict:
+    tol = tolerance_for(cell, entry["model"])
+    base = baselines[tol.baseline]
+    final_rel = abs(entry["final"] - base["final"]) / \
+        max(abs(base["final"]), _EPS)
+    area_rel = abs(entry["area"] - base["area"]) / \
+        max(abs(base["area"]), _EPS)
+    converged = entry["final"] <= tol.converge_frac * entry["initial"]
+    coherent = entry["rank_coherence"] <= RANK_COHERENCE_BOUND
+    ok = (final_rel <= tol.final_rel * tol_scale
+          and area_rel <= tol.area_rel * tol_scale
+          and converged and coherent)
+    entry.update({
+        "baseline": tol.baseline, "final_rel": round(final_rel, 6),
+        "area_rel": round(area_rel, 6),
+        "tol_final_rel": tol.final_rel * tol_scale,
+        "tol_area_rel": tol.area_rel * tol_scale,
+        "converged": converged, "coherent": coherent, "pass": ok})
+    _registry().gauge("hvd_converge_delta_rel", DELTA_HELP,
+                      {"model": entry["model"],
+                       "cell": cell.name}).set(final_rel)
+    return entry
+
+
+def run_matrix(models: Optional[Sequence[str]] = None, *,
+               nranks: Optional[int] = None,
+               steps: Optional[int] = None,
+               batch_size: Optional[int] = None,
+               lr: Optional[float] = None,
+               seed: Optional[int] = None,
+               tol_scale: Optional[float] = None,
+               cells: Optional[Sequence[Cell]] = None) -> dict:
+    """Sweep the (format, op, algo) matrix for each model; returns the
+    verdict dict. ``ok`` is True iff every runnable cell passed its
+    tolerance AND every rejected cell failed fast with its structured
+    message. Never raises on a failed cell — the verdict carries the
+    evidence; it raises only on harness misuse (unknown model/cell)."""
+    from ..core import basics
+    from ..models.bench_zoo import CONVERGE_MODELS
+
+    cfg = basics.get_config()
+    if models is None:
+        models = [m.strip() for m in cfg.converge_models.split(",")
+                  if m.strip()]
+    for m in models:
+        if m not in CONVERGE_MODELS:
+            raise ValueError(
+                f"unknown converge model {m!r}; HOROVOD_CONVERGE_MODELS "
+                f"rows must come from {CONVERGE_MODELS}")
+    n = nranks if nranks is not None else basics.size()
+    tol_scale = cfg.converge_tol_scale if tol_scale is None else tol_scale
+    try:
+        hier_shape = tuple(basics.get_hier_mesh().devices.shape)
+    except Exception:
+        hier_shape = None
+
+    sweep = list(cells) if cells is not None else list(all_cells())
+    # baselines first: every judged cell needs its baseline's curve
+    ordered = [c for c in (REFERENCE, ADASUM_REFERENCE) if c in sweep] + \
+        [c for c in sweep if c not in (REFERENCE, ADASUM_REFERENCE)]
+
+    verdict: dict = {"world": n, "tol_scale": tol_scale,
+                     "hier_shape": hier_shape, "models": {}}
+    ok = True
+    for model in models:
+        bundle = _Bundle(model, n,
+                         batch_size if batch_size is not None
+                         else cfg.converge_batch,
+                         seed if seed is not None else cfg.converge_seed)
+        results: Dict[str, dict] = {}
+        baselines: Dict[str, dict] = {}
+        for cell in ordered:
+            status, detail = cell_status(cell, n, hier_shape)
+            if status == REJECTED:
+                entry = check_rejection(cell, detail, n)
+                ok = ok and entry["error_ok"]
+            elif status == SKIPPED:
+                entry = {"status": "skipped", "detail": detail}
+            else:
+                entry = run_cell(model, cell, nranks=n, steps=steps,
+                                 batch_size=batch_size, lr=lr, seed=seed,
+                                 _bundle=bundle)
+                entry["status"] = "ran"
+                if cell == REFERENCE:
+                    baselines["reference"] = entry
+                elif cell == ADASUM_REFERENCE:
+                    baselines["adasum_reference"] = entry
+                entry = _judge(entry, cell, baselines, tol_scale)
+                ok = ok and entry["pass"]
+            _count_cell(entry["status"])
+            results[cell.name] = entry
+        verdict["models"][model] = results
+    verdict["ok"] = bool(ok)
+    return verdict

@@ -1,0 +1,232 @@
+"""N-process convergence acceptance: the harness loop under a REAL
+``hvdrun -np N`` launch (one CPU device per worker, jax.distributed),
+wired like the chaos soak harness (chaos/soak.py).
+
+`run_converge_proc` drives one (model, cell) through the launcher and
+asserts the multi-process invariants the in-process mode cannot:
+
+* every rank records the SAME loss curve (the engine-negotiated
+  exchange kept the replicas together across real process boundaries);
+* the curve descends (final <= converge_frac * initial);
+* the launcher exits 0 within the timeout (no negotiation deadlock).
+
+The verdict is a JSON-able dict (``ok`` + evidence, never raises on a
+failed invariant). Worker mode (``python -m horovod_tpu.converge.proc
+--worker OUT``) is what the launcher spawns. Module-level imports are
+stdlib-only; jax/horovod load inside the worker.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+DEFAULT_STEPS = 12
+DEFAULT_CONVERGE_FRAC = 0.95
+#: curves from different ranks must agree to fp tolerance — each rank
+#: runs the same symmetric combine on the same pairs, so only ulp-level
+#: reassociation noise may separate them
+CURVE_AGREE_ATOL = 1e-4
+
+
+# --------------------------------------------------------------------------
+# harness side (stdlib only)
+# --------------------------------------------------------------------------
+
+def run_converge_proc(out_dir: str, *, np_: int = 4,
+                      model: str = "gpt_tiny",
+                      fmt: str = "int8", op: str = "adasum",
+                      algo: str = "direct",
+                      steps: int = DEFAULT_STEPS,
+                      lr: float = 0.05, batch_size: int = 2,
+                      seed: int = 0,
+                      converge_frac: float = DEFAULT_CONVERGE_FRAC,
+                      timeout_s: float = 420.0) -> dict:
+    """Launch the -np workers, parse their event logs, return the
+    verdict dict."""
+    os.makedirs(out_dir, exist_ok=True)
+    hostfile = os.path.join(out_dir, "hosts.txt")
+    with open(hostfile, "w") as f:
+        f.write(f"localhost:{np_}\n")
+    disc = os.path.join(out_dir, "discover.sh")
+    with open(disc, "w") as f:
+        f.write(f"#!/bin/sh\ncat {hostfile}\n")
+    os.chmod(disc, 0o755)
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HVD_CONVERGE_MODEL": model,
+        "HVD_CONVERGE_FMT": fmt,
+        "HVD_CONVERGE_OP": op,
+        "HVD_CONVERGE_ALGO": algo,
+        "HVD_CONVERGE_STEPS": str(steps),
+        "HVD_CONVERGE_LR": str(lr),
+        "HVD_CONVERGE_BATCH": str(batch_size),
+        "HVD_CONVERGE_SEED": str(seed),
+    })
+
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "-np", str(np_),
+           "--host-discovery-script", disc,
+           sys.executable, "-m", "horovod_tpu.converge.proc",
+           "--worker", out_dir]
+    t0 = time.time()
+    driver_log = os.path.join(out_dir, "driver.log")
+    with open(driver_log, "w") as dl:
+        try:
+            rc = subprocess.call(cmd, env=env, stdout=dl,
+                                 stderr=subprocess.STDOUT,
+                                 cwd=out_dir, timeout=timeout_s)
+            deadlocked = False
+        except subprocess.TimeoutExpired:
+            rc, deadlocked = -1, True
+    wall_s = time.time() - t0
+
+    verdict = evaluate(out_dir, np_=np_, steps=steps,
+                       converge_frac=converge_frac)
+    verdict.update({
+        "rc": rc, "wall_s": round(wall_s, 2),
+        "no_deadlock": not deadlocked and rc == 0,
+        "model": model, "cell": f"{fmt}x{op}x{algo}",
+        "np": np_, "steps": steps, "seed": seed, "out_dir": out_dir,
+    })
+    verdict["ok"] = bool(
+        verdict["no_deadlock"] and verdict["curves_complete"]
+        and verdict["curves_identical"] and verdict["descended"])
+    return verdict
+
+
+def evaluate(out_dir: str, *, np_: int, steps: int,
+             converge_frac: float) -> dict:
+    """Pure log->verdict core (unit-testable on synthetic event logs)."""
+    curves: List[Optional[List[float]]] = [None] * np_
+    for rank in range(np_):
+        path = os.path.join(out_dir, f"events.{rank}.jsonl")
+        if not os.path.exists(path):
+            continue
+        pts = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if e.get("kind") == "loss":
+                    pts[int(e["step"])] = float(e["loss"])
+        if len(pts) == steps + 1:                  # initial + per-step
+            curves[rank] = [pts[i] for i in range(steps + 1)]
+
+    complete = all(c is not None for c in curves)
+    identical = False
+    descended = False
+    max_spread = None
+    if complete:
+        max_spread = max(abs(curves[r][i] - curves[0][i])
+                         for r in range(1, np_)
+                         for i in range(steps + 1)) if np_ > 1 else 0.0
+        identical = max_spread <= CURVE_AGREE_ATOL
+        descended = curves[0][-1] <= converge_frac * curves[0][0]
+    return {"curves_complete": complete, "curves_identical": identical,
+            "descended": descended, "max_curve_spread": max_spread,
+            "curve": curves[0] if complete else None}
+
+
+# --------------------------------------------------------------------------
+# worker side (spawned by the launcher)
+# --------------------------------------------------------------------------
+
+def _worker(out_dir: str) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # one virtual CPU device per process: the worker IS one rank. A
+    # pytest parent exports an 8-device XLA_FLAGS (conftest) which
+    # inherits through the launcher — REPLACE any existing device-count
+    # flag, never defer to it, or each worker fans out to 8 devices and
+    # the leading-dim-1 stacked rows no longer match local_rows.
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=1")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.bench_zoo import build_converge_model
+    from horovod_tpu.converge.matrix import Cell
+    from horovod_tpu.converge.harness import _cell_reduce_args
+
+    hvd.init()
+    rank, n = hvd.rank(), hvd.size()
+    # knob: exempt (harness->converge-worker process contract, not a
+    # runtime knob: run_converge_proc sets these for the one launched
+    # job, like the chaos soak worker's HVD_SOAK_* wiring)
+    model = os.environ["HVD_CONVERGE_MODEL"]
+    # knob: exempt (harness->converge-worker contract, see above)
+    cell = Cell(os.environ["HVD_CONVERGE_FMT"],
+                # knob: exempt (harness->converge-worker contract)
+                os.environ["HVD_CONVERGE_OP"],
+                # knob: exempt (harness->converge-worker contract)
+                os.environ["HVD_CONVERGE_ALGO"])
+    # knob: exempt (harness->converge-worker contract, see above)
+    steps = int(os.environ["HVD_CONVERGE_STEPS"])
+    # knob: exempt (harness->converge-worker contract, see above)
+    lr = float(os.environ["HVD_CONVERGE_LR"])
+    # knob: exempt (harness->converge-worker contract, see above)
+    batch_size = int(os.environ["HVD_CONVERGE_BATCH"])
+    # knob: exempt (harness->converge-worker contract, see above)
+    seed = int(os.environ["HVD_CONVERGE_SEED"])
+
+    loss_fn, params, batch_fn = build_converge_model(
+        model, nranks=n, batch_size=batch_size, seed=seed)
+    op, prescale, compression, algo = _cell_reduce_args(cell, n)
+    grad_fn = jax.jit(jax.grad(loss_fn))
+
+    def eval_loss(p):
+        per = jax.vmap(loss_fn, in_axes=(None, 0))
+        return float((jnp.mean(per(p, batch_fn(0))) +
+                      jnp.mean(per(p, batch_fn(1)))) / 2.0)
+
+    log_path = os.path.join(out_dir, f"events.{rank}.jsonl")
+    p = params
+    with open(log_path, "w") as log:
+        log.write(json.dumps({"kind": "loss", "step": 0,
+                              "loss": eval_loss(p)}) + "\n")
+        log.flush()
+        for step in range(steps):
+            my = jax.tree_util.tree_map(lambda a: a[rank],
+                                        batch_fn(step))
+            g = grad_fn(p, my)
+            leaves, td = jax.tree_util.tree_flatten(g)
+            # stacked convention: this process contributes its local
+            # row [1, ...]; the engine assembles the global array
+            red = hvd.grouped_allreduce(
+                [jnp.asarray(x)[None] for x in leaves], op,
+                prescale_factor=prescale, compression=compression,
+                algo=algo)
+            red = [hvd.local_rows(r)[0] for r in red]
+            g = jax.tree_util.tree_unflatten(td, red)
+            p = jax.tree_util.tree_map(
+                lambda a, d: a - lr * jnp.asarray(d, a.dtype), p, g)
+            log.write(json.dumps({"kind": "loss", "step": step + 1,
+                                  "loss": eval_loss(p)}) + "\n")
+            log.flush()
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--worker":
+        _worker(sys.argv[2])
+    else:
+        print("usage: python -m horovod_tpu.converge.proc --worker OUT",
+              file=sys.stderr)
+        sys.exit(2)

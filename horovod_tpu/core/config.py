@@ -155,6 +155,31 @@ class Config:
     # (HOROVOD_COLLECTIVE_ALGO_THRESHOLD, bytes); 0 uses the analytic
     # alpha-beta crossover (ops/algo.py crossover_bytes).
     collective_algo_threshold_bytes: int = 0
+    # Convergence harness (horovod_tpu/converge): the short-real-
+    # optimization matrix run that gates every wire-format/algorithm
+    # change. Steps per cell (HOROVOD_CONVERGE_STEPS).
+    converge_steps: int = 30
+    # Per-rank batch size (HOROVOD_CONVERGE_BATCH).
+    converge_batch: int = 4
+    # Data/init seed (HOROVOD_CONVERGE_SEED) — the whole run is a pure
+    # function of this seed, so two runs with the same seed must
+    # produce identical curves (the determinism invariant the tests pin).
+    converge_seed: int = 0
+    # SGD learning rate (HOROVOD_CONVERGE_LR). 0 (the default) uses the
+    # per-model calibrated rate from bench_zoo.CONVERGE_LRS — a single
+    # global rate cannot serve both gpt_tiny (needs ~0.2 to clear the
+    # converge gate in 30 steps) and resnet18 (needs <=0.1 to keep the
+    # short-run trajectory out of its chaotic regime, where ulp-level
+    # wire noise amplifies into large final-loss scatter). A positive
+    # value overrides every row (measured in docs/benchmarks.md).
+    converge_lr: float = 0.0
+    # Comma-separated bench_zoo.CONVERGE_MODELS rows the matrix trains
+    # (HOROVOD_CONVERGE_MODELS).
+    converge_models: str = "resnet18,gpt_tiny"
+    # Global multiplier on every per-cell tolerance
+    # (HOROVOD_CONVERGE_TOL_SCALE): >1 loosens a flaky CI box, <1
+    # tightens a nightly sweep; 1.0 is the documented table as-is.
+    converge_tol_scale: float = 1.0
     # Serving (horovod_tpu/serve): continuous-batching inference knobs.
     # Decode slots the executor batches per iteration (the fixed jit
     # batch shape — HOROVOD_SERVE_MAX_BATCH).
@@ -464,6 +489,21 @@ class Config:
         c.collective_algo_threshold_bytes = _env_int_strict(
             "HOROVOD_COLLECTIVE_ALGO_THRESHOLD",
             c.collective_algo_threshold_bytes)
+        # Convergence-harness knobs parse strictly: a typo'd step count
+        # or tolerance scale silently falling back would change what the
+        # matrix gate actually proved.
+        c.converge_steps = _env_int_strict(
+            "HOROVOD_CONVERGE_STEPS", c.converge_steps)
+        c.converge_batch = _env_int_strict(
+            "HOROVOD_CONVERGE_BATCH", c.converge_batch)
+        c.converge_seed = _env_int_strict(
+            "HOROVOD_CONVERGE_SEED", c.converge_seed)
+        c.converge_lr = _env_float_strict(
+            "HOROVOD_CONVERGE_LR", c.converge_lr)
+        c.converge_models = os.environ.get(
+            "HOROVOD_CONVERGE_MODELS", c.converge_models).strip()
+        c.converge_tol_scale = _env_float_strict(
+            "HOROVOD_CONVERGE_TOL_SCALE", c.converge_tol_scale)
         # Serve knobs parse strictly (no silent default fallback): a
         # typo'd shape knob must fail at startup, not surface as a
         # recompile storm mid-traffic.
@@ -668,6 +708,37 @@ class Config:
             raise ValueError(
                 f"HOROVOD_CACHE_CAPACITY must be a non-negative int; got "
                 f"{self.cache_capacity!r}")
+        if not isinstance(self.converge_steps, int) or \
+                not (1 <= self.converge_steps <= 100_000):
+            raise ValueError(
+                f"HOROVOD_CONVERGE_STEPS must be an int in [1, 100000]; "
+                f"got {self.converge_steps!r}")
+        if not isinstance(self.converge_batch, int) or \
+                not (1 <= self.converge_batch <= 4096):
+            raise ValueError(
+                f"HOROVOD_CONVERGE_BATCH must be an int in [1, 4096]; "
+                f"got {self.converge_batch!r}")
+        if not isinstance(self.converge_seed, int) or \
+                self.converge_seed < 0:
+            raise ValueError(
+                f"HOROVOD_CONVERGE_SEED must be a non-negative int; got "
+                f"{self.converge_seed!r}")
+        lr = self.converge_lr
+        if not isinstance(lr, (int, float)) or not (0 <= lr <= 100):
+            raise ValueError(
+                f"HOROVOD_CONVERGE_LR must be a learning rate in "
+                f"[0, 100] (0 = per-model calibrated rate); got {lr!r}")
+        if not isinstance(self.converge_models, str) or \
+                not self.converge_models.strip():
+            raise ValueError(
+                f"HOROVOD_CONVERGE_MODELS must be a non-empty "
+                f"comma-separated list of models/bench_zoo.py "
+                f"CONVERGE_MODELS rows; got {self.converge_models!r}")
+        ts = self.converge_tol_scale
+        if not isinstance(ts, (int, float)) or not (0 < ts <= 100):
+            raise ValueError(
+                f"HOROVOD_CONVERGE_TOL_SCALE must be a tolerance "
+                f"multiplier in (0, 100]; got {ts!r}")
         if not isinstance(self.serve_max_batch, int) or \
                 not (1 <= self.serve_max_batch <= 4096):
             raise ValueError(

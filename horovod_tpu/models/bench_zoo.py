@@ -64,3 +64,131 @@ def build_benchmark_model(
         return apply_fn, variables["params"], {}, False
     raise ValueError(f"unknown benchmark model {name!r}; "
                      f"choose from {BENCH_MODELS}")
+
+
+#: rows the convergence harness (horovod_tpu/converge/) can train.
+#: Deliberately NOT merged into BENCH_MODELS — bench.py keeps a literal
+#: mirror of that tuple for its --help text (tests/test_models.py pins
+#: them equal), and these rows are loss-curve fixtures, not throughput
+#: subjects. gpt_tiny/moe_tiny pre-stage ROADMAP item 2's MoE rows.
+CONVERGE_MODELS = ("resnet18", "gpt_tiny", "moe_tiny")
+
+#: calibrated per-row SGD rates (used when HOROVOD_CONVERGE_LR is 0,
+#: the default). Each rate clears the harness's converge gate (final
+#: <= 0.9 x initial in 30 steps) while staying OUT of the row's
+#: chaotic regime, where trajectory sensitivity amplifies ulp-level
+#: wire noise into large final-loss scatter: resnet18 needs <= 0.1
+#: (at 0.2 its bf16 cells scatter ~13-31% vs fp32), the transformers
+#: need >= 0.2 to descend 10% (measured, docs/benchmarks.md).
+CONVERGE_LRS = {"resnet18": 0.1, "gpt_tiny": 0.2, "moe_tiny": 0.2}
+
+
+def build_converge_model(
+    name: str, *, nranks: int, batch_size: int = 4, seed: int = 0,
+) -> Tuple[Callable, Any, Callable]:
+    """Returns (loss_fn, params, batch_fn) for the convergence harness:
+    `loss_fn(params, batch) -> scalar fp32` for ONE rank's batch,
+    `batch_fn(step) -> batch` stacked [nranks, batch_size, ...] (the
+    harness vmaps the grad over the rank axis). Everything is float32
+    end-to-end and CPU-smoke sized — the harness compares loss CURVES
+    between wire formats, so model-compute rounding must stay far below
+    the wire deltas under test.
+
+    Data is a small fixed pool the model memorizes: two distinct
+    deterministic batches per rank, cycled. Memorizing a fixed pool
+    descends reliably for every optimizer cell, unlike fitting fresh
+    noise (whose Bayes loss is flat)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = jax.random.PRNGKey(seed)
+    pool = 2                                 # distinct batches per rank
+    rows = nranks * batch_size * pool
+
+    if name == "resnet18":
+        from .resnet import ResNet18
+        size, classes = 32, 10
+        # narrow fp32 variant: full ResNet-18 topology, 1/8 width —
+        # the curve fixture needs the architecture, not the 11M params
+        model = ResNet18(num_classes=classes, num_filters=8,
+                         dtype=jnp.float32)
+        variables = model.init(rng, jnp.zeros((1, size, size, 3)),
+                               train=True)
+        params, frozen = variables["params"], variables["batch_stats"]
+        kx, ky = jax.random.split(jax.random.fold_in(rng, 1))
+        images = jax.random.normal(kx, (rows, size, size, 3), jnp.float32)
+        labels = jax.random.randint(ky, (rows,), 0, classes)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            # frozen init stats: differentiable, no per-step mutable
+            # state to thread through the rank-stacked vmap
+            logits = model.apply({"params": p, "batch_stats": frozen},
+                                 x, train=False)
+            return _xent(logits, y, classes)
+
+        return loss_fn, params, _pool_batch_fn((images, labels),
+                                               nranks, batch_size, pool)
+
+    if name in ("gpt_tiny", "moe_tiny"):
+        seq, vocab = 16, 64
+        kx = jax.random.fold_in(rng, 2)
+        tokens = jax.random.randint(kx, (rows, seq), 0, vocab)
+        if name == "gpt_tiny":
+            from .gpt import GPT, GPTConfig
+            cfg = GPTConfig(vocab_size=vocab, num_layers=2, num_heads=2,
+                            head_dim=8, mlp_ratio=2, max_seq_len=seq,
+                            dtype=jnp.float32)
+            model = GPT(cfg)
+            params = model.init(rng, tokens[:1])["params"]
+
+            def loss_fn(p, batch):
+                logits = model.apply({"params": p}, batch)
+                return _xent(logits[:, :-1], batch[:, 1:], vocab)
+        else:
+            from .moe import MoEGPT, MoEGPTConfig, moe_aux_loss
+            cfg = MoEGPTConfig(vocab_size=vocab, num_layers=2,
+                               num_heads=2, head_dim=8, mlp_ratio=2,
+                               max_seq_len=seq, num_experts=4,
+                               dtype=jnp.float32)
+            model = MoEGPT(cfg)
+            params = model.init(rng, tokens[:1])["params"]
+
+            def loss_fn(p, batch):
+                logits, mut = model.apply({"params": p}, batch,
+                                          mutable=["intermediates"])
+                ce = _xent(logits[:, :-1], batch[:, 1:], vocab)
+                return ce + 0.01 * moe_aux_loss(mut["intermediates"])
+
+        return loss_fn, params, _pool_batch_fn(tokens, nranks,
+                                               batch_size, pool)
+
+    raise ValueError(f"unknown converge model {name!r}; "
+                     f"choose from {CONVERGE_MODELS}")
+
+
+def _xent(logits, labels, num_classes):
+    import jax
+    import jax.numpy as jnp
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def _pool_batch_fn(data, nranks, batch_size, pool):
+    """batch_fn over a fixed pool shaped [nranks*batch_size*pool, ...]:
+    step t serves pool slot t % pool, reshaped [nranks, batch_size, ...]
+    so every rank sees its own fixed shard — deterministic in (seed,
+    step), independent of how many steps the caller runs."""
+    import jax
+    import jax.numpy as jnp
+
+    def reshard(a):
+        return a.reshape((pool, nranks, batch_size) + a.shape[1:])
+
+    pooled = jax.tree_util.tree_map(reshard, data)
+
+    def batch_fn(step):
+        return jax.tree_util.tree_map(lambda a: a[step % pool], pooled)
+
+    return batch_fn

@@ -23,21 +23,60 @@ across the LOCAL mesh axis, Adasum recursive-doubling across the CROSS
 axis on each rank's chunk, allgather back across LOCAL. Chunk
 coefficients are per-chunk, like the reference's per-rank fused segments
 (adasum_gpu_operations.cc:224 notes the same approximation).
+
+Quantized transport (`wire="bf16"|"int8"`): only the ppermute payload is
+compressed — the EQuARX discipline (arxiv 2506.17615): compress the
+transport, never the math. At every tree level BOTH partners combine the
+same dequantized pair: rank i locally round-trips its own value through
+the wire format (vhat_i) and receives the partner's round-tripped value
+(vhat_j), so combine(vhat_i, vhat_j) is evaluated on the same pair on
+both sides (the formula is symmetric) and all ranks still converge to
+the same value — up to ulp-level rounding from the compiled combine's
+multiply-add order, exactly like the uncompressed tree — with no
+broadcast. The dot/normsq projection runs on the
+dequantized fp32 values, so Adasum's scale-invariance sees one coherent
+vector per rank — the property the PR 1 rejection protected (summing
+per-rank int8 scales is meaningless; round-tripping per rank is exact
+bookkeeping). Int8 additionally carries per-HOP error-feedback residuals
+(keyed like the engine's `_ef_residuals`, ops/engine.py): what level l's
+quantizer dropped this step is re-injected at level l next step, so the
+quantization noise is unbiased over time exactly like the Sum path's EF.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from collections import OrderedDict
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..core import basics
 from ..core.mesh import CROSS_AXIS, GLOBAL_AXIS, LOCAL_AXIS
 from ..core.process_sets import ProcessSet
+from ..optim.compression import block_dequantize, block_quantize
+
+#: structured rejection messages, single-sourced so the sync path
+#: (ops/collective_ops.py) and the engine route (ops/engine.py) raise
+#: the SAME error with the supported alternative named — tests assert
+#: the two paths match verbatim (docs/benchmarks.md rejection matrix)
+ADASUM_JOIN_ERROR = (
+    "allreduce(Adasum) is not supported with Join: a joined rank's "
+    "zero-filled contribution has zero norm, which corrupts the "
+    "scale-sensitive dot/normsq projection; use op=Average (joined "
+    "ranks are masked exactly) or keep every rank contributing")
+ADASUM_REDUCESCATTER_ERROR = (
+    "reducescatter(op=Adasum) is not supported: the Adasum combine "
+    "needs every rank's full vector for its dot/normsq projection, so "
+    "it has no scatter form; use allreduce(op=Adasum) and slice, or "
+    "reducescatter(op=Average)")
+
+#: wire formats the Adasum transport implements ("none" = exact)
+ADASUM_WIRE_FORMATS = ("none", "bf16", "int8")
 
 
 def _is_power_of_two(n: int) -> bool:
@@ -71,6 +110,55 @@ def _xor_tree(v: jax.Array, axis: str, n: int) -> jax.Array:
     return v
 
 
+def _xor_tree_bf16(v: jax.Array, axis: str, n: int) -> jax.Array:
+    """`_xor_tree` with bf16 ppermute payloads. Each level combines the
+    pair (bf16(v_i), bf16(v_j)) — i's own value round-tripped locally, so
+    both partners evaluate the symmetric combine on the same pair and
+    stay identical to ulp precision, like the exact tree. No residual: bf16 keeps fp32's exponent, the rounding
+    noise is relative and needs no feedback (the engine's bf16 fused wire
+    makes the same call)."""
+    lvl = 1
+    while lvl < n:
+        perm = [(i, i ^ lvl) for i in range(n)]
+        mine = v.astype(jnp.bfloat16)
+        u = lax.ppermute(mine, axis, perm=perm).astype(jnp.float32)
+        v = adasum_combine(mine.astype(jnp.float32), u)
+        lvl *= 2
+    return v
+
+
+def _xor_tree_int8(v: jax.Array, res: jax.Array, axis: str, n: int,
+                   block_size: int) -> Tuple[jax.Array, jax.Array]:
+    """`_xor_tree` with int8 block-scaled ppermute payloads and per-hop
+    error feedback. `v` is the flat fp32 vector, `res` the [hops, len]
+    residual carried from the previous call with the same key.
+
+    Per level l: fold in res[l], quantize, keep what the quantizer
+    dropped as the NEW res[l] (per-hop keying — each level quantizes a
+    different value, so a shared residual would feed level-0 noise into
+    level-1's combine), exchange int8+scales, and combine the two
+    DEQUANTIZED values. Dequantization is deterministic, so rank i's
+    local vhat_i is bit-equal to what its partner reconstructs — the
+    symmetric combine keeps every rank identical to ulp precision, same
+    as the exact tree."""
+    m = v.shape[0]
+    lvl, hop = 1, 0
+    new_res = []
+    while lvl < n:
+        perm = [(i, i ^ lvl) for i in range(n)]
+        acc = v + res[hop]
+        q, s = block_quantize(acc, block_size)
+        vhat = block_dequantize(q, s, m)
+        new_res.append(acc - vhat)
+        qu = lax.ppermute(q, axis, perm=perm)
+        su = lax.ppermute(s, axis, perm=perm)
+        u = block_dequantize(qu, su, m)
+        v = adasum_combine(vhat, u)
+        lvl *= 2
+        hop += 1
+    return v, jnp.stack(new_res)
+
+
 @functools.lru_cache(maxsize=256)
 def _adasum_flat_fn(mesh: Mesh):
     n = mesh.devices.size
@@ -87,43 +175,160 @@ def _adasum_flat_fn(mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=256)
-def _adasum_hier_fn(mesh: Mesh):
-    """Two-level Adasum over a (cross, local) mesh
-    (adasum_gpu_operations.cc:135-138: NCCL ReduceScatter — parallelized
-    MPI Adasum — NCCL Allgather). The flat element count is padded to a
-    local-size multiple like the reference's FUSION_BUFFER_ATOMIC_UNIT
-    padding (adasum_gpu_operations.cc:118-123)."""
-    cross_n, local_n = mesh.devices.shape
+def _adasum_flat_bf16_fn(mesh: Mesh):
+    n = mesh.devices.size
 
     def blk(x):                                   # [1, ...] per-device row
         dt = x.dtype
         v = x[0].astype(jnp.float32)
         shape = v.shape
+        out = _xor_tree_bf16(v.reshape(-1), GLOBAL_AXIS, n)
+        return out.reshape(shape)[None].astype(dt)
+
+    f = shard_map(blk, mesh=mesh, in_specs=P(GLOBAL_AXIS),
+                  out_specs=P(GLOBAL_AXIS))
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=256)
+def _adasum_flat_int8_fn(mesh: Mesh, block_size: int):
+    n = mesh.devices.size
+
+    def blk(x, res):            # x: [1, ...] row, res: [1, hops, len]
+        dt = x.dtype
+        v = x[0].astype(jnp.float32)
+        shape = v.shape
+        out, nr = _xor_tree_int8(v.reshape(-1), res[0], GLOBAL_AXIS, n,
+                                 block_size)
+        return out.reshape(shape)[None].astype(dt), nr[None]
+
+    f = shard_map(blk, mesh=mesh,
+                  in_specs=(P(GLOBAL_AXIS), P(GLOBAL_AXIS)),
+                  out_specs=(P(GLOBAL_AXIS), P(GLOBAL_AXIS)))
+    return jax.jit(f)
+
+
+def _hier_pad_chunk(m: int, local_n: int) -> Tuple[int, int]:
+    """(pad, chunk_len) of the hier path's per-rank scatter chunk."""
+    pad = (-m) % local_n
+    return pad, (m + pad) // local_n
+
+
+@functools.lru_cache(maxsize=256)
+def _adasum_hier_fn(mesh: Mesh, wire: str = "none", block_size: int = 128):
+    """Two-level Adasum over a (cross, local) mesh
+    (adasum_gpu_operations.cc:135-138: NCCL ReduceScatter — parallelized
+    MPI Adasum — NCCL Allgather). The flat element count is padded to a
+    local-size multiple like the reference's FUSION_BUFFER_ATOMIC_UNIT
+    padding (adasum_gpu_operations.cc:118-123).
+
+    `wire` compresses ONLY the cross-axis XOR tree — the DCN analog, the
+    hop HOROVOD_COMPRESSION_DCN_ONLY exists for; the local (ICI)
+    reduce-scatter/allgather stays exact. Int8 takes and returns the
+    per-hop EF residual on the scatter chunk."""
+    cross_n, local_n = mesh.devices.shape
+    ef = wire == "int8" and cross_n > 1
+
+    def blk(x, res=None):                         # [1, ...] per-device row
+        dt = x.dtype
+        v = x[0].astype(jnp.float32)
+        shape = v.shape
         flat = v.reshape(-1)
         m = flat.shape[0]
-        pad = (-m) % local_n
+        pad, _ = _hier_pad_chunk(m, local_n)
         if pad:
             flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
         # phase 1: sum-reduce-scatter within the local (ICI) group
         chunk = lax.psum_scatter(flat, LOCAL_AXIS, scatter_dimension=0,
                                  tiled=True)
         # phase 2: Adasum across nodes on this rank's chunk
-        chunk = _xor_tree(chunk, CROSS_AXIS, cross_n)
+        nr = None
+        if ef:
+            chunk, nr = _xor_tree_int8(chunk, res[0], CROSS_AXIS, cross_n,
+                                       block_size)
+        elif wire == "bf16" and cross_n > 1:
+            chunk = _xor_tree_bf16(chunk, CROSS_AXIS, cross_n)
+        else:
+            chunk = _xor_tree(chunk, CROSS_AXIS, cross_n)
         # phase 3: allgather back within the local group
         full = lax.all_gather(chunk, LOCAL_AXIS, tiled=True)
         if pad:
             full = full[:m]
-        return full.reshape(shape)[None].astype(dt)
+        out = full.reshape(shape)[None].astype(dt)
+        return (out, nr[None]) if ef else out
 
-    f = shard_map(blk, mesh=mesh, in_specs=P((CROSS_AXIS, LOCAL_AXIS)),
-                  out_specs=P((CROSS_AXIS, LOCAL_AXIS)))
+    spec = P((CROSS_AXIS, LOCAL_AXIS))
+    f = shard_map(blk, mesh=mesh,
+                  in_specs=(spec, spec) if ef else spec,
+                  out_specs=(spec, spec) if ef else spec)
     return jax.jit(f)
+
+
+# -- per-hop error-feedback residual store ---------------------------------
+# Keyed like the engine's `_ef_residuals` (ops/engine.py): the caller's
+# scope (the engine passes its fusion signature + group position, which
+# already folds in op/dtype/process-set/pre-post-scale/wire/algo), plus
+# everything that changes the exchange pattern or payload layout here —
+# topology (flat vs hier chunking AND the set size: a different tree depth
+# is a different exchange pattern), wire format, block size, shape, dtype.
+# A tuner flipping algorithm or wire mid-run therefore lands on a FRESH
+# key and can never fold another exchange pattern's stale residual into
+# its combine. Byte-budgeted LRU like the engine's `_ef_budget_bytes`.
+_EF_BUDGET_BYTES = 64 << 20
+_ef_store: "OrderedDict[tuple, jax.Array]" = OrderedDict()
+
+
+def _ef_store_key(ef_key, ps: ProcessSet, topo: tuple, wire: str,
+                  block_size: int, shape, dtype) -> tuple:
+    return (ef_key, ps.process_set_id, ps.mesh, topo, wire,
+            int(block_size), tuple(int(s) for s in shape), str(dtype))
+
+
+def _ef_get(key: tuple, shape: Tuple[int, ...]) -> jax.Array:
+    r = _ef_store.get(key)
+    if r is None or tuple(r.shape) != tuple(shape):
+        r = jnp.zeros(shape, jnp.float32)
+    return r
+
+
+def _place_residual(res: jax.Array, sharding) -> jax.Array:
+    """Row-shard a residual for its tree program. Steady state the
+    stored residual IS the previous call's sharded output (pass
+    through); the first call's host zeros need multi-process-safe
+    placement (device_put cannot target non-addressable devices)."""
+    if isinstance(res, jax.Array) and not res.is_fully_addressable:
+        return res
+    from ..core.mesh import place_sharded
+    return place_sharded(np.asarray(res), sharding)
+
+
+def _ef_put(key: tuple, value: jax.Array) -> None:
+    _ef_store[key] = value
+    _ef_store.move_to_end(key)
+    total = sum(4 * v.size for v in _ef_store.values())
+    while len(_ef_store) > 1 and total > _EF_BUDGET_BYTES:
+        _, dropped = _ef_store.popitem(last=False)
+        total -= 4 * dropped.size
+
+
+def ef_residual_keys() -> Tuple[tuple, ...]:
+    """Current residual-store keys (test/introspection surface)."""
+    return tuple(_ef_store.keys())
+
+
+def reset_error_feedback() -> None:
+    """Drop all carried residuals (a fresh run must not inherit another
+    run's quantization noise; tests call this between cases)."""
+    _ef_store.clear()
 
 
 def adasum_allreduce(x: jax.Array, *,
                      process_set: Optional[ProcessSet] = None,
                      hierarchical: Optional[bool] = None,
-                     local_size: Optional[int] = None) -> jax.Array:
+                     local_size: Optional[int] = None,
+                     wire: str = "none",
+                     block_size: int = 128,
+                     ef_key=None) -> jax.Array:
     """Adasum reduction over the stacked rank axis; all ranks get the result.
 
     Matches hvd.allreduce(op=hvd.Adasum). Requires a power-of-two set size
@@ -133,9 +338,26 @@ def adasum_allreduce(x: jax.Array, *,
     reduce-scatter, cross-node Adasum, local allgather. `local_size`
     overrides the hier topology's local-group width (default: the
     launcher/host-derived hier mesh from init()).
+
+    `wire` compresses the exchange transport ("bf16" | "int8"; "none" is
+    exact): flat mode every tree hop, hierarchical mode only the cross
+    tree (the local ICI phases stay exact — the DCN-only discipline).
+    Int8 carries per-hop error-feedback residuals under `ef_key` (the
+    engine passes its bucket signature; None derives a key from the
+    call's shape/dtype/set/topology — fine for the steady-state
+    same-tensor-every-step pattern, see `_ef_store_key`).
     """
+    if wire not in ADASUM_WIRE_FORMATS:
+        raise ValueError(
+            f"adasum wire must be one of {ADASUM_WIRE_FORMATS}; got "
+            f"{wire!r}")
     ps = basics.get_process_set(process_set)
     n = ps.size()
+    if wire != "none" and not jnp.issubdtype(
+            jnp.asarray(x).dtype, jnp.floating):
+        raise ValueError(
+            f"adasum wire {wire!r} applies to float tensors only; got "
+            f"dtype {jnp.asarray(x).dtype} (pass wire='none')")
     if hierarchical is None:
         hierarchical = basics.get_config().adasum_hierarchical and \
             ps.process_set_id == 0
@@ -166,12 +388,24 @@ def adasum_allreduce(x: jax.Array, *,
         if n == 1:
             return x
         if local_n == 1:          # degenerate: no local group -> flat tree
-            return _adasum_flat_fn(ps.mesh)(x)
+            return _flat_dispatch(x, ps, n, wire, block_size, ef_key)
         from ..core.mesh import stacked_sharding
         xh = jax.device_put(x, stacked_sharding(hier, (CROSS_AXIS,
                                                        LOCAL_AXIS))) \
             if x.is_fully_addressable else x
-        out = _adasum_hier_fn(hier)(xh)
+        if wire == "int8" and cross_n > 1:
+            m = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+            _, chunk = _hier_pad_chunk(m, local_n)
+            hops = cross_n.bit_length() - 1
+            key = _ef_store_key(ef_key, ps, ("hier", cross_n, local_n),
+                                wire, block_size, x.shape, x.dtype)
+            res = _ef_get(key, (n, hops, chunk))
+            resh = _place_residual(
+                res, NamedSharding(hier, P((CROSS_AXIS, LOCAL_AXIS))))
+            out, new_res = _adasum_hier_fn(hier, wire, block_size)(xh, resh)
+            _ef_put(key, new_res)
+        else:
+            out = _adasum_hier_fn(hier, wire, block_size)(xh)
         return jax.device_put(out, stacked_sharding(ps.mesh)) \
             if out.is_fully_addressable else out
     if not _is_power_of_two(n):
@@ -180,4 +414,21 @@ def adasum_allreduce(x: jax.Array, *,
     x = _place_stacked(x, ps.mesh, n, "adasum")
     if n == 1:
         return x
+    return _flat_dispatch(x, ps, n, wire, block_size, ef_key)
+
+
+def _flat_dispatch(x: jax.Array, ps: ProcessSet, n: int, wire: str,
+                   block_size: int, ef_key) -> jax.Array:
+    if wire == "bf16":
+        return _adasum_flat_bf16_fn(ps.mesh)(x)
+    if wire == "int8":
+        m = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+        hops = n.bit_length() - 1
+        key = _ef_store_key(ef_key, ps, ("flat", n), wire, block_size,
+                            x.shape, x.dtype)
+        res = _ef_get(key, (n, hops, m))
+        res = _place_residual(res, NamedSharding(ps.mesh, P(GLOBAL_AXIS)))
+        out, new_res = _adasum_flat_int8_fn(ps.mesh, block_size)(x, res)
+        _ef_put(key, new_res)
+        return out
     return _adasum_flat_fn(ps.mesh)(x)

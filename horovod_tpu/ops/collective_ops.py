@@ -434,7 +434,8 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
               postscale_factor: float = 1.0,
               name: Optional[str] = None,
               wire: Optional[str] = None,
-              algo: Optional[str] = None) -> Array:
+              algo: Optional[str] = None,
+              ef_key=None) -> Array:
     """Reduce row-wise across ranks; every rank receives the result.
 
     reference semantics: hvd.allreduce (horovod/torch/mpi_ops.py:157;
@@ -452,6 +453,11 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     model. Resolution happens HERE, at execution time, so a tuner flip
     mid-flight can never make two ranks run different algorithms for
     the same bucket (the PR 1 wire-format discipline).
+
+    `ef_key` scopes the Adasum transport's error-feedback residuals
+    (ops/adasum.py): the engine passes its bucket signature + group
+    position so concurrent Adasum tensors never share residual state;
+    direct callers can leave it None (shape/dtype/topology-derived key).
     """
     ps, mesh, n = _resolve(process_set)
     routed = _engine_route("allreduce", x, op=op, name=name, process_set=ps,
@@ -461,19 +467,36 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
         return routed
     if op == ReduceOp.ADASUM:
         if basics.get_state().joined_ranks:
-            # same guard the engine negotiation applies: zero-filled
-            # contributions would corrupt the scale-sensitive combine
+            # same guard (and same single-sourced message) the engine
+            # negotiation applies on the multi-process route
+            from .adasum import ADASUM_JOIN_ERROR
+            raise ValueError(ADASUM_JOIN_ERROR)
+        if algo:
             raise ValueError(
-                "allreduce(Adasum) is not supported with Join "
-                "(zero-filled contributions)")
+                f"allreduce(algo={algo!r}) applies to Sum/Average only "
+                f"(op {op.name} has a single schedule); omit algo")
         from .adasum import adasum_allreduce
+        cfg = basics.get_config()
+        # quantized TRANSPORT (ops/adasum.py): follow the engine-passed
+        # wire when explicit, else HOROVOD_COMPRESSION. DCN-only mode
+        # compresses nothing on the flat tree (every hop is the same
+        # link class) — the hierarchical variant's cross tree is the DCN
+        # hop and stays compressed either way.
+        hop = cfg.compression if wire is None else wire
+        if not _is_float(jnp.asarray(x).dtype):
+            hop = "none"
+        hier = cfg.adasum_hierarchical and ps.process_set_id == 0
+        if wire is None and cfg.compression_dcn_only and not hier:
+            hop = "none"
         # pre/postscale around the scale-invariant combine, like the
         # reference's ScaleBuffer before/after NcclHierarchical
         # (adasum_gpu_operations.cc:104)
         if prescale_factor != 1.0:
             x = _place_stacked(x, mesh, n, "allreduce")
             x = x * jnp.asarray(prescale_factor, x.dtype)
-        r = adasum_allreduce(x, process_set=ps)
+        r = adasum_allreduce(x, process_set=ps, wire=hop,
+                             block_size=cfg.compression_block_size,
+                             ef_key=ef_key)
         if postscale_factor != 1.0:
             r = r * jnp.asarray(postscale_factor, jnp.float32).astype(r.dtype)
         return r
@@ -495,7 +518,7 @@ def allreduce(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
         if algo:
             raise ValueError(
                 f"allreduce(algo={algo!r}) applies to Sum/Average only "
-                f"(op {op} has a single schedule); omit algo")
+                f"(op {op.name} has a single schedule); omit algo")
     else:
         from ..core.mesh import mesh_is_multiprocess
         nbytes = (x.size // max(n, 1)) * x.dtype.itemsize
@@ -1060,7 +1083,8 @@ def reducescatter(x: Array, op: ReduceOp = ReduceOp.AVERAGE, *,
     ps, mesh, n = _resolve(process_set)
     _reject_joined("Reducescatter")
     if op == ReduceOp.ADASUM:
-        raise ValueError("Adasum reducescatter is not supported")
+        from .adasum import ADASUM_REDUCESCATTER_ERROR
+        raise ValueError(ADASUM_REDUCESCATTER_ERROR)
     routed = _engine_route("reducescatter", x, op=op, name=name,
                            process_set=ps)
     if routed is not None:

@@ -56,6 +56,7 @@ from ..core.types import DuplicateNameError, ReduceOp, RequestType, Status
 from ..obs import metrics as obs_metrics
 from ..optim.compression import (block_dequantize, block_quantize,
                                  wire_bytes, wire_format_of)
+from . import adasum as adasum_mod
 from . import collective_ops
 
 logger = logging.getLogger("horovod_tpu")
@@ -1030,10 +1031,14 @@ class Engine:
                     w.request_type != RequestType.ALLREDUCE:
                 errors.append((w, f"{w.request_type.value} is not supported "
                                   "with Join at this time."))
+            elif joined_members and w.op == ReduceOp.ADASUM:
+                # single-sourced with the sync path's guard so both
+                # routes raise the identical structured message
+                errors.append((w, adasum_mod.ADASUM_JOIN_ERROR))
             elif joined_members and w.op not in (ReduceOp.SUM,
                                                  ReduceOp.AVERAGE):
-                # zero-fill would corrupt min/max/product/Adasum (same
-                # guard as the single-controller path)
+                # zero-fill would corrupt min/max/product (same guard
+                # as the single-controller path)
                 errors.append((w, f"allreduce({w.op}) is not supported "
                                   "with Join (zero-filled contributions)"))
             elif m0.get("rag"):
@@ -1243,7 +1248,12 @@ class Engine:
                     results = [self._execute_single(bucket[0])]
                 elif len(bucket) == 1:
                     w = bucket[0]
-                    if self._bucket_wire(bucket) != "none":
+                    if w.op == ReduceOp.ADASUM:
+                        # Adasum transport (quantized or exact) lives in
+                        # ops/adasum.py — never the gather-based fused
+                        # wire path (per-rank scales cannot be summed)
+                        results = [self._execute_single(w)]
+                    elif self._bucket_wire(bucket) != "none":
                         # compressed wire: singletons ride the same
                         # quantizing pack/unpack programs as fused buckets
                         results = self._execute_fused_allreduce(bucket)
@@ -1295,7 +1305,9 @@ class Engine:
                 for i, r in zip(idxs, outs):
                     results[i] = r
         for i in singles:
-            results[i] = self._execute_single(bucket[i])
+            # group position scopes Adasum EF residuals: two same-shape
+            # Adasum members of one group must never share a residual
+            results[i] = self._execute_single(bucket[i], group_pos=i)
         # materialize before declaring success: an async XLA failure after
         # partial resolution would break atomicity (tree-flattened: ragged
         # reducescatter members return LISTS of arrays)
@@ -1307,14 +1319,16 @@ class Engine:
 
     def _wire_eligible(self, bucket: List[_Work]) -> str:
         """Requested wire format after eligibility checks: only float
-        allreduce Sum/Average compresses; joined ranks force the exact
+        allreduce Sum/Average/Adasum compresses (Adasum rides its own
+        transport, `_adasum_wire`); joined ranks force the exact
         zero-fill path; a per-call wire ("" = unspecified) falls back to
         the round-synchronized config default."""
         w0 = bucket[0]
         wire = w0.wire or self._state.config.compression
         if wire == "none" or \
                 w0.request_type != RequestType.ALLREDUCE or \
-                w0.op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+                w0.op not in (ReduceOp.SUM, ReduceOp.AVERAGE,
+                              ReduceOp.ADASUM):
             return "none"
         if getattr(self._state, "joined_ranks", None):
             return "none"
@@ -1347,6 +1361,38 @@ class Engine:
         if self._state.config.compression_dcn_only:
             return self._wire_eligible(bucket)
         return "none"
+
+    def _adasum_wire(self, w: _Work) -> str:
+        """Wire format for an Adasum single's transport — the quantized
+        XOR tree in ops/adasum.py, NOT the gather-based fused path (an
+        Adasum payload must never reach `_execute_fused_allreduce`:
+        summing its per-rank scales is exactly what PR 1 rejected).
+        DCN-only mode compresses nothing unless the hierarchical variant
+        will run, whose cross tree IS the DCN hop. Every input is
+        round-synchronized config or work meta, so all ranks route
+        identically."""
+        wire = self._wire_eligible([w])
+        if wire == "none":
+            return "none"
+        cfg = self._state.config
+        if cfg.compression_dcn_only and not (
+                cfg.adasum_hierarchical and
+                w.process_set.process_set_id == 0):
+            return "none"
+        return wire
+
+    def _account_adasum_wire(self, w: _Work, wire: str) -> None:
+        """Adasum transport accounting, same one-traversal convention as
+        the Sum paths: logical = the stacked payload in its own dtype,
+        actual = that payload in `wire` format (the hierarchical
+        variant's exact local phases ride the convention unchanged)."""
+        t = jnp.asarray(w.tensor)
+        n = w.process_set.size()
+        cols = t.size // max(n, 1)
+        bs = self._state.config.compression_block_size
+        self._m_wire["logical"].inc(t.size * t.dtype.itemsize)
+        self._m_wire["actual"].inc(
+            n * wire_bytes(cols, wire, bs, t.dtype.itemsize))
 
     def _account_wire_plain(self, w: _Work) -> None:
         """Uncompressed transport: wire bytes == logical bytes."""
@@ -1432,7 +1478,22 @@ class Engine:
             return t.shape[1] % n == 0
         return True
 
-    def _execute_single(self, w: _Work):
+    def _execute_single(self, w: _Work, group_pos: int = 0):
+        if w.request_type == RequestType.ALLREDUCE and \
+                w.op == ReduceOp.ADASUM:
+            # quantized (or exact) Adasum transport, ops/adasum.py. The
+            # EF scope is the bucket signature (op/dtype/set/scales/
+            # wire/algo — `_fusion_key`) plus the member's position in
+            # its group: names auto-increment per call, but steady-state
+            # training re-enqueues the same tensors in the same group
+            # order, so (signature, position) is the stable identity —
+            # the same rationale as `_quantized_fused_allreduce`'s sig.
+            aw = self._adasum_wire(w)
+            self._account_adasum_wire(w, aw)
+            return collective_ops.allreduce(
+                w.tensor, w.op, process_set=w.process_set,
+                prescale_factor=w.prescale, postscale_factor=w.postscale,
+                wire=aw, ef_key=(_fusion_key(w), group_pos))
         if self._single_quant_eligible(w):
             # wire accounting + algo note happen inside the quantized
             # ops (they know whether DCN-only rerouted or fell back)
@@ -1687,6 +1748,22 @@ def _resolve_algo(algo) -> str:
     return a
 
 
+def _check_allreduce_request(op: ReduceOp, algo, a: str, wire: str) -> None:
+    """Enqueue-time fail-fast for structurally impossible (op, algo,
+    wire) combinations — rejected cells of the convergence matrix
+    (docs/benchmarks.md) must raise HERE, never silently fall back."""
+    if a and op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"allreduce(algo={algo!r}) applies to Sum/Average only "
+            f"(op {op.name} has a single schedule); omit algo")
+    if a and wire == "int8":
+        raise ValueError(
+            f"allreduce(algo={algo!r}, compression='int8') conflict: the "
+            f"int8 wire is gather-based with no schedule choice — pick "
+            f"one (a config-driven int8 default is opted out "
+            f"automatically when algo is explicit)")
+
+
 def allreduce_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
                     name: Optional[str] = None, *,
                     process_set: Optional[ProcessSet] = None,
@@ -1696,17 +1773,8 @@ def allreduce_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
     ps = basics.get_process_set(process_set)
     name = name or _auto_name("allreduce")
     a = _resolve_algo(algo)
-    if a and op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
-        raise ValueError(
-            f"allreduce(algo={algo!r}) applies to Sum/Average only "
-            f"(op {op} has a single schedule); omit algo")
     wire = _resolve_wire(compression)
-    if a and wire == "int8":
-        raise ValueError(
-            f"allreduce(algo={algo!r}, compression='int8') conflict: the "
-            f"int8 wire is gather-based with no schedule choice — pick "
-            f"one (a config-driven int8 default is opted out "
-            f"automatically when algo is explicit)")
+    _check_allreduce_request(op, algo, a, wire)
     w = _Work(RequestType.ALLREDUCE, name, tensor, op, ps,
               Handle(name), prescale=prescale_factor,
               postscale=postscale_factor, wire=wire,
@@ -1756,6 +1824,11 @@ def reducescatter_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
                         compression=None) -> Handle:
     ps = basics.get_process_set(process_set)
     name = name or _auto_name("reducescatter")
+    if op == ReduceOp.ADASUM:
+        # same single-sourced structured error as the sync path
+        # (ops/collective_ops.py reducescatter): fail at enqueue, not
+        # cycles later inside the dispatch thread
+        raise ValueError(adasum_mod.ADASUM_REDUCESCATTER_ERROR)
     w = _Work(RequestType.REDUCESCATTER, name, tensor, op, ps, Handle(name),
               wire=_resolve_transport_wire(compression,
                                            "reducescatter_async"))
@@ -1784,13 +1857,19 @@ def grouped_allreduce_async(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
                             process_set: Optional[ProcessSet] = None,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0,
-                            compression=None) -> List[Handle]:
+                            compression=None, algo=None) -> List[Handle]:
+    """`algo` forces one transport schedule for every member (same
+    vocabulary and fail-fast rules as `allreduce_async`); the
+    convergence harness drives its per-cell (wire, op, algo) matrix
+    through this surface."""
     ps = basics.get_process_set(process_set)
     base = name or _auto_name("grouped_allreduce")
+    a = _resolve_algo(algo)
     wire = _resolve_wire(compression)
+    _check_allreduce_request(op, algo, a, wire)
     works = [_Work(RequestType.ALLREDUCE, f"{base}.{i}", t, op, ps,
                    Handle(f"{base}.{i}"), prescale=prescale_factor,
-                   postscale=postscale_factor, wire=wire)
+                   postscale=postscale_factor, wire=wire, algo=a)
              for i, t in enumerate(tensors)]
     return _engine().enqueue_group(works)
 
@@ -1800,11 +1879,11 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = ReduceOp.AVERAGE,
                       process_set: Optional[ProcessSet] = None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
-                      compression=None) -> List:
+                      compression=None, algo=None) -> List:
     hs = grouped_allreduce_async(tensors, op, name, process_set=process_set,
                                  prescale_factor=prescale_factor,
                                  postscale_factor=postscale_factor,
-                                 compression=compression)
+                                 compression=compression, algo=algo)
     return [h.wait() for h in hs]
 
 
@@ -1832,6 +1911,8 @@ def grouped_reducescatter_async(tensors: Sequence,
                                 ) -> List[Handle]:
     ps = basics.get_process_set(process_set)
     base = name or _auto_name("grouped_reducescatter")
+    if op == ReduceOp.ADASUM:
+        raise ValueError(adasum_mod.ADASUM_REDUCESCATTER_ERROR)
     works = [_Work(RequestType.REDUCESCATTER, f"{base}.{i}", t, op, ps,
                    Handle(f"{base}.{i}"))
              for i, t in enumerate(tensors)]

@@ -49,11 +49,18 @@ def _validate_reduce_knobs(op: ReduceOp, gradient_predivide_factor: float,
         raise ValueError("Adasum is not supported in in-graph mode yet; "
                          "use the stacked eager mode")
     if getattr(compression, "fused_wire", "") == "int8" and \
-            op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            op not in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        # Adasum graduated off this list: its transport round-trips each
+        # rank's payload through the int8 wire with per-hop error
+        # feedback and runs the projection on dequantized fp32
+        # (ops/adasum.py), so no cross-rank scale mixing ever happens.
+        # Min/max/product stay rejected — there is no transport/math
+        # split to exploit (the extremum IS the payload).
         raise ValueError(
-            "Compression.int8 requires op=Sum or op=Average: the block-"
-            "quantized payload carries per-rank scales, so scale-sensitive "
-            "reductions (Adasum, min/max/product) cannot combine it")
+            "Compression.int8 requires op=Sum, op=Average or op=Adasum: "
+            "the block-quantized payload carries per-rank scales, so "
+            "scale-sensitive reductions (min/max/product) cannot "
+            "combine it")
 
 
 class _AggState(NamedTuple):
@@ -123,16 +130,16 @@ def _reduce_tree_eager(grads, op, process_set, prescale, postscale,
     # (the ones fusion exists for) get the wire win too, and int8 gets
     # persistent error feedback keyed by the bucket signature.
     wire = getattr(compression, "fused_wire", "") \
-        if op in (ReduceOp.SUM, ReduceOp.AVERAGE) else ""
+        if op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM) else ""
     if wire:
         comp = [(g, None) for g in send]
         tensors = send
         eng_comp = wire
     elif getattr(compression, "fused_wire", "") == "int8":
-        # int8 block-quant is Sum/Average-only (per-rank scales make other
-        # reductions meaningless); the constructor rejects the combo, but
-        # a direct caller gets exact transport instead of scale-mixed
-        # garbage
+        # int8 block-quant is Sum/Average/Adasum-only (per-rank scales
+        # make min/max/product meaningless); the constructor rejects the
+        # combo, but a direct caller gets exact transport instead of
+        # scale-mixed garbage
         comp = [(g, None) for g in send]
         tensors = send
         eng_comp = "none"

@@ -1,0 +1,205 @@
+"""Adasum quantized transport (ISSUE 20 tentpole): the PR 1
+`int8 + Adasum` rejection lifted by compressing only the recursive-
+doubling exchange (dequantize before the dot/normsq projection), with
+per-hop error-feedback residuals keyed like the engine's
+`_ef_residuals`.
+
+Covers: rank agreement (every rank converges to the same tree value —
+allclose; the pre-existing exact tree is itself only ulp-identical
+across ranks), round-trip accuracy vs the exact tree for bf16/int8 on
+both the flat and hierarchical topologies, the EF toy-SGD bar (int8
+Adasum final loss within 2% of fp32 Adasum — the PR 1 error-feedback
+bar), EF residual-store keying (satellite 3: a tuner flipping
+algorithm / wire-format / topology mid-run lands on a FRESH key, never
+a stale residual), and rejection-message equality across the sync path
+and the engine route for reducescatter(Adasum) and Adasum+Join
+(satellite 2)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _stacked(n, shape, seed=0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return (scale * rng.randn(n, *shape)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_residuals():
+    from horovod_tpu.ops import adasum as am
+    am.reset_error_feedback()
+    yield
+    am.reset_error_feedback()
+
+
+# -- transport accuracy ----------------------------------------------------
+
+class TestQuantizedTransport:
+    @pytest.mark.parametrize("wire,rtol", [("bf16", 2e-2), ("int8", 5e-2)])
+    def test_flat_wire_tracks_exact_tree(self, hvd, wire, rtol):
+        n = hvd.size()
+        x = _stacked(n, (257,), seed=1)
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        exact = np.asarray(adasum_allreduce(x))
+        out = np.asarray(adasum_allreduce(x, wire=wire))
+        # every rank's row is the same tree value (symmetric combine on
+        # the same dequantized pair both sides)
+        for r in range(1, n):
+            np.testing.assert_allclose(out[r], out[0], atol=1e-5)
+        # and the value tracks the exact tree within the wire's noise
+        err = np.abs(out[0] - exact[0]).max()
+        assert err <= rtol * np.abs(exact[0]).max() + 1e-6, (wire, err)
+
+    @pytest.mark.parametrize("wire,rtol", [("bf16", 2e-2), ("int8", 5e-2)])
+    def test_hier_wire_tracks_exact_tree(self, hvd, wire, rtol):
+        n = hvd.size()
+        x = _stacked(n, (130,), seed=2)   # not a local_n multiple: pads
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        exact = np.asarray(adasum_allreduce(x, hierarchical=True,
+                                            local_size=2))
+        out = np.asarray(adasum_allreduce(x, hierarchical=True,
+                                          local_size=2, wire=wire))
+        for r in range(1, n):
+            np.testing.assert_allclose(out[r], out[0], atol=1e-5)
+        err = np.abs(out[0] - exact[0]).max()
+        assert err <= rtol * np.abs(exact[0]).max() + 1e-6, (wire, err)
+
+    def test_wire_validation(self, hvd):
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        n = hvd.size()
+        with pytest.raises(ValueError, match="adasum wire must be one of"):
+            adasum_allreduce(_stacked(n, (8,)), wire="fp4")
+        with pytest.raises(ValueError, match="float tensors only"):
+            adasum_allreduce(np.ones((n, 8), np.int32), wire="int8")
+
+    def test_int8_ef_unbiased_over_steps(self, hvd):
+        """The PR 1 EF bar, on Adasum itself: a toy least-squares SGD
+        whose gradient exchange is int8 Adasum must land within 2% of
+        the fp32-Adasum run's final loss (error feedback re-injects
+        each hop's quantization error next step, so the noise cancels
+        instead of compounding)."""
+        from horovod_tpu.ops.adasum import adasum_allreduce
+        n = hvd.size()
+        rng = np.random.RandomState(3)
+        A = rng.randn(n, 32, 64).astype(np.float32)
+        b = rng.randn(n, 32).astype(np.float32)
+        Aj, bj = jnp.asarray(A), jnp.asarray(b)
+
+        def loss(p):            # mean over ranks' local least squares
+            r = jnp.einsum("rij,j->ri", Aj, p) - bj
+            return jnp.mean(r * r)
+
+        def run(wire):
+            p = jnp.zeros((64,), jnp.float32)
+            grad = jax.jit(jax.grad(
+                lambda p, r: jnp.mean((Aj[r] @ p - bj[r]) ** 2)))
+            for _ in range(15):
+                g = jnp.stack([grad(p, r) for r in range(n)])
+                g = adasum_allreduce(g, wire=wire, ef_key=("toy", wire))
+                p = p - 0.05 * g[0]
+            return float(loss(p))
+
+        exact, quant = run("none"), run("int8")
+        assert abs(quant - exact) <= 0.02 * abs(exact), (exact, quant)
+        initial = float(loss(jnp.zeros((64,), jnp.float32)))
+        assert quant < 0.9 * initial            # it actually optimized
+
+
+# -- EF residual keying (satellite 3) --------------------------------------
+
+class TestEFResidualKeying:
+    def test_topology_and_format_changes_never_share_a_key(self, hvd):
+        """A mid-run flip of wire format, block size, topology or caller
+        scope must land on a fresh residual slot: each dimension is part
+        of the store key, so a stale residual from a different exchange
+        pattern can never be folded into a combine."""
+        from horovod_tpu.ops import adasum as am
+        n = hvd.size()
+        x = _stacked(n, (64,), seed=4)
+        am.adasum_allreduce(x, wire="int8")
+        am.adasum_allreduce(x, wire="int8", hierarchical=True,
+                            local_size=2)
+        am.adasum_allreduce(x, wire="int8", block_size=32)
+        am.adasum_allreduce(x, wire="int8", ef_key=("sig", "int8", "rhd"))
+        am.adasum_allreduce(_stacked(n, (65,), seed=4), wire="int8")
+        keys = am.ef_residual_keys()
+        assert len(keys) == len(set(keys)) == 5
+        topos = {k[3] for k in keys}
+        assert ("flat", n) in topos and ("hier", n // 2, 2) in topos
+        # bf16 carries no residual at all (relative rounding, no bias)
+        am.reset_error_feedback()
+        am.adasum_allreduce(x, wire="bf16")
+        assert am.ef_residual_keys() == ()
+
+    def test_engine_keys_fold_wire_and_scope(self, hvd):
+        """Through the engine route: the ef_key the engine passes is its
+        (fusion signature, group position), and the signature folds the
+        wire format — so an autotuner flipping HOROVOD_COMPRESSION
+        between steps re-keys instead of reusing."""
+        from horovod_tpu.ops import adasum as am, engine
+        n = hvd.size()
+        x = np.ones((n, 16), np.float32)
+        engine.grouped_allreduce([x], hvd.Adasum, compression="int8")
+        keys = am.ef_residual_keys()
+        assert len(keys) == 1
+        ef_key = keys[0][0]
+        assert "int8" in str(ef_key)            # wire folded into scope
+
+    def test_reset_and_budget(self, hvd):
+        from horovod_tpu.ops import adasum as am
+        n = hvd.size()
+        am.adasum_allreduce(_stacked(n, (64,)), wire="int8")
+        assert len(am.ef_residual_keys()) == 1
+        am.reset_error_feedback()
+        assert am.ef_residual_keys() == ()
+
+
+# -- rejection parity, sync path vs engine route (satellite 2) -------------
+
+class TestRejectionParity:
+    def test_reducescatter_adasum_same_message_both_paths(self, hvd):
+        from horovod_tpu.ops import adasum as am, collective_ops, engine
+        n = hvd.size()
+        x = np.ones((n, 8), np.float32)
+        msgs = []
+        for call in (lambda: collective_ops.reducescatter(x, hvd.Adasum),
+                     lambda: engine.reducescatter_async(x, hvd.Adasum),
+                     lambda: engine.grouped_reducescatter([x], hvd.Adasum)):
+            with pytest.raises(ValueError) as ei:
+                call()
+            msgs.append(str(ei.value))
+        assert msgs[0] == msgs[1] == msgs[2] == am.ADASUM_REDUCESCATTER_ERROR
+        assert "reducescatter(op=Average)" in msgs[0]   # alternative named
+
+    def test_adasum_join_same_message_both_paths(self, hvd):
+        from horovod_tpu.ops import adasum as am
+        n = hvd.size()
+        x = np.ones((n, 8), np.float32)
+        hvd.join(rank=1)
+        try:
+            with pytest.raises(ValueError) as ei:
+                hvd.allreduce(x, hvd.Adasum)
+            sync_msg = str(ei.value)
+            # engine route: the negotiation rejects; the handle carries
+            # the SAME single-sourced message
+            with pytest.raises(RuntimeError) as ei2:
+                hvd.synchronize(hvd.allreduce_async(x, hvd.Adasum,
+                                                    name="ada_join"))
+        finally:
+            hvd.join()
+        assert sync_msg == am.ADASUM_JOIN_ERROR
+        assert am.ADASUM_JOIN_ERROR in str(ei2.value)
+        assert "op=Average" in sync_msg                 # alternative named
+
+    def test_adasum_explicit_algo_rejected_at_enqueue(self, hvd):
+        from horovod_tpu.ops import engine
+        n = hvd.size()
+        x = np.ones((n, 8), np.float32)
+        with pytest.raises(ValueError,
+                           match="applies to Sum/Average only"):
+            engine.allreduce_async(x, hvd.Adasum, algo="rs_ag")
+        with pytest.raises(ValueError,
+                           match="applies to Sum/Average only"):
+            engine.grouped_allreduce([x], hvd.Adasum, algo="two_level")

@@ -294,13 +294,21 @@ def test_optimizer_int8_eager_and_ingraph(hvd):
 
 def test_int8_rejects_scale_sensitive_ops(hvd):
     """Per-rank scales make the quantized payload meaningless under
-    scale-sensitive reductions — the constructor must fail fast."""
+    scale-sensitive reductions — the constructor must fail fast. Adasum
+    graduated off this list (the transport round-trips per rank, the
+    projection math runs on dequantized fp32 — ops/adasum.py), so it
+    must now construct cleanly."""
     import optax
     from horovod_tpu.optim.compression import Compression
     from horovod_tpu.optim.optimizer import DistributedOptimizer
-    with pytest.raises(ValueError, match="Sum or op=Average"):
-        DistributedOptimizer(optax.sgd(1.0), op=hvd.Adasum,
-                             compression=Compression.int8)
+    from horovod_tpu.core.types import ReduceOp
+    for op in (hvd.Min, hvd.Max, ReduceOp.PRODUCT):
+        with pytest.raises(ValueError,
+                           match="op=Sum, op=Average or op=Adasum"):
+            DistributedOptimizer(optax.sgd(1.0), op=op,
+                                 compression=Compression.int8)
+    DistributedOptimizer(optax.sgd(1.0), op=hvd.Adasum,
+                         compression=Compression.int8)
 
 
 # -- config validation ------------------------------------------------------

@@ -1,0 +1,39 @@
+"""ISSUE 20 np4 convergence acceptance (slow tier): the tentpole cell
+— int8-quantized Adasum transport with per-hop error feedback — under
+a REAL 4-process ``hvdrun`` launch.
+
+The bar: every rank records the SAME loss curve (the engine-negotiated
+quantized exchange kept real processes together), the curve descends,
+and the launcher exits cleanly within the timeout. Driven through the
+tools/converge.py CLI so the CLI contract (JSON verdict on stdout,
+exit code) is covered by the same run — the wiring the chaos soak
+acceptance tests use."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.mark.slow
+def test_np4_int8_adasum_converge_acceptance(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "converge.py"),
+         "--np", "4", "--model", "gpt_tiny", "--fmt", "int8",
+         "--op", "adasum", "--out", str(tmp_path), "--timeout", "720"],
+        env=env, capture_output=True, text=True, timeout=780)
+    assert out.stdout.strip(), out.stderr[-3000:]
+    verdict = json.loads(out.stdout)
+    detail = json.dumps(verdict, indent=2, sort_keys=True)[:3000]
+    assert verdict["no_deadlock"], detail
+    assert verdict["curves_complete"], detail
+    assert verdict["curves_identical"], detail
+    assert verdict["descended"], detail
+    assert verdict["cell"] == "int8xadasumxdirect", detail
+    assert verdict["ok"] and out.returncode == 0, detail

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Convergence-harness CLI: run the (wire-format x op x algorithm)
+matrix in-process, or one cell under a REAL ``-np N`` multi-process
+launch, and print the JSON verdict (exit 0 iff every invariant held).
+
+    python tools/converge.py                       # in-process matrix
+    python tools/converge.py --models gpt_tiny --steps 10
+    python tools/converge.py --np 4                # multi-process cell
+    python tools/converge.py --np 4 --fmt int8 --op adasum
+
+In-process mode is what ``bench.py --converge`` gates on: every
+runnable cell within its documented tolerance (docs/benchmarks.md,
+convergence section), every rejected-by-design cell failing fast with
+its structured message. ``--np`` mode launches real worker processes
+through the runner and asserts the cross-process invariants instead:
+identical per-rank loss curves, descent, no deadlock.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--np", dest="np_", type=int, default=0,
+                   help="worker processes; 0 (default) = in-process "
+                        "matrix over the forced 8-device CPU mesh")
+    p.add_argument("--models", default=None,
+                   help="comma-separated bench_zoo rows (default: "
+                        "HOROVOD_CONVERGE_MODELS)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="optimization steps per cell (default: "
+                        "HOROVOD_CONVERGE_STEPS)")
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--model", default="gpt_tiny",
+                   help="--np mode: the one model to train")
+    p.add_argument("--fmt", default="int8",
+                   help="--np mode: wire format (none|bf16|int8)")
+    p.add_argument("--op", default="adasum",
+                   help="--np mode: reduction op (sum|avg|adasum)")
+    p.add_argument("--algo", default="direct",
+                   help="--np mode: transport algorithm")
+    p.add_argument("--out", default=None,
+                   help="--np mode: output dir (default: temp dir)")
+    p.add_argument("--timeout", type=float, default=420.0,
+                   help="--np mode: no-deadlock bound, seconds")
+    args = p.parse_args(argv)
+
+    if args.np_ > 0:
+        from horovod_tpu.converge.proc import run_converge_proc
+        out = args.out or tempfile.mkdtemp(prefix="hvd_converge_")
+        verdict = run_converge_proc(
+            out, np_=args.np_, model=args.model, fmt=args.fmt,
+            op=args.op, algo=args.algo,
+            **({"steps": args.steps} if args.steps is not None else {}),
+            **({"lr": args.lr} if args.lr is not None else {}),
+            **({"seed": args.seed} if args.seed is not None else {}),
+            timeout_s=args.timeout)
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import horovod_tpu as hvd
+        from horovod_tpu.converge.harness import run_matrix
+        hvd.init()
+        models = None if args.models is None else \
+            [m.strip() for m in args.models.split(",") if m.strip()]
+        verdict = run_matrix(models, steps=args.steps, lr=args.lr,
+                             seed=args.seed)
+    json.dump(verdict, sys.stdout, indent=2, sort_keys=True)
+    print()
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
